@@ -1,0 +1,66 @@
+"""Headline benchmark — prints ONE JSON line.
+
+Metric: steady-state decode throughput (tokens/sec) of the serving forward
+path on the available chip (qwen2-0.5b-geometry model, randomly initialized —
+zero-egress environment, so no weight downloads; throughput is
+weight-value-independent).
+
+The reference publishes no benchmark numbers (BASELINE.md), so ``vs_baseline``
+is reported against this repo's recorded round-0 target below.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# Round-0 target for this metric (tokens/sec); see BASELINE.md — reference
+# publishes nothing, so this anchors cross-round comparisons.
+TARGET_TOKENS_PER_SEC = 2000.0
+
+BATCH = 8
+PREFILL = 128
+DECODE_STEPS = 32
+
+
+def main():
+    from rbg_tpu.models import KVCache, forward, get_config, init_params
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = get_config("qwen2-0.5b" if on_tpu else "tiny")
+    params = init_params(cfg, jax.random.key(0))
+
+    S = PREFILL + DECODE_STEPS + 8
+    tokens = jax.random.randint(jax.random.key(1), (BATCH, PREFILL), 0, cfg.vocab_size)
+    cache = KVCache.create(cfg, BATCH, S)
+
+    fwd = jax.jit(lambda p, t, c: forward(p, cfg, t, c), donate_argnums=(2,))
+
+    # Prefill
+    logits, cache = fwd(params, tokens, cache)
+    tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+
+    # Warm up decode compile
+    logits, cache = fwd(params, tok, cache)
+    tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+    jax.block_until_ready(tok)
+
+    start = time.perf_counter()
+    for _ in range(DECODE_STEPS):
+        logits, cache = fwd(params, tok, cache)
+        tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+    jax.block_until_ready(tok)
+    elapsed = time.perf_counter() - start
+
+    tps = BATCH * DECODE_STEPS / elapsed
+    print(json.dumps({
+        "metric": f"decode_throughput_{cfg.name}_bs{BATCH}_{jax.devices()[0].platform}",
+        "value": round(tps, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tps / TARGET_TOKENS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

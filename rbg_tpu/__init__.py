@@ -1,0 +1,25 @@
+"""rbg_tpu — a TPU-native role-based orchestration + serving framework.
+
+One framework, two planes (see SURVEY.md for the reference analysis):
+
+* **Control plane** (``rbg_tpu.api``, ``rbg_tpu.runtime``, ``rbg_tpu.discovery``,
+  ``rbg_tpu.sched``, ``rbg_tpu.coordination``): a ground-up re-design of the
+  reference RoleBasedGroup operator (sgl-project/rbg — a Go/Kubernetes control
+  plane, ``/root/reference``). A distributed LLM inference service is modeled as
+  a *group of roles* (router → prefill → decode); the plane places, wires,
+  scales, updates, and heals them as one unit. Here the plane is re-targeted at
+  TPU pod slices: ICI/DCN-aware placement, JAX coordinator discovery, and
+  multi-host-slice roles are first class.
+
+* **Data plane** (``rbg_tpu.models``, ``rbg_tpu.ops``, ``rbg_tpu.parallel``,
+  ``rbg_tpu.engine``): the serving engine the control plane orchestrates — a
+  JAX/XLA-native equivalent of the SGLang engines the reference deploys:
+  paged-KV continuous batching, tensor/sequence parallel via ``jax.sharding``
+  meshes, Pallas kernels for the hot ops, and prefill/decode disaggregation.
+
+The reference keeps these planes in separate projects (RBG orchestrates; SGLang
+serves). We ship both so that a single repo provides the full capability
+surface on TPU hardware.
+"""
+
+__version__ = "0.1.0"

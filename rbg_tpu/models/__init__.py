@@ -1,0 +1,7 @@
+from rbg_tpu.models.config import ModelConfig, get_config, list_presets
+from rbg_tpu.models.llama import KVCache, forward, init_params
+
+__all__ = [
+    "ModelConfig", "get_config", "list_presets",
+    "KVCache", "forward", "init_params",
+]

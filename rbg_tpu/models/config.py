@@ -1,0 +1,94 @@
+"""Model configurations for the llama-family decoder.
+
+One config dataclass covers the model families the reference's examples deploy
+(reference: ``examples/inference/*.yaml`` deploy Qwen/Llama/DeepSeek via
+SGLang). Presets below mirror the benchmark configs in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Hyperparameters of a llama-family (pre-norm, RoPE, GQA, SwiGLU) decoder."""
+
+    name: str = "tiny"
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_layers: int = 16
+    num_heads: int = 16
+    num_kv_heads: int = 4
+    head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    @property
+    def jax_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def num_params(self) -> int:
+        """Approximate parameter count (embeddings + blocks + head)."""
+        d, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        hd = self.head_dim_
+        attn = d * self.num_heads * hd + 2 * d * self.num_kv_heads * hd + self.num_heads * hd * d
+        mlp = 3 * d * f
+        per_layer = attn + mlp + 2 * d
+        head = 0 if self.tie_word_embeddings else d * v
+        return v * d + self.num_layers * per_layer + d + head
+
+
+_PRESETS = {
+    # Tiny config for tests — compiles in seconds on CPU.
+    "tiny": ModelConfig(
+        name="tiny", vocab_size=256, hidden_size=128, intermediate_size=384,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256,
+        rope_theta=10000.0, dtype="float32",
+    ),
+    # Small config for single-chip benching — fits v5e-1 HBM easily.
+    "qwen2-0.5b": ModelConfig(
+        name="qwen2-0.5b", vocab_size=151936, hidden_size=896,
+        intermediate_size=4864, num_layers=24, num_heads=14, num_kv_heads=2,
+        head_dim=64, max_seq_len=32768, rope_theta=1000000.0,
+        tie_word_embeddings=True,
+    ),
+    "llama3-1b": ModelConfig(
+        name="llama3-1b", vocab_size=128256, hidden_size=2048,
+        intermediate_size=8192, num_layers=16, num_heads=32, num_kv_heads=8,
+        max_seq_len=131072, rope_theta=500000.0, tie_word_embeddings=True,
+    ),
+    "llama3-8b": ModelConfig(
+        name="llama3-8b", vocab_size=128256, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        max_seq_len=131072, rope_theta=500000.0,
+    ),
+    "llama3-70b": ModelConfig(
+        name="llama3-70b", vocab_size=128256, hidden_size=8192,
+        intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
+        max_seq_len=131072, rope_theta=500000.0,
+    ),
+}
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    if name not in _PRESETS:
+        raise KeyError(f"unknown model preset {name!r}; have {sorted(_PRESETS)}")
+    cfg = _PRESETS[name]
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def list_presets():
+    return sorted(_PRESETS)

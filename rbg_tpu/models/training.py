@@ -1,0 +1,108 @@
+"""Training step: next-token cross-entropy + optax, sharded over a mesh.
+
+Used for warm-start fine-tuning and as the multi-chip compile target the
+orchestration plane provisions slices for (``dryrun_multichip`` in
+``__graft_entry__.py`` jits this over a dp×sp×tp mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rbg_tpu.models.config import ModelConfig
+from rbg_tpu.models.llama import forward_train
+from rbg_tpu.parallel import sharding as shd
+
+
+def next_token_loss(params, cfg: ModelConfig, tokens, token_mask=None):
+    """Mean next-token cross-entropy over non-pad positions."""
+    B, T = tokens.shape
+    if token_mask is None:
+        token_mask = jnp.ones((B, T), bool)
+    logits = forward_train(params, cfg, tokens, token_mask)  # [B, T, V]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    w = token_mask[:, 1:].astype(jnp.float32)
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def make_train_step(cfg: ModelConfig, mesh: Mesh, learning_rate: float = 3e-4):
+    """Build (init_fn, train_step) jitted over ``mesh``.
+
+    Shardings: params per Megatron rules (tp), batch over dp, sequence over sp.
+    XLA inserts the gradient psums across dp and the tp collectives.
+    """
+    tx = optax.adamw(learning_rate)
+    pspecs = shd.param_specs(cfg)
+    param_sh = shd.named(mesh, pspecs)
+    tok_sh = NamedSharding(mesh, P("dp", "sp"))
+
+    def _opt_shardings(params_like):
+        """Optimizer-state shardings by tree structure: any state subtree
+        congruent to the params pytree (optax moment trees) inherits the param
+        shardings leaf-for-leaf; everything else (counts, scalars) replicates."""
+        state_shape = jax.eval_shape(tx.init, params_like)
+        ptree = jax.tree_util.tree_structure(params_like)
+        replicated = NamedSharding(mesh, P())
+
+        def is_params_like(node):
+            try:
+                return jax.tree_util.tree_structure(node) == ptree
+            except Exception:
+                return False
+
+        def assign(node):
+            if is_params_like(node):
+                return param_sh
+            return jax.tree_util.tree_map(lambda _: replicated, node)
+
+        return jax.tree_util.tree_map(assign, state_shape, is_leaf=is_params_like)
+
+    def init_fn(params):
+        params = jax.device_put(params, param_sh)
+        opt_sh = _opt_shardings(params)
+        opt_state = jax.jit(tx.init, out_shardings=opt_sh)(params)
+        return params, opt_state
+
+    def _step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(next_token_loss)(params, cfg, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def make_step(params_like):
+        opt_sh = _opt_shardings(params_like)
+        return jax.jit(
+            _step,
+            in_shardings=(param_sh, opt_sh, tok_sh),
+            out_shardings=(param_sh, opt_sh, NamedSharding(mesh, P())),
+            donate_argnums=(0, 1),
+        )
+
+    class _LazyStep:
+        """Binds opt-state shardings on first call (needs concrete params)."""
+
+        _jitted = None
+
+        def __call__(self, params, opt_state, tokens):
+            if self._jitted is None:
+                self._jitted = make_step(params)
+            return self._jitted(params, opt_state, tokens)
+
+    return init_fn, _LazyStep()
+
+
+def train_n_steps(cfg: ModelConfig, mesh: Mesh, params, tokens, n: int) -> Tuple[dict, jnp.ndarray]:
+    """Convenience loop for tests: run n steps, return (params, last loss)."""
+    init_fn, step = make_train_step(cfg, mesh)
+    params, opt_state = init_fn(params)
+    loss = None
+    for _ in range(n):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    return params, loss

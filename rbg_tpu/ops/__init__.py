@@ -1,0 +1,5 @@
+from rbg_tpu.ops.attention import gqa_attention
+from rbg_tpu.ops.norms import rms_norm
+from rbg_tpu.ops.rope import apply_rope
+
+__all__ = ["gqa_attention", "rms_norm", "apply_rope"]

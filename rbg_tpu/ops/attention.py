@@ -1,0 +1,50 @@
+"""Grouped-query attention over a contiguous KV cache.
+
+Dense XLA formulation: einsum → f32 softmax → einsum. On TPU, XLA tiles these
+matmuls onto the MXU and fuses the mask/softmax; a Pallas flash/paged kernel
+(``rbg_tpu.ops.paged_attention``) replaces this on the serving hot path for
+long contexts. Shapes are static everywhere — positions and lengths are data,
+not shapes, so one compiled program serves both prefill and decode.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def gqa_attention(
+    q: jnp.ndarray,          # [B, T, H, hd]
+    k: jnp.ndarray,          # [B, S, KV, hd]
+    v: jnp.ndarray,          # [B, S, KV, hd]
+    q_positions: jnp.ndarray,  # [B, T] int32 — absolute position of each query
+    kv_valid: jnp.ndarray,   # [B, S] bool — cache slot holds a real token
+) -> jnp.ndarray:
+    """Causal GQA. Slot index == absolute position (contiguous cache), so the
+    causal rule is simply ``slot <= q_position`` ∧ ``slot is valid``.
+
+    Returns [B, T, H, hd] in q.dtype.
+    """
+    B, T, H, hd = q.shape
+    S = k.shape[1]
+    KV = k.shape[2]
+    G = H // KV  # query groups per KV head
+
+    qg = q.reshape(B, T, KV, G, hd).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # scores: [B, KV, G, T, S]
+    scores = jnp.einsum("btkgh,bskh->bkgts", qg, kf) / jnp.sqrt(hd).astype(jnp.float32)
+
+    slot = jnp.arange(S, dtype=jnp.int32)[None, None, :]          # [1, 1, S]
+    causal = slot <= q_positions[:, :, None]                      # [B, T, S]
+    mask = jnp.logical_and(causal, kv_valid[:, None, :])          # [B, T, S]
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
+
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+
+    out = jnp.einsum("bkgts,bskh->btkgh", probs, vf)
+    return out.reshape(B, T, H, hd).astype(q.dtype)

@@ -1,0 +1,16 @@
+"""Normalization ops. RMSNorm is computed in float32 regardless of input dtype
+(matches standard llama-family numerics) and cast back — XLA fuses the whole
+thing into the surrounding matmul epilogue on TPU, so no custom kernel needed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (y * weight.astype(jnp.float32)).astype(dtype)

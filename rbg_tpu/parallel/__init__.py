@@ -1,0 +1,10 @@
+from rbg_tpu.parallel.mesh import AXES, make_mesh, mesh_from_spec
+from rbg_tpu.parallel.sharding import (
+    cache_specs, logits_spec, named, param_specs, shard_pytree, tokens_spec,
+)
+
+__all__ = [
+    "AXES", "make_mesh", "mesh_from_spec",
+    "param_specs", "cache_specs", "tokens_spec", "logits_spec",
+    "shard_pytree", "named",
+]

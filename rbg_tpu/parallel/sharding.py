@@ -1,0 +1,77 @@
+"""Sharding rules: map the model's param/activation pytrees to PartitionSpecs.
+
+Megatron-style layout expressed declaratively; XLA inserts the collectives:
+
+* column-parallel projections (wq/wk/wv/w_gate/w_up): output dim on ``tp``
+* row-parallel projections (wo/w_down): input dim on ``tp`` (XLA emits the
+  psum on the residual add)
+* embedding + lm_head: vocab dim on ``tp``
+* activations: batch on ``dp``, sequence on ``sp`` (ring attention path)
+* KV cache: kv-head dim on ``tp``, batch on ``dp``
+
+Everything goes through ``jax.jit``'s in_shardings/out_shardings — no manual
+collectives on this path (shard_map kernels live in rbg_tpu.parallel.ring).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rbg_tpu.models.config import ModelConfig
+
+
+def param_specs(cfg: ModelConfig) -> dict:
+    """PartitionSpec pytree matching ``rbg_tpu.models.llama.init_params``.
+
+    Leading axis of every block param is the scan/layer axis (unsharded).
+    """
+    specs = {
+        "embed": P("tp", None),
+        "blocks": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def cache_specs() -> dict:
+    """Specs for KVCache fields (k/v: [L, B, S, KV, hd])."""
+    kv = P(None, "dp", None, "tp", None)
+    return {"k": kv, "v": kv, "length": P("dp")}
+
+
+def tokens_spec() -> P:
+    return P("dp", None)
+
+
+def logits_spec() -> P:
+    return P("dp", None, "tp")
+
+
+def shard_pytree(tree, specs, mesh: Mesh):
+    """Device-put a pytree according to a spec pytree."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def named(mesh: Mesh, spec_tree):
+    """Map a PartitionSpec pytree to a NamedSharding pytree."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda s: isinstance(s, P),
+    )

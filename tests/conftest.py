@@ -1,0 +1,30 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax imports.
+
+Mirrors the reference's envtest approach (SURVEY.md §4: real apiserver, no
+kubelet, synthetic status) — here: real XLA, no TPU, virtual 8-device mesh.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment may pre-import jax (site customization registering a TPU
+# plugin), in which case env vars above are too late — force via config.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from rbg_tpu.parallel import make_mesh
+    return make_mesh(dp=2, sp=2, tp=2)

@@ -1,0 +1,48 @@
+"""Resource model (the CRD-equivalent API surface). See SURVEY.md §2 #1-3."""
+
+from rbg_tpu.api import constants
+from rbg_tpu.api.group import (
+    ComponentSpec, EngineRuntimeRef, GroupTemplate, LeaderWorkerSpec,
+    PatternType, RestartPolicy, RestartPolicyConfig, RoleBasedGroup,
+    RoleBasedGroupSet, RoleBasedGroupSetSpec, RoleBasedGroupSpec,
+    RoleBasedGroupStatus, RoleSpec, RoleStatus, RoleTemplate, RollingUpdate,
+    ScalingAdapterHook, TpuSpec,
+)
+from rbg_tpu.api.instance import (
+    ComponentStatus, ControllerRevision, InstanceTemplate, ReadyPolicy,
+    RoleInstance, RoleInstanceSet, RoleInstanceSetSpec, RoleInstanceSetStatus,
+    RoleInstanceSpec, RoleInstanceStatus,
+)
+from rbg_tpu.api.meta import (
+    Condition, ObjectMeta, OwnerReference, get_condition, owner_ref,
+    set_condition,
+)
+from rbg_tpu.api.pod import (
+    ConfigMap, Container, EnvVar, Node, NodeAffinityTerm, Pod, PodStatus,
+    PodTemplate, Port, Resources, Service, TpuNodeInfo,
+)
+from rbg_tpu.api.policy import (
+    CoordinatedPolicy, CoordinatedPolicySpec, CoordinatedRollingUpdate,
+    CoordinatedScaling, EngineRuntimeProfile, PodGroup, PodGroupSpec,
+    PodGroupStatus, ProgressionGate, ScalingAdapter, ScalingAdapterSpec,
+    ScalingAdapterStatus, Warmup, WarmupSpec, WarmupStatus, WarmupTarget,
+)
+from rbg_tpu.api.serde import from_dict, load_yaml_docs, to_dict, to_yaml
+
+KINDS = {
+    cls.__name__: cls
+    for cls in (
+        RoleBasedGroup, RoleBasedGroupSet, RoleInstanceSet, RoleInstance,
+        ControllerRevision, CoordinatedPolicy, ScalingAdapter, Warmup,
+        EngineRuntimeProfile, RoleTemplate, Pod, Node, Service, ConfigMap,
+        PodGroup,
+    )
+}
+
+
+def parse_manifest(doc: dict):
+    """Build a typed resource from a parsed YAML document (kind-dispatched)."""
+    kind = doc.get("kind")
+    if kind not in KINDS:
+        raise KeyError(f"unknown kind {kind!r}; known: {sorted(KINDS)}")
+    return from_dict(KINDS[kind], doc)

@@ -1,0 +1,92 @@
+"""Label / annotation / env-var contracts — the per-object config plane.
+
+Reference analog: ``api/workloads/constants`` (inventory #3,
+``label.go:22-102``, ``annotation.go:22-228``, ``env.go:24-79``). Same role
+here: labels ARE the data-plane contract (discovery reads them), annotations
+are per-object feature flags, envs are what engines consume.
+
+TPU-specific additions replace the GPU-era rendezvous contract
+(``RBG_LWP_LEADER_ADDRESS`` consumed as torch ``--dist-init-addr``,
+``env.go:56-68``) with the JAX distributed-init contract: coordinator
+address, process index/count, slice topology, and mesh coordinates.
+"""
+
+DOMAIN = "rbg.tpu.x-k8s.io"
+
+# ---- labels (identity; reference: label.go:22-102) ----
+LABEL_GROUP_NAME = f"{DOMAIN}/group-name"
+LABEL_ROLE_NAME = f"{DOMAIN}/role-name"
+LABEL_GROUP_SET_NAME = f"{DOMAIN}/groupset-name"
+LABEL_GROUP_SET_INDEX = f"{DOMAIN}/groupset-index"
+LABEL_INSTANCE_NAME = f"{DOMAIN}/role-instance-name"
+LABEL_INSTANCE_INDEX = f"{DOMAIN}/role-instance-index"
+LABEL_COMPONENT_NAME = f"{DOMAIN}/component-name"
+LABEL_COMPONENT_ID = f"{DOMAIN}/component-id"
+LABEL_COMPONENT_INDEX = f"{DOMAIN}/component-index"
+LABEL_GROUP_REVISION = f"{DOMAIN}/group-revision"
+LABEL_ROLE_REVISION_PREFIX = f"{DOMAIN}/role-revision-"
+LABEL_REVISION_NAME = f"{DOMAIN}/revision-name"
+LABEL_POD_GROUP = f"{DOMAIN}/pod-group"
+
+# ---- annotations (feature flags; reference: annotation.go:22-228) ----
+ANN_GANG_SCHEDULING = f"{DOMAIN}/gang-scheduling"        # "true"/"false"
+ANN_EXCLUSIVE_TOPOLOGY = f"{DOMAIN}/exclusive-topology"  # topology key
+ANN_INSTANCE_PATTERN = f"{DOMAIN}/role-instance-pattern"  # stateful|stateless
+ANN_RESTART_TRIGGER_POLICY = f"{DOMAIN}/restart-trigger-policy"  # Ignore
+ANN_INPLACE_SCHEDULING = f"{DOMAIN}/in-place-scheduling"  # granularity
+ANN_PORT_ALLOCATOR = f"{DOMAIN}/port-allocator"          # JSON config
+ANN_ALLOCATED_PORTS = f"{DOMAIN}/allocated-ports"        # JSON result
+ANN_COMPONENT_DEPENDS_ON = f"{DOMAIN}/component-depends-on"  # JSON
+ANN_SLICE_BINDING = f"{DOMAIN}/slice-binding"            # recorded slice id
+ANN_DISCOVERY_CONFIG_MODE = f"{DOMAIN}/discovery-config-mode"  # legacy|refine
+
+# ---- env vars injected into engine processes (reference: env.go:24-79) ----
+ENV_GROUP_NAME = "RBG_GROUP_NAME"
+ENV_ROLE_NAME = "RBG_ROLE_NAME"
+ENV_ROLE_INDEX = "RBG_ROLE_INDEX"
+ENV_ROLE_REPLICAS = "RBG_ROLE_REPLICAS"
+ENV_COMPONENT_NAME = "RBG_COMPONENT_NAME"
+ENV_CONFIG_PATH = "RBG_CONFIG_PATH"     # topology config mount path
+ENV_POD_NAME = "RBG_POD_NAME"
+
+# JAX distributed-init contract for multi-host slice roles. These replace the
+# reference's leader-worker envs (RBG_LWP_LEADER_ADDRESS / RBG_LWP_WORKER_INDEX /
+# RBG_LWP_GROUP_SIZE, env.go:56-68): engines call
+# jax.distributed.initialize(coordinator_address, num_processes, process_id).
+ENV_JAX_COORDINATOR = "RBG_JAX_COORDINATOR_ADDRESS"
+ENV_JAX_NUM_PROCESSES = "RBG_JAX_NUM_PROCESSES"
+ENV_JAX_PROCESS_ID = "RBG_JAX_PROCESS_ID"
+ENV_TPU_SLICE_TOPOLOGY = "RBG_TPU_SLICE_TOPOLOGY"   # e.g. "2x4"
+ENV_TPU_ACCELERATOR = "RBG_TPU_ACCELERATOR"         # e.g. "v5e"
+ENV_TPU_MESH_COORDS = "RBG_TPU_MESH_COORDS"         # host coords in slice, "x,y"
+ENV_MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"  # multi-slice DCN
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+
+# ---- defaults ----
+DISCOVERY_MOUNT_PATH = "/etc/rbg"
+DISCOVERY_CONFIG_FILE = "config.yaml"
+MAX_NAME_LEN = 63
+
+# ---- condition types ----
+COND_READY = "Ready"
+COND_UPDATE_IN_PROGRESS = "UpdateInProgress"
+COND_RESTART_IN_PROGRESS = "Restarting"
+COND_ALL_PODS_READY = "AllPodsReady"
+COND_INPLACE_UPDATE_READY = "InPlaceUpdateReady"
+
+
+def workload_name(group: str, role: str) -> str:
+    """Child workload name ``{group}-{role}`` truncated to 63 chars with
+    trailing '-' trimmed (reference: helper.go:87-100)."""
+    return f"{group}-{role}"[:MAX_NAME_LEN].rstrip("-")
+
+
+def service_name(group: str, role: str) -> str:
+    """Headless-service name ``s-{group}-{role}`` (DNS-1035: must not start
+    with a digit — reference: helper.go:106-115)."""
+    return f"s-{group}-{role}"[:MAX_NAME_LEN].rstrip("-")
+
+
+def role_revision_label(role: str) -> str:
+    return (LABEL_ROLE_REVISION_PREFIX + role)[:MAX_NAME_LEN]

@@ -1,0 +1,248 @@
+"""RoleBasedGroup — the root resource: a list of coordinated roles.
+
+Reference analog: ``api/workloads/v1alpha2/rolebasedgroup_types.go`` (inventory
+#1): ``RoleSpec`` (:203), patterns standalone/leaderWorker/customComponents
+(:300-312, :335, :368-433), ``RestartPolicyConfig`` backoff (:164-187),
+``EngineRuntime`` hook (:392-402). TPU-first change: ``leaderWorkerPattern.size``
+(how many GPU nodes form one model instance) becomes ``TpuSpec.slice_topology``
+— one role replica = one multi-host TPU slice, and the plane derives the gang
+size from the topology instead of asking for a raw count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional
+
+from rbg_tpu.api.meta import Condition, ObjectMeta
+from rbg_tpu.api.pod import PodTemplate
+
+
+class PatternType(str, enum.Enum):
+    STANDALONE = "standalone"
+    LEADER_WORKER = "leaderWorker"
+    CUSTOM_COMPONENTS = "customComponents"
+
+
+@dataclasses.dataclass
+class ComponentSpec:
+    """One component of a customComponents role (reference: :368-433 +
+    KEP-173): heterogeneous intra-role groups (router + worker + cache)."""
+
+    name: str = ""
+    size: int = 1
+    template: Optional[PodTemplate] = None
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class LeaderWorkerSpec:
+    """Leader + N-1 workers per role instance. ``size`` may be omitted for TPU
+    roles — it is then derived from tpu.slice_topology (hosts per slice)."""
+
+    size: int = 0
+    leader_template: Optional[PodTemplate] = None  # defaults to role template
+    worker_template: Optional[PodTemplate] = None
+
+
+@dataclasses.dataclass
+class TpuSpec:
+    """First-class TPU placement request for a role.
+
+    Replaces the reference's GPU-implicit knobs (BASELINE.json north star):
+    one role replica occupies one ``slice_topology`` slice of ``accelerator``
+    chips; the plane gang-places its hosts into a single ICI domain and
+    injects the JAX coordinator + mesh coordinates (rbg_tpu.discovery).
+    """
+
+    accelerator: str = ""       # v5e | v5p | v6e ...
+    slice_topology: str = ""    # e.g. "2x4" (chips); hosts derived per accel
+    chips_per_host: int = 4
+
+    @property
+    def total_chips(self) -> int:
+        if not self.slice_topology:
+            return 0
+        n = 1
+        for part in self.slice_topology.lower().split("x"):
+            n *= int(part)
+        return n
+
+    @property
+    def num_hosts(self) -> int:
+        chips = self.total_chips
+        if chips == 0:
+            return 0
+        return max(1, chips // max(1, self.chips_per_host))
+
+
+class RestartPolicy(str, enum.Enum):
+    NONE = "None"
+    RECREATE_INSTANCE_ON_POD_RESTART = "RecreateRoleInstanceOnPodRestart"
+    RECREATE_GROUP_ON_POD_RESTART = "RecreateGroupOnPodRestart"
+
+
+@dataclasses.dataclass
+class RestartPolicyConfig:
+    """Restart policy + exponential backoff (reference: :164-187; backoff math
+    ``min(base·2^(n-1), max)`` in ``sync/instance_scale.go:482-506``)."""
+
+    policy: RestartPolicy = RestartPolicy.RECREATE_INSTANCE_ON_POD_RESTART
+    base_delay_seconds: float = 1.0
+    max_delay_seconds: float = 300.0
+    window_seconds: float = 600.0   # restart-count decay window
+
+
+@dataclasses.dataclass
+class RollingUpdate:
+    """Rolling update knobs (reference: RIS update strategy,
+    ``roleinstanceset_reconciler.go:231-252``)."""
+
+    max_unavailable: int = 1
+    max_surge: int = 0
+    partition: int = 0
+    in_place_if_possible: bool = True
+
+
+@dataclasses.dataclass
+class ScalingAdapterHook:
+    """Auto-create a ScalingAdapter for this role (reference: KEP-29,
+    ``rolebasedgroup_controller.go:896-953``)."""
+
+    enabled: bool = False
+    min_replicas: int = 0
+    max_replicas: int = 0
+
+
+@dataclasses.dataclass
+class EngineRuntimeRef:
+    """Reference to an EngineRuntimeProfile + per-container overrides
+    (reference: ``rolebasedgroup_types.go:392-402``)."""
+
+    profile_name: str = ""
+    container_args: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    container_env: Dict[str, Dict[str, str]] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class RoleSpec:
+    name: str = ""
+    replicas: int = 1
+    dependencies: List[str] = dataclasses.field(default_factory=list)
+    pattern: PatternType = PatternType.STANDALONE
+    leader_worker: Optional[LeaderWorkerSpec] = None
+    components: List[ComponentSpec] = dataclasses.field(default_factory=list)
+    template: PodTemplate = dataclasses.field(default_factory=PodTemplate)
+    template_ref: str = ""      # RoleTemplate name (KEP-8 yaml-dedup)
+    tpu: Optional[TpuSpec] = None
+    restart_policy: RestartPolicyConfig = dataclasses.field(default_factory=RestartPolicyConfig)
+    rolling_update: RollingUpdate = dataclasses.field(default_factory=RollingUpdate)
+    scaling_adapter: Optional[ScalingAdapterHook] = None
+    engine_runtime: Optional[EngineRuntimeRef] = None
+    stateful: bool = True       # ordered identity (TPU slices want this)
+    workload: str = "RoleInstanceSet"  # strategy selector (inventory #23)
+
+    __serde_keep__ = ("name",)
+
+    def gang_size(self) -> int:
+        """Pods per role instance."""
+        if self.pattern == PatternType.LEADER_WORKER:
+            if self.leader_worker and self.leader_worker.size:
+                return self.leader_worker.size
+            if self.tpu:
+                return max(1, self.tpu.num_hosts)
+            return 1
+        if self.pattern == PatternType.CUSTOM_COMPONENTS:
+            return sum(c.size for c in self.components) or 1
+        return 1
+
+
+@dataclasses.dataclass
+class RoleStatus:
+    name: str = ""
+    replicas: int = 0
+    ready_replicas: int = 0
+    updated_replicas: int = 0
+    updated_ready_replicas: int = 0
+    observed_revision: str = ""
+
+    __serde_keep__ = ("name", "replicas", "ready_replicas")
+
+
+@dataclasses.dataclass
+class RoleBasedGroupSpec:
+    roles: List[RoleSpec] = dataclasses.field(default_factory=list)
+
+    def role(self, name: str) -> Optional[RoleSpec]:
+        for r in self.roles:
+            if r.name == name:
+                return r
+        return None
+
+
+@dataclasses.dataclass
+class RoleBasedGroupStatus:
+    observed_generation: int = 0
+    roles: List[RoleStatus] = dataclasses.field(default_factory=list)
+    conditions: List[Condition] = dataclasses.field(default_factory=list)
+    current_revision: str = ""
+
+    def role(self, name: str) -> Optional[RoleStatus]:
+        for r in self.roles:
+            if r.name == name:
+                return r
+        return None
+
+
+@dataclasses.dataclass
+class RoleBasedGroup:
+    kind: str = "RoleBasedGroup"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: RoleBasedGroupSpec = dataclasses.field(default_factory=RoleBasedGroupSpec)
+    status: RoleBasedGroupStatus = dataclasses.field(default_factory=RoleBasedGroupStatus)
+
+    __serde_keep__ = ("kind", "metadata")
+
+
+@dataclasses.dataclass
+class GroupTemplate:
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: RoleBasedGroupSpec = dataclasses.field(default_factory=RoleBasedGroupSpec)
+
+
+@dataclasses.dataclass
+class RoleBasedGroupSetSpec:
+    replicas: int = 1
+    template: GroupTemplate = dataclasses.field(default_factory=GroupTemplate)
+
+
+@dataclasses.dataclass
+class RoleBasedGroupSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclasses.dataclass
+class RoleBasedGroupSet:
+    """Replicated RBGs from a template (reference: inventory #7,
+    ``rolebasedgroupset_controller.go``)."""
+
+    kind: str = "RoleBasedGroupSet"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: RoleBasedGroupSetSpec = dataclasses.field(default_factory=RoleBasedGroupSetSpec)
+    status: RoleBasedGroupSetStatus = dataclasses.field(default_factory=RoleBasedGroupSetStatus)
+
+    __serde_keep__ = ("kind", "metadata")
+
+
+@dataclasses.dataclass
+class RoleTemplate:
+    """Reusable role template (KEP-8 reduce-yaml-duplication)."""
+
+    kind: str = "RoleTemplate"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    template: PodTemplate = dataclasses.field(default_factory=PodTemplate)
+
+    __serde_keep__ = ("kind", "metadata")

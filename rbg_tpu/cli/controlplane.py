@@ -1,0 +1,107 @@
+"""Control-plane CLI commands.
+
+Reference analog: ``cmd/cli`` kubectl plugin (inventory #5). ``apply`` boots
+an in-process plane (fake or local-executor backend), applies manifests, and
+waits for readiness — the single-binary demo path. ``validate`` is offline
+admission. ``rollout``/``status`` against a persistent plane arrive with the
+serve daemon (rbg_tpu.runtime.executor).
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def register(sub) -> None:
+    ap = sub.add_parser("apply", help="apply manifests to an in-process plane and wait")
+    ap.add_argument("-f", "--file", required=True, help="YAML manifest file")
+    ap.add_argument("--backend", default="fake", choices=["fake", "local"])
+    ap.add_argument("--slices", type=int, default=2, help="fake TPU slices")
+    ap.add_argument("--hosts", type=int, default=2, help="hosts per fake slice")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.set_defaults(func=cmd_apply)
+
+    vp = sub.add_parser("validate", help="validate manifests offline")
+    vp.add_argument("-f", "--file", required=True)
+    vp.set_defaults(func=cmd_validate)
+
+
+def _load(path: str):
+    from rbg_tpu.api import load_yaml_docs, parse_manifest
+
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        print(f"error: cannot read {path}: {e.strerror}", file=sys.stderr)
+        raise SystemExit(1)
+    return [parse_manifest(d) for d in load_yaml_docs(text)]
+
+
+def cmd_validate(args) -> int:
+    from rbg_tpu.api.validation import ValidationError, validate_group
+
+    objs = _load(args.file)
+    rc = 0
+    for o in objs:
+        if o.kind == "RoleBasedGroup":
+            try:
+                validate_group(o)
+                print(f"{o.kind}/{o.metadata.name}: OK")
+            except ValidationError as e:
+                rc = 1
+                for err in e.errors:
+                    print(f"{o.kind}/{o.metadata.name}: INVALID: {err}")
+        else:
+            print(f"{o.kind}/{o.metadata.name}: parsed")
+    return rc
+
+
+def cmd_apply(args) -> int:
+    from rbg_tpu.runtime.plane import ControlPlane
+    from rbg_tpu.testutil import make_tpu_nodes
+
+    objs = _load(args.file)
+    plane = ControlPlane(backend=args.backend)
+    if args.backend == "fake":
+        make_tpu_nodes(plane.store, slices=args.slices, hosts_per_slice=args.hosts)
+    with plane:
+        for o in objs:
+            plane.apply(o)
+            print(f"applied {o.kind}/{o.metadata.name}")
+        rc = 0
+        for o in objs:
+            if o.kind != "RoleBasedGroup":
+                continue
+            try:
+                plane.wait_group_ready(o.metadata.name, o.metadata.namespace,
+                                       timeout=args.timeout)
+                print(f"group {o.metadata.name}: Ready")
+            except TimeoutError:
+                rc = 1
+                print(f"group {o.metadata.name}: NOT ready within {args.timeout}s")
+            _print_status(plane, o.metadata.namespace, o.metadata.name)
+        return rc
+
+
+def _print_status(plane, ns: str, name: str) -> None:
+    from rbg_tpu.api import constants as C
+
+    g = plane.store.get("RoleBasedGroup", ns, name)
+    if g is None:
+        print(f"  group {name}: not found", file=sys.stderr)
+        return
+    print(f"  {'ROLE':<12} {'READY':<8} {'UPDATED':<8}")
+    for st in g.status.roles:
+        spec = g.spec.role(st.name)
+        want = spec.replicas if spec else "?"
+        print(f"  {st.name:<12} {st.ready_replicas}/{want:<6} {st.updated_replicas:<8}")
+    pods = plane.store.list("Pod", namespace=ns,
+                            selector={C.LABEL_GROUP_NAME: name})
+    nodes = {n.metadata.name: n for n in plane.store.list("Node")}
+    for p in sorted(pods, key=lambda p: p.metadata.name):
+        slice_id = ""
+        if p.node_name and p.node_name in nodes:
+            slice_id = nodes[p.node_name].tpu.slice_id
+        print(f"    pod {p.metadata.name:<28} {p.status.phase:<9} "
+              f"node={p.node_name or '<pending>'} {('slice=' + slice_id) if slice_id else ''}")

@@ -1,0 +1,3 @@
+from rbg_tpu.discovery.env_builder import build_env, leader_address
+
+__all__ = ["build_env", "leader_address"]

@@ -1,0 +1,160 @@
+"""Reconciler framework: controllers + manager.
+
+Level-triggered reconcile loops over the store, mirroring controller-runtime's
+model (reference: ``cmd/rbgs/main.go:355-422``, 10 workers/controller):
+watch events map to keys, keys dedup in a rate-limited workqueue, N workers
+call ``reconcile(key)``; errors requeue with per-key exponential backoff;
+``Result(requeue_after=...)`` schedules revisits. The reconcile body must be
+idempotent and derive everything from the store — never from the event.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import traceback
+from typing import Callable, List, Optional, Tuple
+
+from rbg_tpu.runtime.queue import ExponentialBackoff, WorkQueue
+from rbg_tpu.runtime.store import Event, Store
+
+log = logging.getLogger("rbg_tpu.runtime")
+
+ReconcileKey = Tuple[str, str]  # (namespace, name)
+
+
+@dataclasses.dataclass
+class Result:
+    requeue_after: Optional[float] = None
+
+
+@dataclasses.dataclass
+class Watch:
+    kind: str
+    # maps an event object to reconcile keys for THIS controller
+    mapper: Callable[[object], List[ReconcileKey]]
+    # optional event filter (reference: predicates, rolebasedgroup_controller.go:1501-1596)
+    predicate: Optional[Callable[[Event], bool]] = None
+
+
+def own_keys(obj) -> List[ReconcileKey]:
+    return [(obj.metadata.namespace, obj.metadata.name)]
+
+
+def owner_keys(kind: str):
+    """Map an owned object to its controller-owner's key (if owner kind matches)."""
+
+    def mapper(obj) -> List[ReconcileKey]:
+        ref = obj.metadata.controller_owner()
+        if ref is not None and ref.kind == kind:
+            return [(obj.metadata.namespace, ref.name)]
+        return []
+
+    return mapper
+
+
+def label_keys(label: str):
+    """Map an object to the key named by one of its labels (same namespace)."""
+
+    def mapper(obj) -> List[ReconcileKey]:
+        v = obj.metadata.labels.get(label)
+        return [(obj.metadata.namespace, v)] if v else []
+
+    return mapper
+
+
+class Controller:
+    """Subclass and implement ``reconcile(store, key) -> Optional[Result]``."""
+
+    name: str = "controller"
+    workers: int = 4
+
+    def __init__(self, store: Store):
+        self.store = store
+        self.queue = WorkQueue()
+        self.backoff = ExponentialBackoff(base=0.01, max_delay=5.0)
+        self._threads: List[threading.Thread] = []
+        self._started = False
+
+    # -- override points --
+    def watches(self) -> List[Watch]:
+        return []
+
+    def reconcile(self, store: Store, key: ReconcileKey) -> Optional[Result]:
+        raise NotImplementedError
+
+    # -- wiring --
+    def _on_event(self, watch: Watch, ev: Event):
+        if watch.predicate is not None and not watch.predicate(ev):
+            return
+        for key in watch.mapper(ev.object):
+            self.queue.add(key)
+
+    def start(self):
+        if self._started:
+            return
+        self._started = True
+        for w in self.watches():
+            self.store.watch(w.kind, lambda ev, w=w: self._on_event(w, ev))
+        for i in range(self.workers):
+            t = threading.Thread(
+                target=self._worker, name=f"{self.name}-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _worker(self):
+        while True:
+            key = self.queue.get()
+            if key is None:
+                return
+            try:
+                res = self.reconcile(self.store, key)
+                self.backoff.forget(key)
+                if res is not None and res.requeue_after is not None:
+                    self.queue.add_after(key, res.requeue_after)
+            except Exception:
+                delay = self.backoff.next_delay(key)
+                log.debug(
+                    "%s reconcile %s failed (retry in %.3fs):\n%s",
+                    self.name, key, delay, traceback.format_exc(),
+                )
+                self.queue.add_after(key, delay)
+            finally:
+                self.queue.done(key)
+
+    def stop(self):
+        self.queue.shutdown()
+
+
+class Manager:
+    """Holds the store + controllers; the ``main()`` equivalent
+    (reference: ``cmd/rbgs/main.go:126``)."""
+
+    def __init__(self, store: Optional[Store] = None):
+        self.store = store or Store()
+        self.controllers: List[Controller] = []
+        self._started = False
+
+    def register(self, controller: Controller):
+        self.controllers.append(controller)
+        return controller
+
+    def start(self):
+        if self._started:
+            return
+        self._started = True
+        for c in self.controllers:
+            c.start()
+
+    def stop(self):
+        for c in self.controllers:
+            c.stop()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,397 @@
+"""RoleBasedGroup controller — the root orchestrator.
+
+Reference analog: inventory #6 (``rolebasedgroup_controller.go``, the 9-step
+reconcile of SURVEY.md §3.2): precheck → revisions → discovery config →
+role statuses → coordination → gang PodGroup → roles in dependency order →
+orphan cleanup. Anti-flicker status propagation per Appendix C.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api import serde
+from rbg_tpu.api.group import RoleBasedGroup, RoleSpec, RoleStatus
+from rbg_tpu.api.instance import (
+    ControllerRevision, InstanceTemplate, RoleInstanceSet, RoleInstanceSetSpec,
+)
+from rbg_tpu.api.meta import Condition, owner_ref, set_condition
+from rbg_tpu.api.pod import Service
+from rbg_tpu.api.policy import PodGroup, PodGroupSpec
+from rbg_tpu.api.validation import ValidationError, validate_group
+from rbg_tpu.coordination.dependency import dependencies_ready, sort_roles
+from rbg_tpu.runtime.controller import (
+    Controller, Result, Watch, label_keys, own_keys, owner_keys,
+)
+from rbg_tpu.runtime.store import AlreadyExists, Store
+from rbg_tpu.utils import spec_hash
+
+REVISION_HISTORY_LIMIT = 10
+
+
+class RoleBasedGroupController(Controller):
+    name = "rolebasedgroup"
+
+    def __init__(self, store: Store, node_binding=None):
+        super().__init__(store)
+        self.node_binding = node_binding
+
+    def watches(self) -> List[Watch]:
+        def adapter_keys(obj):
+            if obj.kind == "ScalingAdapter" and obj.spec.group_name:
+                return [(obj.metadata.namespace, obj.spec.group_name)]
+            return []
+
+        def policy_keys(obj):
+            if obj.kind == "CoordinatedPolicy" and obj.spec.group_name:
+                return [(obj.metadata.namespace, obj.spec.group_name)]
+            return []
+
+        return [
+            Watch("RoleBasedGroup", own_keys),
+            Watch("RoleInstanceSet", owner_keys("RoleBasedGroup")),
+            Watch("ScalingAdapter", adapter_keys),
+            Watch("CoordinatedPolicy", policy_keys),
+        ]
+
+    def reconcile(self, store: Store, key) -> Optional[Result]:
+        ns, name = key
+        rbg = store.get("RoleBasedGroup", ns, name)
+        if rbg is None:
+            return None
+        if rbg.metadata.deletion_timestamp is not None:
+            if self.node_binding is not None:
+                self.node_binding.evict_group(rbg.metadata.name)
+                self.node_binding.evict_group(rbg.metadata.uid)
+            return None
+
+        # 1. precheck / admission
+        try:
+            validate_group(rbg)
+        except ValidationError as e:
+            store.record_event(rbg, "ValidationFailed", str(e))
+            self._set_group_condition(store, rbg, False, "ValidationFailed", str(e))
+            return None
+
+        # 2. scaling-adapter replica overrides (autoscaler wins over spec drift;
+        #    reference: applyRBGSAReplicasOverride :846)
+        rbg = self._apply_scaling_overrides(store, rbg)
+
+        # 3. revisions
+        revision_name, role_hashes = self._ensure_revision(store, rbg)
+
+        # 4. coordination policy (maxSkew-clamped scaling targets; M6 engine)
+        role_targets = self._coordination_targets(store, rbg)
+
+        # 5. group-level gang PodGroup
+        gang = rbg.metadata.annotations.get(C.ANN_GANG_SCHEDULING) == "true"
+        if gang:
+            self._ensure_pod_group(store, rbg, role_targets)
+
+        # 6. role statuses FIRST (fresh readiness gates the dependency walk)
+        rbg = self._update_role_statuses(store, rbg, role_hashes)
+
+        # 7. roles in dependency order
+        levels = sort_roles(rbg.spec.roles)
+        blocked = []
+        for level in levels:
+            for role in level:
+                if dependencies_ready(rbg, role):
+                    self._reconcile_role(
+                        store, rbg, role, role_hashes[role.name],
+                        role_targets.get(role.name, role.replicas), gang,
+                    )
+                else:
+                    blocked.append(role.name)
+
+        # 8. orphan cleanup
+        self._cleanup_orphans(store, rbg)
+
+        if blocked:
+            return Result(requeue_after=0.5)
+        return None
+
+    # ---- revisions (reference: utils/revision_utils.go + KEP-31) ----
+
+    def _ensure_revision(self, store, rbg):
+        role_hashes = {r.name: spec_hash(r) for r in rbg.spec.roles}
+        rev_hash = spec_hash({"roles": sorted(role_hashes.items())})
+        rev_name = f"{rbg.metadata.name}-{rev_hash}"
+        ns = rbg.metadata.namespace
+        if store.get("ControllerRevision", ns, rev_name) is None:
+            revs = store.list("ControllerRevision", namespace=ns,
+                              owner_uid=rbg.metadata.uid)
+            rev = ControllerRevision()
+            rev.metadata.name = rev_name
+            rev.metadata.namespace = ns
+            rev.metadata.labels = {C.LABEL_GROUP_NAME: rbg.metadata.name}
+            rev.metadata.owner_references = [owner_ref(rbg)]
+            rev.revision = max((r.revision for r in revs), default=0) + 1
+            rev.data = serde.to_dict(rbg.spec)
+            rev.role_hashes = role_hashes
+            try:
+                store.create(rev)
+            except AlreadyExists:
+                pass
+            # prune history beyond limit (oldest first)
+            revs = sorted(
+                store.list("ControllerRevision", namespace=ns, owner_uid=rbg.metadata.uid),
+                key=lambda r: r.revision,
+            )
+            for old in revs[:-REVISION_HISTORY_LIMIT]:
+                store.delete("ControllerRevision", ns, old.metadata.name)
+        if rbg.status.current_revision != rev_name:
+            store.mutate(
+                "RoleBasedGroup", ns, rbg.metadata.name,
+                lambda g: setattr(g.status, "current_revision", rev_name) or True,
+                status=True,
+            )
+            rbg.status.current_revision = rev_name
+        return rev_name, role_hashes
+
+    # ---- scaling adapter overrides ----
+
+    def _apply_scaling_overrides(self, store, rbg):
+        adapters = [
+            a for a in store.list("ScalingAdapter", namespace=rbg.metadata.namespace)
+            if a.spec.group_name == rbg.metadata.name and a.spec.replicas is not None
+            and a.status.phase == "Bound"
+        ]
+        if not adapters:
+            return rbg
+        changed = False
+        for a in adapters:
+            role = rbg.spec.role(a.spec.role_name)
+            if role is not None and role.replicas != a.spec.replicas:
+                role.replicas = a.spec.replicas
+                changed = True
+        if changed:
+            try:
+                rbg = store.update(rbg)
+            except Exception:
+                rbg = store.get("RoleBasedGroup", rbg.metadata.namespace, rbg.metadata.name)
+        return rbg
+
+    # ---- coordination (maxSkew clamp; full engine in coordination/scaling) ----
+
+    def _coordination_targets(self, store, rbg):
+        targets = {r.name: r.replicas for r in rbg.spec.roles}
+        policies = [
+            p for p in store.list("CoordinatedPolicy", namespace=rbg.metadata.namespace)
+            if p.spec.group_name == rbg.metadata.name and p.spec.scaling is not None
+        ]
+        if not policies:
+            return targets
+        try:
+            from rbg_tpu.coordination.scaling import clamp_targets
+        except ImportError:
+            return targets
+        for p in policies:
+            targets = clamp_targets(rbg, p.spec.scaling, targets)
+        return targets
+
+    # ---- gang ----
+
+    def _ensure_pod_group(self, store, rbg, role_targets):
+        # Count only roles whose dependencies are satisfied: blocked roles'
+        # pods don't exist yet, and including them would deadlock the gang
+        # (scheduler waits for min_member pods that are never created).
+        # Gang semantics therefore apply per dependency level.
+        total = sum(
+            role_targets.get(r.name, r.replicas) * r.gang_size()
+            for r in rbg.spec.roles
+            if dependencies_ready(rbg, r)
+        )
+        ns, name = rbg.metadata.namespace, rbg.metadata.name
+        pg = store.get("PodGroup", ns, name)
+        if pg is None:
+            pg = PodGroup()
+            pg.metadata.name = name
+            pg.metadata.namespace = ns
+            pg.metadata.owner_references = [owner_ref(rbg)]
+            pg.spec = PodGroupSpec(min_member=total, group_name=name)
+            try:
+                store.create(pg)
+            except AlreadyExists:
+                pass
+        elif pg.spec.min_member != total:
+            def fn(g):
+                g.spec.min_member = total
+                return True
+            store.mutate("PodGroup", ns, name, fn)
+
+    # ---- per-role workload reconcile (strategy: RoleInstanceSet) ----
+
+    def _reconcile_role(self, store, rbg, role: RoleSpec, role_hash: str,
+                        replicas: int, gang: bool):
+        ns = rbg.metadata.namespace
+        wname = C.workload_name(rbg.metadata.name, role.name)
+        self._ensure_service(store, rbg, role)
+
+        role = self._resolve_template(store, rbg, role)
+        labels = {
+            C.LABEL_GROUP_NAME: rbg.metadata.name,
+            C.LABEL_ROLE_NAME: role.name,
+            C.role_revision_label(role.name): role_hash,
+        }
+        annotations = {}
+        if gang:
+            annotations[C.ANN_GANG_SCHEDULING] = rbg.metadata.name
+        for k, v in rbg.metadata.annotations.items():
+            if k.startswith(C.DOMAIN) and k != C.ANN_GANG_SCHEDULING:
+                annotations.setdefault(k, v)
+
+        desired_spec = RoleInstanceSetSpec(
+            replicas=replicas,
+            stateful=role.stateful,
+            instance=InstanceTemplate(
+                pattern=role.pattern,
+                template=role.template,
+                leader_worker=role.leader_worker,
+                components=role.components,
+                tpu=role.tpu,
+            ),
+            restart_policy=role.restart_policy,
+            rolling_update=role.rolling_update,
+            selector=dict(labels),
+        )
+
+        cur = store.get("RoleInstanceSet", ns, wname)
+        if cur is None:
+            ris = RoleInstanceSet()
+            ris.metadata.name = wname
+            ris.metadata.namespace = ns
+            ris.metadata.labels = labels
+            ris.metadata.annotations = annotations
+            ris.metadata.owner_references = [owner_ref(rbg)]
+            ris.spec = desired_spec
+            try:
+                store.create(ris)
+            except AlreadyExists:
+                pass
+            return
+        # semantic-equality update (reference: comparators in each reconciler)
+        if (serde.to_dict(cur.spec) != serde.to_dict(desired_spec)
+                or cur.metadata.labels != labels
+                or cur.metadata.annotations != annotations):
+            def fn(r):
+                r.spec = desired_spec
+                r.metadata.labels = labels
+                r.metadata.annotations = annotations
+                return True
+            store.mutate("RoleInstanceSet", ns, wname, fn)
+
+    def _resolve_template(self, store, rbg, role: RoleSpec) -> RoleSpec:
+        """KEP-8: roles may reference a shared RoleTemplate."""
+        if not role.template_ref:
+            return role
+        import copy
+        tmpl = store.get("RoleTemplate", rbg.metadata.namespace, role.template_ref)
+        if tmpl is None:
+            store.record_event(rbg, "MissingRoleTemplate",
+                               f"role {role.name}: RoleTemplate {role.template_ref} not found")
+            return role
+        role = copy.deepcopy(role)
+        if not role.template.containers:
+            role.template = copy.deepcopy(tmpl.template)
+        return role
+
+    def _ensure_service(self, store, rbg, role: RoleSpec):
+        ns = rbg.metadata.namespace
+        sname = C.service_name(rbg.metadata.name, role.name)
+        if store.get("Service", ns, sname) is not None:
+            return
+        svc = Service()
+        svc.metadata.name = sname
+        svc.metadata.namespace = ns
+        svc.metadata.labels = {
+            C.LABEL_GROUP_NAME: rbg.metadata.name,
+            C.LABEL_ROLE_NAME: role.name,
+        }
+        svc.metadata.owner_references = [owner_ref(rbg)]
+        svc.selector = {
+            C.LABEL_GROUP_NAME: rbg.metadata.name,
+            C.LABEL_ROLE_NAME: role.name,
+        }
+        try:
+            store.create(svc)
+        except AlreadyExists:
+            pass
+
+    # ---- status aggregation (Appendix C, anti-flicker :57-81) ----
+
+    def _update_role_statuses(self, store, rbg, role_hashes):
+        ns = rbg.metadata.namespace
+        new_roles: List[RoleStatus] = []
+        for role in rbg.spec.roles:
+            wname = C.workload_name(rbg.metadata.name, role.name)
+            ris = store.get("RoleInstanceSet", ns, wname)
+            prev = rbg.status.role(role.name)
+            if ris is None:
+                new_roles.append(prev or RoleStatus(name=role.name))
+                continue
+            if (ris.status.observed_generation < ris.metadata.generation and prev is not None):
+                # child controller hasn't observed the latest spec — keep
+                # last-known status (anti-flicker)
+                new_roles.append(prev)
+                continue
+            new_roles.append(RoleStatus(
+                name=role.name,
+                replicas=ris.status.replicas,
+                ready_replicas=ris.status.ready_replicas,
+                updated_replicas=ris.status.updated_replicas,
+                updated_ready_replicas=ris.status.updated_ready_replicas,
+                observed_revision=role_hashes.get(role.name, ""),
+            ))
+
+        ready = all(
+            st.replicas == r.replicas and st.ready_replicas == r.replicas
+            for r, st in zip(rbg.spec.roles, new_roles)
+        ) and len(new_roles) == len(rbg.spec.roles)
+        now = time.time()
+
+        def fn(g):
+            changed = False
+            if serde.to_dict(g.status.roles) != serde.to_dict(new_roles):
+                g.status.roles = new_roles
+                changed = True
+            if g.status.observed_generation != g.metadata.generation:
+                g.status.observed_generation = g.metadata.generation
+                changed = True
+            if set_condition(
+                g.status.conditions,
+                Condition(type=C.COND_READY, status="True" if ready else "False",
+                          reason="AllRolesReady" if ready else "Progressing"),
+                now,
+            ):
+                changed = True
+            return changed
+
+        updated = store.mutate("RoleBasedGroup", ns, rbg.metadata.name, fn, status=True)
+        return updated
+
+    def _set_group_condition(self, store, rbg, ready: bool, reason: str, msg: str):
+        def fn(g):
+            return set_condition(
+                g.status.conditions,
+                Condition(type=C.COND_READY, status="True" if ready else "False",
+                          reason=reason, message=msg[:500]),
+                time.time(),
+            )
+        store.mutate("RoleBasedGroup", rbg.metadata.namespace, rbg.metadata.name,
+                     fn, status=True)
+
+    # ---- orphans ----
+
+    def _cleanup_orphans(self, store, rbg):
+        ns = rbg.metadata.namespace
+        valid_w = {C.workload_name(rbg.metadata.name, r.name) for r in rbg.spec.roles}
+        valid_s = {C.service_name(rbg.metadata.name, r.name) for r in rbg.spec.roles}
+        for ris in store.list("RoleInstanceSet", namespace=ns, owner_uid=rbg.metadata.uid):
+            if ris.metadata.name not in valid_w:
+                store.delete("RoleInstanceSet", ns, ris.metadata.name)
+        for svc in store.list("Service", namespace=ns, owner_uid=rbg.metadata.uid):
+            if svc.metadata.name not in valid_s:
+                store.delete("Service", ns, svc.metadata.name)

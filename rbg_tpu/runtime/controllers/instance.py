@@ -1,0 +1,327 @@
+"""RoleInstance controller — the pod-gang engine.
+
+Reference analog: inventory #13 (``pkg/reconciler/roleinstance``, 3.5k LoC):
+one RoleInstance = a gang of pods; creates/deletes pods, runs the restart
+policy with exponential backoff, aggregates readiness, injects identity.
+
+TPU specifics: a leader-worker instance is one JAX program across the hosts of
+one slice — pods carry slice scheduler hints, JAX coordinator env
+(process_id == component index == slice worker_index), and warm-node affinity
+from the NodeBindingStore. Atomic slice recovery (SURVEY.md §7 hard parts): a
+failed host recreates the WHOLE instance, and the slice-binding annotation
+steers it back onto the same ICI domain.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.group import PatternType, RestartPolicy
+from rbg_tpu.api.instance import ComponentStatus, ReadyPolicy, RoleInstance
+from rbg_tpu.api.meta import Condition, owner_ref, set_condition
+from rbg_tpu.api.pod import Pod
+from rbg_tpu.api.policy import PodGroup, PodGroupSpec
+from rbg_tpu.runtime.controller import (
+    Controller, Result, Watch, own_keys, owner_keys,
+)
+from rbg_tpu.runtime.store import AlreadyExists, Store
+
+def desired_pods(inst: RoleInstance) -> List[Tuple[str, str, int, int, object]]:
+    """[(pod_name, component, component_id, component_index, template)].
+    Naming per reference Appendix B (``instance_utils.go:76-89``):
+    standalone → ``{instance}``; leaderWorker → ``{instance}-{i}`` (leader 0);
+    components → ``{instance}-{component}-{i}``."""
+    name = inst.metadata.name
+    it = inst.spec.instance
+    if it.pattern == PatternType.STANDALONE:
+        return [(name, "", 0, 0, it.template)]
+    if it.pattern == PatternType.LEADER_WORKER:
+        lw = it.leader_worker
+        size = (lw.size if lw and lw.size else 0) or (it.tpu.num_hosts if it.tpu else 1) or 1
+        out = []
+        for i in range(size):
+            tmpl = it.template
+            if lw is not None:
+                if i == 0 and lw.leader_template is not None:
+                    tmpl = lw.leader_template
+                elif i > 0 and lw.worker_template is not None:
+                    tmpl = lw.worker_template
+            out.append((f"{name}-{i}", "leader" if i == 0 else "worker", i, i, tmpl))
+        return out
+    out = []
+    idx = 0
+    for comp in it.components:
+        for i in range(comp.size):
+            out.append((f"{name}-{comp.name}-{i}", comp.name, i, idx,
+                        comp.template or it.template))
+            idx += 1
+    return out
+
+
+class RoleInstanceController(Controller):
+    name = "roleinstance"
+
+    def __init__(self, store: Store, node_binding=None):
+        super().__init__(store)
+        self.node_binding = node_binding
+
+    def watches(self) -> List[Watch]:
+        return [
+            Watch("RoleInstance", own_keys),
+            Watch("Pod", owner_keys("RoleInstance")),
+        ]
+
+    def reconcile(self, store: Store, key) -> Optional[Result]:
+        ns, name = key
+        inst = store.get("RoleInstance", ns, name)
+        if inst is None or inst.metadata.deletion_timestamp is not None:
+            return None
+
+        pods = [p for p in store.list("Pod", namespace=ns, owner_uid=inst.metadata.uid)]
+        active = [p for p in pods if p.active]
+        desired = desired_pods(inst)
+
+        # Record warm bindings for running pods.
+        if self.node_binding is not None:
+            for p in active:
+                if p.running_ready and p.node_name:
+                    node = store.get("Node", "default", p.node_name)
+                    if node is not None:
+                        self.node_binding.record(p, node)
+                        if node.tpu.slice_id and inst.status.slice_id != node.tpu.slice_id:
+                            store.mutate(
+                                "RoleInstance", ns, name,
+                                lambda i, s=node.tpu.slice_id: setattr(i.status, "slice_id", s) or True,
+                                status=True,
+                            )
+                            inst.status.slice_id = node.tpu.slice_id
+
+        # ---- restart policy state machine (reference: §3.5) ----
+        res = self._handle_restarts(store, inst, pods, desired)
+        if res is not None:
+            return res
+
+        # ---- scale/create: converge pod set ----
+        self._ensure_pod_group(store, inst, desired)
+        pg_name = self._pod_group_name(inst, desired)
+        existing = {p.metadata.name for p in active}
+        wanted = {n for (n, *_rest) in desired}
+        for pod_name, comp, cid, cidx, tmpl in desired:
+            if pod_name not in existing:
+                self._create_pod(store, inst, pod_name, comp, cid, cidx, tmpl,
+                                 len(desired), pg_name)
+        for p in active:
+            if p.metadata.name not in wanted:
+                store.delete("Pod", ns, p.metadata.name, grace=True)
+        # Replace terminal (Failed/Succeeded) pods when policy is None:
+        # recreate just that pod (no gang restart).
+        if inst.spec.restart_policy.policy == RestartPolicy.NONE:
+            for p in pods:
+                if not p.active and p.metadata.deletion_timestamp is None:
+                    store.delete("Pod", ns, p.metadata.name)
+
+        return self._update_status(store, inst, desired)
+
+    # ---- restart machinery ----
+
+    def _restart_triggered(self, inst, pods, desired) -> bool:
+        """Trigger on terminal (Failed) pods or in-pod container restarts —
+        terminal pods are no longer 'active', so scan ALL owned pods."""
+        if inst.spec.restart_policy.policy == RestartPolicy.NONE:
+            return False
+        ignored = set()
+        for (pn, comp, _cid, _cidx, tmpl) in desired:
+            if tmpl and tmpl.annotations.get(C.ANN_RESTART_TRIGGER_POLICY) == "Ignore":
+                ignored.add(pn)
+        for p in pods:
+            if p.metadata.name in ignored or p.metadata.deletion_timestamp is not None:
+                continue
+            if p.status.phase == "Failed" or p.status.restart_count > 0:
+                return True
+        return False
+
+    def _handle_restarts(self, store, inst, pods, desired) -> Optional[Result]:
+        ns, name = inst.metadata.namespace, inst.metadata.name
+        rp = inst.spec.restart_policy
+        restarting = inst.status.phase == "Restarting"
+
+        if restarting:
+            if pods:
+                # still tearing down (terminating pods included)
+                for p in pods:
+                    if p.metadata.deletion_timestamp is None:
+                        store.delete("Pod", ns, p.metadata.name, grace=True)
+                return Result(requeue_after=0.05)
+            # teardown complete → leave Restarting; normal path recreates pods
+            store.mutate("RoleInstance", ns, name,
+                         lambda i: setattr(i.status, "phase", "Pending") or True,
+                         status=True)
+            return Result(requeue_after=0)
+
+        if not self._restart_triggered(inst, pods, desired):
+            return None
+
+        now = time.time()
+        n = inst.status.restart_count
+        last = inst.status.last_restart_time
+        if last and (now - last) > rp.window_seconds:
+            n = 0  # decay: stable for a full window resets the backoff
+        delay = min(rp.base_delay_seconds * (2 ** max(0, n - 1)), rp.max_delay_seconds) if n > 0 else 0.0
+        if last and now < last + delay:
+            return Result(requeue_after=(last + delay) - now)
+
+        def fn(i):
+            if i.status.phase == "Restarting":
+                return False  # concurrent worker already started the cycle
+            i.status.phase = "Restarting"
+            i.status.restart_count = n + 1
+            i.status.last_restart_time = now
+            set_condition(i.status.conditions,
+                          Condition(type=C.COND_RESTART_IN_PROGRESS, status="True",
+                                    reason="PodFailure"), now)
+            return True
+
+        store.mutate("RoleInstance", ns, name, fn, status=True)
+        store.record_event(inst, "Restarting",
+                           f"recreating pod gang (restart #{n + 1})")
+        for p in pods:
+            if p.metadata.deletion_timestamp is None:
+                store.delete("Pod", ns, p.metadata.name, grace=True)
+        return Result(requeue_after=0.05)
+
+    # ---- pod construction ----
+
+    def _ensure_pod_group(self, store, inst, desired):
+        """Per-instance gang (slice atomicity) unless a group-level pod-group
+        is designated via annotation."""
+        if inst.metadata.annotations.get(C.ANN_GANG_SCHEDULING):
+            return  # group-level PodGroup managed by the group controller
+        if len(desired) <= 1:
+            return
+        ns, name = inst.metadata.namespace, inst.metadata.name
+        if store.get("PodGroup", ns, name) is None:
+            pg = PodGroup()
+            pg.metadata.name = name
+            pg.metadata.namespace = ns
+            pg.metadata.owner_references = [owner_ref(inst)]
+            pg.spec = PodGroupSpec(
+                min_member=len(desired),
+                group_name=inst.metadata.labels.get(C.LABEL_GROUP_NAME, ""),
+            )
+            try:
+                store.create(pg)
+            except AlreadyExists:
+                pass
+
+    def _pod_group_name(self, inst, desired) -> str:
+        explicit = inst.metadata.annotations.get(C.ANN_GANG_SCHEDULING, "")
+        if explicit:
+            return explicit
+        return inst.metadata.name if len(desired) > 1 else ""
+
+    def _create_pod(self, store, inst, pod_name, comp, cid, cidx, tmpl,
+                    gang_size, pg_name=""):
+        import copy
+
+        ns = inst.metadata.namespace
+        labels = dict(inst.metadata.labels)
+        labels.update({
+            C.LABEL_INSTANCE_NAME: inst.metadata.name,
+            C.LABEL_COMPONENT_NAME: comp or "main",
+            C.LABEL_COMPONENT_ID: str(cid),
+            C.LABEL_COMPONENT_INDEX: str(cidx),
+        })
+        if inst.spec.index >= 0:
+            labels[C.LABEL_INSTANCE_INDEX] = str(inst.spec.index)
+        if pg_name:
+            labels[C.LABEL_POD_GROUP] = pg_name
+
+        pod = Pod()
+        pod.metadata.name = pod_name
+        pod.metadata.namespace = ns
+        pod.metadata.labels = labels
+        pod.metadata.annotations = dict(inst.metadata.annotations)
+        pod.metadata.annotations.update(tmpl.annotations if tmpl else {})
+        pod.metadata.owner_references = [owner_ref(inst)]
+        pod.template = copy.deepcopy(tmpl) if tmpl else None
+        if pod.template is None:
+            from rbg_tpu.api.pod import PodTemplate
+            pod.template = PodTemplate()
+        pod.template.labels = labels
+
+        it = inst.spec.instance
+        if it.pattern == PatternType.LEADER_WORKER and (it.tpu is not None):
+            pod.template.scheduler_hints["tpu-slice"] = "true"
+
+        # identity + JAX rendezvous envs (discovery plane adds topology config)
+        from rbg_tpu.discovery.env_builder import build_env
+        env = build_env(inst, pod_name, comp or "main", cidx, gang_size)
+        for c in pod.template.containers:
+            have = {e.name for e in c.env}
+            c.env.extend(e for e in env if e.name not in have)
+
+        if self.node_binding is not None:
+            pod.affinity.extend(self.node_binding.affinity_terms(pod))
+            slice_id = self.node_binding.preferred_slice(pod) or inst.status.slice_id
+            if slice_id:
+                pod.metadata.annotations[C.ANN_SLICE_BINDING] = slice_id
+
+        try:
+            store.create(pod)
+        except AlreadyExists:
+            pass
+
+    # ---- status ----
+
+    def _update_status(self, store, inst, desired) -> Optional[Result]:
+        ns, name = inst.metadata.namespace, inst.metadata.name
+        pods = {p.metadata.name: p for p in store.list("Pod", namespace=ns,
+                                                       owner_uid=inst.metadata.uid)}
+        comps = {}
+        for pod_name, comp, _cid, _cidx, _tmpl in desired:
+            comp = comp or "main"
+            st = comps.setdefault(comp, ComponentStatus(name=comp))
+            st.size += 1
+            p = pods.get(pod_name)
+            if p is not None and p.active:
+                if p.node_name:
+                    st.scheduled += 1
+                if p.running_ready:
+                    st.ready += 1
+
+        all_ready = all(c.ready == c.size for c in comps.values()) and bool(comps)
+        ready = all_ready or inst.spec.instance.ready_policy == ReadyPolicy.NONE
+        now = time.time()
+
+        def fn(i):
+            changed = False
+            new_comps = sorted(comps.values(), key=lambda c: c.name)
+            from rbg_tpu.api import serde
+            if serde.to_dict(i.status.components) != serde.to_dict(new_comps):
+                i.status.components = new_comps
+                changed = True
+            phase = "Running" if ready else ("Pending" if i.status.phase != "Restarting" else i.status.phase)
+            if i.status.phase != phase:
+                i.status.phase = phase
+                changed = True
+            if set_condition(i.status.conditions,
+                             Condition(type=C.COND_ALL_PODS_READY,
+                                       status="True" if all_ready else "False",
+                                       reason="PodsReady" if all_ready else "WaitingForPods"),
+                             now):
+                changed = True
+            if set_condition(i.status.conditions,
+                             Condition(type=C.COND_READY,
+                                       status="True" if ready else "False",
+                                       reason="Ready" if ready else "NotReady"),
+                             now):
+                changed = True
+            if i.status.observed_revision != i.metadata.labels.get(C.LABEL_REVISION_NAME, ""):
+                i.status.observed_revision = i.metadata.labels.get(C.LABEL_REVISION_NAME, "")
+                changed = True
+            return changed
+
+        store.mutate("RoleInstance", ns, name, fn, status=True)
+        return None

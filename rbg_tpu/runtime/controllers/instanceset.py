@@ -1,0 +1,245 @@
+"""RoleInstanceSet controller — stateful + stateless instance engines.
+
+Reference analog: inventory #10-12 (``roleinstanceset_controller.go`` routing
+to ``statefulmode``/``statelessmode``). Stateful mode (the TPU default —
+ordered identity == stable JAX process topology) manages ordinals 0..n-1 with
+partition/maxUnavailable rolling updates; stateless mode manages random-id
+instances CloneSet-style with specified-delete.
+"""
+
+from __future__ import annotations
+
+import random
+import string
+import time
+from typing import List, Optional
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api import serde
+from rbg_tpu.api.instance import RoleInstance, RoleInstanceSpec
+from rbg_tpu.api.meta import Condition, get_condition, owner_ref, set_condition
+from rbg_tpu.runtime.controller import Controller, Result, Watch, own_keys, owner_keys
+from rbg_tpu.runtime.store import AlreadyExists, Store
+from rbg_tpu.utils import spec_hash
+
+ANN_SPECIFIED_DELETE = f"{C.DOMAIN}/specified-delete"
+
+
+def _ordinal(set_name: str, inst_name: str) -> int:
+    """Parse ``{set}-{ordinal}`` (reference: stateful_instance_set_utils.go:41-65)."""
+    suffix = inst_name[len(set_name) + 1:]
+    try:
+        return int(suffix)
+    except ValueError:
+        return -1
+
+
+def _rand_id(n: int = 5) -> str:
+    return "".join(random.choices(string.ascii_lowercase + string.digits, k=n))
+
+
+def update_revision_of(ris) -> str:
+    return spec_hash({
+        "instance": serde.to_dict(ris.spec.instance),
+        "restart": serde.to_dict(ris.spec.restart_policy),
+    })
+
+
+def instance_ready(inst: RoleInstance) -> bool:
+    c = get_condition(inst.status.conditions, C.COND_READY)
+    return c is not None and c.status == "True"
+
+
+class RoleInstanceSetController(Controller):
+    name = "roleinstanceset"
+
+    def watches(self) -> List[Watch]:
+        return [
+            Watch("RoleInstanceSet", own_keys),
+            Watch("RoleInstance", owner_keys("RoleInstanceSet")),
+        ]
+
+    def reconcile(self, store: Store, key) -> Optional[Result]:
+        ns, name = key
+        ris = store.get("RoleInstanceSet", ns, name)
+        if ris is None or ris.metadata.deletion_timestamp is not None:
+            return None
+
+        revision = update_revision_of(ris)
+        instances = [
+            i for i in store.list("RoleInstance", namespace=ns, owner_uid=ris.metadata.uid)
+            if i.metadata.deletion_timestamp is None
+        ]
+
+        if ris.spec.stateful:
+            self._sync_stateful(store, ris, instances, revision)
+        else:
+            self._sync_stateless(store, ris, instances, revision)
+
+        self._update_status(store, ris, revision)
+        return None
+
+    # ---- stateful: ordered ordinals + partition rolling update ----
+
+    def _sync_stateful(self, store, ris, instances, revision):
+        ns, name = ris.metadata.namespace, ris.metadata.name
+        n = ris.spec.replicas
+        by_ord = {}
+        for inst in instances:
+            o = _ordinal(name, inst.metadata.name)
+            if 0 <= o:
+                by_ord[o] = inst
+
+        # scale up: create missing ordinals with the update revision
+        for o in range(n):
+            if o not in by_ord:
+                self._create_instance(store, ris, f"{name}-{o}", o, revision)
+        # scale down: delete ordinals >= n, highest first
+        for o in sorted((o for o in by_ord if o >= n), reverse=True):
+            store.delete("RoleInstance", ns, by_ord[o].metadata.name)
+
+        # rolling update (recreate semantics; in-place path handled by the
+        # inplace engine when eligible — see rbg_tpu.inplace):
+        # walk descending, honor partition + maxUnavailable
+        # (reference: stateful_instance_set_control.go:362-494).
+        ru = ris.spec.rolling_update
+        current = [by_ord[o] for o in sorted(by_ord) if o < n]
+        unavailable = sum(1 for i in current if not instance_ready(i))
+        budget = max(0, ru.max_unavailable - unavailable)
+        for inst in sorted(current, key=lambda i: -_ordinal(name, i.metadata.name)):
+            o = _ordinal(name, inst.metadata.name)
+            if o < ru.partition:
+                continue
+            if inst.metadata.labels.get(C.LABEL_REVISION_NAME) == revision:
+                continue
+            if budget <= 0:
+                break
+            if self._try_inplace(store, ris, inst, revision):
+                budget -= 1
+                continue
+            store.delete("RoleInstance", ns, inst.metadata.name)
+            budget -= 1
+
+    # ---- stateless: random ids, specified-delete, revision-sorted update ----
+
+    def _sync_stateless(self, store, ris, instances, revision):
+        ns, name = ris.metadata.namespace, ris.metadata.name
+        n = ris.spec.replicas
+        active = list(instances)
+
+        # specified-delete first (reference: statelessmode lifecycle)
+        for inst in list(active):
+            if inst.metadata.annotations.get(ANN_SPECIFIED_DELETE) == "true":
+                store.delete("RoleInstance", ns, inst.metadata.name)
+                active.remove(inst)
+
+        diff = n - len(active)
+        if diff > 0:
+            existing = {i.metadata.name for i in active}
+            for _ in range(diff):
+                iname = f"{name}-{_rand_id()}"
+                while iname in existing:
+                    iname = f"{name}-{_rand_id()}"
+                existing.add(iname)
+                self._create_instance(store, ris, iname, -1, revision)
+        elif diff < 0:
+            # delete preference: not-ready first, then outdated, then newest
+            def key(i):
+                return (
+                    instance_ready(i),
+                    i.metadata.labels.get(C.LABEL_REVISION_NAME) == revision,
+                    -i.metadata.creation_timestamp,
+                )
+
+            for inst in sorted(active, key=key)[: -diff]:
+                store.delete("RoleInstance", ns, inst.metadata.name)
+                active.remove(inst)
+
+        # update: replace outdated within budget
+        ru = ris.spec.rolling_update
+        unavailable = sum(1 for i in active if not instance_ready(i))
+        budget = max(0, ru.max_unavailable - unavailable)
+        for inst in active:
+            if inst.metadata.labels.get(C.LABEL_REVISION_NAME) == revision:
+                continue
+            if budget <= 0:
+                break
+            if self._try_inplace(store, ris, inst, revision):
+                budget -= 1
+                continue
+            store.delete("RoleInstance", ns, inst.metadata.name)
+            budget -= 1
+
+    def _try_inplace(self, store, ris, inst, revision) -> bool:
+        """Image-only changes update pods in place (no recreation).
+        Reference: pkg/inplace (inventory #15). Wired in M6; returns False
+        when ineligible so callers fall back to recreate."""
+        if not ris.spec.rolling_update.in_place_if_possible:
+            return False
+        try:
+            from rbg_tpu.inplace.update import try_inplace_update
+        except ImportError:
+            return False
+        return try_inplace_update(store, ris, inst, revision)
+
+    def _create_instance(self, store, ris, iname, index, revision):
+        import copy
+
+        inst = RoleInstance()
+        inst.metadata.name = iname
+        inst.metadata.namespace = ris.metadata.namespace
+        inst.metadata.labels = dict(ris.metadata.labels)
+        inst.metadata.labels[C.LABEL_REVISION_NAME] = revision
+        if index >= 0:
+            inst.metadata.labels[C.LABEL_INSTANCE_INDEX] = str(index)
+        inst.metadata.annotations = dict(ris.metadata.annotations)
+        inst.metadata.owner_references = [owner_ref(ris)]
+        inst.spec = RoleInstanceSpec(
+            instance=copy.deepcopy(ris.spec.instance),
+            restart_policy=copy.deepcopy(ris.spec.restart_policy),
+            index=index,
+        )
+        try:
+            store.create(inst)
+        except AlreadyExists:
+            pass
+
+    # ---- status rollup (reference: roleinstanceset_types.go:160-206) ----
+
+    def _update_status(self, store, ris, revision):
+        ns, name = ris.metadata.namespace, ris.metadata.name
+        instances = [
+            i for i in store.list("RoleInstance", namespace=ns, owner_uid=ris.metadata.uid)
+            if i.metadata.deletion_timestamp is None
+        ]
+        total = len(instances)
+        ready = sum(1 for i in instances if instance_ready(i))
+        updated = sum(1 for i in instances
+                      if i.metadata.labels.get(C.LABEL_REVISION_NAME) == revision)
+        updated_ready = sum(
+            1 for i in instances
+            if i.metadata.labels.get(C.LABEL_REVISION_NAME) == revision and instance_ready(i)
+        )
+        now = time.time()
+
+        def fn(r):
+            s = r.status
+            new = (total, ready, updated, updated_ready, revision, r.metadata.generation)
+            cur = (s.replicas, s.ready_replicas, s.updated_replicas,
+                   s.updated_ready_replicas, s.update_revision, s.observed_generation)
+            cond_changed = set_condition(
+                s.conditions,
+                Condition(type=C.COND_READY,
+                          status="True" if (ready == r.spec.replicas and total == r.spec.replicas) else "False",
+                          reason="AllInstancesReady" if ready == r.spec.replicas else "Progressing"),
+                now,
+            )
+            if new == cur and not cond_changed:
+                return False
+            (s.replicas, s.ready_replicas, s.updated_replicas,
+             s.updated_ready_replicas, s.update_revision, s.observed_generation) = new
+            if updated == total and total > 0:
+                s.current_revision = revision
+            return True
+
+        store.mutate("RoleInstanceSet", ns, name, fn, status=True)

@@ -1,0 +1,136 @@
+"""Pod lifecycle backends.
+
+``FakeKubelet`` — the envtest/kwok equivalent (SURVEY.md §4: tests drive pod
+status because no kubelet exists; the stress harness uses kwok fake nodes).
+It watches Pods and walks scheduled ones to Running/Ready after a configurable
+delay, with injectable failure hooks for chaos tests.
+
+The real-process executor (``rbg_tpu.runtime.executor``, M7) implements the
+same contract by spawning actual engine processes on the TPU host.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from rbg_tpu.runtime.store import Event, Store
+
+
+class FakeKubelet:
+    """Moves scheduled pods through the lifecycle:
+    Pending+node → Running(ready) after ``ready_delay``; honors graceful
+    deletion by finalizing after ``terminate_delay``.
+    """
+
+    def __init__(
+        self,
+        store: Store,
+        ready_delay: float = 0.0,
+        terminate_delay: float = 0.0,
+        fail_filter: Optional[Callable[[object], bool]] = None,
+    ):
+        self.store = store
+        self.ready_delay = ready_delay
+        self.terminate_delay = terminate_delay
+        self.fail_filter = fail_filter
+        self._timers: list = []
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def start(self):
+        self.store.watch("Pod", self._on_event)
+        # Adopt pods that already exist.
+        for pod in self.store.list("Pod"):
+            self._on_event(Event(Event.ADDED, pod))
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            for t in self._timers:
+                t.cancel()
+            self._timers.clear()
+
+    def _later(self, delay: float, fn, *args):
+        with self._lock:
+            if self._stopped:
+                return
+            if delay <= 0:
+                threading.Thread(target=fn, args=args, daemon=True).start()
+                return
+            t = threading.Timer(delay, fn, args)
+            t.daemon = True
+            t.start()
+            self._timers.append(t)
+            if len(self._timers) > 256:
+                self._timers = [x for x in self._timers if x.is_alive()]
+
+    def _on_event(self, ev: Event):
+        pod = ev.object
+        if ev.type == Event.DELETED:
+            return
+        if pod.metadata.deletion_timestamp is not None:
+            self._later(self.terminate_delay, self._finalize, Store.key(pod))
+            return
+        if pod.node_name and pod.status.phase == "Pending":
+            if self.fail_filter is not None and self.fail_filter(pod):
+                self._later(self.ready_delay, self._set_phase, Store.key(pod), "Failed")
+            else:
+                self._later(self.ready_delay, self._make_ready, Store.key(pod))
+
+    def _make_ready(self, key):
+        kind, ns, name = key
+        try:
+            node = None
+            pod = self.store.get(kind, ns, name)
+            if pod is None or pod.metadata.deletion_timestamp is not None:
+                return
+            if pod.node_name:
+                node = self.store.get("Node", "default", pod.node_name)
+
+            def fn(p):
+                if p.status.phase != "Pending":
+                    return False
+                p.status.phase = "Running"
+                p.status.ready = True
+                p.status.node_name = p.node_name
+                p.status.pod_ip = node.address if node else "127.0.0.1"
+                p.status.start_time = time.time()
+                return True
+
+            self.store.mutate(kind, ns, name, fn, status=True)
+        except Exception:
+            pass
+
+    def _set_phase(self, key, phase: str):
+        kind, ns, name = key
+        try:
+            def fn(p):
+                p.status.phase = phase
+                p.status.ready = False
+                return True
+
+            self.store.mutate(kind, ns, name, fn, status=True)
+        except Exception:
+            pass
+
+    def _finalize(self, key):
+        kind, ns, name = key
+        try:
+            self.store.finalize_delete(kind, ns, name)
+        except Exception:
+            pass
+
+    # ---- test helpers (drive status manually, envtest style) ----
+
+    def fail_pod(self, ns: str, name: str):
+        self.store.mutate("Pod", ns, name, lambda p: setattr(p.status, "phase", "Failed") or setattr(p.status, "ready", False) or True, status=True)
+
+    def restart_container(self, ns: str, name: str, container: str = "main"):
+        def fn(p):
+            p.status.container_restarts[container] = p.status.container_restarts.get(container, 0) + 1
+            p.status.restart_count += 1
+            return True
+
+        self.store.mutate("Pod", ns, name, fn, status=True)

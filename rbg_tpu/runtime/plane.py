@@ -1,0 +1,126 @@
+"""ControlPlane — assembly of store + controllers + pod backend.
+
+The ``main()`` equivalent (reference: ``cmd/rbgs/main.go:126``: scheme, cache,
+controller registration, shared NodeBindingStore, health). Backends:
+
+* ``fake``  — FakeKubelet walks pods to Ready (envtest/kwok equivalent)
+* ``local`` — real subprocesses on this host (rbg_tpu.runtime.executor, M7)
+* ``none``  — no pod backend (tests drive pod status manually)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from rbg_tpu.runtime.controller import Manager
+from rbg_tpu.runtime.kubelet import FakeKubelet
+from rbg_tpu.runtime.store import Store
+from rbg_tpu.sched.binding import NodeBindingStore
+from rbg_tpu.sched.scheduler import SchedulerController
+
+
+class ControlPlane:
+    def __init__(self, store: Optional[Store] = None, backend: str = "fake",
+                 ready_delay: float = 0.0):
+        self.store = store or Store()
+        self.manager = Manager(self.store)
+        self.node_binding = NodeBindingStore(self.store)
+
+        from rbg_tpu.runtime.controllers.group import RoleBasedGroupController
+        from rbg_tpu.runtime.controllers.instance import RoleInstanceController
+        from rbg_tpu.runtime.controllers.instanceset import RoleInstanceSetController
+
+        self.group_controller = self.manager.register(
+            RoleBasedGroupController(self.store, self.node_binding))
+        self.instanceset_controller = self.manager.register(
+            RoleInstanceSetController(self.store))
+        self.instance_controller = self.manager.register(
+            RoleInstanceController(self.store, self.node_binding))
+        self.scheduler = self.manager.register(
+            SchedulerController(self.store, self.node_binding))
+        self._register_optional()
+
+        self.kubelet = None
+        if backend == "fake":
+            self.kubelet = FakeKubelet(self.store, ready_delay=ready_delay)
+        elif backend == "local":
+            from rbg_tpu.runtime.executor import LocalExecutor
+            self.kubelet = LocalExecutor(self.store)
+
+    def _register_optional(self):
+        """Controllers gated on availability (reference: CheckCrdExists gating,
+        ``main.go:355-422``)."""
+        for path, cls_name in (
+            ("rbg_tpu.runtime.controllers.groupset", "RoleBasedGroupSetController"),
+            ("rbg_tpu.runtime.controllers.scalingadapter", "ScalingAdapterController"),
+            ("rbg_tpu.runtime.controllers.warmup", "WarmupController"),
+        ):
+            try:
+                import importlib
+                mod = importlib.import_module(path)
+            except ImportError:
+                continue
+            self.manager.register(getattr(mod, cls_name)(self.store))
+
+    # ---- lifecycle ----
+
+    def start(self):
+        self.node_binding.reseed(self.store)
+        self.manager.start()
+        if self.kubelet is not None:
+            self.kubelet.start()
+        return self
+
+    def stop(self):
+        if self.kubelet is not None:
+            self.kubelet.stop()
+        self.manager.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ---- convenience ----
+
+    def apply(self, *objects):
+        """Create-or-update (kubectl apply equivalent)."""
+        out = []
+        for obj in objects:
+            cur = self.store.get(obj.kind, obj.metadata.namespace, obj.metadata.name)
+            if cur is None:
+                out.append(self.store.create(obj))
+            else:
+                obj.metadata.resource_version = cur.metadata.resource_version
+                obj.metadata.uid = cur.metadata.uid
+                out.append(self.store.update(obj))
+        return out if len(out) != 1 else out[0]
+
+    def wait_for(self, fn, timeout: float = 10.0, interval: float = 0.02,
+                 desc: str = "condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                v = fn()
+            except Exception:
+                v = None
+            if v:
+                return v
+            time.sleep(interval)
+        raise TimeoutError(f"timed out waiting for {desc}")
+
+    def wait_group_ready(self, name: str, namespace: str = "default",
+                         timeout: float = 10.0):
+        from rbg_tpu.api import constants as C
+        from rbg_tpu.api.meta import get_condition
+
+        def check():
+            g = self.store.get("RoleBasedGroup", namespace, name)
+            if g is None:
+                return None
+            c = get_condition(g.status.conditions, C.COND_READY)
+            return g if (c is not None and c.status == "True") else None
+
+        return self.wait_for(check, timeout=timeout, desc=f"group {name} Ready")

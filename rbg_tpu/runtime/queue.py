@@ -1,0 +1,120 @@
+"""Rate-limited work queue — k8s workqueue semantics.
+
+Dedup (dirty/processing sets), delayed adds, per-item exponential backoff.
+Reference analog: controller-runtime's workqueue + the custom rate limiters in
+``pkg/utils`` (SURVEY.md §2 #25). This is the control plane's hot loop; a C++
+implementation can be slotted behind the same interface (see native/).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Hashable, Optional
+
+
+class ExponentialBackoff:
+    """Per-item failure backoff: min(base * 2^(n-1), max)."""
+
+    def __init__(self, base: float = 0.005, max_delay: float = 30.0):
+        self.base = base
+        self.max_delay = max_delay
+        self._failures: dict = {}
+        self._lock = threading.Lock()
+
+    def next_delay(self, item: Hashable) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        return min(self.base * (2 ** n), self.max_delay)
+
+    def forget(self, item: Hashable) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def retries(self, item: Hashable) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class WorkQueue:
+    """FIFO queue with dedup + delayed add. An item present in ``processing``
+    that is re-added lands in ``dirty`` and is re-queued on ``done()`` —
+    guaranteeing a reconcile never runs concurrently for the same key while
+    never losing an event."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._queue: list = []
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._delayed: list = []  # heap of (fire_time, seq, item)
+        self._seq = 0
+        self._shutdown = False
+
+    def add(self, item: Hashable) -> None:
+        with self._lock:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+                self._lock.notify()
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            return self.add(item)
+        with self._lock:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
+            self._lock.notify()
+
+    def _pump_delayed_locked(self) -> Optional[float]:
+        """Move due delayed items into the queue; return wait time to next."""
+        now = time.monotonic()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            if item not in self._dirty:
+                self._dirty.add(item)
+                if item not in self._processing:
+                    self._queue.append(item)
+        return (self._delayed[0][0] - now) if self._delayed else None
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Any]:
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        with self._lock:
+            while True:
+                next_delay = self._pump_delayed_locked()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._processing.add(item)
+                    self._dirty.discard(item)
+                    return item
+                if self._shutdown:
+                    return None
+                wait = next_delay
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = min(wait, remaining) if wait is not None else remaining
+                self._lock.wait(wait if wait is not None else 1.0)
+
+    def done(self, item: Hashable) -> None:
+        with self._lock:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._lock.notify()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)

@@ -1,0 +1,271 @@
+"""In-process object store — the API-server equivalent.
+
+Every cross-controller boundary in the reference is an API-server round trip
+(SURVEY.md §3: watch → informer cache → reconcile → SSA patch); controllers
+never call each other. We preserve exactly that discipline: controllers
+communicate ONLY through this store (typed objects + watch events), which is
+what makes each controller independently testable and the whole plane
+restartable (level-triggered, state fully re-derivable — SURVEY.md §5
+checkpoint/resume).
+
+Semantics carried over: optimistic concurrency on resourceVersion, spec vs
+status subresources (generation bumps only on spec change), owner-reference
+cascade GC, label-selector + owner-uid indexed list (reference:
+``pkg/utils/fieldindex``).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+import uuid
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from rbg_tpu.api import serde
+
+Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+
+class Conflict(Exception):
+    """resourceVersion mismatch (optimistic concurrency failure)."""
+
+
+class AlreadyExists(Exception):
+    pass
+
+
+class NotFound(Exception):
+    pass
+
+
+class Event:
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+    def __init__(self, type_: str, obj):
+        self.type = type_
+        self.object = obj
+
+    def __repr__(self):
+        m = self.object.metadata
+        return f"Event({self.type}, {self.object.kind}/{m.namespace}/{m.name})"
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: Dict[Key, object] = {}
+        self._rv = 0
+        self._watchers: Dict[str, List[Callable[[Event], None]]] = defaultdict(list)
+        self._owner_index: Dict[str, set] = defaultdict(set)  # owner uid -> keys
+        self._events_log: List[tuple] = []  # (ts, kind/ns/name, reason, msg)
+
+    # ---- helpers ----
+
+    @staticmethod
+    def key(obj) -> Key:
+        return (obj.kind, obj.metadata.namespace, obj.metadata.name)
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _notify(self, ev: Event):
+        # Snapshot subscribers under lock; dispatch outside to avoid deadlocks.
+        with self._lock:
+            subs = list(self._watchers.get(ev.object.kind, ())) + list(self._watchers.get("*", ()))
+        for fn in subs:
+            try:
+                fn(Event(ev.type, copy.deepcopy(ev.object)))
+            except Exception:  # watcher bugs must not poison the store
+                import traceback
+                traceback.print_exc()
+
+    # ---- watch ----
+
+    def watch(self, kind: str, handler: Callable[[Event], None]) -> None:
+        """Subscribe to events for ``kind`` ("*" = all kinds)."""
+        with self._lock:
+            self._watchers[kind].append(handler)
+
+    # ---- CRUD ----
+
+    def create(self, obj):
+        obj = copy.deepcopy(obj)
+        m = obj.metadata
+        with self._lock:
+            k = self.key(obj)
+            if k in self._objects:
+                raise AlreadyExists(f"{k} already exists")
+            m.uid = m.uid or uuid.uuid4().hex[:12]
+            m.resource_version = self._next_rv()
+            m.generation = 1
+            m.creation_timestamp = m.creation_timestamp or time.time()
+            self._objects[k] = obj
+            for ref in m.owner_references:
+                self._owner_index[ref.uid].add(k)
+        self._notify(Event(Event.ADDED, obj))
+        return copy.deepcopy(obj)
+
+    def get(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def must_get(self, kind: str, namespace: str, name: str):
+        obj = self.get(kind, namespace, name)
+        if obj is None:
+            raise NotFound(f"{kind}/{namespace}/{name}")
+        return obj
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        selector: Optional[Dict[str, str]] = None,
+        owner_uid: Optional[str] = None,
+    ) -> list:
+        with self._lock:
+            if owner_uid is not None:
+                keys = [k for k in self._owner_index.get(owner_uid, ()) if k[0] == kind]
+                items = [self._objects[k] for k in keys if k in self._objects]
+            else:
+                items = [o for (k, ns, n), o in self._objects.items() if k == kind]
+            out = []
+            for o in items:
+                if namespace is not None and o.metadata.namespace != namespace:
+                    continue
+                if selector:
+                    labels = o.metadata.labels
+                    if any(labels.get(k) != v for k, v in selector.items()):
+                        continue
+                out.append(copy.deepcopy(o))
+            out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+            return out
+
+    def _spec_changed(self, old, new) -> bool:
+        for attr in ("spec", "template", "data", "selector", "labels", "node_name",
+                     "affinity", "revision", "role_hashes", "init_containers",
+                     "containers", "volumes", "tpu", "capacity_pods", "address",
+                     "leader_only"):
+            if hasattr(new, attr):
+                if serde.to_dict(getattr(old, attr, None)) != serde.to_dict(getattr(new, attr)):
+                    return True
+        return False
+
+    def update(self, obj):
+        """Full update with optimistic concurrency; bumps generation on spec
+        change. Status is carried over from the stored object — use
+        update_status for the status subresource."""
+        obj = copy.deepcopy(obj)
+        with self._lock:
+            k = self.key(obj)
+            cur = self._objects.get(k)
+            if cur is None:
+                raise NotFound(str(k))
+            if obj.metadata.resource_version != cur.metadata.resource_version:
+                raise Conflict(f"{k}: rv {obj.metadata.resource_version} != {cur.metadata.resource_version}")
+            if hasattr(cur, "status"):
+                obj.status = copy.deepcopy(cur.status)
+            if self._spec_changed(cur, obj):
+                obj.metadata.generation = cur.metadata.generation + 1
+            else:
+                obj.metadata.generation = cur.metadata.generation
+            obj.metadata.resource_version = self._next_rv()
+            obj.metadata.uid = cur.metadata.uid
+            obj.metadata.creation_timestamp = cur.metadata.creation_timestamp
+            obj.metadata.deletion_timestamp = cur.metadata.deletion_timestamp
+            self._objects[k] = obj
+            for ref in obj.metadata.owner_references:
+                self._owner_index[ref.uid].add(k)
+        self._notify(Event(Event.MODIFIED, obj))
+        return copy.deepcopy(obj)
+
+    def update_status(self, obj):
+        """Status-subresource update (no generation bump)."""
+        with self._lock:
+            k = self.key(obj)
+            cur = self._objects.get(k)
+            if cur is None:
+                raise NotFound(str(k))
+            if obj.metadata.resource_version != cur.metadata.resource_version:
+                raise Conflict(f"{k} status: rv mismatch")
+            new = copy.deepcopy(cur)
+            new.status = copy.deepcopy(obj.status)
+            new.metadata.resource_version = self._next_rv()
+            self._objects[k] = new
+        self._notify(Event(Event.MODIFIED, new))
+        return copy.deepcopy(new)
+
+    def mutate(self, kind: str, namespace: str, name: str, fn, status: bool = False,
+               retries: int = 8):
+        """Read-modify-write with conflict retry (the SSA-patch equivalent:
+        reference controllers use server-side apply; our single-writer-per-
+        field discipline plus this retry loop gives the same convergence)."""
+        for _ in range(retries):
+            obj = self.get(kind, namespace, name)
+            if obj is None:
+                raise NotFound(f"{kind}/{namespace}/{name}")
+            res = fn(obj)
+            if res is False:
+                return obj  # no-op
+            try:
+                return self.update_status(obj) if status else self.update(obj)
+            except Conflict:
+                continue
+        raise Conflict(f"{kind}/{namespace}/{name}: retries exhausted")
+
+    def delete(self, kind: str, namespace: str, name: str, grace: bool = False):
+        """Delete an object. grace=True only marks deletionTimestamp (the
+        executor finalizes via finalize_delete); grace=False removes now.
+        Owned objects are cascade-deleted (k8s GC equivalent)."""
+        with self._lock:
+            k = (kind, namespace, name)
+            cur = self._objects.get(k)
+            if cur is None:
+                return None
+            if grace and cur.metadata.deletion_timestamp is None:
+                cur = copy.deepcopy(cur)
+                cur.metadata.deletion_timestamp = time.time()
+                cur.metadata.resource_version = self._next_rv()
+                self._objects[k] = cur
+                ev = Event(Event.MODIFIED, cur)
+            else:
+                del self._objects[k]
+                for keys in self._owner_index.values():
+                    keys.discard(k)
+                ev = Event(Event.DELETED, cur)
+        self._notify(ev)
+        if ev.type == Event.DELETED:
+            self._gc_owned(cur.metadata.uid)
+        return copy.deepcopy(cur)
+
+    def finalize_delete(self, kind: str, namespace: str, name: str):
+        return self.delete(kind, namespace, name, grace=False)
+
+    def _gc_owned(self, owner_uid: str):
+        with self._lock:
+            keys = list(self._owner_index.pop(owner_uid, ()))
+        for kind, ns, name in keys:
+            self.delete(kind, ns, name)
+
+    # ---- event recorder (k8s Events equivalent) ----
+
+    def record_event(self, obj, reason: str, message: str):
+        with self._lock:
+            self._events_log.append(
+                (time.time(), f"{obj.kind}/{obj.metadata.namespace}/{obj.metadata.name}",
+                 reason, message)
+            )
+            if len(self._events_log) > 2000:
+                del self._events_log[:1000]
+
+    def events_for(self, obj=None) -> list:
+        with self._lock:
+            if obj is None:
+                return list(self._events_log)
+            ref = f"{obj.kind}/{obj.metadata.namespace}/{obj.metadata.name}"
+            return [e for e in self._events_log if e[1] == ref]

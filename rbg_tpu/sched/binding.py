@@ -1,0 +1,85 @@
+"""Node-binding store: warm-placement memory for in-place scheduling.
+
+Reference analog: ``pkg/reconciler/roleinstance/sync/node_binding.go``
+(inventory #14, KEP-351): an in-memory map of where a group's instances last
+ran Running+Ready, injected as node affinity on recreation so pods return to
+warm nodes. TPU extension (SURVEY.md §7 "hard parts"): bindings are recorded
+at **slice granularity** — a recovered multi-host instance must re-acquire the
+*same slice* (same ICI domain) to reuse host-side HBM state and XLA caches.
+
+Non-durable by design; reseeded from live pods after a controller restart
+(reference: ``node_binding.go:200-204``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Set, Tuple
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.pod import NodeAffinityTerm
+
+
+class NodeBindingStore:
+    def __init__(self, store=None):
+        self._lock = threading.Lock()
+        # (group_uid, instance) -> set of node names
+        self._nodes: Dict[Tuple[str, str], Set[str]] = {}
+        # (group_uid, instance) -> slice id
+        self._slices: Dict[Tuple[str, str], str] = {}
+        self._store = store
+
+    @staticmethod
+    def _key(pod) -> Optional[Tuple[str, str]]:
+        grp_uid = pod.metadata.labels.get(C.LABEL_GROUP_NAME, "")
+        inst = pod.metadata.labels.get(C.LABEL_INSTANCE_NAME, "")
+        if not grp_uid or not inst:
+            return None
+        return (grp_uid, inst)
+
+    def record(self, pod, node) -> None:
+        """Record a Running+Ready pod's placement."""
+        key = self._key(pod)
+        if key is None or node is None:
+            return
+        with self._lock:
+            self._nodes.setdefault(key, set()).add(node.metadata.name)
+            if node.tpu.slice_id:
+                self._slices[key] = node.tpu.slice_id
+
+    def preferred_nodes(self, pod) -> Set[str]:
+        key = self._key(pod)
+        with self._lock:
+            return set(self._nodes.get(key, ())) if key else set()
+
+    def preferred_slice(self, pod) -> Optional[str]:
+        key = self._key(pod)
+        with self._lock:
+            return self._slices.get(key) if key else None
+
+    def affinity_terms(self, pod) -> list:
+        """Preferred affinity to historical nodes (never Required — warm nodes
+        may be gone; reference folds to Required only for explicit policies)."""
+        nodes = self.preferred_nodes(pod)
+        if not nodes:
+            return []
+        return [NodeAffinityTerm(key="name", operator="In", values=sorted(nodes), weight=10)]
+
+    def evict_group(self, group_uid_or_name: str) -> None:
+        """Drop all bindings of a group (on group delete; reference:
+        ``rolebasedgroup_controller.go:1024-1040``)."""
+        with self._lock:
+            for k in [k for k in self._nodes if k[0] == group_uid_or_name]:
+                del self._nodes[k]
+            for k in [k for k in self._slices if k[0] == group_uid_or_name]:
+                del self._slices[k]
+
+    def reseed(self, store) -> None:
+        """Rebuild from live Running+Ready pods (controller restart)."""
+        nodes = {n.metadata.name: n for n in store.list("Node")}
+        with self._lock:
+            self._nodes.clear()
+            self._slices.clear()
+        for pod in store.list("Pod"):
+            if pod.running_ready and pod.node_name in nodes:
+                self.record(pod, nodes[pod.node_name])

@@ -1,0 +1,289 @@
+"""TPU-topology-aware scheduler.
+
+The reference delegates placement to kube-scheduler and expresses intent via
+PodGroup CRs + pod (anti-)affinity (``pkg/scheduler``, ``pod_reconciler.go:
+160-242``). This framework OWNS placement — the TPU-first replacement for the
+README's "NVLink > PCIe > RDMA > VPC" affinity ladder is an explicit
+ICI > DCN ladder over slice topology:
+
+1. **Slice atomicity** — a multi-host role instance (one JAX program) must
+   occupy hosts of exactly ONE slice (one ICI domain), one pod per host,
+   worker_index-aligned so JAX process ids match the physical ring order.
+2. **Gang all-or-nothing** — a PodGroup binds only when every member can bind
+   (TPU slices are provisioned whole; partial placement deadlocks capacity).
+3. **Exclusive topology** — at most one group per topology domain when
+   requested (reference: exclusive-topology, ``pod_reconciler.go:160-221``).
+4. **Warm affinity** — prefer nodes/slices recorded by the node-binding store
+   (in-place scheduling, reference KEP-351) so restarted instances return to
+   hosts with warm HBM/XLA caches.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Tuple
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.runtime.controller import Controller, Result, Watch
+from rbg_tpu.runtime.store import Store
+
+
+def _unscheduled(ev) -> bool:
+    return True  # level-triggered; reconcile re-checks everything
+
+
+class SchedulerController(Controller):
+    name = "scheduler"
+    # Single worker: placement decisions are serialized (as in kube-scheduler's
+    # one scheduling loop) so concurrent plans can never double-book a host.
+    workers = 1
+
+    def __init__(self, store: Store, node_binding=None):
+        super().__init__(store)
+        self.node_binding = node_binding  # rbg_tpu.sched.binding.NodeBindingStore
+
+    def watches(self) -> List[Watch]:
+        from rbg_tpu.runtime.controller import own_keys
+        return [
+            Watch("Pod", own_keys),
+            # Node changes can unblock pending pods — re-enqueue all pending.
+            Watch("Node", lambda obj: [
+                (p.metadata.namespace, p.metadata.name)
+                for p in self.store.list("Pod")
+                if not p.node_name and p.active
+            ]),
+        ]
+
+    # ---- reconcile ----
+
+    def reconcile(self, store: Store, key) -> Optional[Result]:
+        ns, name = key
+        pod = store.get("Pod", ns, name)
+        if pod is None or pod.node_name or not pod.active:
+            return None
+
+        group = pod.metadata.labels.get(C.LABEL_POD_GROUP)
+        if group:
+            return self._schedule_gang(store, ns, group)
+        plan = self._place(store, [pod])
+        if plan is None:
+            store.record_event(pod, "FailedScheduling", "no feasible node")
+            return Result(requeue_after=0.2)
+        self._bind(store, plan)
+        return None
+
+    def _schedule_gang(self, store: Store, ns: str, group: str) -> Optional[Result]:
+        pods = [
+            p for p in store.list("Pod", namespace=ns, selector={C.LABEL_POD_GROUP: group})
+            if p.active
+        ]
+        pg = store.get("PodGroup", ns, group)
+        min_member = pg.spec.min_member if pg else len(pods)
+        if len(pods) < min_member:
+            return Result(requeue_after=0.2)  # members still being created
+        unbound = [p for p in pods if not p.node_name]
+        if not unbound:
+            self._mark_pg(store, ns, group, pods)
+            return None
+        plan = self._place(store, unbound)
+        if plan is None:
+            if pods:
+                store.record_event(pods[0], "FailedGangScheduling",
+                                   f"group {group}: cannot place {len(unbound)} pods atomically")
+            return Result(requeue_after=0.3)
+        self._bind(store, plan)
+        self._mark_pg(store, ns, group, pods)
+        return None
+
+    def _mark_pg(self, store, ns, group, pods):
+        pg = store.get("PodGroup", ns, group)
+        if pg is None:
+            return
+        bound = sum(1 for p in store.list("Pod", namespace=ns,
+                                          selector={C.LABEL_POD_GROUP: group})
+                    if p.node_name)
+
+        def fn(g):
+            phase = "Scheduled" if bound >= g.spec.min_member else "Pending"
+            if (g.status.phase, g.status.scheduled) == (phase, bound):
+                return False
+            g.status.phase, g.status.scheduled = phase, bound
+            return True
+
+        try:
+            store.mutate("PodGroup", ns, group, fn, status=True)
+        except Exception:
+            pass
+
+    # ---- placement core ----
+
+    def _place(self, store: Store, pods: List) -> Optional[Dict[Tuple[str, str], str]]:
+        """Compute {(ns, pod): node} for all pods or None (all-or-nothing)."""
+        nodes = [n for n in store.list("Node") if n.ready]
+        if not nodes:
+            return None
+        bound = [p for p in store.list("Pod") if p.node_name and p.active]
+        used = collections.Counter(p.node_name for p in bound)
+        free = {n.metadata.name: n.capacity_pods - used[n.metadata.name] for n in nodes}
+        # TPU hosts are chip-exclusive: one slice pod per host.
+        tpu_used = {
+            p.node_name for p in bound
+            if p.template.scheduler_hints.get("tpu-slice") == "true"
+        }
+        excl = self._exclusive_domains(store, nodes)
+
+        plan: Dict[Tuple[str, str], str] = {}
+        # Slice-atomic groups first (hardest constraints), then singles.
+        by_instance = collections.defaultdict(list)
+        singles = []
+        for p in pods:
+            inst = p.metadata.labels.get(C.LABEL_INSTANCE_NAME)
+            if inst and p.template.scheduler_hints.get("tpu-slice") == "true":
+                by_instance[(p.metadata.namespace, inst)].append(p)
+            else:
+                singles.append(p)
+
+        for (ns, inst), group in sorted(by_instance.items(), key=lambda kv: -len(kv[1])):
+            if not self._place_slice_group(store, group, nodes, free, excl, plan, tpu_used):
+                return None
+        for p in sorted(singles, key=lambda p: p.metadata.name):
+            node = self._pick_node(p, nodes, free, excl)
+            if node is None:
+                return None
+            plan[(p.metadata.namespace, p.metadata.name)] = node
+            free[node] -= 1
+        return plan
+
+    def _place_slice_group(self, store, group, nodes, free, excl, plan, tpu_used) -> bool:
+        """Place (the unbound remainder of) a multi-host slice instance: one
+        ICI domain, one pod per host, worker_index == JAX process id when
+        possible. Sibling pods of the instance may already be bound (partial
+        gang, controller restart) — their slice pins the choice and their
+        hosts are off-limits."""
+        ns = group[0].metadata.namespace
+        inst = group[0].metadata.labels.get(C.LABEL_INSTANCE_NAME, "")
+        node_by = {n.metadata.name: n for n in nodes}
+        siblings = [
+            p for p in store.list("Pod", namespace=ns,
+                                  selector={C.LABEL_INSTANCE_NAME: inst})
+            if p.node_name and p.active
+        ]
+        taken = {p.node_name for p in siblings}
+        sibling_slice = ""
+        for p in siblings:
+            n = node_by.get(p.node_name)
+            if n is not None and n.tpu.slice_id:
+                sibling_slice = n.tpu.slice_id
+                break
+
+        group = sorted(
+            group, key=lambda p: int(p.metadata.labels.get(C.LABEL_COMPONENT_INDEX, "0"))
+        )
+        need = len(group)
+        slices = collections.defaultdict(list)
+        for n in nodes:
+            name = n.metadata.name
+            if (n.tpu.slice_id and self._node_ok(group[0], n, excl)
+                    and free[name] > 0 and name not in taken and name not in tpu_used):
+                slices[n.tpu.slice_id].append(n)
+
+        preferred = sibling_slice or group[0].metadata.annotations.get(C.ANN_SLICE_BINDING, "")
+        # Also consult the warm node-binding store.
+        if not preferred and self.node_binding is not None:
+            preferred = self.node_binding.preferred_slice(group[0]) or ""
+
+        def candidates():
+            if preferred in slices:
+                yield preferred, slices[preferred]
+            if sibling_slice:
+                return  # bound siblings pin the ICI domain — no other slice is legal
+            # Emptiest-first: keep fragmentation low, leave room for big gangs.
+            for sid, hosts in sorted(slices.items(), key=lambda kv: -len(kv[1])):
+                if sid != preferred:
+                    yield sid, hosts
+
+        for sid, hosts in candidates():
+            if len(hosts) < need:
+                continue
+            hosts = sorted(hosts, key=lambda n: n.tpu.worker_index)
+            # Align worker_index to component index when the slice is exactly
+            # sized; otherwise take the first `need` free hosts in ring order.
+            for p, n in zip(group, hosts[:need]):
+                plan[(p.metadata.namespace, p.metadata.name)] = n.metadata.name
+                free[n.metadata.name] -= 1
+                tpu_used.add(n.metadata.name)
+            return True
+        return False
+
+    def _pick_node(self, pod, nodes, free, excl) -> Optional[str]:
+        def satisfies(term, n) -> bool:
+            val = n.metadata.name if term.key == "name" else n.labels.get(term.key)
+            if term.operator == "In":
+                return val in term.values
+            if term.operator == "NotIn":
+                return val not in term.values
+            if term.operator == "Exists":
+                return val is not None
+            if term.operator == "DoesNotExist":
+                return val is None
+            return True
+
+        best, best_score = None, None
+        for n in nodes:
+            if free.get(n.metadata.name, 0) <= 0 or not self._node_ok(pod, n, excl):
+                continue
+            # Required affinity filters candidates; preferred terms score.
+            if any(t.required and not satisfies(t, n) for t in pod.affinity):
+                continue
+            score = free[n.metadata.name]
+            for term in pod.affinity:
+                if not term.required and satisfies(term, n):
+                    score += 1000 * term.weight
+            if best_score is None or score > best_score:
+                best, best_score = n.metadata.name, score
+        return best
+
+    def _node_ok(self, pod, node, excl) -> bool:
+        for k, v in pod.template.node_selector.items():
+            if node.labels.get(k) != v:
+                return False
+        if pod.template.containers and pod.template.containers[0].resources.tpu_chips:
+            if node.tpu.chips < pod.template.containers[0].resources.tpu_chips:
+                return False
+        topo_key = pod.metadata.annotations.get(C.ANN_EXCLUSIVE_TOPOLOGY)
+        if topo_key:
+            domain = node.labels.get(topo_key, "")
+            owner = excl.get((topo_key, domain))
+            mine = pod.metadata.labels.get(C.LABEL_GROUP_NAME)
+            if owner is not None and owner != mine:
+                return False
+        return True
+
+    def _exclusive_domains(self, store, nodes) -> Dict[Tuple[str, str], str]:
+        """Map (topology key, domain) -> group owning it (from bound pods)."""
+        node_by_name = {n.metadata.name: n for n in nodes}
+        out: Dict[Tuple[str, str], str] = {}
+        for p in store.list("Pod"):
+            if not p.node_name or not p.active:
+                continue
+            key = p.metadata.annotations.get(C.ANN_EXCLUSIVE_TOPOLOGY)
+            grp = p.metadata.labels.get(C.LABEL_GROUP_NAME)
+            if not key or not grp:
+                continue
+            n = node_by_name.get(p.node_name)
+            if n is not None:
+                out[(key, n.labels.get(key, ""))] = grp
+        return out
+
+    def _bind(self, store: Store, plan: Dict[Tuple[str, str], str]):
+        for (ns, name), node in plan.items():
+            try:
+                def fn(p, node=node):
+                    if p.node_name:
+                        return False
+                    p.node_name = node
+                    return True
+
+                store.mutate("Pod", ns, name, fn)
+            except Exception:
+                pass

@@ -1,0 +1,3 @@
+from rbg_tpu.utils.hashing import spec_hash
+
+__all__ = ["spec_hash"]

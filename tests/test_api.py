@@ -1,0 +1,85 @@
+"""API surface: manifest parse/round-trip, validation, naming contracts."""
+
+import pytest
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api import parse_manifest, serde, to_yaml
+from rbg_tpu.api.group import PatternType, RoleBasedGroup
+from rbg_tpu.api.validation import ValidationError, validate_group
+from rbg_tpu.testutil import make_group, simple_role, tpu_leaderworker_role
+
+MANIFEST = """
+kind: RoleBasedGroup
+metadata:
+  name: pd-disagg
+  namespace: default
+spec:
+  roles:
+  - name: decode
+    replicas: 2
+    pattern: leaderWorker
+    tpu:
+      accelerator: v5e
+      sliceTopology: 2x4
+    template:
+      containers:
+      - name: engine
+        image: sglang-jax:v1
+        args: ["--model", "llama3-8b"]
+  - name: router
+    replicas: 1
+    dependencies: [decode]
+    template:
+      containers:
+      - name: router
+        image: router:v1
+"""
+
+
+def test_manifest_parse_and_roundtrip():
+    import yaml
+    doc = yaml.safe_load(MANIFEST)
+    g = parse_manifest(doc)
+    assert isinstance(g, RoleBasedGroup)
+    assert g.spec.roles[0].pattern == PatternType.LEADER_WORKER
+    assert g.spec.roles[0].tpu.slice_topology == "2x4"
+    assert g.spec.roles[0].tpu.num_hosts == 2
+    assert g.spec.roles[1].dependencies == ["decode"]
+    # round-trip
+    g2 = parse_manifest(yaml.safe_load(to_yaml(g)))
+    assert serde.to_dict(g2) == serde.to_dict(g)
+
+
+def test_unknown_field_rejected():
+    import yaml
+    doc = yaml.safe_load(MANIFEST)
+    doc["spec"]["roles"][0]["bogusField"] = 1
+    with pytest.raises(KeyError, match="bogusField"):
+        parse_manifest(doc)
+
+
+def test_validation_errors():
+    g = make_group("ok", simple_role("a"), simple_role("a"))
+    with pytest.raises(ValidationError, match="duplicated"):
+        validate_group(g)
+
+    g = make_group("bad_name!", simple_role("a"))
+    with pytest.raises(ValidationError, match="DNS-1123"):
+        validate_group(g)
+
+    g = make_group("ok", simple_role("a", dependencies=["ghost"]))
+    with pytest.raises(ValidationError, match="unknown role"):
+        validate_group(g)
+
+    role = tpu_leaderworker_role("tp", topology="bogus")
+    with pytest.raises(ValidationError, match="sliceTopology"):
+        validate_group(make_group("ok", role))
+
+
+def test_naming_contracts():
+    # reference Appendix B: workload {group}-{role}; service s-{group}-{role}
+    assert C.workload_name("pd", "decode") == "pd-decode"
+    assert C.service_name("pd", "decode") == "s-pd-decode"
+    long = "x" * 70
+    assert len(C.workload_name(long, "r")) <= 63
+    assert not C.workload_name("x" * 62, "r").endswith("-")

@@ -1,0 +1,246 @@
+"""Control-plane e2e (envtest-style): real controllers + fake kubelet.
+
+Mirrors the reference's envtest tier (SURVEY.md §4 tier 2): all controllers
+run against the in-process store; the FakeKubelet plays kubelet.
+"""
+
+import pytest
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.meta import get_condition
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import (
+    make_group, make_tpu_nodes, simple_role, tpu_leaderworker_role,
+)
+
+
+@pytest.fixture()
+def plane():
+    p = ControlPlane(backend="fake")
+    make_tpu_nodes(p.store, slices=2, hosts_per_slice=2)
+    with p:
+        yield p
+
+
+def test_single_role_group_becomes_ready(plane):
+    plane.apply(make_group("demo", simple_role("server", replicas=2)))
+    g = plane.wait_group_ready("demo")
+    st = g.status.role("server")
+    assert st.replicas == 2 and st.ready_replicas == 2
+    # child objects exist with the naming contract
+    assert plane.store.get("RoleInstanceSet", "default", "demo-server") is not None
+    assert plane.store.get("Service", "default", "s-demo-server") is not None
+    pods = plane.store.list("Pod", namespace="default")
+    assert len(pods) == 2
+    assert {p.metadata.labels[C.LABEL_ROLE_NAME] for p in pods} == {"server"}
+
+
+def test_dependency_ordering_router_waits_for_worker(plane):
+    plane.apply(make_group(
+        "pd",
+        simple_role("worker", replicas=1),
+        simple_role("router", replicas=1, dependencies=["worker"]),
+    ))
+    # Router pods must not exist before worker is ready; by the time the group
+    # is Ready, both exist. Verify creation ordering via creation timestamps.
+    plane.wait_group_ready("pd")
+    pods = plane.store.list("Pod", namespace="default")
+    by_role = {p.metadata.labels[C.LABEL_ROLE_NAME]: p for p in pods}
+    assert set(by_role) == {"worker", "router"}
+    assert (by_role["worker"].metadata.creation_timestamp
+            <= by_role["router"].metadata.creation_timestamp)
+
+
+def test_dependency_cycle_rejected(plane):
+    g = make_group(
+        "cyc",
+        simple_role("a", dependencies=["b"]),
+        simple_role("b", dependencies=["a"]),
+    )
+    plane.apply(g)
+
+    def check():
+        cur = plane.store.get("RoleBasedGroup", "default", "cyc")
+        c = get_condition(cur.status.conditions, C.COND_READY)
+        return c if (c and c.status == "False" and c.reason == "ValidationFailed") else None
+
+    plane.wait_for(check, desc="validation failure condition")
+    assert plane.store.list("RoleInstanceSet", namespace="default", owner_uid=None) == []
+
+
+def test_leaderworker_slice_atomic_placement(plane):
+    # 2x4 topology / 4 chips per host = 2 hosts per instance; 2 replicas fill
+    # both fake slices. Pods of one instance must share a slice, one per host.
+    plane.apply(make_group("tp", tpu_leaderworker_role("serve", replicas=2, topology="2x4")))
+    g = plane.wait_group_ready("tp")
+    assert g.status.role("serve").ready_replicas == 2
+    pods = plane.store.list("Pod", namespace="default")
+    assert len(pods) == 4
+    by_inst = {}
+    for p in pods:
+        by_inst.setdefault(p.metadata.labels[C.LABEL_INSTANCE_NAME], []).append(p)
+    assert len(by_inst) == 2
+    nodes = {n.metadata.name: n for n in plane.store.list("Node")}
+    for inst, ps in by_inst.items():
+        slice_ids = {nodes[p.node_name].tpu.slice_id for p in ps}
+        hosts = {p.node_name for p in ps}
+        assert len(slice_ids) == 1, f"instance {inst} spans slices {slice_ids}"
+        assert len(hosts) == len(ps), "two gang pods on one host"
+        # JAX process id == slice worker index (ring-order alignment)
+        for p in ps:
+            envs = {e.name: e.value for e in p.template.containers[0].env}
+            assert envs[C.ENV_JAX_NUM_PROCESSES] == "2"
+            assert envs[C.ENV_JAX_PROCESS_ID] == p.metadata.labels[C.LABEL_COMPONENT_INDEX]
+            assert C.ENV_JAX_COORDINATOR in envs
+
+
+def test_gang_all_or_nothing_until_capacity(plane):
+    # Needs 2 hosts in ONE slice; make a group that needs 3 hosts per instance
+    # → cannot fit any 2-host slice → nothing binds.
+    plane.apply(make_group("big", tpu_leaderworker_role("serve", replicas=1, topology="3x4")))
+
+    import time
+    time.sleep(0.5)
+    pods = plane.store.list("Pod", namespace="default")
+    assert len(pods) == 3
+    assert all(not p.node_name for p in pods), "partial gang placement happened"
+
+    # Add a 3-host slice → gang binds.
+    from rbg_tpu.api.pod import Node, TpuNodeInfo
+    for h in range(3):
+        n = Node()
+        n.metadata.name = f"bigslice-host-{h}"
+        n.tpu = TpuNodeInfo(accelerator="v5e", slice_id="bigslice", worker_index=h, chips=4)
+        plane.store.create(n)
+    plane.wait_group_ready("big")
+    pods = plane.store.list("Pod", namespace="default")
+    assert all(p.node_name.startswith("bigslice") for p in pods)
+
+
+def test_scale_up_and_down(plane):
+    plane.apply(make_group("s", simple_role("server", replicas=1)))
+    plane.wait_group_ready("s")
+
+    g = plane.store.get("RoleBasedGroup", "default", "s")
+    g.spec.roles[0].replicas = 3
+    plane.store.update(g)
+    plane.wait_for(
+        lambda: len([p for p in plane.store.list("Pod", namespace="default") if p.active]) == 3,
+        timeout=30, desc="scale up to 3",
+    )
+    g = plane.store.get("RoleBasedGroup", "default", "s")
+    g.spec.roles[0].replicas = 1
+    plane.store.update(g)
+    plane.wait_for(
+        lambda: len([p for p in plane.store.list("Pod", namespace="default") if p.active]) == 1,
+        timeout=30, desc="scale down to 1",
+    )
+    # stateful: highest ordinals removed first — survivor is ordinal 0
+    pod = [p for p in plane.store.list("Pod", namespace="default") if p.active][0]
+    assert pod.metadata.labels[C.LABEL_INSTANCE_INDEX] == "0"
+
+
+def test_orphan_role_cleanup(plane):
+    plane.apply(make_group("o", simple_role("a"), simple_role("b")))
+    plane.wait_group_ready("o")
+    g = plane.store.get("RoleBasedGroup", "default", "o")
+    g.spec.roles = [r for r in g.spec.roles if r.name == "a"]
+    plane.store.update(g)
+    plane.wait_for(
+        lambda: plane.store.get("RoleInstanceSet", "default", "o-b") is None,
+        desc="orphan RIS deleted",
+    )
+    plane.wait_for(
+        lambda: plane.store.get("Service", "default", "s-o-b") is None,
+        desc="orphan service deleted",
+    )
+
+
+def test_group_delete_cascades(plane):
+    plane.apply(make_group("d", simple_role("server", replicas=2)))
+    plane.wait_group_ready("d")
+    plane.store.delete("RoleBasedGroup", "default", "d")
+    plane.wait_for(
+        lambda: not plane.store.list("Pod", namespace="default"),
+        desc="cascade delete pods",
+    )
+    assert plane.store.list("RoleInstanceSet", namespace="default") == []
+
+
+def test_restart_policy_recreates_gang_with_backoff(plane):
+    from rbg_tpu.api.group import RestartPolicyConfig
+    role = simple_role("server", replicas=1)
+    role.restart_policy = RestartPolicyConfig(base_delay_seconds=0.05, max_delay_seconds=1.0)
+    plane.apply(make_group("r", role))
+    plane.wait_group_ready("r")
+    pod0 = plane.store.list("Pod", namespace="default")[0]
+    uid0 = pod0.metadata.uid
+
+    plane.kubelet.fail_pod("default", pod0.metadata.name)
+
+    def recreated():
+        ps = [p for p in plane.store.list("Pod", namespace="default") if p.active]
+        return ps and all(p.metadata.uid != uid0 for p in ps) and ps[0].running_ready
+
+    plane.wait_for(recreated, desc="pod gang recreated")
+    inst = plane.store.list("RoleInstance", namespace="default")[0]
+    assert inst.status.restart_count == 1
+    assert inst.status.last_restart_time > 0
+    plane.wait_group_ready("r")
+
+
+def test_rolling_update_recreates_descending(plane):
+    role = simple_role("server", replicas=3)
+    plane.apply(make_group("u", role))
+    plane.wait_group_ready("u")
+    old_uids = {p.metadata.labels[C.LABEL_INSTANCE_NAME]: p.metadata.uid
+                for p in plane.store.list("Pod", namespace="default")}
+
+    g = plane.store.get("RoleBasedGroup", "default", "u")
+    g.spec.roles[0].template.containers[0].image = "engine:v2"
+    plane.store.update(g)
+
+    def all_updated():
+        pods = [p for p in plane.store.list("Pod", namespace="default") if p.active]
+        return (len(pods) == 3
+                and all(p.template.containers[0].image == "engine:v2" for p in pods)
+                and all(p.running_ready for p in pods))
+
+    plane.wait_for(all_updated, timeout=15, desc="rolling update complete")
+    new_uids = {p.metadata.labels[C.LABEL_INSTANCE_NAME]: p.metadata.uid
+                for p in plane.store.list("Pod", namespace="default")}
+    assert set(new_uids) == set(old_uids)
+    assert all(new_uids[k] != old_uids[k] for k in old_uids)
+
+    def status_converged():
+        ris = plane.store.get("RoleInstanceSet", "default", "u-server")
+        return (ris.status.updated_replicas == 3
+                and ris.status.updated_ready_replicas == 3)
+
+    plane.wait_for(status_converged, desc="RIS status rollup")
+
+
+def test_warm_slice_rebinding_after_restart(plane):
+    """Atomic slice recovery: a restarted multi-host instance returns to the
+    SAME slice (warm HBM/compile caches) — SURVEY.md §7 hard parts."""
+    from rbg_tpu.api.group import RestartPolicyConfig
+    role = tpu_leaderworker_role("serve", replicas=1, topology="2x4")
+    role.restart_policy = RestartPolicyConfig(base_delay_seconds=0.01)
+    plane.apply(make_group("warm", role))
+    plane.wait_group_ready("warm")
+    nodes = {n.metadata.name: n for n in plane.store.list("Node")}
+    pods0 = [p for p in plane.store.list("Pod", namespace="default")]
+    slice0 = {nodes[p.node_name].tpu.slice_id for p in pods0}.pop()
+    uids0 = {p.metadata.uid for p in pods0}
+
+    plane.kubelet.fail_pod("default", pods0[0].metadata.name)
+
+    def recreated_ready():
+        ps = [p for p in plane.store.list("Pod", namespace="default") if p.active]
+        return (len(ps) == 2 and uids0.isdisjoint({p.metadata.uid for p in ps})
+                and all(p.running_ready for p in ps))
+
+    plane.wait_for(recreated_ready, timeout=15, desc="gang recreated")
+    pods1 = [p for p in plane.store.list("Pod", namespace="default") if p.active]
+    slice1 = {nodes[p.node_name].tpu.slice_id for p in pods1}.pop()
+    assert slice1 == slice0, f"instance moved {slice0} -> {slice1} (cold slice)"

@@ -1,0 +1,92 @@
+// Cluster-wide host-port allocator — native hot path.
+//
+// Reference analog: pkg/port-allocator (inventory #18, Go): random strategy
+// in [start, start+range), cluster-singleton, thread-safe. This is the
+// C++ implementation backing rbg_tpu.portalloc via ctypes; the Python
+// fallback implements identical semantics.
+//
+// C ABI (ctypes-friendly): opaque handle + int results. -1 == failure.
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <vector>
+
+struct PortAllocator {
+  int32_t start;
+  int32_t range;
+  std::vector<uint8_t> used;  // bitmap over [0, range)
+  int32_t n_used = 0;
+  std::mt19937 rng;
+  std::mutex mu;
+
+  PortAllocator(int32_t s, int32_t r, uint64_t seed)
+      : start(s), range(r), used(r, 0), rng(seed) {}
+};
+
+extern "C" {
+
+void* pa_create(int32_t start, int32_t range, uint64_t seed) {
+  if (range <= 0 || start <= 0 || start + range > 65536) return nullptr;
+  return new PortAllocator(start, range, seed);
+}
+
+void pa_destroy(void* h) { delete static_cast<PortAllocator*>(h); }
+
+// Random-probe allocation: O(1) expected while load < ~90%, linear sweep
+// fallback guarantees completeness.
+int32_t pa_allocate(void* h) {
+  auto* a = static_cast<PortAllocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  if (a->n_used >= a->range) return -1;
+  std::uniform_int_distribution<int32_t> dist(0, a->range - 1);
+  for (int probe = 0; probe < 64; ++probe) {
+    int32_t i = dist(a->rng);
+    if (!a->used[i]) {
+      a->used[i] = 1;
+      ++a->n_used;
+      return a->start + i;
+    }
+  }
+  for (int32_t i = 0; i < a->range; ++i) {
+    if (!a->used[i]) {
+      a->used[i] = 1;
+      ++a->n_used;
+      return a->start + i;
+    }
+  }
+  return -1;
+}
+
+// Reserve a specific port (startup reseed from persisted annotations).
+// Returns 1 on success, 0 if already used or out of range.
+int32_t pa_reserve(void* h, int32_t port) {
+  auto* a = static_cast<PortAllocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  int32_t i = port - a->start;
+  if (i < 0 || i >= a->range) return 0;
+  if (a->used[i]) return 0;
+  a->used[i] = 1;
+  ++a->n_used;
+  return 1;
+}
+
+void pa_release(void* h, int32_t port) {
+  auto* a = static_cast<PortAllocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  int32_t i = port - a->start;
+  if (i < 0 || i >= a->range) return;
+  if (a->used[i]) {
+    a->used[i] = 0;
+    --a->n_used;
+  }
+}
+
+int32_t pa_in_use(void* h) {
+  auto* a = static_cast<PortAllocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->n_used;
+}
+
+}  // extern "C"

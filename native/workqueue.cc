@@ -1,0 +1,140 @@
+// Rate-limitable dedup work queue — the control plane's hot loop, native.
+//
+// Reference analog: controller-runtime's workqueue (the reference's Go
+// control plane spends its cycles here; SURVEY.md §2 notes the rebuild's
+// native budget goes to the control plane itself). Semantics match
+// rbg_tpu/runtime/queue.py exactly: dirty/processing dedup (an item re-added
+// mid-reconcile re-queues on done), delayed adds, blocking get.
+//
+// Items are opaque int64 ids; the Python binding interns keys to ids.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <unordered_set>
+#include <vector>
+
+using Clock = std::chrono::steady_clock;
+
+struct Delayed {
+  Clock::time_point at;
+  uint64_t seq;
+  int64_t item;
+  bool operator>(const Delayed& o) const {
+    return at != o.at ? at > o.at : seq > o.seq;
+  }
+};
+
+struct WorkQueue {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<int64_t> queue;
+  std::unordered_set<int64_t> dirty, processing;
+  std::priority_queue<Delayed, std::vector<Delayed>, std::greater<Delayed>> delayed;
+  uint64_t seq = 0;
+  bool shutdown = false;
+
+  void pump_locked() {
+    auto now = Clock::now();
+    while (!delayed.empty() && delayed.top().at <= now) {
+      int64_t item = delayed.top().item;
+      delayed.pop();
+      if (dirty.insert(item).second && !processing.count(item)) {
+        queue.push_back(item);
+      } else if (dirty.count(item) && !processing.count(item)) {
+        // freshly inserted above; nothing more to do
+      }
+    }
+  }
+};
+
+extern "C" {
+
+void* wq_create() { return new WorkQueue(); }
+
+void wq_destroy(void* h) { delete static_cast<WorkQueue*>(h); }
+
+void wq_add(void* h, int64_t item) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  if (q->shutdown || q->dirty.count(item)) return;
+  q->dirty.insert(item);
+  if (!q->processing.count(item)) {
+    q->queue.push_back(item);
+    q->cv.notify_one();
+  }
+}
+
+void wq_add_after(void* h, int64_t item, int64_t delay_us) {
+  auto* q = static_cast<WorkQueue*>(h);
+  if (delay_us <= 0) return wq_add(h, item);
+  std::lock_guard<std::mutex> lock(q->mu);
+  if (q->shutdown) return;
+  q->delayed.push({Clock::now() + std::chrono::microseconds(delay_us),
+                   ++q->seq, item});
+  q->cv.notify_one();
+}
+
+// Blocking pop; timeout_us < 0 means wait forever. Returns -1 on timeout or
+// shutdown-with-empty-queue.
+int64_t wq_get(void* h, int64_t timeout_us) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::unique_lock<std::mutex> lock(q->mu);
+  auto deadline = timeout_us >= 0
+                      ? Clock::now() + std::chrono::microseconds(timeout_us)
+                      : Clock::time_point::max();
+  for (;;) {
+    q->pump_locked();
+    if (!q->queue.empty()) {
+      int64_t item = q->queue.front();
+      q->queue.pop_front();
+      q->processing.insert(item);
+      q->dirty.erase(item);
+      return item;
+    }
+    if (q->shutdown) return -1;
+    auto wait_until = deadline;
+    if (!q->delayed.empty() && q->delayed.top().at < wait_until) {
+      wait_until = q->delayed.top().at;
+    }
+    if (wait_until == Clock::time_point::max()) {
+      q->cv.wait_for(lock, std::chrono::seconds(1));
+    } else {
+      if (q->cv.wait_until(lock, wait_until) == std::cv_status::timeout &&
+          wait_until == deadline && Clock::now() >= deadline) {
+        // real timeout (not a delayed-item wake)
+        q->pump_locked();
+        if (!q->queue.empty()) continue;
+        return -1;
+      }
+    }
+  }
+}
+
+void wq_done(void* h, int64_t item) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  q->processing.erase(item);
+  if (q->dirty.count(item)) {
+    q->queue.push_back(item);
+    q->cv.notify_one();
+  }
+}
+
+void wq_shutdown(void* h) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  q->shutdown = true;
+  q->cv.notify_all();
+}
+
+int64_t wq_len(void* h) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return static_cast<int64_t>(q->queue.size());
+}
+
+}  // extern "C"

@@ -13,8 +13,8 @@ import enum
 from typing import Dict, List, Optional
 
 from rbg_tpu.api.group import (
-    ComponentSpec, LeaderWorkerSpec, PatternType, RestartPolicyConfig,
-    RollingUpdate, TpuSpec,
+    ComponentSpec, EngineRuntimeRef, LeaderWorkerSpec, PatternType,
+    RestartPolicyConfig, RollingUpdate, TpuSpec,
 )
 from rbg_tpu.api.meta import Condition, ObjectMeta
 from rbg_tpu.api.pod import PodTemplate
@@ -35,6 +35,7 @@ class InstanceTemplate:
     components: List[ComponentSpec] = dataclasses.field(default_factory=list)
     tpu: Optional[TpuSpec] = None
     ready_policy: ReadyPolicy = ReadyPolicy.ALL_PODS_READY
+    engine_runtime: Optional["EngineRuntimeRef"] = None
 
 
 @dataclasses.dataclass

@@ -19,6 +19,8 @@ def register(sub) -> None:
     ap.add_argument("--slices", type=int, default=2, help="fake TPU slices")
     ap.add_argument("--hosts", type=int, default=2, help="hosts per fake slice")
     ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print injected envs and the topology config")
     ap.set_defaults(func=cmd_apply)
 
     vp = sub.add_parser("validate", help="validate manifests offline")
@@ -81,7 +83,27 @@ def cmd_apply(args) -> int:
                 rc = 1
                 print(f"group {o.metadata.name}: NOT ready within {args.timeout}s")
             _print_status(plane, o.metadata.namespace, o.metadata.name)
+            if args.verbose:
+                _print_detail(plane, o.metadata.namespace, o.metadata.name)
         return rc
+
+
+def _print_detail(plane, ns: str, name: str) -> None:
+    from rbg_tpu.api import constants as C
+    from rbg_tpu.discovery.config_builder import topology_configmap_name
+
+    pods = plane.store.list("Pod", namespace=ns, selector={C.LABEL_GROUP_NAME: name})
+    for p in sorted(pods, key=lambda p: p.metadata.name):
+        print(f"  env [{p.metadata.name}]:")
+        for c in p.template.containers:
+            for e in c.env:
+                if e.name.startswith(("RBG_", "MEGASCALE_")):
+                    print(f"    {e.name}={e.value}")
+    cm = plane.store.get("ConfigMap", ns, topology_configmap_name(name))
+    if cm is not None:
+        print("  topology config.yaml:")
+        for line in cm.data.get(C.DISCOVERY_CONFIG_FILE, "").splitlines():
+            print(f"    {line}")
 
 
 def _print_status(plane, ns: str, name: str) -> None:

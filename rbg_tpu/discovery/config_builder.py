@@ -1,0 +1,114 @@
+"""Cluster topology ConfigMap — the signature discovery feature.
+
+Reference analog: ``pkg/discovery/config_builder.go`` (inventory #16): a
+``config.yaml`` with the full group/role/instance address+port topology,
+mounted at ``/etc/rbg`` in every stateful role's pods, so engines can discover
+each other without templating.
+
+TPU-first extension (BASELINE.json north star): each instance additionally
+carries its **slice id, slice topology, per-host mesh coordinates, and the
+JAX coordinator address** — the engine-side mesh can be constructed straight
+from this file (``rbg_tpu.parallel.mesh_from_spec``), and routers can make
+ICI/DCN-aware decisions (prefer KV transfer within a superpod block).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import yaml
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.pod import ConfigMap
+from rbg_tpu.api.meta import owner_ref
+from rbg_tpu.discovery.env_builder import JAX_COORDINATOR_PORT
+
+
+def build_cluster_config(store, rbg) -> dict:
+    """Build the ClusterConfig document (reference schema
+    ``config_builder.go:54-75``, FQDNs ``:117-138``)."""
+    ns = rbg.metadata.namespace
+    nodes = {n.metadata.name: n for n in store.list("Node")}
+    roles_out = []
+    for role in rbg.spec.roles:
+        svc = C.service_name(rbg.metadata.name, role.name)
+        wname = C.workload_name(rbg.metadata.name, role.name)
+        instances_out = []
+        instances = store.list(
+            "RoleInstance", namespace=ns,
+            selector={C.LABEL_GROUP_NAME: rbg.metadata.name,
+                      C.LABEL_ROLE_NAME: role.name},
+        )
+        for inst in sorted(instances, key=lambda i: i.metadata.name):
+            pods = sorted(
+                store.list("Pod", namespace=ns,
+                           selector={C.LABEL_INSTANCE_NAME: inst.metadata.name}),
+                key=lambda p: int(p.metadata.labels.get(C.LABEL_COMPONENT_INDEX, "0")),
+            )
+            hosts = []
+            for p in pods:
+                node = nodes.get(p.node_name)
+                hosts.append({
+                    "pod": p.metadata.name,
+                    "address": f"{p.metadata.name}.{svc}",
+                    "ip": p.status.pod_ip,
+                    "processId": int(p.metadata.labels.get(C.LABEL_COMPONENT_INDEX, "0")),
+                    "node": p.node_name,
+                    "meshCoords": node.tpu.mesh_coords if node else "",
+                })
+            entry = {
+                "name": inst.metadata.name,
+                "index": inst.spec.index,
+                "sliceId": inst.status.slice_id,
+                "hosts": hosts,
+            }
+            if role.tpu is not None:
+                entry["coordinator"] = f"{inst.metadata.name}-0.{svc}:{JAX_COORDINATOR_PORT}"
+                entry["sliceTopology"] = role.tpu.slice_topology
+                entry["accelerator"] = role.tpu.accelerator
+            instances_out.append(entry)
+        roles_out.append({
+            "name": role.name,
+            "replicas": role.replicas,
+            "service": svc,
+            "workload": wname,
+            "instances": instances_out,
+        })
+    return {
+        "group": rbg.metadata.name,
+        "namespace": ns,
+        "roles": roles_out,
+    }
+
+
+def topology_configmap_name(group: str) -> str:
+    return f"{group}-topology"[:C.MAX_NAME_LEN]
+
+
+def reconcile_topology_configmap(store, rbg) -> Optional[ConfigMap]:
+    """Create/update the topology ConfigMap (SSA-equivalent: semantic diff)."""
+    data = yaml.safe_dump(build_cluster_config(store, rbg), sort_keys=False)
+    ns = rbg.metadata.namespace
+    name = topology_configmap_name(rbg.metadata.name)
+    cur = store.get("ConfigMap", ns, name)
+    if cur is None:
+        cm = ConfigMap()
+        cm.metadata.name = name
+        cm.metadata.namespace = ns
+        cm.metadata.labels = {C.LABEL_GROUP_NAME: rbg.metadata.name}
+        cm.metadata.owner_references = [owner_ref(rbg)]
+        cm.data = {C.DISCOVERY_CONFIG_FILE: data}
+        try:
+            return store.create(cm)
+        except Exception:
+            return None
+    if cur.data.get(C.DISCOVERY_CONFIG_FILE) != data:
+        def fn(c):
+            c.data[C.DISCOVERY_CONFIG_FILE] = data
+            return True
+        return store.mutate("ConfigMap", ns, name, fn)
+    return cur
+
+
+def load_cluster_config(text: str) -> dict:
+    return yaml.safe_load(text)

@@ -1,0 +1,135 @@
+"""ctypes bindings for the native (C++) runtime components.
+
+``native/librbg_native.so`` implements the control-plane hot paths (work
+queue, port allocator). Everything here degrades gracefully: if the library
+isn't built (``make -C native``) or ``RBG_TPU_NATIVE=0``, pure-Python
+implementations with identical semantics are used instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+_lib = None
+_lib_tried = False
+_lock = threading.Lock()
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        if os.environ.get("RBG_TPU_NATIVE", "1") == "0":
+            return None
+        candidates = [
+            os.environ.get("RBG_TPU_NATIVE_LIB", ""),
+            os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                         "native", "librbg_native.so"),
+        ]
+        for path in candidates:
+            if path and os.path.exists(path):
+                try:
+                    lib = ctypes.CDLL(path)
+                    _bind(lib)
+                    _lib = lib
+                    return _lib
+                except OSError:
+                    continue
+        return None
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    i32, i64, u64, p = (ctypes.c_int32, ctypes.c_int64, ctypes.c_uint64,
+                        ctypes.c_void_p)
+    lib.pa_create.restype = p
+    lib.pa_create.argtypes = [i32, i32, u64]
+    lib.pa_destroy.argtypes = [p]
+    lib.pa_allocate.restype = i32
+    lib.pa_allocate.argtypes = [p]
+    lib.pa_reserve.restype = i32
+    lib.pa_reserve.argtypes = [p, i32]
+    lib.pa_release.argtypes = [p, i32]
+    lib.pa_in_use.restype = i32
+    lib.pa_in_use.argtypes = [p]
+
+    lib.wq_create.restype = p
+    lib.wq_destroy.argtypes = [p]
+    lib.wq_add.argtypes = [p, i64]
+    lib.wq_add_after.argtypes = [p, i64, i64]
+    lib.wq_get.restype = i64
+    lib.wq_get.argtypes = [p, i64]
+    lib.wq_done.argtypes = [p, i64]
+    lib.wq_shutdown.argtypes = [p]
+    lib.wq_len.restype = i64
+    lib.wq_len.argtypes = [p]
+
+
+class NativeWorkQueue:
+    """WorkQueue-compatible wrapper over the C++ queue. Hashable Python keys
+    are interned to int64 ids (stable for the queue's lifetime)."""
+
+    def __init__(self):
+        self._lib = load_native()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.wq_create()
+        self._ids = {}
+        self._keys = {}
+        self._next = 0
+        self._ilock = threading.Lock()
+
+    def _intern(self, key) -> int:
+        with self._ilock:
+            i = self._ids.get(key)
+            if i is None:
+                i = self._next
+                self._next += 1
+                self._ids[key] = i
+                self._keys[i] = key
+            return i
+
+    def add(self, key):
+        self._lib.wq_add(self._h, self._intern(key))
+
+    def add_after(self, key, delay: float):
+        self._lib.wq_add_after(self._h, self._intern(key), int(delay * 1e6))
+
+    def get(self, timeout: Optional[float] = None):
+        t = -1 if timeout is None else int(timeout * 1e6)
+        i = self._lib.wq_get(self._h, t)
+        if i < 0:
+            return None
+        with self._ilock:
+            return self._keys.get(i)
+
+    def done(self, key):
+        with self._ilock:
+            i = self._ids.get(key)
+        if i is not None:
+            self._lib.wq_done(self._h, i)
+
+    def shutdown(self):
+        self._lib.wq_shutdown(self._h)
+
+    def __len__(self):
+        return int(self._lib.wq_len(self._h))
+
+    def __del__(self):
+        try:
+            self._lib.wq_destroy(self._h)
+        except Exception:
+            pass
+
+
+def make_workqueue():
+    """Native queue when built, Python otherwise (identical semantics)."""
+    try:
+        return NativeWorkQueue()
+    except RuntimeError:
+        from rbg_tpu.runtime.queue import WorkQueue
+        return WorkQueue()

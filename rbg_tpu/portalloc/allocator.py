@@ -1,0 +1,79 @@
+"""Cluster-wide host-port allocator.
+
+Reference analog: ``pkg/port-allocator`` (inventory #18, Appendix E):
+flag-gated singleton, random strategy in [start, start+range), config via a
+JSON annotation on the pod template, results persisted as workload
+annotations and injected as env vars. Native C++ backend when built
+(``native/portalloc.cc``); Python fallback with identical semantics.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+from rbg_tpu.native import load_native
+
+DEFAULT_START = 30000
+DEFAULT_RANGE = 5000
+
+
+class PortAllocator:
+    def __init__(self, start: int = DEFAULT_START, range_: int = DEFAULT_RANGE,
+                 seed: int = 0):
+        self.start = start
+        self.range = range_
+        self._lib = load_native()
+        if self._lib is not None:
+            self._h = self._lib.pa_create(start, range_, seed or random.getrandbits(63))
+            if not self._h:
+                self._lib = None
+        if self._lib is None:
+            self._used = set()
+            self._rng = random.Random(seed or None)
+            self._lock = threading.Lock()
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def allocate(self) -> Optional[int]:
+        if self._lib is not None:
+            p = self._lib.pa_allocate(self._h)
+            return None if p < 0 else int(p)
+        with self._lock:
+            if len(self._used) >= self.range:
+                return None
+            for _ in range(64):
+                p = self.start + self._rng.randrange(self.range)
+                if p not in self._used:
+                    self._used.add(p)
+                    return p
+            for p in range(self.start, self.start + self.range):
+                if p not in self._used:
+                    self._used.add(p)
+                    return p
+            return None
+
+    def reserve(self, port: int) -> bool:
+        if self._lib is not None:
+            return bool(self._lib.pa_reserve(self._h, port))
+        with self._lock:
+            if port < self.start or port >= self.start + self.range or port in self._used:
+                return False
+            self._used.add(port)
+            return True
+
+    def release(self, port: int) -> None:
+        if self._lib is not None:
+            self._lib.pa_release(self._h, port)
+            return
+        with self._lock:
+            self._used.discard(port)
+
+    def in_use(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.pa_in_use(self._h))
+        with self._lock:
+            return len(self._used)

@@ -72,7 +72,8 @@ class Controller:
 
     def __init__(self, store: Store):
         self.store = store
-        self.queue = WorkQueue()
+        from rbg_tpu.native import make_workqueue
+        self.queue = make_workqueue()
         self.backoff = ExponentialBackoff(base=0.01, max_delay=5.0)
         self._threads: List[threading.Thread] = []
         self._started = False

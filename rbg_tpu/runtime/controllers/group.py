@@ -93,6 +93,17 @@ class RoleBasedGroupController(Controller):
         # 6. role statuses FIRST (fresh readiness gates the dependency walk)
         rbg = self._update_role_statuses(store, rbg, role_hashes)
 
+        # 6b. topology discovery ConfigMap (reference step 5, :397)
+        try:
+            from rbg_tpu.discovery.config_builder import reconcile_topology_configmap
+            reconcile_topology_configmap(store, rbg)
+        except Exception as e:  # best-effort, but never silently
+            import logging
+            logging.getLogger("rbg_tpu.runtime").warning(
+                "topology configmap for %s/%s failed: %s",
+                ns, name, e, exc_info=True)
+            store.record_event(rbg, "DiscoveryConfigFailed", str(e))
+
         # 7. roles in dependency order
         levels = sort_roles(rbg.spec.roles)
         blocked = []
@@ -195,14 +206,15 @@ class RoleBasedGroupController(Controller):
     # ---- gang ----
 
     def _ensure_pod_group(self, store, rbg, role_targets):
-        # Count only roles whose dependencies are satisfied: blocked roles'
-        # pods don't exist yet, and including them would deadlock the gang
-        # (scheduler waits for min_member pods that are never created).
-        # Gang semantics therefore apply per dependency level.
+        # Count only roles whose dependencies are satisfied AND that are not
+        # internally staged (component startAfter): withheld pods would
+        # deadlock the gang (scheduler waits for min_member pods that are
+        # never created). Gang semantics apply per dependency level.
+        from rbg_tpu.discovery.component_discovery import staged_start
         total = sum(
             role_targets.get(r.name, r.replicas) * r.gang_size()
             for r in rbg.spec.roles
-            if dependencies_ready(rbg, r)
+            if dependencies_ready(rbg, r) and not staged_start(r.components)
         )
         ns, name = rbg.metadata.namespace, rbg.metadata.name
         pg = store.get("PodGroup", ns, name)
@@ -252,6 +264,7 @@ class RoleBasedGroupController(Controller):
                 leader_worker=role.leader_worker,
                 components=role.components,
                 tpu=role.tpu,
+                engine_runtime=role.engine_runtime,
             ),
             restart_policy=role.restart_policy,
             rolling_update=role.rolling_update,
@@ -272,14 +285,19 @@ class RoleBasedGroupController(Controller):
             except AlreadyExists:
                 pass
             return
-        # semantic-equality update (reference: comparators in each reconciler)
+        # semantic-equality update (reference: comparators in each reconciler).
+        # Controller-managed annotations (port allocations, Appendix E) are
+        # copied forward, never wiped by a spec sync.
+        managed = {C.ANN_ALLOCATED_PORTS}
+        cur_ann = {k: v for k, v in cur.metadata.annotations.items() if k not in managed}
         if (serde.to_dict(cur.spec) != serde.to_dict(desired_spec)
                 or cur.metadata.labels != labels
-                or cur.metadata.annotations != annotations):
+                or cur_ann != annotations):
             def fn(r):
                 r.spec = desired_spec
                 r.metadata.labels = labels
-                r.metadata.annotations = annotations
+                keep = {k: v for k, v in r.metadata.annotations.items() if k in managed}
+                r.metadata.annotations = {**annotations, **keep}
                 return True
             store.mutate("RoleInstanceSet", ns, wname, fn)
 

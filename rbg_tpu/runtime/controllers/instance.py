@@ -63,9 +63,10 @@ def desired_pods(inst: RoleInstance) -> List[Tuple[str, str, int, int, object]]:
 class RoleInstanceController(Controller):
     name = "roleinstance"
 
-    def __init__(self, store: Store, node_binding=None):
+    def __init__(self, store: Store, node_binding=None, ports=None):
         super().__init__(store)
         self.node_binding = node_binding
+        self.ports = ports
 
     def watches(self) -> List[Watch]:
         return [
@@ -108,13 +109,16 @@ class RoleInstanceController(Controller):
         pg_name = self._pod_group_name(inst, desired)
         existing = {p.metadata.name for p in active}
         wanted = {n for (n, *_rest) in desired}
+        startable = self._startable(inst, active)
+        created_all = True
         for pod_name, comp, cid, cidx, tmpl in desired:
             if pod_name not in existing:
+                if startable is not None and (comp or "main") not in startable:
+                    created_all = False  # gated by component startAfter ordering
+                    continue
                 self._create_pod(store, inst, pod_name, comp, cid, cidx, tmpl,
                                  len(desired), pg_name)
-        for p in active:
-            if p.metadata.name not in wanted:
-                store.delete("Pod", ns, p.metadata.name, grace=True)
+        gated_deletion = self._delete_surplus(store, inst, active, wanted)
         # Replace terminal (Failed/Succeeded) pods when policy is None:
         # recreate just that pod (no gang restart).
         if inst.spec.restart_policy.policy == RestartPolicy.NONE:
@@ -122,7 +126,52 @@ class RoleInstanceController(Controller):
                 if not p.active and p.metadata.deletion_timestamp is None:
                     store.delete("Pod", ns, p.metadata.name)
 
-        return self._update_status(store, inst, desired)
+        status_res = self._update_status(store, inst, desired)
+        if not created_all or gated_deletion:
+            return Result(requeue_after=0.1)  # revisit once ordering gates open
+        return status_res
+
+    def _delete_surplus(self, store, inst, active, wanted) -> bool:
+        """Delete pods not in the desired set. CustomComponents roles tear
+        down in deletion order (KEP-173: reverse start order unless
+        deleteAfter overrides), one component stage at a time. Returns True
+        while later stages are still gated."""
+        ns = inst.metadata.namespace
+        surplus = [p for p in active if p.metadata.name not in wanted]
+        if not surplus:
+            return False
+        it = inst.spec.instance
+        if it.pattern == PatternType.CUSTOM_COMPONENTS and len(it.components) > 1:
+            from rbg_tpu.discovery.component_discovery import deletion_order
+            order = deletion_order(it.components)
+            pos = {n: i for i, n in enumerate(order)}
+            key = lambda p: pos.get(
+                p.metadata.labels.get(C.LABEL_COMPONENT_NAME, ""), len(order))
+            stage = min(key(p) for p in surplus)
+            for p in surplus:
+                if key(p) == stage:
+                    store.delete("Pod", ns, p.metadata.name, grace=True)
+            return any(key(p) != stage for p in surplus)
+        for p in surplus:
+            store.delete("Pod", ns, p.metadata.name, grace=True)
+        return False
+
+    def _startable(self, inst, active):
+        """Component startup gating (KEP-173). None = no gating (not a
+        customComponents instance)."""
+        from rbg_tpu.api.group import PatternType as PT
+        if inst.spec.instance.pattern != PT.CUSTOM_COMPONENTS:
+            return None
+        from rbg_tpu.discovery.component_discovery import startable_components
+        ready_by_comp = {}
+        for comp in inst.spec.instance.components:
+            ready = sum(
+                1 for p in active
+                if p.metadata.labels.get(C.LABEL_COMPONENT_NAME) == comp.name
+                and p.running_ready
+            )
+            ready_by_comp[comp.name] = (ready, comp.size)
+        return startable_components(inst, ready_by_comp)
 
     # ---- restart machinery ----
 
@@ -193,12 +242,20 @@ class RoleInstanceController(Controller):
 
     # ---- pod construction ----
 
+    def _staged_start(self, inst) -> bool:
+        """Component startAfter ordering implies staged start — incompatible
+        with an all-pods gang (the gang would wait for gated pods forever)."""
+        if inst.spec.instance.pattern != PatternType.CUSTOM_COMPONENTS:
+            return False
+        from rbg_tpu.discovery.component_discovery import staged_start
+        return staged_start(inst.spec.instance.components)
+
     def _ensure_pod_group(self, store, inst, desired):
         """Per-instance gang (slice atomicity) unless a group-level pod-group
         is designated via annotation."""
         if inst.metadata.annotations.get(C.ANN_GANG_SCHEDULING):
             return  # group-level PodGroup managed by the group controller
-        if len(desired) <= 1:
+        if len(desired) <= 1 or self._staged_start(inst):
             return
         ns, name = inst.metadata.namespace, inst.metadata.name
         if store.get("PodGroup", ns, name) is None:
@@ -216,6 +273,10 @@ class RoleInstanceController(Controller):
                 pass
 
     def _pod_group_name(self, inst, desired) -> str:
+        # Staged start always opts out of gangs — even an explicit group-level
+        # gang would deadlock on pods the ordering engine withholds.
+        if self._staged_start(inst):
+            return ""
         explicit = inst.metadata.annotations.get(C.ANN_GANG_SCHEDULING, "")
         if explicit:
             return explicit
@@ -258,9 +319,19 @@ class RoleInstanceController(Controller):
         # identity + JAX rendezvous envs (discovery plane adds topology config)
         from rbg_tpu.discovery.env_builder import build_env
         env = build_env(inst, pod_name, comp or "main", cidx, gang_size)
+        if it.pattern == PatternType.CUSTOM_COMPONENTS:
+            from rbg_tpu.discovery.component_discovery import component_discovery_env
+            env.extend(component_discovery_env(store, inst, comp or "main"))
         for c in pod.template.containers:
             have = {e.name for e in c.env}
             c.env.extend(e for e in env if e.name not in have)
+
+        # engine-runtime profile sidecars + overrides (inventory #19)
+        from rbg_tpu.discovery.sidecar_builder import apply_engine_runtime
+        apply_engine_runtime(store, it.engine_runtime, pod, ns)
+
+        if self.ports is not None:
+            self.ports.inject_pod_ports(inst, pod)
 
         if self.node_binding is not None:
             pod.affinity.extend(self.node_binding.affinity_terms(pod))

@@ -53,6 +53,10 @@ def instance_ready(inst: RoleInstance) -> bool:
 class RoleInstanceSetController(Controller):
     name = "roleinstanceset"
 
+    def __init__(self, store: Store, ports=None):
+        super().__init__(store)
+        self.ports = ports
+
     def watches(self) -> List[Watch]:
         return [
             Watch("RoleInstanceSet", own_keys),
@@ -66,6 +70,12 @@ class RoleInstanceSetController(Controller):
             return None
 
         revision = update_revision_of(ris)
+        if self.ports is not None:
+            _, changed = self.ports.ensure_role_ports(ris)
+            if changed:
+                ris = store.get("RoleInstanceSet", ns, name)  # pick up annotations
+                if ris is None or ris.metadata.deletion_timestamp is not None:
+                    return None
         instances = [
             i for i in store.list("RoleInstance", namespace=ns, owner_uid=ris.metadata.uid)
             if i.metadata.deletion_timestamp is None

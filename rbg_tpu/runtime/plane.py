@@ -26,6 +26,8 @@ class ControlPlane:
         self.store = store or Store()
         self.manager = Manager(self.store)
         self.node_binding = NodeBindingStore(self.store)
+        from rbg_tpu.portalloc import PortAllocatorService
+        self.ports = PortAllocatorService(self.store)
 
         from rbg_tpu.runtime.controllers.group import RoleBasedGroupController
         from rbg_tpu.runtime.controllers.instance import RoleInstanceController
@@ -34,9 +36,9 @@ class ControlPlane:
         self.group_controller = self.manager.register(
             RoleBasedGroupController(self.store, self.node_binding))
         self.instanceset_controller = self.manager.register(
-            RoleInstanceSetController(self.store))
+            RoleInstanceSetController(self.store, ports=self.ports))
         self.instance_controller = self.manager.register(
-            RoleInstanceController(self.store, self.node_binding))
+            RoleInstanceController(self.store, self.node_binding, ports=self.ports))
         self.scheduler = self.manager.register(
             SchedulerController(self.store, self.node_binding))
         self._register_optional()

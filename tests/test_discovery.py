@@ -1,0 +1,176 @@
+"""Discovery plane: topology ConfigMap, port allocator, component ordering,
+sidecar injection, native bindings."""
+
+import json
+
+import pytest
+import yaml
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.group import ComponentSpec, EngineRuntimeRef, PatternType, RoleSpec
+from rbg_tpu.api.pod import Container, PodTemplate
+from rbg_tpu.api.policy import EngineRuntimeProfile
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import (
+    make_group, make_tpu_nodes, simple_container, simple_role,
+    tpu_leaderworker_role,
+)
+
+
+@pytest.fixture()
+def plane():
+    p = ControlPlane(backend="fake")
+    make_tpu_nodes(p.store, slices=2, hosts_per_slice=2)
+    with p:
+        yield p
+
+
+def test_topology_configmap(plane):
+    plane.apply(make_group("t", tpu_leaderworker_role("serve", replicas=1, topology="2x4")))
+    plane.wait_group_ready("t")
+
+    def cm_has_hosts():
+        cm = plane.store.get("ConfigMap", "default", "t-topology")
+        if cm is None:
+            return None
+        cfg = yaml.safe_load(cm.data[C.DISCOVERY_CONFIG_FILE])
+        insts = cfg["roles"][0]["instances"]
+        if insts and len(insts[0]["hosts"]) == 2 and insts[0]["hosts"][0]["ip"]:
+            return cfg
+        return None
+
+    cfg = plane.wait_for(cm_has_hosts, desc="topology configmap populated")
+    role = cfg["roles"][0]
+    assert role["service"] == "s-t-serve"
+    inst = role["instances"][0]
+    assert inst["sliceTopology"] == "2x4"
+    assert inst["coordinator"].endswith(":8476")
+    assert inst["sliceId"].startswith("slice-")
+    hosts = inst["hosts"]
+    assert [h["processId"] for h in hosts] == [0, 1]
+    assert all(h["meshCoords"] for h in hosts)
+
+
+def test_port_allocation_role_scoped(plane):
+    role = simple_role("server", replicas=2)
+    role.template.annotations[C.ANN_PORT_ALLOCATOR] = json.dumps(
+        [{"name": "dist", "scope": "role"}])
+    plane.apply(make_group("p", role))
+    plane.wait_group_ready("p")
+
+    ris = plane.store.get("RoleInstanceSet", "default", "p-server")
+    alloc = json.loads(ris.metadata.annotations[C.ANN_ALLOCATED_PORTS])
+    assert 30000 <= alloc["dist"] < 35000
+    for pod in plane.store.list("Pod", namespace="default"):
+        envs = {e.name: e.value for e in pod.template.containers[0].env}
+        assert envs["RBG_PORT_DIST"] == str(alloc["dist"])
+
+
+def test_port_unique_across_groups_and_released(plane):
+    for g in ("g1", "g2"):
+        role = simple_role("s")
+        role.template.annotations[C.ANN_PORT_ALLOCATOR] = json.dumps(
+            [{"name": "http", "scope": "role"}])
+        plane.apply(make_group(g, role))
+        plane.wait_group_ready(g)
+    p1 = json.loads(plane.store.get("RoleInstanceSet", "default", "g1-s")
+                    .metadata.annotations[C.ANN_ALLOCATED_PORTS])["http"]
+    p2 = json.loads(plane.store.get("RoleInstanceSet", "default", "g2-s")
+                    .metadata.annotations[C.ANN_ALLOCATED_PORTS])["http"]
+    assert p1 != p2
+    used_before = plane.ports.allocator.in_use()
+    plane.store.delete("RoleBasedGroup", "default", "g1")
+    plane.wait_for(lambda: plane.ports.allocator.in_use() == used_before - 1,
+                   desc="port released on delete")
+
+
+def test_component_startup_ordering(plane):
+    role = RoleSpec(
+        name="ep", replicas=1, pattern=PatternType.CUSTOM_COMPONENTS,
+        components=[
+            ComponentSpec(name="server", size=1, template=PodTemplate(
+                containers=[simple_container("server")],
+                annotations={C.ANN_COMPONENT_DEPENDS_ON: '{"startAfter": ["cache"]}'},
+            )),
+            ComponentSpec(name="cache", size=1, template=PodTemplate(
+                containers=[simple_container("cache")])),
+        ],
+    )
+    plane.apply(make_group("ord", role))
+    plane.wait_group_ready("ord", timeout=15)
+    pods = plane.store.list("Pod", namespace="default")
+    by_comp = {p.metadata.labels[C.LABEL_COMPONENT_NAME]: p for p in pods}
+    assert set(by_comp) == {"server", "cache"}
+    assert (by_comp["cache"].metadata.creation_timestamp
+            < by_comp["server"].metadata.creation_timestamp)
+    # intra-role discovery env present
+    envs = {e.name: e.value for e in by_comp["server"].template.containers[0].env}
+    assert envs["RBG_COMPONENT_CACHE_ADDRESSES"] == "ord-ep-xxxxx-cache-0.s-ord-ep".replace(
+        "xxxxx", by_comp["cache"].metadata.labels[C.LABEL_INSTANCE_NAME].rsplit("-", 1)[-1]
+    ) or "cache-0" in envs["RBG_COMPONENT_CACHE_ADDRESSES"]
+
+
+def test_engine_runtime_sidecar_injection(plane):
+    prof = EngineRuntimeProfile()
+    prof.metadata.name = "sglang-runtime"
+    prof.containers = [simple_container("metrics", image="metrics:v1")]
+    prof.init_containers = [simple_container("warmup", image="warmup:v1")]
+    prof.volumes = ["cache-vol"]
+    plane.store.create(prof)
+
+    role = simple_role("server")
+    role.engine_runtime = EngineRuntimeRef(
+        profile_name="sglang-runtime",
+        container_args={"engine": ["--extra-flag"]},
+        container_env={"metrics": {"SCRAPE_PORT": "9100"}},
+    )
+    plane.apply(make_group("er", role))
+    plane.wait_group_ready("er")
+    pod = plane.store.list("Pod", namespace="default")[0]
+    names = [c.name for c in pod.template.containers]
+    assert names == ["engine", "metrics"]
+    assert [c.name for c in pod.template.init_containers] == ["warmup"]
+    assert "cache-vol" in pod.template.volumes
+    assert "--extra-flag" in pod.template.containers[0].args
+    envs = {e.name: e.value for e in pod.template.containers[1].env}
+    assert envs["SCRAPE_PORT"] == "9100"
+
+
+def test_native_bindings_loaded():
+    from rbg_tpu.native import load_native
+    from rbg_tpu.portalloc import PortAllocator
+    lib = load_native()
+    assert lib is not None, "native library should be built (make -C native)"
+    pa = PortAllocator(40000, 16)
+    assert pa.native
+    ports = {pa.allocate() for _ in range(16)}
+    assert len(ports) == 16 and all(40000 <= p < 40016 for p in ports)
+    assert pa.allocate() is None  # exhausted
+    pa.release(40003)
+    assert pa.allocate() == 40003
+    assert not pa.reserve(40003)
+
+
+def test_native_workqueue_semantics():
+    import time
+    from rbg_tpu.native import NativeWorkQueue
+    q = NativeWorkQueue()
+    q.add(("ns", "a"))
+    q.add(("ns", "a"))  # dedup
+    q.add(("ns", "b"))
+    assert q.get(0.1) == ("ns", "a")
+    # re-add while processing → must be re-delivered after done()
+    q.add(("ns", "a"))
+    assert q.get(0.1) == ("ns", "b")
+    q.done(("ns", "b"))
+    assert q.get(0.05) is None  # 'a' still processing, not re-delivered yet
+    q.done(("ns", "a"))
+    assert q.get(0.1) == ("ns", "a")
+    q.done(("ns", "a"))
+    # delayed add
+    t0 = time.monotonic()
+    q.add_after(("ns", "c"), 0.15)
+    assert q.get(1.0) == ("ns", "c")
+    assert time.monotonic() - t0 >= 0.14
+    q.shutdown()
+    assert q.get(0.05) is None

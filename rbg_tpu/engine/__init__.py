@@ -1,0 +1,9 @@
+from rbg_tpu.engine.config import EngineConfig, SamplingParams
+from rbg_tpu.engine.engine import Engine, Request, StepEvent
+from rbg_tpu.engine.kvcache import PageAllocator, PagedKVCache
+from rbg_tpu.engine.radix_cache import RadixCache
+
+__all__ = [
+    "Engine", "EngineConfig", "SamplingParams", "Request", "StepEvent",
+    "PageAllocator", "PagedKVCache", "RadixCache",
+]

@@ -1,0 +1,46 @@
+"""Engine configuration."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from rbg_tpu.models.config import ModelConfig, get_config
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "tiny"
+    page_size: int = 16
+    num_pages: int = 256                    # KV pool size (pages)
+    max_batch: int = 8                      # decode batch ceiling
+    max_seq_len: int = 512                  # per-sequence ceiling
+    prefill_chunk: int = 64                 # chunked-prefill bucket
+    decode_buckets: Tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
+    enable_radix_cache: bool = True
+    use_pallas: str = "auto"                # auto | always | never
+    mode: str = "unified"                   # unified | prefill | decode
+    mesh_spec: Optional[dict] = None        # {"dp": 1, "tp": 4} — from discovery
+    seed: int = 0
+
+    @property
+    def model_config(self) -> ModelConfig:
+        return get_config(self.model)
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return (self.max_seq_len + self.page_size - 1) // self.page_size
+
+    def validate(self) -> None:
+        if self.max_batch > max(self.decode_buckets):
+            raise ValueError("max_batch exceeds largest decode bucket")
+        if self.num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_new_tokens: int = 16
+    temperature: float = 0.0        # 0 = greedy
+    top_k: int = 0                  # 0 = full vocab
+    stop_token: Optional[int] = None

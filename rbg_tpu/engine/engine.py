@@ -1,0 +1,385 @@
+"""Serving engine: continuous batching over a paged KV pool.
+
+The data plane the control plane orchestrates — the SGLang-on-JAX-equivalent
+(the reference deploys SGLang in its role pods; BASELINE.md configs). One
+Engine = one model replica on one JAX program (single chip or a whole slice
+via the tp/sp mesh).
+
+Design (TPU-first):
+* **Bucketed static shapes** — one compiled program per (batch, chunk)
+  bucket; prefill chunks and decode steps reuse the same ``forward_paged``.
+* **Host-side logistics, device-side math** — page tables/lengths are plain
+  numpy handed to jit as arrays; the graph never sees Python branching.
+* **Chunked prefill** — long prompts stream through a fixed-size chunk
+  program, so TTFT for short prompts never waits behind a long compile.
+* **Radix prefix cache** — page-granular prefix sharing with LRU eviction.
+* **Preemption** — page exhaustion preempts the youngest request back to the
+  waiting queue (its pages recycle; the radix cache softens the re-prefill).
+
+Modes: ``unified`` (prefill+decode co-located), ``prefill`` (produces KV
+pages + first token for a peer), ``decode`` (imports KV pages) — see
+rbg_tpu.engine.pd for the disaggregated pair.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rbg_tpu.engine.config import EngineConfig, SamplingParams
+from rbg_tpu.engine.kvcache import PageAllocator, PagedKVCache, pages_for_tokens
+from rbg_tpu.engine.radix_cache import RadixCache
+from rbg_tpu.engine.sampler import sample
+from rbg_tpu.models.llama import forward_paged, init_params
+
+
+@dataclasses.dataclass
+class StepEvent:
+    request_id: int
+    token: int
+    finished: bool
+    text_done: bool = False
+
+
+class Request:
+    _ids = itertools.count()
+
+    def __init__(self, prompt: List[int], sampling: SamplingParams):
+        self.id = next(Request._ids)
+        self.prompt = list(prompt)
+        self.sampling = sampling
+        self.output: List[int] = []
+        self.state = "waiting"          # waiting | prefill | running | finished
+        self.pages: List[int] = []
+        self.shared_tokens = 0          # radix-matched prefix (page-aligned)
+        self.prefill_pos = 0            # next prompt index to prefill
+        self.seq_len = 0                # tokens materialized in KV
+        self.last_token: Optional[int] = None
+        self.t_submit = time.perf_counter()
+        self.t_first: Optional[float] = None
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + len(self.output)
+
+    def max_len(self) -> int:
+        return len(self.prompt) + self.sampling.max_new_tokens
+
+
+class Engine:
+    def __init__(self, cfg: EngineConfig, params: Optional[dict] = None,
+                 mesh=None):
+        cfg.validate()
+        self.cfg = cfg
+        self.mcfg = cfg.model_config
+        self.mesh = mesh
+        key = jax.random.key(cfg.seed)
+        self.params = params if params is not None else init_params(self.mcfg, key)
+        self._sample_key = jax.random.key(cfg.seed + 1)
+
+        self.cache = PagedKVCache.create(self.mcfg, cfg.num_pages, cfg.page_size)
+        self.allocator = PageAllocator(cfg.num_pages)
+        self.radix = RadixCache(self.allocator, cfg.page_size) if cfg.enable_radix_cache else None
+
+        if mesh is not None:
+            self._shard_state(mesh)
+
+        self.waiting: List[Request] = []
+        self.running: List[Request] = []
+        self.requests: Dict[int, Request] = {}
+        self._fwd_cache: Dict[Tuple[int, int], object] = {}
+        self._sampler = jax.jit(sample)
+        self.metrics = {"steps": 0, "decode_tokens": 0, "prefill_tokens": 0,
+                        "radix_hit_tokens": 0, "preemptions": 0}
+
+    def _shard_state(self, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from rbg_tpu.parallel.sharding import param_specs, shard_pytree
+        self.params = shard_pytree(self.params, param_specs(self.mcfg), mesh)
+        page_spec = NamedSharding(mesh, P(None, None, None, "tp", None))
+        self.cache = PagedKVCache(
+            k_pages=jax.device_put(self.cache.k_pages, page_spec),
+            v_pages=jax.device_put(self.cache.v_pages, page_spec),
+        )
+
+    # ---- public API ----
+
+    def add_request(self, prompt: List[int],
+                    sampling: Optional[SamplingParams] = None) -> int:
+        sampling = sampling or SamplingParams()
+        if len(prompt) + sampling.max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt+max_new_tokens {len(prompt)}+{sampling.max_new_tokens} "
+                f"exceeds max_seq_len {self.cfg.max_seq_len}")
+        req = Request(prompt, sampling)
+        self.requests[req.id] = req
+        self.waiting.append(req)
+        return req.id
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def step(self) -> List[StepEvent]:
+        """One scheduler iteration: admit → prefill (chunk each) → decode."""
+        events: List[StepEvent] = []
+        self.metrics["steps"] += 1
+        self._admit()
+        events.extend(self._prefill_step())
+        events.extend(self._decode_step())
+        return events
+
+    def generate(self, prompts: List[List[int]],
+                 sampling: Optional[SamplingParams] = None) -> List[List[int]]:
+        ids = [self.add_request(p, sampling) for p in prompts]
+        outputs = {i: [] for i in ids}
+        while self.has_work():
+            for ev in self.step():
+                if ev.request_id in outputs:
+                    outputs[ev.request_id].append(ev.token)
+        return [outputs[i] for i in ids]
+
+    # ---- admission ----
+
+    def _admit(self):
+        while self.waiting and len(self.running) < self.cfg.max_batch:
+            req = self.waiting[0]
+            matched, shared_pages = 0, []
+            if self.radix is not None and req.state == "waiting":
+                # Keep at least the prompt's last token for prefill (logits).
+                matched, shared_pages = self.radix.match(req.prompt[:-1])
+            # Admit with pages for the PROMPT + first token only — decode
+            # grows page-by-page (memory oversubscription; preemption
+            # reclaims on exhaustion). Reserving max_len up front would
+            # forfeit continuous batching's throughput.
+            need = (pages_for_tokens(len(req.prompt) + 1, self.cfg.page_size)
+                    - len(shared_pages))
+            pages = self._alloc(need)
+            if pages is None:
+                if shared_pages:
+                    self.allocator.release(shared_pages)
+                break  # no capacity — stay queued
+            self.waiting.pop(0)
+            req.pages = shared_pages + pages
+            req.shared_tokens = matched
+            req.prefill_pos = matched
+            req.seq_len = matched
+            req.state = "prefill"
+            self.running.append(req)
+            self.metrics["radix_hit_tokens"] += matched
+
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        if n <= 0:
+            return []
+        pages = self.allocator.alloc(n)
+        if pages is None and self.radix is not None:
+            self.radix.evict(n - self.allocator.free_pages)
+            pages = self.allocator.alloc(n)
+        return pages
+
+    # ---- prefill ----
+
+    def _prefill_step(self) -> List[StepEvent]:
+        events = []
+        for req in list(self.running):
+            if req.state != "prefill":
+                continue
+            chunk = self.cfg.prefill_chunk
+            start = req.prefill_pos
+            end = min(start + chunk, len(req.prompt))
+            toks = req.prompt[start:end]
+            T = len(toks)
+            last = end == len(req.prompt)
+
+            logits = self._run(
+                tokens=[toks], positions=[list(range(start, end))],
+                lens=[end], pages=[req.pages], T_bucket=chunk,
+            )
+            req.prefill_pos = end
+            req.seq_len = end
+            self.metrics["prefill_tokens"] += T
+            if last:
+                # Only the final chunk's last row ever leaves the device.
+                tok = self._sample_one(logits[0, T - 1], req)
+                req.state = "running"
+                req.t_first = time.perf_counter()
+                events.append(self._emit(req, tok))
+        return events
+
+    # ---- decode ----
+
+    def _decode_step(self) -> List[StepEvent]:
+        batch = [r for r in self.running if r.state == "running"]
+        if not batch:
+            return []
+        # Ensure a page exists for each sequence's next position; preempt the
+        # youngest requests on exhaustion. Oldest-first so old requests
+        # finish and release memory (deadlock-free under oversubscription).
+        for req in sorted(batch, key=lambda r: r.t_submit):
+            if req.state != "running":
+                continue  # preempted earlier in this very loop
+            need = pages_for_tokens(req.seq_len + 1, self.cfg.page_size) - len(req.pages)
+            if need > 0:
+                extra = self._alloc(need)
+                while extra is None:
+                    victim = self._preempt_youngest(exclude=req)
+                    if victim is None:
+                        break
+                    extra = self._alloc(need)
+                if extra is None:
+                    self._preempt(req)
+                    continue
+                req.pages.extend(extra)
+        batch = [r for r in self.running if r.state == "running"]
+        if not batch:
+            return []
+
+        B = self._bucket(len(batch))
+        logits = self._run(
+            tokens=[[r.last_token] for r in batch],
+            positions=[[r.seq_len] for r in batch],
+            lens=[r.seq_len + 1 for r in batch],
+            pages=[r.pages for r in batch],
+            T_bucket=1, B_bucket=B,
+        )
+        self.metrics["decode_tokens"] += len(batch)
+
+        events = []
+        temps = np.array([r.sampling.temperature for r in batch], np.float32)
+        ks = np.array([r.sampling.top_k for r in batch], np.int32)
+        self._sample_key, sub = jax.random.split(self._sample_key)
+        padded_t = np.zeros(B, np.float32)
+        padded_k = np.zeros(B, np.int32)
+        padded_t[: len(batch)] = temps
+        padded_k[: len(batch)] = ks
+        # Sample on device; only the [B] token ids cross to host.
+        toks = np.asarray(self._sampler(logits[:, 0, :], sub,
+                                        jnp.asarray(padded_t), jnp.asarray(padded_k)))
+        for i, req in enumerate(batch):
+            req.seq_len += 1
+            events.append(self._emit(req, int(toks[i])))
+        return events
+
+    def _emit(self, req: Request, tok: int) -> StepEvent:
+        req.output.append(tok)
+        req.last_token = tok
+        finished = (
+            len(req.output) >= req.sampling.max_new_tokens
+            or (req.sampling.stop_token is not None and tok == req.sampling.stop_token)
+        )
+        if finished:
+            self._finish(req)
+        return StepEvent(req.id, tok, finished)
+
+    def _sample_one(self, logits_row, req: Request) -> int:
+        self._sample_key, sub = jax.random.split(self._sample_key)
+        t = jnp.asarray([req.sampling.temperature], jnp.float32)
+        k = jnp.asarray([req.sampling.top_k], jnp.int32)
+        return int(np.asarray(self._sampler(logits_row[None], sub, t, k))[0])
+
+    # ---- lifecycle ----
+
+    def _finish(self, req: Request):
+        req.state = "finished"
+        self.running = [r for r in self.running if r is not req]
+        if self.cfg.mode == "prefill":
+            # Disaggregated prefill: the pages ARE the product — the PD layer
+            # exports them to a decode peer, then calls release_request().
+            req.state = "exported"
+            return
+        if self.radix is not None:
+            # Cache the full sequence (prompt + output) for future prefixes.
+            self.radix.insert(req.prompt + req.output[:-1], req.pages)
+        self.allocator.release(req.pages)
+        req.pages = []
+
+    def release_request(self, req_id: int):
+        """Release an exported request's pages (prefill mode)."""
+        req = self.requests.pop(req_id)
+        if req.pages:
+            self.allocator.release(req.pages)
+            req.pages = []
+
+    def _preempt(self, req: Request):
+        self.metrics["preemptions"] += 1
+        self.allocator.release(req.pages)
+        req.pages = []
+        req.state = "waiting"
+        req.prefill_pos = 0
+        req.seq_len = 0
+        req.shared_tokens = 0
+        # Restart cleanly: generated tokens so far are kept as prompt
+        # extension so decoding resumes where it left off.
+        if req.output:
+            req.prompt = req.prompt + req.output
+            req.sampling = dataclasses.replace(
+                req.sampling,
+                max_new_tokens=req.sampling.max_new_tokens - len(req.output))
+            req.output = []
+        self.running = [r for r in self.running if r is not req]
+        self.waiting.insert(0, req)
+
+    def _preempt_youngest(self, exclude: Request) -> Optional[Request]:
+        candidates = [r for r in self.running if r.state == "running" and r is not exclude]
+        if not candidates:
+            return None
+        victim = max(candidates, key=lambda r: r.t_submit)
+        self._preempt(victim)
+        return victim
+
+    # ---- device dispatch ----
+
+    def _bucket(self, n: int) -> int:
+        for b in self.cfg.decode_buckets:
+            if b >= n:
+                return min(b, max(self.cfg.decode_buckets))
+        return max(self.cfg.decode_buckets)
+
+    def _get_fwd(self, B: int, T: int):
+        key = (B, T)
+        fn = self._fwd_cache.get(key)
+        if fn is None:
+            import functools
+            base = functools.partial(forward_paged, cfg=self.mcfg,
+                                     use_pallas=self.cfg.use_pallas)
+
+            def wrapped(params, tokens, positions, token_mask, kv_lens,
+                        page_table, k_pages, v_pages):
+                return base(params, tokens=tokens, positions=positions,
+                            token_mask=token_mask, kv_lens=kv_lens,
+                            page_table=page_table, k_pages=k_pages,
+                            v_pages=v_pages)
+
+            fn = jax.jit(wrapped, donate_argnums=(6, 7))
+            self._fwd_cache[key] = fn
+        return fn
+
+    def _run(self, tokens, positions, lens, pages, T_bucket, B_bucket=None):
+        """Pad host-side lists to (B_bucket, T_bucket) and dispatch."""
+        B = B_bucket or 1
+        T = T_bucket
+        P = self.cfg.max_pages_per_seq
+        tok = np.zeros((B, T), np.int32)
+        pos = np.zeros((B, T), np.int32)
+        mask = np.zeros((B, T), bool)
+        kvl = np.zeros((B,), np.int32)
+        table = np.zeros((B, P), np.int32)
+        for i, (ts, ps_, ln, pg) in enumerate(zip(tokens, positions, lens, pages)):
+            tok[i, :len(ts)] = ts
+            pos[i, :len(ps_)] = ps_
+            mask[i, :len(ts)] = True
+            kvl[i] = ln
+            table[i, :len(pg)] = pg
+        fn = self._get_fwd(B, T)
+        logits, k_pages, v_pages = fn(
+            self.params, jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(mask),
+            jnp.asarray(kvl), jnp.asarray(table),
+            self.cache.k_pages, self.cache.v_pages,
+        )
+        self.cache = PagedKVCache(k_pages=k_pages, v_pages=v_pages)
+        return logits  # device array; callers slice what they need

@@ -1,0 +1,97 @@
+"""Paged KV cache: device-side page pool + host-side page allocator.
+
+The serving engine's memory system (SGLang/vLLM-equivalent, see PAPERS.md
+"Ragged Paged Attention" for the TPU kernel this layout feeds):
+
+* Device: ``k_pages/v_pages [L, num_pages, page_size, KV, hd]`` — one shared
+  pool for all sequences, static shapes (XLA-friendly).
+* Host: ``PageAllocator`` free list + per-sequence page tables (plain ints —
+  page logistics never enter the compiled graph; only gather/scatter indices
+  do).
+
+Sharding: pages shard over ``tp`` on the KV-head dim like the contiguous
+cache (see rbg_tpu.parallel.sharding.cache_specs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rbg_tpu.models.config import ModelConfig
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    k_pages: jnp.ndarray  # [L, NP, page, KV, hd]
+    v_pages: jnp.ndarray
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[2]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k_pages.shape[1]
+
+    @staticmethod
+    def create(cfg: ModelConfig, num_pages: int, page_size: int = 16,
+               dtype=None) -> "PagedKVCache":
+        dtype = dtype or cfg.jax_dtype
+        shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim_)
+        return PagedKVCache(k_pages=jnp.zeros(shape, dtype),
+                            v_pages=jnp.zeros(shape, dtype))
+
+    @staticmethod
+    def hbm_bytes(cfg: ModelConfig, num_pages: int, page_size: int = 16,
+                  dtype_bytes: int = 2) -> int:
+        return (2 * cfg.num_layers * num_pages * page_size
+                * cfg.num_kv_heads * cfg.head_dim_ * dtype_bytes)
+
+
+class PageAllocator:
+    """Host-side page free list with reference counting (shared prefix pages
+    from the radix cache hold refcount > 1; copy-on-write is avoided by only
+    sharing fully-frozen pages)."""
+
+    def __init__(self, num_pages: int):
+        # page 0 is reserved as the null page (padding rows in page tables
+        # point at it; their slots are masked out by seq_lens anyway).
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._refs = np.zeros(num_pages, np.int32)
+        self._refs[0] = 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate n pages or None (caller evicts/preempts and retries)."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._refs[p] = 1
+        return out
+
+    def share(self, pages: List[int]) -> None:
+        for p in pages:
+            assert self._refs[p] > 0, f"share of free page {p}"
+            self._refs[p] += 1
+
+    def release(self, pages: List[int]) -> None:
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
+            assert self._refs[p] >= 0, f"double free of page {p}"
+
+
+def pages_for_tokens(n_tokens: int, page_size: int) -> int:
+    return (n_tokens + page_size - 1) // page_size

@@ -1,0 +1,155 @@
+"""Prefill/decode disaggregation: KV-cache handoff between engines.
+
+Reference context: RBG's flagship topology is PD-disagg serving (router →
+prefill → decode roles, ``examples/inference/pd-disagg-*.yaml``) with
+Mooncake-style KV transfer (``keps/74-mooncake-integration``; Mooncake paper
+in PAPERS.md). The control plane places the roles; THIS module is the data
+path between them:
+
+* ``PrefillWorker`` — runs prompts to first-token on a prefill engine and
+  exports the sequence's KV pages as a ``KVBundle``.
+* ``DecodeWorker`` — imports a bundle into its own page pool and continues
+  decoding with continuous batching.
+* ``PDPair`` — in-process pair (same chip / same slice: the transfer is a
+  device gather+scatter). Cross-process transfer sends the same bundle over
+  the transport in ``rbg_tpu.engine.server`` (DCN analog); on multi-slice
+  TPU the placement layer keeps the pair within one ICI domain so the
+  transfer rides ICI (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from rbg_tpu.engine.config import EngineConfig, SamplingParams
+from rbg_tpu.engine.engine import Engine, Request
+from rbg_tpu.engine.kvcache import pages_for_tokens
+
+
+@dataclasses.dataclass
+class KVBundle:
+    """A sequence's transferable KV state."""
+
+    prompt: List[int]
+    first_token: int
+    k_data: np.ndarray   # [L, n_pages, page, KV, hd]
+    v_data: np.ndarray
+
+    @property
+    def nbytes(self) -> int:
+        return self.k_data.nbytes + self.v_data.nbytes
+
+
+class PrefillWorker:
+    def __init__(self, cfg: EngineConfig, params: Optional[dict] = None, mesh=None):
+        cfg = dataclasses.replace(cfg, mode="prefill")
+        self.engine = Engine(cfg, params=params, mesh=mesh)
+        self.metrics = {"bundles": 0, "bytes_out": 0, "transfer_s": 0.0}
+
+    def prefill(self, prompt: List[int],
+                sampling: Optional[SamplingParams] = None) -> KVBundle:
+        """Run one prompt to its first token; export KV pages."""
+        sampling = sampling or SamplingParams()
+        one = dataclasses.replace(sampling, max_new_tokens=1)
+        rid = self.engine.add_request(prompt, one)
+        first = None
+        while first is None:
+            for ev in self.engine.step():
+                if ev.request_id == rid:
+                    first = ev.token
+        req = self.engine.requests[rid]
+        n_pages = pages_for_tokens(len(prompt), self.engine.cfg.page_size)
+        page_ids = jnp.asarray(req.pages[:n_pages], jnp.int32)
+        t0 = time.perf_counter()
+        k = np.asarray(self.engine.cache.k_pages[:, page_ids])
+        v = np.asarray(self.engine.cache.v_pages[:, page_ids])
+        self.metrics["transfer_s"] += time.perf_counter() - t0
+        self.engine.release_request(rid)
+        bundle = KVBundle(prompt=list(prompt), first_token=first, k_data=k, v_data=v)
+        self.metrics["bundles"] += 1
+        self.metrics["bytes_out"] += bundle.nbytes
+        return bundle
+
+
+class DecodeWorker:
+    def __init__(self, cfg: EngineConfig, params: Optional[dict] = None, mesh=None):
+        cfg = dataclasses.replace(cfg, mode="decode", enable_radix_cache=False)
+        self.engine = Engine(cfg, params=params, mesh=mesh)
+        self.metrics = {"bundles": 0, "bytes_in": 0}
+
+    def inject(self, bundle: KVBundle,
+               sampling: Optional[SamplingParams] = None) -> int:
+        """Import a KV bundle and start decoding it. Returns the request id.
+        The first token is accounted as output[0] (already produced)."""
+        sampling = sampling or SamplingParams()
+        eng = self.engine
+        prompt = bundle.prompt
+        n_pages = bundle.k_data.shape[1]
+        need = pages_for_tokens(len(prompt) + 1, eng.cfg.page_size)
+        pages = eng._alloc(need)
+        if pages is None:
+            raise RuntimeError("decode engine out of KV pages")
+        ids = jnp.asarray(pages[:n_pages], jnp.int32)
+        from rbg_tpu.engine.kvcache import PagedKVCache
+        eng.cache = PagedKVCache(
+            k_pages=eng.cache.k_pages.at[:, ids].set(
+                jnp.asarray(bundle.k_data, eng.cache.k_pages.dtype)),
+            v_pages=eng.cache.v_pages.at[:, ids].set(
+                jnp.asarray(bundle.v_data, eng.cache.v_pages.dtype)),
+        )
+        req = Request(prompt, sampling)
+        req.state = "running"
+        req.pages = pages
+        req.seq_len = len(prompt)
+        req.prefill_pos = len(prompt)
+        req.output = [bundle.first_token]
+        req.last_token = bundle.first_token
+        req.t_first = time.perf_counter()
+        eng.requests[req.id] = req
+        eng.running.append(req)
+        self.metrics["bundles"] += 1
+        self.metrics["bytes_in"] += bundle.nbytes
+        # Already complete (max_new_tokens == 1 or stop token hit): finish
+        # now so its pages recycle.
+        if (len(req.output) >= sampling.max_new_tokens
+                or (sampling.stop_token is not None
+                    and bundle.first_token == sampling.stop_token)):
+            eng._finish(req)
+        return req.id
+
+
+class PDPair:
+    """In-process prefill+decode pair — the single-host PD-disagg unit the
+    bench exercises (BASELINE configs 3-4)."""
+
+    def __init__(self, cfg: EngineConfig, params: Optional[dict] = None,
+                 mesh=None):
+        self.prefill = PrefillWorker(cfg, params=params, mesh=mesh)
+        # Decode shares weights with prefill (same chip in-process).
+        self.decode = DecodeWorker(cfg, params=self.prefill.engine.params, mesh=mesh)
+
+    def generate(self, prompts: List[List[int]],
+                 sampling: Optional[SamplingParams] = None,
+                 collect_ttft: bool = False):
+        sampling = sampling or SamplingParams()
+        outputs: Dict[int, List[int]] = {}
+        ttft: List[float] = []
+        order = []
+        for p in prompts:
+            t0 = time.perf_counter()
+            bundle = self.prefill.prefill(p, sampling)
+            rid = self.decode.inject(bundle, sampling)
+            ttft.append(time.perf_counter() - t0)
+            outputs[rid] = [bundle.first_token]
+            order.append(rid)
+        while self.decode.engine.has_work():
+            for ev in self.decode.engine.step():
+                if ev.request_id in outputs:
+                    outputs[ev.request_id].append(ev.token)
+        result = [outputs[r] for r in order]
+        return (result, ttft) if collect_ttft else result

@@ -1,0 +1,30 @@
+"""Token sampling — jittable, per-row parameters as arrays (one compiled
+sampler serves every batch mix of greedy/temperature/top-k)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample(
+    logits: jnp.ndarray,        # [B, V] f32
+    key: jax.Array,
+    temperature: jnp.ndarray,   # [B] f32; 0 = greedy
+    top_k: jnp.ndarray,         # [B] int32; 0 = full vocab
+) -> jnp.ndarray:
+    """Returns sampled token ids [B] int32."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # top-k mask (per-row k; 0 = disabled)
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]               # [B, V]
+    k_idx = jnp.clip(top_k - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=1)  # [B, 1]
+    masked = jnp.where(
+        (top_k[:, None] > 0) & (logits < kth), -jnp.inf, logits
+    )
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, masked / temp, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)

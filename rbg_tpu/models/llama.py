@@ -86,24 +86,46 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
     return params
 
 
-def _block(cfg: ModelConfig, x, blk, k_cache, v_cache, positions, kv_valid):
-    """One transformer block. x: [B, T, D].
-
-    With caches: reads/writes [B, S, KV, hd] slices (serving path).
-    Without (``k_cache is None``): attends over the current tokens only
-    (training path — no scatter, grads flow through plain matmuls).
-    """
+def _qkv(cfg: ModelConfig, blk, x, positions):
+    """Shared pre-attention math: norm → projections → RoPE."""
     B, T, _ = x.shape
     hd, h, kv = cfg.head_dim_, cfg.num_heads, cfg.num_kv_heads
-
-    # Attention
     xa = rms_norm(x, blk["attn_norm"], cfg.rms_norm_eps)
     q = (xa @ blk["wq"]).reshape(B, T, h, hd)
     k = (xa @ blk["wk"]).reshape(B, T, kv, hd)
     vv = (xa @ blk["wv"]).reshape(B, T, kv, hd)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
+    return q, k, vv
 
+
+def _post_attention(cfg: ModelConfig, blk, x, attn):
+    """Shared post-attention math: residual → norm → SwiGLU → residual."""
+    B, T, _ = x.shape
+    x = x + attn.reshape(B, T, -1) @ blk["wo"]
+    xm = rms_norm(x, blk["mlp_norm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu(xm @ blk["w_gate"])
+    return x + (gate * (xm @ blk["w_up"])) @ blk["w_down"]
+
+
+def _head(params, cfg: ModelConfig, x) -> jnp.ndarray:
+    """Shared epilogue: final norm + (tied) LM head, f32 logits."""
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (x @ head.astype(cfg.jax_dtype)).astype(jnp.float32)
+
+
+def _block(cfg: ModelConfig, x, blk, k_cache, v_cache, positions, kv_valid):
+    """One transformer block over the contiguous cache. x: [B, T, D].
+
+    With caches: reads/writes [B, S, KV, hd] slices (serving path).
+    Without (``k_cache is None``): attends over the current tokens only
+    (training path — no scatter, grads flow through plain matmuls).
+    """
+    B = x.shape[0]
+    q, k, vv = _qkv(cfg, blk, x, positions)
     if k_cache is not None:
         # Write new K/V at their absolute positions (scatter per batch row).
         b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]  # [B, 1]
@@ -112,13 +134,7 @@ def _block(cfg: ModelConfig, x, blk, k_cache, v_cache, positions, kv_valid):
         attn = gqa_attention(q, k_cache, v_cache, positions, kv_valid)
     else:
         attn = gqa_attention(q, k, vv, positions, kv_valid)
-    x = x + attn.reshape(B, T, h * hd) @ blk["wo"]
-
-    # MLP
-    xm = rms_norm(x, blk["mlp_norm"], cfg.rms_norm_eps)
-    gate = jax.nn.silu(xm @ blk["w_gate"])
-    x = x + (gate * (xm @ blk["w_up"])) @ blk["w_down"]
-    return x, k_cache, v_cache
+    return _post_attention(cfg, blk, x, attn), k_cache, v_cache
 
 
 def forward(
@@ -169,13 +185,42 @@ def forward(
         return h, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(step, x, (params["blocks"], cache.k, cache.v))
-
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = (x @ head.astype(cfg.jax_dtype)).astype(jnp.float32)
+    logits = _head(params, cfg, x)
     return logits, KVCache(k=k_new, v=v_new, length=new_length)
+
+
+def forward_paged(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,        # [B, T] int32
+    positions: jnp.ndarray,     # [B, T] int32 absolute positions
+    token_mask: jnp.ndarray,    # [B, T] bool — real (non-pad) tokens
+    kv_lens: jnp.ndarray,       # [B] int32 — cache length AFTER this step
+    page_table: jnp.ndarray,    # [B, P] int32 physical page ids
+    k_pages: jnp.ndarray,       # [L, NP, page, KV, hd]
+    v_pages: jnp.ndarray,
+    use_pallas: str = "auto",
+):
+    """Serving forward over the paged KV pool (prefill chunks and decode steps
+    share this one traced program per (B, T) bucket).
+
+    Returns (logits [B, T, V] f32, k_pages, v_pages).
+    """
+    from rbg_tpu.ops.paged_attention import paged_attention, write_kv_pages
+
+    x = params["embed"].astype(cfg.jax_dtype)[tokens]
+
+    def step(carry, xs):
+        hcur = carry
+        blk, kp, vp = xs
+        q, k, vv = _qkv(cfg, blk, hcur, positions)
+        kp, vp = write_kv_pages(kp, vp, k, vv, page_table, positions, token_mask)
+        attn = paged_attention(q, kp, vp, page_table, positions, kv_lens,
+                               use_pallas=use_pallas)
+        return _post_attention(cfg, blk, hcur, attn), (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(step, x, (params["blocks"], k_pages, v_pages))
+    return _head(params, cfg, x), k_pages, v_pages
 
 
 def forward_train(
@@ -197,11 +242,7 @@ def forward_train(
         return h, None
 
     x, _ = jax.lax.scan(step, x, params["blocks"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    return (x @ head.astype(cfg.jax_dtype)).astype(jnp.float32)
+    return _head(params, cfg, x)
 
 
 def prefill_and_decode_greedy(params, cfg, prompt, steps: int):

@@ -1,0 +1,103 @@
+"""Paged attention: GQA over a page-table-indirected KV pool.
+
+Two implementations behind one signature:
+
+* ``paged_attention_xla`` — gather pages into a per-sequence contiguous view,
+  then dense attention. Correct everywhere (CPU tests, interpreter), and a
+  strong TPU baseline: XLA fuses the gather into the attention matmuls.
+* ``paged_attention_pallas`` — Pallas TPU kernel that streams pages through
+  VMEM without materializing the gathered [B, S, KV, hd] view (flash-style
+  online softmax). Used on TPU for long contexts where the gather's HBM
+  round-trip dominates.
+
+``paged_attention`` picks per-platform; both are numerically interchangeable
+(tests assert equality vs. the dense reference).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def gather_kv(pages: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
+    """pages [NP, page, KV, hd] + table [B, P] -> [B, P*page, KV, hd]."""
+    B, P = page_table.shape
+    page = pages.shape[1]
+    g = pages[page_table]  # [B, P, page, KV, hd]
+    return g.reshape(B, P * page, *pages.shape[2:])
+
+
+def paged_attention_xla(
+    q: jnp.ndarray,            # [B, T, H, hd]
+    k_pages: jnp.ndarray,      # [NP, page, KV, hd] (single layer)
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,   # [B, P] int32
+    q_positions: jnp.ndarray,  # [B, T] int32 absolute positions
+    kv_lens: jnp.ndarray,      # [B] int32 — valid tokens in cache (post-write)
+) -> jnp.ndarray:
+    B, T, H, hd = q.shape
+    KV = k_pages.shape[2]
+    G = H // KV
+    S = page_table.shape[1] * k_pages.shape[1]
+
+    k = gather_kv(k_pages, page_table).astype(jnp.float32)  # [B, S, KV, hd]
+    v = gather_kv(v_pages, page_table).astype(jnp.float32)
+    qg = q.reshape(B, T, KV, G, hd).astype(jnp.float32)
+
+    scores = jnp.einsum("btkgh,bskh->bkgts", qg, k) / jnp.sqrt(hd).astype(jnp.float32)
+    slot = jnp.arange(S, dtype=jnp.int32)[None, None, :]
+    mask = jnp.logical_and(
+        slot <= q_positions[:, :, None],          # causal (slot == position)
+        slot < kv_lens[:, None, None],            # within the live cache
+    )
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bkgts,bskh->btkgh", probs, v)
+    return out.reshape(B, T, H, hd).astype(q.dtype)
+
+
+def write_kv_pages(k_pages, v_pages, k_new, v_new, page_table, positions,
+                   token_mask):
+    """Scatter new K/V into the pool.
+
+    k_new/v_new: [B, T, KV, hd]; positions: [B, T] absolute; pad tokens
+    (token_mask False) are routed to the reserved null page 0's... actually to
+    an out-of-range slot dropped by scatter ``mode="drop"``.
+    """
+    page_size = k_pages.shape[1]
+    page_idx = positions // page_size                       # [B, T]
+    slot = positions % page_size                            # [B, T]
+    phys = jnp.take_along_axis(page_table, page_idx, axis=1)  # [B, T]
+    # Route pad writes out of range → dropped.
+    NP = k_pages.shape[0]
+    phys = jnp.where(token_mask, phys, NP)
+    k_pages = k_pages.at[phys, slot].set(k_new.astype(k_pages.dtype), mode="drop")
+    v_pages = v_pages.at[phys, slot].set(v_new.astype(v_pages.dtype), mode="drop")
+    return k_pages, v_pages
+
+
+def paged_attention(q, k_pages, v_pages, page_table, q_positions, kv_lens,
+                    *, use_pallas: str = "auto"):
+    """Dispatch between the Pallas TPU kernel and the XLA fallback."""
+    if use_pallas == "always":
+        # Explicit request: fail loudly if the kernel is unavailable.
+        from rbg_tpu.ops.pallas.paged_attention_kernel import paged_attention_pallas
+        return paged_attention_pallas(q, k_pages, v_pages, page_table,
+                                      q_positions, kv_lens)
+    if use_pallas == "auto" and jax.default_backend() == "tpu":
+        try:
+            from rbg_tpu.ops.pallas.paged_attention_kernel import (
+                paged_attention_pallas,
+            )
+            return paged_attention_pallas(q, k_pages, v_pages, page_table,
+                                          q_positions, kv_lens)
+        except ImportError:
+            pass
+    return paged_attention_xla(q, k_pages, v_pages, page_table, q_positions,
+                               kv_lens)

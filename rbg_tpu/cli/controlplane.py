@@ -27,6 +27,34 @@ def register(sub) -> None:
     vp.add_argument("-f", "--file", required=True)
     vp.set_defaults(func=cmd_validate)
 
+    sp = sub.add_parser("serve", help="run a persistent plane with an admin API")
+    sp.add_argument("-f", "--file", help="initial manifests to apply")
+    sp.add_argument("--backend", default="local", choices=["fake", "local"])
+    sp.add_argument("--slices", type=int, default=2)
+    sp.add_argument("--hosts", type=int, default=2)
+    sp.add_argument("--admin-port", type=int, default=7070)
+    sp.set_defaults(func=cmd_serve)
+
+    stp = sub.add_parser("status", help="group status (against a serve plane)")
+    stp.add_argument("name")
+    stp.add_argument("--admin", default="127.0.0.1:7070")
+    stp.add_argument("-n", "--namespace", default="default")
+    stp.set_defaults(func=cmd_status)
+
+    gp = sub.add_parser("get", help="list resources of a kind")
+    gp.add_argument("kind")
+    gp.add_argument("--admin", default="127.0.0.1:7070")
+    gp.add_argument("-n", "--namespace", default="default")
+    gp.set_defaults(func=cmd_get)
+
+    rp = sub.add_parser("rollout", help="rollout history|diff|undo")
+    rp.add_argument("action", choices=["history", "diff", "undo"])
+    rp.add_argument("name")
+    rp.add_argument("--revision", type=int)
+    rp.add_argument("--admin", default="127.0.0.1:7070")
+    rp.add_argument("-n", "--namespace", default="default")
+    rp.set_defaults(func=cmd_rollout)
+
 
 def _load(path: str):
     from rbg_tpu.api import load_yaml_docs, parse_manifest
@@ -86,6 +114,102 @@ def cmd_apply(args) -> int:
             if args.verbose:
                 _print_detail(plane, o.metadata.namespace, o.metadata.name)
         return rc
+
+
+def cmd_serve(args) -> int:
+    """Persistent plane + admin API (the single-binary manager; reference:
+    ``cmd/rbgs/main.go``)."""
+    import signal
+    import time as _time
+
+    from rbg_tpu.runtime.admin import AdminServer
+    from rbg_tpu.runtime.plane import ControlPlane
+    from rbg_tpu.testutil import make_tpu_nodes
+
+    plane = ControlPlane(backend=args.backend)
+    if args.backend == "fake":
+        make_tpu_nodes(plane.store, slices=args.slices, hosts_per_slice=args.hosts)
+    else:
+        from rbg_tpu.api.pod import Node
+        node = Node()
+        node.metadata.name = "localhost"
+        plane.store.create(node)
+    plane.start()
+    admin = AdminServer(plane, args.admin_port).start()
+    print(f"plane serving; admin on 127.0.0.1:{admin.port}", flush=True)
+    if args.file:
+        for o in _load(args.file):
+            plane.apply(o)
+            print(f"applied {o.kind}/{o.metadata.name}", flush=True)
+
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    while not stop:
+        _time.sleep(0.2)
+    admin.stop()
+    plane.stop()
+    return 0
+
+
+def _admin_call(addr: str, obj: dict) -> dict:
+    from rbg_tpu.engine.protocol import request_once
+
+    try:
+        resp, _, _ = request_once(addr, obj, timeout=30.0)
+    except OSError as e:
+        print(f"error: cannot reach admin endpoint {addr}: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    if resp is None:
+        print("error: admin endpoint closed connection", file=sys.stderr)
+        raise SystemExit(1)
+    if "error" in resp:
+        print(f"error: {resp['error']}", file=sys.stderr)
+        raise SystemExit(1)
+    return resp
+
+
+def cmd_status(args) -> int:
+    st = _admin_call(args.admin, {"op": "status", "name": args.name,
+                                  "namespace": args.namespace})
+    print(f"group {st['name']}: {'Ready' if st['ready'] else 'NOT ready'} "
+          f"({st['reason']}) revision={st['revision']}")
+    print(f"  {'ROLE':<12} {'READY':<8} {'UPDATED':<8}")
+    for r in st["roles"]:
+        want = st["specReplicas"].get(r.get("name"), "?")
+        print(f"  {r.get('name', ''):<12} {r.get('readyReplicas', 0)}/{want:<6} "
+              f"{r.get('updatedReplicas', 0):<8}")
+    for p in st["pods"]:
+        slice_part = f" slice={p['slice']}" if p["slice"] else ""
+        print(f"    pod {p['name']:<28} {p['phase']:<9} node={p['node'] or '<pending>'}{slice_part}")
+    return 0
+
+
+def cmd_get(args) -> int:
+    resp = _admin_call(args.admin, {"op": "list", "kind": args.kind,
+                                    "namespace": args.namespace})
+    for item in resp["items"]:
+        meta = item.get("metadata", {})
+        print(f"{args.kind}/{meta.get('name')}")
+    return 0
+
+
+def cmd_rollout(args) -> int:
+    base = {"name": args.name, "namespace": args.namespace}
+    if args.action == "history":
+        resp = _admin_call(args.admin, {"op": "history", **base})
+        print(f"{'REVISION':<10} NAME")
+        for r in resp["revisions"]:
+            print(f"{r['revision']:<10} {r['name']}")
+        return 0
+    if args.action == "diff":
+        resp = _admin_call(args.admin, {"op": "diff", "revision": args.revision, **base})
+        for line in resp["diff"]:
+            print(line)
+        return 0
+    resp = _admin_call(args.admin, {"op": "undo", "revision": args.revision, **base})
+    print(f"rolled back to revision {resp['restoredRevision']}")
+    return 0
 
 
 def _print_detail(plane, ns: str, name: str) -> None:

@@ -28,7 +28,7 @@ def build_cluster_config(store, rbg) -> dict:
     """Build the ClusterConfig document (reference schema
     ``config_builder.go:54-75``, FQDNs ``:117-138``)."""
     ns = rbg.metadata.namespace
-    nodes = {n.metadata.name: n for n in store.list("Node")}
+    nodes = {n.metadata.name: n for n in store.list("Node", copy_=False)}
     roles_out = []
     for role in rbg.spec.roles:
         svc = C.service_name(rbg.metadata.name, role.name)
@@ -38,11 +38,13 @@ def build_cluster_config(store, rbg) -> dict:
             "RoleInstance", namespace=ns,
             selector={C.LABEL_GROUP_NAME: rbg.metadata.name,
                       C.LABEL_ROLE_NAME: role.name},
+            copy_=False,
         )
         for inst in sorted(instances, key=lambda i: i.metadata.name):
             pods = sorted(
                 store.list("Pod", namespace=ns,
-                           selector={C.LABEL_INSTANCE_NAME: inst.metadata.name}),
+                           selector={C.LABEL_INSTANCE_NAME: inst.metadata.name},
+                           copy_=False),
                 key=lambda p: int(p.metadata.labels.get(C.LABEL_COMPONENT_INDEX, "0")),
             )
             hosts = []

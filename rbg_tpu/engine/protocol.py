@@ -1,0 +1,108 @@
+"""Wire protocol for engine/router processes.
+
+Newline-delimited JSON headers over TCP, with optional raw binary KV payload
+(lengths declared in the header — no base64 tax on multi-MB KV bundles).
+This is the DCN path of the PD-disagg KV transfer; within a slice the
+in-process PDPair path (device gather/scatter) is used instead.
+
+Ops:
+  {"op": "health"}                              → {"ok": true, "mode": ...}
+  {"op": "generate", "prompt": [...], ...}      → {"tokens": [...], "ttft_s": x}
+  {"op": "prefill", "prompt": [...], ...}       → bundle header + K/V bytes
+  {"op": "decode_bundle", ...hdr} + K/V bytes   → {"tokens": [...]}
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def send_msg(sock: socket.socket, obj: dict,
+             k_bytes: Optional[bytes] = None,
+             v_bytes: Optional[bytes] = None) -> None:
+    obj = dict(obj)
+    if k_bytes is not None:
+        obj["bin_k"] = len(k_bytes)
+        obj["bin_v"] = len(v_bytes or b"")
+    sock.sendall(json.dumps(obj).encode() + b"\n")
+    if k_bytes is not None:
+        sock.sendall(k_bytes)
+        if v_bytes:
+            sock.sendall(v_bytes)
+
+
+_rfiles: "weakref.WeakKeyDictionary" = None  # initialized below
+
+
+def _rfile(sock: socket.socket):
+    """Per-socket buffered reader (persists across messages — a fresh
+    makefile per call would swallow buffered bytes of the next message).
+    socket.socket has __slots__, so the association lives in a weak map."""
+    global _rfiles
+    if _rfiles is None:
+        import weakref
+        _rfiles = weakref.WeakKeyDictionary()
+    f = _rfiles.get(sock)
+    if f is None:
+        f = sock.makefile("rb", buffering=1 << 16)
+        _rfiles[sock] = f
+    return f
+
+
+def _read_exact(f, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = f.read(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-payload")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> Tuple[Optional[dict], Optional[bytes], Optional[bytes]]:
+    f = _rfile(sock)
+    line = f.readline()
+    if not line:
+        return None, None, None
+    obj = json.loads(line)
+    k = v = None
+    if "bin_k" in obj:
+        k = _read_exact(f, obj["bin_k"])
+        v = _read_exact(f, obj.get("bin_v", 0))
+    return obj, k, v
+
+
+def bundle_to_wire(bundle) -> Tuple[dict, bytes, bytes]:
+    header = {
+        "prompt": bundle.prompt,
+        "first_token": bundle.first_token,
+        "shape": list(bundle.k_data.shape),
+        "dtype": str(bundle.k_data.dtype),
+    }
+    return header, bundle.k_data.tobytes(), bundle.v_data.tobytes()
+
+
+def bundle_from_wire(header: dict, k_bytes: bytes, v_bytes: bytes):
+    from rbg_tpu.engine.pd import KVBundle
+
+    shape = tuple(header["shape"])
+    dtype = np.dtype(header["dtype"])
+    return KVBundle(
+        prompt=list(header["prompt"]),
+        first_token=int(header["first_token"]),
+        k_data=np.frombuffer(k_bytes, dtype).reshape(shape),
+        v_data=np.frombuffer(v_bytes, dtype).reshape(shape),
+    )
+
+
+def request_once(addr: str, obj: dict, k_bytes=None, v_bytes=None,
+                 timeout: float = 120.0):
+    """One request/response round trip to ``host:port``."""
+    host, port = addr.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        send_msg(s, obj, k_bytes, v_bytes)
+        return recv_msg(s)

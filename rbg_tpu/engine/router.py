@@ -1,0 +1,173 @@
+"""Router process — the front role of a PD-disagg group.
+
+Reference analog: the sglang-router role in ``examples/inference/
+pd-disagg-*.yaml`` (router → prefill → decode with startup dependencies).
+Discovers its backends from the address registry the executor maintains
+(or static ``--backends``):
+
+* registry entries carry the role name, so PD mode switches on automatically
+  when ``prefill`` and ``decode`` roles exist: prefill op → KV bundle over
+  the wire → decode_bundle op on a decode peer (Mooncake-style transfer).
+* otherwise round-robins ``generate`` over unified workers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import socketserver
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from rbg_tpu.engine.protocol import recv_msg, request_once, send_msg
+
+
+class Registry:
+    """Pod address registry: {fqdn: {addr, role, group}} JSON file, written
+    atomically by the executor; re-read (mtime-cached) per lookup."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self._cache: Dict[str, dict] = {}
+        self._mtime = 0.0
+
+    def entries(self) -> Dict[str, dict]:
+        if not self.path or not os.path.exists(self.path):
+            return self._cache
+        mtime = os.path.getmtime(self.path)
+        if mtime != self._mtime:
+            try:
+                with open(self.path) as f:
+                    self._cache = json.load(f)
+                self._mtime = mtime
+            except (OSError, json.JSONDecodeError):
+                pass
+        return self._cache
+
+    def backends(self, role: str, group: Optional[str] = None) -> List[str]:
+        out = []
+        for fqdn, e in sorted(self.entries().items()):
+            if e.get("role") == role and (group is None or e.get("group") == group):
+                out.append(e["addr"])
+        return out
+
+
+class RouterState:
+    def __init__(self, registry: Registry, group: Optional[str],
+                 static_backends: Optional[dict] = None):
+        self.registry = registry
+        self.group = group
+        self.static = static_backends or {}
+        self._rr = {}
+        self.metrics = {"requests": 0, "pd_requests": 0, "errors": 0,
+                        "kv_bytes_routed": 0}
+
+    def pick(self, role: str) -> Optional[str]:
+        backends = self.static.get(role) or self.registry.backends(role, self.group)
+        if not backends:
+            return None
+        i = self._rr.get(role, 0)
+        self._rr[role] = i + 1
+        return backends[i % len(backends)]
+
+    def pd_mode(self) -> bool:
+        return bool(
+            (self.static.get("prefill") or self.registry.backends("prefill", self.group))
+            and (self.static.get("decode") or self.registry.backends("decode", self.group))
+        )
+
+
+class Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        state: RouterState = self.server.state
+        while True:
+            try:
+                obj, _, _ = recv_msg(self.request)
+            except (ConnectionError, json.JSONDecodeError):
+                return
+            if obj is None:
+                return
+            op = obj.get("op")
+            if op == "health":
+                send_msg(self.request, {
+                    "ok": True, "pd": state.pd_mode(),
+                    "metrics": state.metrics,
+                })
+                continue
+            if op != "generate":
+                send_msg(self.request, {"error": f"router: unsupported op {op!r}"})
+                continue
+            try:
+                send_msg(self.request, self._generate(state, obj))
+            except Exception as e:
+                state.metrics["errors"] += 1
+                send_msg(self.request, {"error": str(e)})
+
+    def _generate(self, state: RouterState, obj: dict) -> dict:
+        state.metrics["requests"] += 1
+        t0 = time.perf_counter()
+        if state.pd_mode():
+            state.metrics["pd_requests"] += 1
+            prefill_addr = state.pick("prefill")
+            decode_addr = state.pick("decode")
+            hdr, kb, vb = request_once(prefill_addr, {"op": "prefill",
+                                                      "prompt": obj["prompt"]})
+            if hdr is None or "error" in hdr:
+                raise RuntimeError(f"prefill failed: {hdr}")
+            state.metrics["kv_bytes_routed"] += len(kb or b"") + len(vb or b"")
+            fwd = dict(hdr)
+            fwd["op"] = "decode_bundle"
+            for key in ("max_new_tokens", "temperature", "top_k", "stop_token"):
+                if key in obj:
+                    fwd[key] = obj[key]
+            resp, _, _ = request_once(decode_addr, fwd, kb, vb)
+            if resp is None or "error" in resp:
+                raise RuntimeError(f"decode failed: {resp}")
+            resp["ttft_s"] = time.perf_counter() - t0
+            return resp
+        worker = state.pick("worker") or state.pick("server")
+        if worker is None:
+            # fall back to any non-router role present
+            roles = {e.get("role") for e in state.registry.entries().values()}
+            roles.discard("router")
+            for r in sorted(roles):
+                worker = state.pick(r)
+                if worker:
+                    break
+        if worker is None:
+            raise RuntimeError("no backends available")
+        resp, _, _ = request_once(worker, obj)
+        if resp is None:
+            raise RuntimeError("backend closed connection")
+        return resp
+
+
+class RouterServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rbg-tpu-router")
+    ap.add_argument("--port", type=int, default=9100)
+    ap.add_argument("--registry", default=os.environ.get("RBG_REGISTRY_PATH"))
+    ap.add_argument("--group", default=os.environ.get("RBG_GROUP_NAME"))
+    ap.add_argument("--backends", default="",
+                    help='static JSON {"prefill": ["host:port"], ...}')
+    args = ap.parse_args(argv)
+    port = int(os.environ.get("RBG_SERVE_PORT")
+               or os.environ.get("RBG_PORT_SERVE") or args.port)
+    static = json.loads(args.backends) if args.backends else None
+    server = RouterServer(("127.0.0.1", port), Handler)
+    server.state = RouterState(Registry(args.registry), args.group, static)
+    print(f"router listening on 127.0.0.1:{port} group={args.group}", flush=True)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""Engine server process — what a role pod runs.
+
+Reference analog: the SGLang server container in RBG's role templates
+(``examples/inference/*.yaml``); here the engine is ours and the rendezvous
+contract is the one the control plane injects (RBG_* envs, see
+rbg_tpu.discovery.env_builder).
+
+Modes (= PD-disagg roles): ``unified`` serves generate; ``prefill`` answers
+prefill ops with KV bundles; ``decode`` accepts bundles and decodes.
+
+Env contract consumed: ``RBG_SERVE_PORT`` (from the executor or the port
+allocator's ``RBG_PORT_SERVE``), ``RBG_JAX_NUM_PROCESSES``/``RBG_JAX_PROCESS_ID``/
+``RBG_JAX_COORDINATOR_ADDRESS`` (multi-host slice init), ``RBG_TPU_*``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socketserver
+import sys
+import threading
+
+from rbg_tpu.engine.config import EngineConfig, SamplingParams
+from rbg_tpu.engine.protocol import bundle_from_wire, bundle_to_wire, recv_msg, send_msg
+
+
+def build_config(args) -> EngineConfig:
+    return EngineConfig(
+        model=args.model, mode=args.mode, page_size=args.page_size,
+        num_pages=args.num_pages, max_batch=args.max_batch,
+        max_seq_len=args.max_seq_len, prefill_chunk=args.prefill_chunk,
+        use_pallas=args.use_pallas,
+    )
+
+
+class Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv = self.server
+        while True:
+            try:
+                obj, k, v = recv_msg(self.request)
+            except (ConnectionError, json.JSONDecodeError):
+                return
+            if obj is None:
+                return
+            try:
+                self._dispatch(srv, obj, k, v)
+            except Exception as e:
+                send_msg(self.request, {"error": str(e)})
+
+    def _dispatch(self, srv, obj, k, v):
+        op = obj.get("op")
+        if op == "health":
+            ready = srv.service is not None or srv.prefill is not None or srv.decode is not None
+            send_msg(self.request, {"ok": ready, "mode": srv.mode})
+            return
+        if op == "generate" and srv.service is not None:
+            sampling = SamplingParams(
+                max_new_tokens=obj.get("max_new_tokens", 16),
+                temperature=obj.get("temperature", 0.0),
+                top_k=obj.get("top_k", 0),
+                stop_token=obj.get("stop_token"),
+            )
+            tokens, ttft = srv.service.submit(obj["prompt"], sampling)
+            send_msg(self.request, {"tokens": tokens, "ttft_s": ttft})
+            return
+        if op == "prefill" and srv.prefill is not None:
+            with srv.pd_lock:
+                bundle = srv.prefill.prefill(obj["prompt"])
+            header, kb, vb = bundle_to_wire(bundle)
+            send_msg(self.request, header, kb, vb)
+            return
+        if op == "decode_bundle" and srv.decode is not None:
+            bundle = bundle_from_wire(obj, k, v)
+            sampling = SamplingParams(
+                max_new_tokens=obj.get("max_new_tokens", 16),
+                temperature=obj.get("temperature", 0.0),
+                top_k=obj.get("top_k", 0),
+                stop_token=obj.get("stop_token"),
+            )
+            with srv.pd_lock:
+                rid = srv.decode.inject(bundle, sampling)
+                eng = srv.decode.engine
+                tokens = [bundle.first_token]
+                while any(r.id == rid and r.state == "running" for r in eng.running):
+                    for ev in eng.step():
+                        if ev.request_id == rid:
+                            tokens.append(ev.token)
+            send_msg(self.request, {"tokens": tokens})
+            return
+        send_msg(self.request, {"error": f"unsupported op {op!r} in mode {srv.mode}"})
+
+
+class EngineServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve(args) -> None:
+    cfg = build_config(args)
+    port = int(os.environ.get("RBG_SERVE_PORT")
+               or os.environ.get("RBG_PORT_SERVE")
+               or args.port)
+
+    # Multi-host slice init (the control plane injected the contract).
+    nproc = int(os.environ.get("RBG_JAX_NUM_PROCESSES", "1"))
+    if nproc > 1 and os.environ.get("RBG_DISTRIBUTED", "0") == "1":
+        import jax
+        jax.distributed.initialize(
+            os.environ["RBG_JAX_COORDINATOR_ADDRESS"],
+            num_processes=nproc,
+            process_id=int(os.environ["RBG_JAX_PROCESS_ID"]),
+        )
+
+    server = EngineServer(("127.0.0.1", port), Handler)
+    server.mode = cfg.mode
+    server.service = server.prefill = server.decode = None
+    server.pd_lock = threading.Lock()
+
+    # Bind the port FIRST (readiness probes connect), then load the model.
+    def init_engine():
+        if cfg.mode == "prefill":
+            from rbg_tpu.engine.pd import PrefillWorker
+            server.prefill = PrefillWorker(cfg)
+        elif cfg.mode == "decode":
+            from rbg_tpu.engine.pd import DecodeWorker
+            server.decode = DecodeWorker(cfg)
+        else:
+            from rbg_tpu.engine.service import EngineService
+            server.service = EngineService(cfg)
+        print(f"engine ready mode={cfg.mode} model={cfg.model} port={port}",
+              flush=True)
+
+    threading.Thread(target=init_engine, daemon=True).start()
+    print(f"engine listening on 127.0.0.1:{port}", flush=True)
+    server.serve_forever()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rbg-tpu-engine")
+    ap.add_argument("--model", default=os.environ.get("RBG_MODEL", "tiny"))
+    ap.add_argument("--mode", default="unified",
+                    choices=["unified", "prefill", "decode"])
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--num-pages", type=int, default=512)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-seq-len", type=int, default=1024)
+    ap.add_argument("--prefill-chunk", type=int, default=64)
+    ap.add_argument("--use-pallas", default="auto")
+    args = ap.parse_args(argv)
+    serve(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

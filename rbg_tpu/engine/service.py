@@ -1,0 +1,77 @@
+"""EngineService: background continuous-batching loop + blocking submit API.
+
+Requests arriving on different connections batch together on the device —
+the server threads only enqueue and wait; one loop thread owns the engine
+(single-writer, no engine locking on the hot path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from rbg_tpu.engine.config import EngineConfig, SamplingParams
+from rbg_tpu.engine.engine import Engine
+
+
+class _Pending:
+    __slots__ = ("tokens", "done", "t_submit", "t_first")
+
+    def __init__(self):
+        self.tokens: List[int] = []
+        self.done = threading.Event()
+        self.t_submit = time.perf_counter()
+        self.t_first: Optional[float] = None
+
+
+class EngineService:
+    def __init__(self, cfg: EngineConfig, params=None, mesh=None):
+        self.engine = Engine(cfg, params=params, mesh=mesh)
+        self._pending: Dict[int, _Pending] = {}
+        self._lock = threading.Lock()          # guards queue handoff only
+        self._wake = threading.Event()
+        self._stop = False
+        self._queue: List[Tuple[List[int], SamplingParams, _Pending]] = []
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="engine-loop")
+        self._thread.start()
+
+    def submit(self, prompt: List[int], sampling: SamplingParams,
+               timeout: float = 600.0) -> Tuple[List[int], float]:
+        """Blocking generate. Returns (tokens, ttft_seconds)."""
+        p = _Pending()
+        with self._lock:
+            self._queue.append((prompt, sampling, p))
+        self._wake.set()
+        if not p.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        return p.tokens, (p.t_first - p.t_submit if p.t_first else 0.0)
+
+    def stop(self):
+        self._stop = True
+        self._wake.set()
+
+    def _loop(self):
+        eng = self.engine
+        while not self._stop:
+            with self._lock:
+                newly = self._queue
+                self._queue = []
+            for prompt, sampling, pending in newly:
+                rid = eng.add_request(prompt, sampling)
+                self._pending[rid] = pending
+            if not eng.has_work():
+                self._wake.wait(0.01)
+                self._wake.clear()
+                continue
+            for ev in eng.step():
+                pending = self._pending.get(ev.request_id)
+                if pending is None:
+                    continue
+                if pending.t_first is None:
+                    pending.t_first = time.perf_counter()
+                pending.tokens.append(ev.token)
+                if ev.finished:
+                    pending.done.set()
+                    del self._pending[ev.request_id]

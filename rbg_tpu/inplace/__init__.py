@@ -1,0 +1,3 @@
+from rbg_tpu.inplace.update import image_only_diff, try_inplace_update
+
+__all__ = ["image_only_diff", "try_inplace_update"]

@@ -1,0 +1,100 @@
+"""In-place update: image-only changes patch running pods without recreation.
+
+Reference analog: ``pkg/inplace`` (inventory #15, Kruise-derived): the update
+spec is computed as the diff of revisions; ONLY ``containers[x].image``
+changes qualify (``inplace_update_defaults.go:76-95``) — anything else falls
+back to recreate. On TPU this matters doubly: recreating a multi-host
+instance tears down a whole slice gang and re-acquires it; an image-only
+rollout keeps the slice, the HBM state, and the XLA compile cache warm.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api import serde
+
+
+def _normalize_images(it_dict: dict) -> dict:
+    """Serialized instance template with every container image blanked."""
+    d = copy.deepcopy(it_dict)
+
+    def blank(tmpl):
+        if not tmpl:
+            return
+        for c in tmpl.get("containers", []) + tmpl.get("initContainers", []):
+            c["image"] = ""
+
+    blank(d.get("template"))
+    lw = d.get("leaderWorker") or {}
+    blank(lw.get("leaderTemplate"))
+    blank(lw.get("workerTemplate"))
+    for comp in d.get("components", []):
+        blank(comp.get("template"))
+    return d
+
+
+def image_only_diff(old_it, new_it) -> Optional[Dict[str, str]]:
+    """If the two instance templates differ ONLY in container images, return
+    {container name: new image}; else None."""
+    old_d = serde.to_dict(old_it)
+    new_d = serde.to_dict(new_it)
+    if old_d == new_d:
+        return {}
+    if _normalize_images(old_d) != _normalize_images(new_d):
+        return None
+    images: Dict[str, str] = {}
+
+    def collect(tmpl):
+        if not tmpl:
+            return
+        for c in tmpl.get("containers", []) + tmpl.get("initContainers", []):
+            if c.get("name") and c.get("image"):
+                images[c["name"]] = c["image"]
+
+    collect(new_d.get("template"))
+    lw = new_d.get("leaderWorker") or {}
+    collect(lw.get("leaderTemplate"))
+    collect(lw.get("workerTemplate"))
+    for comp in new_d.get("components", []):
+        collect(comp.get("template"))
+    return images
+
+
+def try_inplace_update(store, ris, inst, revision: str) -> bool:
+    """Attempt an in-place update of ``inst`` to the RIS's current template.
+    Returns True when applied (pods patched, no recreation)."""
+    images = image_only_diff(inst.spec.instance, ris.spec.instance)
+    if images is None:
+        return False  # structural change — recreate path
+
+    ns = inst.metadata.namespace
+
+    def fn(i):
+        i.spec.instance = copy.deepcopy(ris.spec.instance)
+        i.metadata.labels[C.LABEL_REVISION_NAME] = revision
+        return True
+
+    store.mutate("RoleInstance", ns, inst.metadata.name, fn)
+
+    # Patch the pods' images in place — identity (uid, node, slice) survives.
+    for pod in store.list("Pod", namespace=ns, owner_uid=inst.metadata.uid):
+        def patch(p):
+            changed = False
+            for c in p.template.containers + p.template.init_containers:
+                new_img = images.get(c.name)
+                if new_img and c.image != new_img:
+                    c.image = new_img
+                    changed = True
+            if changed:
+                p.metadata.labels[C.LABEL_REVISION_NAME] = revision
+            return changed
+        try:
+            store.mutate("Pod", ns, pod.metadata.name, patch)
+        except Exception:
+            pass
+    store.record_event(inst, "InPlaceUpdated",
+                       f"images updated in place to revision {revision}")
+    return True

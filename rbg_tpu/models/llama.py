@@ -228,17 +228,39 @@ def forward_train(
     cfg: ModelConfig,
     tokens: jnp.ndarray,                       # [B, T] int32
     token_mask: Optional[jnp.ndarray] = None,  # [B, T] bool
+    mesh=None,                                 # Mesh with an "sp" axis → ring
 ) -> jnp.ndarray:
-    """Cache-free causal forward for training. Returns logits [B, T, V] f32."""
+    """Cache-free causal forward for training. Returns logits [B, T, V] f32.
+
+    With a mesh whose ``sp`` axis is > 1, attention runs as ring attention
+    over sequence shards (exact; ICI neighbor exchange) instead of relying on
+    XLA to all-gather the sequence dim.
+    """
     B, T = tokens.shape
     if token_mask is None:
         token_mask = jnp.ones((B, T), bool)
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
 
+    use_ring = (
+        mesh is not None
+        and "sp" in mesh.axis_names
+        and mesh.shape["sp"] > 1
+        and T % mesh.shape["sp"] == 0
+    )
+    if use_ring:
+        from rbg_tpu.parallel.ring import ring_attention
+        # Pad K/V slots get a position beyond every query → never attended.
+        kv_positions = jnp.where(token_mask, positions, jnp.int32(1 << 30))
+
     x = params["embed"].astype(cfg.jax_dtype)[tokens]
 
     def step(h, blk):
-        h, _, _ = _block(cfg, h, blk, None, None, positions, token_mask)
+        if use_ring:
+            q, k, vv = _qkv(cfg, blk, h, positions)
+            attn = ring_attention(q, k, vv, positions, kv_positions, mesh)
+            h = _post_attention(cfg, blk, h, attn)
+        else:
+            h, _, _ = _block(cfg, h, blk, None, None, positions, token_mask)
         return h, None
 
     x, _ = jax.lax.scan(step, x, params["blocks"])

@@ -19,12 +19,13 @@ from rbg_tpu.models.llama import forward_train
 from rbg_tpu.parallel import sharding as shd
 
 
-def next_token_loss(params, cfg: ModelConfig, tokens, token_mask=None):
+def next_token_loss(params, cfg: ModelConfig, tokens, token_mask=None,
+                    mesh=None):
     """Mean next-token cross-entropy over non-pad positions."""
     B, T = tokens.shape
     if token_mask is None:
         token_mask = jnp.ones((B, T), bool)
-    logits = forward_train(params, cfg, tokens, token_mask)  # [B, T, V]
+    logits = forward_train(params, cfg, tokens, token_mask, mesh=mesh)  # [B, T, V]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
@@ -35,8 +36,10 @@ def next_token_loss(params, cfg: ModelConfig, tokens, token_mask=None):
 def make_train_step(cfg: ModelConfig, mesh: Mesh, learning_rate: float = 3e-4):
     """Build (init_fn, train_step) jitted over ``mesh``.
 
-    Shardings: params per Megatron rules (tp), batch over dp, sequence over sp.
-    XLA inserts the gradient psums across dp and the tp collectives.
+    Shardings: params per Megatron rules (tp), batch over dp, sequence over
+    sp — attention over the sp shards runs as RING attention (exact ICI
+    neighbor exchange, rbg_tpu.parallel.ring), not an XLA all-gather. XLA
+    inserts the gradient psums across dp and the tp collectives.
     """
     tx = optax.adamw(learning_rate)
     pspecs = shd.param_specs(cfg)
@@ -71,7 +74,8 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, learning_rate: float = 3e-4):
         return params, opt_state
 
     def _step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(next_token_loss)(params, cfg, tokens)
+        loss, grads = jax.value_and_grad(next_token_loss)(
+            params, cfg, tokens, mesh=mesh)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

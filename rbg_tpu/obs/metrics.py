@@ -1,0 +1,92 @@
+"""Metrics: counters + histograms with Prometheus text exposition.
+
+Reference analog: controller-runtime's Prometheus metrics server
+(``cmd/rbgs/main.go:270-314``) — reconcile totals/errors/durations per
+controller, workqueue depths. Exposed through the admin API (op "metrics")
+in text exposition format, so a scrape sidecar can forward them.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, Optional, Tuple
+
+_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+            1.0, 2.5, 5.0, 10.0)
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, tuple], float] = defaultdict(float)
+        self._hist: Dict[Tuple[str, tuple], list] = {}
+
+    def inc(self, name: str, value: float = 1.0, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key] += value
+
+    def observe(self, name: str, value: float, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._hist.get(key)
+            if h is None:
+                h = [[0] * (len(_BUCKETS) + 1), 0.0, 0]  # buckets, sum, count
+                self._hist[key] = h
+            for i, b in enumerate(_BUCKETS):
+                if value <= b:
+                    h[0][i] += 1
+                    break
+            else:
+                h[0][-1] += 1
+            h[1] += value
+            h[2] += 1
+
+    def quantile(self, name: str, q: float, **labels) -> Optional[float]:
+        """Approximate quantile from histogram buckets (upper bound)."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._hist.get(key)
+            if h is None or h[2] == 0:
+                return None
+            target = q * h[2]
+            seen = 0
+            for i, count in enumerate(h[0]):
+                seen += count
+                if seen >= target:
+                    return _BUCKETS[i] if i < len(_BUCKETS) else float("inf")
+            return float("inf")
+
+    def render(self) -> str:
+        """Prometheus text exposition."""
+        lines = []
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                lines.append(f"{name}{_fmt(labels)} {v}")
+            for (name, labels), (buckets, total, count) in sorted(self._hist.items()):
+                cum = 0
+                for i, b in enumerate(_BUCKETS):
+                    cum += buckets[i]
+                    lines.append(f"{name}_bucket{_fmt(labels, le=b)} {cum}")
+                cum += buckets[-1]
+                lines.append(f'{name}_bucket{_fmt(labels, le="+Inf")} {cum}')
+                lines.append(f"{name}_sum{_fmt(labels)} {total}")
+                lines.append(f"{name}_count{_fmt(labels)} {count}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._hist.clear()
+
+
+def _fmt(labels: tuple, **extra) -> str:
+    items = list(labels) + sorted(extra.items())
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    return "{" + inner + "}"
+
+
+REGISTRY = Registry()

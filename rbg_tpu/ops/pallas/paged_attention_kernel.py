@@ -1,0 +1,158 @@
+"""Pallas TPU kernel: decode-phase paged attention.
+
+Why a kernel: the XLA fallback (`paged_attention_xla`) materializes the
+gathered per-sequence KV view ``[B, S, KV, hd]`` in HBM before attending —
+every decode step pays ~3× the pool's live-token traffic (gather write +
+attention read, plus the pool read). Decode attention is pure HBM bandwidth,
+so this kernel streams each page HBM→VMEM exactly once and keeps the
+flash-style online softmax state in VMEM scratch.
+
+Design (see /opt/skills/guides/pallas_guide.md):
+* grid = (B, P): one sequence per outer step, its pages inner ("arbitrary"
+  semantics — scratch accumulators persist across the page walk).
+* page_table + kv_lens are scalar-prefetch args: the k/v BlockSpec index_map
+  dereferences the page table, so the pipeline DMAs the RIGHT physical page
+  ahead of compute (double-buffered by the Pallas pipeline itself).
+* GQA via one batched dot per page: [KV, G, hd] × [KV, page, hd].
+* Out-of-range pages (beyond a sequence's kv_len) still prefetch page 0 (the
+  reserved null page) and are masked in-softmax — no divergent control flow.
+
+Reference context: this is the TPU analog of the ragged/paged attention
+kernels the PAPERS.md "Ragged Paged Attention" paper describes; the engine
+only uses it for decode (T == 1); prefill chunks stay on the dense XLA path
+(MXU-bound, already optimal).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_table_ref,   # [B, P] int32 (SMEM)
+    kv_lens_ref,      # [B] int32 (SMEM)
+    # blocks
+    q_ref,            # [1, KV, G, hd] (VMEM)
+    k_ref,            # [1, page, KV, hd] — the page picked by index_map
+    v_ref,
+    out_ref,          # [1, KV, G, hd]
+    # scratch
+    m_ref,            # [KV, G, 1] running max
+    l_ref,            # [KV, G, 1] running denom
+    acc_ref,          # [KV, G, hd] running numerator
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    num_p = pl.num_programs(1)
+    page = k_ref.shape[1]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    kv_len = kv_lens_ref[b]
+
+    # Skip pages entirely past the sequence (still DMA'd, never read).
+    @pl.when(p * page < kv_len)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32)                    # [KV, G, hd]
+        k = k_ref[0].astype(jnp.float32)                    # [page, KV, hd]
+        v = v_ref[0].astype(jnp.float32)
+        hd = q.shape[-1]
+
+        k_t = jnp.transpose(k, (1, 0, 2))                   # [KV, page, hd]
+        v_t = jnp.transpose(v, (1, 0, 2))
+        # scores[kv, g, t] = q[kv, g, :] · k[kv, t, :]
+        scores = jax.lax.dot_general(
+            q, k_t,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * (1.0 / (hd ** 0.5))                             # [KV, G, page]
+
+        token_idx = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, dimension=2)
+        scores = jnp.where(token_idx < kv_len, scores, _NEG_INF)
+
+        m_prev = m_ref[:]                                   # [KV, G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)                     # [KV, G, 1]
+        probs = jnp.exp(scores - m_new)                     # [KV, G, page]
+
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        # acc[kv, g, :] += probs[kv, g, t] * v[kv, t, :]
+        pv = jax.lax.dot_general(
+            probs, v_t,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                                   # [KV, G, hd]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+
+    @pl.when(p == num_p - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:], 1e-30)                # guard empty rows
+        out_ref[0] = (acc_ref[:] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _decode_call(q, k_pages, v_pages, page_table, kv_lens, interpret=False):
+    """q: [B, KV, G, hd]; pages: [NP, page, KV, hd]. Returns [B, KV, G, hd]."""
+    B, KV, G, hd = q.shape
+    NP, page, _, _ = k_pages.shape
+    P = page_table.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, KV, G, hd), lambda b, p, table, lens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, page, KV, hd),
+                         lambda b, p, table, lens: (table[b, p], 0, 0, 0)),
+            pl.BlockSpec((1, page, KV, hd),
+                         lambda b, p, table, lens: (table[b, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, KV, G, hd),
+                               lambda b, p, table, lens: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KV, G, 1), jnp.float32),
+            pltpu.VMEM((KV, G, 1), jnp.float32),
+            pltpu.VMEM((KV, G, hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        _decode_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(page_table, kv_lens, q, k_pages, v_pages)
+
+
+def paged_attention_pallas(q, k_pages, v_pages, page_table, q_positions,
+                           kv_lens, interpret: bool = False):
+    """Drop-in for ``paged_attention_xla``. Decode (T == 1) runs the kernel;
+    other shapes fall back to the XLA path (prefill is MXU-bound there)."""
+    B, T, H, hd = q.shape
+    KV = k_pages.shape[2]
+    if T != 1:
+        from rbg_tpu.ops.paged_attention import paged_attention_xla
+        return paged_attention_xla(q, k_pages, v_pages, page_table,
+                                   q_positions, kv_lens)
+    G = H // KV
+    qg = q.reshape(B, KV, G, hd)
+    out = _decode_call(qg, k_pages, v_pages,
+                       page_table.astype(jnp.int32),
+                       kv_lens.astype(jnp.int32), interpret=interpret)
+    return out.reshape(B, T, H, hd)

@@ -1,0 +1,169 @@
+"""Admin API server: the plane's operator endpoint.
+
+The apiserver-facing half of the kubectl-plugin story (reference: ``cmd/cli``
+talks to the K8s API; our CLI talks to this). JSON-over-TCP on localhost,
+same framing as the engine protocol. Ops: list/get/apply/delete, group
+status, rollout history/diff/undo (ControllerRevision-backed, KEP-31).
+"""
+
+from __future__ import annotations
+
+import json
+import socketserver
+import threading
+from typing import Optional
+
+from rbg_tpu.api import KINDS, constants as C, parse_manifest, serde
+from rbg_tpu.api.group import RoleBasedGroupSpec
+from rbg_tpu.api.meta import get_condition
+from rbg_tpu.engine.protocol import recv_msg, send_msg
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        store = self.server.plane.store
+        while True:
+            try:
+                obj, _, _ = recv_msg(self.request)
+            except (ConnectionError, json.JSONDecodeError):
+                return
+            if obj is None:
+                return
+            try:
+                send_msg(self.request, self._dispatch(store, obj))
+            except Exception as e:
+                send_msg(self.request, {"error": f"{type(e).__name__}: {e}"})
+
+    def _dispatch(self, store, obj: dict) -> dict:
+        op = obj.get("op")
+        ns = obj.get("namespace", "default")
+        if op == "health":
+            return {"ok": True}
+        if op == "list":
+            kind = obj["kind"]
+            if kind not in KINDS:
+                return {"error": f"unknown kind {kind}"}
+            items = store.list(kind, namespace=None if obj.get("all") else ns)
+            return {"items": [serde.to_dict(o) for o in items]}
+        if op == "get":
+            o = store.get(obj["kind"], ns, obj["name"])
+            return {"object": serde.to_dict(o)} if o else {"error": "not found"}
+        if op == "apply":
+            parsed = parse_manifest(obj["manifest"])
+            self.server.plane.apply(parsed)
+            return {"ok": True, "kind": parsed.kind, "name": parsed.metadata.name}
+        if op == "delete":
+            store.delete(obj["kind"], ns, obj["name"])
+            return {"ok": True}
+        if op == "status":
+            return self._status(store, ns, obj["name"])
+        if op == "history":
+            revs = self._revisions(store, ns, obj["name"])
+            return {"revisions": [
+                {"revision": r.revision, "name": r.metadata.name,
+                 "roleHashes": r.role_hashes} for r in revs
+            ]}
+        if op == "diff":
+            return self._diff(store, ns, obj["name"], obj.get("revision"))
+        if op == "undo":
+            return self._undo(store, ns, obj["name"], obj.get("revision"))
+        if op == "events":
+            o = store.get(obj["kind"], ns, obj["name"]) if obj.get("kind") else None
+            return {"events": [
+                {"time": t, "object": ref, "reason": reason, "message": msg}
+                for (t, ref, reason, msg) in store.events_for(o)
+            ][-50:]}
+        return {"error": f"unknown op {op!r}"}
+
+    # ---- group helpers ----
+
+    def _status(self, store, ns, name) -> dict:
+        g = store.get("RoleBasedGroup", ns, name)
+        if g is None:
+            return {"error": "not found"}
+        cond = get_condition(g.status.conditions, C.COND_READY)
+        nodes = {n.metadata.name: n for n in store.list("Node")}
+        pods = []
+        for p in store.list("Pod", namespace=ns,
+                            selector={C.LABEL_GROUP_NAME: name}):
+            node = nodes.get(p.node_name)
+            pods.append({
+                "name": p.metadata.name,
+                "role": p.metadata.labels.get(C.LABEL_ROLE_NAME, ""),
+                "phase": p.status.phase, "ready": p.status.ready,
+                "node": p.node_name,
+                "slice": node.tpu.slice_id if node else "",
+            })
+        return {
+            "name": name,
+            "ready": cond.status == "True" if cond else False,
+            "reason": cond.reason if cond else "",
+            "revision": g.status.current_revision,
+            "roles": [serde.to_dict(r) for r in g.status.roles],
+            "specReplicas": {r.name: r.replicas for r in g.spec.roles},
+            "pods": sorted(pods, key=lambda p: p["name"]),
+        }
+
+    def _revisions(self, store, ns, name):
+        g = store.get("RoleBasedGroup", ns, name)
+        if g is None:
+            return []
+        revs = store.list("ControllerRevision", namespace=ns,
+                          owner_uid=g.metadata.uid)
+        return sorted(revs, key=lambda r: r.revision)
+
+    def _pick_revision(self, store, ns, name, revision: Optional[int]):
+        revs = self._revisions(store, ns, name)
+        if not revs:
+            return None
+        if revision is None:
+            # default: previous revision (undo semantics)
+            return revs[-2] if len(revs) >= 2 else revs[-1]
+        for r in revs:
+            if r.revision == revision:
+                return r
+        return None
+
+    def _diff(self, store, ns, name, revision) -> dict:
+        g = store.get("RoleBasedGroup", ns, name)
+        rev = self._pick_revision(store, ns, name, revision)
+        if g is None or rev is None:
+            return {"error": "group or revision not found"}
+        import difflib
+        cur = json.dumps(serde.to_dict(g.spec), indent=1, sort_keys=True)
+        old = json.dumps(rev.data, indent=1, sort_keys=True)
+        diff = list(difflib.unified_diff(
+            old.splitlines(), cur.splitlines(),
+            fromfile=f"revision-{rev.revision}", tofile="current", lineterm=""))
+        return {"revision": rev.revision, "diff": diff}
+
+    def _undo(self, store, ns, name, revision) -> dict:
+        rev = self._pick_revision(store, ns, name, revision)
+        if rev is None:
+            return {"error": "revision not found"}
+
+        def fn(g):
+            g.spec = serde.from_dict(RoleBasedGroupSpec, rev.data)
+            return True
+
+        store.mutate("RoleBasedGroup", ns, name, fn)
+        return {"ok": True, "restoredRevision": rev.revision}
+
+
+class AdminServer:
+    def __init__(self, plane, port: int = 0):
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", port), _Handler)
+        self._server.allow_reuse_address = True
+        self._server.daemon_threads = True
+        self._server.plane = plane
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="admin")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()

@@ -42,6 +42,20 @@ def own_keys(obj) -> List[ReconcileKey]:
     return [(obj.metadata.namespace, obj.metadata.name)]
 
 
+def spec_change(ev: Event) -> bool:
+    """Predicate: skip pure-status MODIFIED events (reference: event
+    predicates, ``rolebasedgroup_controller.go:1501-1596``). A controller's
+    own status writes must not re-trigger its reconcile — that feedback churn
+    dominates reconcile latency at scale."""
+    if ev.type != Event.MODIFIED or ev.old is None:
+        return True
+    new_m, old_m = ev.object.metadata, ev.old.metadata
+    return (new_m.generation != old_m.generation
+            or new_m.labels != old_m.labels
+            or new_m.annotations != old_m.annotations
+            or new_m.deletion_timestamp != old_m.deletion_timestamp)
+
+
 def owner_keys(kind: str):
     """Map an owned object to its controller-owner's key (if owner kind matches)."""
 
@@ -106,23 +120,33 @@ class Controller:
             self._threads.append(t)
 
     def _worker(self):
+        import time as _time
+
+        from rbg_tpu.obs.metrics import REGISTRY
         while True:
             key = self.queue.get()
             if key is None:
                 return
+            t0 = _time.perf_counter()
             try:
                 res = self.reconcile(self.store, key)
                 self.backoff.forget(key)
+                REGISTRY.inc("rbg_reconcile_total", controller=self.name,
+                             result="success")
                 if res is not None and res.requeue_after is not None:
                     self.queue.add_after(key, res.requeue_after)
             except Exception:
                 delay = self.backoff.next_delay(key)
+                REGISTRY.inc("rbg_reconcile_total", controller=self.name,
+                             result="error")
                 log.debug(
                     "%s reconcile %s failed (retry in %.3fs):\n%s",
                     self.name, key, delay, traceback.format_exc(),
                 )
                 self.queue.add_after(key, delay)
             finally:
+                REGISTRY.observe("rbg_reconcile_duration_seconds",
+                                 _time.perf_counter() - t0, controller=self.name)
                 self.queue.done(key)
 
     def stop(self):

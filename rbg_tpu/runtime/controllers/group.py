@@ -49,8 +49,9 @@ class RoleBasedGroupController(Controller):
                 return [(obj.metadata.namespace, obj.spec.group_name)]
             return []
 
+        from rbg_tpu.runtime.controller import spec_change
         return [
-            Watch("RoleBasedGroup", own_keys),
+            Watch("RoleBasedGroup", own_keys, predicate=spec_change),
             Watch("RoleInstanceSet", owner_keys("RoleBasedGroup")),
             Watch("ScalingAdapter", adapter_keys),
             Watch("CoordinatedPolicy", policy_keys),
@@ -76,22 +77,30 @@ class RoleBasedGroupController(Controller):
             return None
 
         # 2. scaling-adapter replica overrides (autoscaler wins over spec drift;
-        #    reference: applyRBGSAReplicasOverride :846)
+        #    reference: applyRBGSAReplicasOverride :846) + KEP-29 auto-create
+        from rbg_tpu.runtime.controllers.scalingadapter import ensure_auto_adapters
+        ensure_auto_adapters(store, rbg)
         rbg = self._apply_scaling_overrides(store, rbg)
 
         # 3. revisions
         revision_name, role_hashes = self._ensure_revision(store, rbg)
 
-        # 4. coordination policy (maxSkew-clamped scaling targets; M6 engine)
-        role_targets = self._coordination_targets(store, rbg)
+        # 4. role statuses FIRST (fresh readiness gates both the dependency
+        #    walk and the coordination clamp)
+        rbg = self._update_role_statuses(store, rbg, role_hashes)
 
-        # 5. group-level gang PodGroup
+        # 5. coordination policy: maxSkew-clamped scaling targets, computed
+        #    from the status refreshed above
+        role_targets = self._coordination_targets(store, rbg)
+        clamped = any(
+            role_targets.get(r.name, r.replicas) < r.replicas
+            for r in rbg.spec.roles
+        )
+
+        # 6. group-level gang PodGroup
         gang = rbg.metadata.annotations.get(C.ANN_GANG_SCHEDULING) == "true"
         if gang:
             self._ensure_pod_group(store, rbg, role_targets)
-
-        # 6. role statuses FIRST (fresh readiness gates the dependency walk)
-        rbg = self._update_role_statuses(store, rbg, role_hashes)
 
         # 6b. topology discovery ConfigMap (reference step 5, :397)
         try:
@@ -120,8 +129,10 @@ class RoleBasedGroupController(Controller):
         # 8. orphan cleanup
         self._cleanup_orphans(store, rbg)
 
-        if blocked:
-            return Result(requeue_after=0.5)
+        if blocked or clamped:
+            # Dependencies or coordination gates still closing — poll; the
+            # RIS status watch usually beats this requeue.
+            return Result(requeue_after=0.2)
         return None
 
     # ---- revisions (reference: utils/revision_utils.go + KEP-31) ----

@@ -1,0 +1,85 @@
+"""RoleBasedGroupSet controller — replicated groups from a template.
+
+Reference analog: inventory #7 (``rolebasedgroupset_controller.go``): N
+identical RoleBasedGroups (``{set}-{index}``) with the groupset index labels,
+scale up/down (highest index first), status rollup. Canonical TPU use: one
+RBG per availability cell / superpod, scaled horizontally.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.group import RoleBasedGroup
+from rbg_tpu.api.meta import get_condition, owner_ref
+from rbg_tpu.runtime.controller import Controller, Result, Watch, own_keys, owner_keys
+from rbg_tpu.runtime.store import AlreadyExists, Store
+
+
+class RoleBasedGroupSetController(Controller):
+    name = "rolebasedgroupset"
+
+    def watches(self) -> List[Watch]:
+        return [
+            Watch("RoleBasedGroupSet", own_keys),
+            Watch("RoleBasedGroup", owner_keys("RoleBasedGroupSet")),
+        ]
+
+    def reconcile(self, store: Store, key) -> Optional[Result]:
+        ns, name = key
+        rbgs = store.get("RoleBasedGroupSet", ns, name)
+        if rbgs is None or rbgs.metadata.deletion_timestamp is not None:
+            return None
+
+        owned = {
+            g.metadata.name: g
+            for g in store.list("RoleBasedGroup", namespace=ns,
+                                owner_uid=rbgs.metadata.uid)
+            if g.metadata.deletion_timestamp is None
+        }
+        n = rbgs.spec.replicas
+
+        for i in range(n):
+            gname = f"{name}-{i}"
+            if gname not in owned:
+                self._create_group(store, rbgs, gname, i)
+        for gname, g in owned.items():
+            idx = g.metadata.labels.get(C.LABEL_GROUP_SET_INDEX, "")
+            if not idx.isdigit() or int(idx) >= n:
+                store.delete("RoleBasedGroup", ns, gname)
+
+        ready = 0
+        for g in owned.values():
+            c = get_condition(g.status.conditions, C.COND_READY)
+            if c is not None and c.status == "True":
+                ready += 1
+
+        def fn(s):
+            new = (len(owned), ready, s.metadata.generation)
+            cur = (s.status.replicas, s.status.ready_replicas,
+                   s.status.observed_generation)
+            if new == cur:
+                return False
+            (s.status.replicas, s.status.ready_replicas,
+             s.status.observed_generation) = new
+            return True
+
+        store.mutate("RoleBasedGroupSet", ns, name, fn, status=True)
+        return None
+
+    def _create_group(self, store, rbgs, gname: str, index: int):
+        g = RoleBasedGroup()
+        g.metadata.name = gname
+        g.metadata.namespace = rbgs.metadata.namespace
+        g.metadata.labels = dict(rbgs.spec.template.metadata.labels)
+        g.metadata.labels[C.LABEL_GROUP_SET_NAME] = rbgs.metadata.name
+        g.metadata.labels[C.LABEL_GROUP_SET_INDEX] = str(index)
+        g.metadata.annotations = dict(rbgs.spec.template.metadata.annotations)
+        g.metadata.owner_references = [owner_ref(rbgs)]
+        g.spec = copy.deepcopy(rbgs.spec.template.spec)
+        try:
+            store.create(g)
+        except AlreadyExists:
+            pass

@@ -69,8 +69,9 @@ class RoleInstanceController(Controller):
         self.ports = ports
 
     def watches(self) -> List[Watch]:
+        from rbg_tpu.runtime.controller import spec_change
         return [
-            Watch("RoleInstance", own_keys),
+            Watch("RoleInstance", own_keys, predicate=spec_change),
             Watch("Pod", owner_keys("RoleInstance")),
         ]
 

@@ -58,8 +58,9 @@ class RoleInstanceSetController(Controller):
         self.ports = ports
 
     def watches(self) -> List[Watch]:
+        from rbg_tpu.runtime.controller import spec_change
         return [
-            Watch("RoleInstanceSet", own_keys),
+            Watch("RoleInstanceSet", own_keys, predicate=spec_change),
             Watch("RoleInstance", owner_keys("RoleInstanceSet")),
         ]
 

@@ -1,0 +1,135 @@
+"""Warmup controller — per-node preparation jobs.
+
+Reference analog: inventory #9 (``rolebasedgroupwarmup_controller.go``):
+run a pod per target node (explicit list, or the nodes a group's pods
+occupy), bounded parallelism, per-node retries up to backoff_limit, overall
+timeout, TTL cleanup. Canonical TPU uses: XLA compile-cache priming and
+model-weight prefetch onto a slice's hosts before the serving group lands.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.meta import owner_ref
+from rbg_tpu.api.pod import Pod
+from rbg_tpu.runtime.controller import Controller, Result, Watch, own_keys, owner_keys
+from rbg_tpu.runtime.store import AlreadyExists, Store
+
+ANN_RUN_TO_COMPLETION = f"{C.DOMAIN}/run-to-completion"
+LABEL_WARMUP_NAME = f"{C.DOMAIN}/warmup-name"
+LABEL_WARMUP_NODE = f"{C.DOMAIN}/warmup-node"
+
+
+class WarmupController(Controller):
+    name = "warmup"
+
+    def watches(self) -> List[Watch]:
+        return [
+            Watch("Warmup", own_keys),
+            Watch("Pod", owner_keys("Warmup")),
+        ]
+
+    def reconcile(self, store: Store, key) -> Optional[Result]:
+        ns, name = key
+        w = store.get("Warmup", ns, name)
+        if w is None or w.metadata.deletion_timestamp is not None:
+            return None
+        if w.status.phase in ("Succeeded", "Failed"):
+            return self._handle_ttl(store, w)
+
+        nodes = self._target_nodes(store, w)
+        pods = store.list("Pod", namespace=ns, owner_uid=w.metadata.uid)
+        by_node: dict = {}
+        for p in pods:
+            by_node.setdefault(p.metadata.labels.get(LABEL_WARMUP_NODE), []).append(p)
+
+        succeeded, failed_nodes, active = 0, 0, 0
+        for node in nodes:
+            node_pods = by_node.get(node, [])
+            if any(p.status.phase == "Succeeded" for p in node_pods):
+                succeeded += 1
+            elif sum(1 for p in node_pods if p.status.phase == "Failed") > w.spec.backoff_limit:
+                failed_nodes += 1
+            elif any(p.active for p in node_pods):
+                active += 1
+
+        # Launch more, bounded by parallelism.
+        for node in nodes:
+            if active >= w.spec.parallelism:
+                break
+            node_pods = by_node.get(node, [])
+            if any(p.status.phase == "Succeeded" or p.active for p in node_pods):
+                continue
+            failures = sum(1 for p in node_pods if p.status.phase == "Failed")
+            if failures > w.spec.backoff_limit:
+                continue
+            self._create_pod(store, w, node, attempt=failures)
+            active += 1
+
+        timed_out = (w.spec.timeout_seconds > 0
+                     and time.time() - w.metadata.creation_timestamp > w.spec.timeout_seconds)
+        phase = "Running"
+        if succeeded == len(nodes) and nodes:
+            phase = "Succeeded"
+        elif failed_nodes > w.spec.max_failed_nodes or timed_out:
+            phase = "Failed"
+
+        def fn(obj):
+            new = (phase, len(nodes), succeeded, failed_nodes)
+            cur = (obj.status.phase, obj.status.desired_nodes,
+                   obj.status.succeeded_nodes, obj.status.failed_nodes)
+            if new == cur:
+                return False
+            (obj.status.phase, obj.status.desired_nodes,
+             obj.status.succeeded_nodes, obj.status.failed_nodes) = new
+            if phase in ("Succeeded", "Failed") and not obj.status.completion_time:
+                obj.status.completion_time = time.time()
+            return True
+
+        store.mutate("Warmup", ns, name, fn, status=True)
+        if phase == "Running":
+            return Result(requeue_after=0.5)
+        return Result(requeue_after=w.spec.ttl_seconds_after_finished or None)
+
+    def _target_nodes(self, store, w) -> List[str]:
+        t = w.spec.target
+        if t.nodes:
+            return list(t.nodes)
+        if t.group_name:
+            nodes = {
+                p.node_name
+                for p in store.list("Pod", namespace=w.metadata.namespace,
+                                    selector={C.LABEL_GROUP_NAME: t.group_name})
+                if p.node_name
+            }
+            return sorted(nodes)
+        return []
+
+    def _create_pod(self, store, w, node: str, attempt: int):
+        import copy
+        pod = Pod()
+        pod.metadata.name = f"{w.metadata.name}-{node}-{attempt}"[:C.MAX_NAME_LEN]
+        pod.metadata.namespace = w.metadata.namespace
+        pod.metadata.labels = {LABEL_WARMUP_NAME: w.metadata.name,
+                               LABEL_WARMUP_NODE: node}
+        pod.metadata.annotations = {ANN_RUN_TO_COMPLETION: "true"}
+        pod.metadata.owner_references = [owner_ref(w)]
+        pod.template = copy.deepcopy(w.spec.template)
+        pod.node_name = node  # warmup pods bind directly to their target
+        try:
+            store.create(pod)
+        except AlreadyExists:
+            pass
+
+    def _handle_ttl(self, store, w) -> Optional[Result]:
+        ttl = w.spec.ttl_seconds_after_finished
+        if ttl <= 0 or not w.status.completion_time:
+            return None
+        remaining = w.status.completion_time + ttl - time.time()
+        if remaining <= 0:
+            store.delete("Warmup", w.metadata.namespace, w.metadata.name)
+            return None
+        return Result(requeue_after=remaining)

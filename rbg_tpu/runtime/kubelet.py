@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from rbg_tpu.api.constants import DOMAIN as _DOMAIN
 from rbg_tpu.runtime.store import Event, Store
 
 
@@ -89,11 +90,16 @@ class FakeKubelet:
             if pod.node_name:
                 node = self.store.get("Node", "default", pod.node_name)
 
+            run_to_completion = (
+                pod.metadata.annotations.get(f"{_DOMAIN}/run-to-completion") == "true"
+            )
+
             def fn(p):
                 if p.status.phase != "Pending":
                     return False
-                p.status.phase = "Running"
-                p.status.ready = True
+                # Job-style pods (warmup) complete immediately in the fake.
+                p.status.phase = "Succeeded" if run_to_completion else "Running"
+                p.status.ready = not run_to_completion
                 p.status.node_name = p.node_name
                 p.status.pod_ip = node.address if node else "127.0.0.1"
                 p.status.start_time = time.time()

@@ -22,7 +22,7 @@ from rbg_tpu.sched.scheduler import SchedulerController
 
 class ControlPlane:
     def __init__(self, store: Optional[Store] = None, backend: str = "fake",
-                 ready_delay: float = 0.0):
+                 ready_delay: float = 0.0, executor_env: Optional[dict] = None):
         self.store = store or Store()
         self.manager = Manager(self.store)
         self.node_binding = NodeBindingStore(self.store)
@@ -48,7 +48,7 @@ class ControlPlane:
             self.kubelet = FakeKubelet(self.store, ready_delay=ready_delay)
         elif backend == "local":
             from rbg_tpu.runtime.executor import LocalExecutor
-            self.kubelet = LocalExecutor(self.store)
+            self.kubelet = LocalExecutor(self.store, extra_env=executor_env)
 
     def _register_optional(self):
         """Controllers gated on availability (reference: CheckCrdExists gating,

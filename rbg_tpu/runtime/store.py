@@ -45,9 +45,10 @@ class Event:
     MODIFIED = "MODIFIED"
     DELETED = "DELETED"
 
-    def __init__(self, type_: str, obj):
+    def __init__(self, type_: str, obj, old=None):
         self.type = type_
         self.object = obj
+        self.old = old  # previous object on MODIFIED (predicate support)
 
     def __repr__(self):
         m = self.object.metadata
@@ -79,7 +80,7 @@ class Store:
             subs = list(self._watchers.get(ev.object.kind, ())) + list(self._watchers.get("*", ()))
         for fn in subs:
             try:
-                fn(Event(ev.type, copy.deepcopy(ev.object)))
+                fn(Event(ev.type, copy.deepcopy(ev.object), ev.old))
             except Exception:  # watcher bugs must not poison the store
                 import traceback
                 traceback.print_exc()
@@ -110,10 +111,16 @@ class Store:
         self._notify(Event(Event.ADDED, obj))
         return copy.deepcopy(obj)
 
-    def get(self, kind: str, namespace: str, name: str):
+    def get(self, kind: str, namespace: str, name: str, copy_: bool = True):
+        """``copy_=False`` returns the live object WITHOUT copying — strictly
+        read-only use (reference analog: the no-deepcopy cache lister,
+        ``pkg/utils/client/no_deepcopy_lister.go``, added for exactly this
+        hot-path cost). Mutating a no-copy result corrupts the store."""
         with self._lock:
             obj = self._objects.get((kind, namespace, name))
-            return copy.deepcopy(obj) if obj is not None else None
+            if obj is None:
+                return None
+            return copy.deepcopy(obj) if copy_ else obj
 
     def must_get(self, kind: str, namespace: str, name: str):
         obj = self.get(kind, namespace, name)
@@ -127,7 +134,10 @@ class Store:
         namespace: Optional[str] = None,
         selector: Optional[Dict[str, str]] = None,
         owner_uid: Optional[str] = None,
+        copy_: bool = True,
     ) -> list:
+        """``copy_=False``: no-deepcopy list for read-only hot paths (see
+        ``get``)."""
         with self._lock:
             if owner_uid is not None:
                 keys = [k for k in self._owner_index.get(owner_uid, ()) if k[0] == kind]
@@ -142,7 +152,7 @@ class Store:
                     labels = o.metadata.labels
                     if any(labels.get(k) != v for k, v in selector.items()):
                         continue
-                out.append(copy.deepcopy(o))
+                out.append(copy.deepcopy(o) if copy_ else o)
             out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
             return out
 
@@ -181,7 +191,7 @@ class Store:
             self._objects[k] = obj
             for ref in obj.metadata.owner_references:
                 self._owner_index[ref.uid].add(k)
-        self._notify(Event(Event.MODIFIED, obj))
+        self._notify(Event(Event.MODIFIED, obj, old=cur))
         return copy.deepcopy(obj)
 
     def update_status(self, obj):
@@ -197,7 +207,7 @@ class Store:
             new.status = copy.deepcopy(obj.status)
             new.metadata.resource_version = self._next_rv()
             self._objects[k] = new
-        self._notify(Event(Event.MODIFIED, new))
+        self._notify(Event(Event.MODIFIED, new, old=cur))
         return copy.deepcopy(new)
 
     def mutate(self, kind: str, namespace: str, name: str, fn, status: bool = False,
@@ -228,11 +238,12 @@ class Store:
             if cur is None:
                 return None
             if grace and cur.metadata.deletion_timestamp is None:
+                orig = cur
                 cur = copy.deepcopy(cur)
                 cur.metadata.deletion_timestamp = time.time()
                 cur.metadata.resource_version = self._next_rv()
                 self._objects[k] = cur
-                ev = Event(Event.MODIFIED, cur)
+                ev = Event(Event.MODIFIED, cur, old=orig)
             else:
                 del self._objects[k]
                 for keys in self._owner_index.values():

@@ -119,10 +119,10 @@ class SchedulerController(Controller):
 
     def _place(self, store: Store, pods: List) -> Optional[Dict[Tuple[str, str], str]]:
         """Compute {(ns, pod): node} for all pods or None (all-or-nothing)."""
-        nodes = [n for n in store.list("Node") if n.ready]
+        nodes = [n for n in store.list("Node", copy_=False) if n.ready]
         if not nodes:
             return None
-        bound = [p for p in store.list("Pod") if p.node_name and p.active]
+        bound = [p for p in store.list("Pod", copy_=False) if p.node_name and p.active]
         used = collections.Counter(p.node_name for p in bound)
         free = {n.metadata.name: n.capacity_pods - used[n.metadata.name] for n in nodes}
         # TPU hosts are chip-exclusive: one slice pod per host.
@@ -165,7 +165,8 @@ class SchedulerController(Controller):
         node_by = {n.metadata.name: n for n in nodes}
         siblings = [
             p for p in store.list("Pod", namespace=ns,
-                                  selector={C.LABEL_INSTANCE_NAME: inst})
+                                  selector={C.LABEL_INSTANCE_NAME: inst},
+                                  copy_=False)
             if p.node_name and p.active
         ]
         taken = {p.node_name for p in siblings}
@@ -263,7 +264,7 @@ class SchedulerController(Controller):
         """Map (topology key, domain) -> group owning it (from bound pods)."""
         node_by_name = {n.metadata.name: n for n in nodes}
         out: Dict[Tuple[str, str], str] = {}
-        for p in store.list("Pod"):
+        for p in store.list("Pod", copy_=False):
             if not p.node_name or not p.active:
                 continue
             key = p.metadata.annotations.get(C.ANN_EXCLUSIVE_TOPOLOGY)

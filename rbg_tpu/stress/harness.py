@@ -1,0 +1,170 @@
+"""Stress/scale harness: control-plane latency percentiles under churn.
+
+Reference analog: ``test/stress`` (inventory #28, SURVEY.md §4.4/§6 — the
+reference's ONLY performance apparatus): create N groups at a configured
+QPS against a kwok-style fake fleet, measure per-phase create→Ready /
+update→Converged / delete→Gone latencies as P50/P90/P99, and capture
+controller metrics. BASELINE.md maps "role-placement latency" onto exactly
+these percentiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, List, Optional
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.meta import get_condition
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import make_group, make_tpu_nodes, simple_role
+
+
+@dataclasses.dataclass
+class StressConfig:
+    groups: int = 10
+    roles_per_group: int = 2
+    replicas: int = 2
+    create_qps: float = 5.0
+    update: bool = True
+    delete: bool = True
+    slices: int = 64
+    hosts_per_slice: int = 4
+    timeout_per_group: float = 30.0
+
+
+def _pcts(samples: List[float]) -> Dict[str, float]:
+    if not samples:
+        return {"p50": 0.0, "p90": 0.0, "p99": 0.0, "n": 0}
+    s = sorted(samples)
+
+    def pct(q):
+        i = min(len(s) - 1, int(q * len(s)))
+        return round(s[i] * 1000, 2)  # ms
+
+    return {"p50": pct(0.50), "p90": pct(0.90), "p99": pct(0.99),
+            "n": len(s), "max": round(s[-1] * 1000, 2)}
+
+
+def run_stress(cfg: StressConfig, plane: Optional[ControlPlane] = None) -> dict:
+    own_plane = plane is None
+    if own_plane:
+        plane = ControlPlane(backend="fake")
+        make_tpu_nodes(plane.store, slices=cfg.slices,
+                       hosts_per_slice=cfg.hosts_per_slice)
+        plane.start()
+    REGISTRY.reset()
+    try:
+        return _run(cfg, plane)
+    finally:
+        if own_plane:
+            plane.stop()
+
+
+def _run(cfg: StressConfig, plane: ControlPlane) -> dict:
+    interval = 1.0 / cfg.create_qps if cfg.create_qps > 0 else 0.0
+    names = [f"stress-{i}" for i in range(cfg.groups)]
+
+    def ready(name) -> bool:
+        g = plane.store.get("RoleBasedGroup", "default", name)
+        if g is None:
+            return False
+        c = get_condition(g.status.conditions, C.COND_READY)
+        return c is not None and c.status == "True"
+
+    # --- create phase ---
+    create_lat: List[float] = []
+    t_created: Dict[str, float] = {}
+    for i, name in enumerate(names):
+        roles = [simple_role(f"role{j}", replicas=cfg.replicas)
+                 for j in range(cfg.roles_per_group)]
+        for j in range(1, len(roles)):
+            roles[j].dependencies = [roles[0].name]
+        plane.apply(make_group(name, *roles))
+        t_created[name] = time.perf_counter()
+        if interval:
+            time.sleep(interval)
+    for name in names:
+        plane.wait_for(lambda n=name: ready(n), timeout=cfg.timeout_per_group,
+                       desc=f"{name} ready")
+        create_lat.append(time.perf_counter() - t_created[name])
+
+    # --- update phase (image-only → exercises the in-place engine) ---
+    update_lat: List[float] = []
+    if cfg.update:
+        for name in names:
+            g = plane.store.get("RoleBasedGroup", "default", name)
+            for r in g.spec.roles:
+                r.template.containers[0].image = "engine:v2"
+            plane.store.update(g)
+            t0 = time.perf_counter()
+
+            def converged(n=name):
+                pods = plane.store.list(
+                    "Pod", namespace="default",
+                    selector={C.LABEL_GROUP_NAME: n})
+                return pods and all(
+                    p.template.containers[0].image == "engine:v2" and p.running_ready
+                    for p in pods if p.active
+                ) and ready(n)
+
+            plane.wait_for(converged, timeout=cfg.timeout_per_group,
+                           desc=f"{name} updated")
+            update_lat.append(time.perf_counter() - t0)
+
+    # --- delete phase ---
+    delete_lat: List[float] = []
+    if cfg.delete:
+        for name in names:
+            plane.store.delete("RoleBasedGroup", "default", name)
+            t0 = time.perf_counter()
+
+            def gone(n=name):
+                return not plane.store.list(
+                    "Pod", namespace="default", selector={C.LABEL_GROUP_NAME: n})
+
+            plane.wait_for(gone, timeout=cfg.timeout_per_group,
+                           desc=f"{name} deleted")
+            delete_lat.append(time.perf_counter() - t0)
+
+    report = {
+        "config": dataclasses.asdict(cfg),
+        "create_to_ready_ms": _pcts(create_lat),
+        "update_to_converged_ms": _pcts(update_lat),
+        "delete_to_gone_ms": _pcts(delete_lat),
+        "reconcile_p99_s": {
+            c: REGISTRY.quantile("rbg_reconcile_duration_seconds", 0.99, controller=c)
+            for c in ("rolebasedgroup", "roleinstanceset", "roleinstance", "scheduler")
+        },
+    }
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="rbg-tpu-stress")
+    ap.add_argument("--groups", type=int, default=10)
+    ap.add_argument("--roles", type=int, default=2)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--qps", type=float, default=5.0)
+    ap.add_argument("--slices", type=int, default=64)
+    ap.add_argument("--hosts", type=int, default=4)
+    ap.add_argument("--json", action="store_true", help="machine output only")
+    args = ap.parse_args(argv)
+    cfg = StressConfig(groups=args.groups, roles_per_group=args.roles,
+                       replicas=args.replicas, create_qps=args.qps,
+                       slices=args.slices, hosts_per_slice=args.hosts)
+    report = run_stress(cfg)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Admin API + rollout history/diff/undo over a live plane."""
+
+import pytest
+
+from rbg_tpu.engine.protocol import request_once
+from rbg_tpu.runtime.admin import AdminServer
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import make_group, make_tpu_nodes, simple_role
+
+
+@pytest.fixture()
+def served_plane():
+    p = ControlPlane(backend="fake")
+    make_tpu_nodes(p.store, slices=1, hosts_per_slice=2)
+    p.start()
+    admin = AdminServer(p, port=0).start()
+    yield p, f"127.0.0.1:{admin.port}"
+    admin.stop()
+    p.stop()
+
+
+def call(addr, obj):
+    resp, _, _ = request_once(addr, obj)
+    assert resp is not None and "error" not in resp, resp
+    return resp
+
+
+def test_apply_status_get(served_plane):
+    plane, addr = served_plane
+    from rbg_tpu.api import serde
+    g = make_group("demo", simple_role("server", replicas=2))
+    call(addr, {"op": "apply", "manifest": serde.to_dict(g)})
+    plane.wait_group_ready("demo")
+
+    st = call(addr, {"op": "status", "name": "demo"})
+    assert st["ready"] is True
+    assert len(st["pods"]) == 2
+    items = call(addr, {"op": "list", "kind": "RoleInstanceSet"})["items"]
+    assert len(items) == 1
+
+
+def test_rollout_history_diff_undo(served_plane):
+    plane, addr = served_plane
+    plane.apply(make_group("r", simple_role("server", replicas=1, image="engine:v1")))
+    plane.wait_group_ready("r")
+
+    g = plane.store.get("RoleBasedGroup", "default", "r")
+    g.spec.roles[0].template.containers[0].image = "engine:v2"
+    plane.store.update(g)
+
+    def two_revisions():
+        return len(call(addr, {"op": "history", "name": "r"})["revisions"]) == 2
+
+    plane.wait_for(two_revisions, desc="second revision recorded")
+    hist = call(addr, {"op": "history", "name": "r"})["revisions"]
+    assert [h["revision"] for h in hist] == [1, 2]
+
+    diff = call(addr, {"op": "diff", "name": "r"})
+    joined = "\n".join(diff["diff"])
+    assert "engine:v1" in joined and "engine:v2" in joined
+
+    # undo → image back to v1 on live pods
+    undo = call(addr, {"op": "undo", "name": "r"})
+    assert undo["restoredRevision"] == 1
+
+    def rolled_back():
+        pods = [p for p in plane.store.list("Pod", namespace="default") if p.active]
+        return pods and all(
+            p.template.containers[0].image == "engine:v1" for p in pods
+        ) and all(p.running_ready for p in pods)
+
+    plane.wait_for(rolled_back, timeout=15, desc="undo restored v1 image")
+
+
+def test_events_and_delete(served_plane):
+    plane, addr = served_plane
+    plane.apply(make_group("ev", simple_role("s")))
+    plane.wait_group_ready("ev")
+    call(addr, {"op": "delete", "kind": "RoleBasedGroup", "name": "ev"})
+    plane.wait_for(
+        lambda: not plane.store.list("Pod", namespace="default"),
+        desc="cascade delete via admin",
+    )

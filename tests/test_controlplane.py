@@ -190,7 +190,11 @@ def test_restart_policy_recreates_gang_with_backoff(plane):
 
 
 def test_rolling_update_recreates_descending(plane):
+    from rbg_tpu.api.group import RollingUpdate
     role = simple_role("server", replicas=3)
+    # Force the recreate path (the in-place engine would otherwise absorb an
+    # image-only change without recreation — covered in test_coordination).
+    role.rolling_update = RollingUpdate(max_unavailable=1, in_place_if_possible=False)
     plane.apply(make_group("u", role))
     plane.wait_group_ready("u")
     old_uids = {p.metadata.labels[C.LABEL_INSTANCE_NAME]: p.metadata.uid

@@ -1,0 +1,180 @@
+"""Coordination + rollout machinery: maxSkew scaling, in-place update,
+scaling adapter, groupset, warmup."""
+
+import pytest
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.group import (
+    RoleBasedGroupSet, RoleStatus, ScalingAdapterHook,
+)
+from rbg_tpu.api.meta import get_condition
+from rbg_tpu.api.policy import (
+    CoordinatedPolicy, CoordinatedPolicySpec, CoordinatedScaling,
+    ScalingAdapter, ScalingAdapterSpec, Warmup,
+)
+from rbg_tpu.coordination.scaling import clamp_targets
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import make_group, make_tpu_nodes, simple_role
+
+
+@pytest.fixture()
+def plane():
+    p = ControlPlane(backend="fake")
+    make_tpu_nodes(p.store, slices=2, hosts_per_slice=2)
+    with p:
+        yield p
+
+
+# ---- pure math ----
+
+def test_clamp_targets_bounds_skew():
+    g = make_group("x", simple_role("prefill", replicas=10),
+                   simple_role("decode", replicas=10))
+    g.status.roles = [RoleStatus(name="prefill", replicas=0, ready_replicas=0),
+                      RoleStatus(name="decode", replicas=0, ready_replicas=0)]
+    pol = CoordinatedScaling(roles=["prefill", "decode"], max_skew_percent=20)
+    out = clamp_targets(g, pol, {"prefill": 10, "decode": 10})
+    # nothing ready yet: each role may only open 20% + the slowest +1 rule
+    assert out["prefill"] <= 2 or out["prefill"] == 1
+    assert out == {"prefill": max(out["prefill"], 1), "decode": max(out["decode"], 1)}
+
+    # prefill half-ready, decode nothing → decode is slowest, prefill capped
+    g.status.roles[0].ready_replicas = 5
+    out = clamp_targets(g, pol, {"prefill": 10, "decode": 10})
+    assert out["decode"] >= 1           # slowest gets its +1
+    assert out["prefill"] <= 5 + 2      # can't run ahead more than skew+ready
+
+    # both fully ready → full targets
+    g.status.roles[0].ready_replicas = 10
+    g.status.roles[1].ready_replicas = 10
+    out = clamp_targets(g, pol, {"prefill": 10, "decode": 10})
+    assert out == {"prefill": 10, "decode": 10}
+
+
+def test_coordinated_scaling_end_to_end(plane):
+    plane.apply(make_group(
+        "pd", simple_role("prefill", replicas=4), simple_role("decode", replicas=4)))
+    pol = CoordinatedPolicy()
+    pol.metadata.name = "pd-policy"
+    pol.spec = CoordinatedPolicySpec(
+        group_name="pd",
+        scaling=CoordinatedScaling(roles=["prefill", "decode"], max_skew_percent=25),
+    )
+    plane.apply(pol)
+    g = plane.wait_group_ready("pd", timeout=20)
+    assert g.status.role("prefill").ready_replicas == 4
+    assert g.status.role("decode").ready_replicas == 4
+
+
+# ---- in-place update ----
+
+def test_inplace_update_preserves_pods(plane):
+    role = simple_role("server", replicas=2)
+    plane.apply(make_group("ip", role))
+    plane.wait_group_ready("ip")
+    uids0 = {p.metadata.name: p.metadata.uid
+             for p in plane.store.list("Pod", namespace="default")}
+
+    g = plane.store.get("RoleBasedGroup", "default", "ip")
+    g.spec.roles[0].template.containers[0].image = "engine:v2"
+    plane.store.update(g)
+
+    def updated_in_place():
+        pods = [p for p in plane.store.list("Pod", namespace="default") if p.active]
+        return (len(pods) == 2
+                and all(p.template.containers[0].image == "engine:v2" for p in pods)
+                and {p.metadata.name: p.metadata.uid for p in pods} == uids0)
+
+    plane.wait_for(updated_in_place, timeout=15,
+                   desc="image-only rollout patched pods in place")
+
+
+def test_structural_change_recreates(plane):
+    role = simple_role("server", replicas=1)
+    plane.apply(make_group("rc", role))
+    plane.wait_group_ready("rc")
+    uid0 = plane.store.list("Pod", namespace="default")[0].metadata.uid
+
+    g = plane.store.get("RoleBasedGroup", "default", "rc")
+    g.spec.roles[0].template.containers[0].args = ["--new-flag"]  # not image-only
+    plane.store.update(g)
+
+    def recreated():
+        pods = [p for p in plane.store.list("Pod", namespace="default") if p.active]
+        return (pods and pods[0].metadata.uid != uid0
+                and pods[0].template.containers[0].args == ["--new-flag"]
+                and pods[0].running_ready)
+
+    plane.wait_for(recreated, timeout=15, desc="structural change recreated pod")
+
+
+# ---- scaling adapter ----
+
+def test_scaling_adapter_drives_replicas(plane):
+    role = simple_role("server", replicas=1)
+    role.scaling_adapter = ScalingAdapterHook(enabled=True, min_replicas=1,
+                                              max_replicas=3)
+    plane.apply(make_group("sa", role))
+    plane.wait_group_ready("sa")
+
+    def adapter_bound():
+        a = plane.store.get("ScalingAdapter", "default", "sa-server-scaling-adapter")
+        return a if (a is not None and a.status.phase == "Bound") else None
+
+    adapter = plane.wait_for(adapter_bound, desc="auto-created adapter bound")
+
+    # External autoscaler writes replicas (the scale subresource analog).
+    adapter = plane.store.get("ScalingAdapter", "default", adapter.metadata.name)
+    adapter.spec.replicas = 5  # above max → clamped to 3
+    plane.store.update(adapter)
+    plane.wait_for(
+        lambda: len([p for p in plane.store.list("Pod", namespace="default")
+                     if p.active]) == 3,
+        timeout=15, desc="adapter-driven scale to clamped max",
+    )
+
+
+# ---- groupset ----
+
+def test_groupset_scales_groups(plane):
+    gs = RoleBasedGroupSet()
+    gs.metadata.name = "cells"
+    gs.spec.replicas = 2
+    gs.spec.template.spec.roles = [simple_role("server", replicas=1)]
+    plane.apply(gs)
+
+    def both_ready():
+        s = plane.store.get("RoleBasedGroupSet", "default", "cells")
+        return s.status.ready_replicas == 2
+
+    plane.wait_for(both_ready, timeout=20, desc="groupset 2 groups ready")
+    names = {g.metadata.name for g in plane.store.list("RoleBasedGroup", namespace="default")}
+    assert names == {"cells-0", "cells-1"}
+
+    gs = plane.store.get("RoleBasedGroupSet", "default", "cells")
+    gs.spec.replicas = 1
+    plane.store.update(gs)
+    plane.wait_for(
+        lambda: len(plane.store.list("RoleBasedGroup", namespace="default")) == 1,
+        desc="groupset scale down",
+    )
+
+
+# ---- warmup ----
+
+def test_warmup_runs_on_group_nodes(plane):
+    plane.apply(make_group("svc", simple_role("server", replicas=2)))
+    plane.wait_group_ready("svc")
+
+    w = Warmup()
+    w.metadata.name = "prime"
+    w.spec.target.group_name = "svc"
+    w.spec.template.containers = []
+    plane.apply(w)
+
+    def done():
+        cur = plane.store.get("Warmup", "default", "prime")
+        return cur if cur and cur.status.phase == "Succeeded" else None
+
+    done_w = plane.wait_for(done, timeout=15, desc="warmup succeeded")
+    assert done_w.status.succeeded_nodes == done_w.status.desired_nodes > 0

@@ -23,6 +23,7 @@ LABEL_INSTANCE_INDEX = f"{DOMAIN}/role-instance-index"
 LABEL_COMPONENT_NAME = f"{DOMAIN}/component-name"
 LABEL_COMPONENT_ID = f"{DOMAIN}/component-id"
 LABEL_COMPONENT_INDEX = f"{DOMAIN}/component-index"
+LABEL_SLICE_ORDINAL = f"{DOMAIN}/slice-ordinal"   # sub-gang id in multi-slice roles
 LABEL_GROUP_REVISION = f"{DOMAIN}/group-revision"
 LABEL_ROLE_REVISION_PREFIX = f"{DOMAIN}/role-revision-"
 LABEL_REVISION_NAME = f"{DOMAIN}/revision-name"

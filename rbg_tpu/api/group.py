@@ -59,6 +59,10 @@ class TpuSpec:
     accelerator: str = ""       # v5e | v5p | v6e ...
     slice_topology: str = ""    # e.g. "2x4" (chips); hosts derived per accel
     chips_per_host: int = 4
+    # Multi-slice (MEGASCALE) role: one instance spans num_slices slices —
+    # ICI within each slice, DCN across them. The plane places one sub-gang
+    # per slice and injects the MEGASCALE_* env contract.
+    num_slices: int = 1
 
     @property
     def total_chips(self) -> int:
@@ -75,6 +79,18 @@ class TpuSpec:
         if chips == 0:
             return 0
         return max(1, chips // max(1, self.chips_per_host))
+
+
+def per_slice_size(leader_worker, tpu) -> int:
+    """Pods per slice sub-gang of a leaderWorker role: explicit
+    ``leader_worker.size`` wins, else derived from the slice topology.
+    The ONE definition shared by gang sizing, pod naming, slice-ordinal
+    labeling and MEGASCALE env math — these must never diverge."""
+    if leader_worker is not None and leader_worker.size:
+        return leader_worker.size
+    if tpu is not None and tpu.num_hosts:
+        return tpu.num_hosts
+    return 1
 
 
 class RestartPolicy(str, enum.Enum):
@@ -148,11 +164,8 @@ class RoleSpec:
     def gang_size(self) -> int:
         """Pods per role instance."""
         if self.pattern == PatternType.LEADER_WORKER:
-            if self.leader_worker and self.leader_worker.size:
-                return self.leader_worker.size
-            if self.tpu:
-                return max(1, self.tpu.num_hosts)
-            return 1
+            return per_slice_size(self.leader_worker, self.tpu) * (
+                max(1, self.tpu.num_slices) if self.tpu else 1)
         if self.pattern == PatternType.CUSTOM_COMPONENTS:
             return sum(c.size for c in self.components) or 1
         return 1

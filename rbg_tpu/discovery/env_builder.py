@@ -65,4 +65,15 @@ def build_env(inst, pod_name: str, component: str, process_id: int,
             EnvVar(C.ENV_TPU_SLICE_TOPOLOGY, it.tpu.slice_topology),
             EnvVar(C.ENV_TPU_ACCELERATOR, it.tpu.accelerator),
         ]
+        if it.tpu.num_slices > 1:
+            # Multi-slice: JAX/libtpu's MEGASCALE contract — one coordinator
+            # for the whole job, slice id from the sub-gang ordinal.
+            from rbg_tpu.api.group import per_slice_size
+            per = per_slice_size(it.leader_worker, it.tpu)
+            env += [
+                EnvVar(C.ENV_MEGASCALE_COORDINATOR,
+                       leader_address(inst, port=JAX_COORDINATOR_PORT + 1)),
+                EnvVar(C.ENV_MEGASCALE_NUM_SLICES, str(it.tpu.num_slices)),
+                EnvVar(C.ENV_MEGASCALE_SLICE_ID, str(process_id // per)),
+            ]
     return env

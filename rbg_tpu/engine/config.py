@@ -21,6 +21,7 @@ class EngineConfig:
     use_pallas: str = "auto"                # auto | always | never
     mode: str = "unified"                   # unified | prefill | decode
     mesh_spec: Optional[dict] = None        # {"dp": 1, "tp": 4} — from discovery
+    checkpoint_path: str = ""               # orbax dir or local HF dir
     seed: int = 0
 
     @property

@@ -80,7 +80,13 @@ class Engine:
         self.mcfg = cfg.model_config
         self.mesh = mesh
         key = jax.random.key(cfg.seed)
-        self.params = params if params is not None else init_params(self.mcfg, key)
+        if params is not None:
+            self.params = params
+        elif cfg.checkpoint_path:
+            from rbg_tpu.models.checkpoint import load_params
+            self.params = load_params(cfg.checkpoint_path, self.mcfg)
+        else:
+            self.params = init_params(self.mcfg, key)
         self._sample_key = jax.random.key(cfg.seed + 1)
 
         self.cache = PagedKVCache.create(self.mcfg, cfg.num_pages, cfg.page_size)
@@ -101,7 +107,8 @@ class Engine:
     def _shard_state(self, mesh):
         from jax.sharding import NamedSharding, PartitionSpec as P
         from rbg_tpu.parallel.sharding import param_specs, shard_pytree
-        self.params = shard_pytree(self.params, param_specs(self.mcfg), mesh)
+        self.params = shard_pytree(
+            self.params, param_specs(self.mcfg, self.params), mesh)
         page_spec = NamedSharding(mesh, P(None, None, None, "tp", None))
         self.cache = PagedKVCache(
             k_pages=jax.device_put(self.cache.k_pages, page_spec),

@@ -32,6 +32,7 @@ def build_config(args) -> EngineConfig:
         num_pages=args.num_pages, max_batch=args.max_batch,
         max_seq_len=args.max_seq_len, prefill_chunk=args.prefill_chunk,
         use_pallas=args.use_pallas,
+        checkpoint_path=args.checkpoint_path,
     )
 
 
@@ -56,6 +57,16 @@ class Handler(socketserver.BaseRequestHandler):
             ready = srv.service is not None or srv.prefill is not None or srv.decode is not None
             send_msg(self.request, {"ok": ready, "mode": srv.mode})
             return
+        if op == "metrics":
+            stats = {}
+            if srv.service is not None:
+                stats = srv.service.stats()
+            elif srv.prefill is not None:
+                stats = {**srv.prefill.engine.metrics, **srv.prefill.metrics}
+            elif srv.decode is not None:
+                stats = {**srv.decode.engine.metrics, **srv.decode.metrics}
+            send_msg(self.request, {"metrics": stats, "mode": srv.mode})
+            return
         if op == "generate" and srv.service is not None:
             sampling = SamplingParams(
                 max_new_tokens=obj.get("max_new_tokens", 16),
@@ -63,6 +74,28 @@ class Handler(socketserver.BaseRequestHandler):
                 top_k=obj.get("top_k", 0),
                 stop_token=obj.get("stop_token"),
             )
+            if obj.get("stream"):
+                import time as _time
+                pending = srv.service.submit_async(obj["prompt"], sampling)
+                sent = 0
+                deadline = _time.monotonic() + 600.0  # match submit()'s bound
+                while True:
+                    done = pending.done.is_set()
+                    tokens = list(pending.tokens)
+                    if len(tokens) > sent:
+                        send_msg(self.request,
+                                 {"tokens": tokens[sent:], "done": False})
+                        sent = len(tokens)
+                    if done and sent == len(pending.tokens):
+                        break
+                    if _time.monotonic() > deadline:
+                        send_msg(self.request, {"error": "generation timed out",
+                                                "done": True})
+                        return
+                    _time.sleep(0.005)
+                ttft = (pending.t_first - pending.t_submit) if pending.t_first else 0.0
+                send_msg(self.request, {"tokens": [], "done": True, "ttft_s": ttft})
+                return
             tokens, ttft = srv.service.submit(obj["prompt"], sampling)
             send_msg(self.request, {"tokens": tokens, "ttft_s": ttft})
             return
@@ -150,6 +183,9 @@ def main(argv=None) -> int:
     ap.add_argument("--max-seq-len", type=int, default=1024)
     ap.add_argument("--prefill-chunk", type=int, default=64)
     ap.add_argument("--use-pallas", default="auto")
+    ap.add_argument("--checkpoint-path",
+                    default=os.environ.get("RBG_CHECKPOINT_PATH", ""),
+                    help="orbax dir or local HF dir (else random init)")
     args = ap.parse_args(argv)
     serve(args)
     return 0

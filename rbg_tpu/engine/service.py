@@ -40,13 +40,28 @@ class EngineService:
     def submit(self, prompt: List[int], sampling: SamplingParams,
                timeout: float = 600.0) -> Tuple[List[int], float]:
         """Blocking generate. Returns (tokens, ttft_seconds)."""
+        p = self.submit_async(prompt, sampling)
+        if not p.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        return p.tokens, (p.t_first - p.t_submit if p.t_first else 0.0)
+
+    def submit_async(self, prompt: List[int], sampling: SamplingParams) -> _Pending:
+        """Enqueue and return the live Pending (stream by watching .tokens
+        grow until .done is set)."""
         p = _Pending()
         with self._lock:
             self._queue.append((prompt, sampling, p))
         self._wake.set()
-        if not p.done.wait(timeout):
-            raise TimeoutError("generation timed out")
-        return p.tokens, (p.t_first - p.t_submit if p.t_first else 0.0)
+        return p
+
+    def stats(self) -> dict:
+        out = dict(self.engine.metrics)
+        out["running"] = len(self.engine.running)
+        out["waiting"] = len(self.engine.waiting)
+        out["free_pages"] = self.engine.allocator.free_pages
+        out["radix_nodes"] = (self.engine.radix.num_nodes
+                              if self.engine.radix is not None else 0)
+        return out
 
     def stop(self):
         self._stop = True

@@ -30,6 +30,12 @@ class ModelConfig:
     max_seq_len: int = 8192
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
+    # Mixture-of-experts (0 = dense). DeepSeek/Mixtral-style sparse MLP with
+    # top-k routing + optional always-on shared expert.
+    num_experts: int = 0
+    experts_per_token: int = 2
+    moe_intermediate_size: int = 0      # 0 → intermediate_size
+    moe_shared_expert: bool = False
 
     @property
     def head_dim_(self) -> int:
@@ -40,12 +46,21 @@ class ModelConfig:
         return jnp.dtype(self.dtype)
 
     @property
+    def moe_f(self) -> int:
+        return self.moe_intermediate_size or self.intermediate_size
+
+    @property
     def num_params(self) -> int:
         """Approximate parameter count (embeddings + blocks + head)."""
         d, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
         hd = self.head_dim_
         attn = d * self.num_heads * hd + 2 * d * self.num_kv_heads * hd + self.num_heads * hd * d
-        mlp = 3 * d * f
+        if self.num_experts:
+            mlp = self.num_experts * 3 * d * self.moe_f + d * self.num_experts
+            if self.moe_shared_expert:
+                mlp += 3 * d * f
+        else:
+            mlp = 3 * d * f
         per_layer = attn + mlp + 2 * d
         head = 0 if self.tie_word_embeddings else d * v
         return v * d + self.num_layers * per_layer + d + head
@@ -79,6 +94,28 @@ _PRESETS = {
         name="llama3-70b", vocab_size=128256, hidden_size=8192,
         intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
         max_seq_len=131072, rope_theta=500000.0,
+    ),
+    # MoE family (DeepSeek/Mixtral-style) — the reference's config 5 deploys
+    # DeepSeek-V3 multi-host (BASELINE.md).
+    "tiny-moe": ModelConfig(
+        name="tiny-moe", vocab_size=256, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=256,
+        rope_theta=10000.0, dtype="float32",
+        num_experts=4, experts_per_token=2, moe_intermediate_size=96,
+        moe_shared_expert=True,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        max_seq_len=32768, rope_theta=1000000.0,
+        num_experts=8, experts_per_token=2,
+    ),
+    "deepseek-v2-lite": ModelConfig(
+        name="deepseek-v2-lite", vocab_size=102400, hidden_size=2048,
+        intermediate_size=10944, num_layers=27, num_heads=16, num_kv_heads=16,
+        max_seq_len=163840, rope_theta=10000.0,
+        num_experts=64, experts_per_token=6, moe_intermediate_size=1408,
+        moe_shared_expert=True,
     ),
 }
 

@@ -66,19 +66,30 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
     def nrm(k, shape, scale):
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
 
+    blocks = {
+        "attn_norm": jnp.ones((L, d), dt),
+        "wq": nrm(ks[1], (L, d, h * hd), s_in),
+        "wk": nrm(ks[2], (L, d, kv * hd), s_in),
+        "wv": nrm(ks[3], (L, d, kv * hd), s_in),
+        "wo": nrm(ks[4], (L, h * hd, d), s_out),
+        "mlp_norm": jnp.ones((L, d), dt),
+    }
+    dense_mlp = cfg.num_experts == 0 or cfg.moe_shared_expert
+    if dense_mlp:
+        blocks["w_gate"] = nrm(ks[5], (L, d, f), s_in)
+        blocks["w_up"] = nrm(ks[6], (L, d, f), s_in)
+        blocks["w_down"] = nrm(ks[7], (L, f, d), s_out)
+    if cfg.num_experts:
+        E, mf = cfg.num_experts, cfg.moe_f
+        ke = jax.random.split(jax.random.fold_in(key, 7), 4)
+        blocks["router"] = nrm(ke[0], (L, d, E), s_in)
+        blocks["moe_gate"] = nrm(ke[1], (L, E, d, mf), s_in)
+        blocks["moe_up"] = nrm(ke[2], (L, E, d, mf), s_in)
+        blocks["moe_down"] = nrm(ke[3], (L, E, mf, d), s_out)
+
     params = {
         "embed": nrm(ks[0], (v, d), s_in),
-        "blocks": {
-            "attn_norm": jnp.ones((L, d), dt),
-            "wq": nrm(ks[1], (L, d, h * hd), s_in),
-            "wk": nrm(ks[2], (L, d, kv * hd), s_in),
-            "wv": nrm(ks[3], (L, d, kv * hd), s_in),
-            "wo": nrm(ks[4], (L, h * hd, d), s_out),
-            "mlp_norm": jnp.ones((L, d), dt),
-            "w_gate": nrm(ks[5], (L, d, f), s_in),
-            "w_up": nrm(ks[6], (L, d, f), s_in),
-            "w_down": nrm(ks[7], (L, f, d), s_out),
-        },
+        "blocks": blocks,
         "final_norm": jnp.ones((d,), dt),
     }
     if not cfg.tie_word_embeddings:
@@ -87,25 +98,68 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
 
 
 def _qkv(cfg: ModelConfig, blk, x, positions):
-    """Shared pre-attention math: norm → projections → RoPE."""
+    """Shared pre-attention math: norm → projections (+opt bias) → RoPE."""
     B, T, _ = x.shape
     hd, h, kv = cfg.head_dim_, cfg.num_heads, cfg.num_kv_heads
     xa = rms_norm(x, blk["attn_norm"], cfg.rms_norm_eps)
-    q = (xa @ blk["wq"]).reshape(B, T, h, hd)
-    k = (xa @ blk["wk"]).reshape(B, T, kv, hd)
-    vv = (xa @ blk["wv"]).reshape(B, T, kv, hd)
+    q = xa @ blk["wq"]
+    k = xa @ blk["wk"]
+    vv = xa @ blk["wv"]
+    if "bq" in blk:  # Qwen2-style attention bias
+        q = q + blk["bq"]
+        k = k + blk["bk"]
+        vv = vv + blk["bv"]
+    q = q.reshape(B, T, h, hd)
+    k = k.reshape(B, T, kv, hd)
+    vv = vv.reshape(B, T, kv, hd)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     return q, k, vv
 
 
 def _post_attention(cfg: ModelConfig, blk, x, attn):
-    """Shared post-attention math: residual → norm → SwiGLU → residual."""
+    """Shared post-attention math: residual → norm → MLP/MoE → residual."""
     B, T, _ = x.shape
     x = x + attn.reshape(B, T, -1) @ blk["wo"]
     xm = rms_norm(x, blk["mlp_norm"], cfg.rms_norm_eps)
+    return x + _mlp(cfg, blk, xm)
+
+
+def _mlp(cfg: ModelConfig, blk, xm):
+    if cfg.num_experts:
+        return _moe_mlp(cfg, blk, xm)
     gate = jax.nn.silu(xm @ blk["w_gate"])
-    return x + (gate * (xm @ blk["w_up"])) @ blk["w_down"]
+    return (gate * (xm @ blk["w_up"])) @ blk["w_down"]
+
+
+def _moe_mlp(cfg: ModelConfig, blk, xm):
+    """Top-k sparse MoE (DeepSeek/Mixtral-style) in the dense-dispatch
+    formulation: every expert is evaluated and combined with its (mostly
+    zero) routing weight. TPU-first rationale: the expert dim shards over
+    the ``ep`` mesh axis (each device computes only its experts; XLA psums
+    the weighted combine over ep), shapes stay static, and no sort/dispatch
+    scalar code enters the graph. A capacity-based dispatch kernel is a
+    later optimization; routing math is exact either way."""
+    B, T, D = xm.shape
+    E, K = cfg.num_experts, cfg.experts_per_token
+
+    logits = (xm @ blk["router"]).astype(jnp.float32)          # [B, T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, _ = jax.lax.top_k(probs, K)                      # [B, T, K]
+    threshold = top_vals[..., -1:]                              # k-th largest
+    weights = jnp.where(probs >= threshold, probs, 0.0)
+    weights = weights / jnp.maximum(weights.sum(-1, keepdims=True), 1e-9)
+    weights = weights.astype(xm.dtype)
+
+    hg = jnp.einsum("btd,edf->btef", xm, blk["moe_gate"])
+    hu = jnp.einsum("btd,edf->btef", xm, blk["moe_up"])
+    h = jax.nn.silu(hg) * hu
+    out = jnp.einsum("bte,btef,efd->btd", weights, h, blk["moe_down"])
+
+    if cfg.moe_shared_expert:
+        gate = jax.nn.silu(xm @ blk["w_gate"])
+        out = out + (gate * (xm @ blk["w_up"])) @ blk["w_down"]
+    return out
 
 
 def _head(params, cfg: ModelConfig, x) -> jnp.ndarray:

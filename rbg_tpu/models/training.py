@@ -42,14 +42,22 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, learning_rate: float = 3e-4):
     inserts the gradient psums across dp and the tp collectives.
     """
     tx = optax.adamw(learning_rate)
-    pspecs = shd.param_specs(cfg)
-    param_sh = shd.named(mesh, pspecs)
     tok_sh = NamedSharding(mesh, P("dp", "sp"))
+    param_sh_box = {}
+
+    def _param_sh(params_like):
+        # Specs depend on the concrete param tree (checkpoint-dependent
+        # optional keys like Qwen2 biases) — build once, on first sight.
+        if "sh" not in param_sh_box:
+            param_sh_box["sh"] = shd.named(
+                mesh, shd.param_specs(cfg, params_like))
+        return param_sh_box["sh"]
 
     def _opt_shardings(params_like):
         """Optimizer-state shardings by tree structure: any state subtree
         congruent to the params pytree (optax moment trees) inherits the param
         shardings leaf-for-leaf; everything else (counts, scalars) replicates."""
+        param_sh = _param_sh(params_like)
         state_shape = jax.eval_shape(tx.init, params_like)
         ptree = jax.tree_util.tree_structure(params_like)
         replicated = NamedSharding(mesh, P())
@@ -68,7 +76,11 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, learning_rate: float = 3e-4):
         return jax.tree_util.tree_map(assign, state_shape, is_leaf=is_params_like)
 
     def init_fn(params):
-        params = jax.device_put(params, param_sh)
+        # Copy before placing: device_put to an already-matching sharding
+        # aliases the caller's buffers, and the (donating) train step would
+        # delete them out from under the caller.
+        params = jax.tree_util.tree_map(jnp.copy, params)
+        params = jax.device_put(params, _param_sh(params))
         opt_sh = _opt_shardings(params)
         opt_state = jax.jit(tx.init, out_shardings=opt_sh)(params)
         return params, opt_state
@@ -81,6 +93,7 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, learning_rate: float = 3e-4):
         return params, opt_state, loss
 
     def make_step(params_like):
+        param_sh = _param_sh(params_like)
         opt_sh = _opt_shardings(params_like)
         return jax.jit(
             _step,

@@ -23,24 +23,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from rbg_tpu.models.config import ModelConfig
 
 
-def param_specs(cfg: ModelConfig) -> dict:
+def param_specs(cfg: ModelConfig, params: Optional[dict] = None) -> dict:
     """PartitionSpec pytree matching ``rbg_tpu.models.llama.init_params``.
 
     Leading axis of every block param is the scan/layer axis (unsharded).
+    Pass ``params`` to align with optional checkpoint-dependent keys
+    (Qwen2 attention biases) that the config alone can't predict.
     """
+    blocks = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.num_experts == 0 or cfg.moe_shared_expert:
+        blocks["w_gate"] = P(None, None, "tp")
+        blocks["w_up"] = P(None, None, "tp")
+        blocks["w_down"] = P(None, "tp", None)
+    if cfg.num_experts:
+        # Experts split over ep; inside each expert, Megatron tp as usual.
+        blocks["router"] = P(None, None, None)
+        blocks["moe_gate"] = P(None, "ep", None, "tp")
+        blocks["moe_up"] = P(None, "ep", None, "tp")
+        blocks["moe_down"] = P(None, "ep", "tp", None)
+    if params is not None and "bq" in params.get("blocks", {}):
+        # QKV bias columns follow their projection's output sharding.
+        blocks["bq"] = P(None, "tp")
+        blocks["bk"] = P(None, "tp")
+        blocks["bv"] = P(None, "tp")
     specs = {
         "embed": P("tp", None),
-        "blocks": {
-            "attn_norm": P(None, None),
-            "wq": P(None, None, "tp"),
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, None, "tp"),
-            "w_up": P(None, None, "tp"),
-            "w_down": P(None, "tp", None),
-        },
+        "blocks": blocks,
         "final_norm": P(None),
     }
     if not cfg.tie_word_embeddings:

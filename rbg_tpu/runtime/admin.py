@@ -9,6 +9,7 @@ status, rollout history/diff/undo (ControllerRevision-backed, KEP-31).
 from __future__ import annotations
 
 import json
+import os
 import socketserver
 import threading
 from typing import Optional
@@ -67,6 +68,37 @@ class _Handler(socketserver.BaseRequestHandler):
             return self._diff(store, ns, obj["name"], obj.get("revision"))
         if op == "undo":
             return self._undo(store, ns, obj["name"], obj.get("revision"))
+        if op == "metrics":
+            from rbg_tpu.obs.metrics import REGISTRY
+            return {"text": REGISTRY.render()}
+        if op == "profile":
+            # pprof analog (reference: cmd/rbgs/main.go:584-620). cProfile is
+            # per-thread (it would only see this handler sleeping), so we
+            # SAMPLE all threads' stacks via sys._current_frames — a
+            # statistical profile of the whole plane.
+            import sys as _sys
+            import time as _time
+            import traceback as _tb
+            from collections import Counter
+            seconds = min(float(obj.get("seconds", 2.0)), 30.0)
+            interval = 0.01
+            me = __import__("threading").get_ident()
+            counts: Counter = Counter()
+            end = _time.monotonic() + seconds
+            samples = 0
+            while _time.monotonic() < end:
+                for tid, frame in _sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    stack = _tb.extract_stack(frame, limit=3)
+                    if stack:
+                        f = stack[-1]
+                        counts[f"{f.name} ({os.path.basename(f.filename)}:{f.lineno})"] += 1
+                samples += 1
+                _time.sleep(interval)
+            top = [{"site": site, "samples": n}
+                   for site, n in counts.most_common(30)]
+            return {"seconds": seconds, "samples": samples, "top": top}
         if op == "events":
             o = store.get(obj["kind"], ns, obj["name"]) if obj.get("kind") else None
             return {"events": [

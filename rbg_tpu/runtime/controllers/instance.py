@@ -38,8 +38,10 @@ def desired_pods(inst: RoleInstance) -> List[Tuple[str, str, int, int, object]]:
     if it.pattern == PatternType.STANDALONE:
         return [(name, "", 0, 0, it.template)]
     if it.pattern == PatternType.LEADER_WORKER:
+        from rbg_tpu.api.group import per_slice_size
         lw = it.leader_worker
-        size = (lw.size if lw and lw.size else 0) or (it.tpu.num_hosts if it.tpu else 1) or 1
+        n_slices = max(1, it.tpu.num_slices) if it.tpu else 1
+        size = per_slice_size(lw, it.tpu) * n_slices
         out = []
         for i in range(size):
             tmpl = it.template
@@ -297,6 +299,11 @@ class RoleInstanceController(Controller):
         })
         if inst.spec.index >= 0:
             labels[C.LABEL_INSTANCE_INDEX] = str(inst.spec.index)
+        it_spec = inst.spec.instance
+        if it_spec.tpu is not None and it_spec.tpu.num_slices > 1:
+            from rbg_tpu.api.group import per_slice_size
+            per = per_slice_size(it_spec.leader_worker, it_spec.tpu)
+            labels[C.LABEL_SLICE_ORDINAL] = str(cidx // per)
         if pg_name:
             labels[C.LABEL_POD_GROUP] = pg_name
 

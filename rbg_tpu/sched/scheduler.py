@@ -134,17 +134,22 @@ class SchedulerController(Controller):
 
         plan: Dict[Tuple[str, str], str] = {}
         # Slice-atomic groups first (hardest constraints), then singles.
+        # Multi-slice (MEGASCALE) instances split into one sub-gang per
+        # slice ordinal — ICI within a sub-gang, DCN across ordinals.
         by_instance = collections.defaultdict(list)
         singles = []
         for p in pods:
             inst = p.metadata.labels.get(C.LABEL_INSTANCE_NAME)
             if inst and p.template.scheduler_hints.get("tpu-slice") == "true":
-                by_instance[(p.metadata.namespace, inst)].append(p)
+                ordinal = p.metadata.labels.get(C.LABEL_SLICE_ORDINAL, "0")
+                by_instance[(p.metadata.namespace, inst, ordinal)].append(p)
             else:
                 singles.append(p)
 
-        for (ns, inst), group in sorted(by_instance.items(), key=lambda kv: -len(kv[1])):
-            if not self._place_slice_group(store, group, nodes, free, excl, plan, tpu_used):
+        plan_slices: Dict[Tuple[str, str], Dict[str, str]] = {}
+        for key_, group in sorted(by_instance.items(), key=lambda kv: -len(kv[1])):
+            if not self._place_slice_group(store, group, nodes, free, excl,
+                                           plan, tpu_used, plan_slices):
                 return None
         for p in sorted(singles, key=lambda p: p.metadata.name):
             node = self._pick_node(p, nodes, free, excl)
@@ -154,7 +159,8 @@ class SchedulerController(Controller):
             free[node] -= 1
         return plan
 
-    def _place_slice_group(self, store, group, nodes, free, excl, plan, tpu_used) -> bool:
+    def _place_slice_group(self, store, group, nodes, free, excl, plan,
+                           tpu_used, plan_slices) -> bool:
         """Place (the unbound remainder of) a multi-host slice instance: one
         ICI domain, one pod per host, worker_index == JAX process id when
         possible. Sibling pods of the instance may already be bound (partial
@@ -162,14 +168,30 @@ class SchedulerController(Controller):
         hosts are off-limits."""
         ns = group[0].metadata.namespace
         inst = group[0].metadata.labels.get(C.LABEL_INSTANCE_NAME, "")
+        ordinal = group[0].metadata.labels.get(C.LABEL_SLICE_ORDINAL, "0")
         node_by = {n.metadata.name: n for n in nodes}
-        siblings = [
+        all_siblings = [
             p for p in store.list("Pod", namespace=ns,
                                   selector={C.LABEL_INSTANCE_NAME: inst},
                                   copy_=False)
             if p.node_name and p.active
         ]
+        siblings = [p for p in all_siblings
+                    if p.metadata.labels.get(C.LABEL_SLICE_ORDINAL, "0") == ordinal]
         taken = {p.node_name for p in siblings}
+        # Other ordinals' slices are forbidden: MEGASCALE sub-gangs must
+        # occupy DISTINCT ICI domains (DCN between them) even when one big
+        # physical slice could fit several sub-gangs.
+        forbidden_slices = set()
+        for p in all_siblings:
+            if p.metadata.labels.get(C.LABEL_SLICE_ORDINAL, "0") != ordinal:
+                n = node_by.get(p.node_name)
+                if n is not None and n.tpu.slice_id:
+                    forbidden_slices.add(n.tpu.slice_id)
+        key_ = (ns, inst)
+        for other_ordinal, sid in plan_slices.get(key_, {}).items():
+            if other_ordinal != ordinal:
+                forbidden_slices.add(sid)
         sibling_slice = ""
         for p in siblings:
             n = node_by.get(p.node_name)
@@ -184,7 +206,8 @@ class SchedulerController(Controller):
         slices = collections.defaultdict(list)
         for n in nodes:
             name = n.metadata.name
-            if (n.tpu.slice_id and self._node_ok(group[0], n, excl)
+            if (n.tpu.slice_id and n.tpu.slice_id not in forbidden_slices
+                    and self._node_ok(group[0], n, excl)
                     and free[name] > 0 and name not in taken and name not in tpu_used):
                 slices[n.tpu.slice_id].append(n)
 
@@ -213,6 +236,7 @@ class SchedulerController(Controller):
                 plan[(p.metadata.namespace, p.metadata.name)] = n.metadata.name
                 free[n.metadata.name] -= 1
                 tpu_used.add(n.metadata.name)
+            plan_slices.setdefault(key_, {})[ordinal] = sid
             return True
         return False
 

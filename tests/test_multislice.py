@@ -1,0 +1,46 @@
+"""Multi-slice (MEGASCALE) roles: sub-gang-per-slice placement + env contract."""
+
+import pytest
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import make_group, make_tpu_nodes, tpu_leaderworker_role
+
+
+@pytest.fixture()
+def plane():
+    p = ControlPlane(backend="fake")
+    # 4-host slices with 2-host sub-gangs: a single physical slice COULD fit
+    # both sub-gangs — the scheduler must still split them across slices.
+    make_tpu_nodes(p.store, slices=3, hosts_per_slice=4)
+    with p:
+        yield p
+
+
+def test_multislice_instance_spans_slices(plane):
+    role = tpu_leaderworker_role("train", replicas=1, topology="2x4")
+    role.tpu.num_slices = 2  # 2 sub-gangs × 2 hosts = 4 pods
+    plane.apply(make_group("ms", role))
+    g = plane.wait_group_ready("ms", timeout=20)
+    assert g.status.role("train").ready_replicas == 1
+
+    pods = sorted(plane.store.list("Pod", namespace="default"),
+                  key=lambda p: int(p.metadata.labels[C.LABEL_COMPONENT_INDEX]))
+    assert len(pods) == 4
+    nodes = {n.metadata.name: n for n in plane.store.list("Node")}
+
+    # Sub-gang 0 (pods 0,1) on one slice; sub-gang 1 (pods 2,3) on another.
+    s0 = {nodes[p.node_name].tpu.slice_id for p in pods[:2]}
+    s1 = {nodes[p.node_name].tpu.slice_id for p in pods[2:]}
+    assert len(s0) == 1 and len(s1) == 1
+    assert s0 != s1, "multi-slice sub-gangs must land on distinct ICI domains"
+
+    for p in pods:
+        envs = {e.name: e.value for e in p.template.containers[0].env}
+        idx = int(p.metadata.labels[C.LABEL_COMPONENT_INDEX])
+        assert envs[C.ENV_JAX_NUM_PROCESSES] == "4"
+        assert envs[C.ENV_JAX_PROCESS_ID] == str(idx)
+        assert envs[C.ENV_MEGASCALE_NUM_SLICES] == "2"
+        assert envs[C.ENV_MEGASCALE_SLICE_ID] == str(idx // 2)
+        assert p.metadata.labels[C.LABEL_SLICE_ORDINAL] == str(idx // 2)
+        assert C.ENV_MEGASCALE_COORDINATOR in envs

@@ -13,7 +13,7 @@ from rbg_tpu.parallel import (
 
 
 def test_mesh_axes(mesh8):
-    assert mesh8.axis_names == ("dp", "sp", "tp")
+    assert mesh8.axis_names == ("dp", "sp", "ep", "tp")
     assert mesh8.devices.size == 8
 
 

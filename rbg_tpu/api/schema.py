@@ -1,0 +1,79 @@
+"""JSON Schema generation from the resource dataclasses.
+
+Reference analog: the kubebuilder-generated CRD YAML (``config/crd/bases``,
+10 files) — the machine-readable API contract users validate manifests
+against. Here the dataclasses ARE the source of truth; this module emits
+draft-07 JSON Schemas from them (``rbg-tpu schema``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, get_args, get_origin
+
+from rbg_tpu.api.serde import to_camel
+
+
+def _type_schema(tp: Any, defs: dict) -> dict:
+    origin = get_origin(tp)
+    if tp is Any:
+        return {}
+    if origin is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        inner = _type_schema(args[0], defs)
+        return inner  # Optionals: absence is allowed; null not serialized
+    if origin in (list, tuple):
+        (elem,) = get_args(tp) or (Any,)
+        return {"type": "array", "items": _type_schema(elem, defs)}
+    if origin is dict:
+        _, vt = get_args(tp) or (str, Any)
+        return {"type": "object", "additionalProperties": _type_schema(vt, defs)}
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return {"type": "string", "enum": [e.value for e in tp]}
+    if dataclasses.is_dataclass(tp):
+        name = tp.__name__
+        if name not in defs:
+            defs[name] = None  # placeholder breaks recursion
+            props = {}
+            hints = typing.get_type_hints(tp)
+            for f in dataclasses.fields(tp):
+                props[to_camel(f.name)] = _type_schema(hints[f.name], defs)
+            doc = (tp.__doc__ or "").strip().split("\n")[0]
+            if doc.startswith(f"{name}("):
+                doc = ""  # auto-generated dataclass signature, not a doc
+            defs[name] = {
+                "type": "object",
+                "properties": props,
+                "additionalProperties": False,
+                **({"description": doc} if doc else {}),
+            }
+        return {"$ref": f"#/definitions/{name}"}
+    if tp is int:
+        return {"type": "integer"}
+    if tp is float:
+        return {"type": "number"}
+    if tp is bool:
+        return {"type": "boolean"}
+    if tp is str:
+        return {"type": "string"}
+    return {}
+
+
+def schema_for(cls) -> dict:
+    defs: dict = {}
+    root = _type_schema(cls, defs)
+    ref = root.get("$ref", "").rsplit("/", 1)[-1]
+    body = defs.pop(ref)
+    return {
+        "$schema": "http://json-schema.org/draft-07/schema#",
+        "title": cls.__name__,
+        **body,
+        "definitions": defs,
+    }
+
+
+def all_schemas() -> dict:
+    from rbg_tpu.api import KINDS
+    return {kind: schema_for(cls) for kind, cls in sorted(KINDS.items())}

@@ -47,6 +47,18 @@ def register(sub) -> None:
     gp.add_argument("-n", "--namespace", default="default")
     gp.set_defaults(func=cmd_get)
 
+    dp_ = sub.add_parser("delete", help="delete a resource (against a serve plane)")
+    dp_.add_argument("kind")
+    dp_.add_argument("name")
+    dp_.add_argument("--admin", default="127.0.0.1:7070")
+    dp_.add_argument("-n", "--namespace", default="default")
+    dp_.set_defaults(func=cmd_delete)
+
+    scp = sub.add_parser("schema", help="print JSON schema(s) for resource kinds")
+    scp.add_argument("kind", nargs="?", help="one kind (default: all)")
+    scp.add_argument("--write", metavar="DIR", help="write per-kind files to DIR")
+    scp.set_defaults(func=cmd_schema)
+
     rp = sub.add_parser("rollout", help="rollout history|diff|undo")
     rp.add_argument("action", choices=["history", "diff", "undo"])
     rp.add_argument("name")
@@ -191,6 +203,40 @@ def cmd_get(args) -> int:
     for item in resp["items"]:
         meta = item.get("metadata", {})
         print(f"{args.kind}/{meta.get('name')}")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    _admin_call(args.admin, {"op": "delete", "kind": args.kind,
+                             "name": args.name, "namespace": args.namespace})
+    print(f"deleted {args.kind}/{args.name}")
+    return 0
+
+
+def cmd_schema(args) -> int:
+    import json as _json
+
+    from rbg_tpu.api.schema import all_schemas, schema_for
+    from rbg_tpu.api import KINDS
+
+    if args.kind:
+        if args.kind not in KINDS:
+            print(f"error: unknown kind {args.kind}; known: {', '.join(sorted(KINDS))}",
+                  file=sys.stderr)
+            return 1
+        schemas = {args.kind: schema_for(KINDS[args.kind])}
+    else:
+        schemas = all_schemas()
+    if args.write:
+        import os as _os
+        _os.makedirs(args.write, exist_ok=True)
+        for kind, sch in schemas.items():
+            path = _os.path.join(args.write, f"{kind.lower()}.schema.json")
+            with open(path, "w") as f:
+                _json.dump(sch, f, indent=2)
+            print(f"wrote {path}")
+        return 0
+    print(_json.dumps(schemas if not args.kind else schemas[args.kind], indent=2))
     return 0
 
 

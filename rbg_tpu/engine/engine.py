@@ -304,6 +304,8 @@ class Engine:
             self.radix.insert(req.prompt + req.output[:-1], req.pages)
         self.allocator.release(req.pages)
         req.pages = []
+        # Don't retain finished requests forever (long-running servers).
+        self.requests.pop(req.id, None)
 
     def release_request(self, req_id: int):
         """Release an exported request's pages (prefill mode)."""
@@ -311,6 +313,22 @@ class Engine:
         if req.pages:
             self.allocator.release(req.pages)
             req.pages = []
+
+    def cancel_request(self, req_id: int) -> bool:
+        """Abort a request: drop it from the queues and recycle its pages.
+        (Must be called from the thread driving step() — the EngineService
+        routes cancellations through its loop.)"""
+        req = self.requests.get(req_id)
+        if req is None or req.state == "finished":
+            return False
+        req.state = "finished"
+        self.waiting = [r for r in self.waiting if r is not req]
+        self.running = [r for r in self.running if r is not req]
+        if req.pages:
+            self.allocator.release(req.pages)
+            req.pages = []
+        self.requests.pop(req_id, None)
+        return True
 
     def _preempt(self, req: Request):
         self.metrics["preemptions"] += 1

@@ -67,6 +67,31 @@ class Handler(socketserver.BaseRequestHandler):
                 stats = {**srv.decode.engine.metrics, **srv.decode.metrics}
             send_msg(self.request, {"metrics": stats, "mode": srv.mode})
             return
+        if op == "generate_text" and srv.service is not None:
+            tok = srv.tokenizer
+            vocab = srv.service.engine.mcfg.vocab_size
+            if tok.vocab_size > vocab:
+                send_msg(self.request, {"error": (
+                    f"tokenizer vocab {tok.vocab_size} exceeds model vocab "
+                    f"{vocab}; pass --tokenizer-path matching the model")})
+                return
+            sampling = SamplingParams(
+                max_new_tokens=obj.get("max_new_tokens", 64),
+                temperature=obj.get("temperature", 0.0),
+                top_k=obj.get("top_k", 0),
+                stop_token=tok.eos_id,
+            )
+            prompt_ids = tok.encode(obj["text"])
+            limit = srv.service.engine.cfg.max_seq_len
+            if len(prompt_ids) + sampling.max_new_tokens > limit:
+                send_msg(self.request, {"error": (
+                    f"prompt ({len(prompt_ids)} tokens) + max_new_tokens "
+                    f"({sampling.max_new_tokens}) exceeds max_seq_len {limit}")})
+                return
+            ids, ttft = srv.service.submit(prompt_ids, sampling)
+            send_msg(self.request, {"text": tok.decode(ids), "tokens": ids,
+                                    "ttft_s": ttft})
+            return
         if op == "generate" and srv.service is not None:
             sampling = SamplingParams(
                 max_new_tokens=obj.get("max_new_tokens", 16),
@@ -76,11 +101,16 @@ class Handler(socketserver.BaseRequestHandler):
             )
             if obj.get("stream"):
                 import time as _time
+                from rbg_tpu.engine.service import DEFAULT_TIMEOUT_S
                 pending = srv.service.submit_async(obj["prompt"], sampling)
                 sent = 0
-                deadline = _time.monotonic() + 600.0  # match submit()'s bound
+                deadline = _time.monotonic() + DEFAULT_TIMEOUT_S
                 while True:
                     done = pending.done.is_set()
+                    if done and pending.error:
+                        send_msg(self.request, {"error": pending.error,
+                                                "done": True})
+                        return
                     tokens = list(pending.tokens)
                     if len(tokens) > sent:
                         send_msg(self.request,
@@ -89,6 +119,7 @@ class Handler(socketserver.BaseRequestHandler):
                     if done and sent == len(pending.tokens):
                         break
                     if _time.monotonic() > deadline:
+                        srv.service.cancel(pending)  # recycle slot + pages
                         send_msg(self.request, {"error": "generation timed out",
                                                 "done": True})
                         return
@@ -151,9 +182,15 @@ def serve(args) -> None:
     server.mode = cfg.mode
     server.service = server.prefill = server.decode = None
     server.pd_lock = threading.Lock()
+    from rbg_tpu.engine.tokenizer import ByteTokenizer
+    server.tokenizer = ByteTokenizer()  # replaced by init_engine if HF given
 
-    # Bind the port FIRST (readiness probes connect), then load the model.
+    # Bind the port FIRST (readiness probes connect), then load model and
+    # tokenizer in the background — a slow HF load must not stall accepts.
     def init_engine():
+        if args.tokenizer_path:
+            from rbg_tpu.engine.tokenizer import load_tokenizer
+            server.tokenizer = load_tokenizer(args.tokenizer_path)
         if cfg.mode == "prefill":
             from rbg_tpu.engine.pd import PrefillWorker
             server.prefill = PrefillWorker(cfg)
@@ -186,6 +223,9 @@ def main(argv=None) -> int:
     ap.add_argument("--checkpoint-path",
                     default=os.environ.get("RBG_CHECKPOINT_PATH", ""),
                     help="orbax dir or local HF dir (else random init)")
+    ap.add_argument("--tokenizer-path",
+                    default=os.environ.get("RBG_TOKENIZER_PATH", ""),
+                    help="local HF tokenizer dir (else byte-level fallback)")
     args = ap.parse_args(argv)
     serve(args)
     return 0

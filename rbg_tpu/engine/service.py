@@ -16,13 +16,17 @@ from rbg_tpu.engine.engine import Engine
 
 
 class _Pending:
-    __slots__ = ("tokens", "done", "t_submit", "t_first")
+    __slots__ = ("tokens", "done", "t_submit", "t_first", "error")
 
     def __init__(self):
         self.tokens: List[int] = []
         self.done = threading.Event()
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
+        self.error: Optional[str] = None
+
+
+DEFAULT_TIMEOUT_S = 600.0
 
 
 class EngineService:
@@ -33,17 +37,27 @@ class EngineService:
         self._wake = threading.Event()
         self._stop = False
         self._queue: List[Tuple[List[int], SamplingParams, _Pending]] = []
+        self._cancels: List[_Pending] = []
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="engine-loop")
         self._thread.start()
 
     def submit(self, prompt: List[int], sampling: SamplingParams,
-               timeout: float = 600.0) -> Tuple[List[int], float]:
+               timeout: float = DEFAULT_TIMEOUT_S) -> Tuple[List[int], float]:
         """Blocking generate. Returns (tokens, ttft_seconds)."""
         p = self.submit_async(prompt, sampling)
         if not p.done.wait(timeout):
+            self.cancel(p)  # recycle batch slot + KV pages, don't orphan
             raise TimeoutError("generation timed out")
+        if p.error:
+            raise ValueError(p.error)
         return p.tokens, (p.t_first - p.t_submit if p.t_first else 0.0)
+
+    def cancel(self, pending: "_Pending") -> None:
+        """Abort an in-flight request (routed through the loop thread)."""
+        with self._lock:
+            self._cancels.append(pending)
+        self._wake.set()
 
     def submit_async(self, prompt: List[int], sampling: SamplingParams) -> _Pending:
         """Enqueue and return the live Pending (stream by watching .tokens
@@ -73,9 +87,24 @@ class EngineService:
             with self._lock:
                 newly = self._queue
                 self._queue = []
+                cancels = self._cancels
+                self._cancels = []
             for prompt, sampling, pending in newly:
-                rid = eng.add_request(prompt, sampling)
+                try:
+                    rid = eng.add_request(prompt, sampling)
+                except Exception as e:
+                    # A bad request must fail ITSELF, never the loop thread.
+                    pending.error = str(e)
+                    pending.done.set()
+                    continue
                 self._pending[rid] = pending
+            for pending in cancels:
+                rid = next((r for r, p in self._pending.items() if p is pending),
+                           None)
+                if rid is not None:
+                    eng.cancel_request(rid)
+                    del self._pending[rid]
+                    pending.done.set()
             if not eng.has_work():
                 self._wake.wait(0.01)
                 self._wake.clear()

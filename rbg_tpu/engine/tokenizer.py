@@ -1,0 +1,53 @@
+"""Tokenizers: HF (local dir) + a zero-dependency byte-level fallback.
+
+The environment is zero-egress, so nothing downloads: ``load_tokenizer``
+uses a local HF tokenizer dir when given (via ``transformers``), else the
+byte fallback (any model with vocab ≥ 259 can serve text demos with it).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + BOS/EOS. ids: 0..255 bytes, 256 BOS, 257 EOS, 258 PAD."""
+
+    bos_id = 256
+    eos_id = 257
+    pad_id = 258
+    vocab_size = 259
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.bos_id = self._tok.bos_token_id
+        self.eos_id = self._tok.eos_token_id
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(path: Optional[str] = None):
+    if path and os.path.isdir(path):
+        return HFTokenizer(path)
+    return ByteTokenizer()

@@ -54,7 +54,11 @@ class _Handler(socketserver.BaseRequestHandler):
             self.server.plane.apply(parsed)
             return {"ok": True, "kind": parsed.kind, "name": parsed.metadata.name}
         if op == "delete":
-            store.delete(obj["kind"], ns, obj["name"])
+            if obj["kind"] not in KINDS:
+                return {"error": f"unknown kind {obj['kind']}"}
+            deleted = store.delete(obj["kind"], ns, obj["name"])
+            if deleted is None:
+                return {"error": f"{obj['kind']}/{obj['name']} not found"}
             return {"ok": True}
         if op == "status":
             return self._status(store, ns, obj["name"])

@@ -1,0 +1,68 @@
+"""Chaos: random pod failures under churn must always reconverge.
+
+Reference analog: the e2e stability suites
+(``restart_policy_stability`` 666 LoC, ``inactive_pod`` 588 LoC — SURVEY.md
+§4) which kill pods repeatedly and assert convergence.
+"""
+
+import random
+import time
+
+import pytest
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.group import RestartPolicyConfig
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import (
+    make_group, make_tpu_nodes, simple_role, tpu_leaderworker_role,
+)
+
+
+def test_random_pod_failures_reconverge():
+    rng = random.Random(42)
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=4, hosts_per_slice=2)
+    with plane:
+        for i in range(3):
+            role = simple_role("web", replicas=2)
+            role.restart_policy = RestartPolicyConfig(base_delay_seconds=0.01,
+                                                      max_delay_seconds=0.1)
+            tpu_role = tpu_leaderworker_role("serve", replicas=1, topology="2x4")
+            tpu_role.restart_policy = RestartPolicyConfig(base_delay_seconds=0.01,
+                                                          max_delay_seconds=0.1)
+            plane.apply(make_group(f"g{i}", role, tpu_role))
+        for i in range(3):
+            plane.wait_group_ready(f"g{i}", timeout=30)
+
+        # chaos: kill random pods for a while
+        end = time.monotonic() + 3.0
+        kills = 0
+        while time.monotonic() < end:
+            pods = [p for p in plane.store.list("Pod", namespace="default")
+                    if p.active and p.status.phase == "Running"]
+            if pods:
+                victim = rng.choice(pods)
+                plane.kubelet.fail_pod("default", victim.metadata.name)
+                kills += 1
+            time.sleep(0.15)
+        assert kills >= 10
+
+        # everything reconverges
+        for i in range(3):
+            plane.wait_group_ready(f"g{i}", timeout=60)
+
+        # invariants after the storm
+        nodes = {n.metadata.name: n for n in plane.store.list("Node")}
+        pods = [p for p in plane.store.list("Pod", namespace="default") if p.active]
+        by_inst = {}
+        for p in pods:
+            if p.template.scheduler_hints.get("tpu-slice") == "true":
+                by_inst.setdefault(p.metadata.labels[C.LABEL_INSTANCE_NAME], []).append(p)
+        for inst, ps in by_inst.items():
+            slices = {nodes[p.node_name].tpu.slice_id for p in ps}
+            assert len(slices) == 1, f"{inst} split across slices after chaos"
+            assert len({p.node_name for p in ps}) == len(ps)
+        # restart counters recorded
+        total_restarts = sum(i.status.restart_count
+                             for i in plane.store.list("RoleInstance", namespace="default"))
+        assert total_restarts >= 1

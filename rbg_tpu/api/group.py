@@ -158,6 +158,10 @@ class RoleSpec:
     engine_runtime: Optional[EngineRuntimeRef] = None
     stateful: bool = True       # ordered identity (TPU slices want this)
     workload: str = "RoleInstanceSet"  # strategy selector (inventory #23)
+    # KEP-260 sharedServiceSelection: "All" exposes every pod through the
+    # role service; "LeaderOnly" exposes only instance leaders (component
+    # index 0) — routers then address one endpoint per multi-host instance.
+    service_selection: str = "All"     # All | LeaderOnly
 
     __serde_keep__ = ("name",)
 

@@ -1,0 +1,84 @@
+"""Rendezvous check worker: prove the control plane's injected JAX
+distributed-init contract actually forms a working multi-process JAX job.
+
+Runs as a leaderWorker role's pod: consumes RBG_JAX_COORDINATOR_ADDRESS /
+RBG_JAX_NUM_PROCESSES / RBG_JAX_PROCESS_ID exactly the way an engine would
+(reference analog: SGLang consuming RBG_LWP_* as --dist-init-addr/--nnodes/
+--node-rank in examples/inference/pd-disagg-leader-worker.yaml), calls
+``jax.distributed.initialize``, performs a cross-process collective, and
+writes the result to ``RBG_RENDEZVOUS_OUT``. Serves the standard health op so
+the executor's readiness probe passes.
+
+Local-mode address resolution: pod FQDNs aren't DNS here, so when a registry
+path is present the coordinator's host part resolves to 127.0.0.1 (same-host
+processes). On GKE the FQDN resolves via the headless service instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socketserver
+import sys
+import threading
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    port = int(os.environ.get("RBG_SERVE_PORT", "9400"))
+    state = {"ok": False, "detail": "initializing"}
+
+    from rbg_tpu.engine.protocol import recv_msg, send_msg
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            while True:
+                try:
+                    obj, _, _ = recv_msg(self.request)
+                except Exception:
+                    return
+                if obj is None:
+                    return
+                send_msg(self.request, {"ok": True, "rendezvous": dict(state)})
+
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", port), Handler)
+    srv.allow_reuse_address = True
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    print(f"rendezvous-check listening on {port}", flush=True)
+
+    coordinator = os.environ["RBG_JAX_COORDINATOR_ADDRESS"]
+    num = int(os.environ["RBG_JAX_NUM_PROCESSES"])
+    pid = int(os.environ["RBG_JAX_PROCESS_ID"])
+    if os.environ.get("RBG_REGISTRY_PATH"):
+        coordinator = "127.0.0.1:" + coordinator.rsplit(":", 1)[1]
+
+    import jax
+    jax.distributed.initialize(coordinator, num_processes=num, process_id=pid)
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    # Leader broadcasts the group identity; everyone checks the device count.
+    group = os.environ.get("RBG_GROUP_NAME", "")
+    payload = jnp.asarray([float(len(group)), float(pid)])
+    leader_payload = multihost_utils.broadcast_one_to_all(payload)
+    result = {
+        "process_id": pid,
+        "num_processes": num,
+        "global_devices": jax.device_count(),
+        "leader_group_len": int(leader_payload[0]),
+        "leader_pid": int(leader_payload[1]),
+    }
+    state.update(ok=True, detail="rendezvous complete", **result)
+    out = os.environ.get("RBG_RENDEZVOUS_OUT")
+    if out:
+        with open(f"{out}.{pid}", "w") as f:
+            json.dump(result, f)
+    print(f"rendezvous ok: {result}", flush=True)
+    threading.Event().wait()  # serve health until terminated
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

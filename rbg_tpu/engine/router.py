@@ -49,11 +49,18 @@ class Registry:
         return self._cache
 
     def backends(self, role: str, group: Optional[str] = None) -> List[str]:
-        out = []
+        """Addresses for a role. When the role's service declares LeaderOnly
+        (KEP-260 sharedServiceSelection, carried into registry entries), only
+        instance leaders are addressed — one endpoint per multi-host
+        instance; the default (All) round-robins every pod."""
+        all_, leaders, leader_only = [], [], False
         for fqdn, e in sorted(self.entries().items()):
             if e.get("role") == role and (group is None or e.get("group") == group):
-                out.append(e["addr"])
-        return out
+                all_.append(e["addr"])
+                leader_only = leader_only or bool(e.get("leaderOnly"))
+                if e.get("leader", True):
+                    leaders.append(e["addr"])
+        return (leaders or all_) if leader_only else all_
 
 
 class RouterState:

@@ -330,7 +330,14 @@ class RoleBasedGroupController(Controller):
     def _ensure_service(self, store, rbg, role: RoleSpec):
         ns = rbg.metadata.namespace
         sname = C.service_name(rbg.metadata.name, role.name)
-        if store.get("Service", ns, sname) is not None:
+        leader_only = role.service_selection == "LeaderOnly"
+        cur = store.get("Service", ns, sname)
+        if cur is not None:
+            if cur.leader_only != leader_only:
+                def fn(s):
+                    s.leader_only = leader_only
+                    return True
+                store.mutate("Service", ns, sname, fn)
             return
         svc = Service()
         svc.metadata.name = sname
@@ -344,6 +351,7 @@ class RoleBasedGroupController(Controller):
             C.LABEL_GROUP_NAME: rbg.metadata.name,
             C.LABEL_ROLE_NAME: role.name,
         }
+        svc.leader_only = leader_only
         try:
             store.create(svc)
         except AlreadyExists:

@@ -176,10 +176,18 @@ class LocalExecutor:
         role = pod.metadata.labels.get(C.LABEL_ROLE_NAME, "")
         svc = C.service_name(group, role) if group else ""
         fqdn = f"{pod.metadata.name}.{svc}" if svc else pod.metadata.name
+        leader = pod.metadata.labels.get(C.LABEL_COMPONENT_INDEX, "0") == "0"
+        # Role-level routing policy comes from the Service (KEP-260
+        # sharedServiceSelection) — the registry carries it to the router.
+        leader_only = False
+        if svc:
+            service = self.store.get("Service", pod.metadata.namespace, svc)
+            leader_only = bool(service and service.leader_only)
         with self._lock:
             self._registry[fqdn] = {
                 "addr": f"127.0.0.1:{port}",
                 "role": role, "group": group, "pod": pod.metadata.name,
+                "leader": leader, "leaderOnly": leader_only,
             }
             data = self._flush_registry_locked_data()
         self._flush_registry(data)

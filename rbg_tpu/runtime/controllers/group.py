@@ -89,13 +89,19 @@ class RoleBasedGroupController(Controller):
         #    walk and the coordination clamp)
         rbg = self._update_role_statuses(store, rbg, role_hashes)
 
-        # 5. coordination policy: maxSkew-clamped scaling targets, computed
-        #    from the status refreshed above
-        role_targets = self._coordination_targets(store, rbg)
+        # 5. coordination policy: maxSkew-clamped scaling targets + rolling
+        #    update partitions, computed from the status refreshed above
+        policies = [
+            p for p in store.list("CoordinatedPolicy", namespace=ns)
+            if p.spec.group_name == name
+        ]
+        role_targets = self._coordination_targets(rbg, policies)
+        role_partitions = self._coordination_partitions(store, rbg, policies,
+                                                        role_hashes)
         clamped = any(
             role_targets.get(r.name, r.replicas) < r.replicas
             for r in rbg.spec.roles
-        )
+        ) or any(p > 0 for p in role_partitions.values())
 
         # 6. group-level gang PodGroup
         gang = rbg.metadata.annotations.get(C.ANN_GANG_SCHEDULING) == "true"
@@ -122,6 +128,7 @@ class RoleBasedGroupController(Controller):
                     self._reconcile_role(
                         store, rbg, role, role_hashes[role.name],
                         role_targets.get(role.name, role.replicas), gang,
+                        partition=role_partitions.get(role.name),
                     )
                 else:
                     blocked.append(role.name)
@@ -198,19 +205,48 @@ class RoleBasedGroupController(Controller):
 
     # ---- coordination (maxSkew clamp; full engine in coordination/scaling) ----
 
-    def _coordination_targets(self, store, rbg):
+    def _coordination_partitions(self, store, rbg, policies, role_hashes):
+        """Per-role rolling-update partition overrides from
+        CoordinatedRollingUpdate policies (maxSkew-bounded rollout)."""
+        ru_policies = [p for p in policies if p.spec.rolling_update is not None]
+        if not ru_policies:
+            return {}
+        from rbg_tpu.coordination.rollout import rollout_partitions
+        ns = rbg.metadata.namespace
+        policy_roles = set()
+        for p in ru_policies:
+            policy_roles.update(p.spec.rolling_update.roles)
+        updated = {}
+        for role in rbg.spec.roles:
+            if role.name not in policy_roles:
+                continue
+            ris = store.get("RoleInstanceSet", ns,
+                            C.workload_name(rbg.metadata.name, role.name),
+                            copy_=False)
+            if ris is None:
+                # No workload yet: it will be created at the new revision —
+                # treat as fully updated so it doesn't hold others back.
+                updated[role.name] = role.replicas
+            elif (ris.metadata.labels.get(C.role_revision_label(role.name))
+                    != role_hashes.get(role.name)):
+                # RIS hasn't received the new template yet — its updated
+                # counters refer to the OLD revision and would read as 100%
+                # (letting the first reconcile open every partition).
+                updated[role.name] = 0
+            else:
+                updated[role.name] = ris.status.updated_ready_replicas
+        out = {}
+        for p in ru_policies:
+            out.update(rollout_partitions(rbg, p.spec.rolling_update, updated))
+        return out
+
+    def _coordination_targets(self, rbg, policies):
         targets = {r.name: r.replicas for r in rbg.spec.roles}
-        policies = [
-            p for p in store.list("CoordinatedPolicy", namespace=rbg.metadata.namespace)
-            if p.spec.group_name == rbg.metadata.name and p.spec.scaling is not None
-        ]
-        if not policies:
+        scaling = [p for p in policies if p.spec.scaling is not None]
+        if not scaling:
             return targets
-        try:
-            from rbg_tpu.coordination.scaling import clamp_targets
-        except ImportError:
-            return targets
-        for p in policies:
+        from rbg_tpu.coordination.scaling import clamp_targets
+        for p in scaling:
             targets = clamp_targets(rbg, p.spec.scaling, targets)
         return targets
 
@@ -248,7 +284,7 @@ class RoleBasedGroupController(Controller):
     # ---- per-role workload reconcile (strategy: RoleInstanceSet) ----
 
     def _reconcile_role(self, store, rbg, role: RoleSpec, role_hash: str,
-                        replicas: int, gang: bool):
+                        replicas: int, gang: bool, partition=None):
         ns = rbg.metadata.namespace
         wname = C.workload_name(rbg.metadata.name, role.name)
         self._ensure_service(store, rbg, role)
@@ -266,6 +302,13 @@ class RoleBasedGroupController(Controller):
             if k.startswith(C.DOMAIN) and k != C.ANN_GANG_SCHEDULING:
                 annotations.setdefault(k, v)
 
+        import copy as _copy
+        rolling = _copy.deepcopy(role.rolling_update)
+        if partition is not None:
+            # Coordinated rollout TIGHTENS the partition (reference:
+            # calculateNextRollingTarget :1374 → RIS partition); a user's
+            # explicit canary hold is never released by the skew math.
+            rolling.partition = max(partition, role.rolling_update.partition)
         desired_spec = RoleInstanceSetSpec(
             replicas=replicas,
             stateful=role.stateful,
@@ -278,7 +321,7 @@ class RoleBasedGroupController(Controller):
                 engine_runtime=role.engine_runtime,
             ),
             restart_policy=role.restart_policy,
-            rolling_update=role.rolling_update,
+            rolling_update=rolling,
             selector=dict(labels),
         )
 

@@ -48,6 +48,7 @@ class LocalExecutor:
         self.health_timeout = health_timeout
         self._procs: Dict[tuple, subprocess.Popen] = {}
         self._ports: Dict[tuple, int] = {}
+        self._generations: Dict[tuple, int] = {}
         self._lock = threading.Lock()
         self._stopped = False
         self._registry: Dict[str, dict] = {}
@@ -62,8 +63,11 @@ class LocalExecutor:
     def stop(self):
         self._stopped = True
         with self._lock:
-            procs = list(self._procs.values())
+            procs = [p for p in self._procs.values()
+                     if isinstance(p, subprocess.Popen)]
             self._procs.clear()
+            self._ports.clear()
+            self._generations.clear()
         for p in procs:
             try:
                 p.terminate()
@@ -88,7 +92,20 @@ class LocalExecutor:
                 if key in self._procs:
                     return
                 self._procs[key] = None  # claim
+                self._generations[key] = pod.metadata.generation
             threading.Thread(target=self._launch, args=(key, pod), daemon=True).start()
+            return
+        # In-place update: the pod object mutated (new container images) while
+        # its process runs the old ones — restart the process in place (pod
+        # identity, port, and registry entry survive).
+        if ev.type == Event.MODIFIED and pod.status.phase == "Running":
+            with self._lock:
+                proc = self._procs.get(key)
+                launched_gen = self._generations.get(key)
+            if (proc is not None and launched_gen is not None
+                    and pod.metadata.generation > launched_gen):
+                threading.Thread(target=self._restart_in_place,
+                                 args=(key, pod), daemon=True).start()
 
     # ---- launch ----
 
@@ -108,6 +125,7 @@ class LocalExecutor:
                 env[e.name] = e.value
             env["RBG_SERVE_PORT"] = str(port)
             env["RBG_REGISTRY_PATH"] = self.registry_path
+            env["RBG_CONTAINER_IMAGE"] = container.image
             env.setdefault("RBG_TPU_NATIVE", "1")
             self._write_topology(env, pod)
 
@@ -229,10 +247,31 @@ class LocalExecutor:
         phase = "Succeeded" if (rc == 0 and job_like) else "Failed"
         self._set_status(key, phase, ready=False)
 
+    def _restart_in_place(self, key, pod):
+        with self._lock:
+            proc = self._procs.get(key)
+            if not isinstance(proc, subprocess.Popen):
+                return  # another restart/launch holds the claim — leave it
+            self._generations[key] = pod.metadata.generation
+            self._procs[key] = None  # re-claim for the relaunch
+            self._ports.pop(key, None)
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self._unregister(key[1])
+        # Claim (procs[key] = None) stays held: the Pending status event
+        # must not trigger a second concurrent launch.
+        self._set_status(key, "Pending", ready=False)
+        self._launch(key, pod)
+
     def _teardown(self, key):
         with self._lock:
             proc = self._procs.pop(key, None)
             self._ports.pop(key, None)
+            self._generations.pop(key, None)
         self._unregister(key[1])
         if proc is not None and proc.poll() is None:
             proc.terminate()

@@ -1,0 +1,77 @@
+"""Coordinated rolling update: maxSkew-bounded multi-role rollout."""
+
+import pytest
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.group import RollingUpdate
+from rbg_tpu.api.policy import (
+    CoordinatedPolicy, CoordinatedPolicySpec, CoordinatedRollingUpdate,
+)
+from rbg_tpu.coordination.rollout import rollout_partitions
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import make_group, make_tpu_nodes, simple_role
+
+
+def test_rollout_partitions_math():
+    g = make_group("x", simple_role("prefill", replicas=8),
+                   simple_role("decode", replicas=8))
+    pol = CoordinatedRollingUpdate(roles=["prefill", "decode"],
+                                   max_skew_percent=25)
+    # Nothing updated: both roles open 25% (+1 slowest rule) → allowed 2.
+    parts = rollout_partitions(g, pol, {"prefill": 0, "decode": 0})
+    assert parts == {"prefill": 6, "decode": 6}
+    # prefill raced ahead: it gets capped; decode (slowest) gets +1 headroom.
+    parts = rollout_partitions(g, pol, {"prefill": 4, "decode": 0})
+    assert parts["prefill"] == 8 - 2   # floor(8*(0+0.25)) = 2
+    assert parts["decode"] == 8 - 2    # max(floor(2), 0+1) = 2
+    # Both done: fully open.
+    parts = rollout_partitions(g, pol, {"prefill": 8, "decode": 8})
+    assert parts == {"prefill": 0, "decode": 0}
+
+
+def test_coordinated_rollout_end_to_end():
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=2, hosts_per_slice=2)
+    with plane:
+        r1 = simple_role("prefill", replicas=4)
+        r2 = simple_role("decode", replicas=4)
+        # Recreate path so rollout progress is observable per-instance.
+        r1.rolling_update = RollingUpdate(max_unavailable=2, in_place_if_possible=False)
+        r2.rolling_update = RollingUpdate(max_unavailable=2, in_place_if_possible=False)
+        plane.apply(make_group("pd", r1, r2))
+        pol = CoordinatedPolicy()
+        pol.metadata.name = "pd-ru"
+        pol.spec = CoordinatedPolicySpec(
+            group_name="pd",
+            rolling_update=CoordinatedRollingUpdate(
+                roles=["prefill", "decode"], max_skew_percent=25),
+        )
+        plane.apply(pol)
+        plane.wait_group_ready("pd", timeout=30)
+
+        rev0 = plane.store.get("RoleInstanceSet", "default",
+                               "pd-prefill").status.update_revision
+        g = plane.store.get("RoleBasedGroup", "default", "pd")
+        for role in g.spec.roles:
+            role.template.containers[0].image = "engine:v2"
+        plane.store.update(g)
+
+        skew_violations = []
+
+        def converged():
+            a = plane.store.get("RoleInstanceSet", "default", "pd-prefill")
+            b = plane.store.get("RoleInstanceSet", "default", "pd-decode")
+            if a.status.update_revision == rev0 or b.status.update_revision == rev0:
+                return False  # rollout not observed yet — old-revision counts lie
+            ua, ub = a.status.updated_ready_replicas, b.status.updated_ready_replicas
+            # Track observed skew (allow the +1 no-deadlock step + in-flight
+            # batch of maxUnavailable).
+            if abs(ua - ub) > 4 * 0.25 + 1 + 2:
+                skew_violations.append((ua, ub))
+            return ua == 4 and ub == 4
+
+        plane.wait_for(converged, timeout=60, desc="coordinated rollout done")
+        assert not skew_violations, f"skew exceeded bound: {skew_violations}"
+
+        pods = [p for p in plane.store.list("Pod", namespace="default") if p.active]
+        assert all(p.template.containers[0].image == "engine:v2" for p in pods)

@@ -1,0 +1,43 @@
+"""RoleTemplate (KEP-8): shared pod templates referenced by roles."""
+
+from rbg_tpu.api.group import RoleSpec, RoleTemplate
+from rbg_tpu.api.pod import Container, PodTemplate
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import make_group, make_tpu_nodes
+
+
+def test_template_ref_resolution():
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=1, hosts_per_slice=2)
+    with plane:
+        tmpl = RoleTemplate()
+        tmpl.metadata.name = "std-engine"
+        tmpl.template = PodTemplate(containers=[Container(
+            name="engine", image="engine:std", command=["serve"])])
+        plane.apply(tmpl)
+
+        # Two roles share the template; neither repeats the pod spec.
+        plane.apply(make_group(
+            "shared",
+            RoleSpec(name="a", replicas=1, template_ref="std-engine"),
+            RoleSpec(name="b", replicas=1, template_ref="std-engine"),
+        ))
+        plane.wait_group_ready("shared", timeout=20)
+        pods = plane.store.list("Pod", namespace="default")
+        assert len(pods) == 2
+        assert all(p.template.containers[0].image == "engine:std" for p in pods)
+
+
+def test_missing_template_ref_reports_event():
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=1, hosts_per_slice=1)
+    with plane:
+        plane.apply(make_group(
+            "ghost", RoleSpec(name="a", replicas=1, template_ref="nope")))
+
+        def event_recorded():
+            g = plane.store.get("RoleBasedGroup", "default", "ghost")
+            return any(r == "MissingRoleTemplate"
+                       for (_, _, r, _) in plane.store.events_for(g))
+
+        plane.wait_for(event_recorded, timeout=10, desc="missing-template event")

@@ -192,30 +192,57 @@ class Engine:
     # ---- prefill ----
 
     def _prefill_step(self) -> List[StepEvent]:
-        events = []
-        for req in list(self.running):
-            if req.state != "prefill":
-                continue
-            chunk = self.cfg.prefill_chunk
+        """Advance every prefilling request by one chunk — BATCHED: all
+        in-flight prefills share one (B, chunk) forward (rows carry their own
+        positions/lengths/page tables), so admission bursts fill the MXU
+        instead of running B=1 chunks serially."""
+        batch = [r for r in self.running if r.state == "prefill"]
+        if not batch:
+            return []
+        chunk = self.cfg.prefill_chunk
+        rows = []
+        for req in batch:
             start = req.prefill_pos
             end = min(start + chunk, len(req.prompt))
-            toks = req.prompt[start:end]
-            T = len(toks)
-            last = end == len(req.prompt)
+            rows.append((req, start, end))
 
-            logits = self._run(
-                tokens=[toks], positions=[list(range(start, end))],
-                lens=[end], pages=[req.pages], T_bucket=chunk,
-            )
+        B = self._bucket(len(batch))
+        logits = self._run(
+            tokens=[req.prompt[s:e] for req, s, e in rows],
+            positions=[list(range(s, e)) for _, s, e in rows],
+            lens=[e for _, _, e in rows],
+            pages=[req.pages for req, _, _ in rows],
+            T_bucket=chunk, B_bucket=B,
+        )
+
+        finishing = []
+        for i, (req, start, end) in enumerate(rows):
             req.prefill_pos = end
             req.seq_len = end
-            self.metrics["prefill_tokens"] += T
-            if last:
-                # Only the final chunk's last row ever leaves the device.
-                tok = self._sample_one(logits[0, T - 1], req)
-                req.state = "running"
-                req.t_first = time.perf_counter()
-                events.append(self._emit(req, tok))
+            self.metrics["prefill_tokens"] += end - start
+            if end == len(req.prompt):
+                finishing.append((i, end - start - 1, req))
+        if not finishing:
+            return []
+
+        # One batched sample for every finishing row — a single device
+        # dispatch + host transfer (mirrors the decode path).
+        Bs = self._bucket(len(finishing))
+        sel = jnp.stack([logits[i, j] for i, j, _ in finishing]
+                        + [logits[0, 0]] * (Bs - len(finishing)))
+        temps = np.zeros(Bs, np.float32)
+        ks = np.zeros(Bs, np.int32)
+        for n, (_, _, req) in enumerate(finishing):
+            temps[n] = req.sampling.temperature
+            ks[n] = req.sampling.top_k
+        self._sample_key, sub = jax.random.split(self._sample_key)
+        toks = np.asarray(self._sampler(sel, sub, jnp.asarray(temps),
+                                        jnp.asarray(ks)))
+        events = []
+        for n, (_, _, req) in enumerate(finishing):
+            req.state = "running"
+            req.t_first = time.perf_counter()
+            events.append(self._emit(req, int(toks[n])))
         return events
 
     # ---- decode ----

@@ -59,6 +59,10 @@ def register(sub) -> None:
     scp.add_argument("--write", metavar="DIR", help="write per-kind files to DIR")
     scp.set_defaults(func=cmd_schema)
 
+    sub.add_parser(
+        "stress", help="control-plane scale harness (handled in main; see "
+                       "python -m rbg_tpu.stress.harness --help)")
+
     rp = sub.add_parser("rollout", help="rollout history|diff|undo")
     rp.add_argument("action", choices=["history", "diff", "undo"])
     rp.add_argument("name")

@@ -12,6 +12,14 @@ import sys
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # Pass-through subcommands with their own parsers (argparse subparsers
+    # don't reliably forward option-like REMAINDER args).
+    if argv and argv[0] == "stress":
+        from rbg_tpu.stress.harness import main as stress_main
+        return stress_main(argv[1:])
+
     parser = argparse.ArgumentParser(
         prog="rbg-tpu",
         description="TPU-native role-based group orchestration + serving",

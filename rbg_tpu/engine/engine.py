@@ -225,11 +225,13 @@ class Engine:
         if not finishing:
             return []
 
-        # One batched sample for every finishing row — a single device
-        # dispatch + host transfer (mirrors the decode path).
+        # One batched sample for every finishing row — a single gather +
+        # sampler dispatch + host transfer (mirrors the decode path).
         Bs = self._bucket(len(finishing))
-        sel = jnp.stack([logits[i, j] for i, j, _ in finishing]
-                        + [logits[0, 0]] * (Bs - len(finishing)))
+        pad = Bs - len(finishing)
+        row_idx = np.asarray([i for i, _, _ in finishing] + [0] * pad, np.int32)
+        tok_idx = np.asarray([j for _, j, _ in finishing] + [0] * pad, np.int32)
+        sel = logits[jnp.asarray(row_idx), jnp.asarray(tok_idx)]  # [Bs, V]
         temps = np.zeros(Bs, np.float32)
         ks = np.zeros(Bs, np.int32)
         for n, (_, _, req) in enumerate(finishing):

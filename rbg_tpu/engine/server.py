@@ -64,7 +64,8 @@ class Handler(socketserver.BaseRequestHandler):
             elif srv.prefill is not None:
                 stats = {**srv.prefill.engine.metrics, **srv.prefill.metrics}
             elif srv.decode is not None:
-                stats = {**srv.decode.engine.metrics, **srv.decode.metrics}
+                stats = {**srv.decode.worker.engine.metrics,
+                         **srv.decode.worker.metrics}
             send_msg(self.request, {"metrics": stats, "mode": srv.mode})
             return
         if op == "generate_text" and srv.service is not None:
@@ -144,14 +145,9 @@ class Handler(socketserver.BaseRequestHandler):
                 top_k=obj.get("top_k", 0),
                 stop_token=obj.get("stop_token"),
             )
-            with srv.pd_lock:
-                rid = srv.decode.inject(bundle, sampling)
-                eng = srv.decode.engine
-                tokens = [bundle.first_token]
-                while any(r.id == rid and r.state == "running" for r in eng.running):
-                    for ev in eng.step():
-                        if ev.request_id == rid:
-                            tokens.append(ev.token)
+            # Continuous batching: bundles from concurrent connections decode
+            # together on the device (no per-connection serialization).
+            tokens = srv.decode.submit_bundle(bundle, sampling)
             send_msg(self.request, {"tokens": tokens})
             return
         send_msg(self.request, {"error": f"unsupported op {op!r} in mode {srv.mode}"})
@@ -195,8 +191,8 @@ def serve(args) -> None:
             from rbg_tpu.engine.pd import PrefillWorker
             server.prefill = PrefillWorker(cfg)
         elif cfg.mode == "decode":
-            from rbg_tpu.engine.pd import DecodeWorker
-            server.decode = DecodeWorker(cfg)
+            from rbg_tpu.engine.service import DecodeService
+            server.decode = DecodeService(cfg)
         else:
             from rbg_tpu.engine.service import EngineService
             server.service = EngineService(cfg)

@@ -1,8 +1,12 @@
-"""EngineService: background continuous-batching loop + blocking submit API.
+"""Engine services: background continuous-batching loops + blocking APIs.
 
 Requests arriving on different connections batch together on the device —
-the server threads only enqueue and wait; one loop thread owns the engine
-(single-writer, no engine locking on the hot path).
+server threads only enqueue and wait; ONE loop thread owns each engine
+(single-writer, no engine locking on the hot path). ``EngineService`` serves
+unified generate; ``DecodeService`` serves the disaggregated decode role
+(KV-bundle injection). Both share the same loop machinery: locked queue
+swap, admission capped at the engine's max_batch, cancel routing (timeouts
+recycle batch slots + KV pages), and the event pump.
 """
 
 from __future__ import annotations
@@ -29,73 +33,76 @@ class _Pending:
 DEFAULT_TIMEOUT_S = 600.0
 
 
-class EngineService:
-    def __init__(self, cfg: EngineConfig, params=None, mesh=None):
-        self.engine = Engine(cfg, params=params, mesh=mesh)
+class _BatchService:
+    """Shared loop: subclasses implement ``_admit(item, sampling) -> rid``
+    (raising on bad input fails just that request) and expose ``engine``."""
+
+    engine: Engine
+
+    def __init__(self):
         self._pending: Dict[int, _Pending] = {}
-        self._lock = threading.Lock()          # guards queue handoff only
+        self._lock = threading.Lock()
         self._wake = threading.Event()
-        self._stop = False
-        self._queue: List[Tuple[List[int], SamplingParams, _Pending]] = []
+        self._stopped = False
+        self._queue: List[Tuple[object, SamplingParams, _Pending]] = []
         self._cancels: List[_Pending] = []
         self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="engine-loop")
+                                        name=type(self).__name__.lower())
         self._thread.start()
 
-    def submit(self, prompt: List[int], sampling: SamplingParams,
-               timeout: float = DEFAULT_TIMEOUT_S) -> Tuple[List[int], float]:
-        """Blocking generate. Returns (tokens, ttft_seconds)."""
-        p = self.submit_async(prompt, sampling)
+    # -- subclass hook --
+    def _admit(self, item, sampling: SamplingParams) -> Optional[int]:
+        raise NotImplementedError
+
+    # -- public --
+    def submit_async(self, item, sampling: SamplingParams) -> _Pending:
+        p = _Pending()
+        with self._lock:
+            self._queue.append((item, sampling, p))
+        self._wake.set()
+        return p
+
+    def wait(self, p: _Pending, timeout: float) -> List[int]:
         if not p.done.wait(timeout):
             self.cancel(p)  # recycle batch slot + KV pages, don't orphan
             raise TimeoutError("generation timed out")
         if p.error:
             raise ValueError(p.error)
-        return p.tokens, (p.t_first - p.t_submit if p.t_first else 0.0)
+        return p.tokens
 
-    def cancel(self, pending: "_Pending") -> None:
+    def cancel(self, pending: _Pending) -> None:
         """Abort an in-flight request (routed through the loop thread)."""
         with self._lock:
             self._cancels.append(pending)
         self._wake.set()
 
-    def submit_async(self, prompt: List[int], sampling: SamplingParams) -> _Pending:
-        """Enqueue and return the live Pending (stream by watching .tokens
-        grow until .done is set)."""
-        p = _Pending()
-        with self._lock:
-            self._queue.append((prompt, sampling, p))
-        self._wake.set()
-        return p
-
-    def stats(self) -> dict:
-        out = dict(self.engine.metrics)
-        out["running"] = len(self.engine.running)
-        out["waiting"] = len(self.engine.waiting)
-        out["free_pages"] = self.engine.allocator.free_pages
-        out["radix_nodes"] = (self.engine.radix.num_nodes
-                              if self.engine.radix is not None else 0)
-        return out
-
     def stop(self):
-        self._stop = True
+        self._stopped = True
         self._wake.set()
 
+    # -- loop --
     def _loop(self):
         eng = self.engine
-        while not self._stop:
+        while not self._stopped:
             with self._lock:
-                newly = self._queue
-                self._queue = []
                 cancels = self._cancels
                 self._cancels = []
-            for prompt, sampling, pending in newly:
+                # Admission control: never exceed the engine's batch ceiling —
+                # excess items stay queued for later rounds.
+                budget = max(0, eng.cfg.max_batch
+                             - len(eng.running) - len(eng.waiting))
+                newly = self._queue[:budget]
+                self._queue = self._queue[budget:]
+            for item, sampling, pending in newly:
                 try:
-                    rid = eng.add_request(prompt, sampling)
+                    rid = self._admit(item, sampling)
                 except Exception as e:
                     # A bad request must fail ITSELF, never the loop thread.
                     pending.error = str(e)
                     pending.done.set()
+                    continue
+                if rid is None:
+                    pending.done.set()  # completed at admission
                     continue
                 self._pending[rid] = pending
             for pending in cancels:
@@ -105,9 +112,17 @@ class EngineService:
                     eng.cancel_request(rid)
                     del self._pending[rid]
                     pending.done.set()
+                else:
+                    # Still queued (never admitted) — drop it from the queue.
+                    with self._lock:
+                        self._queue = [q for q in self._queue if q[2] is not pending]
+                    pending.done.set()
             if not eng.has_work():
-                self._wake.wait(0.01)
-                self._wake.clear()
+                with self._lock:
+                    empty = not self._queue and not self._cancels
+                if empty:
+                    self._wake.wait(0.01)
+                    self._wake.clear()
                 continue
             for ev in eng.step():
                 pending = self._pending.get(ev.request_id)
@@ -119,3 +134,53 @@ class EngineService:
                 if ev.finished:
                     pending.done.set()
                     del self._pending[ev.request_id]
+
+
+class EngineService(_BatchService):
+    def __init__(self, cfg: EngineConfig, params=None, mesh=None):
+        self.engine = Engine(cfg, params=params, mesh=mesh)
+        super().__init__()
+
+    def _admit(self, prompt, sampling: SamplingParams) -> Optional[int]:
+        return self.engine.add_request(prompt, sampling)
+
+    def submit(self, prompt: List[int], sampling: SamplingParams,
+               timeout: float = DEFAULT_TIMEOUT_S) -> Tuple[List[int], float]:
+        """Blocking generate. Returns (tokens, ttft_seconds)."""
+        p = self.submit_async(prompt, sampling)
+        tokens = self.wait(p, timeout)
+        return tokens, (p.t_first - p.t_submit if p.t_first else 0.0)
+
+    def stats(self) -> dict:
+        out = dict(self.engine.metrics)
+        out["running"] = len(self.engine.running)
+        out["waiting"] = len(self.engine.waiting)
+        out["free_pages"] = self.engine.allocator.free_pages
+        out["radix_nodes"] = (self.engine.radix.num_nodes
+                              if self.engine.radix is not None else 0)
+        return out
+
+
+class DecodeService(_BatchService):
+    """Disaggregated decode role: KV bundles from many router connections
+    decode TOGETHER on the device instead of serializing per connection."""
+
+    def __init__(self, cfg, params=None, mesh=None):
+        from rbg_tpu.engine.pd import DecodeWorker
+
+        self.worker = DecodeWorker(cfg, params=params, mesh=mesh)
+        self.engine = self.worker.engine
+        super().__init__()
+
+    def _admit(self, bundle, sampling: SamplingParams) -> Optional[int]:
+        rid = self.worker.inject(bundle, sampling)
+        req = self.engine.requests.get(rid)
+        if req is None or req.state == "finished":
+            return None  # completed at inject (max_new_tokens == 1 / stop)
+        return rid
+
+    def submit_bundle(self, bundle, sampling: SamplingParams,
+                      timeout: float = DEFAULT_TIMEOUT_S) -> List[int]:
+        p = self.submit_async(bundle, sampling)
+        tokens = self.wait(p, timeout)
+        return [bundle.first_token] + tokens

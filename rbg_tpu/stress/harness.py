@@ -153,16 +153,49 @@ def main(argv=None) -> int:
     ap.add_argument("--slices", type=int, default=64)
     ap.add_argument("--hosts", type=int, default=4)
     ap.add_argument("--json", action="store_true", help="machine output only")
+    ap.add_argument("--html", metavar="FILE", help="also write an HTML report")
     args = ap.parse_args(argv)
     cfg = StressConfig(groups=args.groups, roles_per_group=args.roles,
                        replicas=args.replicas, create_qps=args.qps,
                        slices=args.slices, hosts_per_slice=args.hosts)
     report = run_stress(cfg)
+    if args.html:
+        write_html_report(report, args.html)
     if args.json:
         print(json.dumps(report))
     else:
         print(json.dumps(report, indent=2))
     return 0
+
+
+def write_html_report(report: dict, path: str) -> None:
+    """HTML report (reference analog: test/stress report.go's HTML output)."""
+    rows = []
+    for phase in ("create_to_ready_ms", "update_to_converged_ms",
+                  "delete_to_gone_ms"):
+        p = report[phase]
+        rows.append(
+            f"<tr><td>{phase.replace('_', ' ')}</td>"
+            f"<td>{p.get('p50', 0)}</td><td>{p.get('p90', 0)}</td>"
+            f"<td>{p.get('p99', 0)}</td><td>{p.get('max', 0)}</td>"
+            f"<td>{p.get('n', 0)}</td></tr>")
+    rec = "".join(
+        f"<tr><td>{c}</td><td>{v}</td></tr>"
+        for c, v in (report.get("reconcile_p99_s") or {}).items())
+    html = f"""<!doctype html><html><head><meta charset="utf-8">
+<title>rbg-tpu stress report</title>
+<style>body{{font-family:sans-serif;margin:2rem}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #999;padding:4px 10px;text-align:right}}
+th{{background:#eee}}td:first-child{{text-align:left}}</style></head><body>
+<h1>rbg-tpu control-plane stress report</h1>
+<p>config: {json.dumps(report.get("config", {}))}</p>
+<table><tr><th>phase</th><th>p50 (ms)</th><th>p90</th><th>p99</th>
+<th>max</th><th>n</th></tr>{"".join(rows)}</table>
+<h2>reconcile p99 (s)</h2>
+<table><tr><th>controller</th><th>p99</th></tr>{rec}</table>
+</body></html>"""
+    with open(path, "w") as f:
+        f.write(html)
 
 
 if __name__ == "__main__":

@@ -33,6 +33,9 @@ def register(sub) -> None:
     sp.add_argument("--slices", type=int, default=2)
     sp.add_argument("--hosts", type=int, default=2)
     sp.add_argument("--admin-port", type=int, default=7070)
+    sp.add_argument("--state-file", default="",
+                    help="persist the object store here; a restarted serve "
+                         "resumes from it (the etcd-snapshot analog)")
     sp.set_defaults(func=cmd_serve)
 
     stp = sub.add_parser("status", help="group status (against a serve plane)")
@@ -142,14 +145,24 @@ def cmd_serve(args) -> int:
     from rbg_tpu.runtime.plane import ControlPlane
     from rbg_tpu.testutil import make_tpu_nodes
 
+    import json as _json
+    import os as _os
+
     plane = ControlPlane(backend=args.backend)
-    if args.backend == "fake":
-        make_tpu_nodes(plane.store, slices=args.slices, hosts_per_slice=args.hosts)
-    else:
-        from rbg_tpu.api.pod import Node
-        node = Node()
-        node.metadata.name = "localhost"
-        plane.store.create(node)
+    restored = 0
+    if args.state_file and _os.path.exists(args.state_file):
+        with open(args.state_file) as f:
+            restored = plane.store.load_snapshot(_json.load(f))
+        print(f"restored {restored} objects from {args.state_file}", flush=True)
+    if restored == 0:
+        if args.backend == "fake":
+            make_tpu_nodes(plane.store, slices=args.slices,
+                           hosts_per_slice=args.hosts)
+        else:
+            from rbg_tpu.api.pod import Node
+            node = Node()
+            node.metadata.name = "localhost"
+            plane.store.create(node)
     plane.start()
     admin = AdminServer(plane, args.admin_port).start()
     print(f"plane serving; admin on 127.0.0.1:{admin.port}", flush=True)
@@ -158,11 +171,24 @@ def cmd_serve(args) -> int:
             plane.apply(o)
             print(f"applied {o.kind}/{o.metadata.name}", flush=True)
 
+    def save_state():
+        if not args.state_file:
+            return
+        tmp = args.state_file + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(plane.store.snapshot(), f)
+        _os.replace(tmp, args.state_file)
+
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    last_save = _time.monotonic()
     while not stop:
         _time.sleep(0.2)
+        if args.state_file and _time.monotonic() - last_save > 5.0:
+            save_state()
+            last_save = _time.monotonic()
+    save_state()
     admin.stop()
     plane.stop()
     return 0

@@ -112,6 +112,15 @@ class Controller:
         self._started = True
         for w in self.watches():
             self.store.watch(w.kind, lambda ev, w=w: self._on_event(w, ev))
+        # Initial sync (the informer LIST): a restarted plane must reconcile
+        # every pre-existing object, or changes made while no controllers ran
+        # are never observed (level-triggered ≠ event-sourced).
+        for w in self.watches():
+            if w.kind == "*":
+                continue
+            for obj in self.store.list(w.kind, namespace=None, copy_=False):
+                for key in w.mapper(obj):
+                    self.queue.add(key)
         for i in range(self.workers):
             t = threading.Thread(
                 target=self._worker, name=f"{self.name}-{i}", daemon=True

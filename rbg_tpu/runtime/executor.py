@@ -58,6 +58,16 @@ class LocalExecutor:
     def start(self):
         self.store.watch("Pod", self._on_event)
         for pod in self.store.list("Pod"):
+            # Restored-from-snapshot pods claim to be Running but have no
+            # backing process on this (fresh) executor — fail them so the
+            # restart-policy engine relaunches real processes (the node-
+            # reboot analog). Without this a resumed plane is a zombie:
+            # Ready status, dead ports.
+            if (pod.status.phase == "Running"
+                    and (pod.metadata.namespace, pod.metadata.name) not in self._procs):
+                self._set_status((pod.metadata.namespace, pod.metadata.name),
+                                 "Failed", ready=False)
+                continue
             self._on_event(Event(Event.ADDED, pod))
 
     def stop(self):

@@ -263,6 +263,37 @@ class Store:
         for kind, ns, name in keys:
             self.delete(kind, ns, name)
 
+    # ---- persistence (etcd-snapshot equivalent) ----
+
+    def snapshot(self) -> dict:
+        """Serializable snapshot of every object + the rv counter.
+        Serialization runs OUTSIDE the lock (stored objects are never mutated
+        in place — update/mutate always insert fresh copies), so periodic
+        saves don't stall controller CRUD."""
+        from rbg_tpu.api import serde
+        with self._lock:
+            rv = self._rv
+            objects = list(self._objects.values())
+        return {"rv": rv, "objects": [serde.to_dict(o) for o in objects]}
+
+    def load_snapshot(self, data: dict) -> int:
+        """Restore objects from a snapshot into an empty store. Watches fire
+        no events (controllers do their initial LIST sync on start)."""
+        from rbg_tpu.api import parse_manifest
+        count = 0
+        with self._lock:
+            self._rv = max(self._rv, int(data.get("rv", 0)))
+            for doc in data.get("objects", []):
+                obj = parse_manifest(doc)
+                k = self.key(obj)
+                if k in self._objects:
+                    continue
+                self._objects[k] = obj
+                for ref in obj.metadata.owner_references:
+                    self._owner_index[ref.uid].add(k)
+                count += 1
+        return count
+
     # ---- event recorder (k8s Events equivalent) ----
 
     def record_event(self, obj, reason: str, message: str):

@@ -1,0 +1,65 @@
+"""Stateless instance engine: random ids, delete preferences,
+specified-delete (CloneSet semantics — reference statelessmode)."""
+
+import re
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.runtime.controllers.instanceset import ANN_SPECIFIED_DELETE
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import make_group, make_tpu_nodes, simple_role
+
+
+def _plane():
+    p = ControlPlane(backend="fake")
+    make_tpu_nodes(p.store, slices=1, hosts_per_slice=2)
+    return p
+
+
+def test_stateless_random_ids_and_scale():
+    with _plane() as plane:
+        role = simple_role("worker", replicas=3)
+        role.stateful = False
+        plane.apply(make_group("sl", role))
+        plane.wait_group_ready("sl", timeout=20)
+
+        insts = plane.store.list("RoleInstance", namespace="default")
+        assert len(insts) == 3
+        # CloneSet-style names: {set}-{5-char random id}, not ordinals.
+        for i in insts:
+            assert re.fullmatch(r"sl-worker-[a-z0-9]{5}", i.metadata.name)
+            assert C.LABEL_INSTANCE_INDEX not in i.metadata.labels
+
+        g = plane.store.get("RoleBasedGroup", "default", "sl")
+        g.spec.roles[0].replicas = 1
+        plane.store.update(g)
+        plane.wait_for(
+            lambda: len([p for p in plane.store.list("Pod", namespace="default")
+                         if p.active]) == 1,
+            timeout=20, desc="stateless scale down",
+        )
+
+
+def test_specified_delete_annotation():
+    with _plane() as plane:
+        role = simple_role("worker", replicas=2)
+        role.stateful = False
+        plane.apply(make_group("sd", role))
+        plane.wait_group_ready("sd", timeout=20)
+
+        victim = plane.store.list("RoleInstance", namespace="default")[0]
+        name = victim.metadata.name
+
+        def mark(i):
+            i.metadata.annotations[ANN_SPECIFIED_DELETE] = "true"
+            return True
+
+        plane.store.mutate("RoleInstance", "default", name, mark)
+
+        def replaced():
+            insts = plane.store.list("RoleInstance", namespace="default")
+            names = {i.metadata.name for i in insts}
+            return len(insts) == 2 and name not in names
+
+        plane.wait_for(replaced, timeout=20,
+                       desc="specified-delete replaced the instance")
+        plane.wait_group_ready("sd", timeout=20)

@@ -283,12 +283,16 @@ def forward_train(
     tokens: jnp.ndarray,                       # [B, T] int32
     token_mask: Optional[jnp.ndarray] = None,  # [B, T] bool
     mesh=None,                                 # Mesh with an "sp" axis → ring
+    remat: bool = False,                       # jax.checkpoint per block
 ) -> jnp.ndarray:
     """Cache-free causal forward for training. Returns logits [B, T, V] f32.
 
     With a mesh whose ``sp`` axis is > 1, attention runs as ring attention
     over sequence shards (exact; ICI neighbor exchange) instead of relying on
-    XLA to all-gather the sequence dim.
+    XLA to all-gather the sequence dim. ``remat=True`` rematerializes each
+    block's activations in the backward pass (trade FLOPs for HBM — the
+    standard deep-stack training memory lever; activations per layer drop
+    from O(B·T·(D+F+heads·T)) to the block boundary only).
     """
     B, T = tokens.shape
     if token_mask is None:
@@ -308,14 +312,19 @@ def forward_train(
 
     x = params["embed"].astype(cfg.jax_dtype)[tokens]
 
-    def step(h, blk):
+    def body(h, blk):
         if use_ring:
             q, k, vv = _qkv(cfg, blk, h, positions)
             attn = ring_attention(q, k, vv, positions, kv_positions, mesh)
-            h = _post_attention(cfg, blk, h, attn)
-        else:
-            h, _, _ = _block(cfg, h, blk, None, None, positions, token_mask)
-        return h, None
+            return _post_attention(cfg, blk, h, attn)
+        h, _, _ = _block(cfg, h, blk, None, None, positions, token_mask)
+        return h
+
+    if remat:
+        body = jax.checkpoint(body)
+
+    def step(h, blk):
+        return body(h, blk), None
 
     x, _ = jax.lax.scan(step, x, params["blocks"])
     return _head(params, cfg, x)

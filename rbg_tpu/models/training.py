@@ -20,12 +20,13 @@ from rbg_tpu.parallel import sharding as shd
 
 
 def next_token_loss(params, cfg: ModelConfig, tokens, token_mask=None,
-                    mesh=None):
+                    mesh=None, remat=False):
     """Mean next-token cross-entropy over non-pad positions."""
     B, T = tokens.shape
     if token_mask is None:
         token_mask = jnp.ones((B, T), bool)
-    logits = forward_train(params, cfg, tokens, token_mask, mesh=mesh)  # [B, T, V]
+    logits = forward_train(params, cfg, tokens, token_mask, mesh=mesh,
+                           remat=remat)  # [B, T, V]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
@@ -33,7 +34,8 @@ def next_token_loss(params, cfg: ModelConfig, tokens, token_mask=None,
     return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
-def make_train_step(cfg: ModelConfig, mesh: Mesh, learning_rate: float = 3e-4):
+def make_train_step(cfg: ModelConfig, mesh: Mesh, learning_rate: float = 3e-4,
+                    remat: bool = False):
     """Build (init_fn, train_step) jitted over ``mesh``.
 
     Shardings: params per Megatron rules (tp), batch over dp, sequence over
@@ -87,7 +89,7 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, learning_rate: float = 3e-4):
 
     def _step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(next_token_loss)(
-            params, cfg, tokens, mesh=mesh)
+            params, cfg, tokens, mesh=mesh, remat=remat)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

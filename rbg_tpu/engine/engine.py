@@ -312,12 +312,6 @@ class Engine:
             self._finish(req)
         return StepEvent(req.id, tok, finished)
 
-    def _sample_one(self, logits_row, req: Request) -> int:
-        self._sample_key, sub = jax.random.split(self._sample_key)
-        t = jnp.asarray([req.sampling.temperature], jnp.float32)
-        k = jnp.asarray([req.sampling.top_k], jnp.int32)
-        return int(np.asarray(self._sampler(logits_row[None], sub, t, k))[0])
-
     # ---- lifecycle ----
 
     def _finish(self, req: Request):

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from rbg_tpu.api.meta import Condition, ObjectMeta
 from rbg_tpu.api.pod import Container, PodTemplate

@@ -13,7 +13,7 @@ import dataclasses
 import enum
 import re
 import typing
-from typing import Any, Optional, Type, TypeVar, get_args, get_origin
+from typing import Any, Type, TypeVar, get_args, get_origin
 
 T = TypeVar("T")
 

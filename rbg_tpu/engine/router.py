@@ -14,12 +14,10 @@ Discovers its backends from the address registry the executor maintains
 from __future__ import annotations
 
 import argparse
-import itertools
 import json
 import os
 import socketserver
 import sys
-import threading
 import time
 from typing import Dict, List, Optional
 

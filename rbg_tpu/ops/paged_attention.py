@@ -16,8 +16,6 @@ Two implementations behind one signature:
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 

@@ -16,7 +16,7 @@ import threading
 import traceback
 from typing import Callable, List, Optional, Tuple
 
-from rbg_tpu.runtime.queue import ExponentialBackoff, WorkQueue
+from rbg_tpu.runtime.queue import ExponentialBackoff
 from rbg_tpu.runtime.store import Event, Store
 
 log = logging.getLogger("rbg_tpu.runtime")

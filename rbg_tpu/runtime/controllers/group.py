@@ -23,7 +23,7 @@ from rbg_tpu.api.policy import PodGroup, PodGroupSpec
 from rbg_tpu.api.validation import ValidationError, validate_group
 from rbg_tpu.coordination.dependency import dependencies_ready, sort_roles
 from rbg_tpu.runtime.controller import (
-    Controller, Result, Watch, label_keys, own_keys, owner_keys,
+    Controller, Result, Watch, own_keys, owner_keys,
 )
 from rbg_tpu.runtime.store import AlreadyExists, Store
 from rbg_tpu.utils import spec_hash

@@ -22,6 +22,7 @@ class EngineConfig:
     mode: str = "unified"                   # unified | prefill | decode
     mesh_spec: Optional[dict] = None        # {"dp": 1, "tp": 4} — from discovery
     checkpoint_path: str = ""               # orbax dir or local HF dir
+    kv_dtype: str = "model"                 # model | int8 (quantized KV pool)
     seed: int = 0
 
     @property
@@ -37,6 +38,16 @@ class EngineConfig:
             raise ValueError("max_batch exceeds largest decode bucket")
         if self.num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        if self.kv_dtype not in ("model", "int8"):
+            raise ValueError(f"kv_dtype {self.kv_dtype!r} not in (model, int8)")
+        if self.kv_dtype == "int8" and self.mode != "unified":
+            raise ValueError(
+                "int8 KV is unified-mode only for now (PD bundles carry "
+                "unquantized pages)")
+        if self.kv_dtype == "int8" and self.use_pallas == "always":
+            raise ValueError(
+                "use_pallas='always' is incompatible with kv_dtype='int8' — "
+                "the Pallas kernel does not dequantize yet; use 'auto'")
 
 
 @dataclasses.dataclass

@@ -89,7 +89,8 @@ class Engine:
             self.params = init_params(self.mcfg, key)
         self._sample_key = jax.random.key(cfg.seed + 1)
 
-        self.cache = PagedKVCache.create(self.mcfg, cfg.num_pages, cfg.page_size)
+        self.cache = PagedKVCache.create(self.mcfg, cfg.num_pages, cfg.page_size,
+                                         quantize=(cfg.kv_dtype == "int8"))
         self.allocator = PageAllocator(cfg.num_pages)
         self.radix = RadixCache(self.allocator, cfg.page_size) if cfg.enable_radix_cache else None
 
@@ -113,6 +114,10 @@ class Engine:
         self.cache = PagedKVCache(
             k_pages=jax.device_put(self.cache.k_pages, page_spec),
             v_pages=jax.device_put(self.cache.v_pages, page_spec),
+            k_scales=(jax.device_put(self.cache.k_scales, page_spec)
+                      if self.cache.quantized else None),
+            v_scales=(jax.device_put(self.cache.v_scales, page_spec)
+                      if self.cache.quantized else None),
         )
 
     # ---- public API ----
@@ -397,13 +402,15 @@ class Engine:
                                      use_pallas=self.cfg.use_pallas)
 
             def wrapped(params, tokens, positions, token_mask, kv_lens,
-                        page_table, k_pages, v_pages):
+                        page_table, k_pages, v_pages, k_scales, v_scales):
                 return base(params, tokens=tokens, positions=positions,
                             token_mask=token_mask, kv_lens=kv_lens,
                             page_table=page_table, k_pages=k_pages,
-                            v_pages=v_pages)
+                            v_pages=v_pages, k_scales=k_scales,
+                            v_scales=v_scales)
 
-            fn = jax.jit(wrapped, donate_argnums=(6, 7))
+            donate = (6, 7, 8, 9) if self.cache.quantized else (6, 7)
+            fn = jax.jit(wrapped, donate_argnums=donate)
             self._fwd_cache[key] = fn
         return fn
 
@@ -424,10 +431,12 @@ class Engine:
             kvl[i] = ln
             table[i, :len(pg)] = pg
         fn = self._get_fwd(B, T)
-        logits, k_pages, v_pages = fn(
+        logits, k_pages, v_pages, k_scales, v_scales = fn(
             self.params, jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(mask),
             jnp.asarray(kvl), jnp.asarray(table),
             self.cache.k_pages, self.cache.v_pages,
+            self.cache.k_scales, self.cache.v_scales,
         )
-        self.cache = PagedKVCache(k_pages=k_pages, v_pages=v_pages)
+        self.cache = PagedKVCache(k_pages=k_pages, v_pages=v_pages,
+                                  k_scales=k_scales, v_scales=v_scales)
         return logits  # device array; callers slice what they need

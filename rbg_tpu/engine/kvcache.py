@@ -28,8 +28,14 @@ from rbg_tpu.models.config import ModelConfig
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class PagedKVCache:
-    k_pages: jnp.ndarray  # [L, NP, page, KV, hd]
+    """k/v pages [L, NP, page, KV, hd]. With int8 quantization the pages are
+    int8 and per-(slot, head) scales live alongside ([L, NP, page, KV, 1]) —
+    halving KV HBM at a small accuracy cost (per-vector absmax scaling)."""
+
+    k_pages: jnp.ndarray
     v_pages: jnp.ndarray
+    k_scales: Optional[jnp.ndarray] = None
+    v_scales: Optional[jnp.ndarray] = None
 
     @property
     def page_size(self) -> int:
@@ -39,11 +45,23 @@ class PagedKVCache:
     def num_pages(self) -> int:
         return self.k_pages.shape[1]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scales is not None
+
     @staticmethod
     def create(cfg: ModelConfig, num_pages: int, page_size: int = 16,
-               dtype=None) -> "PagedKVCache":
-        dtype = dtype or cfg.jax_dtype
+               dtype=None, quantize: bool = False) -> "PagedKVCache":
         shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim_)
+        if quantize:
+            sshape = shape[:-1] + (1,)
+            return PagedKVCache(
+                k_pages=jnp.zeros(shape, jnp.int8),
+                v_pages=jnp.zeros(shape, jnp.int8),
+                k_scales=jnp.zeros(sshape, jnp.float32),
+                v_scales=jnp.zeros(sshape, jnp.float32),
+            )
+        dtype = dtype or cfg.jax_dtype
         return PagedKVCache(k_pages=jnp.zeros(shape, dtype),
                             v_pages=jnp.zeros(shape, dtype))
 

@@ -33,6 +33,7 @@ def build_config(args) -> EngineConfig:
         max_seq_len=args.max_seq_len, prefill_chunk=args.prefill_chunk,
         use_pallas=args.use_pallas,
         checkpoint_path=args.checkpoint_path,
+        kv_dtype=args.kv_dtype,
     )
 
 
@@ -184,18 +185,25 @@ def serve(args) -> None:
     # Bind the port FIRST (readiness probes connect), then load model and
     # tokenizer in the background — a slow HF load must not stall accepts.
     def init_engine():
-        if args.tokenizer_path:
-            from rbg_tpu.engine.tokenizer import load_tokenizer
-            server.tokenizer = load_tokenizer(args.tokenizer_path)
-        if cfg.mode == "prefill":
-            from rbg_tpu.engine.pd import PrefillWorker
-            server.prefill = PrefillWorker(cfg)
-        elif cfg.mode == "decode":
-            from rbg_tpu.engine.service import DecodeService
-            server.decode = DecodeService(cfg)
-        else:
-            from rbg_tpu.engine.service import EngineService
-            server.service = EngineService(cfg)
+        try:
+            if args.tokenizer_path:
+                from rbg_tpu.engine.tokenizer import load_tokenizer
+                server.tokenizer = load_tokenizer(args.tokenizer_path)
+            if cfg.mode == "prefill":
+                from rbg_tpu.engine.pd import PrefillWorker
+                server.prefill = PrefillWorker(cfg)
+            elif cfg.mode == "decode":
+                from rbg_tpu.engine.service import DecodeService
+                server.decode = DecodeService(cfg)
+            else:
+                from rbg_tpu.engine.service import EngineService
+                server.service = EngineService(cfg)
+        except Exception:
+            # A pod that cannot build its engine must CRASH (so the restart
+            # policy sees it), not linger as a never-ready zombie listener.
+            import traceback
+            traceback.print_exc()
+            os._exit(1)
         print(f"engine ready mode={cfg.mode} model={cfg.model} port={port}",
               flush=True)
 
@@ -216,6 +224,8 @@ def main(argv=None) -> int:
     ap.add_argument("--max-seq-len", type=int, default=1024)
     ap.add_argument("--prefill-chunk", type=int, default=64)
     ap.add_argument("--use-pallas", default="auto")
+    ap.add_argument("--kv-dtype", default="model", choices=["model", "int8"],
+                    help="int8 halves KV HBM (unified mode only)")
     ap.add_argument("--checkpoint-path",
                     default=os.environ.get("RBG_CHECKPOINT_PATH", ""),
                     help="orbax dir or local HF dir (else random init)")

@@ -251,30 +251,45 @@ def forward_paged(
     token_mask: jnp.ndarray,    # [B, T] bool — real (non-pad) tokens
     kv_lens: jnp.ndarray,       # [B] int32 — cache length AFTER this step
     page_table: jnp.ndarray,    # [B, P] int32 physical page ids
-    k_pages: jnp.ndarray,       # [L, NP, page, KV, hd]
+    k_pages: jnp.ndarray,       # [L, NP, page, KV, hd] (int8 when quantized)
     v_pages: jnp.ndarray,
     use_pallas: str = "auto",
+    k_scales: Optional[jnp.ndarray] = None,  # [L, NP, page, KV, 1] (int8 KV)
+    v_scales: Optional[jnp.ndarray] = None,
 ):
     """Serving forward over the paged KV pool (prefill chunks and decode steps
-    share this one traced program per (B, T) bucket).
+    share this one traced program per (B, T) bucket). With scales, the pool
+    is int8-quantized (per-vector absmax) — half the KV HBM.
 
-    Returns (logits [B, T, V] f32, k_pages, v_pages).
+    Returns (logits [B, T, V] f32, k_pages, v_pages, k_scales, v_scales).
     """
     from rbg_tpu.ops.paged_attention import paged_attention, write_kv_pages
 
     x = params["embed"].astype(cfg.jax_dtype)[tokens]
+    quantized = k_scales is not None
 
     def step(carry, xs):
         hcur = carry
-        blk, kp, vp = xs
+        if quantized:
+            blk, kp, vp, ks, vs = xs
+        else:
+            blk, kp, vp = xs
+            ks = vs = None
         q, k, vv = _qkv(cfg, blk, hcur, positions)
-        kp, vp = write_kv_pages(kp, vp, k, vv, page_table, positions, token_mask)
+        kp, vp, ks, vs = write_kv_pages(kp, vp, k, vv, page_table, positions,
+                                        token_mask, ks, vs)
         attn = paged_attention(q, kp, vp, page_table, positions, kv_lens,
-                               use_pallas=use_pallas)
-        return _post_attention(cfg, blk, hcur, attn), (kp, vp)
+                               use_pallas=use_pallas, k_scales=ks, v_scales=vs)
+        out = _post_attention(cfg, blk, hcur, attn)
+        return out, ((kp, vp, ks, vs) if quantized else (kp, vp))
 
-    x, (k_pages, v_pages) = jax.lax.scan(step, x, (params["blocks"], k_pages, v_pages))
-    return _head(params, cfg, x), k_pages, v_pages
+    if quantized:
+        xs = (params["blocks"], k_pages, v_pages, k_scales, v_scales)
+        x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(step, x, xs)
+    else:
+        x, (k_pages, v_pages) = jax.lax.scan(
+            step, x, (params["blocks"], k_pages, v_pages))
+    return _head(params, cfg, x), k_pages, v_pages, k_scales, v_scales
 
 
 def forward_train(

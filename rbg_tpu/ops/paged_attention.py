@@ -37,6 +37,8 @@ def paged_attention_xla(
     page_table: jnp.ndarray,   # [B, P] int32
     q_positions: jnp.ndarray,  # [B, T] int32 absolute positions
     kv_lens: jnp.ndarray,      # [B] int32 — valid tokens in cache (post-write)
+    k_scales: jnp.ndarray = None,  # [NP, page, KV, 1] f32 (int8 pools)
+    v_scales: jnp.ndarray = None,
 ) -> jnp.ndarray:
     B, T, H, hd = q.shape
     KV = k_pages.shape[2]
@@ -45,6 +47,9 @@ def paged_attention_xla(
 
     k = gather_kv(k_pages, page_table).astype(jnp.float32)  # [B, S, KV, hd]
     v = gather_kv(v_pages, page_table).astype(jnp.float32)
+    if k_scales is not None:
+        k = k * gather_kv(k_scales, page_table)
+        v = v * gather_kv(v_scales, page_table)
     qg = q.reshape(B, T, KV, G, hd).astype(jnp.float32)
 
     scores = jnp.einsum("btkgh,bskh->bkgts", qg, k) / jnp.sqrt(hd).astype(jnp.float32)
@@ -60,13 +65,22 @@ def paged_attention_xla(
     return out.reshape(B, T, H, hd).astype(q.dtype)
 
 
+def quantize_kv(x: jnp.ndarray):
+    """Per-(token, head) absmax int8 quantization. x: [..., hd] →
+    (int8 values, f32 scales [..., 1])."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = absmax / 127.0
+    q = jnp.round(x.astype(jnp.float32) / jnp.maximum(scale, 1e-10))
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
 def write_kv_pages(k_pages, v_pages, k_new, v_new, page_table, positions,
-                   token_mask):
-    """Scatter new K/V into the pool.
+                   token_mask, k_scales=None, v_scales=None):
+    """Scatter new K/V into the pool (quantizing when the pool is int8).
 
     k_new/v_new: [B, T, KV, hd]; positions: [B, T] absolute; pad tokens
-    (token_mask False) are routed to the reserved null page 0's... actually to
-    an out-of-range slot dropped by scatter ``mode="drop"``.
+    (token_mask False) are routed to an out-of-range slot dropped by scatter
+    ``mode="drop"``. Returns (k_pages, v_pages, k_scales, v_scales).
     """
     page_size = k_pages.shape[1]
     page_idx = positions // page_size                       # [B, T]
@@ -75,14 +89,27 @@ def write_kv_pages(k_pages, v_pages, k_new, v_new, page_table, positions,
     # Route pad writes out of range → dropped.
     NP = k_pages.shape[0]
     phys = jnp.where(token_mask, phys, NP)
+    if k_scales is not None:
+        k_q, k_s = quantize_kv(k_new)
+        v_q, v_s = quantize_kv(v_new)
+        k_pages = k_pages.at[phys, slot].set(k_q, mode="drop")
+        v_pages = v_pages.at[phys, slot].set(v_q, mode="drop")
+        k_scales = k_scales.at[phys, slot].set(k_s, mode="drop")
+        v_scales = v_scales.at[phys, slot].set(v_s, mode="drop")
+        return k_pages, v_pages, k_scales, v_scales
     k_pages = k_pages.at[phys, slot].set(k_new.astype(k_pages.dtype), mode="drop")
     v_pages = v_pages.at[phys, slot].set(v_new.astype(v_pages.dtype), mode="drop")
-    return k_pages, v_pages
+    return k_pages, v_pages, None, None
 
 
 def paged_attention(q, k_pages, v_pages, page_table, q_positions, kv_lens,
-                    *, use_pallas: str = "auto"):
-    """Dispatch between the Pallas TPU kernel and the XLA fallback."""
+                    *, use_pallas: str = "auto", k_scales=None, v_scales=None):
+    """Dispatch between the Pallas TPU kernel and the XLA fallback.
+    Quantized (int8 + scales) pools always take the XLA path — the Pallas
+    kernel does not dequantize yet."""
+    if k_scales is not None:
+        return paged_attention_xla(q, k_pages, v_pages, page_table,
+                                   q_positions, kv_lens, k_scales, v_scales)
     if use_pallas == "always":
         # Explicit request: fail loudly if the kernel is unavailable.
         from rbg_tpu.ops.pallas.paged_attention_kernel import paged_attention_pallas

@@ -153,3 +153,57 @@ def test_engine_on_mesh_matches_single_device(tiny_setup):
         params=params, mesh=mesh)
     got = sharded.generate(prompts, SamplingParams(max_new_tokens=6))
     assert got == expect
+
+
+def test_int8_kv_cache(tiny_setup):
+    """int8-quantized KV pool: half the KV memory, bounded logit deviation,
+    page accounting still balanced."""
+    cfg, params = tiny_setup
+    prompt = list(range(1, 25))
+
+    ref = make_engine(params, radix=False)
+    ref_logits = np.asarray(ref._run(
+        tokens=[prompt], positions=[list(range(len(prompt)))],
+        lens=[len(prompt)], pages=[ref.allocator.alloc(4)], T_bucket=32,
+    ))[0, len(prompt) - 1]
+
+    q = Engine(EngineConfig(model="tiny", page_size=8, num_pages=64,
+                            max_batch=4, max_seq_len=128, prefill_chunk=16,
+                            enable_radix_cache=False, use_pallas="never",
+                            kv_dtype="int8"), params=params)
+    assert q.cache.quantized and q.cache.k_pages.dtype == jnp.int8
+    q_logits = np.asarray(q._run(
+        tokens=[prompt], positions=[list(range(len(prompt)))],
+        lens=[len(prompt)], pages=[q.allocator.alloc(4)], T_bucket=32,
+    ))[0, len(prompt) - 1]
+
+    # Deterministic bounded deviation from per-vector absmax int8.
+    denom = np.maximum(np.abs(ref_logits), 1.0)
+    assert np.max(np.abs(ref_logits - q_logits) / denom) < 0.05
+    # Cosine similarity of the full logit rows stays high.
+    cos = np.dot(ref_logits, q_logits) / (
+        np.linalg.norm(ref_logits) * np.linalg.norm(q_logits))
+    assert cos > 0.999
+
+    # End-to-end generation runs, pages balance, greedy tokens mostly agree.
+    q2 = Engine(EngineConfig(model="tiny", page_size=8, num_pages=64,
+                             max_batch=4, max_seq_len=128, prefill_chunk=16,
+                             enable_radix_cache=False, use_pallas="never",
+                             kv_dtype="int8"), params=params)
+    out = q2.generate([prompt], SamplingParams(max_new_tokens=8))[0]
+    assert len(out) == 8
+    assert q2.allocator.free_pages == 63
+
+    ref_out = ref_greedy(params, cfg, prompt, steps=8)
+    agree = sum(a == b for a, b in zip(out, ref_out))
+    assert agree >= 5, f"int8 KV diverged too far: {out} vs {ref_out}"
+
+
+def test_int8_kv_rejected_for_pd_modes():
+    with pytest.raises(ValueError, match="unified"):
+        EngineConfig(model="tiny", kv_dtype="int8", mode="prefill").validate()
+
+
+def test_int8_kv_rejects_pallas_always():
+    with pytest.raises(ValueError, match="dequantize"):
+        EngineConfig(model="tiny", kv_dtype="int8", use_pallas="always").validate()

@@ -81,3 +81,18 @@ def test_events_and_delete(served_plane):
         lambda: not plane.store.list("Pod", namespace="default"),
         desc="cascade delete via admin",
     )
+
+
+def test_metrics_and_profile_ops(served_plane):
+    plane, addr = served_plane
+    plane.apply(make_group("m", simple_role("s")))
+    plane.wait_group_ready("m")
+
+    text = call(addr, {"op": "metrics"})["text"]
+    assert "rbg_reconcile_total" in text
+    assert 'controller="rolebasedgroup"' in text
+    assert "rbg_reconcile_duration_seconds_bucket" in text
+
+    prof = call(addr, {"op": "profile", "seconds": 0.3})
+    assert prof["samples"] > 0
+    assert isinstance(prof["top"], list)

@@ -87,12 +87,25 @@ def topology_configmap_name(group: str) -> str:
     return f"{group}-topology"[:C.MAX_NAME_LEN]
 
 
+# Per-group cache of the last built topology: the YAML dump is the hot cost
+# of the group reconcile, and topologies only change on pod/instance churn.
+_topology_cache: dict = {}
+
+
 def reconcile_topology_configmap(store, rbg) -> Optional[ConfigMap]:
     """Create/update the topology ConfigMap (SSA-equivalent: semantic diff)."""
-    data = yaml.safe_dump(build_cluster_config(store, rbg), sort_keys=False)
     ns = rbg.metadata.namespace
     name = topology_configmap_name(rbg.metadata.name)
-    cur = store.get("ConfigMap", ns, name)
+    doc = build_cluster_config(store, rbg)
+    cached = _topology_cache.get((ns, name))
+    if cached is not None and cached[0] == doc:
+        data = cached[1]
+    else:
+        data = yaml.safe_dump(doc, sort_keys=False)
+        _topology_cache[(ns, name)] = (doc, data)
+        if len(_topology_cache) > 4096:
+            _topology_cache.clear()
+    cur = store.get("ConfigMap", ns, name, copy_=False)
     if cur is None:
         cm = ConfigMap()
         cm.metadata.name = name

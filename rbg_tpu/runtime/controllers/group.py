@@ -149,7 +149,7 @@ class RoleBasedGroupController(Controller):
         rev_hash = spec_hash({"roles": sorted(role_hashes.items())})
         rev_name = f"{rbg.metadata.name}-{rev_hash}"
         ns = rbg.metadata.namespace
-        if store.get("ControllerRevision", ns, rev_name) is None:
+        if store.get("ControllerRevision", ns, rev_name, copy_=False) is None:
             revs = store.list("ControllerRevision", namespace=ns,
                               owner_uid=rbg.metadata.uid)
             rev = ControllerRevision()
@@ -264,7 +264,7 @@ class RoleBasedGroupController(Controller):
             if dependencies_ready(rbg, r) and not staged_start(r.components)
         )
         ns, name = rbg.metadata.namespace, rbg.metadata.name
-        pg = store.get("PodGroup", ns, name)
+        pg = store.get("PodGroup", ns, name, copy_=False)
         if pg is None:
             pg = PodGroup()
             pg.metadata.name = name
@@ -325,7 +325,7 @@ class RoleBasedGroupController(Controller):
             selector=dict(labels),
         )
 
-        cur = store.get("RoleInstanceSet", ns, wname)
+        cur = store.get("RoleInstanceSet", ns, wname, copy_=False)
         if cur is None:
             ris = RoleInstanceSet()
             ris.metadata.name = wname
@@ -374,7 +374,7 @@ class RoleBasedGroupController(Controller):
         ns = rbg.metadata.namespace
         sname = C.service_name(rbg.metadata.name, role.name)
         leader_only = role.service_selection == "LeaderOnly"
-        cur = store.get("Service", ns, sname)
+        cur = store.get("Service", ns, sname, copy_=False)
         if cur is not None:
             if cur.leader_only != leader_only:
                 def fn(s):
@@ -407,7 +407,7 @@ class RoleBasedGroupController(Controller):
         new_roles: List[RoleStatus] = []
         for role in rbg.spec.roles:
             wname = C.workload_name(rbg.metadata.name, role.name)
-            ris = store.get("RoleInstanceSet", ns, wname)
+            ris = store.get("RoleInstanceSet", ns, wname, copy_=False)
             prev = rbg.status.role(role.name)
             if ris is None:
                 new_roles.append(prev or RoleStatus(name=role.name))

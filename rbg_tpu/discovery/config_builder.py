@@ -21,6 +21,7 @@ import yaml
 from rbg_tpu.api import constants as C
 from rbg_tpu.api.pod import ConfigMap
 from rbg_tpu.api.meta import owner_ref
+from rbg_tpu.runtime.store import AlreadyExists
 from rbg_tpu.discovery.env_builder import JAX_COORDINATOR_PORT
 
 
@@ -115,14 +116,14 @@ def reconcile_topology_configmap(store, rbg) -> Optional[ConfigMap]:
         cm.data = {C.DISCOVERY_CONFIG_FILE: data}
         try:
             return store.create(cm)
-        except Exception:
-            return None
+        except AlreadyExists:
+            return None  # concurrent reconcile won the create — benign
     if cur.data.get(C.DISCOVERY_CONFIG_FILE) != data:
         def fn(c):
             c.data[C.DISCOVERY_CONFIG_FILE] = data
             return True
         return store.mutate("ConfigMap", ns, name, fn)
-    return cur
+    return None  # unchanged; never hand out the live no-copy store object
 
 
 def load_cluster_config(text: str) -> dict:

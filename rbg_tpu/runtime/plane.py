@@ -114,7 +114,7 @@ class ControlPlane:
         raise TimeoutError(f"timed out waiting for {desc}")
 
     def wait_group_ready(self, name: str, namespace: str = "default",
-                         timeout: float = 10.0):
+                         timeout: float = 30.0):
         from rbg_tpu.api import constants as C
         from rbg_tpu.api.meta import get_condition
 

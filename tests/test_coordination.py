@@ -61,7 +61,7 @@ def test_coordinated_scaling_end_to_end(plane):
         scaling=CoordinatedScaling(roles=["prefill", "decode"], max_skew_percent=25),
     )
     plane.apply(pol)
-    g = plane.wait_group_ready("pd", timeout=20)
+    g = plane.wait_group_ready("pd", timeout=60)
     assert g.status.role("prefill").ready_replicas == 4
     assert g.status.role("decode").ready_replicas == 4
 

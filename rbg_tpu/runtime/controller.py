@@ -83,6 +83,10 @@ class Controller:
 
     name: str = "controller"
     workers: int = 4
+    # Periodic full resync (controller-runtime's informer resync): with
+    # level-triggered reconciles, any lost/raced event self-heals within one
+    # period. Dedup makes idle resyncs nearly free.
+    resync_period: float = 10.0
 
     def __init__(self, store: Store):
         self.store = store
@@ -115,18 +119,35 @@ class Controller:
         # Initial sync (the informer LIST): a restarted plane must reconcile
         # every pre-existing object, or changes made while no controllers ran
         # are never observed (level-triggered ≠ event-sourced).
-        for w in self.watches():
-            if w.kind == "*":
-                continue
-            for obj in self.store.list(w.kind, namespace=None, copy_=False):
-                for key in w.mapper(obj):
-                    self.queue.add(key)
+        self._enqueue_all()
         for i in range(self.workers):
             t = threading.Thread(
                 target=self._worker, name=f"{self.name}-{i}", daemon=True
             )
             t.start()
             self._threads.append(t)
+        if self.resync_period > 0:
+            t = threading.Thread(target=self._resync_loop,
+                                 name=f"{self.name}-resync", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _enqueue_all(self):
+        for w in self.watches():
+            if w.kind == "*":
+                continue
+            for obj in self.store.list(w.kind, namespace=None, copy_=False):
+                for key in w.mapper(obj):
+                    self.queue.add(key)
+
+    def _resync_loop(self):
+        import time as _time
+        while not getattr(self.queue, "_shutdown", False):
+            _time.sleep(self.resync_period)
+            try:
+                self._enqueue_all()
+            except Exception:
+                pass
 
     def _worker(self):
         import time as _time

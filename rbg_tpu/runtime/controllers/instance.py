@@ -110,6 +110,9 @@ class RoleInstanceController(Controller):
         # ---- scale/create: converge pod set ----
         self._ensure_pod_group(store, inst, desired)
         pg_name = self._pod_group_name(inst, desired)
+        self._adopt_orphans(store, inst, desired)
+        pods = [p for p in store.list("Pod", namespace=ns, owner_uid=inst.metadata.uid)]
+        active = [p for p in pods if p.active]
         existing = {p.metadata.name for p in active}
         wanted = {n for (n, *_rest) in desired}
         startable = self._startable(inst, active)
@@ -244,6 +247,40 @@ class RoleInstanceController(Controller):
         return Result(requeue_after=0.05)
 
     # ---- pod construction ----
+
+    def _adopt_orphans(self, store, inst, desired):
+        """Ref-manager adoption (reference: statelessmode/utils/ref_manager.go
+        + statefulmode/instance_ref_manager.go): a pod bearing one of OUR
+        desired names whose controller owner no longer exists is adopted —
+        it keeps running (warm slice) and its owner ref moves to us. Without
+        this, such an orphan squats the name forever (we can neither create
+        nor count it)."""
+        ns = inst.metadata.namespace
+        for (pod_name, *_rest) in desired:
+            pod = store.get("Pod", ns, pod_name, copy_=False)
+            if pod is None:
+                continue
+            ref = pod.metadata.controller_owner()
+            if ref is not None and ref.uid == inst.metadata.uid:
+                continue  # already ours
+            owner_alive = False
+            if ref is not None and ref.kind == "RoleInstance":
+                owner = store.get("RoleInstance", ns, ref.name, copy_=False)
+                owner_alive = owner is not None and owner.metadata.uid == ref.uid
+            if owner_alive:
+                continue  # belongs to a live different owner — not ours to take
+
+            def fn(p):
+                p.metadata.owner_references = [owner_ref(inst)]
+                p.metadata.labels[C.LABEL_INSTANCE_NAME] = inst.metadata.name
+                return True
+
+            try:
+                store.mutate("Pod", ns, pod_name, fn)
+                store.record_event(inst, "AdoptedPod",
+                                   f"adopted orphaned pod {pod_name}")
+            except Exception:
+                pass
 
     def _staged_start(self, inst) -> bool:
         """Component startAfter ordering implies staged start — incompatible

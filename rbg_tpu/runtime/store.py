@@ -62,6 +62,7 @@ class Store:
         self._rv = 0
         self._watchers: Dict[str, List[Callable[[Event], None]]] = defaultdict(list)
         self._owner_index: Dict[str, set] = defaultdict(set)  # owner uid -> keys
+        self._uids: set = set()  # live object uids (O(1) owner-exists checks)
         self._events_log: List[tuple] = []  # (ts, kind/ns/name, reason, msg)
 
     # ---- helpers ----
@@ -101,11 +102,23 @@ class Store:
             k = self.key(obj)
             if k in self._objects:
                 raise AlreadyExists(f"{k} already exists")
+            # Foreground-GC invariant: a controller owner must exist at
+            # creation. Otherwise a reconcile working from a stale copy of a
+            # deleted owner can create a child AFTER the cascade GC ran — an
+            # immortal orphan that squats its name (the k8s GC would collect
+            # it; our cascade is synchronous, so reject instead).
+            ref = m.controller_owner()
+            if ref is not None:
+                if ref.uid not in self._uids:
+                    raise NotFound(
+                        f"{k}: controller owner {ref.kind}/{ref.name} "
+                        f"(uid {ref.uid}) no longer exists")
             m.uid = m.uid or uuid.uuid4().hex[:12]
             m.resource_version = self._next_rv()
             m.generation = 1
             m.creation_timestamp = m.creation_timestamp or time.time()
             self._objects[k] = obj
+            self._uids.add(m.uid)
             for ref in m.owner_references:
                 self._owner_index[ref.uid].add(k)
         self._notify(Event(Event.ADDED, obj))
@@ -246,6 +259,7 @@ class Store:
                 ev = Event(Event.MODIFIED, cur, old=orig)
             else:
                 del self._objects[k]
+                self._uids.discard(cur.metadata.uid)
                 for keys in self._owner_index.values():
                     keys.discard(k)
                 ev = Event(Event.DELETED, cur)
@@ -289,6 +303,7 @@ class Store:
                 if k in self._objects:
                     continue
                 self._objects[k] = obj
+                self._uids.add(obj.metadata.uid)
                 for ref in obj.metadata.owner_references:
                     self._owner_index[ref.uid].add(k)
                 count += 1

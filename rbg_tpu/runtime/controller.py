@@ -95,6 +95,7 @@ class Controller:
         self.backoff = ExponentialBackoff(base=0.01, max_delay=5.0)
         self._threads: List[threading.Thread] = []
         self._started = False
+        self._stopping = False
 
     # -- override points --
     def watches(self) -> List[Watch]:
@@ -142,8 +143,10 @@ class Controller:
 
     def _resync_loop(self):
         import time as _time
-        while not getattr(self.queue, "_shutdown", False):
+        while not self._stopping:
             _time.sleep(self.resync_period)
+            if self._stopping:
+                return
             try:
                 self._enqueue_all()
             except Exception:
@@ -180,6 +183,7 @@ class Controller:
                 self.queue.done(key)
 
     def stop(self):
+        self._stopping = True
         self.queue.shutdown()
 
 

@@ -111,7 +111,8 @@ class RoleInstanceController(Controller):
         self._ensure_pod_group(store, inst, desired)
         pg_name = self._pod_group_name(inst, desired)
         self._adopt_orphans(store, inst, desired)
-        pods = [p for p in store.list("Pod", namespace=ns, owner_uid=inst.metadata.uid)]
+        # Re-list: adoption may have just brought pods under our owner uid.
+        pods = store.list("Pod", namespace=ns, owner_uid=inst.metadata.uid)
         active = [p for p in pods if p.active]
         existing = {p.metadata.name for p in active}
         wanted = {n for (n, *_rest) in desired}
@@ -256,6 +257,7 @@ class RoleInstanceController(Controller):
         this, such an orphan squats the name forever (we can neither create
         nor count it)."""
         ns = inst.metadata.namespace
+        from rbg_tpu.runtime.store import NotFound
         for (pod_name, *_rest) in desired:
             pod = store.get("Pod", ns, pod_name, copy_=False)
             if pod is None:
@@ -263,12 +265,12 @@ class RoleInstanceController(Controller):
             ref = pod.metadata.controller_owner()
             if ref is not None and ref.uid == inst.metadata.uid:
                 continue  # already ours
-            owner_alive = False
-            if ref is not None and ref.kind == "RoleInstance":
-                owner = store.get("RoleInstance", ns, ref.name, copy_=False)
-                owner_alive = owner is not None and owner.metadata.uid == ref.uid
-            if owner_alive:
-                continue  # belongs to a live different owner — not ours to take
+            if ref is not None:
+                # Liveness check for ANY controller kind — a pod owned by a
+                # live Warmup (or anything else) is never ours to hijack.
+                owner = store.get(ref.kind, ns, ref.name, copy_=False)
+                if owner is not None and owner.metadata.uid == ref.uid:
+                    continue
 
             def fn(p):
                 p.metadata.owner_references = [owner_ref(inst)]
@@ -279,8 +281,9 @@ class RoleInstanceController(Controller):
                 store.mutate("Pod", ns, pod_name, fn)
                 store.record_event(inst, "AdoptedPod",
                                    f"adopted orphaned pod {pod_name}")
-            except Exception:
-                pass
+            except NotFound:
+                pass  # deleted concurrently — nothing to adopt
+            # Conflict propagates: the worker's backoff retries visibly.
 
     def _staged_start(self, inst) -> bool:
         """Component startAfter ordering implies staged start — incompatible

@@ -41,10 +41,8 @@ def main():
     if os.environ.get("RBG_BENCH_FORCE_CPU") != "1":
         if not tpu_reachable():
             # Re-exec on CPU so a wedged tunnel still yields a benchmark line.
-            env = dict(os.environ)
-            env["RBG_BENCH_FORCE_CPU"] = "1"
-            env["JAX_PLATFORMS"] = "cpu"
-            env.pop("PALLAS_AXON_POOL_IPS", None)  # skip the TPU-relay hook
+            from rbg_tpu.utils import scrubbed_cpu_env
+            env = scrubbed_cpu_env(extra={"RBG_BENCH_FORCE_CPU": "1"})
             os.execve(sys.executable, [sys.executable, __file__], env)
     import jax
     import numpy as np

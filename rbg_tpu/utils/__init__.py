@@ -1,3 +1,4 @@
+from rbg_tpu.utils.cpuenv import scrubbed_cpu_env
 from rbg_tpu.utils.hashing import spec_hash
 
-__all__ = ["spec_hash"]
+__all__ = ["scrubbed_cpu_env", "spec_hash"]

@@ -1,0 +1,41 @@
+"""Scrubbed CPU-only environment for subprocesses.
+
+The image's sitecustomize registers a TPU relay at interpreter start when
+``PALLAS_AXON_POOL_IPS`` is present; a wedged relay then stalls even
+CPU-only child processes. Every subprocess that must NOT touch the TPU
+builds its env through :func:`scrubbed_cpu_env` so the scrub list lives in
+one place (used by ``bench.py`` and ``__graft_entry__.dryrun_multichip``).
+"""
+
+from __future__ import annotations
+
+import os
+
+# Env vars that wire the interpreter to the TPU relay; removed wholesale.
+_RELAY_VARS = ("PALLAS_AXON_POOL_IPS",)
+
+
+def scrubbed_cpu_env(base: dict | None = None, *,
+                     host_devices: int | None = None,
+                     extra: dict | None = None) -> dict:
+    """Return a copy of ``base`` (default ``os.environ``) forced to CPU.
+
+    ``host_devices`` adds ``--xla_force_host_platform_device_count=N`` to
+    ``XLA_FLAGS`` (replacing any existing such flag). ``extra`` entries are
+    merged last; a value of ``None`` deletes the key.
+    """
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    for var in _RELAY_VARS:
+        env.pop(var, None)
+    if host_devices is not None:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+        flags.append(f"--xla_force_host_platform_device_count={host_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    for key, val in (extra or {}).items():
+        if val is None:
+            env.pop(key, None)
+        else:
+            env[key] = val
+    return env

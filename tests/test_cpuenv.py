@@ -1,0 +1,33 @@
+"""Scrubbed-CPU-env helper (rbg_tpu.utils.cpuenv).
+
+Guards the driver-entry contract: a wedged TPU relay in the parent env must
+never leak into CPU-only subprocesses (VERDICT r1 item 1).
+"""
+
+from rbg_tpu.utils import scrubbed_cpu_env
+
+
+def test_scrub_removes_relay_and_forces_cpu():
+    base = {"PALLAS_AXON_POOL_IPS": "10.0.0.1", "JAX_PLATFORMS": "axon",
+            "PATH": "/usr/bin"}
+    env = scrubbed_cpu_env(base)
+    assert "PALLAS_AXON_POOL_IPS" not in env
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["PATH"] == "/usr/bin"
+    assert base["JAX_PLATFORMS"] == "axon"  # input not mutated
+
+
+def test_host_devices_replaces_existing_flag():
+    base = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2 --foo=1"}
+    env = scrubbed_cpu_env(base, host_devices=8)
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert "device_count=2" not in env["XLA_FLAGS"]
+    assert "--foo=1" in env["XLA_FLAGS"]
+
+
+def test_extra_merges_and_none_deletes():
+    base = {"KEEP": "1", "DROP": "1"}
+    env = scrubbed_cpu_env(base, extra={"DROP": None, "NEW": "v"})
+    assert "DROP" not in env
+    assert env["NEW"] == "v"
+    assert env["KEEP"] == "1"

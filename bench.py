@@ -79,11 +79,20 @@ def main():
     tokens = eng.metrics["decode_tokens"] - start_tokens
 
     tps = tokens / elapsed
+
+    # MFU estimate: decode FLOPs/token ≈ 2·N_params (matmul MACs×2) plus
+    # KV-read attention FLOPs (small at these lengths). Peak: v5e bf16
+    # 197 TFLOP/s; CPU runs report mfu_est=null (no meaningful peak).
+    mfu = None
+    if on_tpu:
+        flops_per_tok = 2.0 * cfg.model_config.num_params
+        mfu = round(tps * flops_per_tok / 197e12, 5)
     print(json.dumps({
         "metric": f"engine_decode_throughput_{model}_bs{BATCH}_{jax.default_backend()}",
         "value": round(tps, 2),
         "unit": "tokens/sec",
         "vs_baseline": round(tps / TARGET_TOKENS_PER_SEC, 4),
+        "mfu_est": mfu,
     }))
 
 

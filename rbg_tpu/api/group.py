@@ -119,6 +119,12 @@ class RollingUpdate:
     max_surge: int = 0
     partition: int = 0
     in_place_if_possible: bool = True
+    # Freeze rollout progress mid-flight; existing surge is preserved
+    # (reference: UpdateStrategy.Paused, computeTopology paused branch).
+    paused: bool = False
+    # Seconds an instance must be Ready before it counts as available for
+    # the rolling-update budget (reference: getMinReadySeconds).
+    min_ready_seconds: int = 0
 
 
 @dataclasses.dataclass

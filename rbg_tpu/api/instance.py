@@ -57,6 +57,7 @@ class RoleInstanceSetStatus:
     ready_replicas: int = 0
     updated_replicas: int = 0
     updated_ready_replicas: int = 0
+    current_replicas: int = 0       # instances still at current_revision
     current_revision: str = ""
     update_revision: str = ""
     conditions: List[Condition] = dataclasses.field(default_factory=list)

@@ -16,9 +16,13 @@ from typing import List, Optional
 
 from rbg_tpu.api import constants as C
 from rbg_tpu.api import serde
-from rbg_tpu.api.instance import RoleInstance, RoleInstanceSpec
+from rbg_tpu.api.instance import (
+    ControllerRevision, InstanceTemplate, RoleInstance, RoleInstanceSpec,
+)
+from rbg_tpu.api.group import RestartPolicyConfig
 from rbg_tpu.api.meta import Condition, get_condition, owner_ref, set_condition
 from rbg_tpu.runtime.controller import Controller, Result, Watch, own_keys, owner_keys
+from rbg_tpu.runtime.controllers import stateful_update as su
 from rbg_tpu.runtime.store import AlreadyExists, Store
 from rbg_tpu.utils import spec_hash
 
@@ -56,6 +60,9 @@ class RoleInstanceSetController(Controller):
     def __init__(self, store: Store, ports=None):
         super().__init__(store)
         self.ports = ports
+        # Per-set stable-unhealthy observation state (keyed by set UID so a
+        # delete-and-recreate of the set starts fresh).
+        self._observers = {}
 
     def watches(self) -> List[Watch]:
         from rbg_tpu.runtime.controller import spec_change
@@ -82,54 +89,52 @@ class RoleInstanceSetController(Controller):
             if i.metadata.deletion_timestamp is None
         ]
 
+        requeue = None
         if ris.spec.stateful:
-            self._sync_stateful(store, ris, instances, revision)
+            requeue = self._sync_stateful(store, ris, instances, revision)
         else:
             self._sync_stateless(store, ris, instances, revision)
 
         self._update_status(store, ris, revision)
-        return None
+        return Result(requeue_after=requeue) if requeue is not None else None
 
-    # ---- stateful: ordered ordinals + partition rolling update ----
+    # ---- stateful: ordered ordinals + surge-aware rolling update ----
+    # Planning lives in stateful_update.plan_stateful (pure, table-tested);
+    # this method executes the plan against the store.
+
+    def _observer(self, ris) -> su.HealthObserver:
+        uid = ris.metadata.uid
+        obs = self._observers.get(uid)
+        if obs is None:
+            obs = self._observers[uid] = su.HealthObserver()
+            # Opportunistic GC of observers for deleted sets.
+            live = {r.metadata.uid for r in self.store.list(
+                "RoleInstanceSet", namespace=None, copy_=False)}
+            for k in [k for k in self._observers if k not in live]:
+                del self._observers[k]
+        return obs
 
     def _sync_stateful(self, store, ris, instances, revision):
         ns, name = ris.metadata.namespace, ris.metadata.name
-        n = ris.spec.replicas
-        by_ord = {}
-        for inst in instances:
-            o = _ordinal(name, inst.metadata.name)
-            if 0 <= o:
-                by_ord[o] = inst
+        current_rev = ris.status.current_revision or revision
+        self._ensure_ris_revision(store, ris, revision)
 
-        # scale up: create missing ordinals with the update revision
-        for o in range(n):
-            if o not in by_ord:
-                self._create_instance(store, ris, f"{name}-{o}", o, revision)
-        # scale down: delete ordinals >= n, highest first
-        for o in sorted((o for o in by_ord if o >= n), reverse=True):
-            store.delete("RoleInstance", ns, by_ord[o].metadata.name)
+        plan = su.plan_stateful(
+            ris, instances, current_rev, revision, self._observer(ris),
+            lambda i: _ordinal(name, i.metadata.name))
 
-        # rolling update (recreate semantics; in-place path handled by the
-        # inplace engine when eligible — see rbg_tpu.inplace):
-        # walk descending, honor partition + maxUnavailable
-        # (reference: stateful_instance_set_control.go:362-494).
-        ru = ris.spec.rolling_update
-        current = [by_ord[o] for o in sorted(by_ord) if o < n]
-        unavailable = sum(1 for i in current if not instance_ready(i))
-        budget = max(0, ru.max_unavailable - unavailable)
-        for inst in sorted(current, key=lambda i: -_ordinal(name, i.metadata.name)):
-            o = _ordinal(name, inst.metadata.name)
-            if o < ru.partition:
+        for iname, ordinal, rev in plan.create:
+            self._create_instance(store, ris, iname, ordinal, rev)
+        for iname in plan.condemn:
+            store.delete("RoleInstance", ns, iname)
+        for act in plan.updates:
+            inst = store.get("RoleInstance", ns, act.name)
+            if inst is None:
                 continue
-            if inst.metadata.labels.get(C.LABEL_REVISION_NAME) == revision:
-                continue
-            if budget <= 0:
-                break
             if self._try_inplace(store, ris, inst, revision):
-                budget -= 1
                 continue
-            store.delete("RoleInstance", ns, inst.metadata.name)
-            budget -= 1
+            store.delete("RoleInstance", ns, act.name)
+        return plan.requeue_after
 
     # ---- stateless: random ids, specified-delete, revision-sorted update ----
 
@@ -193,9 +198,64 @@ class RoleInstanceSetController(Controller):
             return False
         return try_inplace_update(store, ris, inst, revision)
 
-    def _create_instance(self, store, ris, iname, index, revision):
+    # ---- RIS-level revision snapshots ----
+    # Partition-pinned ordinals must be (re)created at the CURRENT revision's
+    # spec, not the updated one — the reference applies the stored
+    # ControllerRevision (``newVersionedInstance``/``ApplyRevision``,
+    # stateful_instance_set_control.go:330-432). We keep a snapshot object
+    # per live revision, owned by the set, and GC the rest.
+
+    def _rev_name(self, ris, revision: str) -> str:
+        return f"{ris.metadata.name}-rev-{revision[:10]}"
+
+    def _ensure_ris_revision(self, store, ris, revision):
+        ns = ris.metadata.namespace
+        name = self._rev_name(ris, revision)
+        if store.get("ControllerRevision", ns, name, copy_=False) is None:
+            rev = ControllerRevision()
+            rev.metadata.name = name
+            rev.metadata.namespace = ns
+            rev.metadata.labels = {C.LABEL_REVISION_NAME: revision}
+            rev.metadata.owner_references = [owner_ref(ris)]
+            rev.data = {
+                "instance": serde.to_dict(ris.spec.instance),
+                "restart": serde.to_dict(ris.spec.restart_policy),
+            }
+            try:
+                store.create(rev)
+            except AlreadyExists:
+                pass
+        # GC snapshots for revisions that are neither current nor update.
+        keep = {revision, ris.status.current_revision}
+        for obj in store.list("ControllerRevision", namespace=ns,
+                              owner_uid=ris.metadata.uid):
+            if obj.metadata.labels.get(C.LABEL_REVISION_NAME) not in keep:
+                store.delete("ControllerRevision", ns, obj.metadata.name)
+
+    def _revision_spec(self, store, ris, revision):
+        """(InstanceTemplate, RestartPolicyConfig, actual_revision) for
+        ``revision`` — from the stored snapshot when it differs from the
+        in-spec (update) revision. When no snapshot survives (controller
+        upgrade mid-rollout, GC race) we fall back to the update spec and
+        report the UPDATE revision so the instance's label matches the spec
+        it actually runs — a mislabeled pinned ordinal would never be
+        reconciled (ords below partition are not update targets)."""
         import copy
 
+        update_rev = update_revision_of(ris)
+        if revision != update_rev:
+            snap = store.get("ControllerRevision", ris.metadata.namespace,
+                             self._rev_name(ris, revision), copy_=False)
+            if snap is not None:
+                return (serde.from_dict(InstanceTemplate, snap.data["instance"]),
+                        serde.from_dict(RestartPolicyConfig, snap.data["restart"]),
+                        revision)
+        return (copy.deepcopy(ris.spec.instance),
+                copy.deepcopy(ris.spec.restart_policy),
+                update_rev)
+
+    def _create_instance(self, store, ris, iname, index, revision):
+        template, restart, revision = self._revision_spec(store, ris, revision)
         inst = RoleInstance()
         inst.metadata.name = iname
         inst.metadata.namespace = ris.metadata.namespace
@@ -206,8 +266,8 @@ class RoleInstanceSetController(Controller):
         inst.metadata.annotations = dict(ris.metadata.annotations)
         inst.metadata.owner_references = [owner_ref(ris)]
         inst.spec = RoleInstanceSpec(
-            instance=copy.deepcopy(ris.spec.instance),
-            restart_policy=copy.deepcopy(ris.spec.restart_policy),
+            instance=template,
+            restart_policy=restart,
             index=index,
         )
         try:
@@ -233,24 +293,61 @@ class RoleInstanceSetController(Controller):
         )
         now = time.time()
 
+        # Ready condition + CurrentRevision advance are ordinal-aware for
+        # stateful sets: surge instances (ord >= replicas) must not make a
+        # mid-rollout set look Ready, and the advance guard
+        # (stateful_update.should_advance_current_revision) needs the base
+        # ordinal snapshot.
+        n = ris.spec.replicas
+        if ris.spec.stateful:
+            by_ord = {}
+            for i in instances:
+                o = _ordinal(name, i.metadata.name)
+                if o >= 0:
+                    by_ord[o] = i
+            base = [by_ord[o] for o in range(n) if o in by_ord]
+            is_ready_now = (len(base) == n
+                            and all(instance_ready(i) for i in base))
+            current_rev = ris.status.current_revision or revision
+            topo = su.compute_topology(ris, by_ord, current_rev, revision)
+            advance = su.should_advance_current_revision(ris, by_ord, topo, revision)
+        else:
+            is_ready_now = ready == n and total == n
+            advance = updated == total and total > 0
+        count_by_rev = {}
+        for i in instances:
+            rev = i.metadata.labels.get(C.LABEL_REVISION_NAME, "")
+            count_by_rev[rev] = count_by_rev.get(rev, 0) + 1
+
         def fn(r):
             s = r.status
-            new = (total, ready, updated, updated_ready, revision, r.metadata.generation)
+            want_current = s.current_revision
+            if not want_current:
+                want_current = revision      # initialize history
+            elif advance:
+                want_current = revision
+            # Count against the revision we are about to persist — counting
+            # the pre-advance revision would record current_replicas=0 on
+            # the very pass that advances, with no event to correct it.
+            current_count = count_by_rev.get(want_current, 0)
+            new = (total, ready, updated, updated_ready, current_count,
+                   revision, r.metadata.generation)
             cur = (s.replicas, s.ready_replicas, s.updated_replicas,
-                   s.updated_ready_replicas, s.update_revision, s.observed_generation)
+                   s.updated_ready_replicas, s.current_replicas,
+                   s.update_revision, s.observed_generation)
             cond_changed = set_condition(
                 s.conditions,
                 Condition(type=C.COND_READY,
-                          status="True" if (ready == r.spec.replicas and total == r.spec.replicas) else "False",
-                          reason="AllInstancesReady" if ready == r.spec.replicas else "Progressing"),
+                          status="True" if is_ready_now else "False",
+                          reason="AllInstancesReady" if is_ready_now else "Progressing"),
                 now,
             )
-            if new == cur and not cond_changed:
+            if new == cur and not cond_changed and want_current == s.current_revision:
                 return False
             (s.replicas, s.ready_replicas, s.updated_replicas,
-             s.updated_ready_replicas, s.update_revision, s.observed_generation) = new
-            if updated == total and total > 0:
-                s.current_revision = revision
+             s.updated_ready_replicas, s.current_replicas,
+             s.update_revision, s.observed_generation) = new
+            s.current_revision = want_current
             return True
 
         store.mutate("RoleInstanceSet", ns, name, fn, status=True)

@@ -36,6 +36,9 @@ class FakeKubelet:
         self.ready_delay = ready_delay
         self.terminate_delay = terminate_delay
         self.fail_filter = fail_filter
+        # Pods matching hold_filter stay Pending (slow-start simulation)
+        # until release_holds() clears the filter and re-walks them.
+        self.hold_filter: Optional[Callable[[object], bool]] = None
         self._timers: list = []
         self._lock = threading.Lock()
         self._stopped = False
@@ -75,6 +78,8 @@ class FakeKubelet:
             self._later(self.terminate_delay, self._finalize, Store.key(pod))
             return
         if pod.node_name and pod.status.phase == "Pending":
+            if self.hold_filter is not None and self.hold_filter(pod):
+                return
             if self.fail_filter is not None and self.fail_filter(pod):
                 self._later(self.ready_delay, self._set_phase, Store.key(pod), "Failed")
             else:
@@ -129,6 +134,12 @@ class FakeKubelet:
             pass
 
     # ---- test helpers (drive status manually, envtest style) ----
+
+    def release_holds(self):
+        """Clear hold_filter and walk every held (still-Pending) pod."""
+        self.hold_filter = None
+        for pod in self.store.list("Pod"):
+            self._on_event(Event(Event.ADDED, pod))
 
     def fail_pod(self, ns: str, name: str):
         self.store.mutate("Pod", ns, name, lambda p: setattr(p.status, "phase", "Failed") or setattr(p.status, "ready", False) or True, status=True)

@@ -40,9 +40,10 @@ KINDS = {
 }
 
 
-def parse_manifest(doc: dict):
-    """Build a typed resource from a parsed YAML document (kind-dispatched)."""
+def parse_manifest(doc: dict, *, lenient: bool = False):
+    """Build a typed resource from a parsed YAML document (kind-dispatched).
+    ``lenient`` is for durable-storage reads (see serde.from_dict)."""
     kind = doc.get("kind")
     if kind not in KINDS:
         raise KeyError(f"unknown kind {kind!r}; known: {sorted(KINDS)}")
-    return from_dict(KINDS[kind], doc)
+    return from_dict(KINDS[kind], doc, lenient=lenient)

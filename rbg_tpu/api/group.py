@@ -189,8 +189,16 @@ class RoleStatus:
     updated_replicas: int = 0
     updated_ready_replicas: int = 0
     observed_revision: str = ""
+    # Rolled up from the RoleInstanceSet's Ready condition (capacity-aware
+    # during surge rollouts) rather than re-derived from the counters.
+    # DERIVED state: recomputed by the first reconcile after a state-file
+    # load, so it is excluded from serialization (__serde_skip__) — a
+    # snapshot written by this release must still load on the previous,
+    # strict-parsing one (schema-evolution Rule 1, docs/architecture.md §5).
+    ready: bool = False
 
     __serde_keep__ = ("name", "replicas", "ready_replicas")
+    __serde_skip__ = ("ready",)
 
 
 @dataclasses.dataclass

@@ -37,8 +37,11 @@ def to_dict(obj: Any, *, drop_default: bool = True) -> Any:
     """
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         keep = getattr(obj, "__serde_keep__", ())
+        skip = getattr(obj, "__serde_skip__", ())
         out = {}
         for f in dataclasses.fields(obj):
+            if f.name in skip:
+                continue   # derived/in-memory-only fields never serialize
             v = getattr(obj, f.name)
             if drop_default and f.name not in keep:
                 if f.default is not dataclasses.MISSING and v == f.default:
@@ -56,14 +59,25 @@ def to_dict(obj: Any, *, drop_default: bool = True) -> Any:
     return obj
 
 
-def from_dict(cls: Type[T], data: Any) -> T:
-    """Deserialize camelCase dicts into dataclass ``cls`` (strict on unknown
-    keys — admission-style schema checking, reference analog: CEL validation
-    on CRDs, ``api/workloads/v1alpha2/*_types.go`` kubebuilder markers)."""
-    return _build(cls, data, path="$")
+def from_dict(cls: Type[T], data: Any, *, lenient: bool = False) -> T:
+    """Deserialize camelCase dicts into dataclass ``cls``.
+
+    Strict mode (default) rejects unknown keys — admission-style schema
+    checking, reference analog: CEL validation on CRDs
+    (``api/workloads/v1alpha2/*_types.go`` kubebuilder markers). A typo in
+    a user manifest must be an error, never a silent no-op.
+
+    ``lenient=True`` drops unknown keys (logged once per key) — for data
+    read back from DURABLE storage (state-file snapshots, stored
+    ControllerRevisions), which may have been written by a newer release
+    (schema-evolution Rule 3, docs/architecture.md §5)."""
+    return _build(cls, data, path="$", lenient=lenient)
 
 
-def _build(tp: Any, data: Any, path: str) -> Any:
+_warned_unknown: set = set()
+
+
+def _build(tp: Any, data: Any, path: str, lenient: bool = False) -> Any:
     origin = get_origin(tp)
     if tp is Any:
         return data
@@ -71,17 +85,17 @@ def _build(tp: Any, data: Any, path: str) -> Any:
         args = [a for a in get_args(tp) if a is not type(None)]
         if data is None:
             return None
-        return _build(args[0], data, path)
+        return _build(args[0], data, path, lenient)
     if origin in (list, tuple):
         if not isinstance(data, list):
             raise TypeError(f"{path}: expected list, got {type(data).__name__}")
         (elem,) = get_args(tp) or (Any,)
-        return [_build(elem, v, f"{path}[{i}]") for i, v in enumerate(data)]
+        return [_build(elem, v, f"{path}[{i}]", lenient) for i, v in enumerate(data)]
     if origin is dict:
         if not isinstance(data, dict):
             raise TypeError(f"{path}: expected object, got {type(data).__name__}")
         kt, vt = get_args(tp) or (str, Any)
-        return {k: _build(vt, v, f"{path}.{k}") for k, v in data.items()}
+        return {k: _build(vt, v, f"{path}.{k}", lenient) for k, v in data.items()}
     if isinstance(tp, type) and issubclass(tp, enum.Enum):
         return tp(data)
     if dataclasses.is_dataclass(tp):
@@ -95,8 +109,17 @@ def _build(tp: Any, data: Any, path: str) -> Any:
         for k, v in data.items():
             name = to_snake(k)
             if name not in fields:
+                if lenient:
+                    marker = (tp.__name__, k)
+                    if marker not in _warned_unknown:
+                        _warned_unknown.add(marker)
+                        import logging
+                        logging.getLogger("rbg_tpu.serde").warning(
+                            "dropping unknown field %r for %s (written by a "
+                            "newer release?)", k, tp.__name__)
+                    continue
                 raise KeyError(f"{path}: unknown field {k!r} for {tp.__name__}")
-            kwargs[name] = _build(hints[fields[name].name], v, f"{path}.{k}")
+            kwargs[name] = _build(hints[fields[name].name], v, f"{path}.{k}", lenient)
         return tp(**kwargs)
     if tp in (int, float, str, bool):
         if tp is float and isinstance(data, int):

@@ -51,7 +51,11 @@ def sort_roles(roles: List[RoleSpec]) -> List[List[RoleSpec]]:
 
 
 def dependencies_ready(group: RoleBasedGroup, role: RoleSpec) -> bool:
-    """A dependency is ready when its status reports all replicas ready."""
+    """A dependency is ready when its rolled-up RoleStatus.ready flag is set.
+
+    The flag (not raw counter equality) is deliberate: ready_replicas is
+    base-scoped and briefly dips during a zero-disruption surge rollout
+    while a surge instance holds the capacity — dependents must not flap."""
     for dep in role.dependencies:
         spec = group.spec.role(dep)
         st = group.status.role(dep)
@@ -59,6 +63,6 @@ def dependencies_ready(group: RoleBasedGroup, role: RoleSpec) -> bool:
             return False
         if spec.replicas == 0:
             continue
-        if st is None or st.ready_replicas < spec.replicas:
+        if st is None or not st.ready:
             return False
     return True

@@ -179,7 +179,7 @@ class _Handler(socketserver.BaseRequestHandler):
             return {"error": "revision not found"}
 
         def fn(g):
-            g.spec = serde.from_dict(RoleBasedGroupSpec, rev.data)
+            g.spec = serde.from_dict(RoleBasedGroupSpec, rev.data, lenient=True)
             return True
 
         store.mutate("RoleBasedGroup", ns, name, fn)

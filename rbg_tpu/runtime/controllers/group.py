@@ -17,7 +17,7 @@ from rbg_tpu.api.group import RoleBasedGroup, RoleSpec, RoleStatus
 from rbg_tpu.api.instance import (
     ControllerRevision, InstanceTemplate, RoleInstanceSet, RoleInstanceSetSpec,
 )
-from rbg_tpu.api.meta import Condition, owner_ref, set_condition
+from rbg_tpu.api.meta import Condition, get_condition, owner_ref, set_condition
 from rbg_tpu.api.pod import Service
 from rbg_tpu.api.policy import PodGroup, PodGroupSpec
 from rbg_tpu.api.validation import ValidationError, validate_group
@@ -417,6 +417,7 @@ class RoleBasedGroupController(Controller):
                 # last-known status (anti-flicker)
                 new_roles.append(prev)
                 continue
+            ris_ready = get_condition(ris.status.conditions, C.COND_READY)
             new_roles.append(RoleStatus(
                 name=role.name,
                 replicas=ris.status.replicas,
@@ -424,17 +425,29 @@ class RoleBasedGroupController(Controller):
                 updated_replicas=ris.status.updated_replicas,
                 updated_ready_replicas=ris.status.updated_ready_replicas,
                 observed_revision=role_hashes.get(role.name, ""),
+                # Role readiness = the child's Ready CONDITION (capacity-
+                # aware during surge rollouts, when counter equality
+                # `ready_replicas == replicas` briefly flips False even
+                # though serving capacity never dips) AND the child's spec
+                # having reached the role's desired replicas — a
+                # coordination-clamped RIS is Ready at its *interim* target
+                # and must not make the group Ready early.
+                ready=(ris_ready is not None and ris_ready.status == "True"
+                       and ris.spec.replicas == role.replicas),
             ))
 
-        ready = all(
-            st.replicas == r.replicas and st.ready_replicas == r.replicas
-            for r, st in zip(rbg.spec.roles, new_roles)
-        ) and len(new_roles) == len(rbg.spec.roles)
+        ready = all(st.ready for st in new_roles) \
+            and len(new_roles) == len(rbg.spec.roles)
         now = time.time()
 
         def fn(g):
             changed = False
-            if serde.to_dict(g.status.roles) != serde.to_dict(new_roles):
+            # dataclasses.asdict, NOT serde.to_dict: the derived `ready`
+            # flag is __serde_skip__'d from the wire format but a
+            # ready-only flip must still be written to the store.
+            import dataclasses as _dc
+            if ([_dc.asdict(r) for r in g.status.roles]
+                    != [_dc.asdict(r) for r in new_roles]):
                 g.status.roles = new_roles
                 changed = True
             if g.status.observed_generation != g.metadata.generation:

@@ -50,8 +50,7 @@ def update_revision_of(ris) -> str:
 
 
 def instance_ready(inst: RoleInstance) -> bool:
-    c = get_condition(inst.status.conditions, C.COND_READY)
-    return c is not None and c.status == "True"
+    return su.is_ready(inst)   # single readiness predicate, planner-shared
 
 
 class RoleInstanceSetController(Controller):
@@ -89,11 +88,10 @@ class RoleInstanceSetController(Controller):
             if i.metadata.deletion_timestamp is None
         ]
 
-        requeue = None
         if ris.spec.stateful:
             requeue = self._sync_stateful(store, ris, instances, revision)
         else:
-            self._sync_stateless(store, ris, instances, revision)
+            requeue = self._sync_stateless(store, ris, instances, revision)
 
         self._update_status(store, ris, revision)
         return Result(requeue_after=requeue) if requeue is not None else None
@@ -171,13 +169,28 @@ class RoleInstanceSetController(Controller):
                 store.delete("RoleInstance", ns, inst.metadata.name)
                 active.remove(inst)
 
-        # update: replace outdated within budget
+        # update: replace outdated within budget. paused freezes update
+        # progress (scale & specified-delete above still apply); the budget
+        # counts AVAILABILITY (ready past min_ready_seconds), so a
+        # just-ready instance doesn't license another replacement. When a
+        # ready-but-young instance holds the budget, requeue for the moment
+        # its maturation window expires — no store event marks that instant.
         ru = ris.spec.rolling_update
-        unavailable = sum(1 for i in active if not instance_ready(i))
+        if ru.paused:
+            return None
+        now = time.time()
+        unavailable = 0
+        soonest: Optional[float] = None
+        for i in active:
+            avail, wait = su.is_available(i, ru.min_ready_seconds, now)
+            if not avail:
+                unavailable += 1
+                if wait > 0 and (soonest is None or wait < soonest):
+                    soonest = wait
         budget = max(0, ru.max_unavailable - unavailable)
-        for inst in active:
-            if inst.metadata.labels.get(C.LABEL_REVISION_NAME) == revision:
-                continue
+        outdated = [i for i in active
+                    if i.metadata.labels.get(C.LABEL_REVISION_NAME) != revision]
+        for inst in outdated:
             if budget <= 0:
                 break
             if self._try_inplace(store, ris, inst, revision):
@@ -185,6 +198,9 @@ class RoleInstanceSetController(Controller):
                 continue
             store.delete("RoleInstance", ns, inst.metadata.name)
             budget -= 1
+        if outdated and budget <= 0 and soonest is not None:
+            return max(0.05, soonest)
+        return None
 
     def _try_inplace(self, store, ris, inst, revision) -> bool:
         """Image-only changes update pods in place (no recreation).
@@ -247,8 +263,10 @@ class RoleInstanceSetController(Controller):
             snap = store.get("ControllerRevision", ris.metadata.namespace,
                              self._rev_name(ris, revision), copy_=False)
             if snap is not None:
-                return (serde.from_dict(InstanceTemplate, snap.data["instance"]),
-                        serde.from_dict(RestartPolicyConfig, snap.data["restart"]),
+                return (serde.from_dict(InstanceTemplate, snap.data["instance"],
+                                         lenient=True),
+                        serde.from_dict(RestartPolicyConfig, snap.data["restart"],
+                                        lenient=True),
                         revision)
         return (copy.deepcopy(ris.spec.instance),
                 copy.deepcopy(ris.spec.restart_policy),
@@ -283,21 +301,16 @@ class RoleInstanceSetController(Controller):
             i for i in store.list("RoleInstance", namespace=ns, owner_uid=ris.metadata.uid)
             if i.metadata.deletion_timestamp is None
         ]
-        total = len(instances)
-        ready = sum(1 for i in instances if instance_ready(i))
-        updated = sum(1 for i in instances
-                      if i.metadata.labels.get(C.LABEL_REVISION_NAME) == revision)
-        updated_ready = sum(
-            1 for i in instances
-            if i.metadata.labels.get(C.LABEL_REVISION_NAME) == revision and instance_ready(i)
-        )
         now = time.time()
 
-        # Ready condition + CurrentRevision advance are ordinal-aware for
-        # stateful sets: surge instances (ord >= replicas) must not make a
-        # mid-rollout set look Ready, and the advance guard
-        # (stateful_update.should_advance_current_revision) needs the base
-        # ordinal snapshot.
+        # For stateful sets every counter is BASE-scoped (ordinals <
+        # spec.replicas): surge instances are transient rollout scaffolding,
+        # and every downstream consumer — the group Ready rollup, the
+        # coordinated-rollout skew math (updated_ready drives partitions),
+        # the scaling progression gate — means "serving base capacity".
+        # Counting surge would let a rollout with max_surge report
+        # updated_ready > 0 while zero base ordinals run the new revision,
+        # opening sibling roles' partitions beyond the skew bound.
         n = ris.spec.replicas
         if ris.spec.stateful:
             by_ord = {}
@@ -305,17 +318,37 @@ class RoleInstanceSetController(Controller):
                 o = _ordinal(name, i.metadata.name)
                 if o >= 0:
                     by_ord[o] = i
-            base = [by_ord[o] for o in range(n) if o in by_ord]
-            is_ready_now = (len(base) == n
-                            and all(instance_ready(i) for i in base))
+            counted = [by_ord[o] for o in range(n) if o in by_ord]
             current_rev = ris.status.current_revision or revision
             topo = su.compute_topology(ris, by_ord, current_rev, revision)
             advance = su.should_advance_current_revision(ris, by_ord, topo, revision)
+            # Steady state: every base ordinal present and ready. Mid-rollout
+            # the Ready condition is CAPACITY-based — a surge instance holds
+            # ordinal 1's capacity while it is replaced, so total ready
+            # in-range instances >= replicas keeps the set (and the group
+            # rollup above it) Ready through a zero-disruption surge rollout.
+            live_ready = sum(
+                1 for o in range(topo.end_ordinal)
+                if o in by_ord and instance_ready(by_ord[o]))
+            is_ready_now = (
+                (len(counted) == n and all(instance_ready(i) for i in counted))
+                or (topo.in_rollout and live_ready >= n))
         else:
-            is_ready_now = ready == n and total == n
+            counted = instances
+            is_ready_now = (len(counted) == n
+                            and all(instance_ready(i) for i in counted))
+        total = len(counted)
+        ready = sum(1 for i in counted if instance_ready(i))
+        updated = sum(1 for i in counted
+                      if i.metadata.labels.get(C.LABEL_REVISION_NAME) == revision)
+        updated_ready = sum(
+            1 for i in counted
+            if i.metadata.labels.get(C.LABEL_REVISION_NAME) == revision and instance_ready(i)
+        )
+        if not ris.spec.stateful:
             advance = updated == total and total > 0
         count_by_rev = {}
-        for i in instances:
+        for i in counted:
             rev = i.metadata.labels.get(C.LABEL_REVISION_NAME, "")
             count_by_rev[rev] = count_by_rev.get(rev, 0) + 1
 

@@ -204,7 +204,16 @@ def compute_topology(ris, by_ord, current_rev: str, update_rev: str) -> Topology
     if t.max_surge == 0 and t.max_unavailable < 1:
         t.max_unavailable = 1   # rollout must be able to make progress
     t.partition = min(max(0, ru.partition), t.replicas)
-    t.in_rollout = current_rev != update_rev and not ru.paused
+    # A rollout is in progress when the revisions disagree OR a base
+    # instance sits at a stale revision while current == update — the
+    # rollback-to-current-mid-rollout case (undo before the advance guard
+    # fired): instances at the abandoned intermediate revision must still
+    # be walked back, or the set wedges with no event to wake it.
+    stale_in_base = any(
+        o in by_ord and revision_of(by_ord[o]) != update_rev
+        for o in range(t.partition, t.replicas)
+    )
+    t.in_rollout = (current_rev != update_rev or stale_in_base) and not ru.paused
 
     if t.max_surge == 0:
         return t
@@ -274,11 +283,25 @@ def plan_stateful(ris, instances, current_rev: str, update_rev: str,
     # ---- Phase B: scale & identity. In-range slots [0, end_ordinal) are
     # populated; everything else (incl. stale surge) is condemned, highest
     # ordinal first (ref :408-472).
-    for o in range(topo.end_ordinal):
+    #
+    # PAUSED mid-rollout changes both halves: missing BASE ordinals are
+    # recreated at the CURRENT (known-good) revision — pause exists to stop
+    # the new revision from spreading, and a node failure must not smuggle
+    # it in — while the surge range is frozen as-is: no new surge creates
+    # (they'd be update-revision instances) and no condemns inside
+    # [replicas, replicas+max_surge) (a gapped surge range must not delete
+    # a live, ready surge instance just to re-number it).
+    paused_mid_rollout = (ris.spec.rolling_update.paused
+                          and current_rev != update_rev)
+    create_end = topo.replicas if paused_mid_rollout else topo.end_ordinal
+    for o in range(create_end):
         if o not in by_ord:
-            rev = current_rev if o < topo.partition else update_rev
+            rev = current_rev if (o < topo.partition or paused_mid_rollout) \
+                else update_rev
             plan.create.append((f"{name}-{o}", o, rev))
-    for o in sorted((o for o in by_ord if o >= topo.end_ordinal), reverse=True):
+    condemn_start = (topo.replicas + topo.max_surge) if paused_mid_rollout \
+        else topo.end_ordinal
+    for o in sorted((o for o in by_ord if o >= condemn_start), reverse=True):
         plan.condemn.append(by_ord[o].metadata.name)
 
     if not topo.in_rollout:
@@ -360,6 +383,8 @@ def should_advance_current_revision(ris, by_ord, topo: Topology,
         return False
     if ris.status.update_revision != update_rev:
         return False
-    if ris.status.updated_replicas < topo.replicas - topo.partition:
+    # partition is always 0 past guard ② — the persisted concurrence must
+    # cover the full base.
+    if ris.status.updated_replicas < topo.replicas:
         return False
     return _all_base_at_update_rev_healthy(by_ord, topo, update_rev)

@@ -279,6 +279,12 @@ class Store:
 
     # ---- persistence (etcd-snapshot equivalent) ----
 
+    # Snapshot schema version. Bump ONLY for structural changes that lenient
+    # parsing + field defaults can't absorb; add a migration fn to
+    # _SNAPSHOT_MIGRATIONS for each bump (docs/architecture.md §5).
+    SNAPSHOT_SCHEMA = 1
+    _SNAPSHOT_MIGRATIONS: dict = {}   # {from_schema: fn(data_dict) -> data_dict}
+
     def snapshot(self) -> dict:
         """Serializable snapshot of every object + the rv counter.
         Serialization runs OUTSIDE the lock (stored objects are never mutated
@@ -288,17 +294,38 @@ class Store:
         with self._lock:
             rv = self._rv
             objects = list(self._objects.values())
-        return {"rv": rv, "objects": [serde.to_dict(o) for o in objects]}
+        return {"schema": self.SNAPSHOT_SCHEMA, "rv": rv,
+                "objects": [serde.to_dict(o) for o in objects]}
 
     def load_snapshot(self, data: dict) -> int:
         """Restore objects from a snapshot into an empty store. Watches fire
-        no events (controllers do their initial LIST sync on start)."""
+        no events (controllers do their initial LIST sync on start).
+        Parsing is LENIENT (snapshots outlive code both ways: a newer
+        release's extra fields must not crash-loop a rollback), after
+        running any schema migrations forward."""
         from rbg_tpu.api import parse_manifest
+        schema = int(data.get("schema", 1))
+        if schema > self.SNAPSHOT_SCHEMA:
+            # A schema bump marks a structural change lenient parsing CANNOT
+            # absorb — loading a newer-schema file must be an explicit
+            # error, not a silent misparse.
+            raise ValueError(
+                f"state-file schema {schema} is newer than this release's "
+                f"{self.SNAPSHOT_SCHEMA}; upgrade the binary or restore an "
+                f"older snapshot")
+        while schema < self.SNAPSHOT_SCHEMA:
+            migrate = self._SNAPSHOT_MIGRATIONS.get(schema)
+            if migrate is None:
+                raise ValueError(
+                    f"state-file schema {schema} has no migration to "
+                    f"{self.SNAPSHOT_SCHEMA}")
+            data = migrate(data)
+            schema += 1
         count = 0
         with self._lock:
             self._rv = max(self._rv, int(data.get("rv", 0)))
             for doc in data.get("objects", []):
-                obj = parse_manifest(doc)
+                obj = parse_manifest(doc, lenient=True)
                 k = self.key(obj)
                 if k in self._objects:
                     continue

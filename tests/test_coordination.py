@@ -178,3 +178,24 @@ def test_warmup_runs_on_group_nodes(plane):
 
     done_w = plane.wait_for(done, timeout=15, desc="warmup succeeded")
     assert done_w.status.succeeded_nodes == done_w.status.desired_nodes > 0
+
+
+def test_dependencies_ready_uses_rolled_up_flag():
+    """dependencies_ready consumes RoleStatus.ready (capacity-aware during
+    surge rollouts), not raw counter equality — a surge rollout's transient
+    base-counter dip must not flap dependents."""
+    from rbg_tpu.coordination.dependency import dependencies_ready
+
+    g = make_group("dep", simple_role("a", replicas=2),
+                   simple_role("b", replicas=1))
+    g.spec.roles[1].dependencies = ["a"]
+    role_b = g.spec.roles[1]
+
+    # Mid-surge-rollout: base counter dipped to 1 but the rolled-up flag
+    # (from the RIS Ready condition) says capacity is held.
+    g.status.roles = [RoleStatus(name="a", replicas=1, ready_replicas=1,
+                                 ready=True)]
+    assert dependencies_ready(g, role_b)
+
+    g.status.roles[0].ready = False
+    assert not dependencies_ready(g, role_b)

@@ -60,3 +60,50 @@ def test_new_plane_resumes_from_existing_store():
                   for p in store.list("Pod", namespace="default")
                   if p.active and p.metadata.labels[C.LABEL_ROLE_NAME] == "serve"}.pop()
         assert slice1 == slice0
+
+
+def test_snapshot_lenient_load_and_schema(tmp_path):
+    """Schema evolution (docs/architecture.md §5): a snapshot written by a
+    NEWER release (extra unknown fields, same schema int) loads leniently;
+    admission stays strict; an unmigratable schema int is a hard error."""
+    import pytest
+
+    from rbg_tpu.api import parse_manifest
+    from rbg_tpu.runtime.store import Store
+    from rbg_tpu.testutil import make_group, simple_role
+
+    src = Store()
+    src.create(make_group("g", simple_role("server", replicas=2)))
+    snap = src.snapshot()
+    assert snap["schema"] == Store.SNAPSHOT_SCHEMA
+
+    # Simulate a newer release's extra fields at several depths.
+    snap["objects"][0]["futureTopLevel"] = {"x": 1}
+    snap["objects"][0]["spec"]["roles"][0]["futureKnob"] = 7
+
+    dst = Store()
+    assert dst.load_snapshot(snap) == 1
+    g = dst.get("RoleBasedGroup", "default", "g")
+    assert g.spec.roles[0].replicas == 2
+
+    # Admission-path parsing of the same doc stays strict.
+    with pytest.raises(KeyError):
+        parse_manifest(snap["objects"][0])
+
+    # Old schema with no migration chain → explicit error, not silent
+    # misparse; same for a FUTURE schema (structural change by definition).
+    snap2 = src.snapshot()
+    snap2["schema"] = 0
+    with pytest.raises(ValueError):
+        Store().load_snapshot(snap2)
+    snap3 = src.snapshot()
+    snap3["schema"] = Store.SNAPSHOT_SCHEMA + 1
+    with pytest.raises(ValueError):
+        Store().load_snapshot(snap3)
+
+    # Derived status must not leak into the wire format: a Ready group's
+    # snapshot still loads on the previous strict-parsing release.
+    from rbg_tpu.api import serde
+    from rbg_tpu.api.group import RoleStatus
+    assert "ready" not in serde.to_dict(
+        RoleStatus(name="a", replicas=1, ready_replicas=1, ready=True))

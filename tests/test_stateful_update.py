@@ -287,6 +287,45 @@ def test_plan_young_surge_provides_no_budget_under_min_ready():
     assert [a.name for a in plan2.updates] == ["s-2"]
 
 
+def test_plan_paused_recreates_missing_base_at_current_rev():
+    """A paused mid-rollout set that loses a base ordinal (node failure)
+    must recreate it at the CURRENT revision — pause means the new revision
+    must not spread."""
+    ris = make_ris(replicas=2, paused=True)
+    # ordinal 1 vanished; ordinal 0 still at OLD
+    _, plan = run_plan(ris, [make_inst(0, OLD)])
+    assert plan.create == [("s-1", 1, OLD)]
+    assert plan.updates == []
+    # Unpaused: the same missing ordinal comes back at the UPDATE revision.
+    ris2 = make_ris(replicas=2)
+    _, plan2 = run_plan(ris2, [make_inst(0, OLD)])
+    assert ("s-1", 1, NEW) in plan2.create
+
+
+def test_plan_paused_freezes_gapped_surge_range():
+    """Paused with a GAP in the surge range (ord 2 lost, ord 3 alive at the
+    update revision): no re-numbering — the live surge instance is kept and
+    no update-revision create is issued."""
+    ris = make_ris(replicas=2, max_surge=2, paused=True)
+    insts = [make_inst(0, OLD), make_inst(1, OLD), make_inst(3, NEW)]
+    _, plan = run_plan(ris, insts)
+    assert plan.create == []
+    assert plan.condemn == []
+    assert plan.updates == []
+
+
+def test_plan_rollback_to_current_mid_rollout_converges():
+    """Regression: rollout undo back to the CURRENT revision while an
+    instance still sits at the abandoned intermediate revision leaves
+    current == update; the stale instance must still be walked back or the
+    set wedges with no wake-up event (admin-cli undo flake, round 2)."""
+    ris = make_ris(replicas=2)
+    insts = [make_inst(0, "rev-abandoned"), make_inst(1, OLD)]
+    _, plan = run_plan(ris, insts, current=OLD, update=OLD)
+    assert plan.topology.in_rollout
+    assert [a.name for a in plan.updates] == ["s-0"]
+
+
 # ---------------- advance guard ----------------
 
 def test_advance_guard_table():
@@ -345,9 +384,23 @@ def test_e2e_surge_rollout_keeps_capacity(plane):
     plane.store.update(g)
 
     low_water = [2]
+    group_went_unready = [False]
+    counter_overshoot = [False]
 
     def rolled():
         low_water[0] = min(low_water[0], len(_ready_actives(plane)))
+        # Group Ready must never flip False: base capacity never dips, and
+        # the rollup is base-scoped so the transient 3rd (surge) instance
+        # can't break `replicas == spec.replicas`.
+        from rbg_tpu.api.meta import get_condition
+        g_now = plane.store.get("RoleBasedGroup", "default", "sg")
+        c = get_condition(g_now.status.conditions, "Ready")
+        if c is not None and c.status != "True":
+            group_went_unready[0] = True
+        ris = plane.store.get("RoleInstanceSet", "default", "sg-server")
+        if (ris.status.replicas > 2 or ris.status.ready_replicas > 2
+                or ris.status.updated_ready_replicas > 2):
+            counter_overshoot[0] = True
         pods = [p for p in plane.store.list("Pod", namespace="default")
                 if p.active]
         return (len(pods) == 2
@@ -357,6 +410,8 @@ def test_e2e_surge_rollout_keeps_capacity(plane):
 
     plane.wait_for(rolled, timeout=30, desc="surge rollout complete")
     assert low_water[0] >= 2, f"ready pods dipped to {low_water[0]}"
+    assert not group_went_unready[0], "zero-disruption surge rollout flipped group Ready"
+    assert not counter_overshoot[0], "RIS status counters included surge instances"
 
     # Surge instance (ordinal 2) is condemned once the rollout completes.
     def surge_gone():

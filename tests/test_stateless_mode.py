@@ -63,3 +63,42 @@ def test_specified_delete_annotation():
         plane.wait_for(replaced, timeout=20,
                        desc="specified-delete replaced the instance")
         plane.wait_group_ready("sd", timeout=20)
+
+
+def test_stateless_paused_freezes_update():
+    """paused stops outdated-instance replacement for stateless sets too
+    (scale still applies)."""
+    import time as _time
+    from rbg_tpu.api.group import RollingUpdate
+
+    with _plane() as plane:
+        role = simple_role("worker", replicas=2)
+        role.stateful = False
+        role.rolling_update = RollingUpdate(paused=True,
+                                            in_place_if_possible=False)
+        plane.apply(make_group("pz", role))
+        plane.wait_group_ready("pz", timeout=20)
+        uids0 = {i.metadata.uid for i in
+                 plane.store.list("RoleInstance", namespace="default")}
+
+        g = plane.store.get("RoleBasedGroup", "default", "pz")
+        g.spec.roles[0].template.containers[0].image = "engine:v2"
+        plane.store.update(g)
+        _time.sleep(0.8)   # several reconcile cycles
+        insts = plane.store.list("RoleInstance", namespace="default")
+        assert {i.metadata.uid for i in insts} == uids0, \
+            "paused stateless rollout replaced instances"
+
+        # unpause → rollout proceeds
+        g = plane.store.get("RoleBasedGroup", "default", "pz")
+        g.spec.roles[0].rolling_update.paused = False
+        plane.store.update(g)
+
+        def rolled():
+            pods = [p for p in plane.store.list("Pod", namespace="default")
+                    if p.active]
+            return (len(pods) == 2
+                    and all(p.template.containers[0].image == "engine:v2"
+                            for p in pods))
+
+        plane.wait_for(rolled, timeout=20, desc="unpaused rollout completes")

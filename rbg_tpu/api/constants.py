@@ -39,6 +39,18 @@ ANN_PORT_ALLOCATOR = f"{DOMAIN}/port-allocator"          # JSON config
 ANN_ALLOCATED_PORTS = f"{DOMAIN}/allocated-ports"        # JSON result
 ANN_COMPONENT_DEPENDS_ON = f"{DOMAIN}/component-depends-on"  # JSON
 ANN_SLICE_BINDING = f"{DOMAIN}/slice-binding"            # recorded slice id
+# In-place update state on a Pod: JSON {revision, images, restarted,
+# baselines, notReadyAt, grace} (reference analog: Kruise's
+# apps.kruise.io/inplace-update-state, pkg/inplace inplace_update.go:223-316).
+ANN_INPLACE_UPDATE_STATE = f"{DOMAIN}/inplace-update-state"
+# PreparingDelete lifecycle (stateless scale-down drain; reference:
+# statelessmode lifecycle states, constants.go:75-80): the instance keeps
+# serving in-flight work until a drain agent acks (drain-complete=true) or
+# the deadline passes, and may be resurrected by a scale-up.
+ANN_LIFECYCLE_STATE = f"{DOMAIN}/lifecycle-state"    # PreparingDelete
+ANN_DRAIN_DEADLINE = f"{DOMAIN}/drain-deadline"      # unix seconds
+ANN_DRAIN_COMPLETE = f"{DOMAIN}/drain-complete"      # "true" from drain agent
+LIFECYCLE_PREPARING_DELETE = "PreparingDelete"
 ANN_DISCOVERY_CONFIG_MODE = f"{DOMAIN}/discovery-config-mode"  # legacy|refine
 
 # ---- env vars injected into engine processes (reference: env.go:24-79) ----

@@ -125,6 +125,11 @@ class RollingUpdate:
     # Seconds an instance must be Ready before it counts as available for
     # the rolling-update budget (reference: getMinReadySeconds).
     min_ready_seconds: int = 0
+    # In-place update drain window: the pod sits InPlaceUpdateReady=False
+    # for this long BEFORE its images are patched, so routers/endpoints can
+    # drain it (reference: InPlaceUpdateStrategy.GracePeriodSeconds,
+    # ``inplace_update.go:258-283``).
+    grace_seconds: float = 0.0
 
 
 @dataclasses.dataclass
@@ -164,6 +169,12 @@ class RoleSpec:
     engine_runtime: Optional[EngineRuntimeRef] = None
     stateful: bool = True       # ordered identity (TPU slices want this)
     workload: str = "RoleInstanceSet"  # strategy selector (inventory #23)
+    # Scale-down drain window (stateless mode): an instance slated for
+    # deletion enters PreparingDelete and keeps serving in-flight work for
+    # up to this long (or until a drain agent acks) before the pods die
+    # (reference: statelessmode preparingDelete lifecycle,
+    # ``api/workloads/constants/constants.go:75-80``).
+    drain_seconds: float = 0.0
     # KEP-260 sharedServiceSelection: "All" exposes every pod through the
     # role service; "LeaderOnly" exposes only instance leaders (component
     # index 0) — routers then address one endpoint per multi-host instance.

@@ -46,6 +46,8 @@ class RoleInstanceSetSpec:
     restart_policy: RestartPolicyConfig = dataclasses.field(default_factory=RestartPolicyConfig)
     rolling_update: RollingUpdate = dataclasses.field(default_factory=RollingUpdate)
     selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # PreparingDelete drain window for stateless scale-down (0 = immediate).
+    drain_seconds: float = 0.0
 
 
 @dataclasses.dataclass
@@ -94,6 +96,10 @@ class RoleInstanceSpec:
     instance: InstanceTemplate = dataclasses.field(default_factory=InstanceTemplate)
     restart_policy: RestartPolicyConfig = dataclasses.field(default_factory=RestartPolicyConfig)
     index: int = -1             # ordinal for stateful instances; -1 stateless
+    # Drain window for in-place updates, propagated from the set's
+    # rollingUpdate.graceSeconds when an update is recorded (the pod-level
+    # convergence loop needs it without reaching back to the RIS).
+    inplace_grace_seconds: float = 0.0
 
 
 @dataclasses.dataclass

@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional
 
+from rbg_tpu.api import constants as C
 from rbg_tpu.api.meta import Condition, ObjectMeta
 
 
@@ -77,6 +78,10 @@ class NodeAffinityTerm:
 @dataclasses.dataclass
 class PodStatus:
     phase: str = "Pending"  # Pending | Running | Succeeded | Failed
+    # Machine-readable failure reason (reference analog: corev1 Pod
+    # status.reason — "Evicted", "UnexpectedAdmissionError", ...; consumed
+    # by the inactive-pod handling of keps/inactive-pod-handling).
+    reason: str = ""
     ready: bool = False
     node_name: str = ""
     pod_ip: str = ""
@@ -110,8 +115,42 @@ class Pod:
         )
 
     @property
+    def inactive_reason(self) -> str:
+        """Why this pod is inactive (reference: GetPodInactiveReason,
+        keps/inactive-pod-handling): Evicted / UnexpectedAdmissionError /
+        the raw reason / the terminal phase / Terminating; empty = active."""
+        if self.active:
+            return ""
+        if self.metadata.deletion_timestamp is not None:
+            return "Terminating"
+        if self.evicted:
+            return "Evicted"
+        if self.status.reason:
+            return self.status.reason
+        return self.status.phase  # Failed | Succeeded
+
+    @property
+    def evicted(self) -> bool:
+        """Evicted by node pressure / disruption (reference: IsPodEvicted —
+        Failed + reason Evicted or a DisruptionTarget condition)."""
+        if self.status.phase != "Failed":
+            return False
+        if self.status.reason == "Evicted":
+            return True
+        return any(c.type == "DisruptionTarget" and c.status == "True"
+                   for c in self.status.conditions)
+
+    @property
+    def inplace_update_pending(self) -> bool:
+        """An in-place update readiness gate is held (reference analog: the
+        InPlaceUpdateReady readinessGate, ``pkg/inplace/pod/readiness``)."""
+        return any(c.type == C.COND_INPLACE_UPDATE_READY and c.status == "False"
+                   for c in self.status.conditions)
+
+    @property
     def running_ready(self) -> bool:
-        return self.active and self.status.phase == "Running" and self.status.ready
+        return (self.active and self.status.phase == "Running"
+                and self.status.ready and not self.inplace_update_pending)
 
 
 @dataclasses.dataclass

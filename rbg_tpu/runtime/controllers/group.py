@@ -25,7 +25,7 @@ from rbg_tpu.coordination.dependency import dependencies_ready, sort_roles
 from rbg_tpu.runtime.controller import (
     Controller, Result, Watch, own_keys, owner_keys,
 )
-from rbg_tpu.runtime.store import AlreadyExists, Store
+from rbg_tpu.runtime.store import AlreadyExists, Conflict, NotFound, Store
 from rbg_tpu.utils import spec_hash
 
 REVISION_HISTORY_LIMIT = 10
@@ -81,6 +81,8 @@ class RoleBasedGroupController(Controller):
         from rbg_tpu.runtime.controllers.scalingadapter import ensure_auto_adapters
         ensure_auto_adapters(store, rbg)
         rbg = self._apply_scaling_overrides(store, rbg)
+        if rbg is None:
+            return None  # deleted while applying overrides
 
         # 3. revisions
         revision_name, role_hashes = self._ensure_revision(store, rbg)
@@ -199,8 +201,13 @@ class RoleBasedGroupController(Controller):
         if changed:
             try:
                 rbg = store.update(rbg)
-            except Exception:
-                rbg = store.get("RoleBasedGroup", rbg.metadata.namespace, rbg.metadata.name)
+            except Conflict:
+                # Someone else moved the spec — re-read; the next pass
+                # re-applies the adapter override over the fresh object.
+                rbg = store.get("RoleBasedGroup", rbg.metadata.namespace,
+                                rbg.metadata.name)
+            except NotFound:
+                return None  # group deleted concurrently — caller bails
         return rbg
 
     # ---- coordination (maxSkew clamp; full engine in coordination/scaling) ----
@@ -323,6 +330,7 @@ class RoleBasedGroupController(Controller):
             restart_policy=role.restart_policy,
             rolling_update=rolling,
             selector=dict(labels),
+            drain_seconds=role.drain_seconds,
         )
 
         cur = store.get("RoleInstanceSet", ns, wname, copy_=False)

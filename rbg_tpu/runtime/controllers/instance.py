@@ -107,6 +107,12 @@ class RoleInstanceController(Controller):
         if res is not None:
             return res
 
+        # ---- in-place update progression: deferred image patches after the
+        # grace/drain window, InPlaceUpdateReady completion on backend ack
+        # (reference: pkg/inplace readiness machinery) ----
+        from rbg_tpu.inplace.update import progress_inplace_updates
+        inplace_delay = progress_inplace_updates(store, inst, pods, desired)
+
         # ---- scale/create: converge pod set ----
         self._ensure_pod_group(store, inst, desired)
         pg_name = self._pod_group_name(inst, desired)
@@ -126,16 +132,41 @@ class RoleInstanceController(Controller):
                 self._create_pod(store, inst, pod_name, comp, cid, cidx, tmpl,
                                  len(desired), pg_name)
         gated_deletion = self._delete_surplus(store, inst, active, wanted)
-        # Replace terminal (Failed/Succeeded) pods when policy is None:
-        # recreate just that pod (no gang restart).
+        # Level-1 inactive-pod handling (keps/inactive-pod-handling): a
+        # Failed pod (Evicted, UnexpectedAdmissionError, ...) squats its
+        # fixed name and blocks the replacement — delete it so the next
+        # reconcile recreates it. Applies under EVERY restart policy: with
+        # RecreateInstance, reaching this point means the failure was
+        # excluded from the gang-restart trigger (Ignore annotation) or the
+        # cycle already ran — pod-level replacement is the remaining fix.
+        # Succeeded (normal completion) pods are left alone.
+        for p in pods:
+            if p.status.phase == "Failed" and p.metadata.deletion_timestamp is None:
+                store.record_event(
+                    inst, "ReplacingFailedPod",
+                    f"pod {p.metadata.name} inactive "
+                    f"({p.inactive_reason or 'Failed'}); deleting so the "
+                    f"fixed-name replacement can be created")
+                store.delete("Pod", ns, p.metadata.name)
+        # Replace Succeeded pods only under policy None (legacy behavior for
+        # run-to-completion mains that should restart).
         if inst.spec.restart_policy.policy == RestartPolicy.NONE:
             for p in pods:
-                if not p.active and p.metadata.deletion_timestamp is None:
+                if (p.status.phase == "Succeeded"
+                        and p.metadata.deletion_timestamp is None):
                     store.delete("Pod", ns, p.metadata.name)
 
         status_res = self._update_status(store, inst, desired)
         if not created_all or gated_deletion:
             return Result(requeue_after=0.1)  # revisit once ordering gates open
+        # Combine requeue sources: the soonest deadline wins (a status-side
+        # requeue must not mask a pending grace-window patch, or vice versa).
+        delays = [r.requeue_after for r in (status_res,) if r is not None
+                  and r.requeue_after is not None]
+        if inplace_delay is not None:
+            delays.append(inplace_delay)
+        if delays:
+            return Result(requeue_after=min(delays))
         return status_res
 
     def _delete_surplus(self, store, inst, active, wanted) -> bool:
@@ -184,9 +215,16 @@ class RoleInstanceController(Controller):
 
     def _restart_triggered(self, inst, pods, desired) -> bool:
         """Trigger on terminal (Failed) pods or in-pod container restarts —
-        terminal pods are no longer 'active', so scan ALL owned pods."""
+        terminal pods are no longer 'active', so scan ALL owned pods.
+
+        Restart counts are compared against the per-container baselines the
+        in-place updater records (reference: container-restart baselines,
+        ``sync/instance_scale.go:542-607``): a container the update swapped
+        is allowed exactly one expected restart; anything beyond — or any
+        restart of an untouched container — is a real failure."""
         if inst.spec.restart_policy.policy == RestartPolicy.NONE:
             return False
+        from rbg_tpu.inplace.update import expected_restarts
         ignored = set()
         for (pn, comp, _cid, _cidx, tmpl) in desired:
             if tmpl and tmpl.annotations.get(C.ANN_RESTART_TRIGGER_POLICY) == "Ignore":
@@ -194,7 +232,14 @@ class RoleInstanceController(Controller):
         for p in pods:
             if p.metadata.name in ignored or p.metadata.deletion_timestamp is not None:
                 continue
-            if p.status.phase == "Failed" or p.status.restart_count > 0:
+            if p.status.phase == "Failed":
+                return True
+            allowed = expected_restarts(p) or {}
+            if p.status.container_restarts:
+                if any(n > allowed.get(c, 0)
+                       for c, n in p.status.container_restarts.items()):
+                    return True
+            elif p.status.restart_count > sum(allowed.values()):
                 return True
         return False
 

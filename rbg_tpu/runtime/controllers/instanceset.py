@@ -139,23 +139,54 @@ class RoleInstanceSetController(Controller):
     def _sync_stateless(self, store, ris, instances, revision):
         ns, name = ris.metadata.namespace, ris.metadata.name
         n = ris.spec.replicas
-        active = list(instances)
+
+        # PreparingDelete lifecycle (reference: statelessmode lifecycle
+        # states, constants.go:75-80): instances slated for deletion drain
+        # first; they are excluded from replica accounting so a replacement
+        # spins up while the condemned one finishes in-flight work.
+        def _is_draining(i):
+            return (i.metadata.annotations.get(C.ANN_LIFECYCLE_STATE)
+                    == C.LIFECYCLE_PREPARING_DELETE)
+
+        draining = [i for i in instances if _is_draining(i)]
+        active = [i for i in instances if not _is_draining(i)]
+        drain_requeue = self._progress_draining(store, ris, draining)
 
         # specified-delete first (reference: statelessmode lifecycle)
         for inst in list(active):
             if inst.metadata.annotations.get(ANN_SPECIFIED_DELETE) == "true":
-                store.delete("RoleInstance", ns, inst.metadata.name)
+                self._begin_delete(store, ris, inst)
                 active.remove(inst)
 
         diff = n - len(active)
         if diff > 0:
-            existing = {i.metadata.name for i in active}
+            # Resurrect draining instances before creating new ones
+            # (reference: preparingDelete → Normal recovery on scale-up) —
+            # a drained-but-alive worker returns to service instantly, no
+            # cold start. Ready and newest first.
+            def res_key(i):
+                return (not instance_ready(i), -i.metadata.creation_timestamp)
+            for inst in sorted(draining, key=res_key):
+                if diff <= 0:
+                    break
+                if inst.metadata.annotations.get(ANN_SPECIFIED_DELETE) == "true":
+                    continue  # explicitly condemned — never resurrect
+                if inst.metadata.labels.get(C.LABEL_REVISION_NAME) != revision:
+                    continue  # condemned BY the rollout — resurrecting it
+                    # would loop condemn/resurrect forever; a fresh instance
+                    # at the update revision replaces it instead
+                if self._cancel_delete(store, inst):
+                    draining.remove(inst)
+                    active.append(inst)
+                    diff -= 1
+            existing = {i.metadata.name for i in instances}
             for _ in range(diff):
                 iname = f"{name}-{_rand_id()}"
                 while iname in existing:
                     iname = f"{name}-{_rand_id()}"
                 existing.add(iname)
                 self._create_instance(store, ris, iname, -1, revision)
+            diff = 0
         elif diff < 0:
             # delete preference: not-ready first, then outdated, then newest
             def key(i):
@@ -166,7 +197,7 @@ class RoleInstanceSetController(Controller):
                 )
 
             for inst in sorted(active, key=key)[: -diff]:
-                store.delete("RoleInstance", ns, inst.metadata.name)
+                self._begin_delete(store, ris, inst)
                 active.remove(inst)
 
         # update: replace outdated within budget. paused freezes update
@@ -196,11 +227,117 @@ class RoleInstanceSetController(Controller):
             if self._try_inplace(store, ris, inst, revision):
                 budget -= 1
                 continue
-            store.delete("RoleInstance", ns, inst.metadata.name)
+            self._begin_delete(store, ris, inst)
             budget -= 1
+        waits = [w for w in (drain_requeue,) if w is not None]
         if outdated and budget <= 0 and soonest is not None:
-            return max(0.05, soonest)
-        return None
+            waits.append(max(0.05, soonest))
+        return min(waits) if waits else None
+
+    # ---- preparingDelete lifecycle (reference: statelessmode
+    # constants.go:75-80 + sync/scale.go specified-delete/lifecycle) ----
+
+    def _begin_delete(self, store, ris, inst):
+        """Condemn an instance. With a drain window it enters
+        PreparingDelete (kept serving, excluded from replica accounting,
+        pods annotated so engines stop accepting new work); without one it
+        dies immediately."""
+        ns = inst.metadata.namespace
+        drain = float(getattr(ris.spec, "drain_seconds", 0.0) or 0.0)
+        if drain <= 0:
+            store.delete("RoleInstance", ns, inst.metadata.name)
+            return
+        deadline = time.time() + drain
+
+        def fn(i):
+            ann = i.metadata.annotations
+            if ann.get(C.ANN_LIFECYCLE_STATE) == C.LIFECYCLE_PREPARING_DELETE:
+                return False
+            ann[C.ANN_LIFECYCLE_STATE] = C.LIFECYCLE_PREPARING_DELETE
+            ann[C.ANN_DRAIN_DEADLINE] = f"{deadline:.3f}"
+            # A stale ack from a PREVIOUS drain cycle (agent raced the
+            # resurrection) must not void this fresh window.
+            ann.pop(C.ANN_DRAIN_COMPLETE, None)
+            return True
+
+        from rbg_tpu.runtime.store import NotFound
+        try:
+            store.mutate("RoleInstance", ns, inst.metadata.name, fn)
+        except NotFound:
+            return
+        # Drain signal to the engines: annotate the live pods (the engine
+        # process / drain agent watches this and stops taking new work).
+        for pod in store.list("Pod", namespace=ns, owner_uid=inst.metadata.uid):
+            def mark(p):
+                if p.metadata.annotations.get(C.ANN_LIFECYCLE_STATE) == \
+                        C.LIFECYCLE_PREPARING_DELETE:
+                    return False
+                p.metadata.annotations[C.ANN_LIFECYCLE_STATE] = \
+                    C.LIFECYCLE_PREPARING_DELETE
+                return True
+            try:
+                store.mutate("Pod", ns, pod.metadata.name, mark)
+            except NotFound:
+                pass
+        store.record_event(inst, "PreparingDelete",
+                           f"draining up to {drain:.0f}s before deletion")
+
+    def _cancel_delete(self, store, inst) -> bool:
+        """Resurrect a draining instance (scale-up reclaimed it). Returns
+        False when the instance already acked drain-complete — its engine
+        stopped taking work; a fresh instance replaces it instead."""
+        ns = inst.metadata.namespace
+        from rbg_tpu.runtime.store import NotFound
+
+        def fn(i):
+            ann = i.metadata.annotations
+            if ann.get(C.ANN_DRAIN_COMPLETE) == "true":
+                return False
+            changed = False
+            for k in (C.ANN_LIFECYCLE_STATE, C.ANN_DRAIN_DEADLINE):
+                if k in ann:
+                    del ann[k]
+                    changed = True
+            return changed
+
+        try:
+            obj = store.mutate("RoleInstance", ns, inst.metadata.name, fn)
+        except NotFound:
+            return False
+        if obj.metadata.annotations.get(C.ANN_DRAIN_COMPLETE) == "true":
+            return False
+        for pod in store.list("Pod", namespace=ns, owner_uid=inst.metadata.uid):
+            def unmark(p):
+                if C.ANN_LIFECYCLE_STATE not in p.metadata.annotations:
+                    return False
+                del p.metadata.annotations[C.ANN_LIFECYCLE_STATE]
+                return True
+            try:
+                store.mutate("Pod", ns, pod.metadata.name, unmark)
+            except NotFound:
+                pass
+        store.record_event(inst, "DeleteCancelled",
+                           "scale-up reclaimed draining instance")
+        return True
+
+    def _progress_draining(self, store, ris, draining) -> Optional[float]:
+        """Delete drained instances (agent ack or deadline); requeue for the
+        soonest pending deadline."""
+        now = time.time()
+        soonest: Optional[float] = None
+        for inst in draining:
+            ann = inst.metadata.annotations
+            try:
+                deadline = float(ann.get(C.ANN_DRAIN_DEADLINE) or 0.0)
+            except ValueError:
+                deadline = 0.0
+            if ann.get(C.ANN_DRAIN_COMPLETE) == "true" or now >= deadline:
+                store.delete("RoleInstance", inst.metadata.namespace,
+                             inst.metadata.name)
+            else:
+                wait = max(0.05, deadline - now)
+                soonest = wait if soonest is None else min(soonest, wait)
+        return soonest
 
     def _try_inplace(self, store, ris, inst, revision) -> bool:
         """Image-only changes update pods in place (no recreation).
@@ -334,7 +471,11 @@ class RoleInstanceSetController(Controller):
                 (len(counted) == n and all(instance_ready(i) for i in counted))
                 or (topo.in_rollout and live_ready >= n))
         else:
-            counted = instances
+            # Draining (PreparingDelete) instances are excluded: their
+            # capacity is already replaced and they vanish on drain ack.
+            counted = [i for i in instances
+                       if i.metadata.annotations.get(C.ANN_LIFECYCLE_STATE)
+                       != C.LIFECYCLE_PREPARING_DELETE]
             is_ready_now = (len(counted) == n
                             and all(instance_ready(i) for i in counted))
         total = len(counted)

@@ -303,6 +303,12 @@ class LocalExecutor:
                 p.status.pod_ip = "127.0.0.1"
                 if port:
                     p.status.start_time = time.time()
+                if phase == "Running":
+                    # The relaunched process runs whatever the pod spec says
+                    # now — report that revision (in-place update ack).
+                    from rbg_tpu.api import constants as _C
+                    p.status.observed_revision = p.metadata.labels.get(
+                        _C.LABEL_REVISION_NAME, p.status.observed_revision)
                 return True
             self.store.mutate("Pod", key[0], key[1], fn, status=True)
         except Exception:

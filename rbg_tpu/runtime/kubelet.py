@@ -15,8 +15,9 @@ import threading
 import time
 from typing import Callable, Optional
 
+from rbg_tpu.api import constants as C
 from rbg_tpu.api.constants import DOMAIN as _DOMAIN
-from rbg_tpu.runtime.store import Event, Store
+from rbg_tpu.runtime.store import Conflict, Event, NotFound, Store
 
 
 class FakeKubelet:
@@ -84,6 +85,18 @@ class FakeKubelet:
                 self._later(self.ready_delay, self._set_phase, Store.key(pod), "Failed")
             else:
                 self._later(self.ready_delay, self._make_ready, Store.key(pod))
+            return
+        # In-place update ack: a Running pod whose images were patched gets
+        # its updated containers "restarted" (counts bumped) and reports the
+        # new revision — the envtest stand-in for a kubelet applying an
+        # image-only pod update.
+        if pod.status.phase == "Running":
+            from rbg_tpu.inplace.update import images_applied, load_state
+            state = load_state(pod)
+            if (state and state.get("revision")
+                    and state["revision"] != pod.status.observed_revision
+                    and images_applied(pod, state.get("images") or {})):
+                self._later(self.ready_delay, self._ack_inplace, Store.key(pod))
 
     def _make_ready(self, key):
         kind, ns, name = key
@@ -108,10 +121,36 @@ class FakeKubelet:
                 p.status.node_name = p.node_name
                 p.status.pod_ip = node.address if node else "127.0.0.1"
                 p.status.start_time = time.time()
+                p.status.observed_revision = p.metadata.labels.get(
+                    C.LABEL_REVISION_NAME, p.status.observed_revision)
                 return True
 
             self.store.mutate(kind, ns, name, fn, status=True)
-        except Exception:
+        except (NotFound, Conflict):
+            pass  # pod vanished / raced — the fake has no retry loop
+
+    def _ack_inplace(self, key):
+        """Apply an in-place update at the node level: bump restart counts
+        for the swapped containers and report the new revision."""
+        kind, ns, name = key
+        from rbg_tpu.inplace.update import images_applied, load_state
+        try:
+            def fn(p):
+                state = load_state(p)
+                if (not state or p.status.phase != "Running"
+                        or state.get("revision") == p.status.observed_revision
+                        or not images_applied(p, state.get("images") or {})):
+                    return False
+                for c in state.get("restarted", []):
+                    p.status.container_restarts[c] = (
+                        p.status.container_restarts.get(c, 0) + 1)
+                    p.status.restart_count += 1
+                p.status.observed_revision = state["revision"]
+                p.status.ready = True
+                return True
+
+            self.store.mutate(kind, ns, name, fn, status=True)
+        except (NotFound, Conflict):
             pass
 
     def _set_phase(self, key, phase: str):
@@ -123,14 +162,14 @@ class FakeKubelet:
                 return True
 
             self.store.mutate(kind, ns, name, fn, status=True)
-        except Exception:
+        except (NotFound, Conflict):
             pass
 
     def _finalize(self, key):
         kind, ns, name = key
         try:
             self.store.finalize_delete(kind, ns, name)
-        except Exception:
+        except (NotFound, Conflict):
             pass
 
     # ---- test helpers (drive status manually, envtest style) ----
@@ -141,8 +180,19 @@ class FakeKubelet:
         for pod in self.store.list("Pod"):
             self._on_event(Event(Event.ADDED, pod))
 
-    def fail_pod(self, ns: str, name: str):
-        self.store.mutate("Pod", ns, name, lambda p: setattr(p.status, "phase", "Failed") or setattr(p.status, "ready", False) or True, status=True)
+    def fail_pod(self, ns: str, name: str, reason: str = ""):
+        def fn(p):
+            p.status.phase = "Failed"
+            p.status.ready = False
+            if reason:
+                p.status.reason = reason
+            return True
+
+        self.store.mutate("Pod", ns, name, fn, status=True)
+
+    def evict_pod(self, ns: str, name: str):
+        """Node-pressure eviction (keps/inactive-pod-handling story 1)."""
+        self.fail_pod(ns, name, reason="Evicted")
 
     def restart_container(self, ns: str, name: str, container: str = "main"):
         def fn(p):

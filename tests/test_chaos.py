@@ -34,18 +34,23 @@ def test_random_pod_failures_reconverge():
         for i in range(3):
             plane.wait_group_ready(f"g{i}", timeout=30)
 
-        # chaos: kill random pods for a while
+        # chaos: kill/evict random pods for a while (evictions exercise the
+        # inactive-pod handling path, keps/inactive-pod-handling)
         end = time.monotonic() + 3.0
-        kills = 0
+        kills = evictions = 0
         while time.monotonic() < end:
             pods = [p for p in plane.store.list("Pod", namespace="default")
                     if p.active and p.status.phase == "Running"]
             if pods:
                 victim = rng.choice(pods)
-                plane.kubelet.fail_pod("default", victim.metadata.name)
+                if rng.random() < 0.4:
+                    plane.kubelet.evict_pod("default", victim.metadata.name)
+                    evictions += 1
+                else:
+                    plane.kubelet.fail_pod("default", victim.metadata.name)
                 kills += 1
             time.sleep(0.15)
-        assert kills >= 10
+        assert kills >= 10 and evictions >= 1
 
         # everything reconverges
         for i in range(3):
@@ -66,3 +71,7 @@ def test_random_pod_failures_reconverge():
         total_restarts = sum(i.status.restart_count
                              for i in plane.store.list("RoleInstance", namespace="default"))
         assert total_restarts >= 1
+        # no inactive (Failed) pod survived the storm un-replaced
+        assert not [p for p in plane.store.list("Pod", namespace="default")
+                    if p.status.phase == "Failed"
+                    and p.metadata.deletion_timestamp is None]

@@ -1,0 +1,67 @@
+"""Store-fault injection: a failed mutation must surface (error metric +
+retry), never vanish (VERDICT r1 item 7 — the scheduler used to wrap bind
+mutations in ``except Exception: pass``)."""
+
+import threading
+
+import pytest
+
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.runtime.store import NotFound
+from rbg_tpu.testutil import make_group, make_tpu_nodes, simple_role
+
+
+def _error_count():
+    total = 0.0
+    for line in REGISTRY.render().splitlines():
+        if line.startswith("rbg_reconcile_total") and 'result="error"' in line:
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def test_injected_bind_fault_retries_and_converges():
+    """One arbitrary store fault on a Pod mutation: the worker must count an
+    error and retry until the group converges — silence is the bug."""
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=1, hosts_per_slice=2)
+
+    real_mutate = plane.store.mutate
+    fired = threading.Event()
+
+    def flaky_mutate(kind, ns, name, fn, status=False, retries=8):
+        if kind == "Pod" and not status and not fired.is_set():
+            fired.set()
+            raise RuntimeError("injected store fault")
+        return real_mutate(kind, ns, name, fn, status=status, retries=retries)
+
+    plane.store.mutate = flaky_mutate
+    errors_before = _error_count()
+    with plane:
+        plane.apply(make_group("flt", simple_role("srv", replicas=2)))
+        plane.wait_group_ready("flt")
+    assert fired.is_set()
+    # The fault was counted, not swallowed.
+    assert _error_count() > errors_before
+
+
+def test_pod_deleted_mid_plan_is_skipped():
+    """NotFound during binding is benign: the deleted pod is skipped and the
+    rest of the system converges (replacement pods re-schedule)."""
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=1, hosts_per_slice=2)
+
+    real_mutate = plane.store.mutate
+    fired = threading.Event()
+
+    def vanish_once(kind, ns, name, fn, status=False, retries=8):
+        if kind == "Pod" and not status and not fired.is_set():
+            fired.set()
+            raise NotFound(f"Pod/{ns}/{name}")
+        return real_mutate(kind, ns, name, fn, status=status, retries=retries)
+
+    plane.store.mutate = vanish_once
+    with plane:
+        plane.apply(make_group("gone", simple_role("srv", replicas=2)))
+        plane.wait_group_ready("gone")
+    assert fired.is_set()

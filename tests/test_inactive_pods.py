@@ -1,0 +1,114 @@
+"""Inactive-pod handling (reference: keps/inactive-pod-handling; VERDICT r1
+item 8): Failed/Evicted pods must be deleted so their fixed-name replacement
+can be created — under every restart policy — and the reason must surface
+as an event."""
+
+import pytest
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.group import RestartPolicy
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import make_group, make_tpu_nodes, simple_role
+
+
+@pytest.fixture()
+def plane():
+    p = ControlPlane(backend="fake")
+    make_tpu_nodes(p.store, slices=1, hosts_per_slice=2)
+    with p:
+        yield p
+
+
+def _events(plane, reason):
+    # store events are (ts, ref, reason, message) tuples
+    return [e for e in plane.store.events_for() if e[2] == reason]
+
+
+def test_evicted_pod_replaced_policy_none(plane):
+    """Story 1/3: eviction under policy None → pod-level replacement with
+    the same fixed name, active replica count restored."""
+    role = simple_role("srv", replicas=2)
+    role.restart_policy.policy = RestartPolicy.NONE
+    plane.apply(make_group("ev", role))
+    plane.wait_group_ready("ev")
+    pods = plane.store.list("Pod", namespace="default")
+    victim = pods[0]
+
+    plane.kubelet.evict_pod("default", victim.metadata.name)
+
+    def replaced():
+        p = plane.store.get("Pod", "default", victim.metadata.name)
+        return (p is not None and p.metadata.uid != victim.metadata.uid
+                and p.running_ready) or None
+
+    plane.wait_for(replaced, timeout=15, desc="same-name replacement")
+    plane.wait_group_ready("ev")
+    evs = _events(plane, "ReplacingFailedPod")
+    assert evs and "Evicted" in evs[0][3]
+
+
+def test_failed_ignored_component_replaced_pod_level(plane):
+    """A component excluded from the gang-restart trigger (Ignore) still
+    gets pod-level replacement when it fails — previously it squatted its
+    name forever (KEP root cause)."""
+    from rbg_tpu.api.group import ComponentSpec, PatternType
+    from rbg_tpu.api.pod import PodTemplate
+    from rbg_tpu.testutil import simple_container
+
+    role = simple_role("mix", replicas=1)
+    role.pattern = PatternType.CUSTOM_COMPONENTS
+    role.components = [
+        ComponentSpec(name="engine", size=1,
+                      template=PodTemplate(containers=[simple_container()])),
+        ComponentSpec(name="cache", size=1,
+                      template=PodTemplate(
+                          containers=[simple_container(name="cache")],
+                          annotations={C.ANN_RESTART_TRIGGER_POLICY: "Ignore"})),
+    ]
+    role.template = PodTemplate(containers=[simple_container()])
+    plane.apply(make_group("ig", role))
+    plane.wait_group_ready("ig")
+
+    pods = plane.store.list("Pod", namespace="default")
+    cache_pod = next(p for p in pods
+                     if p.metadata.labels.get(C.LABEL_COMPONENT_NAME) == "cache")
+    engine_pod = next(p for p in pods
+                      if p.metadata.labels.get(C.LABEL_COMPONENT_NAME) == "engine")
+
+    plane.kubelet.fail_pod("default", cache_pod.metadata.name,
+                           reason="UnexpectedAdmissionError")
+
+    def replaced():
+        p = plane.store.get("Pod", "default", cache_pod.metadata.name)
+        return (p is not None and p.metadata.uid != cache_pod.metadata.uid
+                and p.running_ready) or None
+
+    plane.wait_for(replaced, timeout=15, desc="ignored component replaced")
+    # The engine pod was NOT gang-restarted (Ignore confined the blast).
+    e = plane.store.get("Pod", "default", engine_pod.metadata.name)
+    assert e is not None and e.metadata.uid == engine_pod.metadata.uid
+    insts = plane.store.list("RoleInstance", namespace="default")
+    assert all(i.status.restart_count == 0 for i in insts)
+    plane.wait_group_ready("ig")
+
+
+def test_evicted_pod_instance_recreate_policy(plane):
+    """Story 2: under RecreateInstance policy an eviction recreates the
+    whole gang (level 2), exactly once."""
+    role = simple_role("srv", replicas=1)
+    plane.apply(make_group("l2", role))
+    plane.wait_group_ready("l2")
+    (pod,) = plane.store.list("Pod", namespace="default")
+
+    plane.kubelet.evict_pod("default", pod.metadata.name)
+
+    def recreated():
+        pods = plane.store.list("Pod", namespace="default")
+        if len(pods) != 1 or pods[0].metadata.uid == pod.metadata.uid:
+            return None
+        return pods[0] if pods[0].running_ready else None
+
+    plane.wait_for(recreated, timeout=15, desc="gang recreate")
+    insts = plane.store.list("RoleInstance", namespace="default")
+    assert [i.status.restart_count for i in insts] == [1]
+    plane.wait_group_ready("l2")

@@ -25,11 +25,29 @@ from rbg_tpu.runtime.store import AlreadyExists
 from rbg_tpu.discovery.env_builder import JAX_COORDINATOR_PORT
 
 
+# Node-map cache keyed on the store's Node write counter: nodes are read on
+# EVERY group reconcile but change rarely; rebuilding an O(fleet) dict per
+# reconcile dominated create-burst profiles. WeakKey so test stores die.
+import weakref
+
+_node_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _node_map(store) -> dict:
+    ver = store.kind_version("Node")
+    cached = _node_cache.get(store)
+    if cached is not None and cached[0] == ver:
+        return cached[1]
+    nodes = {n.metadata.name: n for n in store.list("Node", copy_=False)}
+    _node_cache[store] = (ver, nodes)
+    return nodes
+
+
 def build_cluster_config(store, rbg) -> dict:
     """Build the ClusterConfig document (reference schema
     ``config_builder.go:54-75``, FQDNs ``:117-138``)."""
     ns = rbg.metadata.namespace
-    nodes = {n.metadata.name: n for n in store.list("Node", copy_=False)}
+    nodes = _node_map(store)
     roles_out = []
     for role in rbg.spec.roles:
         svc = C.service_name(rbg.metadata.name, role.name)

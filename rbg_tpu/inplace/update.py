@@ -239,6 +239,29 @@ def progress_inplace_updates(store, inst, pods, desired,
         # Pod lags the instance revision → in-place update in progress.
         images = _target_images(targets[pname])
         state = load_state(pod)
+        if not _changed_containers(pod, images):
+            # No container actually changes (restart-policy-only update, or
+            # a rollback to images the pod already runs): nothing to drain,
+            # nothing for the node backend to ack — stamp the label and
+            # release any held gate NOW. Waiting for an observed_revision
+            # ack would wedge forever on backends that only react to image
+            # changes (the process executor restarts on generation bumps,
+            # and a label-only patch doesn't bump the generation).
+            try:
+                store.mutate("Pod", ns, pname,
+                             lambda p: apply_images(p, images, revision))
+                if in_flight:
+                    def release(p):
+                        return set_condition(
+                            p.status.conditions,
+                            Condition(type=C.COND_INPLACE_UPDATE_READY,
+                                      status="True",
+                                      reason="NoContainerChange"),
+                            now)
+                    store.mutate("Pod", ns, pname, release, status=True)
+            except NotFound:
+                pass
+            continue
         if not in_flight or state is None or state.get("revision") != revision:
             # (Re)stage: not-ready gate FIRST (a watcher must never see new
             # images on a ready pod), then record state. Restaging after a

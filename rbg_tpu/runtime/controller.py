@@ -36,6 +36,11 @@ class Watch:
     mapper: Callable[[object], List[ReconcileKey]]
     # optional event filter (reference: predicates, rolebasedgroup_controller.go:1501-1596)
     predicate: Optional[Callable[[Event], bool]] = None
+    # Coalescing window: enqueue this key ``delay`` seconds out instead of
+    # immediately, so an event storm (every pod of a group flipping ready
+    # within ms) collapses into ONE reconcile via workqueue dedup
+    # (reference analog: the rate-limited workqueue's per-item delay).
+    delay: float = 0.0
 
 
 def own_keys(obj) -> List[ReconcileKey]:
@@ -109,7 +114,10 @@ class Controller:
         if watch.predicate is not None and not watch.predicate(ev):
             return
         for key in watch.mapper(ev.object):
-            self.queue.add(key)
+            if watch.delay > 0:
+                self.queue.add_after(key, watch.delay)
+            else:
+                self.queue.add(key)
 
     def start(self):
         if self._started:

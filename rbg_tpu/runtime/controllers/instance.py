@@ -74,7 +74,9 @@ class RoleInstanceController(Controller):
         from rbg_tpu.runtime.controller import spec_change
         return [
             Watch("RoleInstance", own_keys, predicate=spec_change),
-            Watch("Pod", owner_keys("RoleInstance")),
+            # 10ms coalescing window: a multi-host gang's pods flip ready
+            # within ms of each other — fold them into one reconcile.
+            Watch("Pod", owner_keys("RoleInstance"), delay=0.01),
         ]
 
     def reconcile(self, store: Store, key) -> Optional[Result]:
@@ -403,7 +405,11 @@ class RoleInstanceController(Controller):
         if pod.template is None:
             from rbg_tpu.api.pod import PodTemplate
             pod.template = PodTemplate()
-        pod.template.labels = labels
+        # COPY, not alias: deepcopy preserves intra-object aliasing, so a
+        # shared dict would make every metadata-label stamp (e.g. the
+        # in-place revision label) also a template change — a spurious
+        # generation bump that relaunches the process for a label edit.
+        pod.template.labels = dict(labels)
 
         it = inst.spec.instance
         if it.pattern == PatternType.LEADER_WORKER and (it.tpu is not None):

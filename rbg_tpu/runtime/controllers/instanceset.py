@@ -67,7 +67,9 @@ class RoleInstanceSetController(Controller):
         from rbg_tpu.runtime.controller import spec_change
         return [
             Watch("RoleInstanceSet", own_keys, predicate=spec_change),
-            Watch("RoleInstance", owner_keys("RoleInstanceSet")),
+            # 20ms coalescing window: N instances' status flips → one set
+            # reconcile (see group.py watches).
+            Watch("RoleInstance", owner_keys("RoleInstanceSet"), delay=0.02),
         ]
 
     def reconcile(self, store: Store, key) -> Optional[Result]:
@@ -186,7 +188,6 @@ class RoleInstanceSetController(Controller):
                     iname = f"{name}-{_rand_id()}"
                 existing.add(iname)
                 self._create_instance(store, ris, iname, -1, revision)
-            diff = 0
         elif diff < 0:
             # delete preference: not-ready first, then outdated, then newest
             def key(i):
@@ -208,7 +209,10 @@ class RoleInstanceSetController(Controller):
         # its maturation window expires — no store event marks that instant.
         ru = ris.spec.rolling_update
         if ru.paused:
-            return None
+            # paused freezes the UPDATE only — drain deadlines still fire
+            # (dropping the requeue left drained instances holding slice
+            # capacity until the resync backstop).
+            return drain_requeue
         now = time.time()
         unavailable = 0
         soonest: Optional[float] = None

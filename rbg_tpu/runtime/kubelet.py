@@ -43,6 +43,10 @@ class FakeKubelet:
         self._timers: list = []
         self._lock = threading.Lock()
         self._stopped = False
+        # Shared pool: a thread PER pod event melted create bursts.
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(max_workers=4,
+                                        thread_name_prefix="fakekubelet")
 
     def start(self):
         self.store.watch("Pod", self._on_event)
@@ -56,13 +60,14 @@ class FakeKubelet:
             for t in self._timers:
                 t.cancel()
             self._timers.clear()
+        self._pool.shutdown(wait=False, cancel_futures=True)
 
     def _later(self, delay: float, fn, *args):
         with self._lock:
             if self._stopped:
                 return
             if delay <= 0:
-                threading.Thread(target=fn, args=args, daemon=True).start()
+                self._pool.submit(fn, *args)
                 return
             t = threading.Timer(delay, fn, args)
             t.daemon = True

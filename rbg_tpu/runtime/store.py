@@ -24,6 +24,9 @@ from collections import defaultdict
 from typing import Callable, Dict, List, Optional, Tuple
 
 from rbg_tpu.api import serde
+from rbg_tpu.api.constants import (
+    LABEL_GROUP_NAME, LABEL_INSTANCE_NAME, LABEL_POD_GROUP,
+)
 
 Key = Tuple[str, str, str]  # (kind, namespace, name)
 
@@ -56,13 +59,23 @@ class Event:
 
 
 class Store:
+    # Label keys served from an index by ``list(selector=...)`` (reference:
+    # registered field indexes, ``pkg/utils/fieldindex/register.go``). A
+    # selector containing one of these keys narrows candidates to the index
+    # bucket instead of scanning every object of the kind.
+    INDEXED_LABELS = (LABEL_GROUP_NAME, LABEL_INSTANCE_NAME, LABEL_POD_GROUP)
+
     def __init__(self):
         self._lock = threading.RLock()
         self._objects: Dict[Key, object] = {}
+        self._kind_keys: Dict[str, set] = defaultdict(set)  # kind -> keys
+        # (kind, label key, label value) -> keys
+        self._label_index: Dict[Tuple[str, str, str], set] = defaultdict(set)
         self._rv = 0
         self._watchers: Dict[str, List[Callable[[Event], None]]] = defaultdict(list)
         self._owner_index: Dict[str, set] = defaultdict(set)  # owner uid -> keys
         self._uids: set = set()  # live object uids (O(1) owner-exists checks)
+        self._kind_version: Dict[str, int] = {}  # kind -> write counter
         self._events_log: List[tuple] = []  # (ts, kind/ns/name, reason, msg)
 
     # ---- helpers ----
@@ -75,13 +88,71 @@ class Store:
         self._rv += 1
         return self._rv
 
+    def kind_version(self, kind: str) -> int:
+        """Monotone counter bumped on every write to ``kind`` — an O(1)
+        cache-invalidation fingerprint (e.g. the discovery plane's node-map
+        cache; reference analog: informer resourceVersion watermarks)."""
+        with self._lock:
+            return self._kind_version.get(kind, 0)
+
+    def _bump_kind(self, kind: str) -> None:
+        self._kind_version[kind] = self._kind_version.get(kind, 0) + 1
+
+    def _index_add(self, k: Key, obj) -> None:
+        """Register a NEW key in all secondary indexes (lock held)."""
+        self._kind_keys[k[0]].add(k)
+        self._uids.add(obj.metadata.uid)
+        for ref in obj.metadata.owner_references:
+            self._owner_index[ref.uid].add(k)
+        labels = obj.metadata.labels
+        for lk in self.INDEXED_LABELS:
+            lv = labels.get(lk)
+            if lv is not None:
+                self._label_index[(k[0], lk, lv)].add(k)
+
+    def _index_remove(self, k: Key, obj) -> None:
+        """Drop a key from all secondary indexes, pruning empty buckets —
+        per-instance label values are unique, so leaked empty sets would
+        grow without bound under steady churn (lock held)."""
+        self._kind_keys[k[0]].discard(k)
+        self._uids.discard(obj.metadata.uid)
+        for ref in obj.metadata.owner_references:
+            bucket = self._owner_index.get(ref.uid)
+            if bucket is not None:
+                bucket.discard(k)
+                if not bucket:
+                    del self._owner_index[ref.uid]
+        labels = obj.metadata.labels
+        for lk in self.INDEXED_LABELS:
+            lv = labels.get(lk)
+            if lv is not None:
+                bucket = self._label_index.get((k[0], lk, lv))
+                if bucket is not None:
+                    bucket.discard(k)
+                    if not bucket:
+                        del self._label_index[(k[0], lk, lv)]
+
+    def _reindex(self, k: Key, old, new) -> None:
+        """Refresh indexes after a replace (labels/owners may differ)."""
+        if (old.metadata.labels != new.metadata.labels
+                or old.metadata.owner_references != new.metadata.owner_references
+                or old.metadata.uid != new.metadata.uid):
+            self._index_remove(k, old)
+            self._index_add(k, new)
+
     def _notify(self, ev: Event):
         # Snapshot subscribers under lock; dispatch outside to avoid deadlocks.
         with self._lock:
             subs = list(self._watchers.get(ev.object.kind, ())) + list(self._watchers.get("*", ()))
+        # The event carries the stored object WITHOUT copying (the
+        # no-deepcopy informer, ``pkg/utils/client/no_deepcopy_lister.go``):
+        # update/mutate always insert fresh objects, never mutate in place,
+        # so a handler holding this reference observes a frozen snapshot.
+        # Handlers MUST treat event objects as read-only; per-watcher
+        # deepcopies of every pod event dominated burst throughput.
         for fn in subs:
             try:
-                fn(Event(ev.type, copy.deepcopy(ev.object), ev.old))
+                fn(ev)
             except Exception:  # watcher bugs must not poison the store
                 import traceback
                 traceback.print_exc()
@@ -118,9 +189,8 @@ class Store:
             m.generation = 1
             m.creation_timestamp = m.creation_timestamp or time.time()
             self._objects[k] = obj
-            self._uids.add(m.uid)
-            for ref in m.owner_references:
-                self._owner_index[ref.uid].add(k)
+            self._index_add(k, obj)
+            self._bump_kind(k[0])
         self._notify(Event(Event.ADDED, obj))
         return copy.deepcopy(obj)
 
@@ -154,16 +224,29 @@ class Store:
         with self._lock:
             if owner_uid is not None:
                 keys = [k for k in self._owner_index.get(owner_uid, ()) if k[0] == kind]
-                items = [self._objects[k] for k in keys if k in self._objects]
+            elif selector:
+                # Serve from the narrowest label-index bucket available.
+                keys = None
+                for lk, lv in selector.items():
+                    if lk in self.INDEXED_LABELS:
+                        bucket = self._label_index.get((kind, lk, lv), ())
+                        if keys is None or len(bucket) < len(keys):
+                            keys = bucket
+                if keys is None:
+                    keys = self._kind_keys.get(kind, ())
+                keys = list(keys)
             else:
-                items = [o for (k, ns, n), o in self._objects.items() if k == kind]
+                keys = list(self._kind_keys.get(kind, ()))
             out = []
-            for o in items:
+            for k in keys:
+                o = self._objects.get(k)
+                if o is None:
+                    continue
                 if namespace is not None and o.metadata.namespace != namespace:
                     continue
                 if selector:
                     labels = o.metadata.labels
-                    if any(labels.get(k) != v for k, v in selector.items()):
+                    if any(labels.get(lk) != lv for lk, lv in selector.items()):
                         continue
                 out.append(copy.deepcopy(o) if copy_ else o)
             out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
@@ -202,8 +285,8 @@ class Store:
             obj.metadata.creation_timestamp = cur.metadata.creation_timestamp
             obj.metadata.deletion_timestamp = cur.metadata.deletion_timestamp
             self._objects[k] = obj
-            for ref in obj.metadata.owner_references:
-                self._owner_index[ref.uid].add(k)
+            self._reindex(k, cur, obj)
+            self._bump_kind(k[0])
         self._notify(Event(Event.MODIFIED, obj, old=cur))
         return copy.deepcopy(obj)
 
@@ -220,6 +303,7 @@ class Store:
             new.status = copy.deepcopy(obj.status)
             new.metadata.resource_version = self._next_rv()
             self._objects[k] = new
+            self._bump_kind(k[0])
         self._notify(Event(Event.MODIFIED, new, old=cur))
         return copy.deepcopy(new)
 
@@ -259,10 +343,9 @@ class Store:
                 ev = Event(Event.MODIFIED, cur, old=orig)
             else:
                 del self._objects[k]
-                self._uids.discard(cur.metadata.uid)
-                for keys in self._owner_index.values():
-                    keys.discard(k)
+                self._index_remove(k, cur)
                 ev = Event(Event.DELETED, cur)
+            self._bump_kind(kind)
         self._notify(ev)
         if ev.type == Event.DELETED:
             self._gc_owned(cur.metadata.uid)
@@ -330,9 +413,7 @@ class Store:
                 if k in self._objects:
                     continue
                 self._objects[k] = obj
-                self._uids.add(obj.metadata.uid)
-                for ref in obj.metadata.owner_references:
-                    self._owner_index[ref.uid].add(k)
+                self._index_add(k, obj)
                 count += 1
         return count
 

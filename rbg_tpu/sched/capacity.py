@@ -1,0 +1,187 @@
+"""Incremental scheduler state: free capacity, slice occupancy, exclusive
+topology — maintained from store watch events instead of rescanned per
+placement decision.
+
+Reference analog: the informer-cache + no-deepcopy-lister hot path the Go
+controllers schedule against (``pkg/utils/client/no_deepcopy_lister.go``) —
+kube-scheduler itself keeps exactly this kind of incremental NodeInfo cache.
+Our ``_place`` used to list every pod and node per decision (O(pods) per pod
+placed), which made a 30-group create burst scheduler-backlog-bound
+(docs/benchmarks.md; VERDICT r1 item 6).
+
+Consistency model: contributions are keyed by pod UID and *replaced* (never
+incremented), and each carries the pod's resourceVersion — a replace only
+applies when it is not older than what the cache holds, so both duplicate
+AND reordered deliveries (``_notify`` dispatches outside the store lock)
+converge on the newest state; DELETED is terminal and always applies. The
+scheduler is the single binder (workers=1) and applies its own binds to the
+cache synchronously via the same path, so a plan never double-books ahead
+of the watch event. A periodic ``rebuild`` (wired to the controller resync)
+backstops any residual drift.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from rbg_tpu.api import constants as C
+
+# A pod's footprint in the cache: (node, is_tpu_slice_pod, excl) where
+# excl = (topology_key, domain, group) or None.
+_Contrib = Tuple[str, bool, Optional[Tuple[str, str, str]]]
+
+
+def _pod_contrib(pod, nodes) -> Optional[_Contrib]:
+    """The cache footprint of one pod; None when it holds no capacity."""
+    if not pod.node_name or not pod.active:
+        return None
+    tpu = pod.template.scheduler_hints.get("tpu-slice") == "true"
+    excl = None
+    key = pod.metadata.annotations.get(C.ANN_EXCLUSIVE_TOPOLOGY)
+    grp = pod.metadata.labels.get(C.LABEL_GROUP_NAME)
+    if key and grp:
+        node = nodes.get(pod.node_name)
+        if node is not None:
+            excl = (key, node.labels.get(key, ""), grp)
+    return (pod.node_name, tpu, excl)
+
+
+class CapacityCache:
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, object] = {}
+        self._bound: Dict[str, int] = {}        # node -> bound active pods
+        self._tpu_bound: Dict[str, int] = {}    # node -> bound slice pods
+        # (topo key, domain) -> {group: pod count}
+        self._excl: Dict[Tuple[str, str], Dict[str, int]] = {}
+        # pod uid -> (resource_version, footprint); rv -1 = tombstone
+        self._contrib: Dict[str, Tuple[int, Optional[_Contrib]]] = {}
+        self._started = False
+
+    # ---- lifecycle ----
+
+    def start(self):
+        if self._started:
+            return
+        self._started = True
+        self.store.watch("Pod", self._on_pod)
+        self.store.watch("Node", self._on_node)
+        self.rebuild()
+
+    def rebuild(self):
+        """Full resync from the store (drift backstop; also initial build)."""
+        with self._lock:
+            self._nodes = {n.metadata.name: n
+                           for n in self.store.list("Node", copy_=False)}
+            self._bound.clear()
+            self._tpu_bound.clear()
+            self._excl.clear()
+            self._contrib.clear()  # also prunes delete tombstones
+            for pod in self.store.list("Pod", copy_=False):
+                self._apply(pod.metadata.uid, pod.metadata.resource_version,
+                            _pod_contrib(pod, self._nodes))
+
+    # ---- event maintenance ----
+
+    def _on_pod(self, ev):
+        from rbg_tpu.runtime.store import Event
+        pod = ev.object
+        with self._lock:
+            if ev.type == Event.DELETED:
+                self._apply(pod.metadata.uid, None, None)  # terminal
+            else:
+                self._apply(pod.metadata.uid, pod.metadata.resource_version,
+                            _pod_contrib(pod, self._nodes))
+
+    def _on_node(self, ev):
+        from rbg_tpu.runtime.store import Event
+        node = ev.object
+        with self._lock:
+            if ev.type == Event.DELETED:
+                self._nodes.pop(node.metadata.name, None)
+            else:
+                self._nodes[node.metadata.name] = node
+
+    def _apply(self, uid: str, rv: Optional[int], contrib: Optional[_Contrib]):
+        """Replace a pod's footprint iff ``rv`` is not older than what we
+        hold (rv None = terminal delete, always wins; a later stale event
+        for a deleted uid hits the tombstone and is dropped)."""
+        cur = self._contrib.get(uid)
+        if cur is not None:
+            cur_rv, cur_contrib = cur
+            if rv is not None:
+                if cur_rv is None:
+                    return  # deleted — ignore late pre-delete events
+                if rv < cur_rv:
+                    return  # older than current state
+            self._remove_footprint(cur_contrib)
+        elif rv is None:
+            return  # delete of a pod we never accounted
+        self._contrib[uid] = (rv, contrib if rv is not None else None)
+        if rv is not None:
+            self._add_footprint(contrib)
+
+    def _remove_footprint(self, contrib: Optional[_Contrib]):
+        if contrib is None:
+            return
+        node, tpu, excl = contrib
+        self._bound[node] = self._bound.get(node, 1) - 1
+        if self._bound[node] <= 0:
+            del self._bound[node]
+        if tpu:
+            self._tpu_bound[node] = self._tpu_bound.get(node, 1) - 1
+            if self._tpu_bound[node] <= 0:
+                del self._tpu_bound[node]
+        if excl is not None:
+            key, domain, grp = excl
+            owners = self._excl.get((key, domain))
+            if owners is not None:
+                owners[grp] = owners.get(grp, 1) - 1
+                if owners[grp] <= 0:
+                    owners.pop(grp, None)
+                if not owners:
+                    self._excl.pop((key, domain), None)
+
+    def _add_footprint(self, contrib: Optional[_Contrib]):
+        if contrib is None:
+            return
+        node, tpu, excl = contrib
+        self._bound[node] = self._bound.get(node, 0) + 1
+        if tpu:
+            self._tpu_bound[node] = self._tpu_bound.get(node, 0) + 1
+        if excl is not None:
+            key, domain, grp = excl
+            owners = self._excl.setdefault((key, domain), {})
+            owners[grp] = owners.get(grp, 0) + 1
+
+    def apply_bind(self, pod):
+        """Synchronously account a bind this scheduler just committed (pod
+        already carries node_name), so the next plan in the same burst sees
+        it before the watch event lands."""
+        with self._lock:
+            self._apply(pod.metadata.uid, pod.metadata.resource_version,
+                        _pod_contrib(pod, self._nodes))
+
+    # ---- plan-time views (plan-local scratch copies, O(nodes)) ----
+
+    def ready_nodes(self) -> List[object]:
+        with self._lock:
+            return [n for n in self._nodes.values() if n.ready]
+
+    def free_view(self) -> Dict[str, int]:
+        with self._lock:
+            return {name: n.capacity_pods - self._bound.get(name, 0)
+                    for name, n in self._nodes.items()}
+
+    def tpu_used_view(self) -> Set[str]:
+        with self._lock:
+            return set(self._tpu_bound)
+
+    def excl_view(self) -> Dict[Tuple[str, str], str]:
+        """(key, domain) -> owning group. At most one owner by scheduler
+        invariant; if a transient overlap exists, any owner blocks others."""
+        with self._lock:
+            return {kd: next(iter(owners))
+                    for kd, owners in self._excl.items() if owners}

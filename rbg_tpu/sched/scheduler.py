@@ -41,6 +41,27 @@ class SchedulerController(Controller):
     def __init__(self, store: Store, node_binding=None):
         super().__init__(store)
         self.node_binding = node_binding  # rbg_tpu.sched.binding.NodeBindingStore
+        from rbg_tpu.sched.capacity import CapacityCache
+        self.cap = CapacityCache(store)
+
+    def start(self):
+        # Build the capacity cache BEFORE watches/workers start so the first
+        # reconcile never sees an empty view.
+        self.cap.start()
+        super().start()
+
+    def _resync_loop(self):
+        # Piggyback the drift-backstop rebuild on the controller resync.
+        import time as _time
+        while not self._stopping:
+            _time.sleep(self.resync_period)
+            if self._stopping:
+                return
+            try:
+                self.cap.rebuild()
+                self._enqueue_all()
+            except Exception:
+                pass
 
     def watches(self) -> List[Watch]:
         from rbg_tpu.runtime.controller import own_keys
@@ -121,19 +142,17 @@ class SchedulerController(Controller):
     # ---- placement core ----
 
     def _place(self, store: Store, pods: List) -> Optional[Dict[Tuple[str, str], str]]:
-        """Compute {(ns, pod): node} for all pods or None (all-or-nothing)."""
-        nodes = [n for n in store.list("Node", copy_=False) if n.ready]
+        """Compute {(ns, pod): node} for all pods or None (all-or-nothing).
+        All aggregates come from the incremental CapacityCache (O(nodes)
+        per plan) — the old per-decision full pod rescan made create bursts
+        scheduler-backlog-bound (VERDICT r1 item 6)."""
+        nodes = self.cap.ready_nodes()
         if not nodes:
             return None
-        bound = [p for p in store.list("Pod", copy_=False) if p.node_name and p.active]
-        used = collections.Counter(p.node_name for p in bound)
-        free = {n.metadata.name: n.capacity_pods - used[n.metadata.name] for n in nodes}
+        free = self.cap.free_view()
         # TPU hosts are chip-exclusive: one slice pod per host.
-        tpu_used = {
-            p.node_name for p in bound
-            if p.template.scheduler_hints.get("tpu-slice") == "true"
-        }
-        excl = self._exclusive_domains(store, nodes)
+        tpu_used = self.cap.tpu_used_view()
+        excl = self.cap.excl_view()
 
         plan: Dict[Tuple[str, str], str] = {}
         # Slice-atomic groups first (hardest constraints), then singles.
@@ -173,10 +192,12 @@ class SchedulerController(Controller):
         inst = group[0].metadata.labels.get(C.LABEL_INSTANCE_NAME, "")
         ordinal = group[0].metadata.labels.get(C.LABEL_SLICE_ORDINAL, "0")
         node_by = {n.metadata.name: n for n in nodes}
+        # Siblings share the RoleInstance controller-owner — the owner-uid
+        # index makes this O(gang) instead of an O(namespace) label scan.
+        ref = group[0].metadata.controller_owner()
         all_siblings = [
-            p for p in store.list("Pod", namespace=ns,
-                                  selector={C.LABEL_INSTANCE_NAME: inst},
-                                  copy_=False)
+            p for p in (store.list("Pod", namespace=ns, owner_uid=ref.uid,
+                                   copy_=False) if ref is not None else [])
             if p.node_name and p.active
         ]
         siblings = [p for p in all_siblings
@@ -287,22 +308,6 @@ class SchedulerController(Controller):
                 return False
         return True
 
-    def _exclusive_domains(self, store, nodes) -> Dict[Tuple[str, str], str]:
-        """Map (topology key, domain) -> group owning it (from bound pods)."""
-        node_by_name = {n.metadata.name: n for n in nodes}
-        out: Dict[Tuple[str, str], str] = {}
-        for p in store.list("Pod", copy_=False):
-            if not p.node_name or not p.active:
-                continue
-            key = p.metadata.annotations.get(C.ANN_EXCLUSIVE_TOPOLOGY)
-            grp = p.metadata.labels.get(C.LABEL_GROUP_NAME)
-            if not key or not grp:
-                continue
-            n = node_by_name.get(p.node_name)
-            if n is not None:
-                out[(key, n.labels.get(key, ""))] = grp
-        return out
-
     def _bind(self, store: Store, plan: Dict[Tuple[str, str], str]):
         """Commit a placement plan. A pod deleted mid-plan is skipped (its
         replacement re-schedules); any OTHER failure propagates so the
@@ -318,6 +323,10 @@ class SchedulerController(Controller):
                 return True
 
             try:
-                store.mutate("Pod", ns, name, fn)
+                obj = store.mutate("Pod", ns, name, fn)
             except NotFound:
                 continue
+            # Account the bind immediately: the next plan in this burst
+            # must not see the capacity as still free.
+            if obj is not None and obj.node_name:
+                self.cap.apply_bind(obj)

@@ -65,3 +65,23 @@ def test_inplace_image_update_restarts_process(tmp_path):
         # In-place: same pod object — the slice/identity survived the rollout.
         assert pods[0].metadata.uid == uid0
         assert pods[0].template.containers[0].image == "v2"
+
+        # Restart-policy-ONLY change: no container differs, so there is
+        # nothing to drain and no backend ack to wait for — the group must
+        # return to Ready without a process restart (review finding: the
+        # gate used to wait forever for an observed_revision the executor
+        # never reports on label-only patches).
+        cur = plane.store.get("RoleBasedGroup", "default", "ip")
+        cur.spec.roles[0].restart_policy.base_delay_seconds = 9.0
+        plane.store.update(cur)
+
+        def policy_landed():
+            insts = plane.store.list("RoleInstance", namespace="default")
+            return (insts
+                    and insts[0].spec.restart_policy.base_delay_seconds == 9.0
+                    or None)
+
+        plane.wait_for(policy_landed, timeout=60, desc="policy landed")
+        plane.wait_group_ready("ip", timeout=60)
+        pods = [p for p in plane.store.list("Pod", namespace="default") if p.active]
+        assert pods[0].metadata.uid == uid0 and pods[0].running_ready

@@ -233,6 +233,29 @@ def test_specified_delete_is_never_resurrected():
         plane.wait_group_ready("nsd", timeout=20)
 
 
+def test_paused_rollout_still_fires_drain_deadlines():
+    """paused freezes updates, not drain deadlines: a condemned instance
+    must die at its deadline even while the rollout is paused (review
+    finding: the paused path dropped the drain requeue)."""
+    with _plane() as plane:
+        role = _drain_role(drain=1.0)
+        role.rolling_update.paused = True
+        plane.apply(make_group("pd", role))
+        plane.wait_group_ready("pd", timeout=20)
+
+        g = plane.store.get("RoleBasedGroup", "default", "pd")
+        g.spec.roles[0].replicas = 1
+        plane.store.update(g)
+        plane.wait_for(lambda: (_draining(plane) or [None])[0],
+                       timeout=10, desc="PreparingDelete while paused")
+        # Deadline (1s) must delete it well before the 10s resync backstop.
+        plane.wait_for(
+            lambda: len(plane.store.list("RoleInstance",
+                                         namespace="default")) == 1
+            and not _draining(plane),
+            timeout=5, desc="drain deadline fired under paused rollout")
+
+
 def test_delete_preference_not_ready_first():
     """Scale-down condemns the not-ready instance, not a serving one."""
     with _plane() as plane:

@@ -33,20 +33,31 @@ def register(sub) -> None:
     sp.add_argument("--slices", type=int, default=2)
     sp.add_argument("--hosts", type=int, default=2)
     sp.add_argument("--admin-port", type=int, default=7070)
+    sp.add_argument("--admin-host", default="127.0.0.1",
+                    help="admin bind address (0.0.0.0 for containerized "
+                         "deploys behind a Service; pair with a token)")
     sp.add_argument("--state-file", default="",
                     help="persist the object store here; a restarted serve "
                          "resumes from it (the etcd-snapshot analog)")
+    sp.add_argument("--admin-token", default=None,
+                    help="require this bearer token on every admin op "
+                         "(default: $RBG_ADMIN_TOKEN; empty = "
+                         "localhost-trust dev mode)")
     sp.set_defaults(func=cmd_serve)
 
     stp = sub.add_parser("status", help="group status (against a serve plane)")
     stp.add_argument("name")
     stp.add_argument("--admin", default="127.0.0.1:7070")
+    stp.add_argument("--token", default=None,
+                     help="admin bearer token (default: $RBG_ADMIN_TOKEN)")
     stp.add_argument("-n", "--namespace", default="default")
     stp.set_defaults(func=cmd_status)
 
     gp = sub.add_parser("get", help="list resources of a kind")
     gp.add_argument("kind")
     gp.add_argument("--admin", default="127.0.0.1:7070")
+    gp.add_argument("--token", default=None,
+                    help="admin bearer token (default: $RBG_ADMIN_TOKEN)")
     gp.add_argument("-n", "--namespace", default="default")
     gp.set_defaults(func=cmd_get)
 
@@ -54,6 +65,8 @@ def register(sub) -> None:
     dp_.add_argument("kind")
     dp_.add_argument("name")
     dp_.add_argument("--admin", default="127.0.0.1:7070")
+    dp_.add_argument("--token", default=None,
+                     help="admin bearer token (default: $RBG_ADMIN_TOKEN)")
     dp_.add_argument("-n", "--namespace", default="default")
     dp_.set_defaults(func=cmd_delete)
 
@@ -71,6 +84,8 @@ def register(sub) -> None:
     rp.add_argument("name")
     rp.add_argument("--revision", type=int)
     rp.add_argument("--admin", default="127.0.0.1:7070")
+    rp.add_argument("--token", default=None,
+                    help="admin bearer token (default: $RBG_ADMIN_TOKEN)")
     rp.add_argument("-n", "--namespace", default="default")
     rp.set_defaults(func=cmd_rollout)
 
@@ -164,8 +179,14 @@ def cmd_serve(args) -> int:
             node.metadata.name = "localhost"
             plane.store.create(node)
     plane.start()
-    admin = AdminServer(plane, args.admin_port).start()
-    print(f"plane serving; admin on 127.0.0.1:{admin.port}", flush=True)
+    token = args.admin_token
+    if token is None:
+        token = _os.environ.get("RBG_ADMIN_TOKEN", "")
+    admin = AdminServer(plane, args.admin_port, token=token,
+                        host=args.admin_host).start()
+    if token:
+        print("admin auth: token required", flush=True)
+    print(f"plane serving; admin on {args.admin_host}:{admin.port}", flush=True)
     if args.file:
         for o in _load(args.file):
             plane.apply(o)
@@ -194,9 +215,13 @@ def cmd_serve(args) -> int:
     return 0
 
 
-def _admin_call(addr: str, obj: dict) -> dict:
+def _admin_call(addr: str, obj: dict, token=None) -> dict:
     from rbg_tpu.engine.protocol import request_once
 
+    import os as _os
+    tok = token if token is not None else _os.environ.get("RBG_ADMIN_TOKEN", "")
+    if tok:
+        obj = dict(obj, token=tok)
     try:
         resp, _, _ = request_once(addr, obj, timeout=30.0)
     except OSError as e:
@@ -213,7 +238,8 @@ def _admin_call(addr: str, obj: dict) -> dict:
 
 def cmd_status(args) -> int:
     st = _admin_call(args.admin, {"op": "status", "name": args.name,
-                                  "namespace": args.namespace})
+                                  "namespace": args.namespace},
+                     token=getattr(args, 'token', None))
     print(f"group {st['name']}: {'Ready' if st['ready'] else 'NOT ready'} "
           f"({st['reason']}) revision={st['revision']}")
     print(f"  {'ROLE':<12} {'READY':<8} {'UPDATED':<8}")
@@ -229,7 +255,8 @@ def cmd_status(args) -> int:
 
 def cmd_get(args) -> int:
     resp = _admin_call(args.admin, {"op": "list", "kind": args.kind,
-                                    "namespace": args.namespace})
+                                    "namespace": args.namespace},
+                       token=getattr(args, 'token', None))
     for item in resp["items"]:
         meta = item.get("metadata", {})
         print(f"{args.kind}/{meta.get('name')}")
@@ -238,7 +265,8 @@ def cmd_get(args) -> int:
 
 def cmd_delete(args) -> int:
     _admin_call(args.admin, {"op": "delete", "kind": args.kind,
-                             "name": args.name, "namespace": args.namespace})
+                             "name": args.name, "namespace": args.namespace},
+                token=getattr(args, 'token', None))
     print(f"deleted {args.kind}/{args.name}")
     return 0
 
@@ -273,17 +301,17 @@ def cmd_schema(args) -> int:
 def cmd_rollout(args) -> int:
     base = {"name": args.name, "namespace": args.namespace}
     if args.action == "history":
-        resp = _admin_call(args.admin, {"op": "history", **base})
+        resp = _admin_call(args.admin, {"op": "history", **base}, token=getattr(args, 'token', None))
         print(f"{'REVISION':<10} NAME")
         for r in resp["revisions"]:
             print(f"{r['revision']:<10} {r['name']}")
         return 0
     if args.action == "diff":
-        resp = _admin_call(args.admin, {"op": "diff", "revision": args.revision, **base})
+        resp = _admin_call(args.admin, {"op": "diff", "revision": args.revision, **base}, token=getattr(args, 'token', None))
         for line in resp["diff"]:
             print(line)
         return 0
-    resp = _admin_call(args.admin, {"op": "undo", "revision": args.revision, **base})
+    resp = _admin_call(args.admin, {"op": "undo", "revision": args.revision, **base}, token=getattr(args, 'token', None))
     print(f"rolled back to revision {resp['restoredRevision']}")
     return 0
 

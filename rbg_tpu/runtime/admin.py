@@ -31,6 +31,19 @@ class _Handler(socketserver.BaseRequestHandler):
             if obj is None:
                 return
             try:
+                # Bearer-token auth (reference analog: the secured metrics/
+                # API endpoints, ``cmd/rbgs/main.go:270-314``). ``health``
+                # stays open for liveness probes; everything else needs the
+                # token when one is configured. Constant-time compare.
+                token = self.server.token
+                if token and obj.get("op") != "health":
+                    import hmac
+                    presented = str(obj.get("token", ""))
+                    # bytes compare: compare_digest raises on non-ASCII str
+                    if not hmac.compare_digest(presented.encode("utf-8"),
+                                               token.encode("utf-8")):
+                        send_msg(self.request, {"error": "unauthorized"})
+                        continue
                 send_msg(self.request, self._dispatch(store, obj))
             except Exception as e:
                 send_msg(self.request, {"error": f"{type(e).__name__}: {e}"})
@@ -187,12 +200,16 @@ class _Handler(socketserver.BaseRequestHandler):
 
 
 class AdminServer:
-    def __init__(self, plane, port: int = 0):
+    def __init__(self, plane, port: int = 0, token: Optional[str] = None,
+                 host: str = "127.0.0.1"):
         self._server = socketserver.ThreadingTCPServer(
-            ("127.0.0.1", port), _Handler)
+            (host, port), _Handler)
         self._server.allow_reuse_address = True
         self._server.daemon_threads = True
         self._server.plane = plane
+        # None/empty = localhost-trust (dev); any string = required on
+        # every op except health.
+        self._server.token = token or ""
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True, name="admin")

@@ -96,3 +96,31 @@ def test_metrics_and_profile_ops(served_plane):
     prof = call(addr, {"op": "profile", "seconds": 0.3})
     assert prof["samples"] > 0
     assert isinstance(prof["top"], list)
+
+
+def test_admin_token_auth():
+    """With a token configured, every op except health requires it
+    (constant-time compare; VERDICT r1 item 9 — the admin socket was
+    unauthenticated)."""
+    p = ControlPlane(backend="fake")
+    make_tpu_nodes(p.store, slices=1, hosts_per_slice=1)
+    p.start()
+    admin = AdminServer(p, port=0, token="s3cret").start()
+    addr = f"127.0.0.1:{admin.port}"
+    try:
+        # health stays open for probes
+        resp, _, _ = request_once(addr, {"op": "health"})
+        assert resp == {"ok": True}
+        # missing / wrong token rejected
+        resp, _, _ = request_once(addr, {"op": "list", "kind": "Pod"})
+        assert resp == {"error": "unauthorized"}
+        resp, _, _ = request_once(addr, {"op": "list", "kind": "Pod",
+                                         "token": "wrong"})
+        assert resp == {"error": "unauthorized"}
+        # correct token accepted
+        resp, _, _ = request_once(addr, {"op": "list", "kind": "Pod",
+                                         "token": "s3cret"})
+        assert "items" in resp
+    finally:
+        admin.stop()
+        p.stop()

@@ -64,6 +64,11 @@ class RoleBasedGroupController(Controller):
         ns, name = key
         rbg = store.get("RoleBasedGroup", ns, name)
         if rbg is None:
+            # Hard delete: the DELETED event lands here with the object gone
+            # — warm bindings must still be evicted (keyed by group name;
+            # a no-op for groups that never had any).
+            if self.node_binding is not None:
+                self.node_binding.evict_group(name)
             return None
         if rbg.metadata.deletion_timestamp is not None:
             if self.node_binding is not None:

@@ -79,6 +79,15 @@ def register(sub) -> None:
         "stress", help="control-plane scale harness (handled in main; see "
                        "python -m rbg_tpu.stress.harness --help)")
 
+    mp = sub.add_parser(
+        "migrate-state",
+        help="offline state-file upgrade (the CRD-upgrade-job analog): run "
+             "snapshot schema migrations + reserialize through this "
+             "release's parser")
+    mp.add_argument("--in", dest="infile", required=True)
+    mp.add_argument("--out", dest="outfile", required=True)
+    mp.set_defaults(func=cmd_migrate_state)
+
     rp = sub.add_parser("rollout", help="rollout history|diff|undo")
     rp.add_argument("action", choices=["history", "diff", "undo"])
     rp.add_argument("name")
@@ -234,6 +243,28 @@ def _admin_call(addr: str, obj: dict, token=None) -> dict:
         print(f"error: {resp['error']}", file=sys.stderr)
         raise SystemExit(1)
     return resp
+
+
+def cmd_migrate_state(args) -> int:
+    """Load a snapshot (running registered schema migrations + lenient
+    parse) and write it back at the current schema — so an operator can
+    upgrade durable state independently of the binary rollout (reference
+    analog: ``tools/crd-upgrade``)."""
+    import json as _json
+
+    from rbg_tpu.runtime.store import Store
+
+    with open(args.infile) as f:
+        data = _json.load(f)
+    old_schema = int(data.get("schema", 1))
+    store = Store()
+    n = store.load_snapshot(data)
+    out = store.snapshot()
+    with open(args.outfile, "w") as f:
+        _json.dump(out, f)
+    print(f"migrated {n} objects: schema {old_schema} -> "
+          f"{store.SNAPSHOT_SCHEMA} ({args.outfile})")
+    return 0
 
 
 def cmd_status(args) -> int:

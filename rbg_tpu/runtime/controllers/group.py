@@ -68,12 +68,11 @@ class RoleBasedGroupController(Controller):
             # — warm bindings must still be evicted (keyed by group name;
             # a no-op for groups that never had any).
             if self.node_binding is not None:
-                self.node_binding.evict_group(name)
+                self.node_binding.evict_group(name, namespace=ns)
             return None
         if rbg.metadata.deletion_timestamp is not None:
             if self.node_binding is not None:
-                self.node_binding.evict_group(rbg.metadata.name)
-                self.node_binding.evict_group(rbg.metadata.uid)
+                self.node_binding.evict_group(rbg.metadata.name, namespace=ns)
             return None
 
         # 1. precheck / admission

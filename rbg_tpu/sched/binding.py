@@ -31,11 +31,13 @@ class NodeBindingStore:
 
     @staticmethod
     def _key(pod) -> Optional[Tuple[str, str]]:
-        grp_uid = pod.metadata.labels.get(C.LABEL_GROUP_NAME, "")
+        # Namespace-qualified: a same-named group in another namespace must
+        # neither share nor lose these bindings (review finding).
+        grp = pod.metadata.labels.get(C.LABEL_GROUP_NAME, "")
         inst = pod.metadata.labels.get(C.LABEL_INSTANCE_NAME, "")
-        if not grp_uid or not inst:
+        if not grp or not inst:
             return None
-        return (grp_uid, inst)
+        return (f"{pod.metadata.namespace}/{grp}", inst)
 
     def record(self, pod, node) -> None:
         """Record a Running+Ready pod's placement."""
@@ -65,13 +67,14 @@ class NodeBindingStore:
             return []
         return [NodeAffinityTerm(key="name", operator="In", values=sorted(nodes), weight=10)]
 
-    def evict_group(self, group_uid_or_name: str) -> None:
+    def evict_group(self, group: str, namespace: str = "default") -> None:
         """Drop all bindings of a group (on group delete; reference:
-        ``rolebasedgroup_controller.go:1024-1040``)."""
+        ``rolebasedgroup_controller.go:1024-1040``). Namespace-scoped."""
+        key0 = f"{namespace}/{group}"
         with self._lock:
-            for k in [k for k in self._nodes if k[0] == group_uid_or_name]:
+            for k in [k for k in self._nodes if k[0] == key0]:
                 del self._nodes[k]
-            for k in [k for k in self._slices if k[0] == group_uid_or_name]:
+            for k in [k for k in self._slices if k[0] == key0]:
                 del self._slices[k]
 
     def reseed(self, store) -> None:

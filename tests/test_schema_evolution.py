@@ -1,0 +1,116 @@
+"""Schema-evolution mechanisms (docs/architecture.md §5; reference analogs:
+the v1alpha1 conversion webhook and the tools/crd-upgrade job).
+
+Covers: manifest apiVersion conversion chains at admission, snapshot schema
+migrations, and the offline migrate-state CLI.
+"""
+
+import json
+
+import pytest
+
+import rbg_tpu.api as api
+from rbg_tpu.runtime.store import Store
+from rbg_tpu.testutil import make_group, simple_role
+
+
+def test_current_version_and_absent_version_parse():
+    doc = {"kind": "RoleBasedGroup", "metadata": {"name": "g"}}
+    assert api.parse_manifest(doc).metadata.name == "g"
+    doc["apiVersion"] = api.API_VERSION
+    assert api.parse_manifest(doc).metadata.name == "g"
+
+
+def test_unknown_api_version_rejected():
+    with pytest.raises(KeyError, match="unsupported apiVersion"):
+        api.parse_manifest({"apiVersion": f"{api.API_GROUP}/v9",
+                            "kind": "RoleBasedGroup",
+                            "metadata": {"name": "g"}})
+
+
+def test_conversion_chain_runs_to_current(monkeypatch):
+    """A legacy manifest (renamed field, older apiVersion) converts forward
+    through the registered chain before strict parsing."""
+    v0 = f"{api.API_GROUP}/v0"
+
+    def convert_v0(doc):
+        doc = dict(doc)
+        spec = dict(doc.get("spec") or {})
+        if "groupRoles" in spec:           # v0 spelling of spec.roles
+            spec["roles"] = spec.pop("groupRoles")
+        doc["spec"] = spec
+        doc["apiVersion"] = api.API_VERSION
+        return doc
+
+    monkeypatch.setitem(api.MANIFEST_CONVERSIONS, v0, convert_v0)
+    obj = api.parse_manifest({
+        "apiVersion": v0,
+        "kind": "RoleBasedGroup",
+        "metadata": {"name": "legacy"},
+        "spec": {"groupRoles": [{"name": "srv", "replicas": 2}]},
+    })
+    assert obj.spec.roles[0].name == "srv"
+    assert obj.spec.roles[0].replicas == 2
+    # Without the conversion, the old spelling is a strict-parse error —
+    # the admission seam stays strict.
+    with pytest.raises(Exception):
+        api.parse_manifest({
+            "kind": "RoleBasedGroup", "metadata": {"name": "x"},
+            "spec": {"groupRoles": []},
+        })
+
+
+def test_conversion_cycle_detected(monkeypatch):
+    v0 = f"{api.API_GROUP}/v0"
+    monkeypatch.setitem(api.MANIFEST_CONVERSIONS, v0, lambda d: dict(d))
+    with pytest.raises(KeyError):
+        api.parse_manifest({"apiVersion": v0, "kind": "RoleBasedGroup",
+                            "metadata": {"name": "g"}})
+
+
+def test_snapshot_migration_chain(monkeypatch):
+    """A schema-0 snapshot migrates forward on load; a newer-schema file is
+    an explicit error (never a silent misparse)."""
+    src = Store()
+    src.create(make_group("mig", simple_role("srv")))
+    snap = src.snapshot()
+
+    old = dict(snap, schema=0)
+
+    def migrate_0_to_1(data):
+        data = dict(data, schema=1)
+        return data
+
+    monkeypatch.setitem(Store._SNAPSHOT_MIGRATIONS, 0, migrate_0_to_1)
+    dst = Store()
+    assert dst.load_snapshot(old) == 1
+    assert dst.get("RoleBasedGroup", "default", "mig") is not None
+
+    with pytest.raises(ValueError, match="newer"):
+        Store().load_snapshot(dict(snap, schema=Store.SNAPSHOT_SCHEMA + 1))
+    with pytest.raises(ValueError, match="no migration"):
+        Store().load_snapshot(dict(snap, schema=-1))
+
+
+def test_migrate_state_cli(tmp_path, monkeypatch):
+    from rbg_tpu.cli.controlplane import cmd_migrate_state
+
+    src = Store()
+    src.create(make_group("cli", simple_role("srv", replicas=3)))
+    old = dict(src.snapshot(), schema=0)
+    monkeypatch.setitem(Store._SNAPSHOT_MIGRATIONS, 0, lambda d: dict(d, schema=1))
+    infile = tmp_path / "old.json"
+    outfile = tmp_path / "new.json"
+    infile.write_text(json.dumps(old))
+
+    class Args:
+        pass
+    a = Args(); a.infile = str(infile); a.outfile = str(outfile)
+    assert cmd_migrate_state(a) == 0
+
+    migrated = json.loads(outfile.read_text())
+    assert migrated["schema"] == Store.SNAPSHOT_SCHEMA
+    dst = Store()
+    assert dst.load_snapshot(migrated) == 1
+    g = dst.get("RoleBasedGroup", "default", "cli")
+    assert g is not None and g.spec.roles[0].replicas == 3

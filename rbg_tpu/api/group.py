@@ -257,12 +257,22 @@ class GroupTemplate:
 class RoleBasedGroupSetSpec:
     replicas: int = 1
     template: GroupTemplate = dataclasses.field(default_factory=GroupTemplate)
+    # Fleet rollout staging: at most this many child groups may be
+    # unavailable (not Ready) at once while template changes propagate.
+    # <=0 = unbounded (update every drifted group simultaneously — the
+    # reference's behavior, ``rolebasedgroupset_controller.go:168-177``);
+    # the default of 1 rolls the fleet one cell at a time, each cell's own
+    # rolling-update machinery staging its pods in turn.
+    max_unavailable: int = 1
 
 
 @dataclasses.dataclass
 class RoleBasedGroupSetStatus:
     replicas: int = 0
     ready_replicas: int = 0
+    # In-range child groups whose spec/labels/annotations match the current
+    # template (fleet-rollout progress counter).
+    updated_replicas: int = 0
     observed_generation: int = 0
 
 

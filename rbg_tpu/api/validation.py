@@ -17,9 +17,11 @@ _DNS_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
 
 
 class ValidationError(Exception):
-    def __init__(self, errors: List[str]):
-        self.errors = errors
-        super().__init__("; ".join(errors))
+    def __init__(self, errors):
+        if isinstance(errors, str):
+            errors = [errors]
+        self.errors = list(errors)
+        super().__init__("; ".join(self.errors))
 
 
 def validate_group(rbg: RoleBasedGroup) -> None:

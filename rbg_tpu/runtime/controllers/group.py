@@ -14,10 +14,8 @@ from typing import List, Optional
 from rbg_tpu.api import constants as C
 from rbg_tpu.api import serde
 from rbg_tpu.api.group import RoleBasedGroup, RoleSpec, RoleStatus
-from rbg_tpu.api.instance import (
-    ControllerRevision, InstanceTemplate, RoleInstanceSet, RoleInstanceSetSpec,
-)
-from rbg_tpu.api.meta import Condition, get_condition, owner_ref, set_condition
+from rbg_tpu.api.instance import ControllerRevision
+from rbg_tpu.api.meta import Condition, owner_ref, set_condition
 from rbg_tpu.api.pod import Service
 from rbg_tpu.api.policy import PodGroup, PodGroupSpec
 from rbg_tpu.api.validation import ValidationError, validate_group
@@ -49,16 +47,22 @@ class RoleBasedGroupController(Controller):
                 return [(obj.metadata.namespace, obj.spec.group_name)]
             return []
 
+        from rbg_tpu.runtime import workload as workload_registry
         from rbg_tpu.runtime.controller import spec_change
-        return [
+        out = [
             Watch("RoleBasedGroup", own_keys, predicate=spec_change),
-            # Coalesced: every instance/pod status flip bubbles up as a RIS
-            # status write; a 20ms window folds a whole gang's flips into
-            # one group reconcile (the fan-out is the plane's hottest path).
-            Watch("RoleInstanceSet", owner_keys("RoleBasedGroup"), delay=0.02),
             Watch("ScalingAdapter", adapter_keys),
             Watch("CoordinatedPolicy", policy_keys),
         ]
+        # Child-workload watches come from the backend registry (reference:
+        # dynamic CRD watch :1598-1621) — the native RIS watch included.
+        seen = {w.kind for w in out}
+        for backend in workload_registry.backends():
+            for w in backend.watches():
+                if w.kind not in seen:
+                    seen.add(w.kind)
+                    out.append(w)
+        return out
 
     def reconcile(self, store: Store, key) -> Optional[Result]:
         ns, name = key
@@ -75,9 +79,17 @@ class RoleBasedGroupController(Controller):
                 self.node_binding.evict_group(rbg.metadata.name, namespace=ns)
             return None
 
-        # 1. precheck / admission
+        # 1. precheck / admission (incl. per-kind backend validation —
+        #    reference: per-workload Validate in preCheck :277)
+        from rbg_tpu.runtime import workload as workload_registry
         try:
             validate_group(rbg)
+            for role in rbg.spec.roles:
+                try:
+                    backend = workload_registry.resolve(role.workload)
+                except KeyError as e:
+                    raise ValidationError(e.args[0])
+                backend.validate(store, rbg, role)
         except ValidationError as e:
             store.record_event(rbg, "ValidationFailed", str(e))
             self._set_group_condition(store, rbg, False, "ValidationFailed", str(e))
@@ -228,7 +240,7 @@ class RoleBasedGroupController(Controller):
         if not ru_policies:
             return {}
         from rbg_tpu.coordination.rollout import rollout_partitions
-        ns = rbg.metadata.namespace
+        from rbg_tpu.runtime import workload as workload_registry
         policy_roles = set()
         for p in ru_policies:
             policy_roles.update(p.spec.rolling_update.roles)
@@ -236,21 +248,13 @@ class RoleBasedGroupController(Controller):
         for role in rbg.spec.roles:
             if role.name not in policy_roles:
                 continue
-            ris = store.get("RoleInstanceSet", ns,
-                            C.workload_name(rbg.metadata.name, role.name),
-                            copy_=False)
-            if ris is None:
-                # No workload yet: it will be created at the new revision —
-                # treat as fully updated so it doesn't hold others back.
-                updated[role.name] = role.replicas
-            elif (ris.metadata.labels.get(C.role_revision_label(role.name))
-                    != role_hashes.get(role.name)):
-                # RIS hasn't received the new template yet — its updated
-                # counters refer to the OLD revision and would read as 100%
-                # (letting the first reconcile open every partition).
+            try:
+                backend = workload_registry.resolve(role.workload)
+            except KeyError:
                 updated[role.name] = 0
-            else:
-                updated[role.name] = ris.status.updated_ready_replicas
+                continue
+            updated[role.name] = backend.rollout_progress(
+                store, rbg, role, role_hashes.get(role.name, ""))
         out = {}
         for p in ru_policies:
             out.update(rollout_partitions(rbg, p.spec.rolling_update, updated))
@@ -297,80 +301,15 @@ class RoleBasedGroupController(Controller):
                 return True
             store.mutate("PodGroup", ns, name, fn)
 
-    # ---- per-role workload reconcile (strategy: RoleInstanceSet) ----
+    # ---- per-role workload reconcile (strategy seam: inventory #23) ----
 
     def _reconcile_role(self, store, rbg, role: RoleSpec, role_hash: str,
                         replicas: int, gang: bool, partition=None):
-        ns = rbg.metadata.namespace
-        wname = C.workload_name(rbg.metadata.name, role.name)
+        from rbg_tpu.runtime import workload as workload_registry
         self._ensure_service(store, rbg, role)
-
         role = self._resolve_template(store, rbg, role)
-        labels = {
-            C.LABEL_GROUP_NAME: rbg.metadata.name,
-            C.LABEL_ROLE_NAME: role.name,
-            C.role_revision_label(role.name): role_hash,
-        }
-        annotations = {}
-        if gang:
-            annotations[C.ANN_GANG_SCHEDULING] = rbg.metadata.name
-        for k, v in rbg.metadata.annotations.items():
-            if k.startswith(C.DOMAIN) and k != C.ANN_GANG_SCHEDULING:
-                annotations.setdefault(k, v)
-
-        import copy as _copy
-        rolling = _copy.deepcopy(role.rolling_update)
-        if partition is not None:
-            # Coordinated rollout TIGHTENS the partition (reference:
-            # calculateNextRollingTarget :1374 → RIS partition); a user's
-            # explicit canary hold is never released by the skew math.
-            rolling.partition = max(partition, role.rolling_update.partition)
-        desired_spec = RoleInstanceSetSpec(
-            replicas=replicas,
-            stateful=role.stateful,
-            instance=InstanceTemplate(
-                pattern=role.pattern,
-                template=role.template,
-                leader_worker=role.leader_worker,
-                components=role.components,
-                tpu=role.tpu,
-                engine_runtime=role.engine_runtime,
-            ),
-            restart_policy=role.restart_policy,
-            rolling_update=rolling,
-            selector=dict(labels),
-            drain_seconds=role.drain_seconds,
-        )
-
-        cur = store.get("RoleInstanceSet", ns, wname, copy_=False)
-        if cur is None:
-            ris = RoleInstanceSet()
-            ris.metadata.name = wname
-            ris.metadata.namespace = ns
-            ris.metadata.labels = labels
-            ris.metadata.annotations = annotations
-            ris.metadata.owner_references = [owner_ref(rbg)]
-            ris.spec = desired_spec
-            try:
-                store.create(ris)
-            except AlreadyExists:
-                pass
-            return
-        # semantic-equality update (reference: comparators in each reconciler).
-        # Controller-managed annotations (port allocations, Appendix E) are
-        # copied forward, never wiped by a spec sync.
-        managed = {C.ANN_ALLOCATED_PORTS}
-        cur_ann = {k: v for k, v in cur.metadata.annotations.items() if k not in managed}
-        if (serde.to_dict(cur.spec) != serde.to_dict(desired_spec)
-                or cur.metadata.labels != labels
-                or cur_ann != annotations):
-            def fn(r):
-                r.spec = desired_spec
-                r.metadata.labels = labels
-                keep = {k: v for k, v in r.metadata.annotations.items() if k in managed}
-                r.metadata.annotations = {**annotations, **keep}
-                return True
-            store.mutate("RoleInstanceSet", ns, wname, fn)
+        workload_registry.resolve(role.workload).reconcile_role(
+            store, rbg, role, role_hash, replicas, gang, partition=partition)
 
     def _resolve_template(self, store, rbg, role: RoleSpec) -> RoleSpec:
         """KEP-8: roles may reference a shared RoleTemplate."""
@@ -420,38 +359,18 @@ class RoleBasedGroupController(Controller):
     # ---- status aggregation (Appendix C, anti-flicker :57-81) ----
 
     def _update_role_statuses(self, store, rbg, role_hashes):
+        from rbg_tpu.runtime import workload as workload_registry
         ns = rbg.metadata.namespace
         new_roles: List[RoleStatus] = []
         for role in rbg.spec.roles:
-            wname = C.workload_name(rbg.metadata.name, role.name)
-            ris = store.get("RoleInstanceSet", ns, wname, copy_=False)
             prev = rbg.status.role(role.name)
-            if ris is None:
+            try:
+                backend = workload_registry.resolve(role.workload)
+            except KeyError:
                 new_roles.append(prev or RoleStatus(name=role.name))
                 continue
-            if (ris.status.observed_generation < ris.metadata.generation and prev is not None):
-                # child controller hasn't observed the latest spec — keep
-                # last-known status (anti-flicker)
-                new_roles.append(prev)
-                continue
-            ris_ready = get_condition(ris.status.conditions, C.COND_READY)
-            new_roles.append(RoleStatus(
-                name=role.name,
-                replicas=ris.status.replicas,
-                ready_replicas=ris.status.ready_replicas,
-                updated_replicas=ris.status.updated_replicas,
-                updated_ready_replicas=ris.status.updated_ready_replicas,
-                observed_revision=role_hashes.get(role.name, ""),
-                # Role readiness = the child's Ready CONDITION (capacity-
-                # aware during surge rollouts, when counter equality
-                # `ready_replicas == replicas` briefly flips False even
-                # though serving capacity never dips) AND the child's spec
-                # having reached the role's desired replicas — a
-                # coordination-clamped RIS is Ready at its *interim* target
-                # and must not make the group Ready early.
-                ready=(ris_ready is not None and ris_ready.status == "True"
-                       and ris.spec.replicas == role.replicas),
-            ))
+            new_roles.append(backend.construct_role_status(
+                store, rbg, role, role_hashes.get(role.name, ""), prev))
 
         ready = all(st.ready for st in new_roles) \
             and len(new_roles) == len(rbg.spec.roles)
@@ -496,12 +415,19 @@ class RoleBasedGroupController(Controller):
     # ---- orphans ----
 
     def _cleanup_orphans(self, store, rbg):
+        from rbg_tpu.runtime import workload as workload_registry
         ns = rbg.metadata.namespace
-        valid_w = {C.workload_name(rbg.metadata.name, r.name) for r in rbg.spec.roles}
         valid_s = {C.service_name(rbg.metadata.name, r.name) for r in rbg.spec.roles}
-        for ris in store.list("RoleInstanceSet", namespace=ns, owner_uid=rbg.metadata.uid):
-            if ris.metadata.name not in valid_w:
-                store.delete("RoleInstanceSet", ns, ris.metadata.name)
+        # Fan the sweep across every registered backend, each keeping only
+        # the children of roles routed to IT: a role whose workload KIND
+        # changed leaves an orphan in the old backend's store.
+        for backend in workload_registry.backends():
+            valid_w = {
+                C.workload_name(rbg.metadata.name, r.name)
+                for r in rbg.spec.roles
+                if (r.workload or workload_registry.DEFAULT_KIND) == backend.kind
+            }
+            backend.cleanup_orphans(store, rbg, valid_w)
         for svc in store.list("Service", namespace=ns, owner_uid=rbg.metadata.uid):
             if svc.metadata.name not in valid_s:
                 store.delete("Service", ns, svc.metadata.name)

@@ -2,8 +2,15 @@
 
 Reference analog: inventory #7 (``rolebasedgroupset_controller.go``): N
 identical RoleBasedGroups (``{set}-{index}``) with the groupset index labels,
-scale up/down (highest index first), status rollup. Canonical TPU use: one
-RBG per availability cell / superpod, scaled horizontally.
+scale up/down (highest index first), template propagation to live groups
+(``needsUpdate``/``updateExistingRBGs`` :158-191, :374-430), status rollup.
+Canonical TPU use: one RBG per availability cell / superpod, scaled
+horizontally.
+
+Deviation from the reference: the reference pushes a changed template to
+every drifted child simultaneously; here a fleet rollout is staged by
+``spec.max_unavailable`` (default 1) so that at most that many cells are
+mid-update at once — each cell's own rolling machinery then stages its pods.
 """
 
 from __future__ import annotations
@@ -12,10 +19,38 @@ import copy
 from typing import List, Optional
 
 from rbg_tpu.api import constants as C
+from rbg_tpu.api import serde
 from rbg_tpu.api.group import RoleBasedGroup
 from rbg_tpu.api.meta import get_condition, owner_ref
 from rbg_tpu.runtime.controller import Controller, Result, Watch, own_keys, owner_keys
 from rbg_tpu.runtime.store import AlreadyExists, Store
+from rbg_tpu.utils import spec_hash
+
+
+def _is_ready(g) -> bool:
+    c = get_condition(g.status.conditions, C.COND_READY)
+    return c is not None and c.status == "True"
+
+
+def _is_stable(g) -> bool:
+    """Ready with FRESH status and its internal rollout complete (at its own
+    current spec). Freshness matters: right after this controller pushes a new
+    template, the child's Ready condition still reflects the old spec — the
+    generation bump makes it un-stable atomically, so a second drifted cell
+    cannot slip past the unavailability budget in the race window before the
+    child's status degrades."""
+    if not _is_ready(g):
+        return False
+    if g.status.observed_generation < g.metadata.generation:
+        return False
+    for role in g.spec.roles:
+        st = g.status.role(role.name)
+        if st is None or st.observed_revision != spec_hash(role):
+            return False
+        if (st.ready_replicas < role.replicas
+                or st.updated_ready_replicas < role.replicas):
+            return False
+    return True
 
 
 class RoleBasedGroupSetController(Controller):
@@ -41,33 +76,133 @@ class RoleBasedGroupSetController(Controller):
         }
         n = rbgs.spec.replicas
 
-        for i in range(n):
-            gname = f"{name}-{i}"
-            if gname not in owned:
-                self._create_group(store, rbgs, gname, i)
+        in_range = {}
         for gname, g in owned.items():
             idx = g.metadata.labels.get(C.LABEL_GROUP_SET_INDEX, "")
             if not idx.isdigit() or int(idx) >= n:
                 store.delete("RoleBasedGroup", ns, gname)
+            else:
+                in_range[gname] = g
 
-        ready = 0
-        for g in owned.values():
-            c = get_condition(g.status.conditions, C.COND_READY)
-            if c is not None and c.status == "True":
-                ready += 1
+        created = 0
+        for i in range(n):
+            gname = f"{name}-{i}"
+            if gname not in in_range:
+                self._create_group(store, rbgs, gname, i)
+                created += 1
+
+        updated, pending = self._propagate_template(store, rbgs, in_range,
+                                                    created=created)
+
+        ready = sum(1 for g in in_range.values() if _is_ready(g))
 
         def fn(s):
-            new = (len(owned), ready, s.metadata.generation)
+            new = (len(in_range), ready, updated, s.metadata.generation)
             cur = (s.status.replicas, s.status.ready_replicas,
-                   s.status.observed_generation)
+                   s.status.updated_replicas, s.status.observed_generation)
             if new == cur:
                 return False
             (s.status.replicas, s.status.ready_replicas,
-             s.status.observed_generation) = new
+             s.status.updated_replicas, s.status.observed_generation) = new
             return True
 
         store.mutate("RoleBasedGroupSet", ns, name, fn, status=True)
+        if pending:
+            # Drifted groups waiting on the unavailability budget: the
+            # child-group Ready flips drive progression via the watch; this
+            # requeue is a lost-event backstop only.
+            return Result(requeue_after=0.5)
         return None
+
+    # ---- template propagation (reference :158-191 needsUpdate path) ----
+
+    def _desired_meta(self, rbgs, g):
+        """Template labels/annotations + the set-managed identity labels."""
+        labels = dict(rbgs.spec.template.metadata.labels)
+        labels[C.LABEL_GROUP_SET_NAME] = rbgs.metadata.name
+        labels[C.LABEL_GROUP_SET_INDEX] = g.metadata.labels.get(
+            C.LABEL_GROUP_SET_INDEX, "")
+        return labels, dict(rbgs.spec.template.metadata.annotations)
+
+    def _desired_spec(self, store, rbgs, g):
+        """The template spec, with replicas of adapter-managed roles pinned
+        to the child's CURRENT value: a Bound ScalingAdapter owns that field
+        (the group controller persists its override into the child spec,
+        ``group.py::_apply_scaling_overrides``) — treating it as drift would
+        have this controller and the group controller stomping the spec back
+        and forth forever."""
+        spec = copy.deepcopy(rbgs.spec.template.spec)
+        adapter_roles = {
+            a.spec.role_name
+            for a in store.list("ScalingAdapter",
+                                namespace=g.metadata.namespace)
+            if a.spec.group_name == g.metadata.name
+            and a.status.phase == "Bound" and a.spec.replicas is not None
+        }
+        for role in spec.roles:
+            if role.name in adapter_roles:
+                cur = g.spec.role(role.name)
+                if cur is not None:
+                    role.replicas = cur.replicas
+        return spec
+
+    def _propagate_template(self, store, rbgs, in_range, created: int = 0):
+        """Update drifted children toward the template, at most
+        ``max_unavailable`` cells disrupted at a time (cells just created
+        this pass count as disrupted). Returns
+        (#children matching template, #drifted children still waiting)."""
+        drifted = []
+        matching = 0
+        desired_specs = {}
+        for g in in_range.values():
+            labels, annotations = self._desired_meta(rbgs, g)
+            desired = self._desired_spec(store, rbgs, g)
+            desired_specs[g.metadata.name] = desired
+            if (serde.to_dict(g.spec) != serde.to_dict(desired)
+                    or g.metadata.labels != labels
+                    or g.metadata.annotations != annotations):
+                drifted.append(g)
+            else:
+                matching += 1
+
+        if not drifted:
+            return matching, 0
+
+        budget = rbgs.spec.max_unavailable
+        if budget <= 0:
+            budget = (len(in_range) + created) or 1
+        unavailable = created + sum(
+            1 for g in in_range.values() if not _is_stable(g))
+
+        # Ascending index order: deterministic fleet walk, cell 0 first.
+        drifted.sort(key=lambda g: int(
+            g.metadata.labels.get(C.LABEL_GROUP_SET_INDEX, "0") or 0))
+        pending = 0
+        for g in drifted:
+            # An unstable child is already counted unavailable — updating it
+            # adds no disruption, so it never waits on the budget.
+            if _is_stable(g):
+                if unavailable >= budget:
+                    pending += 1
+                    continue
+                unavailable += 1
+            self._update_group(store, rbgs, g,
+                               desired_specs[g.metadata.name])
+        return matching, pending
+
+    def _update_group(self, store, rbgs, g, spec):
+        ns = g.metadata.namespace
+        labels, annotations = self._desired_meta(rbgs, g)
+
+        def fn(cur):
+            cur.spec = copy.deepcopy(spec)
+            cur.metadata.labels = dict(labels)
+            cur.metadata.annotations = dict(annotations)
+            return True
+
+        store.mutate("RoleBasedGroup", ns, g.metadata.name, fn)
+        store.record_event(rbgs, "GroupUpdated",
+                           f"propagated template to {g.metadata.name}")
 
     def _create_group(self, store, rbgs, gname: str, index: int):
         g = RoleBasedGroup()

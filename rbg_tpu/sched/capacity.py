@@ -56,8 +56,11 @@ class CapacityCache:
         self._tpu_bound: Dict[str, int] = {}    # node -> bound slice pods
         # (topo key, domain) -> {group: pod count}
         self._excl: Dict[Tuple[str, str], Dict[str, int]] = {}
-        # pod uid -> (resource_version, footprint); rv -1 = tombstone
-        self._contrib: Dict[str, Tuple[int, Optional[_Contrib]]] = {}
+        # pod uid -> (resource_version, footprint); rv None = tombstone
+        # (terminal delete — late pre-delete events for the uid are dropped)
+        self._contrib: Dict[str, Tuple[Optional[int], Optional[_Contrib]]] = {}
+        # Tombstones that already survived one rebuild (dropped on the next).
+        self._aged_tombstones: set = set()
         self._started = False
 
     # ---- lifecycle ----
@@ -75,11 +78,24 @@ class CapacityCache:
         with self._lock:
             self._nodes = {n.metadata.name: n
                            for n in self.store.list("Node", copy_=False)}
+            pods = self.store.list("Pod", copy_=False)
+            # Carry delete tombstones for ONE extra rebuild cycle: event
+            # dispatch happens outside the store lock, so a delayed
+            # pre-delete MODIFIED event can arrive after this rebuild and
+            # would otherwise resurrect the deleted pod's footprint
+            # (transiently under-reporting free capacity until the next
+            # resync). Tombstones that already survived a cycle are dropped.
+            live = {p.metadata.uid for p in pods}
+            keep = {uid for uid, (rv, _) in self._contrib.items()
+                    if rv is None} - self._aged_tombstones - live
+            self._aged_tombstones = set(keep)
             self._bound.clear()
             self._tpu_bound.clear()
             self._excl.clear()
-            self._contrib.clear()  # also prunes delete tombstones
-            for pod in self.store.list("Pod", copy_=False):
+            self._contrib.clear()
+            for uid in keep:
+                self._contrib[uid] = (None, None)
+            for pod in pods:
                 self._apply(pod.metadata.uid, pod.metadata.resource_version,
                             _pod_contrib(pod, self._nodes))
 
@@ -101,8 +117,33 @@ class CapacityCache:
         with self._lock:
             if ev.type == Event.DELETED:
                 self._nodes.pop(node.metadata.name, None)
-            else:
-                self._nodes[node.metadata.name] = node
+                return
+            old = self._nodes.get(node.metadata.name)
+            self._nodes[node.metadata.name] = node
+            # Topology labels are immutable by convention on TPU nodepools,
+            # but if one DOES change, re-derive the exclusive-topology
+            # domains of pods bound to this node so existing footprints
+            # don't pin the old domain until the next pod event / resync.
+            if old is not None and getattr(old, "labels", {}) != node.labels:
+                self._refresh_excl_on_node(node)
+
+    def _refresh_excl_on_node(self, node):
+        """Recompute (key, domain) exclusive footprints of pods on ``node``
+        after a label change. The footprint tuple carries everything needed
+        (topology key + group); only the domain value is re-read."""
+        for uid, (rv, contrib) in list(self._contrib.items()):
+            if rv is None or contrib is None:
+                continue
+            name, tpu, excl = contrib
+            if name != node.metadata.name or excl is None:
+                continue
+            key, _old_domain, grp = excl
+            new_excl = (key, node.labels.get(key, ""), grp)
+            if new_excl != excl:
+                self._remove_footprint(contrib)
+                new_contrib = (name, tpu, new_excl)
+                self._contrib[uid] = (rv, new_contrib)
+                self._add_footprint(new_contrib)
 
     def _apply(self, uid: str, rv: Optional[int], contrib: Optional[_Contrib]):
         """Replace a pod's footprint iff ``rv`` is not older than what we

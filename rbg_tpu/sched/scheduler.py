@@ -59,9 +59,22 @@ class SchedulerController(Controller):
                 return
             try:
                 self.cap.rebuild()
+            except Exception:
+                # Loud failure (round-1 policy): a persistently failing
+                # rebuild would otherwise silently disable the drift
+                # backstop forever.
+                import logging
+                logging.getLogger("rbg_tpu.sched").warning(
+                    "capacity rebuild failed (drift backstop skipped this "
+                    "cycle)", exc_info=True)
+            # Outside the try: the periodic re-enqueue must still happen
+            # when the rebuild fails.
+            try:
                 self._enqueue_all()
             except Exception:
-                pass
+                import logging
+                logging.getLogger("rbg_tpu.sched").warning(
+                    "scheduler resync enqueue failed", exc_info=True)
 
     def watches(self) -> List[Watch]:
         from rbg_tpu.runtime.controller import own_keys

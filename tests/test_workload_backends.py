@@ -1,0 +1,235 @@
+"""Pluggable workload-backend seam (reference: inventory #23,
+``pkg/reconciler/workload_reconciler.go:54-69`` factory + dynamic CRD watch
+``rolebasedgroup_controller.go:1598-1621``): a custom workload kind attaches
+via ``rbg_tpu.runtime.workload.register()`` with ZERO edits to the group
+controller."""
+
+import dataclasses
+
+import pytest
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.group import RoleStatus
+from rbg_tpu.api.meta import ObjectMeta, get_condition, owner_ref
+from rbg_tpu.api.validation import ValidationError
+from rbg_tpu.runtime import workload
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import make_group, make_tpu_nodes, simple_role
+
+
+@dataclasses.dataclass
+class ExternalStatus:
+    ready: bool = False
+    observed_revision: str = ""
+
+
+@dataclasses.dataclass
+class ExternalWorkload:
+    """A stand-in for an externally-operated workload kind (vendor operator,
+    Kueue job...) — the plane only sees this handle object."""
+
+    kind: str = "ExternalWorkload"
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    replicas: int = 0
+    image: str = ""
+    status: ExternalStatus = dataclasses.field(default_factory=ExternalStatus)
+
+    __serde_keep__ = ("kind", "metadata")
+
+
+class ExternalBackend(workload.WorkloadBackend):
+    kind = "ExternalWorkload"
+
+    def __init__(self):
+        self.validated = []
+
+    def validate(self, store, rbg, role):
+        self.validated.append(role.name)
+        if role.replicas > 10:
+            raise ValidationError("ExternalWorkload caps replicas at 10")
+
+    def watches(self):
+        from rbg_tpu.runtime.controller import Watch, owner_keys
+        return [Watch("ExternalWorkload", owner_keys("RoleBasedGroup"))]
+
+    def reconcile_role(self, store, rbg, role, role_hash, replicas, gang,
+                       partition=None):
+        from rbg_tpu.runtime.store import AlreadyExists
+        ns = rbg.metadata.namespace
+        wname = C.workload_name(rbg.metadata.name, role.name)
+        image = role.template.containers[0].image if role.template.containers else ""
+        cur = store.get("ExternalWorkload", ns, wname, copy_=False)
+        if cur is None:
+            w = ExternalWorkload()
+            w.metadata.name = wname
+            w.metadata.namespace = ns
+            w.metadata.labels = {C.role_revision_label(role.name): role_hash}
+            w.metadata.owner_references = [owner_ref(rbg)]
+            w.replicas, w.image = replicas, image
+            try:
+                store.create(w)
+            except AlreadyExists:
+                pass
+        elif (cur.replicas, cur.image) != (replicas, image) or \
+                cur.metadata.labels.get(C.role_revision_label(role.name)) != role_hash:
+            def fn(w):
+                w.replicas, w.image = replicas, image
+                w.metadata.labels[C.role_revision_label(role.name)] = role_hash
+                return True
+            store.mutate("ExternalWorkload", ns, wname, fn)
+
+    def construct_role_status(self, store, rbg, role, role_hash, prev):
+        ns = rbg.metadata.namespace
+        wname = C.workload_name(rbg.metadata.name, role.name)
+        w = store.get("ExternalWorkload", ns, wname, copy_=False)
+        if w is None:
+            return prev or RoleStatus(name=role.name)
+        n = w.replicas if w.status.ready else 0
+        return RoleStatus(name=role.name, replicas=w.replicas,
+                          ready_replicas=n, updated_replicas=w.replicas,
+                          updated_ready_replicas=n,
+                          observed_revision=role_hash, ready=w.status.ready)
+
+    def cleanup_orphans(self, store, rbg, valid_names):
+        for w in store.list("ExternalWorkload", namespace=rbg.metadata.namespace,
+                            owner_uid=rbg.metadata.uid):
+            if w.metadata.name not in valid_names:
+                store.delete("ExternalWorkload", w.metadata.namespace,
+                             w.metadata.name)
+
+
+@pytest.fixture()
+def external_backend():
+    b = workload.register(ExternalBackend())
+    yield b
+    workload.unregister(b.kind)
+
+
+@pytest.fixture()
+def plane(external_backend):
+    p = ControlPlane(backend="fake")
+    make_tpu_nodes(p.store, slices=2, hosts_per_slice=2)
+    with p:
+        yield p
+
+
+def external_role(name, replicas=2, image="vendor:v1"):
+    r = simple_role(name, replicas=replicas, image=image)
+    r.workload = "ExternalWorkload"
+    return r
+
+
+def test_custom_kind_end_to_end(plane, external_backend):
+    """Group with a custom-kind role reaches Ready purely through the
+    registered backend — the group controller never names the kind."""
+    plane.apply(make_group("svc", external_role("db", replicas=3)))
+
+    def created():
+        w = plane.store.get("ExternalWorkload", "default", "svc-db")
+        return w is not None and w.replicas == 3
+    plane.wait_for(created, desc="backend created the external child")
+    assert "db" in external_backend.validated
+
+    # Group not Ready while the external workload isn't.
+    g = plane.store.get("RoleBasedGroup", "default", "svc")
+    c = get_condition(g.status.conditions, C.COND_READY)
+    assert c is None or c.status == "False"
+
+    # External operator reports ready → group goes Ready via the backend's
+    # status rollup + the backend-declared watch.
+    def mark(w):
+        w.status.ready = True
+        return True
+    plane.store.mutate("ExternalWorkload", "default", "svc-db", mark, status=True)
+    plane.wait_group_ready("svc")
+    g = plane.store.get("RoleBasedGroup", "default", "svc")
+    st = g.status.role("db")
+    assert st.ready_replicas == 3
+
+
+def test_mixed_kinds_in_one_group(plane):
+    """Native InstanceSet role + custom-kind role coexist; group Ready only
+    when BOTH backends report ready."""
+    plane.apply(make_group("mix", simple_role("server", replicas=1),
+                           external_role("cache", replicas=2)))
+    plane.wait_for(
+        lambda: plane.store.get("ExternalWorkload", "default", "mix-cache"),
+        desc="external child")
+    plane.wait_for(
+        lambda: plane.store.get("RoleInstanceSet", "default", "mix-server"),
+        desc="native child")
+
+    # native role becomes ready via the fake kubelet; external still pending
+    def native_ready():
+        ris = plane.store.get("RoleInstanceSet", "default", "mix-server")
+        return ris.status.ready_replicas == 1
+    plane.wait_for(native_ready, timeout=20, desc="native role ready")
+    g = plane.store.get("RoleBasedGroup", "default", "mix")
+    c = get_condition(g.status.conditions, C.COND_READY)
+    assert c is None or c.status == "False"
+
+    plane.store.mutate("ExternalWorkload", "default", "mix-cache",
+                       lambda w: setattr(w.status, "ready", True) or True,
+                       status=True)
+    plane.wait_group_ready("mix")
+
+
+def test_template_change_reaches_custom_kind(plane):
+    plane.apply(make_group("svc", external_role("db", image="vendor:v1")))
+    plane.wait_for(
+        lambda: plane.store.get("ExternalWorkload", "default", "svc-db"),
+        desc="external child")
+    g = plane.store.get("RoleBasedGroup", "default", "svc")
+    g.spec.roles[0].template.containers[0].image = "vendor:v2"
+    plane.store.update(g)
+    plane.wait_for(
+        lambda: plane.store.get("ExternalWorkload", "default", "svc-db").image
+        == "vendor:v2",
+        desc="image propagated to external child")
+
+
+def test_kind_change_cleans_old_backend_child(plane):
+    """Flipping a role's workload kind deletes the old backend's child."""
+    plane.apply(make_group("svc", external_role("db")))
+    plane.wait_for(
+        lambda: plane.store.get("ExternalWorkload", "default", "svc-db"),
+        desc="external child")
+    g = plane.store.get("RoleBasedGroup", "default", "svc")
+    g.spec.roles[0].workload = workload.DEFAULT_KIND
+    plane.store.update(g)
+    plane.wait_for(
+        lambda: plane.store.get("ExternalWorkload", "default", "svc-db") is None,
+        desc="old-kind child cleaned up")
+    plane.wait_for(
+        lambda: plane.store.get("RoleInstanceSet", "default", "svc-db"),
+        desc="native child created")
+
+
+def test_backend_validation_rejects(plane):
+    plane.apply(make_group("svc", external_role("db", replicas=11)))
+
+    def rejected():
+        g = plane.store.get("RoleBasedGroup", "default", "svc")
+        c = get_condition(g.status.conditions, C.COND_READY)
+        return c is not None and c.reason == "ValidationFailed" \
+            and "caps replicas" in (c.message or "")
+    plane.wait_for(rejected, desc="backend validation surfaces")
+    assert plane.store.get("ExternalWorkload", "default", "svc-db") is None
+
+
+def test_unknown_kind_surfaces_validation_failure():
+    """A role naming an unregistered kind → ValidationFailed condition
+    (reference: unsupported workload type error)."""
+    p = ControlPlane(backend="fake")
+    make_tpu_nodes(p.store, slices=1, hosts_per_slice=2)
+    with p:
+        r = simple_role("x")
+        r.workload = "NoSuchKind"
+        p.apply(make_group("svc", r))
+
+        def rejected():
+            g = p.store.get("RoleBasedGroup", "default", "svc")
+            c = get_condition(g.status.conditions, C.COND_READY)
+            return (c is not None and c.reason == "ValidationFailed"
+                    and "NoSuchKind" in (c.message or ""))
+        p.wait_for(rejected, desc="unknown kind rejected")

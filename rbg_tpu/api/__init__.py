@@ -2,7 +2,8 @@
 
 from rbg_tpu.api import constants
 from rbg_tpu.api.group import (
-    ComponentSpec, EngineRuntimeRef, GroupTemplate, LeaderWorkerSpec,
+    ComponentSpec, EngineRuntimeRef, GroupTemplate, IdentityMode,
+    LeaderWorkerSpec,
     PatternType, RestartPolicy, RestartPolicyConfig, RoleBasedGroup,
     RoleBasedGroupSet, RoleBasedGroupSetSpec, RoleBasedGroupSpec,
     RoleBasedGroupStatus, RoleSpec, RoleStatus, RoleTemplate, RollingUpdate,
@@ -41,7 +42,7 @@ KINDS = {
 
 
 API_GROUP = "rbg.tpu.x-k8s.io"
-API_VERSION = f"{API_GROUP}/v1alpha1"
+API_VERSION = f"{API_GROUP}/v1alpha2"
 
 # apiVersion -> converter(dict) -> dict at a NEWER apiVersion. The hub-spoke
 # conversion-webhook analog (reference:
@@ -49,8 +50,18 @@ API_VERSION = f"{API_GROUP}/v1alpha1"
 # pure dict->dict functions run at admission: an old manifest is converted
 # forward until it reaches API_VERSION, then parsed strictly. Register a
 # converter here when a release renames/restructures the manifest schema
-# (docs/architecture.md §5 rule 2).
+# (docs/architecture.md §5 rule 2). v1alpha1 manifests (bool ``stateful``)
+# convert live — see rbg_tpu/api/conversions.py.
 MANIFEST_CONVERSIONS: dict = {}
+
+
+def _register_conversions():
+    from rbg_tpu.api import conversions
+    MANIFEST_CONVERSIONS[f"{API_GROUP}/v1alpha1"] = (
+        conversions.v1alpha1_to_v1alpha2)
+
+
+_register_conversions()
 
 
 def convert_manifest(doc: dict) -> dict:

@@ -25,6 +25,16 @@ class PatternType(str, enum.Enum):
     CUSTOM_COMPONENTS = "customComponents"
 
 
+class IdentityMode(str, enum.Enum):
+    """Instance identity discipline (v1alpha2 rename of the v1alpha1 bool
+    ``stateful``, converted in api/conversions.py). Enum-typed so admission
+    strict-parse rejects misspellings ("Random", "stateless") instead of
+    silently running the role ordinal."""
+
+    ORDINAL = "ordinal"   # stable {set}-{i} names, slice-pinned placement
+    RANDOM = "random"     # CloneSet-like unordered instances
+
+
 @dataclasses.dataclass
 class ComponentSpec:
     """One component of a customComponents role (reference: :368-433 +
@@ -167,7 +177,7 @@ class RoleSpec:
     rolling_update: RollingUpdate = dataclasses.field(default_factory=RollingUpdate)
     scaling_adapter: Optional[ScalingAdapterHook] = None
     engine_runtime: Optional[EngineRuntimeRef] = None
-    stateful: bool = True       # ordered identity (TPU slices want this)
+    identity: IdentityMode = IdentityMode.ORDINAL
     workload: str = "RoleInstanceSet"  # strategy selector (inventory #23)
     # Scale-down drain window (stateless mode): an instance slated for
     # deletion enters PreparingDelete and keeps serving in-flight work for
@@ -181,6 +191,11 @@ class RoleSpec:
     service_selection: str = "All"     # All | LeaderOnly
 
     __serde_keep__ = ("name",)
+
+    @property
+    def stateful(self) -> bool:
+        """Derived from ``identity`` (kept for call-site readability)."""
+        return self.identity != IdentityMode.RANDOM
 
     def gang_size(self) -> int:
         """Pods per role instance."""

@@ -13,8 +13,8 @@ import enum
 from typing import Dict, List, Optional
 
 from rbg_tpu.api.group import (
-    ComponentSpec, EngineRuntimeRef, LeaderWorkerSpec, PatternType,
-    RestartPolicyConfig, RollingUpdate, TpuSpec,
+    ComponentSpec, EngineRuntimeRef, IdentityMode, LeaderWorkerSpec,
+    PatternType, RestartPolicyConfig, RollingUpdate, TpuSpec,
 )
 from rbg_tpu.api.meta import Condition, ObjectMeta
 from rbg_tpu.api.pod import PodTemplate
@@ -41,13 +41,18 @@ class InstanceTemplate:
 @dataclasses.dataclass
 class RoleInstanceSetSpec:
     replicas: int = 1
-    stateful: bool = True
+    identity: IdentityMode = IdentityMode.ORDINAL
     instance: InstanceTemplate = dataclasses.field(default_factory=InstanceTemplate)
     restart_policy: RestartPolicyConfig = dataclasses.field(default_factory=RestartPolicyConfig)
     rolling_update: RollingUpdate = dataclasses.field(default_factory=RollingUpdate)
     selector: Dict[str, str] = dataclasses.field(default_factory=dict)
     # PreparingDelete drain window for stateless scale-down (0 = immediate).
     drain_seconds: float = 0.0
+
+    @property
+    def stateful(self) -> bool:
+        """Derived from ``identity`` (kept for call-site readability)."""
+        return self.identity != IdentityMode.RANDOM
 
 
 @dataclasses.dataclass

@@ -124,26 +124,29 @@ class RoleBasedGroupSetController(Controller):
             C.LABEL_GROUP_SET_INDEX, "")
         return labels, dict(rbgs.spec.template.metadata.annotations)
 
-    def _desired_spec(self, store, rbgs, g):
-        """The template spec, with replicas of adapter-managed roles pinned
-        to the child's CURRENT value: a Bound ScalingAdapter owns that field
-        (the group controller persists its override into the child spec,
-        ``group.py::_apply_scaling_overrides``) — treating it as drift would
-        have this controller and the group controller stomping the spec back
-        and forth forever."""
-        spec = copy.deepcopy(rbgs.spec.template.spec)
-        adapter_roles = {
-            a.spec.role_name
-            for a in store.list("ScalingAdapter",
-                                namespace=g.metadata.namespace)
-            if a.spec.group_name == g.metadata.name
-            and a.status.phase == "Bound" and a.spec.replicas is not None
-        }
-        for role in spec.roles:
-            if role.name in adapter_roles:
-                cur = g.spec.role(role.name)
+    def _desired_spec_dict(self, template_dict, adapter_roles_by_group, g):
+        """The template spec AS A DICT, with replicas of adapter-managed
+        roles pinned to the child's CURRENT value: a Bound ScalingAdapter
+        owns that field (the group controller persists its override into
+        the child spec, ``group.py::_apply_scaling_overrides``) — treating
+        it as drift would have this controller and the group controller
+        stomping the spec back and forth forever."""
+        adapter_roles = adapter_roles_by_group.get(g.metadata.name, ())
+        if not adapter_roles:
+            return template_dict
+        spec = dict(template_dict)
+        roles = []
+        for role in spec.get("roles", []):
+            if role.get("name") in adapter_roles:
+                cur = g.spec.role(role.get("name"))
                 if cur is not None:
-                    role.replicas = cur.replicas
+                    role = dict(role, replicas=cur.replicas)
+                    # serde drops default-valued fields — mirror that so
+                    # replicas=1 pins compare equal to an omitted key.
+                    if cur.replicas == 1:
+                        role.pop("replicas", None)
+            roles.append(role)
+        spec["roles"] = roles
         return spec
 
     def _propagate_template(self, store, rbgs, in_range, created: int = 0):
@@ -151,14 +154,26 @@ class RoleBasedGroupSetController(Controller):
         ``max_unavailable`` cells disrupted at a time (cells just created
         this pass count as disrupted). Returns
         (#children matching template, #drifted children still waiting)."""
+        # One template serialization + one adapter scan per reconcile — this
+        # runs on every child status flip, so per-child store scans would be
+        # O(cells x adapters) work per fleet-wide status wave.
+        template_dict = serde.to_dict(rbgs.spec.template.spec)
+        adapter_roles_by_group: dict = {}
+        for a in store.list("ScalingAdapter", namespace=rbgs.metadata.namespace,
+                            copy_=False):
+            if a.status.phase == "Bound" and a.spec.replicas is not None:
+                adapter_roles_by_group.setdefault(
+                    a.spec.group_name, set()).add(a.spec.role_name)
+
         drifted = []
         matching = 0
         desired_specs = {}
         for g in in_range.values():
             labels, annotations = self._desired_meta(rbgs, g)
-            desired = self._desired_spec(store, rbgs, g)
+            desired = self._desired_spec_dict(template_dict,
+                                              adapter_roles_by_group, g)
             desired_specs[g.metadata.name] = desired
-            if (serde.to_dict(g.spec) != serde.to_dict(desired)
+            if (serde.to_dict(g.spec) != desired
                     or g.metadata.labels != labels
                     or g.metadata.annotations != annotations):
                 drifted.append(g)
@@ -190,12 +205,13 @@ class RoleBasedGroupSetController(Controller):
                                desired_specs[g.metadata.name])
         return matching, pending
 
-    def _update_group(self, store, rbgs, g, spec):
+    def _update_group(self, store, rbgs, g, spec_dict):
+        from rbg_tpu.api.group import RoleBasedGroupSpec
         ns = g.metadata.namespace
         labels, annotations = self._desired_meta(rbgs, g)
 
         def fn(cur):
-            cur.spec = copy.deepcopy(spec)
+            cur.spec = serde.from_dict(RoleBasedGroupSpec, spec_dict)
             cur.metadata.labels = dict(labels)
             cur.metadata.annotations = dict(annotations)
             return True

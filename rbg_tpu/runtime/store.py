@@ -365,7 +365,11 @@ class Store:
     # Snapshot schema version. Bump ONLY for structural changes that lenient
     # parsing + field defaults can't absorb; add a migration fn to
     # _SNAPSHOT_MIGRATIONS for each bump (docs/architecture.md §5).
-    SNAPSHOT_SCHEMA = 1
+    # Schema 2 (this release): role ``stateful`` bool → ``identity`` string
+    # — lenient parse of an old file would silently DROP ``stateful: false``
+    # and default every role to ordinal, which is exactly the class of
+    # misparse the schema number exists to catch.
+    SNAPSHOT_SCHEMA = 2
     _SNAPSHOT_MIGRATIONS: dict = {}   # {from_schema: fn(data_dict) -> data_dict}
 
     def snapshot(self) -> dict:
@@ -434,3 +438,10 @@ class Store:
                 return list(self._events_log)
             ref = f"{obj.kind}/{obj.metadata.namespace}/{obj.metadata.name}"
             return [e for e in self._events_log if e[1] == ref]
+
+
+# ---- registered snapshot migrations (rbg_tpu/api/conversions.py) ----
+
+from rbg_tpu.api.conversions import migrate_snapshot_v1 as _migrate_v1  # noqa: E402
+
+Store._SNAPSHOT_MIGRATIONS[1] = _migrate_v1

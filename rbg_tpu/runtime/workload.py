@@ -161,7 +161,7 @@ class InstanceSetBackend(WorkloadBackend):
             rolling.partition = max(partition, role.rolling_update.partition)
         desired_spec = RoleInstanceSetSpec(
             replicas=replicas,
-            stateful=role.stateful,
+            identity=role.identity,
             instance=InstanceTemplate(
                 pattern=role.pattern,
                 template=role.template,

@@ -114,3 +114,113 @@ def test_migrate_state_cli(tmp_path, monkeypatch):
     assert dst.load_snapshot(migrated) == 1
     g = dst.get("RoleBasedGroup", "default", "cli")
     assert g is not None and g.spec.roles[0].replicas == 3
+
+
+# ---- the REAL shipped migration: v1alpha1 `stateful` -> v1alpha2 `identity`
+# (rbg_tpu/api/conversions.py), proven from committed old-format artifacts.
+
+import os
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def test_shipped_registries_are_non_empty():
+    assert f"{api.API_GROUP}/v1alpha1" in api.MANIFEST_CONVERSIONS
+    assert 1 in Store._SNAPSHOT_MIGRATIONS
+    assert Store.SNAPSHOT_SCHEMA == 2
+    assert api.API_VERSION == f"{api.API_GROUP}/v1alpha2"
+
+
+def test_v1alpha1_manifest_fixture_converts():
+    from rbg_tpu.api.serde import load_yaml_docs
+    with open(os.path.join(FIXTURES, "manifest_v1alpha1.yaml")) as f:
+        (doc,) = load_yaml_docs(f.read())
+    g = api.parse_manifest(doc)
+    roles = {r.name: r for r in g.spec.roles}
+    assert roles["prefill"].identity == "ordinal" and roles["prefill"].stateful
+    assert roles["router"].identity == "random" and not roles["router"].stateful
+    assert roles["router"].drain_seconds == 2.0  # untouched fields survive
+
+    # The OLD spelling at the CURRENT version stays a strict-parse error —
+    # conversion is per-version, not a lenient alias.
+    cur = dict(doc, apiVersion=api.API_VERSION)
+    with pytest.raises(Exception):
+        api.parse_manifest(cur)
+
+
+def test_schema1_snapshot_fixture_loads_and_preserves_statelessness():
+    """Committed schema-1 snapshot (taken by the previous release's shape):
+    the migration must keep the router role STATELESS — a lenient parse
+    without migration would silently default it to ordinal."""
+    with open(os.path.join(FIXTURES, "state_schema1.json")) as f:
+        data = json.load(f)
+    assert data["schema"] == 1
+    store = Store()
+    n = store.load_snapshot(data)
+    assert n == len(data["objects"])
+
+    g = store.get("RoleBasedGroup", "default", "legacy")
+    roles = {r.name: r for r in g.spec.roles}
+    assert roles["router"].identity == "random"
+    assert roles["server"].identity == "ordinal"
+
+    ris = store.get("RoleInstanceSet", "default", "legacy-router")
+    assert ris.spec.identity == "random" and not ris.spec.stateful
+
+    # ControllerRevision payloads converted too (undo to a pre-upgrade
+    # revision must re-apply cleanly).
+    revs = store.list("ControllerRevision", namespace="default")
+    assert revs
+    for rev in revs:
+        if "roles" in rev.data:
+            for r in rev.data["roles"]:
+                assert "stateful" not in r
+                assert "identity" in r
+
+
+def test_migrate_state_cli_on_fixture(tmp_path):
+    from rbg_tpu.cli.controlplane import cmd_migrate_state
+
+    outfile = tmp_path / "migrated.json"
+
+    class Args:
+        pass
+    a = Args()
+    a.infile = os.path.join(FIXTURES, "state_schema1.json")
+    a.outfile = str(outfile)
+    assert cmd_migrate_state(a) == 0
+
+    migrated = json.loads(outfile.read_text())
+    assert migrated["schema"] == Store.SNAPSHOT_SCHEMA
+    assert "stateful" not in json.dumps(migrated)
+
+
+def test_plane_resumes_from_schema1_fixture():
+    """Full resume: boot a live plane from the old-format state file; the
+    stateless role must keep random-id instances (no ordinal rename storm)
+    and the group must converge."""
+    from rbg_tpu.runtime.plane import ControlPlane
+
+    with open(os.path.join(FIXTURES, "state_schema1.json")) as f:
+        data = json.load(f)
+    store = Store()
+    store.load_snapshot(data)
+    p = ControlPlane(store=store, backend="fake")
+    with p:
+        p.wait_group_ready("legacy", timeout=30)
+        instances = store.list("RoleInstance", namespace="default",
+                               selector={"rbg.tpu.x-k8s.io/group-name": "legacy"})
+        router_inst = [i for i in instances
+                       if i.metadata.name.startswith("legacy-router-")]
+        assert router_inst
+        for inst in router_inst:
+            suffix = inst.metadata.name.rsplit("-", 1)[-1]
+            assert not suffix.isdigit(), "stateless instance got renamed to ordinal"
+
+
+def test_invalid_identity_value_rejected_at_admission():
+    with pytest.raises(ValueError, match="IdentityMode"):
+        api.parse_manifest({
+            "kind": "RoleBasedGroup", "metadata": {"name": "g"},
+            "spec": {"roles": [{"name": "a", "identity": "Random"}]},
+        })

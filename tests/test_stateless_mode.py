@@ -18,7 +18,7 @@ def _plane():
 def test_stateless_random_ids_and_scale():
     with _plane() as plane:
         role = simple_role("worker", replicas=3)
-        role.stateful = False
+        role.identity = "random"
         plane.apply(make_group("sl", role))
         plane.wait_group_ready("sl", timeout=20)
 
@@ -42,7 +42,7 @@ def test_stateless_random_ids_and_scale():
 def test_specified_delete_annotation():
     with _plane() as plane:
         role = simple_role("worker", replicas=2)
-        role.stateful = False
+        role.identity = "random"
         plane.apply(make_group("sd", role))
         plane.wait_group_ready("sd", timeout=20)
 
@@ -73,7 +73,7 @@ def test_stateless_paused_freezes_update():
 
     with _plane() as plane:
         role = simple_role("worker", replicas=2)
-        role.stateful = False
+        role.identity = "random"
         role.rolling_update = RollingUpdate(paused=True,
                                             in_place_if_possible=False)
         plane.apply(make_group("pz", role))
@@ -110,7 +110,7 @@ def test_stateless_paused_freezes_update():
 
 def _drain_role(name="worker", replicas=2, drain=30.0, image="engine:v1"):
     role = simple_role(name, replicas=replicas, image=image)
-    role.stateful = False
+    role.identity = "random"
     role.drain_seconds = drain
     return role
 
@@ -260,7 +260,7 @@ def test_delete_preference_not_ready_first():
     """Scale-down condemns the not-ready instance, not a serving one."""
     with _plane() as plane:
         role = simple_role("w", replicas=2)
-        role.stateful = False
+        role.identity = "random"
         plane.apply(make_group("pref", role))
         plane.wait_group_ready("pref", timeout=20)
 

@@ -1,0 +1,84 @@
+"""All-thread sampling profiler (the pprof analog).
+
+cProfile is per-thread — attached to an admin handler it would only see that
+handler sleeping — so we SAMPLE every thread's stack via
+``sys._current_frames``: a statistical CPU profile of the whole plane.
+Used by the admin ``profile`` op (reference: ``cmd/rbgs/main.go:584-620``
+pprof server) and captured into stress reports during load (reference:
+``test/stress/pprof.go``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from typing import List, Optional
+
+
+def sample_profile(seconds: float = 2.0, interval: float = 0.01,
+                   top_n: int = 30, stop_event: Optional[threading.Event] = None,
+                   exclude_thread: Optional[int] = None) -> dict:
+    """Sample all threads for ``seconds`` (or until ``stop_event``); return
+    {"seconds", "samples", "top": [{"site", "samples"}]}."""
+    me = exclude_thread if exclude_thread is not None else threading.get_ident()
+    counts: Counter = Counter()
+    t0 = time.monotonic()
+    end = t0 + seconds
+    samples = 0
+    while time.monotonic() < end:
+        if stop_event is not None and stop_event.is_set():
+            break
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = traceback.extract_stack(frame, limit=3)
+            if stack:
+                f = stack[-1]
+                counts[f"{f.name} ({os.path.basename(f.filename)}:{f.lineno})"] += 1
+        samples += 1
+        time.sleep(interval)
+    return {
+        "seconds": round(time.monotonic() - t0, 2),
+        "samples": samples,
+        "top": [{"site": site, "samples": n}
+                for site, n in counts.most_common(top_n)],
+    }
+
+
+class BackgroundProfiler:
+    """Continuously sample while a load phase runs; ``stop()`` returns the
+    profile. The stress harness wraps each phase in one of these."""
+
+    def __init__(self, interval: float = 0.01, top_n: int = 25):
+        self._interval = interval
+        self._top_n = top_n
+        self._stop = threading.Event()
+        self._result: dict = {}
+        self._thread: Optional[threading.Thread] = None
+
+    def __enter__(self):
+        def run():
+            self._result = sample_profile(
+                seconds=3600.0, interval=self._interval, top_n=self._top_n,
+                stop_event=self._stop)
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="stress-profiler")
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def stop(self) -> dict:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return self._result
+
+    @property
+    def result(self) -> dict:
+        return self._result

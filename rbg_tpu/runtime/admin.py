@@ -64,6 +64,23 @@ class _Handler(socketserver.BaseRequestHandler):
             return {"object": serde.to_dict(o)} if o else {"error": "not found"}
         if op == "apply":
             parsed = parse_manifest(obj["manifest"])
+            # Admission-time semantic validation (the validating-webhook
+            # analog): structural errors are rejected HERE, before the
+            # object lands — the controller-side precheck remains as the
+            # backstop for objects written through other paths.
+            from rbg_tpu.api.validation import ValidationError, validate_group
+            try:
+                if parsed.kind == "RoleBasedGroup":
+                    validate_group(parsed)
+                elif parsed.kind == "RoleBasedGroupSet":
+                    from rbg_tpu.api.group import RoleBasedGroup
+                    probe = RoleBasedGroup()
+                    probe.metadata.name = parsed.metadata.name
+                    probe.metadata.namespace = parsed.metadata.namespace
+                    probe.spec = parsed.spec.template.spec
+                    validate_group(probe)
+            except ValidationError as e:
+                return {"error": f"admission: {e}"}
             self.server.plane.apply(parsed)
             return {"ok": True, "kind": parsed.kind, "name": parsed.metadata.name}
         if op == "delete":
@@ -89,33 +106,11 @@ class _Handler(socketserver.BaseRequestHandler):
             from rbg_tpu.obs.metrics import REGISTRY
             return {"text": REGISTRY.render()}
         if op == "profile":
-            # pprof analog (reference: cmd/rbgs/main.go:584-620). cProfile is
-            # per-thread (it would only see this handler sleeping), so we
-            # SAMPLE all threads' stacks via sys._current_frames — a
-            # statistical profile of the whole plane.
-            import sys as _sys
-            import time as _time
-            import traceback as _tb
-            from collections import Counter
-            seconds = min(float(obj.get("seconds", 2.0)), 30.0)
-            interval = 0.01
-            me = __import__("threading").get_ident()
-            counts: Counter = Counter()
-            end = _time.monotonic() + seconds
-            samples = 0
-            while _time.monotonic() < end:
-                for tid, frame in _sys._current_frames().items():
-                    if tid == me:
-                        continue
-                    stack = _tb.extract_stack(frame, limit=3)
-                    if stack:
-                        f = stack[-1]
-                        counts[f"{f.name} ({os.path.basename(f.filename)}:{f.lineno})"] += 1
-                samples += 1
-                _time.sleep(interval)
-            top = [{"site": site, "samples": n}
-                   for site, n in counts.most_common(30)]
-            return {"seconds": seconds, "samples": samples, "top": top}
+            # pprof analog (reference: cmd/rbgs/main.go:584-620); see
+            # rbg_tpu/obs/profiler.py for why sampling, not cProfile.
+            from rbg_tpu.obs.profiler import sample_profile
+            return sample_profile(seconds=min(float(obj.get("seconds", 2.0)),
+                                              30.0))
         if op == "events":
             o = store.get(obj["kind"], ns, obj["name"]) if obj.get("kind") else None
             return {"events": [

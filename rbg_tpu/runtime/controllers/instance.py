@@ -81,11 +81,12 @@ class RoleInstanceController(Controller):
 
     def reconcile(self, store: Store, key) -> Optional[Result]:
         ns, name = key
-        inst = store.get("RoleInstance", ns, name)
+        inst = store.get("RoleInstance", ns, name, copy_=False)
         if inst is None or inst.metadata.deletion_timestamp is not None:
             return None
 
-        pods = [p for p in store.list("Pod", namespace=ns, owner_uid=inst.metadata.uid)]
+        pods = store.list("Pod", namespace=ns, owner_uid=inst.metadata.uid,
+                          copy_=False)
         active = [p for p in pods if p.active]
         desired = desired_pods(inst)
 
@@ -93,7 +94,7 @@ class RoleInstanceController(Controller):
         if self.node_binding is not None:
             for p in active:
                 if p.running_ready and p.node_name:
-                    node = store.get("Node", "default", p.node_name)
+                    node = store.get("Node", "default", p.node_name, copy_=False)
                     if node is not None:
                         self.node_binding.record(p, node)
                         if node.tpu.slice_id and inst.status.slice_id != node.tpu.slice_id:
@@ -120,7 +121,8 @@ class RoleInstanceController(Controller):
         pg_name = self._pod_group_name(inst, desired)
         self._adopt_orphans(store, inst, desired)
         # Re-list: adoption may have just brought pods under our owner uid.
-        pods = store.list("Pod", namespace=ns, owner_uid=inst.metadata.uid)
+        pods = store.list("Pod", namespace=ns, owner_uid=inst.metadata.uid,
+                          copy_=False)
         active = [p for p in pods if p.active]
         existing = {p.metadata.name for p in active}
         wanted = {n for (n, *_rest) in desired}

@@ -74,7 +74,7 @@ class RoleInstanceSetController(Controller):
 
     def reconcile(self, store: Store, key) -> Optional[Result]:
         ns, name = key
-        ris = store.get("RoleInstanceSet", ns, name)
+        ris = store.get("RoleInstanceSet", ns, name, copy_=False)
         if ris is None or ris.metadata.deletion_timestamp is not None:
             return None
 
@@ -82,11 +82,12 @@ class RoleInstanceSetController(Controller):
         if self.ports is not None:
             _, changed = self.ports.ensure_role_ports(ris)
             if changed:
-                ris = store.get("RoleInstanceSet", ns, name)  # pick up annotations
+                ris = store.get("RoleInstanceSet", ns, name, copy_=False)  # new annotations
                 if ris is None or ris.metadata.deletion_timestamp is not None:
                     return None
         instances = [
-            i for i in store.list("RoleInstance", namespace=ns, owner_uid=ris.metadata.uid)
+            i for i in store.list("RoleInstance", namespace=ns,
+                                  owner_uid=ris.metadata.uid, copy_=False)
             if i.metadata.deletion_timestamp is None
         ]
 

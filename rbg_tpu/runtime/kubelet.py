@@ -107,11 +107,11 @@ class FakeKubelet:
         kind, ns, name = key
         try:
             node = None
-            pod = self.store.get(kind, ns, name)
+            pod = self.store.get(kind, ns, name, copy_=False)
             if pod is None or pod.metadata.deletion_timestamp is not None:
                 return
             if pod.node_name:
-                node = self.store.get("Node", "default", pod.node_name)
+                node = self.store.get("Node", "default", pod.node_name, copy_=False)
 
             run_to_completion = (
                 pod.metadata.annotations.get(f"{_DOMAIN}/run-to-completion") == "true"

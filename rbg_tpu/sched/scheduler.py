@@ -92,7 +92,7 @@ class SchedulerController(Controller):
 
     def reconcile(self, store: Store, key) -> Optional[Result]:
         ns, name = key
-        pod = store.get("Pod", ns, name)
+        pod = store.get("Pod", ns, name, copy_=False)
         if pod is None or pod.node_name or not pod.active:
             return None
 

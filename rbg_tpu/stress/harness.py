@@ -75,6 +75,11 @@ def _run(cfg: StressConfig, plane: ControlPlane) -> dict:
         return c is not None and c.status == "True"
 
     # --- create phase ---
+    # A background stack-sampling profiler runs through the phase and its
+    # top sites land in the report (reference: test/stress/pprof.go scrapes
+    # controller pprof into the HTML report).
+    from rbg_tpu.obs.profiler import BackgroundProfiler
+
     # Ready transitions are observed by a WATCHER so each group's latency is
     # its own (polling after the create burst inflated early groups' numbers
     # by the remaining burst duration — the round-1 "3.1s p99" was mostly
@@ -93,19 +98,20 @@ def _run(cfg: StressConfig, plane: ControlPlane) -> dict:
 
     plane.store.watch("RoleBasedGroup", on_group_event)
 
-    for i, name in enumerate(names):
-        roles = [simple_role(f"role{j}", replicas=cfg.replicas)
-                 for j in range(cfg.roles_per_group)]
-        for j in range(1, len(roles)):
-            roles[j].dependencies = [roles[0].name]
-        t_created[name] = time.perf_counter()
-        plane.apply(make_group(name, *roles))
-        if interval:
-            time.sleep(interval)
-    for name in names:
-        plane.wait_for(lambda n=name: n in t_ready or ready(n),
-                       timeout=cfg.timeout_per_group, desc=f"{name} ready")
-        t_ready.setdefault(name, time.perf_counter())  # watcher raced: now
+    with BackgroundProfiler() as create_prof:
+        for i, name in enumerate(names):
+            roles = [simple_role(f"role{j}", replicas=cfg.replicas)
+                     for j in range(cfg.roles_per_group)]
+            for j in range(1, len(roles)):
+                roles[j].dependencies = [roles[0].name]
+            t_created[name] = time.perf_counter()
+            plane.apply(make_group(name, *roles))
+            if interval:
+                time.sleep(interval)
+        for name in names:
+            plane.wait_for(lambda n=name: n in t_ready or ready(n),
+                           timeout=cfg.timeout_per_group, desc=f"{name} ready")
+            t_ready.setdefault(name, time.perf_counter())  # watcher raced: now
     create_lat = [t_ready[n] - t_created[n] for n in names]
 
     # --- update phase (image-only → exercises the in-place engine) ---
@@ -155,6 +161,7 @@ def _run(cfg: StressConfig, plane: ControlPlane) -> dict:
             c: REGISTRY.quantile("rbg_reconcile_duration_seconds", 0.99, controller=c)
             for c in ("rolebasedgroup", "roleinstanceset", "roleinstance", "scheduler")
         },
+        "create_phase_profile": create_prof.result,
     }
     return report
 
@@ -199,6 +206,10 @@ def write_html_report(report: dict, path: str) -> None:
     rec = "".join(
         f"<tr><td>{c}</td><td>{v}</td></tr>"
         for c, v in (report.get("reconcile_p99_s") or {}).items())
+    prof = report.get("create_phase_profile") or {}
+    prof_rows = "".join(
+        f"<tr><td>{t['site']}</td><td>{t['samples']}</td></tr>"
+        for t in prof.get("top", [])[:15])
     html = f"""<!doctype html><html><head><meta charset="utf-8">
 <title>rbg-tpu stress report</title>
 <style>body{{font-family:sans-serif;margin:2rem}}table{{border-collapse:collapse}}
@@ -210,6 +221,9 @@ th{{background:#eee}}td:first-child{{text-align:left}}</style></head><body>
 <th>max</th><th>n</th></tr>{"".join(rows)}</table>
 <h2>reconcile p99 (s)</h2>
 <table><tr><th>controller</th><th>p99</th></tr>{rec}</table>
+<h2>create-phase CPU profile (top sample sites,
+{prof.get("samples", 0)} samples)</h2>
+<table><tr><th>site</th><th>samples</th></tr>{prof_rows}</table>
 </body></html>"""
     with open(path, "w") as f:
         f.write(html)

@@ -29,7 +29,8 @@ TARGET_TOKENS_PER_SEC = 2000.0
 
 BATCH = 8
 PROMPT_LEN = 128
-DECODE_STEPS = 64
+DECODE_TOKENS_PER_REP = 64   # decode tokens per sequence per timed rep
+MULTI_STEP = 8               # device-side decode window (EngineConfig.multi_step)
 REPS = 5
 PROBE_TIMEOUT_S = 240
 
@@ -94,12 +95,13 @@ def main():
         num_pages=4096 if on_tpu else 512,
         max_batch=BATCH, max_seq_len=2048 if on_tpu else 512,
         prefill_chunk=PROMPT_LEN, enable_radix_cache=False,
-        decode_buckets=(BATCH,),
+        decode_buckets=(BATCH,), multi_step=MULTI_STEP,
     )
     eng = Engine(cfg)
+    steps_per_rep = DECODE_TOKENS_PER_REP // MULTI_STEP
     rng = np.random.RandomState(0)
     vocab = cfg.model_config.vocab_size
-    max_new = REPS * DECODE_STEPS + 16
+    max_new = REPS * DECODE_TOKENS_PER_REP + 4 * MULTI_STEP + 8
     prompts = [rng.randint(0, vocab, size=PROMPT_LEN).tolist() for _ in range(BATCH)]
 
     # Warm-up: admit + prefill everything, compile decode bucket, settle.
@@ -114,7 +116,7 @@ def main():
     for _ in range(REPS):
         start_tokens = eng.metrics["decode_tokens"]
         t0 = time.perf_counter()
-        for _ in range(DECODE_STEPS):
+        for _ in range(steps_per_rep):
             eng.step()
         elapsed = time.perf_counter() - t0
         tokens = eng.metrics["decode_tokens"] - start_tokens
@@ -136,7 +138,8 @@ def main():
         "vs_baseline": round(tps / TARGET_TOKENS_PER_SEC, 4),
         "mfu_est": mfu,
         "runs_tps": [round(r, 1) for r in runs],
-        "spread_pct": round(100.0 * (max(runs) - min(runs)) / tps, 1),
+        "spread_pct": (round(100.0 * (max(runs) - min(runs)) / tps, 1)
+                       if tps else None),
     }
     if probe is not None and not probe.get("ok"):
         out["tpu_probe"] = probe

@@ -18,6 +18,13 @@ class EngineConfig:
     prefill_chunk: int = 64                 # chunked-prefill bucket
     decode_buckets: Tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
     enable_radix_cache: bool = True
+    # Decode steps fused into ONE device dispatch (lax.scan window) — the
+    # JetStream-style device-side decode loop. Each window samples K tokens
+    # per sequence before control returns to the host, amortizing dispatch
+    # overhead K-fold; tokens stream out in bursts of K (ITL burstiness is
+    # the price, throughput the prize). Stop-token checks still happen
+    # host-side, so up to K-1 speculative KV writes are discarded on stop.
+    multi_step: int = 1
     use_pallas: str = "auto"                # auto | always | never
     mode: str = "unified"                   # unified | prefill | decode
     mesh_spec: Optional[dict] = None        # {"dp": 1, "tp": 4} — from discovery
@@ -38,6 +45,8 @@ class EngineConfig:
             raise ValueError("max_batch exceeds largest decode bucket")
         if self.num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        if self.multi_step < 1:
+            raise ValueError("multi_step must be >= 1")
         if self.kv_dtype not in ("model", "int8"):
             raise ValueError(f"kv_dtype {self.kv_dtype!r} not in (model, int8)")
         if self.kv_dtype == "int8" and self.mode != "unified":

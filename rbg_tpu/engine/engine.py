@@ -102,6 +102,12 @@ class Engine:
         self.requests: Dict[int, Request] = {}
         self._fwd_cache: Dict[Tuple[int, int], object] = {}
         self._sampler = jax.jit(sample)
+        # Fused decode path: device-resident (tok, pos, kvl, table, …) state
+        # plus a one-step emission lag so host bookkeeping for step N+1
+        # overlaps the device computing step N (see _decode_step).
+        self._dec: Optional[dict] = None
+        self._dec_key = jax.random.key(cfg.seed + 2)
+        self._dec_fn_cache: Dict[int, object] = {}
         self.metrics = {"steps": 0, "decode_tokens": 0, "prefill_tokens": 0,
                         "radix_hit_tokens": 0, "preemptions": 0}
 
@@ -254,56 +260,219 @@ class Engine:
 
     # ---- decode ----
 
-    def _decode_step(self) -> List[StepEvent]:
-        batch = [r for r in self.running if r.state == "running"]
-        if not batch:
+    def _pending_counts(self) -> Dict[int, int]:
+        """id(req) → number of un-emitted tokens awaiting fetch."""
+        if self._dec is None or self._dec["pending"] is None:
+            return {}
+        rows, _, valid = self._dec["pending"]
+        return {id(r): v for r, v in zip(rows, valid)}
+
+    def _decode_batch(self) -> List[Request]:
+        """Running requests worth dispatching. Rows whose length budget is
+        already consumed by pending (un-emitted) tokens are excluded: they
+        can only finish, and dispatching them would write KV tokens past
+        prompt+max_new_tokens — potentially past max_seq_len."""
+        pend = self._pending_counts()
+        out = []
+        for r in self.running:
+            if r.state != "running":
+                continue
+            if len(r.output) + pend.get(id(r), 0) >= r.sampling.max_new_tokens:
+                continue
+            out.append(r)
+        return out
+
+    def _emit_pending(self, pending) -> List[StepEvent]:
+        rows, toks_dev, valid = pending
+        vals = np.asarray(toks_dev)          # [K, B] — the one host sync
+        events = []
+        for i, req in enumerate(rows):
+            for k in range(valid[i]):
+                if req.state != "running":
+                    break                    # stop token cut the window short
+                self.metrics["decode_tokens"] += 1
+                events.append(self._emit(req, int(vals[k, i])))
+        return events
+
+    def _drain_decode(self) -> List[StepEvent]:
+        """Fetch + emit the pending decode tokens and discard the device
+        state (forcing a rebuild). Called whenever the decode batch
+        composition changes, or before preemption releases pages that host
+        bookkeeping must observe consistently."""
+        st, self._dec = self._dec, None
+        if st is None or st["pending"] is None:
             return []
-        # Ensure a page exists for each sequence's next position; preempt the
+        return self._emit_pending(st["pending"])
+
+    def _get_decode_fn(self, B: int):
+        """One fused jitted program per decode bucket: a lax.scan window of
+        ``multi_step`` iterations, each = forward + on-device sampling +
+        PRNG split + position/length increment, with the sampled token fed
+        straight back as the next iteration's input. Steady state does ZERO
+        host→device transfers per window and one device→host fetch (the
+        [K, B] token ids, one window late)."""
+        fn = self._dec_fn_cache.get(B)
+        if fn is not None:
+            return fn
+        import functools
+        base = functools.partial(forward_paged, cfg=self.mcfg,
+                                 use_pallas=self.cfg.use_pallas)
+        K = self.cfg.multi_step
+
+        def fused(params, tok, pos, kvl, table, mask, limit, k_pages,
+                  v_pages, k_scales, v_scales, key, temps, ks):
+            def body(carry, _):
+                tok, pos, kvl, kp, vp, ksc, vsc, key = carry
+                # Rows at their length limit (mid-window finishers) stop
+                # writing KV and stop advancing — their sampled values are
+                # discarded host-side via the per-row valid count.
+                write_ok = mask & (pos < limit)[:, None]    # [B, 1]
+                logits, kp, vp, ksc, vsc = base(
+                    params, tokens=tok[:, None], positions=pos[:, None],
+                    token_mask=write_ok, kv_lens=kvl, page_table=table,
+                    k_pages=kp, v_pages=vp, k_scales=ksc, v_scales=vsc)
+                key, sub = jax.random.split(key)
+                toks = sample(logits[:, 0, :], sub, temps, ks)
+                active = write_ok[:, 0]
+                pos = jnp.where(active, pos + 1, pos)
+                kvl = jnp.where(active, kvl + 1, kvl)
+                tok = jnp.where(active, toks, tok)
+                return (tok, pos, kvl, kp, vp, ksc, vsc, key), toks
+
+            carry, toks_seq = jax.lax.scan(
+                body, (tok, pos, kvl, k_pages, v_pages, k_scales, v_scales,
+                       key), None, length=K)
+            tok, pos, kvl, kp, vp, ksc, vsc, key = carry
+            return toks_seq, tok, pos, kvl, kp, vp, ksc, vsc, key
+
+        # tok is NOT donated: the pending fetch reads last window's output
+        # after it has been fed back as this window's input.
+        donate = [2, 3, 11]  # pos, kvl, key
+        donate += [7, 8, 9, 10] if self.cache.quantized else [7, 8]
+        fn = jax.jit(fused, donate_argnums=tuple(donate))
+        self._dec_fn_cache[B] = fn
+        return fn
+
+    def _build_decode_state(self, batch: List[Request]) -> dict:
+        B = self._bucket(len(batch))
+        P = self.cfg.max_pages_per_seq
+        tok = np.zeros(B, np.int32)
+        pos = np.zeros(B, np.int32)
+        kvl = np.zeros(B, np.int32)
+        mask = np.zeros((B, 1), bool)
+        limit = np.zeros(B, np.int32)
+        temps = np.zeros(B, np.float32)
+        ks = np.zeros(B, np.int32)
+        table = np.zeros((B, P), np.int32)
+        for i, r in enumerate(batch):
+            tok[i] = r.last_token
+            pos[i] = r.seq_len
+            kvl[i] = r.seq_len + 1
+            mask[i, 0] = True
+            limit[i] = r.max_len()
+            temps[i] = r.sampling.temperature
+            ks[i] = r.sampling.top_k
+            table[i, :len(r.pages)] = r.pages
+        return {
+            "rows": list(batch), "B": B,
+            "tok": jnp.asarray(tok), "pos": jnp.asarray(pos),
+            "kvl": jnp.asarray(kvl), "mask": jnp.asarray(mask),
+            "limit": jnp.asarray(limit),
+            "temps": jnp.asarray(temps), "ks": jnp.asarray(ks),
+            "table_np": table, "table": jnp.asarray(table),
+            "pending": None,
+        }
+
+    def _decode_step(self) -> List[StepEvent]:
+        events: List[StepEvent] = []
+        batch = self._decode_batch()
+        st = self._dec
+        if st is not None and st["rows"] != batch:
+            events.extend(self._drain_decode())
+            st = None
+            batch = self._decode_batch()
+        if not batch:
+            events.extend(self._drain_decode())
+            return events
+
+        # Ensure pages exist for the whole decode window; preempt the
         # youngest requests on exhaustion. Oldest-first so old requests
         # finish and release memory (deadlock-free under oversubscription).
+        K = self.cfg.multi_step
+        pages_changed = False
         for req in sorted(batch, key=lambda r: r.t_submit):
             if req.state != "running":
                 continue  # preempted earlier in this very loop
-            need = pages_for_tokens(req.seq_len + 1, self.cfg.page_size) - len(req.pages)
+            horizon = min(req.seq_len + K, req.max_len())
+            need = pages_for_tokens(horizon, self.cfg.page_size) - len(req.pages)
             if need > 0:
                 extra = self._alloc(need)
                 while extra is None:
+                    # Emit in-flight tokens before any pages are released:
+                    # a preempted request must not receive a stale token
+                    # (and an emitted finish may free enough on its own).
+                    events.extend(self._drain_decode())
+                    st = None
+                    if req.state != "running":
+                        break  # the drain just finished THIS request
+                    extra = self._alloc(need)
+                    if extra is not None:
+                        break
                     victim = self._preempt_youngest(exclude=req)
                     if victim is None:
                         break
                     extra = self._alloc(need)
+                if req.state != "running":
+                    # Finished by a pending stop token emitted in the drain:
+                    # its pages are already released — growing or preempting
+                    # it now would leak pages / resurrect a finished stream.
+                    if extra:
+                        self.allocator.release(extra)
+                    continue
                 if extra is None:
+                    events.extend(self._drain_decode())
+                    st = None
+                    if req.state != "running":
+                        continue
                     self._preempt(req)
                     continue
                 req.pages.extend(extra)
-        batch = [r for r in self.running if r.state == "running"]
+                pages_changed = True
+        batch2 = self._decode_batch()
+        if batch2 != batch:
+            if st is not None:
+                events.extend(self._drain_decode())
+                st = None
+            batch = batch2
         if not batch:
-            return []
+            return events
 
-        B = self._bucket(len(batch))
-        logits = self._run(
-            tokens=[[r.last_token] for r in batch],
-            positions=[[r.seq_len] for r in batch],
-            lens=[r.seq_len + 1 for r in batch],
-            pages=[r.pages for r in batch],
-            T_bucket=1, B_bucket=B,
-        )
-        self.metrics["decode_tokens"] += len(batch)
+        if st is None:
+            st = self._dec = self._build_decode_state(batch)
+        elif pages_changed:
+            for i, r in enumerate(batch):
+                row = st["table_np"][i]
+                row[:len(r.pages)] = r.pages
+                row[len(r.pages):] = 0
+            st["table"] = jnp.asarray(st["table_np"])
 
-        events = []
-        temps = np.array([r.sampling.temperature for r in batch], np.float32)
-        ks = np.array([r.sampling.top_k for r in batch], np.int32)
-        self._sample_key, sub = jax.random.split(self._sample_key)
-        padded_t = np.zeros(B, np.float32)
-        padded_k = np.zeros(B, np.int32)
-        padded_t[: len(batch)] = temps
-        padded_k[: len(batch)] = ks
-        # Sample on device; only the [B] token ids cross to host.
-        toks = np.asarray(self._sampler(logits[:, 0, :], sub,
-                                        jnp.asarray(padded_t), jnp.asarray(padded_k)))
-        for i, req in enumerate(batch):
-            req.seq_len += 1
-            events.append(self._emit(req, int(toks[i])))
+        fn = self._get_decode_fn(st["B"])
+        toks_seq, tok, pos, kvl, kp, vp, ksc, vsc, self._dec_key = fn(
+            self.params, st["tok"], st["pos"], st["kvl"], st["table"],
+            st["mask"], st["limit"], self.cache.k_pages, self.cache.v_pages,
+            self.cache.k_scales, self.cache.v_scales,
+            self._dec_key, st["temps"], st["ks"])
+        self.cache = PagedKVCache(k_pages=kp, v_pages=vp,
+                                  k_scales=ksc, v_scales=vsc)
+        st["tok"], st["pos"], st["kvl"] = tok, pos, kvl
+        valid = []
+        for req in batch:
+            valid.append(min(K, req.max_len() - req.seq_len))
+            req.seq_len = min(req.seq_len + K, req.max_len())
+
+        prev, st["pending"] = st["pending"], (list(batch), toks_seq, valid)
+        if prev is not None:
+            events.extend(self._emit_pending(prev))
         return events
 
     def _emit(self, req: Request, tok: int) -> StepEvent:

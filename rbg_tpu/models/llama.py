@@ -268,27 +268,38 @@ def forward_paged(
     x = params["embed"].astype(cfg.jax_dtype)[tokens]
     quantized = k_scales is not None
 
-    def step(carry, xs):
-        hcur = carry
-        if quantized:
-            blk, kp, vp, ks, vs = xs
-        else:
-            blk, kp, vp = xs
-            ks = vs = None
-        q, k, vv = _qkv(cfg, blk, hcur, positions)
-        kp, vp, ks, vs = write_kv_pages(kp, vp, k, vv, page_table, positions,
-                                        token_mask, ks, vs)
-        attn = paged_attention(q, kp, vp, page_table, positions, kv_lens,
-                               use_pallas=use_pallas, k_scales=ks, v_scales=vs)
-        out = _post_attention(cfg, blk, hcur, attn)
-        return out, ((kp, vp, ks, vs) if quantized else (kp, vp))
+    # The pool rides the layer scan as CARRY over a [L·NP, …] flat view,
+    # with each layer addressing its pages as ``layer·NP + page_table``.
+    # Making the pool a per-layer scan INPUT/OUTPUT instead (stacked ys)
+    # would copy the entire pool every step — the layer-slice stacking is a
+    # full-pool write even though only [B·T] slots changed. In-place carry
+    # scatter keeps the per-step KV traffic at the written slots only.
+    L_, NP = k_pages.shape[0], k_pages.shape[1]
+    flat = lambda p: p.reshape((L_ * NP,) + p.shape[2:])
+    kpf, vpf = flat(k_pages), flat(v_pages)
+    ksf = flat(k_scales) if quantized else None
+    vsf = flat(v_scales) if quantized else None
 
+    def step(carry, xs):
+        hcur, kpf, vpf, ksf, vsf = carry
+        blk, li = xs
+        table = page_table + li * NP
+        q, k, vv = _qkv(cfg, blk, hcur, positions)
+        kpf, vpf, ksf, vsf = write_kv_pages(kpf, vpf, k, vv, table, positions,
+                                            token_mask, ksf, vsf)
+        attn = paged_attention(q, kpf, vpf, table, positions, kv_lens,
+                               use_pallas=use_pallas, k_scales=ksf,
+                               v_scales=vsf)
+        out = _post_attention(cfg, blk, hcur, attn)
+        return (out, kpf, vpf, ksf, vsf), None
+
+    (x, kpf, vpf, ksf, vsf), _ = jax.lax.scan(
+        step, (x, kpf, vpf, ksf, vsf),
+        (params["blocks"], jnp.arange(L_, dtype=jnp.int32)))
+    k_pages, v_pages = kpf.reshape(k_pages.shape), vpf.reshape(v_pages.shape)
     if quantized:
-        xs = (params["blocks"], k_pages, v_pages, k_scales, v_scales)
-        x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(step, x, xs)
-    else:
-        x, (k_pages, v_pages) = jax.lax.scan(
-            step, x, (params["blocks"], k_pages, v_pages))
+        k_scales = ksf.reshape(k_scales.shape)
+        v_scales = vsf.reshape(v_scales.shape)
     return _head(params, cfg, x), k_pages, v_pages, k_scales, v_scales
 
 

@@ -98,12 +98,14 @@ class RoleInstanceController(Controller):
                     if node is not None:
                         self.node_binding.record(p, node)
                         if node.tpu.slice_id and inst.status.slice_id != node.tpu.slice_id:
-                            store.mutate(
+                            # Continue the reconcile with the fresh stored
+                            # snapshot — `inst` was fetched copy_=False and
+                            # stored snapshots are never mutated in place.
+                            inst = store.mutate(
                                 "RoleInstance", ns, name,
                                 lambda i, s=node.tpu.slice_id: setattr(i.status, "slice_id", s) or True,
                                 status=True,
                             )
-                            inst.status.slice_id = node.tpu.slice_id
 
         # ---- restart policy state machine (reference: §3.5) ----
         res = self._handle_restarts(store, inst, pods, desired)

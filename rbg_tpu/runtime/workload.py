@@ -222,16 +222,18 @@ class InstanceSetBackend(WorkloadBackend):
             # child controller hasn't observed the latest spec — keep
             # last-known status (anti-flicker)
             return prev
-        if (prev is not None
-                and ris.metadata.labels.get(C.role_revision_label(role.name))
+        if (ris.metadata.labels.get(C.role_revision_label(role.name))
                 != role_hash):
             # The RIS hasn't RECEIVED the new template yet (the group
             # reconcile pushes it after statuses): claiming the new
             # observed_revision now would make the group look "ready at the
             # new revision" for a window before any pod moved — fleet-level
             # rollout staging (GroupSet max_unavailable) would tear through
-            # every cell inside that window.
-            return prev
+            # every cell inside that window. With no prev to fall back on
+            # (e.g. an external backend's default rollout_progress passes
+            # prev=None), report empty rather than stamping role_hash onto
+            # the OLD revision's counters.
+            return prev if prev is not None else RoleStatus(name=role.name)
         ris_ready = get_condition(ris.status.conditions, C.COND_READY)
         return RoleStatus(
             name=role.name,

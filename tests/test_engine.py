@@ -207,3 +207,98 @@ def test_int8_kv_rejected_for_pd_modes():
 def test_int8_kv_rejects_pallas_always():
     with pytest.raises(ValueError, match="dequantize"):
         EngineConfig(model="tiny", kv_dtype="int8", use_pallas="always").validate()
+
+
+# ---- multi-step (device-side decode window, EngineConfig.multi_step) ----
+
+
+def test_multistep_matches_single_step_greedy(tiny_setup):
+    """A K-step scan window must produce the exact single-step token stream
+    (same forward, same greedy argmax — only dispatch granularity differs)."""
+    cfg, params = tiny_setup
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, cfg.vocab_size, size=n).tolist()
+               for n in (4, 19, 11)]
+    expect = [ref_greedy(params, cfg, p, steps=12) for p in prompts]
+    for k in (2, 4, 5):
+        eng = make_engine(params, radix=False, multi_step=k)
+        got = eng.generate(prompts, SamplingParams(max_new_tokens=12))
+        assert got == expect, f"multi_step={k}"
+
+
+def test_multistep_stop_token_mid_window(tiny_setup):
+    """A stop token landing mid-window cuts emission at the stop; the
+    window's speculative tail is discarded and pages are reclaimed."""
+    cfg, params = tiny_setup
+    prompt = [2, 4, 6]
+    expect = ref_greedy(params, cfg, prompt, steps=10)
+    stop = expect[2]
+    eng = make_engine(params, radix=False, multi_step=4)
+    free0 = eng.allocator.free_pages
+    got = eng.generate([prompt], SamplingParams(max_new_tokens=10,
+                                                stop_token=stop))[0]
+    assert got == expect[:3]
+    assert eng.allocator.free_pages == free0
+
+
+def test_multistep_uneven_lengths_finish_correctly(tiny_setup):
+    """Rows whose max_new_tokens is not a multiple of the window, or less
+    than one window, emit exactly their budget."""
+    cfg, params = tiny_setup
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, cfg.vocab_size, size=6).tolist()
+               for _ in range(3)]
+    eng = make_engine(params, radix=False, multi_step=4)
+    ids = [eng.add_request(p, SamplingParams(max_new_tokens=m))
+           for p, m in zip(prompts, (2, 7, 9))]
+    outputs = {i: [] for i in ids}
+    while eng.has_work():
+        for ev in eng.step():
+            outputs[ev.request_id].append(ev.token)
+    assert [len(outputs[i]) for i in ids] == [2, 7, 9]
+    expect = [ref_greedy(params, cfg, p, steps=m)
+              for p, m in zip(prompts, (2, 7, 9))]
+    assert [outputs[i] for i in ids] == expect
+
+
+def test_multistep_preemption_under_pressure(tiny_setup):
+    """Page exhaustion with a multi-step window still preempts + resumes
+    without corrupting any stream."""
+    cfg, params = tiny_setup
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(0, cfg.vocab_size, size=24).tolist()
+               for _ in range(4)]
+    expect = [ref_greedy(params, cfg, p, steps=16) for p in prompts]
+    eng = make_engine(params, radix=False, num_pages=18, multi_step=3)
+    got = eng.generate(prompts, SamplingParams(max_new_tokens=16))
+    assert got == expect
+    assert eng.metrics["preemptions"] > 0
+
+
+def test_multistep_stop_plus_page_pressure_no_leak(tiny_setup):
+    """A pending stop token emitted by the alloc-retry drain finishes the
+    very request being grown — its freshly allocated pages must return to
+    the allocator, and the finished stream must not be resurrected."""
+    cfg, params = tiny_setup
+    rng = np.random.RandomState(11)
+    stopper = [2, 4, 6]
+    expect = ref_greedy(params, cfg, stopper, steps=12)
+    stop = expect[2]  # lands mid-window
+    growers = [rng.randint(0, cfg.vocab_size, size=20).tolist()
+               for _ in range(3)]
+    eng = make_engine(params, radix=False, num_pages=15, multi_step=4)
+    free0 = eng.allocator.free_pages
+    ids = [eng.add_request(stopper, SamplingParams(max_new_tokens=12,
+                                                   stop_token=stop))]
+    ids += [eng.add_request(p, SamplingParams(max_new_tokens=12))
+            for p in growers]
+    outputs = {i: [] for i in ids}
+    finished = set()
+    while eng.has_work():
+        for ev in eng.step():
+            outputs[ev.request_id].append(ev.token)
+            if ev.finished:
+                assert ev.request_id not in finished, "stream resurrected"
+                finished.add(ev.request_id)
+    assert outputs[ids[0]] == expect[:3]
+    assert eng.allocator.free_pages == free0, "page leak"

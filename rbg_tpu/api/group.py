@@ -15,6 +15,7 @@ import dataclasses
 import enum
 from typing import Dict, List, Optional
 
+from rbg_tpu.api.intstr import IntOrStr
 from rbg_tpu.api.meta import Condition, ObjectMeta
 from rbg_tpu.api.pod import PodTemplate
 
@@ -123,10 +124,13 @@ class RestartPolicyConfig:
 @dataclasses.dataclass
 class RollingUpdate:
     """Rolling update knobs (reference: RIS update strategy,
-    ``roleinstanceset_reconciler.go:231-252``)."""
+    ``roleinstanceset_reconciler.go:231-252``). ``max_unavailable`` and
+    ``max_surge`` accept an int or a percent string ("25%"), scaled
+    against role replicas with K8s rounding (surge up, unavailable down —
+    ``api/intstr.py``; reference ``sts_reconciler.go:198-449``)."""
 
-    max_unavailable: int = 1
-    max_surge: int = 0
+    max_unavailable: IntOrStr = 1
+    max_surge: IntOrStr = 0
     partition: int = 0
     in_place_if_possible: bool = True
     # Freeze rollout progress mid-flight; existing surge is preserved
@@ -272,13 +276,14 @@ class GroupTemplate:
 class RoleBasedGroupSetSpec:
     replicas: int = 1
     template: GroupTemplate = dataclasses.field(default_factory=GroupTemplate)
-    # Fleet rollout staging: at most this many child groups may be
-    # unavailable (not Ready) at once while template changes propagate.
+    # Fleet rollout staging: at most this many child groups (int or
+    # percent of replicas, rounded down) may be unavailable (not Ready) at
+    # once while template changes propagate.
     # <=0 = unbounded (update every drifted group simultaneously — the
     # reference's behavior, ``rolebasedgroupset_controller.go:168-177``);
     # the default of 1 rolls the fleet one cell at a time, each cell's own
     # rolling-update machinery staging its pods in turn.
-    max_unavailable: int = 1
+    max_unavailable: IntOrStr = 1
 
 
 @dataclasses.dataclass

@@ -22,8 +22,14 @@ def _type_schema(tp: Any, defs: dict) -> dict:
         return {}
     if origin is typing.Union:
         args = [a for a in get_args(tp) if a is not type(None)]
-        inner = _type_schema(args[0], defs)
-        return inner  # Optionals: absence is allowed; null not serialized
+        if len(args) == 1:
+            # Optionals: absence is allowed; null not serialized
+            return _type_schema(args[0], defs)
+        if set(args) == {int, str}:
+            # IntOrString (rolling-update knobs): int or "25%".
+            return {"oneOf": [{"type": "integer"},
+                              {"type": "string", "pattern": r"^\d+%$"}]}
+        return {"oneOf": [_type_schema(a, defs) for a in args]}
     if origin in (list, tuple):
         (elem,) = get_args(tp) or (Any,)
         return {"type": "array", "items": _type_schema(elem, defs)}

@@ -85,7 +85,16 @@ def _build(tp: Any, data: Any, path: str, lenient: bool = False) -> Any:
         args = [a for a in get_args(tp) if a is not type(None)]
         if data is None:
             return None
-        return _build(args[0], data, path, lenient)
+        if len(args) == 1:
+            return _build(args[0], data, path, lenient)
+        # Multi-arm unions (IntOrString): first arm that accepts the data.
+        last_err: Exception = TypeError(f"{path}: no union arm matched")
+        for arm in args:
+            try:
+                return _build(arm, data, path, lenient)
+            except (TypeError, ValueError, KeyError) as e:
+                last_err = e
+        raise last_err
     if origin in (list, tuple):
         if not isinstance(data, list):
             raise TypeError(f"{path}: expected list, got {type(data).__name__}")

@@ -53,6 +53,13 @@ def validate_group(rbg: RoleBasedGroup) -> None:
         if role.tpu and role.tpu.slice_topology:
             if not re.match(r"^\d+(x\d+)*$", role.tpu.slice_topology):
                 errs.append(f"{path}.tpu.sliceTopology {role.tpu.slice_topology!r} invalid")
+        from rbg_tpu.api import intstr
+        for knob in ("max_unavailable", "max_surge"):
+            try:
+                intstr.validate(getattr(role.rolling_update, knob),
+                                f"{path}.rollingUpdate.{knob}")
+            except ValueError as e:
+                errs.append(str(e))
     if not rbg.spec.roles:
         errs.append("spec.roles must not be empty")
     # cycle check

@@ -29,7 +29,14 @@ def register(sub) -> None:
 
     sp = sub.add_parser("serve", help="run a persistent plane with an admin API")
     sp.add_argument("-f", "--file", help="initial manifests to apply")
-    sp.add_argument("--backend", default="local", choices=["fake", "local"])
+    sp.add_argument("--backend", default="local",
+                    choices=["fake", "local", "k8s"])
+    sp.add_argument("--kube-api", default="",
+                    help="K8s API server URL (backend=k8s); e.g. "
+                         "https://10.0.0.1:443 or the fake server's URL")
+    sp.add_argument("--kube-token-file", default="",
+                    help="bearer token file for --kube-api (default: the "
+                         "in-cluster serviceaccount token path if present)")
     sp.add_argument("--slices", type=int, default=2)
     sp.add_argument("--hosts", type=int, default=2)
     sp.add_argument("--admin-port", type=int, default=7070)
@@ -172,7 +179,30 @@ def cmd_serve(args) -> int:
     import json as _json
     import os as _os
 
-    plane = ControlPlane(backend=args.backend)
+    k8s_client = None
+    if args.backend == "k8s":
+        from rbg_tpu.k8s.client import KubeClient
+        if not args.kube_api:
+            print("--backend k8s requires --kube-api", file=sys.stderr)
+            return 2
+        token = ""
+        if args.kube_token_file:
+            # Explicitly named file must exist — a typo must not silently
+            # downgrade to unauthenticated requests.
+            if not _os.path.exists(args.kube_token_file):
+                print(f"--kube-token-file {args.kube_token_file}: not found",
+                      file=sys.stderr)
+                return 2
+            with open(args.kube_token_file) as f:
+                token = f.read().strip()
+        else:
+            # The implicit in-cluster serviceaccount path is best-effort.
+            default_path = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+            if _os.path.exists(default_path):
+                with open(default_path) as f:
+                    token = f.read().strip()
+        k8s_client = KubeClient(args.kube_api, token=token)
+    plane = ControlPlane(backend=args.backend, k8s_client=k8s_client)
     restored = 0
     if args.state_file and _os.path.exists(args.state_file):
         with open(args.state_file) as f:
@@ -182,11 +212,12 @@ def cmd_serve(args) -> int:
         if args.backend == "fake":
             make_tpu_nodes(plane.store, slices=args.slices,
                            hosts_per_slice=args.hosts)
-        else:
+        elif args.backend == "local":
             from rbg_tpu.api.pod import Node
             node = Node()
             node.metadata.name = "localhost"
             plane.store.create(node)
+        # backend=k8s: nodes sync from the cluster at backend start.
     plane.start()
     token = args.admin_token
     if token is None:

@@ -1,0 +1,29 @@
+"""Kubernetes pod backend — realize plane Pods as real Kubernetes Pods.
+
+The reference is entirely a K8s operator (``cmd/rbgs/main.go:126``,
+``pkg/reconciler/pod_reconciler.go:64-390``): its pods ARE Kubernetes pods.
+This plane keeps its own store and scheduler (slice-aware gang placement the
+kube-scheduler cannot do), and this package is the third backend behind the
+kubelet seam (``rbg_tpu/runtime/plane.py``): it mirrors plane Pods to a real
+(or in-repo fake) Kubernetes API server as GKE-TPU-shaped Pods and reflects
+their live status back into the plane store.
+
+Pieces:
+
+* ``client``  — minimal K8s REST client (urllib/http.client, token auth,
+  resourceVersion-aware CRUD + JSON-lines watch).
+* ``translate`` — plane Pod ↔ K8s Pod JSON (``google.com/tpu`` resources,
+  ``cloud.google.com/gke-tpu-*`` selectors, slice-binding → nodeAffinity),
+  plane Node ↔ K8s Node (TPU labels).
+* ``backend`` — ``K8sPodBackend``: the kubelet-seam implementation.
+* ``fake_apiserver`` — in-repo fake of the K8s REST semantics (CRUD +
+  resourceVersion conflicts + watch + a kwok-style node agent) for tests:
+  no cluster exists in this environment (SURVEY.md §4 envtest analog).
+"""
+
+from rbg_tpu.k8s.backend import K8sPodBackend
+from rbg_tpu.k8s.client import ApiError, Conflict, KubeClient, NotFound
+from rbg_tpu.k8s.fake_apiserver import FakeK8sApiServer
+
+__all__ = ["K8sPodBackend", "KubeClient", "FakeK8sApiServer",
+           "ApiError", "Conflict", "NotFound"]

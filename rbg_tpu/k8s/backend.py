@@ -1,0 +1,364 @@
+"""K8sPodBackend — the kubelet-seam implementation that realizes plane Pods
+as real Kubernetes Pods.
+
+Division of labor (deliberately different from the reference, which IS a
+K8s controller): the plane keeps its own store, controllers, and the
+slice-aware gang scheduler; this backend is a *mirror* at the pod boundary —
+the one object kind whose lifecycle a cluster must own. Reference analog
+for what gets mirrored: ``pkg/reconciler/pod_reconciler.go:64-390`` (pod
+construction) + the kubelet itself (status).
+
+Flow:
+
+* plane Pod scheduled (``node_name`` set)  → CREATE mirrored K8s Pod
+  (GKE TPU shape, ``translate.to_k8s_pod``)
+* plane in-place image update             → PATCH K8s containers (the only
+  mutable pod field, matching ``pkg/inplace`` semantics)
+* plane graceful delete                   → DELETE K8s pod; plane-side
+  ``finalize_delete`` happens when the cluster confirms the pod is gone
+* K8s pod status                          → reflected into plane
+  ``pod.status`` (phase/ready/IP/restarts + in-place ack)
+* K8s pod deleted out-of-band             → plane pod marked Failed
+  (reason ``Deleted``) so the restart engine replaces it
+* K8s TPU nodes                           → synced into plane Nodes at
+  startup (labels → TpuNodeInfo) so the scheduler places on real capacity
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional, Tuple
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.k8s import translate as T
+from rbg_tpu.k8s.client import ApiError, Conflict, KubeClient, NotFound
+from rbg_tpu.runtime.store import Event, Store
+from rbg_tpu.runtime.store import Conflict as StoreConflict
+from rbg_tpu.runtime.store import NotFound as StoreNotFound
+
+log = logging.getLogger("rbg_tpu.k8s")
+
+_SELECTOR = f"{T.LABEL_MANAGED_BY}={T.MANAGED_BY}"
+
+
+class K8sPodBackend:
+    def __init__(self, store: Store, client: KubeClient,
+                 sync_nodes: bool = True):
+        self.store = store
+        self.client = client
+        self.sync_nodes = sync_nodes
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        # Desired-state dirty set: plane pod keys needing a sync against
+        # the cluster. The worker drains it with retries so a flaky API
+        # server never loses an operation (watch callbacks must not block).
+        self._dirty: Dict[Tuple[str, str], bool] = {}
+        self._lock = threading.Lock()
+        # Last-known mirrored spec images, to detect in-place patches.
+        self._mirrored_images: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self._threads: list = []
+
+    # ---- kubelet contract ----
+
+    def start(self):
+        if self.sync_nodes:
+            self._sync_nodes()
+        self.store.watch("Pod", self._on_event)
+        for pod in self.store.list("Pod"):
+            self._mark(pod.metadata.namespace, pod.metadata.name)
+        self._adopt_orphans()
+        for name, target in (("k8s-sync", self._sync_loop),
+                             ("k8s-reflect", self._reflect_loop)):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # ---- plane → cluster ----
+
+    def _on_event(self, ev: Event):
+        pod = ev.object
+        self._mark(pod.metadata.namespace, pod.metadata.name)
+
+    def _mark(self, ns: str, name: str):
+        with self._lock:
+            self._dirty[(ns, name)] = True
+        self._wake.set()
+
+    def _sync_loop(self):
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            with self._lock:
+                keys = list(self._dirty)
+                self._dirty.clear()
+            for ns, name in keys:
+                try:
+                    self._sync_one(ns, name)
+                except (ApiError, StoreConflict) as e:
+                    log.warning("k8s sync %s/%s: %s (requeued)", ns, name, e)
+                    self._mark(ns, name)
+
+    def _sync_one(self, ns: str, name: str):
+        pod = self.store.get("Pod", ns, name, copy_=False)
+        if pod is None:
+            # Plane pod hard-deleted: remove any mirror.
+            self.client.delete_pod(ns, name)
+            self._mirrored_images.pop((ns, name), None)
+            return
+        if pod.metadata.deletion_timestamp is not None:
+            try:
+                self.client.get_pod(ns, name)
+            except NotFound:
+                self._mirrored_images.pop((ns, name), None)
+                try:
+                    self.store.finalize_delete("Pod", ns, name)
+                except (StoreNotFound, StoreConflict):
+                    pass
+                return
+            self.client.delete_pod(ns, name, grace_period_seconds=0)
+            # DELETED arrives on the reflector; finalize then. But the
+            # watch can race a short stream window — requeue a check.
+            self._mark(ns, name)
+            return
+        if not pod.node_name:
+            return  # not scheduled yet — the plane scheduler owns this
+        key = (ns, name)
+        desired = T.desired_images(pod)
+        mirrored = self._mirrored_images.get(key)
+        if mirrored is None:
+            if pod.status.phase in ("Failed", "Succeeded"):
+                # Terminal plane pod with no mirror (e.g. the cluster pod
+                # was deleted out-of-band): never resurrect it — the
+                # restart engine replaces the plane pod itself.
+                return
+            node = self.store.get("Node", "default", pod.node_name,
+                                  copy_=False)
+            body = T.to_k8s_pod(pod, node)
+            try:
+                self.client.create_pod(ns, body)
+            except Conflict:
+                # Exists (resume/adoption): adopt ONLY if the live pod is
+                # this plane pod's own mirror — identity is the plane-uid
+                # annotation, not the name (an older-snapshot resume can
+                # collide with a later incarnation on another node).
+                live = self.client.get_pod(ns, name)
+                live_uid = (live.get("metadata", {}).get("annotations", {})
+                            or {}).get(T.ANN_PLANE_UID)
+                if live_uid != pod.metadata.uid:
+                    self.client.delete_pod(ns, name)
+                    self._mark(ns, name)  # recreate on the next pass
+                    return
+                live_imgs = {c["name"]: c.get("image", "")
+                             for c in live.get("spec", {}).get("containers", [])}
+                self._mirrored_images[key] = live_imgs
+                mirrored = live_imgs
+            else:
+                self._mirrored_images[key] = desired
+                return
+        if mirrored != desired:
+            # In-place update: image-only container patch (the single
+            # mutable field, pkg/inplace inplace_update_defaults.go:76-95).
+            patch = {"spec": {"containers": [
+                {"name": n, "image": img} for n, img in desired.items()
+                if mirrored.get(n) != img]}}
+            self.client.patch_pod(ns, name, patch)
+            self._mirrored_images[key] = desired
+
+    def _adopt_orphans(self):
+        """Delete mirrored pods whose plane pod no longer exists (plane
+        resumed from an older snapshot, or cluster leftovers)."""
+        try:
+            for kpod in self.client.list_pods(label_selector=_SELECTOR):
+                meta = kpod.get("metadata", {})
+                ns, name = meta.get("namespace", ""), meta.get("name", "")
+                pod = self.store.get("Pod", ns, name, copy_=False)
+                live_uid = (meta.get("annotations", {})
+                            or {}).get(T.ANN_PLANE_UID)
+                if pod is None or live_uid != pod.metadata.uid:
+                    # No plane pod, or a different incarnation's mirror.
+                    self.client.delete_pod(ns, name)
+                else:
+                    live_imgs = {c["name"]: c.get("image", "") for c in
+                                 kpod.get("spec", {}).get("containers", [])}
+                    self._mirrored_images[(ns, name)] = live_imgs
+        except ApiError as e:
+            log.warning("k8s orphan scan failed: %s", e)
+
+    # ---- cluster → plane ----
+
+    def _reflect_loop(self):
+        rv = "0"
+        while not self._stop.is_set():
+            try:
+                for ev_type, kpod in self.client.watch_pods(
+                        label_selector=_SELECTOR, resource_version=rv,
+                        timeout_s=5.0):
+                    if ev_type == "ERROR":
+                        # Watch bookmark expired (410 Gone as an event):
+                        # fall back to a full re-list.
+                        rv = self._resync()
+                        break
+                    meta = kpod.get("metadata", {})
+                    rv = meta.get("resourceVersion", rv)
+                    self._reflect(ev_type, kpod)
+                    if self._stop.is_set():
+                        return
+            except ApiError as e:
+                if e.status == 410:
+                    rv = self._resync()
+                else:
+                    log.warning("k8s watch: %s (reconnecting)", e)
+                    self._stop.wait(0.5)
+
+    def _resync(self) -> str:
+        """Full re-list after watch expiry (410 Gone / etcd compaction):
+        reflect every live pod and synthesize DELETED for mirrors that
+        vanished while the watch was dark. Returns the list's rv."""
+        try:
+            live = self.client.list_pods(label_selector=_SELECTOR)
+        except ApiError as e:
+            log.warning("k8s resync list failed: %s", e)
+            return "0"
+        seen = set()
+        max_rv = 0
+        for kpod in live:
+            meta = kpod.get("metadata", {})
+            seen.add((meta.get("namespace", ""), meta.get("name", "")))
+            try:
+                max_rv = max(max_rv, int(meta.get("resourceVersion", 0)))
+            except ValueError:
+                pass
+            self._reflect("MODIFIED", kpod)
+        for key in list(self._mirrored_images):
+            if key not in seen:
+                self._reflect("DELETED", {"metadata": {
+                    "namespace": key[0], "name": key[1]}})
+        return str(max_rv) if max_rv else "0"
+
+    def _reflect(self, ev_type: str, kpod: dict):
+        meta = kpod.get("metadata", {})
+        ns, name = meta.get("namespace", ""), meta.get("name", "")
+        pod = self.store.get("Pod", ns, name, copy_=False)
+        if ev_type == "DELETED":
+            self._mirrored_images.pop((ns, name), None)
+            if pod is None:
+                return
+            if pod.metadata.deletion_timestamp is not None:
+                try:
+                    self.store.finalize_delete("Pod", ns, name)
+                except (StoreNotFound, StoreConflict):
+                    pass
+            else:
+                # Out-of-band deletion (node drain, manual kubectl): the
+                # restart engine must see a dead pod and replace it.
+                self._set_failed(ns, name, reason="Deleted")
+            return
+        if pod is None:
+            return
+        ref = T.reflect_status(kpod)
+        self._apply_status(ns, name, ref)
+
+    def _set_failed(self, ns: str, name: str, reason: str):
+        def fn(p):
+            if p.status.phase in ("Failed", "Succeeded"):
+                return False
+            p.status.phase = "Failed"
+            p.status.ready = False
+            p.status.reason = reason
+            return True
+        try:
+            self.store.mutate("Pod", ns, name, fn, status=True)
+        except (StoreNotFound, StoreConflict):
+            pass
+
+    def _apply_status(self, ns: str, name: str, ref: dict):
+        from rbg_tpu.inplace.update import load_state
+
+        def fn(p):
+            changed = False
+            if p.status.phase != ref["phase"]:
+                p.status.phase = ref["phase"]
+                changed = True
+            ready = ref["ready"] and not ref["deleting"]
+            if p.status.ready != ready:
+                p.status.ready = ready
+                changed = True
+            for field, key in (("pod_ip", "pod_ip"),
+                               ("reason", "reason")):
+                if getattr(p.status, field) != ref[key]:
+                    setattr(p.status, field, ref[key])
+                    changed = True
+            if ref["node_name"] and p.status.node_name != ref["node_name"]:
+                p.status.node_name = ref["node_name"]
+                changed = True
+            if ref["start_time"] and not p.status.start_time:
+                p.status.start_time = ref["start_time"]
+                changed = True
+            total = 0
+            for cname, count in ref["container_restarts"].items():
+                if p.status.container_restarts.get(cname) != count:
+                    p.status.container_restarts[cname] = count
+                    changed = True
+                total += count
+            if ref["container_restarts"] and p.status.restart_count != total:
+                p.status.restart_count = total
+                changed = True
+            # Revision observation: first Running stamps the pod's revision
+            # label; an in-place update is acknowledged once the cluster
+            # reports every patched container RUNNING on its new image
+            # (the FakeKubelet._ack_inplace analog, driven by real status).
+            state = load_state(p)
+            if state and state.get("revision"):
+                wanted = state.get("images") or {}
+                live = ref["running_images"]
+                if (p.status.observed_revision != state["revision"]
+                        and wanted
+                        and all(live.get(n) == img
+                                for n, img in wanted.items())):
+                    p.status.observed_revision = state["revision"]
+                    changed = True
+            elif (ref["phase"] == "Running"
+                  and not p.status.observed_revision):
+                rev = p.metadata.labels.get(C.LABEL_REVISION_NAME, "")
+                if rev:
+                    p.status.observed_revision = rev
+                    changed = True
+            return changed
+
+        try:
+            self.store.mutate("Pod", ns, name, fn, status=True)
+        except (StoreNotFound, StoreConflict):
+            pass
+
+    # ---- node inventory ----
+
+    def _sync_nodes(self):
+        """Import the cluster's TPU nodes as plane Nodes (idempotent): the
+        scheduler then gangs slices onto real capacity. Non-TPU nodes are
+        imported too (router/CPU roles need somewhere to run)."""
+        try:
+            knodes = self.client.list_nodes()
+        except ApiError as e:
+            log.warning("k8s node sync failed: %s", e)
+            return
+        for kn in knodes:
+            node = T.node_from_k8s(kn)
+            if not node.metadata.name:
+                continue
+            cur = self.store.get("Node", "default", node.metadata.name)
+            if cur is None:
+                self.store.create(node)
+            else:
+                node.metadata.resource_version = cur.metadata.resource_version
+                node.metadata.uid = cur.metadata.uid
+                try:
+                    self.store.update(node)
+                except StoreConflict:
+                    pass

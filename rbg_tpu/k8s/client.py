@@ -1,0 +1,168 @@
+"""Minimal Kubernetes REST client.
+
+Speaks the exact wire protocol the fake API server (and a real apiserver)
+serves: JSON bodies, ``resourceVersion`` optimistic concurrency (409 →
+``Conflict``), ``labelSelector`` list filtering, and JSON-lines watch
+streams. Only the surface the pod backend needs — this replaces the
+reference's generated clientset (SURVEY.md §2 #26) the same way
+``api/serde.py`` replaces its deepcopy/apply-configuration machinery.
+
+Auth: optional bearer token (the in-cluster ``/var/run/secrets/...`` token
+path or a literal). TLS is delegated to ``ssl`` default context when the
+URL is https.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class Conflict(ApiError):
+    pass
+
+
+class NotFound(ApiError):
+    pass
+
+
+def _raise(status: int, body: str):
+    if status == 409:
+        raise Conflict(status, body)
+    if status == 404:
+        raise NotFound(status, body)
+    raise ApiError(status, body)
+
+
+class KubeClient:
+    def __init__(self, base_url: str, token: str = "",
+                 timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    # ---- plumbing ----
+
+    def _headers(self, extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        h = {"Content-Type": "application/json",
+             "Accept": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        h.update(extra or {})
+        return h
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None,
+                params: Optional[Dict[str, str]] = None,
+                content_type: str = "application/json") -> dict:
+        url = self.base_url + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers=self._headers({"Content-Type": content_type}))
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            _raise(e.code, e.read().decode(errors="replace")[:400])
+        except (urllib.error.URLError, socket.timeout) as e:
+            raise ApiError(0, f"{type(e).__name__}: {e}")
+        return json.loads(payload) if payload else {}
+
+    # ---- pods ----
+
+    def list_pods(self, namespace: str = "",
+                  label_selector: str = "") -> List[dict]:
+        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
+                else "/api/v1/pods")
+        params = {"labelSelector": label_selector} if label_selector else None
+        return self.request("GET", path, params=params).get("items", [])
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        return self.request("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def create_pod(self, namespace: str, pod: dict) -> dict:
+        return self.request("POST", f"/api/v1/namespaces/{namespace}/pods",
+                            body=pod)
+
+    def update_pod(self, namespace: str, name: str, pod: dict) -> dict:
+        return self.request("PUT", f"/api/v1/namespaces/{namespace}/pods/{name}",
+                            body=pod)
+
+    def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
+        """Strategic merge patch: lists with patchMergeKey (containers)
+        merge BY NAME instead of wholesale replacement — required for
+        image-only in-place updates (a plain RFC 7386 merge patch would
+        replace the whole containers array and be rejected as a pod-spec
+        mutation)."""
+        return self.request(
+            "PATCH", f"/api/v1/namespaces/{namespace}/pods/{name}",
+            body=patch, content_type="application/strategic-merge-patch+json")
+
+    def delete_pod(self, namespace: str, name: str,
+                   grace_period_seconds: int = 0) -> None:
+        try:
+            self.request("DELETE",
+                         f"/api/v1/namespaces/{namespace}/pods/{name}",
+                         params={"gracePeriodSeconds": str(grace_period_seconds)})
+        except NotFound:
+            pass
+
+    # ---- nodes ----
+
+    def list_nodes(self, label_selector: str = "") -> List[dict]:
+        params = {"labelSelector": label_selector} if label_selector else None
+        return self.request("GET", "/api/v1/nodes", params=params).get("items", [])
+
+    # ---- watch ----
+
+    def watch_pods(self, namespace: str = "", label_selector: str = "",
+                   resource_version: str = "0",
+                   timeout_s: float = 30.0) -> Iterator[Tuple[str, dict]]:
+        """Yield (event_type, pod) from a JSON-lines watch stream. Returns
+        when the server closes the stream (bookmark your own last
+        resourceVersion and reconnect)."""
+        import http.client
+
+        u = urllib.parse.urlparse(self.base_url)
+        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
+                else "/api/v1/pods")
+        params = {"watch": "true", "resourceVersion": resource_version,
+                  "timeoutSeconds": str(int(timeout_s))}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        path += "?" + urllib.parse.urlencode(params)
+        conn_cls = (http.client.HTTPSConnection if u.scheme == "https"
+                    else http.client.HTTPConnection)
+        conn = conn_cls(u.hostname, u.port, timeout=timeout_s + 5)
+        try:
+            conn.request("GET", path, headers=self._headers())
+            resp = conn.getresponse()
+            if resp.status != 200:
+                _raise(resp.status, resp.read().decode(errors="replace")[:400])
+            while True:
+                line = resp.readline()
+                if not line:
+                    return
+                line = line.strip()
+                if not line:
+                    continue
+                ev = json.loads(line)
+                yield ev["type"], ev["object"]
+        except (http.client.HTTPException, OSError):
+            return
+        finally:
+            conn.close()

@@ -1,0 +1,472 @@
+"""In-repo fake of the Kubernetes API server REST semantics.
+
+No cluster exists in this environment, so the K8s backend is tested against
+this fake the way the reference tests controllers against envtest
+(SURVEY.md §4 tier 2: real etcd+apiserver, synthetic pod status). It
+implements the exact subset the backend's client speaks:
+
+* CRUD on ``/api/v1/namespaces/{ns}/pods`` and ``/api/v1/nodes``
+* optimistic concurrency: PUT with a stale ``metadata.resourceVersion``
+  → 409 Conflict; POST on an existing name → 409
+* ``labelSelector`` equality filtering on LIST
+* JSON merge PATCH (``application/merge-patch+json``)
+* graceful DELETE: sets ``deletionTimestamp`` and lets the node agent
+  finalize (grace 0 → immediate removal)
+* JSON-lines WATCH with ``resourceVersion`` resumption
+* a kwok-style **node agent** (same role as the reference's kwok fake
+  nodes, ``test/stress/main.go:45``): resolves the hostname selector,
+  binds ``spec.nodeName``, walks pods Pending→Running(Ready) after
+  ``ready_delay``, acks image patches by bumping restartCount, honors
+  run-to-completion pods (→ Succeeded).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import threading
+import time
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.k8s import translate as T
+
+
+def _match_selector(labels: Dict[str, str], selector: str) -> bool:
+    if not selector:
+        return True
+    for req in selector.split(","):
+        req = req.strip()
+        if not req:
+            continue
+        if "!=" in req:
+            k, v = req.split("!=", 1)
+            if labels.get(k.strip()) == v.strip():
+                return False
+        elif "=" in req:
+            k, v = req.split("=", 1)
+            if labels.get(k.strip()) != v.strip():
+                return False
+        else:  # bare key = exists
+            if req not in labels:
+                return False
+    return True
+
+
+class _State:
+    """Object store + watch log, shared across handler threads."""
+
+    def __init__(self):
+        self.lock = threading.Condition()
+        self.rv = 0
+        self.pods: Dict[Tuple[str, str], dict] = {}
+        self.nodes: Dict[str, dict] = {}
+        # Watch replay log: (rv, type, snapshot). Bounded.
+        self.log: List[Tuple[int, str, dict]] = []
+
+    def bump(self) -> str:
+        self.rv += 1
+        return str(self.rv)
+
+    def record(self, ev_type: str, obj: dict):
+        self.log.append((int(obj["metadata"]["resourceVersion"]),
+                         ev_type, copy.deepcopy(obj)))
+        if len(self.log) > 4096:
+            del self.log[:1024]
+        self.lock.notify_all()
+
+
+class FakeK8sApiServer:
+    def __init__(self, ready_delay: float = 0.0, token: str = "",
+                 agent: bool = True):
+        self.state = _State()
+        self.ready_delay = ready_delay
+        self.token = token
+        self._agent_enabled = agent
+        self._stop = threading.Event()
+        self.fail_filter = None     # fn(pod_json) -> bool: walk to Failed
+        state = self.state
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # silence
+                pass
+
+            # ---- helpers ----
+
+            def _send(self, code: int, body: dict | None = None):
+                data = json.dumps(body or {}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def _auth_ok(self) -> bool:
+                if not server.token:
+                    return True
+                return (self.headers.get("Authorization", "")
+                        == f"Bearer {server.token}")
+
+            def _route(self):
+                u = urllib.parse.urlparse(self.path)
+                q = dict(urllib.parse.parse_qsl(u.query))
+                parts = [p for p in u.path.split("/") if p]
+                return parts, q
+
+            # ---- verbs ----
+
+            def do_GET(self):
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                parts, q = self._route()
+                # /api/v1/nodes[/name]
+                if parts[:3] == ["api", "v1", "nodes"]:
+                    with state.lock:
+                        if len(parts) == 4:
+                            n = state.nodes.get(parts[3])
+                            return (self._send(200, n) if n
+                                    else self._send(404, {"message": "not found"}))
+                        items = [n for n in state.nodes.values()
+                                 if _match_selector(
+                                     n["metadata"].get("labels", {}),
+                                     q.get("labelSelector", ""))]
+                        return self._send(200, {"kind": "NodeList",
+                                                "items": copy.deepcopy(items)})
+                # /api/v1/[namespaces/{ns}/]pods[/name]
+                ns, name = self._pod_path(parts)
+                if ns is None:
+                    return self._send(404, {"message": "unknown path"})
+                if q.get("watch") == "true":
+                    return self._watch(ns, q)
+                with state.lock:
+                    if name:
+                        p = state.pods.get((ns, name))
+                        return (self._send(200, copy.deepcopy(p)) if p
+                                else self._send(404, {"message": "not found"}))
+                    items = [p for (pns, _), p in sorted(state.pods.items())
+                             if (not ns or pns == ns)
+                             and _match_selector(
+                                 p["metadata"].get("labels", {}),
+                                 q.get("labelSelector", ""))]
+                    return self._send(200, {
+                        "kind": "PodList",
+                        "metadata": {"resourceVersion": str(state.rv)},
+                        "items": copy.deepcopy(items)})
+
+            def _pod_path(self, parts):
+                # api/v1/pods | api/v1/namespaces/{ns}/pods[/{name}[/status]]
+                if parts[:3] == ["api", "v1", "pods"]:
+                    return "", ""
+                if (len(parts) >= 5 and parts[:3] == ["api", "v1", "namespaces"]
+                        and parts[4] == "pods"):
+                    name = parts[5] if len(parts) > 5 else ""
+                    return parts[3], name
+                return None, None
+
+            def _watch(self, ns: str, q: dict):
+                sel = q.get("labelSelector", "")
+                since = int(q.get("resourceVersion", "0") or 0)
+                deadline = time.monotonic() + float(q.get("timeoutSeconds", 30))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def emit(ev_type, obj):
+                    line = json.dumps({"type": ev_type, "object": obj}) + "\n"
+                    data = line.encode()
+                    self.wfile.write(f"{len(data):x}\r\n".encode()
+                                     + data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    while not server._stop.is_set():
+                        with state.lock:
+                            batch = [(rv, t, o) for (rv, t, o) in state.log
+                                     if rv > since
+                                     and (not ns or o["metadata"]["namespace"] == ns)
+                                     and _match_selector(
+                                         o["metadata"].get("labels", {}), sel)]
+                            if not batch:
+                                remaining = deadline - time.monotonic()
+                                if remaining <= 0:
+                                    break
+                                state.lock.wait(min(remaining, 0.5))
+                                continue
+                        for rv, t, o in batch:
+                            emit(t, o)
+                            since = rv
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+
+            def do_POST(self):
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                parts, _ = self._route()
+                body = self._body()
+                if parts[:3] == ["api", "v1", "nodes"]:
+                    with state.lock:
+                        name = body["metadata"]["name"]
+                        body["metadata"]["resourceVersion"] = state.bump()
+                        state.nodes[name] = body
+                        return self._send(201, body)
+                ns, _ = self._pod_path(parts)
+                if ns is None:
+                    return self._send(404, {"message": "unknown path"})
+                with state.lock:
+                    name = body["metadata"]["name"]
+                    if (ns, name) in state.pods:
+                        return self._send(409, {"message": "already exists"})
+                    meta = body["metadata"]
+                    meta["namespace"] = ns
+                    meta["uid"] = str(uuid.uuid4())
+                    meta["resourceVersion"] = state.bump()
+                    meta["creationTimestamp"] = time.time()
+                    body.setdefault("status", {"phase": "Pending"})
+                    state.pods[(ns, name)] = body
+                    state.record("ADDED", body)
+                    out = copy.deepcopy(body)
+                server._agent_kick()
+                return self._send(201, out)
+
+            def do_PUT(self):
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                parts, _ = self._route()
+                body = self._body()
+                ns, name = self._pod_path(parts)
+                status_sub = False
+                if ns is not None and len(parts) > 6 and parts[6] == "status":
+                    status_sub = True
+                if ns is None or not name:
+                    return self._send(404, {"message": "unknown path"})
+                with state.lock:
+                    cur = state.pods.get((ns, name))
+                    if cur is None:
+                        return self._send(404, {"message": "not found"})
+                    sent_rv = body.get("metadata", {}).get("resourceVersion")
+                    if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
+                        return self._send(409, {"message": "conflict"})
+                    if status_sub:
+                        cur["status"] = body.get("status", {})
+                    else:
+                        preserved = {k: cur["metadata"][k]
+                                     for k in ("uid", "namespace",
+                                               "creationTimestamp")
+                                     if k in cur["metadata"]}
+                        cur["spec"] = body.get("spec", cur["spec"])
+                        cur["metadata"] = {**body.get("metadata", {}),
+                                           **preserved}
+                        cur["status"] = cur.get("status", {})
+                    cur["metadata"]["resourceVersion"] = state.bump()
+                    state.record("MODIFIED", cur)
+                    out = copy.deepcopy(cur)
+                server._agent_kick()
+                return self._send(200, out)
+
+            def do_PATCH(self):
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                parts, _ = self._route()
+                patch = self._body()
+                ns, name = self._pod_path(parts)
+                if ns is None or not name:
+                    return self._send(404, {"message": "unknown path"})
+
+                def merge(dst, src):
+                    for k, v in src.items():
+                        if v is None:
+                            dst.pop(k, None)
+                        elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+                            merge(dst[k], v)
+                        elif (isinstance(v, list) and k == "containers"
+                              and isinstance(dst.get(k), list)):
+                            # Strategic-merge-lite: containers merge by name.
+                            by_name = {c.get("name"): c for c in dst[k]}
+                            for c in v:
+                                tgt = by_name.get(c.get("name"))
+                                if tgt is not None:
+                                    merge(tgt, c)
+                                else:
+                                    dst[k].append(c)
+                        else:
+                            dst[k] = copy.deepcopy(v)
+
+                with state.lock:
+                    cur = state.pods.get((ns, name))
+                    if cur is None:
+                        return self._send(404, {"message": "not found"})
+                    merge(cur, patch)
+                    cur["metadata"]["resourceVersion"] = state.bump()
+                    state.record("MODIFIED", cur)
+                    out = copy.deepcopy(cur)
+                server._agent_kick()
+                return self._send(200, out)
+
+            def do_DELETE(self):
+                if not self._auth_ok():
+                    return self._send(401, {"message": "unauthorized"})
+                parts, q = self._route()
+                ns, name = self._pod_path(parts)
+                if ns is None or not name:
+                    return self._send(404, {"message": "unknown path"})
+                grace = int(q.get("gracePeriodSeconds", "0") or 0)
+                with state.lock:
+                    cur = state.pods.get((ns, name))
+                    if cur is None:
+                        return self._send(404, {"message": "not found"})
+                    if grace <= 0:
+                        state.pods.pop((ns, name))
+                        cur["metadata"]["resourceVersion"] = state.bump()
+                        state.record("DELETED", cur)
+                    else:
+                        cur["metadata"]["deletionTimestamp"] = time.time()
+                        cur["metadata"]["resourceVersion"] = state.bump()
+                        state.record("MODIFIED", cur)
+                    out = copy.deepcopy(cur)
+                server._agent_kick()
+                return self._send(200, out)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd.daemon_threads = True
+        self._threads: List[threading.Thread] = []
+        self._agent_wake = threading.Event()
+
+    # ---- lifecycle ----
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FakeK8sApiServer":
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name="fake-apiserver", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self._agent_enabled:
+            a = threading.Thread(target=self._agent_loop,
+                                 name="fake-node-agent", daemon=True)
+            a.start()
+            self._threads.append(a)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._agent_wake.set()
+        with self.state.lock:
+            self.state.lock.notify_all()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ---- node agent (kwok equivalent) ----
+
+    def _agent_kick(self):
+        self._agent_wake.set()
+
+    def add_node(self, name: str, labels: Optional[Dict[str, str]] = None,
+                 address: str = "127.0.0.1", pods: int = 64, tpu: int = 0):
+        """Test helper: register a (fake) node directly."""
+        node = {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": labels or {}},
+            "status": {
+                "capacity": {"pods": str(pods),
+                             **({T.TPU_RESOURCE: str(tpu)} if tpu else {})},
+                "addresses": [{"type": "InternalIP", "address": address}],
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        }
+        with self.state.lock:
+            node["metadata"]["resourceVersion"] = self.state.bump()
+            self.state.nodes[name] = node
+
+    def _agent_loop(self):
+        while not self._stop.is_set():
+            self._agent_wake.wait(timeout=0.2)
+            self._agent_wake.clear()
+            if self.ready_delay:
+                time.sleep(self.ready_delay)
+            with self.state.lock:
+                for key, pod in list(self.state.pods.items()):
+                    if self._agent_step(pod):
+                        pod["metadata"]["resourceVersion"] = self.state.bump()
+                        self.state.record("MODIFIED", pod)
+                # Finalize gracefully-deleted pods.
+                for key, pod in list(self.state.pods.items()):
+                    if pod["metadata"].get("deletionTimestamp") is not None:
+                        self.state.pods.pop(key)
+                        pod["metadata"]["resourceVersion"] = self.state.bump()
+                        self.state.record("DELETED", pod)
+
+    def _agent_step(self, pod: dict) -> bool:
+        """One kubelet-ish observation of a pod. Returns True if changed."""
+        spec = pod.get("spec", {})
+        meta = pod.get("metadata", {})
+        st = pod.setdefault("status", {"phase": "Pending"})
+        # Bind: resolve the hostname selector (plane pins placement).
+        if not spec.get("nodeName"):
+            host = (spec.get("nodeSelector") or {}).get(T.LABEL_HOSTNAME)
+            if host and host in self.state.nodes:
+                spec["nodeName"] = host
+            elif self.state.nodes:
+                spec["nodeName"] = sorted(self.state.nodes)[0]
+            else:
+                return False
+        node = self.state.nodes.get(spec["nodeName"])
+        if st.get("phase") == "Pending":
+            if self.fail_filter is not None and self.fail_filter(pod):
+                st["phase"] = "Failed"
+                st["reason"] = "FakeAgentInjected"
+                return True
+            run_once = (meta.get("annotations", {}).get(
+                f"{C.DOMAIN}/run-to-completion") == "true")
+            st["phase"] = "Succeeded" if run_once else "Running"
+            st["startTime"] = time.time()
+            addr = "127.0.0.1"
+            if node:
+                for a in node["status"].get("addresses", []):
+                    if a.get("type") == "InternalIP":
+                        addr = a["address"]
+            st["podIP"] = addr
+            st["conditions"] = [{"type": "Ready",
+                                 "status": "False" if run_once else "True"}]
+            st["containerStatuses"] = [
+                {"name": c["name"], "image": c["image"], "restartCount": 0,
+                 "state": {"running": {}} if not run_once
+                 else {"terminated": {"exitCode": 0}}}
+                for c in spec.get("containers", [])]
+            return True
+        if st.get("phase") == "Running":
+            # Ack image patches: restart the container on the new image.
+            changed = False
+            statuses = st.setdefault("containerStatuses", [])
+            by_name = {cs.get("name"): cs for cs in statuses}
+            for c in spec.get("containers", []):
+                cs = by_name.get(c["name"])
+                if cs is None:
+                    continue
+                if cs.get("image") != c["image"]:
+                    cs["image"] = c["image"]
+                    cs["restartCount"] = int(cs.get("restartCount", 0)) + 1
+                    changed = True
+            return changed
+        return False

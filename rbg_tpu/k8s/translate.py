@@ -1,0 +1,215 @@
+"""Plane Pod / Node ↔ Kubernetes JSON translation (GKE TPU shaped).
+
+Reference analog: the shared pod-template builder
+(``pkg/reconciler/pod_reconciler.go:64-390``) constructs corev1 Pods from
+role templates; here the plane's own Pod objects (already fully built by
+the instance controller + discovery injectors) are translated to the K8s
+wire form the moment they cross to a real cluster.
+
+GKE TPU contract (SURVEY.md §7 step 5):
+
+* chip resources: ``google.com/tpu`` in requests+limits,
+* node selection: ``cloud.google.com/gke-tpu-topology`` /
+  ``cloud.google.com/gke-tpu-accelerator`` labels,
+* one multi-host slice == one node pool → the node-pool label IS the slice
+  identity; the plane's slice-binding annotation (``ANN_SLICE_BINDING``)
+  becomes REQUIRED nodeAffinity on it,
+* hostNetwork for TPU pods (ICI/DCN path stays off the overlay).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.pod import Node, Pod, TpuNodeInfo
+
+# GKE well-known keys.
+TPU_RESOURCE = "google.com/tpu"
+LABEL_GKE_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+LABEL_GKE_TPU_ACCEL = "cloud.google.com/gke-tpu-accelerator"
+LABEL_GKE_NODEPOOL = "cloud.google.com/gke-nodepool"   # slice identity
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+# Plane-owned identity on mirrored objects.
+LABEL_MANAGED_BY = f"{C.DOMAIN}/managed-by"
+MANAGED_BY = "rbg-tpu"
+ANN_PLANE_UID = f"{C.DOMAIN}/plane-uid"
+LABEL_WORKER_INDEX = f"{C.DOMAIN}/tpu-worker-index"
+
+
+def _container_to_k8s(c) -> dict:
+    out: dict = {"name": c.name, "image": c.image}
+    if c.command:
+        out["command"] = list(c.command)
+    if c.args:
+        out["args"] = list(c.args)
+    if c.env:
+        out["env"] = [{"name": e.name, "value": e.value} for e in c.env]
+    if c.ports:
+        out["ports"] = [{"name": p.name, "containerPort": p.container_port}
+                        for p in c.ports if p.container_port]
+    res: Dict[str, dict] = {}
+    if c.resources.cpu:
+        res.setdefault("requests", {})["cpu"] = str(c.resources.cpu)
+    if c.resources.memory_gb:
+        res.setdefault("requests", {})["memory"] = f"{c.resources.memory_gb}Gi"
+    if c.resources.tpu_chips:
+        # google.com/tpu must appear in requests AND limits (extended
+        # resource); GKE rejects TPU pods without the limit.
+        res.setdefault("requests", {})[TPU_RESOURCE] = str(c.resources.tpu_chips)
+        res.setdefault("limits", {})[TPU_RESOURCE] = str(c.resources.tpu_chips)
+    if res:
+        out["resources"] = res
+    return out
+
+
+def to_k8s_pod(pod: Pod, node: Optional[Node] = None) -> dict:
+    """Translate a plane Pod (post-scheduling) to a K8s Pod manifest.
+
+    The plane scheduler already chose the host (``pod.node_name``) — that
+    decision is pinned via the hostname selector so the kube-scheduler
+    cannot undo slice-aware gang placement. The slice-binding annotation
+    additionally folds into REQUIRED nodeAffinity on the node-pool label
+    (in-place-scheduling parity: ``sync/node_binding.go:276``)."""
+    tpl = pod.template
+    tpu_pod = any(c.resources.tpu_chips for c in tpl.containers)
+
+    labels = dict(tpl.labels)
+    labels[LABEL_MANAGED_BY] = MANAGED_BY
+    annotations = dict(tpl.annotations)
+    annotations[ANN_PLANE_UID] = pod.metadata.uid
+
+    spec: dict = {
+        "containers": [_container_to_k8s(c) for c in tpl.containers],
+        "restartPolicy": ("Never" if annotations.get(
+            f"{C.DOMAIN}/run-to-completion") == "true" else "Always"),
+    }
+    if tpl.init_containers:
+        spec["initContainers"] = [_container_to_k8s(c)
+                                  for c in tpl.init_containers]
+    if tpu_pod:
+        spec["hostNetwork"] = True
+        spec["dnsPolicy"] = "ClusterFirstWithHostNet"
+
+    node_selector = dict(tpl.node_selector)
+    if pod.node_name:
+        node_selector[LABEL_HOSTNAME] = pod.node_name
+    if node is not None and node.tpu.accelerator:
+        node_selector.setdefault(LABEL_GKE_TPU_ACCEL, node.tpu.accelerator)
+        if node.tpu.slice_topology:
+            node_selector.setdefault(LABEL_GKE_TPU_TOPOLOGY,
+                                     node.tpu.slice_topology)
+    if node_selector:
+        spec["nodeSelector"] = node_selector
+
+    # Affinity: plane NodeAffinityTerms + slice binding.
+    required_terms = []
+    preferred = []
+    for t in pod.affinity:
+        expr = {"key": t.key, "operator": t.operator}
+        if t.values:
+            expr["values"] = list(t.values)
+        if t.required:
+            required_terms.append(expr)
+        else:
+            preferred.append({"weight": t.weight, "preference":
+                              {"matchExpressions": [expr]}})
+    slice_pin = pod.metadata.annotations.get(C.ANN_SLICE_BINDING, "")
+    if slice_pin:
+        required_terms.append({"key": LABEL_GKE_NODEPOOL, "operator": "In",
+                               "values": [slice_pin]})
+    affinity: dict = {}
+    if required_terms:
+        # K8s semantics: expressions inside ONE term AND together
+        # (Required folding, node_binding.go:409).
+        affinity["requiredDuringSchedulingIgnoredDuringExecution"] = {
+            "nodeSelectorTerms": [{"matchExpressions": required_terms}]}
+    if preferred:
+        affinity["preferredDuringSchedulingIgnoredDuringExecution"] = preferred
+    if affinity:
+        spec["affinity"] = {"nodeAffinity": affinity}
+
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": pod.metadata.name,
+            "namespace": pod.metadata.namespace,
+            "labels": labels,
+            "annotations": annotations,
+        },
+        "spec": spec,
+    }
+
+
+def desired_images(pod: Pod) -> Dict[str, str]:
+    return {c.name: c.image for c in pod.template.containers}
+
+
+def reflect_status(kpod: dict, pod_fallback_revision: str = "") -> dict:
+    """Extract the plane-relevant status fields from a K8s Pod JSON.
+
+    Returns a dict consumed by the backend's status mutator: phase, ready,
+    pod_ip, node, start_time (epoch), container restarts, running images,
+    and reason."""
+    st = kpod.get("status", {})
+    conds = {c.get("type"): c.get("status")
+             for c in st.get("conditions", [])}
+    restarts: Dict[str, int] = {}
+    images: Dict[str, str] = {}
+    for cs in st.get("containerStatuses", []):
+        restarts[cs.get("name", "")] = int(cs.get("restartCount", 0))
+        if cs.get("state", {}).get("running") is not None:
+            images[cs.get("name", "")] = cs.get("image", "")
+    start = st.get("startTime") or 0.0
+    if isinstance(start, str):
+        # Real apiservers serialize RFC3339 ("2026-07-29T12:00:00Z");
+        # the fake uses epoch floats.
+        import datetime
+        try:
+            start = datetime.datetime.fromisoformat(
+                start.replace("Z", "+00:00")).timestamp()
+        except ValueError:
+            start = 0.0
+    return {
+        "phase": st.get("phase", "Pending"),
+        "reason": st.get("reason", ""),
+        "ready": conds.get("Ready") == "True",
+        "pod_ip": st.get("podIP", ""),
+        "node_name": kpod.get("spec", {}).get("nodeName", ""),
+        "start_time": float(start) if isinstance(start, (int, float)) else 0.0,
+        "container_restarts": restarts,
+        "running_images": images,
+        "deleting": kpod.get("metadata", {}).get("deletionTimestamp") is not None,
+    }
+
+
+def node_from_k8s(knode: dict) -> Node:
+    """Build a plane Node from a K8s Node (TPU labels → TpuNodeInfo). The
+    node-pool label is the slice id; worker index comes from the plane's
+    own label when present (set by admin tooling) else 0."""
+    meta = knode.get("metadata", {})
+    labels = meta.get("labels", {}) or {}
+    status = knode.get("status", {})
+    capacity = status.get("capacity", {}) or {}
+    addresses = status.get("addresses", []) or []
+    addr = next((a.get("address") for a in addresses
+                 if a.get("type") == "InternalIP"), "127.0.0.1")
+    conds = {c.get("type"): c.get("status")
+             for c in status.get("conditions", [])}
+    node = Node()
+    node.metadata.name = meta.get("name", "")
+    node.metadata.namespace = "default"
+    node.labels = dict(labels)
+    node.ready = conds.get("Ready", "True") == "True"
+    node.address = addr
+    node.capacity_pods = int(capacity.get("pods", 64))
+    node.tpu = TpuNodeInfo(
+        accelerator=labels.get(LABEL_GKE_TPU_ACCEL, ""),
+        slice_id=labels.get(LABEL_GKE_NODEPOOL, ""),
+        slice_topology=labels.get(LABEL_GKE_TPU_TOPOLOGY, ""),
+        worker_index=int(labels.get(LABEL_WORKER_INDEX, 0)),
+        chips=int(capacity.get(TPU_RESOURCE, 0)),
+        mesh_coords=labels.get(f"{C.DOMAIN}/mesh-coords", ""),
+    )
+    return node

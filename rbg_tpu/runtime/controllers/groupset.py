@@ -183,7 +183,11 @@ class RoleBasedGroupSetController(Controller):
         if not drifted:
             return matching, 0
 
-        budget = rbgs.spec.max_unavailable
+        from rbg_tpu.api import intstr
+        budget = intstr.resolve(rbgs.spec.max_unavailable, rbgs.spec.replicas,
+                                round_up=False, name="maxUnavailable")
+        if isinstance(rbgs.spec.max_unavailable, str):
+            budget = max(1, budget)  # a percent never means "frozen"
         if budget <= 0:
             budget = (len(in_range) + created) or 1
         unavailable = created + sum(

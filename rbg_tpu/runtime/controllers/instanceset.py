@@ -223,7 +223,15 @@ class RoleInstanceSetController(Controller):
                 unavailable += 1
                 if wait > 0 and (soonest is None or wait < soonest):
                     soonest = wait
-        budget = max(0, ru.max_unavailable - unavailable)
+        from rbg_tpu.api import intstr
+        max_unavail = intstr.resolve(ru.max_unavailable, ris.spec.replicas,
+                                     round_up=False, name="maxUnavailable")
+        if isinstance(ru.max_unavailable, str):
+            # Percent forms round DOWN but floor at 1 so the rollout can
+            # always progress (sts_reconciler.go percent convention); an
+            # explicit int 0 stays a deliberate freeze.
+            max_unavail = max(1, max_unavail)
+        budget = max(0, max_unavail - unavailable)
         outdated = [i for i in active
                     if i.metadata.labels.get(C.LABEL_REVISION_NAME) != revision]
         for inst in outdated:

@@ -36,6 +36,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from rbg_tpu.api import constants as C
+from rbg_tpu.api import intstr
 from rbg_tpu.api.meta import get_condition
 
 # Minimum CONSECUTIVE observed-unhealthy time before a base instance may be
@@ -199,8 +200,11 @@ def compute_topology(ris, by_ord, current_rev: str, update_rev: str) -> Topology
     t = Topology(replicas=ris.spec.replicas)
     t.surge_start = t.replicas
     t.end_ordinal = t.replicas
-    t.max_surge = max(0, ru.max_surge)
-    t.max_unavailable = max(0, ru.max_unavailable)
+    t.max_surge = max(0, intstr.resolve(ru.max_surge, t.replicas,
+                                        round_up=True, name="maxSurge"))
+    t.max_unavailable = max(0, intstr.resolve(
+        ru.max_unavailable, t.replicas, round_up=False,
+        name="maxUnavailable"))
     if t.max_surge == 0 and t.max_unavailable < 1:
         t.max_unavailable = 1   # rollout must be able to make progress
     t.partition = min(max(0, ru.partition), t.replicas)

@@ -5,6 +5,8 @@ controller registration, shared NodeBindingStore, health). Backends:
 
 * ``fake``  — FakeKubelet walks pods to Ready (envtest/kwok equivalent)
 * ``local`` — real subprocesses on this host (rbg_tpu.runtime.executor, M7)
+* ``k8s``   — mirror pods to a real Kubernetes API server as GKE TPU pods
+  (rbg_tpu.k8s.backend; pass ``k8s_client``)
 * ``none``  — no pod backend (tests drive pod status manually)
 """
 
@@ -22,7 +24,8 @@ from rbg_tpu.sched.scheduler import SchedulerController
 
 class ControlPlane:
     def __init__(self, store: Optional[Store] = None, backend: str = "fake",
-                 ready_delay: float = 0.0, executor_env: Optional[dict] = None):
+                 ready_delay: float = 0.0, executor_env: Optional[dict] = None,
+                 k8s_client=None):
         self.store = store or Store()
         self.manager = Manager(self.store)
         self.node_binding = NodeBindingStore(self.store)
@@ -49,6 +52,11 @@ class ControlPlane:
         elif backend == "local":
             from rbg_tpu.runtime.executor import LocalExecutor
             self.kubelet = LocalExecutor(self.store, extra_env=executor_env)
+        elif backend == "k8s":
+            if k8s_client is None:
+                raise ValueError("backend='k8s' requires k8s_client")
+            from rbg_tpu.k8s.backend import K8sPodBackend
+            self.kubelet = K8sPodBackend(self.store, k8s_client)
 
     def _register_optional(self):
         """Controllers gated on availability (reference: CheckCrdExists gating,

@@ -516,3 +516,65 @@ def test_e2e_partition_pins_old_revision_spec(plane):
 
     plane.wait_for(recreated_old, timeout=30,
                    desc="pinned ordinal recreated at old revision")
+
+
+# ---------------- IntOrString percent forms (sts_reconciler.go:198-449) ----
+
+
+def test_topology_percent_knobs_k8s_rounding():
+    """maxSurge rounds UP, maxUnavailable rounds DOWN against replicas."""
+    ris = make_ris(replicas=4, max_surge="25%", max_unavailable="30%")
+    t = su.compute_topology(ris, {}, OLD, NEW)
+    assert t.max_surge == 1          # ceil(4 * 0.25) = 1
+    assert t.max_unavailable == 1    # floor(4 * 0.30) = 1
+
+    ris2 = make_ris(replicas=10, max_surge="15%", max_unavailable="25%")
+    t2 = su.compute_topology(ris2, {}, OLD, NEW)
+    assert t2.max_surge == 2         # ceil(1.5)
+    assert t2.max_unavailable == 2   # floor(2.5)
+
+
+def test_topology_percent_unavailable_floors_to_one_without_surge():
+    """"10%" of 3 replicas floors to 0 — but with no surge the budget
+    floor keeps the rollout able to progress."""
+    ris = make_ris(replicas=3, max_surge=0, max_unavailable="10%")
+    t = su.compute_topology(ris, {}, OLD, NEW)
+    assert t.max_unavailable == 1
+
+
+def test_percent_knob_validation_and_serde():
+    from rbg_tpu.api import intstr, serde
+    from rbg_tpu.api.group import RoleBasedGroup
+
+    intstr.validate("25%")
+    intstr.validate(3)
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        intstr.validate("25")
+    with _pytest.raises(ValueError):
+        intstr.validate("a%")
+
+    # Wire round-trip keeps the string form.
+    g = serde.from_dict(RoleBasedGroup, {
+        "kind": "RoleBasedGroup",
+        "metadata": {"name": "g"},
+        "spec": {"roles": [{
+            "name": "r", "replicas": 4,
+            "rollingUpdate": {"maxUnavailable": "25%", "maxSurge": "50%"},
+        }]},
+    })
+    assert g.spec.roles[0].rolling_update.max_unavailable == "25%"
+    out = serde.to_dict(g)
+    assert out["spec"]["roles"][0]["rollingUpdate"]["maxSurge"] == "50%"
+
+    # Admission rejects malformed percent strings.
+    from rbg_tpu.api.validation import ValidationError, validate_group
+    g.spec.roles[0].rolling_update.max_surge = "half"
+    with _pytest.raises(ValidationError):
+        validate_group(g)
+
+    # Schema advertises the oneOf contract.
+    from rbg_tpu.api.schema import schema_for
+    s = schema_for(RoleBasedGroup)
+    ru = s["definitions"]["RollingUpdate"]["properties"]["maxUnavailable"]
+    assert {"type": "integer"} in ru["oneOf"]

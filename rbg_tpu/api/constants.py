@@ -34,7 +34,16 @@ ANN_GANG_SCHEDULING = f"{DOMAIN}/gang-scheduling"        # "true"/"false"
 ANN_EXCLUSIVE_TOPOLOGY = f"{DOMAIN}/exclusive-topology"  # topology key
 ANN_INSTANCE_PATTERN = f"{DOMAIN}/role-instance-pattern"  # stateful|stateless
 ANN_RESTART_TRIGGER_POLICY = f"{DOMAIN}/restart-trigger-policy"  # Ignore
-ANN_INPLACE_SCHEDULING = f"{DOMAIN}/in-place-scheduling"  # granularity
+# In-place scheduling (KEP-351; reference node_binding.go): mode is
+# Preferred | Required | Disabled (our default when unset is Preferred —
+# warm rebinding is the point of TPU slices; the reference defaults to off).
+ANN_INPLACE_SCHEDULING = f"{DOMAIN}/in-place-scheduling"
+# Pod | Component; unset = auto (stateful→Pod, stateless→Component,
+# reference resolveGranularity, node_binding.go:191).
+ANN_INPLACE_SCHEDULING_GRANULARITY = f"{DOMAIN}/in-place-scheduling-granularity"
+# Comma-separated label keys → DoesNotExist node terms (avoid labels,
+# node_binding.go:276 step 3).
+ANN_INPLACE_SCHEDULING_AVOID = f"{DOMAIN}/in-place-scheduling-avoid"
 ANN_PORT_ALLOCATOR = f"{DOMAIN}/port-allocator"          # JSON config
 ANN_ALLOCATED_PORTS = f"{DOMAIN}/allocated-ports"        # JSON result
 ANN_COMPONENT_DEPENDS_ON = f"{DOMAIN}/component-depends-on"  # JSON

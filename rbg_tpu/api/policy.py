@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from rbg_tpu.api.meta import Condition, ObjectMeta
 from rbg_tpu.api.pod import Container, PodTemplate
@@ -85,9 +85,41 @@ class ScalingAdapter:
 
 
 @dataclasses.dataclass
+class ImagePreload:
+    """Pull these images onto the node ahead of time (reference:
+    ``ImagePreloadAction``, ``rolebasedgroupwarmup_types.go:34-45``)."""
+
+    images: List[str] = dataclasses.field(default_factory=list)
+    pull_secrets: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class WarmupActions:
+    """What to run on each target node: per-image pull containers and/or
+    user containers (reference: ``WarmupActions`` / ``CustomizedAction``,
+    types ``:47-75``; container construction ``buildWarmupPod:535``)."""
+
+    image_preload: Optional[ImagePreload] = None
+    containers: List[Container] = dataclasses.field(default_factory=list)
+    volumes: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def empty(self) -> bool:
+        return (self.image_preload is None and not self.containers
+                and not self.volumes)
+
+
+@dataclasses.dataclass
 class WarmupTarget:
     nodes: List[str] = dataclasses.field(default_factory=list)  # explicit
+    # Or: nodes selected by labels (reference TargetNodes.NodeSelector).
+    node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
     group_name: str = ""        # or: nodes discovered from a group's pods
+    # With group_name: per-ROLE actions — each node gets the union of the
+    # actions of the roles whose pods it hosts (reference
+    # TargetRoleBasedGroup.Roles, types ``:96-110``). Empty = spec.actions
+    # on every node of the group.
+    roles: Dict[str, WarmupActions] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -97,6 +129,11 @@ class WarmupSpec:
     priming and model-weight prefetch to hosts of the target slice."""
 
     target: WarmupTarget = dataclasses.field(default_factory=WarmupTarget)
+    # Actions for node-targeted warmups (and the group default when
+    # target.roles is empty).
+    actions: WarmupActions = dataclasses.field(default_factory=WarmupActions)
+    # Legacy single-template form (pre-actions API): used verbatim when no
+    # actions are given anywhere.
     template: PodTemplate = dataclasses.field(default_factory=PodTemplate)
     parallelism: int = 4
     max_failed_nodes: int = 0

@@ -140,6 +140,48 @@ class Engine:
         self.waiting.append(req)
         return req.id
 
+    def add_request_with_prefix(self, prompt: List[int],
+                                sampling: Optional[SamplingParams],
+                                prefix_len: int,
+                                k_data, v_data) -> Optional[int]:
+        """Admit a request whose first ``prefix_len`` tokens' KV arrives
+        precomputed (fetched from the shared KV pool — the Mooncake-reuse
+        path, keps/74): the pages are written into the local pool and
+        prefill resumes at ``prefix_len``. ``prefix_len`` must be
+        page-aligned and < len(prompt) (the last token always prefills for
+        logits). Returns None when no pages are free (caller falls back to
+        a cold prefill through the normal admission queue)."""
+        sampling = sampling or SamplingParams()
+        ps = self.cfg.page_size
+        if prefix_len % ps or not 0 < prefix_len < len(prompt):
+            raise ValueError(f"prefix_len {prefix_len} must be page-aligned "
+                             f"and in (0, {len(prompt)})")
+        if len(prompt) + sampling.max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError("prompt+max_new_tokens exceeds max_seq_len")
+        need = pages_for_tokens(len(prompt) + 1, ps)
+        pages = self._alloc(need)
+        if pages is None:
+            return None
+        n_prefix = prefix_len // ps
+        ids = jnp.asarray(pages[:n_prefix], jnp.int32)
+        self.cache = PagedKVCache(
+            k_pages=self.cache.k_pages.at[:, ids].set(
+                jnp.asarray(k_data, self.cache.k_pages.dtype)),
+            v_pages=self.cache.v_pages.at[:, ids].set(
+                jnp.asarray(v_data, self.cache.v_pages.dtype)),
+            k_scales=self.cache.k_scales, v_scales=self.cache.v_scales,
+        )
+        req = Request(prompt, sampling)
+        req.pages = pages
+        req.prefill_pos = prefix_len
+        req.seq_len = prefix_len
+        req.state = "prefill"
+        self.requests[req.id] = req
+        self.running.append(req)
+        self.metrics["pool_hit_tokens"] = (
+            self.metrics.get("pool_hit_tokens", 0) + prefix_len)
+        return req.id
+
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 

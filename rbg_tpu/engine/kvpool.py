@@ -1,0 +1,283 @@
+"""Shared cross-request KV pool — the Mooncake-store analog.
+
+Reference context: the reference's flagship ecosystem path is a SHARED,
+cross-request KV store with prefix reuse (``keps/74-mooncake-integration/
+README.md``, ``examples/inference/ecosystem/mooncake/mooncake-store/
+pd-disagg-kvcache-reuse-with-mooncake.yaml``): prefill nodes publish
+computed prefix KV; later requests with a common prefix fetch it instead of
+recomputing, across ALL prefill replicas (the per-engine radix cache only
+reuses within one process).
+
+Pieces:
+
+* ``KVPoolStore``  — host-memory page store: a token-trie over page-aligned
+  prefixes (one node per page), LRU-evicted against a byte budget. Values
+  are numpy ``[L, page, KV, hd]`` page pairs — host RAM is the pool's
+  medium (Mooncake's DRAM/SSD tier analog); the TPU HBM pool stays private
+  to each engine.
+* ``KVPoolServer`` — the ``kv-pool`` role's process: TCP service on the
+  plane's discovery fabric (``python -m rbg_tpu.engine.kvpool``), ops
+  ``pool_match`` / ``pool_put`` / ``pool_stats`` over the same length-
+  prefixed wire protocol the PD path uses.
+* ``KVPoolClient`` — used by prefill workers: consult before computing,
+  export after.
+
+Transfer format matches ``pd.KVBundle`` framing: one contiguous K block +
+one V block per message (``protocol.send_msg`` binary lanes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from rbg_tpu.engine.protocol import recv_msg, send_msg
+
+
+class _Node:
+    __slots__ = ("key", "k", "v", "children", "parent", "last_used", "nbytes")
+
+    def __init__(self, key: Tuple[int, ...], parent):
+        self.key = key                    # page_size tokens
+        self.k: Optional[np.ndarray] = None   # [L, page, KV, hd]
+        self.v: Optional[np.ndarray] = None
+        self.children: Dict[int, "_Node"] = {}
+        self.parent = parent
+        self.last_used = time.monotonic()
+        self.nbytes = 0
+
+
+class KVPoolStore:
+    """Page-granular prefix trie with LRU byte-budget eviction."""
+
+    def __init__(self, page_size: int, max_bytes: int = 1 << 30):
+        self.page_size = page_size
+        self.max_bytes = max_bytes
+        self.root = _Node((), None)
+        self.bytes = 0
+        self._lock = threading.Lock()
+        self.metrics = {"hits": 0, "misses": 0, "hit_tokens": 0,
+                        "put_pages": 0, "evicted_pages": 0, "pages": 0}
+
+    # ---- lookup ----
+
+    def match(self, tokens: List[int]) -> Tuple[int, Optional[np.ndarray],
+                                                Optional[np.ndarray]]:
+        """Longest page-aligned stored prefix of ``tokens``. Returns
+        (matched_tokens, k [L, n_pages, page, KV, hd], v) — None arrays on
+        a miss."""
+        ps = self.page_size
+        with self._lock:
+            node = self.root
+            ks, vs = [], []
+            i, n = 0, (len(tokens) // ps) * ps
+            now = time.monotonic()
+            while i < n:
+                child = node.children.get(tuple(tokens[i:i + ps]))
+                if child is None:
+                    break
+                child.last_used = now
+                ks.append(child.k)
+                vs.append(child.v)
+                i += ps
+                node = child
+            if not ks:
+                self.metrics["misses"] += 1
+                return 0, None, None
+            self.metrics["hits"] += 1
+            self.metrics["hit_tokens"] += i
+            return i, np.stack(ks, axis=1), np.stack(vs, axis=1)
+
+    # ---- insert ----
+
+    def put(self, tokens: List[int], k: np.ndarray, v: np.ndarray) -> int:
+        """Store the page-aligned prefix of ``tokens``; ``k``/``v`` are
+        ``[L, n_pages, page, KV, hd]`` covering exactly those pages.
+        Existing pages are refreshed (LRU), not duplicated. Returns pages
+        newly stored."""
+        ps = self.page_size
+        n = min((len(tokens) // ps) * ps, k.shape[1] * ps)
+        new_pages = 0
+        with self._lock:
+            node = self.root
+            now = time.monotonic()
+            for pi in range(n // ps):
+                i = pi * ps
+                key = tuple(tokens[i:i + ps])
+                child = node.children.get(key)
+                if child is not None:
+                    child.last_used = now
+                    node = child
+                    continue
+                # Children are keyed by the FULL page's tokens: prompts
+                # sharing a first token but diverging inside a page coexist
+                # as siblings instead of clobbering each other.
+                child = _Node(key, node)
+                child.k = np.ascontiguousarray(k[:, pi])
+                child.v = np.ascontiguousarray(v[:, pi])
+                child.nbytes = child.k.nbytes + child.v.nbytes
+                child.last_used = now
+                node.children[key] = child
+                self.bytes += child.nbytes
+                new_pages += 1
+                node = child
+            self.metrics["put_pages"] += new_pages
+            self.metrics["pages"] += new_pages
+            self._evict_locked()
+        return new_pages
+
+    # ---- eviction ----
+
+    def _evict_locked(self):
+        while self.bytes > self.max_bytes:
+            leaf = self._lru_leaf()
+            if leaf is None:
+                return
+            leaf.parent.children.pop(leaf.key, None)
+            self.bytes -= leaf.nbytes
+            self.metrics["evicted_pages"] += 1
+            self.metrics["pages"] -= 1
+
+    def _lru_leaf(self) -> Optional[_Node]:
+        best, best_t = None, None
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            if node is not self.root and not node.children:
+                if best_t is None or node.last_used < best_t:
+                    best, best_t = node, node.last_used
+            stack.extend(node.children.values())
+        return best
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {**self.metrics, "bytes": self.bytes,
+                    "max_bytes": self.max_bytes}
+
+
+# ---- wire service ----
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        store: KVPoolStore = self.server.store
+        while True:
+            try:
+                obj, k, v = recv_msg(self.request)
+            except (ConnectionError, json.JSONDecodeError):
+                return
+            if obj is None:
+                return
+            op = obj.get("op")
+            ps = obj.get("page_size")
+            if (op in ("pool_match", "pool_put") and ps is not None
+                    and ps != store.page_size):
+                # Page-size handshake: a mismatched client would interpret
+                # the page arrays wrong (silently corrupt KV) — refuse.
+                send_msg(self.request, {"error": (
+                    f"page_size mismatch: pool={store.page_size} "
+                    f"client={ps}")})
+                continue
+            if op == "pool_match":
+                matched, km, vm = store.match(obj["prompt"])
+                if matched == 0:
+                    send_msg(self.request, {"matched": 0})
+                else:
+                    send_msg(self.request, {
+                        "matched": matched,
+                        "k_shape": list(km.shape), "v_shape": list(vm.shape),
+                        "dtype": str(km.dtype),
+                    }, km.tobytes(), vm.tobytes())
+            elif op == "pool_put":
+                ks = np.frombuffer(k, dtype=obj["dtype"]).reshape(obj["k_shape"])
+                vs = np.frombuffer(v, dtype=obj["dtype"]).reshape(obj["v_shape"])
+                stored = store.put(obj["prompt"], ks, vs)
+                send_msg(self.request, {"stored_pages": stored})
+            elif op == "pool_stats" or op == "metrics":
+                send_msg(self.request, {"metrics": store.stats(),
+                                        "mode": "kvpool"})
+            elif op == "health":
+                send_msg(self.request, {"ok": True, "mode": "kvpool"})
+            else:
+                send_msg(self.request, {"error": f"unsupported op {op!r}"})
+
+
+class KVPoolServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, store: KVPoolStore):
+        super().__init__(addr, _Handler)
+        self.store = store
+
+
+class KVPoolClient:
+    """Prefill-side client. One short-lived connection per op (the ops are
+    rare relative to decode steps: once per admitted prompt)."""
+
+    def __init__(self, addr: str, timeout: float = 30.0,
+                 page_size: Optional[int] = None):
+        host, port = addr.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self.timeout = timeout
+        self.page_size = page_size   # engine's page size; server verifies
+
+    def _roundtrip(self, obj, k=None, v=None):
+        if self.page_size is not None:
+            obj["page_size"] = self.page_size
+        with socket.create_connection(self.addr, timeout=self.timeout) as s:
+            send_msg(s, obj, k, v)
+            return recv_msg(s)
+
+    def match(self, prompt: List[int]):
+        obj, k, v = self._roundtrip({"op": "pool_match", "prompt": list(prompt)})
+        if obj.get("error"):
+            raise RuntimeError(obj["error"])
+        if obj["matched"] == 0:
+            return 0, None, None
+        km = np.frombuffer(k, dtype=obj["dtype"]).reshape(obj["k_shape"])
+        vm = np.frombuffer(v, dtype=obj["dtype"]).reshape(obj["v_shape"])
+        return obj["matched"], km, vm
+
+    def put(self, prompt: List[int], k: np.ndarray, v: np.ndarray) -> int:
+        k = np.ascontiguousarray(k)
+        v = np.ascontiguousarray(v)
+        obj, _, _ = self._roundtrip({
+            "op": "pool_put", "prompt": list(prompt),
+            "k_shape": list(k.shape), "v_shape": list(v.shape),
+            "dtype": str(k.dtype),
+        }, k.tobytes(), v.tobytes())
+        if obj.get("error"):
+            raise RuntimeError(obj["error"])
+        return obj["stored_pages"]
+
+    def stats(self) -> dict:
+        obj, _, _ = self._roundtrip({"op": "pool_stats"})
+        return obj.get("metrics", {})
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("rbg-tpu kv-pool server")
+    ap.add_argument("--port", type=int, default=9100)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--max-bytes", type=int, default=1 << 30)
+    args = ap.parse_args(argv)
+    store = KVPoolStore(args.page_size, max_bytes=args.max_bytes)
+    srv = KVPoolServer(("0.0.0.0", args.port), store)
+    print(f"kv-pool serving on :{args.port}", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,17 +46,53 @@ class KVBundle:
 
 
 class PrefillWorker:
-    def __init__(self, cfg: EngineConfig, params: Optional[dict] = None, mesh=None):
+    def __init__(self, cfg: EngineConfig, params: Optional[dict] = None,
+                 mesh=None, pool=None):
+        """``pool``: optional ``rbg_tpu.engine.kvpool.KVPoolClient`` — the
+        SHARED cross-request/cross-replica prefix store (Mooncake-store
+        analog, keps/74). Consulted before computing, published to after.
+        Pool failures degrade to cold prefill, never to request failure."""
         cfg = dataclasses.replace(cfg, mode="prefill")
         self.engine = Engine(cfg, params=params, mesh=mesh)
-        self.metrics = {"bundles": 0, "bytes_out": 0, "transfer_s": 0.0}
+        self.pool = pool
+        if pool is not None and getattr(pool, "page_size", None) is None:
+            pool.page_size = cfg.page_size  # handshake: server verifies
+        self.metrics = {"bundles": 0, "bytes_out": 0, "transfer_s": 0.0,
+                        "pool_hits": 0, "pool_hit_tokens": 0,
+                        "pool_exports": 0, "pool_errors": 0}
 
     def prefill(self, prompt: List[int],
                 sampling: Optional[SamplingParams] = None) -> KVBundle:
         """Run one prompt to its first token; export KV pages."""
         sampling = sampling or SamplingParams()
         one = dataclasses.replace(sampling, max_new_tokens=1)
-        rid = self.engine.add_request(prompt, one)
+        ps = self.engine.cfg.page_size
+        rid = None
+        matched = 0
+        if self.pool is not None:
+            # Keep at least the prompt's last token for prefill (logits) —
+            # same contract as the in-process radix cache.
+            try:
+                matched, kd, vd = self.pool.match(prompt[:-1])
+            except (OSError, RuntimeError):
+                self.metrics["pool_errors"] += 1
+                matched = 0
+            if matched:
+                try:
+                    rid = self.engine.add_request_with_prefix(
+                        prompt, one, matched, kd, vd)
+                except ValueError:
+                    # Malformed pool data (e.g. misaligned prefix) must
+                    # degrade to a cold prefill, never fail the request.
+                    self.metrics["pool_errors"] += 1
+                    rid = None
+                if rid is None:
+                    matched = 0  # no free pages / bad data: cold prefill
+                else:
+                    self.metrics["pool_hits"] += 1
+                    self.metrics["pool_hit_tokens"] += matched
+        if rid is None:
+            rid = self.engine.add_request(prompt, one)
         first = None
         while first is None:
             for ev in self.engine.step():
@@ -70,6 +106,16 @@ class PrefillWorker:
         v = np.asarray(self.engine.cache.v_pages[:, page_ids])
         self.metrics["transfer_s"] += time.perf_counter() - t0
         self.engine.release_request(rid)
+        if self.pool is not None:
+            # Publish the page-aligned prompt prefix for future requests
+            # (idempotent: the store refreshes rather than duplicates).
+            full = len(prompt) // ps
+            if full > matched // ps:
+                try:
+                    self.pool.put(prompt, k[:, :full], v[:, :full])
+                    self.metrics["pool_exports"] += 1
+                except (OSError, RuntimeError):
+                    self.metrics["pool_errors"] += 1
         bundle = KVBundle(prompt=list(prompt), first_token=first, k_data=k, v_data=v)
         self.metrics["bundles"] += 1
         self.metrics["bytes_out"] += bundle.nbytes

@@ -107,33 +107,34 @@ class Handler(socketserver.BaseRequestHandler):
                 send_msg(self.request, {"error": f"router: unsupported op {op!r}"})
                 continue
             try:
-                send_msg(self.request, self._generate(state, obj))
+                if obj.get("stream"):
+                    self._generate_stream(state, obj)
+                else:
+                    send_msg(self.request, self._generate(state, obj))
             except Exception as e:
                 state.metrics["errors"] += 1
-                send_msg(self.request, {"error": str(e)})
+                send_msg(self.request, {"error": str(e), "done": True})
 
-    def _generate(self, state: RouterState, obj: dict) -> dict:
+    def _route(self, state: RouterState, obj: dict):
+        """Resolve the backend leg shared by blocking and streaming paths.
+        PD mode runs the (always blocking) prefill hop here; returns
+        (addr, (header, k_bytes, v_bytes)) for the final leg."""
         state.metrics["requests"] += 1
-        t0 = time.perf_counter()
         if state.pd_mode():
             state.metrics["pd_requests"] += 1
-            prefill_addr = state.pick("prefill")
-            decode_addr = state.pick("decode")
-            hdr, kb, vb = request_once(prefill_addr, {"op": "prefill",
-                                                      "prompt": obj["prompt"]})
+            hdr, kb, vb = request_once(state.pick("prefill"),
+                                       {"op": "prefill",
+                                        "prompt": obj["prompt"]})
             if hdr is None or "error" in hdr:
                 raise RuntimeError(f"prefill failed: {hdr}")
             state.metrics["kv_bytes_routed"] += len(kb or b"") + len(vb or b"")
             fwd = dict(hdr)
             fwd["op"] = "decode_bundle"
-            for key in ("max_new_tokens", "temperature", "top_k", "stop_token"):
+            for key in ("max_new_tokens", "temperature", "top_k",
+                        "stop_token", "stream"):
                 if key in obj:
                     fwd[key] = obj[key]
-            resp, _, _ = request_once(decode_addr, fwd, kb, vb)
-            if resp is None or "error" in resp:
-                raise RuntimeError(f"decode failed: {resp}")
-            resp["ttft_s"] = time.perf_counter() - t0
-            return resp
+            return state.pick("decode"), (fwd, kb, vb)
         worker = state.pick("worker") or state.pick("server")
         if worker is None:
             # fall back to any non-router role present
@@ -145,10 +146,39 @@ class Handler(socketserver.BaseRequestHandler):
                     break
         if worker is None:
             raise RuntimeError("no backends available")
-        resp, _, _ = request_once(worker, obj)
+        return worker, (obj, None, None)
+
+    def _generate(self, state: RouterState, obj: dict) -> dict:
+        t0 = time.perf_counter()
+        pd = state.pd_mode()
+        addr, payload = self._route(state, obj)
+        resp, _, _ = request_once(addr, *payload)
         if resp is None:
             raise RuntimeError("backend closed connection")
+        if pd:
+            if "error" in resp:
+                raise RuntimeError(f"decode failed: {resp}")
+            resp["ttft_s"] = time.perf_counter() - t0
         return resp
+
+
+    def _generate_stream(self, state: RouterState, obj: dict) -> None:
+        """Streaming generate: relay incremental token frames from the
+        backend to the client (feeds the SSE front end). PD mode streams
+        the decode leg; the prefill leg is one blocking hop (its product is
+        the first token + KV)."""
+        import socket as _socket
+        addr, payload = self._route(state, obj)
+        host, port = addr.rsplit(":", 1)
+        with _socket.create_connection((host, int(port)), timeout=300) as s:
+            send_msg(s, *payload)
+            while True:
+                frame, _, _ = recv_msg(s)
+                if frame is None:
+                    raise RuntimeError("backend closed mid-stream")
+                send_msg(self.request, frame)
+                if frame.get("done") or "error" in frame:
+                    return
 
 
 class RouterServer(socketserver.ThreadingTCPServer):

@@ -52,6 +52,38 @@ class Handler(socketserver.BaseRequestHandler):
             except Exception as e:
                 send_msg(self.request, {"error": str(e)})
 
+    def _stream_pending(self, service, pending, first_tokens=()):
+        """Relay a pending generation as incremental token-batch messages:
+        ``{"tokens": [...], "done": false}``* then a final ``done`` frame
+        with ttft. The transport framing the SSE front end rides on."""
+        import time as _time
+
+        from rbg_tpu.engine.service import DEFAULT_TIMEOUT_S
+        if first_tokens:
+            send_msg(self.request, {"tokens": list(first_tokens),
+                                    "done": False})
+        sent = 0
+        deadline = _time.monotonic() + DEFAULT_TIMEOUT_S
+        while True:
+            done = pending.done.is_set()
+            if done and pending.error:
+                send_msg(self.request, {"error": pending.error, "done": True})
+                return
+            tokens = list(pending.tokens)
+            if len(tokens) > sent:
+                send_msg(self.request, {"tokens": tokens[sent:], "done": False})
+                sent = len(tokens)
+            if done and sent == len(pending.tokens):
+                break
+            if _time.monotonic() > deadline:
+                service.cancel(pending)  # recycle slot + pages
+                send_msg(self.request, {"error": "generation timed out",
+                                        "done": True})
+                return
+            _time.sleep(0.005)
+        ttft = (pending.t_first - pending.t_submit) if pending.t_first else 0.0
+        send_msg(self.request, {"tokens": [], "done": True, "ttft_s": ttft})
+
     def _dispatch(self, srv, obj, k, v):
         op = obj.get("op")
         if op == "health":
@@ -102,32 +134,9 @@ class Handler(socketserver.BaseRequestHandler):
                 stop_token=obj.get("stop_token"),
             )
             if obj.get("stream"):
-                import time as _time
-                from rbg_tpu.engine.service import DEFAULT_TIMEOUT_S
-                pending = srv.service.submit_async(obj["prompt"], sampling)
-                sent = 0
-                deadline = _time.monotonic() + DEFAULT_TIMEOUT_S
-                while True:
-                    done = pending.done.is_set()
-                    if done and pending.error:
-                        send_msg(self.request, {"error": pending.error,
-                                                "done": True})
-                        return
-                    tokens = list(pending.tokens)
-                    if len(tokens) > sent:
-                        send_msg(self.request,
-                                 {"tokens": tokens[sent:], "done": False})
-                        sent = len(tokens)
-                    if done and sent == len(pending.tokens):
-                        break
-                    if _time.monotonic() > deadline:
-                        srv.service.cancel(pending)  # recycle slot + pages
-                        send_msg(self.request, {"error": "generation timed out",
-                                                "done": True})
-                        return
-                    _time.sleep(0.005)
-                ttft = (pending.t_first - pending.t_submit) if pending.t_first else 0.0
-                send_msg(self.request, {"tokens": [], "done": True, "ttft_s": ttft})
+                self._stream_pending(
+                    srv.service, srv.service.submit_async(obj["prompt"],
+                                                          sampling))
                 return
             tokens, ttft = srv.service.submit(obj["prompt"], sampling)
             send_msg(self.request, {"tokens": tokens, "ttft_s": ttft})
@@ -148,8 +157,16 @@ class Handler(socketserver.BaseRequestHandler):
             )
             # Continuous batching: bundles from concurrent connections decode
             # together on the device (no per-connection serialization).
+            if obj.get("stream"):
+                # A bundle finished at inject (max_new_tokens == 1 / stop
+                # token) resolves with done set and no tokens — the stream
+                # then carries only the first_token frame.
+                self._stream_pending(srv.decode,
+                                     srv.decode.submit_async(bundle, sampling),
+                                     first_tokens=[bundle.first_token])
+                return
             tokens = srv.decode.submit_bundle(bundle, sampling)
-            send_msg(self.request, {"tokens": tokens})
+            send_msg(self.request, {"tokens": tokens}, )
             return
         send_msg(self.request, {"error": f"unsupported op {op!r} in mode {srv.mode}"})
 
@@ -191,7 +208,13 @@ def serve(args) -> None:
                 server.tokenizer = load_tokenizer(args.tokenizer_path)
             if cfg.mode == "prefill":
                 from rbg_tpu.engine.pd import PrefillWorker
-                server.prefill = PrefillWorker(cfg)
+                pool = None
+                pool_addr = args.kv_pool or os.environ.get(
+                    "RBG_KV_POOL_ADDR", "")
+                if pool_addr:
+                    from rbg_tpu.engine.kvpool import KVPoolClient
+                    pool = KVPoolClient(pool_addr)
+                server.prefill = PrefillWorker(cfg, pool=pool)
             elif cfg.mode == "decode":
                 from rbg_tpu.engine.service import DecodeService
                 server.decode = DecodeService(cfg)
@@ -232,6 +255,10 @@ def main(argv=None) -> int:
     ap.add_argument("--tokenizer-path",
                     default=os.environ.get("RBG_TOKENIZER_PATH", ""),
                     help="local HF tokenizer dir (else byte-level fallback)")
+    ap.add_argument("--kv-pool",
+                    default=os.environ.get("RBG_KV_POOL_ADDR", ""),
+                    help="host:port of the shared KV pool (prefill mode; "
+                         "Mooncake-store analog, rbg_tpu.engine.kvpool)")
     args = ap.parse_args(argv)
     serve(args)
     return 0

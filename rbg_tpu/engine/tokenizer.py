@@ -47,6 +47,60 @@ class HFTokenizer:
         return self._tok.decode(ids, skip_special_tokens=True)
 
 
+class IncrementalDetokenizer:
+    """Streaming token→text decoding that never emits half a character.
+
+    Feed token ids as they arrive; ``feed`` returns the newly-safe text
+    delta. A decode ending in U+FFFD (replacement char) is held back — the
+    token that completes the multi-byte sequence (or multi-token grapheme,
+    for HF BPE) will release it. ``flush`` force-emits the remainder.
+
+    The concatenation of all deltas equals ``tokenizer.decode(all_ids)``
+    exactly (modulo a trailing U+FFFD only when the stream itself ends
+    mid-character)."""
+
+    # Tail tokens kept as decode context after a commit; commits trigger at
+    # twice this. Bounds per-feed work to O(window) instead of re-decoding
+    # the whole stream (O(n²) over a long completion).
+    WINDOW = 16
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self._tail: List[int] = []   # un-committed trailing ids
+        self._emitted = 0            # chars of decode(self._tail) emitted
+
+    def feed(self, ids) -> str:
+        if isinstance(ids, int):
+            ids = [ids]
+        self._tail.extend(ids)
+        text = self._tok.decode(self._tail)
+        safe = len(text)
+        while safe > self._emitted and text[safe - 1] == "�":
+            safe -= 1   # incomplete sequence pending more tokens
+        delta = text[self._emitted:safe]
+        self._emitted = safe
+        if len(self._tail) > 2 * self.WINDOW and safe == len(text):
+            self._commit(text)
+        return delta
+
+    def _commit(self, text: str) -> None:
+        """Drop fully-emitted leading ids, keeping WINDOW ids of context.
+        Only commits when the tail re-decodes to a clean suffix of the full
+        text (BPE boundary tokens can decode differently without their left
+        context — then skip and retry at a later boundary)."""
+        keep = self._tail[-self.WINDOW:]
+        suffix = self._tok.decode(keep)
+        if suffix and text.endswith(suffix):
+            self._tail = keep
+            self._emitted -= len(text) - len(suffix)
+
+    def flush(self) -> str:
+        text = self._tok.decode(self._tail)
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        return delta
+
+
 def load_tokenizer(path: Optional[str] = None):
     if path and os.path.isdir(path):
         return HFTokenizer(path)

@@ -96,7 +96,8 @@ class RoleInstanceController(Controller):
                 if p.running_ready and p.node_name:
                     node = store.get("Node", "default", p.node_name, copy_=False)
                     if node is not None:
-                        self.node_binding.record(p, node)
+                        self.node_binding.record(
+                            p, node, annotations=inst.metadata.annotations)
                         if node.tpu.slice_id and inst.status.slice_id != node.tpu.slice_id:
                             # Continue the reconcile with the fresh stored
                             # snapshot — `inst` was fetched copy_=False and
@@ -437,8 +438,11 @@ class RoleInstanceController(Controller):
             self.ports.inject_pod_ports(inst, pod)
 
         if self.node_binding is not None:
-            pod.affinity.extend(self.node_binding.affinity_terms(pod))
-            slice_id = self.node_binding.preferred_slice(pod) or inst.status.slice_id
+            ann = inst.metadata.annotations
+            pod.affinity.extend(self.node_binding.affinity_terms(
+                pod, annotations=ann))
+            slice_id = (self.node_binding.preferred_slice(pod, annotations=ann)
+                        or inst.status.slice_id)
             if slice_id:
                 pod.metadata.annotations[C.ANN_SLICE_BINDING] = slice_id
 

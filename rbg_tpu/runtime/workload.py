@@ -149,9 +149,12 @@ class InstanceSetBackend(WorkloadBackend):
         annotations = {}
         if gang:
             annotations[C.ANN_GANG_SCHEDULING] = rbg.metadata.name
-        for k, v in rbg.metadata.annotations.items():
-            if k.startswith(C.DOMAIN) and k != C.ANN_GANG_SCHEDULING:
-                annotations.setdefault(k, v)
+        # Role-scoped config annotations win over group-scoped defaults
+        # (e.g. per-role in-place-scheduling mode/avoid labels, KEP-351).
+        for source in (role.template.annotations, rbg.metadata.annotations):
+            for k, v in source.items():
+                if k.startswith(C.DOMAIN) and k != C.ANN_GANG_SCHEDULING:
+                    annotations.setdefault(k, v)
 
         rolling = _copy.deepcopy(role.rolling_update)
         if partition is not None:

@@ -1,11 +1,28 @@
 """Node-binding store: warm-placement memory for in-place scheduling.
 
 Reference analog: ``pkg/reconciler/roleinstance/sync/node_binding.go``
-(inventory #14, KEP-351): an in-memory map of where a group's instances last
-ran Running+Ready, injected as node affinity on recreation so pods return to
-warm nodes. TPU extension (SURVEY.md §7 "hard parts"): bindings are recorded
-at **slice granularity** — a recovered multi-host instance must re-acquire the
-*same slice* (same ICI domain) to reuse host-side HBM state and XLA caches.
+(inventory #14, KEP-351): an in-memory map of where a group's pods last ran
+Running+Ready, injected as node affinity on recreation so pods return to
+warm nodes. Reference-parity features:
+
+* **Granularity** (``node_binding.go:191``): ``Pod`` — one binding per pod
+  name (stateful sets: deterministic names reattach to their own node);
+  ``Component`` — pods of a role+component share one accumulating node set
+  (stateless: random names, any warm node of the component will do). Unset
+  = auto: stateful (has the instance-index label) → Pod, else Component.
+* **Mode** (``node_binding.go:276``): ``Preferred`` (weight-scored) or
+  ``Required`` (hard constraint). Deviation from the reference: unset means
+  Preferred here, not off — on TPU the warm host holds the XLA compile
+  cache and staged weights, so warm rebinding is the default posture.
+  ``Disabled`` opts out.
+* **Avoid labels** (``:276`` step 3, ``foldIntoRequired:409``): annotation
+  lists label keys; each becomes a REQUIRED DoesNotExist term. Our affinity
+  model ANDs all required terms (no K8s OR-of-terms), so the reference's
+  fold-into-every-term is the native semantic here.
+
+TPU extension (SURVEY.md §7 "hard parts"): bindings also record **slice**
+identity — a recovered multi-host instance must re-acquire the *same slice*
+(same ICI domain) to reuse host-side HBM state and XLA caches.
 
 Non-durable by design; reseeded from live pods after a controller restart
 (reference: ``node_binding.go:200-204``).
@@ -14,71 +31,123 @@ Non-durable by design; reseeded from live pods after a controller restart
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Set, Tuple
+from typing import Dict, Optional, Set
 
 from rbg_tpu.api import constants as C
 from rbg_tpu.api.pod import NodeAffinityTerm
+
+GRANULARITY_POD = "Pod"
+GRANULARITY_COMPONENT = "Component"
+MODE_PREFERRED = "Preferred"
+MODE_REQUIRED = "Required"
+MODE_DISABLED = "Disabled"
+
+
+def resolve_granularity(pod, annotations: Optional[dict] = None) -> str:
+    """Reference ``resolveGranularity`` (``node_binding.go:191``): explicit
+    annotation wins; else stateful pods (instance-index label) bind per-Pod
+    and stateless per-Component."""
+    g = (annotations or {}).get(C.ANN_INPLACE_SCHEDULING_GRANULARITY, "")
+    if g in (GRANULARITY_POD, GRANULARITY_COMPONENT):
+        return g
+    if C.LABEL_INSTANCE_INDEX in pod.metadata.labels:
+        return GRANULARITY_POD
+    return GRANULARITY_COMPONENT
+
+
+def avoid_terms(annotations: Optional[dict]) -> list:
+    """DoesNotExist terms from the avoid annotation (comma-separated label
+    keys). Always REQUIRED — ANDed with everything else."""
+    raw = (annotations or {}).get(C.ANN_INPLACE_SCHEDULING_AVOID, "")
+    out = []
+    for key in raw.split(","):
+        key = key.strip()
+        if key:
+            out.append(NodeAffinityTerm(key=key, operator="DoesNotExist",
+                                        required=True))
+    return out
 
 
 class NodeBindingStore:
     def __init__(self, store=None):
         self._lock = threading.Lock()
-        # (group_uid, instance) -> set of node names
-        self._nodes: Dict[Tuple[str, str], Set[str]] = {}
-        # (group_uid, instance) -> slice id
-        self._slices: Dict[Tuple[str, str], str] = {}
+        self._nodes: Dict[str, Set[str]] = {}   # scope key -> node names
+        self._slices: Dict[str, str] = {}       # scope key -> slice id
         self._store = store
 
     @staticmethod
-    def _key(pod) -> Optional[Tuple[str, str]]:
-        # Namespace-qualified: a same-named group in another namespace must
-        # neither share nor lose these bindings (review finding).
+    def scope_key(pod, granularity: str) -> Optional[str]:
+        """Reference ``buildKey`` (``node_binding.go:150-186``), namespace-
+        qualified (same-named groups in other namespaces are isolated)."""
         grp = pod.metadata.labels.get(C.LABEL_GROUP_NAME, "")
-        inst = pod.metadata.labels.get(C.LABEL_INSTANCE_NAME, "")
-        if not grp or not inst:
+        if not grp:
             return None
-        return (f"{pod.metadata.namespace}/{grp}", inst)
+        base = f"{pod.metadata.namespace}/{grp}"
+        if granularity == GRANULARITY_POD:
+            return f"{base}/pod/{pod.metadata.name}"
+        role = pod.metadata.labels.get(C.LABEL_ROLE_NAME, "")
+        comp = pod.metadata.labels.get(C.LABEL_COMPONENT_NAME, "")
+        if not role or not comp:
+            return None
+        return f"{base}/comp/{role}-{comp}"
 
-    def record(self, pod, node) -> None:
+    def record(self, pod, node, annotations: Optional[dict] = None) -> None:
         """Record a Running+Ready pod's placement."""
-        key = self._key(pod)
-        if key is None or node is None:
+        if node is None:
+            return
+        key = self.scope_key(pod, resolve_granularity(pod, annotations))
+        if key is None:
             return
         with self._lock:
             self._nodes.setdefault(key, set()).add(node.metadata.name)
             if node.tpu.slice_id:
                 self._slices[key] = node.tpu.slice_id
 
-    def preferred_nodes(self, pod) -> Set[str]:
-        key = self._key(pod)
+    def preferred_nodes(self, pod, annotations: Optional[dict] = None) -> Set[str]:
+        key = self.scope_key(pod, resolve_granularity(pod, annotations))
         with self._lock:
             return set(self._nodes.get(key, ())) if key else set()
 
-    def preferred_slice(self, pod) -> Optional[str]:
-        key = self._key(pod)
+    def preferred_slice(self, pod,
+                        annotations: Optional[dict] = None) -> Optional[str]:
+        key = self.scope_key(pod, resolve_granularity(pod, annotations))
         with self._lock:
             return self._slices.get(key) if key else None
 
-    def affinity_terms(self, pod) -> list:
-        """Preferred affinity to historical nodes (never Required — warm nodes
-        may be gone; reference folds to Required only for explicit policies)."""
-        nodes = self.preferred_nodes(pod)
-        if not nodes:
+    def affinity_terms(self, pod, annotations: Optional[dict] = None) -> list:
+        """Warm-node affinity + avoid constraints for a pod about to be
+        (re)created (reference ``InjectInPlaceScheduling``,
+        ``node_binding.go:276``)."""
+        mode = (annotations or {}).get(C.ANN_INPLACE_SCHEDULING,
+                                       MODE_PREFERRED)
+        if mode not in (MODE_PREFERRED, MODE_REQUIRED):
+            return []           # Disabled / unrecognized: inject nothing
+        # Exclusive-topology pods: the topology constraint owns placement
+        # (reference step 2 — conflicting hard affinities would deadlock).
+        if pod.metadata.annotations.get(C.ANN_EXCLUSIVE_TOPOLOGY):
             return []
-        return [NodeAffinityTerm(key="name", operator="In", values=sorted(nodes), weight=10)]
+        terms = avoid_terms(annotations)
+        nodes = self.preferred_nodes(pod, annotations)
+        if nodes:
+            terms.append(NodeAffinityTerm(
+                key="name", operator="In", values=sorted(nodes),
+                required=(mode == MODE_REQUIRED), weight=10))
+        return terms
 
     def evict_group(self, group: str, namespace: str = "default") -> None:
         """Drop all bindings of a group (on group delete; reference:
         ``rolebasedgroup_controller.go:1024-1040``). Namespace-scoped."""
-        key0 = f"{namespace}/{group}"
+        prefix = f"{namespace}/{group}/"
         with self._lock:
-            for k in [k for k in self._nodes if k[0] == key0]:
+            for k in [k for k in self._nodes if k.startswith(prefix)]:
                 del self._nodes[k]
-            for k in [k for k in self._slices if k[0] == key0]:
+            for k in [k for k in self._slices if k.startswith(prefix)]:
                 del self._slices[k]
 
     def reseed(self, store) -> None:
-        """Rebuild from live Running+Ready pods (controller restart)."""
+        """Rebuild from live Running+Ready pods (controller restart).
+        Granularity auto-resolves from pod labels; explicit per-instance
+        granularity annotations re-apply on the next reconcile's record."""
         nodes = {n.metadata.name: n for n in store.list("Node")}
         with self._lock:
             self._nodes.clear()

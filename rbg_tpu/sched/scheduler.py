@@ -245,6 +245,11 @@ class SchedulerController(Controller):
             name = n.metadata.name
             if (n.tpu.slice_id and n.tpu.slice_id not in forbidden_slices
                     and self._node_ok(group[0], n, excl)
+                    # Required affinity (avoid labels, Required-mode warm
+                    # binding) filters slice hosts too — instance-level
+                    # terms are identical across the gang, so group[0]
+                    # stands for all (same convention as _node_ok above).
+                    and self._required_affinity_ok(group[0], n)
                     and free[name] > 0 and name not in taken and name not in tpu_used):
                 slices[n.tpu.slice_id].append(n)
 
@@ -277,29 +282,34 @@ class SchedulerController(Controller):
             return True
         return False
 
-    def _pick_node(self, pod, nodes, free, excl) -> Optional[str]:
-        def satisfies(term, n) -> bool:
-            val = n.metadata.name if term.key == "name" else n.labels.get(term.key)
-            if term.operator == "In":
-                return val in term.values
-            if term.operator == "NotIn":
-                return val not in term.values
-            if term.operator == "Exists":
-                return val is not None
-            if term.operator == "DoesNotExist":
-                return val is None
-            return True
+    @staticmethod
+    def _term_satisfied(term, n) -> bool:
+        val = n.metadata.name if term.key == "name" else n.labels.get(term.key)
+        if term.operator == "In":
+            return val in term.values
+        if term.operator == "NotIn":
+            return val not in term.values
+        if term.operator == "Exists":
+            return val is not None
+        if term.operator == "DoesNotExist":
+            return val is None
+        return True
 
+    def _required_affinity_ok(self, pod, n) -> bool:
+        return all(self._term_satisfied(t, n)
+                   for t in pod.affinity if t.required)
+
+    def _pick_node(self, pod, nodes, free, excl) -> Optional[str]:
         best, best_score = None, None
         for n in nodes:
             if free.get(n.metadata.name, 0) <= 0 or not self._node_ok(pod, n, excl):
                 continue
             # Required affinity filters candidates; preferred terms score.
-            if any(t.required and not satisfies(t, n) for t in pod.affinity):
+            if not self._required_affinity_ok(pod, n):
                 continue
             score = free[n.metadata.name]
             for term in pod.affinity:
-                if not term.required and satisfies(term, n):
+                if not term.required and self._term_satisfied(term, n):
                     score += 1000 * term.weight
             if best_score is None or score > best_score:
                 best, best_score = n.metadata.name, score

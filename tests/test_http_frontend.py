@@ -1,0 +1,235 @@
+"""OpenAI-compatible HTTP front end (VERDICT r3 missing #7): incremental
+detokenization, completions + SSE streaming, and the PD-disagg streaming
+e2e through real processes."""
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from rbg_tpu.engine.tokenizer import ByteTokenizer, IncrementalDetokenizer
+
+
+# ---- incremental detokenization ----
+
+
+def test_incremental_detok_multibyte_boundaries():
+    tok = ByteTokenizer()
+    text = "héllo wörld 你好"
+    ids = tok.encode(text, add_bos=False)
+    detok = IncrementalDetokenizer(tok)
+    out = []
+    for i in ids:                      # one byte at a time — worst case
+        out.append(detok.feed(i))
+    joined = "".join(out) + detok.flush()
+    assert joined == text
+    # No chunk ever carries a replacement char.
+    assert all("�" not in piece for piece in out)
+
+
+def test_incremental_detok_flush_incomplete_tail():
+    tok = ByteTokenizer()
+    ids = tok.encode("ok 你", add_bos=False)
+    detok = IncrementalDetokenizer(tok)
+    emitted = detok.feed(ids[:-1])     # cut inside the multi-byte char
+    assert emitted == "ok "
+    assert "�" in detok.flush() or detok.flush() == ""
+
+
+def test_incremental_detok_batch_feed_equals_full_decode():
+    tok = ByteTokenizer()
+    ids = tok.encode("streaming § text ≠ batch", add_bos=False)
+    detok = IncrementalDetokenizer(tok)
+    parts = [detok.feed(ids[:7]), detok.feed(ids[7:15]), detok.feed(ids[15:])]
+    assert "".join(parts) + detok.flush() == tok.decode(ids)
+
+
+# ---- subprocess plumbing ----
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_http(port, path="/healthz", timeout=180.0, expect_ok=True):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                body = json.loads(r.read())
+                if not expect_ok or body.get("ok"):
+                    return body
+                last = body
+        except Exception as e:  # noqa: BLE001 — retrying startup probe
+            last = e
+        time.sleep(0.3)
+    raise TimeoutError(f"http {port}{path} never healthy: {last}")
+
+
+def _post(port, path, body, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method="POST",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _sse_events(port, path, body, timeout=300):
+    """POST and parse the SSE stream into a list of JSON payloads."""
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(body).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        events = []
+        buf = b""
+        while True:
+            chunk = resp.read(1)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                raw, buf = buf.split(b"\n\n", 1)
+                if not raw.startswith(b"data: "):
+                    continue
+                payload = raw[len(b"data: "):]
+                if payload == b"[DONE]":
+                    return events, True
+                events.append(json.loads(payload))
+        return events, False
+    finally:
+        conn.close()
+
+
+ENGINE_ARGS = ["--model", "tiny", "--page-size", "8", "--num-pages", "128",
+               "--max-seq-len", "256", "--prefill-chunk", "16",
+               "--use-pallas", "never"]
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """prefill + decode + router + http frontend, all real processes —
+    the pd-disagg-leader-worker.yaml shape with the HTTP edge."""
+    from rbg_tpu.utils import scrubbed_cpu_env
+    env = scrubbed_cpu_env()
+    pf, dc, rt, fe = (_free_port() for _ in range(4))
+    procs = []
+    try:
+        for mode, port in (("prefill", pf), ("decode", dc)):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "rbg_tpu.engine.server",
+                 "--mode", mode, "--port", str(port)] + ENGINE_ARGS, env=env))
+        backends = json.dumps({"prefill": [f"127.0.0.1:{pf}"],
+                               "decode": [f"127.0.0.1:{dc}"]})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "rbg_tpu.engine.router",
+             "--port", str(rt), "--backends", backends], env=env))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "rbg_tpu.engine.http_frontend",
+             "--port", str(fe), "--host", "127.0.0.1",
+             "--backend", f"127.0.0.1:{rt}", "--model", "tiny",
+             "--default-max-tokens", "12"], env=env))
+        # Engines report healthy only once their model is built.
+        from rbg_tpu.engine.protocol import request_once
+        for port in (pf, dc):
+            deadline = time.monotonic() + 240
+            while True:
+                try:
+                    h, _, _ = request_once(f"127.0.0.1:{port}",
+                                           {"op": "health"}, timeout=5)
+                    if h.get("ok"):
+                        break
+                except OSError:
+                    pass
+                assert time.monotonic() < deadline, f"engine {port} not ready"
+                time.sleep(0.5)
+        _wait_http(fe, timeout=240)
+        yield fe
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.mark.e2e
+def test_models_and_health(stack):
+    fe = stack
+    body = _wait_http(fe, "/v1/models", expect_ok=False)
+    assert body["data"][0]["id"] == "tiny"
+
+
+@pytest.mark.e2e
+def test_completions_nonstream_through_pd(stack):
+    fe = stack
+    resp = _post(fe, "/v1/completions",
+                 {"model": "tiny", "prompt": "hello tpu", "max_tokens": 10})
+    assert resp["object"] == "text_completion"
+    choice = resp["choices"][0]
+    assert choice["finish_reason"] in ("length", "stop")
+    assert isinstance(choice["text"], str)
+    assert resp["usage"]["completion_tokens"] == 10
+    assert resp["usage"]["prompt_tokens"] == len("hello tpu")
+
+
+@pytest.mark.e2e
+def test_completions_sse_streaming_matches_nonstream(stack):
+    fe = stack
+    req = {"model": "tiny", "prompt": "stream me", "max_tokens": 12}
+    full = _post(fe, "/v1/completions", req)["choices"][0]["text"]
+
+    events, done = _sse_events(fe, "/v1/completions",
+                               {**req, "stream": True})
+    assert done, "stream must end with [DONE]"
+    text_events = [e for e in events
+                   if e["choices"][0].get("text")]
+    assert len(text_events) >= 2, "streaming must chunk, not one blob"
+    streamed = "".join(e["choices"][0]["text"] for e in events)
+    assert streamed == full
+    assert events[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+
+
+@pytest.mark.e2e
+def test_chat_completions_stream(stack):
+    fe = stack
+    events, done = _sse_events(
+        fe, "/v1/chat/completions",
+        {"model": "tiny", "stream": True, "max_tokens": 8,
+         "messages": [{"role": "user", "content": "hi"}]})
+    assert done
+    assert events[0]["choices"][0]["delta"].get("role") == "assistant"
+    content = "".join(e["choices"][0]["delta"].get("content", "")
+                      for e in events)
+    assert isinstance(content, str)
+    assert events[-1]["object"] == "chat.completion.chunk"
+
+
+def test_incremental_detok_long_stream_commits_window():
+    """The bounded commit window keeps per-feed work O(window) while the
+    emitted stream stays byte-exact over a long generation."""
+    tok = ByteTokenizer()
+    text = ("héllo wörld 你好 " * 200)[:2000]
+    ids = tok.encode(text, add_bos=False)
+    detok = IncrementalDetokenizer(tok)
+    out = []
+    for i in range(0, len(ids), 3):
+        out.append(detok.feed(ids[i:i + 3]))
+    assert "".join(out) + detok.flush() == tok.decode(ids)
+    # The tail must stay bounded (committed), not grow with the stream.
+    assert len(detok._tail) <= 2 * IncrementalDetokenizer.WINDOW + 3

@@ -1,0 +1,302 @@
+"""Shared KV pool (Mooncake-store analog, keps/74): store semantics,
+prefill integration, and the cross-process reuse e2e."""
+
+import socket
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from rbg_tpu.engine import Engine, EngineConfig, SamplingParams
+from rbg_tpu.engine.kvpool import KVPoolClient, KVPoolServer, KVPoolStore
+from rbg_tpu.engine.pd import PrefillWorker
+from rbg_tpu.models import get_config, init_params
+
+PS = 8  # page size everywhere here
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def ecfg(**kw):
+    base = dict(model="tiny", page_size=PS, num_pages=64, max_batch=4,
+                max_seq_len=256, prefill_chunk=16, use_pallas="never")
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def fake_pages(n, L=2, KV=2, hd=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(L, n, PS, KV, hd).astype(np.float32),
+            rng.randn(L, n, PS, KV, hd).astype(np.float32))
+
+
+# ---- store semantics ----
+
+
+def test_store_match_put_page_aligned():
+    st = KVPoolStore(PS)
+    toks = list(range(30))           # 3 full pages + 6 tokens
+    k, v = fake_pages(3)
+    assert st.put(toks, k, v) == 3
+    # Identical prefix: full page-aligned match.
+    m, km, vm = st.match(toks)
+    assert m == 24 and km.shape[1] == 3
+    np.testing.assert_array_equal(km[:, 0], k[:, 0])
+    # Shorter query matches fewer pages.
+    m2, km2, _ = st.match(toks[:17])
+    assert m2 == 16 and km2.shape[1] == 2
+    # Diverging second page stops after page 1.
+    div = toks[:PS] + [99] * PS
+    m3, km3, _ = st.match(div)
+    assert m3 == PS and km3.shape[1] == 1
+    # Complete miss.
+    m4, km4, _ = st.match([99] * 16)
+    assert m4 == 0 and km4 is None
+    # Re-put refreshes, no duplicates.
+    assert st.put(toks, k, v) == 0
+    s = st.stats()
+    assert s["pages"] == 3 and s["hits"] == 3 and s["misses"] == 1
+
+
+def test_store_lru_eviction_by_bytes():
+    k, v = fake_pages(1)
+    page_bytes = k.nbytes + v.nbytes
+    st = KVPoolStore(PS, max_bytes=page_bytes * 2)
+    a, b, c = [list(range(i * 100, i * 100 + PS)) for i in range(3)]
+    st.put(a, *fake_pages(1, seed=1))
+    time.sleep(0.01)
+    st.put(b, *fake_pages(1, seed=2))
+    st.match(a)                      # refresh a → b becomes LRU
+    time.sleep(0.01)
+    st.put(c, *fake_pages(1, seed=3))   # evicts b
+    assert st.match(a)[0] == PS
+    assert st.match(b)[0] == 0
+    assert st.match(c)[0] == PS
+    s = st.stats()
+    assert s["evicted_pages"] == 1 and s["pages"] == 2
+    assert s["bytes"] <= s["max_bytes"]
+
+
+# ---- prefill integration (in-process, two workers sharing one pool) ----
+
+
+def test_second_replica_skips_prefill_through_pool(tiny_setup):
+    cfg, params = tiny_setup
+    srv = KVPoolServer(("127.0.0.1", 0), KVPoolStore(PS))
+    import threading
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        addr = f"127.0.0.1:{srv.server_address[1]}"
+        rng = np.random.RandomState(7)
+        prompt = rng.randint(0, cfg.vocab_size, size=128).tolist()
+
+        w1 = PrefillWorker(ecfg(), params=params, pool=KVPoolClient(addr))
+        b1 = w1.prefill(prompt)
+        assert w1.engine.metrics["prefill_tokens"] == 128
+        assert w1.metrics["pool_exports"] == 1
+
+        # A DIFFERENT worker (fresh engine, empty radix) reuses the pool:
+        # only the last partial page computes -> >=90% of prefill skipped.
+        w2 = PrefillWorker(ecfg(), params=params, pool=KVPoolClient(addr))
+        b2 = w2.prefill(prompt)
+        computed = w2.engine.metrics["prefill_tokens"]
+        assert computed <= 128 * 0.10, f"computed {computed} of 128"
+        assert w2.metrics["pool_hits"] == 1
+        assert w2.metrics["pool_hit_tokens"] == 120
+
+        # Numerics: the reused path produces the SAME first token and the
+        # same exported KV as the cold path.
+        assert b2.first_token == b1.first_token
+        np.testing.assert_allclose(b2.k_data, b1.k_data, rtol=2e-4, atol=2e-4)
+
+        # Prefix (not just identical-prompt) reuse.
+        longer = prompt + rng.randint(0, cfg.vocab_size, size=40).tolist()
+        w3 = PrefillWorker(ecfg(), params=params, pool=KVPoolClient(addr))
+        w3.prefill(longer)
+        assert w3.metrics["pool_hit_tokens"] == 128  # 16 full pages
+        assert w3.engine.metrics["prefill_tokens"] == 40
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_pool_failure_degrades_to_cold_prefill(tiny_setup):
+    cfg, params = tiny_setup
+    # Nothing listens on this port.
+    dead = KVPoolClient("127.0.0.1:1", timeout=0.2)
+    w = PrefillWorker(ecfg(), params=params, pool=dead)
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, cfg.vocab_size, size=64).tolist()
+    b = w.prefill(prompt)
+    assert b.first_token is not None
+    assert w.engine.metrics["prefill_tokens"] == 64
+    assert w.metrics["pool_errors"] >= 1
+
+
+# ---- cross-process e2e: two prefill server replicas + pool + decode ----
+
+
+def _wait_port(port, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"port {port} never opened")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_engine_ready(port, timeout=180.0):
+    from rbg_tpu.engine.protocol import request_once
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            h, _, _ = request_once(f"127.0.0.1:{port}", {"op": "health"},
+                                   timeout=5)
+            if h.get("ok"):
+                return
+        except OSError:
+            pass
+        time.sleep(0.5)
+    raise TimeoutError(f"engine on {port} never ready")
+
+
+@pytest.mark.e2e
+def test_kvpool_reuse_across_real_processes():
+    """BASELINE config 4 shape: the second identical prompt, served by a
+    DIFFERENT prefill replica process, skips >=90% of prefill compute via
+    the shared pool; the exported bundle decodes identically."""
+    from rbg_tpu.engine.protocol import bundle_from_wire, request_once
+    from rbg_tpu.utils import scrubbed_cpu_env
+
+    env = scrubbed_cpu_env()
+    pool_port, p1, p2 = _free_port(), _free_port(), _free_port()
+    engine_args = ["--model", "tiny", "--page-size", str(PS),
+                   "--num-pages", "64", "--max-seq-len", "256",
+                   "--prefill-chunk", "16", "--use-pallas", "never",
+                   "--kv-pool", f"127.0.0.1:{pool_port}"]
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "rbg_tpu.engine.kvpool",
+         "--port", str(pool_port), "--page-size", str(PS)], env=env)]
+    try:
+        _wait_port(pool_port)
+        for port in (p1, p2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "rbg_tpu.engine.server",
+                 "--mode", "prefill", "--port", str(port)] + engine_args,
+                env=env))
+        _wait_engine_ready(p1)
+        _wait_engine_ready(p2)
+
+        rng = np.random.RandomState(11)
+        prompt = rng.randint(0, 256, size=128).tolist()
+
+        h1, k1, v1 = request_once(f"127.0.0.1:{p1}",
+                                  {"op": "prefill", "prompt": prompt})
+        assert "error" not in h1
+        m1, _, _ = request_once(f"127.0.0.1:{p1}", {"op": "metrics"})
+        assert m1["metrics"]["prefill_tokens"] == 128
+        assert m1["metrics"]["pool_exports"] == 1
+
+        h2, k2, v2 = request_once(f"127.0.0.1:{p2}",
+                                  {"op": "prefill", "prompt": prompt})
+        assert "error" not in h2
+        m2, _, _ = request_once(f"127.0.0.1:{p2}", {"op": "metrics"})
+        computed = m2["metrics"]["prefill_tokens"]
+        assert computed <= 128 * 0.10, \
+            f"replica 2 computed {computed}/128 prefill tokens"
+        assert m2["metrics"]["pool_hits"] == 1
+
+        # Same numerics across replicas (same seed -> same params).
+        assert h2["first_token"] == h1["first_token"]
+        b1 = bundle_from_wire(h1, k1, v1)
+        b2 = bundle_from_wire(h2, k2, v2)
+        np.testing.assert_allclose(b2.k_data, b1.k_data, rtol=2e-4, atol=2e-4)
+
+        # Pool-side metrics: one export, one hit.
+        stats = KVPoolClient(f"127.0.0.1:{pool_port}").stats()
+        assert stats["hits"] == 1 and stats["hit_tokens"] == 120
+        assert stats["put_pages"] == 16
+
+        # The reused bundle decodes: feed it to a decode worker in-process
+        # and check the continuation matches the cold bundle's.
+        cfg = get_config("tiny")
+        params = init_params(cfg, jax.random.key(0))
+        from rbg_tpu.engine.pd import DecodeWorker
+        outs = []
+        for b in (b1, b2):
+            dw = DecodeWorker(ecfg(), params=params)
+            rid = dw.inject(b, SamplingParams(max_new_tokens=6))
+            toks = [b.first_token]
+            while dw.engine.has_work():
+                for ev in dw.engine.step():
+                    if ev.request_id == rid:
+                        toks.append(ev.token)
+            outs.append(toks)
+        assert len(outs[0]) == 6 and outs[0] == outs[1]
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_store_sibling_pages_with_shared_first_token_coexist():
+    """Pages sharing a first token but diverging inside the page must
+    coexist (children keyed by full page content) with exact byte
+    accounting."""
+    st = KVPoolStore(PS)
+    a = [7, 1, 2, 3, 4, 5, 6, 7]
+    b = [7, 9, 9, 9, 9, 9, 9, 9]
+    ka, va = fake_pages(1, seed=1)
+    kb, vb = fake_pages(1, seed=2)
+    assert st.put(a, ka, va) == 1
+    assert st.put(b, kb, vb) == 1
+    ma, kma, _ = st.match(a)
+    mb, kmb, _ = st.match(b)
+    assert ma == PS and mb == PS
+    np.testing.assert_array_equal(kma[:, 0], ka[:, 0])
+    np.testing.assert_array_equal(kmb[:, 0], kb[:, 0])
+    s = st.stats()
+    assert s["pages"] == 2
+    assert s["bytes"] == ka.nbytes + va.nbytes + kb.nbytes + vb.nbytes
+
+
+def test_pool_page_size_handshake_rejected(tiny_setup):
+    """A client whose engine page size differs from the pool's is refused
+    (silent reinterpretation would corrupt KV) — and the prefill worker
+    degrades to cold prefill."""
+    cfg, params = tiny_setup
+    import threading
+    srv = KVPoolServer(("127.0.0.1", 0), KVPoolStore(page_size=16))  # != PS
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        addr = f"127.0.0.1:{srv.server_address[1]}"
+        w = PrefillWorker(ecfg(), params=params, pool=KVPoolClient(addr))
+        rng = np.random.RandomState(3)
+        prompt = rng.randint(0, cfg.vocab_size, size=64).tolist()
+        b = w.prefill(prompt)  # must not raise
+        assert b.first_token is not None
+        assert w.engine.metrics["prefill_tokens"] == 64  # cold
+        assert w.metrics["pool_errors"] >= 1
+    finally:
+        srv.shutdown()
+        srv.server_close()

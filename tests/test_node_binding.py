@@ -1,9 +1,11 @@
 """NodeBindingStore depth tests (reference analog:
-``sync/node_binding_test.go``, 1,378 LoC — VERDICT r1 missing#6 test depth).
+``sync/node_binding_test.go``, 1,378 LoC — reference parity matrix:
+granularity modes, avoid labels, Required folding, node_binding.go:191,
+276, 409).
 
-Unit: per-(group, instance) isolation, slice granularity, eviction, reseed.
-Integration: preferred (never required) affinity semantics — a vanished warm
-node must not strand a pod; slice-binding annotations steer placement.
+Unit: pod vs component granularity keys, auto-resolution, mode semantics,
+avoid-label injection, eviction, reseed. Integration: a vanished warm node
+must not strand a pod; slice-binding annotations steer placement.
 """
 
 import pytest
@@ -13,17 +15,23 @@ from rbg_tpu.api.group import RestartPolicyConfig
 from rbg_tpu.api.pod import Node, Pod, TpuNodeInfo
 from rbg_tpu.runtime.plane import ControlPlane
 from rbg_tpu.runtime.store import Store
+from rbg_tpu.sched import binding as B
 from rbg_tpu.sched.binding import NodeBindingStore
 from rbg_tpu.testutil import (
     make_group, make_tpu_nodes, simple_role, tpu_leaderworker_role,
 )
 
 
-def _pod(group, inst, name="p"):
+def _pod(group, name="p", role="r", comp="main", index=None, ns="default"):
     p = Pod()
     p.metadata.name = name
-    p.metadata.namespace = "default"
-    p.metadata.labels = {C.LABEL_GROUP_NAME: group, C.LABEL_INSTANCE_NAME: inst}
+    p.metadata.namespace = ns
+    p.metadata.labels = {C.LABEL_GROUP_NAME: group,
+                         C.LABEL_INSTANCE_NAME: f"{group}-{role}-0",
+                         C.LABEL_ROLE_NAME: role,
+                         C.LABEL_COMPONENT_NAME: comp}
+    if index is not None:
+        p.metadata.labels[C.LABEL_INSTANCE_INDEX] = str(index)
     return p
 
 
@@ -34,71 +42,142 @@ def _node(name, slice_id=""):
     return n
 
 
-class TestUnit:
-    def test_per_instance_isolation(self):
+class TestGranularity:
+    """resolveGranularity + buildKey matrix (node_binding.go:150-205)."""
+
+    def test_auto_stateful_is_pod_stateless_is_component(self):
+        assert B.resolve_granularity(_pod("g", index=0)) == B.GRANULARITY_POD
+        assert B.resolve_granularity(_pod("g")) == B.GRANULARITY_COMPONENT
+
+    def test_explicit_annotation_wins(self):
+        ann = {C.ANN_INPLACE_SCHEDULING_GRANULARITY: B.GRANULARITY_COMPONENT}
+        assert B.resolve_granularity(_pod("g", index=0), ann) == \
+            B.GRANULARITY_COMPONENT
+        ann = {C.ANN_INPLACE_SCHEDULING_GRANULARITY: B.GRANULARITY_POD}
+        assert B.resolve_granularity(_pod("g"), ann) == B.GRANULARITY_POD
+
+    def test_pod_granularity_binds_per_pod_name(self):
         nb = NodeBindingStore()
-        nb.record(_pod("g1", "i1"), _node("n1", "s1"))
-        nb.record(_pod("g1", "i2"), _node("n2", "s2"))
-        nb.record(_pod("g2", "i1"), _node("n3", "s3"))
-        assert nb.preferred_nodes(_pod("g1", "i1")) == {"n1"}
-        assert nb.preferred_slice(_pod("g1", "i1")) == "s1"
-        assert nb.preferred_nodes(_pod("g1", "i2")) == {"n2"}
-        assert nb.preferred_slice(_pod("g2", "i1")) == "s3"
+        nb.record(_pod("g", "s-0", index=0), _node("n1", "s1"))
+        nb.record(_pod("g", "s-1", index=1), _node("n2", "s2"))
+        assert nb.preferred_nodes(_pod("g", "s-0", index=0)) == {"n1"}
+        assert nb.preferred_nodes(_pod("g", "s-1", index=1)) == {"n2"}
+        assert nb.preferred_slice(_pod("g", "s-0", index=0)) == "s1"
+        # A pod name never seen has no binding.
+        assert nb.preferred_nodes(_pod("g", "s-9", index=9)) == set()
+
+    def test_component_granularity_accumulates_across_pod_names(self):
+        nb = NodeBindingStore()
+        nb.record(_pod("g", "a1b2c", comp="worker"), _node("n1"))
+        nb.record(_pod("g", "x9y8z", comp="worker"), _node("n2"))
+        nb.record(_pod("g", "q7w6e", comp="cache"), _node("n3"))
+        # Random stateless names share the component's warm set.
+        assert nb.preferred_nodes(_pod("g", "NEW", comp="worker")) == \
+            {"n1", "n2"}
+        assert nb.preferred_nodes(_pod("g", "NEW", comp="cache")) == {"n3"}
+
+    def test_namespace_and_group_isolation(self):
+        nb = NodeBindingStore()
+        nb.record(_pod("g1", "p", index=0), _node("n1", "s1"))
+        nb.record(_pod("g2", "p", index=0), _node("n2", "s2"))
+        nb.record(_pod("g1", "p", index=0, ns="other"), _node("n3", "s3"))
+        assert nb.preferred_nodes(_pod("g1", "p", index=0)) == {"n1"}
+        assert nb.preferred_slice(_pod("g2", "p", index=0)) == "s2"
+        assert nb.preferred_nodes(_pod("g1", "p", index=0, ns="other")) == {"n3"}
 
     def test_unlabeled_pod_never_recorded(self):
         nb = NodeBindingStore()
         nb.record(Pod(), _node("n1"))
-        assert nb.preferred_nodes(_pod("g", "i")) == set()
+        assert nb.preferred_nodes(_pod("g", "p")) == set()
         assert nb.preferred_slice(Pod()) is None
 
-    def test_multi_host_accumulates_nodes_latest_slice_wins(self):
-        nb = NodeBindingStore()
-        nb.record(_pod("g", "i", "p0"), _node("h0", "sA"))
-        nb.record(_pod("g", "i", "p1"), _node("h1", "sA"))
-        assert nb.preferred_nodes(_pod("g", "i")) == {"h0", "h1"}
-        # instance migrated: new slice replaces the binding
-        nb.record(_pod("g", "i", "p0"), _node("h9", "sB"))
-        assert nb.preferred_slice(_pod("g", "i")) == "sB"
 
-    def test_evict_group_scopes_to_that_group(self):
-        nb = NodeBindingStore()
-        nb.record(_pod("g1", "i"), _node("n1", "s1"))
-        nb.record(_pod("g2", "i"), _node("n2", "s2"))
-        nb.evict_group("g1")
-        assert nb.preferred_nodes(_pod("g1", "i")) == set()
-        assert nb.preferred_slice(_pod("g1", "i")) is None
-        assert nb.preferred_nodes(_pod("g2", "i")) == {"n2"}
+class TestInjection:
+    """InjectInPlaceScheduling matrix (node_binding.go:276-409)."""
 
-    def test_affinity_terms_preferred_never_required(self):
+    def test_preferred_mode_default(self):
         nb = NodeBindingStore()
-        nb.record(_pod("g", "i"), _node("n1"))
-        terms = nb.affinity_terms(_pod("g", "i"))
+        nb.record(_pod("g", "s-0", index=0), _node("n1"))
+        terms = nb.affinity_terms(_pod("g", "s-0", index=0))
         assert len(terms) == 1
         assert terms[0].required is False and terms[0].values == ["n1"]
-        assert nb.affinity_terms(_pod("g", "other")) == []
+        assert nb.affinity_terms(_pod("g", "s-9", index=9)) == []
+
+    def test_required_mode_hard_constraint(self):
+        nb = NodeBindingStore()
+        nb.record(_pod("g", "s-0", index=0), _node("n1"))
+        ann = {C.ANN_INPLACE_SCHEDULING: B.MODE_REQUIRED}
+        terms = nb.affinity_terms(_pod("g", "s-0", index=0), ann)
+        assert len(terms) == 1
+        assert terms[0].required is True and terms[0].values == ["n1"]
+
+    def test_avoid_labels_become_required_doesnotexist(self):
+        nb = NodeBindingStore()
+        nb.record(_pod("g", "s-0", index=0), _node("n1"))
+        ann = {C.ANN_INPLACE_SCHEDULING_AVOID: "maintenance, spot-vm ,"}
+        terms = nb.affinity_terms(_pod("g", "s-0", index=0), ann)
+        avoid = [t for t in terms if t.operator == "DoesNotExist"]
+        assert [t.key for t in avoid] == ["maintenance", "spot-vm"]
+        # Avoid terms are ALWAYS required (AND-folded with everything,
+        # foldIntoRequired:409), even when the warm term is preferred.
+        assert all(t.required for t in avoid)
+        warm = [t for t in terms if t.operator == "In"]
+        assert len(warm) == 1 and warm[0].required is False
+
+    def test_avoid_injected_even_without_binding(self):
+        nb = NodeBindingStore()
+        ann = {C.ANN_INPLACE_SCHEDULING_AVOID: "maintenance"}
+        terms = nb.affinity_terms(_pod("g", "new", index=0), ann)
+        assert len(terms) == 1
+        assert terms[0].operator == "DoesNotExist" and terms[0].required
+
+    def test_disabled_mode_injects_nothing(self):
+        nb = NodeBindingStore()
+        nb.record(_pod("g", "s-0", index=0), _node("n1"))
+        ann = {C.ANN_INPLACE_SCHEDULING: B.MODE_DISABLED,
+               C.ANN_INPLACE_SCHEDULING_AVOID: "maintenance"}
+        assert nb.affinity_terms(_pod("g", "s-0", index=0), ann) == []
+
+    def test_exclusive_topology_skips_injection(self):
+        nb = NodeBindingStore()
+        nb.record(_pod("g", "s-0", index=0), _node("n1"))
+        p = _pod("g", "s-0", index=0)
+        p.metadata.annotations[C.ANN_EXCLUSIVE_TOPOLOGY] = "tpu-slice"
+        assert nb.affinity_terms(p) == []
+
+
+class TestLifecycle:
+    def test_evict_group_scopes_to_that_group(self):
+        nb = NodeBindingStore()
+        nb.record(_pod("g1", "p", index=0), _node("n1", "s1"))
+        nb.record(_pod("g2", "p", index=0), _node("n2", "s2"))
+        nb.evict_group("g1")
+        assert nb.preferred_nodes(_pod("g1", "p", index=0)) == set()
+        assert nb.preferred_slice(_pod("g1", "p", index=0)) is None
+        assert nb.preferred_nodes(_pod("g2", "p", index=0)) == {"n2"}
 
     def test_reseed_only_from_running_ready(self):
         store = Store()
         store.create(_node("n1", "s1"))
         store.create(_node("n2", "s2"))
-        ready = _pod("g", "i1", "ready")
+        ready = _pod("g", "ready", index=0)
         ready.node_name = "n1"
         store.create(ready)
         store.mutate("Pod", "default", "ready",
                      lambda p: (setattr(p.status, "phase", "Running"),
                                 setattr(p.status, "ready", True)) and True,
                      status=True)
-        pending = _pod("g", "i2", "pending")
+        pending = _pod("g", "pending", index=1)
         pending.node_name = "n2"
         store.create(pending)
 
         nb = NodeBindingStore()
-        nb.record(_pod("stale", "x"), _node("n9"))  # pre-restart garbage
+        nb.record(_pod("stale", "x", index=0), _node("n9"))  # garbage
         nb.reseed(store)
-        assert nb.preferred_nodes(_pod("g", "i1")) == {"n1"}
-        assert nb.preferred_slice(_pod("g", "i1")) == "s1"
-        assert nb.preferred_nodes(_pod("g", "i2")) == set()   # not ready
-        assert nb.preferred_nodes(_pod("stale", "x")) == set()  # cleared
+        assert nb.preferred_nodes(_pod("g", "ready", index=0)) == {"n1"}
+        assert nb.preferred_slice(_pod("g", "ready", index=0)) == "s1"
+        assert nb.preferred_nodes(_pod("g", "pending", index=1)) == set()
+        assert nb.preferred_nodes(_pod("stale", "x", index=0)) == set()
 
 
 @pytest.fixture()
@@ -138,7 +217,6 @@ def test_vanished_warm_node_does_not_strand(plane):
 def test_slice_binding_annotation_steers_placement(plane):
     """A pod carrying the slice-binding annotation prefers that slice even
     when another slice is emptier (warm HBM wins over balance)."""
-    # Occupy slice-0 partially so 'emptiest-first' would pick another.
     role = tpu_leaderworker_role("serve", replicas=1, topology="2x4")
     plane.apply(make_group("sb", role))
     plane.wait_group_ready("sb")
@@ -146,22 +224,16 @@ def test_slice_binding_annotation_steers_placement(plane):
     pods = plane.store.list("Pod", namespace="default")
     used_slice = {nodes[p.node_name].tpu.slice_id for p in pods}.pop()
 
-    # The binding store should now prefer used_slice for this instance.
-    inst = plane.store.list("RoleInstance", namespace="default")[0]
-    probe = Pod()
-    probe.metadata.labels = dict(inst.metadata.labels)
-    probe.metadata.labels[C.LABEL_INSTANCE_NAME] = inst.metadata.name
-    assert plane.node_binding.preferred_slice(probe) == used_slice
+    # The binding store now prefers used_slice for each REAL pod identity.
+    for p in pods:
+        assert plane.node_binding.preferred_slice(p) == used_slice
 
 
 def test_group_delete_evicts_bindings(plane):
     role = tpu_leaderworker_role("serve", replicas=1, topology="2x4")
     plane.apply(make_group("ev", role))
     plane.wait_group_ready("ev")
-    inst = plane.store.list("RoleInstance", namespace="default")[0]
-    probe = Pod()
-    probe.metadata.labels = dict(inst.metadata.labels)
-    probe.metadata.labels[C.LABEL_INSTANCE_NAME] = inst.metadata.name
+    probe = plane.store.list("Pod", namespace="default")[0]
     assert plane.node_binding.preferred_slice(probe)
 
     plane.store.delete("RoleBasedGroup", "default", "ev")
@@ -171,3 +243,25 @@ def test_group_delete_evicts_bindings(plane):
     plane.wait_for(
         lambda: plane.node_binding.preferred_slice(probe) is None,
         timeout=10, desc="bindings evicted with the group")
+
+
+def test_avoid_label_filters_slice_gang_placement(plane):
+    """Required avoid terms must constrain the SLICE-GANG path too: a
+    leaderworker instance whose role declares an avoid label never lands on
+    a slice whose hosts carry it (review r4: _place_slice_group ignored
+    pod.affinity)."""
+    # Mark every host of slices 0 and 1 as under maintenance.
+    for n in plane.store.list("Node"):
+        if n.tpu.slice_id in ("slice-0", "slice-1"):
+            plane.store.mutate(
+                "Node", "default", n.metadata.name,
+                lambda x: x.labels.__setitem__("maintenance", "true") or True)
+    role = tpu_leaderworker_role("serve", replicas=1, topology="2x4")
+    role.template.annotations = {
+        C.ANN_INPLACE_SCHEDULING_AVOID: "maintenance"}
+    g = make_group("avoid", role)
+    plane.apply(g)
+    plane.wait_group_ready("avoid", timeout=15)
+    nodes = {n.metadata.name: n for n in plane.store.list("Node")}
+    for p in plane.store.list("Pod", namespace="default"):
+        assert nodes[p.node_name].tpu.slice_id == "slice-2"

@@ -1,0 +1,206 @@
+"""Warmup parity (VERDICT r3 #5): per-image pull containers, per-role
+actions, and scheduler-routed placement with capacity admission.
+
+Reference: ``rolebasedgroupwarmup_controller.go:535`` (buildWarmupPod),
+types ``:34-249``."""
+
+import time
+
+import pytest
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.pod import Container, Node, PodTemplate
+from rbg_tpu.api.policy import (ImagePreload, Warmup, WarmupActions,
+                                WarmupTarget)
+from rbg_tpu.runtime.controllers.warmup import (LABEL_WARMUP_NAME,
+                                                LABEL_WARMUP_NODE)
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.testutil import make_group, make_tpu_nodes, simple_role
+
+
+def make_warmup(name, **spec_kw):
+    w = Warmup()
+    w.metadata.name = name
+    w.metadata.namespace = "default"
+    for k, v in spec_kw.items():
+        setattr(w.spec, k, v)
+    return w
+
+
+def warmup_pods(plane, name):
+    return plane.store.list("Pod", namespace="default",
+                            selector={LABEL_WARMUP_NAME: name})
+
+
+def test_image_preload_and_custom_containers():
+    """Per-image pull containers (deduped) + custom containers (content-
+    deduped, renamed) in one pod, per buildWarmupPod."""
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=1, hosts_per_slice=1)
+    with plane:
+        custom = Container(name="prime", image="tool:v1",
+                           command=["prime-cache"])
+        w = make_warmup(
+            "w1",
+            target=WarmupTarget(nodes=["slice-0-host-0"]),
+            actions=WarmupActions(
+                image_preload=ImagePreload(
+                    images=["engine:v1", "engine:v2", "engine:v1"],
+                    pull_secrets=["regcred"]),
+                containers=[custom, custom],   # duplicate → deduped
+            ),
+        )
+        plane.apply(w)
+        plane.wait_for(
+            lambda: plane.store.get("Warmup", "default", "w1")
+            .status.phase == "Succeeded", desc="warmup done")
+        pods = warmup_pods(plane, "w1")
+        assert len(pods) == 1
+        ctrs = pods[0].template.containers
+        names = [c.name for c in ctrs]
+        assert names == ["image-preload-0", "image-preload-1", "custom-2"]
+        assert [c.image for c in ctrs[:2]] == ["engine:v1", "engine:v2"]
+        assert ctrs[0].command == ["sh", "-c", "exit 0"]
+        assert ctrs[2].command == ["prime-cache"]
+        assert pods[0].template.annotations[
+            f"{C.DOMAIN}/image-pull-secrets"] == "regcred"
+
+
+def test_group_targeted_per_role_actions():
+    """TargetRoleBasedGroup semantics: each node receives the union of the
+    actions of the roles whose pods it hosts."""
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=2, hosts_per_slice=1)
+    with plane:
+        g = make_group("svc", simple_role("prefill", replicas=1),
+                       simple_role("decode", replicas=1))
+        plane.apply(g)
+        plane.wait_group_ready("svc", timeout=10)
+        # Force-verify the two roles landed on distinct nodes.
+        by_role = {}
+        for p in plane.store.list("Pod", namespace="default",
+                                  selector={C.LABEL_GROUP_NAME: "svc"}):
+            by_role[p.metadata.labels[C.LABEL_ROLE_NAME]] = p.node_name
+        assert len(set(by_role.values())) == 2
+
+        w = make_warmup(
+            "w2",
+            target=WarmupTarget(group_name="svc", roles={
+                "prefill": WarmupActions(
+                    image_preload=ImagePreload(images=["prefill-img:v1"])),
+                "decode": WarmupActions(
+                    image_preload=ImagePreload(images=["decode-img:v1"])),
+            }),
+        )
+        plane.apply(w)
+        plane.wait_for(
+            lambda: plane.store.get("Warmup", "default", "w2")
+            .status.phase == "Succeeded", desc="warmup done")
+        for pod in warmup_pods(plane, "w2"):
+            node = pod.metadata.labels[LABEL_WARMUP_NODE]
+            images = [c.image for c in pod.template.containers]
+            if node == by_role["prefill"]:
+                assert images == ["prefill-img:v1"]
+            else:
+                assert images == ["decode-img:v1"]
+
+
+def test_warmup_routes_through_scheduler():
+    """Warmup pods are NOT direct-bound: the scheduler places them (with
+    required node affinity), so capacity admission applies (VERDICT r3
+    weak #3)."""
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=1, hosts_per_slice=2)
+    with plane:
+        w = make_warmup(
+            "w3", target=WarmupTarget(nodes=["slice-0-host-1"]),
+            actions=WarmupActions(
+                image_preload=ImagePreload(images=["engine:v1"])))
+        plane.apply(w)
+        plane.wait_for(
+            lambda: plane.store.get("Warmup", "default", "w3")
+            .status.phase == "Succeeded", desc="warmup done")
+        (pod,) = warmup_pods(plane, "w3")
+        # The binding came from the scheduler honoring required affinity.
+        assert pod.node_name == "slice-0-host-1"
+        assert pod.affinity and pod.affinity[0].required
+        assert pod.affinity[0].values == ["slice-0-host-1"]
+
+
+def test_warmup_rejected_on_full_node():
+    """A warmup targeting a node with no free pod capacity must NOT run
+    there — it stays unscheduled and the warmup times out Failed, instead
+    of overcommitting the host behind the scheduler's back."""
+    plane = ControlPlane(backend="fake")
+    nodes = make_tpu_nodes(plane.store, slices=1, hosts_per_slice=1)
+    # Shrink capacity to exactly the filler pod.
+    def shrink(n):
+        n.capacity_pods = 1
+        return True
+    plane.store.mutate("Node", "default", nodes[0].metadata.name, shrink)
+    with plane:
+        g = make_group("filler", simple_role("srv", replicas=1))
+        plane.apply(g)
+        plane.wait_group_ready("filler", timeout=10)
+
+        w = make_warmup(
+            "w4", target=WarmupTarget(nodes=["slice-0-host-0"]),
+            actions=WarmupActions(
+                image_preload=ImagePreload(images=["engine:v1"])),
+            timeout_seconds=1.5)
+        plane.apply(w)
+        plane.wait_for(
+            lambda: plane.store.get("Warmup", "default", "w4")
+            .status.phase == "Failed", timeout=15, desc="warmup times out")
+        for pod in warmup_pods(plane, "w4"):
+            assert not pod.node_name, "warmup overcommitted a full node"
+
+
+def test_legacy_template_still_works():
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=1, hosts_per_slice=1)
+    with plane:
+        w = make_warmup(
+            "w5", target=WarmupTarget(nodes=["slice-0-host-0"]),
+            template=PodTemplate(containers=[Container(
+                name="warm", image="engine:v1", command=["warm"])]))
+        plane.apply(w)
+        plane.wait_for(
+            lambda: plane.store.get("Warmup", "default", "w5")
+            .status.phase == "Succeeded", desc="warmup done")
+        (pod,) = warmup_pods(plane, "w5")
+        assert pod.template.containers[0].name == "warm"
+
+
+def test_roles_target_skips_unlisted_role_nodes():
+    """A roles-targeted warmup must not create pods on group nodes that
+    host only UNLISTED roles."""
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=2, hosts_per_slice=1)
+    with plane:
+        g = make_group("svc", simple_role("prefill", replicas=1),
+                       simple_role("decode", replicas=1))
+        plane.apply(g)
+        plane.wait_group_ready("svc", timeout=10)
+        by_role = {}
+        for p in plane.store.list("Pod", namespace="default",
+                                  selector={C.LABEL_GROUP_NAME: "svc"}):
+            by_role[p.metadata.labels[C.LABEL_ROLE_NAME]] = p.node_name
+        assert len(set(by_role.values())) == 2
+
+        w = make_warmup(
+            "w6",
+            target=WarmupTarget(group_name="svc", roles={
+                "prefill": WarmupActions(
+                    image_preload=ImagePreload(images=["prefill-img:v1"])),
+            }),
+        )
+        plane.apply(w)
+        plane.wait_for(
+            lambda: plane.store.get("Warmup", "default", "w6")
+            .status.phase == "Succeeded", desc="warmup done")
+        pods = warmup_pods(plane, "w6")
+        assert len(pods) == 1
+        assert pods[0].metadata.labels[LABEL_WARMUP_NODE] == by_role["prefill"]
+        w_obj = plane.store.get("Warmup", "default", "w6")
+        assert w_obj.status.desired_nodes == 1

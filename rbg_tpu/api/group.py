@@ -166,6 +166,24 @@ class EngineRuntimeRef:
     container_env: Dict[str, Dict[str, str]] = dataclasses.field(default_factory=dict)
 
 
+SUBDOMAIN_SHARED = "Shared"
+SUBDOMAIN_UNIQUE_PER_REPLICA = "UniquePerReplica"
+
+
+@dataclasses.dataclass
+class NetworkConfig:
+    """Per-role network policy (KEP-275, ``keps/275-enhance-network``).
+
+    ``Shared`` (default): one headless service for the whole role —
+    ``{pod}.s-{group}-{role}``. ``UniquePerReplica``: one headless service
+    PER RoleInstance, named after the instance (``{pod}.{instance}``); the
+    shared role service is removed in steady state. UniquePerReplica
+    requires the leaderWorker pattern (stable per-replica identity) —
+    rejected at admission otherwise, never silently downgraded."""
+
+    subdomain_policy: str = SUBDOMAIN_SHARED
+
+
 @dataclasses.dataclass
 class RoleSpec:
     name: str = ""
@@ -193,6 +211,9 @@ class RoleSpec:
     # role service; "LeaderOnly" exposes only instance leaders (component
     # index 0) — routers then address one endpoint per multi-host instance.
     service_selection: str = "All"     # All | LeaderOnly
+    # Role-level networking (KEP-275): how headless services map to the
+    # role's replicas.
+    network: Optional["NetworkConfig"] = None
 
     __serde_keep__ = ("name",)
 

@@ -53,6 +53,20 @@ def validate_group(rbg: RoleBasedGroup) -> None:
         if role.tpu and role.tpu.slice_topology:
             if not re.match(r"^\d+(x\d+)*$", role.tpu.slice_topology):
                 errs.append(f"{path}.tpu.sliceTopology {role.tpu.slice_topology!r} invalid")
+        if role.network is not None:
+            from rbg_tpu.api.group import (SUBDOMAIN_SHARED,
+                                           SUBDOMAIN_UNIQUE_PER_REPLICA)
+            pol = role.network.subdomain_policy
+            if pol not in (SUBDOMAIN_SHARED, SUBDOMAIN_UNIQUE_PER_REPLICA):
+                errs.append(f"{path}.network.subdomainPolicy {pol!r} must be "
+                            f"Shared or UniquePerReplica")
+            elif (pol == SUBDOMAIN_UNIQUE_PER_REPLICA
+                  and role.pattern != PatternType.LEADER_WORKER):
+                # KEP-275 eligibility: only leaderWorker has the stable
+                # per-replica identity per-instance services need. Reject,
+                # never silently fall back.
+                errs.append(f"{path}.network.subdomainPolicy UniquePerReplica "
+                            f"requires pattern leaderWorker")
         from rbg_tpu.api import intstr
         for knob in ("max_unavailable", "max_surge"):
             try:

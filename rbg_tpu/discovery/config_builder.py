@@ -48,9 +48,13 @@ def build_cluster_config(store, rbg) -> dict:
     ``config_builder.go:54-75``, FQDNs ``:117-138``)."""
     ns = rbg.metadata.namespace
     nodes = _node_map(store)
+    from rbg_tpu.api.group import SUBDOMAIN_UNIQUE_PER_REPLICA
     roles_out = []
     for role in rbg.spec.roles:
         svc = C.service_name(rbg.metadata.name, role.name)
+        unique_subdomain = (role.network is not None
+                            and role.network.subdomain_policy
+                            == SUBDOMAIN_UNIQUE_PER_REPLICA)
         wname = C.workload_name(rbg.metadata.name, role.name)
         instances_out = []
         instances = store.list(
@@ -60,6 +64,9 @@ def build_cluster_config(store, rbg) -> dict:
             copy_=False,
         )
         for inst in sorted(instances, key=lambda i: i.metadata.name):
+            # KEP-275 UniquePerReplica: the pod's subdomain IS the
+            # instance's own headless service.
+            subdomain = inst.metadata.name if unique_subdomain else svc
             pods = sorted(
                 store.list("Pod", namespace=ns,
                            selector={C.LABEL_INSTANCE_NAME: inst.metadata.name},
@@ -71,7 +78,7 @@ def build_cluster_config(store, rbg) -> dict:
                 node = nodes.get(p.node_name)
                 hosts.append({
                     "pod": p.metadata.name,
-                    "address": f"{p.metadata.name}.{svc}",
+                    "address": f"{p.metadata.name}.{subdomain}",
                     "ip": p.status.pod_ip,
                     "processId": int(p.metadata.labels.get(C.LABEL_COMPONENT_INDEX, "0")),
                     "node": p.node_name,
@@ -81,10 +88,12 @@ def build_cluster_config(store, rbg) -> dict:
                 "name": inst.metadata.name,
                 "index": inst.spec.index,
                 "sliceId": inst.status.slice_id,
+                "subdomain": subdomain,
                 "hosts": hosts,
             }
             if role.tpu is not None:
-                entry["coordinator"] = f"{inst.metadata.name}-0.{svc}:{JAX_COORDINATOR_PORT}"
+                entry["coordinator"] = (f"{inst.metadata.name}-0.{subdomain}"
+                                        f":{JAX_COORDINATOR_PORT}")
                 entry["sliceTopology"] = role.tpu.slice_topology
                 entry["accelerator"] = role.tpu.accelerator
             instances_out.append(entry)

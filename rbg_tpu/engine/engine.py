@@ -164,13 +164,20 @@ class Engine:
             return None
         n_prefix = prefix_len // ps
         ids = jnp.asarray(pages[:n_prefix], jnp.int32)
-        self.cache = PagedKVCache(
-            k_pages=self.cache.k_pages.at[:, ids].set(
-                jnp.asarray(k_data, self.cache.k_pages.dtype)),
-            v_pages=self.cache.v_pages.at[:, ids].set(
-                jnp.asarray(v_data, self.cache.v_pages.dtype)),
-            k_scales=self.cache.k_scales, v_scales=self.cache.v_scales,
-        )
+        try:
+            self.cache = PagedKVCache(
+                k_pages=self.cache.k_pages.at[:, ids].set(
+                    jnp.asarray(k_data, self.cache.k_pages.dtype)),
+                v_pages=self.cache.v_pages.at[:, ids].set(
+                    jnp.asarray(v_data, self.cache.v_pages.dtype)),
+                k_scales=self.cache.k_scales, v_scales=self.cache.v_scales,
+            )
+        except (ValueError, TypeError) as e:
+            # Foreign pool data (e.g. a replica with different model
+            # geometry sharing the pool): the freshly allocated pages must
+            # go back or every bad hit leaks them until admission wedges.
+            self.allocator.release(pages)
+            raise ValueError(f"prefix KV rejected: {e}") from e
         req = Request(prompt, sampling)
         req.pages = pages
         req.prefill_pos = prefix_len

@@ -93,7 +93,11 @@ class KVPoolStore:
                 return 0, None, None
             self.metrics["hits"] += 1
             self.metrics["hit_tokens"] += i
-            return i, np.stack(ks, axis=1), np.stack(vs, axis=1)
+        # The payload copy happens OUTSIDE the lock: stored arrays are
+        # immutable (eviction only drops references; our refs keep them
+        # alive), and a multi-MB np.stack under the global lock would
+        # serialize every other replica's match/put behind it.
+        return i, np.stack(ks, axis=1), np.stack(vs, axis=1)
 
     # ---- insert ----
 
@@ -104,13 +108,16 @@ class KVPoolStore:
         newly stored."""
         ps = self.page_size
         n = min((len(tokens) // ps) * ps, k.shape[1] * ps)
+        # Copy the page payloads BEFORE taking the lock (see match()).
+        staged = [(tuple(tokens[pi * ps:(pi + 1) * ps]),
+                   np.ascontiguousarray(k[:, pi]),
+                   np.ascontiguousarray(v[:, pi]))
+                  for pi in range(n // ps)]
         new_pages = 0
         with self._lock:
             node = self.root
             now = time.monotonic()
-            for pi in range(n // ps):
-                i = pi * ps
-                key = tuple(tokens[i:i + ps])
+            for key, kp, vp in staged:
                 child = node.children.get(key)
                 if child is not None:
                     child.last_used = now
@@ -120,9 +127,8 @@ class KVPoolStore:
                 # sharing a first token but diverging inside a page coexist
                 # as siblings instead of clobbering each other.
                 child = _Node(key, node)
-                child.k = np.ascontiguousarray(k[:, pi])
-                child.v = np.ascontiguousarray(v[:, pi])
-                child.nbytes = child.k.nbytes + child.v.nbytes
+                child.k, child.v = kp, vp
+                child.nbytes = kp.nbytes + vp.nbytes
                 child.last_used = now
                 node.children[key] = child
                 self.bytes += child.nbytes
@@ -136,25 +142,28 @@ class KVPoolStore:
     # ---- eviction ----
 
     def _evict_locked(self):
+        """Evict LRU leaves until under budget. Each pass walks the trie
+        ONCE and evicts all current leaves in LRU order (a per-page
+        full-trie scan would be O(pages²) under sustained pressure); a node
+        whose children were all evicted becomes a leaf for the next pass."""
         while self.bytes > self.max_bytes:
-            leaf = self._lru_leaf()
-            if leaf is None:
+            leaves = []
+            stack = [self.root]
+            while stack:
+                node = stack.pop()
+                if node is not self.root and not node.children:
+                    leaves.append(node)
+                stack.extend(node.children.values())
+            if not leaves:
                 return
-            leaf.parent.children.pop(leaf.key, None)
-            self.bytes -= leaf.nbytes
-            self.metrics["evicted_pages"] += 1
-            self.metrics["pages"] -= 1
-
-    def _lru_leaf(self) -> Optional[_Node]:
-        best, best_t = None, None
-        stack = [self.root]
-        while stack:
-            node = stack.pop()
-            if node is not self.root and not node.children:
-                if best_t is None or node.last_used < best_t:
-                    best, best_t = node, node.last_used
-            stack.extend(node.children.values())
-        return best
+            leaves.sort(key=lambda nd: nd.last_used)
+            for leaf in leaves:
+                if self.bytes <= self.max_bytes:
+                    return
+                leaf.parent.children.pop(leaf.key, None)
+                self.bytes -= leaf.nbytes
+                self.metrics["evicted_pages"] += 1
+                self.metrics["pages"] -= 1
 
     def stats(self) -> dict:
         with self._lock:
@@ -175,38 +184,50 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
             if obj is None:
                 return
-            op = obj.get("op")
-            ps = obj.get("page_size")
-            if (op in ("pool_match", "pool_put") and ps is not None
-                    and ps != store.page_size):
-                # Page-size handshake: a mismatched client would interpret
-                # the page arrays wrong (silently corrupt KV) — refuse.
-                send_msg(self.request, {"error": (
-                    f"page_size mismatch: pool={store.page_size} "
-                    f"client={ps}")})
-                continue
-            if op == "pool_match":
-                matched, km, vm = store.match(obj["prompt"])
-                if matched == 0:
-                    send_msg(self.request, {"matched": 0})
-                else:
-                    send_msg(self.request, {
-                        "matched": matched,
-                        "k_shape": list(km.shape), "v_shape": list(vm.shape),
-                        "dtype": str(km.dtype),
-                    }, km.tobytes(), vm.tobytes())
-            elif op == "pool_put":
-                ks = np.frombuffer(k, dtype=obj["dtype"]).reshape(obj["k_shape"])
-                vs = np.frombuffer(v, dtype=obj["dtype"]).reshape(obj["v_shape"])
-                stored = store.put(obj["prompt"], ks, vs)
-                send_msg(self.request, {"stored_pages": stored})
-            elif op == "pool_stats" or op == "metrics":
-                send_msg(self.request, {"metrics": store.stats(),
-                                        "mode": "kvpool"})
-            elif op == "health":
-                send_msg(self.request, {"ok": True, "mode": "kvpool"})
+            try:
+                self._dispatch(store, obj, k, v)
+            except Exception as e:  # noqa: BLE001 — reply, don't die:
+                # a malformed frame (bad shape/dtype, truncated payload)
+                # must produce an error REPLY, not a dead handler thread
+                # and an EOF on the client.
+                try:
+                    send_msg(self.request, {"error": f"{type(e).__name__}: {e}"})
+                except OSError:
+                    return
+
+    def _dispatch(self, store, obj, k, v):
+        op = obj.get("op")
+        ps = obj.get("page_size")
+        if (op in ("pool_match", "pool_put") and ps is not None
+                and ps != store.page_size):
+            # Page-size handshake: a mismatched client would interpret
+            # the page arrays wrong (silently corrupt KV) — refuse.
+            send_msg(self.request, {"error": (
+                f"page_size mismatch: pool={store.page_size} "
+                f"client={ps}")})
+            return
+        if op == "pool_match":
+            matched, km, vm = store.match(obj["prompt"])
+            if matched == 0:
+                send_msg(self.request, {"matched": 0})
             else:
-                send_msg(self.request, {"error": f"unsupported op {op!r}"})
+                send_msg(self.request, {
+                    "matched": matched,
+                    "k_shape": list(km.shape), "v_shape": list(vm.shape),
+                    "dtype": str(km.dtype),
+                }, km.tobytes(), vm.tobytes())
+        elif op == "pool_put":
+            ks = np.frombuffer(k, dtype=obj["dtype"]).reshape(obj["k_shape"])
+            vs = np.frombuffer(v, dtype=obj["dtype"]).reshape(obj["v_shape"])
+            stored = store.put(obj["prompt"], ks, vs)
+            send_msg(self.request, {"stored_pages": stored})
+        elif op == "pool_stats" or op == "metrics":
+            send_msg(self.request, {"metrics": store.stats(),
+                                    "mode": "kvpool"})
+        elif op == "health":
+            send_msg(self.request, {"ok": True, "mode": "kvpool"})
+        else:
+            send_msg(self.request, {"error": f"unsupported op {op!r}"})
 
 
 class KVPoolServer(socketserver.ThreadingTCPServer):
@@ -234,7 +255,12 @@ class KVPoolClient:
             obj["page_size"] = self.page_size
         with socket.create_connection(self.addr, timeout=self.timeout) as s:
             send_msg(s, obj, k, v)
-            return recv_msg(s)
+            resp = recv_msg(s)
+        if resp[0] is None:
+            # EOF without a reply (pool restarting / handler died):
+            # RuntimeError keeps this inside the callers' degrade path.
+            raise RuntimeError("kv pool closed the connection mid-request")
+        return resp
 
     def match(self, prompt: List[int]):
         obj, k, v = self._roundtrip({"op": "pool_match", "prompt": list(prompt)})

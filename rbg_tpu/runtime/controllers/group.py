@@ -327,9 +327,34 @@ class RoleBasedGroupController(Controller):
         return role
 
     def _ensure_service(self, store, rbg, role: RoleSpec):
+        from rbg_tpu.api.group import SUBDOMAIN_UNIQUE_PER_REPLICA
         ns = rbg.metadata.namespace
-        sname = C.service_name(rbg.metadata.name, role.name)
         leader_only = role.service_selection == "LeaderOnly"
+        if (role.network is not None and role.network.subdomain_policy
+                == SUBDOMAIN_UNIQUE_PER_REPLICA):
+            # KEP-275 UniquePerReplica: one headless service PER
+            # RoleInstance, named after the instance; the shared role
+            # service is removed in steady state (orphan cleanup drops it
+            # since it's no longer in the valid set).
+            for inst in store.list(
+                    "RoleInstance", namespace=ns,
+                    selector={C.LABEL_GROUP_NAME: rbg.metadata.name,
+                              C.LABEL_ROLE_NAME: role.name},
+                    copy_=False):
+                self._ensure_one_service(
+                    store, rbg, role, inst.metadata.name,
+                    selector={C.LABEL_INSTANCE_NAME: inst.metadata.name},
+                    leader_only=leader_only)
+            return
+        self._ensure_one_service(
+            store, rbg, role, C.service_name(rbg.metadata.name, role.name),
+            selector={C.LABEL_GROUP_NAME: rbg.metadata.name,
+                      C.LABEL_ROLE_NAME: role.name},
+            leader_only=leader_only)
+
+    def _ensure_one_service(self, store, rbg, role, sname: str,
+                            selector: dict, leader_only: bool):
+        ns = rbg.metadata.namespace
         cur = store.get("Service", ns, sname, copy_=False)
         if cur is not None:
             if cur.leader_only != leader_only:
@@ -346,10 +371,7 @@ class RoleBasedGroupController(Controller):
             C.LABEL_ROLE_NAME: role.name,
         }
         svc.metadata.owner_references = [owner_ref(rbg)]
-        svc.selector = {
-            C.LABEL_GROUP_NAME: rbg.metadata.name,
-            C.LABEL_ROLE_NAME: role.name,
-        }
+        svc.selector = dict(selector)
         svc.leader_only = leader_only
         try:
             store.create(svc)
@@ -417,7 +439,21 @@ class RoleBasedGroupController(Controller):
     def _cleanup_orphans(self, store, rbg):
         from rbg_tpu.runtime import workload as workload_registry
         ns = rbg.metadata.namespace
-        valid_s = {C.service_name(rbg.metadata.name, r.name) for r in rbg.spec.roles}
+        from rbg_tpu.api.group import SUBDOMAIN_UNIQUE_PER_REPLICA
+        valid_s = set()
+        for r in rbg.spec.roles:
+            if (r.network is not None and r.network.subdomain_policy
+                    == SUBDOMAIN_UNIQUE_PER_REPLICA):
+                # Per-instance services are valid; the shared role service
+                # is NOT (KEP-275: removed in steady state).
+                valid_s.update(
+                    i.metadata.name for i in store.list(
+                        "RoleInstance", namespace=ns,
+                        selector={C.LABEL_GROUP_NAME: rbg.metadata.name,
+                                  C.LABEL_ROLE_NAME: r.name},
+                        copy_=False))
+            else:
+                valid_s.add(C.service_name(rbg.metadata.name, r.name))
         # Fan the sweep across every registered backend, each keeping only
         # the children of roles routed to IT: a role whose workload KIND
         # changed leaves an orphan in the old backend's store.

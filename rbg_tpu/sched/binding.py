@@ -127,6 +127,13 @@ class NodeBindingStore:
         if pod.metadata.annotations.get(C.ANN_EXCLUSIVE_TOPOLOGY):
             return []
         terms = avoid_terms(annotations)
+        # Slice-gang pods skip per-node warm terms: their warm rebinding is
+        # SLICE-granular (ANN_SLICE_BINDING steers the whole gang back to
+        # its ICI domain). Per-pod required `name In [...]` terms would
+        # diverge across the gang and strand it — the gang placer filters
+        # hosts by instance-level terms only.
+        if pod.template.scheduler_hints.get("tpu-slice") == "true":
+            return terms
         nodes = self.preferred_nodes(pod, annotations)
         if nodes:
             terms.append(NodeAffinityTerm(

@@ -37,6 +37,10 @@ class SchedulerController(Controller):
     # Single worker: placement decisions are serialized (as in kube-scheduler's
     # one scheduling loop) so concurrent plans can never double-book a host.
     workers = 1
+    # Faster drift backstop than the 300 s controller default: an unbound
+    # pod with no wake-up event is a stranded gang; scheduler sweeps are
+    # cheap (bound pods return in one store.get).
+    resync_period = 30.0
 
     def __init__(self, store: Store, node_binding=None):
         super().__init__(store)
@@ -348,6 +352,12 @@ class SchedulerController(Controller):
             try:
                 obj = store.mutate("Pod", ns, name, fn)
             except NotFound:
+                # Usually the pod was deleted mid-plan (skip; its
+                # replacement re-schedules). But a RACED NotFound can leave
+                # a live pod unbound with no event to wake us — re-queue it
+                # instead of waiting out the resync backstop.
+                if store.get("Pod", ns, name, copy_=False) is not None:
+                    self.queue.add((ns, name))
                 continue
             # Account the bind immediately: the next plan in this burst
             # must not see the capacity as still free.

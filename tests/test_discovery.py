@@ -174,3 +174,69 @@ def test_native_workqueue_semantics():
     assert time.monotonic() - t0 >= 0.14
     q.shutdown()
     assert q.get(0.05) is None
+
+
+def test_unique_per_replica_services_kep275():
+    """KEP-275 UniquePerReplica: one headless service per RoleInstance
+    (named after it, selecting only its pods); the shared role service is
+    removed in steady state; discovery addresses use the per-instance
+    subdomain. Admission rejects non-leaderWorker roles."""
+    import yaml
+
+    from rbg_tpu.api import constants as C
+    from rbg_tpu.api.group import NetworkConfig
+    from rbg_tpu.api.validation import ValidationError, validate_group
+    from rbg_tpu.runtime.plane import ControlPlane
+    from rbg_tpu.testutil import (make_group, make_tpu_nodes, simple_role,
+                                  tpu_leaderworker_role)
+
+    # Admission: standalone + UniquePerReplica rejected, never downgraded.
+    bad = make_group("bad", simple_role("srv"))
+    bad.spec.roles[0].network = NetworkConfig(
+        subdomain_policy="UniquePerReplica")
+    try:
+        validate_group(bad)
+        assert False, "expected rejection"
+    except ValidationError:
+        pass
+
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=2, hosts_per_slice=2)
+    with plane:
+        role = tpu_leaderworker_role("serve", replicas=2, topology="2x4")
+        role.network = NetworkConfig(subdomain_policy="UniquePerReplica")
+        plane.apply(make_group("net", role))
+        plane.wait_group_ready("net", timeout=15)
+
+        def services_converged():
+            svcs = {s.metadata.name: s
+                    for s in plane.store.list("Service", namespace="default")}
+            return (len(svcs) == 2
+                    and C.service_name("net", "serve") not in svcs
+                    and svcs) or None
+        svcs = plane.wait_for(services_converged, timeout=10,
+                              desc="per-replica services, shared gone")
+        insts = plane.store.list("RoleInstance", namespace="default")
+        assert sorted(svcs) == sorted(i.metadata.name for i in insts)
+        for name, svc in svcs.items():
+            assert svc.selector == {C.LABEL_INSTANCE_NAME: name}
+
+        # Discovery addresses ride the per-instance subdomain.
+        from rbg_tpu.discovery.config_builder import build_cluster_config
+        cfg = build_cluster_config(
+            plane.store, plane.store.get("RoleBasedGroup", "default", "net"))
+        (role_out,) = cfg["roles"]
+        for entry in role_out["instances"]:
+            assert entry["subdomain"] == entry["name"]
+            assert entry["coordinator"].startswith(
+                f"{entry['name']}-0.{entry['name']}:")
+            for h in entry["hosts"]:
+                assert h["address"].endswith("." + entry["name"])
+
+        # Scale down: the removed instance's service is GC'd.
+        g = plane.store.get("RoleBasedGroup", "default", "net")
+        g.spec.roles[0].replicas = 1
+        plane.apply(g)
+        plane.wait_for(
+            lambda: len(plane.store.list("Service", namespace="default")) == 1,
+            timeout=15, desc="scale-down removes per-replica service")

@@ -1,0 +1,63 @@
+"""tpu-check harness: verdict shape, timeout containment, wedged-state
+skipping. The real-chip path can't run here (tunnel wedged — the exact
+condition the harness exists to survive); these tests pin the harness
+semantics themselves."""
+
+import json
+import subprocess
+import sys
+
+from rbg_tpu.cli import tpucheck
+
+
+def test_stage_timeout_contains_hang(monkeypatch):
+    monkeypatch.setitem(tpucheck.STAGE_TIMEOUTS, "probe", 1)
+    res = tpucheck._run_stage("probe", "import time; time.sleep(30)")
+    assert res["ok"] is False
+    assert res["elapsed_s"] <= 5
+    assert "hung past its timeout" in res["detail"]
+
+
+def test_stage_collects_json_payload():
+    res = tpucheck._run_stage("probe", "print(json.dumps({'backend': 'x'}))")
+    assert res["ok"] is True and res["backend"] == "x"
+
+
+def test_stage_failure_carries_stderr():
+    res = tpucheck._run_stage("probe", "raise RuntimeError('boom')")
+    assert res["ok"] is False
+    assert "boom" in (res.get("stderr_tail") or "")
+
+
+def test_wedged_probe_skips_later_stages(monkeypatch, capsys):
+    monkeypatch.setitem(tpucheck.STAGE_TIMEOUTS, "probe", 1)
+    monkeypatch.setattr(tpucheck, "_PROBE", "import time; time.sleep(30)")
+    rc = tpucheck.run(["--stages", "probe,pallas,engine"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 2                       # wedged-tunnel exit code
+    assert out["ok"] is False and out["wedged_tunnel"] is True
+    assert out["stages"]["pallas"]["skipped"] is True
+    assert out["stages"]["engine"]["skipped"] is True
+
+
+def test_engine_stage_fails_cleanly_off_tpu():
+    """On a CPU-only interpreter the engine stage must fail fast with a
+    clear assertion, not hang or crash the harness."""
+    from rbg_tpu.utils import scrubbed_cpu_env
+    # Run the actual harness in a scrubbed-CPU subprocess so the stage's
+    # own subprocesses inherit JAX_PLATFORMS=cpu (fast, no tunnel).
+    env = scrubbed_cpu_env()
+    out = subprocess.run(
+        [sys.executable, "-m", "rbg_tpu.cli.tpucheck",
+         "--stages", "engine"],
+        env=env, timeout=300, capture_output=True, text=True)
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["ok"] is False and out.returncode == 1
+    assert "not on tpu" in (doc["stages"]["engine"].get("stderr_tail") or "")
+
+
+def test_stage_payloads_are_valid_python():
+    for name, code in (("probe", tpucheck._PROBE),
+                       ("pallas", tpucheck._PALLAS),
+                       ("engine", tpucheck._ENGINE)):
+        compile("import json\n" + code, f"<{name}>", "exec")

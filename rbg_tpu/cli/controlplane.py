@@ -50,6 +50,10 @@ def register(sub) -> None:
                     help="require this bearer token on every admin op "
                          "(default: $RBG_ADMIN_TOKEN; empty = "
                          "localhost-trust dev mode)")
+    sp.add_argument("--tls-cert-dir", default="",
+                    help="serve the admin API over TLS: bootstrap/reuse a "
+                         "self-signed CA + server cert in this directory "
+                         "(clients pass --tls-ca <dir>/ca.crt)")
     sp.set_defaults(func=cmd_serve)
 
     stp = sub.add_parser("status", help="group status (against a serve plane)")
@@ -57,6 +61,9 @@ def register(sub) -> None:
     stp.add_argument("--admin", default="127.0.0.1:7070")
     stp.add_argument("--token", default=None,
                      help="admin bearer token (default: $RBG_ADMIN_TOKEN)")
+    stp.add_argument("--tls-ca", default=None,
+                   help="CA cert for a TLS admin endpoint "
+                        "(default: $RBG_ADMIN_TLS_CA)")
     stp.add_argument("-n", "--namespace", default="default")
     stp.set_defaults(func=cmd_status)
 
@@ -65,6 +72,9 @@ def register(sub) -> None:
     gp.add_argument("--admin", default="127.0.0.1:7070")
     gp.add_argument("--token", default=None,
                     help="admin bearer token (default: $RBG_ADMIN_TOKEN)")
+    gp.add_argument("--tls-ca", default=None,
+                   help="CA cert for a TLS admin endpoint "
+                        "(default: $RBG_ADMIN_TLS_CA)")
     gp.add_argument("-n", "--namespace", default="default")
     gp.set_defaults(func=cmd_get)
 
@@ -74,6 +84,9 @@ def register(sub) -> None:
     dp_.add_argument("--admin", default="127.0.0.1:7070")
     dp_.add_argument("--token", default=None,
                      help="admin bearer token (default: $RBG_ADMIN_TOKEN)")
+    dp_.add_argument("--tls-ca", default=None,
+                   help="CA cert for a TLS admin endpoint "
+                        "(default: $RBG_ADMIN_TLS_CA)")
     dp_.add_argument("-n", "--namespace", default="default")
     dp_.set_defaults(func=cmd_delete)
 
@@ -102,6 +115,9 @@ def register(sub) -> None:
     rp.add_argument("--admin", default="127.0.0.1:7070")
     rp.add_argument("--token", default=None,
                     help="admin bearer token (default: $RBG_ADMIN_TOKEN)")
+    rp.add_argument("--tls-ca", default=None,
+                   help="CA cert for a TLS admin endpoint "
+                        "(default: $RBG_ADMIN_TLS_CA)")
     rp.add_argument("-n", "--namespace", default="default")
     rp.set_defaults(func=cmd_rollout)
 
@@ -223,9 +239,12 @@ def cmd_serve(args) -> int:
     if token is None:
         token = _os.environ.get("RBG_ADMIN_TOKEN", "")
     admin = AdminServer(plane, args.admin_port, token=token,
-                        host=args.admin_host).start()
+                        host=args.admin_host,
+                        cert_dir=args.tls_cert_dir or None).start()
     if token:
         print("admin auth: token required", flush=True)
+    if admin.ca_path:
+        print(f"admin tls: enabled (ca: {admin.ca_path})", flush=True)
     print(f"plane serving; admin on {args.admin_host}:{admin.port}", flush=True)
     if args.file:
         for o in _load(args.file):
@@ -255,15 +274,20 @@ def cmd_serve(args) -> int:
     return 0
 
 
-def _admin_call(addr: str, obj: dict, token=None) -> dict:
+def _admin_call(addr: str, obj: dict, token=None, tls_ca=None) -> dict:
     from rbg_tpu.engine.protocol import request_once
 
     import os as _os
     tok = token if token is not None else _os.environ.get("RBG_ADMIN_TOKEN", "")
     if tok:
         obj = dict(obj, token=tok)
+    ctx = None
+    ca = tls_ca if tls_ca is not None else _os.environ.get("RBG_ADMIN_TLS_CA", "")
+    if ca:
+        from rbg_tpu.runtime.tlsutil import client_context
+        ctx = client_context(ca)
     try:
-        resp, _, _ = request_once(addr, obj, timeout=30.0)
+        resp, _, _ = request_once(addr, obj, timeout=30.0, ssl_context=ctx)
     except OSError as e:
         print(f"error: cannot reach admin endpoint {addr}: {e}", file=sys.stderr)
         raise SystemExit(1)
@@ -301,7 +325,8 @@ def cmd_migrate_state(args) -> int:
 def cmd_status(args) -> int:
     st = _admin_call(args.admin, {"op": "status", "name": args.name,
                                   "namespace": args.namespace},
-                     token=getattr(args, 'token', None))
+                     token=getattr(args, 'token', None),
+                       tls_ca=getattr(args, 'tls_ca', None))
     print(f"group {st['name']}: {'Ready' if st['ready'] else 'NOT ready'} "
           f"({st['reason']}) revision={st['revision']}")
     print(f"  {'ROLE':<12} {'READY':<8} {'UPDATED':<8}")
@@ -318,7 +343,8 @@ def cmd_status(args) -> int:
 def cmd_get(args) -> int:
     resp = _admin_call(args.admin, {"op": "list", "kind": args.kind,
                                     "namespace": args.namespace},
-                       token=getattr(args, 'token', None))
+                       token=getattr(args, 'token', None),
+                       tls_ca=getattr(args, 'tls_ca', None))
     for item in resp["items"]:
         meta = item.get("metadata", {})
         print(f"{args.kind}/{meta.get('name')}")
@@ -328,7 +354,8 @@ def cmd_get(args) -> int:
 def cmd_delete(args) -> int:
     _admin_call(args.admin, {"op": "delete", "kind": args.kind,
                              "name": args.name, "namespace": args.namespace},
-                token=getattr(args, 'token', None))
+                token=getattr(args, 'token', None),
+                       tls_ca=getattr(args, 'tls_ca', None))
     print(f"deleted {args.kind}/{args.name}")
     return 0
 
@@ -363,17 +390,20 @@ def cmd_schema(args) -> int:
 def cmd_rollout(args) -> int:
     base = {"name": args.name, "namespace": args.namespace}
     if args.action == "history":
-        resp = _admin_call(args.admin, {"op": "history", **base}, token=getattr(args, 'token', None))
+        resp = _admin_call(args.admin, {"op": "history", **base}, token=getattr(args, 'token', None),
+                       tls_ca=getattr(args, 'tls_ca', None))
         print(f"{'REVISION':<10} NAME")
         for r in resp["revisions"]:
             print(f"{r['revision']:<10} {r['name']}")
         return 0
     if args.action == "diff":
-        resp = _admin_call(args.admin, {"op": "diff", "revision": args.revision, **base}, token=getattr(args, 'token', None))
+        resp = _admin_call(args.admin, {"op": "diff", "revision": args.revision, **base}, token=getattr(args, 'token', None),
+                       tls_ca=getattr(args, 'tls_ca', None))
         for line in resp["diff"]:
             print(line)
         return 0
-    resp = _admin_call(args.admin, {"op": "undo", "revision": args.revision, **base}, token=getattr(args, 'token', None))
+    resp = _admin_call(args.admin, {"op": "undo", "revision": args.revision, **base}, token=getattr(args, 'token', None),
+                       tls_ca=getattr(args, 'tls_ca', None))
     print(f"rolled back to revision {resp['restoredRevision']}")
     return 0
 

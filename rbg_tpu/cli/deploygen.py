@@ -1,0 +1,194 @@
+"""``rbg-tpu deploy-manifests`` — parameterized deployment rendering.
+
+Reference analog: the Helm chart (``deploy/helm/rbgs``: manager Deployment
++ RBAC + values.yaml) — inventory #29's parameterization gap. Instead of a
+text-template engine, the manifests are BUILT as data from a values dict
+(defaults → ``--values file.yaml`` → ``--set key=value``, last wins) and
+emitted as one multi-doc YAML stream:
+
+    rbg-tpu deploy-manifests --set image=gcr.io/me/rbg-tpu:v4 \\
+        --set admin.tls=true --set backend=k8s | kubectl apply -f -
+
+Values (dotted keys):
+
+    name                rbg-tpu-plane      deployment/app name
+    namespace           ""                 omit = current kubectl context
+    image               rbg-tpu:latest
+    backend             local              local | fake | k8s
+    kubeApi             ""                 --kube-api for backend=k8s
+    admin.port          7070
+    admin.tokenSecret   rbg-tpu-admin      Secret with key "token"
+    admin.tls           false              TLS cert dir on the state volume
+    state.size          1Gi                PVC request
+    networkPolicy       true               admin-client label gate
+    resources.cpu       "1"
+    resources.memory    1Gi
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Dict
+
+DEFAULTS: Dict[str, Any] = {
+    "name": "rbg-tpu-plane",
+    "namespace": "",
+    "image": "rbg-tpu:latest",
+    "backend": "local",
+    "kubeApi": "",
+    "admin": {"port": 7070, "tokenSecret": "rbg-tpu-admin", "tls": False},
+    "state": {"size": "1Gi"},
+    "networkPolicy": True,
+    "resources": {"cpu": "1", "memory": "1Gi"},
+}
+
+
+def _deep_merge(dst: dict, src: dict) -> dict:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+    return dst
+
+
+def _set_path(values: dict, dotted: str, raw: str) -> None:
+    val: Any = raw
+    if raw.lower() in ("true", "false"):
+        val = raw.lower() == "true"
+    elif raw.isdigit():
+        val = int(raw)
+    node = values
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+        if not isinstance(node, dict):
+            raise ValueError(f"--set {dotted}: {p} is not a mapping")
+    node[parts[-1]] = val
+
+
+def build_manifests(v: Dict[str, Any]) -> list:
+    name = v["name"]
+    meta = {"name": name, "labels": {"app": name}}
+    if v["namespace"]:
+        meta["namespace"] = v["namespace"]
+
+    def named(n):
+        out = {"name": n}
+        if v["namespace"]:
+            out["namespace"] = v["namespace"]
+        return out
+
+    args = ["serve", "--backend", v["backend"],
+            "--admin-host", "0.0.0.0",
+            "--admin-port", str(v["admin"]["port"]),
+            "--state-file", "/var/lib/rbg-tpu/state.json"]
+    if v["backend"] == "k8s":
+        if not v["kubeApi"]:
+            raise ValueError("backend=k8s requires --set kubeApi=https://...")
+        args += ["--kube-api", v["kubeApi"]]
+    if v["admin"]["tls"]:
+        # Cert material lives with the state (persistent: the CA survives
+        # restarts so clients' pinned ca.crt stays valid).
+        args += ["--tls-cert-dir", "/var/lib/rbg-tpu/certs"]
+
+    container = {
+        "name": "plane",
+        "image": v["image"],
+        "command": ["rbg-tpu"],
+        "args": args,
+        "env": [{"name": "RBG_ADMIN_TOKEN", "valueFrom": {"secretKeyRef": {
+            "name": v["admin"]["tokenSecret"], "key": "token"}}}],
+        "ports": [{"containerPort": v["admin"]["port"], "name": "admin"}],
+        "volumeMounts": [{"name": "state",
+                          "mountPath": "/var/lib/rbg-tpu"}],
+        "resources": {"requests": {"cpu": str(v["resources"]["cpu"]),
+                                   "memory": str(v["resources"]["memory"])}},
+        "readinessProbe": {"tcpSocket": {"port": "admin"},
+                           "periodSeconds": 5},
+    }
+    deployment = {
+        "apiVersion": "apps/v1", "kind": "Deployment", "metadata": meta,
+        "spec": {
+            "replicas": 1,  # single logical writer; state in the PVC
+            "strategy": {"type": "Recreate"},
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "containers": [container],
+                    "volumes": [{"name": "state", "persistentVolumeClaim": {
+                        "claimName": f"{name}-state"}}],
+                },
+            },
+        },
+    }
+    pvc = {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": named(f"{name}-state"),
+        "spec": {"accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": v["state"]["size"]}}},
+    }
+    service = {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": named(name),
+        "spec": {"selector": {"app": name},
+                 "ports": [{"name": "admin", "port": v["admin"]["port"],
+                            "targetPort": "admin"}]},
+    }
+    docs = [deployment, pvc, service]
+    if v["networkPolicy"]:
+        docs.append({
+            "apiVersion": "networking.k8s.io/v1", "kind": "NetworkPolicy",
+            "metadata": named(f"{name}-admin"),
+            "spec": {
+                "podSelector": {"matchLabels": {"app": name}},
+                "policyTypes": ["Ingress"],
+                # The bearer token is the credential; network reach is the
+                # blast radius — only labeled admin clients get ingress.
+                "ingress": [{"from": [{"podSelector": {"matchLabels": {
+                    "rbg-tpu/admin-client": "true"}}}],
+                    "ports": [{"port": v["admin"]["port"]}]}],
+            },
+        })
+    return docs
+
+
+def run(argv=None) -> int:
+    import copy
+
+    import yaml
+    ap = argparse.ArgumentParser("rbg-tpu deploy-manifests")
+    ap.add_argument("--values", default="", help="YAML values file")
+    ap.add_argument("--set", action="append", default=[], metavar="K=V",
+                    dest="sets", help="override a value (dotted keys)")
+    ap.add_argument("--out", default="", help="write to file (default stdout)")
+    args = ap.parse_args(argv)
+    values = copy.deepcopy(DEFAULTS)
+    if args.values:
+        with open(args.values) as f:
+            _deep_merge(values, yaml.safe_load(f) or {})
+    try:
+        for item in args.sets:
+            if "=" not in item:
+                raise ValueError(f"--set {item!r}: expected key=value")
+            k, val = item.split("=", 1)
+            _set_path(values, k, val)
+        docs = build_manifests(values)
+    except (ValueError, TypeError, KeyError) as e:
+        # Includes scalar-over-mapping overrides (--set admin=x) and
+        # values files that null out a section: clean exit 2, no traceback.
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    text = "---\n".join(yaml.safe_dump(d, sort_keys=False) for d in docs)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(run())

@@ -22,6 +22,9 @@ def main(argv=None) -> int:
     if argv and argv[0] == "tpu-check":
         from rbg_tpu.cli.tpucheck import run as tpucheck_run
         return tpucheck_run(argv[1:])
+    if argv and argv[0] == "deploy-manifests":
+        from rbg_tpu.cli.deploygen import run as deploygen_run
+        return deploygen_run(argv[1:])
 
     parser = argparse.ArgumentParser(
         prog="rbg-tpu",

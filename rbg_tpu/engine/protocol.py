@@ -100,9 +100,14 @@ def bundle_from_wire(header: dict, k_bytes: bytes, v_bytes: bytes):
 
 
 def request_once(addr: str, obj: dict, k_bytes=None, v_bytes=None,
-                 timeout: float = 120.0):
-    """One request/response round trip to ``host:port``."""
+                 timeout: float = 120.0, ssl_context=None):
+    """One request/response round trip to ``host:port`` (optionally TLS —
+    the admin wire with a cert dir configured)."""
     host, port = addr.rsplit(":", 1)
-    with socket.create_connection((host, int(port)), timeout=timeout) as s:
-        send_msg(s, obj, k_bytes, v_bytes)
-        return recv_msg(s)
+    with socket.create_connection((host, int(port)), timeout=timeout) as raw:
+        if ssl_context is not None:
+            with ssl_context.wrap_socket(raw, server_hostname=host) as s:
+                send_msg(s, obj, k_bytes, v_bytes)
+                return recv_msg(s)
+        send_msg(raw, obj, k_bytes, v_bytes)
+        return recv_msg(raw)

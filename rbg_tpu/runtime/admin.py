@@ -21,7 +21,25 @@ from rbg_tpu.engine.protocol import recv_msg, send_msg
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        # TLS wraps PER CONNECTION on the worker thread, never on the
+        # accept loop: a wrapped LISTENER would run the handshake inside
+        # serve_forever, letting one silent client (port scanner, half-open
+        # connection) freeze every other admin client and wedge stop().
+        ctx = getattr(self.server, "tls_ctx", None)
+        if ctx is not None:
+            self.request.settimeout(10.0)  # bound the handshake
+            try:
+                self.request = ctx.wrap_socket(self.request, server_side=True)
+            except OSError:  # ssl.SSLError / timeout / reset — drop client
+                self._tls_failed = True
+                return
+            self.request.settimeout(None)
+        self._tls_failed = False
+
     def handle(self):
+        if getattr(self, "_tls_failed", False):
+            return
         store = self.server.plane.store
         while True:
             try:
@@ -196,7 +214,7 @@ class _Handler(socketserver.BaseRequestHandler):
 
 class AdminServer:
     def __init__(self, plane, port: int = 0, token: Optional[str] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", cert_dir: Optional[str] = None):
         self._server = socketserver.ThreadingTCPServer(
             (host, port), _Handler)
         self._server.allow_reuse_address = True
@@ -205,6 +223,17 @@ class AdminServer:
         # None/empty = localhost-trust (dev); any string = required on
         # every op except health.
         self._server.token = token or ""
+        self.ca_path = None
+        self._server.tls_ctx = None
+        if cert_dir:
+            # TLS on the admin wire (the webhook-cert analog, inventory
+            # #24): bootstrap/reuse a self-signed CA + server cert; a
+            # TLS-configured client's bearer token then never crosses the
+            # network in cleartext (VERDICT r3 weak #8). The wrap happens
+            # per-connection in _Handler.setup (see note there).
+            from rbg_tpu.runtime.tlsutil import ensure_certs, server_context
+            self.ca_path, crt, key = ensure_certs(cert_dir)
+            self._server.tls_ctx = server_context(crt, key)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True, name="admin")

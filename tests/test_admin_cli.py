@@ -124,3 +124,129 @@ def test_admin_token_auth():
     finally:
         admin.stop()
         p.stop()
+
+
+def test_admin_tls_with_token(tmp_path):
+    """TLS admin wire (VERDICT r3 weak #8 / the webhook-cert analog):
+    self-signed CA bootstrap, TLS-wrapped socket, token never in cleartext;
+    plaintext and wrong-CA clients are rejected; cert material is reused
+    across restarts (idempotent bootstrap)."""
+    import ssl
+
+    from rbg_tpu.api import serde
+    from rbg_tpu.runtime.tlsutil import client_context, ensure_certs
+
+    cert_dir = str(tmp_path / "certs")
+    p = ControlPlane(backend="fake")
+    make_tpu_nodes(p.store, slices=1, hosts_per_slice=2)
+    p.start()
+    admin = AdminServer(p, port=0, token="s3cret",
+                        cert_dir=cert_dir).start()
+    addr = f"127.0.0.1:{admin.port}"
+    try:
+        ctx = client_context(admin.ca_path)
+        g = make_group("tls", simple_role("srv", replicas=1))
+        resp, _, _ = request_once(
+            addr, {"op": "apply", "manifest": serde.to_dict(g),
+                   "token": "s3cret"}, ssl_context=ctx)
+        assert "error" not in resp, resp
+        p.wait_group_ready("tls")
+
+        # Wrong token over TLS → unauthorized.
+        resp, _, _ = request_once(addr, {"op": "list", "kind": "Pod",
+                                         "token": "wrong"}, ssl_context=ctx)
+        assert resp.get("error") == "unauthorized"
+
+        # A plaintext client cannot speak to the TLS socket.
+        try:
+            resp, _, _ = request_once(addr, {"op": "health"}, timeout=5)
+            assert resp is None, "plaintext client must not get a reply"
+        except (OSError, ConnectionError):
+            pass
+
+        # A client pinned to a DIFFERENT CA refuses the server.
+        other = client_context(ensure_certs(str(tmp_path / "other"))[0])
+        try:
+            request_once(addr, {"op": "health"}, timeout=5,
+                         ssl_context=other)
+            assert False, "expected certificate verification failure"
+        except ssl.SSLError:
+            pass
+
+        # Bootstrap is idempotent: same material on reuse.
+        before = open(admin.ca_path, "rb").read()
+        ensure_certs(cert_dir)
+        assert open(admin.ca_path, "rb").read() == before
+    finally:
+        admin.stop()
+        p.stop()
+
+
+def test_deploy_manifests_parameterization(tmp_path):
+    """Helm-chart analog (inventory #29): defaults -> values file -> --set
+    overrides, rendered as valid multi-doc YAML."""
+    import subprocess
+    import sys
+
+    import yaml
+
+    from rbg_tpu.utils import scrubbed_cpu_env
+    vals = tmp_path / "values.yaml"
+    vals.write_text("image: gcr.io/me/rbg-tpu:v4\nstate:\n  size: 5Gi\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "rbg_tpu.cli.main", "deploy-manifests",
+         "--values", str(vals), "--set", "admin.tls=true",
+         "--set", "namespace=prod", "--set", "networkPolicy=false"],
+        env=scrubbed_cpu_env(), timeout=120, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    docs = list(yaml.safe_load_all(out.stdout))
+    kinds = [d["kind"] for d in docs]
+    assert kinds == ["Deployment", "PersistentVolumeClaim", "Service"]
+    dep = docs[0]
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "gcr.io/me/rbg-tpu:v4"          # values file
+    assert "--tls-cert-dir" in c["args"]                 # --set override
+    assert dep["metadata"]["namespace"] == "prod"
+    assert docs[1]["spec"]["resources"]["requests"]["storage"] == "5Gi"
+
+    # backend=k8s without kubeApi is a rendering error, not silent output.
+    bad = subprocess.run(
+        [sys.executable, "-m", "rbg_tpu.cli.main", "deploy-manifests",
+         "--set", "backend=k8s"],
+        env=scrubbed_cpu_env(), timeout=120, capture_output=True, text=True)
+    assert bad.returncode == 2 and "kubeApi" in bad.stderr
+
+
+def test_tls_server_cert_rotation_preserves_ca(tmp_path, monkeypatch):
+    """Server-cert re-mint under the EXISTING CA: clients' pinned ca.crt
+    stays valid across rotation; only CA expiry forces a re-pin."""
+    import os
+
+    from rbg_tpu.runtime import tlsutil
+
+    d = str(tmp_path / "certs")
+    ca1, crt1, key1 = tlsutil.ensure_certs(d)
+    ca_bytes = open(ca1, "rb").read()
+    crt_bytes = open(crt1, "rb").read()
+    # Private keys are born 0600.
+    assert oct(os.stat(key1).st_mode & 0o777) == "0o600"
+    assert oct(os.stat(os.path.join(d, tlsutil.CA_KEY)).st_mode
+               & 0o777) == "0o600"
+
+    # Force the SERVER cert (only) past the rotation horizon.
+    real_valid = tlsutil._still_valid
+    monkeypatch.setattr(
+        tlsutil, "_still_valid",
+        lambda p: False if p.endswith(tlsutil.SERVER_CERT) else real_valid(p))
+    ca2, crt2, _ = tlsutil.ensure_certs(d)
+    assert open(ca2, "rb").read() == ca_bytes, "CA must be preserved"
+    assert open(crt2, "rb").read() != crt_bytes, "server cert must rotate"
+
+    # The rotated server cert still verifies against the ORIGINAL CA.
+    from cryptography import x509
+    from cryptography.hazmat.primitives.asymmetric import ec
+    ca_cert = x509.load_pem_x509_certificate(ca_bytes)
+    srv = x509.load_pem_x509_certificate(open(crt2, "rb").read())
+    ca_cert.public_key().verify(
+        srv.signature, srv.tbs_certificate_bytes,
+        ec.ECDSA(srv.signature_hash_algorithm))

@@ -128,3 +128,89 @@ def test_timeout_cancellation_recycles_pages():
     assert svc.engine.allocator.free_pages == free0, "cancel leaked pages"
     assert not svc.engine.running and not svc.engine.waiting
     svc.stop()
+
+
+# ---- real HF tokenizer fixture (VERDICT r3 weak #7) ----
+
+FIXTURE = "tests/fixtures/tiny_hf_tokenizer"
+
+
+def test_hf_tokenizer_fixture_roundtrip():
+    """A committed LOCAL HF tokenizer dir (byte-level BPE, vocab 161 — it
+    fits the tiny model's 256 vocab) exercises the transformers path that
+    only the byte fallback covered before."""
+    tok = load_tokenizer(FIXTURE)
+    assert type(tok).__name__ == "HFTokenizer"
+    assert tok.vocab_size < 256  # usable as the tiny model's tokenizer
+    for text in ("the quick brown fox", "hello world 你好",
+                 "prefill decode kv cache"):
+        ids = tok.encode(text, add_bos=False)
+        assert ids and all(isinstance(i, int) for i in ids)
+        assert tok.decode(ids) == text
+    # BOS handling.
+    with_bos = tok.encode("hello", add_bos=True)
+    assert with_bos[0] == tok.bos_id
+
+
+def test_hf_incremental_detok_bpe_boundaries():
+    """Incremental detokenization with REAL BPE: multi-token graphemes and
+    byte-level merges must stream without ever emitting partial chars, and
+    the commit-window suffix check must hold for BPE too."""
+    from rbg_tpu.engine.tokenizer import IncrementalDetokenizer
+    tok = load_tokenizer(FIXTURE)
+    text = "the quick brown fox jumps over the lazy dog héllo 你好 " * 20
+    ids = tok.encode(text, add_bos=False)
+    assert len(ids) > 3 * IncrementalDetokenizer.WINDOW
+    detok = IncrementalDetokenizer(tok)
+    parts = [detok.feed(i) for i in ids]
+    joined = "".join(parts) + detok.flush()
+    assert joined == tok.decode(ids)
+    assert all("�" not in p for p in parts)
+
+
+def test_generate_text_with_hf_tokenizer():
+    """decode-to-text quality path: the engine server with a real local
+    tokenizer dir returns decoded TEXT (the byte-fallback vocab-guard test
+    above shows the refusal; this shows the success path)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    from rbg_tpu.engine.protocol import request_once
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "RBG_SERVE_PORT": str(port)})
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rbg_tpu.engine.server", "--model", "tiny",
+         "--page-size", "8", "--num-pages", "64", "--max-seq-len", "128",
+         "--use-pallas", "never", "--tokenizer-path", FIXTURE],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        ready = False
+        for _ in range(200):
+            try:
+                r, _, _ = request_once(f"127.0.0.1:{port}", {"op": "health"},
+                                       timeout=2)
+                if r and r.get("ok"):
+                    ready = True
+                    break
+            except OSError:
+                pass
+            time.sleep(0.3)
+        assert ready, "engine server never became healthy"
+        r, _, _ = request_once(f"127.0.0.1:{port}",
+                               {"op": "generate_text",
+                                "text": "the quick brown",
+                                "max_new_tokens": 8}, timeout=120)
+        assert "error" not in r, r
+        assert isinstance(r["text"], str)
+        assert len(r["tokens"]) >= 1
+    finally:
+        proc.terminate()
+        proc.wait()

@@ -34,6 +34,7 @@ def build_config(args) -> EngineConfig:
         use_pallas=args.use_pallas,
         checkpoint_path=args.checkpoint_path,
         kv_dtype=args.kv_dtype,
+        multi_step=args.multi_step,
     )
 
 
@@ -178,6 +179,7 @@ class EngineServer(socketserver.ThreadingTCPServer):
 
 def serve(args) -> None:
     cfg = build_config(args)
+    cfg.validate()  # fail fast on bad CLI values, before the port binds
     port = int(os.environ.get("RBG_SERVE_PORT")
                or os.environ.get("RBG_PORT_SERVE")
                or args.port)
@@ -259,6 +261,9 @@ def main(argv=None) -> int:
                     default=os.environ.get("RBG_KV_POOL_ADDR", ""),
                     help="host:port of the shared KV pool (prefill mode; "
                          "Mooncake-store analog, rbg_tpu.engine.kvpool)")
+    ap.add_argument("--multi-step", type=int, default=1,
+                    help="decode steps fused per device dispatch (lax.scan "
+                         "window; higher = throughput, burstier streaming)")
     args = ap.parse_args(argv)
     serve(args)
     return 0

@@ -25,6 +25,15 @@ class EngineConfig:
     # the price, throughput the prize). Stop-token checks still happen
     # host-side, so up to K-1 speculative KV writes are discarded on stop.
     multi_step: int = 1
+    # Speculative decoding: "ngram" = prompt-lookup drafting (no draft
+    # model) + one (B, spec_k+1) verify forward per step. Because sampling
+    # randomness is position-keyed (sampler.py), output is bit-identical
+    # to non-speculative decoding — greedy AND sampled. Best on
+    # repetitive/structured text; host-syncs every step, so it replaces
+    # (and excludes) the fused multi_step window.
+    speculative: str = "off"                # off | ngram
+    spec_k: int = 4                         # max drafted tokens per step
+    spec_ngram: int = 3                     # trailing n-gram for lookup
     use_pallas: str = "auto"                # auto | always | never
     mode: str = "unified"                   # unified | prefill | decode
     mesh_spec: Optional[dict] = None        # {"dp": 1, "tp": 4} — from discovery
@@ -47,6 +56,16 @@ class EngineConfig:
             raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
         if self.multi_step < 1:
             raise ValueError("multi_step must be >= 1")
+        if self.speculative not in ("off", "ngram"):
+            raise ValueError(f"speculative {self.speculative!r} not in "
+                             "(off, ngram)")
+        if self.speculative != "off":
+            if self.multi_step != 1:
+                raise ValueError("speculative decoding and multi_step are "
+                                 "mutually exclusive (both own the decode "
+                                 "dispatch)")
+            if self.spec_k < 1 or self.spec_ngram < 1:
+                raise ValueError("spec_k and spec_ngram must be >= 1")
         if self.kv_dtype not in ("model", "int8"):
             raise ValueError(f"kv_dtype {self.kv_dtype!r} not in (model, int8)")
         if self.kv_dtype == "int8" and self.mode != "unified":
@@ -64,4 +83,52 @@ class SamplingParams:
     max_new_tokens: int = 16
     temperature: float = 0.0        # 0 = greedy
     top_k: int = 0                  # 0 = full vocab
+    top_p: float = 1.0              # nucleus mass; 1.0 = disabled
+    min_p: float = 0.0              # min prob ratio vs argmax; 0.0 = disabled
+    repetition_penalty: float = 1.0  # >1 discourages prompt+output tokens
+    presence_penalty: float = 0.0   # subtract once per distinct output token
+    frequency_penalty: float = 0.0  # subtract per output occurrence
+    seed: Optional[int] = None      # per-request PRNG stream (reproducible)
+    logprobs: bool = False          # emit chosen-token logprob per step
     stop_token: Optional[int] = None
+
+    def needs_penalties(self) -> bool:
+        return (self.repetition_penalty != 1.0
+                or self.presence_penalty != 0.0
+                or self.frequency_penalty != 0.0)
+
+    def validate(self) -> None:
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        if not 0.0 <= self.min_p < 1.0:
+            raise ValueError("min_p must be in [0, 1)")
+        if self.repetition_penalty <= 0:
+            raise ValueError("repetition_penalty must be > 0")
+
+    @classmethod
+    def from_wire(cls, obj: dict, *, default_max_tokens: int = 16,
+                  stop_token: Optional[int] = None) -> "SamplingParams":
+        """Parse sampling fields off a protocol message (engine server /
+        decode_bundle / HTTP front end all speak the same field names)."""
+        sp = cls(
+            max_new_tokens=int(obj.get("max_new_tokens", default_max_tokens)),
+            temperature=float(obj.get("temperature", 0.0)),
+            top_k=int(obj.get("top_k", 0)),
+            top_p=float(obj.get("top_p", 1.0)),
+            min_p=float(obj.get("min_p", 0.0)),
+            repetition_penalty=float(obj.get("repetition_penalty", 1.0)),
+            presence_penalty=float(obj.get("presence_penalty", 0.0)),
+            frequency_penalty=float(obj.get("frequency_penalty", 0.0)),
+            seed=(int(obj["seed"]) if obj.get("seed") is not None else None),
+            logprobs=bool(obj.get("logprobs", False)),
+            stop_token=(obj.get("stop_token") if obj.get("stop_token") is None
+                        else int(obj["stop_token"])),
+        )
+        if stop_token is not None and sp.stop_token is None:
+            sp.stop_token = stop_token
+        sp.validate()
+        return sp

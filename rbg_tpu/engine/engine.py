@@ -35,7 +35,7 @@ import numpy as np
 from rbg_tpu.engine.config import EngineConfig, SamplingParams
 from rbg_tpu.engine.kvcache import PageAllocator, PagedKVCache, pages_for_tokens
 from rbg_tpu.engine.radix_cache import RadixCache
-from rbg_tpu.engine.sampler import sample
+from rbg_tpu.engine.sampler import row_keys, sample, step_keys
 from rbg_tpu.models.llama import forward_paged, init_params
 
 
@@ -45,6 +45,7 @@ class StepEvent:
     token: int
     finished: bool
     text_done: bool = False
+    logprob: Optional[float] = None
 
 
 class Request:
@@ -53,6 +54,10 @@ class Request:
     def __init__(self, prompt: List[int], sampling: SamplingParams):
         self.id = next(Request._ids)
         self.prompt = list(prompt)
+        # _preempt folds generated output into prompt for re-prefill;
+        # everything past this index is OUTPUT for penalty accounting
+        # (presence/frequency act on generated tokens only).
+        self.orig_prompt_len = len(prompt)
         self.sampling = sampling
         self.output: List[int] = []
         self.state = "waiting"          # waiting | prefill | running | finished
@@ -61,6 +66,7 @@ class Request:
         self.prefill_pos = 0            # next prompt index to prefill
         self.seq_len = 0                # tokens materialized in KV
         self.last_token: Optional[int] = None
+        self.ngram = None                   # NGramIndex, speculative mode
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
 
@@ -87,7 +93,10 @@ class Engine:
             self.params = load_params(cfg.checkpoint_path, self.mcfg)
         else:
             self.params = init_params(self.mcfg, key)
-        self._sample_key = jax.random.key(cfg.seed + 1)
+        # Base for per-row sampling streams: a request's randomness is
+        # fold_in(row_key, position) — row_key from its seed (reproducible)
+        # or from this base + request id (distinct streams). See sampler.py.
+        self._sample_base = jax.random.key(cfg.seed + 1)
 
         self.cache = PagedKVCache.create(self.mcfg, cfg.num_pages, cfg.page_size,
                                          quantize=(cfg.kv_dtype == "int8"))
@@ -101,15 +110,17 @@ class Engine:
         self.running: List[Request] = []
         self.requests: Dict[int, Request] = {}
         self._fwd_cache: Dict[Tuple[int, int], object] = {}
-        self._sampler = jax.jit(sample)
+        self._samplers: Dict[Tuple[bool, bool], object] = {}
         # Fused decode path: device-resident (tok, pos, kvl, table, …) state
         # plus a one-step emission lag so host bookkeeping for step N+1
         # overlaps the device computing step N (see _decode_step).
         self._dec: Optional[dict] = None
-        self._dec_key = jax.random.key(cfg.seed + 2)
-        self._dec_fn_cache: Dict[int, object] = {}
+        self._dec_fn_cache: Dict[Tuple[int, bool, bool], object] = {}
+        self._spec_fn_cache: Dict[Tuple[int, bool], object] = {}
         self.metrics = {"steps": 0, "decode_tokens": 0, "prefill_tokens": 0,
-                        "radix_hit_tokens": 0, "preemptions": 0}
+                        "radix_hit_tokens": 0, "preemptions": 0,
+                        "spec_drafted": 0, "spec_accepted": 0,
+                        "spec_steps": 0}
 
     def _shard_state(self, mesh):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -128,9 +139,23 @@ class Engine:
 
     # ---- public API ----
 
+    def _check_prompt(self, prompt: List[int]) -> None:
+        """Reject wire-supplied token ids outside the vocab — they would
+        crash the single engine loop thread later (penalty mask indexing,
+        embedding gather on some backends) instead of failing one request."""
+        V = self.mcfg.vocab_size
+        if not prompt:
+            raise ValueError("empty prompt")
+        lo, hi = min(prompt), max(prompt)   # C-speed; this runs per admission
+        if lo < 0 or hi >= V:
+            bad = lo if lo < 0 else hi
+            raise ValueError(
+                f"prompt token {bad} outside model vocab [0, {V})")
+
     def add_request(self, prompt: List[int],
                     sampling: Optional[SamplingParams] = None) -> int:
         sampling = sampling or SamplingParams()
+        self._check_prompt(prompt)
         if len(prompt) + sampling.max_new_tokens > self.cfg.max_seq_len:
             raise ValueError(
                 f"prompt+max_new_tokens {len(prompt)}+{sampling.max_new_tokens} "
@@ -152,6 +177,7 @@ class Engine:
         logits). Returns None when no pages are free (caller falls back to
         a cold prefill through the normal admission queue)."""
         sampling = sampling or SamplingParams()
+        self._check_prompt(prompt)
         ps = self.cfg.page_size
         if prefix_len % ps or not 0 < prefix_len < len(prompt):
             raise ValueError(f"prefix_len {prefix_len} must be page-aligned "
@@ -292,20 +318,100 @@ class Engine:
         row_idx = np.asarray([i for i, _, _ in finishing] + [0] * pad, np.int32)
         tok_idx = np.asarray([j for _, j, _ in finishing] + [0] * pad, np.int32)
         sel = logits[jnp.asarray(row_idx), jnp.asarray(tok_idx)]  # [Bs, V]
-        temps = np.zeros(Bs, np.float32)
-        ks = np.zeros(Bs, np.int32)
-        for n, (_, _, req) in enumerate(finishing):
-            temps[n] = req.sampling.temperature
-            ks[n] = req.sampling.top_k
-        self._sample_key, sub = jax.random.split(self._sample_key)
-        toks = np.asarray(self._sampler(sel, sub, jnp.asarray(temps),
-                                        jnp.asarray(ks)))
+        reqs = [req for _, _, req in finishing]
+        temps, ks, tps, mps, seeds, rids, pen, lp, tpmp = \
+            self._sampling_rows(reqs, Bs)
+        poss = np.zeros(Bs, np.int32)
+        for n, req in enumerate(reqs):
+            poss[n] = req.seq_len  # position of the token being sampled
+        keys = step_keys(row_keys(seeds, self._sample_base, rids),
+                         jnp.asarray(poss))
+        args = [sel, keys, jnp.asarray(temps), jnp.asarray(ks),
+                jnp.asarray(tps), jnp.asarray(mps)]
+        if pen:
+            # First sampled token: output is empty except for pre-preemption
+            # tokens folded into the prompt (counted as output by
+            # _penalty_rows's oc_base).
+            pmask, oc_base, rep, pres, freq = self._penalty_rows(reqs, Bs)
+            args += [pmask, jnp.asarray(oc_base), rep, pres, freq]
+        toks, lps = self._get_sampler(pen, lp, tpmp)(*args)
+        toks = np.asarray(toks)
+        lps = np.asarray(lps) if lps is not None else None
         events = []
-        for n, (_, _, req) in enumerate(finishing):
+        for n, req in enumerate(reqs):
             req.state = "running"
             req.t_first = time.perf_counter()
-            events.append(self._emit(req, int(toks[n])))
+            events.append(self._emit(
+                req, int(toks[n]),
+                float(lps[n]) if lps is not None and req.sampling.logprobs
+                else None))
         return events
+
+    def _sampling_rows(self, reqs, B: int):
+        """Per-row sampling arrays + static variant flags for a batch —
+        the ONE gather shared by prefill finish, fused decode build, and
+        the speculative verify (a new sampling knob lands here once)."""
+        temps = np.zeros(B, np.float32)
+        ks = np.zeros(B, np.int32)
+        tps = np.ones(B, np.float32)
+        mps = np.zeros(B, np.float32)
+        seeds: List[Optional[int]] = [None] * B
+        rids = [0] * B
+        for i, r in enumerate(reqs):
+            sp = r.sampling
+            temps[i], ks[i], tps[i], mps[i] = (sp.temperature, sp.top_k,
+                                               sp.top_p, sp.min_p)
+            seeds[i], rids[i] = sp.seed, r.id
+        pen = any(r.sampling.needs_penalties() for r in reqs)
+        lp = any(r.sampling.logprobs for r in reqs)
+        tpmp = any(r.sampling.top_p < 1.0 or r.sampling.min_p > 0.0
+                   for r in reqs)
+        return temps, ks, tps, mps, seeds, rids, pen, lp, tpmp
+
+    def _penalty_rows(self, reqs, B: int):
+        """Host-built penalty state: prompt-seen mask, output-count base,
+        and per-row factors. [B, V] is only materialized when some request
+        in the batch actually uses penalties (callers compile separate
+        variants otherwise). A preempted-and-resumed request carries its
+        pre-preemption output inside ``prompt`` — those tokens count as
+        OUTPUT (oc_base), not prompt, so presence/frequency penalties and
+        seeded reproducibility survive preemption."""
+        V = self.mcfg.vocab_size
+        pmask = np.zeros((B, V), bool)
+        oc_base = np.zeros((B, V), np.int32)
+        rep = np.ones(B, np.float32)
+        pres = np.zeros(B, np.float32)
+        freq = np.zeros(B, np.float32)
+        for n, req in enumerate(reqs):
+            sp = req.sampling
+            pmask[n, np.asarray(req.prompt[:req.orig_prompt_len],
+                                np.int64)] = True
+            np.add.at(oc_base[n],
+                      np.asarray(req.prompt[req.orig_prompt_len:], np.int64),
+                      1)
+            rep[n], pres[n], freq[n] = (sp.repetition_penalty,
+                                        sp.presence_penalty,
+                                        sp.frequency_penalty)
+        return (jnp.asarray(pmask), oc_base, jnp.asarray(rep),
+                jnp.asarray(pres), jnp.asarray(freq))
+
+    def _get_sampler(self, pen: bool, lp: bool, tpmp: bool = True):
+        fn = self._samplers.get((pen, lp, tpmp))
+        if fn is None:
+            if pen:
+                def f(sel, keys, temps, ks, tps, mps, pmask, ocounts,
+                      rep, pres, freq):
+                    return sample(sel, keys, temps, ks, tps, mps,
+                                  prompt_mask=pmask, out_counts=ocounts,
+                                  rep=rep, pres=pres, freq=freq,
+                                  want_logprobs=lp, use_top_p_min_p=tpmp)
+            else:
+                def f(sel, keys, temps, ks, tps, mps):
+                    return sample(sel, keys, temps, ks, tps, mps,
+                                  want_logprobs=lp, use_top_p_min_p=tpmp)
+            fn = jax.jit(f)
+            self._samplers[(pen, lp, tpmp)] = fn
+        return fn
 
     # ---- decode ----
 
@@ -313,7 +419,7 @@ class Engine:
         """id(req) → number of un-emitted tokens awaiting fetch."""
         if self._dec is None or self._dec["pending"] is None:
             return {}
-        rows, _, valid = self._dec["pending"]
+        rows, _, _, valid = self._dec["pending"]
         return {id(r): v for r, v in zip(rows, valid)}
 
     def _decode_batch(self) -> List[Request]:
@@ -332,15 +438,18 @@ class Engine:
         return out
 
     def _emit_pending(self, pending) -> List[StepEvent]:
-        rows, toks_dev, valid = pending
+        rows, toks_dev, lp_dev, valid = pending
         vals = np.asarray(toks_dev)          # [K, B] — the one host sync
+        lpv = np.asarray(lp_dev) if lp_dev is not None else None
         events = []
         for i, req in enumerate(rows):
             for k in range(valid[i]):
                 if req.state != "running":
                     break                    # stop token cut the window short
                 self.metrics["decode_tokens"] += 1
-                events.append(self._emit(req, int(vals[k, i])))
+                lp = (float(lpv[k, i])
+                      if lpv is not None and req.sampling.logprobs else None)
+                events.append(self._emit(req, int(vals[k, i]), lp))
         return events
 
     def _drain_decode(self) -> List[StepEvent]:
@@ -353,14 +462,19 @@ class Engine:
             return []
         return self._emit_pending(st["pending"])
 
-    def _get_decode_fn(self, B: int):
-        """One fused jitted program per decode bucket: a lax.scan window of
-        ``multi_step`` iterations, each = forward + on-device sampling +
-        PRNG split + position/length increment, with the sampled token fed
-        straight back as the next iteration's input. Steady state does ZERO
-        host→device transfers per window and one device→host fetch (the
-        [K, B] token ids, one window late)."""
-        fn = self._dec_fn_cache.get(B)
+    def _get_decode_fn(self, B: int, pen: bool, lp: bool,
+                       tpmp: bool = True):
+        """One fused jitted program per (decode bucket, penalties-active,
+        logprobs-active): a lax.scan window of ``multi_step`` iterations,
+        each = forward + on-device sampling + position/length increment,
+        with the sampled token fed straight back as the next iteration's
+        input. Per-row sampling keys are fold_in(row_key, position) — no
+        key-split carry, and a state rebuild replays the identical stream.
+        Steady state does ZERO host→device transfers per window and one
+        device→host fetch (the [K, B] token ids, one window late). Penalty
+        state ([B, V] prompt mask + output counts) and per-step logprobs
+        only exist in the variants that need them."""
+        fn = self._dec_fn_cache.get((B, pen, lp, tpmp))
         if fn is not None:
             return fn
         import functools
@@ -369,9 +483,10 @@ class Engine:
         K = self.cfg.multi_step
 
         def fused(params, tok, pos, kvl, table, mask, limit, k_pages,
-                  v_pages, k_scales, v_scales, key, temps, ks):
+                  v_pages, k_scales, v_scales, keys, temps, ks, tps, mps,
+                  pmask=None, ocounts=None, rep=None, pres=None, freq=None):
             def body(carry, _):
-                tok, pos, kvl, kp, vp, ksc, vsc, key = carry
+                tok, pos, kvl, kp, vp, ksc, vsc, oc = carry
                 # Rows at their length limit (mid-window finishers) stop
                 # writing KV and stop advancing — their sampled values are
                 # discarded host-side via the per-row valid count.
@@ -380,26 +495,42 @@ class Engine:
                     params, tokens=tok[:, None], positions=pos[:, None],
                     token_mask=write_ok, kv_lens=kvl, page_table=table,
                     k_pages=kp, v_pages=vp, k_scales=ksc, v_scales=vsc)
-                key, sub = jax.random.split(key)
-                toks = sample(logits[:, 0, :], sub, temps, ks)
+                pkw = (dict(prompt_mask=pmask, out_counts=oc, rep=rep,
+                            pres=pres, freq=freq) if pen else {})
+                # Key by the OUTPUT token's position (pos + 1): the input
+                # token at ``pos`` was itself sampled with key fold_in(row,
+                # pos) — prefill keys its first token by seq_len, so reusing
+                # ``pos`` here would replay that exact Gumbel noise.
+                toks, lps = sample(logits[:, 0, :], step_keys(keys, pos + 1),
+                                   temps, ks, tps, mps, want_logprobs=lp,
+                                   use_top_p_min_p=tpmp, **pkw)
                 active = write_ok[:, 0]
+                if pen:
+                    oc = oc.at[jnp.arange(oc.shape[0]), toks].add(
+                        active.astype(jnp.int32))
                 pos = jnp.where(active, pos + 1, pos)
                 kvl = jnp.where(active, kvl + 1, kvl)
                 tok = jnp.where(active, toks, tok)
-                return (tok, pos, kvl, kp, vp, ksc, vsc, key), toks
+                ys = (toks, lps) if lp else toks
+                return (tok, pos, kvl, kp, vp, ksc, vsc, oc), ys
 
-            carry, toks_seq = jax.lax.scan(
+            oc0 = ocounts if pen else jnp.zeros((), jnp.int32)
+            carry, ys = jax.lax.scan(
                 body, (tok, pos, kvl, k_pages, v_pages, k_scales, v_scales,
-                       key), None, length=K)
-            tok, pos, kvl, kp, vp, ksc, vsc, key = carry
-            return toks_seq, tok, pos, kvl, kp, vp, ksc, vsc, key
+                       oc0), None, length=K)
+            tok, pos, kvl, kp, vp, ksc, vsc, oc = carry
+            toks_seq, lp_seq = ys if lp else (ys, None)
+            return toks_seq, lp_seq, tok, pos, kvl, kp, vp, ksc, vsc, oc
 
         # tok is NOT donated: the pending fetch reads last window's output
-        # after it has been fed back as this window's input.
-        donate = [2, 3, 11]  # pos, kvl, key
+        # after it has been fed back as this window's input. keys is reused
+        # across windows (constant); ocounts is carried and donated.
+        donate = [2, 3]  # pos, kvl
         donate += [7, 8, 9, 10] if self.cache.quantized else [7, 8]
+        if pen:
+            donate.append(17)  # ocounts
         fn = jax.jit(fused, donate_argnums=tuple(donate))
-        self._dec_fn_cache[B] = fn
+        self._dec_fn_cache[(B, pen, lp, tpmp)] = fn
         return fn
 
     def _build_decode_state(self, batch: List[Request]) -> dict:
@@ -410,29 +541,44 @@ class Engine:
         kvl = np.zeros(B, np.int32)
         mask = np.zeros((B, 1), bool)
         limit = np.zeros(B, np.int32)
-        temps = np.zeros(B, np.float32)
-        ks = np.zeros(B, np.int32)
         table = np.zeros((B, P), np.int32)
+        temps, ks, tps, mps, seeds, rids, pen, lp, tpmp = \
+            self._sampling_rows(batch, B)
         for i, r in enumerate(batch):
             tok[i] = r.last_token
             pos[i] = r.seq_len
             kvl[i] = r.seq_len + 1
             mask[i, 0] = True
             limit[i] = r.max_len()
-            temps[i] = r.sampling.temperature
-            ks[i] = r.sampling.top_k
             table[i, :len(r.pages)] = r.pages
-        return {
-            "rows": list(batch), "B": B,
+        st = {
+            "rows": list(batch), "B": B, "pen": pen, "lp": lp,
+            "tpmp": tpmp,
             "tok": jnp.asarray(tok), "pos": jnp.asarray(pos),
             "kvl": jnp.asarray(kvl), "mask": jnp.asarray(mask),
             "limit": jnp.asarray(limit),
             "temps": jnp.asarray(temps), "ks": jnp.asarray(ks),
+            "tps": jnp.asarray(tps), "mps": jnp.asarray(mps),
+            "keys": row_keys(seeds, self._sample_base, rids),
             "table_np": table, "table": jnp.asarray(table),
             "pending": None,
         }
+        if pen:
+            pmask, oc, rep, pres, freq = self._penalty_rows(batch, B)
+            for i, r in enumerate(batch):
+                np.add.at(oc[i], np.asarray(r.output, np.int64), 1)
+            st.update(pmask=pmask, ocounts=jnp.asarray(oc),
+                      rep=rep, pres=pres, freq=freq)
+        return st
 
     def _decode_step(self) -> List[StepEvent]:
+        if self.cfg.speculative == "ngram" and not any(
+                r.sampling.needs_penalties() for r in self.running):
+            # Penalized rows need sequential count updates the parallel
+            # verify can't honor — any such row flips the whole step back
+            # to the fused path (drain first: no stale pending survives).
+            events = self._drain_decode()
+            return events + self._spec_decode_step()
         events: List[StepEvent] = []
         batch = self._decode_batch()
         st = self._dec
@@ -505,27 +651,176 @@ class Engine:
                 row[len(r.pages):] = 0
             st["table"] = jnp.asarray(st["table_np"])
 
-        fn = self._get_decode_fn(st["B"])
-        toks_seq, tok, pos, kvl, kp, vp, ksc, vsc, self._dec_key = fn(
+        fn = self._get_decode_fn(st["B"], st["pen"], st["lp"],
+                                 st["tpmp"])
+        pen_args = ((st["pmask"], st["ocounts"], st["rep"], st["pres"],
+                     st["freq"]) if st["pen"] else ())
+        toks_seq, lp_seq, tok, pos, kvl, kp, vp, ksc, vsc, oc = fn(
             self.params, st["tok"], st["pos"], st["kvl"], st["table"],
             st["mask"], st["limit"], self.cache.k_pages, self.cache.v_pages,
             self.cache.k_scales, self.cache.v_scales,
-            self._dec_key, st["temps"], st["ks"])
+            st["keys"], st["temps"], st["ks"], st["tps"], st["mps"],
+            *pen_args)
         self.cache = PagedKVCache(k_pages=kp, v_pages=vp,
                                   k_scales=ksc, v_scales=vsc)
         st["tok"], st["pos"], st["kvl"] = tok, pos, kvl
+        if st["pen"]:
+            st["ocounts"] = oc
         valid = []
         for req in batch:
             valid.append(min(K, req.max_len() - req.seq_len))
             req.seq_len = min(req.seq_len + K, req.max_len())
 
-        prev, st["pending"] = st["pending"], (list(batch), toks_seq, valid)
+        prev, st["pending"] = st["pending"], (list(batch), toks_seq, lp_seq,
+                                              valid)
         if prev is not None:
             events.extend(self._emit_pending(prev))
         return events
 
-    def _emit(self, req: Request, tok: int) -> StepEvent:
+    # ---- speculative decode (prompt-lookup drafting) ----
+
+    def _ensure_ngram(self, req: Request):
+        """Lazily build/extend the request's n-gram index over its logical
+        sequence (prompt + output — stable across preemption, which only
+        moves tokens between the two)."""
+        from rbg_tpu.engine.spec import NGramIndex
+        if req.ngram is None:
+            req.ngram = NGramIndex(self.cfg.spec_ngram)
+        idx = req.ngram
+        have = len(idx.tokens)
+        total = req.total_len
+        if have < total:
+            seq = req.prompt + req.output
+            idx.extend(seq[have:total])
+
+    def _get_spec_fn(self, B: int, lp: bool, tpmp: bool = True):
+        """One jitted verify program per (bucket, logprobs): a (B, K+1)
+        paged forward + per-position sampling, keys fold_in(row, pos+1) —
+        the same keys the sequential path would use, so accepted tokens
+        are exactly what non-speculative decoding would have produced."""
+        fn = self._spec_fn_cache.get((B, lp, tpmp))
+        if fn is not None:
+            return fn
+        import functools
+        base = functools.partial(forward_paged, cfg=self.mcfg,
+                                 use_pallas=self.cfg.use_pallas)
+
+        def specfn(params, tok, pos, mask, kvl, table, k_pages, v_pages,
+                   k_scales, v_scales, keys, temps, ks, tps, mps):
+            logits, kp, vp, ksc, vsc = base(
+                params, tokens=tok, positions=pos, token_mask=mask,
+                kv_lens=kvl, page_table=table, k_pages=k_pages,
+                v_pages=v_pages, k_scales=k_scales, v_scales=v_scales)
+
+            def samp(lg_t, pos_t):          # [B, V], [B] — one position
+                return sample(lg_t, step_keys(keys, pos_t + 1),
+                              temps, ks, tps, mps, want_logprobs=lp,
+                              use_top_p_min_p=tpmp)
+
+            toks, lps = jax.vmap(samp, in_axes=(1, 1))(logits, pos)
+            return toks, lps, kp, vp, ksc, vsc  # toks/lps: [T, B]
+
+        donate = (6, 7, 8, 9) if self.cache.quantized else (6, 7)
+        fn = jax.jit(specfn, donate_argnums=donate)
+        self._spec_fn_cache[(B, lp, tpmp)] = fn
+        return fn
+
+    def _spec_decode_step(self) -> List[StepEvent]:
+        events: List[StepEvent] = []
+        batch = [r for r in self.running if r.state == "running"
+                 and len(r.output) < r.sampling.max_new_tokens]
+        if not batch:
+            return events
+        K = self.cfg.spec_k
+        ps = self.cfg.page_size
+        drafts: Dict[int, List[int]] = {}
+        # Draft + grow pages, oldest-first (preempt youngest on exhaustion;
+        # a row sheds its drafts before anyone gets preempted for them).
+        for req in sorted(batch, key=lambda r: r.t_submit):
+            if req.state != "running":
+                continue
+            self._ensure_ngram(req)
+            cap = min(K, req.sampling.max_new_tokens - len(req.output) - 1,
+                      self.cfg.max_seq_len - req.seq_len - 1)
+            d = req.ngram.draft(cap) if cap > 0 else []
+            while True:
+                need = (pages_for_tokens(req.seq_len + 1 + len(d), ps)
+                        - len(req.pages))
+                if need <= 0:
+                    break
+                extra = self._alloc(need)
+                if extra is not None:
+                    req.pages.extend(extra)
+                    break
+                if d:
+                    d = []          # shed drafts before preempting others
+                    continue
+                if self._preempt_youngest(exclude=req) is None:
+                    self._preempt(req)
+                    break
+            if req.state == "running":
+                drafts[id(req)] = d
+        batch = [r for r in batch if r.state == "running"]
+        if not batch:
+            return events
+
+        B = self._bucket(len(batch))
+        T = K + 1
+        P = self.cfg.max_pages_per_seq
+        tok = np.zeros((B, T), np.int32)
+        pos = np.zeros((B, T), np.int32)
+        mask = np.zeros((B, T), bool)
+        kvl = np.zeros(B, np.int32)
+        table = np.zeros((B, P), np.int32)
+        temps, ks, tps, mps, seeds, rids, _pen, lp, tpmp = \
+            self._sampling_rows(batch, B)
+        for i, r in enumerate(batch):
+            d = drafts[id(r)]
+            tok[i, 0] = r.last_token
+            tok[i, 1:1 + len(d)] = d
+            pos[i, :] = r.seq_len + np.arange(T)
+            mask[i, :1 + len(d)] = True
+            kvl[i] = r.seq_len + 1 + len(d)
+            table[i, :len(r.pages)] = r.pages
+        fn = self._get_spec_fn(B, lp, tpmp)
+        toks_out, lps_out, kp, vp, ksc, vsc = fn(
+            self.params, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray(mask), jnp.asarray(kvl), jnp.asarray(table),
+            self.cache.k_pages, self.cache.v_pages,
+            self.cache.k_scales, self.cache.v_scales,
+            row_keys(seeds, self._sample_base, rids),
+            jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(tps),
+            jnp.asarray(mps))
+        self.cache = PagedKVCache(k_pages=kp, v_pages=vp,
+                                  k_scales=ksc, v_scales=vsc)
+        vals = np.asarray(toks_out)                       # [T, B]
+        lpv = np.asarray(lps_out) if lps_out is not None else None
+        self.metrics["spec_steps"] += 1
+        for i, req in enumerate(batch):
+            d = drafts[id(req)]
+            m = 0
+            while m < len(d) and int(vals[m, i]) == d[m]:
+                m += 1
+            # d_0..d_{m-1} verified; vals[m] is the true next token at the
+            # first mismatch (or the bonus token when every draft held).
+            self.metrics["spec_drafted"] += len(d)
+            self.metrics["spec_accepted"] += m
+            emit_n = m + 1
+            req.seq_len += emit_n   # KV valid through the last GOOD input
+            for t in range(emit_n):
+                if req.state != "running":
+                    break           # stop token cut the window short
+                self.metrics["decode_tokens"] += 1
+                lpt = (float(lpv[t, i])
+                       if lpv is not None and req.sampling.logprobs else None)
+                events.append(self._emit(req, int(vals[t, i]), lpt))
+        return events
+
+    def _emit(self, req: Request, tok: int,
+              logprob: Optional[float] = None) -> StepEvent:
         req.output.append(tok)
+        if req.ngram is not None:
+            req.ngram.append(tok)
         req.last_token = tok
         finished = (
             len(req.output) >= req.sampling.max_new_tokens
@@ -533,7 +828,7 @@ class Engine:
         )
         if finished:
             self._finish(req)
-        return StepEvent(req.id, tok, finished)
+        return StepEvent(req.id, tok, finished, logprob=logprob)
 
     # ---- lifecycle ----
 

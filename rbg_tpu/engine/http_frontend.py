@@ -30,6 +30,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
+from rbg_tpu.engine.config import SamplingParams
 from rbg_tpu.engine.protocol import recv_msg, request_once, send_msg
 from rbg_tpu.engine.tokenizer import IncrementalDetokenizer, load_tokenizer
 
@@ -114,26 +115,95 @@ class Handler(BaseHTTPRequestHandler):
 
     # ---- completion core ----
 
+    @staticmethod
+    def _parse_stops(body: dict) -> List[str]:
+        stop = body.get("stop")
+        if stop is None:
+            return []
+        if not isinstance(stop, (str, list)):
+            raise ValueError("stop must be a string or array of strings")
+        stops = [stop] if isinstance(stop, str) else stop
+        return [s for s in stops if isinstance(s, str) and s][:4]
+
+    @staticmethod
+    def _earliest_stop(text: str, stops: List[str]) -> int:
+        """Index of the earliest stop-string match, or -1."""
+        return min((i for i in (text.find(s) for s in stops) if i >= 0),
+                   default=-1)
+
+    @staticmethod
+    def _tokens_until(tok, tokens: List[int], cut: int) -> int:
+        """How many leading tokens produce the first ``cut`` chars of the
+        decoded text (the token crossing the boundary is included)."""
+        if cut <= 0:
+            return 0
+        detok = IncrementalDetokenizer(tok)
+        total = 0
+        for i, t in enumerate(tokens):
+            total += len(detok.feed([t]))
+            if total >= cut:
+                return i + 1
+        return len(tokens)
+
+    @staticmethod
+    def _sampling_fields(body: dict) -> dict:
+        """OpenAI body → wire sampling fields (top_k / min_p /
+        repetition_penalty are the usual engine extensions)."""
+        out = {
+            "temperature": float(body.get("temperature", 0.0)),
+            "top_k": int(body.get("top_k", 0)),
+            "top_p": float(body.get("top_p", 1.0)),
+            "min_p": float(body.get("min_p", 0.0)),
+            "repetition_penalty": float(body.get("repetition_penalty", 1.0)),
+            "presence_penalty": float(body.get("presence_penalty", 0.0)),
+            "frequency_penalty": float(body.get("frequency_penalty", 0.0)),
+        }
+        if body.get("seed") is not None:
+            out["seed"] = int(body["seed"])
+        if body.get("logprobs"):
+            out["logprobs"] = True
+        return out
+
+    @staticmethod
+    def _logprobs_obj(chat: bool, text_tokens: List[str],
+                      lps: List[float]) -> Optional[dict]:
+        if not lps:
+            return None
+        if chat:
+            return {"content": [{"token": t, "logprob": l}
+                                for t, l in zip(text_tokens, lps)]}
+        return {"tokens": text_tokens, "token_logprobs": lps,
+                "top_logprobs": None, "text_offset": None}
+
     def _complete(self, st: _State, body: dict, prompt_text: str, chat: bool):
         tok = st.tokenizer
         # No BOS: byte-fallback ids must stay inside small demo vocabs; HF
         # tokenizers add specials via their own template when configured.
         ids = tok.encode(prompt_text, add_bos=False)
-        req = {
-            "op": "generate",
-            "prompt": ids,
-            "max_new_tokens": int(body.get("max_tokens")
-                                  or st.default_max_tokens),
-            "temperature": float(body.get("temperature", 0.0)),
-            "top_k": int(body.get("top_k", 0)),
-        }
-        if tok.eos_id is not None:
-            req["stop_token"] = tok.eos_id
+        try:
+            # Validate edge-side: a caller mistake must be a 400, not the
+            # backend's wire error surfacing as a 502 (which retry
+            # middleware would pointlessly retry). The field conversions
+            # themselves can raise too ("temperature": "hot") — they
+            # belong inside this guard as much as from_wire does.
+            req = {
+                "op": "generate",
+                "prompt": ids,
+                "max_new_tokens": int(body.get("max_tokens")
+                                      or st.default_max_tokens),
+                **self._sampling_fields(body),
+            }
+            if tok.eos_id is not None:
+                req["stop_token"] = tok.eos_id
+            SamplingParams.from_wire(req)
+            stops = self._parse_stops(body)
+        except (ValueError, TypeError) as e:
+            return self._error(400, f"invalid sampling parameters: {e}")
         rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
                else f"cmpl-{uuid.uuid4().hex[:24]}")
         created = int(time.time())
         if body.get("stream"):
-            return self._stream(st, req, rid, created, chat, len(ids))
+            return self._stream(st, req, rid, created, chat, stops)
         try:
             resp, _, _ = request_once(st.backend, req, timeout=300)
         except OSError as e:
@@ -142,22 +212,35 @@ class Handler(BaseHTTPRequestHandler):
             return self._error(502, (resp or {}).get("error", "no response"),
                                "server_error")
         tokens = resp.get("tokens", [])
+        lps = resp.get("logprobs", [])
         text = tok.decode(tokens)
         finish = ("stop" if (tok.eos_id is not None and tokens
                              and tokens[-1] == tok.eos_id) else "length")
+        if stops:
+            cut = self._earliest_stop(text, stops)
+            if cut >= 0:
+                # Truncate tokens/logprobs/usage with the text — the client
+                # only ever sees the kept prefix (the backend generated
+                # more; stop matching is this edge's concern).
+                keep = self._tokens_until(tok, tokens, cut)
+                tokens, lps = tokens[:keep], lps[:keep]
+                text, finish = text[:cut], "stop"
         usage = {"prompt_tokens": len(ids), "completion_tokens": len(tokens),
                  "total_tokens": len(ids) + len(tokens)}
+        lp_obj = (self._logprobs_obj(chat, [tok.decode([t]) for t in tokens],
+                                     lps) if lps else None)
         if chat:
+            choice = {"index": 0, "finish_reason": finish,
+                      "message": {"role": "assistant", "content": text}}
+            if lp_obj is not None:
+                choice["logprobs"] = lp_obj
             return self._json(200, {
                 "id": rid, "object": "chat.completion", "created": created,
-                "model": st.model, "usage": usage,
-                "choices": [{"index": 0, "finish_reason": finish,
-                             "message": {"role": "assistant",
-                                         "content": text}}]})
+                "model": st.model, "usage": usage, "choices": [choice]})
         return self._json(200, {
             "id": rid, "object": "text_completion", "created": created,
             "model": st.model, "usage": usage,
-            "choices": [{"index": 0, "text": text, "logprobs": None,
+            "choices": [{"index": 0, "text": text, "logprobs": lp_obj,
                          "finish_reason": finish}]})
 
     def _sse(self, obj) -> None:
@@ -167,22 +250,28 @@ class Handler(BaseHTTPRequestHandler):
         self.wfile.flush()
 
     def _chunk(self, st, rid, created, chat, text: Optional[str],
-               finish: Optional[str]) -> dict:
+               finish: Optional[str], lp_obj: Optional[dict] = None) -> dict:
         if chat:
             delta = {} if text is None else {"content": text}
+            choice = {"index": 0, "delta": delta, "finish_reason": finish}
+            if lp_obj is not None:
+                choice["logprobs"] = lp_obj
             return {"id": rid, "object": "chat.completion.chunk",
                     "created": created, "model": st.model,
-                    "choices": [{"index": 0, "delta": delta,
-                                 "finish_reason": finish}]}
+                    "choices": [choice]}
         return {"id": rid, "object": "text_completion", "created": created,
                 "model": st.model,
                 "choices": [{"index": 0, "text": text or "",
-                             "logprobs": None, "finish_reason": finish}]}
+                             "logprobs": lp_obj, "finish_reason": finish}]}
 
     def _stream(self, st: _State, req: dict, rid: str, created: int,
-                chat: bool, n_prompt: int):
+                chat: bool, stops: List[str] = ()):
         req["stream"] = True
         detok = IncrementalDetokenizer(st.tokenizer)
+        # Stop-string hold-back: never emit the last len(longest stop)-1
+        # chars until more text rules out a partial stop match.
+        holdback = max((len(s) for s in stops), default=1) - 1
+        buf = ""
         host, port = st.backend.rsplit(":", 1)
         try:
             conn = socket.create_connection((host, int(port)), timeout=300)
@@ -197,7 +286,42 @@ class Handler(BaseHTTPRequestHandler):
             first = self._chunk(st, rid, created, chat, None, None)
             first["choices"][0]["delta"] = {"role": "assistant"}
             self._sse(first)
-        n_tokens, finish = 0, "length"
+        finish, stopped = "length", False
+        chars_out = 0                       # text chars emitted to the client
+        want_lp = bool(req.get("logprobs"))
+        # With stop strings, per-frame logprob chunks could cover tokens the
+        # stop later cuts (text lags tokens through the hold-back buffer) —
+        # defer to ONE exact chunk truncated against the emitted text.
+        defer_lp = want_lp and bool(stops)
+        all_toks: List[int] = []
+        all_lps: List[Optional[float]] = []
+
+        def send_text(text: str) -> None:
+            nonlocal chars_out
+            chars_out += len(text)
+            self._sse(self._chunk(st, rid, created, chat, text, None))
+
+        def emit_text(delta: str) -> bool:
+            """Emit delta through the stop-string buffer; True = stop hit
+            (buffer already flushed up to the match)."""
+            nonlocal buf, finish
+            if not stops:
+                if delta:
+                    send_text(delta)
+                return False
+            buf += delta
+            cut = self._earliest_stop(buf, stops)
+            if cut >= 0:
+                if buf[:cut]:
+                    send_text(buf[:cut])
+                buf, finish = "", "stop"
+                return True
+            safe = buf[:-holdback] if holdback else buf
+            if safe:
+                send_text(safe)
+                buf = buf[len(safe):]
+            return False
+
         try:
             with conn:
                 send_msg(conn, req)
@@ -212,19 +336,53 @@ class Handler(BaseHTTPRequestHandler):
                         break
                     toks = frame.get("tokens", [])
                     if toks:
-                        n_tokens += len(toks)
                         if (st.tokenizer.eos_id is not None
                                 and toks[-1] == st.tokenizer.eos_id):
                             finish = "stop"
-                        delta = detok.feed(toks)
-                        if delta:
-                            self._sse(self._chunk(st, rid, created, chat,
-                                                  delta, None))
+                        if defer_lp:
+                            all_toks.extend(toks)
+                            all_lps.extend(frame.get("logprobs")
+                                           or [None] * len(toks))
+                        hit = emit_text(detok.feed(toks))
+                        if (not hit and want_lp and not defer_lp
+                                and frame.get("logprobs")):
+                            # Token-level logprobs ride their own chunk —
+                            # text deltas lag tokens (detok buffering), so
+                            # aligning them to text chunks would
+                            # misattribute positions.
+                            lp_obj = self._logprobs_obj(
+                                chat,
+                                [st.tokenizer.decode([t]) for t in toks],
+                                frame["logprobs"])
+                            if lp_obj is not None:
+                                self._sse(self._chunk(st, rid, created, chat,
+                                                      None, None, lp_obj))
+                        if hit:
+                            stopped = True
+                            break  # client-side cut; backend stream abandoned
                     if frame.get("done"):
                         break
-            tail = detok.flush()
-            if tail:
-                self._sse(self._chunk(st, rid, created, chat, tail, None))
+            if not stopped:
+                tail = detok.flush()
+                if stops:
+                    buf += tail
+                    cut = self._earliest_stop(buf, stops)
+                    if cut >= 0:
+                        buf, finish = buf[:cut], "stop"
+                    if buf:
+                        send_text(buf)
+                elif tail:
+                    send_text(tail)
+            if defer_lp and all_toks:
+                # Exactly the tokens whose text was emitted — mirrors the
+                # non-stream truncation contract.
+                keep = self._tokens_until(st.tokenizer, all_toks, chars_out)
+                lp_obj = self._logprobs_obj(
+                    chat, [st.tokenizer.decode([t]) for t in all_toks[:keep]],
+                    all_lps[:keep])
+                if lp_obj is not None:
+                    self._sse(self._chunk(st, rid, created, chat, None, None,
+                                          lp_obj))
             self._sse(self._chunk(st, rid, created, chat, None, finish))
             self._sse("[DONE]")
             self.wfile.write(b"0\r\n\r\n")

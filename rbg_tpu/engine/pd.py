@@ -135,6 +135,7 @@ class DecodeWorker:
         sampling = sampling or SamplingParams()
         eng = self.engine
         prompt = bundle.prompt
+        eng._check_prompt(prompt)
         n_pages = bundle.k_data.shape[1]
         need = pages_for_tokens(len(prompt) + 1, eng.cfg.page_size)
         pages = eng._alloc(need)

@@ -35,44 +35,67 @@ def send_msg(sock: socket.socket, obj: dict,
             sock.sendall(v_bytes)
 
 
-_rfiles: "weakref.WeakKeyDictionary" = None  # initialized below
+_rbufs: "weakref.WeakKeyDictionary" = None  # initialized below
 
 
-def _rfile(sock: socket.socket):
-    """Per-socket buffered reader (persists across messages — a fresh
-    makefile per call would swallow buffered bytes of the next message).
-    socket.socket has __slots__, so the association lives in a weak map."""
-    global _rfiles
-    if _rfiles is None:
+def _rbuf(sock: socket.socket) -> bytearray:
+    """Per-socket receive buffer (persists across messages — bytes of the
+    NEXT message read in one recv must not be swallowed). Deliberately NOT
+    ``sock.makefile()``: a makefile reader pins the socket's fd open past
+    ``close()`` (socket._io_refs) and, stored in a weak map keyed by the
+    socket it references, would keep the entry — and the connection —
+    alive forever. A plain bytearray has no back-reference, so the entry
+    dies with the socket and ``close()`` really closes."""
+    global _rbufs
+    if _rbufs is None:
         import weakref
-        _rfiles = weakref.WeakKeyDictionary()
-    f = _rfiles.get(sock)
-    if f is None:
-        f = sock.makefile("rb", buffering=1 << 16)
-        _rfiles[sock] = f
-    return f
+        _rbufs = weakref.WeakKeyDictionary()
+    buf = _rbufs.get(sock)
+    if buf is None:
+        buf = bytearray()
+        _rbufs[sock] = buf
+    return buf
 
 
-def _read_exact(f, n: int) -> bytes:
-    buf = bytearray()
+_RECV_CHUNK = 1 << 16
+
+
+def _read_line(sock: socket.socket, buf: bytearray) -> bytes:
+    while True:
+        nl = buf.find(b"\n")
+        if nl >= 0:
+            line = bytes(buf[:nl + 1])
+            del buf[:nl + 1]
+            return line
+        chunk = sock.recv(_RECV_CHUNK)
+        if not chunk:
+            if buf:
+                raise ConnectionError("peer closed mid-header")
+            return b""
+        buf.extend(chunk)
+
+
+def _read_exact(sock: socket.socket, buf: bytearray, n: int) -> bytes:
     while len(buf) < n:
-        chunk = f.read(n - len(buf))
+        chunk = sock.recv(_RECV_CHUNK)
         if not chunk:
             raise ConnectionError("peer closed mid-payload")
         buf.extend(chunk)
-    return bytes(buf)
+    out = bytes(buf[:n])
+    del buf[:n]
+    return out
 
 
 def recv_msg(sock: socket.socket) -> Tuple[Optional[dict], Optional[bytes], Optional[bytes]]:
-    f = _rfile(sock)
-    line = f.readline()
+    buf = _rbuf(sock)
+    line = _read_line(sock, buf)
     if not line:
         return None, None, None
     obj = json.loads(line)
     k = v = None
     if "bin_k" in obj:
-        k = _read_exact(f, obj["bin_k"])
-        v = _read_exact(f, obj.get("bin_v", 0))
+        k = _read_exact(sock, buf, obj["bin_k"])
+        v = _read_exact(sock, buf, obj.get("bin_v", 0))
     return obj, k, v
 
 

@@ -122,15 +122,24 @@ class Handler(socketserver.BaseRequestHandler):
         state.metrics["requests"] += 1
         if state.pd_mode():
             state.metrics["pd_requests"] += 1
-            hdr, kb, vb = request_once(state.pick("prefill"),
-                                       {"op": "prefill",
-                                        "prompt": obj["prompt"]})
+            # Forward sampling fields: the FIRST token is sampled by the
+            # prefill engine — without them it would always be greedy,
+            # diverging from unified mode for the identical request.
+            pf_req = {"op": "prefill", "prompt": obj["prompt"]}
+            for key in ("temperature", "top_k", "top_p", "min_p",
+                        "repetition_penalty", "presence_penalty",
+                        "frequency_penalty", "seed", "stop_token"):
+                if key in obj:
+                    pf_req[key] = obj[key]
+            hdr, kb, vb = request_once(state.pick("prefill"), pf_req)
             if hdr is None or "error" in hdr:
                 raise RuntimeError(f"prefill failed: {hdr}")
             state.metrics["kv_bytes_routed"] += len(kb or b"") + len(vb or b"")
             fwd = dict(hdr)
             fwd["op"] = "decode_bundle"
-            for key in ("max_new_tokens", "temperature", "top_k",
+            for key in ("max_new_tokens", "temperature", "top_k", "top_p",
+                        "min_p", "repetition_penalty", "presence_penalty",
+                        "frequency_penalty", "seed", "logprobs",
                         "stop_token", "stream"):
                 if key in obj:
                     fwd[key] = obj[key]

@@ -1,30 +1,165 @@
 """Token sampling — jittable, per-row parameters as arrays (one compiled
-sampler serves every batch mix of greedy/temperature/top-k)."""
+sampler serves every batch mix of greedy/temperature/top-k/top-p/min-p,
+with optional repetition/presence/frequency penalties and logprobs).
+
+Design notes (TPU-first):
+
+* **Per-row PRNG streams** — every row samples with its own key,
+  ``fold_in(row_key, position)``. Randomness is a pure function of
+  (request seed, token position): per-request ``seed`` gives OpenAI-style
+  reproducibility, and a decode-state rebuild (batch recomposition,
+  preemption resume) replays the identical stream instead of depending on
+  how many scan windows ran before it.
+* **Gumbel-max** instead of ``jax.random.categorical`` so the per-row keys
+  vmap cleanly: ``argmax(logits/T + G)`` with row-keyed Gumbel noise is
+  exactly categorical sampling.
+* **Masking is value-space** — top-k/top-p/min-p thresholds are computed on
+  sorted copies and applied by comparing against the threshold *value*
+  (ties at the boundary are kept), which keeps everything O(V log V) sorts
+  + elementwise, no scatters, fully fusable by XLA.
+* **Penalties are optional state** — they need token-count tensors
+  ([B, V]); the engine only threads them through the fused decode scan when
+  some request in the batch actually uses penalties, so the common greedy
+  path compiles without the arrays entirely.
+
+Semantics follow the de-facto engine conventions (SGLang/vLLM):
+repetition_penalty divides positive / multiplies negative logits of any
+token seen in prompt or output; presence/frequency penalties subtract from
+output-seen tokens; temperature scales before top-k/top-p/min-p; logprobs
+report the model distribution after penalties but before temperature.
+"""
 
 from __future__ import annotations
+
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+NEG_INF = -1e30  # avoid -inf NaN traps in (masked - masked) style arithmetic
+
+
+def apply_penalties(
+    logits: jnp.ndarray,        # [B, V] f32
+    prompt_mask: jnp.ndarray,   # [B, V] bool — token appears in the prompt
+    out_counts: jnp.ndarray,    # [B, V] int32 — occurrences in the output
+    rep: jnp.ndarray,           # [B] f32; 1.0 = disabled
+    pres: jnp.ndarray,          # [B] f32; 0.0 = disabled
+    freq: jnp.ndarray,          # [B] f32; 0.0 = disabled
+) -> jnp.ndarray:
+    seen = prompt_mask | (out_counts > 0)
+    rp = rep[:, None]
+    logits = jnp.where(
+        seen, jnp.where(logits > 0, logits / rp, logits * rp), logits)
+    out_seen = out_counts > 0
+    logits = logits - pres[:, None] * out_seen
+    logits = logits - freq[:, None] * out_counts.astype(logits.dtype)
+    return logits
+
+
+def _mask_top_k(scaled: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
+    B, V = scaled.shape
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(top_k - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=1)
+    return jnp.where((top_k[:, None] > 0) & (scaled < kth), NEG_INF, scaled)
+
+
+def _mask_top_p_min_p(scaled: jnp.ndarray, top_p: jnp.ndarray,
+                      min_p: jnp.ndarray) -> jnp.ndarray:
+    probs = jax.nn.softmax(scaled, axis=-1)
+    # top-p: keep the smallest prefix of sorted-desc probs whose exclusive
+    # cumulative mass is < top_p; threshold = smallest kept probability.
+    sp = jnp.sort(probs, axis=-1)[:, ::-1]
+    cum_excl = jnp.cumsum(sp, axis=-1) - sp
+    kept = cum_excl < top_p[:, None]
+    thresh = jnp.min(jnp.where(kept, sp, jnp.inf), axis=-1, keepdims=True)
+    scaled = jnp.where((top_p[:, None] < 1.0) & (probs < thresh),
+                       NEG_INF, scaled)
+    # min-p: drop tokens whose prob is below min_p * max-prob.
+    pmax = jnp.max(probs, axis=-1, keepdims=True)
+    scaled = jnp.where((min_p[:, None] > 0.0) & (probs < min_p[:, None] * pmax),
+                       NEG_INF, scaled)
+    return scaled
+
 
 def sample(
     logits: jnp.ndarray,        # [B, V] f32
-    key: jax.Array,
+    keys: jax.Array,            # [B] typed PRNG keys — per-row streams
     temperature: jnp.ndarray,   # [B] f32; 0 = greedy
     top_k: jnp.ndarray,         # [B] int32; 0 = full vocab
-) -> jnp.ndarray:
-    """Returns sampled token ids [B] int32."""
-    B, V = logits.shape
+    top_p: jnp.ndarray,         # [B] f32; 1.0 = disabled
+    min_p: jnp.ndarray,         # [B] f32; 0.0 = disabled
+    *,
+    prompt_mask: Optional[jnp.ndarray] = None,   # [B, V] bool
+    out_counts: Optional[jnp.ndarray] = None,    # [B, V] int32
+    rep: Optional[jnp.ndarray] = None,           # [B] f32
+    pres: Optional[jnp.ndarray] = None,          # [B] f32
+    freq: Optional[jnp.ndarray] = None,          # [B] f32
+    want_logprobs: bool = False,
+    use_top_p_min_p: bool = True,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Returns (token ids [B] int32, logprobs [B] f32 or None).
+
+    Penalty arguments are all-or-nothing: pass every one of prompt_mask /
+    out_counts / rep / pres / freq, or none (the caller compiles separate
+    variants so the penalty-free path never materializes [B, V] state).
+    ``use_top_p_min_p=False`` (static, host-known per batch) compiles out
+    the nucleus/min-p softmax+sort — the common greedy/top-k-only batch
+    should not pay a second O(V log V) sort per token.
+    """
+    if prompt_mask is not None:
+        logits = apply_penalties(logits, prompt_mask, out_counts,
+                                 rep, pres, freq)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    # top-k mask (per-row k; 0 = disabled)
-    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]               # [B, V]
-    k_idx = jnp.clip(top_k - 1, 0, V - 1)
-    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=1)  # [B, 1]
-    masked = jnp.where(
-        (top_k[:, None] > 0) & (logits < kth), -jnp.inf, logits
-    )
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = _mask_top_k(scaled, top_k)
+    if use_top_p_min_p:
+        scaled = _mask_top_p_min_p(scaled, top_p, min_p)
 
-    temp = jnp.maximum(temperature, 1e-6)[:, None]
-    sampled = jax.random.categorical(key, masked / temp, axis=-1).astype(jnp.int32)
-    return jnp.where(temperature > 0, sampled, greedy)
+    # Gumbel-max with per-row keys == per-row categorical.
+    noise = jax.vmap(lambda k, row: jax.random.gumbel(k, row.shape,
+                                                      row.dtype))(keys, scaled)
+    sampled = jnp.argmax(scaled + noise, axis=-1).astype(jnp.int32)
+    toks = jnp.where(temperature > 0, sampled, greedy)
+
+    lps = None
+    if want_logprobs:
+        # Model-distribution logprob of the chosen token (post-penalty,
+        # pre-temperature — the OpenAI ``logprobs`` convention).
+        full = jax.nn.log_softmax(logits, axis=-1)
+        lps = jnp.take_along_axis(full, toks[:, None], axis=-1)[:, 0]
+    return toks, lps
+
+
+@jax.jit
+def _row_keys(seed_vals: jnp.ndarray, has_seed: jnp.ndarray,
+              rids: jnp.ndarray, fallback_key: jax.Array) -> jax.Array:
+    ks = jax.vmap(jax.random.key)(seed_vals)
+    kf = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(fallback_key, rids)
+    kd = jnp.where(has_seed[:, None], jax.random.key_data(ks),
+                   jax.random.key_data(kf))
+    return jax.random.wrap_key_data(kd)
+
+
+def row_keys(seeds, fallback_key: jax.Array, ids) -> jax.Array:
+    """Build a [B] key array: rows with a seed get ``key(seed)`` (stable,
+    user-reproducible); rows without get ``fold_in(fallback, request id)``
+    (distinct streams per request, stable across decode-state rebuilds).
+    One fused dispatch — this runs on every decode-state rebuild, inside
+    the host scheduling path."""
+    # Mask into uint32 — wire seeds are arbitrary ints and NumPy 2.x raises
+    # OverflowError on out-of-range conversion (a request must never be able
+    # to kill the engine loop thread).
+    seed_vals = jnp.asarray(
+        [((s if s is not None else 0) & 0xFFFFFFFF) for s in seeds],
+        jnp.uint32)
+    has_seed = jnp.asarray([s is not None for s in seeds])
+    rids = jnp.asarray([int(i) & 0xFFFFFFFF for i in ids], jnp.uint32)
+    return _row_keys(seed_vals, has_seed, rids, fallback_key)
+
+
+def step_keys(keys: jax.Array, pos: jnp.ndarray) -> jax.Array:
+    """Per-row key for sampling the token at position ``pos`` (jittable)."""
+    return jax.vmap(jax.random.fold_in)(keys, pos)

@@ -35,6 +35,9 @@ def build_config(args) -> EngineConfig:
         checkpoint_path=args.checkpoint_path,
         kv_dtype=args.kv_dtype,
         multi_step=args.multi_step,
+        speculative=args.speculative,
+        spec_k=args.spec_k,
+        spec_ngram=args.spec_ngram,
     )
 
 
@@ -50,40 +53,70 @@ class Handler(socketserver.BaseRequestHandler):
                 return
             try:
                 self._dispatch(srv, obj, k, v)
+            except ConnectionError:
+                return      # client went away; generation already cancelled
             except Exception as e:
-                send_msg(self.request, {"error": str(e)})
+                try:
+                    send_msg(self.request, {"error": str(e)})
+                except OSError:
+                    return
 
-    def _stream_pending(self, service, pending, first_tokens=()):
+    def _stream_pending(self, service, pending, first_tokens=(),
+                        with_logprobs=False):
         """Relay a pending generation as incremental token-batch messages:
         ``{"tokens": [...], "done": false}``* then a final ``done`` frame
-        with ttft. The transport framing the SSE front end rides on."""
+        with ttft. The transport framing the SSE front end rides on. With
+        logprobs, frames carry an aligned ``"logprobs"`` slice (emission
+        waits for both lists — the loop thread appends tokens first)."""
         import time as _time
 
         from rbg_tpu.engine.service import DEFAULT_TIMEOUT_S
-        if first_tokens:
-            send_msg(self.request, {"tokens": list(first_tokens),
-                                    "done": False})
-        sent = 0
-        deadline = _time.monotonic() + DEFAULT_TIMEOUT_S
-        while True:
-            done = pending.done.is_set()
-            if done and pending.error:
-                send_msg(self.request, {"error": pending.error, "done": True})
-                return
-            tokens = list(pending.tokens)
-            if len(tokens) > sent:
-                send_msg(self.request, {"tokens": tokens[sent:], "done": False})
-                sent = len(tokens)
-            if done and sent == len(pending.tokens):
-                break
-            if _time.monotonic() > deadline:
-                service.cancel(pending)  # recycle slot + pages
-                send_msg(self.request, {"error": "generation timed out",
-                                        "done": True})
-                return
-            _time.sleep(0.005)
-        ttft = (pending.t_first - pending.t_submit) if pending.t_first else 0.0
-        send_msg(self.request, {"tokens": [], "done": True, "ttft_s": ttft})
+        try:
+            if first_tokens:
+                frame = {"tokens": list(first_tokens), "done": False}
+                if with_logprobs:
+                    # PD first token is sampled prefill-side (no logprob) —
+                    # null keeps the 1:1 alignment (OpenAI's convention for
+                    # tokens without a logprob).
+                    frame["logprobs"] = [None] * len(first_tokens)
+                send_msg(self.request, frame)
+            sent = 0
+            deadline = _time.monotonic() + DEFAULT_TIMEOUT_S
+            while True:
+                done = pending.done.is_set()
+                if done and pending.error:
+                    send_msg(self.request, {"error": pending.error,
+                                            "done": True})
+                    return
+                tokens = list(pending.tokens)
+                if with_logprobs:
+                    lps = list(pending.logprobs)
+                    n = len(tokens) if done else min(len(tokens), len(lps))
+                    if n > sent:
+                        send_msg(self.request, {"tokens": tokens[sent:n],
+                                                "logprobs": lps[sent:n],
+                                                "done": False})
+                        sent = n
+                elif len(tokens) > sent:
+                    send_msg(self.request, {"tokens": tokens[sent:],
+                                            "done": False})
+                    sent = len(tokens)
+                if done and sent == len(pending.tokens):
+                    break
+                if _time.monotonic() > deadline:
+                    service.cancel(pending)  # recycle slot + pages
+                    send_msg(self.request, {"error": "generation timed out",
+                                            "done": True})
+                    return
+                _time.sleep(0.005)
+            ttft = (pending.t_first - pending.t_submit) if pending.t_first else 0.0
+            send_msg(self.request, {"tokens": [], "done": True, "ttft_s": ttft})
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # Client went away mid-stream (e.g. the HTTP edge cut at a stop
+            # string): abort the generation so it stops occupying a batch
+            # slot and KV pages for the rest of its max_new_tokens budget.
+            service.cancel(pending)
+            raise ConnectionError("client closed stream")
 
     def _dispatch(self, srv, obj, k, v):
         op = obj.get("op")
@@ -110,12 +143,12 @@ class Handler(socketserver.BaseRequestHandler):
                     f"tokenizer vocab {tok.vocab_size} exceeds model vocab "
                     f"{vocab}; pass --tokenizer-path matching the model")})
                 return
-            sampling = SamplingParams(
-                max_new_tokens=obj.get("max_new_tokens", 64),
-                temperature=obj.get("temperature", 0.0),
-                top_k=obj.get("top_k", 0),
-                stop_token=tok.eos_id,
-            )
+            try:
+                sampling = SamplingParams.from_wire(
+                    obj, default_max_tokens=64, stop_token=tok.eos_id)
+            except (ValueError, TypeError) as e:
+                send_msg(self.request, {"error": f"bad sampling params: {e}"})
+                return
             prompt_ids = tok.encode(obj["text"])
             limit = srv.service.engine.cfg.max_seq_len
             if len(prompt_ids) + sampling.max_new_tokens > limit:
@@ -128,34 +161,45 @@ class Handler(socketserver.BaseRequestHandler):
                                     "ttft_s": ttft})
             return
         if op == "generate" and srv.service is not None:
-            sampling = SamplingParams(
-                max_new_tokens=obj.get("max_new_tokens", 16),
-                temperature=obj.get("temperature", 0.0),
-                top_k=obj.get("top_k", 0),
-                stop_token=obj.get("stop_token"),
-            )
+            try:
+                sampling = SamplingParams.from_wire(obj)
+            except (ValueError, TypeError) as e:
+                send_msg(self.request, {"error": f"bad sampling params: {e}"})
+                return
             if obj.get("stream"):
                 self._stream_pending(
                     srv.service, srv.service.submit_async(obj["prompt"],
-                                                          sampling))
+                                                          sampling),
+                    with_logprobs=sampling.logprobs)
                 return
-            tokens, ttft = srv.service.submit(obj["prompt"], sampling)
-            send_msg(self.request, {"tokens": tokens, "ttft_s": ttft})
+            try:
+                p = srv.service.submit_wait(obj["prompt"], sampling)
+            except (TimeoutError, ValueError) as e:
+                send_msg(self.request, {"error": str(e)})
+                return
+            resp = {"tokens": p.tokens, "ttft_s": srv.service.ttft(p)}
+            if sampling.logprobs:
+                resp["logprobs"] = p.logprobs
+            send_msg(self.request, resp)
             return
         if op == "prefill" and srv.prefill is not None:
+            try:
+                sampling = SamplingParams.from_wire(obj)
+            except (ValueError, TypeError) as e:
+                send_msg(self.request, {"error": f"bad sampling params: {e}"})
+                return
             with srv.pd_lock:
-                bundle = srv.prefill.prefill(obj["prompt"])
+                bundle = srv.prefill.prefill(obj["prompt"], sampling)
             header, kb, vb = bundle_to_wire(bundle)
             send_msg(self.request, header, kb, vb)
             return
         if op == "decode_bundle" and srv.decode is not None:
             bundle = bundle_from_wire(obj, k, v)
-            sampling = SamplingParams(
-                max_new_tokens=obj.get("max_new_tokens", 16),
-                temperature=obj.get("temperature", 0.0),
-                top_k=obj.get("top_k", 0),
-                stop_token=obj.get("stop_token"),
-            )
+            try:
+                sampling = SamplingParams.from_wire(obj)
+            except (ValueError, TypeError) as e:
+                send_msg(self.request, {"error": f"bad sampling params: {e}"})
+                return
             # Continuous batching: bundles from concurrent connections decode
             # together on the device (no per-connection serialization).
             if obj.get("stream"):
@@ -164,10 +208,19 @@ class Handler(socketserver.BaseRequestHandler):
                 # then carries only the first_token frame.
                 self._stream_pending(srv.decode,
                                      srv.decode.submit_async(bundle, sampling),
-                                     first_tokens=[bundle.first_token])
+                                     first_tokens=[bundle.first_token],
+                                     with_logprobs=sampling.logprobs)
                 return
-            tokens = srv.decode.submit_bundle(bundle, sampling)
-            send_msg(self.request, {"tokens": tokens}, )
+            try:
+                p = srv.decode.submit_wait(bundle, sampling)
+            except (TimeoutError, ValueError) as e:
+                send_msg(self.request, {"error": str(e)})
+                return
+            resp = {"tokens": [bundle.first_token] + p.tokens}
+            if sampling.logprobs:
+                # First token sampled prefill-side — null placeholder.
+                resp["logprobs"] = [None] + p.logprobs
+            send_msg(self.request, resp)
             return
         send_msg(self.request, {"error": f"unsupported op {op!r} in mode {srv.mode}"})
 
@@ -264,6 +317,13 @@ def main(argv=None) -> int:
     ap.add_argument("--multi-step", type=int, default=1,
                     help="decode steps fused per device dispatch (lax.scan "
                          "window; higher = throughput, burstier streaming)")
+    ap.add_argument("--speculative", choices=("off", "ngram"), default="off",
+                    help="prompt-lookup speculative decoding (bit-identical "
+                         "output; wins on repetitive/structured text)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="max drafted tokens per speculative verify step")
+    ap.add_argument("--spec-ngram", type=int, default=3,
+                    help="trailing n-gram length for prompt lookup")
     args = ap.parse_args(argv)
     serve(args)
     return 0

@@ -20,10 +20,11 @@ from rbg_tpu.engine.engine import Engine
 
 
 class _Pending:
-    __slots__ = ("tokens", "done", "t_submit", "t_first", "error")
+    __slots__ = ("tokens", "logprobs", "done", "t_submit", "t_first", "error")
 
     def __init__(self):
         self.tokens: List[int] = []
+        self.logprobs: List[float] = []   # 1:1 with tokens when requested
         self.done = threading.Event()
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
@@ -69,6 +70,19 @@ class _BatchService:
         if p.error:
             raise ValueError(p.error)
         return p.tokens
+
+    def submit_wait(self, item, sampling: SamplingParams,
+                    timeout: float = DEFAULT_TIMEOUT_S) -> _Pending:
+        """Blocking submit; returns the completed _Pending (tokens,
+        logprobs, ttft timestamps). The one blocking-wait/timeout contract
+        every caller — server ops included — goes through."""
+        p = self.submit_async(item, sampling)
+        self.wait(p, timeout)
+        return p
+
+    @staticmethod
+    def ttft(p: _Pending) -> float:
+        return (p.t_first - p.t_submit) if p.t_first else 0.0
 
     def cancel(self, pending: _Pending) -> None:
         """Abort an in-flight request (routed through the loop thread)."""
@@ -131,6 +145,8 @@ class _BatchService:
                 if pending.t_first is None:
                     pending.t_first = time.perf_counter()
                 pending.tokens.append(ev.token)
+                if ev.logprob is not None:
+                    pending.logprobs.append(ev.logprob)
                 if ev.finished:
                     pending.done.set()
                     del self._pending[ev.request_id]
@@ -147,9 +163,8 @@ class EngineService(_BatchService):
     def submit(self, prompt: List[int], sampling: SamplingParams,
                timeout: float = DEFAULT_TIMEOUT_S) -> Tuple[List[int], float]:
         """Blocking generate. Returns (tokens, ttft_seconds)."""
-        p = self.submit_async(prompt, sampling)
-        tokens = self.wait(p, timeout)
-        return tokens, (p.t_first - p.t_submit if p.t_first else 0.0)
+        p = self.submit_wait(prompt, sampling, timeout)
+        return p.tokens, self.ttft(p)
 
     def stats(self) -> dict:
         out = dict(self.engine.metrics)
@@ -181,6 +196,6 @@ class DecodeService(_BatchService):
 
     def submit_bundle(self, bundle, sampling: SamplingParams,
                       timeout: float = DEFAULT_TIMEOUT_S) -> List[int]:
-        p = self.submit_async(bundle, sampling)
-        tokens = self.wait(p, timeout)
-        return [bundle.first_token] + tokens
+        """Blocking decode of an injected bundle (first token included)."""
+        p = self.submit_wait(bundle, sampling, timeout)
+        return [bundle.first_token] + p.tokens

@@ -1,0 +1,71 @@
+"""Speculative decoding: prompt-lookup (n-gram) drafting.
+
+Reference context: the reference's engines ship speculative decoding as a
+headline feature (SGLang/vLLM n-gram a.k.a. prompt-lookup mode — no draft
+model). The TPU-native twist here: the engine's sampling randomness is a
+pure function of (request seed, token position) (see sampler.py), so the
+verify forward can recompute EXACTLY the token the sequential path would
+have sampled at every drafted position. Speculative output is therefore
+bit-identical to non-speculative output — for greedy AND temperature
+sampling — not merely drawn from the same distribution. No rejection
+sampling machinery is needed: accept while draft matches the recomputed
+sample, take the recomputed sample at the first mismatch (that token is
+the true next token), roll kv_len back past the junk KV.
+
+This module is the host-side drafting half: an incremental n-gram index
+over prompt + output per request. The device-side verify lives in
+Engine._spec_decode_step (one (B, K+1) forward_paged + per-position
+sampling — the same program shape as a prefill chunk).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class NGramIndex:
+    """Incremental last-occurrence n-gram index over one token sequence.
+
+    ``draft(k)`` proposes the k tokens that followed the MOST RECENT prior
+    occurrence of the current trailing n-gram (prompt-lookup decoding).
+    Updates are O(1) per appended token; drafting is O(k)."""
+
+    def __init__(self, n: int = 3):
+        if n < 1:
+            raise ValueError("ngram n must be >= 1")
+        self.n = n
+        self.tokens: List[int] = []
+        # gram -> index just past its most recent occurrence, and the
+        # occurrence before that. The tail's own registration would hide
+        # earlier matches in a single-slot map — at draft time the tail
+        # IS the most recent occurrence, so the useful one is `_prev`.
+        self._last: Dict[Tuple[int, ...], int] = {}
+        self._prev: Dict[Tuple[int, ...], int] = {}
+
+    def extend(self, tokens: List[int]) -> None:
+        for t in tokens:
+            self.append(t)
+
+    def append(self, tok: int) -> None:
+        self.tokens.append(tok)
+        n = self.n
+        if len(self.tokens) >= n:
+            gram = tuple(self.tokens[-n:])
+            old = self._last.get(gram)
+            if old is not None:
+                self._prev[gram] = old
+            self._last[gram] = len(self.tokens)
+
+    def draft(self, k: int) -> List[int]:
+        """Up to k draft tokens continuing the current tail, [] if the
+        trailing n-gram has no earlier occurrence."""
+        n = self.n
+        if k <= 0 or len(self.tokens) < n:
+            return []
+        gram = tuple(self.tokens[-n:])
+        cont = self._last.get(gram)
+        if cont is not None and cont >= len(self.tokens):
+            cont = self._prev.get(gram)  # most recent non-tail occurrence
+        if cont is None:
+            return []
+        return self.tokens[cont:cont + k]

@@ -233,3 +233,297 @@ def test_incremental_detok_long_stream_commits_window():
     assert "".join(out) + detok.flush() == tok.decode(ids)
     # The tail must stay bounded (committed), not grow with the stream.
     assert len(detok._tail) <= 2 * IncrementalDetokenizer.WINDOW + 3
+
+
+# ---- front-end logic against a scripted backend (no JAX, fast) ----
+
+
+class _MockBackend:
+    """Protocol-speaking TCP backend returning canned token streams —
+    isolates front-end behavior (stop strings, logprobs shaping, param
+    forwarding) from engine nondeterminism."""
+
+    def __init__(self, tokens, logprobs=None, frame_size=3):
+        import socketserver
+
+        from rbg_tpu.engine.protocol import recv_msg, send_msg
+        self.seen = []
+        mock = self
+
+        class H(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        obj, _, _ = recv_msg(self.request)
+                    except (ConnectionError, json.JSONDecodeError):
+                        return
+                    if obj is None:
+                        return
+                    if obj.get("op") == "health":
+                        send_msg(self.request, {"ok": True, "mode": "unified"})
+                        continue
+                    mock.seen.append(obj)
+                    toks, lps = list(tokens), logprobs and list(logprobs)
+                    if obj.get("stream"):
+                        for i in range(0, len(toks), frame_size):
+                            frame = {"tokens": toks[i:i + frame_size],
+                                     "done": False}
+                            if lps and obj.get("logprobs"):
+                                frame["logprobs"] = lps[i:i + frame_size]
+                            send_msg(self.request, frame)
+                        send_msg(self.request,
+                                 {"tokens": [], "done": True, "ttft_s": 0.01})
+                    else:
+                        resp = {"tokens": toks, "ttft_s": 0.01}
+                        if lps and obj.get("logprobs"):
+                            resp["logprobs"] = lps
+                        send_msg(self.request, resp)
+
+        import socketserver
+        self.server = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+        self.server.daemon_threads = True
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _frontend_for(backend_port):
+    from rbg_tpu.engine import http_frontend as hf
+    ns = type("A", (), {})()
+    ns.host, ns.port = "127.0.0.1", _free_port()
+    ns.backend = f"127.0.0.1:{backend_port}"
+    ns.model, ns.tokenizer_path, ns.default_max_tokens = "tiny", "", 16
+    server = hf.serve(ns)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, ns.port
+
+
+def _canned(text, logprobs=False, frame_size=3):
+    tok = ByteTokenizer()
+    ids = tok.encode(text, add_bos=False)
+    lps = [-0.5] * len(ids) if logprobs else None
+    return _MockBackend(ids, lps, frame_size=frame_size)
+
+
+def test_stop_string_truncates_nonstream():
+    be = _canned("hello STOP world")
+    fe, port = _frontend_for(be.port)
+    try:
+        resp = _post(port, "/v1/completions",
+                     {"prompt": "x", "stop": ["STOP"], "max_tokens": 32})
+        c = resp["choices"][0]
+        assert c["text"] == "hello "
+        assert c["finish_reason"] == "stop"
+    finally:
+        fe.shutdown(); be.close()
+
+
+def test_stop_string_streaming_holdback():
+    # Frames of 2 bytes force the stop string to arrive split across
+    # frames — the hold-back buffer must still cut exactly before it.
+    be = _canned("ab STOP tail", frame_size=2)
+    fe, port = _frontend_for(be.port)
+    try:
+        events, done = _sse_events(port, "/v1/completions",
+                                   {"prompt": "x", "stop": "STOP",
+                                    "max_tokens": 32, "stream": True})
+        assert done
+        text = "".join(e["choices"][0]["text"] for e in events)
+        assert text == "ab "
+        finishes = [e["choices"][0]["finish_reason"] for e in events]
+        assert finishes[-1] == "stop"
+    finally:
+        fe.shutdown(); be.close()
+
+
+def test_streaming_no_stop_passthrough_unchanged():
+    be = _canned("plain text out", frame_size=4)
+    fe, port = _frontend_for(be.port)
+    try:
+        events, done = _sse_events(port, "/v1/completions",
+                                   {"prompt": "x", "max_tokens": 32,
+                                    "stream": True})
+        assert done
+        text = "".join(e["choices"][0]["text"] for e in events)
+        assert text == "plain text out"
+    finally:
+        fe.shutdown(); be.close()
+
+
+def test_logprobs_shapes_completions_and_chat():
+    be = _canned("abc", logprobs=True)
+    fe, port = _frontend_for(be.port)
+    try:
+        resp = _post(port, "/v1/completions",
+                     {"prompt": "x", "logprobs": 1, "max_tokens": 8})
+        lp = resp["choices"][0]["logprobs"]
+        assert lp["token_logprobs"] == [-0.5] * 3
+        assert lp["tokens"] == ["a", "b", "c"]
+        resp = _post(port, "/v1/chat/completions",
+                     {"messages": [{"role": "user", "content": "x"}],
+                      "logprobs": True, "max_tokens": 8})
+        lp = resp["choices"][0]["logprobs"]
+        assert [e["logprob"] for e in lp["content"]] == [-0.5] * 3
+    finally:
+        fe.shutdown(); be.close()
+
+
+def test_sampling_fields_forwarded_to_backend():
+    be = _canned("ok")
+    fe, port = _frontend_for(be.port)
+    try:
+        _post(port, "/v1/completions",
+              {"prompt": "x", "temperature": 0.7, "top_p": 0.9,
+               "min_p": 0.05, "top_k": 40, "seed": 123,
+               "presence_penalty": 0.1, "frequency_penalty": 0.2,
+               "repetition_penalty": 1.1, "max_tokens": 4})
+        seen = be.seen[-1]
+        assert seen["temperature"] == 0.7 and seen["top_p"] == 0.9
+        assert seen["min_p"] == 0.05 and seen["top_k"] == 40
+        assert seen["seed"] == 123
+        assert seen["presence_penalty"] == 0.1
+        assert seen["frequency_penalty"] == 0.2
+        assert seen["repetition_penalty"] == 1.1
+    finally:
+        fe.shutdown(); be.close()
+
+
+def test_stop_truncates_logprobs_and_usage():
+    # "hello STOP world" with stop → kept tokens = len("hello ") (byte
+    # tokenizer: 1 token per char), and logprobs/usage must shrink with it.
+    be = _canned("hello STOP world", logprobs=True)
+    fe, port = _frontend_for(be.port)
+    try:
+        resp = _post(port, "/v1/completions",
+                     {"prompt": "x", "stop": ["STOP"], "logprobs": 1,
+                      "max_tokens": 32})
+        c = resp["choices"][0]
+        assert c["text"] == "hello "
+        assert len(c["logprobs"]["token_logprobs"]) == len("hello ")
+        assert resp["usage"]["completion_tokens"] == len("hello ")
+    finally:
+        fe.shutdown(); be.close()
+
+
+def test_streaming_logprobs_chunks():
+    be = _canned("abcdef", logprobs=True, frame_size=2)
+    fe, port = _frontend_for(be.port)
+    try:
+        events, done = _sse_events(port, "/v1/completions",
+                                   {"prompt": "x", "logprobs": 1,
+                                    "max_tokens": 32, "stream": True})
+        assert done
+        lps = []
+        for e in events:
+            lp = e["choices"][0].get("logprobs")
+            if lp:
+                lps.extend(lp["token_logprobs"])
+        assert lps == [-0.5] * 6
+        text = "".join(e["choices"][0]["text"] for e in events)
+        assert text == "abcdef"
+        # chat shape too
+        events, done = _sse_events(port, "/v1/chat/completions",
+                                   {"messages": [{"role": "user",
+                                                  "content": "x"}],
+                                    "logprobs": True, "max_tokens": 32,
+                                    "stream": True})
+        assert done
+        toks = []
+        for e in events:
+            lp = e["choices"][0].get("logprobs")
+            if lp:
+                toks.extend(lp["content"])
+        assert [t["logprob"] for t in toks] == [-0.5] * 6
+    finally:
+        fe.shutdown(); be.close()
+
+
+@pytest.mark.e2e
+def test_pd_logprobs_first_token_null(stack):
+    fe = stack
+    resp = _post(fe, "/v1/completions",
+                 {"model": "tiny", "prompt": "lp", "max_tokens": 6,
+                  "logprobs": 1})
+    lp = resp["choices"][0]["logprobs"]
+    lps = lp["token_logprobs"]
+    assert len(lps) == 6
+    assert lps[0] is None               # prefill-side token: no logprob
+    assert all(isinstance(v, float) and v <= 0 for v in lps[1:])
+
+
+def test_invalid_sampling_params_return_400():
+    be = _canned("ok")
+    fe, port = _frontend_for(be.port)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions", method="POST",
+            data=json.dumps({"prompt": "x", "temperature": -1}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("expected HTTPError")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            body = json.loads(e.read())
+            assert body["error"]["type"] == "invalid_request_error"
+    finally:
+        fe.shutdown(); be.close()
+
+
+def test_stop_at_offset_zero_reports_empty():
+    be = _canned("STOP right away", logprobs=True)
+    fe, port = _frontend_for(be.port)
+    try:
+        resp = _post(port, "/v1/completions",
+                     {"prompt": "x", "stop": ["STOP"], "logprobs": 1,
+                      "max_tokens": 32})
+        c = resp["choices"][0]
+        assert c["text"] == "" and c["finish_reason"] == "stop"
+        assert resp["usage"]["completion_tokens"] == 0
+        assert c["logprobs"] is None or c["logprobs"]["token_logprobs"] == []
+    finally:
+        fe.shutdown(); be.close()
+
+
+def test_streaming_stop_logprobs_match_emitted_text():
+    # Stop + logprobs in a stream: exactly one logprobs chunk, truncated to
+    # the emitted text, mirroring the non-stream contract.
+    be = _canned("hello STOP world", logprobs=True, frame_size=2)
+    fe, port = _frontend_for(be.port)
+    try:
+        events, done = _sse_events(port, "/v1/completions",
+                                   {"prompt": "x", "stop": ["STOP"],
+                                    "logprobs": 1, "max_tokens": 32,
+                                    "stream": True})
+        assert done
+        text = "".join(e["choices"][0]["text"] for e in events)
+        assert text == "hello "
+        lp_chunks = [e["choices"][0]["logprobs"] for e in events
+                     if e["choices"][0].get("logprobs")]
+        assert len(lp_chunks) == 1
+        assert lp_chunks[0]["token_logprobs"] == [-0.5] * len("hello ")
+        assert "".join(lp_chunks[0]["tokens"]) == "hello "
+    finally:
+        fe.shutdown(); be.close()
+
+
+def test_non_numeric_sampling_fields_return_400():
+    be = _canned("ok")
+    fe, port = _frontend_for(be.port)
+    try:
+        for bad in ({"temperature": "hot"}, {"max_tokens": "abc"},
+                    {"top_p": None}):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/completions", method="POST",
+                data=json.dumps({"prompt": "x", **bad}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError(f"expected 400 for {bad}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400, (bad, e.code)
+    finally:
+        fe.shutdown(); be.close()

@@ -1,0 +1,140 @@
+"""Speculative decoding (prompt-lookup / n-gram drafting).
+
+The load-bearing property: because sampling randomness is position-keyed
+(rbg_tpu/engine/sampler.py), speculative output is BIT-IDENTICAL to
+non-speculative output — greedy and temperature sampling alike — so every
+test here is an exact-equality check, not a distribution check.
+
+Reference context: the reference's engines (SGLang/vLLM) ship n-gram
+speculative decoding as a headline feature; the verify pass here is one
+(B, K+1) ``forward_paged`` whose per-query causal masking
+(ops/paged_attention.py:58) guarantees junk post-mismatch KV never
+pollutes accepted positions."""
+
+import pytest
+
+from rbg_tpu.engine import Engine, EngineConfig, SamplingParams
+from rbg_tpu.engine.spec import NGramIndex
+
+
+# ---- NGramIndex ----
+
+
+def test_ngram_draft_basic():
+    idx = NGramIndex(2)
+    idx.extend([1, 2, 3, 1, 2])
+    assert idx.draft(2) == [3, 1]          # continuation of earlier (1,2)
+    idx.append(3)                          # tail (2,3) seen earlier at idx 2
+    assert idx.draft(3) == [1, 2, 3]
+
+
+def test_ngram_no_earlier_occurrence():
+    idx = NGramIndex(3)
+    idx.extend([5, 6, 7])
+    assert idx.draft(4) == []              # only occurrence is the tail
+
+
+def test_ngram_truncated_at_sequence_end():
+    idx = NGramIndex(1)
+    idx.extend([4, 4, 4])
+    assert idx.draft(2) == [4]             # continuation shorter than k
+
+
+def test_ngram_most_recent_match_wins():
+    idx = NGramIndex(2)
+    idx.extend([1, 2, 9, 5, 1, 2, 7, 3, 1, 2])
+    assert idx.draft(1) == [7]             # the LATER (1,2) continuation
+
+
+# ---- engine equivalence ----
+
+
+def _mk(**kw):
+    return Engine(EngineConfig(model="tiny", page_size=8, num_pages=128,
+                               max_seq_len=256, use_pallas="never",
+                               enable_radix_cache=False, **kw))
+
+
+REP_PROMPT = [1, 2, 3, 4] * 8
+
+
+def test_spec_greedy_bit_identical():
+    plain = _mk().generate([REP_PROMPT], SamplingParams(max_new_tokens=24))[0]
+    eng = _mk(speculative="ngram")
+    spec = eng.generate([REP_PROMPT], SamplingParams(max_new_tokens=24))[0]
+    assert plain == spec
+    assert eng.metrics["spec_steps"] > 0
+    assert eng.metrics["spec_accepted"] <= eng.metrics["spec_drafted"]
+
+
+def test_spec_sampled_bit_identical():
+    sp = SamplingParams(max_new_tokens=24, temperature=1.0, top_p=0.9, seed=3)
+    a = _mk().generate([REP_PROMPT], sp)[0]
+    b = _mk(speculative="ngram").generate([REP_PROMPT], sp)[0]
+    assert a == b
+
+
+def test_spec_batch_bit_identical():
+    prompts = [[1, 2, 3] * 6, [9, 8, 7, 6, 5], [4] * 8]
+    sp = SamplingParams(max_new_tokens=12)
+    assert _mk().generate(prompts, sp) == \
+        _mk(speculative="ngram").generate(prompts, sp)
+
+
+def test_spec_stop_token_respected():
+    # Find the greedy continuation, then stop on its 3rd token — spec and
+    # plain paths must cut at the same place.
+    base = _mk().generate([REP_PROMPT], SamplingParams(max_new_tokens=10))[0]
+    stop = base[2]
+    sp = SamplingParams(max_new_tokens=10, stop_token=stop)
+    plain = _mk().generate([REP_PROMPT], sp)[0]
+    spec = _mk(speculative="ngram").generate([REP_PROMPT], sp)[0]
+    assert plain == spec
+    assert plain[-1] == stop or len(plain) == 10
+
+
+def test_spec_penalties_fall_back_to_fused_path():
+    # Penalized rows can't verify in parallel — the engine must fall back
+    # and still produce the sequential result.
+    sp = SamplingParams(max_new_tokens=12, presence_penalty=1e9)
+    plain = _mk().generate([REP_PROMPT], sp)[0]
+    eng = _mk(speculative="ngram")
+    spec = eng.generate([REP_PROMPT], sp)[0]
+    assert plain == spec
+    assert eng.metrics["spec_steps"] == 0      # never took the spec path
+    assert len(set(spec)) == len(spec)
+
+
+def test_spec_logprobs_emitted():
+    eng = _mk(speculative="ngram")
+    rid = eng.add_request(REP_PROMPT,
+                          SamplingParams(max_new_tokens=8, logprobs=True))
+    lps = []
+    while eng.has_work():
+        for ev in eng.step():
+            if ev.request_id == rid:
+                lps.append(ev.logprob)
+    assert len(lps) == 8
+    assert all(lp is not None and lp <= 0 for lp in lps)
+
+
+def test_spec_preemption_equivalence():
+    # Tight page pool forces preemption mid-spec; output must still match
+    # the sequential result from an unconstrained engine.
+    sp = SamplingParams(max_new_tokens=16, seed=5, temperature=1.0)
+    prompts = [[1, 2, 3, 4] * 4, [5, 6, 7, 8] * 4, [2, 4, 6, 8] * 4]
+    big = _mk().generate(prompts, sp)
+    eng = Engine(EngineConfig(model="tiny", page_size=8, num_pages=10,
+                              max_seq_len=256, use_pallas="never",
+                              enable_radix_cache=False, speculative="ngram"))
+    small = eng.generate(prompts, sp)
+    assert eng.metrics["preemptions"] > 0
+    assert big == small
+
+
+def test_spec_config_validation():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        EngineConfig(model="tiny", speculative="ngram",
+                     multi_step=4).validate()
+    with pytest.raises(ValueError, match="speculative"):
+        EngineConfig(model="tiny", speculative="eagle").validate()

@@ -39,10 +39,13 @@ class EngineConfig:
     mesh_spec: Optional[dict] = None        # {"dp": 1, "tp": 4} — from discovery
     checkpoint_path: str = ""               # orbax dir or local HF dir
     kv_dtype: str = "model"                 # model | int8 (quantized KV pool)
+    vocab_size: int = 0                     # override preset vocab (0 = keep)
     seed: int = 0
 
     @property
     def model_config(self) -> ModelConfig:
+        if self.vocab_size:
+            return get_config(self.model, vocab_size=self.vocab_size)
         return get_config(self.model)
 
     @property
@@ -76,6 +79,15 @@ class EngineConfig:
             raise ValueError(
                 "use_pallas='always' is incompatible with kv_dtype='int8' — "
                 "the Pallas kernel does not dequantize yet; use 'auto'")
+        mcfg = self.model_config
+        if mcfg.mla:
+            if self.kv_dtype == "int8":
+                raise ValueError("kv_dtype='int8' not supported for MLA "
+                                 "latent pools yet")
+            if self.use_pallas == "always":
+                raise ValueError("use_pallas='always' unsupported for MLA — "
+                                 "the Pallas kernel is GQA-shaped; MLA "
+                                 "attention runs the XLA path")
 
 
 @dataclasses.dataclass
@@ -90,6 +102,7 @@ class SamplingParams:
     frequency_penalty: float = 0.0  # subtract per output occurrence
     seed: Optional[int] = None      # per-request PRNG stream (reproducible)
     logprobs: bool = False          # emit chosen-token logprob per step
+    json_mode: bool = False         # grammar-constrained: output is valid JSON
     stop_token: Optional[int] = None
 
     def needs_penalties(self) -> bool:
@@ -125,6 +138,7 @@ class SamplingParams:
             frequency_penalty=float(obj.get("frequency_penalty", 0.0)),
             seed=(int(obj["seed"]) if obj.get("seed") is not None else None),
             logprobs=bool(obj.get("logprobs", False)),
+            json_mode=bool(obj.get("json_mode", False)),
             stop_token=(obj.get("stop_token") if obj.get("stop_token") is None
                         else int(obj["stop_token"])),
         )

@@ -35,7 +35,7 @@ import numpy as np
 from rbg_tpu.engine.config import EngineConfig, SamplingParams
 from rbg_tpu.engine.kvcache import PageAllocator, PagedKVCache, pages_for_tokens
 from rbg_tpu.engine.radix_cache import RadixCache
-from rbg_tpu.engine.sampler import row_keys, sample, step_keys
+from rbg_tpu.engine.sampler import NEG_INF, row_keys, sample, step_keys
 from rbg_tpu.models.llama import forward_paged, init_params
 
 
@@ -67,6 +67,7 @@ class Request:
         self.seq_len = 0                # tokens materialized in KV
         self.last_token: Optional[int] = None
         self.ngram = None                   # NGramIndex, speculative mode
+        self.gstate = None                  # grammar state (json_mode)
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
 
@@ -116,7 +117,8 @@ class Engine:
         # overlaps the device computing step N (see _decode_step).
         self._dec: Optional[dict] = None
         self._dec_fn_cache: Dict[Tuple[int, bool, bool], object] = {}
-        self._spec_fn_cache: Dict[Tuple[int, bool], object] = {}
+        self._spec_fn_cache: Dict[Tuple[int, bool, bool, bool, bool], object] = {}
+        self.grammar = None     # TokenGrammar — enable_json_grammar()
         self.metrics = {"steps": 0, "decode_tokens": 0, "prefill_tokens": 0,
                         "radix_hit_tokens": 0, "preemptions": 0,
                         "spec_drafted": 0, "spec_accepted": 0,
@@ -127,7 +129,10 @@ class Engine:
         from rbg_tpu.parallel.sharding import param_specs, shard_pytree
         self.params = shard_pytree(
             self.params, param_specs(self.mcfg, self.params), mesh)
-        page_spec = NamedSharding(mesh, P(None, None, None, "tp", None))
+        # GQA pages shard over tp on the KV-head axis; the MLA latent pool
+        # has no head axis and replicates (it is ~10x smaller).
+        page_spec = NamedSharding(
+            mesh, P() if self.mcfg.mla else P(None, None, None, "tp", None))
         self.cache = PagedKVCache(
             k_pages=jax.device_put(self.cache.k_pages, page_spec),
             v_pages=jax.device_put(self.cache.v_pages, page_spec),
@@ -152,15 +157,45 @@ class Engine:
             raise ValueError(
                 f"prompt token {bad} outside model vocab [0, {V})")
 
+    def enable_json_grammar(self, tokenizer) -> None:
+        """Wire grammar-constrained decoding (json_mode requests) to a
+        tokenizer's token→bytes table. Callers that admit json_mode
+        requests without this get a per-request admission error."""
+        from rbg_tpu.engine.grammar import (JsonGrammar, TokenGrammar,
+                                            token_bytes_for)
+        self.grammar = TokenGrammar(JsonGrammar(),
+                                    token_bytes_for(tokenizer),
+                                    tokenizer.eos_id)
+
+    def _grammar_check(self, sampling: SamplingParams) -> None:
+        if sampling.json_mode and self.grammar is None:
+            raise ValueError(
+                "json_mode requires a grammar table — the server wires it "
+                "from the tokenizer (enable_json_grammar)")
+
+    def _gmask(self, state) -> np.ndarray:
+        """Grammar mask padded to MODEL vocab: ids beyond the tokenizer's
+        vocab can never be legal constrained output."""
+        V = self.mcfg.vocab_size
+        m = self.grammar.mask(state)
+        if len(m) == V:
+            return m
+        out = np.zeros(V, bool)
+        out[:min(len(m), V)] = m[:V]
+        return out
+
     def add_request(self, prompt: List[int],
                     sampling: Optional[SamplingParams] = None) -> int:
         sampling = sampling or SamplingParams()
         self._check_prompt(prompt)
+        self._grammar_check(sampling)
         if len(prompt) + sampling.max_new_tokens > self.cfg.max_seq_len:
             raise ValueError(
                 f"prompt+max_new_tokens {len(prompt)}+{sampling.max_new_tokens} "
                 f"exceeds max_seq_len {self.cfg.max_seq_len}")
         req = Request(prompt, sampling)
+        if sampling.json_mode:
+            req.gstate = self.grammar.initial()
         self.requests[req.id] = req
         self.waiting.append(req)
         return req.id
@@ -178,6 +213,7 @@ class Engine:
         a cold prefill through the normal admission queue)."""
         sampling = sampling or SamplingParams()
         self._check_prompt(prompt)
+        self._grammar_check(sampling)
         ps = self.cfg.page_size
         if prefix_len % ps or not 0 < prefix_len < len(prompt):
             raise ValueError(f"prefix_len {prefix_len} must be page-aligned "
@@ -205,6 +241,8 @@ class Engine:
             self.allocator.release(pages)
             raise ValueError(f"prefix KV rejected: {e}") from e
         req = Request(prompt, sampling)
+        if sampling.json_mode:
+            req.gstate = self.grammar.initial()
         req.pages = pages
         req.prefill_pos = prefix_len
         req.seq_len = prefix_len
@@ -326,6 +364,14 @@ class Engine:
             poss[n] = req.seq_len  # position of the token being sampled
         keys = step_keys(row_keys(seeds, self._sample_base, rids),
                          jnp.asarray(poss))
+        gr = any(r.gstate is not None for r in reqs)
+        if gr:
+            # First output token must already obey the grammar.
+            gm = np.ones((Bs, self.mcfg.vocab_size), bool)
+            for n, req in enumerate(reqs):
+                if req.gstate is not None:
+                    gm[n] = self._gmask(req.gstate)
+            sel = jnp.where(jnp.asarray(gm), sel, NEG_INF)
         args = [sel, keys, jnp.asarray(temps), jnp.asarray(ks),
                 jnp.asarray(tps), jnp.asarray(mps)]
         if pen:
@@ -432,6 +478,8 @@ class Engine:
         for r in self.running:
             if r.state != "running":
                 continue
+            if r.sampling.json_mode and self.cfg.speculative != "ngram":
+                continue    # grammar rows decode via the host-synced step
             if len(r.output) + pend.get(id(r), 0) >= r.sampling.max_new_tokens:
                 continue
             out.append(r)
@@ -572,13 +620,22 @@ class Engine:
         return st
 
     def _decode_step(self) -> List[StepEvent]:
-        if self.cfg.speculative == "ngram" and not any(
-                r.sampling.needs_penalties() for r in self.running):
-            # Penalized rows need sequential count updates the parallel
-            # verify can't honor — any such row flips the whole step back
-            # to the fused path (drain first: no stale pending survives).
+        if self.cfg.speculative == "ngram":
+            # Speculative mode: the host-synced verify step owns the whole
+            # batch (drafts, grammar masks, and penalties together —
+            # penalized/grammar rows simply never draft).
             events = self._drain_decode()
             return events + self._spec_decode_step()
+        if any(r.sampling.json_mode for r in self.running
+               if r.state == "running"):
+            # Mixed traffic: ONLY grammar rows pay the per-token
+            # host-synced step; everyone else keeps the fused multi-step
+            # path (its _decode_batch excludes grammar rows below).
+            events = self._spec_decode_step(grammar_only=True)
+            return events + self._fused_decode_step()
+        return self._fused_decode_step()
+
+    def _fused_decode_step(self) -> List[StepEvent]:
         events: List[StepEvent] = []
         batch = self._decode_batch()
         st = self._dec
@@ -693,12 +750,18 @@ class Engine:
             seq = req.prompt + req.output
             idx.extend(seq[have:total])
 
-    def _get_spec_fn(self, B: int, lp: bool, tpmp: bool = True):
-        """One jitted verify program per (bucket, logprobs): a (B, K+1)
-        paged forward + per-position sampling, keys fold_in(row, pos+1) —
-        the same keys the sequential path would use, so accepted tokens
-        are exactly what non-speculative decoding would have produced."""
-        fn = self._spec_fn_cache.get((B, lp, tpmp))
+    def _get_spec_fn(self, B: int, lp: bool, tpmp: bool = True,
+                     pen: bool = False, gr: bool = False):
+        """One jitted verify program per (bucket, logprobs, top-p, pen,
+        grammar): a (B, K+1) paged forward + per-position sampling, keys
+        fold_in(row, pos+1) — the same keys the sequential path would use,
+        so accepted tokens are exactly what non-speculative decoding would
+        have produced. Penalized rows use host-built counts (constant
+        across the window — those rows never draft, so only their slot-0
+        sample is consumed). Grammar rows get per-slot allowed-token masks
+        computed host-side along the draft path."""
+        key = (B, lp, tpmp, pen, gr)
+        fn = self._spec_fn_cache.get(key)
         if fn is not None:
             return fn
         import functools
@@ -706,43 +769,73 @@ class Engine:
                                  use_pallas=self.cfg.use_pallas)
 
         def specfn(params, tok, pos, mask, kvl, table, k_pages, v_pages,
-                   k_scales, v_scales, keys, temps, ks, tps, mps):
+                   k_scales, v_scales, keys, temps, ks, tps, mps,
+                   pmask=None, ocounts=None, rep=None, pres=None, freq=None,
+                   gmasks=None):
             logits, kp, vp, ksc, vsc = base(
                 params, tokens=tok, positions=pos, token_mask=mask,
                 kv_lens=kvl, page_table=table, k_pages=k_pages,
                 v_pages=v_pages, k_scales=k_scales, v_scales=v_scales)
+            pkw = (dict(prompt_mask=pmask, out_counts=ocounts, rep=rep,
+                        pres=pres, freq=freq) if pen else {})
 
-            def samp(lg_t, pos_t):          # [B, V], [B] — one position
+            def samp(lg_t, pos_t, gm_t):    # [B, V], [B], [B, V]
+                if gr:
+                    lg_t = jnp.where(gm_t, lg_t, NEG_INF)
                 return sample(lg_t, step_keys(keys, pos_t + 1),
                               temps, ks, tps, mps, want_logprobs=lp,
-                              use_top_p_min_p=tpmp)
+                              use_top_p_min_p=tpmp, **pkw)
 
-            toks, lps = jax.vmap(samp, in_axes=(1, 1))(logits, pos)
+            gm = gmasks if gr else jnp.zeros(
+                (logits.shape[0], logits.shape[1], 1), bool)
+            toks, lps = jax.vmap(samp, in_axes=(1, 1, 1))(logits, pos, gm)
             return toks, lps, kp, vp, ksc, vsc  # toks/lps: [T, B]
 
         donate = (6, 7, 8, 9) if self.cache.quantized else (6, 7)
         fn = jax.jit(specfn, donate_argnums=donate)
-        self._spec_fn_cache[(B, lp, tpmp)] = fn
+        self._spec_fn_cache[key] = fn
         return fn
 
-    def _spec_decode_step(self) -> List[StepEvent]:
+    def _spec_decode_step(self, grammar_only: bool = False) -> List[StepEvent]:
         events: List[StepEvent] = []
         batch = [r for r in self.running if r.state == "running"
+                 and (not grammar_only or r.sampling.json_mode)
                  and len(r.output) < r.sampling.max_new_tokens]
         if not batch:
             return events
-        K = self.cfg.spec_k
+        K = self.cfg.spec_k if self.cfg.speculative == "ngram" else 0
         ps = self.cfg.page_size
         drafts: Dict[int, List[int]] = {}
+        gmask_rows: Dict[int, list] = {}
         # Draft + grow pages, oldest-first (preempt youngest on exhaustion;
         # a row sheds its drafts before anyone gets preempted for them).
+        # Penalized rows never draft (their counts are sequential); grammar
+        # rows draft along the automaton — masks are computed assuming the
+        # draft prefix is accepted, which holds for every accepted prefix.
         for req in sorted(batch, key=lambda r: r.t_submit):
             if req.state != "running":
                 continue
-            self._ensure_ngram(req)
             cap = min(K, req.sampling.max_new_tokens - len(req.output) - 1,
                       self.cfg.max_seq_len - req.seq_len - 1)
-            d = req.ngram.draft(cap) if cap > 0 else []
+            if cap > 0 and not req.sampling.needs_penalties():
+                self._ensure_ngram(req)
+                d = req.ngram.draft(cap)
+            else:
+                d = []
+            if req.gstate is not None:
+                g = self.grammar
+                s = req.gstate
+                masks = [self._gmask(s)]
+                kept = []
+                for dt in d:
+                    ns = g.advance_token(s, dt)
+                    if ns is None:
+                        break           # draft leaves the grammar — cut here
+                    kept.append(dt)
+                    masks.append(self._gmask(ns))
+                    s = ns
+                d = kept
+                gmask_rows[id(req)] = masks
             while True:
                 need = (pages_for_tokens(req.seq_len + 1 + len(d), ps)
                         - len(req.pages))
@@ -772,8 +865,11 @@ class Engine:
         mask = np.zeros((B, T), bool)
         kvl = np.zeros(B, np.int32)
         table = np.zeros((B, P), np.int32)
-        temps, ks, tps, mps, seeds, rids, _pen, lp, tpmp = \
+        temps, ks, tps, mps, seeds, rids, pen, lp, tpmp = \
             self._sampling_rows(batch, B)
+        gr = any(r.gstate is not None for r in batch)
+        gmasks = (np.ones((B, T, self.mcfg.vocab_size), bool)
+                  if gr else None)
         for i, r in enumerate(batch):
             d = drafts[id(r)]
             tok[i, 0] = r.last_token
@@ -782,7 +878,20 @@ class Engine:
             mask[i, :1 + len(d)] = True
             kvl[i] = r.seq_len + 1 + len(d)
             table[i, :len(r.pages)] = r.pages
-        fn = self._get_spec_fn(B, lp, tpmp)
+            if gr and id(r) in gmask_rows:
+                for t, m in enumerate(gmask_rows[id(r)]):
+                    gmasks[i, t] = m
+        extra = []
+        if pen:
+            pmask, oc, rep, pres, freq = self._penalty_rows(batch, B)
+            for i, r in enumerate(batch):
+                np.add.at(oc[i], np.asarray(r.output, np.int64), 1)
+            extra += [pmask, jnp.asarray(oc), rep, pres, freq]
+        elif gr:
+            extra += [None, None, None, None, None]
+        if gr:
+            extra.append(jnp.asarray(gmasks))
+        fn = self._get_spec_fn(B, lp, tpmp, pen, gr)
         toks_out, lps_out, kp, vp, ksc, vsc = fn(
             self.params, jnp.asarray(tok), jnp.asarray(pos),
             jnp.asarray(mask), jnp.asarray(kvl), jnp.asarray(table),
@@ -790,7 +899,7 @@ class Engine:
             self.cache.k_scales, self.cache.v_scales,
             row_keys(seeds, self._sample_base, rids),
             jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(tps),
-            jnp.asarray(mps))
+            jnp.asarray(mps), *extra)
         self.cache = PagedKVCache(k_pages=kp, v_pages=vp,
                                   k_scales=ksc, v_scales=vsc)
         vals = np.asarray(toks_out)                       # [T, B]
@@ -821,6 +930,10 @@ class Engine:
         req.output.append(tok)
         if req.ngram is not None:
             req.ngram.append(tok)
+        if req.gstate is not None and self.grammar is not None:
+            nxt = self.grammar.advance_token(req.gstate, tok)
+            if nxt is not None:     # defensively keep old state on EOS etc.
+                req.gstate = nxt
         req.last_token = tok
         finished = (
             len(req.output) >= req.sampling.max_new_tokens

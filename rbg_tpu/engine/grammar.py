@@ -1,0 +1,306 @@
+"""Grammar-constrained decoding: JSON mode (structured output).
+
+Reference context: structured output is the signature feature of the
+reference's flagship engine (SGLang — the "structured generation
+language"); vLLM ships it as guided/JSON mode. Here it is a byte-level
+JSON pushdown automaton lifted to token masks:
+
+* ``JsonGrammar`` — immutable-state automaton over BYTES. ``advance``
+  returns the next state or None (byte illegal); ``is_complete`` says a
+  full JSON value has been consumed (EOS becomes legal).
+* ``TokenGrammar`` — lifts a byte grammar over a token→bytes table:
+  ``mask(state)`` marks every token whose full byte sequence is legal
+  from ``state`` (plus EOS iff complete); ``advance_token`` folds a
+  token's bytes into the state.
+
+Engine integration (engine.py): constrained rows decode through the
+spec-style host-synced step. Masks for drafted positions are computed
+host-side ALONG THE DRAFT PATH — the mask at position i+1 assumes drafts
+0..i were accepted, which holds exactly for every accepted prefix, so
+grammar constraints and speculative decoding compose without
+approximation (a draft token the grammar forbids truncates the draft).
+
+Complexity note: ``mask`` probes every vocab token's bytes per step —
+exact and cheap for the byte tokenizer (V=256); for 100k-token HF vocabs
+a production deployment wants a precompiled token trie (xgrammar-style).
+The seam is ``TokenGrammar``: swap the probe loop for a compiled table
+without touching the engine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+# ---- JSON byte automaton ----
+#
+# State = (mode, stack, aux) — plain tuples, hashable, never mutated.
+#   mode: one of the _M_* constants below
+#   stack: tuple of b'{' / b'[' container markers
+#   aux: mode-specific scalar (literal progress, number sub-state, …)
+
+_WS = frozenset(b" \t\n\r")
+_DIGITS = frozenset(b"0123456789")
+_HEX = frozenset(b"0123456789abcdefABCDEF")
+
+# modes
+_VALUE = 0          # expecting a value
+_STRING = 1         # inside a string (aux: 0 normal, 1 after backslash,
+                    #                  2-5 unicode escape digits remaining)
+_KEYSTR = 2         # inside an object key string (same aux)
+_AFTER = 3          # after a complete value (expect , } ] or EOS at top)
+_OBJ_KEY = 4        # inside {, expecting key string or }
+_OBJ_COLON = 5      # after key, expecting :
+_OBJ_NEXTKEY = 6    # after comma in object, expecting key string
+_NUM = 7            # inside a number (aux: sub-state)
+_LIT = 8            # inside true/false/null (aux: (literal, idx))
+
+# number sub-states (aux for _NUM)
+_N_MINUS = 0        # consumed '-', need first digit
+_N_ZERO = 1         # consumed leading 0 (no more int digits)
+_N_INT = 2          # in integer digits
+_N_DOT = 3          # consumed '.', need fraction digit
+_N_FRAC = 4         # in fraction digits
+_N_E = 5            # consumed e/E, need sign or digit
+_N_ESIGN = 6        # consumed exponent sign, need digit
+_N_EXP = 7          # in exponent digits
+
+_NUM_COMPLETE = {_N_ZERO, _N_INT, _N_FRAC, _N_EXP}
+
+State = Tuple[int, tuple, object]
+
+
+class JsonGrammar:
+    def initial(self) -> State:
+        return (_VALUE, (), None)
+
+    # -- helpers --
+
+    @staticmethod
+    def _close(stack: tuple) -> State:
+        """A value just completed; what comes next."""
+        return (_AFTER, stack, None)
+
+    def _open_value(self, b: int, stack: tuple,
+                    aux: object) -> Optional[State]:
+        # aux == "af" marks "first slot of an array" — the only VALUE
+        # position where a closing ] is legal ([] yes, [1,] no).
+        if b in _WS:
+            return (_VALUE, stack, aux)
+        if b == 0x7B:                                   # {
+            return (_OBJ_KEY, stack + (b"{",), None)
+        if b == 0x5B:                                   # [
+            return (_VALUE, stack + (b"[",), "af")
+        if b == 0x22:                                   # "
+            return (_STRING, stack, 0)
+        if b == 0x2D:                                   # -
+            return (_NUM, stack, _N_MINUS)
+        if b == 0x30:                                   # 0
+            return (_NUM, stack, _N_ZERO)
+        if b in _DIGITS:
+            return (_NUM, stack, _N_INT)
+        for lit in (b"true", b"false", b"null"):
+            if b == lit[0]:
+                return (_LIT, stack, (lit, 1))
+        if (b == 0x5D and aux == "af"
+                and stack and stack[-1] == b"["):       # ] — empty array
+            return self._close(stack[:-1])
+        return None
+
+    def _string_step(self, mode: int, b: int, stack: tuple,
+                     aux: int) -> Optional[State]:
+        if aux == 1:                                     # after backslash
+            if b in b'"\\/bfnrt':
+                return (mode, stack, 0)
+            if b == 0x75:                                # u
+                return (mode, stack, 2)
+            return None
+        if aux >= 2:                                     # unicode digits
+            if b in _HEX:
+                return (mode, stack, 0 if aux == 5 else aux + 1)
+            return None
+        if b == 0x22:                                    # closing quote
+            if mode == _KEYSTR:
+                return (_OBJ_COLON, stack, None)
+            return self._close(stack)
+        if b == 0x5C:                                    # backslash
+            return (mode, stack, 1)
+        if b < 0x20:                                     # raw control char
+            return None
+        return (mode, stack, 0)                          # any other byte
+
+    def _num_step(self, b: int, stack: tuple, aux: int) -> Optional[State]:
+        if aux == _N_MINUS:
+            if b == 0x30:
+                return (_NUM, stack, _N_ZERO)
+            if b in _DIGITS:
+                return (_NUM, stack, _N_INT)
+            return None
+        if aux in (_N_ZERO, _N_INT):
+            if aux == _N_INT and b in _DIGITS:
+                return (_NUM, stack, _N_INT)
+            if b == 0x2E:                                # .
+                return (_NUM, stack, _N_DOT)
+            if b in (0x65, 0x45):                        # e E
+                return (_NUM, stack, _N_E)
+            return self._after_number(b, stack)
+        if aux == _N_DOT:
+            return (_NUM, stack, _N_FRAC) if b in _DIGITS else None
+        if aux == _N_FRAC:
+            if b in _DIGITS:
+                return (_NUM, stack, _N_FRAC)
+            if b in (0x65, 0x45):
+                return (_NUM, stack, _N_E)
+            return self._after_number(b, stack)
+        if aux == _N_E:
+            if b in (0x2B, 0x2D):                        # + -
+                return (_NUM, stack, _N_ESIGN)
+            return (_NUM, stack, _N_EXP) if b in _DIGITS else None
+        if aux == _N_ESIGN:
+            return (_NUM, stack, _N_EXP) if b in _DIGITS else None
+        if aux == _N_EXP:
+            if b in _DIGITS:
+                return (_NUM, stack, _N_EXP)
+            return self._after_number(b, stack)
+        return None
+
+    def _after_number(self, b: int, stack: tuple) -> Optional[State]:
+        """A number ended implicitly — re-dispatch the byte in AFTER."""
+        return self.advance(self._close(stack), b)
+
+    # -- public --
+
+    def advance(self, state: State, b: int) -> Optional[State]:
+        mode, stack, aux = state
+        if mode == _VALUE:
+            return self._open_value(b, stack, aux)
+        if mode in (_STRING, _KEYSTR):
+            return self._string_step(mode, b, stack, aux)
+        if mode == _NUM:
+            return self._num_step(b, stack, aux)
+        if mode == _LIT:
+            lit, i = aux
+            if b == lit[i]:
+                if i + 1 == len(lit):
+                    return self._close(stack)
+                return (_LIT, stack, (lit, i + 1))
+            return None
+        if mode == _AFTER:
+            if b in _WS:
+                return (_AFTER, stack, None)
+            if stack:
+                top = stack[-1]
+                if b == 0x2C:                            # ,
+                    if top == b"{":
+                        return (_OBJ_NEXTKEY, stack, None)
+                    return (_VALUE, stack, None)
+                if b == 0x7D and top == b"{":            # }
+                    return self._close(stack[:-1])
+                if b == 0x5D and top == b"[":            # ]
+                    return self._close(stack[:-1])
+            return None
+        if mode in (_OBJ_KEY, _OBJ_NEXTKEY):
+            if b in _WS:
+                return (mode, stack, None)
+            if b == 0x22:
+                return (_KEYSTR, stack, 0)
+            if b == 0x7D and mode == _OBJ_KEY:           # } — empty object
+                return self._close(stack[:-1])
+            return None
+        if mode == _OBJ_COLON:
+            if b in _WS:
+                return (mode, stack, None)
+            if b == 0x3A:                                # :
+                return (_VALUE, stack, None)
+            return None
+        return None
+
+    def is_complete(self, state: State) -> bool:
+        mode, stack, aux = state
+        if stack:
+            return False
+        if mode == _AFTER:
+            return True
+        if mode == _NUM:
+            return aux in _NUM_COMPLETE
+        return False
+
+
+class TokenGrammar:
+    """Lift a byte grammar over a token→bytes table.
+
+    ``token_bytes[i]`` is the byte string token i appends, or None for
+    tokens that must never appear inside constrained output (specials).
+    ``eos_id`` is allowed exactly when the value is complete."""
+
+    def __init__(self, grammar: JsonGrammar, token_bytes: List[Optional[bytes]],
+                 eos_id: Optional[int]):
+        self.grammar = grammar
+        self.token_bytes = token_bytes
+        self.eos_id = eos_id
+        self.V = len(token_bytes)
+
+    def initial(self) -> State:
+        return self.grammar.initial()
+
+    def advance_token(self, state: State, tok: int) -> Optional[State]:
+        if tok == self.eos_id:
+            return state if self.grammar.is_complete(state) else None
+        bs = self.token_bytes[tok] if 0 <= tok < self.V else None
+        if bs is None:
+            return None
+        for b in bs:
+            state = self.grammar.advance(state, b)
+            if state is None:
+                return None
+        return state
+
+    def mask(self, state: State) -> np.ndarray:
+        """[V] bool — tokens legal from ``state`` (EOS iff complete)."""
+        out = np.zeros(self.V, bool)
+        adv = self.grammar.advance
+        for i, bs in enumerate(self.token_bytes):
+            if not bs:
+                continue
+            s = state
+            ok = True
+            for b in bs:
+                s = adv(s, b)
+                if s is None:
+                    ok = False
+                    break
+            out[i] = ok
+        if self.eos_id is not None and self.eos_id < self.V:
+            out[self.eos_id] = self.grammar.is_complete(state)
+        return out
+
+
+def token_bytes_for(tokenizer) -> List[Optional[bytes]]:
+    """Build the token→bytes table for a tokenizer. The byte tokenizer
+    maps id i (< 256) to byte i DIRECTLY — decode() would turn a lone
+    UTF-8 continuation byte into U+FFFD and corrupt the table. Other
+    tokenizers fall back to per-token decode (adequate for grammar
+    probing; specials map to None)."""
+    from rbg_tpu.engine.tokenizer import ByteTokenizer
+
+    vocab = tokenizer.vocab_size
+    specials = {getattr(tokenizer, a, None)
+                for a in ("bos_id", "eos_id", "pad_id")}
+    table: List[Optional[bytes]] = []
+    if isinstance(tokenizer, ByteTokenizer):
+        for i in range(vocab):
+            table.append(bytes([i]) if i < 256 and i not in specials
+                         else None)
+        return table
+    for i in range(vocab):
+        if i in specials:
+            table.append(None)
+            continue
+        try:
+            s = tokenizer.decode([i])
+        except Exception:   # noqa: BLE001 — unknown id quirks → unusable
+            table.append(None)
+            continue
+        table.append(s.encode("utf-8", errors="ignore") or None)
+    return table

@@ -162,6 +162,17 @@ class Handler(BaseHTTPRequestHandler):
             out["seed"] = int(body["seed"])
         if body.get("logprobs"):
             out["logprobs"] = True
+        rf = body.get("response_format")
+        if rf is not None:
+            rft = rf.get("type") if isinstance(rf, dict) else None
+            if rft == "json_object":
+                out["json_mode"] = True
+            elif rft != "text":
+                # Silently ignoring an unsupported constraint would return
+                # unconstrained output a client will feed to json.loads.
+                raise ValueError(
+                    f"unsupported response_format {rft!r} (supported: "
+                    "text, json_object)")
         return out
 
     @staticmethod

@@ -52,6 +52,19 @@ class PagedKVCache:
     @staticmethod
     def create(cfg: ModelConfig, num_pages: int, page_size: int = 16,
                dtype=None, quantize: bool = False) -> "PagedKVCache":
+        if cfg.mla:
+            # MLA latent pool: k holds the compressed latent, v the shared
+            # RoPE key — ~an order of magnitude less HBM than per-head KV.
+            if quantize:
+                raise ValueError("int8 KV quantization not supported for "
+                                 "MLA latent pools yet")
+            dtype = dtype or cfg.jax_dtype
+            return PagedKVCache(
+                k_pages=jnp.zeros((cfg.num_layers, num_pages, page_size, 1,
+                                   cfg.kv_lora_rank), dtype),
+                v_pages=jnp.zeros((cfg.num_layers, num_pages, page_size, 1,
+                                   cfg.qk_rope_head_dim), dtype),
+            )
         shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim_)
         if quantize:
             sshape = shape[:-1] + (1,)
@@ -68,6 +81,10 @@ class PagedKVCache:
     @staticmethod
     def hbm_bytes(cfg: ModelConfig, num_pages: int, page_size: int = 16,
                   dtype_bytes: int = 2) -> int:
+        if cfg.mla:
+            per_tok = cfg.kv_lora_rank + cfg.qk_rope_head_dim
+            return (cfg.num_layers * num_pages * page_size * per_tok
+                    * dtype_bytes)
         return (2 * cfg.num_layers * num_pages * page_size
                 * cfg.num_kv_heads * cfg.head_dim_ * dtype_bytes)
 

@@ -136,6 +136,7 @@ class DecodeWorker:
         eng = self.engine
         prompt = bundle.prompt
         eng._check_prompt(prompt)
+        eng._grammar_check(sampling)   # before alloc — a raise must not leak pages
         n_pages = bundle.k_data.shape[1]
         need = pages_for_tokens(len(prompt) + 1, eng.cfg.page_size)
         pages = eng._alloc(need)
@@ -150,6 +151,20 @@ class DecodeWorker:
                 jnp.asarray(bundle.v_data, eng.cache.v_pages.dtype)),
         )
         req = Request(prompt, sampling)
+        if sampling.json_mode:
+            st = eng.grammar.initial()
+            # The first token was sampled prefill-side under the grammar
+            # mask — fold it in so decode continues from the right state.
+            nxt = eng.grammar.advance_token(st, bundle.first_token)
+            if nxt is None:
+                # A grammar-wired prefill can't produce this; it means the
+                # prefill peer ignored json_mode (mixed-version deploy).
+                # Reject rather than emit corrupt "constrained" output.
+                eng.allocator.release(pages)
+                raise ValueError(
+                    f"first token {bundle.first_token} violates the JSON "
+                    "grammar — prefill peer ignored json_mode?")
+            req.gstate = nxt
         req.state = "running"
         req.pages = pages
         req.seq_len = len(prompt)

@@ -128,7 +128,8 @@ class Handler(socketserver.BaseRequestHandler):
             pf_req = {"op": "prefill", "prompt": obj["prompt"]}
             for key in ("temperature", "top_k", "top_p", "min_p",
                         "repetition_penalty", "presence_penalty",
-                        "frequency_penalty", "seed", "stop_token"):
+                        "frequency_penalty", "seed", "json_mode",
+                        "stop_token"):
                 if key in obj:
                     pf_req[key] = obj[key]
             hdr, kb, vb = request_once(state.pick("prefill"), pf_req)
@@ -139,7 +140,7 @@ class Handler(socketserver.BaseRequestHandler):
             fwd["op"] = "decode_bundle"
             for key in ("max_new_tokens", "temperature", "top_k", "top_p",
                         "min_p", "repetition_penalty", "presence_penalty",
-                        "frequency_penalty", "seed", "logprobs",
+                        "frequency_penalty", "seed", "logprobs", "json_mode",
                         "stop_token", "stream"):
                 if key in obj:
                     fwd[key] = obj[key]

@@ -35,6 +35,7 @@ def build_config(args) -> EngineConfig:
         checkpoint_path=args.checkpoint_path,
         kv_dtype=args.kv_dtype,
         multi_step=args.multi_step,
+        vocab_size=args.vocab_size,
         speculative=args.speculative,
         spec_k=args.spec_k,
         spec_ngram=args.spec_ngram,
@@ -270,12 +271,15 @@ def serve(args) -> None:
                     from rbg_tpu.engine.kvpool import KVPoolClient
                     pool = KVPoolClient(pool_addr)
                 server.prefill = PrefillWorker(cfg, pool=pool)
+                server.prefill.engine.enable_json_grammar(server.tokenizer)
             elif cfg.mode == "decode":
                 from rbg_tpu.engine.service import DecodeService
                 server.decode = DecodeService(cfg)
+                server.decode.engine.enable_json_grammar(server.tokenizer)
             else:
                 from rbg_tpu.engine.service import EngineService
                 server.service = EngineService(cfg)
+                server.service.engine.enable_json_grammar(server.tokenizer)
         except Exception:
             # A pod that cannot build its engine must CRASH (so the restart
             # policy sees it), not linger as a never-ready zombie listener.
@@ -317,6 +321,9 @@ def main(argv=None) -> int:
     ap.add_argument("--multi-step", type=int, default=1,
                     help="decode steps fused per device dispatch (lax.scan "
                          "window; higher = throughput, burstier streaming)")
+    ap.add_argument("--vocab-size", type=int, default=0,
+                    help="override the preset's vocab size (0 = keep; lets "
+                         "demo models cover the byte tokenizer's 259 ids)")
     ap.add_argument("--speculative", choices=("off", "ngram"), default="off",
                     help="prompt-lookup speculative decoding (bit-identical "
                          "output; wins on repetitive/structured text)")

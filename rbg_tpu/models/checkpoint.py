@@ -84,6 +84,10 @@ def load_hf_llama(path: str, cfg: ModelConfig) -> dict:
             "HF import currently covers dense llama-family layouts only; "
             "MoE checkpoints (Mixtral block_sparse_moe / DeepSeek experts) "
             "need a dedicated mapping — load via orbax instead.")
+    if cfg.mla:
+        raise NotImplementedError(
+            "HF import does not map MLA layouts yet (kv_a/kv_b projections "
+            "→ w_dkv/w_uk/w_uv) — load via orbax instead.")
     sd = _hf_state_dict(path)
     dt = cfg.jax_dtype
     L = cfg.num_layers

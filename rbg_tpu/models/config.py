@@ -36,6 +36,17 @@ class ModelConfig:
     experts_per_token: int = 2
     moe_intermediate_size: int = 0      # 0 → intermediate_size
     moe_shared_expert: bool = False
+    moe_shared_expert_size: int = 0     # 0 → intermediate_size
+    # Multi-head latent attention (DeepSeek-V2/V3): the cache stores ONE
+    # compressed latent (kv_lora_rank) + one shared RoPE key
+    # (qk_rope_head_dim) per token instead of per-head K/V — an order of
+    # magnitude less KV HBM, which is what makes long-context PD
+    # disaggregation cheap to ship around. num_kv_heads is ignored.
+    mla: bool = False
+    kv_lora_rank: int = 512
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
 
     @property
     def head_dim_(self) -> int:
@@ -50,15 +61,29 @@ class ModelConfig:
         return self.moe_intermediate_size or self.intermediate_size
 
     @property
+    def moe_shared_f(self) -> int:
+        return self.moe_shared_expert_size or self.intermediate_size
+
+    @property
     def num_params(self) -> int:
         """Approximate parameter count (embeddings + blocks + head)."""
         d, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
         hd = self.head_dim_
-        attn = d * self.num_heads * hd + 2 * d * self.num_kv_heads * hd + self.num_heads * hd * d
+        if self.mla:
+            h, dc = self.num_heads, self.kv_lora_rank
+            dn, dr, dv = (self.qk_nope_head_dim, self.qk_rope_head_dim,
+                          self.v_head_dim)
+            attn = (d * h * (dn + dr)        # wq
+                    + d * (dc + dr) + dc     # w_dkv + kv_norm
+                    + dc * h * dn            # w_uk
+                    + dc * h * dv            # w_uv
+                    + h * dv * d)            # wo
+        else:
+            attn = d * self.num_heads * hd + 2 * d * self.num_kv_heads * hd + self.num_heads * hd * d
         if self.num_experts:
             mlp = self.num_experts * 3 * d * self.moe_f + d * self.num_experts
             if self.moe_shared_expert:
-                mlp += 3 * d * f
+                mlp += 3 * d * self.moe_shared_f
         else:
             mlp = 3 * d * f
         per_layer = attn + mlp + 2 * d
@@ -115,7 +140,26 @@ _PRESETS = {
         intermediate_size=10944, num_layers=27, num_heads=16, num_kv_heads=16,
         max_seq_len=163840, rope_theta=10000.0,
         num_experts=64, experts_per_token=6, moe_intermediate_size=1408,
-        moe_shared_expert=True,
+        moe_shared_expert=True, moe_shared_expert_size=2816,
+        mla=True, kv_lora_rank=512, qk_nope_head_dim=128,
+        qk_rope_head_dim=64, v_head_dim=128,
+    ),
+    "deepseek-v3": ModelConfig(
+        name="deepseek-v3", vocab_size=129280, hidden_size=7168,
+        intermediate_size=18432, num_layers=61, num_heads=128,
+        num_kv_heads=128, max_seq_len=163840, rope_theta=10000.0,
+        num_experts=256, experts_per_token=8, moe_intermediate_size=2048,
+        moe_shared_expert=True, moe_shared_expert_size=2048,
+        mla=True, kv_lora_rank=512, qk_nope_head_dim=128,
+        qk_rope_head_dim=64, v_head_dim=128,
+    ),
+    # Tiny MLA config for tests — compiles in seconds on CPU.
+    "tiny-mla": ModelConfig(
+        name="tiny-mla", vocab_size=256, hidden_size=128,
+        intermediate_size=384, num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_len=256, rope_theta=10000.0, dtype="float32",
+        mla=True, kv_lora_rank=64, qk_nope_head_dim=32, qk_rope_head_dim=16,
+        v_head_dim=32,
     ),
 }
 

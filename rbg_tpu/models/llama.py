@@ -45,6 +45,16 @@ class KVCache:
     @staticmethod
     def create(cfg: ModelConfig, batch: int, max_len: int, dtype=None) -> "KVCache":
         dtype = dtype or cfg.jax_dtype
+        if cfg.mla:
+            # MLA: k holds the compressed latent (kv_lora_rank), v the
+            # shared RoPE key (qk_rope_head_dim) — one "head" each.
+            return KVCache(
+                k=jnp.zeros((cfg.num_layers, batch, max_len, 1,
+                             cfg.kv_lora_rank), dtype),
+                v=jnp.zeros((cfg.num_layers, batch, max_len, 1,
+                             cfg.qk_rope_head_dim), dtype),
+                length=jnp.zeros((batch,), jnp.int32),
+            )
         shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim_)
         return KVCache(
             k=jnp.zeros(shape, dtype),
@@ -68,17 +78,34 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
 
     blocks = {
         "attn_norm": jnp.ones((L, d), dt),
-        "wq": nrm(ks[1], (L, d, h * hd), s_in),
-        "wk": nrm(ks[2], (L, d, kv * hd), s_in),
-        "wv": nrm(ks[3], (L, d, kv * hd), s_in),
-        "wo": nrm(ks[4], (L, h * hd, d), s_out),
         "mlp_norm": jnp.ones((L, d), dt),
     }
+    if cfg.mla:
+        dc, dn = cfg.kv_lora_rank, cfg.qk_nope_head_dim
+        dr, dv = cfg.qk_rope_head_dim, cfg.v_head_dim
+        blocks.update({
+            "wq": nrm(ks[1], (L, d, h * (dn + dr)), s_in),
+            "w_dkv": nrm(ks[2], (L, d, dc + dr), s_in),
+            "kv_norm": jnp.ones((L, dc), dt),
+            "w_uk": nrm(ks[3], (L, dc, h * dn), s_in),
+            "w_uv": nrm(jax.random.fold_in(ks[3], 1), (L, dc, h * dv), s_in),
+            "wo": nrm(ks[4], (L, h * dv, d), s_out),
+        })
+    else:
+        blocks.update({
+            "wq": nrm(ks[1], (L, d, h * hd), s_in),
+            "wk": nrm(ks[2], (L, d, kv * hd), s_in),
+            "wv": nrm(ks[3], (L, d, kv * hd), s_in),
+            "wo": nrm(ks[4], (L, h * hd, d), s_out),
+        })
     dense_mlp = cfg.num_experts == 0 or cfg.moe_shared_expert
     if dense_mlp:
-        blocks["w_gate"] = nrm(ks[5], (L, d, f), s_in)
-        blocks["w_up"] = nrm(ks[6], (L, d, f), s_in)
-        blocks["w_down"] = nrm(ks[7], (L, f, d), s_out)
+        # The shared expert (DeepSeek-style) can be narrower than the
+        # dense FFN (moe_shared_expert_size); plain dense models use f.
+        fs = cfg.moe_shared_f if cfg.num_experts else f
+        blocks["w_gate"] = nrm(ks[5], (L, d, fs), s_in)
+        blocks["w_up"] = nrm(ks[6], (L, d, fs), s_in)
+        blocks["w_down"] = nrm(ks[7], (L, fs, d), s_out)
     if cfg.num_experts:
         E, mf = cfg.num_experts, cfg.moe_f
         ke = jax.random.split(jax.random.fold_in(key, 7), 4)
@@ -115,6 +142,39 @@ def _qkv(cfg: ModelConfig, blk, x, positions):
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     return q, k, vv
+
+
+def _mla_qkv(cfg: ModelConfig, blk, x, positions):
+    """MLA pre-attention math in the absorbed form: norm → q projection
+    (split nope/rope, absorb W_uk into q) → latent down-projection
+    (+kv-norm) and shared RoPE key. Returns (q_lat [B,T,h,dc],
+    q_pe [B,T,h,dr], c [B,T,dc], k_pe [B,T,dr])."""
+    B, T, _ = x.shape
+    h = cfg.num_heads
+    dc, dn, dr = cfg.kv_lora_rank, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    xa = rms_norm(x, blk["attn_norm"], cfg.rms_norm_eps)
+    q = (xa @ blk["wq"]).reshape(B, T, h, dn + dr)
+    q_nope, q_pe = q[..., :dn], q[..., dn:]
+    q_pe = apply_rope(q_pe, positions, cfg.rope_theta)
+    # Absorb: q_lat·c == q_nope·(c @ W_uk) — per-head K never materializes.
+    w_uk = blk["w_uk"].reshape(dc, h, dn)
+    q_lat = jnp.einsum("bthn,chn->bthc", q_nope, w_uk)
+    kv = xa @ blk["w_dkv"]                                   # [B, T, dc+dr]
+    c = rms_norm(kv[..., :dc], blk["kv_norm"], cfg.rms_norm_eps)
+    k_pe = apply_rope(kv[..., None, dc:], positions, cfg.rope_theta)[:, :, 0]
+    return q_lat, q_pe, c, k_pe
+
+
+def _mla_out(cfg: ModelConfig, blk, attn_lat):
+    """Latent attention output [B,T,h,dc] → per-head values [B,T,h,dv]
+    via W_uv (the value-side absorption)."""
+    dc, h, dv = cfg.kv_lora_rank, cfg.num_heads, cfg.v_head_dim
+    w_uv = blk["w_uv"].reshape(dc, h, dv)
+    return jnp.einsum("bthc,chv->bthv", attn_lat, w_uv)
+
+
+def _mla_scale(cfg: ModelConfig) -> float:
+    return (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
 
 
 def _post_attention(cfg: ModelConfig, blk, x, attn):
@@ -179,10 +239,29 @@ def _block(cfg: ModelConfig, x, blk, k_cache, v_cache, positions, kv_valid):
     (training path — no scatter, grads flow through plain matmuls).
     """
     B = x.shape[0]
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]      # [B, 1]
+    if cfg.mla:
+        from rbg_tpu.ops.mla_attention import mla_attention
+        q_lat, q_pe, c, k_pe = _mla_qkv(cfg, blk, x, positions)
+        if k_cache is not None:
+            # k_cache holds the latent, v_cache the shared RoPE key.
+            k_cache = k_cache.at[b_idx, positions].set(
+                c[:, :, None, :].astype(k_cache.dtype), mode="drop")
+            v_cache = v_cache.at[b_idx, positions].set(
+                k_pe[:, :, None, :].astype(v_cache.dtype), mode="drop")
+            attn_lat = mla_attention(q_lat, q_pe, k_cache[:, :, 0],
+                                     v_cache[:, :, 0], positions, kv_valid,
+                                     _mla_scale(cfg))
+        else:
+            T = x.shape[1]
+            valid = kv_valid[:, :T] if kv_valid.shape[1] >= T else kv_valid
+            attn_lat = mla_attention(q_lat, q_pe, c, k_pe, positions, valid,
+                                     _mla_scale(cfg))
+        attn = _mla_out(cfg, blk, attn_lat)
+        return _post_attention(cfg, blk, x, attn), k_cache, v_cache
     q, k, vv = _qkv(cfg, blk, x, positions)
     if k_cache is not None:
         # Write new K/V at their absolute positions (scatter per batch row).
-        b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]  # [B, 1]
         k_cache = k_cache.at[b_idx, positions].set(k.astype(k_cache.dtype), mode="drop")
         v_cache = v_cache.at[b_idx, positions].set(vv.astype(v_cache.dtype), mode="drop")
         attn = gqa_attention(q, k_cache, v_cache, positions, kv_valid)
@@ -284,12 +363,24 @@ def forward_paged(
         hcur, kpf, vpf, ksf, vsf = carry
         blk, li = xs
         table = page_table + li * NP
-        q, k, vv = _qkv(cfg, blk, hcur, positions)
-        kpf, vpf, ksf, vsf = write_kv_pages(kpf, vpf, k, vv, table, positions,
-                                            token_mask, ksf, vsf)
-        attn = paged_attention(q, kpf, vpf, table, positions, kv_lens,
-                               use_pallas=use_pallas, k_scales=ksf,
-                               v_scales=vsf)
+        if cfg.mla:
+            from rbg_tpu.ops.mla_attention import paged_mla_attention
+            q_lat, q_pe, c, k_pe = _mla_qkv(cfg, blk, hcur, positions)
+            kpf, vpf, ksf, vsf = write_kv_pages(
+                kpf, vpf, c[:, :, None, :], k_pe[:, :, None, :], table,
+                positions, token_mask, ksf, vsf)
+            attn_lat = paged_mla_attention(q_lat, q_pe, kpf, vpf, table,
+                                           positions, kv_lens,
+                                           _mla_scale(cfg))
+            attn = _mla_out(cfg, blk, attn_lat)
+        else:
+            q, k, vv = _qkv(cfg, blk, hcur, positions)
+            kpf, vpf, ksf, vsf = write_kv_pages(kpf, vpf, k, vv, table,
+                                                positions, token_mask,
+                                                ksf, vsf)
+            attn = paged_attention(q, kpf, vpf, table, positions, kv_lens,
+                                   use_pallas=use_pallas, k_scales=ksf,
+                                   v_scales=vsf)
         out = _post_attention(cfg, blk, hcur, attn)
         return (out, kpf, vpf, ksf, vsf), None
 

@@ -1,0 +1,86 @@
+"""Multi-head latent attention (DeepSeek-V2/V3) in the ABSORBED inference
+form, over contiguous and paged latent caches.
+
+Reference context: the reference's flagship PD-disagg deployments serve
+DeepSeek models via SGLang (``examples/inference/ecosystem/mooncake/*``,
+BASELINE.md config 5 deploys DeepSeek-V3); MLA is what makes their KV
+transfer cheap — the cache stores one ``kv_lora_rank`` latent plus one
+shared ``qk_rope_head_dim`` RoPE key per token instead of per-head K/V.
+
+Absorbed form (the serving identity): with per-head up-projections
+``k_nope = c @ W_uk`` and ``v = c @ W_uv``,
+
+    score = q_nope·k_nope + q_pe·k_pe  =  (q_nope @ W_uk^T)·c + q_pe·k_pe
+
+so queries are absorbed into latent space once per step ([B,T,h,dc]) and
+attention runs DIRECTLY on the latent cache — no per-head K/V ever
+materializes. The value side likewise: ``attn @ v = (attn @ c) @ W_uv``.
+This module computes scores/weights/latent-output; the model applies the
+W_uk absorption before and the W_uv up-projection after.
+
+TPU notes: two einsums + fused mask/softmax — XLA tiles them onto the MXU;
+softmax in f32. The latent cache has no head axis, so it REPLICATES over
+``tp`` (it is ~an order of magnitude smaller than GQA K/V); each device
+attends its local query heads against the full latent cache.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def mla_attention(
+    q_lat: jnp.ndarray,       # [B, T, H, dc]  — q_nope absorbed through W_uk
+    q_pe: jnp.ndarray,        # [B, T, H, dr]  — RoPE'd query part
+    c_cache: jnp.ndarray,     # [B, S, dc]     — latent cache (post-norm)
+    pe_cache: jnp.ndarray,    # [B, S, dr]     — shared RoPE key cache
+    q_positions: jnp.ndarray,  # [B, T] int32 absolute positions
+    kv_valid: jnp.ndarray,    # [B, S] bool — slot holds a real token
+    scale: float,             # 1/sqrt(qk_nope_head_dim + qk_rope_head_dim)
+) -> jnp.ndarray:
+    """Causal MLA over a contiguous latent cache (slot index == position).
+
+    Returns the LATENT attention output [B, T, H, dc] in q_lat.dtype
+    (caller up-projects through W_uv)."""
+    B, T, H, dc = q_lat.shape
+    S = c_cache.shape[1]
+    qf = q_lat.astype(jnp.float32)
+    pf = q_pe.astype(jnp.float32)
+    cf = c_cache.astype(jnp.float32)
+    ef = pe_cache.astype(jnp.float32)
+
+    scores = (jnp.einsum("bthc,bsc->bhts", qf, cf)
+              + jnp.einsum("bthr,bsr->bhts", pf, ef)) * scale   # [B,H,T,S]
+    slot = jnp.arange(S, dtype=jnp.int32)[None, None, None, :]
+    ok = (slot <= q_positions[:, None, :, None]) & kv_valid[:, None, None, :]
+    scores = jnp.where(ok, scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bsc->bthc", w, cf)
+    return out.astype(q_lat.dtype)
+
+
+def paged_mla_attention(
+    q_lat: jnp.ndarray,       # [B, T, H, dc]
+    q_pe: jnp.ndarray,        # [B, T, H, dr]
+    c_pages: jnp.ndarray,     # [NP_layer, page, 1, dc] — this layer's pool view
+    pe_pages: jnp.ndarray,    # [NP_layer, page, 1, dr]
+    page_table: jnp.ndarray,  # [B, P] physical page ids (layer-offset applied)
+    q_positions: jnp.ndarray,  # [B, T]
+    kv_lens: jnp.ndarray,     # [B] — valid tokens post-write
+    scale: float,
+) -> jnp.ndarray:
+    """Causal MLA over the paged latent pool: gather the rows' pages into a
+    contiguous [B, S, dc] view (S = P·page — static), then the same math as
+    the contiguous form. Logical slot i lives in page i//page at offset
+    i%page, so slot index == absolute position."""
+    B, P = page_table.shape
+    page = c_pages.shape[1]
+    S = P * page
+    c = c_pages[page_table][:, :, :, 0, :].reshape(B, S, -1)
+    pe = pe_pages[page_table][:, :, :, 0, :].reshape(B, S, -1)
+    slot_valid = (jnp.arange(S, dtype=jnp.int32)[None, :]
+                  < kv_lens[:, None])
+    return mla_attention(q_lat, q_pe, c, pe, q_positions, slot_valid, scale)

@@ -33,11 +33,24 @@ def param_specs(cfg: ModelConfig, params: Optional[dict] = None) -> dict:
     blocks = {
         "attn_norm": P(None, None),
         "wq": P(None, None, "tp"),
-        "wk": P(None, None, "tp"),
-        "wv": P(None, None, "tp"),
         "wo": P(None, "tp", None),
         "mlp_norm": P(None, None),
     }
+    if cfg.mla:
+        # MLA: query-side weights shard over heads (tp); the latent
+        # down-projection and its norm replicate (no head axis — the latent
+        # cache is shared by every head, which is the whole point).
+        blocks.update({
+            "w_dkv": P(None, None, None),
+            "kv_norm": P(None, None),
+            "w_uk": P(None, None, "tp"),
+            "w_uv": P(None, None, "tp"),
+        })
+    else:
+        blocks.update({
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+        })
     if cfg.num_experts == 0 or cfg.moe_shared_expert:
         blocks["w_gate"] = P(None, None, "tp")
         blocks["w_up"] = P(None, None, "tp")

@@ -527,3 +527,36 @@ def test_non_numeric_sampling_fields_return_400():
                 assert e.code == 400, (bad, e.code)
     finally:
         fe.shutdown(); be.close()
+
+
+def test_response_format_json_forwarded():
+    be = _canned("ok")
+    fe, port = _frontend_for(be.port)
+    try:
+        _post(port, "/v1/chat/completions",
+              {"messages": [{"role": "user", "content": "x"}],
+               "response_format": {"type": "json_object"}, "max_tokens": 4})
+        assert be.seen[-1].get("json_mode") is True
+        _post(port, "/v1/completions", {"prompt": "x", "max_tokens": 4})
+        assert "json_mode" not in be.seen[-1]
+    finally:
+        fe.shutdown(); be.close()
+
+
+def test_unsupported_response_format_returns_400():
+    be = _canned("ok")
+    fe, port = _frontend_for(be.port)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions", method="POST",
+            data=json.dumps({"messages": [{"role": "user", "content": "x"}],
+                             "response_format": {"type": "json_schema"}}
+                            ).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        fe.shutdown(); be.close()

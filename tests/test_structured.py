@@ -1,0 +1,260 @@
+"""Structured output: JSON-mode grammar-constrained decoding.
+
+The signature feature of the reference's flagship engine (SGLang —
+structured generation; vLLM guided/JSON mode), built TPU-side as
+host-computed token masks applied inside the jitted sampler (engine
+routing: constrained rows decode through the host-synced verify step,
+composing exactly with n-gram speculative drafts)."""
+
+import json
+
+import pytest
+
+from rbg_tpu.engine import Engine, EngineConfig, SamplingParams
+from rbg_tpu.engine.grammar import JsonGrammar, TokenGrammar, token_bytes_for
+from rbg_tpu.engine.tokenizer import ByteTokenizer
+
+
+
+# ---- byte automaton ----
+
+
+def _accepts(text: str) -> bool:
+    g = JsonGrammar()
+    s = g.initial()
+    for b in text.encode():
+        s = g.advance(s, b)
+        if s is None:
+            return False
+    return g.is_complete(s)
+
+
+@pytest.mark.parametrize("text", [
+    '{}', '[]', '{"a": 1}', '[1, 2.5, -3e+7, "x", true, false, null]',
+    '{"k": {"n": [[]]}, "s": "\\u00e9 \\n"}', '  42  ', '"hi"', '0.5',
+    '{"a":[{"b":null}]}', '-0', '[[], {}]', '1e9',
+])
+def test_grammar_accepts_valid_json(text):
+    assert _accepts(text)
+    json.loads(text)  # python agrees it is valid
+
+
+@pytest.mark.parametrize("text", [
+    '{', '{]', '{"a" 1}', '[1,]', '01', '+1', '1.', '.5', 'tru', '"\\x"',
+    '{"a": 1,}', '{a: 1}', '[1 2]', '"unterminated', '{} x', '[],', 'nan',
+])
+def test_grammar_rejects_invalid_json(text):
+    assert not _accepts(text)
+
+
+def test_grammar_number_termination():
+    g = JsonGrammar()
+    s = g.initial()
+    for b in b"12":
+        s = g.advance(s, b)
+    assert g.is_complete(s)            # "12" is a complete value
+    s2 = g.advance(s, ord("3"))
+    assert s2 is not None              # ...but may extend
+
+
+# ---- token lifting ----
+
+
+def test_token_mask_over_byte_tokenizer():
+    tok = ByteTokenizer()
+    tg = TokenGrammar(JsonGrammar(), token_bytes_for(tok), tok.eos_id)
+    st = tg.initial()
+    m = tg.mask(st)
+    assert m[ord('{')] and m[ord('[')] and m[ord('"')] and m[ord('1')]
+    assert not m[ord('}')] and not m[ord('x')] and not m[tok.eos_id]
+    st = tg.advance_token(st, ord('{'))
+    m = tg.mask(st)
+    assert m[ord('"')] and m[ord('}')] and not m[ord('1')]
+    st = tg.advance_token(st, ord('}'))
+    assert tg.mask(st)[tok.eos_id]     # complete → EOS legal
+    assert tg.advance_token(st, tok.eos_id) is not None
+
+
+def test_token_bytes_byte_tokenizer_is_identity():
+    table = token_bytes_for(ByteTokenizer())
+    assert table[0x41] == b"A"
+    assert table[0x80] == bytes([0x80])     # raw continuation byte, no U+FFFD
+    assert table[256] is None and table[257] is None  # BOS/EOS specials
+
+
+# ---- engine ----
+
+
+_TOK = ByteTokenizer()
+
+
+def _engine(**kw):
+    eng = Engine(EngineConfig(model="tiny", vocab_size=512, page_size=8,
+                              num_pages=128, max_seq_len=256,
+                              use_pallas="never", **kw))
+    eng.enable_json_grammar(_TOK)
+    return eng
+
+
+def _gen_text(eng, seed, max_new=80, temperature=0.9):
+    sp = SamplingParams(max_new_tokens=max_new, temperature=temperature,
+                        seed=seed, json_mode=True, stop_token=_TOK.eos_id)
+    out = eng.generate([_TOK.encode("j:", add_bos=False)], sp)[0]
+    done = bool(out) and out[-1] == _TOK.eos_id
+    return _TOK.decode([t for t in out if t != _TOK.eos_id]), done
+
+
+@pytest.mark.parametrize("seed", [1, 2, 7, 11])
+def test_json_mode_outputs_are_valid_json(seed):
+    text, done = _gen_text(_engine(), seed)
+    if done:
+        json.loads(text)               # finished → must parse
+    else:
+        # Budget-truncated: the emitted prefix must still be legal.
+        g = JsonGrammar()
+        s = g.initial()
+        for b in text.encode():
+            s = g.advance(s, b)
+            assert s is not None, text
+
+
+def test_json_mode_greedy_also_constrained():
+    text, done = _gen_text(_engine(), seed=None, temperature=0.0)
+    g = JsonGrammar()
+    s = g.initial()
+    for b in text.encode():
+        s = g.advance(s, b)
+        assert s is not None, text
+
+
+def test_json_mode_composes_with_speculative():
+    sp = SamplingParams(max_new_tokens=60, temperature=0.0, json_mode=True,
+                        stop_token=_TOK.eos_id)
+    prompt = _TOK.encode("q", add_bos=False)
+    a = _engine(speculative="ngram").generate([prompt], sp)[0]
+    b = _engine().generate([prompt], sp)[0]
+    assert a == b
+
+
+def test_json_mode_mixed_with_unconstrained_batch():
+    eng = _engine()
+    rj = eng.add_request(_TOK.encode("a", add_bos=False),
+                         SamplingParams(max_new_tokens=30, temperature=0.7,
+                                        seed=4, json_mode=True,
+                                        stop_token=_TOK.eos_id))
+    rf = eng.add_request([1, 2, 3],
+                         SamplingParams(max_new_tokens=10))
+    outs = {rj: [], rf: []}
+    while eng.has_work():
+        for ev in eng.step():
+            outs[ev.request_id].append(ev.token)
+    assert len(outs[rf]) == 10          # free row unaffected
+    text = _TOK.decode([t for t in outs[rj] if t != _TOK.eos_id])
+    g = JsonGrammar()
+    s = g.initial()
+    for b in text.encode():
+        s = g.advance(s, b)
+        assert s is not None, text
+
+
+def test_json_mode_with_penalties_same_step():
+    # Penalized rows ride the host-synced step alongside grammar rows.
+    eng = _engine()
+    rj = eng.add_request(_TOK.encode("a", add_bos=False),
+                         SamplingParams(max_new_tokens=20, temperature=0.7,
+                                        seed=9, json_mode=True,
+                                        stop_token=_TOK.eos_id))
+    rp = eng.add_request([1, 2, 3],
+                         SamplingParams(max_new_tokens=12,
+                                        presence_penalty=1e9))
+    outs = {rj: [], rp: []}
+    while eng.has_work():
+        for ev in eng.step():
+            outs[ev.request_id].append(ev.token)
+    assert len(set(outs[rp])) == len(outs[rp])   # penalty row: all distinct
+    text = _TOK.decode([t for t in outs[rj] if t != _TOK.eos_id])
+    g = JsonGrammar()
+    s = g.initial()
+    for b in text.encode():
+        s = g.advance(s, b)
+        assert s is not None, text
+
+
+def test_json_mode_without_grammar_table_fails_request():
+    eng = Engine(EngineConfig(model="tiny", vocab_size=512, page_size=8,
+                              num_pages=64, max_seq_len=128,
+                              use_pallas="never"))
+    with pytest.raises(ValueError, match="json_mode"):
+        eng.add_request([1, 2], SamplingParams(max_new_tokens=4,
+                                               json_mode=True))
+
+
+@pytest.mark.e2e
+def test_json_mode_over_wire():
+    """generate_text with json_mode through a real server subprocess —
+    decoded text parses as JSON (or is a legal truncated prefix)."""
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    from rbg_tpu.engine.protocol import request_once
+    from rbg_tpu.utils import scrubbed_cpu_env
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = scrubbed_cpu_env()
+    env["RBG_SERVE_PORT"] = str(port)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rbg_tpu.engine.server", "--model", "tiny",
+         "--vocab-size", "512", "--page-size", "8", "--num-pages", "128",
+         "--max-seq-len", "256", "--use-pallas", "never"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 240
+        while True:
+            try:
+                h, _, _ = request_once(f"127.0.0.1:{port}",
+                                       {"op": "health"}, timeout=2)
+                if h and h.get("ok"):
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < deadline, "server never healthy"
+            time.sleep(0.3)
+        r, _, _ = request_once(
+            f"127.0.0.1:{port}",
+            {"op": "generate_text", "text": "emit json:",
+             "max_new_tokens": 60, "temperature": 0.8, "seed": 5,
+             "json_mode": True}, timeout=180)
+        assert "error" not in r, r
+        text = r["text"]
+        g = JsonGrammar()
+        s = g.initial()
+        for b in text.encode():
+            s = g.advance(s, b)
+            assert s is not None, text
+    finally:
+        proc.terminate()
+        proc.wait()
+
+
+def test_json_row_does_not_evict_fused_rows_from_their_path():
+    """Mixed traffic: a grammar row decodes host-synced while plain rows
+    keep the fused path — a greedy plain row's output must be identical
+    with or without a JSON request in flight."""
+    alone = _engine(multi_step=2).generate(
+        [[1, 2, 3]], SamplingParams(max_new_tokens=10))[0]
+    eng = _engine(multi_step=2)
+    rj = eng.add_request(_TOK.encode("a", add_bos=False),
+                         SamplingParams(max_new_tokens=20, temperature=0.7,
+                                        seed=3, json_mode=True,
+                                        stop_token=_TOK.eos_id))
+    rf = eng.add_request([1, 2, 3], SamplingParams(max_new_tokens=10))
+    outs = {rj: [], rf: []}
+    while eng.has_work():
+        for ev in eng.step():
+            outs[ev.request_id].append(ev.token)
+    assert outs[rf] == alone
+    assert eng.metrics["spec_steps"] > 0       # grammar row went host-synced

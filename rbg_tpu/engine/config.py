@@ -103,6 +103,7 @@ class SamplingParams:
     seed: Optional[int] = None      # per-request PRNG stream (reproducible)
     logprobs: bool = False          # emit chosen-token logprob per step
     json_mode: bool = False         # grammar-constrained: output is valid JSON
+    lora: Optional[str] = None      # adapter name (engine-registered)
     stop_token: Optional[int] = None
 
     def needs_penalties(self) -> bool:
@@ -139,6 +140,7 @@ class SamplingParams:
             seed=(int(obj["seed"]) if obj.get("seed") is not None else None),
             logprobs=bool(obj.get("logprobs", False)),
             json_mode=bool(obj.get("json_mode", False)),
+            lora=(str(obj["lora"]) if obj.get("lora") else None),
             stop_token=(obj.get("stop_token") if obj.get("stop_token") is None
                         else int(obj["stop_token"])),
         )

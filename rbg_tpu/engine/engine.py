@@ -68,6 +68,7 @@ class Request:
         self.last_token: Optional[int] = None
         self.ngram = None                   # NGramIndex, speculative mode
         self.gstate = None                  # grammar state (json_mode)
+        self.lora_idx = 0                   # adapter slot (0 = base model)
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
 
@@ -119,6 +120,14 @@ class Engine:
         self._dec_fn_cache: Dict[Tuple[int, bool, bool], object] = {}
         self._spec_fn_cache: Dict[Tuple[int, bool, bool, bool, bool], object] = {}
         self.grammar = None     # TokenGrammar — enable_json_grammar()
+        # Events drained outside step() (e.g. a runtime load_lora must
+        # flush the fused pipeline) surface on the NEXT step() call.
+        self._deferred_events: List[StepEvent] = []
+        # Multi-LoRA: name → slot (0 = reserved no-adapter slot); stacked
+        # arrays rebuilt on load (rank-padded so one program serves all).
+        self._lora_slots: Dict[str, int] = {}
+        self._lora_raw: List[Tuple[dict, float]] = []
+        self.lora_stack: Optional[dict] = None
         self.metrics = {"steps": 0, "decode_tokens": 0, "prefill_tokens": 0,
                         "radix_hit_tokens": 0, "preemptions": 0,
                         "spec_drafted": 0, "spec_accepted": 0,
@@ -167,6 +176,105 @@ class Engine:
                                     token_bytes_for(tokenizer),
                                     tokenizer.eos_id)
 
+    _LORA_ATTN_TARGETS = ("wq", "wk", "wv", "wo")
+    _LORA_MLP_TARGETS = ("w_gate", "w_up", "w_down")
+
+    def load_lora(self, name: str, adapter: dict, alpha: float = 16.0):
+        """Register a LoRA adapter for per-request batched serving.
+
+        ``adapter``: {target: (A [L, d_in, r], B [L, r, d_out])} for any of
+        wq/wk/wv/wo (+ w_gate/w_up/w_down on dense-MLP models). All loaded
+        adapters are stacked (rank-padded, alpha/r folded into B
+        per-target) into one [L, n, ...] array set so a single compiled
+        program serves every batch mix — per-row adapter gather inside the
+        jitted step (punica/S-LoRA), no recompile per adapter."""
+        if self.mcfg.mla:
+            raise NotImplementedError(
+                "LoRA serving targets dense/GQA projections; MLA adapter "
+                "mapping (wq/w_uk/w_uv) is not wired yet")
+        if not adapter:
+            raise ValueError("empty adapter")
+        if name in self._lora_slots:
+            raise ValueError(f"adapter {name!r} already loaded")
+        allowed = set(self._LORA_ATTN_TARGETS)
+        if self.mcfg.num_experts == 0:
+            allowed |= set(self._LORA_MLP_TARGETS)
+        L = self.mcfg.num_layers
+        base = self.params["blocks"]
+        for tgt, (A, B) in adapter.items():
+            if tgt not in allowed:
+                # A typo'd/unsupported target would be a silent no-op —
+                # _lora_proj matches exact names on the dense paths only.
+                raise ValueError(
+                    f"adapter {name!r}: unsupported target {tgt!r} "
+                    f"(supported here: {sorted(allowed)})")
+            if A.shape[0] != L or B.shape[0] != L or A.shape[2] != B.shape[1]:
+                raise ValueError(
+                    f"adapter {name!r} target {tgt!r}: bad shapes "
+                    f"{A.shape} / {B.shape}")
+            bw = base[tgt]
+            if A.shape[1] != bw.shape[1] or B.shape[2] != bw.shape[2]:
+                raise ValueError(
+                    f"adapter {name!r} target {tgt!r}: dims {A.shape[1]}→"
+                    f"{B.shape[2]} do not match base weight "
+                    f"{bw.shape[1]}→{bw.shape[2]} (wrong base model?)")
+        # Commit only after a successful rebuild — a half-registered slot
+        # would resolve past the stack and JAX's clamped gather would
+        # silently serve a DIFFERENT adapter.
+        self._lora_raw.append((adapter, float(alpha)))
+        try:
+            self._rebuild_lora_stack()
+        except Exception:
+            self._lora_raw.pop()
+            raise
+        self._lora_slots[name] = len(self._lora_raw)
+
+    def _rebuild_lora_stack(self):
+        L = self.mcfg.num_layers
+        n = len(self._lora_raw) + 1                     # + no-adapter slot 0
+        targets = sorted({t for ad, _ in self._lora_raw for t in ad})
+        rmax = max(A.shape[2] for ad, _ in self._lora_raw
+                   for A, _B in ad.values())
+        stack = {}
+        dt = self.mcfg.jax_dtype
+        for tgt in targets:
+            d_in = next(A.shape[1] for ad, _ in self._lora_raw
+                        if tgt in ad for A, _B in [ad[tgt]])
+            d_out = next(B.shape[2] for ad, _ in self._lora_raw
+                         if tgt in ad for _A, B in [ad[tgt]])
+            As = np.zeros((L, n, d_in, rmax), np.float32)
+            Bs = np.zeros((L, n, rmax, d_out), np.float32)
+            for i, (ad, alpha) in enumerate(self._lora_raw):
+                if tgt in ad:
+                    A, B = ad[tgt]
+                    r = A.shape[2]
+                    As[:, i + 1, :, :r] = np.asarray(A, np.float32)
+                    # Per-TARGET scaling: alpha/r with THIS target's rank
+                    # (mixed-rank adapters would otherwise mis-scale).
+                    Bs[:, i + 1, :r, :] = (np.asarray(B, np.float32)
+                                           * (alpha / r))
+            stack[tgt] = (jnp.asarray(As, dt), jnp.asarray(Bs, dt))
+        self.lora_stack = stack
+        # The compiled variants bind the stack shape — new adapters mean
+        # new shapes, so old cached programs are stale. DRAIN the fused
+        # pipeline first: discarding self._dec would lose the pending
+        # window's tokens while seq_len already counts them (corrupting
+        # every in-flight request on a runtime load).
+        self._deferred_events.extend(self._drain_decode())
+        self._fwd_cache.clear()
+        self._dec_fn_cache.clear()
+        self._spec_fn_cache.clear()
+
+    def _resolve_lora(self, sampling: SamplingParams) -> int:
+        if sampling.lora is None:
+            return 0
+        slot = self._lora_slots.get(sampling.lora)
+        if slot is None:
+            raise ValueError(
+                f"unknown LoRA adapter {sampling.lora!r}; loaded: "
+                f"{sorted(self._lora_slots) or 'none'}")
+        return slot
+
     def _grammar_check(self, sampling: SamplingParams) -> None:
         if sampling.json_mode and self.grammar is None:
             raise ValueError(
@@ -194,6 +302,7 @@ class Engine:
                 f"prompt+max_new_tokens {len(prompt)}+{sampling.max_new_tokens} "
                 f"exceeds max_seq_len {self.cfg.max_seq_len}")
         req = Request(prompt, sampling)
+        req.lora_idx = self._resolve_lora(sampling)
         if sampling.json_mode:
             req.gstate = self.grammar.initial()
         self.requests[req.id] = req
@@ -214,6 +323,7 @@ class Engine:
         sampling = sampling or SamplingParams()
         self._check_prompt(prompt)
         self._grammar_check(sampling)
+        lora_idx = self._resolve_lora(sampling)  # before alloc: no page leak
         ps = self.cfg.page_size
         if prefix_len % ps or not 0 < prefix_len < len(prompt):
             raise ValueError(f"prefix_len {prefix_len} must be page-aligned "
@@ -241,6 +351,7 @@ class Engine:
             self.allocator.release(pages)
             raise ValueError(f"prefix KV rejected: {e}") from e
         req = Request(prompt, sampling)
+        req.lora_idx = lora_idx
         if sampling.json_mode:
             req.gstate = self.grammar.initial()
         req.pages = pages
@@ -259,6 +370,9 @@ class Engine:
     def step(self) -> List[StepEvent]:
         """One scheduler iteration: admit → prefill (chunk each) → decode."""
         events: List[StepEvent] = []
+        if self._deferred_events:
+            events.extend(self._deferred_events)
+            self._deferred_events = []
         self.metrics["steps"] += 1
         self._admit()
         events.extend(self._prefill_step())
@@ -281,8 +395,11 @@ class Engine:
         while self.waiting and len(self.running) < self.cfg.max_batch:
             req = self.waiting[0]
             matched, shared_pages = 0, []
-            if self.radix is not None and req.state == "waiting":
+            if (self.radix is not None and req.state == "waiting"
+                    and req.lora_idx == 0):
                 # Keep at least the prompt's last token for prefill (logits).
+                # Adapter requests skip the prefix cache: their KV differs
+                # from base-model KV for the same tokens.
                 matched, shared_pages = self.radix.match(req.prompt[:-1])
             # Admit with pages for the PROMPT + first token only — decode
             # grows page-by-page (memory oversubscription; preemption
@@ -337,6 +454,7 @@ class Engine:
             lens=[e for _, _, e in rows],
             pages=[req.pages for req, _, _ in rows],
             T_bucket=chunk, B_bucket=B,
+            reqs=[req for req, _, _ in rows],
         )
 
         finishing = []
@@ -413,6 +531,16 @@ class Engine:
         tpmp = any(r.sampling.top_p < 1.0 or r.sampling.min_p > 0.0
                    for r in reqs)
         return temps, ks, tps, mps, seeds, rids, pen, lp, tpmp
+
+    def _lora_rows(self, reqs, B: int):
+        """(lora_ids [B] or None): None when no row uses an adapter —
+        callers compile the adapter-free variant in that case."""
+        if self.lora_stack is None or not any(r.lora_idx for r in reqs):
+            return None
+        ids = np.zeros(B, np.int32)
+        for i, r in enumerate(reqs):
+            ids[i] = r.lora_idx
+        return jnp.asarray(ids)
 
     def _penalty_rows(self, reqs, B: int):
         """Host-built penalty state: prompt-seen mask, output-count base,
@@ -511,7 +639,7 @@ class Engine:
         return self._emit_pending(st["pending"])
 
     def _get_decode_fn(self, B: int, pen: bool, lp: bool,
-                       tpmp: bool = True):
+                       tpmp: bool = True, la: bool = False):
         """One fused jitted program per (decode bucket, penalties-active,
         logprobs-active): a lax.scan window of ``multi_step`` iterations,
         each = forward + on-device sampling + position/length increment,
@@ -522,7 +650,7 @@ class Engine:
         device→host fetch (the [K, B] token ids, one window late). Penalty
         state ([B, V] prompt mask + output counts) and per-step logprobs
         only exist in the variants that need them."""
-        fn = self._dec_fn_cache.get((B, pen, lp, tpmp))
+        fn = self._dec_fn_cache.get((B, pen, lp, tpmp, la))
         if fn is not None:
             return fn
         import functools
@@ -532,7 +660,8 @@ class Engine:
 
         def fused(params, tok, pos, kvl, table, mask, limit, k_pages,
                   v_pages, k_scales, v_scales, keys, temps, ks, tps, mps,
-                  pmask=None, ocounts=None, rep=None, pres=None, freq=None):
+                  pmask=None, ocounts=None, rep=None, pres=None, freq=None,
+                  lora=None, lids=None):
             def body(carry, _):
                 tok, pos, kvl, kp, vp, ksc, vsc, oc = carry
                 # Rows at their length limit (mid-window finishers) stop
@@ -542,7 +671,8 @@ class Engine:
                 logits, kp, vp, ksc, vsc = base(
                     params, tokens=tok[:, None], positions=pos[:, None],
                     token_mask=write_ok, kv_lens=kvl, page_table=table,
-                    k_pages=kp, v_pages=vp, k_scales=ksc, v_scales=vsc)
+                    k_pages=kp, v_pages=vp, k_scales=ksc, v_scales=vsc,
+                    lora=lora, lora_ids=lids)
                 pkw = (dict(prompt_mask=pmask, out_counts=oc, rep=rep,
                             pres=pres, freq=freq) if pen else {})
                 # Key by the OUTPUT token's position (pos + 1): the input
@@ -578,7 +708,7 @@ class Engine:
         if pen:
             donate.append(17)  # ocounts
         fn = jax.jit(fused, donate_argnums=tuple(donate))
-        self._dec_fn_cache[(B, pen, lp, tpmp)] = fn
+        self._dec_fn_cache[(B, pen, lp, tpmp, la)] = fn
         return fn
 
     def _build_decode_state(self, batch: List[Request]) -> dict:
@@ -599,9 +729,10 @@ class Engine:
             mask[i, 0] = True
             limit[i] = r.max_len()
             table[i, :len(r.pages)] = r.pages
+        lids = self._lora_rows(batch, B)
         st = {
             "rows": list(batch), "B": B, "pen": pen, "lp": lp,
-            "tpmp": tpmp,
+            "tpmp": tpmp, "lids": lids,
             "tok": jnp.asarray(tok), "pos": jnp.asarray(pos),
             "kvl": jnp.asarray(kvl), "mask": jnp.asarray(mask),
             "limit": jnp.asarray(limit),
@@ -709,15 +840,18 @@ class Engine:
             st["table"] = jnp.asarray(st["table_np"])
 
         fn = self._get_decode_fn(st["B"], st["pen"], st["lp"],
-                                 st["tpmp"])
-        pen_args = ((st["pmask"], st["ocounts"], st["rep"], st["pres"],
-                     st["freq"]) if st["pen"] else ())
+                                 st["tpmp"], st["lids"] is not None)
+        kw = {}
+        if st["pen"]:
+            kw.update(pmask=st["pmask"], ocounts=st["ocounts"],
+                      rep=st["rep"], pres=st["pres"], freq=st["freq"])
+        if st["lids"] is not None:
+            kw.update(lora=self.lora_stack, lids=st["lids"])
         toks_seq, lp_seq, tok, pos, kvl, kp, vp, ksc, vsc, oc = fn(
             self.params, st["tok"], st["pos"], st["kvl"], st["table"],
             st["mask"], st["limit"], self.cache.k_pages, self.cache.v_pages,
             self.cache.k_scales, self.cache.v_scales,
-            st["keys"], st["temps"], st["ks"], st["tps"], st["mps"],
-            *pen_args)
+            st["keys"], st["temps"], st["ks"], st["tps"], st["mps"], **kw)
         self.cache = PagedKVCache(k_pages=kp, v_pages=vp,
                                   k_scales=ksc, v_scales=vsc)
         st["tok"], st["pos"], st["kvl"] = tok, pos, kvl
@@ -751,7 +885,7 @@ class Engine:
             idx.extend(seq[have:total])
 
     def _get_spec_fn(self, B: int, lp: bool, tpmp: bool = True,
-                     pen: bool = False, gr: bool = False):
+                     pen: bool = False, gr: bool = False, la: bool = False):
         """One jitted verify program per (bucket, logprobs, top-p, pen,
         grammar): a (B, K+1) paged forward + per-position sampling, keys
         fold_in(row, pos+1) — the same keys the sequential path would use,
@@ -760,7 +894,7 @@ class Engine:
         across the window — those rows never draft, so only their slot-0
         sample is consumed). Grammar rows get per-slot allowed-token masks
         computed host-side along the draft path."""
-        key = (B, lp, tpmp, pen, gr)
+        key = (B, lp, tpmp, pen, gr, la)
         fn = self._spec_fn_cache.get(key)
         if fn is not None:
             return fn
@@ -771,11 +905,12 @@ class Engine:
         def specfn(params, tok, pos, mask, kvl, table, k_pages, v_pages,
                    k_scales, v_scales, keys, temps, ks, tps, mps,
                    pmask=None, ocounts=None, rep=None, pres=None, freq=None,
-                   gmasks=None):
+                   gmasks=None, lora=None, lids=None):
             logits, kp, vp, ksc, vsc = base(
                 params, tokens=tok, positions=pos, token_mask=mask,
                 kv_lens=kvl, page_table=table, k_pages=k_pages,
-                v_pages=v_pages, k_scales=k_scales, v_scales=v_scales)
+                v_pages=v_pages, k_scales=k_scales, v_scales=v_scales,
+                lora=lora, lora_ids=lids)
             pkw = (dict(prompt_mask=pmask, out_counts=ocounts, rep=rep,
                         pres=pres, freq=freq) if pen else {})
 
@@ -881,17 +1016,19 @@ class Engine:
             if gr and id(r) in gmask_rows:
                 for t, m in enumerate(gmask_rows[id(r)]):
                     gmasks[i, t] = m
-        extra = []
+        kw = {}
         if pen:
             pmask, oc, rep, pres, freq = self._penalty_rows(batch, B)
             for i, r in enumerate(batch):
                 np.add.at(oc[i], np.asarray(r.output, np.int64), 1)
-            extra += [pmask, jnp.asarray(oc), rep, pres, freq]
-        elif gr:
-            extra += [None, None, None, None, None]
+            kw.update(pmask=pmask, ocounts=jnp.asarray(oc), rep=rep,
+                      pres=pres, freq=freq)
         if gr:
-            extra.append(jnp.asarray(gmasks))
-        fn = self._get_spec_fn(B, lp, tpmp, pen, gr)
+            kw["gmasks"] = jnp.asarray(gmasks)
+        lids = self._lora_rows(batch, B)
+        if lids is not None:
+            kw.update(lora=self.lora_stack, lids=lids)
+        fn = self._get_spec_fn(B, lp, tpmp, pen, gr, lids is not None)
         toks_out, lps_out, kp, vp, ksc, vsc = fn(
             self.params, jnp.asarray(tok), jnp.asarray(pos),
             jnp.asarray(mask), jnp.asarray(kvl), jnp.asarray(table),
@@ -899,7 +1036,7 @@ class Engine:
             self.cache.k_scales, self.cache.v_scales,
             row_keys(seeds, self._sample_base, rids),
             jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(tps),
-            jnp.asarray(mps), *extra)
+            jnp.asarray(mps), **kw)
         self.cache = PagedKVCache(k_pages=kp, v_pages=vp,
                                   k_scales=ksc, v_scales=vsc)
         vals = np.asarray(toks_out)                       # [T, B]
@@ -953,8 +1090,9 @@ class Engine:
             # exports them to a decode peer, then calls release_request().
             req.state = "exported"
             return
-        if self.radix is not None:
-            # Cache the full sequence (prompt + output) for future prefixes.
+        if self.radix is not None and req.lora_idx == 0:
+            # Cache the full sequence (prompt + output) for future prefixes
+            # (base-model requests only — adapter KV must not cross-match).
             self.radix.insert(req.prompt + req.output[:-1], req.pages)
         self.allocator.release(req.pages)
         req.pages = []
@@ -1019,8 +1157,8 @@ class Engine:
                 return min(b, max(self.cfg.decode_buckets))
         return max(self.cfg.decode_buckets)
 
-    def _get_fwd(self, B: int, T: int):
-        key = (B, T)
+    def _get_fwd(self, B: int, T: int, la: bool = False):
+        key = (B, T, la)
         fn = self._fwd_cache.get(key)
         if fn is None:
             import functools
@@ -1028,20 +1166,23 @@ class Engine:
                                      use_pallas=self.cfg.use_pallas)
 
             def wrapped(params, tokens, positions, token_mask, kv_lens,
-                        page_table, k_pages, v_pages, k_scales, v_scales):
+                        page_table, k_pages, v_pages, k_scales, v_scales,
+                        lora=None, lids=None):
                 return base(params, tokens=tokens, positions=positions,
                             token_mask=token_mask, kv_lens=kv_lens,
                             page_table=page_table, k_pages=k_pages,
                             v_pages=v_pages, k_scales=k_scales,
-                            v_scales=v_scales)
+                            v_scales=v_scales, lora=lora, lora_ids=lids)
 
             donate = (6, 7, 8, 9) if self.cache.quantized else (6, 7)
             fn = jax.jit(wrapped, donate_argnums=donate)
             self._fwd_cache[key] = fn
         return fn
 
-    def _run(self, tokens, positions, lens, pages, T_bucket, B_bucket=None):
-        """Pad host-side lists to (B_bucket, T_bucket) and dispatch."""
+    def _run(self, tokens, positions, lens, pages, T_bucket, B_bucket=None,
+             reqs=None):
+        """Pad host-side lists to (B_bucket, T_bucket) and dispatch.
+        ``reqs`` (row-aligned) selects per-row LoRA adapters when given."""
         B = B_bucket or 1
         T = T_bucket
         P = self.cfg.max_pages_per_seq
@@ -1056,12 +1197,15 @@ class Engine:
             mask[i, :len(ts)] = True
             kvl[i] = ln
             table[i, :len(pg)] = pg
-        fn = self._get_fwd(B, T)
+        lids = self._lora_rows(reqs, B) if reqs is not None else None
+        kw = ({"lora": self.lora_stack, "lids": lids}
+              if lids is not None else {})
+        fn = self._get_fwd(B, T, lids is not None)
         logits, k_pages, v_pages, k_scales, v_scales = fn(
             self.params, jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(mask),
             jnp.asarray(kvl), jnp.asarray(table),
             self.cache.k_pages, self.cache.v_pages,
-            self.cache.k_scales, self.cache.v_scales,
+            self.cache.k_scales, self.cache.v_scales, **kw,
         )
         self.cache = PagedKVCache(k_pages=k_pages, v_pages=v_pages,
                                   k_scales=k_scales, v_scales=v_scales)

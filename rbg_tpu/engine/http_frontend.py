@@ -162,6 +162,8 @@ class Handler(BaseHTTPRequestHandler):
             out["seed"] = int(body["seed"])
         if body.get("logprobs"):
             out["logprobs"] = True
+        if body.get("lora"):
+            out["lora"] = str(body["lora"])
         rf = body.get("response_format")
         if rf is not None:
             rft = rf.get("type") if isinstance(rf, dict) else None

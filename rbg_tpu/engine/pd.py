@@ -69,7 +69,8 @@ class PrefillWorker:
         ps = self.engine.cfg.page_size
         rid = None
         matched = 0
-        if self.pool is not None:
+        # Adapter requests skip the shared pool: pooled KV is base-model KV.
+        if self.pool is not None and sampling.lora is None:
             # Keep at least the prompt's last token for prefill (logits) —
             # same contract as the in-process radix cache.
             try:
@@ -106,9 +107,10 @@ class PrefillWorker:
         v = np.asarray(self.engine.cache.v_pages[:, page_ids])
         self.metrics["transfer_s"] += time.perf_counter() - t0
         self.engine.release_request(rid)
-        if self.pool is not None:
+        if self.pool is not None and sampling.lora is None:
             # Publish the page-aligned prompt prefix for future requests
             # (idempotent: the store refreshes rather than duplicates).
+            # Adapter KV never enters the pool — it is not base-model KV.
             full = len(prompt) // ps
             if full > matched // ps:
                 try:
@@ -136,7 +138,9 @@ class DecodeWorker:
         eng = self.engine
         prompt = bundle.prompt
         eng._check_prompt(prompt)
-        eng._grammar_check(sampling)   # before alloc — a raise must not leak pages
+        # Before alloc — a raise must not leak pages.
+        eng._grammar_check(sampling)
+        lora_idx = eng._resolve_lora(sampling)
         n_pages = bundle.k_data.shape[1]
         need = pages_for_tokens(len(prompt) + 1, eng.cfg.page_size)
         pages = eng._alloc(need)
@@ -151,6 +155,7 @@ class DecodeWorker:
                 jnp.asarray(bundle.v_data, eng.cache.v_pages.dtype)),
         )
         req = Request(prompt, sampling)
+        req.lora_idx = lora_idx
         if sampling.json_mode:
             st = eng.grammar.initial()
             # The first token was sampled prefill-side under the grammar

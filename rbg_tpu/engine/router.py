@@ -128,7 +128,7 @@ class Handler(socketserver.BaseRequestHandler):
             pf_req = {"op": "prefill", "prompt": obj["prompt"]}
             for key in ("temperature", "top_k", "top_p", "min_p",
                         "repetition_penalty", "presence_penalty",
-                        "frequency_penalty", "seed", "json_mode",
+                        "frequency_penalty", "seed", "json_mode", "lora",
                         "stop_token"):
                 if key in obj:
                     pf_req[key] = obj[key]
@@ -141,7 +141,7 @@ class Handler(socketserver.BaseRequestHandler):
             for key in ("max_new_tokens", "temperature", "top_k", "top_p",
                         "min_p", "repetition_penalty", "presence_penalty",
                         "frequency_penalty", "seed", "logprobs", "json_mode",
-                        "stop_token", "stream"):
+                        "lora", "stop_token", "stream"):
                 if key in obj:
                     fwd[key] = obj[key]
             return state.pick("decode"), (fwd, kb, vb)

@@ -262,6 +262,21 @@ def serve(args) -> None:
             if args.tokenizer_path:
                 from rbg_tpu.engine.tokenizer import load_tokenizer
                 server.tokenizer = load_tokenizer(args.tokenizer_path)
+            def load_adapters(engine):
+                import numpy as np
+                for spec in args.lora:
+                    name, _, path = spec.partition("=")
+                    if not path:
+                        raise ValueError(f"--lora expects NAME=PATH, got "
+                                         f"{spec!r}")
+                    z = np.load(path)
+                    targets = sorted({k.rsplit(".", 1)[0] for k in z.files
+                                      if k.endswith(".A")})
+                    adapter = {t: (z[f"{t}.A"], z[f"{t}.B"])
+                               for t in targets}
+                    alpha = float(z["alpha"]) if "alpha" in z.files else 16.0
+                    engine.load_lora(name, adapter, alpha=alpha)
+
             if cfg.mode == "prefill":
                 from rbg_tpu.engine.pd import PrefillWorker
                 pool = None
@@ -272,14 +287,17 @@ def serve(args) -> None:
                     pool = KVPoolClient(pool_addr)
                 server.prefill = PrefillWorker(cfg, pool=pool)
                 server.prefill.engine.enable_json_grammar(server.tokenizer)
+                load_adapters(server.prefill.engine)
             elif cfg.mode == "decode":
                 from rbg_tpu.engine.service import DecodeService
                 server.decode = DecodeService(cfg)
                 server.decode.engine.enable_json_grammar(server.tokenizer)
+                load_adapters(server.decode.engine)
             else:
                 from rbg_tpu.engine.service import EngineService
                 server.service = EngineService(cfg)
                 server.service.engine.enable_json_grammar(server.tokenizer)
+                load_adapters(server.service.engine)
         except Exception:
             # A pod that cannot build its engine must CRASH (so the restart
             # policy sees it), not linger as a never-ready zombie listener.
@@ -321,6 +339,12 @@ def main(argv=None) -> int:
     ap.add_argument("--multi-step", type=int, default=1,
                     help="decode steps fused per device dispatch (lax.scan "
                          "window; higher = throughput, burstier streaming)")
+    ap.add_argument("--lora", action="append", default=[],
+                    metavar="NAME=PATH.npz",
+                    help="load a LoRA adapter (repeatable). The npz holds "
+                         "'{target}.A' [L,d,r] / '{target}.B' [L,r,o] "
+                         "arrays (targets wq/wk/wv/wo/w_gate/w_up/w_down) "
+                         "and optional scalar 'alpha'")
     ap.add_argument("--vocab-size", type=int, default=0,
                     help="override the preset's vocab size (0 = keep; lets "
                          "demo models cover the byte tokenizer's 259 ids)")

@@ -124,14 +124,36 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
     return params
 
 
-def _qkv(cfg: ModelConfig, blk, x, positions):
+def lora_delta(x, A, B_, ids):
+    """Batched multi-LoRA (punica/S-LoRA BGMV shape): per-row adapter
+    gather + two skinny matmuls.
+
+    x [B,T,d]; A [n,d,r]; B_ [n,r,o] with the per-target alpha/r scale
+    FOLDED INTO B at stack-build time (per-target, so mixed-rank adapters
+    scale correctly); ids [B] int32 per-row adapter slot. Returns [B,T,o]
+    in x.dtype. Slot 0 is the reserved no-adapter slot (zero weights)."""
+    a = A[ids]                                   # [B, d, r]
+    b = B_[ids]                                  # [B, r, o]
+    mid = jnp.einsum("btd,bdr->btr", x, a.astype(x.dtype))
+    return jnp.einsum("btr,bro->bto", mid, b.astype(x.dtype))
+
+
+def _lora_proj(xa, base_w, name, lora, lora_ids):
+    y = xa @ base_w
+    if lora is not None and name in lora:
+        A, B_ = lora[name]
+        y = y + lora_delta(xa, A, B_, lora_ids)
+    return y
+
+
+def _qkv(cfg: ModelConfig, blk, x, positions, lora=None, lora_ids=None):
     """Shared pre-attention math: norm → projections (+opt bias) → RoPE."""
     B, T, _ = x.shape
     hd, h, kv = cfg.head_dim_, cfg.num_heads, cfg.num_kv_heads
     xa = rms_norm(x, blk["attn_norm"], cfg.rms_norm_eps)
-    q = xa @ blk["wq"]
-    k = xa @ blk["wk"]
-    vv = xa @ blk["wv"]
+    q = _lora_proj(xa, blk["wq"], "wq", lora, lora_ids)
+    k = _lora_proj(xa, blk["wk"], "wk", lora, lora_ids)
+    vv = _lora_proj(xa, blk["wv"], "wv", lora, lora_ids)
     if "bq" in blk:  # Qwen2-style attention bias
         q = q + blk["bq"]
         k = k + blk["bk"]
@@ -177,19 +199,23 @@ def _mla_scale(cfg: ModelConfig) -> float:
     return (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
 
 
-def _post_attention(cfg: ModelConfig, blk, x, attn):
+def _post_attention(cfg: ModelConfig, blk, x, attn, lora=None,
+                    lora_ids=None):
     """Shared post-attention math: residual → norm → MLP/MoE → residual."""
     B, T, _ = x.shape
-    x = x + attn.reshape(B, T, -1) @ blk["wo"]
+    x = x + _lora_proj(attn.reshape(B, T, -1), blk["wo"], "wo", lora,
+                       lora_ids)
     xm = rms_norm(x, blk["mlp_norm"], cfg.rms_norm_eps)
-    return x + _mlp(cfg, blk, xm)
+    return x + _mlp(cfg, blk, xm, lora, lora_ids)
 
 
-def _mlp(cfg: ModelConfig, blk, xm):
+def _mlp(cfg: ModelConfig, blk, xm, lora=None, lora_ids=None):
     if cfg.num_experts:
-        return _moe_mlp(cfg, blk, xm)
-    gate = jax.nn.silu(xm @ blk["w_gate"])
-    return (gate * (xm @ blk["w_up"])) @ blk["w_down"]
+        return _moe_mlp(cfg, blk, xm)   # LoRA targets dense layers only
+    gate = jax.nn.silu(_lora_proj(xm, blk["w_gate"], "w_gate", lora,
+                                  lora_ids))
+    up = _lora_proj(xm, blk["w_up"], "w_up", lora, lora_ids)
+    return _lora_proj(gate * up, blk["w_down"], "w_down", lora, lora_ids)
 
 
 def _moe_mlp(cfg: ModelConfig, blk, xm):
@@ -335,6 +361,9 @@ def forward_paged(
     use_pallas: str = "auto",
     k_scales: Optional[jnp.ndarray] = None,  # [L, NP, page, KV, 1] (int8 KV)
     v_scales: Optional[jnp.ndarray] = None,
+    lora: Optional[dict] = None,    # {w: (A [L,n,d,r], B [L,n,r,o])} —
+                                    # multi-LoRA stack, alpha/r folded into B
+    lora_ids: Optional[jnp.ndarray] = None,  # [B] int32 adapter slot per row
 ):
     """Serving forward over the paged KV pool (prefill chunks and decode steps
     share this one traced program per (B, T) bucket). With scales, the pool
@@ -361,7 +390,11 @@ def forward_paged(
 
     def step(carry, xs):
         hcur, kpf, vpf, ksf, vsf = carry
-        blk, li = xs
+        if lora is not None:
+            blk, li, lr = xs
+        else:
+            blk, li = xs
+            lr = None
         table = page_table + li * NP
         if cfg.mla:
             from rbg_tpu.ops.mla_attention import paged_mla_attention
@@ -374,19 +407,21 @@ def forward_paged(
                                            _mla_scale(cfg))
             attn = _mla_out(cfg, blk, attn_lat)
         else:
-            q, k, vv = _qkv(cfg, blk, hcur, positions)
+            q, k, vv = _qkv(cfg, blk, hcur, positions, lr, lora_ids)
             kpf, vpf, ksf, vsf = write_kv_pages(kpf, vpf, k, vv, table,
                                                 positions, token_mask,
                                                 ksf, vsf)
             attn = paged_attention(q, kpf, vpf, table, positions, kv_lens,
                                    use_pallas=use_pallas, k_scales=ksf,
                                    v_scales=vsf)
-        out = _post_attention(cfg, blk, hcur, attn)
+        out = _post_attention(cfg, blk, hcur, attn, lr, lora_ids)
         return (out, kpf, vpf, ksf, vsf), None
 
+    xs_in = (params["blocks"], jnp.arange(L_, dtype=jnp.int32))
+    if lora is not None:
+        xs_in = xs_in + (lora,)             # A/B carry leading L → scan-sliced
     (x, kpf, vpf, ksf, vsf), _ = jax.lax.scan(
-        step, (x, kpf, vpf, ksf, vsf),
-        (params["blocks"], jnp.arange(L_, dtype=jnp.int32)))
+        step, (x, kpf, vpf, ksf, vsf), xs_in)
     k_pages, v_pages = kpf.reshape(k_pages.shape), vpf.reshape(v_pages.shape)
     if quantized:
         k_scales = ksf.reshape(k_scales.shape)

@@ -1,0 +1,39 @@
+"""Serving benchmark harness: open-loop Poisson load, TTFT/ITL/e2e
+percentiles (sglang.bench_serving analog; BASELINE.json SLO shape)."""
+
+import argparse
+
+from rbg_tpu.engine.bench_serving import _percentile, main, run
+
+
+def test_percentile_edges():
+    assert _percentile([1.0], 50) == 1.0
+    assert _percentile([1.0, 2.0, 3.0], 0) == 1.0
+    assert _percentile([1.0, 2.0, 3.0], 100) == 3.0
+    assert str(_percentile([], 50)) == "nan"
+
+
+def test_inprocess_run_produces_slo_report():
+    args = argparse.Namespace(
+        requests=8, rate=64.0, input_len=8, output_len=8, model="tiny",
+        page_size=8, num_pages=128, max_seq_len=128, max_batch=8,
+        use_pallas="never", multi_step=1, speculative="off", addr="",
+        seed=0)
+    out = run(args)
+    assert out["completed"] == 8
+    assert out["output_tok_per_s"] > 0
+    for k in ("p50", "p90", "p99"):
+        assert out["ttft_s"][k] >= 0
+    assert out["e2e_s"]["p50"] > 0
+
+
+def test_cli_json_line(capsys):
+    rc = main(["--requests", "4", "--rate", "64", "--input-len", "8",
+               "--output-len", "4", "--model", "tiny", "--use-pallas",
+               "never", "--num-pages", "128", "--max-seq-len", "128",
+               "--json"])
+    assert rc == 0
+    import json
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(line)
+    assert parsed["completed"] == 4

@@ -109,9 +109,52 @@ class Handler(BaseHTTPRequestHandler):
             return self._complete(st, body, prompt, chat=False)
         if self.path == "/v1/chat/completions":
             messages = body.get("messages") or []
-            return self._complete(st, body, _chat_to_prompt(messages),
-                                  chat=True)
+            text = None
+            if hasattr(st.tokenizer, "apply_chat_template"):
+                try:
+                    # The model's OWN template when the tokenizer ships one.
+                    text = st.tokenizer.apply_chat_template(messages)
+                except Exception as e:  # jinja TemplateError/TypeError etc.
+                    return self._error(
+                        400, f"messages rejected by the model's chat "
+                             f"template: {e}")
+            if text is None:
+                text = _chat_to_prompt(messages)
+            return self._complete(st, body, text, chat=True)
+        if self.path == "/v1/embeddings":
+            return self._embeddings(st, body)
         return self._error(404, f"no route {self.path}")
+
+    def _embeddings(self, st: _State, body: dict):
+        inputs = body.get("input")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if (not isinstance(inputs, list) or not inputs
+                or not all(isinstance(s, str) and s for s in inputs)):
+            return self._error(400, "input must be a non-empty string or "
+                                    "non-empty list of non-empty strings")
+        if len(inputs) > 256:
+            return self._error(400, "input list too large (max 256 per "
+                                    "request)")
+        # Tokenize EDGE-side (same contract as completions — the wire stays
+        # token-ids; the backend's fallback tokenizer must never see text),
+        # and ship the whole batch as ONE op → one batched forward.
+        prompts = [st.tokenizer.encode(s, add_bos=False) for s in inputs]
+        try:
+            resp, _, _ = request_once(st.backend,
+                                      {"op": "embed", "prompts": prompts},
+                                      timeout=300)
+        except OSError as e:
+            return self._error(502, f"backend: {e}", "server_error")
+        if resp is None or "error" in (resp or {}):
+            return self._error(502, (resp or {}).get("error", "no response"),
+                               "server_error")
+        total = sum(len(p) for p in prompts)
+        data = [{"object": "embedding", "index": i, "embedding": v}
+                for i, v in enumerate(resp["embeddings"])]
+        return self._json(200, {
+            "object": "list", "model": st.model, "data": data,
+            "usage": {"prompt_tokens": total, "total_tokens": total}})
 
     # ---- completion core ----
 

@@ -103,6 +103,14 @@ class Handler(socketserver.BaseRequestHandler):
                     "metrics": state.metrics,
                 })
                 continue
+            if op == "embed":
+                try:
+                    addr = self._pick_worker(state)
+                    resp, _, _ = request_once(addr, obj)
+                    send_msg(self.request, resp or {"error": "no response"})
+                except Exception as e:
+                    send_msg(self.request, {"error": f"embed: {e}"})
+                continue
             if op != "generate":
                 send_msg(self.request, {"error": f"router: unsupported op {op!r}"})
                 continue
@@ -145,6 +153,11 @@ class Handler(socketserver.BaseRequestHandler):
                 if key in obj:
                     fwd[key] = obj[key]
             return state.pick("decode"), (fwd, kb, vb)
+        return self._pick_worker(state), (obj, None, None)
+
+    @staticmethod
+    def _pick_worker(state: RouterState) -> str:
+        """A unified-engine backend (embed / non-PD generate)."""
         worker = state.pick("worker") or state.pick("server")
         if worker is None:
             # fall back to any non-router role present
@@ -156,7 +169,7 @@ class Handler(socketserver.BaseRequestHandler):
                     break
         if worker is None:
             raise RuntimeError("no backends available")
-        return worker, (obj, None, None)
+        return worker
 
     def _generate(self, state: RouterState, obj: dict) -> dict:
         t0 = time.perf_counter()

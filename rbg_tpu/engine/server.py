@@ -183,6 +183,38 @@ class Handler(socketserver.BaseRequestHandler):
                 resp["logprobs"] = p.logprobs
             send_msg(self.request, resp)
             return
+        if op == "embed":
+            # Any engine mode serves embeddings — prefill/decode roles hold
+            # the same weights, so a PD group's edge works too.
+            eng = None
+            if srv.service is not None:
+                eng = srv.service.engine
+            elif srv.prefill is not None:
+                eng = srv.prefill.engine
+            elif srv.decode is not None:
+                eng = srv.decode.engine
+            if eng is None:
+                send_msg(self.request, {"error": "engine not ready"})
+                return
+            tok = srv.tokenizer
+            if "prompts" in obj:
+                prompts = [list(p) for p in obj["prompts"]]
+            elif "text" in obj:
+                prompts = [tok.encode(obj["text"], add_bos=False)]
+            else:
+                prompts = [list(obj.get("prompt") or [])]
+            from rbg_tpu.engine.service import embed_prompts
+            try:
+                vecs = embed_prompts(eng, prompts)
+            except ValueError as e:
+                send_msg(self.request, {"error": str(e)})
+                return
+            send_msg(self.request, {
+                "embeddings": vecs, "dim": len(vecs[0]),
+                "prompt_tokens": sum(len(p) for p in prompts),
+                # single-prompt back-compat field
+                "embedding": vecs[0]})
+            return
         if op == "prefill" and srv.prefill is not None:
             try:
                 sampling = SamplingParams.from_wire(obj)

@@ -34,6 +34,72 @@ class _Pending:
 DEFAULT_TIMEOUT_S = 600.0
 
 
+def embed_prompts(engine: Engine, prompts: List[List[int]]) -> List[List[float]]:
+    """Mean-pooled final-norm hidden states, ONE batched forward for the
+    whole list (encode_hidden is [B, T]-shaped; a per-string forward would
+    cost B serial dispatches). Pads (B, T) to (chunk-multiple) buckets and
+    caches one jitted program per bucket on the engine. Safe to call from
+    server threads — reads engine.params only (jit dispatch is
+    thread-safe; no queue state is touched)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    for p in prompts:
+        engine._check_prompt(p)
+        if len(p) > engine.cfg.max_seq_len:
+            raise ValueError(f"prompt ({len(p)} tokens) exceeds "
+                             f"max_seq_len {engine.cfg.max_seq_len}")
+    out: List[List[float]] = []
+    for lo in range(0, len(prompts), EMBED_MAX_BATCH):
+        out.extend(_embed_batch(engine, prompts[lo:lo + EMBED_MAX_BATCH]))
+    return out
+
+
+# Per-forward row cap: bounds activation memory and the (B, T) compile
+# variety to the same order as the serving path (engine batches are capped
+# by cfg); larger request lists chunk through this.
+EMBED_MAX_BATCH = 32
+
+
+def _embed_batch(engine: Engine, prompts: List[List[int]]) -> List[List[float]]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    chunk = engine.cfg.prefill_chunk
+    longest = max(len(p) for p in prompts)
+    T = max(chunk, ((longest + chunk - 1) // chunk) * chunk)
+    B = 1
+    while B < len(prompts):
+        B *= 2
+    cache = getattr(engine, "_embed_cache", None)
+    if cache is None:
+        cache = engine._embed_cache = {}
+    fn = cache.get((B, T))
+    if fn is None:
+        from rbg_tpu.models.llama import encode_hidden
+        mcfg = engine.mcfg
+
+        def pooled(params, toks, mask):
+            # Pool in f32: bf16 models would accumulate the D-sum AND the
+            # token count in bf16 (counts are exact only to 256 — long
+            # prompts would mean-pool with the wrong divisor).
+            h = encode_hidden(params, mcfg, toks, mask).astype(jnp.float32)
+            m = mask[:, :, None].astype(jnp.float32)
+            return (h * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+
+        fn = cache[(B, T)] = jax.jit(pooled)
+    toks = np.zeros((B, T), np.int32)
+    mask = np.zeros((B, T), bool)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+        mask[i, :len(p)] = True
+    vecs = np.asarray(fn(engine.params, jnp.asarray(toks),
+                         jnp.asarray(mask)), np.float32)
+    return [vecs[i].tolist() for i in range(len(prompts))]
+
+
 class _BatchService:
     """Shared loop: subclasses implement ``_admit(item, sampling) -> rid``
     (raising on bad input fails just that request) and expose ``engine``."""
@@ -165,6 +231,10 @@ class EngineService(_BatchService):
         """Blocking generate. Returns (tokens, ttft_seconds)."""
         p = self.submit_wait(prompt, sampling, timeout)
         return p.tokens, self.ttft(p)
+
+    def embed(self, prompt: List[int]) -> List[float]:
+        """Mean-pooled final-norm hidden state for one prompt."""
+        return embed_prompts(self.engine, [prompt])[0]
 
     def stats(self) -> dict:
         out = dict(self.engine.metrics)

@@ -46,6 +46,15 @@ class HFTokenizer:
     def decode(self, ids: List[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
+    def apply_chat_template(self, messages: List[dict]) -> Optional[str]:
+        """Render messages with the model's own chat template (returns None
+        when the tokenizer ships no template — caller falls back to the
+        plain role-tagged form)."""
+        if not getattr(self._tok, "chat_template", None):
+            return None
+        return self._tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=True)
+
 
 class IncrementalDetokenizer:
     """Streaming token→text decoding that never emits half a character.

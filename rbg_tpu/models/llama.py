@@ -446,10 +446,20 @@ def forward_train(
     standard deep-stack training memory lever; activations per layer drop
     from O(B·T·(D+F+heads·T)) to the block boundary only).
     """
+    return _head(params, cfg,
+                 _encode_core(params, cfg, tokens, token_mask, mesh, remat,
+                              final_norm=False))
+
+
+def _encode_core(params, cfg, tokens, token_mask, mesh=None, remat=False,
+                 final_norm=True):
+    """Shared cache-free causal body (training AND embeddings paths — one
+    copy of the embed → scan-over-blocks → norm pipeline)."""
     B, T = tokens.shape
     if token_mask is None:
         token_mask = jnp.ones((B, T), bool)
-    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    positions = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
 
     use_ring = (
         mesh is not None
@@ -479,7 +489,21 @@ def forward_train(
         return body(h, blk), None
 
     x, _ = jax.lax.scan(step, x, params["blocks"])
-    return _head(params, cfg, x)
+    if final_norm:
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return x
+
+
+def encode_hidden(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,                       # [B, T] int32
+    token_mask: Optional[jnp.ndarray] = None,  # [B, T] bool
+) -> jnp.ndarray:
+    """Cache-free causal forward returning the FINAL-NORM hidden states
+    [B, T, D] (no LM head) — the embeddings/representation path
+    (/v1/embeddings pools these; reference engines expose the same)."""
+    return _encode_core(params, cfg, tokens, token_mask)
 
 
 def prefill_and_decode_greedy(params, cfg, prompt, steps: int):

@@ -1,0 +1,158 @@
+"""Embeddings path (/v1/embeddings analog) + HF chat-template rendering.
+
+Reference engines expose embeddings endpoints alongside generation; ours
+mean-pools the final-norm hidden states (models/llama.py encode_hidden)
+through a dedicated jitted program on the serving engine."""
+
+import numpy as np
+import pytest
+
+from rbg_tpu.engine import EngineConfig
+from rbg_tpu.engine.service import EngineService
+from rbg_tpu.engine.tokenizer import HFTokenizer
+
+
+def _svc(**kw):
+    return EngineService(EngineConfig(model="tiny", page_size=8,
+                                      num_pages=64, max_seq_len=128,
+                                      use_pallas="never", **kw))
+
+
+def test_embed_shape_and_determinism():
+    svc = _svc()
+    try:
+        v1 = svc.embed([1, 2, 3, 4, 5])
+        v2 = svc.embed([1, 2, 3, 4, 5])
+        assert len(v1) == 128               # tiny hidden_size
+        assert v1 == v2
+        assert any(abs(x) > 0 for x in v1)
+        v3 = svc.embed([9, 8, 7])
+        assert v3 != v1
+    finally:
+        svc.stop()
+
+
+def test_embed_padding_invariant():
+    # The same prompt must pool to the same vector regardless of the
+    # chunk bucket it gets padded to (mask-correct pooling).
+    a, b = _svc(prefill_chunk=16), _svc(prefill_chunk=64)
+    try:
+        va = np.asarray(a.embed([1, 2, 3, 4, 5]))
+        vb = np.asarray(b.embed([1, 2, 3, 4, 5]))
+        assert np.max(np.abs(va - vb)) < 1e-4
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_embed_rejects_bad_prompts():
+    svc = _svc()
+    try:
+        with pytest.raises(ValueError, match="vocab"):
+            svc.embed([99999])
+        with pytest.raises(ValueError, match="empty"):
+            svc.embed([])
+        with pytest.raises(ValueError, match="max_seq_len"):
+            svc.embed(list(range(1, 200)))
+    finally:
+        svc.stop()
+
+
+def test_hf_chat_template_render_and_fallback():
+    tok = HFTokenizer("tests/fixtures/tiny_hf_tokenizer")
+    msgs = [{"role": "user", "content": "hi"}]
+    assert tok.apply_chat_template(msgs) is None   # fixture has none
+    tok._tok.chat_template = (
+        "{% for m in messages %}<|{{ m.role }}|>{{ m.content }}"
+        "{% endfor %}{% if add_generation_prompt %}<|assistant|>{% endif %}")
+    assert tok.apply_chat_template(msgs) == "<|user|>hi<|assistant|>"
+
+
+@pytest.mark.e2e
+def test_embeddings_over_http():
+    import json
+    import socket
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    from rbg_tpu.engine.protocol import request_once
+    from rbg_tpu.utils import scrubbed_cpu_env
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    env = scrubbed_cpu_env()
+    ep, hp = free_port(), free_port()
+    env["RBG_SERVE_PORT"] = str(ep)
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "rbg_tpu.engine.server", "--model", "tiny",
+         "--vocab-size", "512", "--page-size", "8", "--num-pages", "64",
+         "--max-seq-len", "128", "--use-pallas", "never"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)]
+    try:
+        deadline = time.monotonic() + 240
+        while True:
+            try:
+                h, _, _ = request_once(f"127.0.0.1:{ep}", {"op": "health"},
+                                       timeout=2)
+                if h and h.get("ok"):
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < deadline, "server never healthy"
+            time.sleep(0.3)
+        # wire op
+        r, _, _ = request_once(f"127.0.0.1:{ep}",
+                               {"op": "embed", "prompt": [1, 2, 3]},
+                               timeout=180)
+        assert r["dim"] == 128 and len(r["embedding"]) == 128
+        # HTTP edge
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "rbg_tpu.engine.http_frontend",
+             "--port", str(hp), "--host", "127.0.0.1",
+             "--backend", f"127.0.0.1:{ep}", "--model", "tiny"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{hp}/healthz", timeout=3) as resp:
+                    if json.loads(resp.read()).get("ok"):
+                        break
+            except Exception:
+                pass
+            assert time.monotonic() < deadline
+            time.sleep(0.3)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{hp}/v1/embeddings", method="POST",
+            data=json.dumps({"input": ["hello", "world"]}).encode(),
+            headers={"Content-Type": "application/json"})
+        body = json.loads(urllib.request.urlopen(req, timeout=300).read())
+        assert body["object"] == "list" and len(body["data"]) == 2
+        assert len(body["data"][0]["embedding"]) == 128
+        assert body["data"][0]["embedding"] != body["data"][1]["embedding"]
+        assert body["usage"]["prompt_tokens"] == len("hello") + len("world")
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait()
+
+
+def test_embed_batched_matches_singles_and_chunks():
+    from rbg_tpu.engine.service import EMBED_MAX_BATCH, embed_prompts
+    svc = _svc()
+    try:
+        prompts = [[i + 1, i + 2, i + 3] for i in range(EMBED_MAX_BATCH + 3)]
+        batch = embed_prompts(svc.engine, prompts)   # chunks internally
+        assert len(batch) == len(prompts)
+        for i in (0, EMBED_MAX_BATCH - 1, EMBED_MAX_BATCH + 2):
+            solo = embed_prompts(svc.engine, [prompts[i]])[0]
+            assert np.max(np.abs(np.asarray(solo)
+                                 - np.asarray(batch[i]))) < 1e-4
+    finally:
+        svc.stop()

@@ -183,20 +183,23 @@ class Engine:
         """Register a LoRA adapter for per-request batched serving.
 
         ``adapter``: {target: (A [L, d_in, r], B [L, r, d_out])} for any of
-        wq/wk/wv/wo (+ w_gate/w_up/w_down on dense-MLP models). All loaded
+        wq/wk/wv/wo (GQA) or wq/w_dkv/wo (MLA), plus w_gate/w_up/w_down on
+        dense-MLP models. All loaded
         adapters are stacked (rank-padded, alpha/r folded into B
         per-target) into one [L, n, ...] array set so a single compiled
         program serves every batch mix — per-row adapter gather inside the
         jitted step (punica/S-LoRA), no recompile per adapter."""
-        if self.mcfg.mla:
-            raise NotImplementedError(
-                "LoRA serving targets dense/GQA projections; MLA adapter "
-                "mapping (wq/w_uk/w_uv) is not wired yet")
         if not adapter:
             raise ValueError("empty adapter")
         if name in self._lora_slots:
             raise ValueError(f"adapter {name!r} already loaded")
-        allowed = set(self._LORA_ATTN_TARGETS)
+        if self.mcfg.mla:
+            # MLA: LoRA targets the PLAIN input projections + output;
+            # the absorbed per-head up-projections (w_uk/w_uv) are not
+            # adapter targets.
+            allowed = {"wq", "w_dkv", "wo"}
+        else:
+            allowed = set(self._LORA_ATTN_TARGETS)
         if self.mcfg.num_experts == 0:
             allowed |= set(self._LORA_MLP_TARGETS)
         L = self.mcfg.num_layers

@@ -166,22 +166,25 @@ def _qkv(cfg: ModelConfig, blk, x, positions, lora=None, lora_ids=None):
     return q, k, vv
 
 
-def _mla_qkv(cfg: ModelConfig, blk, x, positions):
+def _mla_qkv(cfg: ModelConfig, blk, x, positions, lora=None, lora_ids=None):
     """MLA pre-attention math in the absorbed form: norm → q projection
     (split nope/rope, absorb W_uk into q) → latent down-projection
     (+kv-norm) and shared RoPE key. Returns (q_lat [B,T,h,dc],
-    q_pe [B,T,h,dr], c [B,T,dc], k_pe [B,T,dr])."""
+    q_pe [B,T,h,dr], c [B,T,dc], k_pe [B,T,dr]). LoRA applies to the
+    plain input projections (wq, w_dkv); the absorbed up-projections
+    (w_uk/w_uv) are not adapter targets."""
     B, T, _ = x.shape
     h = cfg.num_heads
     dc, dn, dr = cfg.kv_lora_rank, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
     xa = rms_norm(x, blk["attn_norm"], cfg.rms_norm_eps)
-    q = (xa @ blk["wq"]).reshape(B, T, h, dn + dr)
+    q = _lora_proj(xa, blk["wq"], "wq", lora, lora_ids)
+    q = q.reshape(B, T, h, dn + dr)
     q_nope, q_pe = q[..., :dn], q[..., dn:]
     q_pe = apply_rope(q_pe, positions, cfg.rope_theta)
     # Absorb: q_lat·c == q_nope·(c @ W_uk) — per-head K never materializes.
     w_uk = blk["w_uk"].reshape(dc, h, dn)
     q_lat = jnp.einsum("bthn,chn->bthc", q_nope, w_uk)
-    kv = xa @ blk["w_dkv"]                                   # [B, T, dc+dr]
+    kv = _lora_proj(xa, blk["w_dkv"], "w_dkv", lora, lora_ids)  # [B,T,dc+dr]
     c = rms_norm(kv[..., :dc], blk["kv_norm"], cfg.rms_norm_eps)
     k_pe = apply_rope(kv[..., None, dc:], positions, cfg.rope_theta)[:, :, 0]
     return q_lat, q_pe, c, k_pe
@@ -398,7 +401,8 @@ def forward_paged(
         table = page_table + li * NP
         if cfg.mla:
             from rbg_tpu.ops.mla_attention import paged_mla_attention
-            q_lat, q_pe, c, k_pe = _mla_qkv(cfg, blk, hcur, positions)
+            q_lat, q_pe, c, k_pe = _mla_qkv(cfg, blk, hcur, positions,
+                                            lr, lora_ids)
             kpf, vpf, ksf, vsf = write_kv_pages(
                 kpf, vpf, c[:, :, None, :], k_pe[:, :, None, :], table,
                 positions, token_mask, ksf, vsf)

@@ -148,8 +148,9 @@ def test_load_lora_validation():
     with pytest.raises(ValueError, match="already loaded"):
         eng.load_lora("x", _adapter(1))
     mla = Engine(EngineConfig(model="tiny-mla", **BASE_KW))
-    with pytest.raises(NotImplementedError, match="MLA"):
-        mla.load_lora("x", _adapter(0))
+    with pytest.raises(ValueError, match="unsupported target"):
+        mla.load_lora("x", {"wk": (np.zeros((2, 128, 4), np.float32),
+                                   np.zeros((2, 4, 64), np.float32))})
 
 
 def test_pd_disagg_carries_adapter():
@@ -317,3 +318,38 @@ def test_runtime_load_lora_does_not_drop_inflight_tokens():
         if steps == 3:
             eng.load_lora("late", _adapter(9), alpha=8.0)
     assert out == ref
+
+
+
+def test_mla_lora_matches_merged_weights():
+    """MLA adapters (wq / w_dkv / wo) must match the merged-weights
+    reference exactly — _post_attention and _mla_qkv both thread LoRA."""
+    mla_cfg = get_config("tiny-mla")
+    mla_params = init_params(mla_cfg, jax.random.key(2))
+    rng = np.random.default_rng(11)
+    ad = {}
+    for tgt in ("wq", "w_dkv", "wo"):
+        _, d_in, d_out = mla_params["blocks"][tgt].shape
+        ad[tgt] = (rng.normal(size=(mla_cfg.num_layers, d_in, 4))
+                   .astype(np.float32) * 0.05,
+                   rng.normal(size=(mla_cfg.num_layers, 4, d_out))
+                   .astype(np.float32) * 0.05)
+    merged = dict(mla_params)
+    mb = dict(merged["blocks"])
+    for tgt, (A, B) in ad.items():
+        mb[tgt] = mb[tgt] + (8.0 / 4) * jnp.einsum(
+            "ldr,lro->ldo", jnp.asarray(A), jnp.asarray(B))
+    merged["blocks"] = mb
+    ref = Engine(EngineConfig(model="tiny-mla", **BASE_KW),
+                 params=merged).generate(
+        [PROMPT], SamplingParams(max_new_tokens=8))[0]
+    eng = Engine(EngineConfig(model="tiny-mla", **BASE_KW),
+                 params=mla_params)
+    eng.load_lora("m", ad, alpha=8.0)
+    got = eng.generate([PROMPT],
+                       SamplingParams(max_new_tokens=8, lora="m"))[0]
+    base = eng.generate([PROMPT], SamplingParams(max_new_tokens=8))[0]
+    assert got == ref
+    assert base == Engine(EngineConfig(model="tiny-mla", **BASE_KW),
+                          params=mla_params).generate(
+        [PROMPT], SamplingParams(max_new_tokens=8))[0]

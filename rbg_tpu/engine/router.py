@@ -1,14 +1,32 @@
 """Router process — the front role of a PD-disagg group.
 
 Reference analog: the sglang-router role in ``examples/inference/
-pd-disagg-*.yaml`` (router → prefill → decode with startup dependencies).
-Discovers its backends from the address registry the executor maintains
-(or static ``--backends``):
+pd-disagg-*.yaml`` (router → prefill → decode with startup dependencies;
+the deployed router is cache-aware and fault-tolerant). Discovers its
+backends from the address registry the executor maintains (or static
+``--backends``):
 
 * registry entries carry the role name, so PD mode switches on automatically
   when ``prefill`` and ``decode`` roles exist: prefill op → KV bundle over
   the wire → decode_bundle op on a decode peer (Mooncake-style transfer).
-* otherwise round-robins ``generate`` over unified workers.
+* otherwise routes ``generate`` over unified workers.
+
+Resilience (reference parity with the deployed sglang-router):
+
+* **least-outstanding-requests** backend choice per role (ties broken
+  least-recently-picked), not blind round-robin;
+* **health eviction**: a connect/transport failure evicts the backend with
+  exponential backoff (1 s → 15 s); a background prober health-checks
+  evicted backends every 500 ms and re-admits on first success;
+* **failover retries**: every leg is idempotent here — prefill re-runs on a
+  sibling, decode_bundle re-sends the held KV bundle, unified generate
+  re-submits — so a dead backend never surfaces as a client error while a
+  sibling lives;
+* **deterministic replay**: sampled requests without a client seed get a
+  router-assigned one, so a mid-stream failover re-runs the identical
+  token stream on the sibling (position-keyed PRNG: randomness is
+  f(seed, position)) and the router resumes the client stream exactly
+  where it broke — already-delivered tokens are skipped, never replayed.
 """
 
 from __future__ import annotations
@@ -16,12 +34,19 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
+import socket
 import socketserver
 import sys
+import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from rbg_tpu.engine.protocol import recv_msg, request_once, send_msg
+
+MAX_ATTEMPTS = 3          # distinct backends tried per leg
+CONNECT_TIMEOUT_S = 5.0   # fast failure detection on the connect
+STREAM_TIMEOUT_S = 300.0  # per-recv budget once streaming
 
 
 class Registry:
@@ -50,7 +75,7 @@ class Registry:
         """Addresses for a role. When the role's service declares LeaderOnly
         (KEP-260 sharedServiceSelection, carried into registry entries), only
         instance leaders are addressed — one endpoint per multi-host
-        instance; the default (All) round-robins every pod."""
+        instance; the default (All) addresses every pod."""
         all_, leaders, leader_only = [], [], False
         for fqdn, e in sorted(self.entries().items()):
             if e.get("role") == role and (group is None or e.get("group") == group):
@@ -61,23 +86,138 @@ class Registry:
         return (leaders or all_) if leader_only else all_
 
 
+class _BackendState:
+    __slots__ = ("outstanding", "fails", "down_until", "last_pick")
+
+    def __init__(self):
+        self.outstanding = 0
+        self.fails = 0
+        self.down_until = 0.0
+        self.last_pick = 0
+
+
+class BackendPool:
+    """Health + load bookkeeping for backend addresses.
+
+    Selection is least-outstanding-requests over healthy backends (ties:
+    least recently picked). A transport failure evicts the address with
+    exponential backoff; recovery re-admits it (via the prober, or lazily
+    when the backoff expires). When EVERY candidate is evicted the
+    soonest-to-recover one is still returned — total eviction must degrade
+    to "keep trying", not a hard outage."""
+
+    EVICT_BASE_S = 1.0
+    EVICT_MAX_S = 15.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._st: Dict[str, _BackendState] = {}
+        self._seq = 0
+
+    def _state(self, addr: str) -> _BackendState:
+        st = self._st.get(addr)
+        if st is None:
+            st = self._st[addr] = _BackendState()
+        return st
+
+    def order(self, addrs: List[str]) -> List[str]:
+        """Candidates in try-order: healthy by (outstanding, last_pick),
+        then evicted by soonest recovery."""
+        now = time.monotonic()
+        with self._lock:
+            healthy, down = [], []
+            for i, a in enumerate(addrs):
+                st = self._state(a)
+                if st.down_until > now:
+                    down.append((st.down_until, i, a))
+                else:
+                    healthy.append((st.outstanding, st.last_pick, i, a))
+            healthy.sort()
+            down.sort()
+            out = [t[-1] for t in healthy] + [t[-1] for t in down]
+            if out:
+                self._seq += 1
+                self._st[out[0]].last_pick = self._seq
+            return out
+
+    def acquire(self, addr: str) -> None:
+        with self._lock:
+            self._state(addr).outstanding += 1
+
+    def release(self, addr: str) -> None:
+        with self._lock:
+            st = self._state(addr)
+            st.outstanding = max(0, st.outstanding - 1)
+
+    def ok(self, addr: str) -> None:
+        with self._lock:
+            st = self._state(addr)
+            st.fails = 0
+            st.down_until = 0.0
+
+    def fail(self, addr: str) -> None:
+        with self._lock:
+            st = self._state(addr)
+            st.fails += 1
+            backoff = min(self.EVICT_BASE_S * (2 ** (st.fails - 1)),
+                          self.EVICT_MAX_S)
+            st.down_until = time.monotonic() + backoff
+
+    def evicted(self) -> List[str]:
+        now = time.monotonic()
+        with self._lock:
+            return [a for a, st in self._st.items() if st.down_until > now]
+
+    def probe(self, timeout: float = 1.0) -> List[str]:
+        """Health-check every evicted backend; re-admit responders.
+        Returns the re-admitted addresses."""
+        readmitted = []
+        for addr in self.evicted():
+            try:
+                resp, _, _ = request_once(addr, {"op": "health"},
+                                          timeout=timeout)
+            except (OSError, ConnectionError, json.JSONDecodeError):
+                continue
+            if resp and resp.get("ok"):
+                self.ok(addr)
+                readmitted.append(addr)
+        return readmitted
+
+    def retain(self, live) -> None:
+        """Drop state for addresses no longer in the registry (pod churn
+        mints a new address per replacement — without pruning, a long-lived
+        router's state and health payload grow monotonically). In-flight
+        entries are kept until their requests drain."""
+        with self._lock:
+            for a in list(self._st):
+                if a not in live and self._st[a].outstanding == 0:
+                    del self._st[a]
+
+    def snapshot(self) -> Dict[str, dict]:
+        now = time.monotonic()
+        with self._lock:
+            return {a: {"outstanding": st.outstanding, "fails": st.fails,
+                        "down_for_s": round(max(0.0, st.down_until - now), 3)}
+                    for a, st in self._st.items()}
+
+
 class RouterState:
     def __init__(self, registry: Registry, group: Optional[str],
                  static_backends: Optional[dict] = None):
         self.registry = registry
         self.group = group
         self.static = static_backends or {}
-        self._rr = {}
+        self.pool = BackendPool()
         self.metrics = {"requests": 0, "pd_requests": 0, "errors": 0,
-                        "kv_bytes_routed": 0}
+                        "retries": 0, "failovers": 0, "kv_bytes_routed": 0}
 
-    def pick(self, role: str) -> Optional[str]:
+    def candidates(self, role: str) -> List[str]:
         backends = self.static.get(role) or self.registry.backends(role, self.group)
-        if not backends:
-            return None
-        i = self._rr.get(role, 0)
-        self._rr[role] = i + 1
-        return backends[i % len(backends)]
+        live = {a for addrs in self.static.values() for a in addrs}
+        live.update(e["addr"] for e in self.registry.entries().values()
+                    if "addr" in e)
+        self.pool.retain(live)
+        return self.pool.order(list(backends))
 
     def pd_mode(self) -> bool:
         return bool(
@@ -85,9 +225,70 @@ class RouterState:
             and (self.static.get("decode") or self.registry.backends("decode", self.group))
         )
 
+    def worker_role(self) -> str:
+        """The unified-engine role (embed / non-PD generate)."""
+        for role in ("worker", "server"):
+            if self.static.get(role) or self.registry.backends(role, self.group):
+                return role
+        roles = {e.get("role") for e in self.registry.entries().values()}
+        roles |= set(self.static)
+        roles.discard("router")
+        roles.discard(None)
+        for r in sorted(roles):
+            if self.static.get(r) or self.registry.backends(r, self.group):
+                return r
+        raise RuntimeError("no backends available")
+
+    def call(self, role: str, obj: dict, k_bytes=None, v_bytes=None,
+             timeout: float = 120.0) -> Tuple[str, dict, bytes, bytes]:
+        """One blocking request with failover across the role's backends.
+        Transport failures (connect refused, peer closed) evict + retry on
+        a sibling; application errors pass through untouched."""
+        cands = self.candidates(role)
+        if not cands:
+            raise RuntimeError(f"no {role} backends available")
+        last: Optional[Exception] = None
+        for i, addr in enumerate(cands[:MAX_ATTEMPTS]):
+            if i:
+                self.metrics["retries"] += 1
+            self.pool.acquire(addr)
+            try:
+                resp, rk, rv = request_once(addr, obj, k_bytes, v_bytes,
+                                            timeout=timeout)
+            except (OSError, ConnectionError, json.JSONDecodeError) as e:
+                self.pool.fail(addr)
+                last = e
+                continue
+            finally:
+                self.pool.release(addr)
+            if resp is None:
+                self.pool.fail(addr)
+                last = RuntimeError(f"{addr} closed connection")
+                continue
+            self.pool.ok(addr)
+            if i:
+                self.metrics["failovers"] += 1
+            return addr, resp, rk, rv
+        raise RuntimeError(
+            f"all {role} backends failed (tried {min(len(cands), MAX_ATTEMPTS)}): {last}")
+
+
+class _ClientGone(Exception):
+    """The CLIENT socket failed mid-relay. Deliberately NOT an OSError
+    subclass: the failover loop catches transport errors and charges them
+    to the backend — a vanished client must neither evict a healthy
+    backend nor trigger a pointless replay on a sibling."""
+
 
 class Handler(socketserver.BaseRequestHandler):
     def handle(self):
+        try:
+            self._serve_connection()
+        except _ClientGone:
+            # Routine client disconnect — not a router error, no traceback.
+            return
+
+    def _serve_connection(self):
         state: RouterState = self.server.state
         while True:
             try:
@@ -98,36 +299,56 @@ class Handler(socketserver.BaseRequestHandler):
                 return
             op = obj.get("op")
             if op == "health":
-                send_msg(self.request, {
+                self._send_client({
                     "ok": True, "pd": state.pd_mode(),
                     "metrics": state.metrics,
+                    "backends": state.pool.snapshot(),
                 })
                 continue
             if op == "embed":
+                state.metrics["requests"] += 1
                 try:
-                    addr = self._pick_worker(state)
-                    resp, _, _ = request_once(addr, obj)
-                    send_msg(self.request, resp or {"error": "no response"})
+                    _, resp, _, _ = state.call(state.worker_role(), obj)
                 except Exception as e:
-                    send_msg(self.request, {"error": f"embed: {e}"})
+                    state.metrics["errors"] += 1
+                    resp = {"error": f"embed: {e}"}
+                self._send_client(resp)
                 continue
             if op != "generate":
-                send_msg(self.request, {"error": f"router: unsupported op {op!r}"})
+                self._send_client({"error": f"router: unsupported op {op!r}"})
                 continue
             try:
                 if obj.get("stream"):
                     self._generate_stream(state, obj)
                 else:
-                    send_msg(self.request, self._generate(state, obj))
+                    resp = self._generate(state, obj)
+                    self._send_client(resp)
+            except _ClientGone:
+                raise
             except Exception as e:
                 state.metrics["errors"] += 1
-                send_msg(self.request, {"error": str(e), "done": True})
+                self._send_client({"error": str(e), "done": True})
+
+    @staticmethod
+    def _pin_seed(obj: dict) -> dict:
+        """Sampled requests without a client seed get a router-assigned one
+        BEFORE any backend sees the request, so a failover re-run produces
+        the identical stream (position-keyed PRNG: tokens are f(seed,
+        position), independent of which replica computes them)."""
+        if float(obj.get("temperature", 0.0) or 0.0) > 0.0 \
+                and obj.get("seed") is None:
+            obj = dict(obj)
+            obj["seed"] = random.getrandbits(31)
+        return obj
 
     def _route(self, state: RouterState, obj: dict):
-        """Resolve the backend leg shared by blocking and streaming paths.
-        PD mode runs the (always blocking) prefill hop here; returns
-        (addr, (header, k_bytes, v_bytes)) for the final leg."""
+        """Resolve the final leg shared by blocking and streaming paths.
+        PD mode runs the (always blocking, failover-wrapped) prefill hop
+        here; returns (role, (header, k_bytes, v_bytes)) for the leg the
+        caller owns — the caller can re-send that payload to any sibling of
+        ``role``, which is what makes decode failover possible."""
         state.metrics["requests"] += 1
+        obj = self._pin_seed(obj)
         if state.pd_mode():
             state.metrics["pd_requests"] += 1
             # Forward sampling fields: the FIRST token is sampled by the
@@ -140,8 +361,8 @@ class Handler(socketserver.BaseRequestHandler):
                         "stop_token"):
                 if key in obj:
                     pf_req[key] = obj[key]
-            hdr, kb, vb = request_once(state.pick("prefill"), pf_req)
-            if hdr is None or "error" in hdr:
+            _, hdr, kb, vb = state.call("prefill", pf_req)
+            if "error" in hdr:
                 raise RuntimeError(f"prefill failed: {hdr}")
             state.metrics["kv_bytes_routed"] += len(kb or b"") + len(vb or b"")
             fwd = dict(hdr)
@@ -152,61 +373,131 @@ class Handler(socketserver.BaseRequestHandler):
                         "lora", "stop_token", "stream"):
                 if key in obj:
                     fwd[key] = obj[key]
-            return state.pick("decode"), (fwd, kb, vb)
-        return self._pick_worker(state), (obj, None, None)
-
-    @staticmethod
-    def _pick_worker(state: RouterState) -> str:
-        """A unified-engine backend (embed / non-PD generate)."""
-        worker = state.pick("worker") or state.pick("server")
-        if worker is None:
-            # fall back to any non-router role present
-            roles = {e.get("role") for e in state.registry.entries().values()}
-            roles.discard("router")
-            for r in sorted(roles):
-                worker = state.pick(r)
-                if worker:
-                    break
-        if worker is None:
-            raise RuntimeError("no backends available")
-        return worker
+            return "decode", (fwd, kb, vb)
+        return state.worker_role(), (obj, None, None)
 
     def _generate(self, state: RouterState, obj: dict) -> dict:
         t0 = time.perf_counter()
         pd = state.pd_mode()
-        addr, payload = self._route(state, obj)
-        resp, _, _ = request_once(addr, *payload)
-        if resp is None:
-            raise RuntimeError("backend closed connection")
+        role, payload = self._route(state, obj)
+        _, resp, _, _ = state.call(role, *payload)
         if pd:
             if "error" in resp:
                 raise RuntimeError(f"decode failed: {resp}")
             resp["ttft_s"] = time.perf_counter() - t0
         return resp
 
-
     def _generate_stream(self, state: RouterState, obj: dict) -> None:
-        """Streaming generate: relay incremental token frames from the
-        backend to the client (feeds the SSE front end). PD mode streams
-        the decode leg; the prefill leg is one blocking hop (its product is
-        the first token + KV)."""
-        import socket as _socket
-        addr, payload = self._route(state, obj)
+        """Streaming generate with mid-stream failover: relay incremental
+        token frames from the backend to the client (feeds the SSE front
+        end). PD mode streams the decode leg; the prefill leg is one
+        blocking hop (its product is the first token + KV).
+
+        If the backend dies mid-stream, the SAME payload is re-sent to a
+        sibling (the router still holds the KV bundle / the request), and
+        the replayed stream — identical because the seed is pinned — is
+        relayed with the already-delivered token prefix skipped. The
+        client never sees the failure."""
+        role, payload = self._route(state, obj)
+        delivered = 0                  # tokens already relayed to the client
+        last: Optional[Exception] = None
+        for attempt in range(MAX_ATTEMPTS):
+            cands = state.candidates(role)
+            if not cands:
+                break
+            addr = cands[0]
+            if attempt:
+                state.metrics["retries"] += 1
+            state.pool.acquire(addr)
+            try:
+                delivered, finished = self._relay_attempt(
+                    addr, payload, delivered)
+            finally:
+                state.pool.release(addr)
+            if finished:
+                state.pool.ok(addr)
+                if attempt:
+                    state.metrics["failovers"] += 1
+                return
+            # Backend closed mid-stream without a done frame.
+            state.pool.fail(addr)
+            last = RuntimeError(f"{addr} closed mid-stream")
+        state.metrics["errors"] += 1
+        self._send_client({
+            "error": f"all {role} backends failed mid-stream: {last}",
+            "done": True})
+
+    def _send_client(self, frame: dict) -> None:
+        try:
+            send_msg(self.request, frame)
+        except OSError as e:
+            raise _ClientGone(str(e)) from e
+
+    def _relay_attempt(self, addr: str, payload, delivered: int):
+        """One streaming attempt against ``addr``. Relays frames to the
+        client, skipping the first ``delivered`` tokens (already sent by a
+        previous attempt — deterministic replay makes them identical).
+        Returns (new_delivered, finished) — BACKEND transport failures
+        (abrupt reset, mid-frame close, recv timeout) are absorbed here so
+        the tokens relayed before the failure are never lost from the
+        count (a raise would discard the local and make the retry replay
+        them as duplicates). Client-side send failures raise _ClientGone,
+        which aborts the request without charging the backend."""
         host, port = addr.rsplit(":", 1)
-        with _socket.create_connection((host, int(port)), timeout=300) as s:
-            send_msg(s, *payload)
-            while True:
-                frame, _, _ = recv_msg(s)
-                if frame is None:
-                    raise RuntimeError("backend closed mid-stream")
-                send_msg(self.request, frame)
-                if frame.get("done") or "error" in frame:
-                    return
+        skip = delivered
+        try:
+            with socket.create_connection((host, int(port)),
+                                          timeout=CONNECT_TIMEOUT_S) as s:
+                s.settimeout(STREAM_TIMEOUT_S)
+                send_msg(s, *payload)
+                while True:
+                    frame, _, _ = recv_msg(s)
+                    if frame is None:
+                        return delivered, False   # died mid-stream
+                    if "error" in frame:
+                        # Application error — not a transport failure; the
+                        # engine is healthy and answered. Pass through.
+                        self._send_client(frame)
+                        return delivered, True
+                    tokens = frame.get("tokens") or []
+                    drop = min(skip, len(tokens))
+                    if drop:
+                        skip -= drop
+                        frame = dict(frame)
+                        frame["tokens"] = tokens[drop:]
+                        if "logprobs" in frame:
+                            frame["logprobs"] = frame["logprobs"][drop:]
+                        tokens = frame["tokens"]
+                    if tokens or frame.get("done"):
+                        self._send_client(frame)
+                        delivered += len(tokens)
+                    if frame.get("done"):
+                        return delivered, True
+        except (OSError, ConnectionError, json.JSONDecodeError):
+            # JSONDecodeError = garbage frame from a version-mismatched or
+            # corrupt backend — same class as a transport failure (probe()
+            # classifies it identically): fail over, don't surface it.
+            return delivered, False
 
 
 class RouterServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+
+
+def start_prober(state: RouterState, interval_s: float = 0.5) -> threading.Thread:
+    """Background re-admission: health-check evicted backends so recovery
+    is noticed in ~interval_s instead of waiting out the backoff."""
+    def loop():
+        while True:
+            time.sleep(interval_s)
+            try:
+                state.pool.probe()
+            except Exception:
+                pass
+    t = threading.Thread(target=loop, daemon=True, name="router-prober")
+    t.start()
+    return t
 
 
 def main(argv=None) -> int:
@@ -222,6 +513,7 @@ def main(argv=None) -> int:
     static = json.loads(args.backends) if args.backends else None
     server = RouterServer(("127.0.0.1", port), Handler)
     server.state = RouterState(Registry(args.registry), args.group, static)
+    start_prober(server.state)
     print(f"router listening on 127.0.0.1:{port} group={args.group}", flush=True)
     server.serve_forever()
     return 0

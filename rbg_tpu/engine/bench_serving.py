@@ -61,17 +61,12 @@ def _drive_inprocess(args, prompts, arrivals):
         # Honest prefills: warmup prompts must not seed a prefix cache the
         # measured requests then hit.
         enable_radix_cache=False))
-    # Warm EVERY decode bucket up to max_batch (a full concurrent batch
-    # drains through all smaller buckets as requests finish), so measured
-    # TTFT/ITL excludes XLA compilation. Warmup prompts are distinct from
-    # the measured set.
-    import numpy as np
-    wrng = np.random.default_rng(args.seed + 10_000)
-    warm = [svc.submit_async(
-        wrng.integers(200, 250, size=args.input_len).tolist(),
-        SamplingParams(max_new_tokens=4)) for _ in range(args.max_batch)]
-    for p in warm:
-        svc.wait(p, 600.0)
+    # Compile every jit bucket variant up front (prefill B, finish-sample
+    # Bs, decode B — one wave per bucket size), so measured TTFT/ITL
+    # excludes XLA compilation. Full-batch draining alone is NOT enough:
+    # a bucket first hit mid-measurement was observed as a 9x throughput
+    # swing between identical runs.
+    svc.warmup(args.input_len)
 
     results = [_Result() for _ in prompts]
     lock = threading.Lock()

@@ -148,3 +148,13 @@ class SamplingParams:
             sp.stop_token = stop_token
         sp.validate()
         return sp
+
+
+def warm_prompt(input_len: int, wave: int = 0, row: int = 0) -> list:
+    """Deterministic warmup prompt, distinct per (wave, row) — identical
+    prompts would radix-hit and skip the very prefill shapes warmup exists
+    to compile. Token ids stay in [1, 200): inside every preset's vocab
+    and clear of special ids. The ONE generator for all warmup paths
+    (EngineService / DecodeService / PrefillWorker)."""
+    base = (wave * 131 + row * 17) % 199
+    return [1 + (base + j) % 199 for j in range(input_len)]

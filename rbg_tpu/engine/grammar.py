@@ -20,15 +20,21 @@ host-side ALONG THE DRAFT PATH — the mask at position i+1 assumes drafts
 grammar constraints and speculative decoding compose without
 approximation (a draft token the grammar forbids truncates the draft).
 
-Complexity note: ``mask`` probes every vocab token's bytes per step —
-exact and cheap for the byte tokenizer (V=256); for 100k-token HF vocabs
-a production deployment wants a precompiled token trie (xgrammar-style).
-The seam is ``TokenGrammar``: swap the probe loop for a compiled table
-without touching the engine.
+Complexity note: ``mask`` walks a precompiled byte-path TRIE over the
+vocabulary (xgrammar-style): the automaton advances once per trie NODE,
+so tokens sharing a prefix share the walk and an illegal first byte
+prunes its whole subtree — O(legal byte paths) per step instead of
+O(total vocab bytes). Masks are additionally memoized per automaton
+state (states recur heavily: a long string interior, number digits, the
+AFTER-value gap all map to one state each), so steady-state decoding
+costs a dict hit + memcpy. Exactness is preserved — the probe loop
+survives as ``_mask_probe`` and tests assert trie == probe on every
+state they visit.
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -227,6 +233,34 @@ class JsonGrammar:
         return False
 
 
+class TokenTrie:
+    """Byte-path trie over a token→bytes table, compiled once per
+    tokenizer (the xgrammar move). Nodes are parallel lists:
+    ``children[n]`` maps byte→child node, ``tokens[n]`` lists the token
+    ids whose byte string ends at node n."""
+
+    __slots__ = ("children", "tokens", "total_bytes")
+
+    def __init__(self, token_bytes: List[Optional[bytes]]):
+        self.children: List[dict] = [{}]
+        self.tokens: List[list] = [[]]
+        self.total_bytes = 0
+        for tid, bs in enumerate(token_bytes):
+            if not bs:
+                continue
+            self.total_bytes += len(bs)
+            n = 0
+            for b in bs:
+                nxt = self.children[n].get(b)
+                if nxt is None:
+                    nxt = len(self.children)
+                    self.children[n][b] = nxt
+                    self.children.append({})
+                    self.tokens.append([])
+                n = nxt
+            self.tokens[n].append(tid)
+
+
 class TokenGrammar:
     """Lift a byte grammar over a token→bytes table.
 
@@ -234,12 +268,22 @@ class TokenGrammar:
     tokens that must never appear inside constrained output (specials).
     ``eos_id`` is allowed exactly when the value is complete."""
 
+    # Steady-state decoding revisits a few dozen states (string interior,
+    # number digits, AFTER-gap, one per stack depth); masks are cached
+    # bit-PACKED (V/8 bytes each) so even a full cache at a 100k vocab is
+    # ~3 MB, not ~25 MB of bool arrays.
+    MASK_CACHE_SIZE = 256
+
     def __init__(self, grammar: JsonGrammar, token_bytes: List[Optional[bytes]],
                  eos_id: Optional[int]):
         self.grammar = grammar
         self.token_bytes = token_bytes
         self.eos_id = eos_id
         self.V = len(token_bytes)
+        self.trie = TokenTrie(token_bytes)
+        self._mask_cache: "OrderedDict[State, np.ndarray]" = OrderedDict()
+        self.stats = {"mask_calls": 0, "mask_cache_hits": 0,
+                      "advance_calls": 0}
 
     def initial(self) -> State:
         return self.grammar.initial()
@@ -257,7 +301,44 @@ class TokenGrammar:
         return state
 
     def mask(self, state: State) -> np.ndarray:
-        """[V] bool — tokens legal from ``state`` (EOS iff complete)."""
+        """[V] bool — tokens legal from ``state`` (EOS iff complete).
+        Trie-walked and per-state memoized; callers own the returned
+        array (a copy — masks are row-assigned into batch buffers)."""
+        self.stats["mask_calls"] += 1
+        cached = self._mask_cache.get(state)
+        if cached is not None:
+            self.stats["mask_cache_hits"] += 1
+            self._mask_cache.move_to_end(state)
+            return np.unpackbits(cached, count=self.V).astype(bool)
+        out = np.zeros(self.V, bool)
+        children = self.trie.children
+        tokens = self.trie.tokens
+        adv = self.grammar.advance
+        n_adv = 0
+        stack = [(0, state)]
+        while stack:
+            node, st = stack.pop()
+            for b, child in children[node].items():
+                n_adv += 1
+                ns = adv(st, b)
+                if ns is None:
+                    continue
+                toks = tokens[child]
+                if toks:
+                    out[toks] = True
+                if children[child]:
+                    stack.append((child, ns))
+        self.stats["advance_calls"] += n_adv
+        if self.eos_id is not None and self.eos_id < self.V:
+            out[self.eos_id] = self.grammar.is_complete(state)
+        self._mask_cache[state] = np.packbits(out)
+        if len(self._mask_cache) > self.MASK_CACHE_SIZE:
+            self._mask_cache.popitem(last=False)
+        return out
+
+    def _mask_probe(self, state: State) -> np.ndarray:
+        """Reference implementation: probe every token's bytes from
+        ``state``. O(total vocab bytes) — kept for exactness tests."""
         out = np.zeros(self.V, bool)
         adv = self.grammar.advance
         for i, bs in enumerate(self.token_bytes):

@@ -61,6 +61,22 @@ class PrefillWorker:
                         "pool_hits": 0, "pool_hit_tokens": 0,
                         "pool_exports": 0, "pool_errors": 0}
 
+    def warmup(self, input_len: int = 32) -> float:
+        """Compile the prefill + bundle-export path (jit variants keyed on
+        chunk/bucket shapes and the export gather on the page count)
+        before traffic — same rationale as ``_BatchService.warmup``. The
+        shared pool is bypassed: warmup KV must not pollute the
+        cross-replica prefix store."""
+        from rbg_tpu.engine.config import warm_prompt
+
+        t0 = time.perf_counter()
+        pool, self.pool = self.pool, None
+        try:
+            self.prefill(warm_prompt(input_len))
+        finally:
+            self.pool = pool
+        return time.perf_counter() - t0
+
     def prefill(self, prompt: List[int],
                 sampling: Optional[SamplingParams] = None) -> KVBundle:
         """Run one prompt to its first token; export KV pages."""

@@ -125,6 +125,28 @@ class Handler(socketserver.BaseRequestHandler):
             ready = srv.service is not None or srv.prefill is not None or srv.decode is not None
             send_msg(self.request, {"ok": ready, "mode": srv.mode})
             return
+        if op == "warmup":
+            # Compile every jit bucket variant NOW (one blocking op per
+            # serving pod, before it takes traffic) instead of stalling
+            # live requests at first variant hit. The serving-SLO analog
+            # of the control plane's warmup pods (SURVEY #9).
+            import time as _time
+            t0 = _time.perf_counter()
+            n = int(obj.get("input_len", 32))
+            if srv.service is not None:
+                srv.service.warmup(n)
+            elif srv.prefill is not None:
+                with srv.pd_lock:
+                    srv.prefill.warmup(n)
+            elif srv.decode is not None:
+                srv.decode.warmup(n)
+            else:
+                send_msg(self.request, {"error": "engine not ready"})
+                return
+            send_msg(self.request, {
+                "ok": True,
+                "elapsed_s": round(_time.perf_counter() - t0, 2)})
+            return
         if op == "metrics":
             stats = {}
             if srv.service is not None:

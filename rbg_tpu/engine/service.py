@@ -129,6 +129,49 @@ class _BatchService:
         self._wake.set()
         return p
 
+    def submit_wave(self, items) -> List[_Pending]:
+        """Atomically enqueue ``[(item, sampling), ...]`` so one loop
+        iteration admits them together (up to max_batch) — warmup needs a
+        deterministic batch composition, not whatever interleaving the
+        wake races produce."""
+        ps = []
+        with self._lock:
+            for item, sampling in items:
+                p = _Pending()
+                self._queue.append((item, sampling, p))
+                ps.append(p)
+        self._wake.set()
+        return ps
+
+    def _bucket_sizes(self) -> List[int]:
+        eng = self.engine
+        return sorted({eng._bucket(b)
+                       for b in range(1, eng.cfg.max_batch + 1)},
+                      reverse=True)
+
+    def warmup(self, input_len: int = 32, out_len: int = 2) -> float:
+        """Compile every decode/prefill bucket jit variant before traffic.
+
+        A variant first hit mid-serving stalls EVERY in-flight request for
+        the compile (seconds on CPU, tens of seconds at real model sizes) —
+        measured here as a 9x throughput swing between identical bench
+        runs, and the gap the reference closes with warmup pods
+        (``rolebasedgroup_controller.go`` buildWarmupPod:535; our control
+        plane's warmup controller readies images, this readies the jit
+        cache). One wave per bucket size, largest first, through the
+        normal submit path. Returns elapsed seconds."""
+        t0 = time.monotonic()
+        for B in self._bucket_sizes():
+            items = [(self._warm_item(input_len, B, i),
+                      SamplingParams(max_new_tokens=out_len))
+                     for i in range(B)]
+            for p in self.submit_wave(items):
+                self.wait(p, 600.0)
+        return time.monotonic() - t0
+
+    def _warm_item(self, input_len: int, wave: int, row: int):
+        raise NotImplementedError
+
     def wait(self, p: _Pending, timeout: float) -> List[int]:
         if not p.done.wait(timeout):
             self.cancel(p)  # recycle batch slot + KV pages, don't orphan
@@ -226,6 +269,10 @@ class EngineService(_BatchService):
     def _admit(self, prompt, sampling: SamplingParams) -> Optional[int]:
         return self.engine.add_request(prompt, sampling)
 
+    def _warm_item(self, input_len: int, wave: int, row: int):
+        from rbg_tpu.engine.config import warm_prompt
+        return warm_prompt(input_len, wave, row)
+
     def submit(self, prompt: List[int], sampling: SamplingParams,
                timeout: float = DEFAULT_TIMEOUT_S) -> Tuple[List[int], float]:
         """Blocking generate. Returns (tokens, ttft_seconds)."""
@@ -263,6 +310,27 @@ class DecodeService(_BatchService):
         if req is None or req.state == "finished":
             return None  # completed at inject (max_new_tokens == 1 / stop)
         return rid
+
+    def _warm_item(self, input_len: int, wave: int, row: int):
+        """A zero-KV bundle with the serving page count: compiles the
+        inject scatter (keyed on n_pages) and the decode buckets. Numerics
+        are irrelevant to compilation; the zero pages are released when
+        the warm request finishes."""
+        import numpy as np
+
+        from rbg_tpu.engine.kvcache import pages_for_tokens
+        from rbg_tpu.engine.pd import KVBundle
+
+        from rbg_tpu.engine.config import warm_prompt
+
+        eng = self.engine
+        L, _, page, KV, hd = eng.cache.k_pages.shape
+        n_pages = pages_for_tokens(input_len, eng.cfg.page_size)
+        shape = (L, n_pages, page, KV, hd)
+        dt = np.dtype(eng.cache.k_pages.dtype)
+        return KVBundle(prompt=warm_prompt(input_len, wave, row),
+                        first_token=1,
+                        k_data=np.zeros(shape, dt), v_data=np.zeros(shape, dt))
 
     def submit_bundle(self, bundle, sampling: SamplingParams,
                       timeout: float = DEFAULT_TIMEOUT_S) -> List[int]:

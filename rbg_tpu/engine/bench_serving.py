@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import threading
 import time
 from typing import List, Optional
@@ -131,11 +132,14 @@ def _drive_remote(args, prompts, arrivals):
         t0 = time.perf_counter()
         try:
             host, port = args.addr.rsplit(":", 1)
+            req = {"op": "generate", "prompt": prompts[i],
+                   "max_new_tokens": args.output_len, "stream": True}
+            token = getattr(args, "token", None)
+            if token:
+                req["token"] = token
             with socket.create_connection((host, int(port)),
                                           timeout=300) as s:
-                send_msg(s, {"op": "generate", "prompt": prompts[i],
-                             "max_new_tokens": args.output_len,
-                             "stream": True})
+                send_msg(s, req)
                 last = t0
                 while True:
                     frame, _, _ = recv_msg(s)
@@ -230,6 +234,9 @@ def main(argv=None) -> int:
     ap.add_argument("--addr", default="",
                     help="benchmark a remote engine/router instead of "
                          "in-process (host:port)")
+    ap.add_argument("--token", default=os.environ.get("RBG_DATA_TOKEN", ""),
+                    help="data-plane bearer token for --addr targets "
+                         "(default: $RBG_DATA_TOKEN)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", action="store_true",
                     help="print one JSON line instead of the table")

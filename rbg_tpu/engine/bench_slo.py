@@ -127,10 +127,18 @@ class _Topology:
 
         from rbg_tpu.engine.protocol import request_once
         import numpy as np
+        token = os.environ.get("RBG_DATA_TOKEN") or None
+
+        def req(extra):
+            # Token-gated deployments (RBG_DATA_TOKEN set) must be
+            # benchmarkable — attach the same credential the topology's
+            # own processes inherited from this environment.
+            return {**extra, "token": token} if token else extra
+
         for port in self.engine_ports:
-            resp, _, _ = request_once(f"127.0.0.1:{port}",
-                                      {"op": "warmup",
-                                       "input_len": input_len}, timeout=900)
+            resp, _, _ = request_once(
+                f"127.0.0.1:{port}",
+                req({"op": "warmup", "input_len": input_len}), timeout=900)
             if not (resp or {}).get("ok"):
                 raise RuntimeError(f"warmup failed on :{port}: {resp}")
         rng = np.random.default_rng(987)
@@ -139,8 +147,8 @@ class _Topology:
             prompt = rng.integers(200, 250, size=input_len).tolist()
             t = threading.Thread(
                 target=lambda p=prompt: request_once(
-                    self.addr, {"op": "generate", "prompt": p,
-                                "max_new_tokens": 4}, timeout=600),
+                    self.addr, req({"op": "generate", "prompt": p,
+                                    "max_new_tokens": 4}), timeout=600),
                 daemon=True)
             t.start()
             threads.append(t)
@@ -181,6 +189,7 @@ def measure(kind: str, rates: List[float], args, env) -> List[dict]:
                 num_pages=args.num_pages, max_seq_len=args.max_seq_len,
                 max_batch=args.max_batch, use_pallas=args.use_pallas,
                 multi_step=1, speculative="off", addr=topo.addr,
+                token=os.environ.get("RBG_DATA_TOKEN", ""),
                 seed=args.seed, json=True)
             load1 = os.getloadavg()[0]
             out = bench_serving.run(bargs)

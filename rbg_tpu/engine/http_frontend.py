@@ -51,6 +51,14 @@ class _State:
         self.model = args.model
         self.tokenizer = load_tokenizer(args.tokenizer_path or None)
         self.default_max_tokens = args.default_max_tokens
+        # Data-plane bearer token attached to every backend call when the
+        # serving wire is token-gated (RBG_DATA_TOKEN; VERDICT r4 #6).
+        self.data_token = os.environ.get("RBG_DATA_TOKEN") or None
+
+    def backend_req(self, req: dict) -> dict:
+        if self.data_token:
+            req["token"] = self.data_token
+        return req
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -142,7 +150,8 @@ class Handler(BaseHTTPRequestHandler):
         prompts = [st.tokenizer.encode(s, add_bos=False) for s in inputs]
         try:
             resp, _, _ = request_once(st.backend,
-                                      {"op": "embed", "prompts": prompts},
+                                      st.backend_req({"op": "embed",
+                                                      "prompts": prompts}),
                                       timeout=300)
         except OSError as e:
             return self._error(502, f"backend: {e}", "server_error")
@@ -261,7 +270,8 @@ class Handler(BaseHTTPRequestHandler):
         if body.get("stream"):
             return self._stream(st, req, rid, created, chat, stops)
         try:
-            resp, _, _ = request_once(st.backend, req, timeout=300)
+            resp, _, _ = request_once(st.backend, st.backend_req(req),
+                                      timeout=300)
         except OSError as e:
             return self._error(502, f"backend: {e}", "server_error")
         if resp is None or "error" in (resp or {}):
@@ -380,7 +390,7 @@ class Handler(BaseHTTPRequestHandler):
 
         try:
             with conn:
-                send_msg(conn, req)
+                send_msg(conn, st.backend_req(req))
                 while True:
                     frame, _, _ = recv_msg(conn)
                     if frame is None:

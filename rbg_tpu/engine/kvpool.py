@@ -24,21 +24,31 @@ Pieces:
 
 Transfer format matches ``pd.KVBundle`` framing: one contiguous K block +
 one V block per message (``protocol.send_msg`` binary lanes).
+
+Wire security (flag-gated, VERDICT r4 #6): ``--auth-token`` (env
+``RBG_DATA_TOKEN``) requires a shared bearer token on every data op
+(``health`` stays open for liveness probes), and ``--cert-dir`` wraps the
+listener in TLS using the same self-signed CA bootstrap as the admin
+wire (``runtime/tlsutil.ensure_certs``). Clients pass ``token=`` /
+``ca_path=``. Without the flags the wire is open — the NetworkPolicy in
+``deploy/k8s/rbg-tpu.yaml`` is then the only fence (documented there).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import socket
 import socketserver
+import ssl
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from rbg_tpu.engine.protocol import recv_msg, send_msg
+from rbg_tpu.engine.protocol import recv_msg, send_msg, token_ok
 
 
 class _Node:
@@ -175,7 +185,26 @@ class KVPoolStore:
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        # TLS wraps PER CONNECTION on the worker thread, never on the
+        # accept loop — a wrapped listener would run the handshake inside
+        # serve_forever, letting one silent peer (port scanner, half-open
+        # flow) freeze every prefill replica's pool access (same pattern
+        # as the admin wire, runtime/admin.py).
+        ctx = self.server.ssl_context
+        self._tls_failed = False
+        if ctx is not None:
+            self.request.settimeout(10.0)  # bound the handshake
+            try:
+                self.request = ctx.wrap_socket(self.request, server_side=True)
+            except OSError:  # ssl.SSLError / timeout / reset — drop peer
+                self._tls_failed = True
+                return
+            self.request.settimeout(None)
+
     def handle(self):
+        if self._tls_failed:
+            return
         store: KVPoolStore = self.server.store
         while True:
             try:
@@ -197,6 +226,14 @@ class _Handler(socketserver.BaseRequestHandler):
 
     def _dispatch(self, store, obj, k, v):
         op = obj.get("op")
+        token = self.server.auth_token
+        if token and op != "health":
+            # Shared-token gate on every data op: an unauthenticated peer
+            # must neither read KV (match leaks computed activations) nor
+            # poison the store (put). Constant-time compare.
+            if not token_ok(obj.get("token"), token):
+                send_msg(self.request, {"error": "unauthorized"})
+                return
         ps = obj.get("page_size")
         if (op in ("pool_match", "pool_put") and ps is not None
                 and ps != store.page_size):
@@ -234,9 +271,13 @@ class KVPoolServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, addr, store: KVPoolStore):
+    def __init__(self, addr, store: KVPoolStore,
+                 auth_token: Optional[str] = None,
+                 ssl_context: Optional[ssl.SSLContext] = None):
         super().__init__(addr, _Handler)
         self.store = store
+        self.auth_token = auth_token
+        self.ssl_context = ssl_context
 
 
 class KVPoolClient:
@@ -244,18 +285,35 @@ class KVPoolClient:
     rare relative to decode steps: once per admitted prompt)."""
 
     def __init__(self, addr: str, timeout: float = 30.0,
-                 page_size: Optional[int] = None):
+                 page_size: Optional[int] = None,
+                 token: Optional[str] = None,
+                 ca_path: Optional[str] = None):
         host, port = addr.rsplit(":", 1)
+        self.host = host
         self.addr = (host, int(port))
         self.timeout = timeout
         self.page_size = page_size   # engine's page size; server verifies
+        self.token = (token if token is not None
+                      else os.environ.get("RBG_DATA_TOKEN") or None)
+        self._ssl = None
+        if ca_path:
+            from rbg_tpu.runtime.tlsutil import client_context
+            self._ssl = client_context(ca_path)
 
     def _roundtrip(self, obj, k=None, v=None):
         if self.page_size is not None:
             obj["page_size"] = self.page_size
-        with socket.create_connection(self.addr, timeout=self.timeout) as s:
-            send_msg(s, obj, k, v)
-            resp = recv_msg(s)
+        if self.token:
+            obj["token"] = self.token
+        with socket.create_connection(self.addr, timeout=self.timeout) as raw:
+            if self._ssl is not None:
+                with self._ssl.wrap_socket(raw,
+                                           server_hostname=self.host) as s:
+                    send_msg(s, obj, k, v)
+                    resp = recv_msg(s)
+            else:
+                send_msg(raw, obj, k, v)
+                resp = recv_msg(raw)
         if resp[0] is None:
             # EOF without a reply (pool restarting / handler died):
             # RuntimeError keeps this inside the callers' degrade path.
@@ -294,10 +352,26 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=9100)
     ap.add_argument("--page-size", type=int, default=16)
     ap.add_argument("--max-bytes", type=int, default=1 << 30)
+    ap.add_argument("--auth-token",
+                    default=os.environ.get("RBG_DATA_TOKEN", ""),
+                    help="require this bearer token on every data op "
+                         "(default: $RBG_DATA_TOKEN; empty = open wire)")
+    ap.add_argument("--cert-dir", default="",
+                    help="serve TLS with certs from this dir (bootstrapped "
+                         "via runtime.tlsutil.ensure_certs, same CA "
+                         "machinery as the admin wire)")
     args = ap.parse_args(argv)
     store = KVPoolStore(args.page_size, max_bytes=args.max_bytes)
-    srv = KVPoolServer(("0.0.0.0", args.port), store)
-    print(f"kv-pool serving on :{args.port}", flush=True)
+    ctx = None
+    if args.cert_dir:
+        from rbg_tpu.runtime.tlsutil import ensure_certs, server_context
+        _ca, cert, key = ensure_certs(args.cert_dir)
+        ctx = server_context(cert, key)
+    srv = KVPoolServer(("0.0.0.0", args.port), store,
+                       auth_token=args.auth_token or None, ssl_context=ctx)
+    print(f"kv-pool serving on :{args.port}"
+          f"{' [tls]' if ctx else ''}{' [auth]' if args.auth_token else ''}",
+          flush=True)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:

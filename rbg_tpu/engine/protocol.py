@@ -99,6 +99,16 @@ def recv_msg(sock: socket.socket) -> Tuple[Optional[dict], Optional[bytes], Opti
     return obj, k, v
 
 
+def token_ok(presented, expected) -> bool:
+    """Constant-time bearer-token compare for the data-plane gates
+    (engine server / router / kv pool). Compares utf-8 BYTES:
+    ``hmac.compare_digest`` raises TypeError on non-ASCII str operands
+    (admin.py documents the same pitfall)."""
+    import hmac
+    return hmac.compare_digest(str(presented or "").encode("utf-8"),
+                               str(expected or "").encode("utf-8"))
+
+
 def bundle_to_wire(bundle) -> Tuple[dict, bytes, bytes]:
     header = {
         "prompt": bundle.prompt,

@@ -203,13 +203,25 @@ class BackendPool:
 
 class RouterState:
     def __init__(self, registry: Registry, group: Optional[str],
-                 static_backends: Optional[dict] = None):
+                 static_backends: Optional[dict] = None,
+                 token: Optional[str] = None):
         self.registry = registry
         self.group = group
         self.static = static_backends or {}
         self.pool = BackendPool()
+        # Shared data-plane bearer token (VERDICT r4 #6): when set, clients
+        # must present it and the router forwards it on every backend leg
+        # (one trust domain edge-to-engine; health stays open for probes).
+        self.token = token if token is not None \
+            else (os.environ.get("RBG_DATA_TOKEN") or None)
         self.metrics = {"requests": 0, "pd_requests": 0, "errors": 0,
                         "retries": 0, "failovers": 0, "kv_bytes_routed": 0}
+
+    def authorized(self, obj: dict) -> bool:
+        if not self.token:
+            return True
+        from rbg_tpu.engine.protocol import token_ok
+        return token_ok(obj.get("token"), self.token)
 
     def candidates(self, role: str) -> List[str]:
         backends = self.static.get(role) or self.registry.backends(role, self.group)
@@ -305,6 +317,9 @@ class Handler(socketserver.BaseRequestHandler):
                     "backends": state.pool.snapshot(),
                 })
                 continue
+            if op in ("embed", "generate") and not state.authorized(obj):
+                self._send_client({"error": "unauthorized", "done": True})
+                continue
             if op == "embed":
                 state.metrics["requests"] += 1
                 try:
@@ -358,7 +373,7 @@ class Handler(socketserver.BaseRequestHandler):
             for key in ("temperature", "top_k", "top_p", "min_p",
                         "repetition_penalty", "presence_penalty",
                         "frequency_penalty", "seed", "json_mode", "lora",
-                        "stop_token"):
+                        "stop_token", "token"):
                 if key in obj:
                     pf_req[key] = obj[key]
             _, hdr, kb, vb = state.call("prefill", pf_req)
@@ -370,7 +385,7 @@ class Handler(socketserver.BaseRequestHandler):
             for key in ("max_new_tokens", "temperature", "top_k", "top_p",
                         "min_p", "repetition_penalty", "presence_penalty",
                         "frequency_penalty", "seed", "logprobs", "json_mode",
-                        "lora", "stop_token", "stream"):
+                        "lora", "stop_token", "stream", "token"):
                 if key in obj:
                     fwd[key] = obj[key]
             return "decode", (fwd, kb, vb)
@@ -507,12 +522,17 @@ def main(argv=None) -> int:
     ap.add_argument("--group", default=os.environ.get("RBG_GROUP_NAME"))
     ap.add_argument("--backends", default="",
                     help='static JSON {"prefill": ["host:port"], ...}')
+    ap.add_argument("--auth-token", default="",
+                    help="require this bearer token on generate/embed and "
+                         "forward it on every backend leg (default: "
+                         "$RBG_DATA_TOKEN; empty = open wire)")
     args = ap.parse_args(argv)
     port = int(os.environ.get("RBG_SERVE_PORT")
                or os.environ.get("RBG_PORT_SERVE") or args.port)
     static = json.loads(args.backends) if args.backends else None
     server = RouterServer(("127.0.0.1", port), Handler)
-    server.state = RouterState(Registry(args.registry), args.group, static)
+    server.state = RouterState(Registry(args.registry), args.group, static,
+                               token=args.auth_token or None)
     start_prober(server.state)
     print(f"router listening on 127.0.0.1:{port} group={args.group}", flush=True)
     server.serve_forever()

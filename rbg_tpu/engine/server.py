@@ -125,6 +125,15 @@ class Handler(socketserver.BaseRequestHandler):
             ready = srv.service is not None or srv.prefill is not None or srv.decode is not None
             send_msg(self.request, {"ok": ready, "mode": srv.mode})
             return
+        if srv.auth_token and op != "metrics":
+            # Data-plane token gate (VERDICT r4 #6): prefill/decode_bundle
+            # carry KV activations, generate carries prompts — none of it
+            # for unauthenticated peers. health (above) stays open for
+            # probes; metrics too (scrape-friendly, numbers only).
+            from rbg_tpu.engine.protocol import token_ok
+            if not token_ok(obj.get("token"), srv.auth_token):
+                send_msg(self.request, {"error": "unauthorized"})
+                return
         if op == "warmup":
             # Compile every jit bucket variant NOW (one blocking op per
             # serving pod, before it takes traffic) instead of stalling
@@ -305,6 +314,8 @@ def serve(args) -> None:
     server = EngineServer(("127.0.0.1", port), Handler)
     server.mode = cfg.mode
     server.service = server.prefill = server.decode = None
+    server.auth_token = (args.auth_token
+                         or os.environ.get("RBG_DATA_TOKEN") or None)
     server.pd_lock = threading.Lock()
     from rbg_tpu.engine.tokenizer import ByteTokenizer
     server.tokenizer = ByteTokenizer()  # replaced by init_engine if HF given
@@ -338,7 +349,12 @@ def serve(args) -> None:
                     "RBG_KV_POOL_ADDR", "")
                 if pool_addr:
                     from rbg_tpu.engine.kvpool import KVPoolClient
-                    pool = KVPoolClient(pool_addr)
+                    pool = KVPoolClient(
+                        pool_addr,
+                        token=server.auth_token,
+                        ca_path=(args.kv_pool_ca
+                                 or os.environ.get("RBG_KV_POOL_CA")
+                                 or None))
                 server.prefill = PrefillWorker(cfg, pool=pool)
                 server.prefill.engine.enable_json_grammar(server.tokenizer)
                 load_adapters(server.prefill.engine)
@@ -390,6 +406,14 @@ def main(argv=None) -> int:
                     default=os.environ.get("RBG_KV_POOL_ADDR", ""),
                     help="host:port of the shared KV pool (prefill mode; "
                          "Mooncake-store analog, rbg_tpu.engine.kvpool)")
+    ap.add_argument("--kv-pool-ca", default="",
+                    help="CA cert path for a TLS kv-pool (default: "
+                         "$RBG_KV_POOL_CA; empty = plaintext)")
+    ap.add_argument("--auth-token", default="",
+                    help="require this bearer token on every data op "
+                         "(default: $RBG_DATA_TOKEN; empty = open wire). "
+                         "The same token authenticates this server's own "
+                         "kv-pool client calls.")
     ap.add_argument("--multi-step", type=int, default=1,
                     help="decode steps fused per device dispatch (lax.scan "
                          "window; higher = throughput, burstier streaming)")

@@ -1,0 +1,284 @@
+"""Data-plane wire security (VERDICT r4 #6): shared-token auth on the KV
+pool / engine / router sockets, TLS on the pool wire via the admin-wire CA
+machinery, and the pool-restart-mid-serving e2e (degrade to cold prefill,
+warm refill)."""
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rbg_tpu.engine.kvpool import KVPoolClient, KVPoolServer, KVPoolStore
+from rbg_tpu.engine.protocol import recv_msg, request_once, send_msg
+
+PS = 8
+
+
+def _pages(n, L=2, KV=2, hd=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(L, n, PS, KV, hd).astype(np.float32),
+            rng.randn(L, n, PS, KV, hd).astype(np.float32))
+
+
+def _serve(store=None, **kw):
+    srv = KVPoolServer(("127.0.0.1", 0), store or KVPoolStore(PS), **kw)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"127.0.0.1:{srv.server_address[1]}"
+
+
+# ---- token auth on the pool ----
+
+
+def test_pool_rejects_unauthenticated_writes_and_reads():
+    srv, addr = _serve(auth_token="s3cret")
+    try:
+        toks = list(range(PS))
+        k, v = _pages(1)
+        # No token: put and match both refused.
+        noauth = KVPoolClient(addr, page_size=PS, token="")
+        with pytest.raises(RuntimeError, match="unauthorized"):
+            noauth.put(toks, k, v)
+        with pytest.raises(RuntimeError, match="unauthorized"):
+            noauth.match(toks)
+        # Wrong token: refused.
+        wrong = KVPoolClient(addr, page_size=PS, token="nope")
+        with pytest.raises(RuntimeError, match="unauthorized"):
+            wrong.put(toks, k, v)
+        # Nothing was stored by the refused writes.
+        assert srv.store.stats()["pages"] == 0
+        # Right token: full round trip.
+        ok = KVPoolClient(addr, page_size=PS, token="s3cret")
+        assert ok.put(toks, k, v) == 1
+        m, km, _ = ok.match(toks)
+        assert m == PS
+        np.testing.assert_array_equal(km[:, 0], k[:, 0])
+        # Health stays open for probes.
+        h, _, _ = request_once(addr, {"op": "health"})
+        assert h["ok"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_non_ascii_tokens_compare_without_raising():
+    """hmac.compare_digest raises TypeError on non-ASCII str operands —
+    the shared gate must compare utf-8 bytes (admin.py documents the same
+    pitfall), so a unicode token neither crashes the handler nor leaks a
+    TypeError to the peer."""
+    from rbg_tpu.engine.protocol import token_ok
+
+    assert token_ok("café", "café")
+    assert not token_ok("café", "cafe")
+    assert not token_ok(None, "café")
+    srv, addr = _serve(auth_token="café")
+    try:
+        ok = KVPoolClient(addr, page_size=PS, token="café")
+        assert ok.put(list(range(PS)), *_pages(1)) == 1
+        bad = KVPoolClient(addr, page_size=PS, token="cafeéé")
+        with pytest.raises(RuntimeError, match="unauthorized"):
+            bad.match(list(range(PS)))
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_pool_open_wire_without_token_flag():
+    srv, addr = _serve()
+    try:
+        c = KVPoolClient(addr, page_size=PS, token="")
+        assert c.put(list(range(PS)), *_pages(1)) == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---- TLS on the pool wire ----
+
+
+def test_pool_tls_rejects_plaintext_and_serves_pinned_clients(tmp_path):
+    from rbg_tpu.runtime.tlsutil import ensure_certs, server_context
+
+    ca, cert, key = ensure_certs(str(tmp_path / "certs"))
+    srv, addr = _serve(ssl_context=server_context(cert, key))
+    try:
+        # Plaintext client: no reply (handshake fails server-side).
+        plain = KVPoolClient(addr, page_size=PS, timeout=2, token="")
+        with pytest.raises((RuntimeError, OSError)):
+            plain.put(list(range(PS)), *_pages(1))
+        assert srv.store.stats()["pages"] == 0
+        # Pinned-CA TLS client: works.
+        tls = KVPoolClient(addr, page_size=PS, token="", ca_path=ca)
+        assert tls.put(list(range(PS)), *_pages(1)) == 1
+        assert tls.match(list(range(PS)))[0] == PS
+        # A client pinning a DIFFERENT CA refuses the server.
+        other_ca, _, _ = ensure_certs(str(tmp_path / "other"))
+        bad = KVPoolClient(addr, page_size=PS, timeout=2, token="",
+                           ca_path=other_ca)
+        with pytest.raises((RuntimeError, OSError)):
+            bad.match(list(range(PS)))
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---- router token gate ----
+
+
+def test_router_requires_token_and_forwards_it():
+    from rbg_tpu.engine.router import Handler, Registry, RouterServer, RouterState
+
+    seen = []
+
+    class _Backend(__import__("socketserver").ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+        def __init__(self):
+            import socketserver
+
+            class H(socketserver.BaseRequestHandler):
+                def handle(self):
+                    while True:
+                        try:
+                            obj, _, _ = recv_msg(self.request)
+                        except (ConnectionError, json.JSONDecodeError):
+                            return
+                        if obj is None:
+                            return
+                        seen.append(obj)
+                        send_msg(self.request, {"tokens": [1]}
+                                 if obj.get("op") != "health"
+                                 else {"ok": True})
+
+            super().__init__(("127.0.0.1", 0), H)
+            self.addr = f"127.0.0.1:{self.server_address[1]}"
+            threading.Thread(target=self.serve_forever, daemon=True).start()
+
+    be = _Backend()
+    router = RouterServer(("127.0.0.1", 0), Handler)
+    router.state = RouterState(Registry(None), None, {"worker": [be.addr]},
+                               token="rt-token")
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    try:
+        addr = f"127.0.0.1:{router.server_address[1]}"
+        # No token → refused at the router, backend never sees it.
+        r, _, _ = request_once(addr, {"op": "generate", "prompt": [1]})
+        assert r["error"] == "unauthorized"
+        assert not [o for o in seen if o.get("op") == "generate"]
+        # With the token → forwarded to the backend verbatim.
+        r, _, _ = request_once(addr, {"op": "generate", "prompt": [1],
+                                      "token": "rt-token"})
+        assert r["tokens"] == [1]
+        fwd = [o for o in seen if o.get("op") == "generate"]
+        assert fwd and fwd[0]["token"] == "rt-token"
+        # Health stays open (the prober depends on it).
+        h, _, _ = request_once(addr, {"op": "health"})
+        assert h["ok"]
+    finally:
+        router.shutdown()
+        router.server_close()
+        be.stop() if hasattr(be, "stop") else (be.shutdown(), be.server_close())
+
+
+# ---- pool restart mid-serving e2e ----
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_ready(port, timeout=240.0, op="health"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            h, _, _ = request_once(f"127.0.0.1:{port}", {"op": op}, timeout=5)
+            if h and h.get("ok"):
+                return
+        except OSError:
+            pass
+        time.sleep(0.3)
+    raise TimeoutError(f"server on {port} never ready")
+
+
+@pytest.mark.e2e
+def test_pool_restart_mid_serving_degrades_then_refills():
+    """Kill the KV pool under a live token-gated prefill server: requests
+    must degrade to cold prefill (pool_errors counts them, no request
+    fails); after the pool restarts on the same address the worker
+    re-exports (warm refill) and subsequent identical prompts hit."""
+    from rbg_tpu.utils import scrubbed_cpu_env
+
+    token = "e2e-token"
+    env = scrubbed_cpu_env(extra={"RBG_SERVE_PORT": None,
+                                  "RBG_PORT_SERVE": None})
+    pool_port, pf_port = _free_port(), _free_port()
+    pool_cmd = [sys.executable, "-m", "rbg_tpu.engine.kvpool",
+                "--port", str(pool_port), "--page-size", str(PS),
+                "--auth-token", token]
+
+    def metrics():
+        m, _, _ = request_once(f"127.0.0.1:{pf_port}",
+                               {"op": "metrics"}, timeout=30)
+        return m["metrics"]
+
+    def prefill(prompt):
+        h, _, _ = request_once(
+            f"127.0.0.1:{pf_port}",
+            {"op": "prefill", "prompt": prompt, "token": token},
+            timeout=300)
+        assert "error" not in h, h
+        return h
+
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, 256, size=64).tolist()
+    pool = subprocess.Popen(pool_cmd, env=env)
+    server = subprocess.Popen(
+        [sys.executable, "-m", "rbg_tpu.engine.server",
+         "--mode", "prefill", "--port", str(pf_port),
+         "--model", "tiny", "--page-size", str(PS),
+         "--num-pages", "64", "--max-seq-len", "256",
+         "--prefill-chunk", "16", "--use-pallas", "never",
+         "--kv-pool", f"127.0.0.1:{pool_port}",
+         "--auth-token", token], env=env)
+    try:
+        _wait_ready(pool_port)
+        _wait_ready(pf_port)
+
+        h1 = prefill(prompt)
+        m = metrics()
+        assert m["pool_exports"] == 1 and m["pool_errors"] == 0
+
+        pool.kill()
+        pool.wait(timeout=10)
+        h2 = prefill(prompt)            # must succeed, cold
+        assert h2["first_token"] == h1["first_token"]
+        m = metrics()
+        assert m["pool_errors"] >= 1
+        assert m["pool_exports"] == 1   # nothing exported while down
+
+        pool = subprocess.Popen(pool_cmd, env=env)
+        _wait_ready(pool_port)
+        prefill(prompt)                 # warm refill: re-export
+        m = metrics()
+        assert m["pool_exports"] == 2
+
+        before = metrics()["prefill_tokens"]
+        prefill(prompt)                 # now a pool hit: minimal compute
+        m = metrics()
+        assert m["pool_hits"] >= 1
+        assert m["prefill_tokens"] - before <= 16  # last partial page only
+    finally:
+        for p in (pool, server):
+            p.terminate()
+        for p in (pool, server):
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -311,11 +311,16 @@ class Handler(socketserver.BaseRequestHandler):
                 return
             op = obj.get("op")
             if op == "health":
-                self._send_client({
-                    "ok": True, "pd": state.pd_mode(),
-                    "metrics": state.metrics,
-                    "backends": state.pool.snapshot(),
-                })
+                # Liveness itself stays unauthenticated, but on a
+                # token-gated router the metrics and the backend pool
+                # snapshot (internal topology addresses) are only for
+                # authenticated peers — health must not map the very
+                # fleet the token protects.
+                resp = {"ok": True, "pd": state.pd_mode()}
+                if state.authorized(obj):
+                    resp["metrics"] = state.metrics
+                    resp["backends"] = state.pool.snapshot()
+                self._send_client(resp)
                 continue
             if op in ("embed", "generate") and not state.authorized(obj):
                 self._send_client({"error": "unauthorized", "done": True})

@@ -14,11 +14,11 @@ URL is https.
 
 from __future__ import annotations
 
+import http.client
 import json
 import socket
-import urllib.error
+import threading
 import urllib.parse
-import urllib.request
 from typing import Dict, Iterator, List, Optional, Tuple
 
 
@@ -51,6 +51,7 @@ class KubeClient:
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self._local = threading.local()  # per-thread keep-alive connection
 
     # ---- plumbing ----
 
@@ -62,24 +63,64 @@ class KubeClient:
         h.update(extra or {})
         return h
 
+    def _conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            u = urllib.parse.urlparse(self.base_url)
+            cls = (http.client.HTTPSConnection if u.scheme == "https"
+                   else http.client.HTTPConnection)
+            conn = cls(u.hostname, u.port, timeout=self.timeout)
+            self._local.conn = conn
+        return conn
+
+    def _drop_conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._local.conn = None
+
     def request(self, method: str, path: str,
                 body: Optional[dict] = None,
                 params: Optional[Dict[str, str]] = None,
                 content_type: str = "application/json") -> dict:
-        url = self.base_url + path
+        """One API round trip over a PER-THREAD keep-alive connection
+        (a fresh TCP connect per call costs a server handler-thread spawn
+        each time — the dominant burst-scale overhead). A stale kept-alive
+        socket (server restarted / idle-closed) is retried once on a fresh
+        connection; HTTP errors are not retried."""
         if params:
-            url += "?" + urllib.parse.urlencode(params)
+            path += "?" + urllib.parse.urlencode(params)
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            url, data=data, method=method,
-            headers=self._headers({"Content-Type": content_type}))
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+        headers = self._headers({"Content-Type": content_type})
+        for attempt in (0, 1):
+            conn = self._conn()
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
                 payload = resp.read()
-        except urllib.error.HTTPError as e:
-            _raise(e.code, e.read().decode(errors="replace")[:400])
-        except (urllib.error.URLError, socket.timeout) as e:
-            raise ApiError(0, f"{type(e).__name__}: {e}")
+                status = resp.status
+                break
+            except (ConnectionError, http.client.RemoteDisconnected,
+                    http.client.CannotSendRequest) as e:
+                # Pre-response connection death — the idle keep-alive
+                # socket went stale. Retrying is safe-ish (the server may
+                # have executed a delivered non-idempotent request, which
+                # surfaces as a 409 the callers already handle). A
+                # TIMEOUT is deliberately NOT retried: the request may be
+                # mid-execution and a blind re-send would double it while
+                # doubling the latency of a down server.
+                self._drop_conn()
+                if attempt:
+                    raise ApiError(0, f"{type(e).__name__}: {e}")
+            except (socket.timeout, OSError,
+                    http.client.HTTPException) as e:
+                self._drop_conn()
+                raise ApiError(0, f"{type(e).__name__}: {e}")
+        if status >= 400:
+            _raise(status, payload.decode(errors="replace")[:400])
         return json.loads(payload) if payload else {}
 
     # ---- pods ----

@@ -64,8 +64,14 @@ class _State:
         self.rv = 0
         self.pods: Dict[Tuple[str, str], dict] = {}
         self.nodes: Dict[str, dict] = {}
-        # Watch replay log: (rv, type, snapshot). Bounded.
+        # Watch replay log: (rv, type, snapshot). Bounded — and when it
+        # trims, ``floor`` records the oldest retained rv so a watcher
+        # resuming from before the gap gets a 410-style ERROR (real
+        # apiserver semantics after etcd compaction) instead of silently
+        # missing events. Before the fix, a slow watcher at burst scale
+        # lost events with no signal at all.
         self.log: List[Tuple[int, str, dict]] = []
+        self.floor = 0
 
     def bump(self) -> str:
         self.rv += 1
@@ -74,9 +80,24 @@ class _State:
     def record(self, ev_type: str, obj: dict):
         self.log.append((int(obj["metadata"]["resourceVersion"]),
                          ev_type, copy.deepcopy(obj)))
-        if len(self.log) > 4096:
-            del self.log[:1024]
+        if len(self.log) > self.max_log:
+            del self.log[:max(1, self.max_log // 4)]
+            self.floor = self.log[0][0]
         self.lock.notify_all()
+
+    max_log = 4096
+
+    def compact(self, keep_last: int = 1):
+        """Chaos/test hook: force-expire the watch history (etcd
+        compaction analog) so resumers must take the 410 path."""
+        with self.lock:
+            if len(self.log) > keep_last:
+                del self.log[:-keep_last]
+            if self.log:
+                self.floor = self.log[0][0]
+            else:
+                self.floor = self.rv
+            self.lock.notify_all()
 
 
 class FakeK8sApiServer:
@@ -87,11 +108,17 @@ class FakeK8sApiServer:
         self.token = token
         self._agent_enabled = agent
         self._stop = threading.Event()
+        self._watch_gen = 0
+        self._watch_paused = False
         self.fail_filter = None     # fn(pod_json) -> bool: walk to Failed
         state = self.state
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Keep-alive (Content-Length is always set, chunked streams
+            # self-terminate): a syncing backend reuses ONE connection per
+            # worker instead of a TCP connect + handler-thread spawn per
+            # pod operation — the dominant cost at burst scale.
             protocol_version = "HTTP/1.1"
 
             def log_message(self, *a):  # silence
@@ -177,6 +204,7 @@ class FakeK8sApiServer:
                 sel = q.get("labelSelector", "")
                 since = int(q.get("resourceVersion", "0") or 0)
                 deadline = time.monotonic() + float(q.get("timeoutSeconds", 30))
+                gen = server._watch_gen
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -189,25 +217,57 @@ class FakeK8sApiServer:
                                      + data + b"\r\n")
                     self.wfile.flush()
 
+                def end_stream():
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+
+                def matches(o):
+                    return ((not ns or o["metadata"]["namespace"] == ns)
+                            and _match_selector(
+                                o["metadata"].get("labels", {}), sel))
+
                 try:
-                    while not server._stop.is_set():
+                    if since == 0:
+                        # rv=0 (k8s semantics): snapshot of current state,
+                        # then future events — never a log replay, which
+                        # would be incomplete after any trim.
                         with state.lock:
-                            batch = [(rv, t, o) for (rv, t, o) in state.log
-                                     if rv > since
-                                     and (not ns or o["metadata"]["namespace"] == ns)
-                                     and _match_selector(
-                                         o["metadata"].get("labels", {}), sel)]
-                            if not batch:
+                            snap = [copy.deepcopy(p)
+                                    for p in state.pods.values()
+                                    if matches(p)]
+                            since = state.rv
+                        for o in snap:
+                            emit("ADDED", o)
+                    while not server._stop.is_set():
+                        if server._watch_gen != gen:
+                            break  # kill_watches(): clean EOF, client reconnects
+                        with state.lock:
+                            if server._watch_paused:
+                                state.lock.wait(0.2)
+                                continue
+                            if since + 1 < state.floor:
+                                # History compacted past the resume point:
+                                # the 410 signal (as an ERROR event, the
+                                # apiserver's in-stream form).
+                                batch = None
+                            else:
+                                batch = [(rv, t, o) for (rv, t, o) in state.log
+                                         if rv > since and matches(o)]
+                            if batch == []:
                                 remaining = deadline - time.monotonic()
                                 if remaining <= 0:
                                     break
                                 state.lock.wait(min(remaining, 0.5))
                                 continue
+                        if batch is None:
+                            emit("ERROR", {"kind": "Status", "code": 410,
+                                           "reason": "Expired",
+                                           "metadata": {}})
+                            break
                         for rv, t, o in batch:
                             emit(t, o)
                             since = rv
-                    self.wfile.write(b"0\r\n\r\n")
-                    self.wfile.flush()
+                    end_stream()
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
 
@@ -381,6 +441,27 @@ class FakeK8sApiServer:
 
     def _agent_kick(self):
         self._agent_wake.set()
+
+    def kill_watches(self):
+        """Chaos hook: close every active watch stream (clean EOF) —
+        clients must reconnect at their bookmarked rv without losing
+        events (load-balancer idle reset / apiserver rolling restart)."""
+        with self.state.lock:
+            self._watch_gen += 1
+            self.state.lock.notify_all()
+
+    def compact(self, keep_last: int = 1):
+        """Chaos hook: expire watch history (etcd compaction) — resumers
+        behind the floor get the 410 ERROR and must full-relist."""
+        self.state.compact(keep_last)
+
+    def pause_watches(self, paused: bool):
+        """Chaos hook: freeze event delivery on every watch stream (the
+        'watch went dark' window) without closing them — deterministic
+        setup for the compaction-while-dark 410 drill."""
+        with self.state.lock:
+            self._watch_paused = paused
+            self.state.lock.notify_all()
 
     def add_node(self, name: str, labels: Optional[Dict[str, str]] = None,
                  address: str = "127.0.0.1", pods: int = 64, tpu: int = 0):

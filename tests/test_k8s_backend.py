@@ -296,3 +296,77 @@ def test_serve_resume_adopts_cluster_pods(cluster):
         uids2 = sorted(p["metadata"]["uid"] for p in cli.list_pods(
             label_selector=f"{T.LABEL_MANAGED_BY}={T.MANAGED_BY}"))
         assert uids2 == uids  # adopted, not recreated
+
+
+# ---- watch chaos: disconnects and 410 compaction (VERDICT r4 #4) ----
+
+
+def test_watch_kill_mid_burst_no_lost_status(k8s_plane):
+    """Closing every watch stream mid-burst (LB idle reset / apiserver
+    rolling restart analog) must not lose pod status: the reflector
+    reconnects at its bookmark and every group still converges."""
+    srv, cli, plane = k8s_plane
+    for i in range(6):
+        plane.apply(make_group(f"wk-{i}", simple_role("worker", replicas=2)))
+        if i == 2:
+            srv.kill_watches()
+    for i in range(6):
+        plane.wait_group_ready(f"wk-{i}", timeout=20)
+    for pod in plane.store.list("Pod"):
+        assert pod.status.phase == "Running" and pod.status.ready
+
+
+def test_watch_410_compaction_resyncs(k8s_plane):
+    """Compacting the watch history past the reflector's bookmark makes
+    the stream emit a 410 ERROR; the backend must full-relist (including
+    synthesizing DELETED for mirrors that vanished while dark) and
+    converge. Silent event loss was the pre-fix behavior."""
+    srv, cli, plane = k8s_plane
+    plane.apply(make_group("g410", simple_role("worker", replicas=2)))
+    plane.wait_group_ready("g410", timeout=20)
+
+    # Deterministic dark window: freeze event delivery, delete one mirror
+    # out-of-band, wait for the fake agent to finalize it (the DELETED is
+    # recorded but undelivered), then expire the history PAST the frozen
+    # reflector's bookmark. On resume only the 410→relist path can
+    # observe the deletion.
+    victim = cli.list_pods(
+        label_selector=f"{T.LABEL_MANAGED_BY}={T.MANAGED_BY}")[0]
+    vname = victim["metadata"]["name"]
+    srv.pause_watches(True)
+    cli.delete_pod("default", vname)
+
+    def mirror_gone():
+        try:
+            cli.get_pod("default", vname)
+            return False
+        except NotFound:
+            return True
+    wait_until(mirror_gone, timeout=10, desc="mirror finalized")
+    srv.compact(keep_last=1)
+    srv.pause_watches(False)
+
+    # The replacement proves the DELETED synthesis reached the restart
+    # engine: back to 2 Running mirrors with a new incarnation.
+    def healthy():
+        pods = cli.list_pods(
+            label_selector=f"{T.LABEL_MANAGED_BY}={T.MANAGED_BY}")
+        return (len(pods) == 2
+                and all(p["status"].get("phase") == "Running" for p in pods))
+    wait_until(healthy, timeout=20, desc="replacement after 410 relist")
+    plane.wait_group_ready("g410", timeout=20)
+
+
+def test_stress_harness_k8s_backend_smoke():
+    """`rbg-tpu stress --backend k8s` end to end at small scale: the full
+    mirror path (REST create -> agent Running -> watch reflect -> plane
+    Ready) under the same phases the fake-backend table uses."""
+    from rbg_tpu.stress.harness import StressConfig, run_stress
+
+    report = run_stress(StressConfig(
+        groups=4, roles_per_group=2, replicas=2, create_qps=10.0,
+        slices=4, hosts_per_slice=2, backend="k8s"))
+    assert report["backend"] == "k8s"
+    assert report["create_to_ready_ms"]["n"] == 4
+    assert report["create_to_ready_ms"]["p99"] < 10_000
+    assert report["update_to_converged_ms"]["n"] == 4

@@ -33,6 +33,13 @@ DECODE_TOKENS_PER_REP = 64   # decode tokens per sequence per timed rep
 MULTI_STEP = 8               # device-side decode window (EngineConfig.multi_step)
 REPS = 5
 PROBE_TIMEOUT_S = 240
+# Spread gate (docs/benchmarks.md trust bar): a run whose min–max spread
+# exceeds this is machine-noise-contaminated; re-measure with a FRESH
+# batch (same shapes — comparability across rounds depends on identical
+# conditions) up to MAX_ATTEMPTS times, else report the gate failure
+# instead of publishing noise as signal.
+SPREAD_GATE_PCT = 5.0
+MAX_ATTEMPTS = 4
 
 _PROBE_ENV = "RBG_BENCH_PROBE_JSON"
 
@@ -84,6 +91,12 @@ def main():
     elif os.environ.get(_PROBE_ENV):
         probe = json.loads(os.environ[_PROBE_ENV])
     import jax
+
+    if os.environ.get("RBG_BENCH_FORCE_CPU") == "1":
+        # Externally-forced CPU runs may arrive WITHOUT the scrubbed env
+        # the self-re-exec uses — pin the platform before the first
+        # backend touch, or a wedged relay hangs the bench forever.
+        jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
     from rbg_tpu.engine import Engine, EngineConfig, SamplingParams
@@ -104,24 +117,42 @@ def main():
     max_new = REPS * DECODE_TOKENS_PER_REP + 4 * MULTI_STEP + 8
     prompts = [rng.randint(0, vocab, size=PROMPT_LEN).tolist() for _ in range(BATCH)]
 
-    # Warm-up: admit + prefill everything, compile decode bucket, settle.
-    for p in prompts:
-        eng.add_request(p, SamplingParams(max_new_tokens=max_new))
-    while eng.waiting or any(r.state != "running" for r in eng.running):
-        eng.step()
-    for _ in range(4):
-        eng.step()
-
-    runs = []
-    for _ in range(REPS):
-        start_tokens = eng.metrics["decode_tokens"]
-        t0 = time.perf_counter()
-        for _ in range(steps_per_rep):
+    def measure_once():
+        """One gated attempt: fresh batch (identical shapes), warm-up,
+        REPS timed windows, then release everything."""
+        for p in prompts:
+            eng.add_request(p, SamplingParams(max_new_tokens=max_new))
+        while eng.waiting or any(r.state != "running" for r in eng.running):
             eng.step()
-        elapsed = time.perf_counter() - t0
-        tokens = eng.metrics["decode_tokens"] - start_tokens
-        runs.append(tokens / elapsed)
+        for _ in range(4):
+            eng.step()
+        runs = []
+        for _ in range(REPS):
+            start_tokens = eng.metrics["decode_tokens"]
+            t0 = time.perf_counter()
+            for _ in range(steps_per_rep):
+                eng.step()
+            elapsed = time.perf_counter() - t0
+            tokens = eng.metrics["decode_tokens"] - start_tokens
+            runs.append(tokens / elapsed)
+        for r in list(eng.running):
+            eng.cancel_request(r.id)
+        return runs
 
+    def spread_of(runs):
+        med = statistics.median(runs)
+        return 100.0 * (max(runs) - min(runs)) / med if med else float("inf")
+
+    best_runs, best_spread, attempt_spreads = None, None, []
+    for _ in range(MAX_ATTEMPTS):
+        runs = measure_once()
+        s = spread_of(runs)
+        attempt_spreads.append(round(s, 1))
+        if best_spread is None or s < best_spread:
+            best_runs, best_spread = runs, s
+        if s <= SPREAD_GATE_PCT:
+            break
+    runs = best_runs
     tps = statistics.median(runs)
 
     # MFU estimate: decode FLOPs/token ≈ 2·N_params (matmul MACs×2) plus
@@ -138,8 +169,12 @@ def main():
         "vs_baseline": round(tps / TARGET_TOKENS_PER_SEC, 4),
         "mfu_est": mfu,
         "runs_tps": [round(r, 1) for r in runs],
-        "spread_pct": (round(100.0 * (max(runs) - min(runs)) / tps, 1)
-                       if tps else None),
+        "spread_pct": round(best_spread, 1),
+        "spread_gate_pct": SPREAD_GATE_PCT,
+        "spread_gate": ("pass" if best_spread <= SPREAD_GATE_PCT
+                        else "fail"),
+        "attempt_spreads_pct": attempt_spreads,
+        "load1": round(os.getloadavg()[0], 2),
     }
     if probe is not None and not probe.get("ok"):
         out["tpu_probe"] = probe

@@ -84,10 +84,6 @@ class EngineConfig:
             if self.kv_dtype == "int8":
                 raise ValueError("kv_dtype='int8' not supported for MLA "
                                  "latent pools yet")
-            if self.use_pallas == "always":
-                raise ValueError("use_pallas='always' unsupported for MLA — "
-                                 "the Pallas kernel is GQA-shaped; MLA "
-                                 "attention runs the XLA path")
 
 
 @dataclasses.dataclass

@@ -408,7 +408,8 @@ def forward_paged(
                 positions, token_mask, ksf, vsf)
             attn_lat = paged_mla_attention(q_lat, q_pe, kpf, vpf, table,
                                            positions, kv_lens,
-                                           _mla_scale(cfg))
+                                           _mla_scale(cfg),
+                                           use_pallas=use_pallas)
             attn = _mla_out(cfg, blk, attn_lat)
         else:
             q, k, vv = _qkv(cfg, blk, hcur, positions, lr, lora_ids)

@@ -62,7 +62,7 @@ def mla_attention(
     return out.astype(q_lat.dtype)
 
 
-def paged_mla_attention(
+def paged_mla_attention_xla(
     q_lat: jnp.ndarray,       # [B, T, H, dc]
     q_pe: jnp.ndarray,        # [B, T, H, dr]
     c_pages: jnp.ndarray,     # [NP_layer, page, 1, dc] — this layer's pool view
@@ -75,7 +75,12 @@ def paged_mla_attention(
     """Causal MLA over the paged latent pool: gather the rows' pages into a
     contiguous [B, S, dc] view (S = P·page — static), then the same math as
     the contiguous form. Logical slot i lives in page i//page at offset
-    i%page, so slot index == absolute position."""
+    i%page, so slot index == absolute position.
+
+    Cost note: the gather MATERIALIZES [B, S, dc] in HBM every step — at
+    long context that is ~3× the live-latent traffic (gather write +
+    attention read + pool read). The Pallas kernel streams pages instead;
+    ``paged_mla_attention`` dispatches."""
     B, P = page_table.shape
     page = c_pages.shape[1]
     S = P * page
@@ -84,3 +89,29 @@ def paged_mla_attention(
     slot_valid = (jnp.arange(S, dtype=jnp.int32)[None, :]
                   < kv_lens[:, None])
     return mla_attention(q_lat, q_pe, c, pe, q_positions, slot_valid, scale)
+
+
+def paged_mla_attention(q_lat, q_pe, c_pages, pe_pages, page_table,
+                        q_positions, kv_lens, scale,
+                        *, use_pallas: str = "auto") -> jnp.ndarray:
+    """Dispatch between the Pallas MLA decode kernel and the XLA gather
+    fallback (same contract as ``paged_attention``'s GQA dispatch)."""
+    if use_pallas == "always":
+        from rbg_tpu.ops.pallas.paged_attention_kernel import (
+            paged_mla_attention_pallas,
+        )
+        return paged_mla_attention_pallas(q_lat, q_pe, c_pages, pe_pages,
+                                          page_table, q_positions, kv_lens,
+                                          scale)
+    if use_pallas == "auto" and jax.default_backend() == "tpu":
+        try:
+            from rbg_tpu.ops.pallas.paged_attention_kernel import (
+                paged_mla_attention_pallas,
+            )
+            return paged_mla_attention_pallas(q_lat, q_pe, c_pages, pe_pages,
+                                              page_table, q_positions,
+                                              kv_lens, scale)
+        except ImportError:
+            pass
+    return paged_mla_attention_xla(q_lat, q_pe, c_pages, pe_pages,
+                                   page_table, q_positions, kv_lens, scale)

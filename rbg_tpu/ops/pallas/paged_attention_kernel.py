@@ -156,3 +156,137 @@ def paged_attention_pallas(q, k_pages, v_pages, page_table, q_positions,
                        page_table.astype(jnp.int32),
                        kv_lens.astype(jnp.int32), interpret=interpret)
     return out.reshape(B, T, H, hd)
+
+
+# ---- MLA (latent) decode ----------------------------------------------------
+#
+# The latent cache is MQA-shaped — ONE shared latent per token (no head
+# axis). scores = q_lat·c + q_pe·pe, values ARE the latents, so the page
+# walk streams each (c, pe) page HBM→VMEM once and attends all H query
+# heads against it. The XLA fallback instead gathers the rows' pages into
+# a [B, S, dc] view in HBM every step — at long context that gather (plus
+# its attention re-read) is ~3× the live-latent traffic, same argument as
+# the GQA kernel above.
+
+
+def _mla_decode_kernel(
+    # scalar prefetch
+    page_table_ref,   # [B, P] int32 (SMEM)
+    kv_lens_ref,      # [B] int32 (SMEM)
+    # blocks
+    ql_ref,           # [1, H, dc] (VMEM) — q_nope absorbed through W_uk
+    qp_ref,           # [1, H, dr] — RoPE'd query part
+    c_ref,            # [1, page, 1, dc] — the page picked by index_map
+    pe_ref,           # [1, page, 1, dr]
+    out_ref,          # [1, H, dc] — latent attention output
+    # scratch
+    m_ref,            # [H, 1] running max
+    l_ref,            # [H, 1] running denom
+    acc_ref,          # [H, dc] running numerator
+    *,
+    scale: float,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    num_p = pl.num_programs(1)
+    page = c_ref.shape[1]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    kv_len = kv_lens_ref[b]
+
+    @pl.when(p * page < kv_len)
+    def _attend():
+        ql = ql_ref[0].astype(jnp.float32)              # [H, dc]
+        qp = qp_ref[0].astype(jnp.float32)              # [H, dr]
+        c = c_ref[0, :, 0, :].astype(jnp.float32)       # [page, dc]
+        pe = pe_ref[0, :, 0, :].astype(jnp.float32)     # [page, dr]
+
+        scores = (
+            jax.lax.dot_general(ql, c, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            + jax.lax.dot_general(qp, pe, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        ) * scale                                       # [H, page]
+
+        token_idx = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, dimension=1)
+        scores = jnp.where(token_idx < kv_len, scores, _NEG_INF)
+
+        m_prev = m_ref[:]                               # [H, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(scores - m_new)                 # [H, page]
+
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            probs, c, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [H, dc]
+
+    @pl.when(p == num_p - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:], 1e-30)
+        out_ref[0] = (acc_ref[:] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _mla_decode_call(q_lat, q_pe, c_pages, pe_pages, page_table, kv_lens,
+                     scale, interpret=False):
+    """q_lat: [B, H, dc], q_pe: [B, H, dr]; pages: [NP, page, 1, d].
+    Returns the latent attention output [B, H, dc]."""
+    B, H, dc = q_lat.shape
+    dr = q_pe.shape[-1]
+    _, page, _, _ = c_pages.shape
+    P = page_table.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, H, dc), lambda b, p, table, lens: (b, 0, 0)),
+            pl.BlockSpec((1, H, dr), lambda b, p, table, lens: (b, 0, 0)),
+            pl.BlockSpec((1, page, 1, dc),
+                         lambda b, p, table, lens: (table[b, p], 0, 0, 0)),
+            pl.BlockSpec((1, page, 1, dr),
+                         lambda b, p, table, lens: (table[b, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, dc),
+                               lambda b, p, table, lens: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, dc), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_mla_decode_kernel, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, dc), q_lat.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(page_table, kv_lens, q_lat, q_pe, c_pages, pe_pages)
+
+
+def paged_mla_attention_pallas(q_lat, q_pe, c_pages, pe_pages, page_table,
+                               q_positions, kv_lens, scale,
+                               interpret: bool = False):
+    """Drop-in for ``paged_mla_attention`` (the XLA gather path). Decode
+    (T == 1) runs the kernel; prefill falls back to XLA."""
+    B, T, H, dc = q_lat.shape
+    if T != 1:
+        from rbg_tpu.ops.mla_attention import paged_mla_attention_xla
+        return paged_mla_attention_xla(q_lat, q_pe, c_pages, pe_pages,
+                                       page_table, q_positions, kv_lens,
+                                       scale)
+    out = _mla_decode_call(q_lat[:, 0], q_pe[:, 0], c_pages, pe_pages,
+                           page_table.astype(jnp.int32),
+                           kv_lens.astype(jnp.int32),
+                           scale=float(scale), interpret=interpret)
+    return out[:, None]

@@ -143,11 +143,15 @@ def main():
         med = statistics.median(runs)
         return 100.0 * (max(runs) - min(runs)) / med if med else float("inf")
 
+    import math
+
     best_runs, best_spread, attempt_spreads = None, None, []
     for _ in range(MAX_ATTEMPTS):
         runs = measure_once()
         s = spread_of(runs)
-        attempt_spreads.append(round(s, 1))
+        # A zero-throughput attempt gives spread inf — keep the gate math
+        # but never let Infinity reach the JSON line (unparseable).
+        attempt_spreads.append(round(s, 1) if math.isfinite(s) else None)
         if best_spread is None or s < best_spread:
             best_runs, best_spread = runs, s
         if s <= SPREAD_GATE_PCT:
@@ -169,7 +173,8 @@ def main():
         "vs_baseline": round(tps / TARGET_TOKENS_PER_SEC, 4),
         "mfu_est": mfu,
         "runs_tps": [round(r, 1) for r in runs],
-        "spread_pct": round(best_spread, 1),
+        "spread_pct": (round(best_spread, 1)
+                       if math.isfinite(best_spread) else None),
         "spread_gate_pct": SPREAD_GATE_PCT,
         "spread_gate": ("pass" if best_spread <= SPREAD_GATE_PCT
                         else "fail"),

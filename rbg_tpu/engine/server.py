@@ -342,6 +342,10 @@ def serve(args) -> None:
                     alpha = float(z["alpha"]) if "alpha" in z.files else 16.0
                     engine.load_lora(name, adapter, alpha=alpha)
 
+            # Fully wire each engine (grammar table, adapters) BEFORE
+            # publishing it on the server object: health reports ready the
+            # moment the attribute is set, and a json_mode request racing
+            # the grammar wiring used to get a spurious admission error.
             if cfg.mode == "prefill":
                 from rbg_tpu.engine.pd import PrefillWorker
                 pool = None
@@ -355,19 +359,22 @@ def serve(args) -> None:
                         ca_path=(args.kv_pool_ca
                                  or os.environ.get("RBG_KV_POOL_CA")
                                  or None))
-                server.prefill = PrefillWorker(cfg, pool=pool)
-                server.prefill.engine.enable_json_grammar(server.tokenizer)
-                load_adapters(server.prefill.engine)
+                prefill = PrefillWorker(cfg, pool=pool)
+                prefill.engine.enable_json_grammar(server.tokenizer)
+                load_adapters(prefill.engine)
+                server.prefill = prefill
             elif cfg.mode == "decode":
                 from rbg_tpu.engine.service import DecodeService
-                server.decode = DecodeService(cfg)
-                server.decode.engine.enable_json_grammar(server.tokenizer)
-                load_adapters(server.decode.engine)
+                decode = DecodeService(cfg)
+                decode.engine.enable_json_grammar(server.tokenizer)
+                load_adapters(decode.engine)
+                server.decode = decode
             else:
                 from rbg_tpu.engine.service import EngineService
-                server.service = EngineService(cfg)
-                server.service.engine.enable_json_grammar(server.tokenizer)
-                load_adapters(server.service.engine)
+                service = EngineService(cfg)
+                service.engine.enable_json_grammar(server.tokenizer)
+                load_adapters(service.engine)
+                server.service = service
         except Exception:
             # A pod that cannot build its engine must CRASH (so the restart
             # policy sees it), not linger as a never-ready zombie listener.

@@ -95,23 +95,10 @@ def paged_mla_attention(q_lat, q_pe, c_pages, pe_pages, page_table,
                         q_positions, kv_lens, scale,
                         *, use_pallas: str = "auto") -> jnp.ndarray:
     """Dispatch between the Pallas MLA decode kernel and the XLA gather
-    fallback (same contract as ``paged_attention``'s GQA dispatch)."""
-    if use_pallas == "always":
-        from rbg_tpu.ops.pallas.paged_attention_kernel import (
-            paged_mla_attention_pallas,
-        )
-        return paged_mla_attention_pallas(q_lat, q_pe, c_pages, pe_pages,
-                                          page_table, q_positions, kv_lens,
-                                          scale)
-    if use_pallas == "auto" and jax.default_backend() == "tpu":
-        try:
-            from rbg_tpu.ops.pallas.paged_attention_kernel import (
-                paged_mla_attention_pallas,
-            )
-            return paged_mla_attention_pallas(q_lat, q_pe, c_pages, pe_pages,
-                                              page_table, q_positions,
-                                              kv_lens, scale)
-        except ImportError:
-            pass
-    return paged_mla_attention_xla(q_lat, q_pe, c_pages, pe_pages,
-                                   page_table, q_positions, kv_lens, scale)
+    fallback (same policy as ``paged_attention``'s GQA dispatch — shared
+    via ``dispatch_pallas``)."""
+    from rbg_tpu.ops.paged_attention import dispatch_pallas
+    return dispatch_pallas(
+        use_pallas, "paged_mla_attention_pallas", paged_mla_attention_xla,
+        (q_lat, q_pe, c_pages, pe_pages, page_table, q_positions, kv_lens,
+         scale))

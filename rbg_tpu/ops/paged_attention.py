@@ -102,6 +102,23 @@ def write_kv_pages(k_pages, v_pages, k_new, v_new, page_table, positions,
     return k_pages, v_pages, None, None
 
 
+def dispatch_pallas(use_pallas: str, kernel_name: str, xla_fn, args):
+    """The ONE kernel-vs-XLA dispatch policy (GQA and MLA both use it):
+    'always' imports the kernel and fails loudly if unavailable; 'auto'
+    takes the kernel on TPU, swallowing only ImportError; anything else
+    (or a non-TPU backend) runs the XLA fallback."""
+    if use_pallas == "always":
+        from rbg_tpu.ops.pallas import paged_attention_kernel as K
+        return getattr(K, kernel_name)(*args)
+    if use_pallas == "auto" and jax.default_backend() == "tpu":
+        try:
+            from rbg_tpu.ops.pallas import paged_attention_kernel as K
+        except ImportError:
+            return xla_fn(*args)
+        return getattr(K, kernel_name)(*args)
+    return xla_fn(*args)
+
+
 def paged_attention(q, k_pages, v_pages, page_table, q_positions, kv_lens,
                     *, use_pallas: str = "auto", k_scales=None, v_scales=None):
     """Dispatch between the Pallas TPU kernel and the XLA fallback.
@@ -110,19 +127,6 @@ def paged_attention(q, k_pages, v_pages, page_table, q_positions, kv_lens,
     if k_scales is not None:
         return paged_attention_xla(q, k_pages, v_pages, page_table,
                                    q_positions, kv_lens, k_scales, v_scales)
-    if use_pallas == "always":
-        # Explicit request: fail loudly if the kernel is unavailable.
-        from rbg_tpu.ops.pallas.paged_attention_kernel import paged_attention_pallas
-        return paged_attention_pallas(q, k_pages, v_pages, page_table,
-                                      q_positions, kv_lens)
-    if use_pallas == "auto" and jax.default_backend() == "tpu":
-        try:
-            from rbg_tpu.ops.pallas.paged_attention_kernel import (
-                paged_attention_pallas,
-            )
-            return paged_attention_pallas(q, k_pages, v_pages, page_table,
-                                          q_positions, kv_lens)
-        except ImportError:
-            pass
-    return paged_attention_xla(q, k_pages, v_pages, page_table, q_positions,
-                               kv_lens)
+    return dispatch_pallas(
+        use_pallas, "paged_attention_pallas", paged_attention_xla,
+        (q, k_pages, v_pages, page_table, q_positions, kv_lens))

@@ -40,6 +40,7 @@ import socketserver
 import sys
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from rbg_tpu.engine.protocol import recv_msg, request_once, send_msg
@@ -47,6 +48,8 @@ from rbg_tpu.engine.protocol import recv_msg, request_once, send_msg
 MAX_ATTEMPTS = 3          # distinct backends tried per leg
 CONNECT_TIMEOUT_S = 5.0   # fast failure detection on the connect
 STREAM_TIMEOUT_S = 300.0  # per-recv budget once streaming
+AFFINITY_PREFIX = 32      # prompt tokens hashed for cache affinity
+AFFINITY_SLACK = 4        # max extra outstanding before affinity yields
 
 
 class Registry:
@@ -134,15 +137,18 @@ class BackendPool:
                     healthy.append((st.outstanding, st.last_pick, i, a))
             healthy.sort()
             down.sort()
-            out = [t[-1] for t in healthy] + [t[-1] for t in down]
-            if out:
-                self._seq += 1
-                self._st[out[0]].last_pick = self._seq
-            return out
+            return [t[-1] for t in healthy] + [t[-1] for t in down]
 
     def acquire(self, addr: str) -> None:
+        # last_pick is charged HERE — to the address actually served —
+        # not in order(): affinity reordering can choose a different head
+        # than order() computed, and crediting the unserved sibling would
+        # invert the least-recently-picked tie-break.
         with self._lock:
-            self._state(addr).outstanding += 1
+            st = self._state(addr)
+            st.outstanding += 1
+            self._seq += 1
+            st.last_pick = self._seq
 
     def release(self, addr: str) -> None:
         with self._lock:
@@ -167,6 +173,14 @@ class BackendPool:
         now = time.monotonic()
         with self._lock:
             return [a for a, st in self._st.items() if st.down_until > now]
+
+    def outstanding(self, addr: str) -> int:
+        with self._lock:
+            return self._state(addr).outstanding
+
+    def is_down(self, addr: str) -> bool:
+        with self._lock:
+            return self._state(addr).down_until > time.monotonic()
 
     def probe(self, timeout: float = 1.0) -> List[str]:
         """Health-check every evicted backend; re-admit responders.
@@ -201,6 +215,45 @@ class BackendPool:
                     for a, st in self._st.items()}
 
 
+class PrefixAffinity:
+    """Cache-aware routing memory (the sglang-router property VERDICT r4
+    #4 named): requests sharing a prompt prefix go to the backend whose
+    radix / prefix cache already holds it. Approximation: an LRU map from
+    hash(first AFFINITY_PREFIX tokens) → the backend that last served
+    that prefix. The *balance guard* lives in the caller — affinity only
+    wins while the remembered backend isn't meaningfully busier than the
+    least-loaded one, so a hot prefix cannot melt a single replica."""
+
+    def __init__(self, cap: int = 4096):
+        self.cap = cap
+        self._m: "OrderedDict[int, str]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def key(prompt) -> Optional[int]:
+        if not prompt:
+            return None
+        return hash(tuple(prompt[:AFFINITY_PREFIX]))
+
+    def get(self, key: Optional[int]) -> Optional[str]:
+        if key is None:
+            return None
+        with self._lock:
+            addr = self._m.get(key)
+            if addr is not None:
+                self._m.move_to_end(key)
+            return addr
+
+    def put(self, key: Optional[int], addr: str) -> None:
+        if key is None:
+            return
+        with self._lock:
+            self._m[key] = addr
+            self._m.move_to_end(key)
+            if len(self._m) > self.cap:
+                self._m.popitem(last=False)
+
+
 class RouterState:
     def __init__(self, registry: Registry, group: Optional[str],
                  static_backends: Optional[dict] = None,
@@ -214,8 +267,10 @@ class RouterState:
         # (one trust domain edge-to-engine; health stays open for probes).
         self.token = token if token is not None \
             else (os.environ.get("RBG_DATA_TOKEN") or None)
+        self.affinity = PrefixAffinity()
         self.metrics = {"requests": 0, "pd_requests": 0, "errors": 0,
-                        "retries": 0, "failovers": 0, "kv_bytes_routed": 0}
+                        "retries": 0, "failovers": 0, "affinity_hits": 0,
+                        "kv_bytes_routed": 0}
 
     def authorized(self, obj: dict) -> bool:
         if not self.token:
@@ -251,14 +306,37 @@ class RouterState:
                 return r
         raise RuntimeError("no backends available")
 
+    def candidates_for(self, role: str, prompt) -> List[str]:
+        """Candidates with CACHE AFFINITY applied: the backend that last
+        served this prompt prefix moves to the front — its radix / shared-
+        pool prefix is warm — unless it is evicted or meaningfully busier
+        (> AFFINITY_SLACK outstanding) than the least-loaded choice, so a
+        hot prefix cannot melt one replica."""
+        cands = self.candidates(role)
+        akey = PrefixAffinity.key(prompt)
+        if akey is None or len(cands) < 2:
+            return cands
+        addr = self.affinity.get(akey)
+        if (addr and addr in cands and addr != cands[0]
+                and not self.pool.is_down(addr)
+                and self.pool.outstanding(addr)
+                <= self.pool.outstanding(cands[0]) + AFFINITY_SLACK):
+            self.metrics["affinity_hits"] += 1
+            return [addr] + [a for a in cands if a != addr]
+        if addr == cands[0] and addr is not None:
+            self.metrics["affinity_hits"] += 1
+        return cands
+
     def call(self, role: str, obj: dict, k_bytes=None, v_bytes=None,
-             timeout: float = 120.0) -> Tuple[str, dict, bytes, bytes]:
+             timeout: float = 120.0, prompt=None) -> Tuple[str, dict, bytes, bytes]:
         """One blocking request with failover across the role's backends.
         Transport failures (connect refused, peer closed) evict + retry on
-        a sibling; application errors pass through untouched."""
-        cands = self.candidates(role)
+        a sibling; application errors pass through untouched. ``prompt``
+        (when given) engages cache-affinity candidate ordering."""
+        cands = self.candidates_for(role, prompt)
         if not cands:
             raise RuntimeError(f"no {role} backends available")
+        akey = PrefixAffinity.key(prompt)
         last: Optional[Exception] = None
         for i, addr in enumerate(cands[:MAX_ATTEMPTS]):
             if i:
@@ -278,6 +356,7 @@ class RouterState:
                 last = RuntimeError(f"{addr} closed connection")
                 continue
             self.pool.ok(addr)
+            self.affinity.put(akey, addr)
             if i:
                 self.metrics["failovers"] += 1
             return addr, resp, rk, rv
@@ -364,9 +443,10 @@ class Handler(socketserver.BaseRequestHandler):
     def _route(self, state: RouterState, obj: dict):
         """Resolve the final leg shared by blocking and streaming paths.
         PD mode runs the (always blocking, failover-wrapped) prefill hop
-        here; returns (role, (header, k_bytes, v_bytes)) for the leg the
-        caller owns — the caller can re-send that payload to any sibling of
-        ``role``, which is what makes decode failover possible."""
+        here; returns (role, (header, k_bytes, v_bytes), affinity_prompt)
+        for the leg the caller owns — the caller can re-send that payload
+        to any sibling of ``role`` (decode failover), and the affinity
+        prompt (None on cache-less legs) steers cache-aware ordering."""
         state.metrics["requests"] += 1
         obj = self._pin_seed(obj)
         if state.pd_mode():
@@ -381,7 +461,10 @@ class Handler(socketserver.BaseRequestHandler):
                         "stop_token", "token"):
                 if key in obj:
                     pf_req[key] = obj[key]
-            _, hdr, kb, vb = state.call("prefill", pf_req)
+            # Cache affinity on the prefill leg: the replica that served
+            # this prefix before has it in its radix cache / pool hot set.
+            _, hdr, kb, vb = state.call("prefill", pf_req,
+                                        prompt=obj.get("prompt"))
             if "error" in hdr:
                 raise RuntimeError(f"prefill failed: {hdr}")
             state.metrics["kv_bytes_routed"] += len(kb or b"") + len(vb or b"")
@@ -393,14 +476,15 @@ class Handler(socketserver.BaseRequestHandler):
                         "lora", "stop_token", "stream", "token"):
                 if key in obj:
                     fwd[key] = obj[key]
-            return "decode", (fwd, kb, vb)
-        return state.worker_role(), (obj, None, None)
+            # Decode replicas hold no prefix cache — no affinity prompt.
+            return "decode", (fwd, kb, vb), None
+        return state.worker_role(), (obj, None, None), obj.get("prompt")
 
     def _generate(self, state: RouterState, obj: dict) -> dict:
         t0 = time.perf_counter()
         pd = state.pd_mode()
-        role, payload = self._route(state, obj)
-        _, resp, _, _ = state.call(role, *payload)
+        role, payload, aff = self._route(state, obj)
+        _, resp, _, _ = state.call(role, *payload, prompt=aff)
         if pd:
             if "error" in resp:
                 raise RuntimeError(f"decode failed: {resp}")
@@ -418,11 +502,15 @@ class Handler(socketserver.BaseRequestHandler):
         the replayed stream — identical because the seed is pinned — is
         relayed with the already-delivered token prefix skipped. The
         client never sees the failure."""
-        role, payload = self._route(state, obj)
+        role, payload, aff = self._route(state, obj)
+        akey = PrefixAffinity.key(aff)
         delivered = 0                  # tokens already relayed to the client
         last: Optional[Exception] = None
         for attempt in range(MAX_ATTEMPTS):
-            cands = state.candidates(role)
+            # Affinity only steers the FIRST attempt: a failover must not
+            # re-pin to the remembered (possibly just-dead) backend.
+            cands = (state.candidates_for(role, aff) if attempt == 0
+                     else state.candidates(role))
             if not cands:
                 break
             addr = cands[0]
@@ -436,6 +524,7 @@ class Handler(socketserver.BaseRequestHandler):
                 state.pool.release(addr)
             if finished:
                 state.pool.ok(addr)
+                state.affinity.put(akey, addr)
                 if attempt:
                     state.metrics["failovers"] += 1
                 return

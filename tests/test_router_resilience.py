@@ -537,3 +537,65 @@ def test_sigkill_decode_mid_stream_client_completes():
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+# ---- cache-aware routing (sglang-router property) -------------------------
+
+
+def test_affinity_routes_same_prefix_to_same_backend():
+    """Same-prefix requests stick to one backend (warm radix cache);
+    different prefixes spread by least-outstanding."""
+    from rbg_tpu.engine.router import RouterState as RS
+
+    a, b = _EchoBackend(), _EchoBackend()
+    st = RS(__import__("rbg_tpu.engine.router", fromlist=["Registry"])
+            .Registry(None), None, {"worker": [a.addr, b.addr]})
+    try:
+        p1 = list(range(40))
+        p2 = list(range(100, 140))
+        first, _, _, _ = st.call("worker", {"op": "generate", "prompt": p1},
+                                 prompt=p1)
+        for _ in range(4):
+            addr, _, _, _ = st.call("worker",
+                                    {"op": "generate", "prompt": p1},
+                                    prompt=p1)
+            assert addr == first                   # sticky
+        assert st.metrics["affinity_hits"] >= 4
+        # A NEW prefix must land on the colder replica: last_pick is
+        # charged to the address actually served (acquire), so the hot
+        # affinity replica loses the least-recently-picked tie-break.
+        where, _, _, _ = st.call("worker", {"op": "generate", "prompt": p2},
+                                 prompt=p2)
+        assert where != first
+        again, _, _, _ = st.call("worker", {"op": "generate", "prompt": p2},
+                                 prompt=p2)
+        assert again == where                  # and sticks there
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_affinity_yields_to_load_imbalance_and_eviction():
+    from rbg_tpu.engine.router import Registry, RouterState
+
+    a, b = _EchoBackend(), _EchoBackend()
+    st = RouterState(Registry(None), None, {"worker": [a.addr, b.addr]})
+    try:
+        p = list(range(40))
+        pinned, _, _, _ = st.call("worker", {"op": "generate", "prompt": p},
+                                  prompt=p)
+        other = b.addr if pinned == a.addr else a.addr
+        # Overload the pinned backend past the slack: affinity must yield.
+        for _ in range(6):
+            st.pool.acquire(pinned)
+        cands = st.candidates_for("worker", p)
+        assert cands[0] == other
+        for _ in range(6):
+            st.pool.release(pinned)
+        # Evicted affinity target must also yield.
+        st.pool.fail(pinned)
+        cands = st.candidates_for("worker", p)
+        assert cands[0] == other
+    finally:
+        a.stop()
+        b.stop()

@@ -99,6 +99,8 @@ class SamplingParams:
     seed: Optional[int] = None      # per-request PRNG stream (reproducible)
     logprobs: bool = False          # emit chosen-token logprob per step
     json_mode: bool = False         # grammar-constrained: output is valid JSON
+    regex: Optional[str] = None     # grammar-constrained: output matches
+                                    # this anchored byte-level regex
     lora: Optional[str] = None      # adapter name (engine-registered)
     stop_token: Optional[int] = None
 
@@ -118,6 +120,9 @@ class SamplingParams:
             raise ValueError("min_p must be in [0, 1)")
         if self.repetition_penalty <= 0:
             raise ValueError("repetition_penalty must be > 0")
+        if self.json_mode and self.regex:
+            raise ValueError("json_mode and regex are mutually exclusive "
+                             "constraints")
 
     @classmethod
     def from_wire(cls, obj: dict, *, default_max_tokens: int = 16,
@@ -136,6 +141,7 @@ class SamplingParams:
             seed=(int(obj["seed"]) if obj.get("seed") is not None else None),
             logprobs=bool(obj.get("logprobs", False)),
             json_mode=bool(obj.get("json_mode", False)),
+            regex=(str(obj["regex"]) if obj.get("regex") else None),
             lora=(str(obj["lora"]) if obj.get("lora") else None),
             stop_token=(obj.get("stop_token") if obj.get("stop_token") is None
                         else int(obj["stop_token"])),

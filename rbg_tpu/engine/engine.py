@@ -67,7 +67,8 @@ class Request:
         self.seq_len = 0                # tokens materialized in KV
         self.last_token: Optional[int] = None
         self.ngram = None                   # NGramIndex, speculative mode
-        self.gstate = None                  # grammar state (json_mode)
+        self.gstate = None                  # grammar state (json_mode/regex)
+        self.grammar = None                 # this request's TokenGrammar
         self.lora_idx = 0                   # adapter slot (0 = base model)
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
@@ -120,6 +121,11 @@ class Engine:
         self._dec_fn_cache: Dict[Tuple[int, bool, bool], object] = {}
         self._spec_fn_cache: Dict[Tuple[int, bool, bool, bool, bool], object] = {}
         self.grammar = None     # TokenGrammar — enable_json_grammar()
+        self._token_bytes = None
+        self._grammar_eos = None
+        self._token_trie = None
+        from collections import OrderedDict as _OD
+        self._regex_grammars = _OD()
         # Events drained outside step() (e.g. a runtime load_lora must
         # flush the fused pipeline) surface on the NEXT step() call.
         self._deferred_events: List[StepEvent] = []
@@ -167,14 +173,48 @@ class Engine:
                 f"prompt token {bad} outside model vocab [0, {V})")
 
     def enable_json_grammar(self, tokenizer) -> None:
-        """Wire grammar-constrained decoding (json_mode requests) to a
-        tokenizer's token→bytes table. Callers that admit json_mode
-        requests without this get a per-request admission error."""
+        """Wire grammar-constrained decoding (json_mode AND regex
+        requests) to a tokenizer's token→bytes table. Callers that admit
+        constrained requests without this get a per-request admission
+        error."""
         from rbg_tpu.engine.grammar import (JsonGrammar, TokenGrammar,
-                                            token_bytes_for)
-        self.grammar = TokenGrammar(JsonGrammar(),
-                                    token_bytes_for(tokenizer),
-                                    tokenizer.eos_id)
+                                            TokenTrie, token_bytes_for)
+        self._token_bytes = token_bytes_for(tokenizer)
+        self._grammar_eos = tokenizer.eos_id
+        # ONE trie per tokenizer, shared by the JSON grammar and every
+        # cached regex grammar (it depends only on the vocab).
+        self._token_trie = TokenTrie(self._token_bytes)
+        self.grammar = TokenGrammar(JsonGrammar(), self._token_bytes,
+                                    self._grammar_eos,
+                                    trie=self._token_trie)
+        from collections import OrderedDict
+        self._regex_grammars = OrderedDict()
+
+    _REGEX_GRAMMAR_CACHE = 64
+
+    def _regex_grammar(self, pattern: str):
+        """Per-pattern compiled TokenGrammar (NFA + trie + mask cache),
+        LRU-bounded — repeat patterns (the common case: one schema per
+        client) pay compilation once. Raises ValueError on bad patterns
+        (an admission error, never a loop failure)."""
+        from rbg_tpu.engine.grammar import RegexGrammar, TokenGrammar
+        tg = self._regex_grammars.get(pattern)
+        if tg is not None:
+            self._regex_grammars.move_to_end(pattern)  # LRU refresh
+            return tg
+        tg = TokenGrammar(RegexGrammar(pattern), self._token_bytes,
+                          self._grammar_eos, trie=self._token_trie)
+        if len(self._regex_grammars) >= self._REGEX_GRAMMAR_CACHE:
+            self._regex_grammars.popitem(last=False)
+        self._regex_grammars[pattern] = tg
+        return tg
+
+    def _grammar_for(self, sampling: SamplingParams):
+        if sampling.json_mode:
+            return self.grammar
+        if sampling.regex:
+            return self._regex_grammar(sampling.regex)
+        return None
 
     _LORA_ATTN_TARGETS = ("wq", "wk", "wv", "wo")
     _LORA_MLP_TARGETS = ("w_gate", "w_up", "w_down")
@@ -279,16 +319,18 @@ class Engine:
         return slot
 
     def _grammar_check(self, sampling: SamplingParams) -> None:
-        if sampling.json_mode and self.grammar is None:
+        if (sampling.json_mode or sampling.regex) and self.grammar is None:
             raise ValueError(
-                "json_mode requires a grammar table — the server wires it "
-                "from the tokenizer (enable_json_grammar)")
+                "json_mode/regex require a grammar table — the server "
+                "wires it from the tokenizer (enable_json_grammar)")
+        if sampling.regex:
+            self._regex_grammar(sampling.regex)  # bad pattern → admission error
 
-    def _gmask(self, state) -> np.ndarray:
+    def _gmask(self, grammar, state) -> np.ndarray:
         """Grammar mask padded to MODEL vocab: ids beyond the tokenizer's
         vocab can never be legal constrained output."""
         V = self.mcfg.vocab_size
-        m = self.grammar.mask(state)
+        m = grammar.mask(state)
         if len(m) == V:
             return m
         out = np.zeros(V, bool)
@@ -306,8 +348,10 @@ class Engine:
                 f"exceeds max_seq_len {self.cfg.max_seq_len}")
         req = Request(prompt, sampling)
         req.lora_idx = self._resolve_lora(sampling)
-        if sampling.json_mode:
-            req.gstate = self.grammar.initial()
+        g = self._grammar_for(sampling)
+        if g is not None:
+            req.grammar = g
+            req.gstate = g.initial()
         self.requests[req.id] = req
         self.waiting.append(req)
         return req.id
@@ -355,8 +399,10 @@ class Engine:
             raise ValueError(f"prefix KV rejected: {e}") from e
         req = Request(prompt, sampling)
         req.lora_idx = lora_idx
-        if sampling.json_mode:
-            req.gstate = self.grammar.initial()
+        g = self._grammar_for(sampling)
+        if g is not None:
+            req.grammar = g
+            req.gstate = g.initial()
         req.pages = pages
         req.prefill_pos = prefix_len
         req.seq_len = prefix_len
@@ -491,7 +537,7 @@ class Engine:
             gm = np.ones((Bs, self.mcfg.vocab_size), bool)
             for n, req in enumerate(reqs):
                 if req.gstate is not None:
-                    gm[n] = self._gmask(req.gstate)
+                    gm[n] = self._gmask(req.grammar, req.gstate)
             sel = jnp.where(jnp.asarray(gm), sel, NEG_INF)
         args = [sel, keys, jnp.asarray(temps), jnp.asarray(ks),
                 jnp.asarray(tps), jnp.asarray(mps)]
@@ -609,7 +655,7 @@ class Engine:
         for r in self.running:
             if r.state != "running":
                 continue
-            if r.sampling.json_mode and self.cfg.speculative != "ngram":
+            if r.gstate is not None and self.cfg.speculative != "ngram":
                 continue    # grammar rows decode via the host-synced step
             if len(r.output) + pend.get(id(r), 0) >= r.sampling.max_new_tokens:
                 continue
@@ -760,7 +806,7 @@ class Engine:
             # penalized/grammar rows simply never draft).
             events = self._drain_decode()
             return events + self._spec_decode_step()
-        if any(r.sampling.json_mode for r in self.running
+        if any(r.gstate is not None for r in self.running
                if r.state == "running"):
             # Mixed traffic: ONLY grammar rows pay the per-token
             # host-synced step; everyone else keeps the fused multi-step
@@ -937,7 +983,7 @@ class Engine:
     def _spec_decode_step(self, grammar_only: bool = False) -> List[StepEvent]:
         events: List[StepEvent] = []
         batch = [r for r in self.running if r.state == "running"
-                 and (not grammar_only or r.sampling.json_mode)
+                 and (not grammar_only or r.gstate is not None)
                  and len(r.output) < r.sampling.max_new_tokens]
         if not batch:
             return events
@@ -961,16 +1007,16 @@ class Engine:
             else:
                 d = []
             if req.gstate is not None:
-                g = self.grammar
+                g = req.grammar
                 s = req.gstate
-                masks = [self._gmask(s)]
+                masks = [self._gmask(g, s)]
                 kept = []
                 for dt in d:
                     ns = g.advance_token(s, dt)
                     if ns is None:
                         break           # draft leaves the grammar — cut here
                     kept.append(dt)
-                    masks.append(self._gmask(ns))
+                    masks.append(self._gmask(g, ns))
                     s = ns
                 d = kept
                 gmask_rows[id(req)] = masks
@@ -1070,8 +1116,8 @@ class Engine:
         req.output.append(tok)
         if req.ngram is not None:
             req.ngram.append(tok)
-        if req.gstate is not None and self.grammar is not None:
-            nxt = self.grammar.advance_token(req.gstate, tok)
+        if req.gstate is not None and req.grammar is not None:
+            nxt = req.grammar.advance_token(req.gstate, tok)
             if nxt is not None:     # defensively keep old state on EOS etc.
                 req.gstate = nxt
         req.last_token = tok

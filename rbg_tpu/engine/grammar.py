@@ -233,6 +233,269 @@ class JsonGrammar:
         return False
 
 
+class RegexGrammar:
+    """Byte-level regex automaton for constrained decoding (the ``regex``
+    sampling param — vLLM guided_regex / sglang regex analog). Compiles a
+    practical, ASCII-oriented subset to a Thompson NFA whose state (a
+    frozenset of node ids — hashable) rides the same ``TokenGrammar`` /
+    trie / packed-mask-cache machinery as JSON mode.
+
+    Supported syntax: literal characters (non-ASCII literals match their
+    UTF-8 bytes in sequence), ``.`` (any byte except newline), escapes
+    ``\\d \\w \\s \\n \\t \\r`` and literal-escapes (``\\. \\[`` …),
+    character classes ``[a-z0-9_]`` with ranges and ``^`` negation (ASCII
+    members only), grouping ``()``, alternation ``|``, and quantifiers
+    ``* + ?`` / ``{m} {m,} {m,n}``. Matching is ANCHORED at both ends —
+    the whole generated output must match, the only sensible contract for
+    generation. EOS becomes legal exactly at accepting states."""
+
+    _MAX_NODES = 10_000
+    # '.', negated classes, and negated escapes complement within ASCII:
+    # bytes 0x80-0xFF are UTF-8 continuation/lead fragments, and making a
+    # lone one legal would force-sample undecodable output. Non-ASCII
+    # characters still match as LITERALS (their full byte sequence).
+    _ASCII = frozenset(range(0x80))
+
+    def __init__(self, pattern: str):
+        self.pattern = pattern
+        self._trans: List[dict] = []      # node -> {byte: tuple(targets)}
+        self._eps: List[list] = []        # node -> [targets]
+        ast, i = self._parse_alt(pattern, 0)
+        if i != len(pattern):
+            raise ValueError(f"regex: unexpected {pattern[i]!r} at {i}")
+        start, end = self._compile(ast)
+        self._accept = end
+        self._start_closure = self._closure({start})
+        # Precompute eps-closures per node for fast advance.
+        self._node_closure = [self._closure({n})
+                              for n in range(len(self._trans))]
+
+    # -- parsing (recursive descent to a tuple AST) --
+
+    def _parse_alt(self, p: str, i: int):
+        branches = []
+        node, i = self._parse_cat(p, i)
+        branches.append(node)
+        while i < len(p) and p[i] == "|":
+            node, i = self._parse_cat(p, i + 1)
+            branches.append(node)
+        return (branches[0] if len(branches) == 1
+                else ("alt", branches)), i
+
+    def _parse_cat(self, p: str, i: int):
+        items = []
+        while i < len(p) and p[i] not in "|)":
+            atom, i = self._parse_atom(p, i)
+            atom, i = self._parse_quant(p, i, atom)
+            items.append(atom)
+        if len(items) == 1:
+            return items[0], i
+        return ("cat", items), i
+
+    def _parse_atom(self, p: str, i: int):
+        c = p[i]
+        if c == "(":
+            node, i = self._parse_alt(p, i + 1)
+            if i >= len(p) or p[i] != ")":
+                raise ValueError("regex: unbalanced '('")
+            return node, i + 1
+        if c == "[":
+            return self._parse_class(p, i + 1)
+        if c == ".":
+            return ("class", self._ASCII - {0x0A}), i + 1
+        if c == "\\":
+            if i + 1 >= len(p):
+                raise ValueError("regex: dangling backslash")
+            return self._escape(p[i + 1]), i + 2
+        if c in ")|*+?{":
+            raise ValueError(f"regex: unexpected {c!r} at {i}")
+        return self._literal(c), i + 1
+
+    @staticmethod
+    def _literal(c: str):
+        bs = c.encode("utf-8")
+        if len(bs) == 1:
+            return ("lit", bs[0])
+        return ("cat", [("lit", b) for b in bs])
+
+    _ESCAPE_CLASSES = {
+        "d": frozenset(b"0123456789"),
+        "w": frozenset(b"abcdefghijklmnopqrstuvwxyz"
+                       b"ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_"),
+        "s": frozenset(b" \t\n\r\f\v"),
+    }
+    _ESCAPE_LITERALS = {"n": 0x0A, "t": 0x09, "r": 0x0D}
+
+    def _escape(self, c: str):
+        if c in self._ESCAPE_CLASSES:
+            return ("class", self._ESCAPE_CLASSES[c])
+        if c.isupper() and c.lower() in self._ESCAPE_CLASSES:
+            # Negated escapes complement within ASCII: bytes >= 0x80 are
+            # UTF-8 fragments — legalizing a lone continuation byte would
+            # let the engine emit invalid UTF-8 (see _ASCII note).
+            return ("class",
+                    self._ASCII - self._ESCAPE_CLASSES[c.lower()])
+        if c in self._ESCAPE_LITERALS:
+            return ("lit", self._ESCAPE_LITERALS[c])
+        if ord(c) < 128:
+            return ("lit", ord(c))
+        raise ValueError(f"regex: unsupported escape \\{c}")
+
+    def _parse_class(self, p: str, i: int):
+        negate = i < len(p) and p[i] == "^"
+        if negate:
+            i += 1
+        members = set()
+        first = True
+        while i < len(p) and (p[i] != "]" or first):
+            first = False
+            if p[i] == "\\":
+                if i + 1 >= len(p):
+                    raise ValueError("regex: dangling backslash in class")
+                e = self._escape(p[i + 1])
+                members |= (e[1] if e[0] == "class" else {e[1]})
+                i += 2
+                continue
+            c = p[i]
+            if ord(c) > 127:
+                raise ValueError("regex: non-ASCII in character class")
+            if i + 2 < len(p) and p[i + 1] == "-" and p[i + 2] != "]":
+                hi = p[i + 2]
+                if ord(hi) > 127 or ord(hi) < ord(c):
+                    raise ValueError(f"regex: bad range {c}-{hi}")
+                members |= set(range(ord(c), ord(hi) + 1))
+                i += 3
+            else:
+                members.add(ord(c))
+                i += 1
+        if i >= len(p):
+            raise ValueError("regex: unterminated '['")
+        if negate:
+            members = self._ASCII - members
+        return ("class", frozenset(members)), i + 1
+
+    def _parse_quant(self, p: str, i: int, atom):
+        if i >= len(p):
+            return atom, i
+        c = p[i]
+        if c == "*":
+            return ("rep", atom, 0, None), i + 1
+        if c == "+":
+            return ("rep", atom, 1, None), i + 1
+        if c == "?":
+            return ("rep", atom, 0, 1), i + 1
+        if c == "{":
+            j = p.find("}", i)
+            if j < 0:
+                raise ValueError("regex: unterminated '{'")
+            body = p[i + 1:j]
+            try:
+                if "," not in body:
+                    lo = hi = int(body)
+                else:
+                    lo_s, hi_s = body.split(",", 1)
+                    lo = int(lo_s)
+                    hi = int(hi_s) if hi_s else None
+            except ValueError:
+                raise ValueError(f"regex: bad quantifier {{{body}}}") from None
+            if hi is not None and hi < lo:
+                raise ValueError(f"regex: bad quantifier {{{body}}}")
+            return ("rep", atom, lo, hi), j + 1
+        return atom, i
+
+    # -- NFA construction --
+
+    def _node(self) -> int:
+        if len(self._trans) >= self._MAX_NODES:
+            raise ValueError("regex: pattern too large")
+        self._trans.append({})
+        self._eps.append([])
+        return len(self._trans) - 1
+
+    def _compile(self, ast):
+        """Returns (start, end) node ids; fresh nodes per call so ``rep``
+        expansion can instantiate the body repeatedly."""
+        kind = ast[0]
+        if kind == "lit":
+            s, e = self._node(), self._node()
+            self._trans[s].setdefault(ast[1], [])
+            self._trans[s][ast[1]].append(e)
+            return s, e
+        if kind == "class":
+            s, e = self._node(), self._node()
+            for b in ast[1]:
+                self._trans[s].setdefault(b, []).append(e)
+            return s, e
+        if kind == "cat":
+            if not ast[1]:
+                s = self._node()
+                return s, s
+            s, e = self._compile(ast[1][0])
+            for item in ast[1][1:]:
+                s2, e2 = self._compile(item)
+                self._eps[e].append(s2)
+                e = e2
+            return s, e
+        if kind == "alt":
+            s, e = self._node(), self._node()
+            for branch in ast[1]:
+                bs, be = self._compile(branch)
+                self._eps[s].append(bs)
+                self._eps[be].append(e)
+            return s, e
+        if kind == "rep":
+            _, body, lo, hi = ast
+            s = self._node()
+            cur = s
+            for _ in range(lo):
+                bs, be = self._compile(body)
+                self._eps[cur].append(bs)
+                cur = be
+            if hi is None:                      # unbounded tail: loop
+                bs, be = self._compile(body)
+                self._eps[cur].append(bs)
+                self._eps[be].append(bs)
+                end = self._node()
+                self._eps[cur].append(end)
+                self._eps[be].append(end)
+                return s, end
+            end = self._node()
+            self._eps[cur].append(end)
+            for _ in range(hi - lo):            # optional copies
+                bs, be = self._compile(body)
+                self._eps[cur].append(bs)
+                self._eps[be].append(end)
+                cur = be
+            return s, end
+        raise AssertionError(kind)
+
+    def _closure(self, nodes) -> frozenset:
+        out = set(nodes)
+        stack = list(nodes)
+        while stack:
+            n = stack.pop()
+            for t in self._eps[n]:
+                if t not in out:
+                    out.add(t)
+                    stack.append(t)
+        return frozenset(out)
+
+    # -- the JsonGrammar-compatible contract --
+
+    def initial(self) -> frozenset:
+        return self._start_closure
+
+    def advance(self, state, b: int):
+        nxt = set()
+        for n in state:
+            for t in self._trans[n].get(b, ()):
+                nxt |= self._node_closure[t]
+        return frozenset(nxt) if nxt else None
+
+    def is_complete(self, state) -> bool:
+        return self._accept in state
+
+
 class TokenTrie:
     """Byte-path trie over a token→bytes table, compiled once per
     tokenizer (the xgrammar move). Nodes are parallel lists:
@@ -274,13 +537,16 @@ class TokenGrammar:
     # ~3 MB, not ~25 MB of bool arrays.
     MASK_CACHE_SIZE = 256
 
-    def __init__(self, grammar: JsonGrammar, token_bytes: List[Optional[bytes]],
-                 eos_id: Optional[int]):
+    def __init__(self, grammar, token_bytes: List[Optional[bytes]],
+                 eos_id: Optional[int], trie: Optional[TokenTrie] = None):
         self.grammar = grammar
         self.token_bytes = token_bytes
         self.eos_id = eos_id
         self.V = len(token_bytes)
-        self.trie = TokenTrie(token_bytes)
+        # The trie depends only on the TOKENIZER — callers juggling many
+        # grammars over one vocab (per-pattern regex cache) pass the one
+        # shared instance instead of rebuilding O(vocab bytes) each time.
+        self.trie = trie if trie is not None else TokenTrie(token_bytes)
         self._mask_cache: "OrderedDict[State, np.ndarray]" = OrderedDict()
         self.stats = {"mask_calls": 0, "mask_cache_hits": 0,
                       "advance_calls": 0}

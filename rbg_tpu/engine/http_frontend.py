@@ -216,6 +216,10 @@ class Handler(BaseHTTPRequestHandler):
             out["logprobs"] = True
         if body.get("lora"):
             out["lora"] = str(body["lora"])
+        # Regex-constrained output (sglang `regex` / vLLM `guided_regex`).
+        regex = body.get("regex") or body.get("guided_regex")
+        if regex:
+            out["regex"] = str(regex)
         rf = body.get("response_format")
         if rf is not None:
             rft = rf.get("type") if isinstance(rf, dict) else None

@@ -457,8 +457,8 @@ class Handler(socketserver.BaseRequestHandler):
             pf_req = {"op": "prefill", "prompt": obj["prompt"]}
             for key in ("temperature", "top_k", "top_p", "min_p",
                         "repetition_penalty", "presence_penalty",
-                        "frequency_penalty", "seed", "json_mode", "lora",
-                        "stop_token", "token"):
+                        "frequency_penalty", "seed", "json_mode", "regex",
+                        "lora", "stop_token", "token"):
                 if key in obj:
                     pf_req[key] = obj[key]
             # Cache affinity on the prefill leg: the replica that served
@@ -473,7 +473,7 @@ class Handler(socketserver.BaseRequestHandler):
             for key in ("max_new_tokens", "temperature", "top_k", "top_p",
                         "min_p", "repetition_penalty", "presence_penalty",
                         "frequency_penalty", "seed", "logprobs", "json_mode",
-                        "lora", "stop_token", "stream", "token"):
+                        "regex", "lora", "stop_token", "stream", "token"):
                 if key in obj:
                     fwd[key] = obj[key]
             # Decode replicas hold no prefix cache — no affinity prompt.

@@ -1,0 +1,206 @@
+"""Regex-constrained decoding (`regex` sampling param): the byte-level
+NFA grammar, trie-mask exactness, engine integration (every finished
+output matches the anchored pattern), composition with speculative
+decoding, and admission errors for bad patterns."""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from rbg_tpu.engine import Engine, EngineConfig, SamplingParams
+from rbg_tpu.engine.grammar import (RegexGrammar, TokenGrammar,
+                                    token_bytes_for)
+from rbg_tpu.engine.tokenizer import ByteTokenizer
+from rbg_tpu.models import get_config, init_params
+
+
+# ---- automaton semantics vs Python re (anchored) ----
+
+
+@pytest.mark.parametrize("pattern,accept,reject", [
+    (r"\d{3}-\d{4}", ["555-1234"], ["555-123", "5551-234", "x55-1234"]),
+    (r"(yes|no)", ["yes", "no"], ["", "y", "yesno"]),
+    (r"[A-Fa-f0-9]{2,8}", ["deadBEEF", "00"], ["0", "deadbeef0x"]),
+    (r"-?\d+(\.\d+)?", ["-3.14", "42"], ["3.", ".5", "-"]),
+    (r"[^ ]+@[^ ]+", ["a@b"], ["a@", " a@b"]),
+    (r"a+b?", ["a", "aab"], ["b", "abb"]),
+])
+def test_regex_grammar_matches_re_semantics(pattern, accept, reject):
+    g = RegexGrammar(pattern)
+
+    def full(s):
+        st = g.initial()
+        for b in s.encode():
+            st = g.advance(st, b)
+            if st is None:
+                return False
+        return g.is_complete(st)
+
+    for s in accept:
+        assert re.fullmatch(pattern, s), f"test vector wrong: {s}"
+        assert full(s), f"{pattern} should accept {s}"
+    for s in reject:
+        assert not re.fullmatch(pattern, s), f"test vector wrong: {s}"
+        assert not full(s), f"{pattern} should reject {s}"
+
+
+def test_regex_negated_escapes_and_utf8_safety():
+    """\\D / \\W / \\S are real negated classes (not literal letters), and
+    '.', negated classes, and negated escapes stay within ASCII so the
+    mask can never force-sample a lone UTF-8 fragment byte."""
+    g = RegexGrammar(r"\D")
+    assert g.advance(g.initial(), ord("x")) is not None
+    assert g.advance(g.initial(), ord("5")) is None
+    gw = RegexGrammar(r"\W")
+    assert gw.advance(gw.initial(), ord("!")) is not None
+    assert gw.advance(gw.initial(), ord("a")) is None
+    for pat in (r".", r"[^0-9]", r"\S"):
+        gp = RegexGrammar(pat)
+        assert gp.advance(gp.initial(), 0x80) is None, pat  # UTF-8 fragment
+    # Non-ASCII literals still match their full byte sequence.
+    gl = RegexGrammar("é+")
+    st = gl.initial()
+    for b in "éé".encode():
+        st = gl.advance(st, b)
+        assert st is not None
+    assert gl.is_complete(st)
+
+
+def test_regex_grammars_share_one_trie(eng_factory):
+    eng = eng_factory()
+    g1 = eng._regex_grammar(r"\d+")
+    g2 = eng._regex_grammar(r"[a-z]+")
+    assert g1.trie is g2.trie is eng.grammar.trie
+
+
+def test_regex_cache_is_lru_not_fifo(eng_factory):
+    eng = eng_factory()
+    eng._REGEX_GRAMMAR_CACHE = 2
+    hot = eng._regex_grammar(r"\d+")
+    eng._regex_grammar(r"[a-z]+")
+    eng._regex_grammar(r"\d+")        # refresh the hot pattern
+    eng._regex_grammar(r"[A-Z]+")     # evicts [a-z]+, not the hot one
+    assert eng._regex_grammar(r"\d+") is hot
+
+
+def test_regex_bad_patterns_raise():
+    for bad in ["(open", "a{3,1}", "[z-a]", "*lead", "x{bad}", "[unterm",
+                "trail\\"]:
+        with pytest.raises(ValueError):
+            RegexGrammar(bad)
+
+
+def test_regex_trie_mask_equals_probe():
+    tok = ByteTokenizer()
+    tg = TokenGrammar(RegexGrammar(r"(GET|POST) /[a-z/]* HTTP"),
+                      token_bytes_for(tok), tok.eos_id)
+    s = tg.initial()
+    for b in b"GET /api/":
+        np.testing.assert_array_equal(tg.mask(s), tg._mask_probe(s))
+        s = tg.grammar.advance(s, b)
+        assert s is not None
+    np.testing.assert_array_equal(tg.mask(s), tg._mask_probe(s))
+
+
+# ---- engine integration ----
+
+
+@pytest.fixture(scope="module")
+def eng_factory():
+    cfg = get_config("tiny", vocab_size=512)
+    params = init_params(cfg, jax.random.key(0))
+
+    def make(**kw):
+        e = Engine(EngineConfig(model="tiny", vocab_size=512, page_size=8,
+                                num_pages=128, max_seq_len=256,
+                                use_pallas="never", **kw), params=params)
+        e.mcfg = cfg
+        e.enable_json_grammar(ByteTokenizer())
+        return e
+
+    return make
+
+
+PATTERNS = [r"\d{3}-\d{4}", r"(alpha|beta|gamma)", r"[a-f]{4,12}"]
+
+
+def test_regex_outputs_match_pattern(eng_factory):
+    eng = eng_factory()
+    tok = ByteTokenizer()
+    for seed, pattern in enumerate(PATTERNS):
+        rid = eng.add_request(
+            tok.encode("value:"),
+            SamplingParams(max_new_tokens=24, temperature=0.9, seed=seed,
+                           regex=pattern, stop_token=tok.eos_id))
+        out = []
+        while eng.has_work():
+            for ev in eng.step():
+                if ev.request_id == rid:
+                    out.append(ev.token)
+        text = tok.decode(out)
+        assert re.fullmatch(pattern, text), (pattern, text)
+
+
+def test_regex_composes_with_speculative(eng_factory):
+    eng = eng_factory(speculative="ngram", spec_k=4, spec_ngram=3)
+    tok = ByteTokenizer()
+    pattern = r"(ab)+c"
+    rid = eng.add_request(
+        tok.encode("repeat: ababab"),
+        SamplingParams(max_new_tokens=20, temperature=0.8, seed=3,
+                       regex=pattern, stop_token=tok.eos_id))
+    out = []
+    while eng.has_work():
+        for ev in eng.step():
+            if ev.request_id == rid:
+                out.append(ev.token)
+    assert re.fullmatch(pattern, tok.decode(out))
+
+
+def test_regex_mixed_batch_leaves_unconstrained_rows_alone(eng_factory):
+    """A regex row and a plain greedy row decode together; the greedy
+    row's output is identical to a solo run (constrained rows must not
+    perturb the fused path)."""
+    eng = eng_factory()
+    tok = ByteTokenizer()
+    solo = eng_factory()
+    prompt = tok.encode("hello world")
+    ref = solo.generate([prompt], SamplingParams(max_new_tokens=12))[0]
+
+    rid_free = eng.add_request(prompt, SamplingParams(max_new_tokens=12))
+    rid_re = eng.add_request(
+        tok.encode("id:"),
+        SamplingParams(max_new_tokens=16, temperature=0.7, seed=1,
+                       regex=r"\d+", stop_token=tok.eos_id))
+    outs = {rid_free: [], rid_re: []}
+    while eng.has_work():
+        for ev in eng.step():
+            outs[ev.request_id].append(ev.token)
+    assert outs[rid_free] == ref
+    assert re.fullmatch(r"\d+", tok.decode(outs[rid_re]))
+
+
+def test_regex_admission_errors(eng_factory):
+    eng = eng_factory()
+    with pytest.raises(ValueError, match="regex"):
+        eng.add_request([1, 2], SamplingParams(max_new_tokens=4,
+                                               regex="(bad"))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        SamplingParams(max_new_tokens=4, json_mode=True,
+                       regex=r"\d+").validate()
+    bare = Engine(EngineConfig(model="tiny", vocab_size=512, page_size=8,
+                               num_pages=64, max_seq_len=128,
+                               use_pallas="never"))
+    with pytest.raises(ValueError, match="grammar table"):
+        bare.add_request([1, 2], SamplingParams(max_new_tokens=4,
+                                                regex=r"\d+"))
+
+
+def test_regex_pattern_cache_reused(eng_factory):
+    eng = eng_factory()
+    g1 = eng._regex_grammar(r"\d+")
+    g2 = eng._regex_grammar(r"\d+")
+    assert g1 is g2
+    assert len(eng._regex_grammars) == 1

@@ -101,6 +101,9 @@ class SamplingParams:
     json_mode: bool = False         # grammar-constrained: output is valid JSON
     regex: Optional[str] = None     # grammar-constrained: output matches
                                     # this anchored byte-level regex
+    json_schema: Optional[dict] = None  # grammar-constrained: output is
+                                        # compact JSON valid under this
+                                        # schema subset (guided_json)
     lora: Optional[str] = None      # adapter name (engine-registered)
     stop_token: Optional[int] = None
 
@@ -120,9 +123,12 @@ class SamplingParams:
             raise ValueError("min_p must be in [0, 1)")
         if self.repetition_penalty <= 0:
             raise ValueError("repetition_penalty must be > 0")
-        if self.json_mode and self.regex:
-            raise ValueError("json_mode and regex are mutually exclusive "
-                             "constraints")
+        constraints = ((1 if self.json_mode else 0)
+                       + (1 if self.regex is not None else 0)
+                       + (1 if self.json_schema is not None else 0))
+        if constraints > 1:
+            raise ValueError("json_mode, regex, and json_schema are "
+                             "mutually exclusive constraints")
 
     @classmethod
     def from_wire(cls, obj: dict, *, default_max_tokens: int = 16,
@@ -141,7 +147,13 @@ class SamplingParams:
             seed=(int(obj["seed"]) if obj.get("seed") is not None else None),
             logprobs=bool(obj.get("logprobs", False)),
             json_mode=bool(obj.get("json_mode", False)),
-            regex=(str(obj["regex"]) if obj.get("regex") else None),
+            # `is not None` checks: regex="" means "empty output only" and
+            # json_schema={} means "any JSON" — truthiness would silently
+            # drop both and return UNCONSTRAINED output.
+            regex=(str(obj["regex"]) if obj.get("regex") is not None
+                   else None),
+            json_schema=(dict(obj["json_schema"])
+                         if obj.get("json_schema") is not None else None),
             lora=(str(obj["lora"]) if obj.get("lora") else None),
             stop_token=(obj.get("stop_token") if obj.get("stop_token") is None
                         else int(obj["stop_token"])),

@@ -23,8 +23,10 @@ rbg_tpu.engine.pd for the disaggregated pair.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import itertools
+import json
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -124,8 +126,7 @@ class Engine:
         self._token_bytes = None
         self._grammar_eos = None
         self._token_trie = None
-        from collections import OrderedDict as _OD
-        self._regex_grammars = _OD()
+        self._regex_grammars = collections.OrderedDict()
         # Events drained outside step() (e.g. a runtime load_lora must
         # flush the fused pipeline) surface on the NEXT step() call.
         self._deferred_events: List[StepEvent] = []
@@ -187,33 +188,47 @@ class Engine:
         self.grammar = TokenGrammar(JsonGrammar(), self._token_bytes,
                                     self._grammar_eos,
                                     trie=self._token_trie)
-        from collections import OrderedDict
-        self._regex_grammars = OrderedDict()
+        self._regex_grammars = collections.OrderedDict()
 
     _REGEX_GRAMMAR_CACHE = 64
 
     def _regex_grammar(self, pattern: str):
-        """Per-pattern compiled TokenGrammar (NFA + trie + mask cache),
-        LRU-bounded — repeat patterns (the common case: one schema per
-        client) pay compilation once. Raises ValueError on bad patterns
-        (an admission error, never a loop failure)."""
-        from rbg_tpu.engine.grammar import RegexGrammar, TokenGrammar
-        tg = self._regex_grammars.get(pattern)
+        return self._compiled_grammar(("re", pattern))
+
+    def _compiled_grammar(self, key, schema: Optional[dict] = None):
+        """Per-pattern/per-schema compiled TokenGrammar (NFA + shared
+        trie + mask cache), LRU-bounded — repeat constraints (the common
+        case: one schema per client) pay compilation once. Raises
+        ValueError on bad inputs (an admission error, never a loop
+        failure)."""
+        from rbg_tpu.engine.grammar import (JsonSchemaGrammar, RegexGrammar,
+                                            TokenGrammar)
+        tg = self._regex_grammars.get(key)
         if tg is not None:
-            self._regex_grammars.move_to_end(pattern)  # LRU refresh
+            self._regex_grammars.move_to_end(key)  # LRU refresh
             return tg
-        tg = TokenGrammar(RegexGrammar(pattern), self._token_bytes,
+        byte_grammar = (RegexGrammar(key[1]) if key[0] == "re"
+                        else JsonSchemaGrammar(schema))
+        tg = TokenGrammar(byte_grammar, self._token_bytes,
                           self._grammar_eos, trie=self._token_trie)
         if len(self._regex_grammars) >= self._REGEX_GRAMMAR_CACHE:
             self._regex_grammars.popitem(last=False)
-        self._regex_grammars[pattern] = tg
+        self._regex_grammars[key] = tg
         return tg
 
     def _grammar_for(self, sampling: SamplingParams):
         if sampling.json_mode:
             return self.grammar
-        if sampling.regex:
-            return self._regex_grammar(sampling.regex)
+        if sampling.regex is not None:
+            return self._compiled_grammar(("re", sampling.regex))
+        if sampling.json_schema is not None:
+            if not sampling.json_schema:
+                return self.grammar   # {} = "any JSON" (vLLM semantics)
+            # Key preserves property ORDER (no sort_keys): compilation is
+            # order-sensitive — properties emit in declaration order, so
+            # order-differing schemas must not share a grammar.
+            key = ("schema", json.dumps(sampling.json_schema))
+            return self._compiled_grammar(key, sampling.json_schema)
         return None
 
     _LORA_ATTN_TARGETS = ("wq", "wk", "wv", "wo")
@@ -319,12 +334,15 @@ class Engine:
         return slot
 
     def _grammar_check(self, sampling: SamplingParams) -> None:
-        if (sampling.json_mode or sampling.regex) and self.grammar is None:
+        constrained = (sampling.json_mode or sampling.regex is not None
+                       or sampling.json_schema is not None)
+        if constrained and self.grammar is None:
             raise ValueError(
-                "json_mode/regex require a grammar table — the server "
-                "wires it from the tokenizer (enable_json_grammar)")
-        if sampling.regex:
-            self._regex_grammar(sampling.regex)  # bad pattern → admission error
+                "json_mode/regex/json_schema require a grammar table — the "
+                "server wires it from the tokenizer (enable_json_grammar)")
+        if constrained:
+            # Bad pattern/schema → admission error, never a loop failure.
+            self._grammar_for(sampling)
 
     def _gmask(self, grammar, state) -> np.ndarray:
         """Grammar mask padded to MODEL vocab: ids beyond the tokenizer's

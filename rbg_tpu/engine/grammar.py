@@ -34,6 +34,7 @@ state they visit.
 
 from __future__ import annotations
 
+import json
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
@@ -233,21 +234,14 @@ class JsonGrammar:
         return False
 
 
-class RegexGrammar:
-    """Byte-level regex automaton for constrained decoding (the ``regex``
-    sampling param — vLLM guided_regex / sglang regex analog). Compiles a
-    practical, ASCII-oriented subset to a Thompson NFA whose state (a
-    frozenset of node ids — hashable) rides the same ``TokenGrammar`` /
-    trie / packed-mask-cache machinery as JSON mode.
-
-    Supported syntax: literal characters (non-ASCII literals match their
-    UTF-8 bytes in sequence), ``.`` (any byte except newline), escapes
-    ``\\d \\w \\s \\n \\t \\r`` and literal-escapes (``\\. \\[`` …),
-    character classes ``[a-z0-9_]`` with ranges and ``^`` negation (ASCII
-    members only), grouping ``()``, alternation ``|``, and quantifiers
-    ``* + ?`` / ``{m} {m,} {m,n}``. Matching is ANCHORED at both ends —
-    the whole generated output must match, the only sensible contract for
-    generation. EOS becomes legal exactly at accepting states."""
+class NfaGrammar:
+    """Byte-level Thompson-NFA grammar base: compiles a tuple AST
+    (``("lit", byte)``, ``("class", frozenset)``, ``("cat", [...])``,
+    ``("alt", [...])``, ``("rep", node, lo, hi|None)``) and exposes the
+    same ``initial``/``advance``/``is_complete`` contract as JsonGrammar —
+    state is a frozenset of node ids (hashable), so the ``TokenGrammar``
+    trie walk and packed mask cache apply unchanged. Subclasses build the
+    AST (the regex parser, the JSON-Schema compiler)."""
 
     _MAX_NODES = 10_000
     # '.', negated classes, and negated escapes complement within ASCII:
@@ -256,13 +250,9 @@ class RegexGrammar:
     # characters still match as LITERALS (their full byte sequence).
     _ASCII = frozenset(range(0x80))
 
-    def __init__(self, pattern: str):
-        self.pattern = pattern
-        self._trans: List[dict] = []      # node -> {byte: tuple(targets)}
+    def __init__(self, ast):
+        self._trans: List[dict] = []      # node -> {byte: [targets]}
         self._eps: List[list] = []        # node -> [targets]
-        ast, i = self._parse_alt(pattern, 0)
-        if i != len(pattern):
-            raise ValueError(f"regex: unexpected {pattern[i]!r} at {i}")
         start, end = self._compile(ast)
         self._accept = end
         self._start_closure = self._closure({start})
@@ -270,144 +260,11 @@ class RegexGrammar:
         self._node_closure = [self._closure({n})
                               for n in range(len(self._trans))]
 
-    # -- parsing (recursive descent to a tuple AST) --
-
-    def _parse_alt(self, p: str, i: int):
-        branches = []
-        node, i = self._parse_cat(p, i)
-        branches.append(node)
-        while i < len(p) and p[i] == "|":
-            node, i = self._parse_cat(p, i + 1)
-            branches.append(node)
-        return (branches[0] if len(branches) == 1
-                else ("alt", branches)), i
-
-    def _parse_cat(self, p: str, i: int):
-        items = []
-        while i < len(p) and p[i] not in "|)":
-            atom, i = self._parse_atom(p, i)
-            atom, i = self._parse_quant(p, i, atom)
-            items.append(atom)
-        if len(items) == 1:
-            return items[0], i
-        return ("cat", items), i
-
-    def _parse_atom(self, p: str, i: int):
-        c = p[i]
-        if c == "(":
-            node, i = self._parse_alt(p, i + 1)
-            if i >= len(p) or p[i] != ")":
-                raise ValueError("regex: unbalanced '('")
-            return node, i + 1
-        if c == "[":
-            return self._parse_class(p, i + 1)
-        if c == ".":
-            return ("class", self._ASCII - {0x0A}), i + 1
-        if c == "\\":
-            if i + 1 >= len(p):
-                raise ValueError("regex: dangling backslash")
-            return self._escape(p[i + 1]), i + 2
-        if c in ")|*+?{":
-            raise ValueError(f"regex: unexpected {c!r} at {i}")
-        return self._literal(c), i + 1
-
-    @staticmethod
-    def _literal(c: str):
-        bs = c.encode("utf-8")
-        if len(bs) == 1:
-            return ("lit", bs[0])
-        return ("cat", [("lit", b) for b in bs])
-
-    _ESCAPE_CLASSES = {
-        "d": frozenset(b"0123456789"),
-        "w": frozenset(b"abcdefghijklmnopqrstuvwxyz"
-                       b"ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_"),
-        "s": frozenset(b" \t\n\r\f\v"),
-    }
-    _ESCAPE_LITERALS = {"n": 0x0A, "t": 0x09, "r": 0x0D}
-
-    def _escape(self, c: str):
-        if c in self._ESCAPE_CLASSES:
-            return ("class", self._ESCAPE_CLASSES[c])
-        if c.isupper() and c.lower() in self._ESCAPE_CLASSES:
-            # Negated escapes complement within ASCII: bytes >= 0x80 are
-            # UTF-8 fragments — legalizing a lone continuation byte would
-            # let the engine emit invalid UTF-8 (see _ASCII note).
-            return ("class",
-                    self._ASCII - self._ESCAPE_CLASSES[c.lower()])
-        if c in self._ESCAPE_LITERALS:
-            return ("lit", self._ESCAPE_LITERALS[c])
-        if ord(c) < 128:
-            return ("lit", ord(c))
-        raise ValueError(f"regex: unsupported escape \\{c}")
-
-    def _parse_class(self, p: str, i: int):
-        negate = i < len(p) and p[i] == "^"
-        if negate:
-            i += 1
-        members = set()
-        first = True
-        while i < len(p) and (p[i] != "]" or first):
-            first = False
-            if p[i] == "\\":
-                if i + 1 >= len(p):
-                    raise ValueError("regex: dangling backslash in class")
-                e = self._escape(p[i + 1])
-                members |= (e[1] if e[0] == "class" else {e[1]})
-                i += 2
-                continue
-            c = p[i]
-            if ord(c) > 127:
-                raise ValueError("regex: non-ASCII in character class")
-            if i + 2 < len(p) and p[i + 1] == "-" and p[i + 2] != "]":
-                hi = p[i + 2]
-                if ord(hi) > 127 or ord(hi) < ord(c):
-                    raise ValueError(f"regex: bad range {c}-{hi}")
-                members |= set(range(ord(c), ord(hi) + 1))
-                i += 3
-            else:
-                members.add(ord(c))
-                i += 1
-        if i >= len(p):
-            raise ValueError("regex: unterminated '['")
-        if negate:
-            members = self._ASCII - members
-        return ("class", frozenset(members)), i + 1
-
-    def _parse_quant(self, p: str, i: int, atom):
-        if i >= len(p):
-            return atom, i
-        c = p[i]
-        if c == "*":
-            return ("rep", atom, 0, None), i + 1
-        if c == "+":
-            return ("rep", atom, 1, None), i + 1
-        if c == "?":
-            return ("rep", atom, 0, 1), i + 1
-        if c == "{":
-            j = p.find("}", i)
-            if j < 0:
-                raise ValueError("regex: unterminated '{'")
-            body = p[i + 1:j]
-            try:
-                if "," not in body:
-                    lo = hi = int(body)
-                else:
-                    lo_s, hi_s = body.split(",", 1)
-                    lo = int(lo_s)
-                    hi = int(hi_s) if hi_s else None
-            except ValueError:
-                raise ValueError(f"regex: bad quantifier {{{body}}}") from None
-            if hi is not None and hi < lo:
-                raise ValueError(f"regex: bad quantifier {{{body}}}")
-            return ("rep", atom, lo, hi), j + 1
-        return atom, i
-
     # -- NFA construction --
 
     def _node(self) -> int:
         if len(self._trans) >= self._MAX_NODES:
-            raise ValueError("regex: pattern too large")
+            raise ValueError("grammar: pattern/schema too large")
         self._trans.append({})
         self._eps.append([])
         return len(self._trans) - 1
@@ -480,6 +337,14 @@ class RegexGrammar:
                     stack.append(t)
         return frozenset(out)
 
+    # -- AST helpers shared by subclasses --
+
+    @staticmethod
+    def _lit_bytes(bs: bytes):
+        if len(bs) == 1:
+            return ("lit", bs[0])
+        return ("cat", [("lit", b) for b in bs])
+
     # -- the JsonGrammar-compatible contract --
 
     def initial(self) -> frozenset:
@@ -494,6 +359,362 @@ class RegexGrammar:
 
     def is_complete(self, state) -> bool:
         return self._accept in state
+
+
+class RegexGrammar(NfaGrammar):
+    """Byte-level regex automaton for constrained decoding (the ``regex``
+    sampling param — vLLM guided_regex / sglang regex analog). Compiles a
+    practical, ASCII-oriented subset to a Thompson NFA.
+
+    Supported syntax: literal characters (non-ASCII literals match their
+    UTF-8 bytes in sequence), ``.`` (any byte except newline), escapes
+    ``\\d \\w \\s \\n \\t \\r`` and literal-escapes (``\\. \\[`` …),
+    character classes ``[a-z0-9_]`` with ranges and ``^`` negation (ASCII
+    members only), grouping ``()``, alternation ``|``, and quantifiers
+    ``* + ?`` / ``{m} {m,} {m,n}``. Matching is ANCHORED at both ends —
+    the whole generated output must match, the only sensible contract for
+    generation. EOS becomes legal exactly at accepting states."""
+
+    def __init__(self, pattern: str):
+        self.pattern = pattern
+        super().__init__(self.parse_ast(pattern))
+
+    @classmethod
+    def parse_ast(cls, pattern: str):
+        """Parse a pattern to the shared AST without building an NFA —
+        the JSON-Schema compiler embeds string ``pattern`` constraints."""
+        self = object.__new__(cls)
+        ast, i = self._parse_alt(pattern, 0)
+        if i != len(pattern):
+            raise ValueError(f"regex: unexpected {pattern[i]!r} at {i}")
+        return ast
+
+    # -- parsing (recursive descent to a tuple AST) --
+
+    def _parse_alt(self, p: str, i: int):
+        branches = []
+        node, i = self._parse_cat(p, i)
+        branches.append(node)
+        while i < len(p) and p[i] == "|":
+            node, i = self._parse_cat(p, i + 1)
+            branches.append(node)
+        return (branches[0] if len(branches) == 1
+                else ("alt", branches)), i
+
+    def _parse_cat(self, p: str, i: int):
+        items = []
+        while i < len(p) and p[i] not in "|)":
+            atom, i = self._parse_atom(p, i)
+            atom, i = self._parse_quant(p, i, atom)
+            items.append(atom)
+        if len(items) == 1:
+            return items[0], i
+        return ("cat", items), i
+
+    def _parse_atom(self, p: str, i: int):
+        c = p[i]
+        if c == "(":
+            node, i = self._parse_alt(p, i + 1)
+            if i >= len(p) or p[i] != ")":
+                raise ValueError("regex: unbalanced '('")
+            return node, i + 1
+        if c == "[":
+            return self._parse_class(p, i + 1)
+        if c == ".":
+            return ("class", self._ASCII - {0x0A}), i + 1
+        if c == "\\":
+            if i + 1 >= len(p):
+                raise ValueError("regex: dangling backslash")
+            return self._escape(p[i + 1]), i + 2
+        if c in ")|*+?{":
+            raise ValueError(f"regex: unexpected {c!r} at {i}")
+        return self._literal(c), i + 1
+
+    @staticmethod
+    def _literal(c: str):
+        bs = c.encode("utf-8")
+        if len(bs) == 1:
+            return ("lit", bs[0])
+        return ("cat", [("lit", b) for b in bs])
+
+    _ESCAPE_CLASSES = {
+        "d": frozenset(b"0123456789"),
+        "w": frozenset(b"abcdefghijklmnopqrstuvwxyz"
+                       b"ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_"),
+        "s": frozenset(b" \t\n\r\f\v"),
+    }
+    _ESCAPE_LITERALS = {"n": 0x0A, "t": 0x09, "r": 0x0D}
+
+    def _escape(self, c: str):
+        if c in self._ESCAPE_CLASSES:
+            return ("class", self._ESCAPE_CLASSES[c])
+        if c.isupper() and c.lower() in self._ESCAPE_CLASSES:
+            # Negated escapes complement within ASCII: bytes >= 0x80 are
+            # UTF-8 fragments — legalizing a lone continuation byte would
+            # let the engine emit invalid UTF-8 (see _ASCII note).
+            return ("class",
+                    self._ASCII - self._ESCAPE_CLASSES[c.lower()])
+        if c in self._ESCAPE_LITERALS:
+            return ("lit", self._ESCAPE_LITERALS[c])
+        if c.isalnum():
+            # \b \B \A \Z \G and friends carry regex SEMANTICS we don't
+            # implement — silently compiling them as literal letters
+            # would force-emit wrong output. Admission error instead.
+            raise ValueError(f"regex: unsupported escape \\{c}")
+        if ord(c) < 128:
+            return ("lit", ord(c))   # escaped punctuation: literal
+        raise ValueError(f"regex: unsupported escape \\{c}")
+
+    def _parse_class(self, p: str, i: int):
+        negate = i < len(p) and p[i] == "^"
+        if negate:
+            i += 1
+        members = set()
+        first = True
+        while i < len(p) and (p[i] != "]" or first):
+            first = False
+            if p[i] == "\\":
+                if i + 1 >= len(p):
+                    raise ValueError("regex: dangling backslash in class")
+                e = self._escape(p[i + 1])
+                members |= (e[1] if e[0] == "class" else {e[1]})
+                i += 2
+                continue
+            c = p[i]
+            if ord(c) > 127:
+                raise ValueError("regex: non-ASCII in character class")
+            if i + 2 < len(p) and p[i + 1] == "-" and p[i + 2] != "]":
+                hi = p[i + 2]
+                if ord(hi) > 127 or ord(hi) < ord(c):
+                    raise ValueError(f"regex: bad range {c}-{hi}")
+                members |= set(range(ord(c), ord(hi) + 1))
+                i += 3
+            else:
+                members.add(ord(c))
+                i += 1
+        if i >= len(p):
+            raise ValueError("regex: unterminated '['")
+        if negate:
+            members = self._ASCII - members
+        return ("class", frozenset(members)), i + 1
+
+    def _parse_quant(self, p: str, i: int, atom):
+        if i >= len(p):
+            return atom, i
+        c = p[i]
+        if c == "*":
+            return ("rep", atom, 0, None), i + 1
+        if c == "+":
+            return ("rep", atom, 1, None), i + 1
+        if c == "?":
+            return ("rep", atom, 0, 1), i + 1
+        if c == "{":
+            j = p.find("}", i)
+            if j < 0:
+                raise ValueError("regex: unterminated '{'")
+            body = p[i + 1:j]
+            try:
+                if "," not in body:
+                    lo = hi = int(body)
+                else:
+                    lo_s, hi_s = body.split(",", 1)
+                    lo = int(lo_s)
+                    hi = int(hi_s) if hi_s else None
+            except ValueError:
+                raise ValueError(f"regex: bad quantifier {{{body}}}") from None
+            if hi is not None and hi < lo:
+                raise ValueError(f"regex: bad quantifier {{{body}}}")
+            return ("rep", atom, lo, hi), j + 1
+        return atom, i
+
+class JsonSchemaGrammar(NfaGrammar):
+    """JSON-Schema-constrained output (xgrammar / vLLM guided_json /
+    OpenAI ``response_format: json_schema`` analog): compiles a schema
+    subset into the shared byte NFA, so the output both parses as JSON
+    and validates against the schema. Emission is COMPACT JSON (no
+    whitespace) — every property in declaration order.
+
+    Supported keywords: ``type`` object (``properties`` all emitted, in
+    order), string (``minLength``/``maxLength``/``pattern`` — the
+    pattern uses the RegexGrammar subset), number, integer, boolean,
+    null; ``enum``/``const`` of JSON scalars; array (``items``,
+    ``minItems``/``maxItems``); ``anyOf``/``oneOf`` as alternation;
+    nesting to depth 16. Unsupported keywords (``$ref``, ``allOf``,
+    ``patternProperties``, …) raise ValueError at admission."""
+
+    _MAX_DEPTH = 16
+    _UNSUPPORTED = ("$ref", "allOf", "not", "patternProperties",
+                    "if", "then", "else", "dependentSchemas")
+
+    def __init__(self, schema: dict):
+        if not isinstance(schema, dict):
+            raise ValueError("json_schema must be an object")
+        self.schema = schema
+        super().__init__(self._value_ast(schema, 0))
+
+    # -- AST builders --
+
+    def _value_ast(self, schema, depth: int):
+        if not isinstance(schema, dict):
+            # Bool/None subschemas and other malformed shapes must be
+            # ADMISSION errors (ValueError), never handler TypeErrors.
+            raise ValueError(
+                f"json_schema: subschema must be an object, got "
+                f"{type(schema).__name__}")
+        if depth > self._MAX_DEPTH:
+            raise ValueError("json_schema: nesting too deep")
+        for kw in self._UNSUPPORTED:
+            if kw in schema:
+                raise ValueError(f"json_schema: unsupported keyword {kw!r}")
+        if "const" in schema:
+            return self._scalar_lit(schema["const"])
+        if "enum" in schema:
+            vals = schema["enum"]
+            if not isinstance(vals, list) or not vals:
+                raise ValueError("json_schema: enum must be a non-empty list")
+            return ("alt", [self._scalar_lit(v) for v in vals])
+        if "anyOf" in schema or "oneOf" in schema:
+            subs = schema.get("anyOf") if "anyOf" in schema \
+                else schema.get("oneOf")
+            if not isinstance(subs, list) or not subs:
+                raise ValueError(
+                    "json_schema: anyOf/oneOf must be a non-empty list")
+            return ("alt", [self._value_ast(s, depth + 1) for s in subs])
+        t = schema.get("type")
+        if isinstance(t, list):
+            return ("alt", [self._value_ast({**schema, "type": one},
+                                            depth + 1) for one in t])
+        if t == "object":
+            return self._object_ast(schema, depth)
+        if t == "array":
+            return self._array_ast(schema, depth)
+        if t == "string":
+            return self._string_ast(schema)
+        if t == "integer":
+            return self._number_ast(integer=True)
+        if t == "number":
+            return self._number_ast(integer=False)
+        if t == "boolean":
+            return ("alt", [self._lit_bytes(b"true"),
+                            self._lit_bytes(b"false")])
+        if t == "null":
+            return self._lit_bytes(b"null")
+        raise ValueError(f"json_schema: unsupported type {t!r}")
+
+    @staticmethod
+    def _scalar_lit(v):
+        if isinstance(v, (dict, list)):
+            raise ValueError("json_schema: enum/const members must be "
+                             "scalars")
+        return NfaGrammar._lit_bytes(
+            json.dumps(v, ensure_ascii=False,
+                       separators=(",", ":")).encode("utf-8"))
+
+    def _object_ast(self, schema: dict, depth: int):
+        props = schema.get("properties") or {}
+        if not isinstance(props, dict):
+            raise ValueError("json_schema: properties must be an object")
+        if not props:
+            return self._lit_bytes(b"{}")
+        parts = [self._lit_bytes(b"{")]
+        for i, (key, sub) in enumerate(props.items()):
+            if i:
+                parts.append(("lit", 0x2C))                   # ,
+            parts.append(self._lit_bytes(
+                json.dumps(key, ensure_ascii=False).encode("utf-8")))
+            parts.append(("lit", 0x3A))                       # :
+            parts.append(self._value_ast(sub, depth + 1))
+        parts.append(self._lit_bytes(b"}"))
+        return ("cat", parts)
+
+    def _array_ast(self, schema: dict, depth: int):
+        # Missing "items" defaults to string members (our subset has no
+        # "any value" item grammar); an EXPLICIT null/bool items is a
+        # malformed schema and raises in _value_ast.
+        item = self._value_ast(schema["items"] if "items" in schema
+                               else {"type": "string"}, depth + 1)
+        lo = int(schema.get("minItems", 0))
+        hi = schema.get("maxItems")
+        hi = int(hi) if hi is not None else None
+        if lo < 0 or (hi is not None and hi < lo):
+            raise ValueError("json_schema: bad minItems/maxItems")
+        more = ("cat", [("lit", 0x2C), item])
+        if lo == 0:
+            nonempty = ("cat", [("lit", 0x5B), item,
+                                ("rep", more, 0,
+                                 None if hi is None else max(hi - 1, 0)),
+                                ("lit", 0x5D)])
+            if hi == 0:
+                return self._lit_bytes(b"[]")
+            return ("alt", [self._lit_bytes(b"[]"), nonempty])
+        return ("cat", [("lit", 0x5B), item,
+                        ("rep", more, lo - 1,
+                         None if hi is None else hi - 1),
+                        ("lit", 0x5D)])
+
+    def _string_ast(self, schema: dict):
+        if "pattern" in schema:
+            # The pattern constrains the string CONTENT (anchored); the
+            # compiler wraps it in quotes. Patterns that could match a
+            # raw '"' or '\\' are the caller's foot-gun (same contract
+            # as xgrammar).
+            body = RegexGrammar.parse_ast(str(schema["pattern"]))
+            return ("cat", [("lit", 0x22), body, ("lit", 0x22)])
+        lo = int(schema.get("minLength", 0))
+        hi = schema.get("maxLength")
+        hi = int(hi) if hi is not None else None
+        if lo < 0 or (hi is not None and hi < lo):
+            raise ValueError("json_schema: bad minLength/maxLength")
+        return ("cat", [("lit", 0x22),
+                        ("rep", self._string_char(), lo, hi),
+                        ("lit", 0x22)])
+
+    @classmethod
+    def _string_char(cls):
+        """One JSON-string character: printable ASCII (minus quote and
+        backslash), a JSON escape, or a STRICT multi-byte UTF-8 sequence
+        (no overlongs, no surrogates — a mask must never force-sample
+        bytes that cannot decode)."""
+        ascii_ok = ("class", frozenset(range(0x20, 0x7F)) - {0x22, 0x5C})
+        esc = ("cat", [("lit", 0x5C),
+                       ("class", frozenset(b'"\\/bfnrt'))])
+        uesc = ("cat", [("lit", 0x5C), ("lit", 0x75)]
+               + [("class", frozenset(b"0123456789abcdefABCDEF"))] * 4)
+        cont = ("class", frozenset(range(0x80, 0xC0)))
+        two = ("cat", [("class", frozenset(range(0xC2, 0xE0))), cont])
+        three = ("alt", [
+            ("cat", [("lit", 0xE0),
+                     ("class", frozenset(range(0xA0, 0xC0))), cont]),
+            ("cat", [("class", frozenset(range(0xE1, 0xED))
+                      | {0xEE, 0xEF}), cont, cont]),
+            ("cat", [("lit", 0xED),
+                     ("class", frozenset(range(0x80, 0xA0))), cont]),
+        ])
+        four = ("alt", [
+            ("cat", [("lit", 0xF0),
+                     ("class", frozenset(range(0x90, 0xC0))), cont, cont]),
+            ("cat", [("class", frozenset(range(0xF1, 0xF4))),
+                     cont, cont, cont]),
+            ("cat", [("lit", 0xF4),
+                     ("class", frozenset(range(0x80, 0x90))), cont, cont]),
+        ])
+        return ("alt", [ascii_ok, esc, uesc, two, three, four])
+
+    @classmethod
+    def _number_ast(cls, integer: bool):
+        digit = ("class", frozenset(b"0123456789"))
+        intpart = ("alt", [("lit", 0x30),
+                           ("cat", [("class", frozenset(b"123456789")),
+                                    ("rep", digit, 0, None)])])
+        parts = [("rep", ("lit", 0x2D), 0, 1), intpart]
+        if not integer:
+            parts.append(("rep", ("cat", [("lit", 0x2E),
+                                          ("rep", digit, 1, None)]), 0, 1))
+            parts.append(("rep", ("cat", [
+                ("class", frozenset(b"eE")),
+                ("rep", ("class", frozenset(b"+-")), 0, 1),
+                ("rep", digit, 1, None)]), 0, 1))
+        return ("cat", parts)
 
 
 class TokenTrie:

@@ -217,20 +217,39 @@ class Handler(BaseHTTPRequestHandler):
         if body.get("lora"):
             out["lora"] = str(body["lora"])
         # Regex-constrained output (sglang `regex` / vLLM `guided_regex`).
-        regex = body.get("regex") or body.get("guided_regex")
-        if regex:
+        # `is not None`: "" is a legal pattern (empty output only).
+        regex = body.get("regex")
+        if regex is None:
+            regex = body.get("guided_regex")
+        if regex is not None:
             out["regex"] = str(regex)
+        # Schema-constrained output (vLLM `guided_json`).
+        gj = body.get("guided_json")
+        if gj is not None:
+            if not isinstance(gj, dict):
+                raise ValueError("guided_json must be a JSON Schema object")
+            out["json_schema"] = gj
         rf = body.get("response_format")
         if rf is not None:
             rft = rf.get("type") if isinstance(rf, dict) else None
             if rft == "json_object":
                 out["json_mode"] = True
+            elif rft == "json_schema":
+                # OpenAI structured outputs: response_format.json_schema
+                # .schema carries the schema itself.
+                js = rf.get("json_schema")
+                schema = js.get("schema") if isinstance(js, dict) else None
+                if not isinstance(schema, dict):
+                    raise ValueError(
+                        "response_format.json_schema.schema must be a "
+                        "JSON Schema object")
+                out["json_schema"] = schema
             elif rft != "text":
                 # Silently ignoring an unsupported constraint would return
                 # unconstrained output a client will feed to json.loads.
                 raise ValueError(
                     f"unsupported response_format {rft!r} (supported: "
-                    "text, json_object)")
+                    "text, json_object, json_schema)")
         return out
 
     @staticmethod

@@ -458,7 +458,7 @@ class Handler(socketserver.BaseRequestHandler):
             for key in ("temperature", "top_k", "top_p", "min_p",
                         "repetition_penalty", "presence_penalty",
                         "frequency_penalty", "seed", "json_mode", "regex",
-                        "lora", "stop_token", "token"):
+                        "json_schema", "lora", "stop_token", "token"):
                 if key in obj:
                     pf_req[key] = obj[key]
             # Cache affinity on the prefill leg: the replica that served
@@ -473,7 +473,8 @@ class Handler(socketserver.BaseRequestHandler):
             for key in ("max_new_tokens", "temperature", "top_k", "top_p",
                         "min_p", "repetition_penalty", "presence_penalty",
                         "frequency_penalty", "seed", "logprobs", "json_mode",
-                        "regex", "lora", "stop_token", "stream", "token"):
+                        "regex", "json_schema", "lora", "stop_token", "stream",
+                        "token"):
                 if key in obj:
                     fwd[key] = obj[key]
             # Decode replicas hold no prefix cache — no affinity prompt.

@@ -79,11 +79,7 @@ class EngineConfig:
             raise ValueError(
                 "use_pallas='always' is incompatible with kv_dtype='int8' — "
                 "the Pallas kernel does not dequantize yet; use 'auto'")
-        mcfg = self.model_config
-        if mcfg.mla:
-            if self.kv_dtype == "int8":
-                raise ValueError("kv_dtype='int8' not supported for MLA "
-                                 "latent pools yet")
+        self.model_config  # fail fast on an unknown model preset
 
 
 @dataclasses.dataclass

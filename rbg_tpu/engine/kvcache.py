@@ -55,16 +55,24 @@ class PagedKVCache:
         if cfg.mla:
             # MLA latent pool: k holds the compressed latent, v the shared
             # RoPE key — ~an order of magnitude less HBM than per-head KV.
+            # int8 halves it again: per-token absmax over the latent/rope
+            # vector (the write path quantizes generically — the latent is
+            # just a 1-head "KV" with dc/dr channel dims).
+            kshape = (cfg.num_layers, num_pages, page_size, 1,
+                      cfg.kv_lora_rank)
+            vshape = (cfg.num_layers, num_pages, page_size, 1,
+                      cfg.qk_rope_head_dim)
             if quantize:
-                raise ValueError("int8 KV quantization not supported for "
-                                 "MLA latent pools yet")
+                sshape = kshape[:-1] + (1,)
+                return PagedKVCache(
+                    k_pages=jnp.zeros(kshape, jnp.int8),
+                    v_pages=jnp.zeros(vshape, jnp.int8),
+                    k_scales=jnp.zeros(sshape, jnp.float32),
+                    v_scales=jnp.zeros(sshape, jnp.float32),
+                )
             dtype = dtype or cfg.jax_dtype
-            return PagedKVCache(
-                k_pages=jnp.zeros((cfg.num_layers, num_pages, page_size, 1,
-                                   cfg.kv_lora_rank), dtype),
-                v_pages=jnp.zeros((cfg.num_layers, num_pages, page_size, 1,
-                                   cfg.qk_rope_head_dim), dtype),
-            )
+            return PagedKVCache(k_pages=jnp.zeros(kshape, dtype),
+                                v_pages=jnp.zeros(vshape, dtype))
         shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim_)
         if quantize:
             sshape = shape[:-1] + (1,)

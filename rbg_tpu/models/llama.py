@@ -409,7 +409,8 @@ def forward_paged(
             attn_lat = paged_mla_attention(q_lat, q_pe, kpf, vpf, table,
                                            positions, kv_lens,
                                            _mla_scale(cfg),
-                                           use_pallas=use_pallas)
+                                           use_pallas=use_pallas,
+                                           c_scales=ksf, pe_scales=vsf)
             attn = _mla_out(cfg, blk, attn_lat)
         else:
             q, k, vv = _qkv(cfg, blk, hcur, positions, lr, lora_ids)

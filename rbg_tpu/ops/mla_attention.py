@@ -71,6 +71,8 @@ def paged_mla_attention_xla(
     q_positions: jnp.ndarray,  # [B, T]
     kv_lens: jnp.ndarray,     # [B] — valid tokens post-write
     scale: float,
+    c_scales: jnp.ndarray = None,   # [NP_layer, page, 1, 1] (int8 pools)
+    pe_scales: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Causal MLA over the paged latent pool: gather the rows' pages into a
     contiguous [B, S, dc] view (S = P·page — static), then the same math as
@@ -84,8 +86,14 @@ def paged_mla_attention_xla(
     B, P = page_table.shape
     page = c_pages.shape[1]
     S = P * page
-    c = c_pages[page_table][:, :, :, 0, :].reshape(B, S, -1)
-    pe = pe_pages[page_table][:, :, :, 0, :].reshape(B, S, -1)
+    gather = lambda pages: pages[page_table][:, :, :, 0, :].reshape(B, S, -1)
+    c = gather(c_pages)
+    pe = gather(pe_pages)
+    if c_scales is not None:
+        # int8 latent pool: dequantize the gathered view (per-token
+        # absmax scales stored alongside the pages).
+        c = c.astype(jnp.float32) * gather(c_scales)
+        pe = pe.astype(jnp.float32) * gather(pe_scales)
     slot_valid = (jnp.arange(S, dtype=jnp.int32)[None, :]
                   < kv_lens[:, None])
     return mla_attention(q_lat, q_pe, c, pe, q_positions, slot_valid, scale)
@@ -93,10 +101,17 @@ def paged_mla_attention_xla(
 
 def paged_mla_attention(q_lat, q_pe, c_pages, pe_pages, page_table,
                         q_positions, kv_lens, scale,
-                        *, use_pallas: str = "auto") -> jnp.ndarray:
+                        *, use_pallas: str = "auto",
+                        c_scales=None, pe_scales=None) -> jnp.ndarray:
     """Dispatch between the Pallas MLA decode kernel and the XLA gather
     fallback (same policy as ``paged_attention``'s GQA dispatch — shared
-    via ``dispatch_pallas``)."""
+    via ``dispatch_pallas``). Quantized (int8 + scales) latent pools
+    always take the XLA path — the kernel does not dequantize yet (same
+    contract as the GQA kernel)."""
+    if c_scales is not None:
+        return paged_mla_attention_xla(q_lat, q_pe, c_pages, pe_pages,
+                                       page_table, q_positions, kv_lens,
+                                       scale, c_scales, pe_scales)
     from rbg_tpu.ops.paged_attention import dispatch_pallas
     return dispatch_pallas(
         use_pallas, "paged_mla_attention_pallas", paged_mla_attention_xla,

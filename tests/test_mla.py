@@ -148,11 +148,15 @@ def test_mla_sharded_engine_tp2():
 
 
 def test_mla_config_guards():
-    with pytest.raises(ValueError, match="int8"):
-        EngineConfig(model="tiny-mla", kv_dtype="int8").validate()
-    # use_pallas='always' became legal in round 5: the decode kernel now
-    # has an MLA (latent) shape, so the GQA-only guard is gone.
+    # Both round-4 MLA guards fell in round 5: the decode kernel has a
+    # latent shape (use_pallas='always' legal) and the latent pool
+    # quantizes (kv_dtype='int8' legal, unified mode — same restriction
+    # as GQA int8).
+    EngineConfig(model="tiny-mla", kv_dtype="int8").validate()
     EngineConfig(model="tiny-mla", use_pallas="always").validate()
+    with pytest.raises(ValueError, match="unified"):
+        EngineConfig(model="tiny-mla", kv_dtype="int8",
+                     mode="prefill").validate()
 
 
 def test_pd_disagg_ships_latent_bundles():
@@ -167,3 +171,31 @@ def test_pd_disagg_ships_latent_bundles():
     pair = PDPair(EngineConfig(**base), params=PARAMS)
     got = pair.generate([[1, 2, 3, 4, 5]], SamplingParams(max_new_tokens=8))
     assert got[0] == expect
+
+
+def test_mla_int8_latent_pool_numerics():
+    """int8-quantized latent pool (round 5): half the already-compressed
+    latent HBM; bounded deviation vs the fp32 pool and greedy agreement
+    (the GQA int8 invariants, on the latent shape)."""
+    mk = lambda dtype: Engine(
+        EngineConfig(model="tiny-mla", page_size=8, num_pages=96,
+                     max_seq_len=128, use_pallas="never",
+                     enable_radix_cache=False, kv_dtype=dtype),
+        params=PARAMS)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+
+    ref = mk("model")
+    q = mk("int8")
+    assert q.cache.quantized and q.cache.k_pages.dtype == jnp.int8
+    assert q.cache.k_pages.shape[-1] == CFG.kv_lora_rank
+    assert q.cache.k_scales.shape[-1] == 1
+
+    sp = SamplingParams(max_new_tokens=12)
+    ref_out = ref.generate([prompt], sp)[0]
+    q_out = q.generate([prompt], sp)[0]
+    agree = sum(a == b for a, b in zip(ref_out, q_out)) / len(ref_out)
+    assert agree >= 0.75, (ref_out, q_out)
+
+    # Pages balance after generation (quantized pool accounting intact).
+    assert not q.running and not q.waiting
+    assert q.allocator.free_pages == q.cfg.num_pages - 1  # null page

@@ -68,7 +68,7 @@ class _Topology:
     caller passes a TPU-ready env)."""
 
     def __init__(self, kind: str, engine_args: List[str], env: dict,
-                 max_batch: int):
+                 max_batch: int, decode_replicas: int = 1):
         self.kind = kind
         self.procs: List[subprocess.Popen] = []
         self.max_batch = max_batch
@@ -83,8 +83,9 @@ class _Topology:
                 _wait_ready(ports["front"])
                 self.engine_ports = [ports["front"]]
             elif kind == "pd":
-                for name in ("pool", "prefill", "decode", "front"):
+                for name in ("pool", "prefill", "front"):
                     ports[name] = _free_port()
+                decode_ports = [_free_port() for _ in range(decode_replicas)]
                 page = _flag(engine_args, "--page-size", "16")
                 self._spawn(["-m", "rbg_tpu.engine.kvpool",
                              "--port", str(ports["pool"]),
@@ -94,18 +95,19 @@ class _Topology:
                              "--port", str(ports["prefill"]),
                              "--kv-pool", f"127.0.0.1:{ports['pool']}"]
                             + engine_args, env)
-                self._spawn(["-m", "rbg_tpu.engine.server",
-                             "--mode", "decode",
-                             "--port", str(ports["decode"])] + engine_args,
-                            env)
+                for dp in decode_ports:
+                    self._spawn(["-m", "rbg_tpu.engine.server",
+                                 "--mode", "decode",
+                                 "--port", str(dp)] + engine_args, env)
                 backends = {"prefill": [f"127.0.0.1:{ports['prefill']}"],
-                            "decode": [f"127.0.0.1:{ports['decode']}"]}
+                            "decode": [f"127.0.0.1:{dp}"
+                                       for dp in decode_ports]}
                 self._spawn(["-m", "rbg_tpu.engine.router",
                              "--port", str(ports["front"]),
                              "--backends", json.dumps(backends)], env)
-                for name in ("prefill", "decode", "front"):
-                    _wait_ready(ports[name])
-                self.engine_ports = [ports["prefill"], ports["decode"]]
+                for port in [ports["prefill"], ports["front"]] + decode_ports:
+                    _wait_ready(port)
+                self.engine_ports = [ports["prefill"]] + decode_ports
             else:
                 raise ValueError(kind)
         except BaseException:
@@ -177,7 +179,8 @@ def measure(kind: str, rates: List[float], args, env) -> List[dict]:
                    "--max-batch", str(args.max_batch),
                    "--prefill-chunk", str(args.prefill_chunk),
                    "--use-pallas", args.use_pallas]
-    topo = _Topology(kind, engine_args, env, args.max_batch)
+    topo = _Topology(kind, engine_args, env, args.max_batch,
+                     decode_replicas=args.pd_decode_replicas)
     rows = []
     try:
         topo.warmup(args.input_len)
@@ -195,11 +198,15 @@ def measure(kind: str, rates: List[float], args, env) -> List[dict]:
             out = bench_serving.run(bargs)
             out["setup"] = kind
             out["load1_before"] = round(load1, 2)
+            replicas = (f" [pd topology: --pd-decode-replicas "
+                        f"{args.pd_decode_replicas}]"
+                        if kind == "pd" else "")
             out["command"] = (
                 f"python -m rbg_tpu.engine.bench_serving --addr <{kind}> "
                 f"--requests {args.requests} --rate {rate} "
                 f"--input-len {args.input_len} --output-len {args.output_len} "
-                f"--model {args.model} --max-batch {args.max_batch}")
+                f"--model {args.model} --max-batch {args.max_batch}"
+                f"{replicas}")
             rows.append(out)
     finally:
         topo.stop()
@@ -224,6 +231,16 @@ def main(argv=None) -> int:
     ap.add_argument("--json-out", default="",
                     help="write the BENCH-style artifact here")
     ap.add_argument("--setups", default="unified,pd")
+    def _positive(v):
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return n
+
+    ap.add_argument("--pd-decode-replicas", type=_positive, default=1,
+                    help="decode replicas in the pd topology (the router "
+                         "least-loads across them) — the knob the "
+                         "saturation ratio scales with")
     ap.add_argument("--platform", default="cpu", choices=["cpu", "tpu"],
                     help="cpu = scrubbed CPU-proxy subprocesses (default); "
                          "tpu = inherit the TPU environment (one engine "
@@ -285,6 +302,7 @@ def main(argv=None) -> int:
             "model": args.model,
             "hardware": "cpu-proxy" if args.platform == "cpu" else "tpu",
             "input_len": args.input_len, "output_len": args.output_len,
+            "pd_decode_replicas": args.pd_decode_replicas,
             "results": results, "north_star_ratios": ratios,
         }
         with open(args.json_out, "w") as f:

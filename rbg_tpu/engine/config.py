@@ -75,10 +75,6 @@ class EngineConfig:
             raise ValueError(
                 "int8 KV is unified-mode only for now (PD bundles carry "
                 "unquantized pages)")
-        if self.kv_dtype == "int8" and self.use_pallas == "always":
-            raise ValueError(
-                "use_pallas='always' is incompatible with kv_dtype='int8' — "
-                "the Pallas kernel does not dequantize yet; use 'auto'")
         self.model_config  # fail fast on an unknown model preset
 
 

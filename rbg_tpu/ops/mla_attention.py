@@ -106,8 +106,9 @@ def paged_mla_attention(q_lat, q_pe, c_pages, pe_pages, page_table,
     """Dispatch between the Pallas MLA decode kernel and the XLA gather
     fallback (same policy as ``paged_attention``'s GQA dispatch — shared
     via ``dispatch_pallas``). Quantized (int8 + scales) latent pools
-    always take the XLA path — the kernel does not dequantize yet (same
-    contract as the GQA kernel)."""
+    always take the XLA path — the MLA kernel does not dequantize yet
+    (the GQA kernel grew a dequant variant in round 5; the latent one is
+    the remaining seam)."""
     if c_scales is not None:
         return paged_mla_attention_xla(q_lat, q_pe, c_pages, pe_pages,
                                        page_table, q_positions, kv_lens,

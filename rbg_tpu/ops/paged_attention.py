@@ -122,11 +122,14 @@ def dispatch_pallas(use_pallas: str, kernel_name: str, xla_fn, args):
 def paged_attention(q, k_pages, v_pages, page_table, q_positions, kv_lens,
                     *, use_pallas: str = "auto", k_scales=None, v_scales=None):
     """Dispatch between the Pallas TPU kernel and the XLA fallback.
-    Quantized (int8 + scales) pools always take the XLA path — the Pallas
-    kernel does not dequantize yet."""
+    Quantized (int8 + scales) pools route to the dequantizing kernel
+    variant — the pool stays int8 in HBM, so the page walk moves half
+    the bytes."""
     if k_scales is not None:
-        return paged_attention_xla(q, k_pages, v_pages, page_table,
-                                   q_positions, kv_lens, k_scales, v_scales)
+        return dispatch_pallas(
+            use_pallas, "paged_attention_pallas_q", paged_attention_xla,
+            (q, k_pages, v_pages, page_table, q_positions, kv_lens,
+             k_scales, v_scales))
     return dispatch_pallas(
         use_pallas, "paged_attention_pallas", paged_attention_xla,
         (q, k_pages, v_pages, page_table, q_positions, kv_lens))

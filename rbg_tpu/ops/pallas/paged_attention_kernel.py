@@ -158,6 +158,136 @@ def paged_attention_pallas(q, k_pages, v_pages, page_table, q_positions,
     return out.reshape(B, T, H, hd)
 
 
+# ---- int8 (quantized pool) decode ------------------------------------------
+#
+# Same page walk as _decode_kernel, but pages arrive int8 with per-(slot,
+# head) absmax scales alongside (ops/paged_attention.quantize_kv); the
+# dequant multiply happens in VMEM right after the DMA — the pool stays
+# int8 in HBM, so the kernel moves HALF the bytes of the f32/bf16 walk.
+
+
+def _decode_kernel_q(
+    # scalar prefetch
+    page_table_ref,   # [B, P] int32 (SMEM)
+    kv_lens_ref,      # [B] int32 (SMEM)
+    # blocks
+    q_ref,            # [1, KV, G, hd] (VMEM)
+    k_ref,            # [1, page, KV, hd] int8 — the page picked by index_map
+    v_ref,
+    ks_ref,           # [1, page, KV, 1] f32 scales
+    vs_ref,
+    out_ref,          # [1, KV, G, hd]
+    # scratch
+    m_ref, l_ref, acc_ref,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    num_p = pl.num_programs(1)
+    page = k_ref.shape[1]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    kv_len = kv_lens_ref[b]
+
+    @pl.when(p * page < kv_len)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32)                    # [KV, G, hd]
+        k = k_ref[0].astype(jnp.float32) * ks_ref[0]        # dequant in VMEM
+        v = v_ref[0].astype(jnp.float32) * vs_ref[0]
+        hd = q.shape[-1]
+
+        k_t = jnp.transpose(k, (1, 0, 2))                   # [KV, page, hd]
+        v_t = jnp.transpose(v, (1, 0, 2))
+        scores = jax.lax.dot_general(
+            q, k_t,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * (1.0 / (hd ** 0.5))                             # [KV, G, page]
+
+        token_idx = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, dimension=2)
+        scores = jnp.where(token_idx < kv_len, scores, _NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(scores - m_new)
+
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            probs, v_t,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(p == num_p - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:], 1e-30)
+        out_ref[0] = (acc_ref[:] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _decode_call_q(q, k_pages, v_pages, k_scales, v_scales, page_table,
+                   kv_lens, interpret=False):
+    """int8 variant: pages int8, scales f32. Returns [B, KV, G, hd]."""
+    B, KV, G, hd = q.shape
+    _, page, _, _ = k_pages.shape
+    P = page_table.shape[1]
+
+    pick = lambda b, p, table, lens: (table[b, p], 0, 0, 0)
+    fixed = lambda b, p, table, lens: (b, 0, 0, 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, KV, G, hd), fixed),
+            pl.BlockSpec((1, page, KV, hd), pick),
+            pl.BlockSpec((1, page, KV, hd), pick),
+            pl.BlockSpec((1, page, KV, 1), pick),
+            pl.BlockSpec((1, page, KV, 1), pick),
+        ],
+        out_specs=pl.BlockSpec((1, KV, G, hd), fixed),
+        scratch_shapes=[
+            pltpu.VMEM((KV, G, 1), jnp.float32),
+            pltpu.VMEM((KV, G, 1), jnp.float32),
+            pltpu.VMEM((KV, G, hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        _decode_kernel_q,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(page_table, kv_lens, q, k_pages, v_pages, k_scales, v_scales)
+
+
+def paged_attention_pallas_q(q, k_pages, v_pages, page_table, q_positions,
+                             kv_lens, k_scales, v_scales,
+                             interpret: bool = False):
+    """Quantized-pool drop-in: decode (T == 1) dequantizes page-by-page in
+    VMEM; other shapes fall back to the XLA dequant path."""
+    B, T, H, hd = q.shape
+    KV = k_pages.shape[2]
+    if T != 1:
+        from rbg_tpu.ops.paged_attention import paged_attention_xla
+        return paged_attention_xla(q, k_pages, v_pages, page_table,
+                                   q_positions, kv_lens, k_scales, v_scales)
+    G = H // KV
+    qg = q.reshape(B, KV, G, hd)
+    out = _decode_call_q(qg, k_pages, v_pages, k_scales, v_scales,
+                         page_table.astype(jnp.int32),
+                         kv_lens.astype(jnp.int32), interpret=interpret)
+    return out.reshape(B, T, H, hd)
+
+
 # ---- MLA (latent) decode ----------------------------------------------------
 #
 # The latent cache is MQA-shaped — ONE shared latent per token (no head

@@ -142,3 +142,61 @@ def test_mla_dispatcher_routes_and_preserves_args(monkeypatch):
     # The config guard is gone: 'always' is legal for MLA models now.
     from rbg_tpu.engine.config import EngineConfig
     EngineConfig(model="deepseek-v2-lite", use_pallas="always").validate()
+
+
+# ---- int8 (quantized pool) decode kernel ----
+
+
+from rbg_tpu.ops.paged_attention import quantize_kv
+from rbg_tpu.ops.pallas.paged_attention_kernel import paged_attention_pallas_q
+
+
+def _quantize_pages(k, v):
+    kq, ks = quantize_kv(np.asarray(k))
+    vq, vs = quantize_kv(np.asarray(v))
+    return (jnp.asarray(kq), jnp.asarray(vq),
+            jnp.asarray(ks), jnp.asarray(vs))
+
+
+def test_int8_decode_kernel_matches_xla_dequant():
+    q, k, v, table, q_pos, lens = _setup(seed=7)
+    kq, vq, ks, vs = _quantize_pages(k, v)
+    ref = paged_attention_xla(q, kq, vq, table, q_pos, lens, ks, vs)
+    got = paged_attention_pallas_q(q, kq, vq, table, q_pos, lens, ks, vs,
+                                   interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int8_decode_kernel_edge_lens():
+    q, k, v, table, _, _ = _setup(B=4, H=4, KV=4, hd=16, page=4, NP=64, P=8,
+                                  seed=8)
+    lens = jnp.asarray([1, 4, 32, 17], jnp.int32)
+    q_pos = (lens - 1)[:, None]
+    kq, vq, ks, vs = _quantize_pages(k, v)
+    ref = paged_attention_xla(q, kq, vq, table, q_pos, lens, ks, vs)
+    got = paged_attention_pallas_q(q, kq, vq, table, q_pos, lens, ks, vs,
+                                   interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int8_dispatch_routes_to_quantized_kernel(monkeypatch):
+    from rbg_tpu.ops import paged_attention as PA
+    from rbg_tpu.ops.pallas import paged_attention_kernel as K
+
+    q, k, v, table, q_pos, lens = _setup(seed=9)
+    kq, vq, ks, vs = _quantize_pages(k, v)
+    calls = []
+
+    def spy(*args, **kw):
+        calls.append(args)
+        return paged_attention_pallas_q(*args, interpret=True, **kw)
+
+    monkeypatch.setattr(K, "paged_attention_pallas_q", spy)
+    got = PA.paged_attention(q, kq, vq, table, q_pos, lens,
+                             use_pallas="always", k_scales=ks, v_scales=vs)
+    assert len(calls) == 1
+    ref = paged_attention_xla(q, kq, vq, table, q_pos, lens, ks, vs)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-5, atol=1e-5)

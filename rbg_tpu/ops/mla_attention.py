@@ -106,10 +106,17 @@ def paged_mla_attention(q_lat, q_pe, c_pages, pe_pages, page_table,
     """Dispatch between the Pallas MLA decode kernel and the XLA gather
     fallback (same policy as ``paged_attention``'s GQA dispatch — shared
     via ``dispatch_pallas``). Quantized (int8 + scales) latent pools
-    always take the XLA path — the MLA kernel does not dequantize yet
-    (the GQA kernel grew a dequant variant in round 5; the latent one is
-    the remaining seam)."""
+    take the XLA path — the MLA kernel does not dequantize yet (the GQA
+    kernel grew a dequant variant in round 5; the latent one is the
+    remaining seam). Under ``use_pallas='always'`` that would be a
+    SILENT fallback, so it raises instead (the 'always' contract: fail
+    loudly when the kernel cannot run)."""
     if c_scales is not None:
+        if use_pallas == "always":
+            raise ValueError(
+                "use_pallas='always' with an int8 MLA latent pool: the "
+                "latent kernel does not dequantize yet — use 'auto' "
+                "(XLA dequant path) or kv_dtype='model'")
         return paged_mla_attention_xla(q_lat, q_pe, c_pages, pe_pages,
                                        page_table, q_positions, kv_lens,
                                        scale, c_scales, pe_scales)

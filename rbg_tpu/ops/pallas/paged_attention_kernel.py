@@ -48,11 +48,18 @@ def _decode_kernel(
     m_ref,            # [KV, G, 1] running max
     l_ref,            # [KV, G, 1] running denom
     acc_ref,          # [KV, G, hd] running numerator
+    *,
+    # int8 pools (the _decode_kernel_q entry): per-(slot, head) absmax
+    # scales [1, page, KV]. Folded ALGEBRAICALLY — scales factor out of
+    # both dot products, so the int8 page tensors feed the MXU directly.
+    ks_ref=None,
+    vs_ref=None,
 ):
     b = pl.program_id(0)
     p = pl.program_id(1)
     num_p = pl.num_programs(1)
     page = k_ref.shape[1]
+    quantized = ks_ref is not None
 
     @pl.when(p == 0)
     def _init():
@@ -78,6 +85,10 @@ def _decode_kernel(
             dimension_numbers=(((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * (1.0 / (hd ** 0.5))                             # [KV, G, page]
+        if quantized:
+            # scores ·= ks[t, kv] (k's scale factors out of the dot).
+            ks_t = jnp.transpose(ks_ref[0], (1, 0))         # [KV, page]
+            scores = scores * ks_t[:, None, :]
 
         token_idx = p * page + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, dimension=2)
@@ -90,9 +101,14 @@ def _decode_kernel(
 
         m_ref[:] = m_new
         l_ref[:] = l_ref[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
-        # acc[kv, g, :] += probs[kv, g, t] * v[kv, t, :]
+        # acc[kv, g, :] += probs[kv, g, t] * v[kv, t, :]; for int8 v the
+        # scale folds into probs BEFORE the dot (pv = (probs·vs)·v_int8).
+        pmat = probs
+        if quantized:
+            vs_t = jnp.transpose(vs_ref[0], (1, 0))         # [KV, page]
+            pmat = probs * vs_t[:, None, :]
         pv = jax.lax.dot_general(
-            probs, v_t,
+            pmat, v_t,
             dimension_numbers=(((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )                                                   # [KV, G, hd]
@@ -160,10 +176,20 @@ def paged_attention_pallas(q, k_pages, v_pages, page_table, q_positions,
 
 # ---- int8 (quantized pool) decode ------------------------------------------
 #
-# Same page walk as _decode_kernel, but pages arrive int8 with per-(slot,
-# head) absmax scales alongside (ops/paged_attention.quantize_kv); the
-# dequant multiply happens in VMEM right after the DMA — the pool stays
-# int8 in HBM, so the kernel moves HALF the bytes of the f32/bf16 walk.
+# The SAME kernel body handles quantized pools via a static ``quantized``
+# flag: pages arrive int8 with per-(slot, head) absmax scales alongside
+# (ops/paged_attention.quantize_kv). Scales are folded ALGEBRAICALLY —
+# they factor out of both dot products (scores[kv,g,t] = (q·k_int8)·ks[t]
+# and pv = (probs·vs)·v_int8) — so the [page, KV, hd] page tensors are
+# never multiplied elementwise and the MXU consumes the int8 pages'
+# values directly after cast.
+#
+# Byte accounting (honest): int8 halves the k/v page DMA, but the f32
+# scale blocks are (1, page, KV) — the KV lane dim pads to 128 on real
+# hardware, so each scale block moves ~page*128*4 B. At page=16/KV=8/
+# hd=128 that is k+v 64 KB (bf16) → 32 KB (int8) + ~16 KB padded scales
+# ≈ a 25% net walk saving, not 50%. Packing scales lane-major across
+# pages is the documented follow-up seam.
 
 
 def _decode_kernel_q(
@@ -174,82 +200,38 @@ def _decode_kernel_q(
     q_ref,            # [1, KV, G, hd] (VMEM)
     k_ref,            # [1, page, KV, hd] int8 — the page picked by index_map
     v_ref,
-    ks_ref,           # [1, page, KV, 1] f32 scales
+    ks_ref,           # [1, page, KV] f32 scales
     vs_ref,
     out_ref,          # [1, KV, G, hd]
     # scratch
     m_ref, l_ref, acc_ref,
 ):
-    b = pl.program_id(0)
-    p = pl.program_id(1)
-    num_p = pl.num_programs(1)
-    page = k_ref.shape[1]
-
-    @pl.when(p == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    kv_len = kv_lens_ref[b]
-
-    @pl.when(p * page < kv_len)
-    def _attend():
-        q = q_ref[0].astype(jnp.float32)                    # [KV, G, hd]
-        k = k_ref[0].astype(jnp.float32) * ks_ref[0]        # dequant in VMEM
-        v = v_ref[0].astype(jnp.float32) * vs_ref[0]
-        hd = q.shape[-1]
-
-        k_t = jnp.transpose(k, (1, 0, 2))                   # [KV, page, hd]
-        v_t = jnp.transpose(v, (1, 0, 2))
-        scores = jax.lax.dot_general(
-            q, k_t,
-            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * (1.0 / (hd ** 0.5))                             # [KV, G, page]
-
-        token_idx = p * page + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, dimension=2)
-        scores = jnp.where(token_idx < kv_len, scores, _NEG_INF)
-
-        m_prev = m_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        probs = jnp.exp(scores - m_new)
-
-        m_ref[:] = m_new
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            probs, v_t,
-            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        )
-
-    @pl.when(p == num_p - 1)
-    def _finalize():
-        denom = jnp.maximum(l_ref[:], 1e-30)
-        out_ref[0] = (acc_ref[:] / denom).astype(out_ref.dtype)
+    _decode_kernel(page_table_ref, kv_lens_ref, q_ref, k_ref, v_ref,
+                   out_ref, m_ref, l_ref, acc_ref,
+                   ks_ref=ks_ref, vs_ref=vs_ref)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _decode_call_q(q, k_pages, v_pages, k_scales, v_scales, page_table,
                    kv_lens, interpret=False):
-    """int8 variant: pages int8, scales f32. Returns [B, KV, G, hd]."""
+    """int8 variant: pages int8, scales f32 [NP, page, KV]. Returns
+    [B, KV, G, hd]."""
     B, KV, G, hd = q.shape
     _, page, _, _ = k_pages.shape
     P = page_table.shape[1]
 
-    pick = lambda b, p, table, lens: (table[b, p], 0, 0, 0)
+    pick4 = lambda b, p, table, lens: (table[b, p], 0, 0, 0)
+    pick3 = lambda b, p, table, lens: (table[b, p], 0, 0)
     fixed = lambda b, p, table, lens: (b, 0, 0, 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, P),
         in_specs=[
             pl.BlockSpec((1, KV, G, hd), fixed),
-            pl.BlockSpec((1, page, KV, hd), pick),
-            pl.BlockSpec((1, page, KV, hd), pick),
-            pl.BlockSpec((1, page, KV, 1), pick),
-            pl.BlockSpec((1, page, KV, 1), pick),
+            pl.BlockSpec((1, page, KV, hd), pick4),
+            pl.BlockSpec((1, page, KV, hd), pick4),
+            pl.BlockSpec((1, page, KV), pick3),
+            pl.BlockSpec((1, page, KV), pick3),
         ],
         out_specs=pl.BlockSpec((1, KV, G, hd), fixed),
         scratch_shapes=[
@@ -272,8 +254,11 @@ def _decode_call_q(q, k_pages, v_pages, k_scales, v_scales, page_table,
 def paged_attention_pallas_q(q, k_pages, v_pages, page_table, q_positions,
                              kv_lens, k_scales, v_scales,
                              interpret: bool = False):
-    """Quantized-pool drop-in: decode (T == 1) dequantizes page-by-page in
-    VMEM; other shapes fall back to the XLA dequant path."""
+    """Quantized-pool drop-in: decode (T == 1) folds the scales into the
+    score/prob tensors (never dequantizing the pages elementwise); other
+    shapes fall back to the XLA dequant path. Scales arrive as
+    [NP, page, KV, 1] (the pool layout) and are squeezed for the
+    kernel."""
     B, T, H, hd = q.shape
     KV = k_pages.shape[2]
     if T != 1:
@@ -282,7 +267,8 @@ def paged_attention_pallas_q(q, k_pages, v_pages, page_table, q_positions,
                                    q_positions, kv_lens, k_scales, v_scales)
     G = H // KV
     qg = q.reshape(B, KV, G, hd)
-    out = _decode_call_q(qg, k_pages, v_pages, k_scales, v_scales,
+    out = _decode_call_q(qg, k_pages, v_pages,
+                         k_scales[..., 0], v_scales[..., 0],
                          page_table.astype(jnp.int32),
                          kv_lens.astype(jnp.int32), interpret=interpret)
     return out.reshape(B, T, H, hd)

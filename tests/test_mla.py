@@ -157,6 +157,11 @@ def test_mla_config_guards():
     with pytest.raises(ValueError, match="unified"):
         EngineConfig(model="tiny-mla", kv_dtype="int8",
                      mode="prefill").validate()
+    # int8 + 'always' stays guarded for MLA: the latent kernel does not
+    # dequantize, and 'always' must never silently fall back.
+    with pytest.raises(ValueError, match="dequantize"):
+        EngineConfig(model="tiny-mla", kv_dtype="int8",
+                     use_pallas="always").validate()
 
 
 def test_pd_disagg_ships_latent_bundles():

@@ -28,3 +28,60 @@ import pytest  # noqa: E402
 def mesh8():
     from rbg_tpu.parallel import make_mesh
     return make_mesh(dp=2, sp=2, tp=2)
+
+
+class SpawnedEngineServer:
+    """Shared spawn-server + health-poll boilerplate for subprocess e2e
+    tests (the pattern previously copy-pasted per test file). Scrubs the
+    CPU env AND ambient data-plane/port vars so a developer's exported
+    RBG_DATA_TOKEN / RBG_SERVE_PORT never silently arms a gate or
+    rebinds the port under the test.
+
+        with SpawnedEngineServer("--model", "tiny", ...) as srv:
+            request_once(srv.addr, {...})
+    """
+
+    def __init__(self, *args, env_extra=None, timeout=240.0):
+        import socket
+        import subprocess
+        import sys as _sys
+
+        from rbg_tpu.utils import scrubbed_cpu_env
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            self.port = s.getsockname()[1]
+        env = scrubbed_cpu_env(extra={
+            "RBG_DATA_TOKEN": None, "RBG_SERVE_PORT": str(self.port),
+            "RBG_PORT_SERVE": None, **(env_extra or {})})
+        self.addr = f"127.0.0.1:{self.port}"
+        self.timeout = timeout
+        self.proc = subprocess.Popen(
+            [_sys.executable, "-m", "rbg_tpu.engine.server", *args],
+            env=env, stdout=__import__("subprocess").DEVNULL,
+            stderr=__import__("subprocess").DEVNULL)
+
+    def wait_ready(self):
+        import time
+
+        from rbg_tpu.engine.protocol import request_once
+        deadline = time.monotonic() + self.timeout
+        while True:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"engine server died at startup rc={self.proc.returncode}")
+            try:
+                h, _, _ = request_once(self.addr, {"op": "health"}, timeout=2)
+                if h and h.get("ok"):
+                    return self
+            except OSError:
+                pass
+            assert time.monotonic() < deadline, "server never healthy"
+            time.sleep(0.3)
+
+    def __enter__(self):
+        return self.wait_ready()
+
+    def __exit__(self, *exc):
+        self.proc.terminate()
+        self.proc.wait()

@@ -211,3 +211,34 @@ def test_http_edge_maps_schema_fields():
         f({"response_format": {"type": "json_schema"}})
     with pytest.raises(ValueError):
         f({"guided_json": "not a schema"})
+
+
+@pytest.mark.e2e
+def test_json_schema_over_wire():
+    """guided_json through a real server subprocess: generate_text with a
+    json_schema constraint returns text that parses AND validates."""
+    from conftest import SpawnedEngineServer
+    from rbg_tpu.engine.protocol import request_once
+
+    with SpawnedEngineServer(
+            "--model", "tiny", "--vocab-size", "512", "--page-size", "8",
+            "--num-pages", "128", "--max-seq-len", "256",
+            "--use-pallas", "never") as srv:
+        schema = {"type": "object", "properties": {
+            "n": {"type": "integer"},
+            "tag": {"enum": ["x", "y"]}}}
+        r, _, _ = request_once(
+            srv.addr,
+            {"op": "generate_text", "text": "emit:", "max_new_tokens": 40,
+             "temperature": 0.8, "seed": 2, "json_schema": schema},
+            timeout=180)
+        assert "error" not in r, r
+        doc = json.loads(r["text"])
+        assert set(doc) == {"n", "tag"} and doc["tag"] in ("x", "y")
+        assert isinstance(doc["n"], int)
+        # A malformed schema is a clean per-request error, not a dead wire.
+        r2, _, _ = request_once(
+            srv.addr,
+            {"op": "generate_text", "text": "emit:", "max_new_tokens": 8,
+             "json_schema": {"$ref": "#/x"}}, timeout=60)
+        assert "error" in r2 and "unsupported keyword" in r2["error"]

@@ -5,11 +5,7 @@ fixture."""
 
 import json
 import random
-import socket
 import string
-import subprocess
-import sys
-import time
 
 import numpy as np
 import pytest
@@ -133,33 +129,15 @@ def test_json_mode_hf_tokenizer_over_wire():
     """VERDICT done-condition: json_mode works with --tokenizer-path.
     The committed HF fixture (vocab 161) serves grammar-constrained text
     through a real server subprocess."""
+    from conftest import SpawnedEngineServer
     from rbg_tpu.engine.protocol import request_once
-    from rbg_tpu.utils import scrubbed_cpu_env
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    env = scrubbed_cpu_env()
-    env["RBG_SERVE_PORT"] = str(port)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "rbg_tpu.engine.server", "--model", "tiny",
-         "--page-size", "8", "--num-pages", "128", "--max-seq-len", "256",
-         "--use-pallas", "never", "--tokenizer-path", FIXTURE],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    try:
-        deadline = time.monotonic() + 240
-        while True:
-            try:
-                h, _, _ = request_once(f"127.0.0.1:{port}",
-                                       {"op": "health"}, timeout=2)
-                if h and h.get("ok"):
-                    break
-            except OSError:
-                pass
-            assert time.monotonic() < deadline, "server never healthy"
-            time.sleep(0.3)
+    with SpawnedEngineServer(
+            "--model", "tiny", "--page-size", "8", "--num-pages", "128",
+            "--max-seq-len", "256", "--use-pallas", "never",
+            "--tokenizer-path", FIXTURE) as srv:
         r, _, _ = request_once(
-            f"127.0.0.1:{port}",
+            srv.addr,
             {"op": "generate_text", "text": "emit json:",
              "max_new_tokens": 48, "temperature": 0.8, "seed": 11,
              "json_mode": True}, timeout=180)
@@ -174,6 +152,3 @@ def test_json_mode_hf_tokenizer_over_wire():
             json.loads(r["text"])
         except json.JSONDecodeError:
             pass  # legal truncated prefix (hit max_new_tokens)
-    finally:
-        proc.terminate()
-        proc.wait()

@@ -169,14 +169,10 @@ def test_pd_disagg_carries_adapter():
 
 @pytest.mark.e2e
 def test_lora_over_wire_with_npz():
-    import socket
-    import subprocess
-    import sys
     import tempfile
-    import time
 
+    from conftest import SpawnedEngineServer
     from rbg_tpu.engine.protocol import request_once
-    from rbg_tpu.utils import scrubbed_cpu_env
 
     ad = _adapter(4)
     with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
@@ -184,44 +180,23 @@ def test_lora_over_wire_with_npz():
                  **{f"{t}.A": A for t, (A, _B) in ad.items()},
                  **{f"{t}.B": B for t, (_A, B) in ad.items()})
         npz_path = f.name
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    env = scrubbed_cpu_env()
-    env["RBG_SERVE_PORT"] = str(port)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "rbg_tpu.engine.server", "--model", "tiny",
-         "--page-size", "8", "--num-pages", "96", "--max-seq-len", "128",
-         "--use-pallas", "never", "--lora", f"style={npz_path}"],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    try:
-        deadline = time.monotonic() + 240
-        while True:
-            try:
-                h, _, _ = request_once(f"127.0.0.1:{port}",
-                                       {"op": "health"}, timeout=2)
-                if h and h.get("ok"):
-                    break
-            except OSError:
-                pass
-            assert time.monotonic() < deadline, "server never healthy"
-            time.sleep(0.3)
-        base, _, _ = request_once(f"127.0.0.1:{port}",
+    with SpawnedEngineServer(
+            "--model", "tiny", "--page-size", "8", "--num-pages", "96",
+            "--max-seq-len", "128", "--use-pallas", "never",
+            "--lora", f"style={npz_path}") as srv:
+        base, _, _ = request_once(srv.addr,
                                   {"op": "generate", "prompt": PROMPT,
                                    "max_new_tokens": 8}, timeout=180)
-        styled, _, _ = request_once(f"127.0.0.1:{port}",
+        styled, _, _ = request_once(srv.addr,
                                     {"op": "generate", "prompt": PROMPT,
                                      "max_new_tokens": 8, "lora": "style"},
                                     timeout=180)
         assert "error" not in styled, styled
         assert styled["tokens"] != base["tokens"]   # the adapter did bite
-        bad, _, _ = request_once(f"127.0.0.1:{port}",
+        bad, _, _ = request_once(srv.addr,
                                  {"op": "generate", "prompt": PROMPT,
                                   "lora": "nope"}, timeout=30)
         assert "error" in bad and "unknown LoRA" in bad["error"]
-    finally:
-        proc.terminate()
-        proc.wait()
 
 
 def test_mixed_rank_targets_scale_per_target():

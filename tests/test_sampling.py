@@ -209,57 +209,28 @@ def test_greedy_unchanged_by_sampling_machinery():
 
 @pytest.mark.e2e
 def test_server_seed_and_logprobs_over_wire():
-    import os
-    import socket
-    import subprocess
-    import sys
-    import time
-
+    from conftest import SpawnedEngineServer
     from rbg_tpu.engine.protocol import request_once
-    from rbg_tpu.utils import scrubbed_cpu_env
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    env = scrubbed_cpu_env()
-    env["RBG_SERVE_PORT"] = str(port)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "rbg_tpu.engine.server", "--model", "tiny",
-         "--page-size", "8", "--num-pages", "64", "--max-seq-len", "128",
-         "--use-pallas", "never"], env=env,
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    try:
-        deadline = time.monotonic() + 240
-        while True:
-            try:
-                h, _, _ = request_once(f"127.0.0.1:{port}",
-                                       {"op": "health"}, timeout=2)
-                if h and h.get("ok"):
-                    break
-            except OSError:
-                pass
-            assert time.monotonic() < deadline, "server never healthy"
-            time.sleep(0.3)
+    with SpawnedEngineServer(
+            "--model", "tiny", "--page-size", "8", "--num-pages", "64",
+            "--max-seq-len", "128", "--use-pallas", "never") as srv:
         req = {"op": "generate", "prompt": [1, 2, 3, 4],
                "max_new_tokens": 8, "temperature": 0.9, "top_p": 0.9,
                "seed": 77, "logprobs": True}
-        r1, _, _ = request_once(f"127.0.0.1:{port}", req, timeout=180)
-        r2, _, _ = request_once(f"127.0.0.1:{port}", req, timeout=180)
+        r1, _, _ = request_once(srv.addr, req, timeout=180)
+        r2, _, _ = request_once(srv.addr, req, timeout=180)
         assert "error" not in r1, r1
         assert r1["tokens"] == r2["tokens"]          # seeded → reproducible
         assert len(r1["logprobs"]) == len(r1["tokens"])
         assert all(lp <= 0 for lp in r1["logprobs"])
         # invalid params fail the request, not the server
-        bad, _, _ = request_once(f"127.0.0.1:{port}",
+        bad, _, _ = request_once(srv.addr,
                                  {"op": "generate", "prompt": [1],
                                   "top_p": 5.0}, timeout=30)
         assert "error" in bad and "top_p" in bad["error"]
-        h, _, _ = request_once(f"127.0.0.1:{port}", {"op": "health"},
-                               timeout=5)
+        h, _, _ = request_once(srv.addr, {"op": "health"}, timeout=5)
         assert h["ok"]
-    finally:
-        proc.terminate()
-        proc.wait()
 
 
 @pytest.mark.e2e
@@ -268,37 +239,16 @@ def test_server_cancels_generation_on_client_disconnect():
     request occupying a batch slot for its whole max_new_tokens budget
     (the HTTP edge cuts streams at stop strings this way)."""
     import socket
-    import subprocess
-    import sys
     import time
 
+    from conftest import SpawnedEngineServer
     from rbg_tpu.engine.protocol import recv_msg, request_once, send_msg
-    from rbg_tpu.utils import scrubbed_cpu_env
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    env = scrubbed_cpu_env()
-    env["RBG_SERVE_PORT"] = str(port)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "rbg_tpu.engine.server", "--model", "tiny",
-         "--page-size", "8", "--num-pages", "2048", "--max-seq-len", "8192",
-         "--use-pallas", "never"], env=env,
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    try:
-        deadline = time.monotonic() + 240
-        while True:
-            try:
-                h, _, _ = request_once(f"127.0.0.1:{port}",
-                                       {"op": "health"}, timeout=2)
-                if h and h.get("ok"):
-                    break
-            except OSError:
-                pass
-            assert time.monotonic() < deadline, "server never healthy"
-            time.sleep(0.3)
+    with SpawnedEngineServer(
+            "--model", "tiny", "--page-size", "8", "--num-pages", "2048",
+            "--max-seq-len", "8192", "--use-pallas", "never") as srv:
         # Start a long streaming generation, read one frame, vanish.
-        conn = socket.create_connection(("127.0.0.1", port), timeout=60)
+        conn = socket.create_connection(("127.0.0.1", srv.port), timeout=60)
         send_msg(conn, {"op": "generate", "prompt": [1, 2, 3],
                         "max_new_tokens": 8000, "stream": True})
         frame, _, _ = recv_msg(conn)
@@ -307,17 +257,13 @@ def test_server_cancels_generation_on_client_disconnect():
         # The engine must abort the request well before 8000 tokens.
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
-            m, _, _ = request_once(f"127.0.0.1:{port}", {"op": "metrics"},
-                                   timeout=10)
+            m, _, _ = request_once(srv.addr, {"op": "metrics"}, timeout=10)
             st = m["metrics"]
             if st["running"] == 0 and st["waiting"] == 0:
                 break
             time.sleep(0.2)
         assert st["running"] == 0 and st["waiting"] == 0, st
         assert st["decode_tokens"] < 8000, st
-    finally:
-        proc.terminate()
-        proc.wait()
 
 
 def test_extreme_seed_values_do_not_crash():

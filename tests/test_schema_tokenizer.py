@@ -47,46 +47,18 @@ def test_byte_tokenizer_roundtrip():
 
 
 def test_generate_text_op():
-    import os
-    import socket
-    import subprocess
-    import sys
-    import time
-
+    from conftest import SpawnedEngineServer
     from rbg_tpu.engine.protocol import request_once
 
-    with socket.socket() as s:  # pick a free port — avoid cross-test clashes
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    env = dict(os.environ)
-    env.update({"JAX_PLATFORMS": "cpu", "RBG_SERVE_PORT": str(port)})
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "rbg_tpu.engine.server", "--model", "tiny",
-         "--page-size", "8", "--num-pages", "64", "--max-seq-len", "128",
-         "--use-pallas", "never"],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    try:
-        ready = False
-        for _ in range(200):
-            try:
-                r, _, _ = request_once(f"127.0.0.1:{port}", {"op": "health"}, timeout=2)
-                if r and r.get("ok"):
-                    ready = True
-                    break
-            except OSError:
-                pass
-            time.sleep(0.3)
-        assert ready, "engine server never became healthy"
+    with SpawnedEngineServer(
+            "--model", "tiny", "--page-size", "8", "--num-pages", "64",
+            "--max-seq-len", "128", "--use-pallas", "never") as srv:
         # tiny's vocab (256) is smaller than the byte tokenizer's (259):
         # the server must refuse rather than silently clamp token ids.
-        r, _, _ = request_once(f"127.0.0.1:{port}",
+        r, _, _ = request_once(srv.addr,
                                {"op": "generate_text", "text": "hi",
                                 "max_new_tokens": 8}, timeout=120)
         assert "error" in r and "vocab" in r["error"], r
-    finally:
-        proc.terminate()
-        proc.wait()
 
 
 def test_text_generation_in_process():
@@ -172,45 +144,17 @@ def test_generate_text_with_hf_tokenizer():
     """decode-to-text quality path: the engine server with a real local
     tokenizer dir returns decoded TEXT (the byte-fallback vocab-guard test
     above shows the refusal; this shows the success path)."""
-    import os
-    import socket
-    import subprocess
-    import sys
-    import time
-
+    from conftest import SpawnedEngineServer
     from rbg_tpu.engine.protocol import request_once
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    env = dict(os.environ)
-    env.update({"JAX_PLATFORMS": "cpu", "RBG_SERVE_PORT": str(port)})
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "rbg_tpu.engine.server", "--model", "tiny",
-         "--page-size", "8", "--num-pages", "64", "--max-seq-len", "128",
-         "--use-pallas", "never", "--tokenizer-path", FIXTURE],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    try:
-        ready = False
-        for _ in range(200):
-            try:
-                r, _, _ = request_once(f"127.0.0.1:{port}", {"op": "health"},
-                                       timeout=2)
-                if r and r.get("ok"):
-                    ready = True
-                    break
-            except OSError:
-                pass
-            time.sleep(0.3)
-        assert ready, "engine server never became healthy"
-        r, _, _ = request_once(f"127.0.0.1:{port}",
+    with SpawnedEngineServer(
+            "--model", "tiny", "--page-size", "8", "--num-pages", "64",
+            "--max-seq-len", "128", "--use-pallas", "never",
+            "--tokenizer-path", FIXTURE) as srv:
+        r, _, _ = request_once(srv.addr,
                                {"op": "generate_text",
                                 "text": "the quick brown",
                                 "max_new_tokens": 8}, timeout=120)
         assert "error" not in r, r
         assert isinstance(r["text"], str)
         assert len(r["tokens"]) >= 1
-    finally:
-        proc.terminate()
-        proc.wait()

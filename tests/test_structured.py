@@ -193,38 +193,15 @@ def test_json_mode_without_grammar_table_fails_request():
 def test_json_mode_over_wire():
     """generate_text with json_mode through a real server subprocess —
     decoded text parses as JSON (or is a legal truncated prefix)."""
-    import socket
-    import subprocess
-    import sys
-    import time
-
+    from conftest import SpawnedEngineServer
     from rbg_tpu.engine.protocol import request_once
-    from rbg_tpu.utils import scrubbed_cpu_env
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    env = scrubbed_cpu_env()
-    env["RBG_SERVE_PORT"] = str(port)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "rbg_tpu.engine.server", "--model", "tiny",
-         "--vocab-size", "512", "--page-size", "8", "--num-pages", "128",
-         "--max-seq-len", "256", "--use-pallas", "never"],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    try:
-        deadline = time.monotonic() + 240
-        while True:
-            try:
-                h, _, _ = request_once(f"127.0.0.1:{port}",
-                                       {"op": "health"}, timeout=2)
-                if h and h.get("ok"):
-                    break
-            except OSError:
-                pass
-            assert time.monotonic() < deadline, "server never healthy"
-            time.sleep(0.3)
+    with SpawnedEngineServer(
+            "--model", "tiny", "--vocab-size", "512", "--page-size", "8",
+            "--num-pages", "128", "--max-seq-len", "256",
+            "--use-pallas", "never") as srv:
         r, _, _ = request_once(
-            f"127.0.0.1:{port}",
+            srv.addr,
             {"op": "generate_text", "text": "emit json:",
              "max_new_tokens": 60, "temperature": 0.8, "seed": 5,
              "json_mode": True}, timeout=180)
@@ -235,9 +212,6 @@ def test_json_mode_over_wire():
         for b in text.encode():
             s = g.advance(s, b)
             assert s is not None, text
-    finally:
-        proc.terminate()
-        proc.wait()
 
 
 def test_json_row_does_not_evict_fused_rows_from_their_path():

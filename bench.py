@@ -43,6 +43,80 @@ MAX_ATTEMPTS = 4
 
 _PROBE_ENV = "RBG_BENCH_PROBE_JSON"
 
+# Constrained-decode probe (guided_regex): a regex long enough that no
+# row completes inside the timed window. Measured BOTH ways — device-
+# resident grammar tables (fused multi-step scan) vs the host-synced
+# per-token mask path — so the speedup is tracked in BENCH_*.json going
+# forward. bs=4: at tiny-model CPU shapes the forward is cheap enough
+# that wider batches amortize the host path's per-token overhead into
+# the noise floor; production-sized forwards don't have that luxury, so
+# the narrower batch is the representative dispatch-overhead regime.
+CONSTRAINED_REGEX = "[ab]{400}"
+CONSTRAINED_BATCH = 4
+CONSTRAINED_WARM_STEPS = 2
+# 2 warm windows + 3 x 96 timed tokens stay under the regex's 400-char
+# span: no row may complete (and empty the batch) inside a timed window.
+CONSTRAINED_TOKENS_PER_SEQ = 96
+CONSTRAINED_REPS = 3
+
+
+def constrained_probe(batch: int) -> dict:
+    """guided_regex decode throughput, table path vs host-synced path.
+    Reported ALONGSIDE the headline metric (never replacing it). Runs the
+    tiny preset with the byte tokenizer on every backend: the probe
+    tracks the PATH cost (per-token host syncs + host mask builds vs the
+    fused device window), which the grammar machinery makes
+    model-size-independent."""
+    import dataclasses as _dc
+    import time as _time
+
+    from rbg_tpu.engine import Engine, EngineConfig, SamplingParams
+    from rbg_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+
+    def measure(grammar_table: str) -> float:
+        """Median of CONSTRAINED_REPS timed windows on one warm engine
+        (same hardening rationale as the headline REPS)."""
+        multi = MULTI_STEP if grammar_table == "auto" else 1
+        eng = Engine(EngineConfig(
+            model="tiny", vocab_size=512, page_size=16, num_pages=512,
+            max_batch=batch, max_seq_len=512, prefill_chunk=16,
+            enable_radix_cache=False, decode_buckets=(batch,),
+            multi_step=multi, grammar_table=grammar_table))
+        eng.enable_json_grammar(tok)
+        sp = SamplingParams(max_new_tokens=440, temperature=0.7,
+                            regex=CONSTRAINED_REGEX, stop_token=tok.eos_id)
+        for i in range(batch):
+            eng.add_request(tok.encode("p%d:" % i, add_bos=False),
+                            _dc.replace(sp, seed=i))
+        while eng.waiting or any(r.state != "running" for r in eng.running):
+            eng.step()
+        for _ in range(CONSTRAINED_WARM_STEPS):
+            eng.step()
+        steps = max(1, CONSTRAINED_TOKENS_PER_SEQ // multi)
+        runs = []
+        for _ in range(CONSTRAINED_REPS):
+            start = eng.metrics["decode_tokens"]
+            t0 = _time.perf_counter()
+            for _ in range(steps):
+                eng.step()
+            elapsed = _time.perf_counter() - t0
+            runs.append((eng.metrics["decode_tokens"] - start) / elapsed)
+        for r in list(eng.running):
+            eng.cancel_request(r.id)
+        return statistics.median(runs)
+
+    table_tps = measure("auto")
+    host_tps = measure("off")
+    return {
+        "metric": f"guided_regex_decode_throughput_bs{batch}",
+        "regex": CONSTRAINED_REGEX,
+        "table_tps": round(table_tps, 2),
+        "host_synced_tps": round(host_tps, 2),
+        "speedup": round(table_tps / host_tps, 2) if host_tps else None,
+    }
+
 
 def tpu_probe() -> dict:
     """Probe the chip in a THROWAWAY subprocess: the tunnel can wedge
@@ -181,6 +255,12 @@ def main():
         "attempt_spreads_pct": attempt_spreads,
         "load1": round(os.getloadavg()[0], 2),
     }
+    # Constrained-decode probe rides along — a probe failure must never
+    # cost the headline line.
+    try:
+        out["constrained"] = constrained_probe(CONSTRAINED_BATCH)
+    except Exception as e:  # noqa: BLE001 — diagnostics beat a dead line
+        out["constrained"] = {"error": f"{type(e).__name__}: {e}"}
     if probe is not None and not probe.get("ok"):
         out["tpu_probe"] = probe
     print(json.dumps(out))

@@ -34,6 +34,22 @@ class EngineConfig:
     speculative: str = "off"                # off | ngram
     spec_k: int = 4                         # max drafted tokens per step
     spec_ngram: int = 3                     # trailing n-gram for lookup
+    # Device-resident grammar decode: finite-state grammars (regex /
+    # json_schema) compile to dense token-level transition tables
+    # (next_state[S, V] int32 + legal[S, V] bool) uploaded once per
+    # (grammar, vocab), so constrained rows run INSIDE the fused
+    # multi-step scan with zero per-token host syncs. "auto" tables every
+    # eligible grammar and falls back to the host-synced mask path when
+    # the reachable state count exceeds grammar_state_budget (or for the
+    # pushdown JSON grammar, which has no finite table); "off" keeps
+    # every constrained row on the host-synced path.
+    grammar_table: str = "auto"             # auto | off
+    # Max token-level states materialized per grammar. A grammar's table
+    # costs pow2(S) × V × 5 bytes (int32 + bool) host- AND device-side
+    # (device blocks are pow-2-padded and live while the grammar sits in
+    # the 64-entry pattern/schema LRU — budget the AGGREGATE against
+    # your vocab and HBM, worst case 64 × budget × V × 5).
+    grammar_state_budget: int = 512
     use_pallas: str = "auto"                # auto | always | never
     mode: str = "unified"                   # unified | prefill | decode
     mesh_spec: Optional[dict] = None        # {"dp": 1, "tp": 4} — from discovery
@@ -69,6 +85,12 @@ class EngineConfig:
                                  "dispatch)")
             if self.spec_k < 1 or self.spec_ngram < 1:
                 raise ValueError("spec_k and spec_ngram must be >= 1")
+        if self.grammar_table not in ("auto", "off"):
+            raise ValueError(f"grammar_table {self.grammar_table!r} not in "
+                             "(auto, off)")
+        if self.grammar_state_budget < 2:
+            raise ValueError("grammar_state_budget must be >= 2 (initial "
+                             "state + at least one successor)")
         if self.kv_dtype not in ("model", "int8"):
             raise ValueError(f"kv_dtype {self.kv_dtype!r} not in (model, int8)")
         if self.kv_dtype == "int8" and self.mode != "unified":

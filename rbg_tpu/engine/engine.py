@@ -127,6 +127,10 @@ class Engine:
         self._grammar_eos = None
         self._token_trie = None
         self._regex_grammars = collections.OrderedDict()
+        # Device-resident grammar tables: one upload per batch grammar
+        # combination (per-grammar np tables cached on the TokenGrammar
+        # itself; see _device_grammar_tables).
+        self._gtable_dev = collections.OrderedDict()
         # Events drained outside step() (e.g. a runtime load_lora must
         # flush the fused pipeline) surface on the NEXT step() call.
         self._deferred_events: List[StepEvent] = []
@@ -189,6 +193,7 @@ class Engine:
                                     self._grammar_eos,
                                     trie=self._token_trie)
         self._regex_grammars = collections.OrderedDict()
+        self._gtable_dev = collections.OrderedDict()
 
     _REGEX_GRAMMAR_CACHE = 64
 
@@ -354,6 +359,107 @@ class Engine:
         out = np.zeros(V, bool)
         out[:min(len(m), V)] = m[:V]
         return out
+
+    # ---- device-resident grammar tables ----
+
+    # Multi-grammar combination LRU: shallow on purpose — each entry
+    # duplicates its grammars' device blocks, so hold only the current
+    # composition plus one predecessor (ping-pong recompositions).
+    _GTABLE_DEV_CACHE = 2
+
+    def _grammar_table(self, tg):
+        """The host-side GrammarTable for a TokenGrammar, or None when the
+        grammar must stay on the host-synced path (tables disabled,
+        pushdown JSON grammar, or state budget exceeded). The compile —
+        and a budget failure — is cached on the grammar object, which is
+        itself LRU-cached per pattern/schema, so each grammar pays BFS
+        once per engine lifetime."""
+        if self.cfg.grammar_table == "off":
+            return None
+        from rbg_tpu.engine.grammar import NfaGrammar, compile_token_table
+        if not isinstance(tg.grammar, NfaGrammar):
+            return None     # JsonGrammar: pushdown, no finite token table
+        budget = self.cfg.grammar_state_budget
+        cached = getattr(tg, "_table_cache", None)
+        if cached is not None and cached[0] == budget:
+            return cached[1]
+        table = compile_token_table(tg, budget, self.mcfg.vocab_size)
+        tg._table_cache = (budget, table)
+        return table
+
+    def _row_fusable(self, r: Request) -> bool:
+        """True when the row can decode inside the fused scan: no grammar,
+        or a grammar with a compiled device table."""
+        return r.grammar is None or self._grammar_table(r.grammar) is not None
+
+    def _grammar_dev_block(self, tg):
+        """A grammar's table on device, offset-free, padded to the next
+        POWER-OF-TWO state count (rows past the table are -1/False,
+        unreachable) — ONE upload per (grammar, vocab), cached on the
+        grammar object. Pow-2 buckets keep [S, V] shapes stable across
+        similarly-sized grammars (compiled decode programs reuse within a
+        bucket, ≤ log2(budget) shapes total) WITHOUT paying a full
+        budget-sized block for a 3-state regex: blocks live as long as
+        their grammar sits in the pattern/schema LRU, so the aggregate
+        device retention is Σ pow2(S_g) × V × 5 bytes over cached
+        grammars, not 64 × budget × V × 5."""
+        budget = self.cfg.grammar_state_budget
+        cached = getattr(tg, "_dev_block", None)
+        if cached is not None and cached[0] == budget:
+            return cached[1], cached[2]
+        t = self._grammar_table(tg)
+        V = self.mcfg.vocab_size
+        S = 1
+        while S < t.num_states:
+            S *= 2
+        nxt = np.full((S, V), -1, np.int32)
+        leg = np.zeros((S, V), bool)
+        nxt[:t.num_states] = t.next_state
+        leg[:t.num_states] = t.legal
+        nxt_dev, leg_dev = jnp.asarray(nxt), jnp.asarray(leg)
+        tg._dev_block = (budget, nxt_dev, leg_dev)
+        return nxt_dev, leg_dev
+
+    def _device_grammar_tables(self, grammars):
+        """(next_state_dev [S, V] int32, legal_dev [S, V] bool, offsets)
+        for a batch's grammars: per-grammar device blocks concatenated
+        with per-grammar state-id offsets so one array pair serves every
+        row (a row's device gstate = offset + its table's local state
+        id). The common single-grammar batch reuses the grammar's own
+        block directly — no copy; multi-grammar combinations concatenate
+        ON DEVICE (offsets applied with a where, no host re-upload) and
+        are LRU-cached only shallowly: combinations are transient batch
+        compositions, and each held entry duplicates its blocks' memory.
+        Entries hold strong grammar refs so the id()-keys stay valid
+        while cached."""
+        uniq, seen = [], set()
+        for g in grammars:
+            if id(g) not in seen:
+                seen.add(id(g))
+                uniq.append(g)
+        if len(uniq) == 1:
+            nxt, leg = self._grammar_dev_block(uniq[0])
+            return nxt, leg, {id(uniq[0]): 0}
+        key = tuple(sorted(id(g) for g in uniq))
+        hit = self._gtable_dev.get(key)
+        if hit is not None:
+            self._gtable_dev.move_to_end(key)
+            return hit[0], hit[1], hit[2]
+        offsets: Dict[int, int] = {}
+        nexts, legals = [], []
+        off = 0
+        for g in uniq:
+            nxt, leg = self._grammar_dev_block(g)
+            offsets[id(g)] = off
+            nexts.append(jnp.where(nxt >= 0, nxt + off, -1))
+            legals.append(leg)
+            off += nxt.shape[0]
+        entry = (jnp.concatenate(nexts), jnp.concatenate(legals),
+                 offsets, list(uniq))
+        self._gtable_dev[key] = entry
+        if len(self._gtable_dev) > self._GTABLE_DEV_CACHE:
+            self._gtable_dev.popitem(last=False)
+        return entry[0], entry[1], entry[2]
 
     def add_request(self, prompt: List[int],
                     sampling: Optional[SamplingParams] = None) -> int:
@@ -673,8 +779,12 @@ class Engine:
         for r in self.running:
             if r.state != "running":
                 continue
-            if r.gstate is not None and self.cfg.speculative != "ngram":
-                continue    # grammar rows decode via the host-synced step
+            if (r.gstate is not None and self.cfg.speculative != "ngram"
+                    and not self._row_fusable(r)):
+                # Table-less grammar rows (pushdown JSON / budget-exceeded
+                # / tables off) decode via the host-synced step; tabled
+                # grammars join the fused window.
+                continue
             if len(r.output) + pend.get(id(r), 0) >= r.sampling.max_new_tokens:
                 continue
             out.append(r)
@@ -706,18 +816,29 @@ class Engine:
         return self._emit_pending(st["pending"])
 
     def _get_decode_fn(self, B: int, pen: bool, lp: bool,
-                       tpmp: bool = True, la: bool = False):
+                       tpmp: bool = True, la: bool = False,
+                       gr: bool = False):
         """One fused jitted program per (decode bucket, penalties-active,
-        logprobs-active): a lax.scan window of ``multi_step`` iterations,
-        each = forward + on-device sampling + position/length increment,
-        with the sampled token fed straight back as the next iteration's
-        input. Per-row sampling keys are fold_in(row_key, position) — no
-        key-split carry, and a state rebuild replays the identical stream.
-        Steady state does ZERO host→device transfers per window and one
-        device→host fetch (the [K, B] token ids, one window late). Penalty
-        state ([B, V] prompt mask + output counts) and per-step logprobs
-        only exist in the variants that need them."""
-        fn = self._dec_fn_cache.get((B, pen, lp, tpmp, la))
+        logprobs-active, grammar-active): a lax.scan window of
+        ``multi_step`` iterations, each = forward + on-device sampling +
+        position/length increment, with the sampled token fed straight
+        back as the next iteration's input. Per-row sampling keys are
+        fold_in(row_key, position) — no key-split carry, and a state
+        rebuild replays the identical stream. Steady state does ZERO
+        host→device transfers per window and one device→host fetch (the
+        [K, B] token ids, one window late). Penalty state ([B, V] prompt
+        mask + output counts), grammar state (per-row table-state id +
+        the shared [S, V] transition/legality arrays), and per-step
+        logprobs only exist in the variants that need them.
+
+        The grammar variant masks logits with ``glegal[gstate]`` BEFORE
+        sampling (the exact order of the host-synced path: mask, then
+        penalties inside ``sample``) and transitions ``gstate =
+        gnext[gstate, tok]`` on device — a constrained row costs the same
+        dispatches as an unconstrained one. A −1 transition (EOS from a
+        non-identity state can't happen; defensive) keeps the old state,
+        mirroring ``_emit``'s keep-state-on-EOS bookkeeping."""
+        fn = self._dec_fn_cache.get((B, pen, lp, tpmp, la, gr))
         if fn is not None:
             return fn
         import functools
@@ -728,9 +849,10 @@ class Engine:
         def fused(params, tok, pos, kvl, table, mask, limit, k_pages,
                   v_pages, k_scales, v_scales, keys, temps, ks, tps, mps,
                   pmask=None, ocounts=None, rep=None, pres=None, freq=None,
-                  lora=None, lids=None):
+                  lora=None, lids=None, gnext=None, glegal=None,
+                  gstate=None, gactive=None):
             def body(carry, _):
-                tok, pos, kvl, kp, vp, ksc, vsc, oc = carry
+                tok, pos, kvl, kp, vp, ksc, vsc, oc, gs = carry
                 # Rows at their length limit (mid-window finishers) stop
                 # writing KV and stop advancing — their sampled values are
                 # discarded host-side via the per-row valid count.
@@ -742,30 +864,41 @@ class Engine:
                     lora=lora, lora_ids=lids)
                 pkw = (dict(prompt_mask=pmask, out_counts=oc, rep=rep,
                             pres=pres, freq=freq) if pen else {})
+                lg = logits[:, 0, :]
+                if gr:
+                    # Grammar mask first, penalties inside sample() after —
+                    # the identical order the host-synced path applies.
+                    lg = jnp.where(glegal[gs] | ~gactive[:, None],
+                                   lg, NEG_INF)
                 # Key by the OUTPUT token's position (pos + 1): the input
                 # token at ``pos`` was itself sampled with key fold_in(row,
                 # pos) — prefill keys its first token by seq_len, so reusing
                 # ``pos`` here would replay that exact Gumbel noise.
-                toks, lps = sample(logits[:, 0, :], step_keys(keys, pos + 1),
+                toks, lps = sample(lg, step_keys(keys, pos + 1),
                                    temps, ks, tps, mps, want_logprobs=lp,
                                    use_top_p_min_p=tpmp, **pkw)
                 active = write_ok[:, 0]
                 if pen:
                     oc = oc.at[jnp.arange(oc.shape[0]), toks].add(
                         active.astype(jnp.int32))
+                if gr:
+                    ns = gnext[gs, toks]
+                    gs = jnp.where(gactive & active & (ns >= 0), ns, gs)
                 pos = jnp.where(active, pos + 1, pos)
                 kvl = jnp.where(active, kvl + 1, kvl)
                 tok = jnp.where(active, toks, tok)
                 ys = (toks, lps) if lp else toks
-                return (tok, pos, kvl, kp, vp, ksc, vsc, oc), ys
+                return (tok, pos, kvl, kp, vp, ksc, vsc, oc, gs), ys
 
             oc0 = ocounts if pen else jnp.zeros((), jnp.int32)
+            gs0 = gstate if gr else jnp.zeros((), jnp.int32)
             carry, ys = jax.lax.scan(
                 body, (tok, pos, kvl, k_pages, v_pages, k_scales, v_scales,
-                       oc0), None, length=K)
-            tok, pos, kvl, kp, vp, ksc, vsc, oc = carry
+                       oc0, gs0), None, length=K)
+            tok, pos, kvl, kp, vp, ksc, vsc, oc, gs = carry
             toks_seq, lp_seq = ys if lp else (ys, None)
-            return toks_seq, lp_seq, tok, pos, kvl, kp, vp, ksc, vsc, oc
+            return (toks_seq, lp_seq, tok, pos, kvl, kp, vp, ksc, vsc, oc,
+                    gs)
 
         # tok is NOT donated: the pending fetch reads last window's output
         # after it has been fed back as this window's input. keys is reused
@@ -775,7 +908,7 @@ class Engine:
         if pen:
             donate.append(17)  # ocounts
         fn = jax.jit(fused, donate_argnums=tuple(donate))
-        self._dec_fn_cache[(B, pen, lp, tpmp, la)] = fn
+        self._dec_fn_cache[(B, pen, lp, tpmp, la, gr)] = fn
         return fn
 
     def _build_decode_state(self, batch: List[Request]) -> dict:
@@ -815,6 +948,26 @@ class Engine:
                 np.add.at(oc[i], np.asarray(r.output, np.int64), 1)
             st.update(pmask=pmask, ocounts=jnp.asarray(oc),
                       rep=rep, pres=pres, freq=freq)
+        gr_rows = [r for r in batch if r.gstate is not None]
+        st["gr"] = bool(gr_rows)
+        if gr_rows:
+            # Device-resident grammar decode: per-row table-state ids into
+            # the stacked [S, V] tables. A rebuild recovers the device
+            # state exactly from req.gstate — host bookkeeping (_emit)
+            # advances it token-by-token, and every engine gstate is
+            # whole-token-reachable, so the lookup cannot miss.
+            gnext, glegal, offsets = self._device_grammar_tables(
+                [r.grammar for r in gr_rows])
+            gstate = np.zeros(B, np.int32)
+            gactive = np.zeros(B, bool)
+            for i, r in enumerate(batch):
+                if r.gstate is not None:
+                    t = self._grammar_table(r.grammar)
+                    gstate[i] = offsets[id(r.grammar)] + t.state_ids[r.gstate]
+                    gactive[i] = True
+            st.update(gnext=gnext, glegal=glegal,
+                      gstate=jnp.asarray(gstate),
+                      gactive=jnp.asarray(gactive))
         return st
 
     def _decode_step(self) -> List[StepEvent]:
@@ -824,11 +977,12 @@ class Engine:
             # penalized/grammar rows simply never draft).
             events = self._drain_decode()
             return events + self._spec_decode_step()
-        if any(r.gstate is not None for r in self.running
-               if r.state == "running"):
-            # Mixed traffic: ONLY grammar rows pay the per-token
-            # host-synced step; everyone else keeps the fused multi-step
-            # path (its _decode_batch excludes grammar rows below).
+        if any(r.gstate is not None and not self._row_fusable(r)
+               for r in self.running if r.state == "running"):
+            # Mixed traffic: ONLY table-less grammar rows pay the
+            # per-token host-synced step; everyone else — tabled grammar
+            # rows included — keeps the fused multi-step path (its
+            # _decode_batch excludes exactly the host-synced rows).
             events = self._spec_decode_step(grammar_only=True)
             return events + self._fused_decode_step()
         return self._fused_decode_step()
@@ -907,14 +1061,18 @@ class Engine:
             st["table"] = jnp.asarray(st["table_np"])
 
         fn = self._get_decode_fn(st["B"], st["pen"], st["lp"],
-                                 st["tpmp"], st["lids"] is not None)
+                                 st["tpmp"], st["lids"] is not None,
+                                 st["gr"])
         kw = {}
         if st["pen"]:
             kw.update(pmask=st["pmask"], ocounts=st["ocounts"],
                       rep=st["rep"], pres=st["pres"], freq=st["freq"])
         if st["lids"] is not None:
             kw.update(lora=self.lora_stack, lids=st["lids"])
-        toks_seq, lp_seq, tok, pos, kvl, kp, vp, ksc, vsc, oc = fn(
+        if st["gr"]:
+            kw.update(gnext=st["gnext"], glegal=st["glegal"],
+                      gstate=st["gstate"], gactive=st["gactive"])
+        toks_seq, lp_seq, tok, pos, kvl, kp, vp, ksc, vsc, oc, gs = fn(
             self.params, st["tok"], st["pos"], st["kvl"], st["table"],
             st["mask"], st["limit"], self.cache.k_pages, self.cache.v_pages,
             self.cache.k_scales, self.cache.v_scales,
@@ -924,6 +1082,8 @@ class Engine:
         st["tok"], st["pos"], st["kvl"] = tok, pos, kvl
         if st["pen"]:
             st["ocounts"] = oc
+        if st["gr"]:
+            st["gstate"] = gs
         valid = []
         for req in batch:
             valid.append(min(K, req.max_len() - req.seq_len))
@@ -1001,7 +1161,8 @@ class Engine:
     def _spec_decode_step(self, grammar_only: bool = False) -> List[StepEvent]:
         events: List[StepEvent] = []
         batch = [r for r in self.running if r.state == "running"
-                 and (not grammar_only or r.gstate is not None)
+                 and (not grammar_only
+                      or (r.gstate is not None and not self._row_fusable(r)))
                  and len(r.output) < r.sampling.max_new_tokens]
         if not batch:
             return events

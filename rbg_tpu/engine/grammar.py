@@ -13,12 +13,21 @@ JSON pushdown automaton lifted to token masks:
   from ``state`` (plus EOS iff complete); ``advance_token`` folds a
   token's bytes into the state.
 
-Engine integration (engine.py): constrained rows decode through the
-spec-style host-synced step. Masks for drafted positions are computed
-host-side ALONG THE DRAFT PATH — the mask at position i+1 assumes drafts
-0..i were accepted, which holds exactly for every accepted prefix, so
-grammar constraints and speculative decoding compose without
-approximation (a draft token the grammar forbids truncates the draft).
+Engine integration (engine.py), two paths:
+
+* **Device-resident tables** — finite-state grammars (the NFA family
+  below) additionally compile to a dense token-level product automaton
+  (``compile_token_table``: ``next_state[S, V]`` + ``legal[S, V]``,
+  BFS capped by a state budget), uploaded once per (grammar, vocab);
+  constrained rows then decode INSIDE the fused multi-step scan with
+  zero per-token host syncs, bit-identical to the mask path.
+* **Host-synced masks** — the pushdown ``JsonGrammar``, budget-exceeded
+  grammars, and speculative mode decode through the spec-style
+  host-synced step. Masks for drafted positions are computed host-side
+  ALONG THE DRAFT PATH — the mask at position i+1 assumes drafts 0..i
+  were accepted, which holds exactly for every accepted prefix, so
+  grammar constraints and speculative decoding compose without
+  approximation (a draft token the grammar forbids truncates the draft).
 
 Complexity note: ``mask`` walks a precompiled byte-path TRIE over the
 vocabulary (xgrammar-style): the automaton advances once per trie NODE,
@@ -34,9 +43,10 @@ state they visit.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 from collections import OrderedDict
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -545,6 +555,18 @@ class JsonSchemaGrammar(NfaGrammar):
     _MAX_DEPTH = 16
     _UNSUPPORTED = ("$ref", "allOf", "not", "patternProperties",
                     "if", "then", "else", "dependentSchemas")
+    # Constraint keywords this compiler actually ENFORCES. Anything else
+    # that could change which documents validate is rejected at admission
+    # (a keyword silently ignored would emit output the client's schema
+    # rejects — the worst possible structured-output failure).
+    _HANDLED = frozenset({
+        "type", "properties", "items", "minItems", "maxItems",
+        "minLength", "maxLength", "pattern", "enum", "const",
+        "anyOf", "oneOf", "required", "additionalProperties"})
+    # Annotation keywords with no validation semantics: safe to ignore.
+    _ANNOTATIONS = frozenset({
+        "title", "description", "default", "examples", "$schema", "$id",
+        "$comment", "deprecated", "readOnly", "writeOnly"})
 
     def __init__(self, schema: dict):
         if not isinstance(schema, dict):
@@ -566,6 +588,14 @@ class JsonSchemaGrammar(NfaGrammar):
         for kw in self._UNSUPPORTED:
             if kw in schema:
                 raise ValueError(f"json_schema: unsupported keyword {kw!r}")
+        for kw in schema:
+            if kw not in self._HANDLED and kw not in self._ANNOTATIONS:
+                raise ValueError(
+                    f"json_schema: unrecognized constraint keyword {kw!r}"
+                    " — this compiler enforces "
+                    f"{sorted(self._HANDLED)} and refuses to silently "
+                    "ignore anything else")
+        self._check_required(schema)
         if "const" in schema:
             return self._scalar_lit(schema["const"])
         if "enum" in schema:
@@ -600,6 +630,26 @@ class JsonSchemaGrammar(NfaGrammar):
         if t == "null":
             return self._lit_bytes(b"null")
         raise ValueError(f"json_schema: unsupported type {t!r}")
+
+    @staticmethod
+    def _check_required(schema: dict) -> None:
+        """``required`` and ``additionalProperties`` are accepted exactly
+        when the compiler's emission already satisfies them by
+        construction (every declared property emitted, nothing else);
+        shapes that would need real enforcement raise."""
+        if "required" in schema:
+            req = schema["required"]
+            props = schema.get("properties") or {}
+            if not isinstance(req, list) or not isinstance(props, dict) \
+                    or not set(req) <= set(props):
+                raise ValueError(
+                    "json_schema: required must list declared properties "
+                    "(all properties are always emitted, so anything else "
+                    "is unsatisfiable)")
+        if schema.get("additionalProperties", False) is not False:
+            raise ValueError(
+                "json_schema: additionalProperties must be false/absent — "
+                "emission is closed over the declared properties")
 
     @staticmethod
     def _scalar_lit(v):
@@ -798,6 +848,22 @@ class TokenGrammar:
             self._mask_cache.move_to_end(state)
             return np.unpackbits(cached, count=self.V).astype(bool)
         out = np.zeros(self.V, bool)
+        for toks, _ns in self._trie_walk(state):
+            out[toks] = True
+        if self.eos_id is not None and self.eos_id < self.V:
+            out[self.eos_id] = self.grammar.is_complete(state)
+        self._mask_cache[state] = np.packbits(out)
+        if len(self._mask_cache) > self.MASK_CACHE_SIZE:
+            self._mask_cache.popitem(last=False)
+        return out
+
+    def _trie_walk(self, state: State) -> List[Tuple[list, State]]:
+        """(token ids, byte-grammar state) per trie node whose byte path
+        is legal from ``state`` and ends at least one token. The SINGLE
+        source of the legality walk: ``mask`` (which discards the states)
+        and ``token_transitions`` (which keeps them) both consume it, so
+        the table path's bit-identical-to-mask contract can't drift."""
+        out: List[Tuple[list, State]] = []
         children = self.trie.children
         tokens = self.trie.tokens
         adv = self.grammar.advance
@@ -812,16 +878,20 @@ class TokenGrammar:
                     continue
                 toks = tokens[child]
                 if toks:
-                    out[toks] = True
+                    out.append((toks, ns))
                 if children[child]:
                     stack.append((child, ns))
         self.stats["advance_calls"] += n_adv
-        if self.eos_id is not None and self.eos_id < self.V:
-            out[self.eos_id] = self.grammar.is_complete(state)
-        self._mask_cache[state] = np.packbits(out)
-        if len(self._mask_cache) > self.MASK_CACHE_SIZE:
-            self._mask_cache.popitem(last=False)
         return out
+
+    def token_transitions(self, state: State) -> List[Tuple[int, State]]:
+        """(token id, byte-grammar state after the token) for every
+        non-special token legal from ``state``. EOS is NOT included (its
+        transition is identity-on-complete; see ``advance_token``). The
+        legal-token set is exactly ``mask(state)`` minus EOS — same walk,
+        same trie (``_trie_walk``)."""
+        return [(tid, ns) for toks, ns in self._trie_walk(state)
+                for tid in toks]
 
     def _mask_probe(self, state: State) -> np.ndarray:
         """Reference implementation: probe every token's bytes from
@@ -842,6 +912,90 @@ class TokenGrammar:
         if self.eos_id is not None and self.eos_id < self.V:
             out[self.eos_id] = self.grammar.is_complete(state)
         return out
+
+
+@dataclasses.dataclass
+class GrammarTable:
+    """Token-level product automaton of (byte grammar × vocab), dense —
+    the xgrammar-style device-resident form of a finite-state grammar.
+
+    ``next_state[s, v]`` is the state after sampling token ``v`` in state
+    ``s`` (−1 = illegal); ``legal[s, v]`` marks the tokens the grammar
+    allows (EOS legal exactly at accepting states, where its transition is
+    the identity — the engine finishes the row host-side, matching
+    ``TokenGrammar.advance_token``'s keep-state-on-EOS contract). Row
+    ``legal[s]`` equals the host path's ``mask(state)`` padded to the
+    model vocab bit-for-bit: both come from the same trie walk, which is
+    what makes fused table decode provably emit the host-synced stream.
+
+    ``state_ids`` maps byte-grammar states to rows. It covers every state
+    reachable from ``initial`` by WHOLE-token advances — the only states
+    engine bookkeeping can ever hold (prefill, decode, PD injection, and
+    preemption resume all advance token-at-a-time from initial)."""
+
+    next_state: np.ndarray            # [S, V] int32, -1 = illegal
+    legal: np.ndarray                 # [S, V] bool
+    state_ids: Dict[State, int]       # byte-grammar state -> row
+    initial_id: int = 0
+
+    @property
+    def num_states(self) -> int:
+        return self.next_state.shape[0]
+
+    @property
+    def nbytes(self) -> int:
+        return self.next_state.nbytes + self.legal.nbytes
+
+
+def compile_token_table(tg: TokenGrammar, state_budget: int,
+                        vocab_size: Optional[int] = None
+                        ) -> Optional[GrammarTable]:
+    """BFS the token-level automaton of ``tg`` into a ``GrammarTable``.
+
+    Returns None when more than ``state_budget`` states are reachable —
+    the caller keeps the host-synced mask path for that grammar. Intended
+    for finite-state grammars (``NfaGrammar`` subclasses); a pushdown
+    grammar (``JsonGrammar``) has unbounded reachable states and would
+    simply exhaust the budget, so callers should gate on the grammar type
+    and never pay the doomed BFS.
+
+    ``vocab_size`` pads columns to the MODEL vocab (ids beyond the
+    tokenizer's table are never legal — same contract as the engine's
+    host-side ``_gmask`` padding). Memory: S × V × 5 bytes host-side
+    (int32 + bool), uploaded once per (grammar, vocab) by the engine."""
+    V = vocab_size if vocab_size is not None else tg.V
+    g = tg.grammar
+    init = tg.initial()
+    states: List[State] = [init]
+    ids: Dict[State, int] = {init: 0}
+    rows_next: List[np.ndarray] = []
+    rows_legal: List[np.ndarray] = []
+    i = 0
+    while i < len(states):
+        st = states[i]
+        nxt = np.full(V, -1, np.int32)
+        legal = np.zeros(V, bool)
+        for tok, ns in tg.token_transitions(st):
+            if tok >= V:
+                continue              # beyond the model vocab: never legal
+            sid = ids.get(ns)
+            if sid is None:
+                if len(states) >= state_budget:
+                    return None       # budget exceeded → host-synced path
+                sid = len(states)
+                ids[ns] = sid
+                states.append(ns)
+            nxt[tok] = sid
+            legal[tok] = True
+        if (tg.eos_id is not None and tg.eos_id < V
+                and g.is_complete(st)):
+            legal[tg.eos_id] = True
+            nxt[tg.eos_id] = i        # EOS keeps the state; host finishes
+        rows_next.append(nxt)
+        rows_legal.append(legal)
+        i += 1
+    return GrammarTable(next_state=np.stack(rows_next),
+                        legal=np.stack(rows_legal), state_ids=ids)
 
 
 def token_bytes_for(tokenizer) -> List[Optional[bytes]]:

@@ -172,19 +172,26 @@ class DecodeWorker:
         )
         req = Request(prompt, sampling)
         req.lora_idx = lora_idx
-        if sampling.json_mode:
-            st = eng.grammar.initial()
+        g = eng._grammar_for(sampling)
+        if g is not None:
             # The first token was sampled prefill-side under the grammar
             # mask — fold it in so decode continues from the right state.
-            nxt = eng.grammar.advance_token(st, bundle.first_token)
+            # This must cover ALL THREE constraint kinds: a json_mode
+            # request without req.grammar used to crash the decode batch
+            # (advance_token on a None grammar), and regex/json_schema
+            # requests silently decoded UNCONSTRAINED.
+            nxt = g.advance_token(g.initial(), bundle.first_token)
             if nxt is None:
                 # A grammar-wired prefill can't produce this; it means the
-                # prefill peer ignored json_mode (mixed-version deploy).
-                # Reject rather than emit corrupt "constrained" output.
+                # prefill peer ignored the constraint (mixed-version
+                # deploy). Reject rather than emit corrupt "constrained"
+                # output.
                 eng.allocator.release(pages)
                 raise ValueError(
-                    f"first token {bundle.first_token} violates the JSON "
-                    "grammar — prefill peer ignored json_mode?")
+                    f"first token {bundle.first_token} violates the "
+                    "request's grammar constraint — prefill peer ignored "
+                    "json_mode/regex/json_schema?")
+            req.grammar = g
             req.gstate = nxt
         req.state = "running"
         req.pages = pages

@@ -39,6 +39,8 @@ def build_config(args) -> EngineConfig:
         speculative=args.speculative,
         spec_k=args.spec_k,
         spec_ngram=args.spec_ngram,
+        grammar_table=args.grammar_table,
+        grammar_state_budget=args.grammar_state_budget,
     )
 
 
@@ -440,6 +442,16 @@ def main(argv=None) -> int:
                     help="max drafted tokens per speculative verify step")
     ap.add_argument("--spec-ngram", type=int, default=3,
                     help="trailing n-gram length for prompt lookup")
+    ap.add_argument("--grammar-table", choices=("auto", "off"),
+                    default="auto",
+                    help="device-resident grammar tables: constrained "
+                         "(regex/json_schema) rows decode inside the fused "
+                         "multi-step window; 'off' keeps the host-synced "
+                         "per-token mask path")
+    ap.add_argument("--grammar-state-budget", type=int, default=512,
+                    help="max token-level automaton states per grammar "
+                         "table (S x V x 5 bytes each); grammars over "
+                         "budget fall back to the host-synced path")
     args = ap.parse_args(argv)
     serve(args)
     return 0

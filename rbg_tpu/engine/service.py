@@ -324,13 +324,19 @@ class DecodeService(_BatchService):
         from rbg_tpu.engine.config import warm_prompt
 
         eng = self.engine
-        L, _, page, KV, hd = eng.cache.k_pages.shape
         n_pages = pages_for_tokens(input_len, eng.cfg.page_size)
-        shape = (L, n_pages, page, KV, hd)
-        dt = np.dtype(eng.cache.k_pages.dtype)
-        return KVBundle(prompt=warm_prompt(input_len, wave, row),
-                        first_token=1,
-                        k_data=np.zeros(shape, dt), v_data=np.zeros(shape, dt))
+        # k and v bundle halves take their OWN pool's shape/dtype: under
+        # MLA the v pool holds the shared RoPE key (different channel dim
+        # than the k latent) — deriving both from k_pages made every MLA
+        # decode replica fail its {"op": "warmup"}.
+        kshape = eng.cache.k_pages.shape
+        vshape = eng.cache.v_pages.shape
+        return KVBundle(
+            prompt=warm_prompt(input_len, wave, row), first_token=1,
+            k_data=np.zeros((kshape[0], n_pages) + kshape[2:],
+                            np.dtype(eng.cache.k_pages.dtype)),
+            v_data=np.zeros((vshape[0], n_pages) + vshape[2:],
+                            np.dtype(eng.cache.v_pages.dtype)))
 
     def submit_bundle(self, bundle, sampling: SamplingParams,
                       timeout: float = DEFAULT_TIMEOUT_S) -> List[int]:

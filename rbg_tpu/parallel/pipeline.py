@@ -98,11 +98,11 @@ def pipeline_blocks(params_blocks, cfg, x, token_mask, mesh: Mesh,
     body = functools.partial(_stage_shard, cfg=cfg, axis=axis)
     blocks_spec = jax.tree_util.tree_map(
         lambda leaf: P(axis, *([None] * (leaf.ndim - 1))), params_blocks)
-    fn = jax.shard_map(
+    from rbg_tpu.parallel.mesh import shard_map_compat
+    fn = shard_map_compat(
         body, mesh=mesh,
         in_specs=(blocks_spec, P(), P()),
         out_specs=P(),
-        check_vma=False,
     )
     out = fn(params_blocks, x_micro, mask_micro)
     return out.reshape(B, T, D)

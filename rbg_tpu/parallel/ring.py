@@ -82,10 +82,10 @@ def ring_attention(q, k, v, q_positions, kv_positions, mesh: Mesh,
     body = functools.partial(_ring_attention_shard, axis=axis)
     spec_qkv = P(None, axis, None, None)
     spec_pos = P(None, axis)
-    fn = jax.shard_map(
+    from rbg_tpu.parallel.mesh import shard_map_compat
+    fn = shard_map_compat(
         body, mesh=mesh,
         in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_pos, spec_pos),
         out_specs=spec_qkv,
-        check_vma=False,
     )
     return fn(q, k, v, q_positions, kv_positions)

@@ -19,7 +19,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (< 0.5) has no jax_num_cpu_devices option; there the
+    # XLA_FLAGS env var above (set before any backend touch) is the only
+    # device-count knob — and sufficient unless jax was pre-imported.
+    pass
 
 import pytest  # noqa: E402
 

@@ -133,6 +133,7 @@ def test_admin_tls_with_token(tmp_path):
     across restarts (idempotent bootstrap)."""
     import ssl
 
+    pytest.importorskip("cryptography")   # cert mint needs the optional dep
     from rbg_tpu.api import serde
     from rbg_tpu.runtime.tlsutil import client_context, ensure_certs
 
@@ -222,6 +223,7 @@ def test_tls_server_cert_rotation_preserves_ca(tmp_path, monkeypatch):
     stays valid across rotation; only CA expiry forces a re-pin."""
     import os
 
+    pytest.importorskip("cryptography")   # cert mint needs the optional dep
     from rbg_tpu.runtime import tlsutil
 
     d = str(tmp_path / "certs")

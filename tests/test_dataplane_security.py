@@ -101,6 +101,7 @@ def test_pool_open_wire_without_token_flag():
 
 
 def test_pool_tls_rejects_plaintext_and_serves_pinned_clients(tmp_path):
+    pytest.importorskip("cryptography")   # cert mint needs the optional dep
     from rbg_tpu.runtime.tlsutil import ensure_certs, server_context
 
     ca, cert, key = ensure_certs(str(tmp_path / "certs"))

@@ -1,0 +1,274 @@
+"""Device-resident grammar decode: finite-state grammars compile to dense
+token-level transition tables (next_state[S, V] / legal[S, V]) and
+constrained rows run INSIDE the fused multi-step scan — zero per-token
+host syncs. The acceptance bar is exactness: the table path must emit
+BIT-IDENTICAL tokens to the host-synced mask path (the engine's
+position-keyed sampling makes that checkable), across greedy and
+temperature sampling, mixed batches, preemption, and the state-budget
+fallback."""
+
+import json
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from rbg_tpu.engine import Engine, EngineConfig, SamplingParams
+from rbg_tpu.engine.grammar import (JsonGrammar, JsonSchemaGrammar,
+                                    RegexGrammar, TokenGrammar,
+                                    compile_token_table, token_bytes_for)
+from rbg_tpu.engine.tokenizer import ByteTokenizer
+from rbg_tpu.models import get_config, init_params
+
+_TOK = ByteTokenizer()
+
+SCHEMA = {"type": "object", "properties": {
+    "id": {"type": "integer"},
+    "state": {"enum": ["on", "off"]},
+}}
+
+
+# ---- table compiler ----
+
+
+def _tg(grammar):
+    return TokenGrammar(grammar, token_bytes_for(_TOK), _TOK.eos_id)
+
+
+def test_table_legality_matches_mask_on_every_state():
+    """legal[s] must equal the host path's mask(state) for every table
+    state — that equality is what makes fused decode provably exact."""
+    tg = _tg(RegexGrammar(r"(GET|POST) /[a-z/]{0,6} HTTP"))
+    t = compile_token_table(tg, state_budget=256)
+    assert t is not None
+    assert len(t.state_ids) == t.num_states
+    for state, sid in t.state_ids.items():
+        np.testing.assert_array_equal(t.legal[sid, :tg.V], tg.mask(state))
+        assert not t.legal[sid, tg.V:].any()
+
+
+def test_table_transitions_match_advance_token():
+    tg = _tg(RegexGrammar(r"[ab]{1,4}c"))
+    t = compile_token_table(tg, state_budget=64)
+    for state, sid in t.state_ids.items():
+        for v in np.nonzero(t.legal[sid])[0]:
+            ns = tg.advance_token(state, int(v))
+            assert ns is not None
+            assert t.next_state[sid, v] == t.state_ids[ns]
+        # Illegal tokens are -1 across the whole row.
+        assert (t.next_state[sid][~t.legal[sid]] == -1).all()
+
+
+def test_table_eos_is_identity_at_accepting_states():
+    tg = _tg(RegexGrammar(r"ab?"))
+    t = compile_token_table(tg, state_budget=64)
+    for state, sid in t.state_ids.items():
+        if tg.grammar.is_complete(state):
+            assert t.legal[sid, _TOK.eos_id]
+            assert t.next_state[sid, _TOK.eos_id] == sid
+        else:
+            assert not t.legal[sid, _TOK.eos_id]
+
+
+def test_table_vocab_padding():
+    tg = _tg(RegexGrammar(r"x+"))
+    t = compile_token_table(tg, state_budget=16, vocab_size=512)
+    assert t.next_state.shape == (t.num_states, 512)
+    assert not t.legal[:, tg.V:].any()          # beyond tokenizer: illegal
+
+
+def test_table_budget_exceeded_returns_none():
+    tg = _tg(RegexGrammar(r"[ab]{1,40}c"))
+    assert compile_token_table(tg, state_budget=3) is None
+    assert compile_token_table(tg, state_budget=256) is not None
+
+
+def test_schema_grammar_is_tableable():
+    tg = _tg(JsonSchemaGrammar(SCHEMA))
+    t = compile_token_table(tg, state_budget=512)
+    assert t is not None and t.num_states > 2
+
+
+# ---- engine integration ----
+
+
+@pytest.fixture(scope="module")
+def eng_factory():
+    cfg = get_config("tiny", vocab_size=512)
+    params = init_params(cfg, jax.random.key(0))
+
+    def make(**kw):
+        base = dict(model="tiny", vocab_size=512, page_size=8,
+                    num_pages=128, max_seq_len=256, use_pallas="never",
+                    multi_step=4)
+        base.update(kw)
+        e = Engine(EngineConfig(**base), params=params)
+        e.enable_json_grammar(_TOK)
+        return e
+
+    return make
+
+
+def _run(eng, reqs):
+    ids = [eng.add_request(p, sp) for p, sp in reqs]
+    outs = {r: [] for r in ids}
+    while eng.has_work():
+        for ev in eng.step():
+            outs[ev.request_id].append(ev.token)
+    return [outs[r] for r in ids]
+
+
+def _constrained_reqs(temperature):
+    return [
+        (_TOK.encode("e:", add_bos=False),
+         SamplingParams(max_new_tokens=48, temperature=temperature, seed=1,
+                        json_schema=SCHEMA, stop_token=_TOK.eos_id)),
+        (_TOK.encode("v:", add_bos=False),
+         SamplingParams(max_new_tokens=24, temperature=temperature, seed=2,
+                        regex=r"\d{3}-\d{4}", stop_token=_TOK.eos_id)),
+        ([1, 2, 3], SamplingParams(max_new_tokens=12)),
+        (_TOK.encode("v2:", add_bos=False),
+         SamplingParams(max_new_tokens=30, temperature=temperature, seed=7,
+                        regex=r"(alpha|beta|gamma)", stop_token=_TOK.eos_id)),
+    ]
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.9])
+def test_fused_table_decode_is_bit_identical(eng_factory, temperature):
+    """The headline contract: table-driven fused decode == host-synced
+    decode, token for token, greedy AND sampled, in a mixed batch."""
+    host = eng_factory(grammar_table="off")
+    dev = eng_factory(grammar_table="auto")
+    a = _run(host, _constrained_reqs(temperature))
+    b = _run(dev, _constrained_reqs(temperature))
+    assert a == b
+    # And the paths genuinely differed: host-synced stepped per token,
+    # the table engine never left the fused window.
+    assert host.metrics["spec_steps"] > 0
+    assert dev.metrics["spec_steps"] == 0
+    # Outputs actually satisfy their constraints (a budget-truncated
+    # schema row must still be a legal document prefix).
+    stext = _TOK.decode([t for t in b[0] if t != _TOK.eos_id])
+    if b[0] and b[0][-1] == _TOK.eos_id:
+        doc = json.loads(stext)
+        assert set(doc) == {"id", "state"} and doc["state"] in ("on", "off")
+    else:
+        g = JsonSchemaGrammar(SCHEMA)
+        s = g.initial()
+        for byte in stext.encode():
+            s = g.advance(s, byte)
+            assert s is not None, stext
+    assert re.fullmatch(r"\d{3}-\d{4}",
+                        _TOK.decode([t for t in b[1] if t != _TOK.eos_id]))
+
+
+def test_pushdown_json_mode_keeps_host_synced_path(eng_factory):
+    """json_mode rides the pushdown JsonGrammar — no finite table — so it
+    must keep the host-synced path even with tables on, and still match
+    the tables-off engine exactly."""
+    reqs = [(_TOK.encode("j:", add_bos=False),
+             SamplingParams(max_new_tokens=30, temperature=0.7, seed=3,
+                            json_mode=True, stop_token=_TOK.eos_id))]
+    dev = eng_factory(grammar_table="auto")
+    host = eng_factory(grammar_table="off")
+    b, a = _run(dev, reqs), _run(host, reqs)
+    assert a == b
+    assert dev.metrics["spec_steps"] > 0       # pushdown went host-synced
+    assert dev._grammar_table(dev.grammar) is None
+
+
+def test_state_budget_fallback_is_exact(eng_factory):
+    """A grammar exceeding the budget falls back to the host-synced path
+    — same output, no crash — while small grammars in the same batch
+    still ride the table."""
+    small = eng_factory(grammar_table="auto", grammar_state_budget=3)
+    dev = eng_factory(grammar_table="auto")
+    a = _run(small, _constrained_reqs(0.8))
+    b = _run(dev, _constrained_reqs(0.8))
+    assert a == b
+    assert small.metrics["spec_steps"] > 0     # budget-exceeded rows
+    assert dev.metrics["spec_steps"] == 0
+
+
+def test_fused_grammar_rows_leave_plain_rows_alone(eng_factory):
+    """A tabled grammar row joining the fused window must not perturb a
+    plain greedy row's stream."""
+    solo = eng_factory(grammar_table="auto")
+    ref = solo.generate([[1, 2, 3]], SamplingParams(max_new_tokens=12))[0]
+    eng = eng_factory(grammar_table="auto")
+    got = _run(eng, _constrained_reqs(0.9))
+    assert got[2] == ref
+
+
+def test_preemption_mid_stream_is_exact(eng_factory):
+    """Preemption forces a decode-state rebuild (gstate recovered from
+    host bookkeeping via table.state_ids) and a re-prefill; the final
+    streams must still match the host-synced engine exactly."""
+    # A page pool small enough that three growing sequences with held
+    # pending windows preempt each other.
+    reqs = [
+        (_TOK.encode("a:", add_bos=False),
+         SamplingParams(max_new_tokens=80, temperature=0.9, seed=11,
+                        regex=r"[ab]{60,}c", stop_token=_TOK.eos_id)),
+        (_TOK.encode("b:", add_bos=False),
+         SamplingParams(max_new_tokens=80, temperature=0.9, seed=12,
+                        regex=r"[cd]{60,}e", stop_token=_TOK.eos_id)),
+        ([4, 5, 6], SamplingParams(max_new_tokens=60)),
+    ]
+    host = eng_factory(grammar_table="off", num_pages=24,
+                       enable_radix_cache=False)
+    dev = eng_factory(grammar_table="auto", num_pages=24,
+                      enable_radix_cache=False)
+    a = _run(host, list(reqs))
+    b = _run(dev, list(reqs))
+    assert a == b
+    assert dev.metrics["preemptions"] > 0      # the scenario actually hit
+    assert re.fullmatch(r"[ab]{60,}c?",
+                        _TOK.decode([t for t in b[0] if t != _TOK.eos_id]))
+
+
+def test_grammar_table_off_knob_and_validation():
+    with pytest.raises(ValueError, match="grammar_table"):
+        EngineConfig(model="tiny", grammar_table="maybe").validate()
+    with pytest.raises(ValueError, match="grammar_state_budget"):
+        EngineConfig(model="tiny", grammar_state_budget=1).validate()
+
+
+def test_device_table_upload_is_cached_per_combination(eng_factory):
+    eng = eng_factory(grammar_table="auto")
+    g1 = eng._grammar_for(SamplingParams(regex=r"\d+"))
+    g2 = eng._grammar_for(SamplingParams(regex=r"[a-f]+"))
+    n1, l1, off1 = eng._device_grammar_tables([g1, g2])
+    n2, l2, off2 = eng._device_grammar_tables([g2, g1])   # order-insensitive
+    assert n1 is n2 and l1 is l2 and off1 is off2
+    assert set(off1) == {id(g1), id(g2)}
+    # Blocks are pow-2-padded (shape reuse within a bucket, without a
+    # full budget-sized block per tiny grammar).
+    s1 = eng._grammar_dev_block(g1)[0].shape[0]
+    assert s1 & (s1 - 1) == 0 and n1.shape[0] >= s1
+    # A single-grammar batch reuses the grammar's own device block — no
+    # combination entry, no copy.
+    before = len(eng._gtable_dev)
+    ns, _, offs = eng._device_grammar_tables([g1])
+    assert ns is eng._grammar_dev_block(g1)[0]
+    assert offs == {id(g1): 0} and len(eng._gtable_dev) == before
+
+
+def test_shared_grammar_rows_share_one_table_block(eng_factory):
+    """Two rows with the SAME pattern share one compiled grammar (the
+    LRU) and therefore one table block — and decode exactly."""
+    eng = eng_factory(grammar_table="auto")
+    reqs = [
+        (_TOK.encode("p%d:" % i, add_bos=False),
+         SamplingParams(max_new_tokens=20, temperature=0.8, seed=20 + i,
+                        regex=r"[xy]{3,9}z", stop_token=_TOK.eos_id))
+        for i in range(3)
+    ]
+    host = eng_factory(grammar_table="off")
+    assert _run(eng, list(reqs)) == _run(host, list(reqs))
+    # One shared grammar → the rows rode its cached device block; no
+    # multi-grammar combination was ever materialized.
+    assert len(eng._gtable_dev) == 0
+    g = eng._grammar_for(SamplingParams(regex=r"[xy]{3,9}z"))
+    assert getattr(g, "_dev_block", None) is not None

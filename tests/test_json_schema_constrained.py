@@ -114,6 +114,7 @@ def test_schema_outputs_validate(eng):
         "id": {"type": "integer"},
         "state": {"enum": ["on", "off"]},
     }}
+    completed = 0
     for seed in range(3):
         rid = eng.add_request(
             tok.encode("emit:"),
@@ -124,11 +125,29 @@ def test_schema_outputs_validate(eng):
             for ev in eng.step():
                 if ev.request_id == rid:
                     out.append(ev.token)
-        text = tok.decode(out)
-        doc = json.loads(text)              # parses...
-        assert set(doc) == {"id", "state"}  # ...and validates
-        assert isinstance(doc["id"], int)
-        assert doc["state"] in ("on", "off")
+        assert out                              # something was produced
+        done = out[-1] == tok.eos_id
+        text = tok.decode([t for t in out if t != tok.eos_id])
+        if done:
+            completed += 1
+            doc = json.loads(text)              # parses...
+            assert set(doc) == {"id", "state"}  # ...and validates
+            assert isinstance(doc["id"], int)
+            assert doc["state"] in ("on", "off")
+        else:
+            # Budget-truncated (the schema admits unbounded integer
+            # digits): the emitted prefix must still be schema-legal,
+            # and the truncation must be the BUDGET's doing.
+            assert len(out) == 48, text
+            g = JsonSchemaGrammar(schema)
+            s = g.initial()
+            for b in text.encode():
+                s = g.advance(s, b)
+                assert s is not None, text
+    # EOS must actually be reachable: with these fixed seeds the engine
+    # is deterministic and most runs complete — zero completions would
+    # mean EOS never became legal (e.g. a broken is_complete/table row).
+    assert completed >= 1
 
 
 def test_schema_admission_and_cache(eng):
@@ -233,9 +252,21 @@ def test_json_schema_over_wire():
              "temperature": 0.8, "seed": 2, "json_schema": schema},
             timeout=180)
         assert "error" not in r, r
-        doc = json.loads(r["text"])
-        assert set(doc) == {"n", "tag"} and doc["tag"] in ("x", "y")
-        assert isinstance(doc["n"], int)
+        assert r["text"]                       # something was produced
+        g = JsonSchemaGrammar(schema)
+        s = g.initial()
+        for b in r["text"].encode():
+            s = g.advance(s, b)
+            assert s is not None, r["text"]     # schema-legal prefix
+        if g.is_complete(s):
+            doc = json.loads(r["text"])
+            assert set(doc) == {"n", "tag"} and doc["tag"] in ("x", "y")
+            assert isinstance(doc["n"], int)
+        else:
+            # Incomplete is acceptable ONLY as budget truncation (byte
+            # tokenizer: one token per byte, EOS filtered server-side) —
+            # an engine that stalls or never legalizes EOS fails here.
+            assert len(r["text"].encode()) == 40, r["text"]
         # A malformed schema is a clean per-request error, not a dead wire.
         r2, _, _ = request_once(
             srv.addr,

@@ -178,6 +178,28 @@ def test_pd_disagg_ships_latent_bundles():
     assert got[0] == expect
 
 
+def test_mla_decode_service_warm_bundle_shapes():
+    """DecodeService._warm_item must derive each bundle half from its OWN
+    pool: under MLA the v pool (shared RoPE key) has a different channel
+    dim than the k pool (latent) — deriving both from k_pages failed
+    every MLA decode replica's {"op": "warmup"} at the inject scatter."""
+    from rbg_tpu.engine.service import DecodeService
+    svc = DecodeService(EngineConfig(
+        model="tiny-mla", page_size=8, num_pages=64, max_batch=2,
+        max_seq_len=128, prefill_chunk=16, use_pallas="never",
+        decode_buckets=(1, 2)), params=PARAMS)
+    try:
+        b = svc._warm_item(16, 0, 0)
+        assert b.k_data.shape[4] == CFG.kv_lora_rank
+        assert b.v_data.shape[4] == CFG.qk_rope_head_dim
+        # And the bundle actually injects + decodes (the crash site).
+        toks = svc.submit_bundle(b, SamplingParams(max_new_tokens=2),
+                                 timeout=240)
+        assert len(toks) == 2
+    finally:
+        svc.stop()
+
+
 def test_mla_int8_latent_pool_numerics():
     """int8-quantized latent pool (round 5): half the already-compressed
     latent HBM; bounded deviation vs the fp32 pool and greedy agreement

@@ -1,0 +1,183 @@
+"""PD disaggregation × constrained decoding.
+
+Regression suite for the DecodeWorker.inject grammar handoff: a json_mode
+bundle used to crash the decode batch (req.grammar stayed None while
+req.gstate was set), and regex/json_schema bundles silently decoded
+UNCONSTRAINED. Now all three constraint kinds resolve the grammar at
+inject, fold the prefill-side first token into the state, and decode
+bit-identically to a unified engine — including through a real router
+over real prefill/decode server subprocesses."""
+
+import json
+import re
+import threading
+
+import jax
+import pytest
+
+from rbg_tpu.engine import Engine, EngineConfig, SamplingParams
+from rbg_tpu.engine.pd import PDPair
+from rbg_tpu.engine.tokenizer import ByteTokenizer
+
+_TOK = ByteTokenizer()
+
+SCHEMA = {"type": "object", "properties": {
+    "id": {"type": "integer"},
+    "state": {"enum": ["on", "off"]},
+}}
+
+
+def ecfg(**kw):
+    base = dict(model="tiny", vocab_size=512, page_size=8, num_pages=128,
+                max_batch=4, max_seq_len=256, prefill_chunk=16,
+                use_pallas="never")
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _wired_pair(**kw):
+    pair = PDPair(ecfg(**kw))
+    pair.prefill.engine.enable_json_grammar(_TOK)
+    pair.decode.engine.enable_json_grammar(_TOK)
+    return pair
+
+
+CONSTRAINTS = [
+    ("json_mode", dict(json_mode=True)),
+    ("regex", dict(regex=r"\d{3}-\d{4}")),
+    ("json_schema", dict(json_schema=SCHEMA)),
+]
+
+
+@pytest.mark.parametrize("kind,fields", CONSTRAINTS)
+def test_pd_constrained_matches_unified(kind, fields):
+    """Each constraint kind round-trips PD token-identically to a unified
+    engine — the inject fix folds the first token into the grammar state
+    for ALL kinds, not just json_mode."""
+    sp = SamplingParams(max_new_tokens=40, temperature=0.8, seed=5,
+                        stop_token=_TOK.eos_id, **fields)
+    prompt = _TOK.encode(kind + ":", add_bos=False)
+    pair = _wired_pair()
+    uni = Engine(ecfg(enable_radix_cache=False),
+                 params=pair.prefill.engine.params)
+    uni.enable_json_grammar(_TOK)
+    expect = uni.generate([prompt], sp)[0]
+    got = pair.generate([prompt], sp)[0]
+    assert got == expect
+    # The decode side really carries the grammar (constraint enforced,
+    # not vacuously equal).
+    text = _TOK.decode([t for t in got if t != _TOK.eos_id])
+    if kind == "regex":
+        assert re.fullmatch(r"\d{3}-\d{4}", text), text
+    elif kind == "json_schema":
+        doc = json.loads(text)
+        assert set(doc) == {"id", "state"}
+
+
+def test_pd_inject_sets_grammar_state():
+    pair = _wired_pair()
+    sp = SamplingParams(max_new_tokens=20, temperature=0.7, seed=2,
+                        regex=r"[ab]{2,20}c", stop_token=_TOK.eos_id)
+    bundle = pair.prefill.prefill(_TOK.encode("x:", add_bos=False), sp)
+    rid = pair.decode.inject(bundle, sp)
+    req = pair.decode.engine.requests[rid]
+    assert req.grammar is not None and req.gstate is not None
+    # gstate already reflects the prefill-side first token.
+    g = req.grammar
+    assert req.gstate == g.advance_token(g.initial(), bundle.first_token)
+
+
+def test_pd_inject_rejects_constraint_violating_first_token():
+    """A first token the grammar forbids means the prefill peer ignored
+    the constraint (mixed-version deploy): reject the bundle, leak no
+    pages."""
+    pair = _wired_pair()
+    sp = SamplingParams(max_new_tokens=8, regex=r"\d+",
+                        stop_token=_TOK.eos_id)
+    bundle = pair.prefill.prefill(_TOK.encode("n:", add_bos=False), sp)
+    bundle.first_token = ord("x")          # not a digit
+    free_before = pair.decode.engine.allocator.free_pages
+    with pytest.raises(ValueError, match="violates"):
+        pair.decode.inject(bundle, sp)
+    assert pair.decode.engine.allocator.free_pages == free_before
+
+
+def test_pd_constrained_decode_uses_fused_tables():
+    """On the decode side, a tabled grammar bundle decodes through the
+    fused window (no host-synced steps) — the PD handoff composes with
+    device-resident grammar decode."""
+    pair = _wired_pair(multi_step=4)
+    sp = SamplingParams(max_new_tokens=30, temperature=0.8, seed=9,
+                        regex=r"[mn]{4,24}o", stop_token=_TOK.eos_id)
+    out = pair.generate([_TOK.encode("t:", add_bos=False)], sp)[0]
+    assert re.fullmatch(r"[mn]{4,24}o?",
+                        _TOK.decode([t for t in out if t != _TOK.eos_id]))
+    assert pair.decode.engine.metrics["spec_steps"] == 0
+
+
+@pytest.mark.e2e
+def test_pd_constrained_through_router():
+    """guided json_mode / regex / json_schema through a REAL router over
+    real prefill+decode server subprocesses: the router forwards the
+    constraint on both legs, the decode replica enforces it, and the
+    response satisfies it."""
+    from conftest import SpawnedEngineServer
+    from rbg_tpu.engine.protocol import request_once
+    from rbg_tpu.engine.router import (Handler, Registry, RouterServer,
+                                       RouterState)
+
+    args = ["--model", "tiny", "--vocab-size", "512", "--page-size", "8",
+            "--num-pages", "128", "--max-seq-len", "256",
+            "--prefill-chunk", "16", "--use-pallas", "never"]
+    with SpawnedEngineServer("--mode", "prefill", *args) as pf, \
+            SpawnedEngineServer("--mode", "decode", *args) as dc:
+        router = RouterServer(("127.0.0.1", 0), Handler)
+        router.state = RouterState(Registry(None), None,
+                                   {"prefill": [pf.addr],
+                                    "decode": [dc.addr]})
+        threading.Thread(target=router.serve_forever, daemon=True).start()
+        addr = f"127.0.0.1:{router.server_address[1]}"
+        try:
+            prompt = _TOK.encode("emit:", add_bos=False)
+            base = {"op": "generate", "prompt": prompt,
+                    "max_new_tokens": 40, "temperature": 0.8,
+                    "stop_token": _TOK.eos_id}
+
+            r, _, _ = request_once(addr, {**base, "seed": 3,
+                                          "json_mode": True}, timeout=300)
+            assert "error" not in r, r
+            text = _TOK.decode(r["tokens"])
+            st = JsonPrefixOK(text)
+            assert st, text
+
+            r, _, _ = request_once(addr, {**base, "seed": 4,
+                                          "regex": r"\d{3}-\d{4}"},
+                                   timeout=300)
+            assert "error" not in r, r
+            text = _TOK.decode([t for t in r["tokens"]
+                                if t != _TOK.eos_id])
+            assert re.fullmatch(r"\d{3}-\d{4}", text), text
+
+            r, _, _ = request_once(addr, {**base, "seed": 5,
+                                          "json_schema": SCHEMA},
+                                   timeout=300)
+            assert "error" not in r, r
+            doc = json.loads(_TOK.decode([t for t in r["tokens"]
+                                          if t != _TOK.eos_id]))
+            assert set(doc) == {"id", "state"}
+            assert router.state.metrics["pd_requests"] == 3
+        finally:
+            router.shutdown()
+            router.server_close()
+
+
+def JsonPrefixOK(text: str) -> bool:
+    """Valid JSON, or a legal truncated prefix of one (budget cut)."""
+    from rbg_tpu.engine.grammar import JsonGrammar
+    g = JsonGrammar()
+    s = g.initial()
+    for b in text.encode():
+        s = g.advance(s, b)
+        if s is None:
+            return False
+    return True

@@ -678,11 +678,18 @@ class JsonSchemaGrammar(NfaGrammar):
         return ("cat", parts)
 
     def _array_ast(self, schema: dict, depth: int):
-        # Missing "items" defaults to string members (our subset has no
-        # "any value" item grammar); an EXPLICIT null/bool items is a
-        # malformed schema and raises in _value_ast.
-        item = self._value_ast(schema["items"] if "items" in schema
-                               else {"type": "string"}, depth + 1)
+        # Missing "items" means "any value members" in JSON Schema — a
+        # shape this subset cannot emit. Defaulting to array-of-strings
+        # here would CONSTRAIN output to something the client's schema
+        # never asked for (the silent-divergence failure this compiler
+        # exists to refuse): raise at admission like every other
+        # unsupported shape. An explicit null/bool items raises in
+        # _value_ast.
+        if "items" not in schema:
+            raise ValueError(
+                "json_schema: array without 'items' (any-value members) "
+                "is unsupported — declare an item schema")
+        item = self._value_ast(schema["items"], depth + 1)
         lo = int(schema.get("minItems", 0))
         hi = schema.get("maxItems")
         hi = int(hi) if hi is not None else None

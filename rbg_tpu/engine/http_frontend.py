@@ -31,8 +31,18 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 from rbg_tpu.engine.config import SamplingParams
-from rbg_tpu.engine.protocol import recv_msg, request_once, send_msg
+from rbg_tpu.engine.protocol import (CODE_DEADLINE, CODE_DRAINING,
+                                     CODE_OVERLOADED, recv_msg, request_once,
+                                     send_msg)
 from rbg_tpu.engine.tokenizer import IncrementalDetokenizer, load_tokenizer
+
+# Structured backend rejections → HTTP. 429 tells well-behaved clients to
+# back off (Retry-After carries the backend's hint); 503 marks a draining
+# pod a load balancer should rotate out; 504 is a spent client deadline.
+_CODE_STATUS = {CODE_OVERLOADED: 429, CODE_DRAINING: 503, CODE_DEADLINE: 504}
+_CODE_ETYPE = {CODE_OVERLOADED: "overloaded", CODE_DRAINING: "unavailable",
+               CODE_DEADLINE: "timeout"}
+MAX_TIMEOUT_S = 600.0
 
 
 def _chat_to_prompt(messages: List[dict]) -> str:
@@ -70,16 +80,38 @@ class Handler(BaseHTTPRequestHandler):
 
     # ---- plumbing ----
 
-    def _json(self, code: int, body: dict):
+    def _json(self, code: int, body: dict, extra_headers=None):
         data = json.dumps(body).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
-    def _error(self, code: int, message: str, etype: str = "invalid_request_error"):
-        self._json(code, {"error": {"message": message, "type": etype}})
+    def _error(self, code: int, message: str, etype: str = "invalid_request_error",
+               retry_after_s=None):
+        headers = None
+        if retry_after_s is not None:
+            # HTTP Retry-After is integer seconds; round UP so a 0.3 s
+            # hint never becomes "retry immediately".
+            headers = {"Retry-After":
+                       str(max(1, int(-(-float(retry_after_s) // 1))))}
+        self._json(code, {"error": {"message": message, "type": etype}},
+                   extra_headers=headers)
+
+    def _backend_error(self, resp: dict):
+        """Map a backend error reply: structured rejection codes get their
+        HTTP status + Retry-After; anything else stays a 502."""
+        resp = resp or {}
+        status = _CODE_STATUS.get(resp.get("code"))
+        if status is not None:
+            return self._error(status, resp.get("error", "rejected"),
+                               _CODE_ETYPE[resp["code"]],
+                               retry_after_s=resp.get("retry_after_s"))
+        return self._error(502, resp.get("error", "no response"),
+                           "server_error")
 
     def _body(self) -> dict:
         n = int(self.headers.get("Content-Length", 0))
@@ -91,14 +123,18 @@ class Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         st: _State = self.server.state
         if self.path == "/healthz":
-            ok = True
+            ok, draining = True, False
             try:
                 h, _, _ = request_once(st.backend, {"op": "health"}, timeout=5)
                 ok = bool(h and (h.get("ok") or "pd" in h))
+                draining = bool(h and h.get("draining"))
             except OSError:
                 ok = False
-            return self._json(200 if ok else 503,
-                              {"ok": ok, "backend": st.backend})
+            # A draining backend is alive but should be rotated out: 503
+            # flips readiness while in-flight streams keep finishing.
+            return self._json(200 if ok and not draining else 503,
+                              {"ok": ok, "draining": draining,
+                               "backend": st.backend})
         if self.path == "/v1/models":
             return self._json(200, {"object": "list", "data": [
                 {"id": st.model, "object": "model", "owned_by": "rbg-tpu"}]})
@@ -156,8 +192,7 @@ class Handler(BaseHTTPRequestHandler):
         except OSError as e:
             return self._error(502, f"backend: {e}", "server_error")
         if resp is None or "error" in (resp or {}):
-            return self._error(502, (resp or {}).get("error", "no response"),
-                               "server_error")
+            return self._backend_error(resp)
         total = sum(len(p) for p in prompts)
         data = [{"object": "embedding", "index": i, "embedding": v}
                 for i, v in enumerate(resp["embeddings"])]
@@ -283,6 +318,16 @@ class Handler(BaseHTTPRequestHandler):
             }
             if tok.eos_id is not None:
                 req["stop_token"] = tok.eos_id
+            # End-to-end deadline (extension field): rides the wire as
+            # timeout_s; the router stamps the absolute deadline from it
+            # and every hop downstream spends from that one budget.
+            t = body.get("timeout_s", body.get("timeout"))
+            if t is not None:
+                t = float(t)
+                if not 0 < t <= MAX_TIMEOUT_S:
+                    raise ValueError(
+                        f"timeout_s must be in (0, {MAX_TIMEOUT_S:g}]")
+                req["timeout_s"] = t
             SamplingParams.from_wire(req)
             stops = self._parse_stops(body)
         except (ValueError, TypeError) as e:
@@ -293,13 +338,16 @@ class Handler(BaseHTTPRequestHandler):
         if body.get("stream"):
             return self._stream(st, req, rid, created, chat, stops)
         try:
+            # Transport timeout shadows the end-to-end budget (+5 s grace
+            # for the backend's own structured deadline reply to arrive).
             resp, _, _ = request_once(st.backend, st.backend_req(req),
-                                      timeout=300)
+                                      timeout=(req["timeout_s"] + 5
+                                               if "timeout_s" in req
+                                               else 300))
         except OSError as e:
             return self._error(502, f"backend: {e}", "server_error")
         if resp is None or "error" in (resp or {}):
-            return self._error(502, (resp or {}).get("error", "no response"),
-                               "server_error")
+            return self._backend_error(resp)
         tokens = resp.get("tokens", [])
         lps = resp.get("logprobs", [])
         text = tok.decode(tokens)
@@ -366,6 +414,23 @@ class Handler(BaseHTTPRequestHandler):
             conn = socket.create_connection((host, int(port)), timeout=300)
         except OSError as e:
             return self._error(502, f"backend: {e}", "server_error")
+        # First frame BEFORE committing to SSE: an admission-time rejection
+        # (overloaded / draining / spent deadline) must surface as a real
+        # HTTP status + Retry-After — retry middleware and load balancers
+        # can't see codes buried inside a 200 event stream.
+        try:
+            send_msg(conn, st.backend_req(req))
+            first_frame, _, _ = recv_msg(conn)
+        except OSError as e:
+            conn.close()
+            return self._error(502, f"backend: {e}", "server_error")
+        if first_frame is None:
+            conn.close()
+            return self._error(502, "backend closed before streaming",
+                               "server_error")
+        if "error" in first_frame:
+            conn.close()
+            return self._backend_error(first_frame)
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -413,9 +478,11 @@ class Handler(BaseHTTPRequestHandler):
 
         try:
             with conn:
-                send_msg(conn, st.backend_req(req))
                 while True:
-                    frame, _, _ = recv_msg(conn)
+                    if first_frame is not None:
+                        frame, first_frame = first_frame, None
+                    else:
+                        frame, _, _ = recv_msg(conn)
                     if frame is None:
                         break
                     if "error" in frame:

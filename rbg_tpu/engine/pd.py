@@ -78,8 +78,15 @@ class PrefillWorker:
         return time.perf_counter() - t0
 
     def prefill(self, prompt: List[int],
-                sampling: Optional[SamplingParams] = None) -> KVBundle:
-        """Run one prompt to its first token; export KV pages."""
+                sampling: Optional[SamplingParams] = None,
+                deadline: Optional[float] = None) -> KVBundle:
+        """Run one prompt to its first token; export KV pages.
+
+        ``deadline`` (absolute ``time.monotonic()``) aborts a long chunked
+        prefill between chunks once the client's budget is spent — the
+        pages recycle immediately instead of finishing a bundle nobody is
+        waiting for. Raises the service-layer ``DeadlineExceeded`` so the
+        server maps it to the structured wire code."""
         sampling = sampling or SamplingParams()
         one = dataclasses.replace(sampling, max_new_tokens=1)
         ps = self.engine.cfg.page_size
@@ -112,6 +119,13 @@ class PrefillWorker:
             rid = self.engine.add_request(prompt, one)
         first = None
         while first is None:
+            if deadline is not None and time.monotonic() >= deadline:
+                from rbg_tpu.engine.protocol import DeadlineExceeded
+                self.engine.cancel_request(rid)
+                self.metrics["deadline_aborts"] = (
+                    self.metrics.get("deadline_aborts", 0) + 1)
+                raise DeadlineExceeded(
+                    "deadline spent mid-prefill (aborted, pages recycled)")
             for ev in self.engine.step():
                 if ev.request_id == rid:
                     first = ev.token

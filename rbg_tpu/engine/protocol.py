@@ -20,6 +20,44 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+# Structured rejection codes riding the wire alongside "error". The edge
+# maps them to HTTP (429 / 503 / 504), the router routes around the
+# retryable ones (a shed or draining backend is HEALTHY — never evicted),
+# and every layer increments its own counter. Plain-string "error" replies
+# without a code stay what they always were: application errors.
+CODE_OVERLOADED = "overloaded"          # admission control shed the request
+CODE_DEADLINE = "deadline_exceeded"     # client budget spent (queue or run)
+CODE_DRAINING = "draining"              # backend is in SIGTERM drain
+RETRYABLE_REJECT_CODES = (CODE_OVERLOADED, CODE_DRAINING)
+
+
+class Rejected(RuntimeError):
+    """Structured service rejection. ``code`` rides the wire so the edge
+    can map it (429 / 503 / 504) and the router can route around it;
+    ``retry_after_s`` is the backpressure hint for shed replies. Lives
+    HERE (not in service.py) so the server process can import it without
+    pulling jax before the port binds."""
+
+    code = "rejected"
+
+    def __init__(self, msg: str, retry_after_s=None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+    def to_wire(self) -> dict:
+        frame = {"error": str(self), "code": self.code}
+        if self.retry_after_s is not None:
+            frame["retry_after_s"] = round(self.retry_after_s, 3)
+        return frame
+
+
+class Overloaded(Rejected):
+    code = CODE_OVERLOADED
+
+
+class DeadlineExceeded(Rejected):
+    code = CODE_DEADLINE
+
 
 def send_msg(sock: socket.socket, obj: dict,
              k_bytes: Optional[bytes] = None,

@@ -43,13 +43,68 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
-from rbg_tpu.engine.protocol import recv_msg, request_once, send_msg
+from rbg_tpu.engine.protocol import (CODE_DEADLINE, CODE_DRAINING,
+                                     RETRYABLE_REJECT_CODES, recv_msg,
+                                     request_once, send_msg)
 
 MAX_ATTEMPTS = 3          # distinct backends tried per leg
 CONNECT_TIMEOUT_S = 5.0   # fast failure detection on the connect
 STREAM_TIMEOUT_S = 300.0  # per-recv budget once streaming
+LEG_TIMEOUT_S = 120.0     # per-attempt blocking-call cap (deadline trims it)
+DEFAULT_TIMEOUT_S = 120.0 # whole-request budget when the client sends none
 AFFINITY_PREFIX = 32      # prompt tokens hashed for cache affinity
 AFFINITY_SLACK = 4        # max extra outstanding before affinity yields
+
+
+class _Rejected(Exception):
+    """A structured upstream rejection (overloaded / draining / deadline)
+    that must reach the client VERBATIM — wrapping it in a generic error
+    string would strip the code and retry_after_s the edge maps to
+    429/503/504 + Retry-After."""
+
+    def __init__(self, frame: dict):
+        super().__init__(frame.get("error", "rejected"))
+        self.frame = dict(frame)
+
+
+def _deadline_frame(msg: str) -> dict:
+    return {"error": msg, "code": CODE_DEADLINE}
+
+
+class RetryBudget:
+    """Token bucket capping cross-backend retries router-wide. Under a shed
+    storm every request retrying on every sibling MULTIPLIES load exactly
+    when the fleet can least afford it — once the bucket is empty, failures
+    surface immediately instead of amplifying. First attempts are never
+    charged; rate=0 disables retries outright; rate=None disables the
+    budget (unbounded legacy behavior)."""
+
+    def __init__(self, rate: Optional[float] = 8.0, burst: float = 32.0):
+        self.rate = rate
+        # rate=0 means retries DISABLED — the bucket must start empty too,
+        # or the initial burst would still allow `burst` retries.
+        self.burst = 0.0 if rate == 0 else float(burst)
+        self._tokens = self.burst
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self) -> bool:
+        if self.rate is None:
+            return True
+        now = time.monotonic()
+        with self._lock:
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"rate_per_s": self.rate, "burst": self.burst,
+                    "tokens": round(self._tokens, 2)}
 
 
 class Registry:
@@ -90,13 +145,15 @@ class Registry:
 
 
 class _BackendState:
-    __slots__ = ("outstanding", "fails", "down_until", "last_pick")
+    __slots__ = ("outstanding", "fails", "down_until", "last_pick",
+                 "draining")
 
     def __init__(self):
         self.outstanding = 0
         self.fails = 0
         self.down_until = 0.0
         self.last_pick = 0
+        self.draining = False
 
 
 class BackendPool:
@@ -125,19 +182,26 @@ class BackendPool:
 
     def order(self, addrs: List[str]) -> List[str]:
         """Candidates in try-order: healthy by (outstanding, last_pick),
-        then evicted by soonest recovery."""
+        then DRAINING by the same key (not-a-candidate while any healthy
+        sibling exists, but still reachable so a fleet-wide rollout
+        degrades to 'draining' replies rather than a hard outage), then
+        evicted by soonest recovery."""
         now = time.monotonic()
         with self._lock:
-            healthy, down = [], []
+            healthy, draining, down = [], [], []
             for i, a in enumerate(addrs):
                 st = self._state(a)
                 if st.down_until > now:
                     down.append((st.down_until, i, a))
+                elif st.draining:
+                    draining.append((st.outstanding, st.last_pick, i, a))
                 else:
                     healthy.append((st.outstanding, st.last_pick, i, a))
             healthy.sort()
+            draining.sort()
             down.sort()
-            return [t[-1] for t in healthy] + [t[-1] for t in down]
+            return ([t[-1] for t in healthy] + [t[-1] for t in draining]
+                    + [t[-1] for t in down])
 
     def acquire(self, addr: str) -> None:
         # last_pick is charged HERE — to the address actually served —
@@ -169,6 +233,18 @@ class BackendPool:
                           self.EVICT_MAX_S)
             st.down_until = time.monotonic() + backoff
 
+    def set_draining(self, addr: str, draining: bool) -> None:
+        """Mark an address as draining (SIGTERM rollout): it stops being a
+        candidate while siblings live but is NOT evicted — its in-flight
+        streams finish, and probes clear the flag if the pod un-drains
+        (or the address never returns and ordinary eviction takes over)."""
+        with self._lock:
+            self._state(addr).draining = draining
+
+    def draining(self) -> List[str]:
+        with self._lock:
+            return [a for a, st in self._st.items() if st.draining]
+
     def evicted(self) -> List[str]:
         now = time.monotonic()
         with self._lock:
@@ -183,8 +259,10 @@ class BackendPool:
             return self._state(addr).down_until > time.monotonic()
 
     def probe(self, timeout: float = 1.0) -> List[str]:
-        """Health-check every evicted backend; re-admit responders.
-        Returns the re-admitted addresses."""
+        """Health-check every evicted backend (re-admit responders) and
+        every draining backend (clear the flag if it un-drained; a drained
+        process that already exited fails its next dispatch and moves to
+        ordinary eviction). Returns the re-admitted addresses."""
         readmitted = []
         for addr in self.evicted():
             try:
@@ -194,7 +272,16 @@ class BackendPool:
                 continue
             if resp and resp.get("ok"):
                 self.ok(addr)
+                self.set_draining(addr, bool(resp.get("draining")))
                 readmitted.append(addr)
+        for addr in self.draining():
+            try:
+                resp, _, _ = request_once(addr, {"op": "health"},
+                                          timeout=timeout)
+            except (OSError, ConnectionError, json.JSONDecodeError):
+                continue
+            if resp and resp.get("ok") and not resp.get("draining"):
+                self.set_draining(addr, False)
         return readmitted
 
     def retain(self, live) -> None:
@@ -211,7 +298,8 @@ class BackendPool:
         now = time.monotonic()
         with self._lock:
             return {a: {"outstanding": st.outstanding, "fails": st.fails,
-                        "down_for_s": round(max(0.0, st.down_until - now), 3)}
+                        "down_for_s": round(max(0.0, st.down_until - now), 3),
+                        "draining": st.draining}
                     for a, st in self._st.items()}
 
 
@@ -257,7 +345,8 @@ class PrefixAffinity:
 class RouterState:
     def __init__(self, registry: Registry, group: Optional[str],
                  static_backends: Optional[dict] = None,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 retry_budget: Optional[RetryBudget] = None):
         self.registry = registry
         self.group = group
         self.static = static_backends or {}
@@ -268,9 +357,40 @@ class RouterState:
         self.token = token if token is not None \
             else (os.environ.get("RBG_DATA_TOKEN") or None)
         self.affinity = PrefixAffinity()
+        self.retry_budget = retry_budget or RetryBudget()
         self.metrics = {"requests": 0, "pd_requests": 0, "errors": 0,
                         "retries": 0, "failovers": 0, "affinity_hits": 0,
-                        "kv_bytes_routed": 0}
+                        "kv_bytes_routed": 0,
+                        # Overload / lifecycle robustness counters.
+                        "sheds_routed_around": 0, "sheds_returned": 0,
+                        "draining_routed_around": 0,
+                        "deadline_refusals": 0,
+                        "retry_budget_exhausted": 0}
+
+    def charge_retry(self) -> bool:
+        """Take one retry token; on exhaustion count it and refuse."""
+        if self.retry_budget.take():
+            return True
+        self.metrics["retry_budget_exhausted"] += 1
+        return False
+
+    def note_shed(self, addr: str, frame: dict,
+                  best: Optional[dict]) -> dict:
+        """Record a structured route-around shed (overloaded / draining)
+        from a HEALTHY backend — the one shed policy both the blocking and
+        streaming paths apply: no eviction, draining marks the pool, and
+        the frame with the smallest retry_after_s becomes the reply should
+        every candidate shed."""
+        self.pool.ok(addr)
+        if frame.get("code") == CODE_DRAINING:
+            self.pool.set_draining(addr, True)
+            self.metrics["draining_routed_around"] += 1
+        else:
+            self.metrics["sheds_routed_around"] += 1
+        if best is None or (frame.get("retry_after_s") or 1e9) < \
+                (best.get("retry_after_s") or 1e9):
+            return frame
+        return best
 
     def authorized(self, obj: dict) -> bool:
         if not self.token:
@@ -328,18 +448,46 @@ class RouterState:
         return cands
 
     def call(self, role: str, obj: dict, k_bytes=None, v_bytes=None,
-             timeout: float = 120.0, prompt=None) -> Tuple[str, dict, bytes, bytes]:
+             timeout: float = LEG_TIMEOUT_S, prompt=None,
+             deadline: Optional[float] = None) -> Tuple[str, dict, bytes, bytes]:
         """One blocking request with failover across the role's backends.
         Transport failures (connect refused, peer closed) evict + retry on
         a sibling; application errors pass through untouched. ``prompt``
-        (when given) engages cache-affinity candidate ordering."""
+        (when given) engages cache-affinity candidate ordering.
+
+        ``deadline`` (absolute monotonic) is the REQUEST's end-to-end
+        budget: every attempt — first dispatch or failover — derives its
+        transport timeout from what remains instead of the fixed leg cap,
+        the remaining budget is forwarded to the backend as ``timeout_s``
+        (so ITS queue/abort enforcement composes), and a spent budget
+        refuses the dispatch outright (``_Rejected`` with
+        deadline_exceeded) — never a doomed retry.
+
+        Structured sheds (code overloaded/draining) are NOT backend
+        failures: the backend is healthy and answered. The router tries a
+        sibling (retry-budget permitting) and, when every candidate shed,
+        raises ``_Rejected`` carrying the frame with the smallest
+        retry_after_s — the edge maps it to 429/503 + Retry-After."""
         cands = self.candidates_for(role, prompt)
         if not cands:
             raise RuntimeError(f"no {role} backends available")
         akey = PrefixAffinity.key(prompt)
         last: Optional[Exception] = None
+        shed: Optional[dict] = None
         for i, addr in enumerate(cands[:MAX_ATTEMPTS]):
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.metrics["deadline_refusals"] += 1
+                    raise _Rejected(_deadline_frame(
+                        f"deadline spent before dispatch to {role} "
+                        f"(attempt {i + 1})"))
+                timeout = min(LEG_TIMEOUT_S, remaining)
+                obj = dict(obj)
+                obj["timeout_s"] = round(remaining, 3)
             if i:
+                if not self.charge_retry():
+                    break
                 self.metrics["retries"] += 1
             self.pool.acquire(addr)
             try:
@@ -355,11 +503,24 @@ class RouterState:
                 self.pool.fail(addr)
                 last = RuntimeError(f"{addr} closed connection")
                 continue
+            code = resp.get("code")
+            if code == CODE_DEADLINE:
+                # The backend spent the client's budget (queue drop or
+                # mid-run abort): structured passthrough — a sibling retry
+                # would dispatch work that is already out of time.
+                self.pool.ok(addr)
+                raise _Rejected(resp)
+            if code in RETRYABLE_REJECT_CODES:
+                shed = self.note_shed(addr, resp, shed)
+                continue
             self.pool.ok(addr)
             self.affinity.put(akey, addr)
             if i:
                 self.metrics["failovers"] += 1
             return addr, resp, rk, rv
+        if shed is not None:
+            self.metrics["sheds_returned"] += 1
+            raise _Rejected(shed)
         raise RuntimeError(
             f"all {role} backends failed (tried {min(len(cands), MAX_ATTEMPTS)}): {last}")
 
@@ -399,15 +560,26 @@ class Handler(socketserver.BaseRequestHandler):
                 if state.authorized(obj):
                     resp["metrics"] = state.metrics
                     resp["backends"] = state.pool.snapshot()
+                    resp["draining_backends"] = state.pool.draining()
+                    resp["retry_budget"] = state.retry_budget.snapshot()
                 self._send_client(resp)
                 continue
             if op in ("embed", "generate") and not state.authorized(obj):
                 self._send_client({"error": "unauthorized", "done": True})
                 continue
+            try:
+                deadline = self._stamp_deadline(obj)
+            except (TypeError, ValueError) as e:
+                self._send_client({"error": f"bad timeout_s: {e}",
+                                   "done": True})
+                continue
             if op == "embed":
                 state.metrics["requests"] += 1
                 try:
-                    _, resp, _, _ = state.call(state.worker_role(), obj)
+                    _, resp, _, _ = state.call(state.worker_role(), obj,
+                                               deadline=deadline)
+                except _Rejected as e:
+                    resp = e.frame
                 except Exception as e:
                     state.metrics["errors"] += 1
                     resp = {"error": f"embed: {e}"}
@@ -418,15 +590,31 @@ class Handler(socketserver.BaseRequestHandler):
                 continue
             try:
                 if obj.get("stream"):
-                    self._generate_stream(state, obj)
+                    self._generate_stream(state, obj, deadline)
                 else:
-                    resp = self._generate(state, obj)
+                    resp = self._generate(state, obj, deadline)
                     self._send_client(resp)
             except _ClientGone:
                 raise
+            except _Rejected as e:
+                # Structured shed/deadline: NOT a router error — the
+                # contract under overload is exactly this reply.
+                self._send_client({**e.frame, "done": True})
             except Exception as e:
                 state.metrics["errors"] += 1
                 self._send_client({"error": str(e), "done": True})
+
+    @staticmethod
+    def _stamp_deadline(obj: dict) -> float:
+        """Absolute monotonic deadline for this request: the client's
+        ``timeout_s`` budget (or the router default), stamped ONCE at
+        ingress — every hop, failover attempt, and backend admission below
+        derives its remaining budget from this single number."""
+        t = obj.get("timeout_s")
+        t = DEFAULT_TIMEOUT_S if t is None else float(t)
+        if t <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {t}")
+        return time.monotonic() + t
 
     @staticmethod
     def _pin_seed(obj: dict) -> dict:
@@ -440,7 +628,7 @@ class Handler(socketserver.BaseRequestHandler):
             obj["seed"] = random.getrandbits(31)
         return obj
 
-    def _route(self, state: RouterState, obj: dict):
+    def _route(self, state: RouterState, obj: dict, deadline: float):
         """Resolve the final leg shared by blocking and streaming paths.
         PD mode runs the (always blocking, failover-wrapped) prefill hop
         here; returns (role, (header, k_bytes, v_bytes), affinity_prompt)
@@ -463,8 +651,11 @@ class Handler(socketserver.BaseRequestHandler):
                     pf_req[key] = obj[key]
             # Cache affinity on the prefill leg: the replica that served
             # this prefix before has it in its radix cache / pool hot set.
+            # The prefill leg spends from the SAME deadline the decode leg
+            # inherits — a slow prefill shrinks the decode budget.
             _, hdr, kb, vb = state.call("prefill", pf_req,
-                                        prompt=obj.get("prompt"))
+                                        prompt=obj.get("prompt"),
+                                        deadline=deadline)
             if "error" in hdr:
                 raise RuntimeError(f"prefill failed: {hdr}")
             state.metrics["kv_bytes_routed"] += len(kb or b"") + len(vb or b"")
@@ -481,18 +672,21 @@ class Handler(socketserver.BaseRequestHandler):
             return "decode", (fwd, kb, vb), None
         return state.worker_role(), (obj, None, None), obj.get("prompt")
 
-    def _generate(self, state: RouterState, obj: dict) -> dict:
+    def _generate(self, state: RouterState, obj: dict,
+                  deadline: float) -> dict:
         t0 = time.perf_counter()
         pd = state.pd_mode()
-        role, payload, aff = self._route(state, obj)
-        _, resp, _, _ = state.call(role, *payload, prompt=aff)
+        role, payload, aff = self._route(state, obj, deadline)
+        _, resp, _, _ = state.call(role, *payload, prompt=aff,
+                                   deadline=deadline)
         if pd:
             if "error" in resp:
                 raise RuntimeError(f"decode failed: {resp}")
             resp["ttft_s"] = time.perf_counter() - t0
         return resp
 
-    def _generate_stream(self, state: RouterState, obj: dict) -> None:
+    def _generate_stream(self, state: RouterState, obj: dict,
+                         deadline: float) -> None:
         """Streaming generate with mid-stream failover: relay incremental
         token frames from the backend to the client (feeds the SSE front
         end). PD mode streams the decode leg; the prefill leg is one
@@ -502,12 +696,21 @@ class Handler(socketserver.BaseRequestHandler):
         sibling (the router still holds the KV bundle / the request), and
         the replayed stream — identical because the seed is pinned — is
         relayed with the already-delivered token prefix skipped. The
-        client never sees the failure."""
-        role, payload, aff = self._route(state, obj)
+        client never sees the failure. A backend that SHEDS the attempt
+        (overloaded / draining — always before any token) is routed
+        around without eviction; a spent deadline ends the request with a
+        structured frame instead of another doomed attempt."""
+        role, payload, aff = self._route(state, obj, deadline)
         akey = PrefixAffinity.key(aff)
         delivered = 0                  # tokens already relayed to the client
         last: Optional[Exception] = None
+        shed: Optional[dict] = None
         for attempt in range(MAX_ATTEMPTS):
+            if deadline - time.monotonic() <= 0:
+                state.metrics["deadline_refusals"] += 1
+                self._send_client({**_deadline_frame(
+                    "deadline spent mid-stream"), "done": True})
+                return
             # Affinity only steers the FIRST attempt: a failover must not
             # re-pin to the remembered (possibly just-dead) backend.
             cands = (state.candidates_for(role, aff) if attempt == 0
@@ -516,22 +719,37 @@ class Handler(socketserver.BaseRequestHandler):
                 break
             addr = cands[0]
             if attempt:
+                if not state.charge_retry():
+                    break
                 state.metrics["retries"] += 1
             state.pool.acquire(addr)
             try:
-                delivered, finished = self._relay_attempt(
-                    addr, payload, delivered)
+                delivered, status, frame = self._relay_attempt(
+                    addr, payload, delivered, deadline)
             finally:
                 state.pool.release(addr)
-            if finished:
+            if status == "done":
                 state.pool.ok(addr)
                 state.affinity.put(akey, addr)
                 if attempt:
                     state.metrics["failovers"] += 1
                 return
+            if status == "rejected":
+                # Healthy backend refused the attempt (shed before any
+                # token): no eviction; deadline ends the request.
+                if frame.get("code") == CODE_DEADLINE:
+                    state.pool.ok(addr)
+                    self._send_client({**frame, "done": True})
+                    return
+                shed = state.note_shed(addr, frame, shed)
+                continue
             # Backend closed mid-stream without a done frame.
             state.pool.fail(addr)
             last = RuntimeError(f"{addr} closed mid-stream")
+        if shed is not None:
+            state.metrics["sheds_returned"] += 1
+            self._send_client({**shed, "done": True})
+            return
         state.metrics["errors"] += 1
         self._send_client({
             "error": f"all {role} backends failed mid-stream: {last}",
@@ -543,32 +761,64 @@ class Handler(socketserver.BaseRequestHandler):
         except OSError as e:
             raise _ClientGone(str(e)) from e
 
-    def _relay_attempt(self, addr: str, payload, delivered: int):
+    def _relay_attempt(self, addr: str, payload, delivered: int,
+                       deadline: Optional[float] = None):
         """One streaming attempt against ``addr``. Relays frames to the
         client, skipping the first ``delivered`` tokens (already sent by a
         previous attempt — deterministic replay makes them identical).
-        Returns (new_delivered, finished) — BACKEND transport failures
-        (abrupt reset, mid-frame close, recv timeout) are absorbed here so
-        the tokens relayed before the failure are never lost from the
-        count (a raise would discard the local and make the retry replay
-        them as duplicates). Client-side send failures raise _ClientGone,
-        which aborts the request without charging the backend."""
+        Returns (new_delivered, status, frame): status "done" (stream
+        completed or application error passed through), "died" (transport
+        failure — the tokens relayed before it are never lost from the
+        count, so the retry skips them instead of duplicating), or
+        "rejected" (a structured shed frame, returned for the caller's
+        route-around logic instead of being surfaced). Client-side send
+        failures raise _ClientGone, which aborts the request without
+        charging the backend. ``deadline`` re-arms the per-recv timeout
+        from the remaining budget and forwards it to the backend."""
         host, port = addr.rsplit(":", 1)
         skip = delivered
         try:
-            with socket.create_connection((host, int(port)),
-                                          timeout=CONNECT_TIMEOUT_S) as s:
-                s.settimeout(STREAM_TIMEOUT_S)
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return delivered, "rejected", _deadline_frame(
+                        "deadline spent before stream dispatch")
+                payload = (dict(payload[0], timeout_s=round(remaining, 3)),
+                           payload[1], payload[2])
+            with socket.create_connection(
+                    (host, int(port)),
+                    timeout=min(CONNECT_TIMEOUT_S, remaining)
+                    if remaining is not None else CONNECT_TIMEOUT_S) as s:
+                # Widen to the stream budget BEFORE sending: the payload
+                # can carry a multi-MB KV bundle whose transmission must
+                # not be cut by the 5 s connect timeout (that would read
+                # as 'died' and evict a healthy backend).
+                s.settimeout(min(STREAM_TIMEOUT_S, remaining)
+                             if remaining is not None else STREAM_TIMEOUT_S)
                 send_msg(s, *payload)
                 while True:
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return delivered, "rejected", _deadline_frame(
+                                "deadline spent mid-stream")
+                        s.settimeout(min(STREAM_TIMEOUT_S, remaining))
+                    else:
+                        s.settimeout(STREAM_TIMEOUT_S)
                     frame, _, _ = recv_msg(s)
                     if frame is None:
-                        return delivered, False   # died mid-stream
+                        return delivered, "died", None
                     if "error" in frame:
+                        if frame.get("code") in RETRYABLE_REJECT_CODES \
+                                or frame.get("code") == CODE_DEADLINE:
+                            # Shed at admission (always before any token):
+                            # the caller routes around / ends the request.
+                            return delivered, "rejected", frame
                         # Application error — not a transport failure; the
                         # engine is healthy and answered. Pass through.
                         self._send_client(frame)
-                        return delivered, True
+                        return delivered, "done", None
                     tokens = frame.get("tokens") or []
                     drop = min(skip, len(tokens))
                     if drop:
@@ -582,12 +832,12 @@ class Handler(socketserver.BaseRequestHandler):
                         self._send_client(frame)
                         delivered += len(tokens)
                     if frame.get("done"):
-                        return delivered, True
+                        return delivered, "done", None
         except (OSError, ConnectionError, json.JSONDecodeError):
             # JSONDecodeError = garbage frame from a version-mismatched or
             # corrupt backend — same class as a transport failure (probe()
             # classifies it identically): fail over, don't surface it.
-            return delivered, False
+            return delivered, "died", None
 
 
 class RouterServer(socketserver.ThreadingTCPServer):
@@ -621,13 +871,23 @@ def main(argv=None) -> int:
                     help="require this bearer token on generate/embed and "
                          "forward it on every backend leg (default: "
                          "$RBG_DATA_TOKEN; empty = open wire)")
+    ap.add_argument("--retry-rate", type=float, default=8.0,
+                    help="router-wide retry budget: sustained failover "
+                         "retries per second (token bucket; shed storms "
+                         "must not amplify). 0 disables retries; "
+                         "negative = unbounded")
+    ap.add_argument("--retry-burst", type=float, default=32.0,
+                    help="retry budget burst size (bucket capacity)")
     args = ap.parse_args(argv)
     port = int(os.environ.get("RBG_SERVE_PORT")
                or os.environ.get("RBG_PORT_SERVE") or args.port)
     static = json.loads(args.backends) if args.backends else None
     server = RouterServer(("127.0.0.1", port), Handler)
+    budget = RetryBudget(rate=None if args.retry_rate < 0 else args.retry_rate,
+                         burst=args.retry_burst)
     server.state = RouterState(Registry(args.registry), args.group, static,
-                               token=args.auth_token or None)
+                               token=args.auth_token or None,
+                               retry_budget=budget)
     start_prober(server.state)
     print(f"router listening on 127.0.0.1:{port} group={args.group}", flush=True)
     server.serve_forever()

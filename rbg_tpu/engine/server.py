@@ -18,12 +18,30 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import socketserver
 import sys
 import threading
+import time
 
 from rbg_tpu.engine.config import EngineConfig, SamplingParams
-from rbg_tpu.engine.protocol import bundle_from_wire, bundle_to_wire, recv_msg, send_msg
+from rbg_tpu.engine.protocol import (CODE_DRAINING, DeadlineExceeded,
+                                     Rejected, bundle_from_wire,
+                                     bundle_to_wire, recv_msg, send_msg)
+from rbg_tpu.obs.metrics import REGISTRY
+
+
+def _deadline_of(obj: dict):
+    """Absolute monotonic deadline from a wire ``timeout_s`` (None = the
+    legacy unbounded contract). The router stamps the REMAINING client
+    budget here per hop, so engine-side enforcement composes with its."""
+    t = obj.get("timeout_s")
+    if t is None:
+        return None
+    t = float(t)
+    if t <= 0:
+        raise ValueError(f"timeout_s must be > 0, got {t}")
+    return time.monotonic() + t
 
 
 def build_config(args) -> EngineConfig:
@@ -65,12 +83,14 @@ class Handler(socketserver.BaseRequestHandler):
                     return
 
     def _stream_pending(self, service, pending, first_tokens=(),
-                        with_logprobs=False):
+                        with_logprobs=False, deadline=None):
         """Relay a pending generation as incremental token-batch messages:
         ``{"tokens": [...], "done": false}``* then a final ``done`` frame
         with ttft. The transport framing the SSE front end rides on. With
         logprobs, frames carry an aligned ``"logprobs"`` slice (emission
-        waits for both lists — the loop thread appends tokens first)."""
+        waits for both lists — the loop thread appends tokens first).
+        ``deadline`` (absolute monotonic) caps the relay; the service loop
+        aborts the generation itself at the same deadline."""
         import time as _time
 
         from rbg_tpu.engine.service import DEFAULT_TIMEOUT_S
@@ -84,12 +104,15 @@ class Handler(socketserver.BaseRequestHandler):
                     frame["logprobs"] = [None] * len(first_tokens)
                 send_msg(self.request, frame)
             sent = 0
-            deadline = _time.monotonic() + DEFAULT_TIMEOUT_S
+            if deadline is None:
+                deadline = _time.monotonic() + DEFAULT_TIMEOUT_S
             while True:
                 done = pending.done.is_set()
                 if done and pending.error:
-                    send_msg(self.request, {"error": pending.error,
-                                            "done": True})
+                    frame = {"error": pending.error, "done": True}
+                    if pending.code:
+                        frame["code"] = pending.code
+                    send_msg(self.request, frame)
                     return
                 tokens = list(pending.tokens)
                 if with_logprobs:
@@ -107,8 +130,10 @@ class Handler(socketserver.BaseRequestHandler):
                 if done and sent == len(pending.tokens):
                     break
                 if _time.monotonic() > deadline:
+                    from rbg_tpu.engine.protocol import CODE_DEADLINE
                     service.cancel(pending)  # recycle slot + pages
                     send_msg(self.request, {"error": "generation timed out",
+                                            "code": CODE_DEADLINE,
                                             "done": True})
                     return
                 _time.sleep(0.005)
@@ -121,12 +146,40 @@ class Handler(socketserver.BaseRequestHandler):
             service.cancel(pending)
             raise ConnectionError("client closed stream")
 
+    _DATA_OPS = frozenset({"generate", "generate_text", "embed",
+                           "prefill", "decode_bundle"})
+
     def _dispatch(self, srv, obj, k, v):
         op = obj.get("op")
         if op == "health":
             ready = srv.service is not None or srv.prefill is not None or srv.decode is not None
-            send_msg(self.request, {"ok": ready, "mode": srv.mode})
+            resp = {"ok": ready, "mode": srv.mode, "draining": srv.draining}
+            if srv.draining:
+                resp["draining_for_s"] = round(
+                    time.monotonic() - srv.drain_started, 3)
+            send_msg(self.request, resp)
             return
+        if srv.draining and op in self._DATA_OPS:
+            # Drain contract: in-flight work finishes, NEW work is refused
+            # with a structured code the router treats as
+            # route-around-without-evicting. "done" terminates stream
+            # clients that won't look past the first frame.
+            REGISTRY.inc("rbg_serving_drain_refusals_total")
+            send_msg(self.request, {
+                "error": "server is draining (SIGTERM received)",
+                "code": CODE_DRAINING, "done": True})
+            return
+        if op in self._DATA_OPS:
+            srv.note_inflight(+1)
+            try:
+                self._dispatch_data(srv, obj, k, v)
+            finally:
+                srv.note_inflight(-1)
+            return
+        self._dispatch_data(srv, obj, k, v)
+
+    def _dispatch_data(self, srv, obj, k, v):
+        op = obj.get("op")
         if srv.auth_token and op != "metrics":
             # Data-plane token gate (VERDICT r4 #6): prefill/decode_bundle
             # carry KV activations, generate carries prompts — none of it
@@ -165,8 +218,13 @@ class Handler(socketserver.BaseRequestHandler):
             elif srv.prefill is not None:
                 stats = {**srv.prefill.engine.metrics, **srv.prefill.metrics}
             elif srv.decode is not None:
-                stats = {**srv.decode.worker.engine.metrics,
-                         **srv.decode.worker.metrics}
+                eng = srv.decode.engine
+                stats = {**eng.metrics, **srv.decode.worker.metrics,
+                         **srv.decode.service_stats(),
+                         "running": len(eng.running),
+                         "waiting": len(eng.waiting),
+                         "free_pages": eng.allocator.free_pages}
+            stats["draining"] = srv.draining
             send_msg(self.request, {"metrics": stats, "mode": srv.mode})
             return
         if op == "generate_text" and srv.service is not None:
@@ -180,6 +238,7 @@ class Handler(socketserver.BaseRequestHandler):
             try:
                 sampling = SamplingParams.from_wire(
                     obj, default_max_tokens=64, stop_token=tok.eos_id)
+                deadline = _deadline_of(obj)
             except (ValueError, TypeError) as e:
                 send_msg(self.request, {"error": f"bad sampling params: {e}"})
                 return
@@ -190,24 +249,39 @@ class Handler(socketserver.BaseRequestHandler):
                     f"prompt ({len(prompt_ids)} tokens) + max_new_tokens "
                     f"({sampling.max_new_tokens}) exceeds max_seq_len {limit}")})
                 return
-            ids, ttft = srv.service.submit(prompt_ids, sampling)
+            try:
+                ids, ttft = srv.service.submit(prompt_ids, sampling,
+                                               deadline=deadline)
+            except Rejected as e:
+                send_msg(self.request, e.to_wire())
+                return
             send_msg(self.request, {"text": tok.decode(ids), "tokens": ids,
                                     "ttft_s": ttft})
             return
         if op == "generate" and srv.service is not None:
             try:
                 sampling = SamplingParams.from_wire(obj)
+                deadline = _deadline_of(obj)
             except (ValueError, TypeError) as e:
                 send_msg(self.request, {"error": f"bad sampling params: {e}"})
                 return
             if obj.get("stream"):
-                self._stream_pending(
-                    srv.service, srv.service.submit_async(obj["prompt"],
-                                                          sampling),
-                    with_logprobs=sampling.logprobs)
+                try:
+                    pending = srv.service.submit_async(obj["prompt"], sampling,
+                                                       deadline=deadline)
+                except Rejected as e:
+                    send_msg(self.request, {**e.to_wire(), "done": True})
+                    return
+                self._stream_pending(srv.service, pending,
+                                     with_logprobs=sampling.logprobs,
+                                     deadline=deadline)
                 return
             try:
-                p = srv.service.submit_wait(obj["prompt"], sampling)
+                p = srv.service.submit_wait(obj["prompt"], sampling,
+                                            deadline=deadline)
+            except Rejected as e:
+                send_msg(self.request, e.to_wire())
+                return
             except (TimeoutError, ValueError) as e:
                 send_msg(self.request, {"error": str(e)})
                 return
@@ -251,11 +325,33 @@ class Handler(socketserver.BaseRequestHandler):
         if op == "prefill" and srv.prefill is not None:
             try:
                 sampling = SamplingParams.from_wire(obj)
+                deadline = _deadline_of(obj)
             except (ValueError, TypeError) as e:
                 send_msg(self.request, {"error": f"bad sampling params: {e}"})
                 return
-            with srv.pd_lock:
-                bundle = srv.prefill.prefill(obj["prompt"], sampling)
+            # The prefill engine serializes behind pd_lock: a deadline-
+            # carrying request bounds its wait for the lock (the implicit
+            # queue here), and a budget spent while queued is refused
+            # BEFORE any prefill compute burns chip time.
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not srv.pd_lock.acquire(timeout=remaining):
+                    REGISTRY.inc("rbg_serving_deadline_exceeded_total",
+                                 stage="prefill_queue")
+                    send_msg(self.request, DeadlineExceeded(
+                        "deadline spent waiting for the prefill engine"
+                    ).to_wire())
+                    return
+            else:
+                srv.pd_lock.acquire()
+            try:
+                bundle = srv.prefill.prefill(obj["prompt"], sampling,
+                                             deadline=deadline)
+            except DeadlineExceeded as e:
+                send_msg(self.request, e.to_wire())
+                return
+            finally:
+                srv.pd_lock.release()
             header, kb, vb = bundle_to_wire(bundle)
             send_msg(self.request, header, kb, vb)
             return
@@ -263,6 +359,7 @@ class Handler(socketserver.BaseRequestHandler):
             bundle = bundle_from_wire(obj, k, v)
             try:
                 sampling = SamplingParams.from_wire(obj)
+                deadline = _deadline_of(obj)
             except (ValueError, TypeError) as e:
                 send_msg(self.request, {"error": f"bad sampling params: {e}"})
                 return
@@ -272,13 +369,23 @@ class Handler(socketserver.BaseRequestHandler):
                 # A bundle finished at inject (max_new_tokens == 1 / stop
                 # token) resolves with done set and no tokens — the stream
                 # then carries only the first_token frame.
-                self._stream_pending(srv.decode,
-                                     srv.decode.submit_async(bundle, sampling),
+                try:
+                    pending = srv.decode.submit_async(bundle, sampling,
+                                                      deadline=deadline)
+                except Rejected as e:
+                    send_msg(self.request, {**e.to_wire(), "done": True})
+                    return
+                self._stream_pending(srv.decode, pending,
                                      first_tokens=[bundle.first_token],
-                                     with_logprobs=sampling.logprobs)
+                                     with_logprobs=sampling.logprobs,
+                                     deadline=deadline)
                 return
             try:
-                p = srv.decode.submit_wait(bundle, sampling)
+                p = srv.decode.submit_wait(bundle, sampling,
+                                           deadline=deadline)
+            except Rejected as e:
+                send_msg(self.request, e.to_wire())
+                return
             except (TimeoutError, ValueError) as e:
                 send_msg(self.request, {"error": str(e)})
                 return
@@ -294,6 +401,52 @@ class Handler(socketserver.BaseRequestHandler):
 class EngineServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+
+    def note_inflight(self, delta: int) -> None:
+        with self._inflight_lock:
+            self._inflight += delta
+
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+
+def start_drain(server: EngineServer, drain_deadline_s: float) -> None:
+    """Flip the server into draining and schedule the clean exit.
+
+    The state machine (reference: RBG's group-level drain contract —
+    ANN_DRAIN_DEADLINE / PreparingDelete, api/constants.py): serving →
+    (SIGTERM) → draining — health reports it, every NEW data op is refused
+    with code "draining", in-flight requests keep running — → all in-flight
+    done OR drain deadline passed → listener shutdown → process exit 0.
+    Idempotent: a second SIGTERM neither resets the clock nor stacks
+    drainer threads."""
+    if server.draining:
+        return
+    server.draining = True
+    server.drain_started = time.monotonic()
+    REGISTRY.inc("rbg_serving_drains_total")
+    REGISTRY.set_gauge("rbg_serving_draining", 1.0)
+    print(f"draining: finishing in-flight work "
+          f"(deadline {drain_deadline_s:.1f}s)", flush=True)
+
+    def drainer():
+        deadline = server.drain_started + drain_deadline_s
+        while time.monotonic() < deadline:
+            busy = server.inflight() > 0
+            for s in (server.service, server.decode):
+                if s is not None and (s.engine.has_work() or s._queue):
+                    busy = True
+            if not busy:
+                break
+            time.sleep(0.05)
+        drained = time.monotonic() - server.drain_started
+        aborted = server.inflight()
+        print(f"drain {'complete' if not aborted else 'deadline'} after "
+              f"{drained:.2f}s ({aborted} in-flight aborted)", flush=True)
+        server.shutdown()
+
+    threading.Thread(target=drainer, daemon=True, name="drainer").start()
 
 
 def serve(args) -> None:
@@ -319,6 +472,23 @@ def serve(args) -> None:
     server.auth_token = (args.auth_token
                          or os.environ.get("RBG_DATA_TOKEN") or None)
     server.pd_lock = threading.Lock()
+    server.draining = False
+    server.drain_started = 0.0
+    server._inflight = 0
+    server._inflight_lock = threading.Lock()
+    max_queue = args.max_queue if args.max_queue > 0 else None
+    drain_deadline_s = float(
+        args.drain_deadline_s
+        if args.drain_deadline_s is not None
+        else os.environ.get("RBG_DRAIN_DEADLINE_S", "30"))
+    # SIGTERM = the rollout/scale-down signal (what the executor and k8s
+    # send): graceful drain instead of dropping in-flight streams on the
+    # floor. serve() runs on the main thread, where signal() is legal.
+    try:
+        signal.signal(signal.SIGTERM,
+                      lambda *_: start_drain(server, drain_deadline_s))
+    except ValueError:
+        pass  # non-main-thread embedding (tests) — drain via start_drain()
     from rbg_tpu.engine.tokenizer import ByteTokenizer
     server.tokenizer = ByteTokenizer()  # replaced by init_engine if HF given
 
@@ -367,13 +537,13 @@ def serve(args) -> None:
                 server.prefill = prefill
             elif cfg.mode == "decode":
                 from rbg_tpu.engine.service import DecodeService
-                decode = DecodeService(cfg)
+                decode = DecodeService(cfg, max_queue=max_queue)
                 decode.engine.enable_json_grammar(server.tokenizer)
                 load_adapters(decode.engine)
                 server.decode = decode
             else:
                 from rbg_tpu.engine.service import EngineService
-                service = EngineService(cfg)
+                service = EngineService(cfg, max_queue=max_queue)
                 service.engine.enable_json_grammar(server.tokenizer)
                 load_adapters(service.engine)
                 server.service = service
@@ -389,6 +559,10 @@ def serve(args) -> None:
     threading.Thread(target=init_engine, daemon=True).start()
     print(f"engine listening on 127.0.0.1:{port}", flush=True)
     server.serve_forever()
+    # serve_forever returns only via the drainer's shutdown(): close the
+    # listener and fall out of main() with exit code 0 — a clean rollout.
+    server.server_close()
+    print("engine exited cleanly after drain", flush=True)
 
 
 def main(argv=None) -> int:
@@ -452,6 +626,16 @@ def main(argv=None) -> int:
                     help="max token-level automaton states per grammar "
                          "table (S x V x 5 bytes each); grammars over "
                          "budget fall back to the host-synced path")
+    ap.add_argument("--max-queue", type=int, default=256,
+                    help="admission-control bound on the service queue: "
+                         "submissions past it are shed with a structured "
+                         "'overloaded' error + retry_after_s hint instead "
+                         "of queueing unboundedly (0 = unbounded)")
+    ap.add_argument("--drain-deadline-s", type=float, default=None,
+                    help="graceful-drain budget after SIGTERM: in-flight "
+                         "requests may finish for this long before the "
+                         "process exits (default: $RBG_DRAIN_DEADLINE_S "
+                         "or 30)")
     args = ap.parse_args(argv)
     serve(args)
     return 0

@@ -11,27 +11,40 @@ recycle batch slots + KV pages), and the event pump.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 from rbg_tpu.engine.config import EngineConfig, SamplingParams
 from rbg_tpu.engine.engine import Engine
+# Re-exported here for callers that think in service terms; defined in
+# protocol.py so jax-free processes (server startup) can import them.
+from rbg_tpu.engine.protocol import (CODE_DEADLINE, DeadlineExceeded,
+                                     Overloaded, Rejected)
+from rbg_tpu.obs.metrics import REGISTRY
 
 
 class _Pending:
-    __slots__ = ("tokens", "logprobs", "done", "t_submit", "t_first", "error")
+    __slots__ = ("tokens", "logprobs", "done", "t_submit", "t_first", "error",
+                 "code", "deadline")
 
-    def __init__(self):
+    def __init__(self, deadline: Optional[float] = None):
         self.tokens: List[int] = []
         self.logprobs: List[float] = []   # 1:1 with tokens when requested
         self.done = threading.Event()
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
         self.error: Optional[str] = None
+        self.code: Optional[str] = None   # structured rejection code
+        self.deadline = deadline          # absolute time.monotonic() budget
 
 
 DEFAULT_TIMEOUT_S = 600.0
+# Completion timestamps kept for the estimated-wait admission gate.
+_RATE_WINDOW = 64
+# Fallback backpressure hint when no throughput estimate exists yet.
+_RETRY_AFTER_FLOOR_S = 0.5
 
 
 def embed_prompts(engine: Engine, prompts: List[List[int]]) -> List[List[float]]:
@@ -102,17 +115,30 @@ def _embed_batch(engine: Engine, prompts: List[List[int]]) -> List[List[float]]:
 
 class _BatchService:
     """Shared loop: subclasses implement ``_admit(item, sampling) -> rid``
-    (raising on bad input fails just that request) and expose ``engine``."""
+    (raising on bad input fails just that request) and expose ``engine``.
+
+    Overload protection (``max_queue``): submission into a full queue — or
+    one whose estimated wait (from recent completion throughput) already
+    exceeds the request's deadline budget — raises ``Overloaded`` with a
+    ``retry_after_s`` hint instead of queueing unboundedly. Deadlines:
+    queued entries whose budget expires before admission are dropped
+    without ever touching the engine, and admitted rows past deadline are
+    aborted ON the loop thread (slot + KV pages recycle immediately), so
+    abandoned work never burns device steps."""
 
     engine: Engine
 
-    def __init__(self):
+    def __init__(self, max_queue: Optional[int] = None):
+        self.max_queue = max_queue
+        self.counters = {"shed_total": 0, "deadline_queue_drops": 0,
+                         "deadline_running_aborts": 0}
         self._pending: Dict[int, _Pending] = {}
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stopped = False
         self._queue: List[Tuple[object, SamplingParams, _Pending]] = []
         self._cancels: List[_Pending] = []
+        self._done_times = collections.deque(maxlen=_RATE_WINDOW)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=type(self).__name__.lower())
         self._thread.start()
@@ -121,11 +147,70 @@ class _BatchService:
     def _admit(self, item, sampling: SamplingParams) -> Optional[int]:
         raise NotImplementedError
 
+    # -- admission control --
+
+    def _completion_rate(self) -> Optional[float]:
+        """Recent request completions per second (None = no estimate yet).
+        Span is measured between the completions themselves — anchoring it
+        to "now" would decay the rate through idle periods and make the
+        estimated-wait gate shed the first requests after a lull."""
+        d = self._done_times
+        if len(d) < 2:
+            return None
+        span = d[-1] - d[0]
+        if span <= 0:
+            return None
+        return (len(d) - 1) / span
+
+    def estimated_wait_s(self, depth: Optional[int] = None) -> Optional[float]:
+        """Expected queueing delay for a NEW submission, from the recent
+        completion rate. None until enough history exists."""
+        if depth is None:
+            with self._lock:
+                depth = len(self._queue)
+        rate = self._completion_rate()
+        if rate is None or rate <= 0:
+            return None
+        eng = self.engine
+        backlog = depth + len(eng.running) + len(eng.waiting)
+        return backlog / rate
+
+    def _retry_after_hint(self, depth: int) -> float:
+        est = self.estimated_wait_s(depth)
+        return max(_RETRY_AFTER_FLOOR_S, est if est is not None else 1.0)
+
+    def _shed(self, msg: str, depth: int) -> None:
+        self.counters["shed_total"] += 1
+        REGISTRY.inc("rbg_serving_shed_total",
+                     service=type(self).__name__.lower())
+        raise Overloaded(msg, retry_after_s=self._retry_after_hint(depth))
+
     # -- public --
-    def submit_async(self, item, sampling: SamplingParams) -> _Pending:
-        p = _Pending()
+    def submit_async(self, item, sampling: SamplingParams,
+                     deadline: Optional[float] = None) -> _Pending:
+        """Enqueue one request. ``deadline`` is absolute ``time.monotonic()``
+        seconds; raises ``Overloaded`` / ``DeadlineExceeded`` instead of
+        queueing work that cannot be served."""
+        now = time.monotonic()
+        if deadline is not None and now >= deadline:
+            self.counters["deadline_queue_drops"] += 1
+            REGISTRY.inc("rbg_serving_deadline_exceeded_total", stage="queue")
+            raise DeadlineExceeded("deadline already expired at submission")
+        p = _Pending(deadline=deadline)
         with self._lock:
+            # estimated_wait_s with an explicit depth never re-takes the
+            # lock, so both gates may raise from inside it.
+            depth = len(self._queue)
+            if self.max_queue is not None and depth >= self.max_queue:
+                self._shed(f"service queue full ({self.max_queue})", depth)
+            if deadline is not None:
+                est = self.estimated_wait_s(depth)
+                if est is not None and now + est >= deadline:
+                    self._shed(
+                        f"estimated wait {est:.2f}s exceeds remaining "
+                        f"deadline budget {deadline - now:.2f}s", depth)
             self._queue.append((item, sampling, p))
+            REGISTRY.observe("rbg_serving_queue_depth", depth + 1)
         self._wake.set()
         return p
 
@@ -177,21 +262,40 @@ class _BatchService:
             self.cancel(p)  # recycle batch slot + KV pages, don't orphan
             raise TimeoutError("generation timed out")
         if p.error:
+            if p.code == CODE_DEADLINE:
+                raise DeadlineExceeded(p.error)
             raise ValueError(p.error)
         return p.tokens
 
     def submit_wait(self, item, sampling: SamplingParams,
-                    timeout: float = DEFAULT_TIMEOUT_S) -> _Pending:
+                    timeout: float = DEFAULT_TIMEOUT_S,
+                    deadline: Optional[float] = None) -> _Pending:
         """Blocking submit; returns the completed _Pending (tokens,
         logprobs, ttft timestamps). The one blocking-wait/timeout contract
-        every caller — server ops included — goes through."""
-        p = self.submit_async(item, sampling)
+        every caller — server ops included — goes through. ``deadline``
+        (absolute monotonic) bounds the whole stay: admission gate, queue
+        drop, AND engine-side abort, not just this thread's wait."""
+        p = self.submit_async(item, sampling, deadline=deadline)
+        if deadline is not None:
+            timeout = min(timeout, max(0.0, deadline - time.monotonic()) + 1.0)
         self.wait(p, timeout)
         return p
 
     @staticmethod
     def ttft(p: _Pending) -> float:
         return (p.t_first - p.t_submit) if p.t_first else 0.0
+
+    def service_stats(self) -> dict:
+        """Admission-control / lifecycle counters (merged into the metrics
+        op by every serving mode, scraped by the stress harness)."""
+        with self._lock:
+            depth = len(self._queue)
+        est = self.estimated_wait_s(depth)
+        out = dict(self.counters)
+        out["queue_depth"] = depth
+        out["max_queue"] = self.max_queue
+        out["estimated_wait_s"] = round(est, 4) if est is not None else None
+        return out
 
     def cancel(self, pending: _Pending) -> None:
         """Abort an in-flight request (routed through the loop thread)."""
@@ -202,20 +306,65 @@ class _BatchService:
     def stop(self):
         self._stopped = True
         self._wake.set()
+        # Join so stop() actually frees the CPU: a "stopped" service whose
+        # loop thread lingers keeps polling (and in a test suite, dozens of
+        # leaked loops become ambient load that starves later tests).
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=30.0)
 
     # -- loop --
+    def _expire_queue_locked(self, now: float) -> List[_Pending]:
+        """Drop queued entries whose deadline passed before admission.
+        Caller holds the lock; the dropped pendings are failed OUTSIDE it."""
+        if not any(p.deadline is not None for _, _, p in self._queue):
+            return []
+        live, dead = [], []
+        for entry in self._queue:
+            p = entry[2]
+            if p.deadline is not None and now >= p.deadline:
+                dead.append(p)
+            else:
+                live.append(entry)
+        self._queue = live
+        return dead
+
+    def _abort_expired_running(self, now: float) -> None:
+        """Abort admitted rows past deadline (loop thread — the only thread
+        allowed to touch the engine): the slot and KV pages recycle NOW
+        instead of burning device steps to max_new_tokens."""
+        expired = [(rid, p) for rid, p in self._pending.items()
+                   if p.deadline is not None and now >= p.deadline]
+        for rid, p in expired:
+            self.engine.cancel_request(rid)
+            del self._pending[rid]
+            self.counters["deadline_running_aborts"] += 1
+            REGISTRY.inc("rbg_serving_deadline_exceeded_total",
+                         stage="running")
+            p.error = "deadline exceeded mid-generation (aborted)"
+            p.code = CODE_DEADLINE
+            p.done.set()
+
     def _loop(self):
         eng = self.engine
         while not self._stopped:
+            now = time.monotonic()
             with self._lock:
                 cancels = self._cancels
                 self._cancels = []
+                expired = self._expire_queue_locked(now)
                 # Admission control: never exceed the engine's batch ceiling —
                 # excess items stay queued for later rounds.
                 budget = max(0, eng.cfg.max_batch
                              - len(eng.running) - len(eng.waiting))
                 newly = self._queue[:budget]
                 self._queue = self._queue[budget:]
+            for pending in expired:
+                self.counters["deadline_queue_drops"] += 1
+                REGISTRY.inc("rbg_serving_deadline_exceeded_total",
+                             stage="queue")
+                pending.error = "deadline expired before admission"
+                pending.code = CODE_DEADLINE
+                pending.done.set()
             for item, sampling, pending in newly:
                 try:
                     rid = self._admit(item, sampling)
@@ -226,8 +375,10 @@ class _BatchService:
                     continue
                 if rid is None:
                     pending.done.set()  # completed at admission
+                    self._done_times.append(time.monotonic())
                     continue
                 self._pending[rid] = pending
+            self._abort_expired_running(now)
             for pending in cancels:
                 rid = next((r for r, p in self._pending.items() if p is pending),
                            None)
@@ -259,12 +410,15 @@ class _BatchService:
                 if ev.finished:
                     pending.done.set()
                     del self._pending[ev.request_id]
+                    # Completion history feeds the estimated-wait gate.
+                    self._done_times.append(time.monotonic())
 
 
 class EngineService(_BatchService):
-    def __init__(self, cfg: EngineConfig, params=None, mesh=None):
+    def __init__(self, cfg: EngineConfig, params=None, mesh=None,
+                 max_queue: Optional[int] = None):
         self.engine = Engine(cfg, params=params, mesh=mesh)
-        super().__init__()
+        super().__init__(max_queue=max_queue)
 
     def _admit(self, prompt, sampling: SamplingParams) -> Optional[int]:
         return self.engine.add_request(prompt, sampling)
@@ -274,9 +428,10 @@ class EngineService(_BatchService):
         return warm_prompt(input_len, wave, row)
 
     def submit(self, prompt: List[int], sampling: SamplingParams,
-               timeout: float = DEFAULT_TIMEOUT_S) -> Tuple[List[int], float]:
+               timeout: float = DEFAULT_TIMEOUT_S,
+               deadline: Optional[float] = None) -> Tuple[List[int], float]:
         """Blocking generate. Returns (tokens, ttft_seconds)."""
-        p = self.submit_wait(prompt, sampling, timeout)
+        p = self.submit_wait(prompt, sampling, timeout, deadline=deadline)
         return p.tokens, self.ttft(p)
 
     def embed(self, prompt: List[int]) -> List[float]:
@@ -290,6 +445,7 @@ class EngineService(_BatchService):
         out["free_pages"] = self.engine.allocator.free_pages
         out["radix_nodes"] = (self.engine.radix.num_nodes
                               if self.engine.radix is not None else 0)
+        out.update(self.service_stats())
         return out
 
 
@@ -297,12 +453,13 @@ class DecodeService(_BatchService):
     """Disaggregated decode role: KV bundles from many router connections
     decode TOGETHER on the device instead of serializing per connection."""
 
-    def __init__(self, cfg, params=None, mesh=None):
+    def __init__(self, cfg, params=None, mesh=None,
+                 max_queue: Optional[int] = None):
         from rbg_tpu.engine.pd import DecodeWorker
 
         self.worker = DecodeWorker(cfg, params=params, mesh=mesh)
         self.engine = self.worker.engine
-        super().__init__()
+        super().__init__(max_queue=max_queue)
 
     def _admit(self, bundle, sampling: SamplingParams) -> Optional[int]:
         rid = self.worker.inject(bundle, sampling)

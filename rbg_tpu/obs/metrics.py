@@ -21,11 +21,28 @@ class Registry:
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, tuple], float] = defaultdict(float)
         self._hist: Dict[Tuple[str, tuple], list] = {}
+        self._gauges: Dict[Tuple[str, tuple], float] = {}
 
     def inc(self, name: str, value: float = 1.0, **labels):
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._counters[key] += value
+
+    def set_gauge(self, name: str, value: float, **labels):
+        """Last-write-wins gauge (queue depth, drain state, ...)."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key] = value
+
+    def gauge(self, name: str, **labels) -> Optional[float]:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._gauges.get(key)
+
+    def counter(self, name: str, **labels) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._counters.get(key, 0.0)
 
     def observe(self, name: str, value: float, **labels):
         key = (name, tuple(sorted(labels.items())))
@@ -64,6 +81,8 @@ class Registry:
         with self._lock:
             for (name, labels), v in sorted(self._counters.items()):
                 lines.append(f"{name}{_fmt(labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                lines.append(f"{name}{_fmt(labels)} {v}")
             for (name, labels), (buckets, total, count) in sorted(self._hist.items()):
                 cum = 0
                 for i, b in enumerate(_BUCKETS):
@@ -79,6 +98,7 @@ class Registry:
         with self._lock:
             self._counters.clear()
             self._hist.clear()
+            self._gauges.clear()
 
 
 def _fmt(labels: tuple, **extra) -> str:

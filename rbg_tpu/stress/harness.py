@@ -208,10 +208,136 @@ def _run(cfg: StressConfig, plane: ControlPlane) -> dict:
     return report
 
 
+# ---- serving-plane overload scenario ---------------------------------------
+
+
+@dataclasses.dataclass
+class OverloadConfig:
+    """Sustained-overload drill against ONE in-process EngineService: more
+    concurrent demand than the engine's batch + queue can hold, so the
+    admission gates MUST shed. The report carries the robustness
+    invariants the serving plane promises under overload."""
+
+    clients: int = 6
+    requests_per_client: int = 6
+    max_queue: int = 4
+    max_batch: int = 2
+    max_new_tokens: int = 24
+    prompt_len: int = 8
+    timeout_s: float = 60.0        # per-request deadline budget
+    model: str = "tiny"
+
+
+def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
+    """Fire ``clients`` threads of back-to-back generates at a deliberately
+    undersized service and report what the overload machinery did:
+    admitted-request latency percentiles, shed/deadline counts, and the
+    max queue depth ever observed (the bounded-queue invariant)."""
+    import threading
+
+    from rbg_tpu.engine.config import EngineConfig, SamplingParams
+    from rbg_tpu.engine.service import (DeadlineExceeded, EngineService,
+                                        Overloaded)
+
+    own = service is None
+    if own:
+        service = EngineService(
+            EngineConfig(model=cfg.model, page_size=8, num_pages=256,
+                         max_batch=cfg.max_batch, max_seq_len=256,
+                         prefill_chunk=16, use_pallas="never",
+                         decode_buckets=(cfg.max_batch,)),
+            max_queue=cfg.max_queue)
+    outcomes = {"ok": 0, "overloaded": 0, "deadline_exceeded": 0, "error": 0}
+    latencies: List[float] = []
+    retry_hints: List[float] = []
+    olock = threading.Lock()
+    depth_max = [0]
+    stop_probe = threading.Event()
+
+    def probe_depth():
+        while not stop_probe.is_set():
+            with service._lock:
+                d = len(service._queue)
+            depth_max[0] = max(depth_max[0], d)
+            time.sleep(0.002)
+
+    def client(ci: int):
+        sp = SamplingParams(max_new_tokens=cfg.max_new_tokens)
+        prompt = [(ci * 17 + j) % 200 + 1 for j in range(cfg.prompt_len)]
+        for _ in range(cfg.requests_per_client):
+            t0 = time.monotonic()
+            try:
+                service.submit_wait(prompt, sp,
+                                    deadline=t0 + cfg.timeout_s)
+            except Overloaded as e:
+                with olock:
+                    outcomes["overloaded"] += 1
+                    if e.retry_after_s is not None:
+                        retry_hints.append(e.retry_after_s)
+                continue
+            except DeadlineExceeded:
+                with olock:
+                    outcomes["deadline_exceeded"] += 1
+                continue
+            except Exception:
+                with olock:
+                    outcomes["error"] += 1
+                continue
+            with olock:
+                outcomes["ok"] += 1
+                latencies.append(time.monotonic() - t0)
+
+    prober = threading.Thread(target=probe_depth, daemon=True)
+    prober.start()
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(cfg.clients)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        stop_probe.set()
+        prober.join()
+        if own:
+            service.stop()
+    stats = service.service_stats()
+    total = cfg.clients * cfg.requests_per_client
+    report = {
+        "config": dataclasses.asdict(cfg),
+        "elapsed_s": round(time.perf_counter() - t_start, 3),
+        "outcomes": outcomes,
+        "admitted_latency_ms": _pcts(latencies),
+        "retry_after_hint_s": (round(min(retry_hints), 3)
+                               if retry_hints else None),
+        "max_queue_depth_observed": depth_max[0],
+        "service": stats,
+        "invariants": {
+            # The three promises the overload machinery makes:
+            "queue_bounded": depth_max[0] <= cfg.max_queue,
+            "all_accounted": sum(outcomes.values()) == total,
+            "shed_instead_of_queued": (outcomes["overloaded"] == 0
+                                       or stats["shed_total"] > 0),
+        },
+    }
+    return report
+
+
 def main(argv=None) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(prog="rbg-tpu-stress")
+    ap.add_argument("--scenario", default="churn",
+                    choices=["churn", "overload"],
+                    help="churn = control-plane create/update/delete "
+                         "percentiles; overload = serving-plane admission "
+                         "control drill (sheds, deadlines, queue bound)")
+    ap.add_argument("--clients", type=int, default=6)
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--max-queue", type=int, default=4)
+    ap.add_argument("--max-batch", type=int, default=2)
+    ap.add_argument("--timeout-s", type=float, default=60.0)
     ap.add_argument("--groups", type=int, default=10)
     ap.add_argument("--roles", type=int, default=2)
     ap.add_argument("--replicas", type=int, default=2)
@@ -228,12 +354,24 @@ def main(argv=None) -> int:
                     help="also write the JSON report to FILE (committed "
                          "per round like BENCH)")
     args = ap.parse_args(argv)
+    import os
+    load1 = os.getloadavg()[0]
+    if args.scenario == "overload":
+        report = run_serving_overload(OverloadConfig(
+            clients=args.clients, requests_per_client=args.requests,
+            max_queue=args.max_queue, max_batch=args.max_batch,
+            timeout_s=args.timeout_s))
+        report["load1_before"] = round(load1, 2)
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump(report, f, indent=1)
+        print(json.dumps(report) if args.json
+              else json.dumps(report, indent=2))
+        return 0
     cfg = StressConfig(groups=args.groups, roles_per_group=args.roles,
                        replicas=args.replicas, create_qps=args.qps,
                        slices=args.slices, hosts_per_slice=args.hosts,
                        backend=args.backend)
-    import os
-    load1 = os.getloadavg()[0]
     report = run_stress(cfg)
     report["load1_before"] = round(load1, 2)
     report["command"] = "rbg-tpu stress " + " ".join(

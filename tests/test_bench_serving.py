@@ -1,6 +1,8 @@
 """Serving benchmark harness: open-loop Poisson load, TTFT/ITL/e2e
 percentiles (sglang.bench_serving analog; BASELINE.json SLO shape)."""
 
+import pytest
+
 import argparse
 
 from rbg_tpu.engine.bench_serving import _percentile, main, run
@@ -13,6 +15,7 @@ def test_percentile_edges():
     assert str(_percentile([], 50)) == "nan"
 
 
+@pytest.mark.slow
 def test_inprocess_run_produces_slo_report():
     args = argparse.Namespace(
         requests=8, rate=64.0, input_len=8, output_len=8, model="tiny",
@@ -27,6 +30,7 @@ def test_inprocess_run_produces_slo_report():
     assert out["e2e_s"]["p50"] > 0
 
 
+@pytest.mark.slow
 def test_cli_json_line(capsys):
     rc = main(["--requests", "4", "--rate", "64", "--input-len", "8",
                "--output-len", "4", "--model", "tiny", "--use-pallas",

@@ -56,6 +56,17 @@ def test_random_pod_failures_reconverge():
         for i in range(3):
             plane.wait_group_ready(f"g{i}", timeout=60)
 
+        # Group-Ready can race the LAST replacement pod's binding (the
+        # instance already counts ready while the spare is still
+        # Pending): wait until every active pod is actually scheduled, or
+        # the slice-invariant check below dereferences node_name == "".
+        def all_bound():
+            ps = [p for p in plane.store.list("Pod", namespace="default")
+                  if p.active]
+            return all(p.node_name and p.status.phase == "Running"
+                       for p in ps)
+        plane.wait_for(all_bound, timeout=60, desc="all active pods bound")
+
         # invariants after the storm
         nodes = {n.metadata.name: n for n in plane.store.list("Node")}
         pods = [p for p in plane.store.list("Pod", namespace="default") if p.active]

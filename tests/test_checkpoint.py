@@ -25,6 +25,7 @@ def test_orbax_roundtrip(tmp_path):
     assert not is_hf_checkpoint(path)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("with_bias", [False, True], ids=["llama", "qwen2"])
 def test_hf_import_matches_transformers(tmp_path, with_bias):
     """Build a tiny real HF model, save it, import it, and require our

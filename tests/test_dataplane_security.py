@@ -208,6 +208,7 @@ def _wait_ready(port, timeout=240.0, op="health"):
     raise TimeoutError(f"server on {port} never ready")
 
 
+@pytest.mark.slow
 @pytest.mark.e2e
 def test_pool_restart_mid_serving_degrades_then_refills():
     """Kill the KV pool under a live token-gated prefill server: requests

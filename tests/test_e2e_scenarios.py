@@ -370,6 +370,7 @@ def test_groupset_fleet_rollout(plane):
 
 # ---- scenario 12: convergence after plane SIGKILL mid-rollout ----
 
+@pytest.mark.slow
 def test_convergence_after_plane_kill(tmp_path):
     state = str(tmp_path / "state.json")
     p = ServedPlane(state_file=state, slices=2, hosts=2)

@@ -51,6 +51,7 @@ def test_chunked_prefill_long_prompt(tiny_setup):
     assert got == expect
 
 
+@pytest.mark.slow
 def test_continuous_batching_mixed_lengths(tiny_setup):
     cfg, params = tiny_setup
     rng = np.random.RandomState(1)
@@ -75,6 +76,7 @@ def test_radix_cache_hit_same_output(tiny_setup):
     assert eng.metrics["prefill_tokens"] < 2 * len(prompt)
 
 
+@pytest.mark.slow
 def test_preemption_under_page_pressure(tiny_setup):
     """Pool sized so concurrent decodes exhaust pages mid-flight (admission
     reserves prompt-only pages; decode growth oversubscribes): the engine
@@ -133,6 +135,7 @@ def test_page_accounting_balances(tiny_setup):
     assert eng_r.allocator.free_pages == free0  # full eviction returns the rest
 
 
+@pytest.mark.slow
 def test_engine_on_mesh_matches_single_device(tiny_setup):
     """The sharded serving path (Engine(mesh=...)): tp/dp-sharded params and
     KV pages produce identical tokens."""
@@ -214,6 +217,7 @@ def test_int8_kv_accepts_pallas_always():
 # ---- multi-step (device-side decode window, EngineConfig.multi_step) ----
 
 
+@pytest.mark.slow
 def test_multistep_matches_single_step_greedy(tiny_setup):
     """A K-step scan window must produce the exact single-step token stream
     (same forward, same greedy argmax — only dispatch granularity differs)."""
@@ -243,6 +247,7 @@ def test_multistep_stop_token_mid_window(tiny_setup):
     assert eng.allocator.free_pages == free0
 
 
+@pytest.mark.slow
 def test_multistep_uneven_lengths_finish_correctly(tiny_setup):
     """Rows whose max_new_tokens is not a multiple of the window, or less
     than one window, emit exactly their budget."""
@@ -263,6 +268,7 @@ def test_multistep_uneven_lengths_finish_correctly(tiny_setup):
     assert [outputs[i] for i in ids] == expect
 
 
+@pytest.mark.slow
 def test_multistep_preemption_under_pressure(tiny_setup):
     """Page exhaustion with a multi-step window still preempts + resumes
     without corrupting any stream."""

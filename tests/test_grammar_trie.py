@@ -124,6 +124,7 @@ def test_trie_structure_shares_prefixes():
     assert trie.total_bytes == 7
 
 
+@pytest.mark.slow
 @pytest.mark.e2e
 def test_json_mode_hf_tokenizer_over_wire():
     """VERDICT done-condition: json_mode works with --tokenizer-path.

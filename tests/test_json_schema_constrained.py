@@ -88,6 +88,11 @@ def test_schema_grammar_rejects_unsupported():
     for bad in ({"$ref": "#/x"}, {"allOf": []}, {"type": "frob"},
                 {"enum": []}, {"enum": [{"x": 1}]},
                 {"type": "array", "minItems": 3, "maxItems": 1},
+                # Array without "items" means any-value members — silently
+                # emitting array-of-strings would diverge from the
+                # client's schema; must raise at admission.
+                {"type": "array"},
+                {"type": "array", "minItems": 1},
                 "not a dict"):
         with pytest.raises(ValueError):
             JsonSchemaGrammar(bad)

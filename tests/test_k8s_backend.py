@@ -246,12 +246,21 @@ def test_group_delete_cleans_cluster(k8s_plane):
 
 
 def test_inplace_update_patches_cluster_pod(k8s_plane):
+    # Deflake note: this test is end-to-end asynchronous — plane reconcile
+    # → REST patch → node-agent ack → watch reflector → plane status, five
+    # thread/HTTP hops that comfortably fit 10 s in isolation but starved
+    # past it when the FULL tier-1 run's ambient load (leaked engine-loop
+    # threads of earlier modules) peaked. Only this test's own fixtures
+    # hold state; the budget below is what actually had to give.
     srv, cli, plane = k8s_plane
     grp = make_group("svc", simple_role("worker", replicas=1))
     plane.apply(grp)
-    plane.wait_group_ready("svc", timeout=10)
-    before = cli.list_pods(
-        label_selector=f"{T.LABEL_MANAGED_BY}={T.MANAGED_BY}")[0]
+    plane.wait_group_ready("svc", timeout=30)
+    # The reflector may still be syncing the fresh pod's status: wait for
+    # the UID to be stable under the managed-by selector, not just ready.
+    before = wait_until(lambda: (cli.list_pods(
+        label_selector=f"{T.LABEL_MANAGED_BY}={T.MANAGED_BY}") or [None])[0],
+        timeout=30, desc="cluster pod mirrored")
 
     grp2 = make_group("svc", simple_role("worker", replicas=1,
                                          image="engine:v2"))
@@ -269,8 +278,8 @@ def test_inplace_update_patches_cluster_pod(k8s_plane):
                 and cs[0]["restartCount"] >= 1
                 # Same K8s pod object — updated in place, not recreated.
                 and kp["metadata"]["uid"] == before["metadata"]["uid"])
-    wait_until(updated, desc="in-place image patch acked by cluster")
-    plane.wait_group_ready("svc", timeout=10)
+    wait_until(updated, timeout=30, desc="in-place image patch acked by cluster")
+    plane.wait_group_ready("svc", timeout=30)
     pod = plane.store.list("Pod")[0]
     assert pod.status.restart_count >= 1
 

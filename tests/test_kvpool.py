@@ -176,6 +176,7 @@ def _wait_engine_ready(port, timeout=180.0):
     raise TimeoutError(f"engine on {port} never ready")
 
 
+@pytest.mark.slow
 @pytest.mark.e2e
 def test_kvpool_reuse_across_real_processes():
     """BASELINE config 4 shape: the second identical prompt, served by a

@@ -73,6 +73,7 @@ def test_base_rows_unaffected_by_loaded_adapters():
                                      SamplingParams(max_new_tokens=8))[0]
 
 
+@pytest.mark.slow
 def test_mixed_adapters_in_one_batch():
     """Three rows — adapter a, adapter b (different rank), base — decode
     TOGETHER and each matches its solo merged-weights reference."""
@@ -101,6 +102,7 @@ def test_mixed_adapters_in_one_batch():
         assert outs[rid] == ref, rid
 
 
+@pytest.mark.slow
 def test_lora_composes_with_multi_step_and_speculative():
     ad = _adapter(2)
     ref = None
@@ -153,6 +155,7 @@ def test_load_lora_validation():
                                    np.zeros((2, 4, 64), np.float32))})
 
 
+@pytest.mark.slow
 def test_pd_disagg_carries_adapter():
     from rbg_tpu.engine.pd import PDPair
     ad = _adapter(3)
@@ -167,6 +170,7 @@ def test_pd_disagg_carries_adapter():
     assert got[0] == expect
 
 
+@pytest.mark.slow
 @pytest.mark.e2e
 def test_lora_over_wire_with_npz():
     import tempfile
@@ -199,6 +203,7 @@ def test_lora_over_wire_with_npz():
         assert "error" in bad and "unknown LoRA" in bad["error"]
 
 
+@pytest.mark.slow
 def test_mixed_rank_targets_scale_per_target():
     """alpha/r must use each TARGET's rank — an adapter mixing r=2 and
     r=8 targets must match the per-target merged reference exactly."""
@@ -277,6 +282,7 @@ def test_pool_put_skipped_for_adapter_requests():
     assert pool.gets and pool.puts          # base request uses the pool
 
 
+@pytest.mark.slow
 def test_runtime_load_lora_does_not_drop_inflight_tokens():
     """Loading an adapter mid-serve flushes the fused pipeline instead of
     discarding its pending window — in-flight base requests lose nothing
@@ -296,6 +302,7 @@ def test_runtime_load_lora_does_not_drop_inflight_tokens():
 
 
 
+@pytest.mark.slow
 def test_mla_lora_matches_merged_weights():
     """MLA adapters (wq / w_dkv / wo) must match the merged-weights
     reference exactly — _post_attention and _mla_qkv both thread LoRA."""

@@ -25,6 +25,7 @@ CFG = get_config("tiny-mla")
 PARAMS = init_params(CFG, jax.random.key(0))
 
 
+@pytest.mark.slow
 def test_prefill_decode_equivalence():
     B, T = 2, 12
     toks = jax.random.randint(jax.random.key(1), (B, T), 0, CFG.vocab_size)
@@ -63,6 +64,7 @@ def test_absorbed_equals_naive_attention():
     assert float(jnp.max(jnp.abs(naive - absorbed))) < 1e-4
 
 
+@pytest.mark.slow
 def test_paged_engine_matches_contiguous_greedy():
     ref = prefill_and_decode_greedy(PARAMS, CFG, jnp.asarray([[1, 2, 3, 4]]),
                                     steps=8)
@@ -73,6 +75,7 @@ def test_paged_engine_matches_contiguous_greedy():
     assert np.asarray(ref).reshape(-1).tolist() == got
 
 
+@pytest.mark.slow
 def test_engine_features_compose_with_mla():
     def mk(**kw):
         return Engine(EngineConfig(model="tiny-mla", page_size=8,
@@ -131,6 +134,7 @@ def test_mla_moe_combined_forward():
     assert bool(jnp.isfinite(logits).all())
 
 
+@pytest.mark.slow
 def test_mla_sharded_engine_tp2():
     from jax.sharding import Mesh
     devs = np.array(jax.devices()[:2]).reshape(1, 2)
@@ -164,6 +168,7 @@ def test_mla_config_guards():
                      use_pallas="always").validate()
 
 
+@pytest.mark.slow
 def test_pd_disagg_ships_latent_bundles():
     """PD-disagg with MLA: the KV bundle carries the compressed latent
     pages (the Mooncake-economics point of MLA) and decodes identically."""
@@ -200,6 +205,7 @@ def test_mla_decode_service_warm_bundle_shapes():
         svc.stop()
 
 
+@pytest.mark.slow
 def test_mla_int8_latent_pool_numerics():
     """int8-quantized latent pool (round 5): half the already-compressed
     latent HBM; bounded deviation vs the fp32 pool and greedy agreement

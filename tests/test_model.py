@@ -27,6 +27,7 @@ def test_forward_shapes(tiny):
     np.testing.assert_array_equal(np.asarray(cache.length), [T, T])
 
 
+@pytest.mark.slow
 def test_prefill_matches_incremental_decode(tiny):
     """Logits at position t from one full prefill == logits from feeding tokens
     one at a time through the cache. This validates cache writes, masking and

@@ -69,6 +69,7 @@ def test_moe_expert_parallel_matches_single_device(moe_setup):
     np.testing.assert_allclose(np.asarray(ref), np.asarray(got), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_moe_train_step_reduces_loss(moe_setup):
     cfg, params = moe_setup
     mesh = make_mesh(dp=1, sp=2, ep=2, tp=2)

@@ -1,0 +1,697 @@
+"""Overload protection + lifecycle robustness through the serving path.
+
+The chaos-style invariants of the serving plane (ISSUE 2):
+
+* under sustained overload the service SHEDS (structured ``overloaded`` +
+  retry_after_s; the edge maps it to 429 + Retry-After) instead of
+  queueing unboundedly — queue depth stays <= max_queue;
+* an expired-deadline request is never dispatched to a backend, and an
+  in-flight one is aborted engine-side (slot + KV pages recycle);
+* SIGTERM flips a draining state: in-flight streams finish, new ops are
+  refused with ``draining``, the router routes around the backend WITHOUT
+  evicting it, and the process exits cleanly;
+* a vanished client cancels the backend decode leg (pages recycle).
+"""
+
+import json
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from rbg_tpu.engine.config import EngineConfig, SamplingParams
+from rbg_tpu.engine.protocol import (CODE_DEADLINE, CODE_DRAINING,
+                                     CODE_OVERLOADED, recv_msg, request_once,
+                                     send_msg)
+from rbg_tpu.engine.router import (Handler, Registry, RetryBudget,
+                                   RouterServer, RouterState, _Rejected)
+from rbg_tpu.engine.service import (DeadlineExceeded, EngineService,
+                                    Overloaded)
+
+from test_router_resilience import (_EchoBackend, _StreamBackend, _dead_addr,
+                                    _wait_for)
+
+
+# ---- service-level admission control ---------------------------------------
+
+
+@pytest.fixture(scope="module")
+def svc():
+    s = EngineService(
+        EngineConfig(model="tiny", page_size=8, num_pages=128, max_batch=2,
+                     max_seq_len=256, prefill_chunk=16, use_pallas="never",
+                     decode_buckets=(1, 2)),
+        max_queue=None)
+    # Pay the jit compiles BEFORE any deadline-sensitive test runs.
+    s.submit_wait([1, 2, 3], SamplingParams(max_new_tokens=4))
+    yield s
+    s.stop()
+
+
+def _drain_service(svc, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with svc._lock:
+            empty = not svc._queue
+        if empty and not svc.engine.has_work():
+            return
+        time.sleep(0.02)
+    raise TimeoutError("service never drained")
+
+
+def test_queue_bound_sheds_with_retry_hint(svc):
+    svc.max_queue = 2
+    shed_before = svc.counters["shed_total"]
+    pendings, shed = [], None
+    try:
+        # Saturate: batch(2) + queue(2) admit; further submits must shed.
+        for _ in range(12):
+            try:
+                pendings.append(svc.submit_async(
+                    [5, 6, 7], SamplingParams(max_new_tokens=64)))
+            except Overloaded as e:
+                shed = e
+                break
+        assert shed is not None, "queue never shed"
+        assert shed.retry_after_s is not None and shed.retry_after_s > 0
+        assert shed.to_wire()["code"] == CODE_OVERLOADED
+        assert svc.counters["shed_total"] == shed_before + 1
+        with svc._lock:
+            assert len(svc._queue) <= 2
+    finally:
+        svc.max_queue = None
+        for p in pendings:
+            svc.cancel(p)
+        _drain_service(svc)
+
+
+def test_expired_deadline_rejected_synchronously(svc):
+    before = dict(svc.engine.metrics)
+    with pytest.raises(DeadlineExceeded):
+        svc.submit_async([1, 2, 3], SamplingParams(max_new_tokens=4),
+                         deadline=time.monotonic() - 0.1)
+    # Never reached the engine: no prefill, no steps attributable.
+    assert svc.engine.metrics["prefill_tokens"] == before["prefill_tokens"]
+
+
+def test_queued_expiry_dropped_before_admission(svc):
+    """A request whose deadline lapses while QUEUED behind long work is
+    dropped by the loop without dispatching — the engine never sees it."""
+    drops_before = svc.counters["deadline_queue_drops"]
+    blockers = [svc.submit_async([9, 9, 9 + i],
+                                 SamplingParams(max_new_tokens=200))
+                for i in range(2)]  # occupy both batch slots
+    try:
+        doomed = svc.submit_async([4, 4, 4], SamplingParams(max_new_tokens=4),
+                                  deadline=time.monotonic() + 0.2)
+        assert doomed.done.wait(10), "expired entry never resolved"
+        assert doomed.code == CODE_DEADLINE
+        assert doomed.tokens == []
+        assert svc.counters["deadline_queue_drops"] > drops_before
+    finally:
+        for p in blockers:
+            svc.cancel(p)
+        _drain_service(svc)
+
+
+def test_running_abort_recycles_slot_and_pages(svc):
+    """An admitted request past deadline is aborted ENGINE-side: batch slot
+    and KV pages recycle instead of decoding to max_new_tokens.
+
+    The engine's step is throttled for the test's duration so the request
+    CANNOT finish inside the deadline on any machine — without this, a
+    fast solo run decodes all 240 tokens before the 1 s budget and the
+    abort never needs to fire (observed tier-1 flake)."""
+    _drain_service(svc)
+    free_before = svc.engine.allocator.free_pages
+    aborts_before = svc.counters["deadline_running_aborts"]
+    orig_step = svc.engine.step
+
+    def slow_step():
+        time.sleep(0.05)        # ≤ ~20 tokens/s: 240 can't finish in 1 s
+        return orig_step()
+
+    svc.engine.step = slow_step
+    try:
+        p = svc.submit_async([11, 12, 13],
+                             SamplingParams(max_new_tokens=240),
+                             deadline=time.monotonic() + 1.0)
+        assert p.done.wait(30), "deadline abort never fired"
+        assert p.code == CODE_DEADLINE
+        assert svc.counters["deadline_running_aborts"] == aborts_before + 1
+        # Partial output was produced (it ran), then the abort cut it short.
+        assert len(p.tokens) < 240
+    finally:
+        svc.engine.step = orig_step
+    _wait_for(lambda: svc.engine.allocator.free_pages == free_before,
+              timeout=10)
+    assert not svc.engine.running and not svc.engine.waiting
+
+
+def test_estimated_wait_gate_sheds_doomed_request(svc):
+    """With a measured completion rate, a deadline the backlog can't meet
+    is shed AT ADMISSION (the Orca/SGLang-style overload gate) instead of
+    queueing work guaranteed to expire."""
+    _drain_service(svc)
+    now = time.monotonic()
+    # Seed completion history: 1 completion/s (measured, not configured).
+    svc._done_times.clear()
+    svc._done_times.extend([now - 10 + i for i in range(11)])
+    blockers = [svc.submit_async([7, 7, 7 + i],
+                                 SamplingParams(max_new_tokens=200))
+                for i in range(4)]  # backlog: 2 running + 2 queued
+    try:
+        est = svc.estimated_wait_s()
+        assert est is not None and est > 1.0
+        with pytest.raises(Overloaded) as ei:
+            svc.submit_async([8, 8, 8], SamplingParams(max_new_tokens=4),
+                             deadline=time.monotonic() + 0.5)
+        assert ei.value.retry_after_s >= 0.5
+    finally:
+        svc._done_times.clear()
+        for p in blockers:
+            svc.cancel(p)
+        _drain_service(svc)
+
+
+def test_overload_scenario_invariants():
+    """The stress harness's serving-overload drill: sustained overdemand
+    sheds instead of queueing unboundedly, every request is accounted,
+    and admitted-request latency stays inside the deadline budget."""
+    from rbg_tpu.stress.harness import OverloadConfig, run_serving_overload
+
+    cfg = OverloadConfig(clients=4, requests_per_client=3, max_queue=2,
+                         max_batch=2, max_new_tokens=16, timeout_s=60.0)
+    report = run_serving_overload(cfg)
+    assert report["invariants"]["queue_bounded"]
+    assert report["invariants"]["all_accounted"]
+    assert report["invariants"]["shed_instead_of_queued"]
+    assert report["outcomes"]["error"] == 0
+    assert report["max_queue_depth_observed"] <= cfg.max_queue
+    # p99 of admitted requests bounded by the deadline budget.
+    if report["admitted_latency_ms"]["n"]:
+        assert report["admitted_latency_ms"]["p99"] <= cfg.timeout_s * 1000
+
+
+# ---- router: shed routing, draining, retry budget, deadlines ---------------
+
+
+class _RejectBackend(socketserver.ThreadingTCPServer):
+    """Backend that answers every data op with a structured rejection
+    (health stays ok so the pool never evicts it for probing reasons)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, frame, draining_health=False):
+        backend = self
+        self.seen = []
+
+        class H(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        obj, _, _ = recv_msg(self.request)
+                    except (ConnectionError, json.JSONDecodeError):
+                        return
+                    if obj is None:
+                        return
+                    backend.seen.append(obj)
+                    if obj.get("op") == "health":
+                        send_msg(self.request, {
+                            "ok": True,
+                            "draining": backend.draining_health})
+                        continue
+                    send_msg(self.request, dict(backend.frame))
+
+        self.frame = frame
+        self.draining_health = draining_health
+        super().__init__(("127.0.0.1", 0), H)
+        self.addr = f"127.0.0.1:{self.server_address[1]}"
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.shutdown()
+        self.server_close()
+
+
+OVERLOADED_FRAME = {"error": "queue full", "code": CODE_OVERLOADED,
+                    "retry_after_s": 2.0, "done": True}
+DRAINING_FRAME = {"error": "server draining", "code": CODE_DRAINING,
+                  "done": True}
+
+
+def test_router_routes_around_overloaded_backend():
+    shed = _RejectBackend(OVERLOADED_FRAME)
+    ok = _EchoBackend()
+    st = RouterState(Registry(None), None, {"worker": [shed.addr, ok.addr]})
+    try:
+        addr, resp, _, _ = st.call("worker", {"op": "generate", "prompt": [1]},
+                                   deadline=time.monotonic() + 30)
+        assert addr == ok.addr and resp["tokens"] == [1, 2, 3]
+        assert st.metrics["sheds_routed_around"] == 1
+        assert shed.addr not in st.pool.evicted()   # healthy, just busy
+    finally:
+        shed.stop()
+        ok.stop()
+
+
+def test_router_all_overloaded_returns_structured_shed():
+    a = _RejectBackend(dict(OVERLOADED_FRAME, retry_after_s=5.0))
+    b = _RejectBackend(dict(OVERLOADED_FRAME, retry_after_s=1.5))
+    st = RouterState(Registry(None), None, {"worker": [a.addr, b.addr]})
+    try:
+        with pytest.raises(_Rejected) as ei:
+            st.call("worker", {"op": "generate", "prompt": [1]},
+                    deadline=time.monotonic() + 30)
+        frame = ei.value.frame
+        assert frame["code"] == CODE_OVERLOADED
+        assert frame["retry_after_s"] == 1.5    # the SMALLEST hint wins
+        assert st.metrics["sheds_returned"] == 1
+        assert st.pool.evicted() == []
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_router_draining_backend_not_candidate_not_evicted():
+    dr = _RejectBackend(DRAINING_FRAME, draining_health=True)
+    ok = _EchoBackend()
+    st = RouterState(Registry(None), None, {"worker": [dr.addr, ok.addr]})
+    try:
+        # First call discovers the drain via the structured reply.
+        addr, resp, _, _ = st.call("worker", {"op": "generate", "prompt": [1]},
+                                   deadline=time.monotonic() + 30)
+        assert addr == ok.addr
+        assert st.metrics["draining_routed_around"] == 1
+        assert dr.addr in st.pool.draining()
+        assert dr.addr not in st.pool.evicted()  # routed around, NOT evicted
+        assert st.pool.snapshot()[dr.addr]["draining"] is True
+        # Subsequent candidate ordering keeps the draining backend last.
+        assert st.candidates("worker")[0] == ok.addr
+    finally:
+        dr.stop()
+        ok.stop()
+
+
+def test_prober_clears_draining_when_backend_undrains():
+    be = _EchoBackend()   # healthy: health reply carries no draining flag
+    st = RouterState(Registry(None), None, {"worker": [be.addr]})
+    try:
+        st.pool.set_draining(be.addr, True)
+        st.pool.probe(timeout=2.0)
+        assert be.addr not in st.pool.draining()
+    finally:
+        be.stop()
+
+
+def test_retry_budget_stops_failover_amplification():
+    dead = _dead_addr()
+    ok = _EchoBackend()
+    st = RouterState(Registry(None), None, {"worker": [dead, ok.addr]},
+                     retry_budget=RetryBudget(rate=0.0, burst=0.0))
+    try:
+        # The dead backend is tried first (fresh pool: registry order); the
+        # empty budget refuses the sibling retry — failure surfaces NOW.
+        with pytest.raises(RuntimeError):
+            st.call("worker", {"op": "generate", "prompt": [1]})
+        assert st.metrics["retry_budget_exhausted"] == 1
+        assert st.metrics["retries"] == 0
+        assert len(ok.seen) == 0
+    finally:
+        ok.stop()
+
+
+def test_router_refuses_spent_deadline_without_dispatch():
+    be = _EchoBackend()
+    st = RouterState(Registry(None), None, {"worker": [be.addr]})
+    try:
+        with pytest.raises(_Rejected) as ei:
+            st.call("worker", {"op": "generate", "prompt": [1]},
+                    deadline=time.monotonic() - 0.1)
+        assert ei.value.frame["code"] == CODE_DEADLINE
+        assert st.metrics["deadline_refusals"] == 1
+        assert len(be.seen) == 0                 # never dispatched
+    finally:
+        be.stop()
+
+
+def test_deadline_budget_not_spent_on_doomed_retry():
+    """A backend that eats the whole budget (recv timeout) must not be
+    followed by a sibling attempt: the budget is spent, the client gets
+    deadline_exceeded, and the sibling never sees the request."""
+
+    class _BlackHole(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+        def __init__(self):
+            class H(socketserver.BaseRequestHandler):
+                def handle(self):
+                    recv_msg(self.request)
+                    time.sleep(5.0)         # way past the request budget
+
+            super().__init__(("127.0.0.1", 0), H)
+            self.addr = f"127.0.0.1:{self.server_address[1]}"
+            threading.Thread(target=self.serve_forever, daemon=True).start()
+
+    hole = _BlackHole()
+    sibling = _EchoBackend()
+    st = RouterState(Registry(None), None,
+                     {"worker": [hole.addr, sibling.addr]})
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(_Rejected) as ei:
+            st.call("worker", {"op": "generate", "prompt": [1]},
+                    deadline=time.monotonic() + 0.4)
+        assert ei.value.frame["code"] == CODE_DEADLINE
+        assert time.monotonic() - t0 < 3.0      # budget, not the 120 s cap
+        assert len(sibling.seen) == 0
+    finally:
+        hole.shutdown()
+        hole.server_close()
+        sibling.stop()
+
+
+def test_backend_sees_remaining_budget_not_full_timeout():
+    be = _EchoBackend()
+    st = RouterState(Registry(None), None, {"worker": [be.addr]})
+    try:
+        st.call("worker", {"op": "generate", "prompt": [1]},
+                deadline=time.monotonic() + 7.0)
+        fwd = be.seen[-1]
+        assert 0 < fwd["timeout_s"] <= 7.0
+    finally:
+        be.stop()
+
+
+# ---- router streaming: shed route-around ------------------------------------
+
+
+def _stream_via_router(state, req):
+    router = RouterServer(("127.0.0.1", 0), Handler)
+    router.state = state
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    try:
+        port = router.server_address[1]
+        with socket.create_connection(("127.0.0.1", port), timeout=30) as s:
+            send_msg(s, req)
+            frames = []
+            while True:
+                frame, _, _ = recv_msg(s)
+                assert frame is not None, "router closed mid-stream"
+                frames.append(frame)
+                if frame.get("done") or "error" in frame:
+                    return frames
+    finally:
+        router.shutdown()
+        router.server_close()
+
+
+def test_stream_shed_fails_over_to_sibling():
+    shed = _RejectBackend(OVERLOADED_FRAME)
+    ok = _StreamBackend(n=5)
+    state = RouterState(Registry(None), None,
+                        {"worker": [shed.addr, ok.addr]})
+    try:
+        frames = _stream_via_router(
+            state, {"op": "generate", "prompt": [1], "stream": True})
+        assert all("error" not in f for f in frames), frames
+        tokens = [t for f in frames for t in (f.get("tokens") or [])]
+        assert tokens == list(range(5))
+        assert state.metrics["sheds_routed_around"] == 1
+        assert shed.addr not in state.pool.evicted()
+    finally:
+        shed.stop()
+        ok.stop()
+
+
+def test_stream_all_shed_surfaces_overloaded_frame():
+    a = _RejectBackend(OVERLOADED_FRAME)
+    b = _RejectBackend(dict(OVERLOADED_FRAME, retry_after_s=0.7))
+    state = RouterState(Registry(None), None,
+                        {"worker": [a.addr, b.addr]})
+    try:
+        frames = _stream_via_router(
+            state, {"op": "generate", "prompt": [1], "stream": True})
+        last = frames[-1]
+        assert last["code"] == CODE_OVERLOADED
+        assert last["retry_after_s"] == 0.7
+        assert state.metrics["errors"] == 0     # a shed is NOT an error
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_router_health_snapshot_carries_new_counters():
+    ok = _EchoBackend()
+    state = RouterState(Registry(None), None, {"worker": [ok.addr]})
+    router = RouterServer(("127.0.0.1", 0), Handler)
+    router.state = state
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    try:
+        port = router.server_address[1]
+        h, _, _ = request_once(f"127.0.0.1:{port}", {"op": "health"},
+                               timeout=5)
+        for key in ("sheds_routed_around", "sheds_returned",
+                    "draining_routed_around", "deadline_refusals",
+                    "retry_budget_exhausted"):
+            assert key in h["metrics"], key
+        assert "retry_budget" in h and "tokens" in h["retry_budget"]
+        assert h["draining_backends"] == []
+    finally:
+        router.shutdown()
+        router.server_close()
+        ok.stop()
+
+
+# ---- HTTP edge: status-code mapping -----------------------------------------
+
+
+@pytest.fixture()
+def http_edge():
+    """In-process OpenAI front end wired to a scriptable protocol backend."""
+    import argparse
+
+    from rbg_tpu.engine import http_frontend
+
+    backend = _RejectBackend(OVERLOADED_FRAME)
+    args = argparse.Namespace(port=0, host="127.0.0.1", backend=backend.addr,
+                              model="tiny", tokenizer_path="",
+                              default_max_tokens=16)
+    server = http_frontend.serve(args)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        yield backend, server.server_address[1]
+    finally:
+        server.shutdown()
+        server.server_close()
+        backend.stop()
+
+
+def _http_post(port, path, body):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method="POST",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_http_maps_overloaded_to_429_with_retry_after(http_edge):
+    backend, port = http_edge
+    status, headers, body = _http_post(port, "/v1/completions",
+                                       {"prompt": "hi", "max_tokens": 4})
+    assert status == 429
+    assert headers.get("Retry-After") == "2"    # ceil(2.0)
+    assert body["error"]["type"] == "overloaded"
+
+
+def test_http_maps_draining_to_503(http_edge):
+    backend, port = http_edge
+    backend.frame = dict(DRAINING_FRAME)
+    status, headers, body = _http_post(port, "/v1/chat/completions",
+                                       {"messages": [{"role": "user",
+                                                      "content": "hi"}]})
+    assert status == 503
+    assert body["error"]["type"] == "unavailable"
+
+
+def test_http_maps_deadline_to_504(http_edge):
+    backend, port = http_edge
+    backend.frame = {"error": "deadline spent", "code": CODE_DEADLINE,
+                     "done": True}
+    status, _, body = _http_post(port, "/v1/completions",
+                                 {"prompt": "hi", "max_tokens": 4})
+    assert status == 504
+    assert body["error"]["type"] == "timeout"
+
+
+def test_http_stream_shed_is_http_status_not_sse(http_edge):
+    """An admission shed on a STREAMING request must be a real 429 —
+    retry middleware can't see codes buried in a 200 event stream."""
+    backend, port = http_edge
+    status, headers, body = _http_post(
+        port, "/v1/completions",
+        {"prompt": "hi", "max_tokens": 4, "stream": True})
+    assert status == 429
+    assert headers.get("Retry-After") == "2"
+
+
+def test_http_forwards_timeout_budget(http_edge):
+    backend, port = http_edge
+    _http_post(port, "/v1/completions",
+               {"prompt": "hi", "max_tokens": 4, "timeout_s": 7.5})
+    assert backend.seen[-1]["timeout_s"] == 7.5
+
+
+def test_http_rejects_bad_timeout(http_edge):
+    backend, port = http_edge
+    status, _, body = _http_post(port, "/v1/completions",
+                                 {"prompt": "hi", "timeout_s": -3})
+    assert status == 400
+
+
+# ---- e2e: SIGTERM drain + client-disconnect cancellation --------------------
+
+
+ENGINE_ARGS = ["--model", "tiny", "--page-size", "8", "--num-pages", "128",
+               "--max-seq-len", "512", "--prefill-chunk", "16",
+               "--use-pallas", "never"]
+
+
+@pytest.mark.e2e
+def test_sigterm_drains_stream_then_exits_cleanly():
+    """The rollout drill: SIGTERM lands mid-stream. The in-flight stream
+    completes, health reports draining, NEW ops are refused with the
+    structured code, and the process exits 0 before the drain deadline."""
+    from conftest import SpawnedEngineServer
+
+    srv = SpawnedEngineServer(*ENGINE_ARGS, "--max-queue", "8",
+                              "--drain-deadline-s", "60")
+    with srv:
+        # The first stream pays the jit compiles — a wide window in which
+        # the SIGTERM lands while the request is genuinely in flight.
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=120)
+        try:
+            send_msg(s, {"op": "generate", "prompt": [7, 3, 5, 11],
+                         "stream": True, "max_new_tokens": 160})
+            first, _, _ = recv_msg(s)
+            assert first is not None and "error" not in first, first
+
+            srv.proc.send_signal(signal.SIGTERM)
+            _wait_for(lambda: request_once(
+                srv.addr, {"op": "health"}, timeout=5)[0].get("draining"),
+                timeout=10)
+            h, _, _ = request_once(srv.addr, {"op": "health"}, timeout=5)
+            assert h["ok"] and h["draining"] and "draining_for_s" in h
+
+            # New work is refused with the structured draining code...
+            r, _, _ = request_once(srv.addr, {"op": "generate",
+                                              "prompt": [1, 2],
+                                              "max_new_tokens": 4},
+                                   timeout=10)
+            assert r["code"] == CODE_DRAINING, r
+
+            # ...while the in-flight stream runs to completion, no error.
+            tokens = list(first.get("tokens") or [])
+            while True:
+                frame, _, _ = recv_msg(s)
+                assert frame is not None, "stream cut during drain"
+                assert "error" not in frame, frame
+                tokens.extend(frame.get("tokens") or [])
+                if frame.get("done"):
+                    break
+            assert len(tokens) == 160
+        finally:
+            s.close()
+        assert srv.proc.wait(timeout=60) == 0   # clean exit, not a kill
+    # metrics/gauges flipped (same-process REGISTRY is per-process; the
+    # drain counter lives in the subprocess — rc 0 above is the evidence).
+
+
+@pytest.mark.e2e
+def test_client_disconnect_cancels_backend_decode_leg():
+    """Satellite: the router's _ClientGone path must CANCEL the backend
+    decode leg, not merely stop relaying — verified by the decode
+    replica's slot (running==0) and KV pages returning to baseline."""
+    from rbg_tpu.utils import scrubbed_cpu_env
+
+    def free_port():
+        with socket.socket() as so:
+            so.bind(("127.0.0.1", 0))
+            return so.getsockname()[1]
+
+    env = scrubbed_cpu_env()
+    pf, dc, rp = free_port(), free_port(), free_port()
+    procs = []
+    try:
+        for mode, port in (("prefill", pf), ("decode", dc)):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "rbg_tpu.engine.server",
+                 "--mode", mode, "--port", str(port)] + ENGINE_ARGS,
+                env=env))
+        backends = {"prefill": [f"127.0.0.1:{pf}"],
+                    "decode": [f"127.0.0.1:{dc}"]}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "rbg_tpu.engine.router",
+             "--port", str(rp), "--backends", json.dumps(backends)],
+            env=env))
+
+        def ready(port):
+            try:
+                h, _, _ = request_once(f"127.0.0.1:{port}",
+                                       {"op": "health"}, timeout=5)
+                return bool(h and h.get("ok"))
+            except OSError:
+                return False
+        for port in (pf, dc, rp):
+            _wait_for(lambda p=port: ready(p), timeout=240)
+
+        base, _, _ = request_once(f"127.0.0.1:{dc}", {"op": "metrics"},
+                                  timeout=10)
+        free_before = base["metrics"]["free_pages"]
+
+        s = socket.create_connection(("127.0.0.1", rp), timeout=120)
+        send_msg(s, {"op": "generate", "prompt": [7, 3, 5, 11] * 4,
+                     "stream": True, "max_new_tokens": 400})
+        got = 0
+        while got < 2:   # decode leg is live and relaying
+            frame, _, _ = recv_msg(s)
+            assert frame is not None and "error" not in frame, frame
+            got += len(frame.get("tokens") or [])
+        # Vanish abruptly (RST — the SSE-edge crash shape).
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     __import__("struct").pack("ii", 1, 0))
+        s.close()
+
+        def recycled():
+            m, _, _ = request_once(f"127.0.0.1:{dc}", {"op": "metrics"},
+                                   timeout=10)
+            return (m["metrics"]["running"] == 0
+                    and m["metrics"]["free_pages"] == free_before)
+        _wait_for(recycled, timeout=30)
+
+        # The vanished client charged NOTHING to the healthy backend.
+        h, _, _ = request_once(f"127.0.0.1:{rp}", {"op": "health"},
+                               timeout=5)
+        assert h["metrics"]["errors"] == 0
+        assert h["backends"][f"127.0.0.1:{dc}"]["fails"] == 0
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()

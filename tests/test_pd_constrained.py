@@ -115,6 +115,7 @@ def test_pd_constrained_decode_uses_fused_tables():
     assert pair.decode.engine.metrics["spec_steps"] == 0
 
 
+@pytest.mark.slow
 @pytest.mark.e2e
 def test_pd_constrained_through_router():
     """guided json_mode / regex / json_schema through a REAL router over

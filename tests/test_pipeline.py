@@ -46,6 +46,7 @@ def test_pipeline_with_padding_mask():
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_differentiates():
     cfg = get_config("tiny")
     params = init_params(cfg, jax.random.key(0))

@@ -159,6 +159,7 @@ def test_regex_composes_with_speculative(eng_factory):
     assert re.fullmatch(pattern, tok.decode(out))
 
 
+@pytest.mark.slow
 def test_regex_mixed_batch_leaves_unconstrained_rows_alone(eng_factory):
     """A regex row and a plain greedy row decode together; the greedy
     row's output is identical to a solo run (constrained rows must not

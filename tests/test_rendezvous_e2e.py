@@ -12,6 +12,11 @@ from rbg_tpu.api.pod import Container, Node, PodTemplate
 from rbg_tpu.runtime.plane import ControlPlane
 from rbg_tpu.testutil import make_group
 
+# Forms a REAL two-process jax.distributed job (~2 min when it works, a
+# 120 s wait_for when the Gloo rendezvous wedges, as it does on this
+# image) — tier-2 material; the tier-1 budget (870 s) can't afford it.
+pytestmark = pytest.mark.slow
+
 
 @pytest.mark.e2e
 def test_injected_contract_forms_real_jax_job(tmp_path):

@@ -115,6 +115,7 @@ def _engine(**kw):
     return Engine(cfg)
 
 
+@pytest.mark.slow
 def test_engine_seed_reproducible_across_instances():
     sp = SamplingParams(max_new_tokens=8, temperature=1.0, top_p=0.9, seed=42)
     a = _engine().generate([[1, 2, 3, 4]], sp)[0]
@@ -122,6 +123,7 @@ def test_engine_seed_reproducible_across_instances():
     assert a == b
 
 
+@pytest.mark.slow
 def test_engine_presence_penalty_forces_distinct_tokens():
     # Greedy + overwhelming presence penalty → no output token repeats
     # (the in-scan count update must apply within a multi-step window too).
@@ -207,6 +209,7 @@ def test_greedy_unchanged_by_sampling_machinery():
 # ---- over the wire (unified engine server subprocess) ----
 
 
+@pytest.mark.slow
 @pytest.mark.e2e
 def test_server_seed_and_logprobs_over_wire():
     from conftest import SpawnedEngineServer
@@ -233,6 +236,7 @@ def test_server_seed_and_logprobs_over_wire():
         assert h["ok"]
 
 
+@pytest.mark.slow
 @pytest.mark.e2e
 def test_server_cancels_generation_on_client_disconnect():
     """A streaming client that goes away mid-generation must not leave the
@@ -266,6 +270,7 @@ def test_server_cancels_generation_on_client_disconnect():
         assert st["decode_tokens"] < 8000, st
 
 
+@pytest.mark.slow
 def test_extreme_seed_values_do_not_crash():
     # Wire seeds are arbitrary ints; uint32 masking must keep the engine
     # loop alive (NumPy 2.x raises OverflowError on bad conversions).
@@ -288,6 +293,7 @@ def test_out_of_vocab_prompt_rejected_at_admission():
     assert len(eng.generate([[1, 2]], SamplingParams(max_new_tokens=2))[0]) == 2
 
 
+@pytest.mark.slow
 def test_seeded_output_invariant_under_preemption():
     """Preemption folds output into prompt for re-prefill; penalty counts
     and position-keyed sampling must survive so a seeded request yields

@@ -1,6 +1,8 @@
 """Multi-device sharding: tp/dp/sp-sharded forward equals single-device, and a
 sharded train step runs and reduces loss (8 virtual CPU devices)."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -55,6 +57,7 @@ def test_train_step_reduces_loss(mesh8):
     assert float(loss) < loss0
 
 
+@pytest.mark.slow
 def test_remat_grads_match(mesh8):
     """jax.checkpoint rematerialization changes memory, not math."""
     cfg = get_config("tiny")

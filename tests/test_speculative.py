@@ -67,6 +67,7 @@ def test_spec_greedy_bit_identical():
     assert eng.metrics["spec_accepted"] <= eng.metrics["spec_drafted"]
 
 
+@pytest.mark.slow
 def test_spec_sampled_bit_identical():
     sp = SamplingParams(max_new_tokens=24, temperature=1.0, top_p=0.9, seed=3)
     a = _mk().generate([REP_PROMPT], sp)[0]
@@ -81,6 +82,7 @@ def test_spec_batch_bit_identical():
         _mk(speculative="ngram").generate(prompts, sp)
 
 
+@pytest.mark.slow
 def test_spec_stop_token_respected():
     # Find the greedy continuation, then stop on its 3rd token — spec and
     # plain paths must cut at the same place.
@@ -93,6 +95,7 @@ def test_spec_stop_token_respected():
     assert plain[-1] == stop or len(plain) == 10
 
 
+@pytest.mark.slow
 def test_spec_penalties_never_draft_but_match_sequential():
     # Penalized rows need sequential count updates, so they never draft —
     # they ride the host-synced step one token at a time with fresh
@@ -120,6 +123,7 @@ def test_spec_logprobs_emitted():
     assert all(lp is not None and lp <= 0 for lp in lps)
 
 
+@pytest.mark.slow
 def test_spec_preemption_equivalence():
     # Tight page pool forces preemption mid-spec; output must still match
     # the sequential result from an unconstrained engine.

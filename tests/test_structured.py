@@ -127,6 +127,7 @@ def test_json_mode_greedy_also_constrained():
         assert s is not None, text
 
 
+@pytest.mark.slow
 def test_json_mode_composes_with_speculative():
     sp = SamplingParams(max_new_tokens=60, temperature=0.0, json_mode=True,
                         stop_token=_TOK.eos_id)
@@ -189,6 +190,7 @@ def test_json_mode_without_grammar_table_fails_request():
                                                json_mode=True))
 
 
+@pytest.mark.slow
 @pytest.mark.e2e
 def test_json_mode_over_wire():
     """generate_text with json_mode through a real server subprocess —
@@ -214,6 +216,7 @@ def test_json_mode_over_wire():
             assert s is not None, text
 
 
+@pytest.mark.slow
 def test_json_row_does_not_evict_fused_rows_from_their_path():
     """Mixed traffic: a grammar row decodes host-synced while plain rows
     keep the fused path — a greedy plain row's output must be identical

@@ -62,6 +62,30 @@ ANN_DRAIN_COMPLETE = f"{DOMAIN}/drain-complete"      # "true" from drain agent
 LIFECYCLE_PREPARING_DELETE = "PreparingDelete"
 ANN_DISCOVERY_CONFIG_MODE = f"{DOMAIN}/discovery-config-mode"  # legacy|refine
 
+# ---- slice disruption lifecycle (GKE TPU failure domains) ----
+# On a RoleInstance, the advance-notice migration state machine driven by
+# the disruption controller: "" -> Warming -> CutOver -> (cleared).
+ANN_MIGRATION_STATE = f"{DOMAIN}/migration-state"
+ANN_MIGRATION_TARGET = f"{DOMAIN}/migration-target"    # target slice id
+ANN_MIGRATION_FROM = f"{DOMAIN}/migration-from"        # source slice id
+ANN_MIGRATION_DEADLINE = f"{DOMAIN}/migration-deadline"  # unix seconds
+MIGRATION_WARMING = "Warming"
+MIGRATION_CUTOVER = "CutOver"
+# On a Node, stamped by the disruption controller once no active pod
+# remains on a maintenance-pending slice: the slice is handed back to the
+# infrastructure before its deadline (value = unix seconds of release).
+ANN_MAINT_RELEASED = f"{DOMAIN}/maintenance-released"
+# Marks a cordon the disruption controller itself placed ("disruption") —
+# only those may be auto-lifted or kept sticky across node resyncs;
+# operator cordons are never touched.
+ANN_CORDONED_BY = f"{DOMAIN}/cordoned-by"
+# Node disruption kinds (Node.disruption field / K8s node conditions).
+DISRUPT_MAINTENANCE = "maintenance"   # advance notice, deadline attached
+DISRUPT_PREEMPTED = "preempted"       # no-notice spot preemption
+# Pod failure reasons the gang-recovery path recognizes.
+REASON_PREEMPTED = "Preempted"        # host vanished under the pod
+REASON_GANG_PREEMPTED = "GangPreempted"  # survivor killed by gang semantics
+
 # ---- env vars injected into engine processes (reference: env.go:24-79) ----
 ENV_GROUP_NAME = "RBG_GROUP_NAME"
 ENV_ROLE_NAME = "RBG_ROLE_NAME"
@@ -84,6 +108,10 @@ ENV_TPU_MESH_COORDS = "RBG_TPU_MESH_COORDS"         # host coords in slice, "x,y
 ENV_MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"  # multi-slice DCN
 ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
 ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+# Bumped on every gang restart cycle: a replacement gang must never join a
+# stale coordinator incarnation mid-collective (the JAX coordinator treats a
+# changed epoch as a fresh rendezvous namespace).
+ENV_JAX_RESTART_EPOCH = "RBG_JAX_RESTART_EPOCH"
 
 # ---- defaults ----
 DISCOVERY_MOUNT_PATH = "/etc/rbg"

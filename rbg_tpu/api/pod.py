@@ -175,8 +175,21 @@ class Node:
     capacity_pods: int = 64
     ready: bool = True
     address: str = "127.0.0.1"
+    # Disruption lifecycle (GKE analog: maintenance events + spot
+    # preemption hit ALL hosts of a slice together — same ICI domain):
+    # ``unschedulable`` is the cordon bit (kubectl cordon / spec.
+    # unschedulable); ``disruption`` is "" | maintenance | preempted;
+    # ``disruption_deadline`` (unix seconds) is the advance-notice window
+    # end for maintenance — by then the slice must be released.
+    unschedulable: bool = False
+    disruption: str = ""
+    disruption_deadline: float = 0.0
 
     __serde_keep__ = ("kind", "metadata")
+
+    @property
+    def schedulable(self) -> bool:
+        return self.ready and not self.unschedulable and not self.disruption
 
 
 @dataclasses.dataclass

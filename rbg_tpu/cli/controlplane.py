@@ -54,6 +54,11 @@ def register(sub) -> None:
                     help="serve the admin API over TLS: bootstrap/reuse a "
                          "self-signed CA + server cert in this directory "
                          "(clients pass --tls-ca <dir>/ca.crt)")
+    sp.add_argument("--warm-spares", type=int, default=0,
+                    help="reserve N standby slices per topology as warm "
+                         "spares: slice-preemption/maintenance recovery "
+                         "re-binds onto them instantly instead of waiting "
+                         "for re-provisioning (0 = off)")
     sp.set_defaults(func=cmd_serve)
 
     stp = sub.add_parser("status", help="group status (against a serve plane)")
@@ -218,7 +223,8 @@ def cmd_serve(args) -> int:
                 with open(default_path) as f:
                     token = f.read().strip()
         k8s_client = KubeClient(args.kube_api, token=token)
-    plane = ControlPlane(backend=args.backend, k8s_client=k8s_client)
+    plane = ControlPlane(backend=args.backend, k8s_client=k8s_client,
+                         warm_spares=max(0, args.warm_spares))
     restored = 0
     if args.state_file and _os.path.exists(args.state_file):
         with open(args.state_file) as f:

@@ -59,6 +59,11 @@ def build_env(inst, pod_name: str, component: str, process_id: int,
             EnvVar(C.ENV_JAX_COORDINATOR, leader_address(inst)),
             EnvVar(C.ENV_JAX_NUM_PROCESSES, str(gang_size)),
             EnvVar(C.ENV_JAX_PROCESS_ID, str(process_id)),
+            # Fresh coordinator incarnation per gang-restart cycle: a
+            # replacement gang recovering from a slice preemption must
+            # rendezvous in a NEW namespace, never join the stale
+            # collective of the incarnation it replaces.
+            EnvVar(C.ENV_JAX_RESTART_EPOCH, str(inst.status.restart_count)),
         ]
     if it.tpu is not None:
         env += [

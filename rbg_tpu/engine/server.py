@@ -163,11 +163,19 @@ class Handler(socketserver.BaseRequestHandler):
             # Drain contract: in-flight work finishes, NEW work is refused
             # with a structured code the router treats as
             # route-around-without-evicting. "done" terminates stream
-            # clients that won't look past the first frame.
+            # clients that won't look past the first frame. The
+            # retry_after_s hint is the remaining drain budget (capped):
+            # by then either the replacement serves or this address is
+            # gone — under a ROLLING drain the router surfaces the fleet's
+            # smallest hint to the client.
             REGISTRY.inc("rbg_serving_drain_refusals_total")
+            budget = getattr(srv, "drain_deadline_s", 30.0)
+            remaining = max(0.0, budget - (time.monotonic()
+                                           - srv.drain_started))
             send_msg(self.request, {
                 "error": "server is draining (SIGTERM received)",
-                "code": CODE_DRAINING, "done": True})
+                "code": CODE_DRAINING, "done": True,
+                "retry_after_s": round(min(5.0, max(0.5, remaining)), 3)})
             return
         if op in self._DATA_OPS:
             srv.note_inflight(+1)
@@ -481,6 +489,7 @@ def serve(args) -> None:
         args.drain_deadline_s
         if args.drain_deadline_s is not None
         else os.environ.get("RBG_DRAIN_DEADLINE_S", "30"))
+    server.drain_deadline_s = drain_deadline_s
     # SIGTERM = the rollout/scale-down signal (what the executor and k8s
     # send): graceful drain instead of dropping in-flight streams on the
     # floor. serve() runs on the main thread, where signal() is legal.

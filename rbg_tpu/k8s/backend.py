@@ -83,6 +83,15 @@ class K8sPodBackend:
                              daemon=True)
         t.start()
         self._threads.append(t)
+        if self.sync_nodes:
+            # Node disruption lifecycle (maintenance conditions, cordons,
+            # preemption NotReady) must reach the plane CONTINUOUSLY, not
+            # just at startup — the disruption controller's deadlines are
+            # wall-clock.
+            t = threading.Thread(target=self._node_loop, name="k8s-nodes",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
 
     def stop(self):
         self._stop.set()
@@ -356,10 +365,26 @@ class K8sPodBackend:
 
     # ---- node inventory ----
 
+    NODE_RESYNC_S = 2.0
+
+    def _node_loop(self):
+        while not self._stop.is_set():
+            self._stop.wait(self.NODE_RESYNC_S)
+            if self._stop.is_set():
+                return
+            try:
+                self._sync_nodes()
+            except Exception:
+                log.warning("k8s node resync failed", exc_info=True)
+
     def _sync_nodes(self):
         """Import the cluster's TPU nodes as plane Nodes (idempotent): the
         scheduler then gangs slices onto real capacity. Non-TPU nodes are
-        imported too (router/CPU roles need somewhere to run)."""
+        imported too (router/CPU roles need somewhere to run). Re-run
+        periodically so node-level disruption state (maintenance
+        conditions, preemption NotReady, cordons) keeps flowing; no-op
+        when nothing changed so steady state emits no events."""
+        from rbg_tpu.api import serde
         try:
             knodes = self.client.list_nodes()
         except ApiError as e:
@@ -373,8 +398,19 @@ class K8sPodBackend:
             if cur is None:
                 self.store.create(node)
             else:
-                node.metadata.resource_version = cur.metadata.resource_version
-                node.metadata.uid = cur.metadata.uid
+                node.metadata = cur.metadata
+                # The plane owns cordons it placed ITSELF (disruption
+                # controller, marked by the cordoned-by annotation) — a
+                # resync must not clear those just because the cluster
+                # hasn't mirrored the bit. Every other cordon state is the
+                # cluster's to set AND clear: without the marker check, an
+                # operator's kubectl cordon/uncordon cycle would leave the
+                # plane-side bit stuck True forever.
+                if (cur.unschedulable and cur.metadata.annotations.get(
+                        C.ANN_CORDONED_BY) == "disruption"):
+                    node.unschedulable = True
+                if serde.to_dict(node) == serde.to_dict(cur):
+                    continue
                 try:
                     self.store.update(node)
                 except StoreConflict:

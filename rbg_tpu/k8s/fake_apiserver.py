@@ -480,6 +480,76 @@ class FakeK8sApiServer:
             node["metadata"]["resourceVersion"] = self.state.bump()
             self.state.nodes[name] = node
 
+    # ---- node disruption lifecycle (GKE maintenance / spot preemption) ----
+
+    def _slice_nodes(self, slice_id: str) -> List[str]:
+        with self.state.lock:
+            return [name for name, n in self.state.nodes.items()
+                    if (n["metadata"].get("labels", {})
+                        .get(T.LABEL_GKE_NODEPOOL) == slice_id)]
+
+    def _set_node_condition(self, name: str, cond_type: str, status: str,
+                            annotations: Optional[Dict[str, str]] = None):
+        with self.state.lock:
+            node = self.state.nodes.get(name)
+            if node is None:
+                return
+            conds = node.setdefault("status", {}).setdefault("conditions", [])
+            for c in conds:
+                if c.get("type") == cond_type:
+                    c["status"] = status
+                    break
+            else:
+                conds.append({"type": cond_type, "status": status})
+            if annotations:
+                node["metadata"].setdefault("annotations", {}).update(
+                    annotations)
+            node["metadata"]["resourceVersion"] = self.state.bump()
+
+    def set_maintenance(self, slice_id: str, deadline_s: float,
+                        now: Optional[float] = None) -> List[str]:
+        """Advance-notice maintenance against EVERY host of a slice (node
+        pool): a MaintenancePending condition + deadline annotation. The
+        control plane's node sync turns this into the disruption
+        controller's migrate-before-deadline path."""
+        now = time.time() if now is None else now
+        names = self._slice_nodes(slice_id)
+        for name in names:
+            self._set_node_condition(
+                name, T.COND_MAINTENANCE, "True",
+                {T.ANN_MAINT_DEADLINE: f"{now + deadline_s:.3f}"})
+        return names
+
+    def preempt_slice(self, slice_id: str,
+                      hosts: Optional[List[str]] = None) -> List[str]:
+        """No-notice spot preemption: the hosts (default: the whole node
+        pool — one ICI domain always goes together) flip NotReady +
+        Preempted and every pod bound to them fails with reason Preempted
+        + a DisruptionTarget condition (what GKE leaves behind)."""
+        names = self._slice_nodes(slice_id)
+        if hosts is not None:
+            names = [n for n in names if n in hosts]
+        for name in names:
+            self._set_node_condition(name, T.COND_PREEMPTED, "True")
+            self._set_node_condition(name, "Ready", "False")
+        with self.state.lock:
+            for key, pod in list(self.state.pods.items()):
+                if pod.get("spec", {}).get("nodeName") not in names:
+                    continue
+                st = pod.setdefault("status", {})
+                if st.get("phase") in ("Failed", "Succeeded"):
+                    continue
+                st["phase"] = "Failed"
+                st["reason"] = "Preempted"
+                st.setdefault("conditions", []).append(
+                    {"type": "DisruptionTarget", "status": "True",
+                     "reason": "Preempted"})
+                for c in st.get("containerStatuses", []):
+                    c["state"] = {"terminated": {"exitCode": 137}}
+                pod["metadata"]["resourceVersion"] = self.state.bump()
+                self.state.record("MODIFIED", pod)
+        return names
+
     def _agent_loop(self):
         while not self._stop.is_set():
             self._agent_wake.wait(timeout=0.2)
@@ -504,15 +574,27 @@ class FakeK8sApiServer:
         meta = pod.get("metadata", {})
         st = pod.setdefault("status", {"phase": "Pending"})
         # Bind: resolve the hostname selector (plane pins placement).
+        def node_ready(n: dict) -> bool:
+            conds = {c.get("type"): c.get("status")
+                     for c in n.get("status", {}).get("conditions", [])}
+            return conds.get("Ready", "True") == "True"
+
         if not spec.get("nodeName"):
             host = (spec.get("nodeSelector") or {}).get(T.LABEL_HOSTNAME)
             if host and host in self.state.nodes:
                 spec["nodeName"] = host
-            elif self.state.nodes:
-                spec["nodeName"] = sorted(self.state.nodes)[0]
             else:
-                return False
+                live = sorted(n for n, nd in self.state.nodes.items()
+                              if node_ready(nd))
+                if not live:
+                    return False
+                spec["nodeName"] = live[0]
         node = self.state.nodes.get(spec["nodeName"])
+        # A NotReady host has no kubelet: pods bound there make NO
+        # progress (a preempted node can never run its pods — without
+        # this, a replacement gang could 'start' on vanished hardware).
+        if node is not None and not node_ready(node):
+            return False
         if st.get("phase") == "Pending":
             if self.fail_filter is not None and self.fail_filter(pod):
                 st["phase"] = "Failed"

@@ -35,6 +35,11 @@ LABEL_MANAGED_BY = f"{C.DOMAIN}/managed-by"
 MANAGED_BY = "rbg-tpu"
 ANN_PLANE_UID = f"{C.DOMAIN}/plane-uid"
 LABEL_WORKER_INDEX = f"{C.DOMAIN}/tpu-worker-index"
+# Node disruption lifecycle on the K8s wire (GKE surfaces maintenance via
+# node conditions; spot preemption as an out-of-band NotReady/terminated).
+COND_MAINTENANCE = "MaintenancePending"
+COND_PREEMPTED = "Preempted"
+ANN_MAINT_DEADLINE = f"{C.DOMAIN}/maintenance-deadline"  # unix seconds
 
 
 def _container_to_k8s(c) -> dict:
@@ -204,6 +209,21 @@ def node_from_k8s(knode: dict) -> Node:
     node.ready = conds.get("Ready", "True") == "True"
     node.address = addr
     node.capacity_pods = int(capacity.get("pods", 64))
+    # Disruption lifecycle (GKE maintenance events / spot preemption):
+    # spec.unschedulable is the cordon bit; a Preempted or
+    # MaintenancePending condition maps to the plane's disruption field,
+    # with the advance-notice deadline carried as a node annotation.
+    node.unschedulable = bool(knode.get("spec", {}).get("unschedulable"))
+    annotations = meta.get("annotations", {}) or {}
+    if conds.get(COND_PREEMPTED) == "True":
+        node.disruption = C.DISRUPT_PREEMPTED
+    elif conds.get(COND_MAINTENANCE) == "True":
+        node.disruption = C.DISRUPT_MAINTENANCE
+        try:
+            node.disruption_deadline = float(
+                annotations.get(ANN_MAINT_DEADLINE, 0.0))
+        except (TypeError, ValueError):
+            node.disruption_deadline = 0.0
     node.tpu = TpuNodeInfo(
         accelerator=labels.get(LABEL_GKE_TPU_ACCEL, ""),
         slice_id=labels.get(LABEL_GKE_NODEPOOL, ""),

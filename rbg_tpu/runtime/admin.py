@@ -70,7 +70,17 @@ class _Handler(socketserver.BaseRequestHandler):
         op = obj.get("op")
         ns = obj.get("namespace", "default")
         if op == "health":
-            return {"ok": True}
+            # Disruption posture rides on the health snapshot so operators
+            # see preemption/migration activity and spare-pool depth
+            # without a metrics scrape.
+            from rbg_tpu.runtime.controllers.disruption import (
+                disruption_snapshot,
+            )
+            resp = {"ok": True, "disruption": disruption_snapshot()}
+            spares = getattr(self.server.plane, "spares", None)
+            if spares is not None:
+                resp["spare_pool"] = spares.depth()
+            return resp
         if op == "list":
             kind = obj["kind"]
             if kind not in KINDS:

@@ -101,7 +101,11 @@ class Controller:
         self.store = store
         from rbg_tpu.native import make_workqueue
         self.queue = make_workqueue()
-        self.backoff = ExponentialBackoff(base=0.01, max_delay=5.0)
+        # Decorrelated jitter: a slice-wide failure fails every member of
+        # the gang at once — synchronized exponential retries would storm
+        # the store in waves.
+        self.backoff = ExponentialBackoff(base=0.01, max_delay=5.0,
+                                          jitter=True)
         self._threads: List[threading.Thread] = []
         self._started = False
         self._stopping = False
@@ -112,6 +116,14 @@ class Controller:
 
     def reconcile(self, store: Store, key: ReconcileKey) -> Optional[Result]:
         raise NotImplementedError
+
+    def seed_backoff(self, store: Store) -> None:
+        """Pre-charge per-key retry damping from state observed in the
+        store (called once at start, before workers). Default: nothing.
+        A plane resuming over an existing store otherwise restarts every
+        key's crash-loop damping from zero — a crash-looping workload
+        that drove its backoff to the cap gets a fresh burst of full-rate
+        retries after every controller restart."""
 
     # -- wiring --
     def _on_event(self, watch: Watch, ev: Event):
@@ -132,6 +144,11 @@ class Controller:
         # Initial sync (the informer LIST): a restarted plane must reconcile
         # every pre-existing object, or changes made while no controllers ran
         # are never observed (level-triggered ≠ event-sourced).
+        try:
+            self.seed_backoff(self.store)
+        except Exception:
+            log.warning("%s: seed_backoff failed (starting cold)",
+                        self.name, exc_info=True)
         self._enqueue_all()
         for i in range(self.workers):
             t = threading.Thread(

@@ -1,0 +1,951 @@
+"""Slice disruption controller — preemption-aware self-healing.
+
+The dominant real-world failure on GKE TPU pod slices is not a lone pod
+crash but a whole multi-host slice vanishing (spot preemption) or being
+evicted with advance notice (maintenance events) — the hosts of one ICI
+domain always go together. Mooncake's disruption-tolerant serving and
+"Taming the Chaos" (PAPERS.md) both argue recovery must be planned at the
+group level, not pod-by-pod. This controller owns that plan:
+
+* **Advance notice** (``Node.disruption == maintenance`` + deadline): the
+  slice is cordoned, a replacement slice is granted from the warm-spare
+  pool (``sched.capacity.SparePool``; bind-time recovery) or chosen from
+  healthy capacity, a Warmup job primes the replacement hosts (weight
+  prefetch / XLA cache — SURVEY #9), and only then are the old serving
+  pods drained (PreparingDelete annotation + graceful delete → the
+  executor's SIGTERM path, so the router routes around and in-flight
+  streams finish or replay onto the replacement). Once the slice holds no
+  pods it is stamped released — before the deadline.
+
+* **No notice** (``Node.disruption == preempted``): gang semantics. A
+  slice replica that lost ANY host is dead as a unit — survivors would
+  wedge in collective ops waiting on vanished peers — so every remaining
+  pod of the instance is failed (``GangPreempted``) and the existing
+  restart/backoff machinery recovers the gang whole, steered onto a warm
+  spare when one is reserved, with a fresh JAX-coordinator epoch injected
+  into the replacement (env_builder's RBG_JAX_RESTART_EPOCH).
+
+Everything is level-triggered off Node/Pod state in the store; the
+migration state machine persists in RoleInstance annotations
+(``ANN_MIGRATION_STATE``: Warming → CutOver) so a plane restart resumes
+mid-migration.
+
+Fault injection for tests and ``rbg-tpu stress --scenario preemption``
+lives here too (``notify_maintenance`` / ``preempt_slice``); the
+HTTP-level analog for the k8s backend is on ``FakeK8sApiServer``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.meta import Condition
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.runtime.controller import Controller, Result, Watch
+from rbg_tpu.runtime.store import Conflict, NotFound, Store
+
+# Internal ack markers (idempotent metric counting across reconciles).
+_ANN_NOTICE_ACKED = f"{C.DOMAIN}/disruption-notice-acked"
+_ANN_PREEMPT_ACKED = f"{C.DOMAIN}/disruption-preempt-acked"
+_ANN_GANGKILL_ACKED = f"{C.DOMAIN}/disruption-gang-kill-acked"
+_ANN_CORDONED_BY = C.ANN_CORDONED_BY
+
+# Leave at least this long before the deadline for the drain+rebind leg:
+# warmup that hasn't finished by then is abandoned (it is an optimization;
+# missing the maintenance deadline is an SLO breach).
+CUTOVER_RESERVE_FRACTION = 0.4
+
+DISRUPTION_COUNTERS = (
+    "rbg_disruption_notices_total",
+    "rbg_disruption_preemptions_total",
+    "rbg_disruption_gang_kills_total",
+    "rbg_disruption_migrations_completed_total",
+    "rbg_disruption_migrations_missed_deadline_total",
+    "rbg_disruption_slices_released_total",
+    "rbg_disruption_spares_consumed_total",
+)
+
+
+def disruption_snapshot() -> Dict[str, float]:
+    """Counter snapshot for health endpoints / reports."""
+    out = {name: REGISTRY.counter(name) for name in DISRUPTION_COUNTERS}
+    return out
+
+
+# ---- fault injection (tests + stress harness) ------------------------------
+
+
+def notify_maintenance(store: Store, slice_id: str, deadline_s: float,
+                       now: Optional[float] = None) -> int:
+    """Advance-notice maintenance event against every host of a slice
+    (same ICI failure domain): sets ``disruption=maintenance`` with an
+    absolute deadline. Returns the number of nodes marked."""
+    now = time.time() if now is None else now
+    deadline = now + deadline_s
+    n = 0
+    for node in store.list("Node", copy_=False):
+        if node.tpu.slice_id != slice_id:
+            continue
+
+        def fn(nd):
+            nd.disruption = C.DISRUPT_MAINTENANCE
+            nd.disruption_deadline = deadline
+            return True
+
+        try:
+            store.mutate("Node", node.metadata.namespace,
+                         node.metadata.name, fn)
+            n += 1
+        except (NotFound, Conflict):
+            pass
+    return n
+
+
+def preempt_slice(store: Store, slice_id: str,
+                  hosts: Optional[List[str]] = None) -> int:
+    """No-notice spot preemption: the named hosts (default: ALL hosts of
+    the slice) go NotReady+preempted and every pod bound to them fails
+    with reason Preempted + a DisruptionTarget condition (the corev1
+    shape ``Pod.evicted`` recognizes). Passing a subset of hosts models
+    the partial-loss window the gang enforcer must close. Returns the
+    number of nodes preempted."""
+    targets = []
+    for node in store.list("Node", copy_=False):
+        if node.tpu.slice_id != slice_id:
+            continue
+        if hosts is not None and node.metadata.name not in hosts:
+            continue
+        targets.append((node.metadata.namespace, node.metadata.name))
+    for ns, name in targets:
+        def fn(nd):
+            nd.disruption = C.DISRUPT_PREEMPTED
+            nd.ready = False
+            # Disruption-owned cordon (marker included): _maybe_uncordon
+            # must be able to lift it after restore_slice — an unmarked
+            # cordon reads as operator-placed and sticks forever.
+            if not nd.unschedulable:
+                nd.unschedulable = True
+                nd.metadata.annotations[C.ANN_CORDONED_BY] = "disruption"
+            return True
+
+        try:
+            store.mutate("Node", ns, name, fn)
+        except (NotFound, Conflict):
+            pass
+    names = {name for _, name in targets}
+    for pod in store.list("Pod", copy_=False):
+        if pod.node_name in names and pod.active:
+            _fail_pod(store, pod, C.REASON_PREEMPTED)
+    return len(targets)
+
+
+def restore_slice(store: Store, slice_id: str) -> int:
+    """Replacement capacity arrived (provider re-provisioned the slice /
+    maintenance finished): clear the disruption state so the controller
+    uncordons and the spare pool may re-reserve it. Returns nodes touched."""
+    n = 0
+    for node in store.list("Node", copy_=False):
+        if node.tpu.slice_id != slice_id:
+            continue
+
+        def fn(nd):
+            nd.disruption = ""
+            nd.disruption_deadline = 0.0
+            nd.ready = True
+            return True
+
+        try:
+            store.mutate("Node", node.metadata.namespace,
+                         node.metadata.name, fn)
+            n += 1
+        except (NotFound, Conflict):
+            pass
+    return n
+
+
+def _fail_pod(store: Store, pod, reason: str) -> bool:
+    """Mark a pod Failed with a disruption reason (+DisruptionTarget
+    condition). Returns True when the pod actually transitioned."""
+    changed = {"v": False}
+
+    def fn(p):
+        changed["v"] = False  # reset: mutate retries re-run fn on conflict
+        if not p.active:
+            return False
+        p.status.phase = "Failed"
+        p.status.ready = False
+        p.status.reason = reason
+        p.status.conditions.append(
+            Condition(type="DisruptionTarget", status="True", reason=reason,
+                      last_transition_time=time.time()))
+        changed["v"] = True
+        return True
+
+    try:
+        store.mutate("Pod", pod.metadata.namespace,
+                     pod.metadata.name, fn, status=True)
+    except (NotFound, Conflict):
+        return False
+    return changed["v"]
+
+
+# ---- controller ------------------------------------------------------------
+
+
+class DisruptionController(Controller):
+    name = "disruption"
+    workers = 2
+    # Deadlines are wall-clock: the resync backstop alone (300 s) would
+    # sleep through a notice window; active slices self-requeue instead.
+    resync_period = 30.0
+
+    def __init__(self, store: Store, node_binding=None, spares=None):
+        super().__init__(store)
+        self.node_binding = node_binding
+        self.spares = spares
+
+    def watches(self) -> List[Watch]:
+        def node_keys(node):
+            if getattr(node, "kind", "") != "Node":
+                return []
+            sid = node.tpu.slice_id
+            if sid:
+                return [(node.metadata.namespace, f"slice:{sid}")]
+            return [(node.metadata.namespace, f"node:{node.metadata.name}")]
+
+        def pod_keys(pod):
+            # Pod churn advances the state machine along two edges:
+            # (1) churn ON a disrupted slice (drain finished, host lost)
+            # wakes that slice; (2) churn of a MIGRATING instance's pods
+            # wakes the SOURCE slice — the replacement gang lands on a
+            # healthy slice, and its ready transition is exactly the
+            # completion signal the source slice's machine waits for
+            # (without this edge, completion is timer-only).
+            keys = []
+            if getattr(pod, "node_name", ""):
+                node = self.store.get("Node", "default", pod.node_name,
+                                      copy_=False)
+                if (node is not None and node.tpu.slice_id
+                        and (node.disruption or node.unschedulable)):
+                    keys.append(("default", f"slice:{node.tpu.slice_id}"))
+            ref = pod.metadata.controller_owner()
+            if ref is not None and ref.kind == "RoleInstance":
+                inst = self.store.get("RoleInstance",
+                                      pod.metadata.namespace, ref.name,
+                                      copy_=False)
+                if inst is not None:
+                    src = inst.metadata.annotations.get(
+                        C.ANN_MIGRATION_FROM)
+                    if src and inst.metadata.annotations.get(
+                            C.ANN_MIGRATION_STATE):
+                        key = ("default", f"slice:{src}")
+                        if key not in keys:
+                            keys.append(key)
+            return keys
+
+        return [
+            Watch("Node", node_keys),
+            Watch("Pod", pod_keys, delay=0.02),
+        ]
+
+    # ---- reconcile ----
+
+    def reconcile(self, store: Store, key) -> Optional[Result]:
+        ns, name = key
+        if name.startswith("node:"):
+            return self._reconcile_single_node(store, ns, name[5:])
+        if not name.startswith("slice:"):
+            return None
+        sid = name[6:]
+        nodes = [n for n in store.list("Node", copy_=False)
+                 if n.tpu.slice_id == sid]
+        if not nodes:
+            return None
+        preempted = [n for n in nodes if n.disruption == C.DISRUPT_PREEMPTED]
+        if preempted:
+            return self._handle_preemption(store, sid, nodes, preempted)
+        maint = [n for n in nodes if n.disruption == C.DISRUPT_MAINTENANCE]
+        if maint:
+            return self._handle_maintenance(store, sid, nodes, maint)
+        self._maybe_uncordon(store, nodes)
+        # Maintenance CANCELLED (restore_slice, cluster cleared the
+        # condition, provider kept the nodes): in-flight migrations from
+        # this slice must unwind too — the state machine is only driven
+        # while a maintenance node exists, so leftover annotations would
+        # wedge forever and keep the granted spare in probation.
+        self._abort_cancelled_migrations(store, sid)
+        return None
+
+    def _abort_cancelled_migrations(self, store, sid) -> None:
+        for inst in store.list("RoleInstance", copy_=False):
+            ann = inst.metadata.annotations
+            if (ann.get(C.ANN_MIGRATION_FROM) == sid
+                    and ann.get(C.ANN_MIGRATION_STATE)):
+                self._abort_migration(store, inst, drop_binding=True,
+                                      reason="maintenance cancelled")
+
+    def _reconcile_single_node(self, store, ns, node_name) -> Optional[Result]:
+        """Non-slice nodes (CPU hosts for routers etc.): preemption fails
+        the pods on them so owners replace elsewhere; maintenance cordons
+        and drains. No gang semantics — there is no collective to wedge."""
+        node = store.get("Node", ns, node_name, copy_=False)
+        if node is None:
+            return None
+        if not node.disruption:
+            # Maintenance cleared: lift OUR cordon (same contract as the
+            # slice path — without this, a CPU node's cleared maintenance
+            # leaves it unschedulable forever).
+            self._maybe_uncordon(store, [node])
+            return None
+        pods = [p for p in store.list("Pod", copy_=False)
+                if p.node_name == node_name]
+        if node.disruption == C.DISRUPT_PREEMPTED:
+            for p in pods:
+                if p.active:
+                    _fail_pod(store, p, C.REASON_PREEMPTED)
+            return None
+        # maintenance
+        self._cordon(store, [node])
+        for p in pods:
+            if p.active and p.metadata.deletion_timestamp is None:
+                self._drain_pod(store, p)
+        remaining = [p for p in store.list("Pod", copy_=False)
+                     if p.node_name == node_name]
+        if not remaining:
+            self._stamp_released(store, [node])
+            return None
+        return Result(requeue_after=0.1)
+
+    # ---- no-notice preemption: gang semantics ----
+
+    def _handle_preemption(self, store, sid, nodes, preempted) -> Optional[Result]:
+        self._ack_once(store, preempted, _ANN_PREEMPT_ACKED,
+                       "rbg_disruption_preemptions_total")
+        # Cordon every host of the slice — a partially-preempted ICI
+        # domain must not receive new binds while the gang recovers.
+        self._cordon(store, nodes)
+        gone = {n.metadata.name for n in preempted}
+        # Backstop: fail any pod still 'active' on a vanished host (the
+        # injector / k8s reflector usually did this already).
+        for p in store.list("Pod", copy_=False):
+            if p.node_name in gone and p.active:
+                _fail_pod(store, p, C.REASON_PREEMPTED)
+
+        # Gang enforcement: an instance whose pods touch this slice and
+        # lost any host fails WHOLE — survivors on surviving hosts are
+        # killed rather than left wedged in collective ops.
+        host_names = {n.metadata.name for n in nodes}
+        affected: Dict[tuple, List] = {}
+        for p in store.list("Pod", copy_=False):
+            if (p.node_name in host_names
+                    and p.template.scheduler_hints.get("tpu-slice") == "true"):
+                inst = p.metadata.labels.get(C.LABEL_INSTANCE_NAME)
+                if inst:
+                    affected.setdefault((p.metadata.namespace, inst),
+                                        []).append(p)
+        topology = nodes[0].tpu.slice_topology
+        for (pns, iname), pods in sorted(affected.items()):
+            inst = store.get("RoleInstance", pns, iname, copy_=False)
+            # Lost = a pod sits on a vanished host, OR the gang is
+            # already mid-restart while occupying this slice — the victim
+            # pod may have been FINALIZED by the restart machinery before
+            # this reconcile ran, and the incident (and its spare grant)
+            # must not be skipped just because the evidence got cleaned
+            # up first.
+            lost = (any(p.node_name in gone for p in pods)
+                    or (inst is not None
+                        and inst.status.phase == "Restarting"))
+            if not lost:
+                continue
+            # Kill EVERY active pod of the instance (including sub-gangs on
+            # other slices of a multi-slice instance — one JAX program).
+            owned = (store.list("Pod", namespace=pns,
+                                owner_uid=inst.metadata.uid, copy_=False)
+                     if inst is not None else pods)
+            killed = 0
+            for p in owned:
+                if p.active and not (p.status.phase == "Failed"):
+                    if _fail_pod(store, p, C.REASON_GANG_PREEMPTED):
+                        killed += 1
+            # Count the incident by OBSERVATION, not by who pulled the
+            # trigger: the restart machinery often tears the gang down
+            # first (the victim's Failed event races our reconcile), and
+            # killed==0 then — the gang was still lost to this preemption.
+            # The per-instance ack (stamped with the slice id) keeps the
+            # count at one across reconciles of the same incident.
+            if inst is not None and self._ack_gang_kill(store, inst, sid):
+                REGISTRY.inc("rbg_disruption_gang_kills_total")
+                store.record_event(
+                    inst, "GangPreempted",
+                    f"slice {sid} lost hosts; killed {killed} survivor "
+                    f"pod(s) — recovering the gang whole")
+            # Bind-time recovery: grant a warm spare so the restart
+            # machinery recreates straight onto reserved capacity. Any
+            # in-flight MAINTENANCE migration of this instance is
+            # superseded by the preemption — abort its state machine or
+            # the stale annotations would resume against a future notice
+            # (and spuriously count a migration that never ran).
+            if inst is not None:
+                self._abort_migration(store, inst)
+                self._grant_target(store, inst, sid, topology)
+        return None
+
+    def _abort_migration(self, store, inst, drop_binding: bool = False,
+                         reason: str = "preemption superseded it") -> None:
+        """Drop an in-flight migration's bookkeeping without counting it.
+        After a PREEMPTION the slice-binding annotation is kept (the
+        granted target remains a valid steer for gang recovery); after a
+        CANCELLED maintenance the gang keeps serving in place, so
+        ``drop_binding=True`` also releases the unused target — otherwise
+        the still-referenced spare sits in pool probation forever."""
+        if C.ANN_MIGRATION_STATE not in inst.metadata.annotations:
+            return
+        ns, name = inst.metadata.namespace, inst.metadata.name
+        target = inst.metadata.annotations.get(C.ANN_MIGRATION_TARGET, "")
+
+        def fn(i):
+            a = i.metadata.annotations
+            if C.ANN_MIGRATION_STATE not in a:
+                return False
+            for k in (C.ANN_MIGRATION_STATE, C.ANN_MIGRATION_TARGET,
+                      C.ANN_MIGRATION_FROM, C.ANN_MIGRATION_DEADLINE):
+                a.pop(k, None)
+            if drop_binding and target \
+                    and a.get(C.ANN_SLICE_BINDING) == target:
+                a.pop(C.ANN_SLICE_BINDING, None)
+            return True
+
+        try:
+            store.mutate("RoleInstance", ns, name, fn)
+        except (NotFound, Conflict):
+            return
+        store.delete("Warmup", ns, self._warmup_name(inst))
+        store.record_event(inst, "MigrationAborted",
+                           f"in-flight migration dropped: {reason}")
+
+    def _ack_gang_kill(self, store, inst, sid) -> bool:
+        """Stamp the instance's gang-kill ack for this slice incident;
+        True only for the reconcile that stamps it (counts once)."""
+        ns, name = inst.metadata.namespace, inst.metadata.name
+        stamped = {"v": False}
+
+        def fn(i):
+            stamped["v"] = False  # reset on conflict-retry re-runs
+            if i.metadata.annotations.get(_ANN_GANGKILL_ACKED) == sid:
+                return False
+            i.metadata.annotations[_ANN_GANGKILL_ACKED] = sid
+            stamped["v"] = True
+            return True
+
+        try:
+            store.mutate("RoleInstance", ns, name, fn)
+        except (NotFound, Conflict):
+            return False
+        return stamped["v"]
+
+    def _grant_target(self, store, inst, old_slice, topology) -> Optional[str]:
+        """Steer an instance's recovery/migration to a concrete slice:
+        take a warm spare of the right topology when one is reserved,
+        stamp it as the instance's slice binding, and rewrite the warm
+        node-binding memory. Returns the granted slice id (None = let the
+        scheduler choose freely)."""
+        cur = inst.metadata.annotations.get(C.ANN_SLICE_BINDING, "")
+        if cur and cur != old_slice:
+            return cur  # already granted/steered on a previous reconcile
+        target = None
+        if self.spares is not None:
+            target = self.spares.take(topology=topology)
+        if target is None:
+            return None
+        self._bind_instance(store, inst, old_slice, target)
+        if self.spares is not None:
+            # Replenish in the background: the pool must not stay shallow
+            # until the next scheduler resync.
+            try:
+                self.spares.replenish(store)
+            except Exception:
+                pass
+        return target
+
+    # ---- advance notice: cordon → warm → cut over → release ----
+
+    def _handle_maintenance(self, store, sid, nodes, maint) -> Optional[Result]:
+        deadline = max(n.disruption_deadline for n in maint)
+        self._ack_once(store, maint, _ANN_NOTICE_ACKED,
+                       "rbg_disruption_notices_total")
+        self._cordon(store, nodes)
+
+        host_names = {n.metadata.name for n in nodes}
+        all_pods = store.list("Pod", copy_=False)
+        on_slice = [p for p in all_pods if p.node_name in host_names]
+
+        # Group slice-gang pods by owning instance — plus every instance
+        # whose migration FROM this slice is still in flight (its pods may
+        # already have left the slice; the state machine must still run to
+        # completion or the annotations wedge and nothing counts done).
+        instances: Dict[tuple, List] = {}
+        for p in on_slice:
+            if (p.active
+                    and p.template.scheduler_hints.get("tpu-slice") == "true"):
+                iname = p.metadata.labels.get(C.LABEL_INSTANCE_NAME)
+                if iname:
+                    instances.setdefault((p.metadata.namespace, iname),
+                                         []).append(p)
+        for inst in store.list("RoleInstance", copy_=False):
+            ann = inst.metadata.annotations
+            if (ann.get(C.ANN_MIGRATION_FROM) == sid
+                    and ann.get(C.ANN_MIGRATION_STATE)):
+                instances.setdefault(
+                    (inst.metadata.namespace, inst.metadata.name), [])
+
+        busy = False
+        topology = nodes[0].tpu.slice_topology
+        for (pns, iname), pods in sorted(instances.items()):
+            inst = store.get("RoleInstance", pns, iname, copy_=False)
+            if inst is None:
+                # Ownerless gang pods: drain directly.
+                for p in pods:
+                    self._drain_pod(store, p)
+                busy = True
+                continue
+            if self._migrate_instance(store, inst, sid, topology,
+                                      deadline, pods):
+                busy = True
+
+        # Singles (routers, CPU roles) on the slice hosts: plain drain —
+        # their controllers recreate them on schedulable capacity.
+        for p in on_slice:
+            if (p.active and p.metadata.deletion_timestamp is None
+                    and p.template.scheduler_hints.get("tpu-slice") != "true"):
+                self._drain_pod(store, p)
+                busy = True
+
+        # Release: the slice is handed back the moment NOTHING remains
+        # bound to its hosts (terminating pods included — the provider may
+        # power hosts off right after); in-flight state machines keep the
+        # reconcile loop alive past the release stamp.
+        remaining = [p for p in store.list("Pod", copy_=False)
+                     if p.node_name in host_names]
+        if not remaining:
+            self._stamp_released(store, nodes)
+        if remaining or busy:
+            # Timed backstop only: the Pod watch already re-enqueues this
+            # slice on every pod transition (drain finished, replacement
+            # ready), so progress is event-driven — a 20 Hz poll here
+            # would full-scan the store ~5x per pass for the whole drain
+            # window for nothing.
+            return Result(requeue_after=0.25)
+        return None
+
+    def _migrate_instance(self, store, inst, sid, topology, deadline,
+                          pods) -> bool:
+        """One step of the per-instance migration state machine. Returns
+        True while the migration is still in flight."""
+        ns, name = inst.metadata.namespace, inst.metadata.name
+        ann = inst.metadata.annotations
+        state = ann.get(C.ANN_MIGRATION_STATE, "")
+        now = time.time()
+
+        if not state:
+            target = self._grant_target(store, inst, sid, topology)
+            if target is None:
+                target = self._pick_target_slice(store, sid, topology,
+                                                 len(pods))
+                if target:
+                    self._bind_instance(store, inst, sid, target)
+            warm_name = self._ensure_warmup(store, inst, target)
+
+            def fn(i):
+                a = i.metadata.annotations
+                a[C.ANN_MIGRATION_STATE] = C.MIGRATION_WARMING
+                a[C.ANN_MIGRATION_TARGET] = target or ""
+                a[C.ANN_MIGRATION_FROM] = sid
+                a[C.ANN_MIGRATION_DEADLINE] = f"{deadline:.3f}"
+                return True
+
+            try:
+                store.mutate("RoleInstance", ns, name, fn)
+            except (NotFound, Conflict):
+                return True
+            store.record_event(
+                inst, "MigrationStarted",
+                f"maintenance on slice {sid}: warming "
+                f"{'spare ' + target if target else 'replacement capacity'}"
+                + (f" via {warm_name}" if warm_name else ""))
+            return True
+
+        if state == C.MIGRATION_WARMING:
+            if self._warmup_done(store, inst, deadline, now):
+                self._cut_over(store, inst, sid)
+            return True
+
+        if state == C.MIGRATION_CUTOVER:
+            if self._cutover_complete(store, inst, sid):
+                # Still in flight until the annotation clear actually
+                # LANDS: a conflict-swallowed finish (instance status is
+                # churning hardest exactly now — the gang just turned
+                # ready) must keep the requeue chain alive, not wedge the
+                # state machine until the resync backstop.
+                return not self._finish_migration(store, inst, deadline, now)
+            # Keep pressing the drain: pods created between reconciles
+            # (restart races) must also leave the cordoned slice.
+            for p in pods:
+                if p.active and p.metadata.deletion_timestamp is None:
+                    self._drain_pod(store, p)
+            return True
+        return True
+
+    def _pick_target_slice(self, store, old_sid, topology,
+                           need: int) -> Optional[str]:
+        """Fallback when no warm spare is reserved: the healthy slice
+        (matching topology when possible) with the most free TPU hosts.
+        None = let the scheduler place freely at recreation time."""
+        reserved = (self.spares.held_slices()
+                    if self.spares is not None else set())
+        occupied = {p.node_name for p in store.list("Pod", copy_=False)
+                    if p.active and p.node_name
+                    and p.template.scheduler_hints.get("tpu-slice") == "true"}
+        by_slice: Dict[str, List] = {}
+        for n in store.list("Node", copy_=False):
+            sid = n.tpu.slice_id
+            if sid and sid != old_sid and sid not in reserved:
+                by_slice.setdefault(sid, []).append(n)
+        best, best_key = None, None
+        for sid, hosts in sorted(by_slice.items()):
+            free = [n for n in hosts if n.schedulable
+                    and n.metadata.name not in occupied]
+            if len(free) < need:
+                continue
+            key = (hosts[0].tpu.slice_topology == topology, len(free))
+            if best_key is None or key > best_key:
+                best, best_key = sid, key
+        return best
+
+    def _bind_instance(self, store, inst, old_slice, target) -> None:
+        ns, name = inst.metadata.namespace, inst.metadata.name
+
+        def fn(i):
+            if i.metadata.annotations.get(C.ANN_SLICE_BINDING) == target:
+                return False
+            i.metadata.annotations[C.ANN_SLICE_BINDING] = target
+            return True
+
+        try:
+            store.mutate("RoleInstance", ns, name, fn)
+        except (NotFound, Conflict):
+            return
+        if self.node_binding is not None:
+            group = inst.metadata.labels.get(C.LABEL_GROUP_NAME, "")
+            self.node_binding.retarget_slice(old_slice, target,
+                                             group=group or None,
+                                             namespace=ns)
+
+    # -- warmup leg --
+
+    def _warmup_name(self, inst) -> str:
+        return f"mig-{inst.metadata.name}"[:C.MAX_NAME_LEN].rstrip("-")
+
+    def _ensure_warmup(self, store, inst, target) -> Optional[str]:
+        """Prime the replacement slice's hosts (image prefetch — the XLA
+        compile-cache / weight-staging stand-in) before cutover. Skipped
+        when no concrete target is known or the Warmup kind is absent."""
+        if not target:
+            return None
+        try:
+            from rbg_tpu.api.policy import ImagePreload, Warmup, WarmupActions
+        except ImportError:
+            return None
+        hosts = sorted(n.metadata.name
+                       for n in store.list("Node", copy_=False)
+                       if n.tpu.slice_id == target)
+        if not hosts:
+            return None
+        images = []
+        tmpl = inst.spec.instance.template
+        for c in (tmpl.containers if tmpl else []):
+            if c.image and c.image not in images:
+                images.append(c.image)
+        name = self._warmup_name(inst)
+        ns = inst.metadata.namespace
+        if store.get("Warmup", ns, name, copy_=False) is not None:
+            return name
+        w = Warmup()
+        w.metadata.name = name
+        w.metadata.namespace = ns
+        w.spec.target.nodes = hosts
+        if images:
+            w.spec.actions = WarmupActions(
+                image_preload=ImagePreload(images=images))
+        w.spec.ttl_seconds_after_finished = 5.0
+        from rbg_tpu.runtime.store import AlreadyExists
+        try:
+            store.create(w)
+        except AlreadyExists:
+            pass
+        except Exception:
+            return None
+        return name
+
+    def _warmup_done(self, store, inst, deadline, now) -> bool:
+        target = inst.metadata.annotations.get(C.ANN_MIGRATION_TARGET, "")
+        if not target:
+            return True  # nothing to warm
+        w = store.get("Warmup", inst.metadata.namespace,
+                      self._warmup_name(inst), copy_=False)
+        if w is None:
+            return True  # controller absent / already GC'd
+        if w.status.phase in ("Succeeded", "Failed"):
+            return True  # warmup failure never blocks the migration
+        # Deadline pressure: reserve the tail of the window for the
+        # drain+rebind leg — an unfinished warmup is abandoned.
+        notice_left = deadline - now
+        created = w.metadata.creation_timestamp or now
+        total = max(deadline - created, 1e-6)
+        return notice_left <= CUTOVER_RESERVE_FRACTION * total
+
+    # -- cutover leg --
+
+    def _cut_over(self, store, inst, sid) -> None:
+        ns, name = inst.metadata.namespace, inst.metadata.name
+
+        def fn(i):
+            a = i.metadata.annotations
+            if a.get(C.ANN_MIGRATION_STATE) == C.MIGRATION_CUTOVER:
+                return False
+            a[C.ANN_MIGRATION_STATE] = C.MIGRATION_CUTOVER
+            return True
+
+        try:
+            store.mutate("RoleInstance", ns, name, fn)
+        except (NotFound, Conflict):
+            return
+        target = inst.metadata.annotations.get(C.ANN_MIGRATION_TARGET, "")
+        store.record_event(
+            inst, "MigrationCutOver",
+            f"draining gang off slice {sid}"
+            + (f" onto {target}" if target else ""))
+        for p in store.list("Pod", namespace=ns,
+                            owner_uid=inst.metadata.uid, copy_=False):
+            if p.active and p.metadata.deletion_timestamp is None:
+                self._drain_pod(store, p)
+        # Re-assert the warm-binding retarget NOW that the old pods are
+        # inactive: all through the Warming phase they were still
+        # Running+Ready, so the instance controller's record() loop kept
+        # re-recording the OLD slice over the grant-time retarget — the
+        # drain ends those re-records, and this final rewrite is what the
+        # recreated pods actually read.
+        if target and self.node_binding is not None:
+            group = inst.metadata.labels.get(C.LABEL_GROUP_NAME, "")
+            self.node_binding.retarget_slice(sid, target,
+                                             group=group or None,
+                                             namespace=ns)
+
+    def _drain_pod(self, store, pod) -> None:
+        """PR-2 drain contract: the PreparingDelete annotation tells the
+        engine to stop taking new work (router marks it draining, routes
+        around), then graceful delete → the executor's SIGTERM path lets
+        in-flight requests finish up to the drain deadline."""
+        ns, name = pod.metadata.namespace, pod.metadata.name
+
+        def mark(p):
+            if p.metadata.deletion_timestamp is not None:
+                return False  # already terminating — someone else drains
+            if p.metadata.annotations.get(C.ANN_LIFECYCLE_STATE) == \
+                    C.LIFECYCLE_PREPARING_DELETE:
+                return False
+            p.metadata.annotations[C.ANN_LIFECYCLE_STATE] = \
+                C.LIFECYCLE_PREPARING_DELETE
+            return True
+
+        try:
+            obj = store.mutate("Pod", ns, name, mark)
+        except (NotFound, Conflict):
+            return
+        # Re-check on the post-mutate snapshot: grace-deleting a pod whose
+        # deletionTimestamp was set by a concurrent deleter would HARD
+        # delete it (Store.delete's else branch), skipping the SIGTERM
+        # drain and dropping its in-flight streams.
+        if obj.metadata.deletion_timestamp is not None:
+            return
+        store.delete("Pod", ns, name, grace=True)
+
+    def _cutover_complete(self, store, inst, old_sid) -> bool:
+        """Done when the full desired gang runs ready OFF the old slice
+        and nothing of the instance remains bound to it."""
+        from rbg_tpu.runtime.controllers.instance import desired_pods
+        ns = inst.metadata.namespace
+        pods = store.list("Pod", namespace=ns,
+                          owner_uid=inst.metadata.uid, copy_=False)
+        nodes = {n.metadata.name: n for n in store.list("Node", copy_=False)}
+        want = {n for (n, *_rest) in desired_pods(inst)}
+        by_name = {p.metadata.name: p for p in pods}
+        for p in pods:
+            node = nodes.get(p.node_name)
+            if node is not None and node.tpu.slice_id == old_sid:
+                return False  # still anchored to the doomed slice
+        for pod_name in want:
+            p = by_name.get(pod_name)
+            if p is None or not p.running_ready or not p.node_name:
+                return False
+        return True
+
+    def _finish_migration(self, store, inst, deadline, now) -> bool:
+        """Clear the migration bookkeeping and count the completion.
+        Returns True when the annotations are gone (cleared here, or
+        already cleared by a racing worker — the migration is over either
+        way); False on a transient store failure so the caller keeps the
+        slice busy and retries."""
+        ns, name = inst.metadata.namespace, inst.metadata.name
+        cleared = {"v": False}
+
+        def fn(i):
+            cleared["v"] = False  # reset: conflict retries re-run fn
+            a = i.metadata.annotations
+            if C.ANN_MIGRATION_STATE not in a:
+                return False  # another worker already finished it
+            for k in (C.ANN_MIGRATION_STATE, C.ANN_MIGRATION_TARGET,
+                      C.ANN_MIGRATION_FROM, C.ANN_MIGRATION_DEADLINE):
+                a.pop(k, None)
+            cleared["v"] = True
+            return True
+
+        try:
+            store.mutate("RoleInstance", ns, name, fn)
+        except NotFound:
+            return True   # instance deleted — nothing left to finish
+        except Conflict:
+            return False  # transient: retry on the next pass
+        if not cleared["v"]:
+            return True   # lost the race — only the clearing worker counts
+        REGISTRY.inc("rbg_disruption_migrations_completed_total")
+        late = now > deadline
+        if late:
+            REGISTRY.inc("rbg_disruption_migrations_missed_deadline_total")
+        store.record_event(
+            inst, "MigrationCompleted",
+            f"gang serving off the maintenance slice "
+            f"({'MISSED deadline by %.2fs' % (now - deadline) if late else 'before deadline'})")
+        return True
+
+    # ---- node bookkeeping ----
+
+    def _cordon(self, store, nodes) -> None:
+        for n in nodes:
+            if n.unschedulable:
+                continue
+
+            def fn(nd):
+                if nd.unschedulable:
+                    return False
+                nd.unschedulable = True
+                nd.metadata.annotations[_ANN_CORDONED_BY] = "disruption"
+                return True
+
+            try:
+                store.mutate("Node", n.metadata.namespace,
+                             n.metadata.name, fn)
+            except (NotFound, Conflict):
+                pass
+
+    def _maybe_uncordon(self, store, nodes) -> None:
+        """A cleared disruption (maintenance cancelled / capacity
+        restored) releases OUR cordon — never one an operator placed by
+        hand — and closes the incident's gang-kill acks so a REPEAT
+        preemption of the same slice counts again."""
+        sid = nodes[0].tpu.slice_id if nodes else ""
+        if sid and any(
+                not n.disruption and n.metadata.annotations.get(
+                    _ANN_CORDONED_BY) == "disruption"
+                for n in nodes):
+            for inst in store.list("RoleInstance", copy_=False):
+                if inst.metadata.annotations.get(_ANN_GANGKILL_ACKED) != sid:
+                    continue
+
+                def drop(i):
+                    if i.metadata.annotations.get(_ANN_GANGKILL_ACKED) != sid:
+                        return False
+                    del i.metadata.annotations[_ANN_GANGKILL_ACKED]
+                    return True
+
+                try:
+                    store.mutate("RoleInstance", inst.metadata.namespace,
+                                 inst.metadata.name, drop)
+                except (NotFound, Conflict):
+                    pass
+        for n in nodes:
+            if not n.unschedulable or \
+                    n.metadata.annotations.get(_ANN_CORDONED_BY) != "disruption":
+                continue
+
+            def fn(nd):
+                if nd.disruption:
+                    return False
+                nd.unschedulable = False
+                for k in (_ANN_CORDONED_BY, _ANN_NOTICE_ACKED,
+                          _ANN_PREEMPT_ACKED, C.ANN_MAINT_RELEASED):
+                    nd.metadata.annotations.pop(k, None)
+                return True
+
+            try:
+                store.mutate("Node", n.metadata.namespace,
+                             n.metadata.name, fn)
+            except (NotFound, Conflict):
+                pass
+
+    def _ack_once(self, store, nodes, marker: str, counter: str) -> None:
+        """Count a disruption event once per slice INCIDENT: increment
+        only when no node of the slice was acked yet (injection marks
+        hosts one at a time — each marking must not count again), then
+        stamp every disrupted node."""
+        already = any(n.metadata.annotations.get(marker) == "true"
+                      for n in nodes)
+        fresh = {"v": False}
+        for n in nodes:
+            if n.metadata.annotations.get(marker) == "true":
+                continue
+            stamped = {"v": False}
+
+            def fn(nd, stamped=stamped):
+                stamped["v"] = False  # reset on conflict-retry re-runs
+                if nd.metadata.annotations.get(marker) == "true":
+                    return False
+                nd.metadata.annotations[marker] = "true"
+                stamped["v"] = True
+                return True
+
+            try:
+                store.mutate("Node", n.metadata.namespace,
+                             n.metadata.name, fn)
+                fresh["v"] = fresh["v"] or stamped["v"]
+            except (NotFound, Conflict):
+                pass
+        if fresh["v"] and not already:
+            REGISTRY.inc(counter)
+
+    def _stamp_released(self, store, nodes) -> None:
+        stamped = False
+        now = time.time()
+        for n in nodes:
+            if n.metadata.annotations.get(C.ANN_MAINT_RELEASED):
+                continue
+
+            def fn(nd):
+                if nd.metadata.annotations.get(C.ANN_MAINT_RELEASED):
+                    return False
+                nd.metadata.annotations[C.ANN_MAINT_RELEASED] = f"{now:.3f}"
+                return True
+
+            try:
+                store.mutate("Node", n.metadata.namespace,
+                             n.metadata.name, fn)
+                stamped = True
+            except (NotFound, Conflict):
+                pass
+        if stamped:
+            REGISTRY.inc("rbg_disruption_slices_released_total")
+            store.record_event(
+                nodes[0], "SliceReleased",
+                f"slice {nodes[0].tpu.slice_id or nodes[0].metadata.name} "
+                f"drained and released to the infrastructure")

@@ -79,6 +79,43 @@ class RoleInstanceController(Controller):
             Watch("Pod", owner_keys("RoleInstance"), delay=0.01),
         ]
 
+    # Cap for resumed crash-loop damping: 8 charges of the jittered
+    # exponential already sit at the delay ceiling; seeding higher only
+    # delays legitimate recovery.
+    SEED_BACKOFF_CAP = 8
+
+    def seed_backoff(self, store: Store) -> None:
+        """Pre-charge per-key ERROR-retry damping from observed pod
+        restart counts (minus the restarts an in-place update
+        legitimately caused) when resuming over an existing store. Scope:
+        this damps the workqueue's error-retry schedule for keys that
+        FAIL to reconcile during the resume window (conflict storms,
+        transient store errors around a crash-looping gang); it is
+        cleared by the first clean reconcile, as any error backoff is.
+        The restart-cycle pacing itself (delay between gang recreations)
+        lives in inst.status.restart_count/last_restart_time and already
+        survives restarts on its own."""
+        from rbg_tpu.inplace.update import expected_restarts
+        worst: dict = {}
+        for p in store.list("Pod", copy_=False):
+            ref = p.metadata.controller_owner()
+            if ref is None or ref.kind != "RoleInstance":
+                continue
+            allowed = expected_restarts(p) or {}
+            if p.status.container_restarts:
+                n = sum(max(0, c - allowed.get(name, 0))
+                        for name, c in p.status.container_restarts.items())
+            else:
+                n = max(0, p.status.restart_count - sum(allowed.values()))
+            if n > 0:
+                key = (p.metadata.namespace, ref.name)
+                worst[key] = max(worst.get(key, 0), n)
+        for inst in store.list("RoleInstance", copy_=False):
+            key = (inst.metadata.namespace, inst.metadata.name)
+            n = max(worst.get(key, 0), inst.status.restart_count)
+            if n > 0:
+                self.backoff.seed(key, min(n, self.SEED_BACKOFF_CAP))
+
     def reconcile(self, store: Store, key) -> Optional[Result]:
         ns, name = key
         inst = store.get("RoleInstance", ns, name, copy_=False)

@@ -25,13 +25,20 @@ from rbg_tpu.sched.scheduler import SchedulerController
 class ControlPlane:
     def __init__(self, store: Optional[Store] = None, backend: str = "fake",
                  ready_delay: float = 0.0, executor_env: Optional[dict] = None,
-                 k8s_client=None):
+                 k8s_client=None, warm_spares: int = 0):
         self.store = store or Store()
         self.manager = Manager(self.store)
         self.node_binding = NodeBindingStore(self.store)
         from rbg_tpu.portalloc import PortAllocatorService
         self.ports = PortAllocatorService(self.store)
+        # Warm-spare slice reservation (disruption recovery is bind-time,
+        # not provision-time): N standby slices per topology, shared by
+        # the scheduler (steers ordinary gangs away) and the disruption
+        # controller (grants them to recovering/migrating gangs).
+        from rbg_tpu.sched.capacity import SparePool
+        self.spares = SparePool(warm_spares)
 
+        from rbg_tpu.runtime.controllers.disruption import DisruptionController
         from rbg_tpu.runtime.controllers.group import RoleBasedGroupController
         from rbg_tpu.runtime.controllers.instance import RoleInstanceController
         from rbg_tpu.runtime.controllers.instanceset import RoleInstanceSetController
@@ -43,7 +50,11 @@ class ControlPlane:
         self.instance_controller = self.manager.register(
             RoleInstanceController(self.store, self.node_binding, ports=self.ports))
         self.scheduler = self.manager.register(
-            SchedulerController(self.store, self.node_binding))
+            SchedulerController(self.store, self.node_binding,
+                                spares=self.spares))
+        self.disruption_controller = self.manager.register(
+            DisruptionController(self.store, node_binding=self.node_binding,
+                                 spares=self.spares))
         self._register_optional()
 
         self.kubelet = None

@@ -15,23 +15,60 @@ from typing import Any, Hashable, Optional
 
 
 class ExponentialBackoff:
-    """Per-item failure backoff: min(base * 2^(n-1), max)."""
+    """Per-item failure backoff: min(base * 2^(n-1), max).
 
-    def __init__(self, base: float = 0.005, max_delay: float = 30.0):
+    With ``jitter=True`` the delay is DECORRELATED jitter instead
+    (``min(max, uniform(base, prev*3))``): a slice-wide failure marks
+    every member of the gang failed within the same millisecond, and
+    pure exponential backoff then re-fires every retry in lockstep — a
+    synchronized reconcile storm against the store/apiserver on each
+    wave. Jittered delays spread the wave while keeping the same growth
+    rate and cap."""
+
+    def __init__(self, base: float = 0.005, max_delay: float = 30.0,
+                 jitter: bool = False):
         self.base = base
         self.max_delay = max_delay
+        self.jitter = jitter
         self._failures: dict = {}
+        self._prev: dict = {}    # item -> previous jittered delay
         self._lock = threading.Lock()
 
     def next_delay(self, item: Hashable) -> float:
         with self._lock:
             n = self._failures.get(item, 0)
             self._failures[item] = n + 1
-        return min(self.base * (2 ** n), self.max_delay)
+            if not self.jitter:
+                return min(self.base * (2 ** n), self.max_delay)
+            import random
+            # First delay seeds prev with base (standard AWS decorrelated
+            # jitter): uniform(base, 3*base) — a deterministic first wait
+            # of exactly `base` would leave the FIRST retry wave of a
+            # slice-wide failure fully synchronized.
+            prev = self._prev.get(item) or self.base
+            d = min(self.max_delay, random.uniform(self.base, prev * 3))
+            self._prev[item] = d
+            return d
 
     def forget(self, item: Hashable) -> None:
         with self._lock:
             self._failures.pop(item, None)
+            self._prev.pop(item, None)
+
+    def seed(self, item: Hashable, failures: int) -> None:
+        """Pre-charge an item's failure count (never lowering it): a
+        restarted plane seeds crash-loop damping from observed pod
+        restart counts instead of starting every key from zero."""
+        if failures <= 0:
+            return
+        with self._lock:
+            if failures > self._failures.get(item, 0):
+                self._failures[item] = failures
+                if self.jitter:
+                    # Equivalent decorrelated state: the delay a run of
+                    # `failures` consecutive fails would have reached.
+                    self._prev[item] = min(
+                        self.max_delay, self.base * (2 ** (failures - 1)))
 
     def retries(self, item: Hashable) -> int:
         with self._lock:

@@ -256,7 +256,8 @@ class Store:
         for attr in ("spec", "template", "data", "selector", "labels", "node_name",
                      "affinity", "revision", "role_hashes", "init_containers",
                      "containers", "volumes", "tpu", "capacity_pods", "address",
-                     "leader_only"):
+                     "leader_only", "unschedulable", "disruption",
+                     "disruption_deadline"):
             if hasattr(new, attr):
                 if serde.to_dict(getattr(old, attr, None)) != serde.to_dict(getattr(new, attr)):
                     return True

@@ -141,6 +141,24 @@ class NodeBindingStore:
                 required=(mode == MODE_REQUIRED), weight=10))
         return terms
 
+    def retarget_slice(self, old_slice: str, new_slice: str,
+                       group: Optional[str] = None,
+                       namespace: str = "default") -> None:
+        """Disruption migration: rewrite warm bindings that point at
+        ``old_slice`` to ``new_slice`` and drop the per-node memory that
+        backed them (the old hosts are being vacated — steering a
+        recreated pod back to them would fight the cordon). Scoped to one
+        group when given, else every binding on the old slice."""
+        prefix = f"{namespace}/{group}/" if group else None
+        with self._lock:
+            for k, sid in list(self._slices.items()):
+                if sid != old_slice:
+                    continue
+                if prefix is not None and not k.startswith(prefix):
+                    continue
+                self._slices[k] = new_slice
+                self._nodes.pop(k, None)
+
     def evict_group(self, group: str, namespace: str = "default") -> None:
         """Drop all bindings of a group (on group delete; reference:
         ``rolebasedgroup_controller.go:1024-1040``). Namespace-scoped."""

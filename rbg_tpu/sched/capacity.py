@@ -208,8 +208,11 @@ class CapacityCache:
     # ---- plan-time views (plan-local scratch copies, O(nodes)) ----
 
     def ready_nodes(self) -> List[object]:
+        """Bind candidates: ready AND schedulable — a cordoned or
+        disrupted (maintenance/preempted) host keeps its bound-pod
+        accounting but must never receive a NEW bind."""
         with self._lock:
-            return [n for n in self._nodes.values() if n.ready]
+            return [n for n in self._nodes.values() if n.schedulable]
 
     def free_view(self) -> Dict[str, int]:
         with self._lock:
@@ -226,3 +229,169 @@ class CapacityCache:
         with self._lock:
             return {kd: next(iter(owners))
                     for kd, owners in self._excl.items() if owners}
+
+
+class SparePool:
+    """Warm-spare slice reservation: N fully-idle standby slices held back
+    per topology so disruption recovery is BIND-time, not provision-time.
+
+    Mooncake / "Taming the Chaos" argument (PAPERS.md): group-level
+    recovery must have somewhere to recover INTO — re-provisioning a
+    multi-host slice after a preemption is minutes, re-binding onto a
+    reserved warm slice is milliseconds. The pool is a *soft* reservation:
+    the scheduler steers ordinary gangs away from reserved slices, but
+    when nothing else fits it raids the pool rather than wedging a gang
+    Pending (capacity starvation must degrade, not deadlock).
+
+    ``take`` consumes a spare (disruption controller granting it to a
+    migrating/recovering instance); ``replenish`` re-reserves idle
+    eligible slices up to the target, called from the scheduler's resync
+    and after every take — "replenished in the background"."""
+
+    def __init__(self, per_topology: int = 0):
+        self.per_topology = per_topology
+        self._lock = threading.Lock()
+        self._reserved: Dict[str, str] = {}   # slice_id -> topology
+        self._known_topos: Set[str] = set()   # gauge zeroing on drain
+        # Slices taken but not yet occupied: a grant's target stays idle
+        # until the recovering gang binds, and replenish must not
+        # re-reserve it in that window (that would silently revoke the
+        # grant — the scheduler would then treat the target as held back).
+        self._granted: Set[str] = set()
+
+    def configure(self, per_topology: int) -> None:
+        with self._lock:
+            self.per_topology = per_topology
+
+    def reserved_slices(self) -> Set[str]:
+        with self._lock:
+            return set(self._reserved)
+
+    def held_slices(self) -> Set[str]:
+        """Slices the scheduler must steer ordinary gangs away from:
+        reserved spares PLUS granted-but-not-yet-bound targets — a
+        recovering gang's granted slice sits idle through its whole
+        warmup leg, and emptiest-first ordering would otherwise hand it
+        to the next ordinary gang created in that window."""
+        with self._lock:
+            return set(self._reserved) | set(self._granted)
+
+    def is_reserved(self, slice_id: str) -> bool:
+        with self._lock:
+            return slice_id in self._reserved
+
+    def depth(self) -> Dict[str, int]:
+        """topology -> reserved spare count (the pool-depth gauge)."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for topo in self._reserved.values():
+                out[topo] = out.get(topo, 0) + 1
+        return out
+
+    def take(self, topology: Optional[str] = None,
+             slice_id: Optional[str] = None) -> Optional[str]:
+        """Consume one spare (by topology, or a specific slice when the
+        scheduler raids the pool). Returns the slice id or None."""
+        from rbg_tpu.obs.metrics import REGISTRY
+        with self._lock:
+            if slice_id is not None:
+                if self._reserved.pop(slice_id, None) is None:
+                    return None
+                taken = slice_id
+            else:
+                taken = next((s for s, t in sorted(self._reserved.items())
+                              if topology is None or t == topology), None)
+                if taken is None:
+                    return None
+                del self._reserved[taken]
+            self._granted.add(taken)
+        REGISTRY.inc("rbg_disruption_spares_consumed_total")
+        self._export_depth()
+        return taken
+
+    def replenish(self, store) -> None:
+        """Re-reserve idle slices up to ``per_topology`` per topology.
+        Eligible: every host ready, schedulable, undisrupted; no active
+        pod bound to any host; not already reserved."""
+        if self.per_topology <= 0:
+            return
+        by_slice: Dict[str, list] = {}
+        for n in store.list("Node", copy_=False):
+            if n.tpu.slice_id:
+                by_slice.setdefault(n.tpu.slice_id, []).append(n)
+        occupied = set()
+        occupied_gang = set()
+        for p in store.list("Pod", copy_=False):
+            if p.node_name and p.active:
+                occupied.add(p.node_name)
+                if p.template.scheduler_hints.get("tpu-slice") == "true":
+                    occupied_gang.add(p.node_name)
+        # Slice ids still referenced as a PENDING recovery target by some
+        # instance: their grants hold probation even with nothing bound
+        # yet. A binding is only STALE — the grant was bypassed and must
+        # not pin probation forever — when its instance observably runs
+        # on a different slice that is HEALTHY: mid-migration the status
+        # still names the old (disrupted/cordoned) slice the gang is
+        # fleeing, and that must keep the grant alive.
+        healthy = {sid: all(n.schedulable for n in hosts)
+                   for sid, hosts in by_slice.items()}
+        referenced = set()
+        for inst in store.list("RoleInstance", copy_=False):
+            sid = inst.metadata.annotations.get(C.ANN_SLICE_BINDING)
+            if not sid:
+                continue
+            cur = inst.status.slice_id
+            if not cur or cur == sid or not healthy.get(cur, False):
+                referenced.add(sid)
+
+        def eligible(hosts) -> bool:
+            return (all(n.schedulable for n in hosts)
+                    and not any(n.metadata.name in occupied for n in hosts))
+
+        with self._lock:
+            # Drop reservations whose slices stopped being spares: a pod
+            # landed there (capacity-starved single placement binds
+            # WITHOUT take()), or the slice got cordoned/disrupted/
+            # removed. Without this the pool overcounts forever and a
+            # later take() grants a slice the gang cannot fit on.
+            for sid in list(self._reserved):
+                hosts = by_slice.get(sid)
+                if hosts is None or not eligible(hosts):
+                    del self._reserved[sid]
+            # A granted slice leaves probation once its GANG actually
+            # bound (warmup pods occupying it first don't count — the
+            # grant is still pending), the slice vanished, or no instance
+            # references it anymore (grant abandoned mid-recovery) —
+            # otherwise a cancelled migration would leak the slice out of
+            # the re-reservable pool forever.
+            for sid in list(self._granted):
+                hosts = by_slice.get(sid)
+                if (hosts is None
+                        or any(n.metadata.name in occupied_gang
+                               for n in hosts)
+                        or sid not in referenced):
+                    self._granted.discard(sid)
+            counts: Dict[str, int] = {}
+            for topo in self._reserved.values():
+                counts[topo] = counts.get(topo, 0) + 1
+            for sid, hosts in sorted(by_slice.items()):
+                if sid in self._reserved or sid in self._granted:
+                    continue
+                topo = hosts[0].tpu.slice_topology
+                if counts.get(topo, 0) >= self.per_topology:
+                    continue
+                if not eligible(hosts):
+                    continue
+                self._reserved[sid] = topo
+                counts[topo] = counts.get(topo, 0) + 1
+        self._export_depth()
+
+    def _export_depth(self) -> None:
+        from rbg_tpu.obs.metrics import REGISTRY
+        depth = self.depth()
+        with self._lock:
+            self._known_topos |= set(depth)
+            topos = set(self._known_topos)
+        for topo in topos:
+            REGISTRY.set_gauge("rbg_disruption_spare_pool_depth",
+                               float(depth.get(topo, 0)), topology=topo)

@@ -42,16 +42,19 @@ class SchedulerController(Controller):
     # cheap (bound pods return in one store.get).
     resync_period = 30.0
 
-    def __init__(self, store: Store, node_binding=None):
+    def __init__(self, store: Store, node_binding=None, spares=None):
         super().__init__(store)
         self.node_binding = node_binding  # rbg_tpu.sched.binding.NodeBindingStore
-        from rbg_tpu.sched.capacity import CapacityCache
+        from rbg_tpu.sched.capacity import CapacityCache, SparePool
         self.cap = CapacityCache(store)
+        # Warm-spare reservation (disruption recovery lands here bind-time).
+        self.spares = spares if spares is not None else SparePool(0)
 
     def start(self):
         # Build the capacity cache BEFORE watches/workers start so the first
         # reconcile never sees an empty view.
         self.cap.start()
+        self.spares.replenish(self.store)
         super().start()
 
     def _resync_loop(self):
@@ -61,6 +64,12 @@ class SchedulerController(Controller):
             _time.sleep(self.resync_period)
             if self._stopping:
                 return
+            try:
+                self.spares.replenish(self.store)
+            except Exception:
+                import logging
+                logging.getLogger("rbg_tpu.sched").warning(
+                    "spare-pool replenish failed", exc_info=True)
             try:
                 self.cap.rebuild()
             except Exception:
@@ -262,28 +271,42 @@ class SchedulerController(Controller):
         if not preferred and self.node_binding is not None:
             preferred = self.node_binding.preferred_slice(group[0]) or ""
 
-        def candidates():
+        # Warm-spare steering: reserved spares AND granted-but-unbound
+        # targets are held back for disruption recovery. An explicitly-
+        # bound preferred slice is always honored (candidates() yields it
+        # regardless) — that is exactly how the granted gang itself gets
+        # onto its held target.
+        reserved = self.spares.held_slices()
+
+        def candidates(include_reserved: bool):
             if preferred in slices:
                 yield preferred, slices[preferred]
             if sibling_slice:
                 return  # bound siblings pin the ICI domain — no other slice is legal
             # Emptiest-first: keep fragmentation low, leave room for big gangs.
             for sid, hosts in sorted(slices.items(), key=lambda kv: -len(kv[1])):
-                if sid != preferred:
+                if sid != preferred and (include_reserved
+                                         or sid not in reserved):
                     yield sid, hosts
 
-        for sid, hosts in candidates():
-            if len(hosts) < need:
-                continue
-            hosts = sorted(hosts, key=lambda n: n.tpu.worker_index)
-            # Align worker_index to component index when the slice is exactly
-            # sized; otherwise take the first `need` free hosts in ring order.
-            for p, n in zip(group, hosts[:need]):
-                plan[(p.metadata.namespace, p.metadata.name)] = n.metadata.name
-                free[n.metadata.name] -= 1
-                tpu_used.add(n.metadata.name)
-            plan_slices.setdefault(key_, {})[ordinal] = sid
-            return True
+        # Pass 1 avoids the spare pool; pass 2 raids it — a gang stuck
+        # Pending forever is worse than a thinner spare pool.
+        for include_reserved in (False, True) if reserved else (False,):
+            for sid, hosts in candidates(include_reserved):
+                if len(hosts) < need:
+                    continue
+                if sid in reserved:
+                    self.spares.take(slice_id=sid)
+                hosts = sorted(hosts, key=lambda n: n.tpu.worker_index)
+                # Align worker_index to component index when the slice is
+                # exactly sized; otherwise take the first `need` free hosts
+                # in ring order.
+                for p, n in zip(group, hosts[:need]):
+                    plan[(p.metadata.namespace, p.metadata.name)] = n.metadata.name
+                    free[n.metadata.name] -= 1
+                    tpu_used.add(n.metadata.name)
+                plan_slices.setdefault(key_, {})[ordinal] = sid
+                return True
         return False
 
     @staticmethod
@@ -305,6 +328,7 @@ class SchedulerController(Controller):
 
     def _pick_node(self, pod, nodes, free, excl) -> Optional[str]:
         best, best_score = None, None
+        reserved = self.spares.held_slices()
         for n in nodes:
             if free.get(n.metadata.name, 0) <= 0 or not self._node_ok(pod, n, excl):
                 continue
@@ -312,6 +336,11 @@ class SchedulerController(Controller):
             if not self._required_affinity_ok(pod, n):
                 continue
             score = free[n.metadata.name]
+            # Spare-pool hosts sort last: a single pod landing on a warm
+            # spare makes that slice non-idle (gone from the pool on the
+            # next replenish) — only use one when nothing else fits.
+            if n.tpu.slice_id and n.tpu.slice_id in reserved:
+                score -= 10_000_000
             for term in pod.affinity:
                 if not term.required and self._term_satisfied(term, n):
                     score += 1000 * term.weight

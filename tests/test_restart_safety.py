@@ -62,6 +62,64 @@ def test_new_plane_resumes_from_existing_store():
         assert slice1 == slice0
 
 
+def test_resume_seeds_crashloop_backoff():
+    """A plane resuming over an existing store must NOT reset crash-loop
+    damping to zero: observed pod restart counts pre-charge the instance
+    controller's per-key workqueue backoff (in-place-update restarts are
+    legitimate and excluded)."""
+    import json
+
+    from rbg_tpu.runtime.controllers.instance import RoleInstanceController
+
+    plane_a = ControlPlane(backend="fake")
+    make_tpu_nodes(plane_a.store, slices=2, hosts_per_slice=2)
+    with plane_a:
+        plane_a.apply(make_group("svc", simple_role("web", replicas=1)))
+        plane_a.wait_group_ready("svc", timeout=30)
+    store = plane_a.store
+    pods = [p for p in store.list("Pod", namespace="default")
+            if p.metadata.labels[C.LABEL_ROLE_NAME] == "web"]
+    crashing = pods[0]
+
+    # Offline (no controllers running): the pod crashed its way to a high
+    # restart count while the old plane was down.
+    def bump(p):
+        p.status.container_restarts = {"engine": 6}
+        p.status.restart_count = 6
+        return True
+
+    store.mutate("Pod", "default", crashing.metadata.name, bump, status=True)
+
+    ctrl = RoleInstanceController(store)
+    ctrl.seed_backoff(store)
+    ref = crashing.metadata.controller_owner()
+    key = ("default", ref.name)
+    assert ctrl.backoff.retries(key) == 6
+    # The next failure continues the damped schedule instead of restarting
+    # from the base delay.
+    assert ctrl.backoff.next_delay(key) > ctrl.backoff.base
+
+    # In-place-update restarts are expected, not crash-loops: a pod whose
+    # counts match its recorded update baseline seeds nothing.
+    from rbg_tpu.api import constants as CC
+    def with_state(p):
+        p.status.container_restarts = {"engine": 1}
+        p.status.restart_count = 1
+        return True
+    store.mutate("Pod", "default", crashing.metadata.name, with_state,
+                 status=True)
+
+    def ann(p):
+        p.metadata.annotations[CC.ANN_INPLACE_UPDATE_STATE] = json.dumps(
+            {"revision": "r2", "images": {}, "restarted": ["engine"],
+             "baselines": {"engine": 0}})
+        return True
+    store.mutate("Pod", "default", crashing.metadata.name, ann)
+    ctrl2 = RoleInstanceController(store)
+    ctrl2.seed_backoff(store)
+    assert ctrl2.backoff.retries(key) == 0
+
+
 def test_snapshot_lenient_load_and_schema(tmp_path):
     """Schema evolution (docs/architecture.md §5): a snapshot written by a
     NEWER release (extra unknown fields, same schema int) loads leniently;

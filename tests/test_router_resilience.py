@@ -182,6 +182,70 @@ def test_call_all_backends_dead_raises():
         st.call("worker", {"op": "generate", "prompt": [1]})
 
 
+# ---- rolling preemption: EVERY backend of the role draining at once --------
+
+
+def _draining_reply(retry_after_s):
+    from rbg_tpu.engine.protocol import CODE_DRAINING
+    return {"error": "server is draining", "code": CODE_DRAINING,
+            "retry_after_s": retry_after_s, "done": True}
+
+
+def test_call_every_backend_draining_returns_min_retry_after():
+    """Rolling preemption drains a whole role at once. The client must
+    get the structured retriable error carrying the SMALLEST
+    retry_after_s of the fleet — not an eviction storm, not a generic
+    'all backends failed'."""
+    from rbg_tpu.engine.router import _Rejected
+
+    slow = _EchoBackend(reply=_draining_reply(3.0))
+    soon = _EchoBackend(reply=_draining_reply(1.5))
+    st = RouterState(Registry(None), None,
+                     {"worker": [slow.addr, soon.addr]})
+    try:
+        with pytest.raises(_Rejected) as exc:
+            st.call("worker", {"op": "generate", "prompt": [1]})
+        assert exc.value.frame["code"] == "draining"
+        assert exc.value.frame["retry_after_s"] == 1.5
+        # Draining is a healthy answer: nobody gets evicted, both are
+        # marked draining, and the shed is accounted.
+        assert st.pool.evicted() == []
+        assert set(st.pool.draining()) == {slow.addr, soon.addr}
+        assert st.metrics["draining_routed_around"] == 2
+        assert st.metrics["sheds_returned"] == 1
+    finally:
+        slow.stop()
+        soon.stop()
+
+
+def test_stream_every_backend_draining_structured_frame_no_hang():
+    """The streaming path under a fleet-wide drain: one structured done
+    frame (smallest retry_after_s), delivered promptly — never a hang,
+    never a half-open stream."""
+    a = _EchoBackend(reply=_draining_reply(4.0))
+    b = _EchoBackend(reply=_draining_reply(2.0))
+    router = RouterServer(("127.0.0.1", 0), Handler)
+    router.state = RouterState(Registry(None), None,
+                               {"worker": [a.addr, b.addr]})
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    try:
+        port = router.server_address[1]
+        t0 = time.monotonic()
+        with socket.create_connection(("127.0.0.1", port), timeout=30) as s:
+            send_msg(s, {"op": "generate", "prompt": [1], "stream": True,
+                         "timeout_s": 30})
+            frame, _, _ = recv_msg(s)
+        assert frame is not None and frame.get("done")
+        assert frame.get("code") == "draining"
+        assert frame.get("retry_after_s") == 2.0
+        assert time.monotonic() - t0 < 10.0   # structured, not a timeout
+    finally:
+        router.shutdown()
+        router.server_close()
+        a.stop()
+        b.stop()
+
+
 def test_pin_seed_only_for_unseeded_sampling():
     pin = Handler._pin_seed
     assert "seed" not in pin({"temperature": 0.0})

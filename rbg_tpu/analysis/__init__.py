@@ -1,0 +1,12 @@
+"""rbg-lint: AST-based domain-invariant checks for the control plane.
+
+The serving plane's correctness story (PRs 2-3) rests on conventions —
+deadlines derive from one ingress stamp, error codes and metric names come
+from registries, loop threads never block, threads are daemonized or
+joined. This package machine-checks them: ``rbg-tpu lint <paths>``.
+
+See ``docs/static-analysis.md`` for the rule catalog and the allowlist
+(justification-comment) syntax.
+"""
+
+from rbg_tpu.analysis.core import Finding, Rule, run_lint  # noqa: F401

@@ -1,0 +1,64 @@
+"""``rbg-tpu lint`` — run the domain rules over source trees.
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error. ``--format json``
+emits machine-readable findings for tooling; the default text form is
+one ``path:line:col: [rule] message`` per finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rbg-tpu lint",
+        description="AST-based domain-invariant checks (see "
+                    "docs/static-analysis.md)")
+    parser.add_argument("paths", nargs="*", default=["rbg_tpu"],
+                        help="files or directories to lint "
+                             "(default: rbg_tpu)")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="NAME",
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--include-fixtures", action="store_true",
+                        help="lint tests/fixtures too (they are known-bad "
+                             "by design and skipped by default)")
+    args = parser.parse_args(argv)
+
+    from rbg_tpu.analysis.core import run_lint
+    from rbg_tpu.analysis.rules import make_rules, rule_catalog
+
+    if args.list_rules:
+        for name, desc in sorted(rule_catalog().items()):
+            print(f"{name}: {desc}")
+        return 0
+
+    try:
+        rules = make_rules(args.rule)
+    except ValueError as e:
+        print(f"rbg-tpu lint: {e}", file=sys.stderr)
+        return 2
+
+    paths = args.paths or ["rbg_tpu"]
+    findings = run_lint(paths, rules,
+                        skip_fixture_dirs=not args.include_fixtures)
+    if args.format == "json":
+        print(json.dumps([vars(f) for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"\n{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

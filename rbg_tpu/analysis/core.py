@@ -1,0 +1,267 @@
+"""Checker framework: rules, findings, allowlist comments, file walking.
+
+Design: each rule is a class with a ``name``, a ``description``, and a
+``check(ctx) -> [Finding]`` over one parsed file; rules needing cross-file
+state implement ``finalize() -> [Finding]``, called once after every file.
+Suppression is *per line, per rule, with a mandatory justification*::
+
+    deadline = time.monotonic() + 30.0  # lint: allow[deadline-hygiene] ingress stamp
+
+A bare ``allow`` without justification text is itself reported — the
+comment is the audit trail for why the invariant does not apply.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Tuple
+
+ALLOW_RE = re.compile(
+    r"#\s*lint:\s*allow\[(?P<rules>[a-z0-9_,\- ]+)\]\s*(?P<why>.*)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+class FileContext:
+    """One parsed source file handed to every rule."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        norm = path.replace(os.sep, "/")
+        base = os.path.basename(norm)
+        # Fixture snippets are production-SHAPED data (the lint suite's own
+        # known-bad/known-good corpus) — never test-exempt.
+        in_fixtures = "/fixtures/" in norm
+        self.is_test = (not in_fixtures
+                        and ("/tests/" in norm or norm.startswith("tests/")
+                             or base.startswith("test_")
+                             or base in ("conftest.py", "testutil.py")))
+        self.is_bench = base.startswith("bench") or "/examples/" in norm
+
+    def expr_text(self, node: ast.AST) -> str:
+        try:
+            return ast.get_source_segment(self.source, node) or ""
+        except Exception:
+            return ""
+
+
+class Rule:
+    name = "rule"
+    description = ""
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        raise NotImplementedError
+
+    def finalize(self) -> List[Finding]:
+        return []
+
+
+def _comment_tokens(source: str) -> List[Tuple[int, str, bool]]:
+    """(line, comment text, is_own_line) for every REAL comment token —
+    tokenize-based so allow syntax quoted inside a string/docstring is
+    never treated as a directive (nor reported as a bare allow)."""
+    out: List[Tuple[int, str, bool]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                own_line = tok.start[1] == 0 or not tok.line[
+                    :tok.start[1]].strip()
+                out.append((tok.start[0], tok.string, own_line))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # Unparsable source is reported separately (syntax-error finding).
+        pass
+    return out
+
+
+def parse_allows(source: str) -> Tuple[Dict[int, set], List[Tuple[int, str]]]:
+    """Map line number -> set of allowed rule names; plus bare-allow
+    violations (line, text) where the justification is missing."""
+    allows: Dict[int, set] = {}
+    bare: List[Tuple[int, str]] = []
+    for lineno, text, own_line in _comment_tokens(source):
+        m = ALLOW_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        if not m.group("why").strip():
+            bare.append((lineno, text.strip()))
+            continue
+        allows.setdefault(lineno, set()).update(rules)
+        # A comment on its own line suppresses the line below it too.
+        if own_line:
+            allows.setdefault(lineno + 1, set()).update(rules)
+    return allows, bare
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", ".ruff_cache")]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    return sorted(set(out))
+
+
+def run_lint(paths: Iterable[str], rules: List[Rule],
+             skip_fixture_dirs: bool = True) -> List[Finding]:
+    """Run ``rules`` over every .py file under ``paths``; returns surviving
+    findings (allowlisted ones dropped, missing-justification allows added)."""
+    findings: List[Finding] = []
+    allows_by_path: Dict[str, Dict[int, set]] = {}
+    # A gate that lints ZERO files must not read as clean — a typo'd path
+    # (or running from the wrong cwd) would otherwise go green forever.
+    for p in paths:
+        if not os.path.exists(p):
+            findings.append(Finding("io-error", p, 0, 0,
+                                    "path does not exist — nothing linted"))
+    for path in iter_py_files(paths):
+        norm = path.replace(os.sep, "/")
+        if skip_fixture_dirs and "/fixtures/" in norm:
+            # Known-bad lint fixtures exist to flag; the repo gate must not
+            # count them. (Direct invocation on a fixture file still works.)
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            findings.append(Finding("io-error", path, 0, 0, str(e)))
+            continue
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding("syntax-error", path, e.lineno or 0,
+                                    e.offset or 0, e.msg or "syntax error"))
+            continue
+        ctx = FileContext(path, source, tree)
+        allows, bare = parse_allows(source)
+        allows_by_path[path] = allows
+        for line, text in bare:
+            findings.append(Finding(
+                "lint-allow", path, line, 0,
+                f"allow comment without justification: {text!r} — write "
+                f"`# lint: allow[rule] <why this is safe>`"))
+        for rule in rules:
+            for f in rule.check(ctx):
+                if rule.name in allows.get(f.line, ()):
+                    continue
+                findings.append(f)
+    for rule in rules:
+        for f in rule.finalize():
+            # Cross-file findings honor the allowlist too; the file they
+            # point at (e.g. the catalog module) may not be under `paths`,
+            # so parse its allow comments on demand.
+            allows = allows_by_path.get(f.path)
+            if allows is None:
+                try:
+                    with open(f.path, encoding="utf-8") as fh:
+                        allows, _ = parse_allows(fh.read())
+                except OSError:
+                    allows = {}
+                allows_by_path[f.path] = allows
+            if f.rule in allows.get(f.line, ()):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---- small AST helpers shared by rules ----
+
+def build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    """Child -> parent links for one tree (rules needing upward walks)."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def module_imports(tree: ast.AST) -> Dict[str, str]:
+    """Local alias -> imported dotted module, from top-of-tree imports:
+    ``import time as _time`` -> {"_time": "time"}; ``from urllib import
+    request`` -> {"request": "urllib.request"}; ``from x import y as z``
+    -> {"z": "x.y"}."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("?")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted_name(call.func)
+
+
+def kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def is_true(node: Optional[ast.expr]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def str_const(node: Optional[ast.expr]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_no_nested_functions(node: ast.AST):
+    """Yield child statements/expressions without descending into nested
+    function/class bodies (their execution is deferred)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                          ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))

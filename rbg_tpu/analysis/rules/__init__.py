@@ -1,0 +1,36 @@
+"""Rule registry: importing this module registers the five domain rules."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+from rbg_tpu.analysis.core import Rule
+from rbg_tpu.analysis.rules.blocking import BlockingInCriticalSection
+from rbg_tpu.analysis.rules.deadlines import DeadlineHygiene
+from rbg_tpu.analysis.rules.errorcodes import ErrorCodeRegistry
+from rbg_tpu.analysis.rules.metricnames import MetricNameRegistry
+from rbg_tpu.analysis.rules.threads import ThreadLifecycle
+
+RULE_CLASSES: List[Type[Rule]] = [
+    BlockingInCriticalSection,
+    DeadlineHygiene,
+    ErrorCodeRegistry,
+    MetricNameRegistry,
+    ThreadLifecycle,
+]
+
+
+def make_rules(only: List[str] | None = None) -> List[Rule]:
+    """Instantiate the registered rules (fresh cross-file state per run)."""
+    rules = [cls() for cls in RULE_CLASSES]
+    if only:
+        wanted = set(only)
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        rules = [r for r in rules if r.name in wanted]
+    return rules
+
+
+def rule_catalog() -> Dict[str, str]:
+    return {cls.name: cls.description for cls in RULE_CLASSES}

@@ -1,0 +1,140 @@
+"""blocking-in-critical-section: no blocking I/O while holding a lock, and
+no unbounded joins / socket connects in non-test code.
+
+The control plane's loop threads (engine service loop, controller workers,
+the k8s sync/reflect loops) share locks with request threads; one
+``time.sleep`` or RPC under a shared lock turns into tail latency for every
+peer — and one unbounded ``.join()`` is how a drain path hangs forever on
+a wedged thread (the PR-2 leaked-poller class).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from rbg_tpu.analysis.core import (FileContext, Finding, Rule, call_name,
+                                   dotted_name, kwarg, module_imports,
+                                   walk_no_nested_functions)
+
+LOCKISH_RE = re.compile(r"(^|[._])(lock|mutex|rlock)s?$", re.IGNORECASE)
+
+# Module-rooted dotted-name prefixes that block the calling thread on I/O
+# or sleep. The root must actually be an IMPORTED module in the file (a
+# local list named `requests` is not HTTP I/O).
+BLOCKING_PREFIXES = (
+    "time.sleep",
+    "subprocess.",
+    "socket.create_connection",
+    "urllib.request.urlopen",
+    "requests.",
+    "http.client.",
+)
+# Project-local blocking helpers (TCP round trips / wire reads).
+BLOCKING_LOCAL = ("request_once", "recv_msg")
+
+
+def _is_lockish(ctx_expr: ast.expr) -> bool:
+    name = dotted_name(ctx_expr)
+    if not name and isinstance(ctx_expr, ast.Call):
+        name = call_name(ctx_expr)
+    return bool(name) and bool(LOCKISH_RE.search(name))
+
+
+def _blocking_reason(call: ast.Call, imports: dict) -> str:
+    name = call_name(call)
+    if not name:
+        return ""
+    root, _, rest = name.partition(".")
+    module = imports.get(root)
+    if module:
+        canonical = f"{module}.{rest}" if rest else module
+        for prefix in BLOCKING_PREFIXES:
+            if canonical == prefix.rstrip(".") or canonical.startswith(prefix):
+                return name
+    last = name.rsplit(".", 1)[-1]
+    if last in BLOCKING_LOCAL:
+        return name
+    if last == "join" and _joins_thread(call):
+        return name
+    return ""
+
+
+def _joins_thread(call: ast.Call) -> bool:
+    """``x.join()`` with no positional string args: str.join always takes
+    an iterable argument, so a ZERO-argument .join() is a thread/process
+    join — and one without a timeout at that."""
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "join"
+            and not call.args and not call.keywords)
+
+
+class BlockingInCriticalSection(Rule):
+    name = "blocking-in-critical-section"
+    description = ("no sleep / subprocess / socket / HTTP I/O or thread "
+                   "joins inside `with ...lock:` bodies; no unbounded "
+                   ".join() or connect-without-timeout in non-test code")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        imports = module_imports(ctx.tree)
+        findings: List[Finding] = []
+        seen = set()  # nested lock-ish withs must not double-report a call
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.With):
+                if any(_is_lockish(item.context_expr)
+                       for item in node.items):
+                    for f in self._scan_critical(ctx, node, imports):
+                        key = (f.line, f.col)
+                        if key not in seen:
+                            seen.add(key)
+                            findings.append(f)
+        if not ctx.is_test:
+            findings.extend(self._scan_unbounded(ctx, imports))
+        return findings
+
+    def _scan_critical(self, ctx: FileContext, with_node: ast.With,
+                       imports: dict) -> List[Finding]:
+        out: List[Finding] = []
+        for stmt in with_node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # deferred bodies run outside the lock
+            for n in [stmt, *walk_no_nested_functions(stmt)]:
+                if not isinstance(n, ast.Call):
+                    continue
+                reason = _blocking_reason(n, imports)
+                if reason:
+                    out.append(Finding(
+                        self.name, ctx.path, n.lineno, n.col_offset,
+                        f"blocking call `{reason}(...)` inside a critical "
+                        f"section (`with "
+                        f"{ctx.expr_text(with_node.items[0].context_expr)}"
+                        f":` at line {with_node.lineno}) — move the I/O "
+                        f"outside the lock"))
+        return out
+
+    def _scan_unbounded(self, ctx: FileContext,
+                        imports: dict) -> List[Finding]:
+        out: List[Finding] = []
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            name = call_name(n)
+            root, _, rest = name.partition(".")
+            canonical = ""
+            if root in imports:
+                canonical = imports[root] + (f".{rest}" if rest else "")
+            if _joins_thread(n):
+                out.append(Finding(
+                    self.name, ctx.path, n.lineno, n.col_offset,
+                    f"unbounded `{ctx.expr_text(n.func)}()` — pass a "
+                    f"timeout (a wedged thread must not hang the caller "
+                    f"forever)"))
+            elif (canonical == "socket.create_connection"
+                  and len(n.args) < 2 and kwarg(n, "timeout") is None):
+                out.append(Finding(
+                    self.name, ctx.path, n.lineno, n.col_offset,
+                    "socket.create_connection without a timeout — a black-"
+                    "holed peer blocks this thread indefinitely"))
+        return out

@@ -1,0 +1,114 @@
+"""deadline-hygiene: serving-path deadlines derive from the propagated
+ingress stamp, never minted fresh mid-path.
+
+The PR-2 invariant: the router stamps ONE absolute deadline at ingress and
+every hop derives its remaining budget from it. A handler that writes
+``deadline = time.monotonic() + 30.0`` re-ups the budget mid-flight — the
+client's 504 becomes a doomed retry that occupies a batch slot anyway.
+
+Flagged shape: ``time.time()/time.monotonic() + <numeric literal or
+UPPER_CASE constant>`` flowing into a deadline context (assigned to a
+``*deadline*`` name, passed as ``deadline=``, or returned from a
+``*deadline*`` function). Arithmetic on *variables* (``+ timeout_s`` from
+a caller) is the derivation pattern and stays legal. Ingress stamps and
+test helpers carry an allow comment / live in test files.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from rbg_tpu.analysis.core import (FileContext, Finding, Rule,
+                                   build_parents, dotted_name)
+
+TIME_FUNCS = {"time", "monotonic"}
+
+
+def _is_time_call(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in TIME_FUNCS:
+        return isinstance(f.value, ast.Name)  # time.time / _time.monotonic
+    if isinstance(f, ast.Name) and f.id == "monotonic":
+        return True
+    return False
+
+
+def _fresh_budget(node: ast.expr) -> Optional[str]:
+    """The literal/constant budget when ``node`` is ``<time call> + X``
+    with X a number literal or an UPPER_CASE constant name."""
+    if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add)):
+        return None
+    left, right = node.left, node.right
+    if _is_time_call(right):
+        left, right = right, left
+    if not _is_time_call(left):
+        return None
+    if isinstance(right, ast.Constant) and isinstance(right.value,
+                                                     (int, float)):
+        return repr(right.value)
+    if isinstance(right, ast.Name) and right.id.isupper():
+        return right.id
+    return None
+
+
+def _target_names(target: ast.expr):
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _target_names(elt)
+    else:
+        yield dotted_name(target)
+
+
+def _deadline_sink(parent: ast.AST, fn_name: str) -> bool:
+    if isinstance(parent, ast.Assign):
+        return any("deadline" in name.lower()
+                   for t in parent.targets for name in _target_names(t))
+    if isinstance(parent, (ast.AnnAssign, ast.AugAssign)):
+        return any("deadline" in name.lower()
+                   for name in _target_names(parent.target))
+    if isinstance(parent, ast.keyword):
+        return parent.arg is not None and "deadline" in parent.arg.lower()
+    if isinstance(parent, ast.Return):
+        return "deadline" in fn_name.lower()
+    return False
+
+
+class DeadlineHygiene(Rule):
+    name = "deadline-hygiene"
+    description = ("serving deadlines must derive from the propagated "
+                   "ingress stamp — `time.*() + <literal>` deadline "
+                   "creation is forbidden outside ingress/tests")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        if ctx.is_test or ctx.is_bench:
+            return []
+        findings: List[Finding] = []
+        parents = build_parents(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            budget = _fresh_budget(node)
+            if budget is None:
+                continue
+            parent = parents.get(node)
+            # Climb out of value-side containers: in
+            # `a, deadline = x, time.monotonic() + 30.0` the BinOp's parent
+            # is the value Tuple, not the Assign.
+            while isinstance(parent, (ast.Tuple, ast.List)):
+                parent = parents.get(parent)
+            fn = node
+            fn_name = ""
+            while fn in parents:
+                fn = parents[fn]
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn_name = fn.name
+                    break
+            if _deadline_sink(parent, fn_name):
+                findings.append(Finding(
+                    self.name, ctx.path, node.lineno, node.col_offset,
+                    f"fresh deadline minted from `{ctx.expr_text(node)}` — "
+                    f"derive the budget from the propagated request "
+                    f"deadline instead (or mark the ingress stamp with an "
+                    f"allow comment)"))
+        return findings

@@ -1,0 +1,97 @@
+"""error-code-registry: every structured-error ``code`` string comes from
+``rbg_tpu/api/errors.py``.
+
+The wire contract (HTTP mapping, router route-around, stress accounting)
+dispatches on these strings; a literal that drifts from the catalog is a
+silent contract break. Flagged positions: ``code=`` keyword arguments,
+``{"code": ...}`` dict values, ``frame["code"] = ...`` assignments,
+comparisons against ``.code`` / ``["code"]`` / ``.get("code")``, and
+class-level ``code = "..."`` attributes (the ``Rejected`` subclass
+pattern). Integer codes (HTTP statuses) are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from rbg_tpu.analysis.core import FileContext, Finding, Rule, str_const
+
+
+def _catalog() -> frozenset:
+    from rbg_tpu.api import errors
+    return errors.ALL_CODES
+
+
+def _code_ref(node: ast.expr) -> bool:
+    """Does this expression read a structured-error code field?"""
+    if isinstance(node, ast.Attribute) and node.attr == "code":
+        return True
+    if isinstance(node, ast.Subscript):
+        return str_const(node.slice) == "code"
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get" and node.args):
+        return str_const(node.args[0]) == "code"
+    return False
+
+
+class ErrorCodeRegistry(Rule):
+    name = "error-code-registry"
+    description = ("structured-error `code` literals must come from the "
+                   "rbg_tpu/api/errors.py catalog")
+
+    def __init__(self):
+        self.codes = _catalog()
+
+    def _check_literal(self, ctx: FileContext, node: ast.expr,
+                       where: str) -> Optional[Finding]:
+        value = str_const(node)
+        if value is None or value in self.codes:
+            return None
+        return Finding(
+            self.name, ctx.path, node.lineno, node.col_offset,
+            f"error code literal {value!r} ({where}) is not in the "
+            f"api/errors.py catalog — add it there (and import the "
+            f"constant) or fix the typo")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def add(maybe: Optional[Finding]):
+            if maybe is not None:
+                findings.append(maybe)
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg == "code":
+                        add(self._check_literal(ctx, kw.value,
+                                                "code= keyword"))
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if k is not None and str_const(k) == "code":
+                        add(self._check_literal(ctx, v, '"code" dict value'))
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Subscript)
+                            and str_const(tgt.slice) == "code"):
+                        add(self._check_literal(ctx, node.value,
+                                                '["code"] assignment'))
+            elif isinstance(node, ast.ClassDef):
+                for stmt in node.body:
+                    if (isinstance(stmt, ast.Assign)
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == "code"
+                                    for t in stmt.targets)
+                            and str_const(stmt.value) is not None):
+                        add(self._check_literal(ctx, stmt.value,
+                                                f"class {node.name} code "
+                                                f"attribute"))
+            elif isinstance(node, ast.Compare):
+                sides = [node.left, *node.comparators]
+                if any(_code_ref(s) for s in sides):
+                    for s in sides:
+                        add(self._check_literal(ctx, s,
+                                                "compared against a code "
+                                                "field"))
+        return findings

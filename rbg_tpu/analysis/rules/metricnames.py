@@ -1,0 +1,144 @@
+"""metric-name-registry: every ``rbg_*`` metric name is cataloged once in
+``rbg_tpu/obs/names.py`` with a consistent kind.
+
+Flags, at REGISTRY call sites (``inc/counter``, ``set_gauge/gauge``,
+``observe/quantile``):
+
+* ``rbg_*`` string literals not in the catalog (typos / unregistered);
+* names used under the wrong kind (a counter observed as a histogram —
+  the "duplicate registration" class: one name, two metric types);
+* counter names missing the ``_total`` suffix.
+
+And, cross-file at finalize time, the catalog module itself: duplicate
+values across constants and counters without ``_total``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from rbg_tpu.analysis.core import (FileContext, Finding, Rule,
+                                   module_imports, str_const)
+
+CATALOG_MODULE = "rbg_tpu.obs.names"
+
+COUNTER_METHODS = {"inc", "counter"}
+GAUGE_METHODS = {"set_gauge", "gauge"}
+HIST_METHODS = {"observe", "quantile"}
+ALL_METHODS = COUNTER_METHODS | GAUGE_METHODS | HIST_METHODS
+
+
+class MetricNameRegistry(Rule):
+    name = "metric-name-registry"
+    description = ("rbg_* metric names must be cataloged in obs/names.py, "
+                   "used under one kind, and counters must end in _total")
+
+    def __init__(self):
+        from rbg_tpu.obs import names
+        self.counters = names.COUNTERS
+        self.gauges = names.GAUGES
+        self.histograms = names.HISTOGRAMS
+        self.all_names = names.ALL_NAMES
+        self._names_module = names.__file__
+
+    def _kind_of(self, metric: str) -> str:
+        if metric in self.counters:
+            return "counter"
+        if metric in self.gauges:
+            return "gauge"
+        if metric in self.histograms:
+            return "histogram"
+        return ""
+
+    def _resolve_name_arg(self, arg: ast.expr, imports: Dict[str, str]
+                          ) -> str:
+        """The metric name for a literal OR a catalog-constant reference —
+        constants must obey the kind rules too, or the recommended
+        migration would exempt call sites from checking. Only references
+        that provably come from THIS file's import of the catalog module
+        resolve (a foreign module's same-named constant may hold a
+        different value and must not borrow the catalog's)."""
+        lit = str_const(arg)
+        if lit is not None:
+            return lit
+        from rbg_tpu.obs import names as names_mod
+        const = None
+        if (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and imports.get(arg.value.id) == CATALOG_MODULE):
+            const = arg.attr       # names.X via `from rbg_tpu.obs import names [as y]`
+        elif (isinstance(arg, ast.Name)
+              and imports.get(arg.id) == f"{CATALOG_MODULE}.{arg.id}"):
+            const = arg.id         # X via `from rbg_tpu.obs.names import X`
+        if const is not None:
+            value = getattr(names_mod, const, None)
+            if isinstance(value, str):
+                return value
+        return ""
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        imports = module_imports(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ALL_METHODS
+                    and node.args):
+                continue
+            metric = self._resolve_name_arg(node.args[0], imports)
+            if not metric.startswith("rbg_"):
+                continue
+            method = node.func.attr
+            kind = self._kind_of(metric)
+            if not kind:
+                findings.append(Finding(
+                    self.name, ctx.path, node.lineno, node.col_offset,
+                    f"metric name {metric!r} is not in the obs/names.py "
+                    f"catalog — add it (as the right kind) or fix the typo; "
+                    f"then import the constant instead of the literal"))
+                continue
+            expected = ("counter" if method in COUNTER_METHODS else
+                        "gauge" if method in GAUGE_METHODS else "histogram")
+            if kind != expected:
+                findings.append(Finding(
+                    self.name, ctx.path, node.lineno, node.col_offset,
+                    f"metric {metric!r} is cataloged as a {kind} but used "
+                    f"via .{method}() — one name must have one kind"))
+            if (method in COUNTER_METHODS
+                    and not metric.endswith("_total")):
+                findings.append(Finding(
+                    self.name, ctx.path, node.lineno, node.col_offset,
+                    f"counter {metric!r} must end in _total (Prometheus "
+                    f"counter convention)"))
+        return findings
+
+    def finalize(self) -> List[Finding]:
+        """Audit the catalog module itself: duplicate values, bad suffixes."""
+        findings: List[Finding] = []
+        try:
+            with open(self._names_module, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=self._names_module)
+        except (OSError, SyntaxError):
+            return findings
+        seen: Dict[str, str] = {}
+        for node in tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            const = node.targets[0].id
+            value = str_const(node.value)
+            if value is None or not value.startswith("rbg_"):
+                continue
+            if value in seen:
+                findings.append(Finding(
+                    self.name, self._names_module, node.lineno, 0,
+                    f"duplicate metric registration: {const} and "
+                    f"{seen[value]} both name {value!r}"))
+            seen[value] = const
+            if value in self.counters and not value.endswith("_total"):
+                findings.append(Finding(
+                    self.name, self._names_module, node.lineno, 0,
+                    f"cataloged counter {value!r} must end in _total"))
+        return findings

@@ -1,0 +1,179 @@
+"""thread-lifecycle: every ``threading.Thread`` is daemonized or provably
+joined by a stop()/close() path.
+
+The PR-2 postmortem class: a test (or a drain path) that forgets to stop a
+service leaked 100 Hz poller threads whose ambient load then starved
+*other* tests' timing. A thread is acceptable when:
+
+* constructed with ``daemon=True`` (or ``t.daemon = True`` before start);
+* a join is provable: the local variable is joined in the same function,
+  the ``self.attr`` it is stored in is joined by some method of the class
+  (directly or by iterating a list attribute and joining the loop
+  variable), or the list it is appended to is join-iterated.
+
+Anything else — in particular ``threading.Thread(...).start()`` fire-and-
+forget without ``daemon=True`` — is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from rbg_tpu.analysis.core import (FileContext, Finding, Rule, build_parents,
+                                   dotted_name, is_true, kwarg)
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    return name in ("threading.Thread", "Thread")
+
+
+class _Joins:
+    """Join/daemonize facts collected from one scope (function or class)."""
+
+    def __init__(self):
+        self.joined: Set[str] = set()        # x.join(...) receivers
+        self.elem_joined: Set[str] = set()   # for v in X: v.join(...)
+        self.daemonized: Set[str] = set()    # x.daemon = True
+
+    def update_from(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "join"):
+                    recv = dotted_name(n.func.value)
+                    if recv:
+                        self.joined.add(recv)
+                elif isinstance(n, ast.For):
+                    tgt = n.target
+                    it = dotted_name(n.iter)
+                    if not (isinstance(tgt, ast.Name) and it):
+                        continue
+                    for m in ast.walk(n):
+                        if (isinstance(m, ast.Call)
+                                and isinstance(m.func, ast.Attribute)
+                                and m.func.attr == "join"
+                                and isinstance(m.func.value, ast.Name)
+                                and m.func.value.id == tgt.id):
+                            self.elem_joined.add(it)
+                elif (isinstance(n, ast.Assign)
+                      and any(isinstance(t, ast.Attribute)
+                              and t.attr == "daemon"
+                              and is_true(n.value)
+                              for t in n.targets)):
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute):
+                            recv = dotted_name(t.value)
+                            if recv:
+                                self.daemonized.add(recv)
+
+
+class ThreadLifecycle(Rule):
+    name = "thread-lifecycle"
+    description = ("threading.Thread must be daemon=True or provably "
+                   "joined by a stop()/close() path")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        parents = build_parents(ctx.tree)
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_thread_ctor(node):
+                if is_true(kwarg(node, "daemon")):
+                    continue
+                if self._provably_managed(ctx, node, parents):
+                    continue
+                findings.append(Finding(
+                    self.name, ctx.path, node.lineno, node.col_offset,
+                    "thread is neither daemon=True nor provably joined by "
+                    "a stop()/close() path — a leaked thread is ambient "
+                    "load for every other tenant (the PR-2 leaked-poller "
+                    "bug class)"))
+        return findings
+
+    # ---- provability ----
+
+    def _enclosing(self, node: ast.AST, parents: Dict[ast.AST, ast.AST]
+                   ) -> Tuple[Optional[ast.FunctionDef],
+                              Optional[ast.ClassDef]]:
+        fn = cls = None
+        cur = node
+        while cur in parents:
+            cur = parents[cur]
+            if fn is None and isinstance(cur, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef)):
+                fn = cur
+            if isinstance(cur, ast.ClassDef):
+                cls = cur
+                break
+        return fn, cls
+
+    def _class_joins(self, cls: Optional[ast.ClassDef]) -> _Joins:
+        j = _Joins()
+        if cls is not None:
+            for stmt in cls.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    j.update_from(stmt.body)
+        return j
+
+    def _provably_managed(self, ctx: FileContext, call: ast.Call,
+                          parents: Dict[ast.AST, ast.AST]) -> bool:
+        fn, cls = self._enclosing(call, parents)
+        fn_joins = _Joins()
+        if fn is not None:
+            fn_joins.update_from(fn.body)
+        cls_joins = self._class_joins(cls)
+        ok_names = (fn_joins.joined | fn_joins.daemonized | cls_joins.joined
+                    | cls_joins.daemonized)
+        elem_ok = fn_joins.elem_joined | cls_joins.elem_joined
+
+        parent = parents.get(call)
+        # self.attr = Thread(...) / t = Thread(...)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            tgt = dotted_name(parent.targets[0])
+            if tgt and (tgt in ok_names or tgt in elem_ok):
+                return True
+            if tgt and fn is not None:
+                return self._local_flows_to_managed(
+                    fn, tgt, ok_names, elem_ok)
+            return False
+        # X.append(Thread(...))
+        if (isinstance(parent, ast.Call)
+                and isinstance(parent.func, ast.Attribute)
+                and parent.func.attr == "append"):
+            coll = dotted_name(parent.func.value)
+            return bool(coll) and coll in elem_ok
+        # [Thread(...) for ...] assigned to a join-iterated collection
+        comp = parent
+        while comp in parents and isinstance(
+                comp, (ast.ListComp, ast.GeneratorExp, ast.comprehension)):
+            comp = parents[comp]
+        if isinstance(comp, ast.Assign) and len(comp.targets) == 1:
+            coll = dotted_name(comp.targets[0])
+            if coll and coll in elem_ok:
+                return True
+        return False
+
+    def _local_flows_to_managed(self, fn: ast.AST, local: str,
+                                ok_names: Set[str],
+                                elem_ok: Set[str]) -> bool:
+        """`t = Thread(...)` then `self.x = t` / `self.xs.append(t)` where
+        self.x / self.xs is joined elsewhere in the class."""
+        for n in ast.walk(fn):
+            if (isinstance(n, ast.Assign)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == local):
+                for t in n.targets:
+                    tgt = dotted_name(t)
+                    if tgt and (tgt in ok_names or tgt in elem_ok):
+                        return True
+            elif (isinstance(n, ast.Call)
+                  and isinstance(n.func, ast.Attribute)
+                  and n.func.attr == "append"
+                  and any(isinstance(a, ast.Name) and a.id == local
+                          for a in n.args)):
+                coll = dotted_name(n.func.value)
+                if coll and coll in elem_ok:
+                    return True
+        return False

@@ -1,0 +1,70 @@
+"""Canonical catalog of structured error codes.
+
+Every ``code`` that rides the serving wire (``{"error": ..., "code": ...}``
+frames, ``Rejected.to_wire``) or is compared against a reply's ``code``
+field MUST be a constant from this module — the ``error-code-registry``
+lint rule (``rbg_tpu/analysis/rules/errorcodes.py``) flags any string
+literal used in a code position that is not cataloged here.
+
+Why a registry: PRs 2-3 built the serving plane's error contract on these
+strings — the HTTP edge maps them to statuses (429/503/504), the router
+routes around the retryable ones, the stress harness accounts outcomes by
+them. A typo ("overladed") at any of those layers silently breaks the
+contract; a catalog plus a lint rule makes the break a build failure.
+
+This module is dependency-free on purpose: the engine server imports its
+codes before jax loads (see ``engine/protocol.py``), and the lint rule
+parses it statically (AST), so keep it to plain ``NAME = "literal"``
+assignments and simple containers.
+"""
+
+from __future__ import annotations
+
+# ---- structured rejection codes (serving wire) ----
+
+#: Admission control shed the request (queue full / estimated wait too
+#: long). Retryable — the edge maps it to HTTP 429 + Retry-After.
+CODE_OVERLOADED = "overloaded"
+
+#: The client's end-to-end budget is spent (queued too long, or aborted
+#: mid-run). Not retryable — HTTP 504.
+CODE_DEADLINE = "deadline_exceeded"
+
+#: The backend is in SIGTERM drain: in-flight work finishes, new work is
+#: refused. Retryable on a sibling — HTTP 503.
+CODE_DRAINING = "draining"
+
+#: Base code of ``Rejected`` — a structured rejection that is none of the
+#: specific kinds above.
+CODE_REJECTED = "rejected"
+
+#: Codes the router may retry on a sibling backend (a shed or draining
+#: backend is HEALTHY — never evicted).
+RETRYABLE_REJECT_CODES = (CODE_OVERLOADED, CODE_DRAINING)
+
+#: Every cataloged code. The lint rule and the runtime registry check
+#: against this set.
+ALL_CODES = frozenset({
+    CODE_OVERLOADED,
+    CODE_DEADLINE,
+    CODE_DRAINING,
+    CODE_REJECTED,
+})
+
+# ---- HTTP edge mapping (single source for http_frontend) ----
+
+#: code → HTTP status. 429 tells well-behaved clients to back off
+#: (Retry-After carries the backend's hint); 503 marks a draining pod a
+#: load balancer should rotate out; 504 is a spent client deadline.
+CODE_HTTP_STATUS = {
+    CODE_OVERLOADED: 429,
+    CODE_DRAINING: 503,
+    CODE_DEADLINE: 504,
+}
+
+#: code → OpenAI-style error ``type`` string for the JSON error body.
+CODE_HTTP_ETYPE = {
+    CODE_OVERLOADED: "overloaded",
+    CODE_DRAINING: "unavailable",
+    CODE_DEADLINE: "timeout",
+}

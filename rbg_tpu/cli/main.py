@@ -25,6 +25,9 @@ def main(argv=None) -> int:
     if argv and argv[0] == "deploy-manifests":
         from rbg_tpu.cli.deploygen import run as deploygen_run
         return deploygen_run(argv[1:])
+    if argv and argv[0] == "lint":
+        from rbg_tpu.analysis.cli import run as lint_run
+        return lint_run(argv[1:])
 
     parser = argparse.ArgumentParser(
         prog="rbg-tpu",

@@ -30,18 +30,15 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
+from rbg_tpu.api.errors import CODE_HTTP_ETYPE as _CODE_ETYPE
+from rbg_tpu.api.errors import CODE_HTTP_STATUS as _CODE_STATUS
 from rbg_tpu.engine.config import SamplingParams
-from rbg_tpu.engine.protocol import (CODE_DEADLINE, CODE_DRAINING,
-                                     CODE_OVERLOADED, recv_msg, request_once,
-                                     send_msg)
+from rbg_tpu.engine.protocol import recv_msg, request_once, send_msg
 from rbg_tpu.engine.tokenizer import IncrementalDetokenizer, load_tokenizer
 
-# Structured backend rejections → HTTP. 429 tells well-behaved clients to
-# back off (Retry-After carries the backend's hint); 503 marks a draining
-# pod a load balancer should rotate out; 504 is a spent client deadline.
-_CODE_STATUS = {CODE_OVERLOADED: 429, CODE_DRAINING: 503, CODE_DEADLINE: 504}
-_CODE_ETYPE = {CODE_OVERLOADED: "overloaded", CODE_DRAINING: "unavailable",
-               CODE_DEADLINE: "timeout"}
+# Structured backend rejections → HTTP statuses and OpenAI-style error
+# types: the mapping lives with the code catalog (api/errors.py) so the
+# edge and the catalog cannot drift apart.
 MAX_TIMEOUT_S = 600.0
 
 

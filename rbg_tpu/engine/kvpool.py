@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from rbg_tpu.engine.protocol import recv_msg, send_msg, token_ok
+from rbg_tpu.utils.locktrace import named_lock
 
 
 class _Node:
@@ -72,7 +73,7 @@ class KVPoolStore:
         self.max_bytes = max_bytes
         self.root = _Node((), None)
         self.bytes = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("engine.kvpool")
         self.metrics = {"hits": 0, "misses": 0, "hit_tokens": 0,
                         "put_pages": 0, "evicted_pages": 0, "pages": 0}
 

@@ -25,10 +25,12 @@ import numpy as np
 # retryable ones (a shed or draining backend is HEALTHY — never evicted),
 # and every layer increments its own counter. Plain-string "error" replies
 # without a code stay what they always were: application errors.
-CODE_OVERLOADED = "overloaded"          # admission control shed the request
-CODE_DEADLINE = "deadline_exceeded"     # client budget spent (queue or run)
-CODE_DRAINING = "draining"              # backend is in SIGTERM drain
-RETRYABLE_REJECT_CODES = (CODE_OVERLOADED, CODE_DRAINING)
+# Canonical catalog: rbg_tpu/api/errors.py (the error-code-registry lint
+# rule enforces it); re-exported here because the server process imports
+# protocol.py before jax loads and callers already import from here.
+from rbg_tpu.api.errors import (CODE_DEADLINE, CODE_DRAINING,  # noqa: F401
+                                CODE_OVERLOADED, CODE_REJECTED,
+                                RETRYABLE_REJECT_CODES)
 
 
 class Rejected(RuntimeError):
@@ -38,7 +40,7 @@ class Rejected(RuntimeError):
     HERE (not in service.py) so the server process can import it without
     pulling jax before the port binds."""
 
-    code = "rejected"
+    code = CODE_REJECTED
 
     def __init__(self, msg: str, retry_after_s=None):
         super().__init__(msg)

@@ -28,7 +28,9 @@ from rbg_tpu.engine.config import EngineConfig, SamplingParams
 from rbg_tpu.engine.protocol import (CODE_DRAINING, DeadlineExceeded,
                                      Rejected, bundle_from_wire,
                                      bundle_to_wire, recv_msg, send_msg)
+from rbg_tpu.obs import names
 from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.utils.locktrace import named_lock
 
 
 def _deadline_of(obj: dict):
@@ -105,6 +107,7 @@ class Handler(socketserver.BaseRequestHandler):
                 send_msg(self.request, frame)
             sent = 0
             if deadline is None:
+                # lint: allow[deadline-hygiene] ingress fallback: the client sent no timeout_s, so THIS is the one stamp the legacy contract gets
                 deadline = _time.monotonic() + DEFAULT_TIMEOUT_S
             while True:
                 done = pending.done.is_set()
@@ -168,7 +171,7 @@ class Handler(socketserver.BaseRequestHandler):
             # by then either the replacement serves or this address is
             # gone — under a ROLLING drain the router surfaces the fleet's
             # smallest hint to the client.
-            REGISTRY.inc("rbg_serving_drain_refusals_total")
+            REGISTRY.inc(names.SERVING_DRAIN_REFUSALS_TOTAL)
             budget = getattr(srv, "drain_deadline_s", 30.0)
             remaining = max(0.0, budget - (time.monotonic()
                                            - srv.drain_started))
@@ -344,7 +347,7 @@ class Handler(socketserver.BaseRequestHandler):
             if deadline is not None:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not srv.pd_lock.acquire(timeout=remaining):
-                    REGISTRY.inc("rbg_serving_deadline_exceeded_total",
+                    REGISTRY.inc(names.SERVING_DEADLINE_EXCEEDED_TOTAL,
                                  stage="prefill_queue")
                     send_msg(self.request, DeadlineExceeded(
                         "deadline spent waiting for the prefill engine"
@@ -433,8 +436,8 @@ def start_drain(server: EngineServer, drain_deadline_s: float) -> None:
         return
     server.draining = True
     server.drain_started = time.monotonic()
-    REGISTRY.inc("rbg_serving_drains_total")
-    REGISTRY.set_gauge("rbg_serving_draining", 1.0)
+    REGISTRY.inc(names.SERVING_DRAINS_TOTAL)
+    REGISTRY.set_gauge(names.SERVING_DRAINING, 1.0)
     print(f"draining: finishing in-flight work "
           f"(deadline {drain_deadline_s:.1f}s)", flush=True)
 
@@ -479,11 +482,11 @@ def serve(args) -> None:
     server.service = server.prefill = server.decode = None
     server.auth_token = (args.auth_token
                          or os.environ.get("RBG_DATA_TOKEN") or None)
-    server.pd_lock = threading.Lock()
+    server.pd_lock = named_lock("engine.server_pd")
     server.draining = False
     server.drain_started = 0.0
     server._inflight = 0
-    server._inflight_lock = threading.Lock()
+    server._inflight_lock = named_lock("engine.server_inflight")
     max_queue = args.max_queue if args.max_queue > 0 else None
     drain_deadline_s = float(
         args.drain_deadline_s

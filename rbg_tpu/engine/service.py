@@ -22,7 +22,9 @@ from rbg_tpu.engine.engine import Engine
 # protocol.py so jax-free processes (server startup) can import them.
 from rbg_tpu.engine.protocol import (CODE_DEADLINE, DeadlineExceeded,
                                      Overloaded, Rejected)
+from rbg_tpu.obs import names
 from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.utils.locktrace import named_lock
 
 
 class _Pending:
@@ -133,7 +135,7 @@ class _BatchService:
         self.counters = {"shed_total": 0, "deadline_queue_drops": 0,
                          "deadline_running_aborts": 0}
         self._pending: Dict[int, _Pending] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("engine.service_queue")
         self._wake = threading.Event()
         self._stopped = False
         self._queue: List[Tuple[object, SamplingParams, _Pending]] = []
@@ -181,7 +183,7 @@ class _BatchService:
 
     def _shed(self, msg: str, depth: int) -> None:
         self.counters["shed_total"] += 1
-        REGISTRY.inc("rbg_serving_shed_total",
+        REGISTRY.inc(names.SERVING_SHED_TOTAL,
                      service=type(self).__name__.lower())
         raise Overloaded(msg, retry_after_s=self._retry_after_hint(depth))
 
@@ -194,7 +196,7 @@ class _BatchService:
         now = time.monotonic()
         if deadline is not None and now >= deadline:
             self.counters["deadline_queue_drops"] += 1
-            REGISTRY.inc("rbg_serving_deadline_exceeded_total", stage="queue")
+            REGISTRY.inc(names.SERVING_DEADLINE_EXCEEDED_TOTAL, stage="queue")
             raise DeadlineExceeded("deadline already expired at submission")
         p = _Pending(deadline=deadline)
         with self._lock:
@@ -210,7 +212,7 @@ class _BatchService:
                         f"estimated wait {est:.2f}s exceeds remaining "
                         f"deadline budget {deadline - now:.2f}s", depth)
             self._queue.append((item, sampling, p))
-            REGISTRY.observe("rbg_serving_queue_depth", depth + 1)
+            REGISTRY.observe(names.SERVING_QUEUE_DEPTH, depth + 1)
         self._wake.set()
         return p
 
@@ -338,7 +340,7 @@ class _BatchService:
             self.engine.cancel_request(rid)
             del self._pending[rid]
             self.counters["deadline_running_aborts"] += 1
-            REGISTRY.inc("rbg_serving_deadline_exceeded_total",
+            REGISTRY.inc(names.SERVING_DEADLINE_EXCEEDED_TOTAL,
                          stage="running")
             p.error = "deadline exceeded mid-generation (aborted)"
             p.code = CODE_DEADLINE

@@ -36,6 +36,7 @@ from rbg_tpu.k8s.client import ApiError, Conflict, KubeClient, NotFound
 from rbg_tpu.runtime.store import Event, Store
 from rbg_tpu.runtime.store import Conflict as StoreConflict
 from rbg_tpu.runtime.store import NotFound as StoreNotFound
+from rbg_tpu.utils.locktrace import named_lock
 
 log = logging.getLogger("rbg_tpu.k8s")
 
@@ -60,7 +61,7 @@ class K8sPodBackend:
         # operation (watch callbacks must not block).
         self._dirty = [dict() for _ in range(self.SYNC_WORKERS)]
         self._wakes = [threading.Event() for _ in range(self.SYNC_WORKERS)]
-        self._lock = threading.Lock()
+        self._lock = named_lock("k8s.backend_dirty")
         # Last-known mirrored spec images, to detect in-place patches.
         self._mirrored_images: Dict[Tuple[str, str], Dict[str, str]] = {}
         self._threads: list = []
@@ -97,8 +98,12 @@ class K8sPodBackend:
         self._stop.set()
         for w in self._wakes:
             w.set()
+        # The reflector can be parked inside a watch stream for up to
+        # WATCH_WINDOW_S — join past that so stop() really stops the
+        # threads (a reflector outliving its plane kept mutating the store
+        # and burning CPU into the NEXT test's budget).
         for t in self._threads:
-            t.join(timeout=2.0)
+            t.join(timeout=self.WATCH_WINDOW_S + 1.0)
 
     # ---- plane → cluster ----
 
@@ -219,13 +224,18 @@ class K8sPodBackend:
 
     # ---- cluster → plane ----
 
+    # Per-connection watch window: short enough that stop() (which joins
+    # WATCH_WINDOW_S + 1) returns promptly, long enough that idle
+    # reconnects stay cheap (the stream resumes from the rv bookmark).
+    WATCH_WINDOW_S = 2.0
+
     def _reflect_loop(self):
         rv = "0"
         while not self._stop.is_set():
             try:
                 for ev_type, kpod in self.client.watch_pods(
                         label_selector=_SELECTOR, resource_version=rv,
-                        timeout_s=5.0):
+                        timeout_s=self.WATCH_WINDOW_S):
                     if ev_type == "ERROR":
                         # Watch bookmark expired (410 Gone as an event):
                         # fall back to a full re-list.

@@ -430,6 +430,11 @@ class FakeK8sApiServer:
             self.state.lock.notify_all()
         self._httpd.shutdown()
         self._httpd.server_close()
+        # Reap the listener + agent threads so a stopped fake leaves no
+        # ambient load behind for later tests (bounded: both loops check
+        # _stop within ~0.2 s).
+        for t in self._threads:
+            t.join(timeout=2.0)
 
     def __enter__(self):
         return self.start()

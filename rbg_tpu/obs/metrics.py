@@ -8,6 +8,7 @@ in text exposition format, so a scrape sidecar can forward them.
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import defaultdict
 from typing import Dict, Optional, Tuple
@@ -17,19 +18,45 @@ _BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 
 
 class Registry:
-    def __init__(self):
+    """NOTE: this lock stays a plain threading.Lock, not a locktrace
+    named_lock — inversion reporting itself increments a counter, and a
+    traced metrics lock would re-enter here mid-report."""
+
+    def __init__(self, strict: Optional[bool] = None):
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, tuple], float] = defaultdict(float)
         self._hist: Dict[Tuple[str, tuple], list] = {}
         self._gauges: Dict[Tuple[str, tuple], float] = {}
+        # Strict mode (RBG_METRICS_STRICT=1): the runtime complement of
+        # the metric-name-registry lint rule — an rbg_* name emitted under
+        # the wrong kind, or missing from obs/names.py, raises at the call
+        # site instead of silently minting a new series.
+        if strict is None:
+            v = (os.environ.get("RBG_METRICS_STRICT") or "").strip().lower()
+            # Same off-values as RBG_LOCKTRACE: "0"/"false"/"off" disable.
+            strict = bool(v) and v not in ("0", "false", "off")
+        self._strict = strict
+
+    def _check(self, name: str, kind: str):
+        if not (self._strict and name.startswith("rbg_")):
+            return
+        from rbg_tpu.obs import names as _names
+        catalog = {"counter": _names.COUNTERS, "gauge": _names.GAUGES,
+                   "histogram": _names.HISTOGRAMS}[kind]
+        if name not in catalog:
+            raise ValueError(
+                f"metric {name!r} is not cataloged as a {kind} in "
+                f"rbg_tpu/obs/names.py (RBG_METRICS_STRICT is set)")
 
     def inc(self, name: str, value: float = 1.0, **labels):
+        self._check(name, "counter")
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._counters[key] += value
 
     def set_gauge(self, name: str, value: float, **labels):
         """Last-write-wins gauge (queue depth, drain state, ...)."""
+        self._check(name, "gauge")
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._gauges[key] = value
@@ -45,6 +72,7 @@ class Registry:
             return self._counters.get(key, 0.0)
 
     def observe(self, name: str, value: float, **labels):
+        self._check(name, "histogram")
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             h = self._hist.get(key)

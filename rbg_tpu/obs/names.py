@@ -1,0 +1,79 @@
+"""Canonical catalog of ``rbg_*`` metric names.
+
+One module owns every metric name the project emits. Call sites import the
+constant instead of retyping the string — the ``metric-name-registry``
+lint rule (``rbg_tpu/analysis/rules/metricnames.py``) flags any ``rbg_*``
+literal passed to a ``REGISTRY`` method that is not cataloged here, any
+counter whose name is missing the ``_total`` suffix, and any name
+registered under two different kinds (e.g. the same name used as both a
+counter and a gauge).
+
+Naming contract (Prometheus conventions):
+
+* counters end in ``_total``;
+* histograms of durations end in ``_seconds``;
+* gauges are bare nouns (``..._depth``, ``..._draining``).
+
+Keep this module to plain ``NAME = "literal"`` assignments grouped by
+kind — the lint rule parses it statically.
+"""
+
+from __future__ import annotations
+
+# ---- counters (monotonic, name must end in _total) ----
+
+RECONCILE_TOTAL = "rbg_reconcile_total"
+SERVING_SHED_TOTAL = "rbg_serving_shed_total"
+SERVING_DEADLINE_EXCEEDED_TOTAL = "rbg_serving_deadline_exceeded_total"
+SERVING_DRAINS_TOTAL = "rbg_serving_drains_total"
+SERVING_DRAIN_REFUSALS_TOTAL = "rbg_serving_drain_refusals_total"
+DISRUPTION_NOTICES_TOTAL = "rbg_disruption_notices_total"
+DISRUPTION_PREEMPTIONS_TOTAL = "rbg_disruption_preemptions_total"
+DISRUPTION_GANG_KILLS_TOTAL = "rbg_disruption_gang_kills_total"
+DISRUPTION_MIGRATIONS_COMPLETED_TOTAL = (
+    "rbg_disruption_migrations_completed_total")
+DISRUPTION_MIGRATIONS_MISSED_DEADLINE_TOTAL = (
+    "rbg_disruption_migrations_missed_deadline_total")
+DISRUPTION_SLICES_RELEASED_TOTAL = "rbg_disruption_slices_released_total"
+DISRUPTION_SPARES_CONSUMED_TOTAL = "rbg_disruption_spares_consumed_total"
+LOCKTRACE_INVERSIONS_TOTAL = "rbg_locktrace_inversions_total"
+
+# ---- gauges (last-write-wins) ----
+
+SERVING_DRAINING = "rbg_serving_draining"
+DISRUPTION_SPARE_POOL_DEPTH = "rbg_disruption_spare_pool_depth"
+
+# ---- histograms ----
+
+RECONCILE_DURATION_SECONDS = "rbg_reconcile_duration_seconds"
+SERVING_QUEUE_DEPTH = "rbg_serving_queue_depth"
+
+# ---- catalog sets (consumed by the lint rule and strict-mode registry) ----
+
+COUNTERS = frozenset({
+    RECONCILE_TOTAL,
+    SERVING_SHED_TOTAL,
+    SERVING_DEADLINE_EXCEEDED_TOTAL,
+    SERVING_DRAINS_TOTAL,
+    SERVING_DRAIN_REFUSALS_TOTAL,
+    DISRUPTION_NOTICES_TOTAL,
+    DISRUPTION_PREEMPTIONS_TOTAL,
+    DISRUPTION_GANG_KILLS_TOTAL,
+    DISRUPTION_MIGRATIONS_COMPLETED_TOTAL,
+    DISRUPTION_MIGRATIONS_MISSED_DEADLINE_TOTAL,
+    DISRUPTION_SLICES_RELEASED_TOTAL,
+    DISRUPTION_SPARES_CONSUMED_TOTAL,
+    LOCKTRACE_INVERSIONS_TOTAL,
+})
+
+GAUGES = frozenset({
+    SERVING_DRAINING,
+    DISRUPTION_SPARE_POOL_DEPTH,
+})
+
+HISTOGRAMS = frozenset({
+    RECONCILE_DURATION_SECONDS,
+    SERVING_QUEUE_DEPTH,
+})
+
+ALL_NAMES = COUNTERS | GAUGES | HISTOGRAMS

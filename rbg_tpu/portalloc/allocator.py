@@ -14,6 +14,7 @@ import threading
 from typing import Optional
 
 from rbg_tpu.native import load_native
+from rbg_tpu.utils.locktrace import named_lock
 
 DEFAULT_START = 30000
 DEFAULT_RANGE = 5000
@@ -32,7 +33,7 @@ class PortAllocator:
         if self._lib is None:
             self._used = set()
             self._rng = random.Random(seed or None)
-            self._lock = threading.Lock()
+            self._lock = named_lock("portalloc.allocator")
 
     @property
     def native(self) -> bool:

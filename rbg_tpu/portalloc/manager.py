@@ -23,9 +23,10 @@ from typing import Dict, List, Optional
 
 from rbg_tpu.api import constants as C
 from rbg_tpu.portalloc.allocator import PortAllocator
+from rbg_tpu.utils.locktrace import named_lock
 
 _singleton: Optional["PortAllocatorService"] = None
-_lock = threading.Lock()
+_lock = named_lock("portalloc.manager")
 
 
 def parse_port_config(annotations: Dict[str, str]) -> List[dict]:

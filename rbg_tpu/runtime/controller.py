@@ -108,7 +108,7 @@ class Controller:
                                           jitter=True)
         self._threads: List[threading.Thread] = []
         self._started = False
-        self._stopping = False
+        self._stop_event = threading.Event()
 
     # -- override points --
     def watches(self) -> List[Watch]:
@@ -171,11 +171,10 @@ class Controller:
                     self.queue.add(key)
 
     def _resync_loop(self):
-        import time as _time
-        while not self._stopping:
-            _time.sleep(self.resync_period)
-            if self._stopping:
-                return
+        # Event-wait, not sleep: stop() must not leave this thread parked
+        # for a full resync period (300 s of leaked thread per controller
+        # per test plane, before the fix).
+        while not self._stop_event.wait(self.resync_period):
             try:
                 self._enqueue_all()
             except Exception:
@@ -184,22 +183,27 @@ class Controller:
     def _worker(self):
         import time as _time
 
+        from rbg_tpu.obs import names
         from rbg_tpu.obs.metrics import REGISTRY
         while True:
             key = self.queue.get()
-            if key is None:
+            if key is None or self._stop_event.is_set():
+                # Checked HERE, not only via queue.get(): the native
+                # workqueue drains already-queued keys after shutdown, and
+                # post-stop reconciles churn against backends that are
+                # themselves stopping.
                 return
             t0 = _time.perf_counter()
             try:
                 res = self.reconcile(self.store, key)
                 self.backoff.forget(key)
-                REGISTRY.inc("rbg_reconcile_total", controller=self.name,
+                REGISTRY.inc(names.RECONCILE_TOTAL, controller=self.name,
                              result="success")
                 if res is not None and res.requeue_after is not None:
                     self.queue.add_after(key, res.requeue_after)
             except Exception as exc:
                 delay = self.backoff.next_delay(key)
-                REGISTRY.inc("rbg_reconcile_total", controller=self.name,
+                REGISTRY.inc(names.RECONCILE_TOTAL, controller=self.name,
                              result="error")
                 # Conflicts are expected optimistic-concurrency churn (debug);
                 # anything else is a real fault and must be LOUD (warning) —
@@ -213,13 +217,19 @@ class Controller:
                 )
                 self.queue.add_after(key, delay)
             finally:
-                REGISTRY.observe("rbg_reconcile_duration_seconds",
+                REGISTRY.observe(names.RECONCILE_DURATION_SECONDS,
                                  _time.perf_counter() - t0, controller=self.name)
                 self.queue.done(key)
 
     def stop(self):
-        self._stopping = True
+        self._stop_event.set()
         self.queue.shutdown()
+        # Join with a bound: a reconcile stuck in backend I/O must not
+        # hang the caller (the unbounded-join lint invariant), but the
+        # normal case — workers parked in queue.get — exits immediately.
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = [t for t in self._threads if t.is_alive()]
 
 
 class Manager:

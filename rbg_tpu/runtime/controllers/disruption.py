@@ -42,6 +42,7 @@ from typing import Dict, List, Optional
 
 from rbg_tpu.api import constants as C
 from rbg_tpu.api.meta import Condition
+from rbg_tpu.obs import names
 from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.runtime.controller import Controller, Result, Watch
 from rbg_tpu.runtime.store import Conflict, NotFound, Store
@@ -58,13 +59,13 @@ _ANN_CORDONED_BY = C.ANN_CORDONED_BY
 CUTOVER_RESERVE_FRACTION = 0.4
 
 DISRUPTION_COUNTERS = (
-    "rbg_disruption_notices_total",
-    "rbg_disruption_preemptions_total",
-    "rbg_disruption_gang_kills_total",
-    "rbg_disruption_migrations_completed_total",
-    "rbg_disruption_migrations_missed_deadline_total",
-    "rbg_disruption_slices_released_total",
-    "rbg_disruption_spares_consumed_total",
+    names.DISRUPTION_NOTICES_TOTAL,
+    names.DISRUPTION_PREEMPTIONS_TOTAL,
+    names.DISRUPTION_GANG_KILLS_TOTAL,
+    names.DISRUPTION_MIGRATIONS_COMPLETED_TOTAL,
+    names.DISRUPTION_MIGRATIONS_MISSED_DEADLINE_TOTAL,
+    names.DISRUPTION_SLICES_RELEASED_TOTAL,
+    names.DISRUPTION_SPARES_CONSUMED_TOTAL,
 )
 
 
@@ -322,7 +323,7 @@ class DisruptionController(Controller):
 
     def _handle_preemption(self, store, sid, nodes, preempted) -> Optional[Result]:
         self._ack_once(store, preempted, _ANN_PREEMPT_ACKED,
-                       "rbg_disruption_preemptions_total")
+                       names.DISRUPTION_PREEMPTIONS_TOTAL)
         # Cordon every host of the slice — a partially-preempted ICI
         # domain must not receive new binds while the gang recovers.
         self._cordon(store, nodes)
@@ -376,7 +377,7 @@ class DisruptionController(Controller):
             # The per-instance ack (stamped with the slice id) keeps the
             # count at one across reconciles of the same incident.
             if inst is not None and self._ack_gang_kill(store, inst, sid):
-                REGISTRY.inc("rbg_disruption_gang_kills_total")
+                REGISTRY.inc(names.DISRUPTION_GANG_KILLS_TOTAL)
                 store.record_event(
                     inst, "GangPreempted",
                     f"slice {sid} lost hosts; killed {killed} survivor "
@@ -474,7 +475,7 @@ class DisruptionController(Controller):
     def _handle_maintenance(self, store, sid, nodes, maint) -> Optional[Result]:
         deadline = max(n.disruption_deadline for n in maint)
         self._ack_once(store, maint, _ANN_NOTICE_ACKED,
-                       "rbg_disruption_notices_total")
+                       names.DISRUPTION_NOTICES_TOTAL)
         self._cordon(store, nodes)
 
         host_names = {n.metadata.name for n in nodes}
@@ -819,10 +820,10 @@ class DisruptionController(Controller):
             return False  # transient: retry on the next pass
         if not cleared["v"]:
             return True   # lost the race — only the clearing worker counts
-        REGISTRY.inc("rbg_disruption_migrations_completed_total")
+        REGISTRY.inc(names.DISRUPTION_MIGRATIONS_COMPLETED_TOTAL)
         late = now > deadline
         if late:
-            REGISTRY.inc("rbg_disruption_migrations_missed_deadline_total")
+            REGISTRY.inc(names.DISRUPTION_MIGRATIONS_MISSED_DEADLINE_TOTAL)
         store.record_event(
             inst, "MigrationCompleted",
             f"gang serving off the maintenance slice "
@@ -944,7 +945,7 @@ class DisruptionController(Controller):
             except (NotFound, Conflict):
                 pass
         if stamped:
-            REGISTRY.inc("rbg_disruption_slices_released_total")
+            REGISTRY.inc(names.DISRUPTION_SLICES_RELEASED_TOTAL)
             store.record_event(
                 nodes[0], "SliceReleased",
                 f"slice {nodes[0].tpu.slice_id or nodes[0].metadata.name} "

@@ -29,6 +29,7 @@ from typing import Dict, Optional
 
 from rbg_tpu.api import constants as C
 from rbg_tpu.runtime.store import Event, Store
+from rbg_tpu.utils.locktrace import named_lock
 
 
 def _free_port() -> int:
@@ -49,7 +50,7 @@ class LocalExecutor:
         self._procs: Dict[tuple, subprocess.Popen] = {}
         self._ports: Dict[tuple, int] = {}
         self._generations: Dict[tuple, int] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("runtime.executor")
         self._stopped = False
         self._registry: Dict[str, dict] = {}
 

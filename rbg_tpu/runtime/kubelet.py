@@ -18,6 +18,7 @@ from typing import Callable, Optional
 from rbg_tpu.api import constants as C
 from rbg_tpu.api.constants import DOMAIN as _DOMAIN
 from rbg_tpu.runtime.store import Conflict, Event, NotFound, Store
+from rbg_tpu.utils.locktrace import named_lock
 
 
 class FakeKubelet:
@@ -41,7 +42,7 @@ class FakeKubelet:
         # until release_holds() clears the filter and re-walks them.
         self.hold_filter: Optional[Callable[[object], bool]] = None
         self._timers: list = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("runtime.kubelet")
         self._stopped = False
         # Shared pool: a thread PER pod event melted create bursts.
         from concurrent.futures import ThreadPoolExecutor

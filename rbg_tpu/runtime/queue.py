@@ -124,14 +124,18 @@ class WorkQueue:
         deadline = time.monotonic() + timeout if timeout is not None else None
         with self._lock:
             while True:
+                if self._shutdown:
+                    # Drop queued work on shutdown: the controller is
+                    # terminal, and post-stop reconciles churn against
+                    # backends that are themselves stopping (leaked ambient
+                    # load was the PR-2 flake class).
+                    return None
                 next_delay = self._pump_delayed_locked()
                 if self._queue:
                     item = self._queue.pop(0)
                     self._processing.add(item)
                     self._dirty.discard(item)
                     return item
-                if self._shutdown:
-                    return None
                 wait = next_delay
                 if deadline is not None:
                     remaining = deadline - time.monotonic()

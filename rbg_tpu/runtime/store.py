@@ -24,6 +24,7 @@ from collections import defaultdict
 from typing import Callable, Dict, List, Optional, Tuple
 
 from rbg_tpu.api import serde
+from rbg_tpu.utils.locktrace import named_rlock
 from rbg_tpu.api.constants import (
     LABEL_GROUP_NAME, LABEL_INSTANCE_NAME, LABEL_POD_GROUP,
 )
@@ -66,7 +67,7 @@ class Store:
     INDEXED_LABELS = (LABEL_GROUP_NAME, LABEL_INSTANCE_NAME, LABEL_POD_GROUP)
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = named_rlock("runtime.store")
         self._objects: Dict[Key, object] = {}
         self._kind_keys: Dict[str, set] = defaultdict(set)  # kind -> keys
         # (kind, label key, label value) -> keys

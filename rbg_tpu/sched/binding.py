@@ -35,6 +35,7 @@ from typing import Dict, Optional, Set
 
 from rbg_tpu.api import constants as C
 from rbg_tpu.api.pod import NodeAffinityTerm
+from rbg_tpu.utils.locktrace import named_lock
 
 GRANULARITY_POD = "Pod"
 GRANULARITY_COMPONENT = "Component"
@@ -70,7 +71,7 @@ def avoid_terms(annotations: Optional[dict]) -> list:
 
 class NodeBindingStore:
     def __init__(self, store=None):
-        self._lock = threading.Lock()
+        self._lock = named_lock("sched.node_binding")
         self._nodes: Dict[str, Set[str]] = {}   # scope key -> node names
         self._slices: Dict[str, str] = {}       # scope key -> slice id
         self._store = store

@@ -26,6 +26,7 @@ import threading
 from typing import Dict, List, Optional, Set, Tuple
 
 from rbg_tpu.api import constants as C
+from rbg_tpu.utils.locktrace import named_lock, named_rlock
 
 # A pod's footprint in the cache: (node, is_tpu_slice_pod, excl) where
 # excl = (topology_key, domain, group) or None.
@@ -50,7 +51,7 @@ def _pod_contrib(pod, nodes) -> Optional[_Contrib]:
 class CapacityCache:
     def __init__(self, store):
         self.store = store
-        self._lock = threading.RLock()
+        self._lock = named_rlock("sched.capacity_cache")
         self._nodes: Dict[str, object] = {}
         self._bound: Dict[str, int] = {}        # node -> bound active pods
         self._tpu_bound: Dict[str, int] = {}    # node -> bound slice pods
@@ -250,7 +251,7 @@ class SparePool:
 
     def __init__(self, per_topology: int = 0):
         self.per_topology = per_topology
-        self._lock = threading.Lock()
+        self._lock = named_lock("sched.spare_pool")
         self._reserved: Dict[str, str] = {}   # slice_id -> topology
         self._known_topos: Set[str] = set()   # gauge zeroing on drain
         # Slices taken but not yet occupied: a grant's target stays idle
@@ -292,6 +293,7 @@ class SparePool:
              slice_id: Optional[str] = None) -> Optional[str]:
         """Consume one spare (by topology, or a specific slice when the
         scheduler raids the pool). Returns the slice id or None."""
+        from rbg_tpu.obs import names
         from rbg_tpu.obs.metrics import REGISTRY
         with self._lock:
             if slice_id is not None:
@@ -305,7 +307,7 @@ class SparePool:
                     return None
                 del self._reserved[taken]
             self._granted.add(taken)
-        REGISTRY.inc("rbg_disruption_spares_consumed_total")
+        REGISTRY.inc(names.DISRUPTION_SPARES_CONSUMED_TOTAL)
         self._export_depth()
         return taken
 
@@ -387,11 +389,12 @@ class SparePool:
         self._export_depth()
 
     def _export_depth(self) -> None:
+        from rbg_tpu.obs import names
         from rbg_tpu.obs.metrics import REGISTRY
         depth = self.depth()
         with self._lock:
             self._known_topos |= set(depth)
             topos = set(self._known_topos)
         for topo in topos:
-            REGISTRY.set_gauge("rbg_disruption_spare_pool_depth",
+            REGISTRY.set_gauge(names.DISRUPTION_SPARE_POOL_DEPTH,
                                float(depth.get(topo, 0)), topology=topo)

@@ -58,12 +58,9 @@ class SchedulerController(Controller):
         super().start()
 
     def _resync_loop(self):
-        # Piggyback the drift-backstop rebuild on the controller resync.
-        import time as _time
-        while not self._stopping:
-            _time.sleep(self.resync_period)
-            if self._stopping:
-                return
+        # Piggyback the drift-backstop rebuild on the controller resync
+        # (event-wait so stop() exits promptly, as in the base class).
+        while not self._stop_event.wait(self.resync_period):
             try:
                 self.spares.replenish(self.store)
             except Exception:

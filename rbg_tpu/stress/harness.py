@@ -16,7 +16,9 @@ import time
 from typing import Dict, List, Optional
 
 from rbg_tpu.api import constants as C
+from rbg_tpu.api.errors import CODE_DEADLINE, CODE_OVERLOADED
 from rbg_tpu.api.meta import get_condition
+from rbg_tpu.obs import names as metric_names
 from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.runtime.plane import ControlPlane
 from rbg_tpu.testutil import make_group, make_tpu_nodes, simple_role
@@ -201,7 +203,7 @@ def _run(cfg: StressConfig, plane: ControlPlane) -> dict:
         "update_to_converged_ms": _pcts(update_lat),
         "delete_to_gone_ms": _pcts(delete_lat),
         "reconcile_p99_s": {
-            c: REGISTRY.quantile("rbg_reconcile_duration_seconds", 0.99, controller=c)
+            c: REGISTRY.quantile(metric_names.RECONCILE_DURATION_SECONDS, 0.99, controller=c)
             for c in ("rolebasedgroup", "roleinstanceset", "roleinstance", "scheduler")
         },
         "create_phase_profile": create_prof.result,
@@ -248,7 +250,7 @@ def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
                          prefill_chunk=16, use_pallas="never",
                          decode_buckets=(cfg.max_batch,)),
             max_queue=cfg.max_queue)
-    outcomes = {"ok": 0, "overloaded": 0, "deadline_exceeded": 0, "error": 0}
+    outcomes = {"ok": 0, CODE_OVERLOADED: 0, CODE_DEADLINE: 0, "error": 0}
     latencies: List[float] = []
     retry_hints: List[float] = []
     olock = threading.Lock()
@@ -272,13 +274,13 @@ def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
                                     deadline=t0 + cfg.timeout_s)
             except Overloaded as e:
                 with olock:
-                    outcomes["overloaded"] += 1
+                    outcomes[CODE_OVERLOADED] += 1
                     if e.retry_after_s is not None:
                         retry_hints.append(e.retry_after_s)
                 continue
             except DeadlineExceeded:
                 with olock:
-                    outcomes["deadline_exceeded"] += 1
+                    outcomes[CODE_DEADLINE] += 1
                 continue
             except Exception:
                 with olock:
@@ -291,16 +293,22 @@ def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
     prober = threading.Thread(target=probe_depth, daemon=True)
     prober.start()
     t_start = time.perf_counter()
-    threads = [threading.Thread(target=client, args=(i,))
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
                for i in range(cfg.clients)]
+    # Every request a client makes is deadline-bounded (timeout_s), so a
+    # client that outlives its whole budget is WEDGED — join with that
+    # budget instead of forever, and let the all_accounted invariant fail
+    # loudly instead of hanging the harness.
+    client_budget_s = cfg.requests_per_client * cfg.timeout_s + 30.0
     try:
         for t in threads:
             t.start()
+        join_deadline = time.monotonic() + client_budget_s
         for t in threads:
-            t.join()
+            t.join(timeout=max(0.1, join_deadline - time.monotonic()))
     finally:
         stop_probe.set()
-        prober.join()
+        prober.join(timeout=5.0)
         if own:
             service.stop()
     stats = service.service_stats()
@@ -319,7 +327,7 @@ def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
             # The three promises the overload machinery makes:
             "queue_bounded": depth_max[0] <= cfg.max_queue,
             "all_accounted": sum(outcomes.values()) == total,
-            "shed_instead_of_queued": (outcomes["overloaded"] == 0
+            "shed_instead_of_queued": (outcomes[CODE_OVERLOADED] == 0
                                        or stats["shed_total"] > 0),
         },
     }
@@ -493,11 +501,11 @@ def run_preemption(cfg: PreemptionConfig) -> dict:
     after = _counters_snapshot()
     deltas = {k: round(after[k] - before.get(k, 0.0), 1) for k in after}
     inv["disruption_counters_moved"] = (
-        deltas.get("rbg_disruption_preemptions_total", 0) >= 1
-        and deltas.get("rbg_disruption_gang_kills_total", 0) >= 1
-        and deltas.get("rbg_disruption_notices_total", 0) >= 1
-        and deltas.get("rbg_disruption_migrations_completed_total", 0) >= 1
-        and deltas.get("rbg_disruption_migrations_missed_deadline_total",
+        deltas.get(metric_names.DISRUPTION_PREEMPTIONS_TOTAL, 0) >= 1
+        and deltas.get(metric_names.DISRUPTION_GANG_KILLS_TOTAL, 0) >= 1
+        and deltas.get(metric_names.DISRUPTION_NOTICES_TOTAL, 0) >= 1
+        and deltas.get(metric_names.DISRUPTION_MIGRATIONS_COMPLETED_TOTAL, 0) >= 1
+        and deltas.get(metric_names.DISRUPTION_MIGRATIONS_MISSED_DEADLINE_TOTAL,
                        0) == 0)
     return {
         "scenario": "preemption",
@@ -557,7 +565,7 @@ def _router_replay_drill(n_tokens: int) -> dict:
                                       "draining": backend.draining})
                             continue
                         if backend.draining:
-                            frame = {"error": "draining",
+                            frame = {"error": "backend is draining",
                                      "code": CODE_DRAINING, "done": True}
                             if backend.retry_after_s is not None:
                                 frame["retry_after_s"] = backend.retry_after_s
@@ -670,8 +678,17 @@ def main(argv=None) -> int:
     ap.add_argument("--json-out", metavar="FILE",
                     help="also write the JSON report to FILE (committed "
                          "per round like BENCH)")
+    ap.add_argument("--locktrace", action="store_true",
+                    help="run the scenario with the runtime lock-order "
+                         "detector armed (RBG_LOCKTRACE=1): every shared "
+                         "control-plane lock records its acquisition-order "
+                         "graph and an inversion fails the run")
     args = ap.parse_args(argv)
     import os
+    if args.locktrace:
+        # Must be set BEFORE any plane/service objects are constructed —
+        # named_lock reads the env var at lock-construction time.
+        os.environ["RBG_LOCKTRACE"] = "1"
     load1 = os.getloadavg()[0]
     if args.scenario in ("overload", "preemption"):
         if args.scenario == "overload":
@@ -687,6 +704,7 @@ def main(argv=None) -> int:
                 notice_deadline_s=args.notice_s,
                 timeout_s=args.timeout_s))
         report["load1_before"] = round(load1, 2)
+        _attach_locktrace(report, args)
         if args.json_out:
             with open(args.json_out, "w") as f:
                 json.dump(report, f, indent=1)
@@ -706,6 +724,7 @@ def main(argv=None) -> int:
     report["load1_before"] = round(load1, 2)
     report["command"] = "rbg-tpu stress " + " ".join(
         argv if argv is not None else __import__("sys").argv[1:])
+    _attach_locktrace(report, args)
     if args.json_out:
         with open(args.json_out, "w") as f:
             json.dump(report, f, indent=1)
@@ -715,7 +734,22 @@ def main(argv=None) -> int:
         print(json.dumps(report))
     else:
         print(json.dumps(report, indent=2))
+    if report.get("locktrace", {}).get("inversions"):
+        return 1
     return 0
+
+
+def _attach_locktrace(report: dict, args) -> None:
+    """Fold the lock-order graph into the report when --locktrace ran, and
+    add an invariant so an inversion fails the drill like any other red."""
+    if not getattr(args, "locktrace", False):
+        return
+    from rbg_tpu.utils import locktrace
+    report["locktrace"] = {"order_graph": locktrace.snapshot(),
+                           "inversions": locktrace.inversions()}
+    if "invariants" in report:
+        report["invariants"]["lock_order_acyclic"] = (
+            not locktrace.inversions())
 
 
 def _kv_table(d: dict) -> str:

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Static-analysis gate: the domain rules (rbg-tpu lint) + ruff (generic
+# pyflakes/pycodestyle tier, config in pyproject.toml [tool.ruff]).
+#
+#   scripts/lint.sh              # lint rbg_tpu/ (the repo gate)
+#   scripts/lint.sh PATH...      # lint specific files/dirs
+#
+# ruff is OPTIONAL: this container image does not ship it and nothing may
+# be pip-installed here, so when the binary is absent we run the domain
+# rules alone and say so. CI images that have ruff get both tiers.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+PATHS=("$@")
+if [ ${#PATHS[@]} -eq 0 ]; then
+    PATHS=(rbg_tpu)
+fi
+
+rc=0
+
+echo "== rbg-tpu lint ${PATHS[*]} =="
+python -m rbg_tpu.cli.main lint "${PATHS[@]}" || rc=1
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check ${PATHS[*]} =="
+    ruff check "${PATHS[@]}" || rc=1
+else
+    echo "== ruff not installed: skipping the generic tier (domain rules ran) =="
+fi
+
+exit "$rc"

@@ -6,6 +6,9 @@
 #   scripts/tier1.sh              # the gate: run tier-1, print DOTS_PASSED
 #   scripts/tier1.sh --audit      # + pytest --durations=25: find the tests
 #                                 #   to mark `slow` when the budget creeps
+#   scripts/tier1.sh --lint       # static analysis FIRST (scripts/lint.sh:
+#                                 #   rbg-tpu lint + ruff when available),
+#                                 #   then the test gate, same 870 s budget
 #   scripts/tier1.sh [pytest args...]   # extra args pass through
 #
 # Policy (CHANGES.md PR-2): heavy equivalence/e2e drills are marked `slow`
@@ -18,6 +21,13 @@ EXTRA=()
 if [ "${1:-}" = "--audit" ]; then
     shift
     EXTRA+=(--durations=25)
+elif [ "${1:-}" = "--lint" ]; then
+    shift
+    if ! scripts/lint.sh; then
+        echo "TIER1 LINT FAILED — fix the findings (or justify with" \
+             "'# lint: allow[rule] why' inline comments) before running tests" >&2
+        exit 1
+    fi
 fi
 
 LOG=/tmp/_t1.log

@@ -1,0 +1,36 @@
+"""Known-bad fixture: blocking calls inside critical sections + unbounded
+joins. Every marked line MUST be flagged by blocking-in-critical-section."""
+import socket
+import subprocess
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def sleeps_under_lock():
+    with _lock:
+        time.sleep(0.5)  # BAD: sleep in critical section
+
+
+def subprocess_under_lock(self):
+    with self._lock:
+        subprocess.run(["true"])  # BAD: subprocess in critical section
+
+
+def io_under_lock(self, addr):
+    with self.state.lock:
+        socket.create_connection(addr, timeout=1)  # BAD: connect under lock
+
+
+def join_under_lock(t):
+    with _lock:
+        t.join()  # BAD: thread join in critical section (and unbounded)
+
+
+def unbounded_join(t):
+    t.join()  # BAD: no timeout
+
+
+def connect_no_timeout(addr):
+    return socket.create_connection(addr)  # BAD: no timeout

@@ -1,0 +1,32 @@
+"""Known-bad fixture: fresh deadline minting (the PR-2 invariant break)."""
+import time as _time
+
+RETRY_BUDGET_S = 30.0
+
+
+def handler(request):
+    deadline = _time.monotonic() + 30.0  # BAD: fresh literal deadline
+    return run(request, deadline)
+
+
+def retry(request):
+    deadline = _time.time() + RETRY_BUDGET_S  # BAD: fresh constant deadline
+    return run(request, deadline)
+
+
+def submit(service, prompt):
+    return service.submit(prompt, deadline=_time.monotonic() + 5.0)  # BAD
+
+
+def annotated(request):
+    deadline: float = _time.monotonic() + 10.0  # BAD: AnnAssign mint
+    return run(request, deadline)
+
+
+def tupled(request):
+    req, deadline = request, _time.monotonic() + 2.5  # BAD: tuple-target mint
+    return run(req, deadline)
+
+
+def run(request, deadline):
+    return (request, deadline)

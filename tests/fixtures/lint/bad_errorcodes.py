@@ -1,0 +1,24 @@
+"""Known-bad fixture: error-code literals missing from api/errors.py."""
+
+
+class MistypedReject(RuntimeError):
+    code = "overladed"  # BAD: typo, not in catalog
+
+
+def to_wire(msg):
+    return {"error": msg, "code": "drainning"}  # BAD: typo dict value
+
+
+def mark(frame):
+    frame["code"] = "deadline_exceded"  # BAD: typo assignment
+    return frame
+
+
+def route(resp):
+    if resp.get("code") == "over_loaded":  # BAD: typo comparison
+        return "retry"
+    return "fail"
+
+
+def build(make_error):
+    return make_error("boom", code="not_in_catalog")  # BAD: unknown code

@@ -1,0 +1,11 @@
+"""Known-bad fixture: metric-name violations at REGISTRY call sites."""
+from rbg_tpu.obs import names
+from rbg_tpu.obs.metrics import REGISTRY
+
+
+def record():
+    REGISTRY.inc("rbg_serving_sheds_total")          # BAD: typo/unregistered
+    REGISTRY.inc("rbg_serving_queue_depth")          # BAD: histogram via inc
+    REGISTRY.set_gauge("rbg_reconcile_total", 1.0)   # BAD: counter as gauge
+    REGISTRY.observe("rbg_serving_draining", 1.0)    # BAD: gauge observed
+    REGISTRY.observe(names.SERVING_SHED_TOTAL, 1.0)  # BAD: constant, wrong kind

@@ -1,0 +1,21 @@
+"""Known-bad fixture: threads neither daemonized nor provably joined."""
+import threading
+
+
+def fire_and_forget(fn):
+    threading.Thread(target=fn).start()  # BAD: unbound, non-daemon
+
+
+def leaked_local(fn):
+    t = threading.Thread(target=fn)  # BAD: started, never joined
+    t.start()
+    return True
+
+
+class Service:
+    def start(self, fn):
+        self._worker = threading.Thread(target=fn)  # BAD: no stop() joins it
+        self._worker.start()
+
+    def poke(self):
+        return self._worker.is_alive()

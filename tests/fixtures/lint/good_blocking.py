@@ -1,0 +1,33 @@
+"""Known-good fixture: the legal shapes the blocking rule must NOT flag."""
+import socket
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def io_outside_lock(addr):
+    with _lock:
+        snapshot = list(range(3))
+    time.sleep(0.01)                                  # outside the lock
+    return socket.create_connection(addr, timeout=5), snapshot
+
+
+def bounded_join(t):
+    t.join(timeout=2.0)                               # bounded
+
+
+def str_join(parts):
+    return ", ".join(parts)                           # str.join has an arg
+
+
+def deferred_work_under_lock():
+    with _lock:
+        def later():
+            time.sleep(1.0)                           # runs OUTSIDE the lock
+        return later
+
+
+def justified(t):
+    with _lock:
+        t.join()  # lint: allow[blocking-in-critical-section] example justified suppression for the allowlist test

@@ -1,0 +1,22 @@
+"""Known-good fixture: legal deadline derivation (the PR-2 pattern)."""
+import time
+
+
+def derive(request, deadline):
+    remaining = deadline - time.monotonic()          # derive, don't mint
+    return min(remaining, 5.0)
+
+
+def from_wire(obj):
+    t = obj.get("timeout_s")
+    return None if t is None else time.monotonic() + float(t)
+
+
+def hop(service, prompt, deadline):
+    return service.submit(prompt, deadline=deadline)  # propagate verbatim
+
+
+def ingress(request):
+    # lint: allow[deadline-hygiene] example ingress stamp for the allowlist test
+    deadline = time.monotonic() + 30.0
+    return (request, deadline)

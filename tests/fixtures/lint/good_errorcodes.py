@@ -1,0 +1,24 @@
+"""Known-good fixture: codes imported from the canonical catalog."""
+from rbg_tpu.api.errors import CODE_DRAINING, CODE_OVERLOADED
+
+
+class Shed(RuntimeError):
+    code = CODE_OVERLOADED                       # constant, not literal
+
+
+def to_wire(msg):
+    return {"error": msg, "code": CODE_DRAINING}
+
+
+def route(resp):
+    if resp.get("code") == CODE_OVERLOADED:
+        return "retry"
+    # Comparing against a cataloged literal is legal too (the registry
+    # exists to catch drift, not to ban the strings).
+    if resp.get("code") == "draining":
+        return "sibling"
+    return "fail"
+
+
+def http_status(code):
+    return {"status": 429} if code == 429 else {}   # ints are not codes

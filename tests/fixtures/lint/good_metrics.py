@@ -1,0 +1,11 @@
+"""Known-good fixture: cataloged names used under their own kind."""
+from rbg_tpu.obs import names
+from rbg_tpu.obs.metrics import REGISTRY
+
+
+def record(duration):
+    REGISTRY.inc(names.SERVING_SHED_TOTAL, reason="queue_full")
+    REGISTRY.inc("rbg_serving_shed_total")           # cataloged literal: ok
+    REGISTRY.set_gauge(names.SERVING_DRAINING, 1.0)
+    REGISTRY.observe(names.RECONCILE_DURATION_SECONDS, duration)
+    REGISTRY.inc("other_system_total")               # non-rbg_ namespace: ok

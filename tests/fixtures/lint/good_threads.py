@@ -1,0 +1,44 @@
+"""Known-good fixture: daemonized, joined-in-function, and joined-by-stop
+thread lifecycles."""
+import threading
+
+
+def daemonized(fn):
+    threading.Thread(target=fn, daemon=True).start()
+
+
+def daemon_via_attr(fn):
+    t = threading.Thread(target=fn)
+    t.daemon = True
+    t.start()
+
+
+def scoped(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=5.0)
+
+
+def fanout(fn, n):
+    threads = [threading.Thread(target=fn) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5.0)
+
+
+class Service:
+    def __init__(self):
+        self._threads = []
+
+    def start(self, fn):
+        self._worker = threading.Thread(target=fn)
+        self._worker.start()
+        t = threading.Thread(target=fn)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self):
+        self._worker.join(timeout=2.0)
+        for t in self._threads:
+            t.join(timeout=2.0)

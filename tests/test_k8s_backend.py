@@ -246,12 +246,16 @@ def test_group_delete_cleans_cluster(k8s_plane):
 
 
 def test_inplace_update_patches_cluster_pod(k8s_plane):
-    # Deflake note: this test is end-to-end asynchronous — plane reconcile
-    # → REST patch → node-agent ack → watch reflector → plane status, five
-    # thread/HTTP hops that comfortably fit 10 s in isolation but starved
-    # past it when the FULL tier-1 run's ambient load (leaked engine-loop
-    # threads of earlier modules) peaked. Only this test's own fixtures
-    # hold state; the budget below is what actually had to give.
+    # Deflake history: end-to-end asynchronous — plane reconcile → REST
+    # patch → node-agent ack → watch reflector → plane status, five
+    # thread/HTTP hops. Fit 10 s in isolation but starved order-dependently
+    # under the full run's ambient load. Root causes fixed since: every
+    # plane leaked ~8 controller resync threads parked 300 s (stop() now
+    # Event-wakes and joins them), controller workqueues kept draining
+    # reconciles AFTER stop (get() now returns None once shut down), and
+    # the k8s reflector could outlive stop() by its watch window (join now
+    # covers WATCH_WINDOW_S). The thread-lifecycle lint rule guards the
+    # class; the wide budget below stays as load margin.
     srv, cli, plane = k8s_plane
     grp = make_group("svc", simple_role("worker", replicas=1))
     plane.apply(grp)

@@ -1,0 +1,320 @@
+"""rbg-lint: every rule flags its known-bad fixture and passes its
+known-good one; the allowlist syntax suppresses with justification only;
+the CLI gates; locktrace catches a seeded lock inversion."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from rbg_tpu.analysis.core import run_lint
+from rbg_tpu.analysis.rules import make_rules, rule_catalog
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
+
+
+def lint_fixture(fname, rule=None):
+    rules = make_rules([rule] if rule else None)
+    return run_lint([os.path.join(FIXTURES, fname)], rules,
+                    skip_fixture_dirs=False)
+
+
+# ---- each rule: bad flags, good passes ----
+
+
+@pytest.mark.parametrize("rule,bad,good,min_bad", [
+    ("blocking-in-critical-section", "bad_blocking.py",
+     "good_blocking.py", 6),
+    ("deadline-hygiene", "bad_deadline.py", "good_deadline.py", 5),
+    ("error-code-registry", "bad_errorcodes.py", "good_errorcodes.py", 5),
+    ("metric-name-registry", "bad_metrics.py", "good_metrics.py", 5),
+    ("thread-lifecycle", "bad_threads.py", "good_threads.py", 3),
+])
+def test_rule_fires_on_bad_and_passes_good(rule, bad, good, min_bad):
+    bad_findings = [f for f in lint_fixture(bad, rule) if f.rule == rule]
+    assert len(bad_findings) >= min_bad, (
+        f"{rule} found only {[f.render() for f in bad_findings]}")
+    # Every BAD-marked line is caught (the fixture is the rule's contract).
+    src = open(os.path.join(FIXTURES, bad)).readlines()
+    bad_lines = {i for i, line in enumerate(src, 1) if "# BAD" in line}
+    if bad_lines:
+        flagged = {f.line for f in bad_findings}
+        assert bad_lines <= flagged, (
+            f"{rule} missed BAD lines {sorted(bad_lines - flagged)}")
+    good_findings = [f for f in lint_fixture(good, rule) if f.rule == rule]
+    assert good_findings == [], [f.render() for f in good_findings]
+
+
+def test_rule_catalog_names_match():
+    assert set(rule_catalog()) == {
+        "blocking-in-critical-section", "deadline-hygiene",
+        "error-code-registry", "metric-name-registry", "thread-lifecycle"}
+
+
+# ---- allowlist semantics ----
+
+
+def test_allow_comment_requires_justification(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("import time as _t\n"
+                 "def f():\n"
+                 "    # lint: allow[deadline-hygiene]\n"
+                 "    deadline = _t.monotonic() + 3.0\n"
+                 "    return deadline\n")
+    findings = run_lint([str(p)], make_rules())
+    rules = {f.rule for f in findings}
+    # The bare allow is itself a finding AND does not suppress.
+    assert "lint-allow" in rules
+    assert "deadline-hygiene" in rules
+
+
+def test_allow_comment_with_justification_suppresses(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("import time as _t\n"
+                 "def f():\n"
+                 "    # lint: allow[deadline-hygiene] ingress stamp, client sent no budget\n"
+                 "    deadline = _t.monotonic() + 3.0\n"
+                 "    return deadline\n")
+    assert run_lint([str(p)], make_rules()) == []
+
+
+def test_allow_scopes_to_named_rule_only(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("import time as _t\n"
+                 "def f():\n"
+                 "    deadline = _t.monotonic() + 3.0  # lint: allow[thread-lifecycle] wrong rule named\n"
+                 "    return deadline\n")
+    assert {f.rule for f in run_lint([str(p)], make_rules())} == {
+        "deadline-hygiene"}
+
+
+# ---- the repo gate + CLI ----
+
+
+def test_repo_tree_is_clean():
+    """`rbg-tpu lint rbg_tpu/` exits 0 on the final tree (the acceptance
+    gate) — run in-process for speed."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = run_lint([os.path.join(repo, "rbg_tpu")], make_rules())
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "PYTHONPATH": repo_root}
+    bad = os.path.join(FIXTURES, "bad_deadline.py")
+    r = subprocess.run(
+        [sys.executable, "-m", "rbg_tpu.cli.main", "lint",
+         "--include-fixtures", bad],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 1
+    assert "deadline-hygiene" in r.stdout
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "rbg_tpu.cli.main", "lint", str(clean)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "rbg_tpu.cli.main", "lint", "--rule",
+         "no-such-rule", str(clean)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 2
+
+
+def test_fixture_dir_skipped_by_default():
+    """The gate must not count the known-bad corpus."""
+    findings = run_lint([FIXTURES], make_rules())
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_missing_path_is_a_finding(tmp_path):
+    """A typo'd path must not read as a clean gate."""
+    findings = run_lint([str(tmp_path / "no_such_dir")], make_rules())
+    assert [f.rule for f in findings] == ["io-error"]
+
+
+def test_allow_syntax_in_docstring_is_inert(tmp_path):
+    """Documenting the allow syntax inside a string must neither fail the
+    gate (bare form) nor suppress findings (justified form)."""
+    p = tmp_path / "mod.py"
+    p.write_text('import time as _t\n'
+                 'DOC = """use # lint: allow[deadline-hygiene] here"""\n'
+                 'DOC2 = """or # lint: allow[deadline-hygiene] reasons why\n'
+                 'deadline = 1"""\n'
+                 'def f():\n'
+                 '    deadline = _t.monotonic() + 3.0\n'
+                 '    return deadline\n')
+    rules = [f.rule for f in run_lint([str(p)], make_rules())]
+    assert rules == ["deadline-hygiene"]  # no lint-allow, no suppression
+
+
+def test_blocking_prefix_needs_module_import(tmp_path):
+    """A local variable named `requests` is not HTTP I/O."""
+    p = tmp_path / "mod.py"
+    p.write_text("import threading\n"
+                 "_lock = threading.Lock()\n"
+                 "def f(requests, req):\n"
+                 "    with _lock:\n"
+                 "        requests.append(req)\n")
+    assert run_lint([str(p)], make_rules()) == []
+
+
+def test_nested_lock_withs_report_once(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("import threading, time\n"
+                 "a_lock = threading.Lock()\n"
+                 "b_lock = threading.Lock()\n"
+                 "def f():\n"
+                 "    with a_lock:\n"
+                 "        with b_lock:\n"
+                 "            time.sleep(1)\n")
+    findings = [f for f in run_lint([str(p)], make_rules())
+                if f.rule == "blocking-in-critical-section"]
+    assert len(findings) == 1
+
+
+def test_metric_constant_from_foreign_module_not_borrowed(tmp_path):
+    """Only constants imported from the catalog module resolve — a foreign
+    module's same-named constant must not borrow the catalog's value."""
+    p = tmp_path / "mod.py"
+    p.write_text("from mypkg import consts\n"
+                 "from rbg_tpu.obs.metrics import REGISTRY\n"
+                 "def f():\n"
+                 "    REGISTRY.inc(consts.SERVING_SHED_TOTAL)\n")
+    assert run_lint([str(p)], make_rules()) == []  # unresolvable: unchecked
+    p2 = tmp_path / "mod2.py"
+    p2.write_text("from rbg_tpu.obs import names\n"
+                  "from rbg_tpu.obs.metrics import REGISTRY\n"
+                  "def f(dt):\n"
+                  "    REGISTRY.observe(names.SERVING_SHED_TOTAL, dt)\n")
+    findings = run_lint([str(p2)], make_rules())
+    assert any("one name must have one kind" in f.message for f in findings)
+
+
+# ---- metric catalog self-audit ----
+
+
+def test_catalog_duplicate_detection(tmp_path, monkeypatch):
+    from rbg_tpu.analysis.rules.metricnames import MetricNameRegistry
+    rule = MetricNameRegistry()
+    dup = tmp_path / "names.py"
+    dup.write_text('A_TOTAL = "rbg_x_total"\nB_TOTAL = "rbg_x_total"\n'
+                   'BAD_COUNter = "rbg_y"\n')
+    rule._names_module = str(dup)
+    rule.counters = frozenset({"rbg_x_total", "rbg_y"})
+    msgs = [f.message for f in rule.finalize()]
+    assert any("duplicate metric registration" in m for m in msgs)
+    assert any("must end in _total" in m for m in msgs)
+
+
+def test_registry_strict_mode_rejects_uncataloged():
+    from rbg_tpu.obs.metrics import Registry
+    r = Registry(strict=True)
+    r.inc("rbg_serving_shed_total")          # cataloged counter: fine
+    r.inc("unprefixed_counter")              # non-rbg namespace: unchecked
+    with pytest.raises(ValueError):
+        r.inc("rbg_typo_total")              # not cataloged
+    with pytest.raises(ValueError):
+        r.inc("rbg_serving_queue_depth")     # histogram used as counter
+
+
+# ---- locktrace: the runtime half ----
+
+
+@pytest.fixture()
+def traced(monkeypatch):
+    monkeypatch.setenv("RBG_LOCKTRACE", "1")
+    from rbg_tpu.utils import locktrace
+    locktrace.reset()
+    yield locktrace
+    locktrace.reset()
+
+
+def test_locktrace_detects_seeded_inversion(traced):
+    a = traced.named_lock("lockA")
+    b = traced.named_lock("lockB")
+    with a:
+        with b:  # establishes A -> B
+            pass
+    with pytest.raises(traced.LockOrderError) as ei:
+        with b:
+            with a:  # B -> A closes the cycle
+                pass
+    assert "lockA" in str(ei.value) and "lockB" in str(ei.value)
+    assert traced.inversions()
+
+
+def test_locktrace_transitive_cycle(traced):
+    a, b, c = (traced.named_lock(n) for n in ("tA", "tB", "tC"))
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(traced.LockOrderError):
+        with c:
+            with a:  # C -> A via A -> B -> C
+                pass
+
+
+def test_locktrace_consistent_order_is_silent(traced):
+    a = traced.named_lock("okA")
+    b = traced.named_lock("okB")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert traced.inversions() == []
+    assert traced.snapshot().get("okA") == ["okB"]
+
+
+def test_locktrace_rlock_reentrancy_no_self_edge(traced):
+    r = traced.named_rlock("reent")
+    with r:
+        with r:
+            pass
+    assert "reent" not in traced.snapshot()
+
+
+def test_locktrace_warn_mode_counts_instead_of_raising(traced, monkeypatch):
+    monkeypatch.setenv("RBG_LOCKTRACE", "warn")
+    from rbg_tpu.obs.metrics import REGISTRY
+    from rbg_tpu.obs.names import LOCKTRACE_INVERSIONS_TOTAL
+    before = REGISTRY.counter(LOCKTRACE_INVERSIONS_TOTAL)
+    a = traced.named_lock("wA")
+    b = traced.named_lock("wB")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # inversion: logged + counted, not raised
+            pass
+    assert REGISTRY.counter(LOCKTRACE_INVERSIONS_TOTAL) == before + 1
+    assert len(traced.inversions()) == 1
+
+
+def test_locktrace_disabled_returns_stdlib_locks(monkeypatch):
+    monkeypatch.delenv("RBG_LOCKTRACE", raising=False)
+    from rbg_tpu.utils import locktrace
+    lock = locktrace.named_lock("plain")
+    assert not isinstance(lock, locktrace.TracedLock)
+    with lock:
+        pass
+
+
+def test_plane_lifecycle_under_locktrace(traced):
+    """A full fake-backend plane converges with tracing armed and records
+    an acyclic order graph (the integration the stress --locktrace flag
+    relies on)."""
+    from rbg_tpu.runtime.plane import ControlPlane
+    from rbg_tpu.testutil import make_group, make_tpu_nodes, simple_role
+
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=2, hosts_per_slice=2)
+    with plane:
+        plane.apply(make_group("svc", simple_role("worker", replicas=2)))
+        plane.wait_group_ready("svc", timeout=30)
+    assert traced.inversions() == []

@@ -1,16 +1,52 @@
 """``rbg-tpu lint`` — run the domain rules over source trees.
 
 Exit codes: 0 clean, 1 findings, 2 usage/internal error. ``--format json``
-emits machine-readable findings for tooling; the default text form is
-one ``path:line:col: [rule] message`` per finding.
+emits machine-readable findings (``file``/``line``/``col``/``rule``/
+``message``/``severity``) for tooling; the default text form is one
+``path:line:col: [rule] message`` per finding. ``--changed`` lints only
+files touched vs ``git HEAD`` (plus untracked) — the fast pre-commit mode.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Tuple
+
+
+def _git_changed_files() -> Tuple[str, List[str]]:
+    """(repo toplevel, changed .py files abs paths): worktree+index diff vs
+    HEAD plus untracked files. Raises on any git failure."""
+
+    def git(*argv: str, cwd: Optional[str] = None) -> List[str]:
+        r = subprocess.run(["git", *argv], capture_output=True, text=True,
+                           timeout=30, cwd=cwd)
+        if r.returncode != 0:
+            raise RuntimeError(r.stderr.strip() or f"git {argv[0]} failed")
+        return [ln for ln in r.stdout.splitlines() if ln.strip()]
+
+    top = git("rev-parse", "--show-toplevel")[0]
+    names = git("diff", "--name-only", "HEAD", cwd=top)
+    names += git("ls-files", "--others", "--exclude-standard", cwd=top)
+    out = []
+    for n in sorted(set(names)):
+        if n.endswith(".py"):
+            p = os.path.join(top, n)
+            if os.path.exists(p):  # deleted files have nothing to lint
+                out.append(p)
+    return top, out
+
+
+def _under(path: str, roots: List[str]) -> bool:
+    ap = os.path.abspath(path)
+    for r in roots:
+        ar = os.path.abspath(r)
+        if ap == ar or ap.startswith(ar.rstrip(os.sep) + os.sep):
+            return True
+    return False
 
 
 def run(argv: Optional[List[str]] = None) -> int:
@@ -28,6 +64,10 @@ def run(argv: Optional[List[str]] = None) -> int:
                         help="print the rule catalog and exit")
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only files changed vs git HEAD (plus "
+                             "untracked), intersected with PATHS — the "
+                             "fast pre-commit mode")
     parser.add_argument("--include-fixtures", action="store_true",
                         help="lint tests/fixtures too (they are known-bad "
                              "by design and skipped by default)")
@@ -48,10 +88,38 @@ def run(argv: Optional[List[str]] = None) -> int:
         return 2
 
     paths = args.paths or ["rbg_tpu"]
+    if args.changed:
+        try:
+            _, changed = _git_changed_files()
+        except Exception as e:
+            print(f"rbg-tpu lint: --changed needs a git checkout: {e}",
+                  file=sys.stderr)
+            return 2
+        roots = args.paths or ["rbg_tpu"]
+        missing = [r for r in roots if not os.path.exists(r)]
+        if missing:
+            # A typo'd PATH must not read as "nothing changed ⇒ clean" —
+            # plain mode would emit an io-error finding for the same typo.
+            print("rbg-tpu lint: no such path(s): " + " ".join(missing),
+                  file=sys.stderr)
+            return 2
+        paths = [f for f in changed if _under(f, roots)]
+        if not paths:
+            # Nothing touched: legitimately clean (unlike a typo'd path).
+            if args.format == "json":
+                print("[]")
+            else:
+                print("rbg-tpu lint: no changed python files under "
+                      f"{' '.join(args.paths or ['rbg_tpu'])}",
+                      file=sys.stderr)
+            return 0
     findings = run_lint(paths, rules,
                         skip_fixture_dirs=not args.include_fixtures)
     if args.format == "json":
-        print(json.dumps([vars(f) for f in findings], indent=2))
+        print(json.dumps([{
+            "file": f.path, "line": f.line, "col": f.col, "rule": f.rule,
+            "message": f.message, "severity": f.severity,
+        } for f in findings], indent=2))
     else:
         for f in findings:
             print(f.render())

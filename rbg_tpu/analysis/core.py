@@ -3,12 +3,18 @@
 Design: each rule is a class with a ``name``, a ``description``, and a
 ``check(ctx) -> [Finding]`` over one parsed file; rules needing cross-file
 state implement ``finalize() -> [Finding]``, called once after every file.
-Suppression is *per line, per rule, with a mandatory justification*::
+Every file is parsed ONCE per run (``parse_module`` memo) and the parsed
+``FileContext`` carries the shared resolution layer — parent links and the
+import table — computed lazily and cached, so no rule re-walks what another
+already derived. Suppression is *per line, per rule, with a mandatory
+justification*::
 
     deadline = time.monotonic() + 30.0  # lint: allow[deadline-hygiene] ingress stamp
 
 A bare ``allow`` without justification text is itself reported — the
-comment is the audit trail for why the invariant does not apply.
+comment is the audit trail for why the invariant does not apply. And an
+allow whose rule no longer fires on that line is reported as
+``stale-allow``: suppressions must rot OUT of the tree, not in it.
 """
 
 from __future__ import annotations
@@ -19,10 +25,14 @@ import io
 import os
 import re
 import tokenize
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 ALLOW_RE = re.compile(
     r"#\s*lint:\s*allow\[(?P<rules>[a-z0-9_,\- ]+)\]\s*(?P<why>.*)")
+
+# Rules implemented by the framework itself (not Rule classes): an allow
+# naming one of these is never checked for staleness against the rule set.
+BUILTIN_FINDINGS = {"io-error", "syntax-error", "lint-allow", "stale-allow"}
 
 
 @dataclasses.dataclass
@@ -32,13 +42,43 @@ class Finding:
     line: int
     col: int
     message: str
+    severity: str = "error"
 
     def render(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
 
 
+# ---- one-parse-per-file memo ----
+#
+# run_lint() clears this at entry; every parse inside a run — the per-file
+# walk AND any cross-file lookups a rule makes at finalize time (e.g. the
+# metric catalog module) — goes through parse_module, so a file is parsed
+# exactly once per run no matter how many rules consult it.
+
+_PARSE_MEMO: Dict[str, Tuple[str, ast.AST]] = {}
+
+
+def clear_parse_memo() -> None:
+    _PARSE_MEMO.clear()
+
+
+def parse_module(path: str) -> Tuple[str, ast.AST]:
+    """(source, tree) for ``path``, memoized per lint run. Raises OSError /
+    SyntaxError like open()/ast.parse() would."""
+    key = os.path.abspath(path)
+    hit = _PARSE_MEMO.get(key)
+    if hit is not None:
+        return hit
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    tree = ast.parse(source, filename=path)
+    _PARSE_MEMO[key] = (source, tree)
+    return source, tree
+
+
 class FileContext:
-    """One parsed source file handed to every rule."""
+    """One parsed source file handed to every rule, carrying the shared
+    resolution layer (parent links, import table) computed once."""
 
     def __init__(self, path: str, source: str, tree: ast.AST):
         self.path = path
@@ -55,12 +95,35 @@ class FileContext:
                              or base.startswith("test_")
                              or base in ("conftest.py", "testutil.py")))
         self.is_bench = base.startswith("bench") or "/examples/" in norm
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+        self._imports: Optional[Dict[str, str]] = None
+        self._comments: Optional[List[Tuple[int, str, bool]]] = None
+        self._module_index = None  # lazily built by analysis.ipe
 
     def expr_text(self, node: ast.AST) -> str:
         try:
             return ast.get_source_segment(self.source, node) or ""
         except Exception:
             return ""
+
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        """Child -> parent links, computed once per file per run."""
+        if self._parents is None:
+            self._parents = build_parents(self.tree)
+        return self._parents
+
+    def imports(self) -> Dict[str, str]:
+        """Local alias -> imported dotted module, computed once per file."""
+        if self._imports is None:
+            self._imports = module_imports(self.tree)
+        return self._imports
+
+    def comment_tokens(self) -> List[Tuple[int, str, bool]]:
+        """The tokenized comment stream, computed once per file per run —
+        shared by allow parsing and the guarded_by comment scan."""
+        if self._comments is None:
+            self._comments = _comment_tokens(self.source)
+        return self._comments
 
 
 class Rule:
@@ -91,12 +154,30 @@ def _comment_tokens(source: str) -> List[Tuple[int, str, bool]]:
     return out
 
 
-def parse_allows(source: str) -> Tuple[Dict[int, set], List[Tuple[int, str]]]:
-    """Map line number -> set of allowed rule names; plus bare-allow
-    violations (line, text) where the justification is missing."""
+@dataclasses.dataclass(frozen=True)
+class AllowRecord:
+    """One allow comment: the line it sits on, every line it covers (its
+    own, plus the next when it stands alone), and the rules it names."""
+    comment_line: int
+    lines: frozenset
+    rules: frozenset
+
+
+def parse_allows(source: str,
+                 tokens: Optional[List[Tuple[int, str, bool]]] = None
+                 ) -> Tuple[Dict[int, set],
+                            List[Tuple[int, str]],
+                            List[AllowRecord]]:
+    """Map line number -> set of allowed rule names; bare-allow violations
+    (line, text) where the justification is missing; and the full allow
+    records (for staleness auditing). Pass ``tokens`` (from
+    ``FileContext.comment_tokens()``) to reuse an already-tokenized
+    comment stream instead of re-tokenizing ``source``."""
     allows: Dict[int, set] = {}
     bare: List[Tuple[int, str]] = []
-    for lineno, text, own_line in _comment_tokens(source):
+    records: List[AllowRecord] = []
+    for lineno, text, own_line in (tokens if tokens is not None
+                                   else _comment_tokens(source)):
         m = ALLOW_RE.search(text)
         if not m:
             continue
@@ -104,11 +185,15 @@ def parse_allows(source: str) -> Tuple[Dict[int, set], List[Tuple[int, str]]]:
         if not m.group("why").strip():
             bare.append((lineno, text.strip()))
             continue
+        covered = {lineno}
         allows.setdefault(lineno, set()).update(rules)
         # A comment on its own line suppresses the line below it too.
         if own_line:
+            covered.add(lineno + 1)
             allows.setdefault(lineno + 1, set()).update(rules)
-    return allows, bare
+        records.append(AllowRecord(lineno, frozenset(covered),
+                                   frozenset(rules)))
+    return allows, bare, records
 
 
 def iter_py_files(paths: Iterable[str]) -> List[str]:
@@ -130,9 +215,14 @@ def iter_py_files(paths: Iterable[str]) -> List[str]:
 def run_lint(paths: Iterable[str], rules: List[Rule],
              skip_fixture_dirs: bool = True) -> List[Finding]:
     """Run ``rules`` over every .py file under ``paths``; returns surviving
-    findings (allowlisted ones dropped, missing-justification allows added)."""
+    findings (allowlisted ones dropped, missing-justification allows added,
+    stale allows — suppressions whose rule no longer fires — reported)."""
+    clear_parse_memo()
     findings: List[Finding] = []
     allows_by_path: Dict[str, Dict[int, set]] = {}
+    records_by_path: Dict[str, List[AllowRecord]] = {}
+    used: Set[Tuple[str, int, str]] = set()  # (path, line, rule) suppressions
+    running = {r.name for r in rules}
     # A gate that lints ZERO files must not read as clean — a typo'd path
     # (or running from the wrong cwd) would otherwise go green forever.
     for p in paths:
@@ -146,20 +236,18 @@ def run_lint(paths: Iterable[str], rules: List[Rule],
             # count them. (Direct invocation on a fixture file still works.)
             continue
         try:
-            with open(path, encoding="utf-8") as f:
-                source = f.read()
+            source, tree = parse_module(path)
         except OSError as e:
             findings.append(Finding("io-error", path, 0, 0, str(e)))
             continue
-        try:
-            tree = ast.parse(source, filename=path)
         except SyntaxError as e:
             findings.append(Finding("syntax-error", path, e.lineno or 0,
                                     e.offset or 0, e.msg or "syntax error"))
             continue
         ctx = FileContext(path, source, tree)
-        allows, bare = parse_allows(source)
+        allows, bare, records = parse_allows(source, ctx.comment_tokens())
         allows_by_path[path] = allows
+        records_by_path[path] = records
         for line, text in bare:
             findings.append(Finding(
                 "lint-allow", path, line, 0,
@@ -168,6 +256,7 @@ def run_lint(paths: Iterable[str], rules: List[Rule],
         for rule in rules:
             for f in rule.check(ctx):
                 if rule.name in allows.get(f.line, ()):
+                    used.add((path, f.line, rule.name))
                     continue
                 findings.append(f)
     for rule in rules:
@@ -179,13 +268,31 @@ def run_lint(paths: Iterable[str], rules: List[Rule],
             if allows is None:
                 try:
                     with open(f.path, encoding="utf-8") as fh:
-                        allows, _ = parse_allows(fh.read())
+                        allows, _, _ = parse_allows(fh.read())
                 except OSError:
                     allows = {}
                 allows_by_path[f.path] = allows
             if f.rule in allows.get(f.line, ()):
+                used.add((f.path, f.line, f.rule))
                 continue
             findings.append(f)
+    # Stale-allow audit: an allow naming a rule that RAN but fired nothing
+    # on any covered line is dead weight — the code was fixed (or the
+    # comment drifted) and the suppression must go before it hides the
+    # next real finding on that line.
+    for path, records in records_by_path.items():
+        for rec in records:
+            for rule_name in sorted(rec.rules):
+                if rule_name in BUILTIN_FINDINGS or rule_name not in running:
+                    continue
+                if any((path, line, rule_name) in used for line in rec.lines):
+                    continue
+                findings.append(Finding(
+                    "stale-allow", path, rec.comment_line, 0,
+                    f"stale suppression: `allow[{rule_name}]` but the rule "
+                    f"no longer fires here — delete the comment (a rotting "
+                    f"allow hides the next real finding on this line)",
+                    severity="warning"))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -193,7 +300,8 @@ def run_lint(paths: Iterable[str], rules: List[Rule],
 # ---- small AST helpers shared by rules ----
 
 def build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
-    """Child -> parent links for one tree (rules needing upward walks)."""
+    """Child -> parent links for one tree. Prefer ``ctx.parents()`` — it
+    caches this walk per file per run."""
     parents: Dict[ast.AST, ast.AST] = {}
     for node in ast.walk(tree):
         for child in ast.iter_child_nodes(node):
@@ -205,7 +313,7 @@ def module_imports(tree: ast.AST) -> Dict[str, str]:
     """Local alias -> imported dotted module, from top-of-tree imports:
     ``import time as _time`` -> {"_time": "time"}; ``from urllib import
     request`` -> {"request": "urllib.request"}; ``from x import y as z``
-    -> {"z": "x.y"}."""
+    -> {"z": "x.y"}. Prefer ``ctx.imports()`` (cached)."""
     out: Dict[str, str] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):

@@ -1,0 +1,349 @@
+"""Interprocedural resolution layer: module symbol table + call graph.
+
+The per-file AST walk the original rules did cannot answer "is this helper
+only ever called with the store lock held?" — that needs a module-level
+symbol table (which classes exist, which attribute holds which named lock,
+which fields are annotated ``# guarded_by[...]``) and a call graph so lock
+context propagates through helper calls. This module builds both, once per
+file per lint run (cached on the :class:`~rbg_tpu.analysis.core.FileContext`),
+and exposes the lock-held analysis the ``guarded-by`` rule and the runtime
+race tracer share.
+
+Conventions resolved here:
+
+* ``self._lock = named_lock("sched.spare_pool")`` (or ``named_rlock`` /
+  ``named_condition``) binds the attribute ``_lock`` to the lock *name*
+  ``sched.spare_pool`` for the whole class; module-level
+  ``_lock = named_lock(...)`` does the same at module scope.
+* ``self._reserved: Dict[str, str] = {}  # guarded_by[sched.spare_pool]``
+  registers ``_reserved`` as guarded by that named lock. The comment may
+  also stand alone on the line above the assignment. Class-level
+  (dataclass-style) ``field: T = default`` annotations work the same way.
+* Lock context is lexical ``with`` blocks over a resolved lock attribute
+  (``with self._lock:`` / ``with _lock:``). Manual ``.acquire()`` calls
+  are NOT modeled — use ``with`` (everything in this tree does).
+
+Propagation model: a method's guarded access is clean when the guarding
+lock is held lexically, or when the method is *lock-held* — every in-class
+call site holds the lock (lexically or because the calling method is
+itself lock-held; computed as a greatest fixpoint, so helper chains of any
+depth and mutual recursion resolve). ``__init__`` is construction — its
+writes are exempt (no peer can hold a reference yet) and it never counts
+as a lock-held context for helpers it calls.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from rbg_tpu.analysis.core import FileContext, _comment_tokens
+
+GUARDED_RE = re.compile(r"#\s*guarded_by\[(?P<lock>[A-Za-z0-9_.\-]+)\]")
+
+# Constructors from rbg_tpu.utils.locktrace that bind a lock NAME.
+LOCK_CTORS = {"named_lock", "named_rlock", "named_condition"}
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardedField:
+    name: str
+    lock: str
+    lineno: int
+
+
+@dataclasses.dataclass
+class Access:
+    """One read/write of a guarded name inside a function body."""
+    node: ast.AST
+    field: GuardedField
+    held: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class CallSite:
+    callee: str
+    caller: str
+    lineno: int
+    held: FrozenSet[str]
+
+
+class ScopeIndex:
+    """Symbol table + per-function analysis for one scope (a class, or the
+    module level): lock attrs, guarded fields, and for every function the
+    guarded accesses and intra-scope call sites with the lexically-held
+    lock set at each."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.lock_attrs: Dict[str, str] = {}      # attr/var -> lock name
+        self.guarded: Dict[str, GuardedField] = {}
+        self.functions: Dict[str, ast.AST] = {}
+        self.accesses: Dict[str, List[Access]] = {}
+        self.calls: List[CallSite] = []
+        self._locked_memo: Dict[str, Set[str]] = {}
+
+    # ---- lock-held fixpoint ----
+
+    def call_sites(self, callee: str) -> List[CallSite]:
+        return [c for c in self.calls if c.callee == callee]
+
+    def locked_methods(self, lock: str) -> Set[str]:
+        """Functions of this scope reachable ONLY with ``lock`` held:
+        greatest fixpoint over "every in-scope call site holds the lock
+        (lexically, or the caller is itself in the set)". ``__init__``
+        never qualifies and never transfers lock context."""
+        memo = self._locked_memo.get(lock)
+        if memo is not None:
+            return memo
+        callers: Dict[str, List[CallSite]] = {}
+        for c in self.calls:
+            callers.setdefault(c.callee, []).append(c)
+        live = {m for m in self.functions
+                if m != "__init__" and callers.get(m)}
+        changed = True
+        while changed:
+            changed = False
+            for m in list(live):
+                for site in callers[m]:
+                    ok = (lock in site.held
+                          or (site.caller in live
+                              and site.caller != "__init__"))
+                    if not ok:
+                        live.discard(m)
+                        changed = True
+                        break
+        self._locked_memo[lock] = live
+        return live
+
+    def unlocked_call_site(self, method: str, lock: str
+                           ) -> Optional[CallSite]:
+        """A call site of ``method`` that does NOT hold ``lock`` (for the
+        finding message), or None if the method has no in-scope callers."""
+        locked = self.locked_methods(lock)
+        for site in self.call_sites(method):
+            if lock not in site.held and (site.caller not in locked
+                                          or site.caller == "__init__"):
+                return site
+        return None
+
+
+class ModuleIndex:
+    def __init__(self, path: str):
+        self.path = path
+        self.classes: Dict[str, ScopeIndex] = {}
+        self.module: ScopeIndex = ScopeIndex("<module>")
+
+
+def _guard_comments(tokens: List[Tuple[int, str, bool]]) -> Dict[int, str]:
+    """line -> lock name for every ``# guarded_by[...]`` comment; an
+    own-line comment covers the line below it too."""
+    out: Dict[int, str] = {}
+    for lineno, text, own_line in tokens:
+        m = GUARDED_RE.search(text)
+        if not m:
+            continue
+        out[lineno] = m.group("lock")
+        if own_line:
+            out.setdefault(lineno + 1, m.group("lock"))
+    return out
+
+
+def _lock_ctor_name(value: ast.expr) -> Optional[str]:
+    """The lock name when ``value`` is ``named_lock("x")`` (or rlock /
+    condition, possibly module-qualified), else None."""
+    if not (isinstance(value, ast.Call) and value.args):
+        return None
+    fn = value.func
+    last = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else "")
+    if last not in LOCK_CTORS:
+        return None
+    arg = value.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def _stmt_lines(stmt: ast.stmt) -> range:
+    return range(stmt.lineno, (getattr(stmt, "end_lineno", None)
+                               or stmt.lineno) + 1)
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _register_fields(scope: ScopeIndex, stmt: ast.stmt,
+                     comments: Dict[int, str], self_scope: bool) -> None:
+    """Register guarded fields / lock attrs declared by one assignment."""
+    targets: List[ast.expr] = []
+    value: Optional[ast.expr] = None
+    if isinstance(stmt, ast.Assign):
+        targets, value = stmt.targets, stmt.value
+    elif isinstance(stmt, ast.AnnAssign):
+        targets, value = [stmt.target], stmt.value
+    else:
+        return
+    names = []
+    for t in targets:
+        if self_scope:
+            attr = _self_attr(t)
+            if attr:
+                names.append(attr)
+        elif isinstance(t, ast.Name):
+            names.append(t.id)
+    if not names:
+        return
+    lock_name = _lock_ctor_name(value) if value is not None else None
+    if lock_name is not None:
+        for n in names:
+            scope.lock_attrs[n] = lock_name
+        return
+    guard = next((comments[ln] for ln in _stmt_lines(stmt)
+                  if ln in comments), None)
+    if guard is not None:
+        for n in names:
+            scope.guarded.setdefault(
+                n, GuardedField(n, guard, stmt.lineno))
+
+
+class _BodyWalker:
+    """Walk one function body tracking the lexically-held lock set;
+    collects guarded accesses and intra-scope calls. Nested function /
+    lambda / class bodies are skipped (deferred execution — their lock
+    context is unknowable lexically)."""
+
+    def __init__(self, scope: ScopeIndex, fn_name: str, self_scope: bool,
+                 module_locks: Dict[str, str]):
+        self.scope = scope
+        self.fn = fn_name
+        self.self_scope = self_scope
+        self.module_locks = module_locks
+        self.accesses: List[Access] = []
+
+    def _locks_of_with(self, node: ast.With) -> Set[str]:
+        held: Set[str] = set()
+        for item in node.items:
+            expr = item.context_expr
+            if self.self_scope:
+                attr = _self_attr(expr)
+                if attr and attr in self.scope.lock_attrs:
+                    held.add(self.scope.lock_attrs[attr])
+                    continue
+            if isinstance(expr, ast.Name):
+                if expr.id in self.module_locks:
+                    held.add(self.module_locks[expr.id])
+                elif not self.self_scope and expr.id in self.scope.lock_attrs:
+                    held.add(self.scope.lock_attrs[expr.id])
+        return held
+
+    def walk(self, stmts: List[ast.stmt], held: FrozenSet[str]) -> None:
+        for stmt in stmts:
+            self._visit(stmt, held)
+
+    def _visit(self, node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # deferred body: lock context unknowable
+        if isinstance(node, ast.With):
+            inner = held | self._locks_of_with(node)
+            for item in node.items:
+                self._visit(item.context_expr, held)
+            self.walk(node.body, frozenset(inner))
+            return
+        self._record(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _record(self, node: ast.AST, held: FrozenSet[str]) -> None:
+        if self.self_scope:
+            attr = _self_attr(node) if isinstance(node, ast.Attribute) else None
+            if attr and attr in self.scope.guarded:
+                self.accesses.append(
+                    Access(node, self.scope.guarded[attr], held))
+            if (isinstance(node, ast.Call)):
+                callee = _self_attr(node.func)
+                if callee and callee in self.scope.functions:
+                    self.scope.calls.append(
+                        CallSite(callee, self.fn, node.lineno, held))
+        else:
+            if (isinstance(node, ast.Name)
+                    and node.id in self.scope.guarded):
+                self.accesses.append(
+                    Access(node, self.scope.guarded[node.id], held))
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in self.scope.functions):
+                self.scope.calls.append(
+                    CallSite(node.func.id, self.fn, node.lineno, held))
+
+
+def index_module(ctx: FileContext) -> ModuleIndex:
+    """Build (and cache on ``ctx``) the module symbol table + call graph."""
+    cached = getattr(ctx, "_module_index", None)
+    if cached is not None:
+        return cached
+    idx = _build(ctx.path, ctx.tree, ctx.comment_tokens())
+    ctx._module_index = idx
+    return idx
+
+
+def _build(path: str, tree: ast.AST,
+           tokens: List[Tuple[int, str, bool]]) -> ModuleIndex:
+    comments = _guard_comments(tokens)
+    idx = ModuleIndex(path)
+
+    # Pass 1: declarations (functions must be known before call-graph walk).
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            scope = ScopeIndex(stmt.name)
+            idx.classes[stmt.name] = scope
+            for s in stmt.body:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scope.functions[s.name] = s
+                    for inner in ast.walk(s):
+                        if isinstance(inner, (ast.Assign, ast.AnnAssign)):
+                            _register_fields(scope, inner, comments,
+                                             self_scope=True)
+                else:
+                    _register_fields(scope, s, comments, self_scope=False)
+                    # Class-level ``X = ...`` guarded annotations register
+                    # as fields accessed through self.
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            idx.module.functions[stmt.name] = stmt
+        elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            _register_fields(idx.module, stmt, comments, self_scope=False)
+
+    # Pass 2: per-function lock-held walk.
+    for scope in idx.classes.values():
+        for fn_name, fn in scope.functions.items():
+            w = _BodyWalker(scope, fn_name, True, idx.module.lock_attrs)
+            w.walk(fn.body, frozenset())
+            scope.accesses[fn_name] = w.accesses
+    for fn_name, fn in idx.module.functions.items():
+        w = _BodyWalker(idx.module, fn_name, False, idx.module.lock_attrs)
+        w.walk(fn.body, frozenset())
+        idx.module.accesses[fn_name] = w.accesses
+    return idx
+
+
+def guarded_fields_from_source(source: str) -> Dict[str, Dict[str, str]]:
+    """{class name: {field: lock name}} from one module's (or one class's)
+    source — the runtime race tracer's entry point. ``source`` may be an
+    ``inspect.getsource(cls)`` snippet (leading indentation is handled)."""
+    import textwrap
+    src = textwrap.dedent(source)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return {}
+    idx = _build("<runtime>", tree, _comment_tokens(src))
+    return {name: {f: g.lock for f, g in scope.guarded.items()}
+            for name, scope in idx.classes.items() if scope.guarded}

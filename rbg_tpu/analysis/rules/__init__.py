@@ -1,4 +1,4 @@
-"""Rule registry: importing this module registers the five domain rules."""
+"""Rule registry: importing this module registers the domain rules."""
 
 from __future__ import annotations
 
@@ -8,6 +8,7 @@ from rbg_tpu.analysis.core import Rule
 from rbg_tpu.analysis.rules.blocking import BlockingInCriticalSection
 from rbg_tpu.analysis.rules.deadlines import DeadlineHygiene
 from rbg_tpu.analysis.rules.errorcodes import ErrorCodeRegistry
+from rbg_tpu.analysis.rules.guardedby import GuardedBy
 from rbg_tpu.analysis.rules.metricnames import MetricNameRegistry
 from rbg_tpu.analysis.rules.threads import ThreadLifecycle
 
@@ -15,6 +16,7 @@ RULE_CLASSES: List[Type[Rule]] = [
     BlockingInCriticalSection,
     DeadlineHygiene,
     ErrorCodeRegistry,
+    GuardedBy,
     MetricNameRegistry,
     ThreadLifecycle,
 ]

@@ -15,7 +15,7 @@ import re
 from typing import List
 
 from rbg_tpu.analysis.core import (FileContext, Finding, Rule, call_name,
-                                   dotted_name, kwarg, module_imports,
+                                   dotted_name, kwarg,
                                    walk_no_nested_functions)
 
 LOCKISH_RE = re.compile(r"(^|[._])(lock|mutex|rlock)s?$", re.IGNORECASE)
@@ -77,7 +77,7 @@ class BlockingInCriticalSection(Rule):
                    ".join() or connect-without-timeout in non-test code")
 
     def check(self, ctx: FileContext) -> List[Finding]:
-        imports = module_imports(ctx.tree)
+        imports = ctx.imports()
         findings: List[Finding] = []
         seen = set()  # nested lock-ish withs must not double-report a call
         for node in ast.walk(ctx.tree):

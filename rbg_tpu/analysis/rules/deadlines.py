@@ -19,8 +19,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional
 
-from rbg_tpu.analysis.core import (FileContext, Finding, Rule,
-                                   build_parents, dotted_name)
+from rbg_tpu.analysis.core import FileContext, Finding, Rule, dotted_name
 
 TIME_FUNCS = {"time", "monotonic"}
 
@@ -86,7 +85,7 @@ class DeadlineHygiene(Rule):
         if ctx.is_test or ctx.is_bench:
             return []
         findings: List[Finding] = []
-        parents = build_parents(ctx.tree)
+        parents = ctx.parents()
         for node in ast.walk(ctx.tree):
             budget = _fresh_budget(node)
             if budget is None:

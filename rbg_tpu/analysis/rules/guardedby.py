@@ -1,0 +1,93 @@
+"""guarded-by: fields annotated ``# guarded_by[lock]`` are only touched
+with that named lock held.
+
+The control plane's shared mutable state (store maps, scheduler caches,
+the spare pool, service queues, KV trie, port sets) is each guarded by one
+``locktrace.named_lock``. Which fields a lock guards used to be tribal
+knowledge; the annotation makes it machine-checked: every read or write of
+a registered field must sit inside ``with <that lock>:`` — directly, or in
+a helper the interprocedural engine proves is only ever called with the
+lock held (``rbg_tpu/analysis/ipe.py``; any-depth helper chains resolve
+via a fixpoint). ``__init__`` writes are exempt (no peer holds a
+reference during construction). The runtime complement is
+``RBG_RACETRACE`` (``rbg_tpu/utils/racetrace.py``), which samples real
+accesses against the live held-lock set — this rule proves the lexical
+discipline, the tracer catches what static analysis cannot see (dynamic
+dispatch, cross-module pokes).
+"""
+
+from __future__ import annotations
+
+from typing import List, Set, Tuple
+
+from rbg_tpu.analysis import ipe
+from rbg_tpu.analysis.core import FileContext, Finding, Rule
+
+
+class GuardedBy(Rule):
+    name = "guarded-by"
+    description = ("fields annotated `# guarded_by[lock]` must only be "
+                   "accessed under `with <that named lock>:` (helper calls "
+                   "resolve interprocedurally)")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        idx = ipe.index_module(ctx)
+        findings: List[Finding] = []
+        for scope in [*idx.classes.values(), idx.module]:
+            findings.extend(self._check_scope(ctx, idx, scope))
+        return findings
+
+    def _check_scope(self, ctx: FileContext, idx: ipe.ModuleIndex,
+                     scope: ipe.ScopeIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        if not scope.guarded:
+            return findings
+        # Every annotation must name a lock this scope (or the module) can
+        # actually resolve to `with` contexts — an annotation pointing at a
+        # lock constructed elsewhere is unverifiable and would read as
+        # protection without being checked.
+        visible = set(scope.lock_attrs.values()) | set(
+            idx.module.lock_attrs.values())
+        for field in scope.guarded.values():
+            if field.lock not in visible:
+                findings.append(Finding(
+                    self.name, ctx.path, field.lineno, 0,
+                    f"`guarded_by[{field.lock}]` on `{field.name}` but no "
+                    f"named lock {field.lock!r} is constructed in this "
+                    f"class/module — the analysis cannot verify the guard; "
+                    f"construct the lock here via locktrace.named_lock("
+                    f"{field.lock!r}) or fix the annotation"))
+        seen: Set[Tuple[int, str]] = set()
+        for fn_name, accesses in scope.accesses.items():
+            if fn_name == "__init__":
+                continue  # construction: no peer can hold a reference yet
+            for acc in accesses:
+                lock = acc.field.lock
+                if lock in acc.held or lock not in visible:
+                    continue
+                if fn_name in scope.locked_methods(lock):
+                    continue
+                key = (acc.node.lineno, acc.field.name)
+                if key in seen:
+                    continue
+                seen.add(key)
+                site = scope.unlocked_call_site(fn_name, lock)
+                if site is not None:
+                    reach = (f"`{fn_name}` is reached without the lock — "
+                             f"called from `{site.caller}` at line "
+                             f"{site.lineno} outside `with` on {lock!r}")
+                elif scope.call_sites(fn_name):
+                    reach = (f"`{fn_name}`'s callers hold the lock but the "
+                             f"access itself is outside every `with` block "
+                             f"the engine can see")
+                else:
+                    reach = (f"`{fn_name}` is a public entry point with no "
+                             f"lock acquisition around the access")
+                findings.append(Finding(
+                    self.name, ctx.path, acc.node.lineno,
+                    getattr(acc.node, "col_offset", 0),
+                    f"`{acc.field.name}` is guarded_by[{lock}] but accessed "
+                    f"without the lock held: {reach} — wrap the access in "
+                    f"`with` on the {lock!r} lock or make every call path "
+                    f"hold it"))
+        return findings

@@ -18,8 +18,8 @@ from __future__ import annotations
 import ast
 from typing import Dict, List
 
-from rbg_tpu.analysis.core import (FileContext, Finding, Rule,
-                                   module_imports, str_const)
+from rbg_tpu.analysis.core import (FileContext, Finding, Rule, parse_module,
+                                   str_const)
 
 CATALOG_MODULE = "rbg_tpu.obs.names"
 
@@ -79,7 +79,7 @@ class MetricNameRegistry(Rule):
 
     def check(self, ctx: FileContext) -> List[Finding]:
         findings: List[Finding] = []
-        imports = module_imports(ctx.tree)
+        imports = ctx.imports()
         for node in ast.walk(ctx.tree):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
@@ -117,8 +117,9 @@ class MetricNameRegistry(Rule):
         """Audit the catalog module itself: duplicate values, bad suffixes."""
         findings: List[Finding] = []
         try:
-            with open(self._names_module, encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=self._names_module)
+            # Via the run-scoped memo: linting rbg_tpu/ itself must not
+            # parse the catalog a second time (one parse pass per file).
+            _, tree = parse_module(self._names_module)
         except (OSError, SyntaxError):
             return findings
         seen: Dict[str, str] = {}

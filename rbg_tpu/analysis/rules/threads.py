@@ -20,8 +20,8 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from rbg_tpu.analysis.core import (FileContext, Finding, Rule, build_parents,
-                                   dotted_name, is_true, kwarg)
+from rbg_tpu.analysis.core import (FileContext, Finding, Rule, dotted_name,
+                                   is_true, kwarg)
 
 
 def _is_thread_ctor(node: ast.Call) -> bool:
@@ -76,7 +76,7 @@ class ThreadLifecycle(Rule):
                    "joined by a stop()/close() path")
 
     def check(self, ctx: FileContext) -> List[Finding]:
-        parents = build_parents(ctx.tree)
+        parents = ctx.parents()
         findings: List[Finding] = []
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Call) and _is_thread_ctor(node):

@@ -50,6 +50,7 @@ import numpy as np
 
 from rbg_tpu.engine.protocol import recv_msg, send_msg, token_ok
 from rbg_tpu.utils.locktrace import named_lock
+from rbg_tpu.utils.racetrace import guard as _race_guard
 
 
 class _Node:
@@ -65,15 +66,17 @@ class _Node:
         self.nbytes = 0
 
 
+@_race_guard
 class KVPoolStore:
     """Page-granular prefix trie with LRU byte-budget eviction."""
 
     def __init__(self, page_size: int, max_bytes: int = 1 << 30):
         self.page_size = page_size
         self.max_bytes = max_bytes
-        self.root = _Node((), None)
-        self.bytes = 0
+        self.root = _Node((), None)  # guarded_by[engine.kvpool]
+        self.bytes = 0  # guarded_by[engine.kvpool]
         self._lock = named_lock("engine.kvpool")
+        # guarded_by[engine.kvpool]
         self.metrics = {"hits": 0, "misses": 0, "hit_tokens": 0,
                         "put_pages": 0, "evicted_pages": 0, "pages": 0}
 

@@ -25,6 +25,7 @@ from rbg_tpu.engine.protocol import (CODE_DEADLINE, DeadlineExceeded,
 from rbg_tpu.obs import names
 from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.utils.locktrace import named_lock
+from rbg_tpu.utils.racetrace import guard as _race_guard
 
 
 class _Pending:
@@ -115,6 +116,7 @@ def _embed_batch(engine: Engine, prompts: List[List[int]]) -> List[List[float]]:
     return [vecs[i].tolist() for i in range(len(prompts))]
 
 
+@_race_guard
 class _BatchService:
     """Shared loop: subclasses implement ``_admit(item, sampling) -> rid``
     (raising on bad input fails just that request) and expose ``engine``.
@@ -132,14 +134,17 @@ class _BatchService:
 
     def __init__(self, max_queue: Optional[int] = None):
         self.max_queue = max_queue
+        # guarded_by[engine.service_queue]
         self.counters = {"shed_total": 0, "deadline_queue_drops": 0,
                          "deadline_running_aborts": 0}
+        # Loop-thread-confined (admitted rows); deliberately NOT guarded.
         self._pending: Dict[int, _Pending] = {}
         self._lock = named_lock("engine.service_queue")
         self._wake = threading.Event()
         self._stopped = False
+        # guarded_by[engine.service_queue]
         self._queue: List[Tuple[object, SamplingParams, _Pending]] = []
-        self._cancels: List[_Pending] = []
+        self._cancels: List[_Pending] = []  # guarded_by[engine.service_queue]
         self._done_times = collections.deque(maxlen=_RATE_WINDOW)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=type(self).__name__.lower())
@@ -195,7 +200,8 @@ class _BatchService:
         queueing work that cannot be served."""
         now = time.monotonic()
         if deadline is not None and now >= deadline:
-            self.counters["deadline_queue_drops"] += 1
+            with self._lock:
+                self.counters["deadline_queue_drops"] += 1
             REGISTRY.inc(names.SERVING_DEADLINE_EXCEEDED_TOTAL, stage="queue")
             raise DeadlineExceeded("deadline already expired at submission")
         p = _Pending(deadline=deadline)
@@ -292,8 +298,8 @@ class _BatchService:
         op by every serving mode, scraped by the stress harness)."""
         with self._lock:
             depth = len(self._queue)
+            out = dict(self.counters)
         est = self.estimated_wait_s(depth)
-        out = dict(self.counters)
         out["queue_depth"] = depth
         out["max_queue"] = self.max_queue
         out["estimated_wait_s"] = round(est, 4) if est is not None else None
@@ -336,10 +342,12 @@ class _BatchService:
         instead of burning device steps to max_new_tokens."""
         expired = [(rid, p) for rid, p in self._pending.items()
                    if p.deadline is not None and now >= p.deadline]
+        if expired:
+            with self._lock:
+                self.counters["deadline_running_aborts"] += len(expired)
         for rid, p in expired:
             self.engine.cancel_request(rid)
             del self._pending[rid]
-            self.counters["deadline_running_aborts"] += 1
             REGISTRY.inc(names.SERVING_DEADLINE_EXCEEDED_TOTAL,
                          stage="running")
             p.error = "deadline exceeded mid-generation (aborted)"
@@ -360,9 +368,11 @@ class _BatchService:
                              - len(eng.running) - len(eng.waiting))
                 newly = self._queue[:budget]
                 self._queue = self._queue[budget:]
+            if expired:
+                with self._lock:
+                    self.counters["deadline_queue_drops"] += len(expired)
             for pending in expired:
-                self.counters["deadline_queue_drops"] += 1
-                REGISTRY.inc("rbg_serving_deadline_exceeded_total",
+                REGISTRY.inc(names.SERVING_DEADLINE_EXCEEDED_TOTAL,
                              stage="queue")
                 pending.error = "deadline expired before admission"
                 pending.code = CODE_DEADLINE

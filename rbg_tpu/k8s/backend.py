@@ -37,12 +37,14 @@ from rbg_tpu.runtime.store import Event, Store
 from rbg_tpu.runtime.store import Conflict as StoreConflict
 from rbg_tpu.runtime.store import NotFound as StoreNotFound
 from rbg_tpu.utils.locktrace import named_lock
+from rbg_tpu.utils.racetrace import guard as _race_guard
 
 log = logging.getLogger("rbg_tpu.k8s")
 
 _SELECTOR = f"{T.LABEL_MANAGED_BY}={T.MANAGED_BY}"
 
 
+@_race_guard
 class K8sPodBackend:
     SYNC_WORKERS = 8
 
@@ -59,7 +61,7 @@ class K8sPodBackend:
         # bottleneck (one REST round trip at a time for a 1200-pod burst).
         # Workers drain with retries so a flaky API server never loses an
         # operation (watch callbacks must not block).
-        self._dirty = [dict() for _ in range(self.SYNC_WORKERS)]
+        self._dirty = [dict() for _ in range(self.SYNC_WORKERS)]  # guarded_by[k8s.backend_dirty]
         self._wakes = [threading.Event() for _ in range(self.SYNC_WORKERS)]
         self._lock = named_lock("k8s.backend_dirty")
         # Last-known mirrored spec images, to detect in-place patches.

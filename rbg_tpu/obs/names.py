@@ -37,11 +37,14 @@ DISRUPTION_MIGRATIONS_MISSED_DEADLINE_TOTAL = (
 DISRUPTION_SLICES_RELEASED_TOTAL = "rbg_disruption_slices_released_total"
 DISRUPTION_SPARES_CONSUMED_TOTAL = "rbg_disruption_spares_consumed_total"
 LOCKTRACE_INVERSIONS_TOTAL = "rbg_locktrace_inversions_total"
+RACE_CHECKED_TOTAL = "rbg_race_checked_total"
+RACE_VIOLATIONS_TOTAL = "rbg_race_violations_total"
 
 # ---- gauges (last-write-wins) ----
 
 SERVING_DRAINING = "rbg_serving_draining"
 DISRUPTION_SPARE_POOL_DEPTH = "rbg_disruption_spare_pool_depth"
+RACE_GUARDED_CLASSES = "rbg_race_guarded_classes"
 
 # ---- histograms ----
 
@@ -64,11 +67,14 @@ COUNTERS = frozenset({
     DISRUPTION_SLICES_RELEASED_TOTAL,
     DISRUPTION_SPARES_CONSUMED_TOTAL,
     LOCKTRACE_INVERSIONS_TOTAL,
+    RACE_CHECKED_TOTAL,
+    RACE_VIOLATIONS_TOTAL,
 })
 
 GAUGES = frozenset({
     SERVING_DRAINING,
     DISRUPTION_SPARE_POOL_DEPTH,
+    RACE_GUARDED_CLASSES,
 })
 
 HISTOGRAMS = frozenset({

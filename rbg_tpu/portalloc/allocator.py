@@ -15,11 +15,13 @@ from typing import Optional
 
 from rbg_tpu.native import load_native
 from rbg_tpu.utils.locktrace import named_lock
+from rbg_tpu.utils.racetrace import guard as _race_guard
 
 DEFAULT_START = 30000
 DEFAULT_RANGE = 5000
 
 
+@_race_guard
 class PortAllocator:
     def __init__(self, start: int = DEFAULT_START, range_: int = DEFAULT_RANGE,
                  seed: int = 0):
@@ -31,8 +33,8 @@ class PortAllocator:
             if not self._h:
                 self._lib = None
         if self._lib is None:
-            self._used = set()
-            self._rng = random.Random(seed or None)
+            self._used = set()  # guarded_by[portalloc.allocator]
+            self._rng = random.Random(seed or None)  # guarded_by[portalloc.allocator]
             self._lock = named_lock("portalloc.allocator")
 
     @property

@@ -25,6 +25,7 @@ from rbg_tpu.api import constants as C
 from rbg_tpu.portalloc.allocator import PortAllocator
 from rbg_tpu.utils.locktrace import named_lock
 
+# guarded_by[portalloc.manager]
 _singleton: Optional["PortAllocatorService"] = None
 _lock = named_lock("portalloc.manager")
 
@@ -204,4 +205,5 @@ def setup_port_allocator(store, start: int = 30000, range_: int = 5000) -> PortA
 
 
 def get_port_allocator() -> Optional[PortAllocatorService]:
-    return _singleton
+    with _lock:
+        return _singleton

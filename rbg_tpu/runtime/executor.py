@@ -30,6 +30,7 @@ from typing import Dict, Optional
 from rbg_tpu.api import constants as C
 from rbg_tpu.runtime.store import Event, Store
 from rbg_tpu.utils.locktrace import named_lock
+from rbg_tpu.utils.racetrace import guard as _race_guard
 
 
 def _free_port() -> int:
@@ -38,6 +39,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@_race_guard
 class LocalExecutor:
     def __init__(self, store: Store, workdir: Optional[str] = None,
                  extra_env: Optional[Dict[str, str]] = None,
@@ -47,12 +49,12 @@ class LocalExecutor:
         self.registry_path = os.path.join(self.workdir, "registry.json")
         self.extra_env = dict(extra_env or {})
         self.health_timeout = health_timeout
-        self._procs: Dict[tuple, subprocess.Popen] = {}
-        self._ports: Dict[tuple, int] = {}
-        self._generations: Dict[tuple, int] = {}
+        self._procs: Dict[tuple, subprocess.Popen] = {}  # guarded_by[runtime.executor]
+        self._ports: Dict[tuple, int] = {}  # guarded_by[runtime.executor]
+        self._generations: Dict[tuple, int] = {}  # guarded_by[runtime.executor]
         self._lock = named_lock("runtime.executor")
         self._stopped = False
-        self._registry: Dict[str, dict] = {}
+        self._registry: Dict[str, dict] = {}  # guarded_by[runtime.executor]
 
     # ---- kubelet contract ----
 
@@ -64,8 +66,10 @@ class LocalExecutor:
             # restart-policy engine relaunches real processes (the node-
             # reboot analog). Without this a resumed plane is a zombie:
             # Ready status, dead ports.
-            if (pod.status.phase == "Running"
-                    and (pod.metadata.namespace, pod.metadata.name) not in self._procs):
+            with self._lock:
+                known = (pod.metadata.namespace,
+                         pod.metadata.name) in self._procs
+            if pod.status.phase == "Running" and not known:
                 self._set_status((pod.metadata.namespace, pod.metadata.name),
                                  "Failed", ready=False)
                 continue

@@ -19,8 +19,10 @@ from rbg_tpu.api import constants as C
 from rbg_tpu.api.constants import DOMAIN as _DOMAIN
 from rbg_tpu.runtime.store import Conflict, Event, NotFound, Store
 from rbg_tpu.utils.locktrace import named_lock
+from rbg_tpu.utils.racetrace import guard as _race_guard
 
 
+@_race_guard
 class FakeKubelet:
     """Moves scheduled pods through the lifecycle:
     Pending+node → Running(ready) after ``ready_delay``; honors graceful
@@ -41,9 +43,9 @@ class FakeKubelet:
         # Pods matching hold_filter stay Pending (slow-start simulation)
         # until release_holds() clears the filter and re-walks them.
         self.hold_filter: Optional[Callable[[object], bool]] = None
-        self._timers: list = []
+        self._timers: list = []  # guarded_by[runtime.kubelet]
         self._lock = named_lock("runtime.kubelet")
-        self._stopped = False
+        self._stopped = False  # guarded_by[runtime.kubelet]
         # Shared pool: a thread PER pod event melted create bursts.
         from concurrent.futures import ThreadPoolExecutor
         self._pool = ThreadPoolExecutor(max_workers=4,

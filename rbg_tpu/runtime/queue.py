@@ -13,7 +13,11 @@ import threading
 import time
 from typing import Any, Hashable, Optional
 
+from rbg_tpu.utils.locktrace import named_condition, named_lock
+from rbg_tpu.utils.racetrace import guard as _race_guard
 
+
+@_race_guard
 class ExponentialBackoff:
     """Per-item failure backoff: min(base * 2^(n-1), max).
 
@@ -30,9 +34,10 @@ class ExponentialBackoff:
         self.base = base
         self.max_delay = max_delay
         self.jitter = jitter
-        self._failures: dict = {}
-        self._prev: dict = {}    # item -> previous jittered delay
-        self._lock = threading.Lock()
+        self._failures: dict = {}  # guarded_by[runtime.backoff]
+        # item -> previous jittered delay  # guarded_by[runtime.backoff]
+        self._prev: dict = {}
+        self._lock = named_lock("runtime.backoff")
 
     def next_delay(self, item: Hashable) -> float:
         with self._lock:
@@ -75,6 +80,7 @@ class ExponentialBackoff:
             return self._failures.get(item, 0)
 
 
+@_race_guard
 class WorkQueue:
     """FIFO queue with dedup + delayed add. An item present in ``processing``
     that is re-added lands in ``dirty`` and is re-queued on ``done()`` —
@@ -82,13 +88,14 @@ class WorkQueue:
     never losing an event."""
 
     def __init__(self):
-        self._lock = threading.Condition()
-        self._queue: list = []
-        self._dirty: set = set()
-        self._processing: set = set()
-        self._delayed: list = []  # heap of (fire_time, seq, item)
-        self._seq = 0
-        self._shutdown = False
+        self._lock = named_condition("runtime.workqueue")
+        self._queue: list = []  # guarded_by[runtime.workqueue]
+        self._dirty: set = set()  # guarded_by[runtime.workqueue]
+        self._processing: set = set()  # guarded_by[runtime.workqueue]
+        # heap of (fire_time, seq, item)  # guarded_by[runtime.workqueue]
+        self._delayed: list = []
+        self._seq = 0  # guarded_by[runtime.workqueue]
+        self._shutdown = False  # guarded_by[runtime.workqueue]
 
     def add(self, item: Hashable) -> None:
         with self._lock:

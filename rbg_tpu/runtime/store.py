@@ -25,6 +25,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from rbg_tpu.api import serde
 from rbg_tpu.utils.locktrace import named_rlock
+from rbg_tpu.utils.racetrace import guard as _race_guard
 from rbg_tpu.api.constants import (
     LABEL_GROUP_NAME, LABEL_INSTANCE_NAME, LABEL_POD_GROUP,
 )
@@ -59,6 +60,7 @@ class Event:
         return f"Event({self.type}, {self.object.kind}/{m.namespace}/{m.name})"
 
 
+@_race_guard
 class Store:
     # Label keys served from an index by ``list(selector=...)`` (reference:
     # registered field indexes, ``pkg/utils/fieldindex/register.go``). A
@@ -68,16 +70,22 @@ class Store:
 
     def __init__(self):
         self._lock = named_rlock("runtime.store")
-        self._objects: Dict[Key, object] = {}
-        self._kind_keys: Dict[str, set] = defaultdict(set)  # kind -> keys
-        # (kind, label key, label value) -> keys
+        self._objects: Dict[Key, object] = {}  # guarded_by[runtime.store]
+        # kind -> keys  # guarded_by[runtime.store]
+        self._kind_keys: Dict[str, set] = defaultdict(set)
+        # (kind, label key, label value) -> keys  # guarded_by[runtime.store]
         self._label_index: Dict[Tuple[str, str, str], set] = defaultdict(set)
-        self._rv = 0
+        self._rv = 0  # guarded_by[runtime.store]
+        # guarded_by[runtime.store]
         self._watchers: Dict[str, List[Callable[[Event], None]]] = defaultdict(list)
-        self._owner_index: Dict[str, set] = defaultdict(set)  # owner uid -> keys
-        self._uids: set = set()  # live object uids (O(1) owner-exists checks)
-        self._kind_version: Dict[str, int] = {}  # kind -> write counter
-        self._events_log: List[tuple] = []  # (ts, kind/ns/name, reason, msg)
+        # owner uid -> keys  # guarded_by[runtime.store]
+        self._owner_index: Dict[str, set] = defaultdict(set)
+        # live object uids (O(1) owner-exists checks)  # guarded_by[runtime.store]
+        self._uids: set = set()
+        # kind -> write counter  # guarded_by[runtime.store]
+        self._kind_version: Dict[str, int] = {}
+        # (ts, kind/ns/name, reason, msg)  # guarded_by[runtime.store]
+        self._events_log: List[tuple] = []
 
     # ---- helpers ----
 

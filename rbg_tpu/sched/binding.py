@@ -36,6 +36,7 @@ from typing import Dict, Optional, Set
 from rbg_tpu.api import constants as C
 from rbg_tpu.api.pod import NodeAffinityTerm
 from rbg_tpu.utils.locktrace import named_lock
+from rbg_tpu.utils.racetrace import guard as _race_guard
 
 GRANULARITY_POD = "Pod"
 GRANULARITY_COMPONENT = "Component"
@@ -69,11 +70,14 @@ def avoid_terms(annotations: Optional[dict]) -> list:
     return out
 
 
+@_race_guard
 class NodeBindingStore:
     def __init__(self, store=None):
         self._lock = named_lock("sched.node_binding")
-        self._nodes: Dict[str, Set[str]] = {}   # scope key -> node names
-        self._slices: Dict[str, str] = {}       # scope key -> slice id
+        # scope key -> node names  # guarded_by[sched.node_binding]
+        self._nodes: Dict[str, Set[str]] = {}
+        # scope key -> slice id  # guarded_by[sched.node_binding]
+        self._slices: Dict[str, str] = {}
         self._store = store
 
     @staticmethod

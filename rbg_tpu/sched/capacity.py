@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from rbg_tpu.api import constants as C
 from rbg_tpu.utils.locktrace import named_lock, named_rlock
+from rbg_tpu.utils.racetrace import guard as _race_guard
 
 # A pod's footprint in the cache: (node, is_tpu_slice_pod, excl) where
 # excl = (topology_key, domain, group) or None.
@@ -48,19 +49,24 @@ def _pod_contrib(pod, nodes) -> Optional[_Contrib]:
     return (pod.node_name, tpu, excl)
 
 
+@_race_guard
 class CapacityCache:
     def __init__(self, store):
         self.store = store
         self._lock = named_rlock("sched.capacity_cache")
-        self._nodes: Dict[str, object] = {}
-        self._bound: Dict[str, int] = {}        # node -> bound active pods
-        self._tpu_bound: Dict[str, int] = {}    # node -> bound slice pods
-        # (topo key, domain) -> {group: pod count}
+        self._nodes: Dict[str, object] = {}  # guarded_by[sched.capacity_cache]
+        # node -> bound active pods  # guarded_by[sched.capacity_cache]
+        self._bound: Dict[str, int] = {}
+        # node -> bound slice pods  # guarded_by[sched.capacity_cache]
+        self._tpu_bound: Dict[str, int] = {}
+        # (topo key, domain) -> {group: pod count}  # guarded_by[sched.capacity_cache]
         self._excl: Dict[Tuple[str, str], Dict[str, int]] = {}
         # pod uid -> (resource_version, footprint); rv None = tombstone
         # (terminal delete — late pre-delete events for the uid are dropped)
+        # guarded_by[sched.capacity_cache]
         self._contrib: Dict[str, Tuple[Optional[int], Optional[_Contrib]]] = {}
         # Tombstones that already survived one rebuild (dropped on the next).
+        # guarded_by[sched.capacity_cache]
         self._aged_tombstones: set = set()
         self._started = False
 
@@ -232,6 +238,7 @@ class CapacityCache:
                     for kd, owners in self._excl.items() if owners}
 
 
+@_race_guard
 class SparePool:
     """Warm-spare slice reservation: N fully-idle standby slices held back
     per topology so disruption recovery is BIND-time, not provision-time.
@@ -250,14 +257,17 @@ class SparePool:
     and after every take — "replenished in the background"."""
 
     def __init__(self, per_topology: int = 0):
-        self.per_topology = per_topology
+        self.per_topology = per_topology  # guarded_by[sched.spare_pool]
         self._lock = named_lock("sched.spare_pool")
-        self._reserved: Dict[str, str] = {}   # slice_id -> topology
-        self._known_topos: Set[str] = set()   # gauge zeroing on drain
+        # slice_id -> topology  # guarded_by[sched.spare_pool]
+        self._reserved: Dict[str, str] = {}
+        # gauge zeroing on drain  # guarded_by[sched.spare_pool]
+        self._known_topos: Set[str] = set()
         # Slices taken but not yet occupied: a grant's target stays idle
         # until the recovering gang binds, and replenish must not
         # re-reserve it in that window (that would silently revoke the
         # grant — the scheduler would then treat the target as held back).
+        # guarded_by[sched.spare_pool]
         self._granted: Set[str] = set()
 
     def configure(self, per_topology: int) -> None:
@@ -315,7 +325,10 @@ class SparePool:
         """Re-reserve idle slices up to ``per_topology`` per topology.
         Eligible: every host ready, schedulable, undisrupted; no active
         pod bound to any host; not already reserved."""
-        if self.per_topology <= 0:
+        with self._lock:
+            # One consistent target for this pass (configure() can race).
+            target = self.per_topology
+        if target <= 0:
             return
         by_slice: Dict[str, list] = {}
         for n in store.list("Node", copy_=False):
@@ -380,7 +393,7 @@ class SparePool:
                 if sid in self._reserved or sid in self._granted:
                     continue
                 topo = hosts[0].tpu.slice_topology
-                if counts.get(topo, 0) >= self.per_topology:
+                if counts.get(topo, 0) >= target:
                     continue
                 if not eligible(hosts):
                     continue

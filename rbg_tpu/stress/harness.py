@@ -683,12 +683,28 @@ def main(argv=None) -> int:
                          "detector armed (RBG_LOCKTRACE=1): every shared "
                          "control-plane lock records its acquisition-order "
                          "graph and an inversion fails the run")
+    ap.add_argument("--racetrace", action="store_true",
+                    help="run the scenario with the guarded-field race "
+                         "detector armed (RBG_RACETRACE=warn unless the "
+                         "env var is already set): every write (and a "
+                         "sampled read) of a `# guarded_by[...]` field "
+                         "checks the owning lock is held; violations fail "
+                         "the run via the race_free invariant")
     args = ap.parse_args(argv)
     import os
     if args.locktrace:
         # Must be set BEFORE any plane/service objects are constructed —
         # named_lock reads the env var at lock-construction time.
         os.environ["RBG_LOCKTRACE"] = "1"
+    if args.racetrace:
+        # warn (record + count), not raise: the drill's job is to finish
+        # and REPORT — the race_free invariant turns records into a red.
+        # Same construction-time caveat as locktrace; arm() instruments
+        # the registered classes before any instance exists.
+        os.environ.setdefault("RBG_RACETRACE", "warn")
+        from rbg_tpu.utils import racetrace
+        racetrace.reset()
+        racetrace.arm()
     load1 = os.getloadavg()[0]
     if args.scenario in ("overload", "preemption"):
         if args.scenario == "overload":
@@ -705,6 +721,7 @@ def main(argv=None) -> int:
                 timeout_s=args.timeout_s))
         report["load1_before"] = round(load1, 2)
         _attach_locktrace(report, args)
+        _attach_racetrace(report, args)
         if args.json_out:
             with open(args.json_out, "w") as f:
                 json.dump(report, f, indent=1)
@@ -725,6 +742,7 @@ def main(argv=None) -> int:
     report["command"] = "rbg-tpu stress " + " ".join(
         argv if argv is not None else __import__("sys").argv[1:])
     _attach_locktrace(report, args)
+    _attach_racetrace(report, args)
     if args.json_out:
         with open(args.json_out, "w") as f:
             json.dump(report, f, indent=1)
@@ -735,6 +753,8 @@ def main(argv=None) -> int:
     else:
         print(json.dumps(report, indent=2))
     if report.get("locktrace", {}).get("inversions"):
+        return 1
+    if report.get("racetrace", {}).get("violations"):
         return 1
     return 0
 
@@ -750,6 +770,19 @@ def _attach_locktrace(report: dict, args) -> None:
     if "invariants" in report:
         report["invariants"]["lock_order_acyclic"] = (
             not locktrace.inversions())
+
+
+def _attach_racetrace(report: dict, args) -> None:
+    """Fold the guarded-access verdict into the report when --racetrace
+    ran: the rbg_race_* counters, the recorded violations, and a
+    ``race_free`` invariant that reds the drill on any of them."""
+    if not getattr(args, "racetrace", False):
+        return
+    from rbg_tpu.utils import racetrace
+    report["racetrace"] = {"counters": racetrace.counters(),
+                           "violations": racetrace.violations()}
+    if "invariants" in report:
+        report["invariants"]["race_free"] = not racetrace.violations()
 
 
 def _kv_table(d: dict) -> str:

@@ -38,18 +38,28 @@ from typing import Dict, List, Optional, Set
 log = logging.getLogger("rbg_tpu.locktrace")
 
 ENV_VAR = "RBG_LOCKTRACE"
+RACE_ENV_VAR = "RBG_RACETRACE"  # racetrace needs the held-lock stack too
 
 
-def mode() -> str:
-    """"" (disabled) | "raise" | "warn" — from the RBG_LOCKTRACE env var."""
-    v = (os.environ.get(ENV_VAR) or "").strip().lower()
+def _env_mode(var: str) -> str:
+    v = (os.environ.get(var) or "").strip().lower()
     if not v or v in ("0", "false", "off"):
         return ""
     return "warn" if v == "warn" else "raise"
 
 
+def mode() -> str:
+    """"" (disabled) | "raise" | "warn" — from the RBG_LOCKTRACE env var."""
+    return _env_mode(ENV_VAR)
+
+
 def enabled() -> bool:
-    return bool(mode())
+    """Construct TracedLock wrappers? True when EITHER detector is armed:
+    the racetrace guarded-access checker (utils/racetrace.py) asks "which
+    named locks does this thread hold?" — answerable only if the locks
+    maintain the per-thread held stack, i.e. are TracedLocks. Order-graph
+    checking itself stays governed by RBG_LOCKTRACE alone."""
+    return bool(mode()) or bool(_env_mode(RACE_ENV_VAR))
 
 
 class LockOrderError(RuntimeError):
@@ -140,9 +150,15 @@ class TracedLock:
         self.name = name
         self._reentrant = reentrant
         self._inner = threading.RLock() if reentrant else threading.Lock()
-        self._strict = (mode() != "warn") if strict is None else strict
+        # Order-graph checking is RBG_LOCKTRACE's; a lock traced only for
+        # the racetrace held-stack records no edges and raises nothing.
+        self._order_mode = mode()
+        self._strict = (self._order_mode != "warn") if strict is None \
+            else strict
 
     def _note_acquire(self) -> None:
+        if not self._order_mode:
+            return  # held-stack-only tracing (racetrace armed, locktrace off)
         stack = _held_stack()
         if self._reentrant and self.name in stack:
             return  # re-entrant re-acquire: no new ordering information
@@ -203,6 +219,22 @@ def named_rlock(name: str):
     if enabled():
         return TracedLock(name, reentrant=True)
     return threading.RLock()
+
+
+def named_condition(name: str):
+    """A ``threading.Condition`` whose underlying mutex participates in
+    tracing when armed (the workqueue's lock is a Condition — its guarded
+    fields need the same held-stack visibility as plain named locks).
+    Plain stdlib Condition otherwise — zero overhead."""
+    if enabled():
+        return threading.Condition(TracedLock(name))
+    return threading.Condition()
+
+
+def held_names() -> List[str]:
+    """Names of the traced locks THIS thread currently holds, innermost
+    last (the racetrace guarded-access checker's query)."""
+    return list(_held_stack())
 
 
 def snapshot() -> Dict[str, List[str]]:

@@ -1,0 +1,234 @@
+"""Opt-in runtime guarded-field access checker — the dynamic half of the
+``guarded-by`` discipline (Go's ``-race`` analog, scoped to the annotated
+control-plane state).
+
+The static rule (``rbg_tpu/analysis/rules/guardedby.py``) proves the
+LEXICAL discipline; it cannot see dynamic dispatch, cross-module pokes, or
+code paths built at runtime. This module closes that gap: classes whose
+fields carry ``# guarded_by[lock]`` annotations are registered with the
+:func:`guard` decorator, and when armed every write (and a 1-in-N sample
+of reads) of a guarded field checks that the owning named lock is held by
+the current thread — straight off the ``locktrace`` held stack, which is
+why arming racetrace also makes :func:`locktrace.named_lock` return traced
+wrappers.
+
+Off by default — ``guard`` merely records the class (zero overhead, no
+wrapper installed). Armed by ``RBG_RACETRACE=1`` (raise
+:class:`RaceError` at the access) or ``RBG_RACETRACE=warn`` (log + count,
+the stress-drill mode), read at :func:`arm` time / class-registration
+time. Like ``RBG_LOCKTRACE``, set the env var BEFORE constructing the
+objects under test: locks built while disarmed are plain stdlib locks and
+invisible to the held stack. ``rbg-tpu stress --racetrace`` does exactly
+this and folds the verdict into a ``race_free`` invariant plus
+``rbg_race_*`` counters.
+
+Granularity caveat: locks are matched by NAME. Classes instantiated many
+times share one lock name across instances (workqueue, backoff), so a
+thread holding instance A's lock while touching instance B's fields is
+not flagged — the same trade named locks already make for order tracing.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, List, Optional
+
+log = logging.getLogger("rbg_tpu.racetrace")
+
+ENV_VAR = "RBG_RACETRACE"
+SAMPLE_ENV_VAR = "RBG_RACETRACE_SAMPLE"
+DEFAULT_READ_SAMPLE = 4  # check every Nth guarded read; writes always
+
+_LIVE_FLAG = "_rbg_race_live_"
+
+
+class RaceError(RuntimeError):
+    """A guarded field was accessed without its owning lock held."""
+
+
+def mode() -> str:
+    """"" (disabled) | "raise" | "warn" — from the RBG_RACETRACE env var."""
+    v = (os.environ.get(ENV_VAR) or "").strip().lower()
+    if not v or v in ("0", "false", "off"):
+        return ""
+    return "warn" if v == "warn" else "raise"
+
+
+def enabled() -> bool:
+    return bool(mode())
+
+
+def read_sample() -> int:
+    try:
+        n = int(os.environ.get(SAMPLE_ENV_VAR, ""))
+        return max(1, n)
+    except ValueError:
+        return DEFAULT_READ_SAMPLE
+
+
+# ---- global state ----
+
+_state = threading.Lock()  # guards the records below (plain by design:
+# this module IS the detector — tracing its own lock would recurse)
+_registered: List[type] = []
+_armed: Dict[type, dict] = {}   # cls -> saved dunders for disarm()
+_violations: List[str] = []
+_checked = [0]                  # [int] so closures can bump it
+_violated = [0]
+# Failure mode, resolved at RECORD time (not baked into the wrappers) so
+# arm(strict=...) can flip it even for classes armed at import time.
+_mode = ["raise"]
+
+
+def guard(cls):
+    """Class decorator: register ``cls`` as guarded (its ``# guarded_by``
+    field annotations define the contract). No-op unless/until armed."""
+    if cls not in _registered:
+        _registered.append(cls)
+    if enabled() and cls not in _armed:
+        _mode[0] = mode() or "raise"
+        _arm_class(cls)
+    return cls
+
+
+def arm(strict: Optional[bool] = None) -> int:
+    """Instrument every registered class (idempotent). ``strict`` overrides
+    the env mode (True = raise, False = warn). Returns the number of
+    guarded classes armed. Call BEFORE constructing the objects under
+    test, with RBG_RACETRACE (or strict=) deciding the failure mode."""
+    m = mode() or "raise"
+    if strict is not None:
+        m = "raise" if strict else "warn"
+    _mode[0] = m
+    count = 0
+    for cls in list(_registered):
+        if cls not in _armed:
+            _arm_class(cls)
+        if cls in _armed:
+            count += 1
+    try:
+        from rbg_tpu.obs import names
+        from rbg_tpu.obs.metrics import REGISTRY
+        REGISTRY.set_gauge(names.RACE_GUARDED_CLASSES, float(count))
+    except Exception:
+        pass
+    return count
+
+
+def disarm() -> None:
+    """Remove the instrumentation and reset counters (test isolation)."""
+    for cls, saved in list(_armed.items()):
+        for attr, (had, value) in saved.items():
+            if had:
+                setattr(cls, attr, value)
+            else:
+                try:
+                    delattr(cls, attr)
+                except AttributeError:
+                    pass
+        del _armed[cls]
+    reset()
+
+
+def reset() -> None:
+    with _state:
+        _violations.clear()
+        _checked[0] = 0
+        _violated[0] = 0
+
+
+def violations() -> List[str]:
+    with _state:
+        return list(_violations)
+
+
+def counters() -> Dict[str, float]:
+    """The ``rbg_race_*`` counter snapshot for reports."""
+    with _state:
+        return {
+            "rbg_race_checked_total": float(_checked[0]),
+            "rbg_race_violations_total": float(_violated[0]),
+            "rbg_race_guarded_classes": float(len(_armed)),
+        }
+
+
+def _record(desc: str) -> None:
+    with _state:
+        _violated[0] += 1
+        if len(_violations) < 200:  # bound the report payload
+            _violations.append(desc)
+    try:
+        from rbg_tpu.obs import names
+        from rbg_tpu.obs.metrics import REGISTRY
+        REGISTRY.inc(names.RACE_VIOLATIONS_TOTAL)
+    except Exception:  # metrics must never mask the finding
+        pass
+    if _mode[0] != "warn":
+        raise RaceError(desc)
+    log.warning("%s", desc)
+
+
+def _arm_class(cls) -> None:
+    """Install the ``__setattr__`` / sampled ``__getattribute__`` probes on
+    one class. Guarded fields come from the class's own source — the same
+    ``# guarded_by[...]`` comments the static rule reads."""
+    import inspect
+
+    from rbg_tpu.analysis.ipe import guarded_fields_from_source
+    try:
+        src = inspect.getsource(cls)
+    except (OSError, TypeError):
+        return
+    fields = guarded_fields_from_source(src).get(cls.__name__, {})
+    if not fields:
+        return
+    sample = read_sample()
+    read_tick = [0]
+
+    saved = {}
+    for attr in ("__setattr__", "__getattribute__", "__init__"):
+        saved[attr] = (attr in cls.__dict__, getattr(cls, attr))
+    orig_setattr = getattr(cls, "__setattr__")
+    orig_getattribute = getattr(cls, "__getattribute__")
+    orig_init = getattr(cls, "__init__")
+
+    def _check(self, name: str, lock: str, op: str) -> None:
+        from rbg_tpu.utils import locktrace
+        with _state:
+            _checked[0] += 1
+        held = locktrace.held_names()
+        if lock in held:
+            return
+        _record(
+            f"unguarded {op} of {cls.__name__}.{name} "
+            f"(guarded_by[{lock}]) on thread "
+            f"{threading.current_thread().name}; held locks: "
+            f"{held or 'none'}")
+
+    def traced_init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        # Construction writes are exempt until here: no peer can hold a
+        # reference to an object still inside its own __init__.
+        object.__setattr__(self, _LIVE_FLAG, True)
+
+    def traced_setattr(self, name, value):
+        lock = fields.get(name)
+        if lock is not None and self.__dict__.get(_LIVE_FLAG):
+            _check(self, name, lock, "write")
+        orig_setattr(self, name, value)
+
+    def traced_getattribute(self, name):
+        lock = fields.get(name)
+        if lock is not None:
+            read_tick[0] += 1  # benign race: it only skews the sampling
+            if read_tick[0] % sample == 0 and object.__getattribute__(
+                    self, "__dict__").get(_LIVE_FLAG):
+                _check(self, name, lock, "read")
+        return orig_getattribute(self, name)
+
+    cls.__setattr__ = traced_setattr
+    cls.__getattribute__ = traced_getattribute
+    cls.__init__ = traced_init
+    _armed[cls] = saved

@@ -2,14 +2,32 @@
 # Static-analysis gate: the domain rules (rbg-tpu lint) + ruff (generic
 # pyflakes/pycodestyle tier, config in pyproject.toml [tool.ruff]).
 #
-#   scripts/lint.sh              # lint rbg_tpu/ (the repo gate)
-#   scripts/lint.sh PATH...      # lint specific files/dirs
+#   scripts/lint.sh                  # lint rbg_tpu/ (the repo gate)
+#   scripts/lint.sh PATH...          # lint specific files/dirs
+#   scripts/lint.sh --json [PATH...] # machine-readable findings
+#                                    #   (file/line/rule/message/severity);
+#                                    #   skips the ruff tier so stdout
+#                                    #   stays pure JSON
+#   scripts/lint.sh --changed        # only files changed vs git HEAD —
+#                                    #   the fast pre-commit mode
 #
 # ruff is OPTIONAL: this container image does not ship it and nothing may
 # be pip-installed here, so when the binary is absent we run the domain
 # rules alone and say so. CI images that have ruff get both tiers.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+LINT_FLAGS=()
+JSON=0
+CHANGED=0
+while [[ "${1:-}" == --* ]]; do
+    case "$1" in
+        --json) JSON=1; LINT_FLAGS+=(--format json) ;;
+        --changed) CHANGED=1; LINT_FLAGS+=(--changed) ;;
+        *) echo "scripts/lint.sh: unknown flag $1" >&2; exit 2 ;;
+    esac
+    shift
+done
 
 PATHS=("$@")
 if [ ${#PATHS[@]} -eq 0 ]; then
@@ -18,10 +36,22 @@ fi
 
 rc=0
 
-echo "== rbg-tpu lint ${PATHS[*]} =="
-python -m rbg_tpu.cli.main lint "${PATHS[@]}" || rc=1
+if [ "$JSON" -eq 0 ]; then
+    echo "== rbg-tpu lint ${LINT_FLAGS[*]} ${PATHS[*]} =="
+fi
+python -m rbg_tpu.cli.main lint ${LINT_FLAGS[@]+"${LINT_FLAGS[@]}"} "${PATHS[@]}" || rc=1
 
-if command -v ruff >/dev/null 2>&1; then
+if [ "$JSON" -eq 1 ]; then
+    # Machine mode: stdout is the findings JSON alone; ruff would pollute it.
+    exit "$rc"
+fi
+
+if [ "$CHANGED" -eq 1 ]; then
+    # Fast pre-commit mode: the domain rules already ran over just the
+    # changed files; a full-tree ruff sweep here would defeat the point
+    # (and fail on files the commit never touched).
+    echo "== --changed: skipping the ruff tier (run scripts/lint.sh for the full gate) =="
+elif command -v ruff >/dev/null 2>&1; then
     echo "== ruff check ${PATHS[*]} =="
     ruff check "${PATHS[@]}" || rc=1
 else

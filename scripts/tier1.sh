@@ -28,6 +28,19 @@ elif [ "${1:-}" = "--lint" ]; then
              "'# lint: allow[rule] why' inline comments) before running tests" >&2
         exit 1
     fi
+    # Dynamic complement to the guarded-by rule: a short overload drill
+    # with the race detector armed. Catches unlocked guarded-field access
+    # on real code paths the AST engine cannot see (runs OUTSIDE the 870 s
+    # pytest budget, only in --lint mode; the full preemption drill is the
+    # acceptance run, kept out of the gate for time).
+    echo "== rbg-tpu stress --scenario overload --racetrace (smoke) =="
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
+            stress --scenario overload --racetrace --clients 2 --requests 2 \
+            --max-queue 2 --max-batch 1 --timeout-s 60 --json >/tmp/_t1_race.json; then
+        echo "TIER1 RACETRACE SMOKE FAILED — see /tmp/_t1_race.json" \
+             "(race_free/invariants)" >&2
+        exit 1
+    fi
 fi
 
 LOG=/tmp/_t1.log

@@ -1,0 +1,38 @@
+"""Known-bad corpus for the guarded-by rule: every marked line must be
+flagged (direct unlocked access, helper reached without the lock, module
+global outside the module lock, unverifiable annotation)."""
+
+from rbg_tpu.utils.locktrace import named_lock
+
+_glock = named_lock("fixture.module")
+_registry = {}  # guarded_by[fixture.module]
+
+
+def module_reader():
+    return len(_registry)  # BAD module global read without fixture.module
+
+
+class Cache:
+    def __init__(self):
+        self._lock = named_lock("fixture.cache")
+        self._items = {}  # guarded_by[fixture.cache]
+        self._count = 0  # guarded_by[fixture.cache]
+
+    def get(self, k):
+        return self._items.get(k)  # BAD direct access outside the lock
+
+    def put(self, k, v):
+        with self._lock:
+            self._items[k] = v
+        self._count += 1  # BAD write after the with block closed
+
+    def _bump(self):
+        self._count += 1  # BAD helper reached from an unlocked caller
+
+    def public_bump(self):
+        self._bump()
+
+
+class Orphan:
+    def __init__(self):
+        self._weird = {}  # guarded_by[missing.lock] # BAD lock never built here

@@ -1,0 +1,13 @@
+"""Known-bad corpus for stale-allow: suppressions whose rule no longer
+fires on the covered line must themselves be findings."""
+
+
+def fixed_long_ago():
+    x = 1  # lint: allow[deadline-hygiene] the mint this excused was removed  # BAD
+    return x
+
+
+def fixed_too():
+    # lint: allow[blocking-in-critical-section] sleep was moved out  # BAD
+    y = 2
+    return y

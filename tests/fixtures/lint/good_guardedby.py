@@ -1,0 +1,48 @@
+"""Known-good corpus for the guarded-by rule: lexical `with`, helpers
+proven lock-held through the call-graph fixpoint (any depth), helpers that
+acquire the lock themselves, __init__ writes, and guarded module globals."""
+
+from rbg_tpu.utils.locktrace import named_lock, named_rlock
+
+_glock = named_lock("fixture.good_module")
+_singleton = None  # guarded_by[fixture.good_module]
+
+
+def set_singleton(v):
+    global _singleton
+    with _glock:
+        _singleton = v
+
+
+def get_singleton():
+    with _glock:
+        return _singleton
+
+
+class Cache:
+    def __init__(self):
+        self._lock = named_rlock("fixture.good_cache")
+        self._items = {}  # guarded_by[fixture.good_cache]
+        # guarded_by[fixture.good_cache]
+        self._count = 0
+
+    def put(self, k, v):
+        with self._lock:
+            self._insert(k, v)
+
+    def _insert(self, k, v):
+        # Lock-held helper: every call site holds the lock.
+        self._items[k] = v
+        self._bump()
+
+    def _bump(self):
+        # Two levels deep: caller (_insert) is itself lock-held.
+        self._count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._items), self._count
+
+    def size(self):
+        with self._lock:
+            return len(self._items)

@@ -1,0 +1,9 @@
+"""Known-good corpus for stale-allow: a justified allow that still
+suppresses a live finding is NOT stale."""
+
+import time as _t
+
+
+def ingress():
+    deadline = _t.monotonic() + 3.0  # lint: allow[deadline-hygiene] ingress stamp example (fixture)
+    return deadline

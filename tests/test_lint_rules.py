@@ -28,6 +28,7 @@ def lint_fixture(fname, rule=None):
      "good_blocking.py", 6),
     ("deadline-hygiene", "bad_deadline.py", "good_deadline.py", 5),
     ("error-code-registry", "bad_errorcodes.py", "good_errorcodes.py", 5),
+    ("guarded-by", "bad_guardedby.py", "good_guardedby.py", 5),
     ("metric-name-registry", "bad_metrics.py", "good_metrics.py", 5),
     ("thread-lifecycle", "bad_threads.py", "good_threads.py", 3),
 ])
@@ -49,7 +50,8 @@ def test_rule_fires_on_bad_and_passes_good(rule, bad, good, min_bad):
 def test_rule_catalog_names_match():
     assert set(rule_catalog()) == {
         "blocking-in-critical-section", "deadline-hygiene",
-        "error-code-registry", "metric-name-registry", "thread-lifecycle"}
+        "error-code-registry", "guarded-by", "metric-name-registry",
+        "thread-lifecycle"}
 
 
 # ---- allowlist semantics ----
@@ -85,8 +87,11 @@ def test_allow_scopes_to_named_rule_only(tmp_path):
                  "def f():\n"
                  "    deadline = _t.monotonic() + 3.0  # lint: allow[thread-lifecycle] wrong rule named\n"
                  "    return deadline\n")
+    # The wrong-rule allow does not suppress the deadline finding, and —
+    # because thread-lifecycle never fires on that line — it is itself a
+    # stale suppression.
     assert {f.rule for f in run_lint([str(p)], make_rules())} == {
-        "deadline-hygiene"}
+        "deadline-hygiene", "stale-allow"}
 
 
 # ---- the repo gate + CLI ----
@@ -191,6 +196,196 @@ def test_metric_constant_from_foreign_module_not_borrowed(tmp_path):
                   "    REGISTRY.observe(names.SERVING_SHED_TOTAL, dt)\n")
     findings = run_lint([str(p2)], make_rules())
     assert any("one name must have one kind" in f.message for f in findings)
+
+
+# ---- guarded-by: the interprocedural corpus ----
+
+
+def test_guardedby_direct_access_flagged():
+    findings = [f for f in lint_fixture("bad_guardedby.py", "guarded-by")
+                if f.rule == "guarded-by"]
+    msgs = "\n".join(f.message for f in findings)
+    assert "`_items` is guarded_by[fixture.cache]" in msgs
+    assert "public entry point" in msgs
+
+
+def test_guardedby_helper_without_lock_names_the_unlocked_caller():
+    findings = [f for f in lint_fixture("bad_guardedby.py", "guarded-by")
+                if f.rule == "guarded-by"]
+    helper = [f for f in findings if "called from `public_bump`" in f.message]
+    assert helper, [f.render() for f in findings]
+
+
+def test_guardedby_helper_under_lock_is_clean():
+    """good_guardedby's _insert/_bump chain (two levels deep) resolves via
+    the call-graph fixpoint — no findings on the good corpus."""
+    assert lint_fixture("good_guardedby.py", "guarded-by") == []
+
+
+def test_guardedby_unverifiable_annotation_flagged():
+    findings = [f for f in lint_fixture("bad_guardedby.py", "guarded-by")
+                if "missing.lock" in f.message]
+    assert findings and "cannot verify" in findings[0].message
+
+
+def test_guardedby_module_global_checked():
+    findings = [f for f in lint_fixture("bad_guardedby.py", "guarded-by")
+                if "_registry" in f.message]
+    assert findings, "module-global guarded access must be checked"
+
+
+def test_guardedby_mixed_callers_flags_the_unlocked_path(tmp_path):
+    """A helper called both under the lock and without it is NOT lock-held:
+    the one unlocked caller poisons it (that is the race)."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from rbg_tpu.utils.locktrace import named_lock\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('t.mixed')\n"
+        "        self._x = 0  # guarded_by[t.mixed]\n"
+        "    def locked_path(self):\n"
+        "        with self._lock:\n"
+        "            self._help()\n"
+        "    def unlocked_path(self):\n"
+        "        self._help()\n"
+        "    def _help(self):\n"
+        "        self._x += 1\n")
+    findings = [f for f in run_lint([str(p)], make_rules(["guarded-by"]))]
+    assert len(findings) == 1
+    assert "unlocked_path" in findings[0].message
+
+
+def test_guardedby_self_acquiring_helper_is_clean(tmp_path):
+    """A helper that takes the lock itself is fine from any caller."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from rbg_tpu.utils.locktrace import named_lock\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('t.selfacq')\n"
+        "        self._x = 0  # guarded_by[t.selfacq]\n"
+        "    def anyone(self):\n"
+        "        return self._grab()\n"
+        "    def _grab(self):\n"
+        "        with self._lock:\n"
+        "            return self._x\n")
+    assert run_lint([str(p)], make_rules(["guarded-by"])) == []
+
+
+# ---- stale-allow ----
+
+
+def test_stale_allow_fixture_corpus():
+    findings = run_lint([os.path.join(FIXTURES, "bad_staleallow.py")],
+                        make_rules(), skip_fixture_dirs=False)
+    stale = [f for f in findings if f.rule == "stale-allow"]
+    assert len(stale) == 2, [f.render() for f in findings]
+    assert all(f.severity == "warning" for f in stale)
+    src = open(os.path.join(FIXTURES, "bad_staleallow.py")).readlines()
+    bad_lines = {i for i, line in enumerate(src, 1) if "# BAD" in line}
+    assert bad_lines == {f.line for f in stale}
+    good = run_lint([os.path.join(FIXTURES, "good_staleallow.py")],
+                    make_rules(), skip_fixture_dirs=False)
+    assert good == [], [f.render() for f in good]
+
+
+def test_stale_allow_ignores_rules_not_running(tmp_path):
+    """`--rule X` must not report allows for rule Y as stale — Y never got
+    the chance to fire."""
+    p = tmp_path / "mod.py"
+    p.write_text("def f():\n"
+                 "    x = 1  # lint: allow[thread-lifecycle] justified elsewhere\n"
+                 "    return x\n")
+    assert run_lint([str(p)], make_rules(["deadline-hygiene"])) == []
+    stale = run_lint([str(p)], make_rules())
+    assert [f.rule for f in stale] == ["stale-allow"]
+
+
+# ---- CLI: json format + --changed ----
+
+
+def _run_cli(args, cwd=None):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "PYTHONPATH": repo_root}
+    return subprocess.run(
+        [sys.executable, "-m", "rbg_tpu.cli.main", "lint", *args],
+        capture_output=True, text=True, env=env, timeout=120, cwd=cwd)
+
+
+def test_cli_json_format_fields():
+    import json
+    bad = os.path.join(FIXTURES, "bad_deadline.py")
+    r = _run_cli(["--include-fixtures", "--format", "json", bad])
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert payload, "expected findings"
+    for item in payload:
+        assert set(item) == {"file", "line", "col", "rule", "message",
+                             "severity"}
+    assert any(i["rule"] == "deadline-hygiene" for i in payload)
+    assert all(i["severity"] in ("error", "warning") for i in payload)
+
+
+def test_cli_changed_mode(tmp_path):
+    import json
+    repo = tmp_path / "proj"
+    pkg = repo / "pkg"
+    pkg.mkdir(parents=True)
+    env = {**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@x",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@x"}
+
+    def git(*argv):
+        subprocess.run(["git", *argv], cwd=repo, check=True, env=env,
+                       capture_output=True, timeout=60)
+
+    (pkg / "clean.py").write_text("x = 1\n")
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # Untouched tree: --changed lints nothing and exits 0.
+    r = _run_cli(["--changed", "--format", "json", "pkg"], cwd=str(repo))
+    assert r.returncode == 0 and json.loads(r.stdout) == []
+    # Touch one file with a finding; only it is linted.
+    (pkg / "clean.py").write_text(
+        "import time as _t\n"
+        "def f():\n"
+        "    deadline = _t.monotonic() + 3.0\n"
+        "    return deadline\n")
+    (pkg / "untouched.py").write_text("ignored = True\n")  # untracked: linted
+    r = _run_cli(["--changed", "--format", "json", "pkg"], cwd=str(repo))
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert {os.path.basename(i["file"]) for i in payload} == {"clean.py"}
+    # A path that excludes the changed file sees nothing.
+    (repo / "other").mkdir()
+    r = _run_cli(["--changed", "other"], cwd=str(repo))
+    assert r.returncode == 0
+
+
+# ---- one parse pass per file ----
+
+
+def test_gate_parses_each_file_exactly_once(monkeypatch):
+    """The repo gate must parse every module ONCE and share the tree across
+    all rules (including the metric catalog consulted at finalize time)."""
+    import ast as ast_mod
+    from collections import Counter
+    counts = Counter()
+    real_parse = ast_mod.parse
+
+    def counting_parse(source, *a, **kw):
+        fn = kw.get("filename") or (a[0] if a else "<unknown>")
+        counts[fn] += 1
+        return real_parse(source, *a, **kw)
+
+    monkeypatch.setattr(ast_mod, "parse", counting_parse)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = run_lint([os.path.join(repo, "rbg_tpu")], make_rules())
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+    multi = {f: c for f, c in counts.items() if c > 1}
+    assert not multi, f"files parsed more than once: {multi}"
+    assert len(counts) > 100  # the gate actually walked the tree
 
 
 # ---- metric catalog self-audit ----
@@ -298,11 +493,110 @@ def test_locktrace_warn_mode_counts_instead_of_raising(traced, monkeypatch):
 
 def test_locktrace_disabled_returns_stdlib_locks(monkeypatch):
     monkeypatch.delenv("RBG_LOCKTRACE", raising=False)
+    monkeypatch.delenv("RBG_RACETRACE", raising=False)
     from rbg_tpu.utils import locktrace
     lock = locktrace.named_lock("plain")
     assert not isinstance(lock, locktrace.TracedLock)
     with lock:
         pass
+
+
+@pytest.mark.parametrize("value", ["0", "false", "off"])
+def test_locktrace_explicit_off_values_construct_stdlib_locks(
+        monkeypatch, value):
+    """RBG_LOCKTRACE=0 (and friends) is the zero-overhead path: plain
+    stdlib lock / rlock / condition objects, no wrapper anywhere."""
+    import threading
+    monkeypatch.setenv("RBG_LOCKTRACE", value)
+    monkeypatch.delenv("RBG_RACETRACE", raising=False)
+    from rbg_tpu.utils import locktrace
+    assert type(locktrace.named_lock("z")) is type(threading.Lock())
+    assert type(locktrace.named_rlock("z")) is type(threading.RLock())
+    cond = locktrace.named_condition("z")
+    assert isinstance(cond, threading.Condition)
+    assert type(cond._lock) is type(threading.RLock())  # stdlib default
+    assert locktrace.held_names() == []
+
+
+def test_locktrace_reentrant_deep_nesting_keeps_order_clean(traced):
+    """Re-entrant re-acquires at any depth add no edges and do not corrupt
+    the held stack: the orders proven around them stay consistent."""
+    r = traced.named_rlock("deepR")
+    a = traced.named_lock("deepA")
+    with r:
+        with r:
+            with r:
+                with a:
+                    pass
+    # Same outer order again, no reentrancy: must still be clean.
+    with r:
+        with a:
+            pass
+    assert traced.inversions() == []
+    assert traced.snapshot().get("deepR") == ["deepA"]
+    assert traced.held_names() == []
+
+
+def test_locktrace_warn_counter_accuracy_under_concurrent_inversions(
+        traced, monkeypatch):
+    """N threads racing the SAME B->A inversion: the first attempt records
+    it, later attempts see an established (bad) edge and stay silent — the
+    counter moves by exactly 1 and matches inversions()."""
+    import threading
+    monkeypatch.setenv("RBG_LOCKTRACE", "warn")
+    from rbg_tpu.obs.metrics import REGISTRY
+    from rbg_tpu.obs.names import LOCKTRACE_INVERSIONS_TOTAL
+    before = REGISTRY.counter(LOCKTRACE_INVERSIONS_TOTAL)
+    a = traced.named_lock("cwA")
+    b = traced.named_lock("cwB")
+    with a:
+        with b:
+            pass
+    barrier = threading.Barrier(4)
+
+    def invert():
+        barrier.wait(timeout=10)
+        with b:
+            with a:
+                pass
+
+    threads = [threading.Thread(target=invert, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert not any(t.is_alive() for t in threads)
+    assert len(traced.inversions()) == 1
+    assert REGISTRY.counter(LOCKTRACE_INVERSIONS_TOTAL) == before + 1
+
+
+def test_locktrace_named_condition_participates(traced):
+    """named_condition's mutex is traced: held_names sees it, and an order
+    inversion through a condition still raises."""
+    cond = traced.named_condition("condX")
+    a = traced.named_lock("condA")
+    with cond:
+        assert "condX" in traced.held_names()
+        with a:  # establishes condX -> condA
+            pass
+    assert traced.held_names() == []
+    with pytest.raises(traced.LockOrderError):
+        with a:
+            with cond:
+                pass
+
+
+def test_locktrace_held_names_tracks_stack(traced):
+    a = traced.named_lock("hnA")
+    b = traced.named_lock("hnB")
+    assert traced.held_names() == []
+    with a:
+        assert traced.held_names() == ["hnA"]
+        with b:
+            assert traced.held_names() == ["hnA", "hnB"]
+        assert traced.held_names() == ["hnA"]
+    assert traced.held_names() == []
 
 
 def test_plane_lifecycle_under_locktrace(traced):

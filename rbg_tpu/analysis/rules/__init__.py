@@ -10,6 +10,7 @@ from rbg_tpu.analysis.rules.deadlines import DeadlineHygiene
 from rbg_tpu.analysis.rules.errorcodes import ErrorCodeRegistry
 from rbg_tpu.analysis.rules.guardedby import GuardedBy
 from rbg_tpu.analysis.rules.metricnames import MetricNameRegistry
+from rbg_tpu.analysis.rules.spannames import SpanNameRegistry
 from rbg_tpu.analysis.rules.threads import ThreadLifecycle
 
 RULE_CLASSES: List[Type[Rule]] = [
@@ -18,6 +19,7 @@ RULE_CLASSES: List[Type[Rule]] = [
     ErrorCodeRegistry,
     GuardedBy,
     MetricNameRegistry,
+    SpanNameRegistry,
     ThreadLifecycle,
 ]
 

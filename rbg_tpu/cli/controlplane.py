@@ -9,6 +9,7 @@ serve daemon (rbg_tpu.runtime.executor).
 
 from __future__ import annotations
 
+import os
 import sys
 
 
@@ -125,6 +126,32 @@ def register(sub) -> None:
                         "(default: $RBG_ADMIN_TLS_CA)")
     rp.add_argument("-n", "--namespace", default="default")
     rp.set_defaults(func=cmd_rollout)
+
+    tp = sub.add_parser(
+        "traces",
+        help="pull request traces from a live plane: slowest-request "
+             "waterfall, recent/slowest trace summaries, and the histogram "
+             "exemplars linking a bad quantile to a trace_id "
+             "(requires RBG_TRACE=1 on the target process)")
+    tp.add_argument("--admin", default="127.0.0.1:7070",
+                    help="admin endpoint of a `serve` plane; pass an "
+                         "engine-server address via --engine instead to "
+                         "pull from a serving pod")
+    tp.add_argument("--engine", default=None,
+                    help="engine-server host:port (the serving-plane "
+                         "`traces` data op; bypasses --admin)")
+    tp.add_argument("--token", default=None,
+                    help="bearer token: admin token for --admin (default: "
+                         "$RBG_ADMIN_TOKEN), data-plane token for --engine "
+                         "(default: $RBG_DATA_TOKEN)")
+    tp.add_argument("--tls-ca", default=None,
+                    help="CA cert for a TLS admin endpoint "
+                         "(default: $RBG_ADMIN_TLS_CA)")
+    tp.add_argument("--slowest", type=int, default=10, metavar="N",
+                    help="how many slowest/recent traces to pull")
+    tp.add_argument("--json", action="store_true",
+                    help="raw JSON (waterfall + records + exemplars)")
+    tp.set_defaults(func=cmd_traces)
 
 
 def _load(path: str):
@@ -411,6 +438,66 @@ def cmd_rollout(args) -> int:
     resp = _admin_call(args.admin, {"op": "undo", "revision": args.revision, **base}, token=getattr(args, 'token', None),
                        tls_ca=getattr(args, 'tls_ca', None))
     print(f"rolled back to revision {resp['restoredRevision']}")
+    return 0
+
+
+def cmd_traces(args) -> int:
+    """Pull the trace sink (admin plane or engine server) and render the
+    slowest-request waterfall plus per-trace summaries — the operator leg
+    of the exemplar→waterfall workflow (docs/observability.md)."""
+    import json as _json
+
+    req = {"op": "traces", "n": args.slowest}
+    if args.engine:
+        from rbg_tpu.engine.protocol import request_once
+        # The serving wire is token-gated (RBG_DATA_TOKEN, VERDICT r4 #6) —
+        # not the admin bearer; --token overrides the env for both legs.
+        token = (getattr(args, "token", None)
+                 or os.environ.get("RBG_DATA_TOKEN") or None)
+        if token:
+            req["token"] = token
+        try:
+            resp, _, _ = request_once(args.engine, req, timeout=30.0)
+        except OSError as e:
+            print(f"error: cannot reach engine server {args.engine}: {e}",
+                  file=sys.stderr)
+            return 1
+        if resp is None or "error" in (resp or {}):
+            print(f"error: {(resp or {}).get('error', 'closed connection')}",
+                  file=sys.stderr)
+            return 1
+    else:
+        resp = _admin_call(args.admin, req,
+                           token=getattr(args, "token", None),
+                           tls_ca=getattr(args, "tls_ca", None))
+    if args.json:
+        print(_json.dumps(resp, indent=2))
+        return 0
+    slowest = resp.get("slowest") or []
+    recent = resp.get("recent") or []
+    print(f"traces: {len(slowest)} slowest / {len(recent)} recent "
+          f"buffered, {resp.get('active', 0)} active")
+    if not slowest:
+        print("no finalized traces (is RBG_TRACE=1 set on the target, and "
+              "has a sampled request completed?)")
+        return 0
+    print("\nslowest-request waterfall:")
+    for line in resp.get("waterfall") or []:
+        print(f"  {line}")
+    print(f"\n{'TRACE':<34} {'ROOT':<18} {'MS':>9}  SPANS  COMPLETE")
+    for r in slowest:
+        print(f"{r.get('trace_id', '?'):<34} {r.get('root', ''):<18} "
+              f"{r.get('duration_ms') or 0:>9.1f}  "
+              f"{len(r.get('spans') or []):>5}  "
+              f"{'yes' if r.get('complete') else 'NO'}")
+    ex = resp.get("exemplars") or []
+    if ex:
+        print("\nexemplars (slowest trace per histogram bucket):")
+        for e in ex[:20]:
+            labels = ",".join(f"{k}={v}" for k, v in
+                              sorted((e.get("labels") or {}).items()))
+            print(f"  {e['metric']}{{{labels}}} le={e['le']} "
+                  f"value={e['value']} trace={e['trace_id']}")
     return 0
 
 

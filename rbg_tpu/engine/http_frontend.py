@@ -35,6 +35,8 @@ from rbg_tpu.api.errors import CODE_HTTP_STATUS as _CODE_STATUS
 from rbg_tpu.engine.config import SamplingParams
 from rbg_tpu.engine.protocol import recv_msg, request_once, send_msg
 from rbg_tpu.engine.tokenizer import IncrementalDetokenizer, load_tokenizer
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs import trace
 
 # Structured backend rejections → HTTP statuses and OpenAI-style error
 # types: the mapping lives with the code catalog (api/errors.py) so the
@@ -65,7 +67,10 @@ class _State:
     def backend_req(self, req: dict) -> dict:
         if self.data_token:
             req["token"] = self.data_token
-        return req
+        # Trace context rides the wire next to the token: the router (or a
+        # unified engine server) continues this edge's http.request span.
+        # No-op when the request is unsampled or tracing is off.
+        return trace.inject(req)
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -79,9 +84,13 @@ class Handler(BaseHTTPRequestHandler):
 
     def _json(self, code: int, body: dict, extra_headers=None):
         data = json.dumps(body).encode()
+        self._status = code
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        rid = getattr(self, "_request_id", None)
+        if rid:
+            self.send_header("X-Request-Id", rid)
         for k, v in (extra_headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -118,6 +127,10 @@ class Handler(BaseHTTPRequestHandler):
     # ---- routes ----
 
     def do_GET(self):
+        # Re-stamp per request: the handler instance persists across a
+        # keep-alive connection, so a stale id from an earlier POST must
+        # not be echoed on this response.
+        self._request_id = self.headers.get("X-Request-Id")
         st: _State = self.server.state
         if self.path == "/healthz":
             ok, draining = True, False
@@ -138,6 +151,25 @@ class Handler(BaseHTTPRequestHandler):
         return self._error(404, f"no route {self.path}")
 
     def do_POST(self):
+        # Request identity + trace ingress (alongside the PR-2 deadline):
+        # accept the caller's X-Request-Id (stamp one otherwise — it is
+        # echoed on every response), accept a W3C ``traceparent`` header,
+        # and make the http.request span ambient so the whole handler —
+        # backend_req injection included — rides under it.
+        self._request_id = (self.headers.get("X-Request-Id")
+                            or f"req-{uuid.uuid4().hex[:16]}")
+        self._status = 0
+        span = trace.ingress_span(obs_names.SPAN_HTTP_REQUEST,
+                                  traceparent=self.headers.get("traceparent"),
+                                  path=self.path,
+                                  request_id=self._request_id)
+        try:
+            with trace.use_span(span):
+                self._handle_post()
+        finally:
+            span.end(status=self._status)
+
+    def _handle_post(self):
         st: _State = self.server.state
         try:
             body = self._body()
@@ -428,10 +460,14 @@ class Handler(BaseHTTPRequestHandler):
         if "error" in first_frame:
             conn.close()
             return self._backend_error(first_frame)
+        self._status = 200
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Transfer-Encoding", "chunked")
+        rid_hdr = getattr(self, "_request_id", None)
+        if rid_hdr:
+            self.send_header("X-Request-Id", rid_hdr)
         self.end_headers()
         if chat:
             first = self._chunk(st, rid, created, chat, None, None)

@@ -29,6 +29,8 @@ import numpy as np
 from rbg_tpu.engine.config import EngineConfig, SamplingParams
 from rbg_tpu.engine.engine import Engine, Request
 from rbg_tpu.engine.kvcache import pages_for_tokens
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs import trace
 
 
 @dataclasses.dataclass
@@ -163,7 +165,13 @@ class DecodeWorker:
     def inject(self, bundle: KVBundle,
                sampling: Optional[SamplingParams] = None) -> int:
         """Import a KV bundle and start decoding it. Returns the request id.
-        The first token is accounted as output[0] (already produced)."""
+        The first token is accounted as output[0] (already produced).
+
+        The page-pool import (the on-device half of the prefill→decode KV
+        handoff) gets its own ``pd.kv_handoff`` span under the ambient
+        request span — the ROADMAP transfer-plane work (chunked /
+        layer-overlapped streaming) lands inside this same hop and
+        inherits the instrumentation."""
         sampling = sampling or SamplingParams()
         eng = self.engine
         prompt = bundle.prompt
@@ -176,14 +184,18 @@ class DecodeWorker:
         pages = eng._alloc(need)
         if pages is None:
             raise RuntimeError("decode engine out of KV pages")
-        ids = jnp.asarray(pages[:n_pages], jnp.int32)
-        from rbg_tpu.engine.kvcache import PagedKVCache
-        eng.cache = PagedKVCache(
-            k_pages=eng.cache.k_pages.at[:, ids].set(
-                jnp.asarray(bundle.k_data, eng.cache.k_pages.dtype)),
-            v_pages=eng.cache.v_pages.at[:, ids].set(
-                jnp.asarray(bundle.v_data, eng.cache.v_pages.dtype)),
-        )
+        # Context-manager form: a raise in the page import must still end
+        # the span or the trace finalizes incomplete.
+        with trace.child(obs_names.SPAN_PD_KV_HANDOFF,
+                         bytes=bundle.nbytes, pages=int(n_pages)):
+            ids = jnp.asarray(pages[:n_pages], jnp.int32)
+            from rbg_tpu.engine.kvcache import PagedKVCache
+            eng.cache = PagedKVCache(
+                k_pages=eng.cache.k_pages.at[:, ids].set(
+                    jnp.asarray(bundle.k_data, eng.cache.k_pages.dtype)),
+                v_pages=eng.cache.v_pages.at[:, ids].set(
+                    jnp.asarray(bundle.v_data, eng.cache.v_pages.dtype)),
+            )
         req = Request(prompt, sampling)
         req.lora_idx = lora_idx
         g = eng._grammar_for(sampling)

@@ -46,6 +46,8 @@ from typing import Dict, List, Optional, Tuple
 from rbg_tpu.engine.protocol import (CODE_DEADLINE, CODE_DRAINING,
                                      RETRYABLE_REJECT_CODES, recv_msg,
                                      request_once, send_msg)
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs import trace
 
 MAX_ATTEMPTS = 3          # distinct backends tried per leg
 CONNECT_TIMEOUT_S = 5.0   # fast failure detection on the connect
@@ -472,21 +474,34 @@ class RouterState:
         if not cands:
             raise RuntimeError(f"no {role} backends available")
         akey = PrefixAffinity.key(prompt)
+        rspan = trace.current()     # ambient request span (NULL when off)
         last: Optional[Exception] = None
         shed: Optional[dict] = None
         for i, addr in enumerate(cands[:MAX_ATTEMPTS]):
+            aspan = rspan.child(obs_names.SPAN_ROUTER_ATTEMPT,
+                                backend=addr, attempt=i, role=role)
+            if aspan and k_bytes is not None:
+                aspan.attrs["kv_bytes"] = len(k_bytes) + len(v_bytes or b"")
             if deadline is not None:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     self.metrics["deadline_refusals"] += 1
+                    aspan.end(outcome="deadline")
                     raise _Rejected(_deadline_frame(
                         f"deadline spent before dispatch to {role} "
                         f"(attempt {i + 1})"))
                 timeout = min(LEG_TIMEOUT_S, remaining)
                 obj = dict(obj)
                 obj["timeout_s"] = round(remaining, 3)
+            if aspan:
+                # Per-attempt child context: the backend's engine.op span
+                # parents under THIS attempt, so sibling retries stay
+                # distinguishable in the waterfall.
+                obj = dict(obj)
+                obj["trace"] = aspan.wire()
             if i:
                 if not self.charge_retry():
+                    aspan.end(outcome="retry_budget_exhausted")
                     break
                 self.metrics["retries"] += 1
             self.pool.acquire(addr)
@@ -495,12 +510,14 @@ class RouterState:
                                             timeout=timeout)
             except (OSError, ConnectionError, json.JSONDecodeError) as e:
                 self.pool.fail(addr)
+                aspan.end(outcome="transport_error")
                 last = e
                 continue
             finally:
                 self.pool.release(addr)
             if resp is None:
                 self.pool.fail(addr)
+                aspan.end(outcome="transport_error")
                 last = RuntimeError(f"{addr} closed connection")
                 continue
             code = resp.get("code")
@@ -509,14 +526,17 @@ class RouterState:
                 # mid-run abort): structured passthrough — a sibling retry
                 # would dispatch work that is already out of time.
                 self.pool.ok(addr)
+                aspan.end(outcome="deadline")
                 raise _Rejected(resp)
             if code in RETRYABLE_REJECT_CODES:
                 shed = self.note_shed(addr, resp, shed)
+                aspan.end(outcome=code)
                 continue
             self.pool.ok(addr)
             self.affinity.put(akey, addr)
             if i:
                 self.metrics["failovers"] += 1
+            aspan.end(outcome="ok")
             return addr, resp, rk, rv
         if shed is not None:
             self.metrics["sheds_returned"] += 1
@@ -573,36 +593,52 @@ class Handler(socketserver.BaseRequestHandler):
                 self._send_client({"error": f"bad timeout_s: {e}",
                                    "done": True})
                 continue
+            # The router continues the edge's trace context — or IS the
+            # ingress (head sampling) when clients hit it directly. The
+            # incoming context is consumed here; every downstream leg gets
+            # a fresh per-attempt child context instead.
+            rspan = trace.from_wire(obj.pop("trace", None),
+                                    obs_names.SPAN_ROUTER_REQUEST, op=op)
             if op == "embed":
                 state.metrics["requests"] += 1
                 try:
-                    _, resp, _, _ = state.call(state.worker_role(), obj,
-                                               deadline=deadline)
+                    with trace.use_span(rspan):
+                        _, resp, _, _ = state.call(state.worker_role(), obj,
+                                                   deadline=deadline)
                 except _Rejected as e:
                     resp = e.frame
                 except Exception as e:
                     state.metrics["errors"] += 1
                     resp = {"error": f"embed: {e}"}
+                rspan.end(outcome=resp.get("code") or
+                          ("error" if "error" in resp else "ok"))
                 self._send_client(resp)
                 continue
             if op != "generate":
+                rspan.end(outcome="unsupported_op")
                 self._send_client({"error": f"router: unsupported op {op!r}"})
                 continue
             try:
-                if obj.get("stream"):
-                    self._generate_stream(state, obj, deadline)
-                else:
-                    resp = self._generate(state, obj, deadline)
-                    self._send_client(resp)
+                with trace.use_span(rspan):
+                    if obj.get("stream"):
+                        self._generate_stream(state, obj, deadline)
+                    else:
+                        resp = self._generate(state, obj, deadline)
+                        self._send_client(resp)
             except _ClientGone:
+                rspan.end(outcome="client_gone")
                 raise
             except _Rejected as e:
                 # Structured shed/deadline: NOT a router error — the
                 # contract under overload is exactly this reply.
+                rspan.end(outcome=e.frame.get("code") or "rejected")
                 self._send_client({**e.frame, "done": True})
             except Exception as e:
                 state.metrics["errors"] += 1
+                rspan.end(outcome="error")
                 self._send_client({"error": str(e), "done": True})
+            else:
+                rspan.end(outcome="ok")
 
     @staticmethod
     def _stamp_deadline(obj: dict) -> float:
@@ -702,6 +738,8 @@ class Handler(socketserver.BaseRequestHandler):
         structured frame instead of another doomed attempt."""
         role, payload, aff = self._route(state, obj, deadline)
         akey = PrefixAffinity.key(aff)
+        rspan = trace.current()
+        kv_bytes = len(payload[1] or b"") + len(payload[2] or b"")
         delivered = 0                  # tokens already relayed to the client
         last: Optional[Exception] = None
         shed: Optional[dict] = None
@@ -718,14 +756,24 @@ class Handler(socketserver.BaseRequestHandler):
             if not cands:
                 break
             addr = cands[0]
+            aspan = rspan.child(obs_names.SPAN_ROUTER_ATTEMPT,
+                                backend=addr, attempt=attempt, role=role,
+                                stream=True)
+            if aspan and kv_bytes:
+                aspan.attrs["kv_bytes"] = kv_bytes
             if attempt:
                 if not state.charge_retry():
+                    aspan.end(outcome="retry_budget_exhausted")
                     break
                 state.metrics["retries"] += 1
+            attempt_payload = payload
+            if aspan:
+                attempt_payload = (dict(payload[0], trace=aspan.wire()),
+                                   payload[1], payload[2])
             state.pool.acquire(addr)
             try:
                 delivered, status, frame = self._relay_attempt(
-                    addr, payload, delivered, deadline)
+                    addr, attempt_payload, delivered, deadline)
             finally:
                 state.pool.release(addr)
             if status == "done":
@@ -733,18 +781,22 @@ class Handler(socketserver.BaseRequestHandler):
                 state.affinity.put(akey, addr)
                 if attempt:
                     state.metrics["failovers"] += 1
+                aspan.end(outcome="ok", delivered=delivered)
                 return
             if status == "rejected":
                 # Healthy backend refused the attempt (shed before any
                 # token): no eviction; deadline ends the request.
                 if frame.get("code") == CODE_DEADLINE:
                     state.pool.ok(addr)
+                    aspan.end(outcome=CODE_DEADLINE)
                     self._send_client({**frame, "done": True})
                     return
                 shed = state.note_shed(addr, frame, shed)
+                aspan.end(outcome=frame.get("code") or "rejected")
                 continue
             # Backend closed mid-stream without a done frame.
             state.pool.fail(addr)
+            aspan.end(outcome="died_mid_stream", delivered=delivered)
             last = RuntimeError(f"{addr} closed mid-stream")
         if shed is not None:
             state.metrics["sheds_returned"] += 1

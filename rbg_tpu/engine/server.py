@@ -28,7 +28,7 @@ from rbg_tpu.engine.config import EngineConfig, SamplingParams
 from rbg_tpu.engine.protocol import (CODE_DRAINING, DeadlineExceeded,
                                      Rejected, bundle_from_wire,
                                      bundle_to_wire, recv_msg, send_msg)
-from rbg_tpu.obs import names
+from rbg_tpu.obs import names, trace
 from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.utils.locktrace import named_lock
 
@@ -190,6 +190,11 @@ class Handler(socketserver.BaseRequestHandler):
         self._dispatch_data(srv, obj, k, v)
 
     def _dispatch_data(self, srv, obj, k, v):
+        """Auth gate + trace wrapper around :meth:`_serve_data`: every data
+        op continues the request's wire trace context (or starts one when
+        this server IS the ingress) as an ``engine.op`` span, ambient for
+        the op's duration so the service queue/scan spans and the PD
+        KV-handoff span parent under it."""
         op = obj.get("op")
         if srv.auth_token and op != "metrics":
             # Data-plane token gate (VERDICT r4 #6): prefill/decode_bundle
@@ -200,6 +205,28 @@ class Handler(socketserver.BaseRequestHandler):
             if not token_ok(obj.get("token"), srv.auth_token):
                 send_msg(self.request, {"error": "unauthorized"})
                 return
+        if op == "traces":
+            # Operator pull of the trace sink (the serving-plane sibling of
+            # the admin `traces` op): recent + slowest ring buffers, the
+            # slowest request's waterfall, and the histogram exemplars
+            # linking a bad quantile to a trace_id.
+            from rbg_tpu.obs.trace import traces_response
+            send_msg(self.request, traces_response(obj.get("n", 10)))
+            return
+        if op in self._DATA_OPS:
+            span = trace.from_wire(obj.get("trace"), names.SPAN_ENGINE_OP,
+                                   op=op, mode=srv.mode)
+            if not span:
+                return self._serve_data(srv, obj, k, v)
+            try:
+                with trace.use_span(span):
+                    return self._serve_data(srv, obj, k, v)
+            finally:
+                span.end()
+        return self._serve_data(srv, obj, k, v)
+
+    def _serve_data(self, srv, obj, k, v):
+        op = obj.get("op")
         if op == "warmup":
             # Compile every jit bucket variant NOW (one blocking op per
             # serving pod, before it takes traffic) instead of stalling
@@ -344,25 +371,35 @@ class Handler(socketserver.BaseRequestHandler):
             # carrying request bounds its wait for the lock (the implicit
             # queue here), and a budget spent while queued is refused
             # BEFORE any prefill compute burns chip time.
+            qspan = trace.child(names.SPAN_SERVICE_QUEUE_WAIT)
             if deadline is not None:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not srv.pd_lock.acquire(timeout=remaining):
                     REGISTRY.inc(names.SERVING_DEADLINE_EXCEEDED_TOTAL,
                                  stage="prefill_queue")
+                    qspan.end(outcome="deadline")
                     send_msg(self.request, DeadlineExceeded(
                         "deadline spent waiting for the prefill engine"
                     ).to_wire())
                     return
             else:
                 srv.pd_lock.acquire()
+            qspan.end(outcome="admitted")
+            pspan = trace.child(names.SPAN_PD_PREFILL,
+                                prompt_tokens=len(obj.get("prompt") or ()))
             try:
                 bundle = srv.prefill.prefill(obj["prompt"], sampling,
                                              deadline=deadline)
             except DeadlineExceeded as e:
+                pspan.end(outcome="deadline_abort")
                 send_msg(self.request, e.to_wire())
                 return
+            except Exception:
+                pspan.end(outcome="error")
+                raise
             finally:
                 srv.pd_lock.release()
+            pspan.end(outcome="ok", bytes=bundle.nbytes)
             header, kb, vb = bundle_to_wire(bundle)
             send_msg(self.request, header, kb, vb)
             return

@@ -22,7 +22,7 @@ from rbg_tpu.engine.engine import Engine
 # protocol.py so jax-free processes (server startup) can import them.
 from rbg_tpu.engine.protocol import (CODE_DEADLINE, DeadlineExceeded,
                                      Overloaded, Rejected)
-from rbg_tpu.obs import names
+from rbg_tpu.obs import names, trace
 from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.utils.locktrace import named_lock
 from rbg_tpu.utils.racetrace import guard as _race_guard
@@ -30,7 +30,7 @@ from rbg_tpu.utils.racetrace import guard as _race_guard
 
 class _Pending:
     __slots__ = ("tokens", "logprobs", "done", "t_submit", "t_first", "error",
-                 "code", "deadline")
+                 "code", "deadline", "span_parent", "span_queue", "span_scan")
 
     def __init__(self, deadline: Optional[float] = None):
         self.tokens: List[int] = []
@@ -41,6 +41,12 @@ class _Pending:
         self.error: Optional[str] = None
         self.code: Optional[str] = None   # structured rejection code
         self.deadline = deadline          # absolute time.monotonic() budget
+        # Tracing (obs/trace.py): parent span of this request plus the
+        # queue-wait / scan child spans — NULL_SPAN when unsampled, so
+        # every lifecycle site below ends them unconditionally.
+        self.span_parent = trace.NULL_SPAN
+        self.span_queue = trace.NULL_SPAN
+        self.span_scan = trace.NULL_SPAN
 
 
 DEFAULT_TIMEOUT_S = 600.0
@@ -194,31 +200,44 @@ class _BatchService:
 
     # -- public --
     def submit_async(self, item, sampling: SamplingParams,
-                     deadline: Optional[float] = None) -> _Pending:
+                     deadline: Optional[float] = None,
+                     span=None) -> _Pending:
         """Enqueue one request. ``deadline`` is absolute ``time.monotonic()``
         seconds; raises ``Overloaded`` / ``DeadlineExceeded`` instead of
-        queueing work that cannot be served."""
+        queueing work that cannot be served. ``span`` (or the ambient
+        current span) parents this request's queue-wait/scan spans; shed
+        and deadline rejections still close their span — a refused request
+        must leave a complete trace, not an orphan."""
+        parent = span if span is not None else trace.current()
+        qspan = parent.child(names.SPAN_SERVICE_QUEUE_WAIT)
         now = time.monotonic()
         if deadline is not None and now >= deadline:
             with self._lock:
                 self.counters["deadline_queue_drops"] += 1
             REGISTRY.inc(names.SERVING_DEADLINE_EXCEEDED_TOTAL, stage="queue")
+            qspan.end(outcome="deadline")
             raise DeadlineExceeded("deadline already expired at submission")
         p = _Pending(deadline=deadline)
-        with self._lock:
-            # estimated_wait_s with an explicit depth never re-takes the
-            # lock, so both gates may raise from inside it.
-            depth = len(self._queue)
-            if self.max_queue is not None and depth >= self.max_queue:
-                self._shed(f"service queue full ({self.max_queue})", depth)
-            if deadline is not None:
-                est = self.estimated_wait_s(depth)
-                if est is not None and now + est >= deadline:
-                    self._shed(
-                        f"estimated wait {est:.2f}s exceeds remaining "
-                        f"deadline budget {deadline - now:.2f}s", depth)
-            self._queue.append((item, sampling, p))
-            REGISTRY.observe(names.SERVING_QUEUE_DEPTH, depth + 1)
+        p.span_parent = parent
+        p.span_queue = qspan
+        try:
+            with self._lock:
+                # estimated_wait_s with an explicit depth never re-takes the
+                # lock, so both gates may raise from inside it.
+                depth = len(self._queue)
+                if self.max_queue is not None and depth >= self.max_queue:
+                    self._shed(f"service queue full ({self.max_queue})", depth)
+                if deadline is not None:
+                    est = self.estimated_wait_s(depth)
+                    if est is not None and now + est >= deadline:
+                        self._shed(
+                            f"estimated wait {est:.2f}s exceeds remaining "
+                            f"deadline budget {deadline - now:.2f}s", depth)
+                self._queue.append((item, sampling, p))
+                REGISTRY.observe(names.SERVING_QUEUE_DEPTH, depth + 1)
+        except Rejected as e:
+            qspan.end(outcome=e.code)
+            raise
         self._wake.set()
         return p
 
@@ -277,13 +296,14 @@ class _BatchService:
 
     def submit_wait(self, item, sampling: SamplingParams,
                     timeout: float = DEFAULT_TIMEOUT_S,
-                    deadline: Optional[float] = None) -> _Pending:
+                    deadline: Optional[float] = None,
+                    span=None) -> _Pending:
         """Blocking submit; returns the completed _Pending (tokens,
         logprobs, ttft timestamps). The one blocking-wait/timeout contract
         every caller — server ops included — goes through. ``deadline``
         (absolute monotonic) bounds the whole stay: admission gate, queue
         drop, AND engine-side abort, not just this thread's wait."""
-        p = self.submit_async(item, sampling, deadline=deadline)
+        p = self.submit_async(item, sampling, deadline=deadline, span=span)
         if deadline is not None:
             timeout = min(timeout, max(0.0, deadline - time.monotonic()) + 1.0)
         self.wait(p, timeout)
@@ -352,6 +372,8 @@ class _BatchService:
                          stage="running")
             p.error = "deadline exceeded mid-generation (aborted)"
             p.code = CODE_DEADLINE
+            p.span_scan.end(outcome="deadline_abort",
+                            tokens=len(p.tokens))
             p.done.set()
 
     def _loop(self):
@@ -376,16 +398,29 @@ class _BatchService:
                              stage="queue")
                 pending.error = "deadline expired before admission"
                 pending.code = CODE_DEADLINE
+                pending.span_queue.end(outcome="deadline_dropped")
                 pending.done.set()
             for item, sampling, pending in newly:
+                pending.span_queue.end(outcome="admitted")
+                scan = pending.span_scan = pending.span_parent.child(
+                    names.SPAN_SERVICE_SCAN)
                 try:
-                    rid = self._admit(item, sampling)
+                    if pending.span_parent:
+                        # Ambient span so hop internals (e.g. the decode
+                        # bundle KV-import in pd.py) attach their own
+                        # children without signature plumbing.
+                        with trace.use_span(pending.span_parent):
+                            rid = self._admit(item, sampling)
+                    else:
+                        rid = self._admit(item, sampling)
                 except Exception as e:
                     # A bad request must fail ITSELF, never the loop thread.
+                    scan.end(outcome="admit_error")
                     pending.error = str(e)
                     pending.done.set()
                     continue
                 if rid is None:
+                    scan.end(outcome="done_at_admit")
                     pending.done.set()  # completed at admission
                     self._done_times.append(time.monotonic())
                     continue
@@ -397,11 +432,13 @@ class _BatchService:
                 if rid is not None:
                     eng.cancel_request(rid)
                     del self._pending[rid]
+                    pending.span_scan.end(outcome="cancelled")
                     pending.done.set()
                 else:
                     # Still queued (never admitted) — drop it from the queue.
                     with self._lock:
                         self._queue = [q for q in self._queue if q[2] is not pending]
+                    pending.span_queue.end(outcome="cancelled")
                     pending.done.set()
             if not eng.has_work():
                 with self._lock:
@@ -420,6 +457,13 @@ class _BatchService:
                 if ev.logprob is not None:
                     pending.logprobs.append(ev.logprob)
                 if ev.finished:
+                    pending.span_scan.end(outcome="ok",
+                                          tokens=len(pending.tokens))
+                    REGISTRY.observe(
+                        names.SERVING_REQUEST_DURATION_SECONDS,
+                        time.perf_counter() - pending.t_submit,
+                        exemplar=pending.span_scan.trace_id or None,
+                        service=type(self).__name__.lower())
                     pending.done.set()
                     del self._pending[ev.request_id]
                     # Completion history feeds the estimated-wait gate.

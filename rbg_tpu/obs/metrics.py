@@ -71,25 +71,39 @@ class Registry:
         with self._lock:
             return self._counters.get(key, 0.0)
 
-    def observe(self, name: str, value: float, **labels):
+    def observe(self, name: str, value: float, exemplar: Optional[str] = None,
+                **labels):
+        """``exemplar``: a trace_id to remember for the bucket this value
+        lands in (the SLOWEST value per bucket wins) — a bad quantile then
+        links to a concrete trace waterfall via the ``traces`` op."""
         self._check(name, "histogram")
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             h = self._hist.get(key)
             if h is None:
-                h = [[0] * (len(_BUCKETS) + 1), 0.0, 0]  # buckets, sum, count
+                # buckets, sum, count, observed max, per-bucket exemplar
+                h = [[0] * (len(_BUCKETS) + 1), 0.0, 0, 0.0,
+                     [None] * (len(_BUCKETS) + 1)]
                 self._hist[key] = h
             for i, b in enumerate(_BUCKETS):
                 if value <= b:
                     h[0][i] += 1
                     break
             else:
+                i = len(_BUCKETS)
                 h[0][-1] += 1
             h[1] += value
             h[2] += 1
+            h[3] = max(h[3], value)
+            if exemplar is not None:
+                ex = h[4][i]
+                if ex is None or value >= ex[0]:
+                    h[4][i] = (value, exemplar)
 
     def quantile(self, name: str, q: float, **labels) -> Optional[float]:
-        """Approximate quantile from histogram buckets (upper bound)."""
+        """Approximate quantile from histogram buckets (upper bound). A
+        quantile landing in the overflow bucket reports the OBSERVED max
+        instead of +Inf — "all samples overflowed" has a finite answer."""
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             h = self._hist.get(key)
@@ -100,27 +114,91 @@ class Registry:
             for i, count in enumerate(h[0]):
                 seen += count
                 if seen >= target:
-                    return _BUCKETS[i] if i < len(_BUCKETS) else float("inf")
-            return float("inf")
+                    return _BUCKETS[i] if i < len(_BUCKETS) else h[3]
+            return h[3]
 
-    def render(self) -> str:
-        """Prometheus text exposition."""
+    def exemplars(self, name: str, **labels) -> Dict[str, dict]:
+        """{le: {"value", "trace_id"}} for one histogram series — the
+        slowest traced observation per bucket."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._hist.get(key)
+            if h is None:
+                return {}
+            out = {}
+            for i, ex in enumerate(h[4]):
+                if ex is None:
+                    continue
+                le = str(_BUCKETS[i]) if i < len(_BUCKETS) else "+Inf"
+                out[le] = {"value": ex[0], "trace_id": ex[1]}
+            return out
+
+    def exemplars_snapshot(self) -> list:
+        """Every bucket exemplar across every histogram series, flat —
+        what the ``traces`` op returns so an operator can walk quantile →
+        trace_id → waterfall."""
+        with self._lock:
+            out = []
+            for (name, labels), h in sorted(self._hist.items()):
+                for i, ex in enumerate(h[4]):
+                    if ex is None:
+                        continue
+                    out.append({
+                        "metric": name, "labels": dict(labels),
+                        "le": (str(_BUCKETS[i]) if i < len(_BUCKETS)
+                               else "+Inf"),
+                        "value": round(ex[0], 6), "trace_id": ex[1]})
+            return out
+
+    def render(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition, with ``# HELP``/``# TYPE`` metadata
+        per family (help text from the obs/names.py catalog; the type is
+        known from which store the family lives in). ``exemplars=True``
+        appends OpenMetrics-style ``# {trace_id="..."} v`` exemplars to
+        bucket lines — off by default so plain Prometheus text parsers
+        stay happy."""
+        from rbg_tpu.obs import names as _names
         lines = []
+        seen = set()
+
+        def meta(name: str, kind: str):
+            if name in seen:
+                return
+            seen.add(name)
+            help_text = _names.HELP.get(name)
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+
         with self._lock:
             for (name, labels), v in sorted(self._counters.items()):
+                meta(name, "counter")
                 lines.append(f"{name}{_fmt(labels)} {v}")
             for (name, labels), v in sorted(self._gauges.items()):
+                meta(name, "gauge")
                 lines.append(f"{name}{_fmt(labels)} {v}")
-            for (name, labels), (buckets, total, count) in sorted(self._hist.items()):
+            for (name, labels), h in sorted(self._hist.items()):
+                buckets, total, count = h[0], h[1], h[2]
+                meta(name, "histogram")
                 cum = 0
                 for i, b in enumerate(_BUCKETS):
                     cum += buckets[i]
-                    lines.append(f"{name}_bucket{_fmt(labels, le=b)} {cum}")
+                    line = f"{name}_bucket{_fmt(labels, le=b)} {cum}"
+                    lines.append(self._exemplar_suffix(line, h[4][i])
+                                 if exemplars else line)
                 cum += buckets[-1]
-                lines.append(f'{name}_bucket{_fmt(labels, le="+Inf")} {cum}')
+                line = f'{name}_bucket{_fmt(labels, le="+Inf")} {cum}'
+                lines.append(self._exemplar_suffix(line, h[4][-1])
+                             if exemplars else line)
                 lines.append(f"{name}_sum{_fmt(labels)} {total}")
                 lines.append(f"{name}_count{_fmt(labels)} {count}")
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _exemplar_suffix(line: str, ex) -> str:
+        if ex is None:
+            return line
+        return f'{line} # {{trace_id="{ex[1]}"}} {ex[0]}'
 
     def reset(self):
         with self._lock:
@@ -129,11 +207,19 @@ class Registry:
             self._gauges.clear()
 
 
+def _esc(v) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, and
+    line-feed must be escaped or the series line is malformed (some
+    scrapers reject the whole exposition)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt(labels: tuple, **extra) -> str:
     items = list(labels) + sorted(extra.items())
     if not items:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    inner = ",".join(f'{k}="{_esc(v)}"' for k, v in items)
     return "{" + inner + "}"
 
 

@@ -39,6 +39,8 @@ DISRUPTION_SPARES_CONSUMED_TOTAL = "rbg_disruption_spares_consumed_total"
 LOCKTRACE_INVERSIONS_TOTAL = "rbg_locktrace_inversions_total"
 RACE_CHECKED_TOTAL = "rbg_race_checked_total"
 RACE_VIOLATIONS_TOTAL = "rbg_race_violations_total"
+TRACE_TRACES_TOTAL = "rbg_trace_traces_total"
+TRACE_SPANS_DROPPED_TOTAL = "rbg_trace_spans_dropped_total"
 
 # ---- gauges (last-write-wins) ----
 
@@ -50,6 +52,7 @@ RACE_GUARDED_CLASSES = "rbg_race_guarded_classes"
 
 RECONCILE_DURATION_SECONDS = "rbg_reconcile_duration_seconds"
 SERVING_QUEUE_DEPTH = "rbg_serving_queue_depth"
+SERVING_REQUEST_DURATION_SECONDS = "rbg_serving_request_duration_seconds"
 
 # ---- catalog sets (consumed by the lint rule and strict-mode registry) ----
 
@@ -69,6 +72,8 @@ COUNTERS = frozenset({
     LOCKTRACE_INVERSIONS_TOTAL,
     RACE_CHECKED_TOTAL,
     RACE_VIOLATIONS_TOTAL,
+    TRACE_TRACES_TOTAL,
+    TRACE_SPANS_DROPPED_TOTAL,
 })
 
 GAUGES = frozenset({
@@ -80,6 +85,69 @@ GAUGES = frozenset({
 HISTOGRAMS = frozenset({
     RECONCILE_DURATION_SECONDS,
     SERVING_QUEUE_DEPTH,
+    SERVING_REQUEST_DURATION_SECONDS,
 })
 
 ALL_NAMES = COUNTERS | GAUGES | HISTOGRAMS
+
+# ---- exposition help text (render() emits it as # HELP) ----
+
+HELP = {
+    RECONCILE_TOTAL: "Reconcile passes per controller and result",
+    SERVING_SHED_TOTAL: "Requests shed by admission control",
+    SERVING_DEADLINE_EXCEEDED_TOTAL:
+        "Requests dropped or aborted past their deadline, per stage",
+    SERVING_DRAINS_TOTAL: "SIGTERM drains started",
+    SERVING_DRAIN_REFUSALS_TOTAL: "Data ops refused while draining",
+    DISRUPTION_NOTICES_TOTAL: "Advance maintenance notices observed",
+    DISRUPTION_PREEMPTIONS_TOTAL: "No-notice slice preemptions observed",
+    DISRUPTION_GANG_KILLS_TOTAL: "Whole-gang kills after partial slice loss",
+    DISRUPTION_MIGRATIONS_COMPLETED_TOTAL:
+        "Maintenance migrations completed before their deadline",
+    DISRUPTION_MIGRATIONS_MISSED_DEADLINE_TOTAL:
+        "Maintenance migrations that missed their deadline",
+    DISRUPTION_SLICES_RELEASED_TOTAL: "Slices released to maintenance",
+    DISRUPTION_SPARES_CONSUMED_TOTAL: "Warm spare slices granted",
+    LOCKTRACE_INVERSIONS_TOTAL: "Lock acquisition-order inversions observed",
+    RACE_CHECKED_TOTAL: "Guarded-field accesses checked by racetrace",
+    RACE_VIOLATIONS_TOTAL: "Guarded-field accesses without the owning lock",
+    TRACE_TRACES_TOTAL: "Traces finalized into the trace sink, per result",
+    TRACE_SPANS_DROPPED_TOTAL:
+        "Spans dropped by the per-trace span bound",
+    SERVING_DRAINING: "1 while this process is draining",
+    DISRUPTION_SPARE_POOL_DEPTH: "Reserved warm spare slices per topology",
+    RACE_GUARDED_CLASSES: "Classes instrumented by the race detector",
+    RECONCILE_DURATION_SECONDS: "Reconcile latency per controller",
+    SERVING_QUEUE_DEPTH: "Service queue depth observed at submission",
+    SERVING_REQUEST_DURATION_SECONDS:
+        "End-to-end request latency inside the serving loop",
+}
+
+# ---- span names (obs/trace.py) ----
+#
+# Same contract as the metric catalog: every span name the tracer emits is
+# declared here once, the ``span-name-registry`` lint rule flags literals
+# that are not, and ``RBG_TRACE_STRICT=1`` adds the same check at span
+# creation time. Naming contract: lowercase dotted ``component.phase``.
+
+SPAN_HTTP_REQUEST = "http.request"
+SPAN_ROUTER_REQUEST = "router.request"
+SPAN_ROUTER_ATTEMPT = "router.attempt"
+SPAN_ENGINE_OP = "engine.op"
+SPAN_SERVICE_QUEUE_WAIT = "service.queue_wait"
+SPAN_SERVICE_SCAN = "service.scan"
+SPAN_PD_PREFILL = "pd.prefill"
+SPAN_PD_KV_HANDOFF = "pd.kv_handoff"
+SPAN_STRESS_REQUEST = "stress.request"
+
+SPANS = frozenset({
+    SPAN_HTTP_REQUEST,
+    SPAN_ROUTER_REQUEST,
+    SPAN_ROUTER_ATTEMPT,
+    SPAN_ENGINE_OP,
+    SPAN_SERVICE_QUEUE_WAIT,
+    SPAN_SERVICE_SCAN,
+    SPAN_PD_PREFILL,
+    SPAN_PD_KV_HANDOFF,
+    SPAN_STRESS_REQUEST,
+})

@@ -19,13 +19,24 @@ from collections import Counter
 from typing import List, Optional
 
 
+def _site(f) -> str:
+    return f"{f.name} ({os.path.basename(f.filename)}:{f.lineno})"
+
+
 def sample_profile(seconds: float = 2.0, interval: float = 0.01,
                    top_n: int = 30, stop_event: Optional[threading.Event] = None,
-                   exclude_thread: Optional[int] = None) -> dict:
+                   exclude_thread: Optional[int] = None,
+                   folded_depth: int = 24, folded_top: int = 60) -> dict:
     """Sample all threads for ``seconds`` (or until ``stop_event``); return
-    {"seconds", "samples", "top": [{"site", "samples"}]}."""
+    {"seconds", "samples", "top": [{"site", "samples"}], "folded": [...]}.
+
+    ``top`` is the leaf-only hot-site table; ``folded`` carries the FULL
+    stacks in flamegraph-folded form — ``root;caller;leaf N`` lines
+    (oldest frame first, ``;``-joined, sample count last), directly
+    consumable by flamegraph.pl / speedscope."""
     me = exclude_thread if exclude_thread is not None else threading.get_ident()
     counts: Counter = Counter()
+    folded: Counter = Counter()
     t0 = time.monotonic()
     end = t0 + seconds
     samples = 0
@@ -35,10 +46,18 @@ def sample_profile(seconds: float = 2.0, interval: float = 0.01,
         for tid, frame in sys._current_frames().items():
             if tid == me:
                 continue
-            stack = traceback.extract_stack(frame, limit=3)
+            stack = traceback.extract_stack(frame)
             if stack:
-                f = stack[-1]
-                counts[f"{f.name} ({os.path.basename(f.filename)}:{f.lineno})"] += 1
+                counts[_site(stack[-1])] += 1
+                names = [_site(f) for f in stack]
+                if len(names) > folded_depth:
+                    # Root-anchored truncation: flamegraphs merge from the
+                    # root, so deep stacks must keep their OLDEST frames
+                    # (``limit=`` keeps the newest — same chain would render
+                    # as many disconnected towers). Drop leaf-side frames
+                    # and mark the elision.
+                    names = names[:folded_depth - 1] + ["…truncated"]
+                folded[";".join(names)] += 1
         samples += 1
         time.sleep(interval)
     return {
@@ -46,6 +65,8 @@ def sample_profile(seconds: float = 2.0, interval: float = 0.01,
         "samples": samples,
         "top": [{"site": site, "samples": n}
                 for site, n in counts.most_common(top_n)],
+        "folded": [f"{stack} {n}"
+                   for stack, n in folded.most_common(folded_top)],
     }
 
 

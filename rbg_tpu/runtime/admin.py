@@ -133,6 +133,13 @@ class _Handler(socketserver.BaseRequestHandler):
         if op == "metrics":
             from rbg_tpu.obs.metrics import REGISTRY
             return {"text": REGISTRY.render()}
+        if op == "traces":
+            # Operator pull of the trace sink: recent + slowest-N ring
+            # buffers, the slowest request's rendered waterfall, and the
+            # histogram exemplars that link a bad quantile to a trace_id
+            # (scrape → exemplar → waterfall, no log spelunking).
+            from rbg_tpu.obs.trace import traces_response
+            return traces_response(obj.get("n", 10))
         if op == "profile":
             # pprof analog (reference: cmd/rbgs/main.go:584-620); see
             # rbg_tpu/obs/profiler.py for why sampling, not cProfile.

@@ -207,6 +207,10 @@ def _run(cfg: StressConfig, plane: ControlPlane) -> dict:
             for c in ("rolebasedgroup", "roleinstanceset", "roleinstance", "scheduler")
         },
         "create_phase_profile": create_prof.result,
+        # Flamegraph-folded full stacks (`root;caller;leaf N`), directly
+        # consumable by flamegraph.pl / speedscope — the leaf-only `top`
+        # table above can't tell WHICH caller chain owns a hot leaf.
+        "profile_folded": (create_prof.result or {}).get("folded", []),
     }
     return report
 
@@ -265,27 +269,39 @@ def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
             time.sleep(0.002)
 
     def client(ci: int):
+        from rbg_tpu.obs import trace
         sp = SamplingParams(max_new_tokens=cfg.max_new_tokens)
         prompt = [(ci * 17 + j) % 200 + 1 for j in range(cfg.prompt_len)]
-        for _ in range(cfg.requests_per_client):
+        for ri in range(cfg.requests_per_client):
             t0 = time.monotonic()
+            # Root span per drill request (sampling per --trace-sample);
+            # the service's queue-wait/scan spans — and the shed/deadline
+            # rejections — parent under it, so the report's waterfall is
+            # the real hop timeline, not a synthetic one.
+            root = trace.start_trace(metric_names.SPAN_STRESS_REQUEST,
+                                     client=ci, request=ri)
             try:
                 service.submit_wait(prompt, sp,
-                                    deadline=t0 + cfg.timeout_s)
+                                    deadline=t0 + cfg.timeout_s,
+                                    span=root)
             except Overloaded as e:
+                root.end(outcome=CODE_OVERLOADED)
                 with olock:
                     outcomes[CODE_OVERLOADED] += 1
                     if e.retry_after_s is not None:
                         retry_hints.append(e.retry_after_s)
                 continue
             except DeadlineExceeded:
+                root.end(outcome=CODE_DEADLINE)
                 with olock:
                     outcomes[CODE_DEADLINE] += 1
                 continue
             except Exception:
+                root.end(outcome="error")
                 with olock:
                     outcomes["error"] += 1
                 continue
+            root.end(outcome="ok")
             with olock:
                 outcomes["ok"] += 1
                 latencies.append(time.monotonic() - t0)
@@ -690,7 +706,21 @@ def main(argv=None) -> int:
                          "sampled read) of a `# guarded_by[...]` field "
                          "checks the owning lock is held; violations fail "
                          "the run via the race_free invariant")
+    ap.add_argument("--trace", action="store_true",
+                    help="run the scenario with request tracing armed "
+                         "(obs/trace.py): per-request hop spans, the "
+                         "slowest-request waterfall in the report, and a "
+                         "trace_complete invariant (every sampled request "
+                         "forms one rooted span tree — no orphans/leaks)")
+    ap.add_argument("--trace-sample", type=float, default=None,
+                    metavar="RATE",
+                    help="head-sampling rate for --trace (default 1.0 in "
+                         "the drill so the report is deterministic; "
+                         "production via RBG_TRACE_SAMPLE defaults to "
+                         "0.01 + the sink always keeps the slowest-N)")
     args = ap.parse_args(argv)
+    if args.trace_sample is not None:
+        args.trace = True
     import os
     if args.locktrace:
         # Must be set BEFORE any plane/service objects are constructed —
@@ -705,6 +735,21 @@ def main(argv=None) -> int:
         from rbg_tpu.utils import racetrace
         racetrace.reset()
         racetrace.arm()
+    if args.trace:
+        # Programmatic arming (env-var route: RBG_TRACE=1). Sample 1.0 by
+        # default so a drill of a few dozen requests reliably fills the
+        # waterfall; the sink is reset so the report reflects THIS run.
+        from rbg_tpu.obs import trace as _trace
+        _trace.configure(enabled=True,
+                         sample=(1.0 if args.trace_sample is None
+                                 else args.trace_sample))
+        _trace.SINK.reset()
+        # Counter baseline so _attach_trace judges only THIS run's
+        # finalizations (in-process callers, e.g. tests, may have traced
+        # before).
+        args._trace_counter_base = {
+            r: REGISTRY.counter(metric_names.TRACE_TRACES_TOTAL, result=r)
+            for r in ("complete", "incomplete", "leaked")}
     load1 = os.getloadavg()[0]
     if args.scenario in ("overload", "preemption"):
         if args.scenario == "overload":
@@ -722,6 +767,7 @@ def main(argv=None) -> int:
         report["load1_before"] = round(load1, 2)
         _attach_locktrace(report, args)
         _attach_racetrace(report, args)
+        _attach_trace(report, args)
         if args.json_out:
             with open(args.json_out, "w") as f:
                 json.dump(report, f, indent=1)
@@ -743,6 +789,7 @@ def main(argv=None) -> int:
         argv if argv is not None else __import__("sys").argv[1:])
     _attach_locktrace(report, args)
     _attach_racetrace(report, args)
+    _attach_trace(report, args)
     if args.json_out:
         with open(args.json_out, "w") as f:
             json.dump(report, f, indent=1)
@@ -770,6 +817,52 @@ def _attach_locktrace(report: dict, args) -> None:
     if "invariants" in report:
         report["invariants"]["lock_order_acyclic"] = (
             not locktrace.inversions())
+
+
+def _attach_trace(report: dict, args) -> None:
+    """Fold the trace sink into the report when --trace ran: the
+    slowest-request waterfall, per-trace summaries, and two invariants —
+    ``trace_complete`` (every sampled request's spans form one rooted
+    tree: no orphans, no leaked/never-ended roots) and, for the overload
+    drill, ``trace_hops_cover_root`` (the hop durations of the slowest
+    request sum — union of intervals, so retries don't double-count — to
+    ≥90% of its root span: the waterfall explains the latency it reports).
+    """
+    if not getattr(args, "trace", False):
+        return
+    from rbg_tpu.obs import trace
+    recent = trace.SINK.recent(64)
+    slowest = trace.SINK.slowest(10)
+    active = trace.SINK.active_count()
+    cov = trace.hop_coverage(slowest[0]) if slowest else None
+    # Soundness comes from the per-finalization counters, not the recent
+    # ring (capped at 64 — a drill can finalize far more, and an orphan
+    # evicted from the ring must still red the invariant). The ring only
+    # supplies concrete example trace_ids for the report.
+    base = getattr(args, "_trace_counter_base", {})
+    totals = {r: max(0.0, REGISTRY.counter(metric_names.TRACE_TRACES_TOTAL,
+                                           result=r) - base.get(r, 0.0))
+              for r in ("complete", "incomplete", "leaked")}
+    seen = {}
+    for r in recent + slowest:
+        seen[r["trace_id"]] = r
+    incomplete = [tid for tid, r in seen.items() if not r["complete"]]
+    report["trace"] = {
+        "sampled_finalized": int(sum(totals.values())),
+        "finalized_by_result": {k: int(v) for k, v in totals.items()},
+        "active_unfinalized": active,
+        "incomplete": incomplete,
+        "slowest": slowest[:5],
+        "waterfall": trace.waterfall(slowest[0]) if slowest else [],
+        "hop_coverage": round(cov, 4) if cov is not None else None,
+    }
+    if "invariants" in report:
+        report["invariants"]["trace_complete"] = (
+            totals["complete"] > 0 and totals["incomplete"] == 0
+            and totals["leaked"] == 0 and active == 0)
+        if getattr(args, "scenario", "") == "overload":
+            report["invariants"]["trace_hops_cover_root"] = (
+                cov is not None and cov >= 0.9)
 
 
 def _attach_racetrace(report: dict, args) -> None:
@@ -863,6 +956,11 @@ def write_html_report(report: dict, path: str) -> None:
         body = _preemption_sections(report)
     else:
         body = f"<pre>{json.dumps(report, indent=2)}</pre>"
+    tr = report.get("trace")
+    if tr:
+        wf = "\n".join(tr.get("waterfall") or ["(no sampled traces)"])
+        body += (f"<h2>slowest-request waterfall (hop coverage: "
+                 f"{tr.get('hop_coverage')})</h2><pre>{wf}</pre>")
     html = f"""<!doctype html><html><head><meta charset="utf-8">
 <title>rbg-tpu stress report — {scenario}</title>
 <style>body{{font-family:sans-serif;margin:2rem}}table{{border-collapse:collapse;margin-bottom:1rem}}

@@ -29,16 +29,29 @@ elif [ "${1:-}" = "--lint" ]; then
         exit 1
     fi
     # Dynamic complement to the guarded-by rule: a short overload drill
-    # with the race detector armed. Catches unlocked guarded-field access
-    # on real code paths the AST engine cannot see (runs OUTSIDE the 870 s
-    # pytest budget, only in --lint mode; the full preemption drill is the
-    # acceptance run, kept out of the gate for time).
-    echo "== rbg-tpu stress --scenario overload --racetrace (smoke) =="
+    # with the race detector AND request tracing armed. Catches unlocked
+    # guarded-field access on real code paths the AST engine cannot see,
+    # and asserts the trace layer produces a complete, non-empty
+    # slowest-request waterfall (runs OUTSIDE the 870 s pytest budget,
+    # only in --lint mode; the full preemption drill is the acceptance
+    # run, kept out of the gate for time).
+    echo "== rbg-tpu stress --scenario overload --racetrace --trace (smoke) =="
     if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
-            stress --scenario overload --racetrace --clients 2 --requests 2 \
+            stress --scenario overload --racetrace --trace --clients 2 --requests 2 \
             --max-queue 2 --max-batch 1 --timeout-s 60 --json >/tmp/_t1_race.json; then
         echo "TIER1 RACETRACE SMOKE FAILED — see /tmp/_t1_race.json" \
              "(race_free/invariants)" >&2
+        exit 1
+    fi
+    if ! python -c "
+import json, sys
+r = json.load(open('/tmp/_t1_race.json'))
+t = r.get('trace') or {}
+assert t.get('waterfall'), 'slowest-trace waterfall is empty'
+assert r['invariants'].get('trace_complete'), 'trace_complete invariant red'
+"; then
+        echo "TIER1 TRACE SMOKE FAILED — empty waterfall or incomplete" \
+             "traces in /tmp/_t1_race.json" >&2
         exit 1
     fi
 fi

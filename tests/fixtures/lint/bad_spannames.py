@@ -1,0 +1,15 @@
+"""Known-bad fixture: span-name violations at tracer call sites."""
+from rbg_tpu.obs import names, trace
+from rbg_tpu.obs.trace import start_trace
+
+
+def handle(parent):
+    root = trace.start_trace("router.reqest")          # BAD: typo/unregistered
+    sp = trace.child("service.queue_waits")            # BAD: unregistered
+    trace.from_wire({}, "engine.opp")                  # BAD: name is arg 2
+    trace.ingress_span("HTTP.Request")                 # BAD: naming contract
+    other = start_trace("pd.prefil")                   # BAD: from-import form
+    parent.child("router.atempt")                      # BAD: method call site
+    root.end()
+    sp.end()
+    return other

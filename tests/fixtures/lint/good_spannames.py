@@ -1,0 +1,14 @@
+"""Known-good fixture: cataloged span names, constants and literals."""
+from rbg_tpu.obs import names, trace
+
+
+def handle(parent, tree):
+    root = trace.start_trace(names.SPAN_ROUTER_REQUEST)     # constant: ok
+    sp = trace.child("service.queue_wait")                  # cataloged literal
+    trace.from_wire({}, names.SPAN_ENGINE_OP, op="generate")
+    trace.ingress_span("http.request", traceparent=None)
+    attempt = parent.child(names.SPAN_ROUTER_ATTEMPT)       # method call site
+    tree.child("section")          # non-span .child(): not a dotted name, ok
+    attempt.end()
+    sp.end()
+    return root

@@ -560,3 +560,87 @@ def test_unsupported_response_format_returns_400():
             assert e.code == 400
     finally:
         fe.shutdown(); be.close()
+
+
+def test_request_id_stamped_and_echoed():
+    """Every POST gets an X-Request-Id: the caller's value is echoed back
+    verbatim; without one the edge stamps (and returns) a fresh id."""
+    import http.client
+    be = _canned("hi")
+    fe, port = _frontend_for(be.port)
+    try:
+        body = json.dumps({"prompt": "x", "max_tokens": 4}).encode()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/v1/completions", body,
+                     headers={"Content-Type": "application/json",
+                              "X-Request-Id": "req-mine-42"})
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 200
+        assert r.getheader("X-Request-Id") == "req-mine-42"
+        conn.request("POST", "/v1/completions", body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        r.read()
+        stamped = r.getheader("X-Request-Id")
+        assert stamped and stamped.startswith("req-")
+        conn.close()
+    finally:
+        fe.shutdown(); be.close()
+
+
+def test_traceparent_ingress_continues_trace_and_injects_wire_ctx():
+    """With tracing armed, a W3C traceparent header continues the
+    client's trace: the edge's http.request span parents under it, the
+    backend request carries the wire context, and the finalized record is
+    complete. With tracing off, requests stay untouched (no trace key)."""
+    import http.client
+
+    from rbg_tpu.obs import trace
+
+    be = _canned("hi")
+    fe, port = _frontend_for(be.port)
+    old = (trace._CFG.enabled, trace._CFG.sample, trace._CFG.strict)
+    trace.configure(enabled=True, sample=1.0, strict=False)
+    trace.SINK.reset()
+    try:
+        tid, parent = "ab" * 16, "cd" * 8
+        body = json.dumps({"prompt": "x", "max_tokens": 4}).encode()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/v1/completions", body,
+                     headers={"Content-Type": "application/json",
+                              "traceparent": f"00-{tid}-{parent}-01"})
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 200
+        wire = be.seen[-1].get("trace")
+        assert wire and wire["trace_id"] == tid and wire["sampled"]
+        # The span END happens on the handler thread after the reply: poll.
+        deadline = time.monotonic() + 10.0
+        recs = []
+        while time.monotonic() < deadline and not recs:
+            recs = [rec for rec in trace.SINK.recent(10)
+                    if rec["trace_id"] == tid]
+            time.sleep(0.01)
+        assert recs, "edge span never finalized"
+        span = recs[0]["spans"][0]
+        assert span["name"] == "http.request"
+        assert span["parent_id"] == parent          # continued, not re-rooted
+        assert span["attrs"]["status"] == 200
+        assert span["attrs"]["path"] == "/v1/completions"
+        assert recs[0]["complete"]
+        # The backend saw the edge span (not the remote parent) as parent.
+        assert wire["parent_id"] == span["span_id"]
+
+        # Tracing off: zero footprint on the wire.
+        trace.configure(enabled=False)
+        conn.request("POST", "/v1/completions", body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        r.read()
+        assert "trace" not in be.seen[-1]
+        conn.close()
+    finally:
+        trace.configure(enabled=old[0], sample=old[1], strict=old[2])
+        trace.SINK.reset()
+        fe.shutdown(); be.close()

@@ -30,6 +30,7 @@ def lint_fixture(fname, rule=None):
     ("error-code-registry", "bad_errorcodes.py", "good_errorcodes.py", 5),
     ("guarded-by", "bad_guardedby.py", "good_guardedby.py", 5),
     ("metric-name-registry", "bad_metrics.py", "good_metrics.py", 5),
+    ("span-name-registry", "bad_spannames.py", "good_spannames.py", 6),
     ("thread-lifecycle", "bad_threads.py", "good_threads.py", 3),
 ])
 def test_rule_fires_on_bad_and_passes_good(rule, bad, good, min_bad):
@@ -47,11 +48,27 @@ def test_rule_fires_on_bad_and_passes_good(rule, bad, good, min_bad):
     assert good_findings == [], [f.render() for f in good_findings]
 
 
+def test_span_catalog_audit_flags_unregistered_and_duplicates(tmp_path):
+    """The finalize pass audits the catalog itself: duplicate SPAN_*
+    values, constants missing from the SPANS frozenset, contract breaks."""
+    from rbg_tpu.analysis.rules.spannames import SpanNameRegistry
+    cat = tmp_path / "fake_names.py"
+    cat.write_text('SPAN_A = "a.b"\n'
+                   'SPAN_DUP = "a.b"\n'
+                   'SPAN_BAD = "NotDotted"\n')
+    rule = SpanNameRegistry()
+    rule._names_module = str(cat)
+    msgs = " | ".join(f.render() for f in rule.finalize())
+    assert "duplicate span registration: SPAN_DUP and SPAN_A" in msgs
+    assert "not in the SPANS frozenset" in msgs
+    assert "naming contract" in msgs
+
+
 def test_rule_catalog_names_match():
     assert set(rule_catalog()) == {
         "blocking-in-critical-section", "deadline-hygiene",
         "error-code-registry", "guarded-by", "metric-name-registry",
-        "thread-lifecycle"}
+        "span-name-registry", "thread-lifecycle"}
 
 
 # ---- allowlist semantics ----

@@ -1,0 +1,138 @@
+"""Prometheus exposition hygiene (obs/metrics.py) + profiler folded
+stacks: # HELP/# TYPE metadata, label-value escaping, the finite-max
+overflow quantile, and trace exemplars per histogram bucket."""
+
+import threading
+
+import pytest
+
+from rbg_tpu.obs import names
+from rbg_tpu.obs.metrics import _BUCKETS, Registry, _fmt
+
+
+@pytest.fixture()
+def reg():
+    return Registry(strict=False)
+
+
+def test_render_emits_type_and_help_metadata(reg):
+    reg.inc(names.SERVING_SHED_TOTAL, reason="queue_full")
+    reg.set_gauge(names.SERVING_DRAINING, 1.0)
+    reg.observe(names.RECONCILE_DURATION_SECONDS, 0.2, controller="rbg")
+    text = reg.render()
+    lines = text.splitlines()
+    assert f"# TYPE {names.SERVING_SHED_TOTAL} counter" in lines
+    assert f"# TYPE {names.SERVING_DRAINING} gauge" in lines
+    assert f"# TYPE {names.RECONCILE_DURATION_SECONDS} histogram" in lines
+    for metric in (names.SERVING_SHED_TOTAL, names.SERVING_DRAINING,
+                   names.RECONCILE_DURATION_SECONDS):
+        help_line = next(ln for ln in lines
+                         if ln.startswith(f"# HELP {metric} "))
+        assert help_line == f"# HELP {metric} {names.HELP[metric]}"
+        # Metadata precedes the first sample of its family, exactly once.
+        assert text.count(f"# TYPE {metric} ") == 1
+    # Every # TYPE line sits before its family's first sample line.
+    first_sample = next(i for i, ln in enumerate(lines)
+                        if ln.startswith(names.SERVING_SHED_TOTAL))
+    type_line = lines.index(f"# TYPE {names.SERVING_SHED_TOTAL} counter")
+    assert type_line < first_sample
+
+
+def test_type_emitted_once_across_label_sets(reg):
+    reg.inc(names.SERVING_SHED_TOTAL, reason="a")
+    reg.inc(names.SERVING_SHED_TOTAL, reason="b")
+    assert reg.render().count(f"# TYPE {names.SERVING_SHED_TOTAL}") == 1
+
+
+def test_label_values_escape_quotes_backslashes_newlines(reg):
+    reg.inc(names.SERVING_SHED_TOTAL,
+            reason='queue "full" at C:\\dev\nnow')
+    text = reg.render()
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith(names.SERVING_SHED_TOTAL))
+    assert 'reason="queue \\"full\\" at C:\\\\dev\\nnow"' in line
+    # The exposition stays one-sample-per-line parseable.
+    assert "\n" not in line
+
+
+def test_fmt_escaping_unit():
+    assert _fmt((("k", 'a"b'),)) == '{k="a\\"b"}'
+    assert _fmt((("k", "a\\b"),)) == '{k="a\\\\b"}'
+    assert _fmt((("k", "a\nb"),)) == '{k="a\\nb"}'
+
+
+def test_quantile_overflow_bucket_returns_observed_max(reg):
+    top = _BUCKETS[-1]
+    for v in (top + 1.0, top + 2.0, top + 7.5):
+        reg.observe(names.RECONCILE_DURATION_SECONDS, v, controller="c")
+    # Every sample overflowed — the answer is the finite observed max,
+    # not +Inf, for ANY quantile.
+    assert reg.quantile(names.RECONCILE_DURATION_SECONDS, 0.5,
+                        controller="c") == top + 7.5
+    assert reg.quantile(names.RECONCILE_DURATION_SECONDS, 0.99,
+                        controller="c") == top + 7.5
+    # Mixed: a mid-bucket quantile still reports the bucket upper bound.
+    reg2 = Registry(strict=False)
+    for v in (0.002, 0.002, 0.002, top + 3.0):
+        reg2.observe(names.RECONCILE_DURATION_SECONDS, v, controller="c")
+    assert reg2.quantile(names.RECONCILE_DURATION_SECONDS, 0.5,
+                         controller="c") == 0.0025
+    assert reg2.quantile(names.RECONCILE_DURATION_SECONDS, 0.99,
+                         controller="c") == top + 3.0
+
+
+def test_histogram_exemplars_keep_slowest_per_bucket(reg):
+    m = names.SERVING_REQUEST_DURATION_SECONDS
+    reg.observe(m, 0.002, exemplar="trace-fast")
+    reg.observe(m, 0.0021, exemplar="trace-faster")   # same bucket, slower
+    reg.observe(m, 0.0015, exemplar="trace-loser")    # same bucket, faster
+    reg.observe(m, 99.0, exemplar="trace-overflow")   # +Inf bucket
+    reg.observe(m, 0.3)                               # untraced: no exemplar
+    ex = reg.exemplars(m)
+    assert ex["0.0025"] == {"value": 0.0021, "trace_id": "trace-faster"}
+    assert ex["+Inf"] == {"value": 99.0, "trace_id": "trace-overflow"}
+    assert "0.5" not in ex
+    flat = reg.exemplars_snapshot()
+    assert {e["trace_id"] for e in flat} == {"trace-faster",
+                                            "trace-overflow"}
+    assert all(e["metric"] == m for e in flat)
+    # render(exemplars=True) appends OpenMetrics-style exemplar suffixes;
+    # the default render stays plain for strict text-format scrapers.
+    plain = reg.render()
+    assert "trace-faster" not in plain
+    rich = reg.render(exemplars=True)
+    assert '# {trace_id="trace-faster"} 0.0021' in rich
+
+
+def test_profiler_folded_stacks_full_depth():
+    from rbg_tpu.obs.profiler import sample_profile
+
+    stop = threading.Event()
+
+    def outer_frame_anchor():
+        def inner_frame_anchor():
+            stop.wait(5.0)
+        inner_frame_anchor()
+
+    t = threading.Thread(target=outer_frame_anchor, daemon=True)
+    t.start()
+    try:
+        prof = sample_profile(seconds=0.3, interval=0.01)
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    assert prof["samples"] > 0 and prof["folded"]
+    anchored = [f for f in prof["folded"] if "inner_frame_anchor" in f]
+    assert anchored, prof["folded"][:5]
+    stack, count = anchored[0].rsplit(" ", 1)
+    assert int(count) >= 1
+    frames = stack.split(";")
+    # FULL caller chain, oldest-first — the leaf-only top table can't
+    # show that outer_frame_anchor owns this leaf.
+    ii = next(i for i, fr in enumerate(frames)
+              if "inner_frame_anchor" in fr)
+    oi = next(i for i, fr in enumerate(frames)
+              if "outer_frame_anchor" in fr)
+    assert oi < ii
+    # Leaf table still present and leaf-only (no joined stacks).
+    assert prof["top"] and all(";" not in t["site"] for t in prof["top"])

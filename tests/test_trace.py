@@ -1,0 +1,592 @@
+"""End-to-end request tracing (obs/trace.py): span/sink unit contracts,
+ambient-context propagation through the service and PD layers, router
+retry/shed/deadline trace completeness, and the cross-process PD leg via
+the engine-server ``traces`` op."""
+
+import json
+import socket
+import socketserver
+import threading
+import time
+
+import pytest
+
+from rbg_tpu.obs import names, trace
+from rbg_tpu.obs.metrics import REGISTRY
+
+
+@pytest.fixture()
+def traced():
+    """Tracing armed at sample=1.0 with a clean sink; restores the prior
+    (off) configuration afterwards so unrelated tests stay zero-overhead."""
+    old = (trace._CFG.enabled, trace._CFG.sample, trace._CFG.strict)
+    trace.configure(enabled=True, sample=1.0, strict=False)
+    trace.SINK.reset()
+    yield trace
+    trace.configure(enabled=old[0], sample=old[1], strict=old[2])
+    trace.SINK.reset()
+
+
+def _wait_recs(n=1, timeout=10.0, complete=True):
+    """The root span ends on the SERVER thread after the response is sent,
+    so a client that just got its reply may observe the sink a moment
+    before finalization — poll instead of asserting instantly."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        recs = trace.SINK.recent(64)
+        if len(recs) >= n and (not complete
+                               or all(r["complete"] for r in recs)):
+            return recs
+        time.sleep(0.01)
+    return trace.SINK.recent(64)
+
+
+# ---- span / sink unit contracts ----
+
+
+def test_disabled_tracing_returns_null_span():
+    trace.configure(enabled=False)
+    try:
+        sp = trace.start_trace(names.SPAN_STRESS_REQUEST)
+        assert not sp
+        assert sp.child("anything") is sp
+        assert sp.wire() is None
+        sp.end()  # no-op, no error
+        assert trace.current() is trace.NULL_SPAN
+    finally:
+        trace.configure(enabled=False)
+
+
+def test_span_tree_records_complete_trace(traced):
+    root = trace.start_trace(names.SPAN_STRESS_REQUEST, client=0)
+    assert root and root.sampled
+    a = root.child(names.SPAN_SERVICE_QUEUE_WAIT)
+    a.end(outcome="admitted")
+    b = root.child(names.SPAN_SERVICE_SCAN)
+    b.end(outcome="ok", tokens=4)
+    root.end(outcome="ok")
+    recs = trace.SINK.recent(10)
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["complete"] and not rec["leaked"]
+    assert rec["root"] == names.SPAN_STRESS_REQUEST
+    assert rec["duration_ms"] is not None
+    by_name = {s["name"]: s for s in rec["spans"]}
+    assert set(by_name) == {names.SPAN_STRESS_REQUEST,
+                            names.SPAN_SERVICE_QUEUE_WAIT,
+                            names.SPAN_SERVICE_SCAN}
+    root_id = by_name[names.SPAN_STRESS_REQUEST]["span_id"]
+    assert by_name[names.SPAN_SERVICE_QUEUE_WAIT]["parent_id"] == root_id
+    assert by_name[names.SPAN_SERVICE_SCAN]["parent_id"] == root_id
+    assert by_name[names.SPAN_SERVICE_SCAN]["attrs"]["tokens"] == 4
+    # The same record sits in the slowest buffer (only trace so far).
+    assert trace.SINK.slowest(5)[0]["trace_id"] == rec["trace_id"]
+
+
+def test_unended_child_marks_trace_incomplete(traced):
+    root = trace.start_trace(names.SPAN_STRESS_REQUEST)
+    root.child(names.SPAN_SERVICE_SCAN)      # never ended
+    root.end()
+    rec = trace.SINK.recent(1)[0]
+    assert not rec["complete"]
+    assert "INCOMPLETE" in trace.waterfall(rec)[0]
+
+
+def test_sampling_rate_zero_suppresses(traced):
+    trace.configure(sample=0.0)
+    assert not trace.start_trace(names.SPAN_STRESS_REQUEST)
+    # Explicit force overrides the rate (the stress drills).
+    assert trace.start_trace(names.SPAN_STRESS_REQUEST, sample=True)
+
+
+def test_strict_mode_rejects_uncataloged_names(traced):
+    trace.configure(strict=True)
+    with pytest.raises(ValueError, match="not cataloged"):
+        trace.start_trace("router.reqest")  # lint: allow[span-name-registry] strict-mode negative test needs an uncataloged literal
+    # Cataloged names stay fine.
+    sp = trace.start_trace(names.SPAN_ROUTER_REQUEST)
+    assert sp
+    sp.end()
+
+
+def test_from_wire_joins_in_process_state(traced):
+    root = trace.start_trace(names.SPAN_ROUTER_REQUEST)
+    hop = trace.from_wire(root.wire(), names.SPAN_ENGINE_OP, op="generate")
+    assert hop.trace_id == root.trace_id
+    assert hop.parent_id == root.span_id
+    hop.end()
+    root.end()
+    recs = trace.SINK.recent(10)
+    assert len(recs) == 1                    # ONE rooted tree, not two
+    assert recs[0]["complete"]
+    assert len(recs[0]["spans"]) == 2
+
+
+def test_from_wire_without_context_is_ingress(traced):
+    sp = trace.from_wire(None, names.SPAN_ROUTER_REQUEST)
+    assert sp and sp.parent_id is None
+    sp.end()
+    assert trace.SINK.recent(1)[0]["complete"]
+
+
+def test_from_wire_foreign_trace_is_local_root(traced):
+    """A wire context from ANOTHER process: the local span becomes this
+    process's root (parent unresolvable locally) and the record is still
+    complete — the cross-process half of trace_complete."""
+    ctx = {"trace_id": "a" * 32, "parent_id": "b" * 16, "sampled": True}
+    sp = trace.from_wire(ctx, names.SPAN_ENGINE_OP, op="prefill")
+    assert sp.trace_id == "a" * 32 and sp.parent_id == "b" * 16
+    sp.end()
+    rec = trace.SINK.recent(1)[0]
+    assert rec["trace_id"] == "a" * 32
+    assert rec["complete"]
+
+
+def test_ingress_span_traceparent():
+    trace.configure(enabled=True, sample=0.0)  # local decision would drop
+    trace.SINK.reset()
+    try:
+        tid, parent = "c" * 32, "d" * 16
+        sp = trace.ingress_span(names.SPAN_HTTP_REQUEST,
+                                f"00-{tid}-{parent}-01")
+        assert sp and sp.trace_id == tid and sp.parent_id == parent
+        sp.end()
+        # Explicitly UNsampled header: the client made the head decision.
+        assert not trace.ingress_span(names.SPAN_HTTP_REQUEST,
+                                      f"00-{tid}-{parent}-00")
+        # Garbage falls back to the local decision (rate 0 ⇒ NULL).
+        assert not trace.ingress_span(names.SPAN_HTTP_REQUEST, "zz-bad")
+        trace.configure(sample=1.0)
+        assert trace.ingress_span(names.SPAN_HTTP_REQUEST, "zz-bad")
+    finally:
+        trace.configure(enabled=False)
+        trace.SINK.reset()
+
+
+def test_per_trace_span_bound_drops_and_counts(traced):
+    before = REGISTRY.counter(names.TRACE_SPANS_DROPPED_TOTAL)
+    root = trace.start_trace(names.SPAN_STRESS_REQUEST)
+    kept, dropped = 0, 0
+    for _ in range(trace.MAX_SPANS_PER_TRACE + 10):
+        sp = root.child(names.SPAN_SERVICE_SCAN)
+        if sp:
+            kept += 1
+            sp.end()
+        else:
+            dropped += 1
+    root.end()
+    assert kept == trace.MAX_SPANS_PER_TRACE - 1  # root takes one slot
+    assert dropped == 11
+    rec = trace.SINK.recent(1)[0]
+    assert rec["dropped_spans"] == 11
+    assert rec["complete"]  # a bounding choice, not an orphan
+    assert REGISTRY.counter(names.TRACE_SPANS_DROPPED_TOTAL) - before == 11
+
+
+def test_active_trace_bound_finalizes_oldest_as_leaked(traced):
+    spans = [trace.start_trace(names.SPAN_STRESS_REQUEST, i=i)
+             for i in range(trace.MAX_ACTIVE_TRACES + 1)]
+    leaked = [r for r in trace.SINK.recent(trace.MAX_ACTIVE_TRACES)
+              if r["leaked"]]
+    assert len(leaked) == 1
+    assert leaked[0]["trace_id"] == spans[0].trace_id
+    assert trace.SINK.active_count() == trace.MAX_ACTIVE_TRACES
+    for sp in spans[1:]:
+        sp.end()
+
+
+def test_ambient_use_span_and_inject(traced):
+    root = trace.start_trace(names.SPAN_ROUTER_REQUEST)
+    obj = {}
+    with trace.use_span(root):
+        assert trace.current() is root
+        child = trace.child(names.SPAN_ROUTER_ATTEMPT, attempt=0)
+        assert child.parent_id == root.span_id
+        trace.inject(obj)
+        child.end()
+    assert trace.current() is trace.NULL_SPAN
+    assert obj["trace"] == {"trace_id": root.trace_id,
+                            "parent_id": root.span_id, "sampled": True}
+    # Unsampled ambient: inject is a no-op.
+    clean = {}
+    with trace.use_span(trace.NULL_SPAN):
+        trace.inject(clean)
+    assert "trace" not in clean
+    root.end()
+
+
+def test_two_local_roots_is_incomplete(traced):
+    root = trace.start_trace(names.SPAN_STRESS_REQUEST)
+    orphan = trace.Span(names.SPAN_SERVICE_SCAN, root.trace_id,
+                        "f" * 16, root._state)  # parent id resolves nowhere
+    assert root._state.add(orphan)
+    orphan.end()
+    root.end()
+    assert not trace.SINK.recent(1)[0]["complete"]
+
+
+def test_slowest_buffer_orders_by_root_duration(traced):
+    for ms in (0.0, 0.02, 0.01):
+        sp = trace.start_trace(names.SPAN_STRESS_REQUEST, pause=ms)
+        time.sleep(ms)
+        sp.end()
+    slowest = trace.SINK.slowest(2)
+    assert len(slowest) == 2
+    assert slowest[0]["duration_ms"] >= slowest[1]["duration_ms"]
+    assert slowest[0]["spans"][0]["attrs"]["pause"] == 0.02
+
+
+def test_hop_coverage_union_of_overlapping_children(traced):
+    root = trace.start_trace(names.SPAN_STRESS_REQUEST)
+    a = root.child(names.SPAN_SERVICE_QUEUE_WAIT)
+    b = root.child(names.SPAN_SERVICE_SCAN)
+    time.sleep(0.03)
+    a.end()
+    b.end()
+    root.end()
+    rec = trace.SINK.recent(1)[0]
+    cov = trace.hop_coverage(rec)
+    # a and b overlap almost entirely: union ≈ root, never ≈ 2× root.
+    assert cov is not None and 0.8 <= cov <= 1.05
+
+
+def test_waterfall_renders_tree_with_attrs(traced):
+    root = trace.start_trace(names.SPAN_ROUTER_REQUEST, op="generate")
+    att = root.child(names.SPAN_ROUTER_ATTEMPT, backend="b:1", attempt=0)
+    att.end(outcome="ok")
+    root.end()
+    lines = trace.waterfall(trace.SINK.recent(1)[0])
+    assert root.trace_id in lines[0]
+    assert any(names.SPAN_ROUTER_ATTEMPT in ln and "backend=b:1" in ln
+               for ln in lines)
+    # Child is indented deeper than the root span line.
+    root_ln = next(ln for ln in lines if names.SPAN_ROUTER_REQUEST in ln)
+    att_ln = next(ln for ln in lines if names.SPAN_ROUTER_ATTEMPT in ln)
+    indent = lambda s: len(s) - len(s.lstrip())  # noqa: E731
+    assert indent(att_ln) > indent(root_ln)
+
+
+# ---- service-layer propagation (real tiny engine) ----
+
+
+def test_service_queue_scan_spans_and_rejections_complete(traced):
+    """One EngineService: an OK request yields root→queue_wait→scan; a
+    queue-full shed and an expired-deadline submit still leave COMPLETE
+    traces (the rejection closes its span — no orphans)."""
+    from rbg_tpu.engine.config import EngineConfig, SamplingParams
+    from rbg_tpu.engine.service import (DeadlineExceeded, EngineService,
+                                        Overloaded)
+
+    svc = EngineService(EngineConfig(
+        model="tiny", page_size=8, num_pages=64, max_batch=2,
+        max_seq_len=128, prefill_chunk=16, use_pallas="never",
+        decode_buckets=(2,)), max_queue=4)
+    try:
+        sp = SamplingParams(max_new_tokens=4)
+        ok_root = trace.start_trace(names.SPAN_STRESS_REQUEST)
+        svc.submit_wait([1, 2, 3], sp, span=ok_root)
+        ok_root.end(outcome="ok")
+
+        dl_root = trace.start_trace(names.SPAN_STRESS_REQUEST)
+        with pytest.raises(DeadlineExceeded):
+            svc.submit_wait([1, 2, 3], sp, deadline=time.monotonic() - 1.0,
+                            span=dl_root)
+        dl_root.end(outcome="deadline_exceeded")
+
+        svc.max_queue = 0  # every submission is now over the bound
+        shed_root = trace.start_trace(names.SPAN_STRESS_REQUEST)
+        with pytest.raises(Overloaded):
+            svc.submit_wait([1, 2, 3], sp, span=shed_root)
+        shed_root.end(outcome="overloaded")
+    finally:
+        svc.stop()
+
+    recs = {r["trace_id"]: r for r in trace.SINK.recent(10)}
+    assert len(recs) == 3
+    assert all(r["complete"] for r in recs.values())
+    ok = recs[ok_root.trace_id]
+    ok_names = {s["name"] for s in ok["spans"]}
+    assert {names.SPAN_SERVICE_QUEUE_WAIT,
+            names.SPAN_SERVICE_SCAN} <= ok_names
+    qspan = next(s for s in ok["spans"]
+                 if s["name"] == names.SPAN_SERVICE_QUEUE_WAIT)
+    assert qspan["attrs"]["outcome"] == "admitted"
+    scan = next(s for s in ok["spans"]
+                if s["name"] == names.SPAN_SERVICE_SCAN)
+    assert scan["attrs"]["outcome"] == "ok"
+    # Hop durations explain the root (the acceptance-criteria check).
+    assert trace.hop_coverage(ok) >= 0.9
+    # Rejections: queue_wait span carries the rejection outcome.
+    dl = recs[dl_root.trace_id]
+    dl_q = next(s for s in dl["spans"]
+                if s["name"] == names.SPAN_SERVICE_QUEUE_WAIT)
+    assert dl_q["attrs"]["outcome"] == "deadline"
+    shed = recs[shed_root.trace_id]
+    shed_q = next(s for s in shed["spans"]
+                  if s["name"] == names.SPAN_SERVICE_QUEUE_WAIT)
+    assert shed_q["attrs"]["outcome"] == "overloaded"
+    # The request-duration histogram carries the OK request's exemplar.
+    ex = REGISTRY.exemplars(names.SERVING_REQUEST_DURATION_SECONDS,
+                            service="engineservice")
+    assert any(v["trace_id"] == ok_root.trace_id for v in ex.values())
+
+
+def test_pd_pair_kv_handoff_span_parents_under_ambient(traced):
+    """In-process prefill→decode handoff: DecodeWorker.inject's
+    pd.kv_handoff span attaches under the ambient request span."""
+    from rbg_tpu.engine.config import EngineConfig, SamplingParams
+    from rbg_tpu.engine.pd import PDPair
+
+    pair = PDPair(EngineConfig(
+        model="tiny", page_size=8, num_pages=64, max_batch=4,
+        max_seq_len=128, prefill_chunk=16, use_pallas="never"))
+    root = trace.start_trace(names.SPAN_STRESS_REQUEST)
+    with trace.use_span(root):
+        out = pair.generate([[1, 2, 3, 4]],
+                            SamplingParams(max_new_tokens=4))
+    root.end()
+    assert len(out[0]) >= 1
+    rec = trace.SINK.recent(1)[0]
+    assert rec["complete"]
+    handoff = [s for s in rec["spans"]
+               if s["name"] == names.SPAN_PD_KV_HANDOFF]
+    assert len(handoff) == 1
+    assert handoff[0]["parent_id"] == rec["spans"][0]["span_id"]
+    assert handoff[0]["attrs"]["bytes"] > 0
+    assert handoff[0]["attrs"]["pages"] >= 1
+
+
+# ---- router propagation (scripted backends, no JAX) ----
+
+
+class _ScriptedBackend(socketserver.ThreadingTCPServer):
+    """Protocol-speaking backend: fails the first generate (closes the
+    socket) when ``fail_first``, sheds as draining when ``draining``,
+    otherwise returns a canned token frame."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, fail_first=False, tokens=(5, 6, 7)):
+        from rbg_tpu.engine.protocol import (CODE_DRAINING, recv_msg,
+                                             send_msg)
+        backend = self
+        backend.fail_first = fail_first
+        backend.draining = False
+        backend.requests = 0
+
+        class H(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        obj, _, _ = recv_msg(self.request)
+                    except (ConnectionError, json.JSONDecodeError):
+                        return
+                    if obj is None:
+                        return
+                    if obj.get("op") == "health":
+                        send_msg(self.request,
+                                 {"ok": True, "draining": backend.draining})
+                        continue
+                    if backend.draining:
+                        send_msg(self.request, {
+                            "error": "draining", "code": CODE_DRAINING,
+                            "done": True, "retry_after_s": 2.0})
+                        continue
+                    backend.requests += 1
+                    if backend.fail_first:
+                        backend.fail_first = False
+                        return  # cut the socket: transport error upstream
+                    send_msg(self.request, {"tokens": list(tokens)})
+
+        super().__init__(("127.0.0.1", 0), H)
+        self.addr = f"127.0.0.1:{self.server_address[1]}"
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+
+
+@pytest.fixture()
+def scripted_router():
+    from rbg_tpu.engine.router import (Handler, Registry, RouterServer,
+                                       RouterState)
+
+    flaky = _ScriptedBackend(fail_first=True)
+    steady = _ScriptedBackend()
+    router = RouterServer(("127.0.0.1", 0), Handler)
+    router.state = RouterState(Registry(None), None,
+                               {"worker": [flaky.addr, steady.addr]})
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    addr = f"127.0.0.1:{router.server_address[1]}"
+    yield addr, router, flaky, steady
+    router.shutdown()
+    router.server_close()
+    flaky.shutdown()
+    steady.shutdown()
+
+
+def test_router_retry_makes_sibling_attempt_spans(traced, scripted_router):
+    from rbg_tpu.engine.protocol import request_once
+
+    addr, router, flaky, steady = scripted_router
+    # Load the steady backend so the flaky one is picked first, fails at
+    # the transport, and the SAME request fails over.
+    router.state.pool.acquire(steady.addr)
+    try:
+        resp, _, _ = request_once(addr, {"op": "generate", "prompt": [1],
+                                         "timeout_s": 20}, timeout=30)
+    finally:
+        router.state.pool.release(steady.addr)
+    assert resp == {"tokens": [5, 6, 7]}
+    rec = _wait_recs()[0]
+    assert rec["complete"], rec
+    root = rec["spans"][0]
+    assert root["name"] == names.SPAN_ROUTER_REQUEST
+    attempts = [s for s in rec["spans"]
+                if s["name"] == names.SPAN_ROUTER_ATTEMPT]
+    assert len(attempts) == 2
+    # SIBLINGS under the one request span, distinguishable by attempt #.
+    assert all(a["parent_id"] == root["span_id"] for a in attempts)
+    by_attempt = {a["attrs"]["attempt"]: a for a in attempts}
+    assert by_attempt[0]["attrs"]["outcome"] == "transport_error"
+    assert by_attempt[0]["attrs"]["backend"] == flaky.addr
+    assert by_attempt[1]["attrs"]["outcome"] == "ok"
+    assert by_attempt[1]["attrs"]["backend"] == steady.addr
+
+
+def test_router_shed_and_deadline_traces_complete(traced, scripted_router):
+    from rbg_tpu.engine.protocol import CODE_DRAINING, request_once
+
+    addr, router, flaky, steady = scripted_router
+    flaky.draining = True
+    steady.draining = True
+    resp, _, _ = request_once(addr, {"op": "generate", "prompt": [1],
+                                     "timeout_s": 5}, timeout=30)
+    assert resp.get("code") == CODE_DRAINING
+    rec = _wait_recs()[0]
+    assert rec["complete"], rec           # shed request is NOT an orphan
+    assert rec["spans"][0]["attrs"]["outcome"] == CODE_DRAINING
+    attempts = [s for s in rec["spans"]
+                if s["name"] == names.SPAN_ROUTER_ATTEMPT]
+    assert attempts and all(a["attrs"]["outcome"] == CODE_DRAINING
+                            for a in attempts)
+
+    # Deadline spent before dispatch: structured reply, complete trace.
+    flaky.draining = steady.draining = False
+    trace.SINK.reset()
+    resp, _, _ = request_once(addr, {"op": "generate", "prompt": [1],
+                                     "timeout_s": 0.000001}, timeout=30)
+    assert resp.get("code")               # deadline_exceeded frame
+    rec = _wait_recs()[0]
+    assert rec["complete"], rec
+
+
+def test_router_wire_context_continues_upstream_trace(traced,
+                                                      scripted_router):
+    """A client-supplied wire context (the http_frontend leg): the
+    router's request span parents under it and joins the SAME trace."""
+    from rbg_tpu.engine.protocol import request_once
+
+    addr = scripted_router[0]
+    edge = trace.start_trace(names.SPAN_HTTP_REQUEST, path="/v1/completions")
+    resp, _, _ = request_once(addr, {"op": "generate", "prompt": [1],
+                                     "timeout_s": 20,
+                                     "trace": edge.wire()}, timeout=30)
+    assert resp == {"tokens": [5, 6, 7]}
+    # The router's spans end on ITS thread after the reply: wait for them
+    # before finalizing, or the record would snapshot an unfinished hop.
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        with edge._state.lock:
+            spans = list(edge._state.spans)
+        if len(spans) >= 3 and all(s.duration_s is not None
+                                   for s in spans if s is not edge):
+            break
+        time.sleep(0.01)
+    edge.end(status=200)
+    rec = trace.SINK.recent(1)[0]
+    assert rec["complete"]
+    by_name = {s["name"]: s for s in rec["spans"]}
+    assert by_name[names.SPAN_ROUTER_REQUEST]["parent_id"] == \
+        by_name[names.SPAN_HTTP_REQUEST]["span_id"]
+
+
+# ---- cross-process PD e2e: spans pulled via the engine `traces` op ----
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+def test_pd_trace_propagation_across_processes(traced):
+    """Full PD path over real prefill+decode subprocesses with RBG_TRACE
+    armed: the router's per-attempt wire context reaches each server,
+    whose engine.op span parents under the attempt that dispatched it —
+    queue-wait/prefill spans on the prefill pod, scan/kv-handoff spans on
+    the decode pod — all sharing ONE trace id, every local tree complete."""
+    from conftest import SpawnedEngineServer
+    from rbg_tpu.engine.protocol import request_once
+    from rbg_tpu.engine.router import (Handler, Registry, RouterServer,
+                                       RouterState)
+
+    args = ["--model", "tiny", "--page-size", "8", "--num-pages", "128",
+            "--max-seq-len", "256", "--prefill-chunk", "16",
+            "--use-pallas", "never"]
+    tr_env = {"RBG_TRACE": "1", "RBG_TRACE_SAMPLE": "1"}
+    with SpawnedEngineServer("--mode", "prefill", *args,
+                             env_extra=tr_env) as pf, \
+            SpawnedEngineServer("--mode", "decode", *args,
+                                env_extra=tr_env) as dc:
+        router = RouterServer(("127.0.0.1", 0), Handler)
+        router.state = RouterState(Registry(None), None,
+                                   {"prefill": [pf.addr],
+                                    "decode": [dc.addr]})
+        threading.Thread(target=router.serve_forever, daemon=True).start()
+        addr = f"127.0.0.1:{router.server_address[1]}"
+        try:
+            resp, _, _ = request_once(
+                addr, {"op": "generate", "prompt": [1, 2, 3, 4],
+                       "max_new_tokens": 6, "timeout_s": 120}, timeout=300)
+            assert "error" not in resp, resp
+            assert resp["tokens"]
+
+            # Local (router-process) trace: root + one attempt per leg.
+            rec = _wait_recs()[0]
+            assert rec["complete"], rec
+            tid = rec["trace_id"]
+            attempts = {s["attrs"]["role"]: s for s in rec["spans"]
+                        if s["name"] == names.SPAN_ROUTER_ATTEMPT}
+            assert set(attempts) == {"prefill", "decode"}
+            assert attempts["decode"]["attrs"]["kv_bytes"] > 0
+
+            def pull(addr):
+                deadline = time.monotonic() + 15.0
+                while True:
+                    t, _, _ = request_once(addr, {"op": "traces"},
+                                           timeout=30)
+                    recs = [r for r in t["recent"]
+                            if r["trace_id"] == tid and r["complete"]]
+                    if recs or time.monotonic() > deadline:
+                        return t, recs
+                    time.sleep(0.05)
+
+            # Prefill pod: engine.op rooted at the prefill ATTEMPT span.
+            pt, precs = pull(pf.addr)
+            assert len(precs) == 1 and precs[0]["complete"], pt
+            pnames = {s["name"] for s in precs[0]["spans"]}
+            assert {names.SPAN_ENGINE_OP, names.SPAN_SERVICE_QUEUE_WAIT,
+                    names.SPAN_PD_PREFILL} <= pnames
+            proot = precs[0]["spans"][0]
+            assert proot["name"] == names.SPAN_ENGINE_OP
+            assert proot["parent_id"] == \
+                attempts["prefill"]["span_id"]
+
+            # Decode pod: engine.op rooted at the decode ATTEMPT span,
+            # with the KV-handoff and scan spans under it.
+            dt, drecs = pull(dc.addr)
+            assert len(drecs) == 1 and drecs[0]["complete"], dt
+            dnames = {s["name"] for s in drecs[0]["spans"]}
+            assert {names.SPAN_ENGINE_OP, names.SPAN_PD_KV_HANDOFF,
+                    names.SPAN_SERVICE_SCAN} <= dnames
+            droot = drecs[0]["spans"][0]
+            assert droot["parent_id"] == attempts["decode"]["span_id"]
+            assert dt["waterfall"], "engine traces op waterfall empty"
+        finally:
+            router.shutdown()
+            router.server_close()

@@ -51,6 +51,15 @@ class EngineConfig:
     # your vocab and HBM, worst case 64 × budget × V × 5).
     grammar_state_budget: int = 512
     use_pallas: str = "auto"                # auto | always | never
+    # Ragged unified prefill/decode dispatch (continuous batching): while
+    # any row is mid-prefill, the WHOLE batch — prefill chunks and decode
+    # steps together — rides one ragged forward (ops/ragged_paged_attention)
+    # instead of phase-split prefill-then-decode programs, and the fused
+    # decode scan shortens its window to absorb waiting joins. "off" keeps
+    # the split paths (the bit-identity baseline). Pure-decode batches use
+    # the fused multi-step scan either way; MLA models, speculative mode,
+    # and LoRA-mixed batches fall back to the split paths automatically.
+    ragged: str = "auto"                    # auto | off
     mode: str = "unified"                   # unified | prefill | decode
     mesh_spec: Optional[dict] = None        # {"dp": 1, "tp": 4} — from discovery
     checkpoint_path: str = ""               # orbax dir or local HF dir
@@ -85,6 +94,8 @@ class EngineConfig:
                                  "dispatch)")
             if self.spec_k < 1 or self.spec_ngram < 1:
                 raise ValueError("spec_k and spec_ngram must be >= 1")
+        if self.ragged not in ("auto", "off"):
+            raise ValueError(f"ragged {self.ragged!r} not in (auto, off)")
         if self.grammar_table not in ("auto", "off"):
             raise ValueError(f"grammar_table {self.grammar_table!r} not in "
                              "(auto, off)")

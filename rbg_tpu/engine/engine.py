@@ -38,7 +38,7 @@ from rbg_tpu.engine.config import EngineConfig, SamplingParams
 from rbg_tpu.engine.kvcache import PageAllocator, PagedKVCache, pages_for_tokens
 from rbg_tpu.engine.radix_cache import RadixCache
 from rbg_tpu.engine.sampler import NEG_INF, row_keys, sample, step_keys
-from rbg_tpu.models.llama import forward_paged, init_params
+from rbg_tpu.models.llama import forward_paged, forward_ragged, init_params
 
 
 @dataclasses.dataclass
@@ -74,6 +74,19 @@ class Request:
         self.lora_idx = 0                   # adapter slot (0 = base model)
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
+        # Continuous-admission accounting: the engine step at which the
+        # request entered `waiting`, and how many admission attempts it sat
+        # out because capacity (a batch slot or KV pages) was unavailable.
+        # The difference (wait − blocked) is the request's EXCESS wait —
+        # steps it queued beyond what resource availability forced — and
+        # the continuous-batching invariant bounds it at one step.
+        self.enqueue_step = 0
+        self.blocked_steps = 0
+        # Wall-clock twin of enqueue_step: when the request last entered
+        # `waiting` (submit or preemption) — the join-latency metric
+        # measures from here, not t_submit, so a preempted-then-readmitted
+        # request's running time never reads as queue wait.
+        self.t_enqueue = self.t_submit
 
     @property
     def total_len(self) -> int:
@@ -122,6 +135,19 @@ class Engine:
         self._dec: Optional[dict] = None
         self._dec_fn_cache: Dict[Tuple[int, bool, bool], object] = {}
         self._spec_fn_cache: Dict[Tuple[int, bool, bool, bool, bool], object] = {}
+        # Ragged unified prefill/decode dispatch: one compiled program per
+        # (row bucket, packed-token bucket).
+        self._ragged_fn_cache: Dict[Tuple[int, int], object] = {}
+        # Set by the serving loop when submissions are waiting beyond this
+        # step's admissions — the fused decode scan shortens its window so
+        # the join is absorbed next step instead of a full multi_step
+        # window later. Loop-thread-confined (single-writer, like all
+        # engine state); cleared at the end of every step.
+        self.join_hint = False
+        # Seconds each admitted request waited between entering the engine
+        # queue and joining the running batch — drained by the service
+        # loop into rbg_serving_join_latency_seconds.
+        self.last_join_waits: List[float] = []
         self.grammar = None     # TokenGrammar — enable_json_grammar()
         self._token_bytes = None
         self._grammar_eos = None
@@ -142,7 +168,8 @@ class Engine:
         self.metrics = {"steps": 0, "decode_tokens": 0, "prefill_tokens": 0,
                         "radix_hit_tokens": 0, "preemptions": 0,
                         "spec_drafted": 0, "spec_accepted": 0,
-                        "spec_steps": 0}
+                        "spec_steps": 0, "unified_steps": 0, "joins": 0,
+                        "join_wait_steps_max": 0, "join_excess_steps_max": 0}
 
     def _shard_state(self, mesh):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -472,6 +499,7 @@ class Engine:
                 f"exceeds max_seq_len {self.cfg.max_seq_len}")
         req = Request(prompt, sampling)
         req.lora_idx = self._resolve_lora(sampling)
+        req.enqueue_step = self.metrics["steps"]
         g = self._grammar_for(sampling)
         if g is not None:
             req.grammar = g
@@ -541,15 +569,25 @@ class Engine:
         return bool(self.waiting or self.running)
 
     def step(self) -> List[StepEvent]:
-        """One scheduler iteration: admit → prefill (chunk each) → decode."""
+        """One scheduler iteration: admit, then either the ragged UNIFIED
+        dispatch (prefill chunks + decode steps of the whole batch in one
+        program — continuous batching, no phase split) or the legacy
+        split prefill→decode paths (pure-decode batches always take the
+        fused multi-step scan; cfg.ragged='off', MLA, speculative, and
+        LoRA-mixed batches keep the split paths throughout)."""
         events: List[StepEvent] = []
         if self._deferred_events:
             events.extend(self._deferred_events)
             self._deferred_events = []
         self.metrics["steps"] += 1
         self._admit()
-        events.extend(self._prefill_step())
-        events.extend(self._decode_step())
+        if self._unified_eligible():
+            self.metrics["unified_steps"] += 1
+            events.extend(self._unified_step())
+        else:
+            events.extend(self._prefill_step())
+            events.extend(self._decode_step())
+        self.join_hint = False
         return events
 
     def generate(self, prompts: List[List[int]],
@@ -565,7 +603,11 @@ class Engine:
     # ---- admission ----
 
     def _admit(self):
-        while self.waiting and len(self.running) < self.cfg.max_batch:
+        blocked = False
+        while self.waiting:
+            if len(self.running) >= self.cfg.max_batch:
+                blocked = True   # a batch slot is the unavailable resource
+                break
             req = self.waiting[0]
             matched, shared_pages = 0, []
             if (self.radix is not None and req.state == "waiting"
@@ -584,8 +626,25 @@ class Engine:
             if pages is None:
                 if shared_pages:
                     self.allocator.release(shared_pages)
+                blocked = True
                 break  # no capacity — stay queued
             self.waiting.pop(0)
+            # Join accounting for the continuous-admission invariant: a
+            # request admitted at the first step after enqueue waited 0.
+            wait = max(0, self.metrics["steps"] - req.enqueue_step - 1)
+            excess = max(0, wait - req.blocked_steps)
+            self.metrics["joins"] += 1
+            self.metrics["join_wait_steps_max"] = max(
+                self.metrics["join_wait_steps_max"], wait)
+            self.metrics["join_excess_steps_max"] = max(
+                self.metrics["join_excess_steps_max"], excess)
+            self.last_join_waits.append(time.perf_counter() - req.t_enqueue)
+            # Bounded: only the service loop drains this (PD workers and
+            # generate() step the engine directly) — cap so an undrained
+            # engine never leaks; the loop drains every step, so real
+            # serving never comes near the cap.
+            del self.last_join_waits[:-1024]
+            req.blocked_steps = 0
             req.pages = shared_pages + pages
             req.shared_tokens = matched
             req.prefill_pos = matched
@@ -593,6 +652,11 @@ class Engine:
             req.state = "prefill"
             self.running.append(req)
             self.metrics["radix_hit_tokens"] += matched
+        if blocked:
+            # Every still-queued request sat this step out for a capacity
+            # reason — the excess-wait metric must not count it.
+            for r in self.waiting:
+                r.blocked_steps += 1
 
     def _alloc(self, n: int) -> Optional[List[int]]:
         if n <= 0:
@@ -602,6 +666,294 @@ class Engine:
             self.radix.evict(n - self.allocator.free_pages)
             pages = self.allocator.alloc(n)
         return pages
+
+    # ---- ragged unified prefill/decode step ----
+
+    def _unified_eligible(self) -> bool:
+        """True when this step should run ONE ragged dispatch serving the
+        whole batch (prefill chunks + decode steps together). Pure-decode
+        batches return False — the fused multi-step scan (zero host syncs
+        per window) beats a host-synced ragged step there."""
+        if (self.cfg.ragged == "off" or self.cfg.speculative != "off"
+                or self.mcfg.mla):
+            return False
+        if not any(r.state == "prefill" for r in self.running):
+            return False
+        if any(r.lora_idx for r in self.running):
+            # lora_delta gathers adapters per batch ROW; the packed batch
+            # axis is 1, so adapter-mixed batches keep the split paths.
+            return False
+        return True
+
+    def _token_bucket(self, n: int) -> int:
+        """Packed-token bucket: next power of two (≥ 8), so compile
+        variety stays at log2(max_batch × prefill_chunk) programs."""
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def _get_ragged_fn(self, R: int, T: int):
+        """One jitted ragged forward per (row bucket, packed-token
+        bucket)."""
+        fn = self._ragged_fn_cache.get((R, T))
+        if fn is None:
+            import functools
+            base = functools.partial(forward_ragged, cfg=self.mcfg,
+                                     use_pallas=self.cfg.use_pallas,
+                                     max_q_len=self.cfg.prefill_chunk)
+
+            def wrapped(params, tokens, positions, token_mask, row_ids,
+                        kv_lens, page_table, k_pages, v_pages, k_scales,
+                        v_scales):
+                return base(params, tokens=tokens, positions=positions,
+                            token_mask=token_mask, row_ids=row_ids,
+                            kv_lens=kv_lens, page_table=page_table,
+                            k_pages=k_pages, v_pages=v_pages,
+                            k_scales=k_scales, v_scales=v_scales)
+
+            donate = (7, 8, 9, 10) if self.cache.quantized else (7, 8)
+            fn = jax.jit(wrapped, donate_argnums=donate)
+            self._ragged_fn_cache[(R, T)] = fn
+        return fn
+
+    def warm_ragged(self) -> int:
+        """Pre-compile every ragged unified program shape (row bucket ×
+        packed-token bucket) with an all-pad dispatch: token_mask is all
+        False so every KV write drops and the pool round-trips through
+        the donated buffers unchanged. A shape first hit mid-serving
+        stalls every in-flight request for the compile — same rationale
+        as _BatchService.warmup, which calls this. Must run while the
+        engine is IDLE (no in-flight requests): the warm dispatches
+        mutate the cache from the calling thread, outside the loop
+        thread's single-writer discipline. Returns the number of
+        programs compiled."""
+        if (self.cfg.ragged == "off" or self.cfg.speculative != "off"
+                or self.mcfg.mla or self.cfg.mode == "decode"):
+            return 0
+        P = self.cfg.max_pages_per_seq
+        n = 0
+        buckets = sorted({self._bucket(b)
+                          for b in range(1, self.cfg.max_batch + 1)})
+        for R in buckets:
+            t = 8
+            t_max = self._token_bucket(R * self.cfg.prefill_chunk)
+            while True:
+                fn = self._get_ragged_fn(R, t)
+                _, kp, vp, ksc, vsc = fn(
+                    self.params,
+                    jnp.zeros((1, t), jnp.int32),
+                    jnp.full((1, t), -1, jnp.int32),       # all pad
+                    jnp.zeros((1, t), bool),
+                    jnp.zeros((t,), jnp.int32),
+                    jnp.zeros((R,), jnp.int32),
+                    jnp.zeros((R, P), jnp.int32),
+                    self.cache.k_pages, self.cache.v_pages,
+                    self.cache.k_scales, self.cache.v_scales)
+                self.cache = PagedKVCache(k_pages=kp, v_pages=vp,
+                                          k_scales=ksc, v_scales=vsc)
+                n += 1
+                if t >= t_max:
+                    break
+                t *= 2
+        return n
+
+    def warm_join_windows(self) -> int:
+        """Pre-compile the K=1 'early-exit' variant of every PLAIN fused
+        decode program compiled so far (same bucket and sampling flags,
+        window length 1). _decode_window shortens to 1 exactly on the
+        join-latency path, so a mid-serving compile there would stall
+        every in-flight request — the hazard warm_ragged documents —
+        right when this feature is trying to cut latency. Exotic
+        variants (penalties/logprobs/LoRA/grammar) stay lazy, as they do
+        for every other program. Same idle-engine requirement as
+        warm_ragged (the dispatches mutate the cache from the calling
+        thread). Returns the number of programs compiled."""
+        if self.cfg.multi_step == 1 or self.cfg.ragged == "off":
+            return 0   # the window never shortens (see _decode_window)
+        P = self.cfg.max_pages_per_seq
+        n = 0
+        for (B, pen, lp, tpmp, la, gr, K) in list(self._dec_fn_cache):
+            if K == 1 or pen or lp or la or gr:
+                continue
+            if (B, pen, lp, tpmp, la, gr, 1) in self._dec_fn_cache:
+                continue
+            temps, ks, tps, mps, seeds, rids, _, _, _ = \
+                self._sampling_rows([], B)
+            fn = self._get_decode_fn(B, pen, lp, tpmp, la, gr, K=1)
+            # mask all-False: write_ok is False everywhere, so no KV slot
+            # is written and pos/kvl never advance — the donated pool
+            # buffers round-trip unchanged (tok/pos/kvl/limit are
+            # separate arrays: pos and kvl are donated, tok is not).
+            _, _, _, _, _, kp, vp, ksc, vsc, _, _ = fn(
+                self.params, jnp.zeros(B, jnp.int32),
+                jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+                jnp.zeros((B, P), jnp.int32), jnp.zeros((B, 1), bool),
+                jnp.zeros(B, jnp.int32),
+                self.cache.k_pages, self.cache.v_pages,
+                self.cache.k_scales, self.cache.v_scales,
+                row_keys(seeds, self._sample_base, rids),
+                jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(tps),
+                jnp.asarray(mps))
+            self.cache = PagedKVCache(k_pages=kp, v_pages=vp,
+                                      k_scales=ksc, v_scales=vsc)
+            n += 1
+        return n
+
+    def _grow_decode_pages(self, rows: List[Request]) -> None:
+        """Ensure every decode row has a page for its next token (the
+        unified step advances decode rows by exactly one). Preempts the
+        youngest on exhaustion, mirroring the fused path — but with no
+        pending device window to drain (the caller already drained)."""
+        for req in sorted(rows, key=lambda r: r.t_submit):
+            if req.state != "running":
+                continue  # preempted earlier in this very loop
+            need = (pages_for_tokens(req.seq_len + 1, self.cfg.page_size)
+                    - len(req.pages))
+            if need <= 0:
+                continue
+            extra = self._alloc(need)
+            while extra is None:
+                if self._preempt_youngest(exclude=req) is None:
+                    break
+                extra = self._alloc(need)
+            if extra is None:
+                self._preempt(req)
+                continue
+            req.pages.extend(extra)
+
+    def _unified_step(self) -> List[StepEvent]:
+        """ONE ragged device dispatch for the whole batch: every
+        mid-prefill row contributes its next chunk, every decoding row
+        contributes one step, packed on a flat token axis with per-token
+        row ids (ops/ragged_paged_attention). Sampling mirrors the legacy
+        paths exactly — per-row keys are fold_in(row_key, token position),
+        grammar masks apply before penalties — so outputs are
+        bit-identical to the split prefill/decode programs.
+
+        The pending fused-decode window is drained FIRST: its tokens are
+        already counted in seq_len (the same invariant the runtime-LoRA
+        drain protects — see _rebuild_lora_stack), so dispatching decode
+        rows on top of an undrained window would double-write KV slots
+        and corrupt the stream."""
+        events: List[StepEvent] = list(self._drain_decode())
+        decode = [r for r in self.running if r.state == "running"]
+        self._grow_decode_pages(decode)
+
+        entries = []                 # (req, start, end) — end==start: decode
+        for r in self.running:
+            if r.state == "prefill":
+                start = r.prefill_pos
+                end = min(start + self.cfg.prefill_chunk, len(r.prompt))
+                entries.append((r, start, end))
+            elif r.state == "running":
+                entries.append((r, r.seq_len, r.seq_len))
+        if not entries:
+            return events
+
+        P = self.cfg.max_pages_per_seq
+        Rb = self._bucket(len(entries))
+        Ttot = sum((e - s) if e > s else 1 for _, s, e in entries)
+        Tb = self._token_bucket(Ttot)
+        tok = np.zeros((1, Tb), np.int32)
+        # Pad tokens carry position -1 — the ragged-pack pad contract
+        # (ops/ragged_paged_attention): the XLA fallback's unpack routes
+        # them out of its scatter and the kernel skips them outright.
+        pos = np.full((1, Tb), -1, np.int32)
+        tmask = np.zeros((1, Tb), bool)
+        row_ids = np.zeros(Tb, np.int32)
+        kvl = np.zeros(Rb, np.int32)
+        table = np.zeros((Rb, P), np.int32)
+        off = 0
+        sample_rows = []             # (req, packed_idx, key_pos, is_decode)
+        for i, (req, start, end) in enumerate(entries):
+            if end > start:          # prefill chunk
+                n = end - start
+                tok[0, off:off + n] = req.prompt[start:end]
+                pos[0, off:off + n] = np.arange(start, end, dtype=np.int32)
+                kvl[i] = end
+                if end == len(req.prompt):
+                    # Finishing row: its first output token samples at the
+                    # position right after the prompt (key rule: a token at
+                    # absolute position p is keyed by p).
+                    sample_rows.append((req, off + n - 1, end, False))
+            else:                    # decode step: write last_token, sample
+                n = 1
+                tok[0, off] = req.last_token
+                pos[0, off] = req.seq_len
+                kvl[i] = req.seq_len + 1
+                sample_rows.append((req, off, req.seq_len + 1, True))
+            tmask[0, off:off + n] = True
+            row_ids[off:off + n] = i
+            table[i, :len(req.pages)] = req.pages
+            off += n
+
+        fn = self._get_ragged_fn(Rb, Tb)
+        logits, kp, vp, ksc, vsc = fn(
+            self.params, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray(tmask), jnp.asarray(row_ids), jnp.asarray(kvl),
+            jnp.asarray(table), self.cache.k_pages, self.cache.v_pages,
+            self.cache.k_scales, self.cache.v_scales)
+        self.cache = PagedKVCache(k_pages=kp, v_pages=vp,
+                                  k_scales=ksc, v_scales=vsc)
+
+        # Host bookkeeping for prefill rows (before emission, matching the
+        # legacy order: seq_len is advanced, then the finish token emits).
+        for req, start, end in entries:
+            if end > start:
+                req.prefill_pos = end
+                req.seq_len = end
+                self.metrics["prefill_tokens"] += end - start
+        if not sample_rows:
+            return events
+
+        # One batched sampler dispatch for every sampling row — decode
+        # steps and finishing prefills together (the _prefill_step /
+        # fused-scan sampler, so outputs stay bit-identical).
+        reqs = [r for r, _, _, _ in sample_rows]
+        Bs = self._bucket(len(sample_rows))
+        pad = Bs - len(sample_rows)
+        idx = np.asarray([i for _, i, _, _ in sample_rows] + [0] * pad,
+                         np.int32)
+        sel = logits[0][jnp.asarray(idx)]                   # [Bs, V]
+        temps, ks, tps, mps, seeds, rids, pen, lp, tpmp = \
+            self._sampling_rows(reqs, Bs)
+        key_pos = np.zeros(Bs, np.int32)
+        for n, (_, _, kpos, _) in enumerate(sample_rows):
+            key_pos[n] = kpos
+        keys = step_keys(row_keys(seeds, self._sample_base, rids),
+                         jnp.asarray(key_pos))
+        if any(r.gstate is not None for r in reqs):
+            # Host-side grammar masks (the unified step host-syncs every
+            # token anyway, so tabled and table-less grammars both apply
+            # the mask-then-penalties order of the host path).
+            gm = np.ones((Bs, self.mcfg.vocab_size), bool)
+            for n, req in enumerate(reqs):
+                if req.gstate is not None:
+                    gm[n] = self._gmask(req.grammar, req.gstate)
+            sel = jnp.where(jnp.asarray(gm), sel, NEG_INF)
+        args = [sel, keys, jnp.asarray(temps), jnp.asarray(ks),
+                jnp.asarray(tps), jnp.asarray(mps)]
+        if pen:
+            pmask, oc_base, rep, pres, freq = self._penalty_rows(reqs, Bs)
+            oc = oc_base
+            for n, req in enumerate(reqs):
+                np.add.at(oc[n], np.asarray(req.output, np.int64), 1)
+            args += [pmask, jnp.asarray(oc), rep, pres, freq]
+        toks, lps = self._get_sampler(pen, lp, tpmp)(*args)
+        toks = np.asarray(toks)
+        lps = np.asarray(lps) if lps is not None else None
+        for n, (req, _, _, is_decode) in enumerate(sample_rows):
+            lpv = (float(lps[n]) if lps is not None and req.sampling.logprobs
+                   else None)
+            if is_decode:
+                req.seq_len += 1
+                self.metrics["decode_tokens"] += 1
+            else:
+                req.state = "running"
+                req.t_first = time.perf_counter()
+            events.append(self._emit(req, int(toks[n]), lpv))
+        return events
 
     # ---- prefill ----
 
@@ -815,9 +1167,30 @@ class Engine:
             return []
         return self._emit_pending(st["pending"])
 
+    def _decode_window(self) -> int:
+        """Fused-scan window length for THIS step. Continuous batching:
+        when a join is possible and work is waiting (a service submission
+        beyond this step's admissions, or an engine-queued request while a
+        batch slot is free — i.e. page-blocked), the window shortens to 1
+        so the scan 'exits early' and absorbs the join next step instead
+        of making it wait out a full multi_step window."""
+        K = self.cfg.multi_step
+        if K == 1 or self.cfg.ragged == "off":
+            return K   # 'off' IS the window-boundary baseline behavior
+        if (len(self.running) < self.cfg.max_batch
+                and (self.join_hint or self.waiting)):
+            # A join is actually possible (free slot) and work is waiting
+            # (page-blocked in the engine queue, or still queued at the
+            # service): short windows surface finishes — and the pages
+            # they release — at step granularity so the join lands next
+            # step. When the batch is FULL, shortening buys nothing and
+            # costs the window's dispatch amortization — keep K.
+            return 1
+        return K
+
     def _get_decode_fn(self, B: int, pen: bool, lp: bool,
                        tpmp: bool = True, la: bool = False,
-                       gr: bool = False):
+                       gr: bool = False, K: Optional[int] = None):
         """One fused jitted program per (decode bucket, penalties-active,
         logprobs-active, grammar-active): a lax.scan window of
         ``multi_step`` iterations, each = forward + on-device sampling +
@@ -838,13 +1211,14 @@ class Engine:
         dispatches as an unconstrained one. A −1 transition (EOS from a
         non-identity state can't happen; defensive) keeps the old state,
         mirroring ``_emit``'s keep-state-on-EOS bookkeeping."""
-        fn = self._dec_fn_cache.get((B, pen, lp, tpmp, la, gr))
+        if K is None:
+            K = self.cfg.multi_step
+        fn = self._dec_fn_cache.get((B, pen, lp, tpmp, la, gr, K))
         if fn is not None:
             return fn
         import functools
         base = functools.partial(forward_paged, cfg=self.mcfg,
                                  use_pallas=self.cfg.use_pallas)
-        K = self.cfg.multi_step
 
         def fused(params, tok, pos, kvl, table, mask, limit, k_pages,
                   v_pages, k_scales, v_scales, keys, temps, ks, tps, mps,
@@ -908,7 +1282,7 @@ class Engine:
         if pen:
             donate.append(17)  # ocounts
         fn = jax.jit(fused, donate_argnums=tuple(donate))
-        self._dec_fn_cache[(B, pen, lp, tpmp, la, gr)] = fn
+        self._dec_fn_cache[(B, pen, lp, tpmp, la, gr, K)] = fn
         return fn
 
     def _build_decode_state(self, batch: List[Request]) -> dict:
@@ -1002,7 +1376,7 @@ class Engine:
         # Ensure pages exist for the whole decode window; preempt the
         # youngest requests on exhaustion. Oldest-first so old requests
         # finish and release memory (deadlock-free under oversubscription).
-        K = self.cfg.multi_step
+        K = self._decode_window()
         pages_changed = False
         for req in sorted(batch, key=lambda r: r.t_submit):
             if req.state != "running":
@@ -1062,7 +1436,7 @@ class Engine:
 
         fn = self._get_decode_fn(st["B"], st["pen"], st["lp"],
                                  st["tpmp"], st["lids"] is not None,
-                                 st["gr"])
+                                 st["gr"], K=K)
         kw = {}
         if st["pen"]:
             kw.update(pmask=st["pmask"], ocounts=st["ocounts"],
@@ -1358,6 +1732,11 @@ class Engine:
         req.prefill_pos = 0
         req.seq_len = 0
         req.shared_tokens = 0
+        # Re-queued: join accounting restarts from the preemption step
+        # (time spent RUNNING must not read as queue wait).
+        req.enqueue_step = self.metrics["steps"]
+        req.blocked_steps = 0
+        req.t_enqueue = time.perf_counter()
         # Restart cleanly: generated tokens so far are kept as prompt
         # extension so decoding resumes where it left off.
         if req.output:

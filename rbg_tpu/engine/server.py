@@ -55,6 +55,7 @@ def build_config(args) -> EngineConfig:
         checkpoint_path=args.checkpoint_path,
         kv_dtype=args.kv_dtype,
         multi_step=args.multi_step,
+        ragged=args.ragged,
         vocab_size=args.vocab_size,
         speculative=args.speculative,
         spec_k=args.spec_k,
@@ -649,6 +650,10 @@ def main(argv=None) -> int:
     ap.add_argument("--multi-step", type=int, default=1,
                     help="decode steps fused per device dispatch (lax.scan "
                          "window; higher = throughput, burstier streaming)")
+    ap.add_argument("--ragged", choices=("auto", "off"), default="auto",
+                    help="ragged unified prefill/decode dispatch "
+                         "(continuous batching); 'off' keeps the split "
+                         "phase paths — the bit-identical baseline")
     ap.add_argument("--lora", action="append", default=[],
                     metavar="NAME=PATH.npz",
                     help="load a LoRA adapter (repeatable). The npz holds "

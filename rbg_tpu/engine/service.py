@@ -273,12 +273,20 @@ class _BatchService:
         cache). One wave per bucket size, largest first, through the
         normal submit path. Returns elapsed seconds."""
         t0 = time.monotonic()
+        # Ragged unified shapes first (all-pad dispatches, cache
+        # untouched) — the prompt waves below only hit the packed-token
+        # buckets their own composition happens to produce.
+        self.engine.warm_ragged()
         for B in self._bucket_sizes():
             items = [(self._warm_item(input_len, B, i),
                       SamplingParams(max_new_tokens=out_len))
                      for i in range(B)]
             for p in self.submit_wave(items):
                 self.wait(p, 600.0)
+        # The waves compiled the K=multi_step fused programs; the K=1
+        # early-exit twins (_decode_window's join shortening) would
+        # otherwise first compile MID-SERVING, on the join-latency path.
+        self.engine.warm_join_windows()
         return time.monotonic() - t0
 
     def _warm_item(self, input_len: int, wave: int, row: int):
@@ -390,6 +398,10 @@ class _BatchService:
                              - len(eng.running) - len(eng.waiting))
                 newly = self._queue[:budget]
                 self._queue = self._queue[budget:]
+                # Continuous batching: submissions still queued beyond this
+                # step's budget shorten the engine's fused decode window so
+                # the next free slot absorbs them at step granularity.
+                eng.join_hint = bool(self._queue)
             if expired:
                 with self._lock:
                     self.counters["deadline_queue_drops"] += len(expired)
@@ -447,7 +459,19 @@ class _BatchService:
                     self._wake.wait(0.01)
                     self._wake.clear()
                 continue
-            for ev in eng.step():
+            events = eng.step()
+            # Batch-occupancy / join-latency observability (one occupancy
+            # sample per step; join waits are recorded by the engine at
+            # admission and drained here — both loop-thread-confined).
+            REGISTRY.observe(names.SERVING_BATCH_OCCUPANCY,
+                             len(eng.running) / max(1, eng.cfg.max_batch),
+                             service=type(self).__name__.lower())
+            if eng.last_join_waits:
+                for w in eng.last_join_waits:
+                    REGISTRY.observe(names.SERVING_JOIN_LATENCY_SECONDS, w,
+                                     service=type(self).__name__.lower())
+                eng.last_join_waits.clear()
+            for ev in events:
                 pending = self._pending.get(ev.request_id)
                 if pending is None:
                     continue

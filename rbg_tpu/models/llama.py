@@ -435,6 +435,81 @@ def forward_paged(
     return _head(params, cfg, x), k_pages, v_pages, k_scales, v_scales
 
 
+def forward_ragged(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,        # [1, T] int32 — ALL rows' tokens, packed
+    positions: jnp.ndarray,     # [1, T] int32 absolute positions
+    token_mask: jnp.ndarray,    # [1, T] bool — real (non-pad) tokens
+    row_ids: jnp.ndarray,       # [T] int32 — token → batch row
+    kv_lens: jnp.ndarray,       # [R] int32 — per-row cache length AFTER step
+    page_table: jnp.ndarray,    # [R, P] int32 physical page ids per row
+    k_pages: jnp.ndarray,       # [L, NP, page, KV, hd] (int8 when quantized)
+    v_pages: jnp.ndarray,
+    use_pallas: str = "auto",
+    k_scales: Optional[jnp.ndarray] = None,
+    v_scales: Optional[jnp.ndarray] = None,
+    max_q_len: Optional[int] = None,  # static bound on per-row query len
+                                      # (engine: prefill_chunk)
+):
+    """Serving forward over a RAGGED packed batch: prefill chunks and decode
+    steps of different rows ride ONE dispatch (tokens packed row-major on
+    the flat token axis, per-token ``row_ids`` naming each token's page
+    table line / kv length). Everything token-pointwise (norms, projections,
+    RoPE, MLP, head) is shape-agnostic and reuses the ``forward_paged``
+    building blocks verbatim — only the KV scatter and the attention need
+    the ragged metadata. GQA only: the MLA latent path keeps the split
+    programs (engine gates on ``cfg.mla``); multi-LoRA rows are likewise
+    gated out by the engine (``lora_delta`` gathers adapters per batch ROW,
+    and the packed batch axis is 1).
+
+    Returns (logits [1, T, V] f32, k_pages, v_pages, k_scales, v_scales).
+    """
+    from rbg_tpu.ops.ragged_paged_attention import (ragged_paged_attention,
+                                                    write_kv_pages_ragged)
+
+    if cfg.mla:
+        raise NotImplementedError(
+            "forward_ragged is GQA-only; MLA serves via the split "
+            "prefill/decode programs")
+
+    x = params["embed"].astype(cfg.jax_dtype)[tokens]
+    quantized = k_scales is not None
+
+    # Same flat-pool carry trick as forward_paged (see the comment there):
+    # each layer addresses its pages as ``layer·NP + table``.
+    L_, NP = k_pages.shape[0], k_pages.shape[1]
+    flat = lambda p: p.reshape((L_ * NP,) + p.shape[2:])
+    kpf, vpf = flat(k_pages), flat(v_pages)
+    ksf = flat(k_scales) if quantized else None
+    vsf = flat(v_scales) if quantized else None
+
+    def step(carry, xs):
+        hcur, kpf, vpf, ksf, vsf = carry
+        blk, li = xs
+        table = page_table + li * NP
+        q, k, vv = _qkv(cfg, blk, hcur, positions)
+        kpf, vpf, ksf, vsf = write_kv_pages_ragged(
+            kpf, vpf, k, vv, table, row_ids, positions, token_mask,
+            ksf, vsf)
+        attn = ragged_paged_attention(q, kpf, vpf, table, positions,
+                                      kv_lens, row_ids,
+                                      use_pallas=use_pallas,
+                                      k_scales=ksf, v_scales=vsf,
+                                      max_q_len=max_q_len)
+        out = _post_attention(cfg, blk, hcur, attn)
+        return (out, kpf, vpf, ksf, vsf), None
+
+    (x, kpf, vpf, ksf, vsf), _ = jax.lax.scan(
+        step, (x, kpf, vpf, ksf, vsf),
+        (params["blocks"], jnp.arange(L_, dtype=jnp.int32)))
+    k_pages, v_pages = kpf.reshape(k_pages.shape), vpf.reshape(v_pages.shape)
+    if quantized:
+        k_scales = ksf.reshape(k_scales.shape)
+        v_scales = vsf.reshape(v_scales.shape)
+    return _head(params, cfg, x), k_pages, v_pages, k_scales, v_scales
+
+
 def forward_train(
     params: dict,
     cfg: ModelConfig,

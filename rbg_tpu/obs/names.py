@@ -53,6 +53,8 @@ RACE_GUARDED_CLASSES = "rbg_race_guarded_classes"
 RECONCILE_DURATION_SECONDS = "rbg_reconcile_duration_seconds"
 SERVING_QUEUE_DEPTH = "rbg_serving_queue_depth"
 SERVING_REQUEST_DURATION_SECONDS = "rbg_serving_request_duration_seconds"
+SERVING_BATCH_OCCUPANCY = "rbg_serving_batch_occupancy"
+SERVING_JOIN_LATENCY_SECONDS = "rbg_serving_join_latency_seconds"
 
 # ---- catalog sets (consumed by the lint rule and strict-mode registry) ----
 
@@ -86,6 +88,8 @@ HISTOGRAMS = frozenset({
     RECONCILE_DURATION_SECONDS,
     SERVING_QUEUE_DEPTH,
     SERVING_REQUEST_DURATION_SECONDS,
+    SERVING_BATCH_OCCUPANCY,
+    SERVING_JOIN_LATENCY_SECONDS,
 })
 
 ALL_NAMES = COUNTERS | GAUGES | HISTOGRAMS
@@ -121,6 +125,12 @@ HELP = {
     SERVING_QUEUE_DEPTH: "Service queue depth observed at submission",
     SERVING_REQUEST_DURATION_SECONDS:
         "End-to-end request latency inside the serving loop",
+    SERVING_BATCH_OCCUPANCY:
+        "Running-batch fill fraction (running / max_batch) observed per "
+        "engine step",
+    SERVING_JOIN_LATENCY_SECONDS:
+        "Wait between entering the engine queue and joining the running "
+        "batch",
 }
 
 # ---- span names (obs/trace.py) ----

@@ -1,5 +1,7 @@
 from rbg_tpu.ops.attention import gqa_attention
 from rbg_tpu.ops.norms import rms_norm
+from rbg_tpu.ops.ragged_paged_attention import ragged_paged_attention
 from rbg_tpu.ops.rope import apply_rope
 
-__all__ = ["gqa_attention", "rms_norm", "apply_rope"]
+__all__ = ["gqa_attention", "rms_norm", "apply_rope",
+           "ragged_paged_attention"]

@@ -412,3 +412,15 @@ def paged_mla_attention_pallas(q_lat, q_pe, c_pages, pe_pages, page_table,
                            kv_lens.astype(jnp.int32),
                            scale=float(scale), interpret=interpret)
     return out[:, None]
+
+
+# ---- ragged (mixed prefill/decode) kernels ---------------------------------
+#
+# Re-exported here because ``dispatch_pallas`` resolves every kernel name
+# against this module; the implementation lives in
+# ragged_attention_kernel.py (token-grid variant of the decode kernel).
+
+from rbg_tpu.ops.pallas.ragged_attention_kernel import (  # noqa: E402,F401
+    ragged_paged_attention_pallas,
+    ragged_paged_attention_pallas_q,
+)

@@ -233,6 +233,22 @@ class OverloadConfig:
     prompt_len: int = 8
     timeout_s: float = 60.0        # per-request deadline budget
     model: str = "tiny"
+    # Mixed trace (continuous batching): per-request prompt lengths cycle
+    # through this tuple, so the engine serves prefill-heavy and
+    # decode-heavy rows TOGETHER and the continuous-admission invariant
+    # (no admitted request waits more than one step beyond page/slot
+    # availability) is actually exercised. Empty tuple = fixed prompt_len.
+    # A caller who customizes prompt_len while leaving this at its default
+    # gets fixed-length prompts (see __post_init__) — prompt_len predates
+    # the trace and must not be silently ignored.
+    mixed_prompt_lens: tuple = (4, 12, 24, 40)
+
+    def __post_init__(self):
+        fields = type(self).__dataclass_fields__
+        if (self.prompt_len != fields["prompt_len"].default
+                and self.mixed_prompt_lens
+                == fields["mixed_prompt_lens"].default):
+            self.mixed_prompt_lens = ()
 
 
 def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
@@ -271,8 +287,11 @@ def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
     def client(ci: int):
         from rbg_tpu.obs import trace
         sp = SamplingParams(max_new_tokens=cfg.max_new_tokens)
-        prompt = [(ci * 17 + j) % 200 + 1 for j in range(cfg.prompt_len)]
         for ri in range(cfg.requests_per_client):
+            plen = (cfg.mixed_prompt_lens[(ci + ri)
+                                          % len(cfg.mixed_prompt_lens)]
+                    if cfg.mixed_prompt_lens else cfg.prompt_len)
+            prompt = [(ci * 17 + ri * 5 + j) % 200 + 1 for j in range(plen)]
             t0 = time.monotonic()
             # Root span per drill request (sampling per --trace-sample);
             # the service's queue-wait/scan spans — and the shed/deadline
@@ -329,6 +348,13 @@ def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
             service.stop()
     stats = service.service_stats()
     total = cfg.clients * cfg.requests_per_client
+    em = service.engine.metrics
+    svc_label = type(service).__name__.lower()
+
+    def _q(name, q):
+        v = REGISTRY.quantile(name, q, service=svc_label)
+        return round(v, 4) if v is not None else None
+
     report = {
         "scenario": "overload",
         "config": dataclasses.asdict(cfg),
@@ -339,12 +365,31 @@ def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
                                if retry_hints else None),
         "max_queue_depth_observed": depth_max[0],
         "service": stats,
+        # Continuous-batching observability (engine join accounting + the
+        # rbg_serving_batch_occupancy / rbg_serving_join_latency_seconds
+        # registry series this service labeled).
+        "continuous_batching": {
+            "joins": em.get("joins", 0),
+            "unified_steps": em.get("unified_steps", 0),
+            "join_wait_steps_max": em.get("join_wait_steps_max", 0),
+            "join_excess_steps_max": em.get("join_excess_steps_max", 0),
+            "batch_occupancy_p50": _q(metric_names.SERVING_BATCH_OCCUPANCY,
+                                      0.5),
+            "join_latency_p50_s": _q(
+                metric_names.SERVING_JOIN_LATENCY_SECONDS, 0.5),
+            "join_latency_p95_s": _q(
+                metric_names.SERVING_JOIN_LATENCY_SECONDS, 0.95),
+        },
         "invariants": {
             # The three promises the overload machinery makes:
             "queue_bounded": depth_max[0] <= cfg.max_queue,
             "all_accounted": sum(outcomes.values()) == total,
             "shed_instead_of_queued": (outcomes[CODE_OVERLOADED] == 0
                                        or stats["shed_total"] > 0),
+            # Continuous admission (the ragged-batching promise): under
+            # the mixed trace, no request the engine admitted waited more
+            # than ONE step beyond page/slot availability.
+            "continuous_admission": em.get("join_excess_steps_max", 0) <= 1,
         },
     }
     return report
@@ -923,6 +968,8 @@ def _overload_sections(report: dict) -> str:
     lat = report.get("admitted_latency_ms") or {}
     return f"""<h2>outcomes</h2>{_kv_table(report.get("outcomes") or {})}
 <h2>admitted-request latency (ms)</h2>{_kv_table(lat)}
+<h2>continuous batching</h2>{_kv_table(
+        report.get("continuous_batching") or {})}
 <h2>service counters</h2>{_kv_table(report.get("service") or {})}
 <p>max queue depth observed: {report.get("max_queue_depth_observed")}
 &nbsp; retry_after hint: {report.get("retry_after_hint_s")}</p>

@@ -1,0 +1,276 @@
+"""Ragged unified prefill/decode step + continuous batching: bit-identity
+against the split paths (pure prefill, pure decode, mixed joins; greedy and
+seeded-sampled), join accounting, window shortening, and the prefill-chunk
+boundary / pending-window seq_len invariant."""
+
+import jax
+import numpy as np
+import pytest
+
+from rbg_tpu.engine import Engine, EngineConfig, SamplingParams
+from rbg_tpu.models import get_config, init_params
+from rbg_tpu.models.llama import prefill_and_decode_greedy
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def make_engine(params, ragged="auto", **kw):
+    defaults = dict(model="tiny", page_size=8, num_pages=64, max_batch=4,
+                    max_seq_len=128, prefill_chunk=16,
+                    enable_radix_cache=False, use_pallas="never",
+                    multi_step=4)
+    defaults.update(kw)
+    return Engine(EngineConfig(ragged=ragged, **defaults), params=params)
+
+
+def drain(eng, outputs, ids):
+    while eng.has_work():
+        for ev in eng.step():
+            if ev.request_id in outputs:
+                outputs[ev.request_id].append(ev.token)
+    return [outputs[i] for i in ids]
+
+
+def run_batch(params, ragged, prompts, sps, stagger_after=None, **kw):
+    """Drive a batch to completion; ``stagger_after`` splits the adds
+    around a few steps so late rows JOIN a decoding batch."""
+    eng = make_engine(params, ragged=ragged, **kw)
+    cut = stagger_after if stagger_after is not None else len(prompts)
+    ids = [eng.add_request(p, s) for p, s in zip(prompts[:cut], sps[:cut])]
+    outputs = {i: [] for i in ids}
+    if stagger_after is not None:
+        for _ in range(3):
+            for ev in eng.step():
+                outputs[ev.request_id].append(ev.token)
+        for p, s in zip(prompts[cut:], sps[cut:]):
+            i = eng.add_request(p, s)
+            ids.append(i)
+            outputs[i] = []
+    return drain(eng, outputs, ids), eng
+
+
+def _prompts(cfg, sizes, seed=1):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size, size=n).tolist() for n in sizes]
+
+
+def test_pure_prefill_bit_identity(tiny_setup):
+    """max_new_tokens=1: the run is all prefill — the packed ragged
+    dispatch must reproduce the split prefill path exactly."""
+    cfg, params = tiny_setup
+    prompts = _prompts(cfg, (4, 23, 9, 17))
+    sps = [SamplingParams(max_new_tokens=1)] * 4
+    got, eng = run_batch(params, "auto", prompts, sps)
+    ref, _ = run_batch(params, "off", prompts, sps)
+    assert got == ref
+    assert eng.metrics["unified_steps"] > 0
+
+
+def test_pure_decode_keeps_fused_scan(tiny_setup):
+    """Once every row is decoding, the engine must return to the fused
+    multi-step scan (unified steps only cover the prefill-mixed phase) —
+    and the output still matches the dense reference."""
+    cfg, params = tiny_setup
+    prompt = [5, 9, 13, 2, 7, 11, 3, 1, 8, 4]
+    out = prefill_and_decode_greedy(
+        params, cfg, np.asarray([prompt], np.int32), 8)
+    expect = [int(t) for t in np.asarray(out)[0]]
+    eng = make_engine(params, ragged="auto")
+    got = eng.generate([prompt], SamplingParams(max_new_tokens=8))[0]
+    assert got == expect
+    # one chunk of prefill → exactly one unified step; the rest decoded
+    # in fused windows
+    assert eng.metrics["unified_steps"] == 1
+    assert eng.metrics["decode_tokens"] > 4
+
+
+def test_mixed_join_bit_identity_greedy(tiny_setup):
+    """Rows joining a decoding batch mid-stream (continuous admission)
+    produce bit-identical streams to the split path for every row."""
+    cfg, params = tiny_setup
+    prompts = _prompts(cfg, (4, 23, 9, 17))
+    sps = [SamplingParams(max_new_tokens=6)] * 4
+    got, eng = run_batch(params, "auto", prompts, sps, stagger_after=2)
+    ref, _ = run_batch(params, "off", prompts, sps, stagger_after=2)
+    assert got == ref
+    assert eng.metrics["unified_steps"] >= 2  # initial prefill + the join
+    assert eng.metrics["joins"] == 4
+
+
+def test_mixed_join_bit_identity_sampled(tiny_setup):
+    """Seeded sampling + penalties + logprobs across a mid-decode join:
+    per-row keys are position-keyed, so the ragged path must replay the
+    identical random stream."""
+    cfg, params = tiny_setup
+    prompts = _prompts(cfg, (4, 23, 9, 17), seed=3)
+    sps = [SamplingParams(max_new_tokens=8, temperature=0.8, top_k=20,
+                          seed=i, logprobs=True,
+                          repetition_penalty=1.2 if i % 2 else 1.0)
+           for i in range(4)]
+    got, _ = run_batch(params, "auto", prompts, sps, stagger_after=2)
+    ref, _ = run_batch(params, "off", prompts, sps, stagger_after=2)
+    assert got == ref
+
+
+@pytest.mark.slow
+def test_mixed_join_bit_identity_int8_pool(tiny_setup):
+    cfg, params = tiny_setup
+    prompts = _prompts(cfg, (4, 23, 9), seed=5)
+    sps = [SamplingParams(max_new_tokens=6)] * 3
+    got, _ = run_batch(params, "auto", prompts, sps, stagger_after=1,
+                       kv_dtype="int8")
+    ref, _ = run_batch(params, "off", prompts, sps, stagger_after=1,
+                       kv_dtype="int8")
+    assert got == ref
+
+
+@pytest.mark.slow
+def test_grammar_row_joins_mid_decode(tiny_setup):
+    """A regex-constrained row joining plain decoding rows rides the
+    unified step on host-side masks — identical to the split path."""
+    from rbg_tpu.engine.tokenizer import ByteTokenizer
+    cfg, params = tiny_setup
+    tok = ByteTokenizer()
+
+    def run(ragged):
+        eng = make_engine(params, ragged=ragged)
+        eng.enable_json_grammar(tok)
+        plain = eng.add_request(
+            _prompts(cfg, (12,), seed=7)[0],
+            SamplingParams(max_new_tokens=10))
+        outputs = {plain: []}
+        for _ in range(2):
+            for ev in eng.step():
+                outputs[ev.request_id].append(ev.token)
+        gr = eng.add_request(
+            tok.encode("p:", add_bos=False),
+            SamplingParams(max_new_tokens=8, temperature=0.7, seed=1,
+                           regex="[ab]{8}", stop_token=tok.eos_id))
+        outputs[gr] = []
+        return drain(eng, outputs, [plain, gr])
+
+    assert run("auto") == run("off")
+
+
+@pytest.mark.slow
+def test_preemption_under_page_pressure_ragged(tiny_setup):
+    """Page exhaustion mid-mix preempts the youngest and still completes
+    every stream — identically to the split path."""
+    cfg, params = tiny_setup
+    prompts = _prompts(cfg, (20, 22, 24), seed=9)
+    sps = [SamplingParams(max_new_tokens=12)] * 3
+    got, eng = run_batch(params, "auto", prompts, sps, num_pages=16,
+                        max_batch=3)
+    ref, _ = run_batch(params, "off", prompts, sps, num_pages=16,
+                       max_batch=3)
+    assert got == ref
+    assert all(len(o) == 12 for o in got)
+
+
+def test_seq_len_accounting_after_pending_drain(tiny_setup):
+    """Regression for the prefill-chunk boundary invariant (the seq_len
+    double-count the runtime-LoRA drain comment protects): a join forces
+    a unified step while a fused window's tokens are still PENDING — the
+    drain must reconcile seq_len with the emitted stream, and after any
+    step with no device window in flight every running row satisfies
+    seq_len == total_len - 1 (last_token not yet written)."""
+    cfg, params = tiny_setup
+    eng = make_engine(params, ragged="auto", multi_step=4)
+    first = eng.add_request(_prompts(cfg, (10,), seed=11)[0],
+                            SamplingParams(max_new_tokens=20))
+    outputs = {first: []}
+    # prefill + a couple of fused windows so a pending emission lag exists
+    for _ in range(3):
+        for ev in eng.step():
+            outputs[ev.request_id].append(ev.token)
+    assert eng._dec is not None and eng._dec["pending"] is not None
+    joiner = eng.add_request(_prompts(cfg, (21,), seed=12)[0],
+                             SamplingParams(max_new_tokens=20))
+    outputs[joiner] = []
+    for ev in eng.step():                  # unified: drains pending first
+        outputs[ev.request_id].append(ev.token)
+    assert eng._dec is None                # window consumed, not discarded
+    for r in eng.running:
+        if r.state == "running":
+            assert r.seq_len == r.total_len - 1
+    got = drain(eng, outputs, [first, joiner])
+    # no token lost or duplicated across the drain: full streams, and
+    # identical to the split path end to end
+    assert [len(o) for o in got] == [20, 20]
+
+    def split_run():
+        eng2 = make_engine(params, ragged="off", multi_step=4)
+        a = eng2.add_request(_prompts(cfg, (10,), seed=11)[0],
+                             SamplingParams(max_new_tokens=20))
+        outs = {a: []}
+        for _ in range(3):
+            for ev in eng2.step():
+                outs[ev.request_id].append(ev.token)
+        b = eng2.add_request(_prompts(cfg, (21,), seed=12)[0],
+                             SamplingParams(max_new_tokens=20))
+        outs[b] = []
+        return drain(eng2, outs, [a, b])
+
+    assert got == split_run()
+
+
+def test_join_accounting_metrics(tiny_setup):
+    """Admissions record joins and (with free capacity) zero excess wait;
+    page-blocked queueing counts as availability wait, not excess."""
+    cfg, params = tiny_setup
+    eng = make_engine(params, ragged="auto", num_pages=16, max_batch=4)
+    sps = SamplingParams(max_new_tokens=8)
+    for p in _prompts(cfg, (20, 22, 24, 26), seed=13):
+        eng.add_request(p, sps)
+    while eng.has_work():
+        eng.step()
+    m = eng.metrics
+    assert m["joins"] >= 4            # preempted rows re-join
+    assert m["join_excess_steps_max"] <= 1
+    assert len(eng.last_join_waits) == m["joins"]
+
+
+def test_decode_window_shortens_for_joins(tiny_setup):
+    cfg, params = tiny_setup
+    eng = make_engine(params, ragged="auto", multi_step=8, max_batch=4)
+    rid = eng.add_request(_prompts(cfg, (8,), seed=15)[0],
+                          SamplingParams(max_new_tokens=4))
+    assert eng._decode_window() == 1          # queued request, free slot
+    eng.step()                                # admit + prefill it
+    assert eng._decode_window() == 8          # no waiting work
+    eng.join_hint = True
+    assert eng._decode_window() == 1          # free slot + hinted join
+    eng.join_hint = False
+    eng.cancel_request(rid)
+
+    off = make_engine(params, ragged="off", multi_step=8)
+    off.join_hint = True
+    assert off._decode_window() == 8          # baseline keeps full windows
+
+
+def test_service_publishes_join_and_occupancy_metrics(tiny_setup):
+    from rbg_tpu.engine.service import EngineService
+    from rbg_tpu.obs import names
+    from rbg_tpu.obs.metrics import REGISTRY
+
+    _, params = tiny_setup
+    svc = EngineService(
+        EngineConfig(model="tiny", page_size=8, num_pages=64, max_batch=2,
+                     max_seq_len=128, prefill_chunk=16, use_pallas="never",
+                     enable_radix_cache=False, decode_buckets=(2,)),
+        params=params)
+    try:
+        svc.submit([1, 2, 3, 4], SamplingParams(max_new_tokens=4))
+        assert svc.engine.metrics["joins"] >= 1
+        assert not svc.engine.last_join_waits    # drained by the loop
+        assert REGISTRY.quantile(names.SERVING_JOIN_LATENCY_SECONDS, 0.5,
+                                 service="engineservice") is not None
+        assert REGISTRY.quantile(names.SERVING_BATCH_OCCUPANCY, 0.5,
+                                 service="engineservice") is not None
+    finally:
+        svc.stop()

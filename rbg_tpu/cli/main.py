@@ -28,6 +28,9 @@ def main(argv=None) -> int:
     if argv and argv[0] == "lint":
         from rbg_tpu.analysis.cli import run as lint_run
         return lint_run(argv[1:])
+    if argv and argv[0] == "top":
+        from rbg_tpu.cli.top import run as top_run
+        return top_run(argv[1:])
 
     parser = argparse.ArgumentParser(
         prog="rbg-tpu",

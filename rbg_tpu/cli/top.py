@@ -1,0 +1,260 @@
+"""``rbg-tpu top`` — live per-role serving dashboard.
+
+The operator leg of the windowed-signal plane (docs/observability.md):
+polls the ``slo`` + ``metrics`` ops of engine servers (and/or a router's
+``health``, and/or an admin plane's ``slo`` op) and renders occupancy,
+queue depth, windowed throughput, shed rate, TTFT/TPOT attainment, and
+goodput per role. ``--once`` prints a single frame and exits — the
+scripting/CI mode (`scripts/tier1.sh --lint` smoke-renders it against a
+live engine).
+
+Usage:
+    rbg-tpu top --engine 127.0.0.1:9000 [--engine HOST:PORT ...]
+    rbg-tpu top --router 127.0.0.1:9100
+    rbg-tpu top --admin 127.0.0.1:7070
+    rbg-tpu top --once --json ...        # one raw JSON frame
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+REFRESH_DEFAULT_S = 2.0
+
+
+def _fmt(v, nd=2, suffix="") -> str:
+    if v is None:
+        return "—"
+    return f"{v:.{nd}f}{suffix}"
+
+
+def _pct(v) -> str:
+    return "—" if v is None else f"{100.0 * v:.1f}%"
+
+
+def _call(addr: str, obj: dict, token: Optional[str] = None,
+          timeout: float = 10.0) -> dict:
+    from rbg_tpu.engine.protocol import request_once
+    if token:
+        obj = dict(obj, token=token)
+    resp, _, _ = request_once(addr, obj, timeout=timeout)
+    if resp is None:
+        raise ConnectionError(f"{addr} closed connection")
+    if "error" in resp:
+        raise RuntimeError(f"{addr}: {resp['error']}")
+    return resp
+
+
+def _collect_engine(addr: str, token: Optional[str], window: int) -> dict:
+    met = _call(addr, {"op": "metrics"}, token)
+    slo = _call(addr, {"op": "slo", "window": window})
+    return {"kind": "engine", "addr": addr, "mode": met.get("mode", "?"),
+            "stats": met.get("metrics") or {}, "slo": slo}
+
+
+def _collect_router(addr: str, token: Optional[str]) -> dict:
+    health = _call(addr, {"op": "health"}, token)
+    return {"kind": "router", "addr": addr, "health": health}
+
+
+def _collect_admin(addr: str, token: Optional[str], window: int) -> dict:
+    tok = token if token is not None else os.environ.get("RBG_ADMIN_TOKEN", "")
+    resp = _call(addr, {"op": "slo", "window": window}, tok or None)
+    return {"kind": "admin", "addr": addr, "slo": resp}
+
+
+_ROLE_HDR = (f"  {'ROLE':<10} {'OCC':>6} {'QDEPTH':>7} {'REQ/S':>7} "
+             f"{'TOK/S':>8} {'SHED/S':>7} {'TTFT-ATT':>9} {'TPOT-ATT':>9} "
+             f"{'GOODPUT':>9}")
+
+
+def _tracker_role_rows(trackers: List[dict], window: int,
+                       signals: dict, stats: dict) -> List[str]:
+    """One row per (tracker, role group) with the engine-wide windowed
+    signals folded into the first row (they are per-process series)."""
+    rows = []
+    wkey = f"{window}s"
+    first = True
+    for t in trackers:
+        groups = (t.get("windows") or {}).get(wkey) or {}
+        if not groups:
+            groups = {"(no judgments yet)": {}}
+        for gk, g in sorted(groups.items()):
+            role = gk.split("=", 1)[1] if "=" in gk else gk
+            occ = qd = rps = tps = shed = None
+            if first:
+                occ = signals.get("occupancy_mean")
+                qd = (stats.get("queue_depth")
+                      if stats.get("queue_depth") is not None
+                      else signals.get("queue_depth_mean"))
+                rps = signals.get("requests_per_s")
+                tps = signals.get("tokens_per_s")
+                shed = signals.get("shed_per_s")
+                first = False
+            rows.append(
+                f"  {role:<10} {_fmt(occ):>6} {_fmt(qd, 0):>7} "
+                f"{_fmt(rps):>7} {_fmt(tps, 1):>8} {_fmt(shed):>7} "
+                f"{_pct(g.get('ttft_attainment')):>9} "
+                f"{_pct(g.get('tpot_attainment')):>9} "
+                f"{_fmt(g.get('goodput_rps'), 3):>9}")
+    return rows
+
+
+def _render_engine(src: dict, window: int) -> List[str]:
+    stats = src["stats"]
+    slo = src["slo"]
+    signals = slo.get("signals") or {}
+    sampler = slo.get("sampler") or {}
+    lines = [f"engine {src['addr']}  mode={src['mode']}  "
+             f"draining={'yes' if stats.get('draining') else 'no'}  "
+             f"running={stats.get('running', '—')}  "
+             f"waiting={stats.get('waiting', '—')}  "
+             f"judged={stats.get('slo_judged_total', 0)}  "
+             f"samples={sampler.get('samples', 0)}"]
+    lines.append(_ROLE_HDR)
+    lines.extend(_tracker_role_rows(slo.get("trackers") or [], window,
+                                    signals, stats))
+    return lines
+
+
+def _render_router(src: dict, window: int) -> List[str]:
+    h = src["health"]
+    slo = h.get("slo") or {}
+    met = h.get("metrics") or {}
+    lines = [f"router {src['addr']}  pd={'yes' if h.get('pd') else 'no'}  "
+             f"requests={met.get('requests', '—')}  "
+             f"retries={met.get('retries', '—')}  "
+             f"judged={slo.get('judged_total', '—')}"]
+    per_role = slo.get("per_role") or {}
+    if not slo:
+        lines.append("  (health snapshot carries no slo section — "
+                     "is the router authorized / new enough?)")
+        return lines
+    lines.append(f"  {'ROLE':<12} {'JUDGED':>7} {'TTFT-ATT':>9} "
+                 f"{'TPOT-ATT':>9} {'GOODPUT':>9}")
+    for gk, g in sorted(per_role.items()) or [("(none)", {})]:
+        role = gk.split("=", 1)[1] if "=" in gk else gk
+        lines.append(f"  {role:<12} {g.get('judged', 0):>7} "
+                     f"{_pct(g.get('ttft_attainment')):>9} "
+                     f"{_pct(g.get('tpot_attainment')):>9} "
+                     f"{_fmt(g.get('goodput_rps'), 3):>9}")
+    per_backend = slo.get("per_backend") or {}
+    backends = h.get("backends") or {}
+    if backends:
+        lines.append(f"  {'BACKEND':<22} {'OUT':>4} {'DOWN-S':>7} "
+                     f"{'DRAIN':>6} {'GOODPUT':>9}")
+        for addr, st in sorted(backends.items()):
+            g = per_backend.get(f"backend={addr}") or {}
+            lines.append(f"  {addr:<22} {st.get('outstanding', 0):>4} "
+                         f"{st.get('down_for_s', 0):>7} "
+                         f"{'yes' if st.get('draining') else 'no':>6} "
+                         f"{_fmt(g.get('goodput_rps'), 3):>9}")
+    return lines
+
+
+def _render_admin(src: dict, window: int) -> List[str]:
+    slo = src["slo"]
+    signals = slo.get("signals") or {}
+    sampler = slo.get("sampler") or {}
+    lines = [f"plane {src['addr']}  samples={sampler.get('samples', 0)}  "
+             f"span={sampler.get('span_s', 0)}s"]
+    lines.append(_ROLE_HDR)
+    lines.extend(_tracker_role_rows(slo.get("trackers") or [], window,
+                                    signals, {}))
+    return lines
+
+
+def _frame(args) -> tuple:
+    """Collect + render one frame. Returns (lines, raw, errors)."""
+    lines: List[str] = []
+    raw: List[dict] = []
+    errors: List[str] = []
+    window = int(args.window)
+    stamp = time.strftime("%H:%M:%S")
+    lines.append(f"rbg-tpu top — window {window}s — {stamp}"
+                 + ("" if args.once else
+                    f" — every {args.interval}s (ctrl-c to quit)"))
+    collectors = (
+        [(a, lambda a=a: _collect_engine(a, args.token, window),
+          _render_engine) for a in args.engine]
+        + [(a, lambda a=a: _collect_router(a, args.token), _render_router)
+           for a in args.router]
+        + [(a, lambda a=a: _collect_admin(a, args.token, window),
+            _render_admin) for a in args.admin])
+    for addr, collect, render in collectors:
+        try:
+            src = collect()
+        except (OSError, RuntimeError, ConnectionError) as e:
+            errors.append(f"{addr}: {e}")
+            lines.append(f"!! {addr}: unreachable ({e})")
+            continue
+        raw.append(src)
+        lines.append("")
+        lines.extend(render(src, window))
+    return lines, raw, errors
+
+
+def run(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rbg-tpu top",
+        description="live per-role serving dashboard: occupancy, queue "
+                    "depth, windowed throughput, shed rate, SLO "
+                    "attainment, goodput")
+    ap.add_argument("--engine", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="engine server to poll (repeatable; slo + "
+                         "metrics ops)")
+    ap.add_argument("--router", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="router to poll (health snapshot: per-role / "
+                         "per-backend attainment)")
+    ap.add_argument("--admin", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="admin plane to poll (slo op; in-process "
+                         "trackers + sampler signals)")
+    ap.add_argument("--window", type=int, default=60,
+                    choices=(10, 60, 300),
+                    help="sliding window for rates/attainment (seconds)")
+    ap.add_argument("--interval", type=float, default=REFRESH_DEFAULT_S,
+                    help="refresh period in live mode")
+    ap.add_argument("--once", action="store_true",
+                    help="print ONE frame and exit (scripting mode; exit "
+                         "1 if any target was unreachable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw collected payloads as JSON instead "
+                         "of the rendered table (implies --once)")
+    ap.add_argument("--token", default=os.environ.get("RBG_DATA_TOKEN")
+                    or None,
+                    help="bearer token forwarded to engine/router targets "
+                         "(default: $RBG_DATA_TOKEN); --admin uses "
+                         "$RBG_ADMIN_TOKEN unless this is set")
+    args = ap.parse_args(argv)
+    if not (args.engine or args.router or args.admin):
+        ap.error("pass at least one --engine / --router / --admin target")
+    if args.json:
+        args.once = True
+    if args.once:
+        lines, raw, errors = _frame(args)
+        if args.json:
+            print(json.dumps(raw, indent=2))
+        else:
+            print("\n".join(lines))
+        return 1 if errors else 0
+    try:
+        while True:
+            lines, _, _ = _frame(args)
+            # Clear + home, then the frame — a plain-terminal live view.
+            sys.stdout.write("\x1b[2J\x1b[H" + "\n".join(lines) + "\n")
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

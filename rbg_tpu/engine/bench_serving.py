@@ -198,6 +198,26 @@ def run(args) -> dict:
     itls = [x for r in ok for x in r.itl_s]
     lats = [r.latency_s for r in ok]
     total_tokens = sum(r.n_tokens for r in ok)
+
+    # SLO judgment (optional): per-request TTFT + TPOT ((e2e - ttft) /
+    # (n - 1), the decode-side per-token latency) against the targets —
+    # goodput is completions/s that met BOTH. The met-rules (0 disables
+    # a dimension, a missing measurement fails an enabled one) live in
+    # ONE place: SLOTargets.verdict, the same rules the serving plane's
+    # rbg_slo_* series stand on.
+    from rbg_tpu.obs.slo import SLOTargets
+    ttft_target = float(getattr(args, "slo_ttft_s", 0.0) or 0.0)
+    tpot_target = float(getattr(args, "slo_tpot_s", 0.0) or 0.0)
+    targets = SLOTargets(ttft_s=ttft_target, tpot_s=tpot_target)
+
+    def _tpot(r):
+        if r.n_tokens > 1 and r.ttft_s is not None:
+            return (r.latency_s - r.ttft_s) / (r.n_tokens - 1)
+        return 0.0 if r.ttft_s is not None else None
+
+    def _verdict(r):
+        return targets.verdict(r.ttft_s, _tpot(r))
+
     out = {
         "requests": args.requests,
         "completed": len(ok),
@@ -213,6 +233,20 @@ def run(args) -> dict:
         "e2e_s": {"p50": round(_percentile(lats, 50), 3),
                   "p99": round(_percentile(lats, 99), 3)},
     }
+    if ttft_target > 0 or tpot_target > 0:
+        verdicts = [_verdict(r) for r in ok]
+        good = sum(1 for t_ok, p_ok in verdicts if t_ok and p_ok)
+        out["slo"] = {
+            "ttft_target_s": ttft_target, "tpot_target_s": tpot_target,
+            "ttft_attainment": round(
+                sum(1 for t_ok, _ in verdicts if t_ok) / len(ok), 4)
+                if ok else None,
+            "tpot_attainment": round(
+                sum(1 for _, p_ok in verdicts if p_ok) / len(ok), 4)
+                if ok else None,
+            "goodput_fraction": round(good / len(ok), 4) if ok else None,
+        }
+        out["goodput_rps"] = round(good / wall, 3) if wall else 0.0
     return out
 
 
@@ -237,6 +271,12 @@ def main(argv=None) -> int:
     ap.add_argument("--token", default=os.environ.get("RBG_DATA_TOKEN", ""),
                     help="data-plane bearer token for --addr targets "
                          "(default: $RBG_DATA_TOKEN)")
+    ap.add_argument("--slo-ttft-s", type=float, default=0.0,
+                    help="TTFT target: emit goodput_rps + attainment "
+                         "(0 = no TTFT judgment)")
+    ap.add_argument("--slo-tpot-s", type=float, default=0.0,
+                    help="per-output-token latency target for goodput "
+                         "(0 = no TPOT judgment)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", action="store_true",
                     help="print one JSON line instead of the table")
@@ -254,6 +294,11 @@ def main(argv=None) -> int:
           f"{out['itl_ms']['p90']}ms  p99 {out['itl_ms']['p99']}ms")
     print(f"e2e         p50 {out['e2e_s']['p50']}s   p99 "
           f"{out['e2e_s']['p99']}s")
+    if "goodput_rps" in out:
+        slo = out["slo"]
+        print(f"goodput     {out['goodput_rps']} req/s meeting ttft<="
+              f"{slo['ttft_target_s']}s tpot<={slo['tpot_target_s']}s "
+              f"(fraction {slo['goodput_fraction']})")
     return 0
 
 

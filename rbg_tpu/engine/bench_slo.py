@@ -193,6 +193,7 @@ def measure(kind: str, rates: List[float], args, env) -> List[dict]:
                 max_batch=args.max_batch, use_pallas=args.use_pallas,
                 multi_step=1, speculative="off", addr=topo.addr,
                 token=os.environ.get("RBG_DATA_TOKEN", ""),
+                slo_ttft_s=args.slo_ttft_s, slo_tpot_s=args.slo_tpot_s,
                 seed=args.seed, json=True)
             load1 = os.getloadavg()[0]
             out = bench_serving.run(bargs)
@@ -228,6 +229,11 @@ def main(argv=None) -> int:
     ap.add_argument("--prefill-chunk", type=int, default=32)
     ap.add_argument("--use-pallas", default="never")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slo-ttft-s", type=float, default=0.2,
+                    help="TTFT target for windowed goodput (default: the "
+                         "BASELINE north-star 200 ms; 0 disables)")
+    ap.add_argument("--slo-tpot-s", type=float, default=0.1,
+                    help="per-output-token target for goodput (0 disables)")
     ap.add_argument("--json-out", default="",
                     help="write the BENCH-style artifact here")
     ap.add_argument("--setups", default="unified,pd")
@@ -276,6 +282,10 @@ def main(argv=None) -> int:
                     if u["output_tok_per_s"] else None,
                 "pd_ttft_p50_s": p["ttft_s"]["p50"],
                 "unified_ttft_p50_s": u["ttft_s"]["p50"],
+                # Attainment, not just latency quantiles: req/s that met
+                # BOTH SLO targets — the trajectory SLO_r*.json tracks.
+                "pd_goodput_rps": p.get("goodput_rps"),
+                "unified_goodput_rps": u.get("goodput_rps"),
             })
 
     hdr = (f"| setup | rate rps | done | tok/s | ttft p50/p99 s | "
@@ -294,7 +304,9 @@ def main(argv=None) -> int:
     for rt in ratios:
         print(f"ratio @ {rt['rate_rps']} rps: PD/unified throughput = "
               f"{rt['pd_over_unified_throughput']}  "
-              f"(PD ttft p50 {rt['pd_ttft_p50_s']}s)")
+              f"(PD ttft p50 {rt['pd_ttft_p50_s']}s, PD goodput "
+              f"{rt['pd_goodput_rps']} rps vs unified "
+              f"{rt['unified_goodput_rps']} rps)")
 
     if args.json_out:
         artifact = {
@@ -303,6 +315,8 @@ def main(argv=None) -> int:
             "hardware": "cpu-proxy" if args.platform == "cpu" else "tpu",
             "input_len": args.input_len, "output_len": args.output_len,
             "pd_decode_replicas": args.pd_decode_replicas,
+            "slo_targets": {"ttft_s": args.slo_ttft_s,
+                            "tpot_s": args.slo_tpot_s},
             "results": results, "north_star_ratios": ratios,
         }
         with open(args.json_out, "w") as f:

@@ -60,6 +60,14 @@ class EngineConfig:
     # the fused multi-step scan either way; MLA models, speculative mode,
     # and LoRA-mixed batches fall back to the split paths automatically.
     ragged: str = "auto"                    # auto | off
+    # Per-request SLO targets the serving loop judges every FINISHED
+    # request against (obs/slo.py): seconds to first token, and seconds
+    # per output token after the first. Judgment is cheap host-side
+    # bookkeeping at finish — it publishes rbg_slo_* attainment/goodput
+    # series but never gates admission. 0 disables that dimension (it
+    # always counts as met).
+    slo_ttft_s: float = 2.0
+    slo_tpot_s: float = 0.5
     mode: str = "unified"                   # unified | prefill | decode
     mesh_spec: Optional[dict] = None        # {"dp": 1, "tp": 4} — from discovery
     checkpoint_path: str = ""               # orbax dir or local HF dir
@@ -102,6 +110,9 @@ class EngineConfig:
         if self.grammar_state_budget < 2:
             raise ValueError("grammar_state_budget must be >= 2 (initial "
                              "state + at least one successor)")
+        if self.slo_ttft_s < 0 or self.slo_tpot_s < 0:
+            raise ValueError("slo_ttft_s / slo_tpot_s must be >= 0 "
+                             "(0 disables that SLO dimension)")
         if self.kv_dtype not in ("model", "int8"):
             raise ValueError(f"kv_dtype {self.kv_dtype!r} not in (model, int8)")
         if self.kv_dtype == "int8" and self.mode != "unified":

@@ -48,6 +48,8 @@ from rbg_tpu.engine.protocol import (CODE_DEADLINE, CODE_DRAINING,
                                      request_once, send_msg)
 from rbg_tpu.obs import names as obs_names
 from rbg_tpu.obs import trace
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.obs.slo import SLOTargets, SLOTracker
 
 MAX_ATTEMPTS = 3          # distinct backends tried per leg
 CONNECT_TIMEOUT_S = 5.0   # fast failure detection on the connect
@@ -146,6 +148,18 @@ class Registry:
         return (leaders or all_) if leader_only else all_
 
 
+# Every registry family carrying a per-backend `backend=` label — the
+# staleness sweep in BackendPool.retain prunes these for dead addresses.
+_BACKEND_SERIES = (obs_names.ROUTER_BACKEND_OUTSTANDING,
+                   obs_names.ROUTER_BACKEND_DRAINING,
+                   obs_names.SLO_JUDGED_TOTAL,
+                   obs_names.SLO_TTFT_MET_TOTAL,
+                   obs_names.SLO_TPOT_MET_TOTAL,
+                   obs_names.SLO_GOODPUT_TOTAL,
+                   obs_names.SLO_TTFT_SECONDS,
+                   obs_names.SLO_TPOT_SECONDS)
+
+
 class _BackendState:
     __slots__ = ("outstanding", "fails", "down_until", "last_pick",
                  "draining")
@@ -215,11 +229,19 @@ class BackendPool:
             st.outstanding += 1
             self._seq += 1
             st.last_pick = self._seq
+            # Published INSIDE the lock: concurrent acquires on one addr
+            # would otherwise commit their gauge writes out of order and
+            # park a stale value (the Registry lock is a plain leaf lock
+            # — no ordering hazard nesting it here).
+            REGISTRY.set_gauge(obs_names.ROUTER_BACKEND_OUTSTANDING,
+                               float(st.outstanding), backend=addr)
 
     def release(self, addr: str) -> None:
         with self._lock:
             st = self._state(addr)
             st.outstanding = max(0, st.outstanding - 1)
+            REGISTRY.set_gauge(obs_names.ROUTER_BACKEND_OUTSTANDING,
+                               float(st.outstanding), backend=addr)
 
     def ok(self, addr: str) -> None:
         with self._lock:
@@ -242,6 +264,8 @@ class BackendPool:
         (or the address never returns and ordinary eviction takes over)."""
         with self._lock:
             self._state(addr).draining = draining
+            REGISTRY.set_gauge(obs_names.ROUTER_BACKEND_DRAINING,
+                               1.0 if draining else 0.0, backend=addr)
 
     def draining(self) -> List[str]:
         with self._lock:
@@ -292,9 +316,21 @@ class BackendPool:
         router's state and health payload grow monotonically). In-flight
         entries are kept until their requests drain."""
         with self._lock:
-            for a in list(self._st):
-                if a not in live and self._st[a].outstanding == 0:
-                    del self._st[a]
+            for a in [a for a in self._st
+                      if a not in live and self._st[a].outstanding == 0]:
+                del self._st[a]
+            keep = set(self._st) | set(live)
+        # Series staleness: an evicted address must leave the exposition
+        # too, or a long-lived router on a churning fleet renders every
+        # dead pod's series forever — the pool gauges AND the backend-
+        # labeled rbg_slo_* verdicts the router's judgment minted. Swept
+        # against the registry's ACTUAL label values (not a drop list):
+        # a judgment that lands after its request's release — and so
+        # re-mints series for an address already pruned from _st — is
+        # caught by the next sweep instead of leaking permanently.
+        for name in _BACKEND_SERIES:
+            for a in REGISTRY.label_values(name, "backend") - keep:
+                REGISTRY.remove_series(name, backend=a)
 
     def snapshot(self) -> Dict[str, dict]:
         now = time.monotonic()
@@ -348,11 +384,18 @@ class RouterState:
     def __init__(self, registry: Registry, group: Optional[str],
                  static_backends: Optional[dict] = None,
                  token: Optional[str] = None,
-                 retry_budget: Optional[RetryBudget] = None):
+                 retry_budget: Optional[RetryBudget] = None,
+                 slo_targets: Optional[SLOTargets] = None):
         self.registry = registry
         self.group = group
         self.static = static_backends or {}
         self.pool = BackendPool()
+        # Router-level SLO judgment (obs/slo.py): TTFT/TPOT measured from
+        # the INGRESS arrival stamp — a retried or failed-over request is
+        # charged its full wait — aggregated per role and per backend
+        # into the health snapshot.
+        self.slo = SLOTracker(slo_targets or SLOTargets(),
+                              component="router")
         # Shared data-plane bearer token (VERDICT r4 #6): when set, clients
         # must present it and the router forwards it on every backend leg
         # (one trust domain edge-to-engine; health stays open for probes).
@@ -505,6 +548,7 @@ class RouterState:
                     break
                 self.metrics["retries"] += 1
             self.pool.acquire(addr)
+            t_dispatch = time.monotonic()
             try:
                 resp, rk, rv = request_once(addr, obj, k_bytes, v_bytes,
                                             timeout=timeout)
@@ -537,6 +581,12 @@ class RouterState:
             if i:
                 self.metrics["failovers"] += 1
             aspan.end(outcome="ok")
+            # Private timing stamp: when the SUCCESSFUL attempt was
+            # dispatched (monotonic). Callers pop it to anchor TTFT at
+            # ingress arrival — a backend-reported ttft_s alone restarts
+            # the clock on every failover attempt and under-reports.
+            if isinstance(resp, dict):
+                resp["_router_t_dispatch"] = t_dispatch
             return addr, resp, rk, rv
         if shed is not None:
             self.metrics["sheds_returned"] += 1
@@ -582,6 +632,18 @@ class Handler(socketserver.BaseRequestHandler):
                     resp["backends"] = state.pool.snapshot()
                     resp["draining_backends"] = state.pool.draining()
                     resp["retry_budget"] = state.retry_budget.snapshot()
+                    # Measured SLO attainment from THIS router's vantage
+                    # (ingress-anchored TTFT): per role and per backend,
+                    # 60 s window — the agg↔disagg switcher's decision
+                    # input.
+                    resp["slo"] = {
+                        "targets": state.slo.targets.as_dict(),
+                        "judged_total": state.slo.judged_total(),
+                        "per_role": state.slo.attainment(
+                            60.0, group_by=("role",)),
+                        "per_backend": state.slo.attainment(
+                            60.0, group_by=("backend",)),
+                    }
                 self._send_client(resp)
                 continue
             if op in ("embed", "generate") and not state.authorized(obj):
@@ -593,6 +655,10 @@ class Handler(socketserver.BaseRequestHandler):
                 self._send_client({"error": f"bad timeout_s: {e}",
                                    "done": True})
                 continue
+            # Ingress arrival stamp (the PR-2 deadline's sibling): TTFT is
+            # measured from HERE — spanning queueing, the prefill leg, and
+            # every failover attempt — never restarted per attempt.
+            t_arrival = time.monotonic()
             # The router continues the edge's trace context — or IS the
             # ingress (head sampling) when clients hit it directly. The
             # incoming context is consumed here; every downstream leg gets
@@ -610,6 +676,7 @@ class Handler(socketserver.BaseRequestHandler):
                 except Exception as e:
                     state.metrics["errors"] += 1
                     resp = {"error": f"embed: {e}"}
+                resp.pop("_router_t_dispatch", None)
                 rspan.end(outcome=resp.get("code") or
                           ("error" if "error" in resp else "ok"))
                 self._send_client(resp)
@@ -621,9 +688,11 @@ class Handler(socketserver.BaseRequestHandler):
             try:
                 with trace.use_span(rspan):
                     if obj.get("stream"):
-                        self._generate_stream(state, obj, deadline)
+                        self._generate_stream(state, obj, deadline,
+                                              t_arrival)
                     else:
-                        resp = self._generate(state, obj, deadline)
+                        resp = self._generate(state, obj, deadline,
+                                              t_arrival)
                         self._send_client(resp)
             except _ClientGone:
                 rspan.end(outcome="client_gone")
@@ -667,10 +736,13 @@ class Handler(socketserver.BaseRequestHandler):
     def _route(self, state: RouterState, obj: dict, deadline: float):
         """Resolve the final leg shared by blocking and streaming paths.
         PD mode runs the (always blocking, failover-wrapped) prefill hop
-        here; returns (role, (header, k_bytes, v_bytes), affinity_prompt)
-        for the leg the caller owns — the caller can re-send that payload
-        to any sibling of ``role`` (decode failover), and the affinity
-        prompt (None on cache-less legs) steers cache-aware ordering."""
+        here; returns (role, (header, k_bytes, v_bytes), affinity_prompt,
+        t_first) for the leg the caller owns — the caller can re-send
+        that payload to any sibling of ``role`` (decode failover), the
+        affinity prompt (None on cache-less legs) steers cache-aware
+        ordering, and ``t_first`` (PD only, else None) is the monotonic
+        instant the prefill hop returned: the FIRST TOKEN exists from
+        then on, so PD TTFT ends here, not when decode completes."""
         state.metrics["requests"] += 1
         obj = self._pin_seed(obj)
         if state.pd_mode():
@@ -692,6 +764,8 @@ class Handler(socketserver.BaseRequestHandler):
             _, hdr, kb, vb = state.call("prefill", pf_req,
                                         prompt=obj.get("prompt"),
                                         deadline=deadline)
+            hdr.pop("_router_t_dispatch", None)
+            t_first = time.monotonic()
             if "error" in hdr:
                 raise RuntimeError(f"prefill failed: {hdr}")
             state.metrics["kv_bytes_routed"] += len(kb or b"") + len(vb or b"")
@@ -705,24 +779,43 @@ class Handler(socketserver.BaseRequestHandler):
                 if key in obj:
                     fwd[key] = obj[key]
             # Decode replicas hold no prefix cache — no affinity prompt.
-            return "decode", (fwd, kb, vb), None
-        return state.worker_role(), (obj, None, None), obj.get("prompt")
+            return "decode", (fwd, kb, vb), None, t_first
+        return state.worker_role(), (obj, None, None), obj.get("prompt"), None
 
-    def _generate(self, state: RouterState, obj: dict,
-                  deadline: float) -> dict:
-        t0 = time.perf_counter()
+    def _generate(self, state: RouterState, obj: dict, deadline: float,
+                  t_arrival: float) -> dict:
+        """Blocking generate. TTFT is anchored at the INGRESS arrival
+        stamp: PD requests end it when the prefill hop returns (the first
+        token exists then — decode time is NOT first-token time), unified
+        requests add the backend-reported ttft to the successful
+        attempt's dispatch offset (a failed-over request is charged the
+        attempts that preceded it, not just the winner's clock)."""
         pd = state.pd_mode()
-        role, payload, aff = self._route(state, obj, deadline)
-        _, resp, _, _ = state.call(role, *payload, prompt=aff,
-                                   deadline=deadline)
+        role, payload, aff, t_first = self._route(state, obj, deadline)
+        addr, resp, _, _ = state.call(role, *payload, prompt=aff,
+                                      deadline=deadline)
+        t_dispatch = resp.pop("_router_t_dispatch", None) \
+            if isinstance(resp, dict) else None
+        t_done = time.monotonic()
         if pd:
             if "error" in resp:
                 raise RuntimeError(f"decode failed: {resp}")
-            resp["ttft_s"] = time.perf_counter() - t0
+            resp["ttft_s"] = round(t_first - t_arrival, 6)
+        elif "error" not in resp and resp.get("ttft_s") is not None \
+                and t_dispatch is not None:
+            t_first = t_dispatch + float(resp["ttft_s"])
+            resp["ttft_s"] = round(t_first - t_arrival, 6)
+        else:
+            t_first = None
+        if "error" not in resp and t_first is not None:
+            n = len(resp.get("tokens") or ())
+            tpot = ((t_done - t_first) / (n - 1)) if n > 1 else 0.0
+            state.slo.judge(t_first - t_arrival, tpot,
+                            role=role, backend=addr)
         return resp
 
     def _generate_stream(self, state: RouterState, obj: dict,
-                         deadline: float) -> None:
+                         deadline: float, t_arrival: float) -> None:
         """Streaming generate with mid-stream failover: relay incremental
         token frames from the backend to the client (feeds the SSE front
         end). PD mode streams the decode leg; the prefill leg is one
@@ -736,11 +829,16 @@ class Handler(socketserver.BaseRequestHandler):
         (overloaded / draining — always before any token) is routed
         around without eviction; a spent deadline ends the request with a
         structured frame instead of another doomed attempt."""
-        role, payload, aff = self._route(state, obj, deadline)
+        role, payload, aff, t_first = self._route(state, obj, deadline)
         akey = PrefixAffinity.key(aff)
         rspan = trace.current()
         kv_bytes = len(payload[1] or b"") + len(payload[2] or b"")
         delivered = 0                  # tokens already relayed to the client
+        # SLO timing across attempts: t_first (PD: set by the prefill hop
+        # above; unified: the first relayed token frame) survives
+        # failover — the replay skips already-delivered tokens, so the
+        # client's first token stays the one the clock stopped on.
+        timing = {"t_first": t_first}
         last: Optional[Exception] = None
         shed: Optional[dict] = None
         for attempt in range(MAX_ATTEMPTS):
@@ -773,7 +871,8 @@ class Handler(socketserver.BaseRequestHandler):
             state.pool.acquire(addr)
             try:
                 delivered, status, frame = self._relay_attempt(
-                    addr, attempt_payload, delivered, deadline)
+                    addr, attempt_payload, delivered, deadline,
+                    timing=timing)
             finally:
                 state.pool.release(addr)
             if status == "done":
@@ -782,6 +881,15 @@ class Handler(socketserver.BaseRequestHandler):
                 if attempt:
                     state.metrics["failovers"] += 1
                 aspan.end(outcome="ok", delivered=delivered)
+                # frame is None on a CLEAN stream completion; an
+                # application-error passthrough carries its frame and is
+                # not a finished request — never judged.
+                if timing["t_first"] is not None and frame is None:
+                    t_done = time.monotonic()
+                    tpot = ((t_done - timing["t_first"]) / (delivered - 1)
+                            if delivered > 1 else 0.0)
+                    state.slo.judge(timing["t_first"] - t_arrival, tpot,
+                                    role=role, backend=addr)
                 return
             if status == "rejected":
                 # Healthy backend refused the attempt (shed before any
@@ -814,19 +922,23 @@ class Handler(socketserver.BaseRequestHandler):
             raise _ClientGone(str(e)) from e
 
     def _relay_attempt(self, addr: str, payload, delivered: int,
-                       deadline: Optional[float] = None):
+                       deadline: Optional[float] = None,
+                       timing: Optional[dict] = None):
         """One streaming attempt against ``addr``. Relays frames to the
         client, skipping the first ``delivered`` tokens (already sent by a
         previous attempt — deterministic replay makes them identical).
-        Returns (new_delivered, status, frame): status "done" (stream
-        completed or application error passed through), "died" (transport
-        failure — the tokens relayed before it are never lost from the
-        count, so the retry skips them instead of duplicating), or
-        "rejected" (a structured shed frame, returned for the caller's
-        route-around logic instead of being surfaced). Client-side send
-        failures raise _ClientGone, which aborts the request without
-        charging the backend. ``deadline`` re-arms the per-recv timeout
-        from the remaining budget and forwards it to the backend."""
+        Returns (new_delivered, status, frame): status "done" with a None
+        frame (stream completed cleanly), "done" with the error frame (an
+        application error passed through — not a finished request),
+        "died" (transport failure — the tokens relayed before it are
+        never lost from the count, so the retry skips them instead of
+        duplicating), or "rejected" (a structured shed frame, returned
+        for the caller's route-around logic instead of being surfaced).
+        Client-side send failures raise _ClientGone, which aborts the
+        request without charging the backend. ``deadline`` re-arms the
+        per-recv timeout from the remaining budget and forwards it to the
+        backend. ``timing`` (when given) gets ``t_first`` stamped the
+        instant the first NEW token reaches the client — SLO TTFT input."""
         host, port = addr.rsplit(":", 1)
         skip = delivered
         try:
@@ -868,9 +980,10 @@ class Handler(socketserver.BaseRequestHandler):
                             # the caller routes around / ends the request.
                             return delivered, "rejected", frame
                         # Application error — not a transport failure; the
-                        # engine is healthy and answered. Pass through.
+                        # engine is healthy and answered. Pass through
+                        # (frame returned so the caller skips SLO judgment).
                         self._send_client(frame)
-                        return delivered, "done", None
+                        return delivered, "done", frame
                     tokens = frame.get("tokens") or []
                     drop = min(skip, len(tokens))
                     if drop:
@@ -882,6 +995,9 @@ class Handler(socketserver.BaseRequestHandler):
                         tokens = frame["tokens"]
                     if tokens or frame.get("done"):
                         self._send_client(frame)
+                        if (tokens and timing is not None
+                                and timing.get("t_first") is None):
+                            timing["t_first"] = time.monotonic()
                         delivered += len(tokens)
                     if frame.get("done"):
                         return delivered, "done", None
@@ -930,6 +1046,13 @@ def main(argv=None) -> int:
                          "negative = unbounded")
     ap.add_argument("--retry-burst", type=float, default=32.0,
                     help="retry budget burst size (bucket capacity)")
+    ap.add_argument("--slo-ttft-s", type=float, default=2.0,
+                    help="TTFT target for router-side SLO judgment "
+                         "(ingress-anchored; health carries per-role and "
+                         "per-backend attainment; 0 disables)")
+    ap.add_argument("--slo-tpot-s", type=float, default=0.5,
+                    help="per-output-token latency target for router-side "
+                         "SLO judgment (0 disables)")
     args = ap.parse_args(argv)
     port = int(os.environ.get("RBG_SERVE_PORT")
                or os.environ.get("RBG_PORT_SERVE") or args.port)
@@ -939,7 +1062,12 @@ def main(argv=None) -> int:
                          burst=args.retry_burst)
     server.state = RouterState(Registry(args.registry), args.group, static,
                                token=args.auth_token or None,
-                               retry_budget=budget)
+                               retry_budget=budget,
+                               slo_targets=SLOTargets(
+                                   ttft_s=args.slo_ttft_s,
+                                   tpot_s=args.slo_tpot_s))
+    from rbg_tpu.obs import timeseries
+    timeseries.ensure_started()
     start_prober(server.state)
     print(f"router listening on 127.0.0.1:{port} group={args.group}", flush=True)
     server.serve_forever()

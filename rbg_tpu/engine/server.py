@@ -62,6 +62,8 @@ def build_config(args) -> EngineConfig:
         spec_ngram=args.spec_ngram,
         grammar_table=args.grammar_table,
         grammar_state_budget=args.grammar_state_budget,
+        slo_ttft_s=args.slo_ttft_s,
+        slo_tpot_s=args.slo_tpot_s,
     )
 
 
@@ -197,7 +199,7 @@ class Handler(socketserver.BaseRequestHandler):
         the op's duration so the service queue/scan spans and the PD
         KV-handoff span parent under it."""
         op = obj.get("op")
-        if srv.auth_token and op != "metrics":
+        if srv.auth_token and op not in ("metrics", "slo"):
             # Data-plane token gate (VERDICT r4 #6): prefill/decode_bundle
             # carry KV activations, generate carries prompts — none of it
             # for unauthenticated peers. health (above) stays open for
@@ -206,6 +208,14 @@ class Handler(socketserver.BaseRequestHandler):
             if not token_ok(obj.get("token"), srv.auth_token):
                 send_msg(self.request, {"error": "unauthorized"})
                 return
+        if op == "slo":
+            # Operator pull of SLO attainment + windowed signals (the
+            # serving-plane sibling of the admin `slo` op; numbers only,
+            # so it stays scrape-open like `metrics`). Same clamped-
+            # response contract as `traces`.
+            from rbg_tpu.obs.slo import slo_response
+            send_msg(self.request, slo_response(obj.get("window")))
+            return
         if op == "traces":
             # Operator pull of the trace sink (the serving-plane sibling of
             # the admin `traces` op): recent + slowest ring buffers, the
@@ -515,6 +525,12 @@ def serve(args) -> None:
             process_id=int(os.environ["RBG_JAX_PROCESS_ID"]),
         )
 
+    # Windowed-signal sampler (obs/timeseries.py): the `slo` data op and
+    # `rbg-tpu top` read rates/means over its ring buffer — start it with
+    # the process so the first operator pull already has history.
+    from rbg_tpu.obs import timeseries
+    timeseries.ensure_started()
+
     server = EngineServer(("127.0.0.1", port), Handler)
     server.mode = cfg.mode
     server.service = server.prefill = server.decode = None
@@ -680,6 +696,14 @@ def main(argv=None) -> int:
                     help="max token-level automaton states per grammar "
                          "table (S x V x 5 bytes each); grammars over "
                          "budget fall back to the host-synced path")
+    ap.add_argument("--slo-ttft-s", type=float, default=2.0,
+                    help="per-request TTFT target the serving loop judges "
+                         "every finished request against (rbg_slo_* "
+                         "attainment/goodput series; 0 disables the "
+                         "dimension)")
+    ap.add_argument("--slo-tpot-s", type=float, default=0.5,
+                    help="per-output-token latency target (time per token "
+                         "after the first; 0 disables the dimension)")
     ap.add_argument("--max-queue", type=int, default=256,
                     help="admission-control bound on the service queue: "
                          "submissions past it are shed with a structured "

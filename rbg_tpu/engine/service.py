@@ -24,6 +24,7 @@ from rbg_tpu.engine.protocol import (CODE_DEADLINE, DeadlineExceeded,
                                      Overloaded, Rejected)
 from rbg_tpu.obs import names, trace
 from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.obs.slo import SLOTargets, SLOTracker
 from rbg_tpu.utils.locktrace import named_lock
 from rbg_tpu.utils.racetrace import guard as _race_guard
 
@@ -137,9 +138,20 @@ class _BatchService:
     abandoned work never burns device steps."""
 
     engine: Engine
+    # Role label the SLO judgments carry (per-role attainment aggregates
+    # over it); DecodeService overrides.
+    slo_role = "unified"
 
     def __init__(self, max_queue: Optional[int] = None):
         self.max_queue = max_queue
+        # Per-request SLO judgment at finish (obs/slo.py): targets come
+        # from the engine config; one judgment per FINISHED request —
+        # blocking and streaming both finish through the loop below, so
+        # this is the single site (the slo_accounted invariant).
+        cfg = self.engine.cfg
+        self.slo = SLOTracker(
+            SLOTargets(ttft_s=cfg.slo_ttft_s, tpot_s=cfg.slo_tpot_s),
+            component=type(self).__name__.lower())
         # guarded_by[engine.service_queue]
         self.counters = {"shed_total": 0, "deadline_queue_drops": 0,
                          "deadline_running_aborts": 0}
@@ -331,6 +343,7 @@ class _BatchService:
         out["queue_depth"] = depth
         out["max_queue"] = self.max_queue
         out["estimated_wait_s"] = round(est, 4) if est is not None else None
+        out["slo_judged_total"] = self.slo.judged_total()
         return out
 
     def cancel(self, pending: _Pending) -> None:
@@ -384,6 +397,28 @@ class _BatchService:
                             tokens=len(p.tokens))
             p.done.set()
 
+    def _judge_finished(self, pending: _Pending, t_done: float) -> None:
+        """SLO-judge ONE finished request (loop thread). TTFT measures
+        submission → first token; TPOT is the mean per-token latency
+        after the first (0 for single-token outputs — trivially met).
+        Every finished request passes here exactly once, and only
+        finished requests do (deadline aborts, cancels, and admit errors
+        are accounted under their own counters, not judged)."""
+        n = len(pending.tokens)
+        if pending.t_first is not None:
+            ttft = pending.t_first - pending.t_submit
+            tpot = ((t_done - pending.t_first) / (n - 1)) if n > 1 else 0.0
+        else:
+            # Finished without a streamed token (e.g. a decode bundle
+            # completed at inject): its whole stay is the TTFT.
+            ttft = t_done - pending.t_submit
+            tpot = 0.0
+        self.slo.judge(ttft, tpot, role=self.slo_role)
+        svc = type(self).__name__.lower()
+        REGISTRY.inc(names.SERVING_REQUESTS_FINISHED_TOTAL, service=svc)
+        if n:
+            REGISTRY.inc(names.SERVING_TOKENS_TOTAL, float(n), service=svc)
+
     def _loop(self):
         eng = self.engine
         while not self._stopped:
@@ -433,6 +468,7 @@ class _BatchService:
                     continue
                 if rid is None:
                     scan.end(outcome="done_at_admit")
+                    self._judge_finished(pending, time.perf_counter())
                     pending.done.set()  # completed at admission
                     self._done_times.append(time.monotonic())
                     continue
@@ -483,11 +519,13 @@ class _BatchService:
                 if ev.finished:
                     pending.span_scan.end(outcome="ok",
                                           tokens=len(pending.tokens))
+                    t_done = time.perf_counter()
                     REGISTRY.observe(
                         names.SERVING_REQUEST_DURATION_SECONDS,
-                        time.perf_counter() - pending.t_submit,
+                        t_done - pending.t_submit,
                         exemplar=pending.span_scan.trace_id or None,
                         service=type(self).__name__.lower())
+                    self._judge_finished(pending, t_done)
                     pending.done.set()
                     del self._pending[ev.request_id]
                     # Completion history feeds the estimated-wait gate.
@@ -532,6 +570,8 @@ class EngineService(_BatchService):
 class DecodeService(_BatchService):
     """Disaggregated decode role: KV bundles from many router connections
     decode TOGETHER on the device instead of serializing per connection."""
+
+    slo_role = "decode"
 
     def __init__(self, cfg, params=None, mesh=None,
                  max_queue: Optional[int] = None):

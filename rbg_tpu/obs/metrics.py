@@ -200,6 +200,47 @@ class Registry:
             return line
         return f'{line} # {{trace_id="{ex[1]}"}} {ex[0]}'
 
+    def snapshot_values(self):
+        """Point-in-time copies for the time-series sampler
+        (obs/timeseries.py): ``(counters, gauges, hists)`` keyed by
+        ``(name, sorted_label_tuple)``; histogram series are reduced to
+        ``(sum, count)`` pairs so windowed means cost two counter deltas."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: (h[1], h[2]) for k, h in self._hist.items()}
+        return counters, gauges, hists
+
+    def label_values(self, name: str, label: str) -> set:
+        """Distinct values one label takes across every live series of a
+        family — what a staleness sweep diffs against its live set."""
+        out = set()
+        with self._lock:
+            for store in (self._counters, self._gauges, self._hist):
+                for key in store:
+                    if key[0] == name:
+                        v = dict(key[1]).get(label)
+                        if v is not None:
+                            out.add(v)
+        return out
+
+    def remove_series(self, name: str, **labels) -> int:
+        """Drop every series of ``name`` whose labels include ``labels``
+        (label-scoped reset; no labels = the whole family). Evicting a
+        backend must take its per-backend gauges out of the exposition —
+        a dead address otherwise renders forever. Returns the number of
+        series removed."""
+        want = set(labels.items())
+        removed = 0
+        with self._lock:
+            for store in (self._counters, self._gauges, self._hist):
+                dead = [k for k in store
+                        if k[0] == name and want.issubset(set(k[1]))]
+                for k in dead:
+                    del store[k]
+                removed += len(dead)
+        return removed
+
     def reset(self):
         with self._lock:
             self._counters.clear()

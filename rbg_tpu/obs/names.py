@@ -41,12 +41,23 @@ RACE_CHECKED_TOTAL = "rbg_race_checked_total"
 RACE_VIOLATIONS_TOTAL = "rbg_race_violations_total"
 TRACE_TRACES_TOTAL = "rbg_trace_traces_total"
 TRACE_SPANS_DROPPED_TOTAL = "rbg_trace_spans_dropped_total"
+SERVING_REQUESTS_FINISHED_TOTAL = "rbg_serving_requests_finished_total"
+SERVING_TOKENS_TOTAL = "rbg_serving_tokens_total"
+SLO_JUDGED_TOTAL = "rbg_slo_judged_total"
+SLO_TTFT_MET_TOTAL = "rbg_slo_ttft_met_total"
+SLO_TPOT_MET_TOTAL = "rbg_slo_tpot_met_total"
+SLO_GOODPUT_TOTAL = "rbg_slo_goodput_total"
 
 # ---- gauges (last-write-wins) ----
 
 SERVING_DRAINING = "rbg_serving_draining"
 DISRUPTION_SPARE_POOL_DEPTH = "rbg_disruption_spare_pool_depth"
 RACE_GUARDED_CLASSES = "rbg_race_guarded_classes"
+SLO_TTFT_ATTAINMENT = "rbg_slo_ttft_attainment"
+SLO_TPOT_ATTAINMENT = "rbg_slo_tpot_attainment"
+SLO_GOODPUT_RPS = "rbg_slo_goodput_rps"
+ROUTER_BACKEND_OUTSTANDING = "rbg_router_backend_outstanding"
+ROUTER_BACKEND_DRAINING = "rbg_router_backend_draining"
 
 # ---- histograms ----
 
@@ -55,6 +66,8 @@ SERVING_QUEUE_DEPTH = "rbg_serving_queue_depth"
 SERVING_REQUEST_DURATION_SECONDS = "rbg_serving_request_duration_seconds"
 SERVING_BATCH_OCCUPANCY = "rbg_serving_batch_occupancy"
 SERVING_JOIN_LATENCY_SECONDS = "rbg_serving_join_latency_seconds"
+SLO_TTFT_SECONDS = "rbg_slo_ttft_seconds"
+SLO_TPOT_SECONDS = "rbg_slo_tpot_seconds"
 
 # ---- catalog sets (consumed by the lint rule and strict-mode registry) ----
 
@@ -76,12 +89,23 @@ COUNTERS = frozenset({
     RACE_VIOLATIONS_TOTAL,
     TRACE_TRACES_TOTAL,
     TRACE_SPANS_DROPPED_TOTAL,
+    SERVING_REQUESTS_FINISHED_TOTAL,
+    SERVING_TOKENS_TOTAL,
+    SLO_JUDGED_TOTAL,
+    SLO_TTFT_MET_TOTAL,
+    SLO_TPOT_MET_TOTAL,
+    SLO_GOODPUT_TOTAL,
 })
 
 GAUGES = frozenset({
     SERVING_DRAINING,
     DISRUPTION_SPARE_POOL_DEPTH,
     RACE_GUARDED_CLASSES,
+    SLO_TTFT_ATTAINMENT,
+    SLO_TPOT_ATTAINMENT,
+    SLO_GOODPUT_RPS,
+    ROUTER_BACKEND_OUTSTANDING,
+    ROUTER_BACKEND_DRAINING,
 })
 
 HISTOGRAMS = frozenset({
@@ -90,6 +114,8 @@ HISTOGRAMS = frozenset({
     SERVING_REQUEST_DURATION_SECONDS,
     SERVING_BATCH_OCCUPANCY,
     SERVING_JOIN_LATENCY_SECONDS,
+    SLO_TTFT_SECONDS,
+    SLO_TPOT_SECONDS,
 })
 
 ALL_NAMES = COUNTERS | GAUGES | HISTOGRAMS
@@ -131,6 +157,29 @@ HELP = {
     SERVING_JOIN_LATENCY_SECONDS:
         "Wait between entering the engine queue and joining the running "
         "batch",
+    SERVING_REQUESTS_FINISHED_TOTAL:
+        "Requests that finished generation (the SLO-judged population)",
+    SERVING_TOKENS_TOTAL: "Output tokens produced by finished requests",
+    SLO_JUDGED_TOTAL: "Finished requests judged against the SLO targets",
+    SLO_TTFT_MET_TOTAL: "Judged requests whose TTFT met its target",
+    SLO_TPOT_MET_TOTAL: "Judged requests whose TPOT met its target",
+    SLO_GOODPUT_TOTAL:
+        "Judged requests meeting BOTH the TTFT and TPOT targets",
+    SLO_TTFT_ATTAINMENT:
+        "Sliding-window fraction of judged requests meeting the TTFT "
+        "target",
+    SLO_TPOT_ATTAINMENT:
+        "Sliding-window fraction of judged requests meeting the TPOT "
+        "target",
+    SLO_GOODPUT_RPS:
+        "Sliding-window requests/s meeting both SLO targets",
+    ROUTER_BACKEND_OUTSTANDING:
+        "In-flight requests the router holds against one backend",
+    ROUTER_BACKEND_DRAINING: "1 while the router sees this backend draining",
+    SLO_TTFT_SECONDS: "Time to first token of judged requests",
+    SLO_TPOT_SECONDS:
+        "Per-output-token latency after the first token, per judged "
+        "request",
 }
 
 # ---- span names (obs/trace.py) ----

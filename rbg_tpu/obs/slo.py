@@ -1,0 +1,286 @@
+"""Per-request SLO judgment: TTFT / TPOT targets, sliding-window
+attainment, and goodput.
+
+The serving plane measured TTFT and per-token latency per request and
+threw both to the client — nothing ever asked "did that request MEET its
+target?". This module closes the loop: every finished request is judged
+ONCE against configurable targets (``SLOTargets``: seconds to first
+token, seconds per output token after the first; a 0 target disables
+that dimension — it always counts as met), the verdicts land in the
+``rbg_slo_*`` registry series (counters for scrape pipelines, histograms
+for quantiles), and a bounded in-process event window answers the
+control-plane questions directly: attainment fractions and **goodput**
+(requests/s meeting BOTH targets) over 10/60/300 s windows. "Taming the
+Chaos" scales heterogeneous pools off exactly these signals; the
+PD-aggregation paper flips agg↔disagg on measured attainment — both
+ROADMAP items consume this API.
+
+Judgment sites: ``_BatchService`` (engine side, streaming and blocking —
+one judgment per finished request, the ``slo_accounted`` invariant), and
+the router (per-role / per-backend attainment from the ingress-stamped
+arrival, so retried and failed-over requests are charged their full
+wait).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from rbg_tpu.obs import names
+from rbg_tpu.obs.metrics import REGISTRY
+# ONE set of standard windows: tracker snapshot keys ("10s"/"60s"/"300s")
+# and the sampler's signal windows must stay in lockstep or operator
+# surfaces (rbg-tpu top --window) silently stop matching snapshot keys.
+from rbg_tpu.obs.timeseries import WINDOWS_S
+from rbg_tpu.utils.locktrace import named_lock
+
+DEFAULT_TTFT_S = 2.0
+DEFAULT_TPOT_S = 0.5
+# Per-tracker event bound: 300 s of judgments at ~13 req/s. Attainment is
+# a windowed signal — evicting the tail only shortens the oldest window.
+_MAX_EVENTS = 4096
+# Gauges are published for this window on every snapshot().
+_GAUGE_WINDOW_S = 60.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOTargets:
+    """Per-request targets. ``ttft_s``: seconds to first token;
+    ``tpot_s``: seconds per output token after the first. 0 disables a
+    dimension (it always judges as met)."""
+
+    ttft_s: float = DEFAULT_TTFT_S
+    tpot_s: float = DEFAULT_TPOT_S
+
+    def as_dict(self) -> dict:
+        return {"ttft_s": self.ttft_s, "tpot_s": self.tpot_s}
+
+    def verdict(self, ttft_s, tpot_s) -> Tuple[bool, bool]:
+        """THE met-rules, side-effect free: (ttft_ok, tpot_ok). A
+        disabled dimension (target <= 0) is always met; a missing
+        measurement (None) fails an ENABLED dimension — the one place
+        these semantics live (tracker, router, bench all call here)."""
+        ttft_ok = self.ttft_s <= 0 or (ttft_s is not None
+                                       and ttft_s <= self.ttft_s)
+        tpot_ok = self.tpot_s <= 0 or (tpot_s is not None
+                                       and tpot_s <= self.tpot_s)
+        return ttft_ok, tpot_ok
+
+
+class _Event:
+    __slots__ = ("t", "labels", "ttft_ok", "tpot_ok")
+
+    def __init__(self, t, labels, ttft_ok, tpot_ok):
+        self.t = t
+        self.labels = labels
+        self.ttft_ok = ttft_ok
+        self.tpot_ok = tpot_ok
+
+
+class SLOTracker:
+    """One judgment stream (a service, a router). ``judge()`` records the
+    verdict + registry series; ``attainment()`` / ``snapshot()`` answer
+    windowed fractions and goodput, optionally grouped by a label
+    ("role", "backend")."""
+
+    def __init__(self, targets: Optional[SLOTargets] = None,
+                 component: str = "service", register: bool = True):
+        self.targets = targets or SLOTargets()
+        self.component = component
+        self._lock = named_lock("obs.slo")
+        self._events = collections.deque(maxlen=_MAX_EVENTS)  # guarded_by[obs.slo]
+        self._judged = 0          # guarded_by[obs.slo]
+        self._met = [0, 0, 0]     # guarded_by[obs.slo] (ttft, tpot, both)
+        if register:
+            register_tracker(self)
+
+    # -- judgment --
+
+    def judge(self, ttft_s: float, tpot_s: float, **labels) -> dict:
+        """Judge ONE finished request. Returns the verdict dict; publishes
+        the rbg_slo_* counter/histogram series labeled with ``labels`` +
+        this tracker's component."""
+        ttft_ok, tpot_ok = self.targets.verdict(ttft_s, tpot_s)
+        both = ttft_ok and tpot_ok
+        ev = _Event(time.monotonic(), tuple(sorted(labels.items())),
+                    ttft_ok, tpot_ok)
+        with self._lock:
+            self._events.append(ev)
+            self._judged += 1
+            self._met[0] += ttft_ok
+            self._met[1] += tpot_ok
+            self._met[2] += both
+        lbl = dict(labels, component=self.component)
+        REGISTRY.inc(names.SLO_JUDGED_TOTAL, **lbl)
+        if ttft_ok:
+            REGISTRY.inc(names.SLO_TTFT_MET_TOTAL, **lbl)
+        if tpot_ok:
+            REGISTRY.inc(names.SLO_TPOT_MET_TOTAL, **lbl)
+        if both:
+            REGISTRY.inc(names.SLO_GOODPUT_TOTAL, **lbl)
+        REGISTRY.observe(names.SLO_TTFT_SECONDS, ttft_s, **lbl)
+        REGISTRY.observe(names.SLO_TPOT_SECONDS, tpot_s, **lbl)
+        return {"ttft_ok": ttft_ok, "tpot_ok": tpot_ok, "goodput": both}
+
+    def judged_total(self) -> int:
+        with self._lock:
+            return self._judged
+
+    def totals(self) -> dict:
+        """Lifetime verdict counts (bounded only by int width — these are
+        counters, not the event window)."""
+        with self._lock:
+            judged, (ttft, tpot, both) = self._judged, tuple(self._met)
+        return {"judged": judged, "ttft_met": ttft, "tpot_met": tpot,
+                "goodput": both}
+
+    # -- windows --
+
+    @staticmethod
+    def _frac(num: int, den: int) -> Optional[float]:
+        return round(num / den, 4) if den else None
+
+    def attainment(self, window_s: float = 60.0,
+                   group_by: Optional[Iterable[str]] = None,
+                   now: Optional[float] = None) -> Dict[str, dict]:
+        """Windowed attainment, grouped by the given label names (or one
+        ``"all"`` group). Each group carries judged count, ttft/tpot
+        attainment fractions (None when nothing was judged), and
+        goodput_rps over the window."""
+        anchor = time.monotonic() if now is None else now
+        cutoff = anchor - window_s
+        keys = tuple(group_by or ())
+        with self._lock:
+            events = [e for e in self._events if e.t >= cutoff]
+        groups: Dict[str, List[_Event]] = {}
+        for e in events:
+            if keys:
+                lbl = dict(e.labels)
+                gk = ",".join(f"{k}={lbl.get(k, '')}" for k in keys)
+            else:
+                gk = "all"
+            groups.setdefault(gk, []).append(e)
+        out = {}
+        for gk, evs in sorted(groups.items()):
+            n = len(evs)
+            good = sum(1 for e in evs if e.ttft_ok and e.tpot_ok)
+            out[gk] = {
+                "judged": n,
+                "ttft_attainment": self._frac(
+                    sum(1 for e in evs if e.ttft_ok), n),
+                "tpot_attainment": self._frac(
+                    sum(1 for e in evs if e.tpot_ok), n),
+                "goodput_attainment": self._frac(good, n),
+                "goodput_rps": round(good / window_s, 4),
+            }
+        return out
+
+    def snapshot(self, windows: Tuple[float, ...] = WINDOWS_S,
+                 group_by: Optional[Iterable[str]] = None,
+                 now: Optional[float] = None) -> dict:
+        """Targets + totals + per-window attainment; publishes the 60 s
+        overall attainment/goodput gauges for scrape pipelines."""
+        out = {
+            "component": self.component,
+            "targets": self.targets.as_dict(),
+            "totals": self.totals(),
+            "windows": {f"{int(w)}s": self.attainment(w, group_by=group_by,
+                                                      now=now)
+                        for w in windows},
+        }
+        overall = self.attainment(_GAUGE_WINDOW_S, now=now).get("all")
+        if overall:
+            if overall["ttft_attainment"] is not None:
+                REGISTRY.set_gauge(names.SLO_TTFT_ATTAINMENT,
+                                   overall["ttft_attainment"],
+                                   component=self.component)
+            if overall["tpot_attainment"] is not None:
+                REGISTRY.set_gauge(names.SLO_TPOT_ATTAINMENT,
+                                   overall["tpot_attainment"],
+                                   component=self.component)
+            REGISTRY.set_gauge(names.SLO_GOODPUT_RPS,
+                               overall["goodput_rps"],
+                               component=self.component)
+        return out
+
+
+# ---- process-wide tracker registry -----------------------------------------
+#
+# The operator surface (admin `slo` op, engine-server `slo` data op, the
+# stress reports) pulls every live tracker in-process. Bounded: only the
+# newest _MAX_TRACKERS survive — a test suite churning services must not
+# accumulate dead trackers forever.
+
+_MAX_TRACKERS = 16
+_TRACKERS: List[SLOTracker] = []
+_REG_LOCK = threading.Lock()
+
+
+def register_tracker(tracker: SLOTracker) -> None:
+    with _REG_LOCK:
+        _TRACKERS.append(tracker)
+        del _TRACKERS[:-_MAX_TRACKERS]
+
+
+def trackers() -> List[SLOTracker]:
+    with _REG_LOCK:
+        return list(_TRACKERS)
+
+
+def reset_trackers() -> None:
+    with _REG_LOCK:
+        _TRACKERS.clear()
+
+
+def slo_response(window=None) -> dict:
+    """The operator ``slo`` op payload, shared by the admin plane and the
+    engine server (same clamped-response contract as ``traces_response``):
+    per-tracker attainment snapshots plus the windowed signals the
+    timeseries sampler holds. ``window`` (seconds) picks the headline
+    signals window; malformed input falls back to 60 and is clamped to
+    [1, 3600] — wire-facing, must not throw."""
+    from rbg_tpu.obs import timeseries
+    try:
+        w = float(window)
+    except (TypeError, ValueError):
+        w = 60.0
+    w = max(1.0, min(w, 3600.0))
+    sampler = timeseries.get_sampler()
+
+    def signals(window_s: float) -> dict:
+        def r(v, nd=4):
+            return round(v, nd) if v is not None else None
+        return {
+            "requests_per_s": r(sampler.rate(
+                names.SERVING_REQUESTS_FINISHED_TOTAL, window_s)),
+            "tokens_per_s": r(sampler.rate(
+                names.SERVING_TOKENS_TOTAL, window_s), 2),
+            "shed_per_s": r(sampler.rate(
+                names.SERVING_SHED_TOTAL, window_s)),
+            "deadline_exceeded_per_s": r(sampler.rate(
+                names.SERVING_DEADLINE_EXCEEDED_TOTAL, window_s)),
+            "goodput_per_s": r(sampler.rate(
+                names.SLO_GOODPUT_TOTAL, window_s)),
+            "queue_depth_mean": r(sampler.mean_observed(
+                names.SERVING_QUEUE_DEPTH, window_s), 2),
+            "occupancy_mean": r(sampler.mean_observed(
+                names.SERVING_BATCH_OCCUPANCY, window_s)),
+            "ttft_mean_s": r(sampler.mean_observed(
+                names.SLO_TTFT_SECONDS, window_s)),
+            "tpot_mean_s": r(sampler.mean_observed(
+                names.SLO_TPOT_SECONDS, window_s)),
+        }
+
+    return {
+        "window_s": w,
+        "sampler": sampler.stats(),
+        "signals": signals(w),
+        "signals_by_window": {f"{int(ws)}s": signals(ws)
+                              for ws in timeseries.WINDOWS_S},
+        "trackers": [t.snapshot(group_by=("role",))
+                     for t in trackers()],
+    }

@@ -1,0 +1,283 @@
+"""Windowed signals over the metrics Registry: a bounded ring-buffer
+sampler exposing ``rate`` / ``delta`` / ``mean_gauge`` / ``mean_observed``
+over sliding windows.
+
+The Registry holds cumulative counters and point-in-time gauges — enough
+for a scrape pipeline, useless for a control decision ("is goodput
+falling over the last 60 s?"). This module is the consumable in-process
+answer: a daemon sampler snapshots the registry every ``interval_s``
+seconds into a bounded ring (``retention_s`` worth of samples, oldest
+evicted), and the query API turns any cataloged series into a windowed
+number. The SLO plane (obs/slo.py), ``rbg-tpu top``, and the future
+autoscaler / agg↔disagg switcher (ROADMAP) all read THIS api — none of
+them re-derive windows from raw scrapes.
+
+Conventions:
+
+* windows are the standard ``WINDOWS_S`` (10 s / 60 s / 300 s) unless a
+  caller passes its own;
+* ``rbg_*`` names are validated against the obs/names.py catalog — the
+  lint discipline of PRs 4-6 carries into the query layer (a typo'd name
+  returns an error at the call site, not a silent 0.0);
+* counter queries sum over every series matching the given label SUBSET
+  (``rate(names.SLO_GOODPUT_TOTAL, 60, role="decode")`` sums all decode
+  series whatever their other labels);
+* counter resets (a restarted plane mid-window) follow the Prometheus
+  convention: a decrease reads as "reset to zero, then grew to the new
+  value", so the increase never goes negative.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.utils.locktrace import named_lock
+
+WINDOWS_S = (10.0, 60.0, 300.0)
+DEFAULT_INTERVAL_S = 2.0
+# Default retention covers the largest standard window plus one interval
+# of slack so the boundary sample is still in the ring.
+DEFAULT_RETENTION_S = 330.0
+
+
+def _check_name(name: str) -> None:
+    if name.startswith("rbg_"):
+        from rbg_tpu.obs import names as _names
+        if name not in _names.ALL_NAMES:
+            raise ValueError(
+                f"metric {name!r} is not cataloged in rbg_tpu/obs/names.py "
+                f"— windowed queries only serve registered names")
+
+
+def _match(key: Tuple[str, tuple], name: str, want: frozenset) -> bool:
+    return key[0] == name and want.issubset(set(key[1]))
+
+
+class TimeSeriesSampler:
+    """Periodic registry snapshots + windowed queries.
+
+    ``start()`` spawns the daemon sampling thread (idempotent);
+    ``stop()`` wakes and joins it. ``sample_now(now=...)`` takes one
+    snapshot synchronously — tests inject their own clock through it, so
+    window math is deterministic without sleeping."""
+
+    def __init__(self, registry=None, interval_s: float = DEFAULT_INTERVAL_S,
+                 retention_s: float = DEFAULT_RETENTION_S):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        if retention_s < interval_s:
+            raise ValueError("retention_s must be >= interval_s")
+        self.registry = registry if registry is not None else REGISTRY
+        self.interval_s = float(interval_s)
+        self.retention_s = float(retention_s)
+        maxlen = max(2, int(self.retention_s / self.interval_s) + 1)
+        # Ring of (t, counters, gauges, hists) snapshot tuples.
+        self._samples = collections.deque(maxlen=maxlen)  # guarded_by[obs.timeseries]
+        self._lock = named_lock("obs.timeseries")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --
+
+    def start(self) -> "TimeSeriesSampler":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="timeseries-sampler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10.0)
+        self._thread = None
+
+    def _loop(self) -> None:
+        # Seed one sample immediately so the first window query after
+        # start() has a baseline, then sample on the interval.
+        self.sample_now()
+        while not self._stop.wait(self.interval_s):
+            self.sample_now()
+
+    # -- sampling --
+
+    def sample_now(self, now: Optional[float] = None) -> None:
+        """Take one snapshot. ``now`` overrides the monotonic timestamp
+        (tests). Snapshot + timestamp + append happen under ONE critical
+        section: two concurrent callers (the daemon tick racing a
+        drill's closing sample) could otherwise append an older registry
+        copy after a newer one, which the reset-aware delta would read
+        as a counter restart and inflate the window by the cumulative
+        total. The registry lock nests inside ours and is a plain leaf
+        lock — no ordering hazard."""
+        with self._lock:
+            counters, gauges, hists = self.registry.snapshot_values()
+            t = time.monotonic() if now is None else float(now)
+            if self._samples and t < self._samples[-1][0]:
+                t = self._samples[-1][0]   # append order IS time order
+            self._samples.append((t, counters, gauges, hists))
+
+    def stats(self) -> dict:
+        with self._lock:
+            n = len(self._samples)
+            span = (self._samples[-1][0] - self._samples[0][0]) if n else 0.0
+        return {"samples": n, "interval_s": self.interval_s,
+                "retention_s": self.retention_s, "span_s": round(span, 3),
+                "running": bool(self._thread and self._thread.is_alive())}
+
+    # -- queries --
+
+    def _window(self, window_s: float, now: Optional[float]) -> List[tuple]:
+        """Samples covering the window, newest-anchored: everything at or
+        after ``cutoff`` plus the last sample BEFORE it (the baseline a
+        full-window delta measures against)."""
+        if window_s <= 0:
+            raise ValueError("window_s must be > 0")
+        with self._lock:
+            samples = list(self._samples)
+        if not samples:
+            return []
+        anchor = samples[-1][0] if now is None else float(now)
+        cutoff = anchor - window_s
+        # Interior = strictly inside the window; baseline = the newest
+        # sample AT or before the cutoff (a sample exactly on the
+        # boundary already carries the pre-window totals — adding an
+        # older one would silently widen the window).
+        inside = [s for s in samples if cutoff < s[0] <= anchor]
+        before = [s for s in samples if s[0] <= cutoff]
+        if before:
+            inside.insert(0, before[-1])
+        return inside
+
+    @staticmethod
+    def _increase(win: List[tuple], name: str, labels: dict, field: int,
+                  hist_part: Optional[int] = None):
+        """Summed monotonic increase across matching series over an
+        already-materialized window (None when fewer than two samples
+        cover it). ``field`` picks the snapshot store; ``hist_part``
+        picks sum/count out of a histogram pair. Callers pass the SAME
+        ``win`` for related queries (Δsum and Δcount of one histogram) so
+        a concurrent sampler tick cannot skew them apart."""
+        if len(win) < 2:
+            return None, None
+        want = frozenset(labels.items())
+        total = 0.0
+        prev: Dict[tuple, float] = {}
+        first = True
+        for sample in win:
+            store = sample[field]
+            seen = set()
+            for key, v in store.items():
+                if not _match(key, name, want):
+                    continue
+                if hist_part is not None:
+                    v = v[hist_part]
+                seen.add(key)
+                if key in prev:
+                    d = v - prev[key]
+                    # Reset: the counter restarted from zero and grew to
+                    # v — count v, never a negative delta.
+                    total += v if d < 0 else d
+                elif not first:
+                    # Series born mid-window: it went 0 -> v inside it.
+                    total += v
+                prev[key] = v
+            # A series that vanished (registry reset) restarts from its
+            # next appearance — drop its baseline so the reappearance is
+            # counted as a fresh birth, not diffed against stale state.
+            for key in [k for k in prev if k not in seen]:
+                del prev[key]
+            first = False
+        elapsed = win[-1][0] - win[0][0]
+        return total, elapsed
+
+    def delta(self, name: str, window_s: float, now: Optional[float] = None,
+              **labels) -> Optional[float]:
+        """Counter increase over the window (reset-aware), summed across
+        every series matching the label subset. None until two samples
+        cover the window."""
+        _check_name(name)
+        total, _ = self._increase(self._window(window_s, now), name,
+                                  labels, field=1)
+        return total
+
+    def rate(self, name: str, window_s: float, now: Optional[float] = None,
+             **labels) -> Optional[float]:
+        """Per-second counter rate over the window (delta / observed
+        sample span)."""
+        _check_name(name)
+        total, elapsed = self._increase(self._window(window_s, now), name,
+                                        labels, field=1)
+        if total is None or not elapsed or elapsed <= 0:
+            return None
+        return total / elapsed
+
+    def mean_gauge(self, name: str, window_s: float,
+                   now: Optional[float] = None, **labels) -> Optional[float]:
+        """Mean of the gauge over the window's samples; matching series
+        are summed per sample first (e.g. queue depth across services).
+        None when no sample in the window carries the series."""
+        _check_name(name)
+        want = frozenset(labels.items())
+        vals = []
+        for sample in self._window(window_s, now):
+            matched = [v for key, v in sample[2].items()
+                       if _match(key, name, want)]
+            if matched:
+                vals.append(sum(matched))
+        if not vals:
+            return None
+        return sum(vals) / len(vals)
+
+    def mean_observed(self, name: str, window_s: float,
+                      now: Optional[float] = None,
+                      **labels) -> Optional[float]:
+        """Mean VALUE observed into a histogram over the window:
+        Δsum / Δcount across matching series (reset-aware). The windowed
+        complement of ``Registry.quantile`` — mean occupancy, mean queue
+        depth at submission, mean TTFT. One window materialization feeds
+        both deltas, so a sampler tick between them cannot mismatch the
+        numerator's sample set against the denominator's."""
+        _check_name(name)
+        win = self._window(window_s, now)
+        dsum, _ = self._increase(win, name, labels, field=3, hist_part=0)
+        dcount, _ = self._increase(win, name, labels, field=3, hist_part=1)
+        if dsum is None or not dcount:
+            return None
+        return dsum / dcount
+
+
+# ---- process-wide default sampler ------------------------------------------
+
+_DEFAULT: Optional[TimeSeriesSampler] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_sampler() -> TimeSeriesSampler:
+    """The process-wide sampler over the global REGISTRY (created on
+    first use, NOT started — call :func:`ensure_started` or drive it with
+    ``sample_now()``). Knobs: ``RBG_TS_INTERVAL_S`` / ``RBG_TS_RETENTION_S``."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            interval = float(os.environ.get("RBG_TS_INTERVAL_S")
+                             or DEFAULT_INTERVAL_S)
+            retention = float(os.environ.get("RBG_TS_RETENTION_S")
+                              or DEFAULT_RETENTION_S)
+            _DEFAULT = TimeSeriesSampler(interval_s=interval,
+                                         retention_s=retention)
+        return _DEFAULT
+
+
+def ensure_started() -> TimeSeriesSampler:
+    """Start (idempotently) and return the process-wide sampler — what
+    serving processes and drills call once at boot."""
+    return get_sampler().start()

@@ -133,6 +133,13 @@ class _Handler(socketserver.BaseRequestHandler):
         if op == "metrics":
             from rbg_tpu.obs.metrics import REGISTRY
             return {"text": REGISTRY.render()}
+        if op == "slo":
+            # Operator pull of SLO attainment + windowed signals
+            # (obs/slo.py, same clamped-response contract as `traces`):
+            # per-tracker attainment/goodput snapshots plus rate/mean
+            # signals over the timeseries sampler's ring buffer.
+            from rbg_tpu.obs.slo import slo_response
+            return slo_response(obj.get("window"))
         if op == "traces":
             # Operator pull of the trace sink: recent + slowest-N ring
             # buffers, the slowest request's rendered waterfall, and the

@@ -49,9 +49,65 @@ r = json.load(open('/tmp/_t1_race.json'))
 t = r.get('trace') or {}
 assert t.get('waterfall'), 'slowest-trace waterfall is empty'
 assert r['invariants'].get('trace_complete'), 'trace_complete invariant red'
+assert r['invariants'].get('slo_accounted'), 'slo_accounted invariant red'
+assert (r.get('slo') or {}).get('judged', 0) > 0, 'no SLO judgments'
+assert 'goodput_rps' in (r.get('goodput_vs_throughput') or {}), \
+    'goodput-vs-throughput summary missing'
 "; then
-        echo "TIER1 TRACE SMOKE FAILED — empty waterfall or incomplete" \
-             "traces in /tmp/_t1_race.json" >&2
+        echo "TIER1 TRACE/SLO SMOKE FAILED — empty waterfall, incomplete" \
+             "traces, or missing SLO accounting in /tmp/_t1_race.json" >&2
+        exit 1
+    fi
+    # Live windowed-signal render: boot a tiny engine server, push one
+    # request through it, and assert `rbg-tpu top --once` renders the
+    # per-role dashboard (attainment + goodput columns) from its slo +
+    # metrics ops. Outside the 870 s pytest budget, --lint mode only.
+    echo "== rbg-tpu top --once (live windowed-signal render) =="
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'PYEOF'
+import os, socket, subprocess, sys, time
+from rbg_tpu.engine.protocol import request_once
+
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+env = {k: v for k, v in os.environ.items()
+       if k not in ("RBG_SERVE_PORT", "RBG_PORT_SERVE")}
+env["JAX_PLATFORMS"] = "cpu"
+proc = subprocess.Popen(
+    [sys.executable, "-m", "rbg_tpu.engine.server", "--model", "tiny",
+     "--port", str(port), "--max-batch", "2", "--num-pages", "64",
+     "--max-seq-len", "128", "--prefill-chunk", "16",
+     "--use-pallas", "never"], env=env)
+try:
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline:
+        try:
+            h, _, _ = request_once(f"127.0.0.1:{port}", {"op": "health"},
+                                   timeout=2)
+            if h and h.get("ok"):
+                break
+        except OSError:
+            pass
+        time.sleep(0.5)
+    else:
+        raise SystemExit("engine never became ready")
+    request_once(f"127.0.0.1:{port}",
+                 {"op": "generate", "prompt": [1, 2, 3, 4],
+                  "max_new_tokens": 4}, timeout=240)
+    out = subprocess.run(
+        [sys.executable, "-m", "rbg_tpu.cli.main", "top", "--once",
+         "--window", "10", "--engine", f"127.0.0.1:{port}"],
+        env=env, capture_output=True, text=True, timeout=60)
+    sys.stdout.write(out.stdout)
+    assert out.returncode == 0, f"top --once rc={out.returncode}: {out.stderr}"
+    assert "GOODPUT" in out.stdout and "TTFT-ATT" in out.stdout, out.stdout
+    assert "unified" in out.stdout, out.stdout
+finally:
+    proc.terminate()
+    proc.wait(timeout=10)
+PYEOF
+    then
+        echo "TIER1 TOP SMOKE FAILED — rbg-tpu top --once could not render" \
+             "live windowed signals from a running engine" >&2
         exit 1
     fi
 fi

@@ -21,13 +21,24 @@ def test_inprocess_run_produces_slo_report():
         requests=8, rate=64.0, input_len=8, output_len=8, model="tiny",
         page_size=8, num_pages=128, max_seq_len=128, max_batch=8,
         use_pallas="never", multi_step=1, speculative="off", addr="",
-        seed=0)
+        slo_ttft_s=1000.0, slo_tpot_s=1000.0, seed=0)
     out = run(args)
     assert out["completed"] == 8
     assert out["output_tok_per_s"] > 0
     for k in ("p50", "p90", "p99"):
         assert out["ttft_s"][k] >= 0
     assert out["e2e_s"]["p50"] > 0
+    # Absurdly generous targets: every completion is goodput, so
+    # goodput_rps equals the completion rate and attainment is 1.0.
+    assert out["slo"]["goodput_fraction"] == 1.0
+    assert out["goodput_rps"] == pytest.approx(
+        out["completed"] / out["duration_s"], rel=0.05)
+    # An impossible TTFT target zeroes goodput without touching the
+    # latency quantiles.
+    args.slo_ttft_s = 1e-9
+    out2 = run(args)
+    assert out2["goodput_rps"] == 0.0
+    assert out2["slo"]["goodput_fraction"] == 0.0
 
 
 @pytest.mark.slow

@@ -104,6 +104,55 @@ def test_histogram_exemplars_keep_slowest_per_bucket(reg):
     assert '# {trace_id="trace-faster"} 0.0021' in rich
 
 
+def test_remove_series_label_scoped(reg):
+    """Gauge staleness (backend eviction): remove_series drops exactly
+    the series whose labels include the selector — across counters,
+    gauges, and histograms — and the exposition forgets them."""
+    reg.set_gauge(names.ROUTER_BACKEND_OUTSTANDING, 3.0, backend="h:1")
+    reg.set_gauge(names.ROUTER_BACKEND_OUTSTANDING, 1.0, backend="h:2")
+    reg.set_gauge(names.ROUTER_BACKEND_DRAINING, 1.0, backend="h:1")
+    reg.inc(names.SERVING_SHED_TOTAL, 2, backend="h:1")
+    reg.observe(names.SERVING_QUEUE_DEPTH, 5.0, backend="h:1")
+    assert reg.remove_series(names.ROUTER_BACKEND_OUTSTANDING,
+                             backend="h:1") == 1
+    assert reg.gauge(names.ROUTER_BACKEND_OUTSTANDING, backend="h:1") is None
+    # The sibling series with other labels survives.
+    assert reg.gauge(names.ROUTER_BACKEND_OUTSTANDING, backend="h:2") == 1.0
+    # Name is part of the selector: other families with the same label
+    # are untouched until removed themselves.
+    assert reg.counter(names.SERVING_SHED_TOTAL, backend="h:1") == 2
+    assert reg.remove_series(names.SERVING_SHED_TOTAL, backend="h:1") == 1
+    assert reg.remove_series(names.SERVING_QUEUE_DEPTH, backend="h:1") == 1
+    assert reg.remove_series(names.ROUTER_BACKEND_DRAINING, backend="h:1") == 1
+    text = reg.render()
+    assert names.SERVING_QUEUE_DEPTH not in text
+    assert 'backend="h:1"' not in text
+    assert 'backend="h:2"' in text
+    # Removing an absent series is a no-op, not an error.
+    assert reg.remove_series(names.SERVING_SHED_TOTAL, backend="h:1") == 0
+
+
+def test_remove_series_whole_family(reg):
+    reg.set_gauge(names.ROUTER_BACKEND_DRAINING, 1.0, backend="h:1")
+    reg.set_gauge(names.ROUTER_BACKEND_DRAINING, 0.0, backend="h:2")
+    assert reg.remove_series(names.ROUTER_BACKEND_DRAINING) == 2
+    assert names.ROUTER_BACKEND_DRAINING not in reg.render()
+
+
+def test_snapshot_values_shapes(reg):
+    reg.inc(names.SERVING_SHED_TOTAL, 3)
+    reg.set_gauge(names.SERVING_DRAINING, 1.0)
+    reg.observe(names.SERVING_QUEUE_DEPTH, 2.0)
+    reg.observe(names.SERVING_QUEUE_DEPTH, 4.0)
+    counters, gauges, hists = reg.snapshot_values()
+    assert counters[(names.SERVING_SHED_TOTAL, ())] == 3
+    assert gauges[(names.SERVING_DRAINING, ())] == 1.0
+    assert hists[(names.SERVING_QUEUE_DEPTH, ())] == (6.0, 2)
+    # Copies, not views: later registry writes don't mutate the snapshot.
+    reg.inc(names.SERVING_SHED_TOTAL, 1)
+    assert counters[(names.SERVING_SHED_TOTAL, ())] == 3
+
+
 def test_profiler_folded_stacks_full_depth():
     from rbg_tpu.obs.profiler import sample_profile
 

@@ -1,0 +1,425 @@
+"""SLO attainment plane (obs/slo.py + service/router judgment):
+
+* SLOTracker verdicts, windowed attainment, goodput, group_by;
+* the service judges every finished request exactly once (blocking path,
+  rejected requests excluded);
+* router TTFT anchors at the INGRESS arrival stamp — the regression the
+  blocking path had: a scripted first-attempt failure must be charged to
+  the reported TTFT (unified passthrough AND the PD prefill leg), and PD
+  TTFT ends at the prefill hop, not at decode completion;
+* per-backend router gauges are removed when the address leaves the
+  registry (Registry.remove_series wired into BackendPool.retain).
+"""
+
+import json
+import socketserver
+import threading
+import time
+
+import pytest
+
+from rbg_tpu.engine.protocol import recv_msg, request_once, send_msg
+from rbg_tpu.engine.router import (Handler, Registry, RouterServer,
+                                   RouterState)
+from rbg_tpu.obs import names
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.obs.slo import (SLOTargets, SLOTracker, reset_trackers,
+                             slo_response, trackers)
+
+
+# ---- tracker units ---------------------------------------------------------
+
+
+def test_tracker_verdicts_and_attainment():
+    t = SLOTracker(SLOTargets(ttft_s=1.0, tpot_s=0.1), component="t",
+                   register=False)
+    assert t.judge(0.5, 0.05, role="unified") == {
+        "ttft_ok": True, "tpot_ok": True, "goodput": True}
+    assert t.judge(2.0, 0.05, role="unified")["goodput"] is False
+    assert t.judge(0.5, 0.5, role="decode") == {
+        "ttft_ok": True, "tpot_ok": False, "goodput": False}
+    assert t.judged_total() == 3
+    assert t.totals() == {"judged": 3, "ttft_met": 2, "tpot_met": 2,
+                          "goodput": 1}
+    att = t.attainment(60.0)
+    assert att["all"]["judged"] == 3
+    assert att["all"]["ttft_attainment"] == pytest.approx(2 / 3, abs=1e-3)
+    assert att["all"]["goodput_attainment"] == pytest.approx(1 / 3, abs=1e-3)
+    by_role = t.attainment(60.0, group_by=("role",))
+    assert by_role["role=unified"]["judged"] == 2
+    assert by_role["role=decode"]["tpot_attainment"] == 0.0
+    # goodput_rps = met-both / window.
+    assert att["all"]["goodput_rps"] == pytest.approx(1 / 60.0, abs=1e-3)
+
+
+def test_tracker_zero_target_disables_dimension():
+    t = SLOTracker(SLOTargets(ttft_s=0.0, tpot_s=0.1), component="t",
+                   register=False)
+    v = t.judge(99.0, 0.05)
+    assert v["ttft_ok"] and v["goodput"]
+
+
+def test_tracker_window_excludes_old_events(monkeypatch):
+    t = SLOTracker(SLOTargets(1.0, 1.0), component="t", register=False)
+    t.judge(0.1, 0.0)
+    # Judged "now"; a window anchored far in the future sees nothing.
+    future = time.monotonic() + 1000.0
+    assert t.attainment(60.0, now=future) == {}
+    assert t.attainment(2000.0, now=future)["all"]["judged"] == 1
+
+
+def test_tracker_publishes_registry_series():
+    before = REGISTRY.counter(names.SLO_JUDGED_TOTAL, component="unit",
+                              role="r")
+    t = SLOTracker(SLOTargets(1.0, 1.0), component="unit", register=False)
+    t.judge(0.5, 0.1, role="r")
+    t.judge(5.0, 0.1, role="r")
+    assert REGISTRY.counter(names.SLO_JUDGED_TOTAL, component="unit",
+                            role="r") == before + 2
+    assert REGISTRY.counter(names.SLO_GOODPUT_TOTAL, component="unit",
+                            role="r") >= 1
+    # snapshot() publishes the 60 s attainment gauges.
+    t.snapshot()
+    assert REGISTRY.gauge(names.SLO_TTFT_ATTAINMENT,
+                          component="unit") == 0.5
+
+
+def test_slo_response_clamps_malformed_window():
+    reset_trackers()
+    t = SLOTracker(SLOTargets(1.0, 1.0), component="resp")
+    t.judge(0.1, 0.0, role="x")
+    for bad, expect in (("bogus", 60.0), (None, 60.0), (-5, 1.0),
+                        (10**9, 3600.0), ("30", 30.0)):
+        resp = slo_response(bad)
+        assert resp["window_s"] == expect
+        assert "signals" in resp and "signals_by_window" in resp
+    comps = [tr["component"] for tr in slo_response(None)["trackers"]]
+    assert "resp" in comps
+    reset_trackers()
+
+
+def test_tracker_registry_bounded():
+    reset_trackers()
+    made = [SLOTracker(component=f"c{i}") for i in range(40)]
+    live = trackers()
+    assert len(live) == 16
+    assert live[-1] is made[-1]
+    reset_trackers()
+
+
+# ---- service-side judgment (real tiny engine) ------------------------------
+
+
+@pytest.fixture(scope="module")
+def svc():
+    from rbg_tpu.engine.config import EngineConfig
+    from rbg_tpu.engine.service import EngineService
+
+    s = EngineService(
+        EngineConfig(model="tiny", page_size=8, num_pages=64, max_batch=1,
+                     max_seq_len=128, prefill_chunk=16, use_pallas="never",
+                     decode_buckets=(1,), slo_ttft_s=30.0, slo_tpot_s=5.0),
+        max_queue=4)
+    yield s
+    s.stop()
+
+
+def test_service_judges_every_finished_request_once(svc):
+    from rbg_tpu.engine.config import SamplingParams
+    from rbg_tpu.engine.service import DeadlineExceeded
+
+    svc_label = "engineservice"
+    judged0 = svc.slo.judged_total()
+    fin0 = REGISTRY.counter(names.SERVING_REQUESTS_FINISHED_TOTAL,
+                            service=svc_label)
+    tok0 = REGISTRY.counter(names.SERVING_TOKENS_TOTAL, service=svc_label)
+    for i in range(3):
+        svc.submit_wait([1 + i, 2, 3], SamplingParams(max_new_tokens=4))
+    assert svc.slo.judged_total() - judged0 == 3
+    assert REGISTRY.counter(names.SERVING_REQUESTS_FINISHED_TOTAL,
+                            service=svc_label) - fin0 == 3
+    assert REGISTRY.counter(names.SERVING_TOKENS_TOTAL,
+                            service=svc_label) - tok0 == 12
+    # Generous targets on a tiny CPU engine: everything attains.
+    att = svc.slo.attainment(60.0, group_by=("role",))
+    assert att["role=unified"]["judged"] >= 3
+    assert svc.service_stats()["slo_judged_total"] == svc.slo.judged_total()
+    # A request rejected at submission never reaches the judged set.
+    with pytest.raises(DeadlineExceeded):
+        svc.submit_wait([9, 9, 9], SamplingParams(max_new_tokens=4),
+                        deadline=time.monotonic() - 1.0)
+    assert svc.slo.judged_total() - judged0 == 3
+
+
+# ---- router-side judgment (scripted backends) ------------------------------
+
+
+class _ScriptedBackend(socketserver.ThreadingTCPServer):
+    """Engine stand-in with scripted behavior per op:
+
+    * ``die_delay_s``: sleep then cut the socket on the FIRST data op
+      (transport failure → router failover), then behave;
+    * ``reply_delay_s``: sleep before answering (models compute time);
+    * ``reply``: extra fields merged into the generate/decode response;
+    * ``prefill=True``: answer op=prefill with a bundle-shaped header +
+      empty KV bytes (the router forwards headers verbatim).
+    """
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, die_delay_s=None, reply_delay_s=0.0, reply=None,
+                 prefill=False, stream_tokens=0):
+        backend = self
+        self.die_delay_s = die_delay_s
+        self.seen = []
+
+        class H(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        obj, _, _ = recv_msg(self.request)
+                    except (ConnectionError, json.JSONDecodeError):
+                        return
+                    if obj is None:
+                        return
+                    if obj.get("op") == "health":
+                        send_msg(self.request, {"ok": True})
+                        continue
+                    backend.seen.append(obj)
+                    if backend.die_delay_s is not None:
+                        time.sleep(backend.die_delay_s)
+                        backend.die_delay_s = None   # die once
+                        return
+                    if reply_delay_s:
+                        time.sleep(reply_delay_s)
+                    if prefill:
+                        send_msg(self.request,
+                                 {"prompt": obj.get("prompt"),
+                                  "first_token": 5, "n_pages": 0},
+                                 b"", b"")
+                        continue
+                    if stream_tokens and obj.get("stream"):
+                        for t in range(stream_tokens):
+                            send_msg(self.request,
+                                     {"tokens": [t], "done": False})
+                            time.sleep(0.01)
+                        send_msg(self.request, {"tokens": [], "done": True})
+                        continue
+                    resp = {"tokens": [5, 6, 7]}
+                    resp.update(reply or {})
+                    send_msg(self.request, resp)
+
+        super().__init__(("127.0.0.1", 0), H)
+        self.addr = f"127.0.0.1:{self.server_address[1]}"
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.shutdown()
+        self.server_close()
+
+
+def _router(static, **kw):
+    server = RouterServer(("127.0.0.1", 0), Handler)
+    server.state = RouterState(Registry(None), None, static, **kw)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"127.0.0.1:{server.server_address[1]}"
+
+
+def test_unified_blocking_ttft_charges_failed_attempt():
+    """Regression (satellite 1): the backend-reported ttft_s restarted
+    the clock on failover — a first attempt that burned 0.4 s before
+    dying must appear in the client-visible TTFT."""
+    flaky = _ScriptedBackend(die_delay_s=0.4)
+    steady = _ScriptedBackend(reply={"ttft_s": 0.01})
+    server, addr = _router({"worker": [flaky.addr, steady.addr]},
+                           slo_targets=SLOTargets(10.0, 1.0))
+    try:
+        # Load the steady sibling so the flaky one is tried first.
+        server.state.pool.acquire(steady.addr)
+        resp, _, _ = request_once(addr, {"op": "generate",
+                                         "prompt": [1, 2, 3],
+                                         "timeout_s": 20}, timeout=20)
+        assert resp and "error" not in resp, resp
+        assert "_router_t_dispatch" not in resp
+        # Old behavior: 0.01 passthrough. New: arrival-anchored.
+        assert resp["ttft_s"] >= 0.35, resp
+        assert server.state.metrics["failovers"] == 1
+        assert server.state.slo.judged_total() == 1
+        att = server.state.slo.attainment(60.0, group_by=("backend",))
+        assert f"backend={steady.addr}" in att
+    finally:
+        server.shutdown()
+        flaky.stop()
+        steady.stop()
+
+
+def test_pd_blocking_ttft_ends_at_prefill_not_decode():
+    """PD TTFT = ingress → prefill hop return (the first token exists
+    then). A scripted 0.3 s first-attempt prefill failure is charged; the
+    0.8 s decode leg is NOT."""
+    pf_flaky = _ScriptedBackend(die_delay_s=0.3, prefill=True)
+    pf_ok = _ScriptedBackend(prefill=True)
+    dec = _ScriptedBackend(reply_delay_s=0.8)
+    server, addr = _router(
+        {"prefill": [pf_flaky.addr, pf_ok.addr], "decode": [dec.addr]},
+        slo_targets=SLOTargets(10.0, 1.0))
+    try:
+        server.state.pool.acquire(pf_ok.addr)   # flaky prefill goes first
+        t0 = time.monotonic()
+        resp, _, _ = request_once(addr, {"op": "generate",
+                                         "prompt": [1, 2, 3],
+                                         "timeout_s": 30}, timeout=30)
+        e2e = time.monotonic() - t0
+        assert resp and "error" not in resp, resp
+        assert e2e >= 1.0                        # decode leg really ran
+        assert 0.25 <= resp["ttft_s"] <= 0.7, resp   # charged, no decode
+        att = server.state.slo.attainment(60.0, group_by=("role",))
+        assert att["role=decode"]["judged"] == 1
+    finally:
+        server.shutdown()
+        for b in (pf_flaky, pf_ok, dec):
+            b.stop()
+
+
+def test_streaming_judged_and_health_carries_slo():
+    be = _ScriptedBackend(stream_tokens=5)
+    server, addr = _router({"worker": [be.addr]},
+                           slo_targets=SLOTargets(10.0, 1.0))
+    try:
+        import socket as _socket
+        host, port = addr.rsplit(":", 1)
+        got = []
+        with _socket.create_connection((host, int(port)), timeout=10) as s:
+            send_msg(s, {"op": "generate", "stream": True,
+                         "prompt": [1, 2], "timeout_s": 20})
+            while True:
+                frame, _, _ = recv_msg(s)
+                assert frame is not None and "error" not in frame, frame
+                got.extend(frame.get("tokens") or [])
+                if frame.get("done"):
+                    break
+        assert got == list(range(5))
+        deadline = time.monotonic() + 5.0
+        while (time.monotonic() < deadline
+               and server.state.slo.judged_total() < 1):
+            time.sleep(0.01)
+        assert server.state.slo.judged_total() == 1
+        health, _, _ = request_once(addr, {"op": "health"}, timeout=10)
+        slo = health.get("slo")
+        assert slo and slo["judged_total"] == 1
+        assert "role=worker" in slo["per_role"]
+        assert f"backend={be.addr}" in slo["per_backend"]
+        assert slo["per_role"]["role=worker"]["goodput_attainment"] == 1.0
+    finally:
+        server.shutdown()
+        be.stop()
+
+
+def test_top_once_renders_engine_and_router(capsys):
+    """`rbg-tpu top --once` renders a per-role dashboard frame from live
+    slo/metrics ops and exits 0; an unreachable target exits 1."""
+    from rbg_tpu.cli.top import run as top_run
+
+    class _OpsBackend(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+        def __init__(self):
+            tr = SLOTracker(SLOTargets(1.0, 0.5), component="engineservice",
+                            register=False)
+            tr.judge(0.1, 0.01, role="unified")
+
+            class H(socketserver.BaseRequestHandler):
+                def handle(self):
+                    while True:
+                        try:
+                            obj, _, _ = recv_msg(self.request)
+                        except (ConnectionError, json.JSONDecodeError):
+                            return
+                        if obj is None:
+                            return
+                        op = obj.get("op")
+                        if op == "metrics":
+                            send_msg(self.request, {
+                                "mode": "unified",
+                                "metrics": {"queue_depth": 2, "running": 1,
+                                            "waiting": 0, "draining": False,
+                                            "slo_judged_total": 1}})
+                        elif op == "slo":
+                            send_msg(self.request, {
+                                "window_s": 60.0,
+                                "sampler": {"samples": 5},
+                                "signals": {"requests_per_s": 1.5,
+                                            "tokens_per_s": 48.0,
+                                            "shed_per_s": 0.0,
+                                            "occupancy_mean": 0.5},
+                                "trackers": [tr.snapshot(
+                                    group_by=("role",))]})
+                        else:
+                            send_msg(self.request, {"ok": True})
+
+            super().__init__(("127.0.0.1", 0), H)
+            self.addr = f"127.0.0.1:{self.server_address[1]}"
+            threading.Thread(target=self.serve_forever, daemon=True).start()
+
+    ops = _OpsBackend()
+    be = _ScriptedBackend(reply={"ttft_s": 0.01})
+    rsrv, raddr = _router({"worker": [be.addr]},
+                          slo_targets=SLOTargets(10.0, 1.0))
+    try:
+        request_once(raddr, {"op": "generate", "prompt": [1, 2],
+                             "timeout_s": 10}, timeout=10)
+        rc = top_run(["--once", "--engine", ops.addr, "--router", raddr])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "GOODPUT" in out and "TTFT-ATT" in out
+        assert "unified" in out and "worker" in out
+        assert f"router {raddr}" in out
+        # JSON mode emits the raw payloads.
+        rc = top_run(["--json", "--engine", ops.addr])
+        raw = json.loads(capsys.readouterr().out)
+        assert rc == 0 and raw[0]["kind"] == "engine"
+        # Unreachable target: rendered as an error row, exit 1.
+        rc = top_run(["--once", "--engine", "127.0.0.1:1"])
+        assert rc == 1
+        assert "unreachable" in capsys.readouterr().out
+    finally:
+        rsrv.shutdown()
+        be.stop()
+        ops.shutdown()
+
+
+def test_backend_gauges_published_and_pruned():
+    """Satellite 2: per-backend gauges follow the pool, and pruning an
+    address out of the registry removes its series from the exposition."""
+    from rbg_tpu.engine.router import BackendPool
+
+    pool = BackendPool()
+    a = "10.9.9.9:1234"
+    pool.acquire(a)
+    assert REGISTRY.gauge(names.ROUTER_BACKEND_OUTSTANDING, backend=a) == 1.0
+    pool.set_draining(a, True)
+    assert REGISTRY.gauge(names.ROUTER_BACKEND_DRAINING, backend=a) == 1.0
+    # Router-minted per-backend SLO verdicts must be pruned with the
+    # address too — pod churn otherwise grows slo series forever.
+    tr = SLOTracker(SLOTargets(1.0, 1.0), component="router",
+                    register=False)
+    tr.judge(0.1, 0.0, role="worker", backend=a)
+    assert REGISTRY.counter(names.SLO_JUDGED_TOTAL, component="router",
+                            role="worker", backend=a) == 1
+    assert a in REGISTRY.render()
+    pool.release(a)
+    pool.retain(live=set())        # address left the registry
+    assert REGISTRY.gauge(names.ROUTER_BACKEND_OUTSTANDING,
+                          backend=a) is None
+    assert REGISTRY.gauge(names.ROUTER_BACKEND_DRAINING, backend=a) is None
+    assert REGISTRY.counter(names.SLO_JUDGED_TOTAL, component="router",
+                            role="worker", backend=a) == 0.0
+    assert a not in REGISTRY.render()
+    # Outstanding traffic pins the state (and its gauges) until drained.
+    b = "10.9.9.9:4321"
+    pool.acquire(b)
+    pool.retain(live=set())
+    assert REGISTRY.gauge(names.ROUTER_BACKEND_OUTSTANDING,
+                          backend=b) == 1.0

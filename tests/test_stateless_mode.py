@@ -195,10 +195,19 @@ def test_scale_up_resurrects_draining_instance():
 
         plane.wait_for(resurrected, timeout=10,
                        desc="draining instance reclaimed, no 3rd created")
-        # Pods lost the drain signal.
-        pods = plane.store.list("Pod", namespace="default", owner_uid=uid)
-        assert all(C.ANN_LIFECYCLE_STATE not in p.metadata.annotations
-                   for p in pods)
+
+        # Pods lose the drain signal one reconcile after the instance
+        # flips back — wait for the annotation clear instead of racing
+        # it (load-sensitive flake otherwise).
+        def pods_undrained():
+            pods = plane.store.list("Pod", namespace="default",
+                                    owner_uid=uid)
+            return pods and all(
+                C.ANN_LIFECYCLE_STATE not in p.metadata.annotations
+                for p in pods)
+
+        plane.wait_for(pods_undrained, timeout=10,
+                       desc="pods lost the drain annotation")
         plane.wait_group_ready("rez", timeout=20)
 
 

@@ -1,0 +1,162 @@
+"""Windowed time-series sampler (obs/timeseries.py): window math over an
+injected clock, counter-reset handling, retention eviction, catalog
+validation, and sampler thread lifecycle."""
+
+import threading
+import time
+
+import pytest
+
+from rbg_tpu.obs import names
+from rbg_tpu.obs.metrics import Registry
+from rbg_tpu.obs.timeseries import TimeSeriesSampler
+
+
+@pytest.fixture()
+def reg():
+    return Registry(strict=False)
+
+
+def mk(reg, interval=1.0, retention=60.0):
+    return TimeSeriesSampler(registry=reg, interval_s=interval,
+                             retention_s=retention)
+
+
+def test_rate_delta_over_window(reg):
+    s = mk(reg)
+    s.sample_now(now=0.0)
+    reg.inc(names.SERVING_TOKENS_TOTAL, 10, service="a")
+    reg.inc(names.SERVING_TOKENS_TOTAL, 5, service="b")
+    s.sample_now(now=5.0)
+    reg.inc(names.SERVING_TOKENS_TOTAL, 10, service="a")
+    s.sample_now(now=10.0)
+    # Subset matching sums across label sets; exact labels narrow it.
+    assert s.delta(names.SERVING_TOKENS_TOTAL, 10.0) == 25.0
+    assert s.rate(names.SERVING_TOKENS_TOTAL, 10.0) == pytest.approx(2.5)
+    assert s.rate(names.SERVING_TOKENS_TOTAL, 10.0,
+                  service="a") == pytest.approx(2.0)
+    assert s.delta(names.SERVING_TOKENS_TOTAL, 10.0, service="b") == 5.0
+    # A narrower window anchored at the newest sample sees only the
+    # second increment.
+    assert s.delta(names.SERVING_TOKENS_TOTAL, 5.0) == 10.0
+
+
+def test_empty_window_returns_none(reg):
+    s = mk(reg)
+    assert s.delta(names.SERVING_TOKENS_TOTAL, 10.0) is None
+    assert s.rate(names.SERVING_TOKENS_TOTAL, 10.0) is None
+    assert s.mean_gauge(names.SERVING_DRAINING, 10.0) is None
+    assert s.mean_observed(names.SERVING_QUEUE_DEPTH, 10.0) is None
+    # One sample is not a window either.
+    s.sample_now(now=0.0)
+    assert s.delta(names.SERVING_TOKENS_TOTAL, 10.0) is None
+    # A window anchored far past the newest sample holds at most the
+    # baseline sample — still no delta.
+    s.sample_now(now=1.0)
+    assert s.delta(names.SERVING_TOKENS_TOTAL, 10.0, now=500.0) is None
+
+
+def test_counter_reset_counts_post_restart_value(reg):
+    """A plane restart mid-window (counter decreases) reads as reset-to-
+    zero-then-grew — the Prometheus convention — never a negative delta."""
+    s = mk(reg)
+    reg.inc(names.SERVING_TOKENS_TOTAL, 100)
+    s.sample_now(now=0.0)
+    reg.reset()   # plane restart
+    reg.inc(names.SERVING_TOKENS_TOTAL, 7)
+    s.sample_now(now=5.0)
+    assert s.delta(names.SERVING_TOKENS_TOTAL, 10.0) == 7.0
+    # Explicit decrease (same series, lower value) behaves identically.
+    reg2 = Registry(strict=False)
+    s2 = mk(reg2)
+    reg2.inc(names.SERVING_SHED_TOTAL, 50)
+    s2.sample_now(now=0.0)
+    reg2._counters.clear()
+    reg2.inc(names.SERVING_SHED_TOTAL, 3)
+    s2.sample_now(now=2.0)
+    reg2.inc(names.SERVING_SHED_TOTAL, 4)
+    s2.sample_now(now=4.0)
+    assert s2.delta(names.SERVING_SHED_TOTAL, 10.0) == 7.0
+
+
+def test_series_born_mid_window_counts_from_zero(reg):
+    s = mk(reg)
+    s.sample_now(now=0.0)
+    s.sample_now(now=2.0)
+    reg.inc(names.SERVING_SHED_TOTAL, 9, service="new")
+    s.sample_now(now=4.0)
+    assert s.delta(names.SERVING_SHED_TOTAL, 10.0) == 9.0
+
+
+def test_retention_evicts_oldest(reg):
+    s = mk(reg, interval=1.0, retention=5.0)   # ring of 6 samples
+    for t in range(10):
+        reg.inc(names.SERVING_TOKENS_TOTAL, 1)
+        s.sample_now(now=float(t))
+    st = s.stats()
+    assert st["samples"] == 6
+    # The evicted head is gone: a full-history delta only sees the
+    # retained span (5 increments across samples t=4..9).
+    assert s.delta(names.SERVING_TOKENS_TOTAL, 100.0) == 5.0
+    assert st["span_s"] == pytest.approx(5.0)
+
+
+def test_mean_gauge_and_mean_observed(reg):
+    s = mk(reg)
+    reg.set_gauge(names.SERVING_DRAINING, 0.0)
+    s.sample_now(now=0.0)
+    reg.set_gauge(names.SERVING_DRAINING, 1.0)
+    s.sample_now(now=2.0)
+    s.sample_now(now=4.0)
+    assert s.mean_gauge(names.SERVING_DRAINING, 10.0) == pytest.approx(2 / 3)
+    # Histogram windowed mean = Δsum/Δcount, so it reflects only the
+    # window's observations — not lifetime history.
+    reg.observe(names.SERVING_QUEUE_DEPTH, 100.0)
+    s.sample_now(now=6.0)
+    reg.observe(names.SERVING_QUEUE_DEPTH, 2.0)
+    reg.observe(names.SERVING_QUEUE_DEPTH, 4.0)
+    s.sample_now(now=8.0)
+    assert s.mean_observed(names.SERVING_QUEUE_DEPTH, 2.0,
+                           now=8.0) == pytest.approx(3.0)
+
+
+def test_uncataloged_rbg_name_rejected(reg):
+    s = mk(reg)
+    s.sample_now(now=0.0)
+    s.sample_now(now=1.0)
+    with pytest.raises(ValueError, match="not cataloged"):
+        s.rate("rbg_totally_made_up_total", 10.0)
+    with pytest.raises(ValueError, match="not cataloged"):
+        s.mean_gauge("rbg_totally_made_up", 10.0)
+
+
+def test_sampler_thread_lifecycle(reg):
+    """start() is idempotent, the thread is a daemon (the thread-lifecycle
+    lint contract), and stop() provably joins it."""
+    s = mk(reg, interval=0.01, retention=1.0)
+    before = threading.active_count()
+    s.start()
+    t = s._thread
+    assert t.daemon
+    assert s.start() is s and s._thread is t   # idempotent, same thread
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and s.stats()["samples"] < 3:
+        time.sleep(0.01)
+    assert s.stats()["samples"] >= 3
+    s.stop()
+    assert s._thread is None
+    assert not t.is_alive()
+    assert threading.active_count() <= before
+    # stop() twice is a no-op; a fresh start() works after stop.
+    s.stop()
+    s.start()
+    s.stop()
+
+
+def test_bad_config_rejected(reg):
+    with pytest.raises(ValueError):
+        TimeSeriesSampler(registry=reg, interval_s=0.0)
+    with pytest.raises(ValueError):
+        TimeSeriesSampler(registry=reg, interval_s=5.0, retention_s=1.0)
+    with pytest.raises(ValueError):
+        mk(reg).delta(names.SERVING_TOKENS_TOTAL, 0.0)

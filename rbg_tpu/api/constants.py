@@ -62,6 +62,20 @@ ANN_DRAIN_COMPLETE = f"{DOMAIN}/drain-complete"      # "true" from drain agent
 LIFECYCLE_PREPARING_DELETE = "PreparingDelete"
 ANN_DISCOVERY_CONFIG_MODE = f"{DOMAIN}/discovery-config-mode"  # legacy|refine
 
+# ---- autoscaler contract (SLO-driven coordinated autoscaling) ----
+# On a ScalingAdapter: the replica value the autoscaler last wrote. When
+# spec.replicas differs from this stamp at the next evaluation, a FOREIGN
+# writer (an external HPA, an operator) touched the adapter since our last
+# write — the autoscaler backs off for one cycle and adopts the foreign
+# value as its new baseline instead of silently clobbering it
+# (last-writer-wins is how two controllers fight forever).
+ANN_AUTOSCALE_LAST_WRITE = f"{DOMAIN}/autoscale-last-write"
+# On a RoleInstance: scale-down preference stamped by the autoscaler from
+# observed in-flight streams (lowest cost retired first — the k8s
+# pod-deletion-cost analog). Consumed by the stateless instance engine's
+# victim ordering; absent reads as 0.
+ANN_SCALE_DOWN_COST = f"{DOMAIN}/scale-down-cost"
+
 # ---- slice disruption lifecycle (GKE TPU failure domains) ----
 # On a RoleInstance, the advance-notice migration state machine driven by
 # the disruption controller: "" -> Warming -> CutOver -> (cleared).

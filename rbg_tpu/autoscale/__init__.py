@@ -1,0 +1,25 @@
+"""SLO-driven coordinated autoscaling: the control loop that reads the
+windowed signal plane (obs/timeseries + obs/slo) and writes role replica
+targets through the ScalingAdapter seam.
+
+Three parts (docs/architecture.md "Autoscaling"):
+
+* :mod:`rbg_tpu.autoscale.signals` — ``SignalReader``, the staleness-aware
+  per-role view of goodput, attainment, queue depth, estimated wait;
+* :mod:`rbg_tpu.autoscale.policy` — ``RolePolicy`` / ``RoleScaler``
+  (hysteresis, cooldown) and the coordinated-ratio math for PD pairs;
+* :mod:`rbg_tpu.autoscale.controller` — ``AutoscaleController``, the
+  actuator (adapter writes, warm-spare grants, drain-first scale-down).
+"""
+
+from rbg_tpu.autoscale.controller import AutoscaleConfig, AutoscaleController
+from rbg_tpu.autoscale.policy import (
+    CoordinatedRoles, Decision, RolePolicy, RoleScaler, coordinated_targets,
+)
+from rbg_tpu.autoscale.signals import RoleSignals, SignalReader
+
+__all__ = [
+    "AutoscaleConfig", "AutoscaleController", "CoordinatedRoles",
+    "Decision", "RolePolicy", "RoleScaler", "RoleSignals", "SignalReader",
+    "coordinated_targets",
+]

@@ -1,0 +1,508 @@
+"""AutoscaleController — the actuator closing the signal→capacity loop.
+
+A runtime controller (same lifecycle as ``DisruptionController``) that
+reads the windowed signal plane through :class:`SignalReader`, runs each
+configured role through its :class:`RoleScaler`, and writes the resulting
+replica targets through ``ScalingAdapter.spec.replicas`` — the existing
+HPA seam, so the group controller's ``_apply_scaling_overrides`` carries
+the override to the role exactly as it would for an external autoscaler.
+
+Actuation contract:
+
+* **two-writer safety** — every write stamps the adapter with the value
+  written (``ANN_AUTOSCALE_LAST_WRITE``). If ``spec.replicas`` no longer
+  matches the stamp at the next evaluation, a foreign writer (external
+  HPA, operator) touched the adapter: the autoscaler counts
+  ``rbg_autoscale_conflicts_total``, backs off for one cycle, and adopts
+  the foreign value as its new baseline — never silent last-writer-wins;
+* **scale-up prefers warm spares** — pending TPU instances created by a
+  raised target are granted reserved SparePool slices (bind-time
+  capacity) and the scheduler steers them straight on;
+* **scale-down retires the emptiest first** — before lowering a target,
+  live instances are stamped with ``ANN_SCALE_DOWN_COST`` (observed
+  in-flight streams), and the stateless instance engine's victim
+  ordering drains the cheapest instance through the PreparingDelete /
+  SIGTERM path, so no stream is ever dropped;
+* **coordinated-ratio mode** — PD pairs scale through
+  ``policy.coordinated_targets`` (measured prefill:decode token ratio +
+  the group's maxSkew clamp).
+
+Every decision lands in ``rbg_autoscale_*`` metrics and the in-process
+status surfaced by the admin ``autoscale`` op and ``rbg-tpu top``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.autoscale.policy import (
+    DIR_HOLD, CoordinatedRoles, Decision, RolePolicy, RoleScaler,
+    coordinated_targets, follower_raw_target, gate_growth_only,
+)
+from rbg_tpu.autoscale.signals import SignalReader
+from rbg_tpu.obs import names
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.runtime.controller import Controller, Result, Watch
+from rbg_tpu.runtime.store import Conflict, NotFound, Store
+from rbg_tpu.utils.locktrace import named_lock
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Wiring for one plane's autoscaler. ``roles`` maps role name →
+    policy (roles without an entry are never touched); ``coordinated``
+    lists PD driver/follower pairs whose targets derive in ratio."""
+
+    roles: Dict[str, RolePolicy] = dataclasses.field(default_factory=dict)
+    coordinated: List[CoordinatedRoles] = dataclasses.field(
+        default_factory=list)
+    eval_period_s: float = 15.0
+    window_s: float = 60.0
+    stale_after_s: float = 10.0
+    # Per-role extras hook for signals the registry does not label per
+    # role (queue depth / estimated wait from a router health snapshot or
+    # service stats): role -> dict.
+    extras_fn: Optional[Callable[[str], dict]] = None
+    # pod name -> observed in-flight streams (scale-down victim cost).
+    inflight_streams_fn: Optional[Callable[[str], float]] = None
+
+
+class AutoscaleController(Controller):
+    name = "autoscale"
+    workers = 1
+
+    def __init__(self, store: Store, config: AutoscaleConfig, spares=None):
+        super().__init__(store)
+        self.cfg = config
+        self.spares = spares
+        self.resync_period = max(config.eval_period_s, 0.05)
+        self.reader = SignalReader(window_s=config.window_s,
+                                   stale_after_s=config.stale_after_s,
+                                   extras_fn=config.extras_fn)
+        self._scalers: Dict[tuple, RoleScaler] = {}
+        self._lock = named_lock("autoscale.status")
+        # (ns, group, role) -> status dict  # guarded_by[autoscale.status]
+        self._status: Dict[tuple, dict] = {}
+        # runtime-disabled role names  # guarded_by[autoscale.status]
+        self._disabled: set = set()
+
+    # ---- wiring ----
+
+    def watches(self) -> List[Watch]:
+        def adapter_to_group(sa):
+            if getattr(sa, "kind", "") != "ScalingAdapter" \
+                    or not sa.spec.group_name:
+                return []
+            return [(sa.metadata.namespace, sa.spec.group_name)]
+
+        return [Watch("ScalingAdapter", adapter_to_group)]
+
+    # ---- operator surface ----
+
+    def set_enabled(self, role: str, enabled: bool) -> bool:
+        """Runtime per-role kill switch (admin ``autoscale`` op). Returns
+        True when the role is configured at all."""
+        if role not in self.cfg.roles:
+            return False
+        with self._lock:
+            if enabled:
+                self._disabled.discard(role)
+            else:
+                self._disabled.add(role)
+        return True
+
+    def enabled(self, role: str) -> bool:
+        with self._lock:
+            disabled = role in self._disabled
+        return (not disabled
+                and self.cfg.roles.get(role, RolePolicy(role)).enabled)
+
+    def status(self) -> dict:
+        """Per-role posture for the admin op / ``rbg-tpu top``."""
+        with self._lock:
+            rows = [dict(v) for v in self._status.values()]
+        rows.sort(key=lambda r: (r["namespace"], r["group"], r["role"]))
+        return {
+            "eval_period_s": self.cfg.eval_period_s,
+            "window_s": self.cfg.window_s,
+            "spare_slices_available": (self.spares.available()
+                                       if self.spares is not None else None),
+            "roles": rows,
+        }
+
+    # ---- reconcile ----
+
+    def reconcile(self, store: Store, key) -> Optional[Result]:
+        ns, group = key
+        rbg = store.get("RoleBasedGroup", ns, group, copy_=False)
+        if rbg is None or rbg.metadata.deletion_timestamp is not None:
+            return None
+        adapters = {
+            sa.spec.role_name: sa
+            for sa in store.list("ScalingAdapter", namespace=ns)
+            if sa.spec.group_name == group
+            and sa.spec.role_name in self.cfg.roles
+            and rbg.spec.role(sa.spec.role_name) is not None
+        }
+        if not adapters:
+            return None
+        now = time.monotonic()
+        signals = self.reader.read_all(adapters, now=now)
+
+        current: Dict[str, int] = {}
+        conflicted: Dict[str, int] = {}
+        for role, sa in adapters.items():
+            cur = (sa.spec.replicas if sa.spec.replicas is not None
+                   else rbg.spec.role(role).replicas)
+            current[role] = cur
+            stamp = sa.metadata.annotations.get(C.ANN_AUTOSCALE_LAST_WRITE)
+            if (stamp is not None and sa.spec.replicas is not None
+                    and str(sa.spec.replicas) != stamp):
+                conflicted[role] = cur
+                self._adopt_foreign(store, sa, role)
+
+        decisions = self._decide(rbg, adapters, signals, current,
+                                 conflicted, now)
+        for role, (target, decision, skew_clamped) in decisions.items():
+            sa = adapters[role]
+            actual = self._actual(rbg, role)
+            # The adapter's own [min, max] bounds the actuation — clamp
+            # BEFORE the write guard so a tighter adapter never causes a
+            # write-loop of no-op mutates (and the gauge/status reflect
+            # what can actually land).
+            bounded = self._bound_to_adapter(sa, target)
+            adapter_clamped = bounded != target
+            target = bounded
+            if decision.clamped or skew_clamped or adapter_clamped:
+                # One clamp event per evaluation, whichever bound bit —
+                # operators tune off this counter's slope.
+                REGISTRY.inc(names.AUTOSCALE_CLAMPED_TOTAL, role=role)
+            self._count(role, decision)
+            effective = ("up" if target > current[role]
+                         else "down" if target < current[role] else DIR_HOLD)
+            wrote = False
+            if (role not in conflicted and self.enabled(role)
+                    and target != self._adapter_value(sa, rbg, role)):
+                wrote = self._write_target(store, sa, rbg, role, target,
+                                           decision)
+            if wrote and effective != DIR_HOLD:
+                REGISTRY.inc(names.AUTOSCALE_DECISIONS_TOTAL, role=role,
+                             direction=effective)
+            elif decision.direction != DIR_HOLD:
+                # The scaler actuated but nothing landed (growth gated by
+                # the skew clamp or adapter bound, write lost/no-op):
+                # give the cooldown + stabilization back, or sustained
+                # pressure pays ~cooldown+stabilization per gated round
+                # for a change that never happened.
+                self._scaler(ns, group, role).revoke(decision)
+            # Spare grants re-check every cycle: the instances a raised
+            # target creates only EXIST a few reconciles after the write
+            # (group controller → instance set → instances), so a
+            # write-cycle-only grant would race them and never land.
+            self._grant_spares(store, ns, rbg, role)
+            if decision.direction != "down":
+                self._clear_victim_costs(store, ns, group, role)
+            REGISTRY.set_gauge(names.AUTOSCALE_TARGET_REPLICAS,
+                               float(target), role=role)
+            REGISTRY.set_gauge(names.AUTOSCALE_ACTUAL_REPLICAS,
+                               float(actual), role=role)
+            self._record_status(ns, group, role, target, actual,
+                                decision, conflicted, now)
+        return Result(requeue_after=self.cfg.eval_period_s)
+
+    # ---- decision assembly ----
+
+    def _decide(self, rbg, adapters, signals, current, conflicted, now):
+        """role -> (final_target, Decision, skew_clamped). Coordinated
+        followers derive from their driver's effective target; everyone
+        else runs their own scaler."""
+        ns = rbg.metadata.namespace
+        out: Dict[str, tuple] = {}
+        followers = {p.follower: p for p in self.cfg.coordinated}
+        for role, sa in adapters.items():
+            if role in followers:
+                continue
+            scaler = self._scaler(ns, rbg.metadata.name, role)
+            if role in conflicted or not self.enabled(role):
+                reason = ("foreign writer touched adapter"
+                          if role in conflicted else "disabled")
+                d = Decision(role, current[role], current[role], DIR_HOLD,
+                             reason)
+                scaler.last_decision = d
+                out[role] = (current[role], d, False)
+                continue
+            d = scaler.decide(now, signals[role], current[role])
+            if d.direction == "down":
+                self._stamp_victim_costs(self.store, ns,
+                                         rbg.metadata.name, role)
+            out[role] = (d.target, d, False)
+        for pair in self.cfg.coordinated:
+            if pair.driver not in out or pair.follower not in adapters:
+                continue
+            follower_policy = self.cfg.roles[pair.follower]
+            ratio = self.reader.measured_ratio(pair.follower, pair.driver,
+                                               now=now)
+            scaling = self._store_scaling_policy(ns, rbg.metadata.name,
+                                                 pair)
+            drv_raw, drv_dec, _ = out[pair.driver]
+            targets, _ = coordinated_targets(
+                rbg, pair, drv_raw, follower_policy,
+                measured_ratio=ratio, scaling_policy=scaling)
+            # The skew clamp is a per-round progression GATE: it may hold
+            # a rise back while the lagging partner catches up, but a
+            # clamped value below current is never persisted as a
+            # scale-down (gate_growth_only) — only the scaler's own raw
+            # decision sheds capacity.
+            fol_raw = follower_raw_target(pair, drv_raw, follower_policy,
+                                          ratio)
+            drv_cur, fol_cur = current[pair.driver], current[pair.follower]
+            drv_final = gate_growth_only(drv_raw, drv_cur,
+                                         targets[pair.driver])
+            fol_final = gate_growth_only(fol_raw, fol_cur,
+                                         targets[pair.follower])
+            out[pair.driver] = (drv_final, drv_dec, drv_final != drv_raw)
+            direction = ("up" if fol_final > fol_cur
+                         else "down" if fol_final < fol_cur else DIR_HOLD)
+            d = Decision(
+                pair.follower, fol_cur, fol_final, direction,
+                f"coordinated with {pair.driver} "
+                f"(ratio {ratio if ratio is not None else pair.default_ratio:.2f})")
+            if direction == "down":
+                self._stamp_victim_costs(self.store, ns,
+                                         rbg.metadata.name, pair.follower)
+            out[pair.follower] = (fol_final, d, fol_final != fol_raw)
+        return out
+
+    def _store_scaling_policy(self, ns, group, pair):
+        """The operator's CoordinatedScaling for this pair when one is
+        declared — the autoscaler must respect it, not invent a second
+        skew bound."""
+        for p in self.store.list("CoordinatedPolicy", namespace=ns,
+                                 copy_=False):
+            sc = p.spec.scaling
+            if (p.spec.group_name == group and sc is not None
+                    and pair.driver in sc.roles
+                    and pair.follower in sc.roles):
+                return sc
+        return None
+
+    def _scaler(self, ns, group, role) -> RoleScaler:
+        key = (ns, group, role)
+        s = self._scalers.get(key)
+        if s is None:
+            s = self._scalers[key] = RoleScaler(self.cfg.roles[role])
+        return s
+
+    @staticmethod
+    def _actual(rbg, role) -> int:
+        st = rbg.status.role(role)
+        return st.ready_replicas if st is not None else 0
+
+    @staticmethod
+    def _adapter_value(sa, rbg, role) -> Optional[int]:
+        return (sa.spec.replicas if sa.spec.replicas is not None
+                else rbg.spec.role(role).replicas)
+
+    # ---- actuation ----
+
+    @staticmethod
+    def _bound_to_adapter(sa, target: int) -> int:
+        """The adapter's own [min, max] — applied on OUR side before the
+        guard and the write, so the ScalingAdapterController's clamp
+        never rewrites our value (which would read as a foreign writer
+        next cycle) and an out-of-bounds policy never write-loops."""
+        lo, hi = sa.spec.min_replicas, sa.spec.max_replicas
+        if hi > 0:
+            target = min(target, hi)
+        return max(target, lo)
+
+    def _write_target(self, store, sa, rbg, role, target,
+                      decision) -> bool:
+        """One atomic adapter write: replicas + ownership stamp. Returns
+        True only when the store object actually changed — a no-op must
+        not record an event or read as an actuation."""
+        ns, name = sa.metadata.namespace, sa.metadata.name
+        changed = {"v": False}
+
+        def fn(a):
+            changed["v"] = False  # reset: mutate retries re-run fn
+            if (a.spec.replicas == target
+                    and a.metadata.annotations.get(
+                        C.ANN_AUTOSCALE_LAST_WRITE) == str(target)):
+                return False
+            a.spec.replicas = target
+            a.metadata.annotations[C.ANN_AUTOSCALE_LAST_WRITE] = str(target)
+            changed["v"] = True
+            return True
+
+        try:
+            store.mutate("ScalingAdapter", ns, name, fn)
+        except (NotFound, Conflict):
+            return False
+        if not changed["v"]:
+            return False
+        store.record_event(
+            sa, "Autoscaled",
+            f"{role}: {decision.current} -> {target} "
+            f"({decision.direction}: {decision.reason})")
+        return True
+
+    def _adopt_foreign(self, store, sa, role) -> None:
+        """A foreign writer moved spec.replicas since our stamp: count it,
+        drop the stamp (the foreign value becomes our baseline), and skip
+        actuating this role for the cycle."""
+        ns, name = sa.metadata.namespace, sa.metadata.name
+
+        def fn(a):
+            if C.ANN_AUTOSCALE_LAST_WRITE not in a.metadata.annotations:
+                return False
+            del a.metadata.annotations[C.ANN_AUTOSCALE_LAST_WRITE]
+            return True
+
+        try:
+            store.mutate("ScalingAdapter", ns, name, fn)
+        except (NotFound, Conflict):
+            return
+        REGISTRY.inc(names.AUTOSCALE_CONFLICTS_TOTAL, role=role)
+        store.record_event(
+            sa, "AutoscaleConflict",
+            f"{role}: foreign writer set replicas={sa.spec.replicas}; "
+            f"backing off and adopting it as baseline")
+
+    def _stamp_victim_costs(self, store, ns, group, role) -> None:
+        """Stamp each live instance's scale-down cost from observed
+        in-flight streams (sum over its pods) so the stateless engine
+        retires the emptiest instance first."""
+        fn = self.cfg.inflight_streams_fn
+        if fn is None:
+            return
+        pods_by_inst: Dict[str, float] = {}
+        for p in store.list("Pod", namespace=ns, copy_=False):
+            if (p.metadata.labels.get(C.LABEL_GROUP_NAME) != group
+                    or p.metadata.labels.get(C.LABEL_ROLE_NAME) != role):
+                continue
+            inst = p.metadata.labels.get(C.LABEL_INSTANCE_NAME)
+            if not inst:
+                continue
+            try:
+                cost = float(fn(p.metadata.name) or 0.0)
+            except Exception:
+                cost = 0.0
+            pods_by_inst[inst] = pods_by_inst.get(inst, 0.0) + cost
+        for iname, cost in pods_by_inst.items():
+            def stamp(i, cost=cost):
+                val = f"{cost:g}"
+                if i.metadata.annotations.get(C.ANN_SCALE_DOWN_COST) == val:
+                    return False
+                i.metadata.annotations[C.ANN_SCALE_DOWN_COST] = val
+                return True
+
+            try:
+                store.mutate("RoleInstance", ns, iname, stamp)
+            except (NotFound, Conflict):
+                pass
+
+    def _clear_victim_costs(self, store, ns, group, role) -> None:
+        """Drop scale-down-cost stamps once the down pressure passed:
+        the observed stream counts go stale immediately, and a LATER
+        scale-down (operator-driven, or with no streams hook wired) must
+        fall back to the engine's default victim order, not sort by
+        history."""
+        for inst in store.list("RoleInstance", namespace=ns, copy_=False):
+            if (inst.metadata.labels.get(C.LABEL_GROUP_NAME) != group
+                    or inst.metadata.labels.get(C.LABEL_ROLE_NAME) != role
+                    or C.ANN_SCALE_DOWN_COST not in
+                    inst.metadata.annotations):
+                continue
+
+            def drop(i):
+                if C.ANN_SCALE_DOWN_COST not in i.metadata.annotations:
+                    return False
+                del i.metadata.annotations[C.ANN_SCALE_DOWN_COST]
+                return True
+
+            try:
+                store.mutate("RoleInstance", ns, inst.metadata.name, drop)
+            except (NotFound, Conflict):
+                pass
+
+    def _grant_spares(self, store, ns, rbg, role) -> None:
+        """Bind-time scale-up: steer pending TPU instances of the role
+        onto reserved warm spares so new capacity serves in rebind time,
+        not provision time (the PR-3 grant seam, autoscaler-driven)."""
+        spec = rbg.spec.role(role)
+        if self.spares is None or spec is None or spec.tpu is None:
+            return
+        group = rbg.metadata.name
+        took = 0
+        for inst in store.list("RoleInstance", namespace=ns, copy_=False):
+            if (inst.metadata.labels.get(C.LABEL_GROUP_NAME) != group
+                    or inst.metadata.labels.get(C.LABEL_ROLE_NAME) != role):
+                continue
+            if (inst.metadata.annotations.get(C.ANN_SLICE_BINDING)
+                    or inst.status.slice_id):
+                continue
+            target = self.spares.take(topology=spec.tpu.slice_topology)
+            if target is None:
+                break   # pool dry — still replenish below for what landed
+            took += 1
+            iname = inst.metadata.name
+            bound = {"v": False}
+
+            def fn(i, target=target):
+                bound["v"] = False  # reset: mutate retries re-run fn
+                if i.metadata.annotations.get(C.ANN_SLICE_BINDING):
+                    return False
+                i.metadata.annotations[C.ANN_SLICE_BINDING] = target
+                bound["v"] = True
+                return True
+
+            try:
+                store.mutate("RoleInstance", ns, iname, fn)
+            except (NotFound, Conflict):
+                continue   # replenish reclaims the unreferenced grant
+            if not bound["v"]:
+                # Someone bound the instance between our pre-check and
+                # the mutate (scheduler, disruption grant) — the taken
+                # spare references nothing; replenish below reclaims it.
+                continue
+            REGISTRY.inc(names.AUTOSCALE_SPARE_GRANTS_TOTAL, role=role)
+            store.record_event(
+                inst, "AutoscaleSpareGrant",
+                f"scale-up of {role} granted warm spare {target}")
+        if took:
+            # Replenish in the background so the pool does not stay
+            # shallow until the scheduler's resync — and so any take
+            # whose bind was lost returns to the re-reservable set.
+            try:
+                self.spares.replenish(store)
+            except Exception:
+                pass
+
+    # ---- bookkeeping ----
+
+    def _count(self, role, decision: Decision) -> None:
+        """Suppression counters only — actuations and clamps are counted
+        at the reconcile site, where what actually LANDED is known."""
+        if decision.suppressed == "stale":
+            REGISTRY.inc(names.AUTOSCALE_STALE_HOLDS_TOTAL, role=role)
+        elif decision.suppressed == "cooldown":
+            REGISTRY.inc(names.AUTOSCALE_COOLDOWN_SUPPRESSED_TOTAL,
+                         role=role)
+
+    def _record_status(self, ns, group, role, target, actual, decision,
+                       conflicted, now) -> None:
+        scaler = self._scaler(ns, group, role)
+        row = {
+            "namespace": ns, "group": group, "role": role,
+            "target": target, "actual": actual,
+            "enabled": self.enabled(role),
+            "conflicted": role in conflicted,
+            "cooldown_remaining_s": round(scaler.cooldown_remaining(now), 2),
+            "last_decision": decision.as_dict(),
+        }
+        with self._lock:
+            self._status[(ns, group, role)] = row

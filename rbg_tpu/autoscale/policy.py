@@ -1,0 +1,316 @@
+"""Per-role target computation: thresholds, hysteresis, cooldown, and
+the coordinated-ratio mode for PD groups.
+
+The shape follows "Taming the Chaos" (PAPERS.md): each role scales
+INDEPENDENTLY off its own SLO attainment and wait signals, but roles of
+one PD group stay in a COORDINATED ratio — prefill and decode targets
+derive from the measured prefill:decode token ratio and pass through the
+``coordination/scaling.py::clamp_targets`` skew bound, so KV-transfer
+capacity never outruns either side.
+
+Stability machinery (the part that separates a controller from a
+thermostat):
+
+* **direction-split stabilization** — scale-up pressure must hold
+  continuously for ``up_stabilization_s`` before it actuates; scale-down
+  uses the MAX of the desired-replica recommendations over
+  ``down_stabilization_s`` (the HPA convention), so a transient dip never
+  sheds capacity a burst will want back;
+* **cooldown** — after any actuation the role holds for ``cooldown_s``
+  (suppressions are counted, not silent);
+* **staleness** — a stale signal plane (dead sampler) always HOLDS.
+
+Everything here is pure state-machine code: ``now`` is a parameter, no
+clocks are read, no store is touched — the controller owns the I/O.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+from rbg_tpu.autoscale.signals import RoleSignals
+
+DIR_UP = "up"
+DIR_DOWN = "down"
+DIR_HOLD = "hold"
+
+
+@dataclasses.dataclass
+class RolePolicy:
+    """Tuning for one role. ``target_rps_per_replica`` > 0 enables
+    load-proportional sizing (the capacity-follows-load computer);
+    attainment / wait / queue thresholds add SLO-driven scale-up pressure
+    on top. A 0 threshold disables that trigger."""
+
+    role: str
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # Load-proportional sizing: desired = ceil(demand_rps / this).
+    target_rps_per_replica: float = 0.0
+    # Scale up while windowed goodput attainment sits below this (only
+    # once at least ``min_judged`` requests were judged in the window —
+    # two unlucky requests must not double the fleet).
+    attainment_target: float = 0.9
+    min_judged: int = 3
+    max_estimated_wait_s: float = 0.0
+    max_queue_depth: float = 0.0
+    up_stabilization_s: float = 30.0
+    down_stabilization_s: float = 120.0
+    cooldown_s: float = 60.0
+    step: int = 1
+    enabled: bool = True
+
+
+@dataclasses.dataclass
+class Decision:
+    role: str
+    current: int
+    target: int
+    direction: str            # up | down | hold
+    reason: str
+    suppressed: Optional[str] = None   # stale | cooldown | stabilizing
+    clamped: bool = False              # min/max bound bit the raw desire
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class RoleScaler:
+    """Hysteresis state for one role. ``decide(now, signals, current)``
+    is the whole API; the instance remembers pressure onsets, the
+    scale-down recommendation window, and the last actuation time."""
+
+    def __init__(self, policy: RolePolicy):
+        self.policy = policy
+        self._up_since: Optional[float] = None
+        self._down_since: Optional[float] = None
+        # (t, desired) recommendations feeding the down-window max.
+        self._recs = collections.deque(maxlen=512)
+        self._last_actuation: Optional[float] = None
+        # Pre-actuation state for revoke() — an actuation the controller
+        # could not land must not burn cooldown/stabilization.
+        self._revoke_state: Optional[tuple] = None
+        self.last_decision: Optional[Decision] = None
+
+    # -- internals --
+
+    def _demand_replicas(self, sig: RoleSignals) -> Optional[int]:
+        p = self.policy
+        if p.target_rps_per_replica <= 0:
+            return None
+        if sig.requests_rps is None and sig.shed_rps is None:
+            return None
+        # Shed demand IS demand: capacity must absorb what admission
+        # turned away, or the shed rate never falls.
+        demand = (sig.requests_rps or 0.0) + (sig.shed_rps or 0.0)
+        return max(0, math.ceil(demand / p.target_rps_per_replica))
+
+    def _up_pressure(self, sig: RoleSignals) -> Optional[str]:
+        p = self.policy
+        if sig.shed_rps is not None and sig.shed_rps > 0:
+            return f"shedding {sig.shed_rps:.2f}/s"
+        if (sig.goodput_attainment is not None
+                and sig.judged >= p.min_judged
+                and sig.goodput_attainment < p.attainment_target):
+            return (f"attainment {sig.goodput_attainment:.2f} < "
+                    f"{p.attainment_target:.2f}")
+        if (p.max_estimated_wait_s > 0 and sig.estimated_wait_s is not None
+                and sig.estimated_wait_s > p.max_estimated_wait_s):
+            return (f"estimated wait {sig.estimated_wait_s:.2f}s > "
+                    f"{p.max_estimated_wait_s:.2f}s")
+        if (p.max_queue_depth > 0 and sig.queue_depth is not None
+                and sig.queue_depth > p.max_queue_depth):
+            return (f"queue depth {sig.queue_depth:.0f} > "
+                    f"{p.max_queue_depth:.0f}")
+        return None
+
+    def _clamp(self, v: int) -> Tuple[int, bool]:
+        p = self.policy
+        c = max(p.min_replicas, v)
+        if p.max_replicas > 0:
+            c = min(p.max_replicas, c)
+        return c, c != v
+
+    def _hold(self, now, current, reason, suppressed=None) -> Decision:
+        d = Decision(self.policy.role, current, current, DIR_HOLD, reason,
+                     suppressed=suppressed)
+        self.last_decision = d
+        return d
+
+    # -- the API --
+
+    def decide(self, now: float, sig: RoleSignals, current: int) -> Decision:
+        p = self.policy
+        if not sig.fresh:
+            # A dead scrape never drives a decision; pressure onsets are
+            # also forgotten — stale time is not evidence of anything.
+            self._up_since = self._down_since = None
+            return self._hold(now, current, "signals stale",
+                              suppressed="stale")
+
+        demand = self._demand_replicas(sig)
+        if demand is not None:
+            self._recs.append((now, demand))
+        pressure = self._up_pressure(sig)
+
+        # ---- scale-up leg ----
+        if pressure is not None or (demand is not None and demand > current):
+            self._down_since = None
+            if self._up_since is None:
+                self._up_since = now
+            if now - self._up_since < p.up_stabilization_s:
+                return self._hold(
+                    now, current,
+                    pressure or f"load wants {demand} replicas",
+                    suppressed="stabilizing")
+            desired = max(current + p.step, demand or 0)
+            target, clamped = self._clamp(desired)
+            if target <= current:
+                return self._hold(now, current,
+                                  f"at max_replicas={p.max_replicas}")
+            return self._actuate(now, current, target, DIR_UP,
+                                 pressure or f"load wants {demand} replicas",
+                                 clamped)
+        self._up_since = None
+
+        # ---- scale-down leg: sustained headroom ----
+        # Headroom = no pressure AND the load computer (or plain idleness)
+        # wants fewer replicas. The effective desire is the MAX
+        # recommendation over the down window, so one quiet sample after a
+        # burst never sheds the burst's capacity.
+        idle = (demand is None and sig.requests_rps is not None
+                and sig.requests_rps <= 1e-9
+                and (sig.queue_depth or 0) <= 1e-9)
+        wants_down = (demand is not None and demand < current) or idle
+        if not wants_down:
+            self._down_since = None
+            return self._hold(now, current, "load matches capacity")
+        if self._down_since is None:
+            self._down_since = now
+        if now - self._down_since < p.down_stabilization_s:
+            return self._hold(now, current, "headroom observed",
+                              suppressed="stabilizing")
+        cutoff = now - p.down_stabilization_s
+        window = [d for (t, d) in self._recs if t >= cutoff]
+        desired = max(window) if window else current - p.step
+        # Land on the window's max recommendation (HPA convention) but
+        # make the decision a real step down — never a no-op "down".
+        desired = min(desired, current - p.step)
+        target, clamped = self._clamp(desired)
+        if target >= current:
+            return self._hold(now, current,
+                              f"at min_replicas={p.min_replicas}")
+        return self._actuate(now, current, target, DIR_DOWN,
+                             "sustained headroom", clamped)
+
+    def _actuate(self, now, current, target, direction, reason,
+                 clamped) -> Decision:
+        p = self.policy
+        if (self._last_actuation is not None
+                and now - self._last_actuation < p.cooldown_s):
+            d = Decision(p.role, current, current, DIR_HOLD,
+                         f"cooldown ({reason})", suppressed="cooldown")
+            self.last_decision = d
+            return d
+        self._revoke_state = (self._last_actuation, self._up_since,
+                              self._down_since)
+        self._last_actuation = now
+        self._up_since = self._down_since = None
+        d = Decision(p.role, current, target, direction, reason,
+                     clamped=clamped)
+        self.last_decision = d
+        return d
+
+    def revoke(self, decision: Decision) -> None:
+        """The controller could not land this actuation (growth gated by
+        the skew clamp, adapter bound, or a lost write): undo the
+        cooldown latch and restore the pressure onsets, so the retry is
+        not charged cooldown_s + a fresh stabilization window for a
+        change that never happened."""
+        if decision is not self.last_decision \
+                or decision.direction == DIR_HOLD:
+            return
+        if self._revoke_state is not None:
+            (self._last_actuation, self._up_since,
+             self._down_since) = self._revoke_state
+            self._revoke_state = None
+
+    def cooldown_remaining(self, now: float) -> float:
+        if self._last_actuation is None:
+            return 0.0
+        return max(0.0, self.policy.cooldown_s - (now - self._last_actuation))
+
+
+# ---- coordinated-ratio mode (PD groups) ------------------------------------
+
+
+@dataclasses.dataclass
+class CoordinatedRoles:
+    """A driver/follower pair scaling in ratio: the follower's raw target
+    is ``driver_target × ratio`` (measured token ratio when the signal
+    plane carries it, ``default_ratio`` otherwise), then BOTH targets pass
+    through the maxSkew clamp so neither side outruns the other's actual
+    progress. Canonical use: ``driver="decode"``, ``follower="prefill"``."""
+
+    driver: str
+    follower: str
+    default_ratio: float = 1.0
+    max_skew_percent: int = 10
+
+
+def follower_raw_target(pair: "CoordinatedRoles", driver_target: int,
+                        follower_policy: RolePolicy,
+                        measured_ratio: Optional[float] = None) -> int:
+    """The follower's UNclamped target: driver × ratio, bounded by the
+    follower's own min/max."""
+    ratio = measured_ratio if measured_ratio is not None \
+        else pair.default_ratio
+    raw = max(1, int(round(driver_target * ratio)))
+    t = max(follower_policy.min_replicas, raw)
+    if follower_policy.max_replicas > 0:
+        t = min(follower_policy.max_replicas, t)
+    return t
+
+
+def coordinated_targets(rbg, pair: CoordinatedRoles,
+                        driver_target: int,
+                        follower_policy: RolePolicy,
+                        measured_ratio: Optional[float] = None,
+                        scaling_policy=None) -> Tuple[Dict[str, int], bool]:
+    """(targets, skew_clamped): the pair's per-role targets for this
+    round, passed through the maxSkew progression gate.
+    ``scaling_policy`` (a ``CoordinatedScaling``) overrides the
+    synthesized one when the group already declares a CoordinatedPolicy —
+    the autoscaler must respect the operator's skew bound, not invent a
+    second one.
+
+    NOTE for actuators: the clamp is a LEVEL-TRIGGERED, per-round
+    progression gate ("later rounds raise them further") — it may hold a
+    rise back while progress lands, but a clamped value below the
+    current replica count is NOT a scale-down decision and must never be
+    persisted as one (see ``gate_growth_only``)."""
+    from rbg_tpu.api.policy import CoordinatedScaling
+    from rbg_tpu.coordination.scaling import clamp_targets
+
+    follower_target = follower_raw_target(pair, driver_target,
+                                          follower_policy, measured_ratio)
+    targets = {pair.driver: driver_target, pair.follower: follower_target}
+    policy = scaling_policy or CoordinatedScaling(
+        roles=[pair.driver, pair.follower],
+        max_skew_percent=pair.max_skew_percent)
+    out = clamp_targets(rbg, policy, dict(targets))
+    return out, out != targets
+
+
+def gate_growth_only(raw: int, current: int, clamped: int) -> int:
+    """Fold the skew clamp into an actuation target without ever
+    shedding capacity: on a rise (raw >= current) the clamp may hold the
+    target anywhere in [current, raw]; on a genuine scale-down the RAW
+    target wins — removing capacity needs no progression gate, and a
+    transiently lagging partner must never deepen it."""
+    if raw >= current:
+        return min(raw, max(clamped, current))
+    return raw

@@ -1,0 +1,172 @@
+"""SignalReader — the autoscaler's consumable view of the windowed-signal
+plane.
+
+One reader wraps the PR-8 surfaces — ``obs/timeseries`` (windowed counter
+rates / gauge means over the registry), ``obs/slo`` (per-role attainment
+and goodput from the live trackers) — plus an optional caller-supplied
+per-role extras hook (router health snapshot, service stats) for the
+signals that only the serving process knows (queue depth, estimated
+wait). Everything lands in one frozen :class:`RoleSignals` per role per
+evaluation, so the policy layer never touches the registry directly.
+
+Staleness is first-class: a dead sampler thread or an empty ring must
+read as "no signal" (``fresh=False``, the policy HOLDS), never as "rate
+fell to zero, scale everything down". The reader judges freshness from
+the sampler's newest-sample age against ``stale_after_s``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+from rbg_tpu.obs import names
+
+
+@dataclasses.dataclass(frozen=True)
+class RoleSignals:
+    """One role's windowed signals at one evaluation instant. ``None``
+    fields mean "not measured in this window" — the policy treats each
+    according to its own semantics (a missing attainment is not a failing
+    one)."""
+
+    role: str
+    window_s: float
+    fresh: bool
+    sample_age_s: Optional[float] = None
+    # windowed rates (per second, label-summed over the window)
+    requests_rps: Optional[float] = None
+    tokens_rps: Optional[float] = None
+    shed_rps: Optional[float] = None
+    goodput_rps: Optional[float] = None
+    # attainment fractions from the SLO trackers (judged-weighted)
+    judged: int = 0
+    ttft_attainment: Optional[float] = None
+    tpot_attainment: Optional[float] = None
+    goodput_attainment: Optional[float] = None
+    # serving-process extras (router health / service stats / simulator)
+    queue_depth: Optional[float] = None
+    estimated_wait_s: Optional[float] = None
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SignalReader:
+    """Query layer: ``read(role)`` -> :class:`RoleSignals`.
+
+    ``extras_fn(role)`` may return a dict carrying ``queue_depth``,
+    ``estimated_wait_s``, and overrides for any rate field — the seam the
+    stress harness and router-fed deployments use for signals the
+    registry does not label per role.
+    """
+
+    def __init__(self, sampler=None, window_s: float = 60.0,
+                 stale_after_s: float = 10.0,
+                 extras_fn: Optional[Callable[[str], dict]] = None):
+        if sampler is None:
+            from rbg_tpu.obs import timeseries
+            sampler = timeseries.get_sampler()
+        self.sampler = sampler
+        self.window_s = float(window_s)
+        self.stale_after_s = float(stale_after_s)
+        self.extras_fn = extras_fn
+
+    # -- freshness --
+
+    def fresh(self, now: Optional[float] = None):
+        """(fresh, age_s): the sampler produced a sample recently enough
+        for windowed queries to describe the present."""
+        age = self.sampler.last_sample_age_s(now=now)
+        if age is None:
+            return False, None
+        return age <= self.stale_after_s, age
+
+    # -- per-role read --
+
+    def read(self, role: str, now: Optional[float] = None) -> RoleSignals:
+        fresh, age = self.fresh(now=now)
+        w = self.window_s
+
+        def rate(name):
+            v = self.sampler.rate(name, w, now=now, role=role)
+            return round(v, 4) if v is not None else None
+
+        sig = {
+            "requests_rps": rate(names.SERVING_REQUESTS_FINISHED_TOTAL),
+            "tokens_rps": rate(names.SERVING_TOKENS_TOTAL),
+            "shed_rps": rate(names.SERVING_SHED_TOTAL),
+            "goodput_rps": rate(names.SLO_GOODPUT_TOTAL),
+        }
+        judged, ttft, tpot, good = self._attainment(role, now=now)
+        extras = {}
+        if self.extras_fn is not None:
+            try:
+                extras = dict(self.extras_fn(role) or {})
+            except Exception:
+                extras = {}
+        for k in sig:
+            if extras.get(k) is not None:
+                sig[k] = float(extras[k])
+        return RoleSignals(
+            role=role, window_s=w, fresh=fresh, sample_age_s=age,
+            judged=judged, ttft_attainment=ttft, tpot_attainment=tpot,
+            goodput_attainment=good,
+            queue_depth=(float(extras["queue_depth"])
+                         if extras.get("queue_depth") is not None else
+                         self._round(self.sampler.mean_observed(
+                             names.SERVING_QUEUE_DEPTH, w, now=now))),
+            estimated_wait_s=(float(extras["estimated_wait_s"])
+                              if extras.get("estimated_wait_s") is not None
+                              else None),
+            **sig,
+        )
+
+    def read_all(self, roles, now: Optional[float] = None
+                 ) -> Dict[str, RoleSignals]:
+        return {r: self.read(r, now=now) for r in roles}
+
+    def measured_ratio(self, num_role: str, den_role: str,
+                       now: Optional[float] = None) -> Optional[float]:
+        """Measured token-rate ratio ``num_role:den_role`` for the
+        coordinated-ratio policy (prefill:decode). Falls back to the
+        judged-request ratio when token counters carry no role label
+        (real engines label tokens per service; routers judge per role).
+        None when neither side measured."""
+        w = self.window_s
+        for name in (names.SERVING_TOKENS_TOTAL, names.SLO_JUDGED_TOTAL):
+            num = self.sampler.rate(name, w, now=now, role=num_role)
+            den = self.sampler.rate(name, w, now=now, role=den_role)
+            if num is not None and den is not None and den > 1e-9:
+                return num / den
+        return None
+
+    # -- internals --
+
+    @staticmethod
+    def _round(v, nd: int = 4):
+        return round(v, nd) if v is not None else None
+
+    def _attainment(self, role: str, now: Optional[float] = None):
+        """Judged-count-weighted attainment for ``role`` across every live
+        tracker (a PD pair runs one tracker per service; the router adds
+        its own — each judges a disjoint population)."""
+        from rbg_tpu.obs import slo as slo_mod
+        judged = 0
+        met = [0.0, 0.0, 0.0]
+        for tracker in slo_mod.trackers():
+            groups = tracker.attainment(self.window_s, group_by=("role",),
+                                        now=now)
+            g = groups.get(f"role={role}")
+            if not g or not g["judged"]:
+                continue
+            n = g["judged"]
+            judged += n
+            for i, k in enumerate(("ttft_attainment", "tpot_attainment",
+                                   "goodput_attainment")):
+                if g[k] is not None:
+                    met[i] += g[k] * n
+        if not judged:
+            return 0, None, None, None
+        return (judged, round(met[0] / judged, 4), round(met[1] / judged, 4),
+                round(met[2] / judged, 4))

@@ -65,7 +65,15 @@ def _collect_router(addr: str, token: Optional[str]) -> dict:
 def _collect_admin(addr: str, token: Optional[str], window: int) -> dict:
     tok = token if token is not None else os.environ.get("RBG_ADMIN_TOKEN", "")
     resp = _call(addr, {"op": "slo", "window": window}, tok or None)
-    return {"kind": "admin", "addr": addr, "slo": resp}
+    out = {"kind": "admin", "addr": addr, "slo": resp}
+    # Autoscaler posture (optional — older/unconfigured planes answer
+    # with an error, which just omits the section).
+    try:
+        auto = _call(addr, {"op": "autoscale"}, tok or None)
+        out["autoscale"] = auto.get("autoscale")
+    except (OSError, RuntimeError, ConnectionError):
+        pass
+    return out
 
 
 _ROLE_HDR = (f"  {'ROLE':<10} {'OCC':>6} {'QDEPTH':>7} {'REQ/S':>7} "
@@ -166,6 +174,25 @@ def _render_admin(src: dict, window: int) -> List[str]:
     lines.append(_ROLE_HDR)
     lines.extend(_tracker_role_rows(slo.get("trackers") or [], window,
                                     signals, {}))
+    auto = src.get("autoscale")
+    if auto:
+        lines.append(
+            f"  autoscale — eval every {auto.get('eval_period_s')}s, "
+            f"window {auto.get('window_s')}s, spares "
+            f"{auto.get('spare_slices_available', '—')}")
+        lines.append(f"  {'ROLE':<10} {'TARGET':>6} {'ACTUAL':>6} "
+                     f"{'ON':>3} {'COOL-S':>7}  LAST DECISION")
+        for r in auto.get("roles") or []:
+            last = r.get("last_decision") or {}
+            what = last.get("direction", "—")
+            if last.get("suppressed"):
+                what = f"{what}/{last['suppressed']}"
+            lines.append(
+                f"  {r.get('role', ''):<10} {r.get('target', 0):>6} "
+                f"{r.get('actual', 0):>6} "
+                f"{'y' if r.get('enabled') else 'n':>3} "
+                f"{r.get('cooldown_remaining_s', 0):>7}  "
+                f"{what}: {last.get('reason', '')}")
     return lines
 
 

@@ -47,6 +47,13 @@ SLO_JUDGED_TOTAL = "rbg_slo_judged_total"
 SLO_TTFT_MET_TOTAL = "rbg_slo_ttft_met_total"
 SLO_TPOT_MET_TOTAL = "rbg_slo_tpot_met_total"
 SLO_GOODPUT_TOTAL = "rbg_slo_goodput_total"
+AUTOSCALE_DECISIONS_TOTAL = "rbg_autoscale_decisions_total"
+AUTOSCALE_CLAMPED_TOTAL = "rbg_autoscale_clamped_total"
+AUTOSCALE_COOLDOWN_SUPPRESSED_TOTAL = (
+    "rbg_autoscale_cooldown_suppressed_total")
+AUTOSCALE_STALE_HOLDS_TOTAL = "rbg_autoscale_stale_holds_total"
+AUTOSCALE_CONFLICTS_TOTAL = "rbg_autoscale_conflicts_total"
+AUTOSCALE_SPARE_GRANTS_TOTAL = "rbg_autoscale_spare_grants_total"
 
 # ---- gauges (last-write-wins) ----
 
@@ -58,6 +65,8 @@ SLO_TPOT_ATTAINMENT = "rbg_slo_tpot_attainment"
 SLO_GOODPUT_RPS = "rbg_slo_goodput_rps"
 ROUTER_BACKEND_OUTSTANDING = "rbg_router_backend_outstanding"
 ROUTER_BACKEND_DRAINING = "rbg_router_backend_draining"
+AUTOSCALE_TARGET_REPLICAS = "rbg_autoscale_target_replicas"
+AUTOSCALE_ACTUAL_REPLICAS = "rbg_autoscale_actual_replicas"
 
 # ---- histograms ----
 
@@ -95,6 +104,12 @@ COUNTERS = frozenset({
     SLO_TTFT_MET_TOTAL,
     SLO_TPOT_MET_TOTAL,
     SLO_GOODPUT_TOTAL,
+    AUTOSCALE_DECISIONS_TOTAL,
+    AUTOSCALE_CLAMPED_TOTAL,
+    AUTOSCALE_COOLDOWN_SUPPRESSED_TOTAL,
+    AUTOSCALE_STALE_HOLDS_TOTAL,
+    AUTOSCALE_CONFLICTS_TOTAL,
+    AUTOSCALE_SPARE_GRANTS_TOTAL,
 })
 
 GAUGES = frozenset({
@@ -106,6 +121,8 @@ GAUGES = frozenset({
     SLO_GOODPUT_RPS,
     ROUTER_BACKEND_OUTSTANDING,
     ROUTER_BACKEND_DRAINING,
+    AUTOSCALE_TARGET_REPLICAS,
+    AUTOSCALE_ACTUAL_REPLICAS,
 })
 
 HISTOGRAMS = frozenset({
@@ -176,6 +193,22 @@ HELP = {
     ROUTER_BACKEND_OUTSTANDING:
         "In-flight requests the router holds against one backend",
     ROUTER_BACKEND_DRAINING: "1 while the router sees this backend draining",
+    AUTOSCALE_DECISIONS_TOTAL:
+        "Autoscaler actuations per role and direction (up/down)",
+    AUTOSCALE_CLAMPED_TOTAL:
+        "Autoscaler targets clamped by min/max or the coordination skew "
+        "bound",
+    AUTOSCALE_COOLDOWN_SUPPRESSED_TOTAL:
+        "Autoscaler decisions suppressed by the post-actuation cooldown",
+    AUTOSCALE_STALE_HOLDS_TOTAL:
+        "Autoscaler evaluations held because the signal plane was stale",
+    AUTOSCALE_CONFLICTS_TOTAL:
+        "Autoscaler back-offs after a foreign writer touched the adapter",
+    AUTOSCALE_SPARE_GRANTS_TOTAL:
+        "Warm spare slices granted to autoscaler-created instances",
+    AUTOSCALE_TARGET_REPLICAS:
+        "Replica target the autoscaler last wrote, per role",
+    AUTOSCALE_ACTUAL_REPLICAS: "Ready replicas observed per role",
     SLO_TTFT_SECONDS: "Time to first token of judged requests",
     SLO_TPOT_SECONDS:
         "Per-output-token latency after the first token, per judged "

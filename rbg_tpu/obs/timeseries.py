@@ -125,6 +125,17 @@ class TimeSeriesSampler:
                 t = self._samples[-1][0]   # append order IS time order
             self._samples.append((t, counters, gauges, hists))
 
+    def last_sample_age_s(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since the newest sample (None when nothing was sampled
+        yet). The staleness input for control loops: a dead scrape thread
+        must read as 'no signal', never as 'rate fell to zero'."""
+        with self._lock:
+            if not self._samples:
+                return None
+            last = self._samples[-1][0]
+        anchor = time.monotonic() if now is None else float(now)
+        return max(0.0, anchor - last)
+
     def stats(self) -> dict:
         with self._lock:
             n = len(self._samples)

@@ -140,6 +140,22 @@ class _Handler(socketserver.BaseRequestHandler):
             # signals over the timeseries sampler's ring buffer.
             from rbg_tpu.obs.slo import slo_response
             return slo_response(obj.get("window"))
+        if op == "autoscale":
+            # Autoscaler posture: per-role target vs actual, last decision
+            # (direction + reason), cooldown, conflicts — plus a per-role
+            # runtime kill switch ({"op":"autoscale","disable":"<role>"} /
+            # "enable"). Wire-facing: unknown roles return an error, never
+            # an exception.
+            ac = getattr(self.server.plane, "autoscale_controller", None)
+            if ac is None:
+                return {"error": "autoscaler not enabled on this plane"}
+            for key, want in (("enable", True), ("disable", False)):
+                role = obj.get(key)
+                if role is not None:
+                    if not ac.set_enabled(str(role), want):
+                        return {"error": f"role {role!r} is not under "
+                                         f"autoscaler control"}
+            return {"autoscale": ac.status()}
         if op == "traces":
             # Operator pull of the trace sink: recent + slowest-N ring
             # buffers, the slowest request's rendered waterfall, and the

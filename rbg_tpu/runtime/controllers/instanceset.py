@@ -190,11 +190,21 @@ class RoleInstanceSetController(Controller):
                 existing.add(iname)
                 self._create_instance(store, ris, iname, -1, revision)
         elif diff < 0:
-            # delete preference: not-ready first, then outdated, then newest
+            # delete preference: not-ready first, then outdated, then
+            # lowest scale-down cost (the autoscaler stamps observed
+            # in-flight streams — the emptiest instance drains first;
+            # unstamped instances read as 0, preserving the old order),
+            # then newest.
             def key(i):
+                try:
+                    cost = float(i.metadata.annotations.get(
+                        C.ANN_SCALE_DOWN_COST) or 0.0)
+                except ValueError:
+                    cost = 0.0
                 return (
                     instance_ready(i),
                     i.metadata.labels.get(C.LABEL_REVISION_NAME) == revision,
+                    cost,
                     -i.metadata.creation_timestamp,
                 )
 

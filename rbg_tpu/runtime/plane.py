@@ -25,7 +25,7 @@ from rbg_tpu.sched.scheduler import SchedulerController
 class ControlPlane:
     def __init__(self, store: Optional[Store] = None, backend: str = "fake",
                  ready_delay: float = 0.0, executor_env: Optional[dict] = None,
-                 k8s_client=None, warm_spares: int = 0):
+                 k8s_client=None, warm_spares: int = 0, autoscale=None):
         self.store = store or Store()
         self.manager = Manager(self.store)
         self.node_binding = NodeBindingStore(self.store)
@@ -55,6 +55,16 @@ class ControlPlane:
         self.disruption_controller = self.manager.register(
             DisruptionController(self.store, node_binding=self.node_binding,
                                  spares=self.spares))
+        # SLO-driven autoscaler (rbg_tpu/autoscale): reads the windowed
+        # signal plane, writes role targets through ScalingAdapter. Off
+        # unless an AutoscaleConfig is passed — capacity is operator-owned
+        # by default.
+        self.autoscale_controller = None
+        if autoscale is not None:
+            from rbg_tpu.autoscale import AutoscaleController
+            self.autoscale_controller = self.manager.register(
+                AutoscaleController(self.store, autoscale,
+                                    spares=self.spares))
         self._register_optional()
 
         self.kubelet = None

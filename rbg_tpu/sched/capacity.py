@@ -291,6 +291,14 @@ class SparePool:
         with self._lock:
             return slice_id in self._reserved
 
+    def available(self, topology: Optional[str] = None) -> int:
+        """Reserved spares a ``take`` could grant right now (peek, never
+        consumes). The autoscaler reads this to report how much of a
+        scale-up is bind-time instant vs provision-bound."""
+        with self._lock:
+            return sum(1 for t in self._reserved.values()
+                       if topology is None or t == topology)
+
     def depth(self) -> Dict[str, int]:
         """topology -> reserved spare count (the pool-depth gauge)."""
         out: Dict[str, int] = {}

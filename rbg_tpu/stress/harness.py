@@ -442,6 +442,323 @@ def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
     return report
 
 
+# ---- SLO-driven autoscaling scenario ---------------------------------------
+
+
+@dataclasses.dataclass
+class AutoscaleStressConfig:
+    """Capacity-follows-load drill: a diurnal + burst Poisson trace
+    against a LIVE mini-plane (fake fleet, real group/instance/scheduler
+    controllers, real AutoscaleController writing real ScalingAdapters).
+    A simulated serving role turns ready-replica capacity into completed
+    requests, judges them against an SLO, and publishes the same windowed
+    signals a real engine would — the autoscaler closes the loop, and the
+    drill asserts that it did: targets rise within an evaluation period
+    of the burst, fall after it, scale-down drains without dropping one
+    in-flight stream, every finished request is judged, and goodput never
+    collapses."""
+
+    duration_s: float = 14.0
+    tick_s: float = 0.05
+    # Offered-load profile: slow diurnal sine from base to peak across
+    # the run, plus a flat burst on top inside the burst window.
+    base_rps: float = 10.0
+    peak_rps: float = 28.0
+    burst_rps: float = 85.0
+    burst_start_frac: float = 0.40
+    burst_end_frac: float = 0.62
+    # Simulated role capacity: each ready, non-draining replica completes
+    # this many requests per second.
+    per_replica_rps: float = 12.0
+    queue_limit: int = 120          # admission bound — beyond this, shed
+    slo_wait_s: float = 0.6         # TTFT target the sim judges against
+    min_replicas: int = 1
+    max_replicas: int = 10
+    eval_period_s: float = 0.4
+    window_s: float = 2.0
+    stale_after_s: float = 1.5
+    up_stabilization_s: float = 0.3
+    down_stabilization_s: float = 2.0
+    cooldown_s: float = 0.5
+    drain_s: float = 6.0            # scale-down drain window
+    # Without the autoscaler this trace pins attainment near zero from
+    # the burst on; the floor asserts the loop kept roughly half of all
+    # requests green, with margin over observed run-to-run noise
+    # (~0.55-0.59 on this box).
+    goodput_floor: float = 0.45
+    seed: int = 7
+    timeout_s: float = 60.0
+
+
+def _poisson(rng, lam: float) -> int:
+    """Knuth's Poisson sampler (lam is small — per-tick arrivals)."""
+    if lam <= 0:
+        return 0
+    limit = __import__("math").exp(-lam)
+    k, p = 0, 1.0
+    while True:
+        p *= rng.random()
+        if p <= limit:
+            return k
+        k += 1
+
+
+def run_autoscale(cfg: AutoscaleStressConfig) -> dict:
+    import math
+
+    from rbg_tpu.api.group import IdentityMode, ScalingAdapterHook
+    from rbg_tpu.autoscale import AutoscaleConfig, RolePolicy
+    from rbg_tpu.obs import slo as slo_mod, timeseries
+    from rbg_tpu.obs.slo import SLOTargets, SLOTracker
+    from rbg_tpu.runtime.controllers.scalingadapter import adapter_name
+
+    role_name = "serve"
+    group_name = "asc"
+    rng = __import__("random").Random(cfg.seed)
+
+    # Shared sim state read by the controller's hooks. Whole-dict
+    # reassignment keeps reads torn-free without a lock (the hooks only
+    # ever read the current reference).
+    hook_state = {"queue_depth": 0.0, "estimated_wait_s": 0.0}
+    stream_view: Dict[str, float] = {}
+
+    def extras_fn(_role):
+        return hook_state
+
+    def inflight_fn(pod_name):
+        return stream_view.get(pod_name, 0.0)
+
+    policy = RolePolicy(
+        role=role_name, min_replicas=cfg.min_replicas,
+        max_replicas=cfg.max_replicas,
+        target_rps_per_replica=cfg.per_replica_rps,
+        attainment_target=0.9, min_judged=3,
+        max_estimated_wait_s=cfg.slo_wait_s,
+        up_stabilization_s=cfg.up_stabilization_s,
+        down_stabilization_s=cfg.down_stabilization_s,
+        cooldown_s=cfg.cooldown_s)
+    auto_cfg = AutoscaleConfig(
+        roles={role_name: policy}, eval_period_s=cfg.eval_period_s,
+        window_s=cfg.window_s, stale_after_s=cfg.stale_after_s,
+        extras_fn=extras_fn, inflight_streams_fn=inflight_fn)
+
+    slo_mod.reset_trackers()
+    tracker = SLOTracker(SLOTargets(ttft_s=cfg.slo_wait_s, tpot_s=0.5),
+                         component="autoscale-sim")
+    sampler = timeseries.get_sampler()
+
+    plane = ControlPlane(backend="fake", autoscale=auto_cfg)
+    make_tpu_nodes(plane.store, slices=4, hosts_per_slice=4)
+    role = simple_role(role_name, replicas=cfg.min_replicas)
+    role.identity = IdentityMode.RANDOM      # stateless: drain lifecycle
+    role.drain_seconds = cfg.drain_s
+    role.scaling_adapter = ScalingAdapterHook(
+        enabled=True, min_replicas=cfg.min_replicas,
+        max_replicas=cfg.max_replicas)
+    counters_before = {
+        name: REGISTRY.counter(name, role=role_name)
+        for name in (metric_names.SERVING_SHED_TOTAL,
+                     metric_names.SERVING_REQUESTS_FINISHED_TOTAL)}
+    decisions_before = {
+        d: REGISTRY.counter(metric_names.AUTOSCALE_DECISIONS_TOTAL,
+                            role=role_name, direction=d)
+        for d in ("up", "down")}
+    t_run = time.perf_counter()
+    plane.start()
+    inv: Dict[str, bool] = {}
+    curve: List[dict] = []
+    dropped = [0]
+    finished_total = [0]
+    shed_total = [0]
+    judged_before = tracker.judged_total()
+    sa_name = adapter_name(group_name, role_name)
+    try:
+        plane.apply(make_group(group_name, role))
+        plane.wait_group_ready(group_name, timeout=cfg.timeout_s)
+        plane.wait_for(
+            lambda: plane.store.get("ScalingAdapter", "default", sa_name),
+            timeout=cfg.timeout_s, desc="auto-created scaling adapter")
+
+        def role_pods():
+            return [p for p in plane.store.list("Pod", namespace="default")
+                    if p.metadata.labels.get(C.LABEL_GROUP_NAME) == group_name
+                    and p.metadata.labels.get(C.LABEL_ROLE_NAME) == role_name]
+
+        def is_draining(p) -> bool:
+            return (p.metadata.annotations.get(C.ANN_LIFECYCLE_STATE)
+                    == C.LIFECYCLE_PREPARING_DELETE)
+
+        def target_now() -> int:
+            sa = plane.store.get("ScalingAdapter", "default", sa_name,
+                                 copy_=False)
+            if sa is not None and sa.spec.replicas is not None:
+                return sa.spec.replicas
+            g = plane.store.get("RoleBasedGroup", "default", group_name,
+                                copy_=False)
+            return g.spec.role(role_name).replicas if g is not None else 0
+
+        streams: Dict[str, float] = {}   # pod -> in-flight streams
+        queue = 0.0
+        burst_t0 = cfg.duration_s * cfg.burst_start_frac
+        burst_t1 = cfg.duration_s * cfg.burst_end_frac
+        target_pre_burst: Optional[int] = None
+        burst_react_s: Optional[float] = None
+        t0 = time.perf_counter()
+        while True:
+            now = time.perf_counter() - t0
+            if now >= cfg.duration_s:
+                break
+            frac = now / cfg.duration_s
+            lam = (cfg.base_rps + (cfg.peak_rps - cfg.base_rps)
+                   * math.sin(math.pi * frac) ** 2)
+            in_burst = burst_t0 <= now < burst_t1
+            if in_burst:
+                lam += cfg.burst_rps
+            arrivals = _poisson(rng, lam * cfg.tick_s)
+
+            pods = role_pods()
+            live = {p.metadata.name for p in pods if p.active}
+            serving = [p for p in pods
+                       if p.active and p.running_ready and not is_draining(p)]
+            draining = [p for p in pods if p.active and is_draining(p)]
+
+            # Streams: lost pods with in-flight streams are DROPS (the
+            # invariant); draining pods finish theirs and ack; serving
+            # pods carry a stream population proportional to load.
+            for name in [n for n in streams if n not in live]:
+                if streams[name] > 0:
+                    dropped[0] += int(streams[name])
+                del streams[name]
+            for p in draining:
+                n = streams.get(p.metadata.name, 0.0)
+                if n > 0:
+                    streams[p.metadata.name] = max(0.0, n - 2.0)
+                if streams.get(p.metadata.name, 0.0) <= 0:
+                    iname = p.metadata.labels.get(C.LABEL_INSTANCE_NAME)
+                    if iname:
+                        def ack(i):
+                            if i.metadata.annotations.get(
+                                    C.ANN_DRAIN_COMPLETE) == "true":
+                                return False
+                            i.metadata.annotations[
+                                C.ANN_DRAIN_COMPLETE] = "true"
+                            return True
+                        try:
+                            plane.store.mutate("RoleInstance", "default",
+                                               iname, ack)
+                        except Exception:
+                            pass
+            want_streams = min(len(serving) * 4, int(lam / 4) + 1)
+            have = sum(int(streams.get(p.metadata.name, 0.0))
+                       for p in serving)
+            for p in serving:
+                if have >= want_streams:
+                    break
+                streams[p.metadata.name] = streams.get(p.metadata.name,
+                                                       0.0) + 1
+                have += 1
+            # Rebinding the locals the closures capture is the publish
+            # step: extras_fn / inflight_fn read the current dicts.
+            stream_view = dict(streams)
+
+            # Service model: capacity completes queue, overflow sheds.
+            cap_rps = len(serving) * cfg.per_replica_rps
+            queue += arrivals
+            completed = min(queue, cap_rps * cfg.tick_s)
+            queue -= completed
+            wait_s = queue / cap_rps if cap_rps > 0 else float(
+                cfg.slo_wait_s * 10)
+            overflow = max(0.0, queue - cfg.queue_limit)
+            if overflow >= 1.0:
+                n_shed = int(overflow)
+                queue -= n_shed
+                shed_total[0] += n_shed
+                REGISTRY.inc(metric_names.SERVING_SHED_TOTAL, float(n_shed),
+                             role=role_name)
+            n_done = int(round(completed))
+            if n_done:
+                finished_total[0] += n_done
+                REGISTRY.inc(metric_names.SERVING_REQUESTS_FINISHED_TOTAL,
+                             float(n_done), role=role_name)
+                REGISTRY.inc(metric_names.SERVING_TOKENS_TOTAL,
+                             float(n_done * 8), role=role_name)
+                for _ in range(n_done):
+                    tracker.judge(wait_s, 0.01, role=role_name)
+            hook_state = {"queue_depth": queue, "estimated_wait_s": wait_s}
+            sampler.sample_now()
+
+            tgt = target_now()
+            if in_burst and target_pre_burst is None:
+                target_pre_burst = tgt
+            if (target_pre_burst is not None and burst_react_s is None
+                    and tgt > target_pre_burst):
+                burst_react_s = round(now - burst_t0, 3)
+            curve.append({
+                "t": round(now, 3),
+                "offered_rps": round(lam, 2),
+                "capacity_rps": round(cap_rps, 2),
+                "queue": round(queue, 1),
+                "target": tgt,
+                "actual": len(serving),
+            })
+            time.sleep(cfg.tick_s)
+        status = (plane.autoscale_controller.status()
+                  if plane.autoscale_controller else {})
+    finally:
+        plane.stop()
+
+    judged = tracker.judged_total() - judged_before
+    totals = tracker.totals()
+    goodput_frac = totals["goodput"] / judged if judged else None
+    peak_target = max((c["target"] for c in curve), default=0)
+    end_target = curve[-1]["target"] if curve else 0
+    # Deltas from the pre-run snapshot: the registry is process-global,
+    # and an in-process caller (a test suite) may have scaled this role
+    # name before — absolute values would let a prior run's scale-down
+    # satisfy THIS run's invariant.
+    decisions = {
+        d: REGISTRY.counter(metric_names.AUTOSCALE_DECISIONS_TOTAL,
+                            role=role_name, direction=d)
+        - decisions_before[d]
+        for d in ("up", "down")}
+    # Reaction bound: pressure must be noticed at one evaluation and
+    # actuated by the next once the up-stabilization window passed —
+    # two evaluation periods end to end, plus scheduling slack.
+    react_bound = 2 * cfg.eval_period_s + cfg.up_stabilization_s + 0.75
+    inv["capacity_follows_load"] = (
+        burst_react_s is not None and burst_react_s <= react_bound)
+    inv["targets_fell_after_burst"] = (end_target < peak_target
+                                      and decisions["down"] >= 1)
+    inv["zero_dropped_streams"] = dropped[0] == 0
+    inv["slo_accounted"] = judged == finished_total[0]
+    inv["goodput_floor"] = (goodput_frac is not None
+                            and goodput_frac >= cfg.goodput_floor)
+    return {
+        "scenario": "autoscale",
+        "config": dataclasses.asdict(cfg),
+        "elapsed_s": round(time.perf_counter() - t_run, 3),
+        "burst_react_s": burst_react_s,
+        "burst_react_bound_s": round(react_bound, 3),
+        "peak_target": peak_target,
+        "end_target": end_target,
+        "requests": {
+            "finished": finished_total[0],
+            "shed": shed_total[0],
+            "judged": judged,
+            "goodput_fraction": (round(goodput_frac, 4)
+                                 if goodput_frac is not None else None),
+            "dropped_streams": dropped[0],
+        },
+        "decisions": {k: round(v, 1) for k, v in decisions.items()},
+        "autoscale_status": status,
+        "curve": curve,
+        "counters_delta": {
+            name: round(REGISTRY.counter(name, role=role_name) - v, 1)
+            for name, v in counters_before.items()},
+        "invariants": inv,
+    }
+
+
 # ---- slice preemption / self-healing scenario ------------------------------
 
 
@@ -772,12 +1089,15 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(prog="rbg-tpu-stress")
     ap.add_argument("--scenario", default="churn",
-                    choices=["churn", "overload", "preemption"],
+                    choices=["churn", "overload", "preemption", "autoscale"],
                     help="churn = control-plane create/update/delete "
                          "percentiles; overload = serving-plane admission "
                          "control drill (sheds, deadlines, queue bound); "
                          "preemption = slice disruption drill (gang "
-                         "semantics, deadline migration, router replay)")
+                         "semantics, deadline migration, router replay); "
+                         "autoscale = capacity-follows-load drill (diurnal "
+                         "+ burst trace against a live mini-plane, the "
+                         "autoscaler closing the signal→capacity loop)")
     ap.add_argument("--clients", type=int, default=6)
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--max-queue", type=int, default=4)
@@ -792,6 +1112,11 @@ def main(argv=None) -> int:
     ap.add_argument("--warm-spares", type=int, default=1,
                     help="standby slices reserved per topology "
                          "(preemption scenario)")
+    ap.add_argument("--duration-s", type=float, default=14.0,
+                    help="trace length for the autoscale scenario")
+    ap.add_argument("--burst-rps", type=float, default=85.0,
+                    help="burst magnitude on top of the diurnal profile "
+                         "(autoscale scenario)")
     ap.add_argument("--notice-s", type=float, default=25.0,
                     help="maintenance notice window before the deadline "
                          "(preemption scenario)")
@@ -873,13 +1198,17 @@ def main(argv=None) -> int:
             r: REGISTRY.counter(metric_names.TRACE_TRACES_TOTAL, result=r)
             for r in ("complete", "incomplete", "leaked")}
     load1 = os.getloadavg()[0]
-    if args.scenario in ("overload", "preemption"):
+    if args.scenario in ("overload", "preemption", "autoscale"):
         if args.scenario == "overload":
             report = run_serving_overload(OverloadConfig(
                 clients=args.clients, requests_per_client=args.requests,
                 max_queue=args.max_queue, max_batch=args.max_batch,
                 timeout_s=args.timeout_s,
                 slo_ttft_s=args.slo_ttft_s, slo_tpot_s=args.slo_tpot_s))
+        elif args.scenario == "autoscale":
+            report = run_autoscale(AutoscaleStressConfig(
+                duration_s=args.duration_s, burst_rps=args.burst_rps,
+                timeout_s=args.timeout_s))
         else:
             report = run_preemption(PreemptionConfig(
                 groups=max(2, args.groups) if args.groups else 2,
@@ -1076,6 +1405,158 @@ def _overload_sections(report: dict) -> str:
 <h2>invariants</h2>{_invariants_table(report.get("invariants") or {})}"""
 
 
+def _autoscale_curve_html(report: dict) -> str:
+    """Capacity-vs-load curve: two stacked single-axis panels over one
+    time axis (req/s above, replicas below — different units never share
+    an axis), thin 2px lines, recessive grid, legend + line-end labels,
+    a crosshair hover layer, and a data-table view."""
+    curve = report.get("curve") or []
+    if len(curve) < 2:
+        return "<p>(no curve samples)</p>"
+    ml, mr, mt, ph, gap, iw = 46, 96, 14, 132, 30, 560
+    W = ml + iw + mr
+    x1 = curve[-1]["t"] or 1.0
+    panels = [
+        ("req/s", (("offered_rps", "offered", "#2a78d6"),
+                   ("capacity_rps", "capacity", "#eb6834"))),
+        ("replicas", (("target", "target", "#1baf7a"),
+                      ("actual", "actual", "#eda100"))),
+    ]
+    svg = []
+    H = mt + ph * 2 + gap + 22
+    svg.append(f'<svg id="asc-svg" viewBox="0 0 {W} {H}" width="{W}" '
+               f'height="{H}" role="img" '
+               f'aria-label="capacity vs load over time">')
+    for pi, (unit, series) in enumerate(panels):
+        top = mt + pi * (ph + gap)
+        ymax = max(max(c[k] for c in curve) for k, _, _ in series) or 1.0
+        ymax = float(__import__("math").ceil(ymax * 1.1))
+        for gi in range(5):
+            gy = top + ph - gi * ph / 4
+            val = ymax * gi / 4
+            svg.append(
+                f'<line x1="{ml}" y1="{gy:.1f}" x2="{ml + iw}" '
+                f'y2="{gy:.1f}" stroke="#e4e3de" stroke-width="1"/>'
+                f'<text x="{ml - 6}" y="{gy + 3.5:.1f}" text-anchor="end" '
+                f'class="vt">{val:g}</text>')
+        svg.append(f'<text x="{ml}" y="{top - 4}" class="vt">{unit}</text>')
+        for key, label, color in series:
+            pts = " ".join(
+                f'{ml + c["t"] / x1 * iw:.1f},'
+                f'{top + ph - min(1.0, c[key] / ymax) * ph:.1f}'
+                for c in curve)
+            last = curve[-1]
+            ly = top + ph - min(1.0, last[key] / ymax) * ph
+            svg.append(
+                f'<polyline points="{pts}" fill="none" stroke="{color}" '
+                f'stroke-width="2" stroke-linejoin="round"/>'
+                f'<circle cx="{ml + iw:.1f}" cy="{ly:.1f}" r="4" '
+                f'fill="{color}"/>'
+                f'<text x="{ml + iw + 8}" y="{ly + 3.5:.1f}" class="vl">'
+                f'{label} {last[key]:g}</text>')
+    for tx in range(0, 5):
+        t = x1 * tx / 4
+        px = ml + t / x1 * iw
+        svg.append(f'<text x="{px:.1f}" y="{H - 6}" text-anchor="middle" '
+                   f'class="vt">{t:.1f}s</text>')
+    # Burst window shading (context, behind the hover layer).
+    cfg = report.get("config") or {}
+    if cfg.get("burst_start_frac") is not None:
+        bx0 = ml + cfg["burst_start_frac"] * iw
+        bx1 = ml + cfg["burst_end_frac"] * iw
+        svg.insert(1, f'<rect x="{bx0:.1f}" y="{mt}" '
+                      f'width="{bx1 - bx0:.1f}" '
+                      f'height="{ph * 2 + gap}" fill="#52514e" '
+                      f'opacity="0.06"/>')
+    svg.append(f'<line id="asc-cross" x1="0" x2="0" y1="{mt}" '
+               f'y2="{mt + ph * 2 + gap}" stroke="#52514e" '
+               f'stroke-width="1" opacity="0"/>')
+    svg.append(f'<rect id="asc-hit" x="{ml}" y="{mt}" width="{iw}" '
+               f'height="{ph * 2 + gap}" fill="transparent"/>')
+    svg.append("</svg>")
+    legend = "".join(
+        f'<span class="chip" style="background:{color}"></span>'
+        f'<span class="vl">{label}</span>'
+        for _, series in panels for _, label, color in series)
+    step = max(1, len(curve) // 40)
+    rows = "".join(
+        f'<tr><td>{c["t"]}</td><td>{c["offered_rps"]}</td>'
+        f'<td>{c["capacity_rps"]}</td><td>{c["target"]}</td>'
+        f'<td>{c["actual"]}</td><td>{c["queue"]}</td></tr>'
+        for c in curve[::step])
+    data = json.dumps([[c["t"], c["offered_rps"], c["capacity_rps"],
+                        c["target"], c["actual"]] for c in curve])
+    return f"""<div class="viz-root" style="position:relative">
+<style>.viz-root{{color-scheme:light}}
+.viz-root .vt{{font:10px sans-serif;fill:#52514e}}
+.viz-root .vl{{font:11px sans-serif;fill:#0b0b0b;color:#0b0b0b;
+margin-right:10px}}
+.viz-root .chip{{display:inline-block;width:10px;height:10px;
+border-radius:2px;margin:0 4px 0 0;vertical-align:-1px}}
+#asc-tip{{position:absolute;display:none;background:#fff;
+border:1px solid #c3c2b7;border-radius:4px;padding:4px 8px;
+font:11px sans-serif;color:#0b0b0b;pointer-events:none;
+box-shadow:0 1px 3px rgba(0,0,0,.15)}}</style>
+<div>{legend}</div>
+{"".join(svg)}
+<div id="asc-tip"></div>
+<script>(function(){{
+var D={data}, svg=document.getElementById("asc-svg"),
+ tip=document.getElementById("asc-tip"),
+ cross=document.getElementById("asc-cross"),
+ hit=document.getElementById("asc-hit"),
+ ml={ml}, iw={iw}, x1={x1};
+hit.addEventListener("mousemove", function(ev){{
+ var pt=svg.createSVGPoint(); pt.x=ev.clientX; pt.y=ev.clientY;
+ var p=pt.matrixTransform(svg.getScreenCTM().inverse());
+ var t=(p.x-ml)/iw*x1, best=D[0], bd=1e9;
+ for (var i=0;i<D.length;i++) {{var d=Math.abs(D[i][0]-t);
+  if(d<bd){{bd=d;best=D[i];}}}}
+ cross.setAttribute("x1", ml+best[0]/x1*iw);
+ cross.setAttribute("x2", ml+best[0]/x1*iw);
+ cross.setAttribute("opacity", "0.5");
+ tip.style.display="block";
+ tip.style.left=(ev.offsetX+14)+"px"; tip.style.top=(ev.offsetY+8)+"px";
+ tip.innerHTML="t="+best[0].toFixed(2)+"s<br>offered "+best[1]
+  +" r/s<br>capacity "+best[2]+" r/s<br>target "+best[3]
+  +" · actual "+best[4];
+}});
+hit.addEventListener("mouseleave", function(){{
+ tip.style.display="none"; cross.setAttribute("opacity","0");}});
+}})();</script>
+<details><summary>data table</summary>
+<table><tr><th>t (s)</th><th>offered r/s</th><th>capacity r/s</th>
+<th>target</th><th>actual</th><th>queue</th></tr>{rows}</table>
+</details></div>"""
+
+
+def _autoscale_sections(report: dict) -> str:
+    req = report.get("requests") or {}
+    reaction = {
+        "burst_react_s": report.get("burst_react_s"),
+        "burst_react_bound_s": report.get("burst_react_bound_s"),
+        "peak_target": report.get("peak_target"),
+        "end_target": report.get("end_target"),
+    }
+    roles = ((report.get("autoscale_status") or {}).get("roles")) or []
+    role_rows = "".join(
+        f"<tr><td>{r.get('role')}</td><td>{r.get('target')}</td>"
+        f"<td>{r.get('actual')}</td>"
+        f"<td>{'yes' if r.get('enabled') else 'no'}</td>"
+        f"<td>{(r.get('last_decision') or {}).get('direction')}: "
+        f"{(r.get('last_decision') or {}).get('reason')}</td></tr>"
+        for r in roles)
+    return f"""<h2>capacity vs load</h2>{_autoscale_curve_html(report)}
+<h2>burst reaction</h2>{_kv_table(reaction)}
+<h2>requests</h2>{_kv_table(req)}
+<h2>autoscaler decisions (this run)</h2>{_kv_table(
+        report.get("decisions") or {})}
+<h2>autoscaler posture at end</h2>
+<table><tr><th>role</th><th>target</th><th>actual</th><th>enabled</th>
+<th>last decision</th></tr>{role_rows}</table>
+<h2>invariants</h2>{_invariants_table(report.get("invariants") or {})}"""
+
+
 def _preemption_sections(report: dict) -> str:
     phases = dict(report.get("phases") or {})
     replay = phases.pop("router_replay", {}) or {}
@@ -1103,6 +1584,8 @@ def write_html_report(report: dict, path: str) -> None:
         body = _overload_sections(report)
     elif scenario == "preemption":
         body = _preemption_sections(report)
+    elif scenario == "autoscale":
+        body = _autoscale_sections(report)
     else:
         body = f"<pre>{json.dumps(report, indent=2)}</pre>"
     tr = report.get("trace")

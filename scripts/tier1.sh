@@ -58,6 +58,34 @@ assert 'goodput_rps' in (r.get('goodput_vs_throughput') or {}), \
              "traces, or missing SLO accounting in /tmp/_t1_race.json" >&2
         exit 1
     fi
+    # Capacity-follows-load smoke: the autoscale drill against a live
+    # mini-plane (diurnal + burst trace; the AutoscaleController must
+    # raise targets within an evaluation period of the burst, drop them
+    # after, and scale down through the drain path without dropping one
+    # in-flight stream). Outside the 870 s pytest budget, --lint only.
+    echo "== rbg-tpu stress --scenario autoscale (capacity-follows-load smoke) =="
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
+            stress --scenario autoscale --json \
+            >/tmp/_t1_autoscale.json; then
+        echo "TIER1 AUTOSCALE SMOKE FAILED — see /tmp/_t1_autoscale.json" \
+             "(invariants)" >&2
+        exit 1
+    fi
+    if ! python -c "
+import json
+r = json.load(open('/tmp/_t1_autoscale.json'))
+inv = r.get('invariants') or {}
+assert inv.get('capacity_follows_load'), \
+    'targets did not track the burst: %s' % r.get('burst_react_s')
+assert inv.get('zero_dropped_streams'), \
+    'scale-down dropped streams: %s' % (r.get('requests') or {})
+assert inv.get('slo_accounted'), 'finished != judged'
+assert len(r.get('curve') or []) > 10, 'capacity-vs-load curve is empty'
+"; then
+        echo "TIER1 AUTOSCALE SMOKE FAILED — capacity-follows-load or" \
+             "zero-dropped-streams invariant red in /tmp/_t1_autoscale.json" >&2
+        exit 1
+    fi
     # Live windowed-signal render: boot a tiny engine server, push one
     # request through it, and assert `rbg-tpu top --once` renders the
     # per-role dashboard (attainment + goodput columns) from its slo +

@@ -1,0 +1,911 @@
+"""SLO-driven coordinated autoscaler (rbg_tpu/autoscale): signal
+reading + staleness, policy hysteresis/cooldown, coordinated-ratio
+clamping through coordination/scaling.py, two-writer safety on the
+ScalingAdapter, drain-aware victim selection, and the plane-level loop.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.group import RoleBasedGroup, RoleStatus, ScalingAdapterHook
+from rbg_tpu.api.policy import (
+    CoordinatedScaling, ScalingAdapter, ScalingAdapterSpec,
+)
+from rbg_tpu.autoscale import (
+    AutoscaleConfig, AutoscaleController, CoordinatedRoles, RolePolicy,
+    RoleScaler, SignalReader, coordinated_targets,
+)
+from rbg_tpu.autoscale.signals import RoleSignals
+from rbg_tpu.obs import names, slo as slo_mod
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.obs.slo import SLOTargets, SLOTracker
+from rbg_tpu.obs.timeseries import TimeSeriesSampler
+from rbg_tpu.runtime.store import Store
+from rbg_tpu.testutil import make_group, make_tpu_nodes, simple_role
+
+
+def _sig(role="serve", fresh=True, **kw) -> RoleSignals:
+    return RoleSignals(role=role, window_s=60.0, fresh=fresh, **kw)
+
+
+def _pol(**kw) -> RolePolicy:
+    base = dict(role="serve", min_replicas=1, max_replicas=8,
+                up_stabilization_s=1.0, down_stabilization_s=5.0,
+                cooldown_s=3.0)
+    base.update(kw)
+    return RolePolicy(**base)
+
+
+# ---- SignalReader ----------------------------------------------------------
+
+
+def test_signal_reader_rates_and_staleness():
+    s = TimeSeriesSampler(interval_s=1.0, retention_s=300.0)
+    role = "sigtest-rates"
+    s.sample_now(now=0.0)
+    REGISTRY.inc(names.SERVING_REQUESTS_FINISHED_TOTAL, 30.0, role=role)
+    REGISTRY.inc(names.SERVING_SHED_TOTAL, 10.0, role=role)
+    s.sample_now(now=10.0)
+    r = SignalReader(sampler=s, window_s=60.0, stale_after_s=5.0)
+    sig = r.read(role, now=10.0)
+    assert sig.fresh and sig.sample_age_s == 0.0
+    assert sig.requests_rps == pytest.approx(3.0)
+    assert sig.shed_rps == pytest.approx(1.0)
+    # Newest sample is 20 s old at now=30: stale, never "rate is zero".
+    sig = r.read(role, now=30.0)
+    assert not sig.fresh and sig.sample_age_s == pytest.approx(20.0)
+
+
+def test_signal_reader_empty_sampler_is_stale():
+    s = TimeSeriesSampler(interval_s=1.0, retention_s=300.0)
+    r = SignalReader(sampler=s, window_s=60.0, stale_after_s=5.0)
+    assert r.read("whatever", now=0.0).fresh is False
+
+
+def test_signal_reader_attainment_and_extras():
+    slo_mod.reset_trackers()
+    tr = SLOTracker(SLOTargets(ttft_s=1.0, tpot_s=0.0), component="sigtest")
+    role = "sigtest-att"
+    for ttft in (0.2, 0.4, 0.6, 2.5):
+        tr.judge(ttft, 0.0, role=role)
+    s = TimeSeriesSampler(interval_s=1.0, retention_s=300.0)
+    s.sample_now()
+    r = SignalReader(sampler=s, window_s=60.0, stale_after_s=60.0,
+                     extras_fn=lambda _r: {"queue_depth": 7,
+                                           "estimated_wait_s": 0.25})
+    sig = r.read(role)
+    assert sig.judged == 4
+    assert sig.ttft_attainment == pytest.approx(0.75)
+    assert sig.goodput_attainment == pytest.approx(0.75)
+    assert sig.queue_depth == 7.0
+    assert sig.estimated_wait_s == 0.25
+    slo_mod.reset_trackers()
+
+
+def test_signal_reader_measured_ratio():
+    s = TimeSeriesSampler(interval_s=1.0, retention_s=300.0)
+    s.sample_now(now=0.0)
+    REGISTRY.inc(names.SERVING_TOKENS_TOTAL, 200.0, role="sigtest-p")
+    REGISTRY.inc(names.SERVING_TOKENS_TOTAL, 100.0, role="sigtest-d")
+    s.sample_now(now=10.0)
+    r = SignalReader(sampler=s, window_s=60.0)
+    assert r.measured_ratio("sigtest-p", "sigtest-d",
+                            now=10.0) == pytest.approx(2.0)
+    assert r.measured_ratio("sigtest-p", "never-published", now=10.0) is None
+
+
+# ---- RoleScaler hysteresis -------------------------------------------------
+
+
+def test_scaler_up_on_low_attainment_after_stabilization():
+    sc = RoleScaler(_pol())
+    bad = _sig(goodput_attainment=0.5, judged=10)
+    d = sc.decide(0.0, bad, 2)
+    assert d.direction == "hold" and d.suppressed == "stabilizing"
+    d = sc.decide(1.2, bad, 2)
+    assert d.direction == "up" and d.target == 3
+    assert "attainment" in d.reason
+
+
+def test_scaler_up_on_estimated_wait():
+    sc = RoleScaler(_pol(max_estimated_wait_s=0.5, up_stabilization_s=0.0))
+    d = sc.decide(0.0, _sig(estimated_wait_s=2.0), 1)
+    assert d.direction == "up" and "wait" in d.reason
+
+
+def test_scaler_load_proportional_jump():
+    sc = RoleScaler(_pol(target_rps_per_replica=10.0,
+                         up_stabilization_s=0.0))
+    d = sc.decide(0.0, _sig(requests_rps=38.0, shed_rps=11.0), 2)
+    # demand = ceil((38 + 11) / 10) = 5 — sheds count as demand.
+    assert d.direction == "up" and d.target == 5
+
+
+def test_scaler_cooldown_suppresses_and_is_counted_as_suppressed():
+    sc = RoleScaler(_pol(up_stabilization_s=0.0))
+    bad = _sig(goodput_attainment=0.1, judged=10)
+    assert sc.decide(0.0, bad, 1).direction == "up"
+    d = sc.decide(1.0, bad, 2)
+    assert d.direction == "hold" and d.suppressed == "cooldown"
+    assert sc.decide(4.0, bad, 2).direction == "up"
+
+
+def test_scaler_stale_always_holds():
+    sc = RoleScaler(_pol(up_stabilization_s=0.0))
+    d = sc.decide(0.0, _sig(fresh=False, goodput_attainment=0.0, judged=99),
+                  1)
+    assert d.direction == "hold" and d.suppressed == "stale"
+
+
+def test_scaler_min_judged_gate():
+    sc = RoleScaler(_pol(up_stabilization_s=0.0, min_judged=5))
+    # Two unlucky requests must not scale the fleet.
+    d = sc.decide(0.0, _sig(goodput_attainment=0.0, judged=2), 2)
+    assert d.direction == "hold"
+
+
+def test_scaler_down_needs_sustained_headroom_and_respects_window_max():
+    sc = RoleScaler(_pol(target_rps_per_replica=10.0,
+                         up_stabilization_s=0.0, down_stabilization_s=4.0,
+                         cooldown_s=0.0))
+    # Demand 4 at t=0 seeds the window; then demand falls to 1.
+    assert sc.decide(0.0, _sig(requests_rps=35.0), 5).direction == "hold"
+    low = _sig(requests_rps=9.0)
+    assert sc.decide(1.0, low, 5).suppressed == "stabilizing"
+    assert sc.decide(3.0, low, 5).suppressed == "stabilizing"
+    d = sc.decide(5.1, low, 5)
+    # Window still contains nothing above demand 1 (the t=0 rec aged
+    # out), so the target is the stabilized recommendation.
+    assert d.direction == "down" and d.target == 1
+    # A recent high recommendation floors the drop.
+    sc2 = RoleScaler(_pol(target_rps_per_replica=10.0,
+                          up_stabilization_s=0.0,
+                          down_stabilization_s=4.0, cooldown_s=0.0))
+    sc2.decide(0.0, low, 5)
+    sc2.decide(2.0, _sig(requests_rps=35.0), 5)   # demand 4 mid-window
+    d = sc2.decide(4.5, low, 5)
+    assert d.direction == "down" and d.target == 4
+
+
+def test_scaler_clamps_to_min_and_max():
+    sc = RoleScaler(_pol(max_replicas=3, up_stabilization_s=0.0))
+    d = sc.decide(0.0, _sig(goodput_attainment=0.0, judged=10), 3)
+    assert d.direction == "hold" and "max_replicas" in d.reason
+    sc = RoleScaler(_pol(min_replicas=2, target_rps_per_replica=10.0,
+                         up_stabilization_s=0.0, down_stabilization_s=0.0,
+                         cooldown_s=0.0))
+    sc.decide(0.0, _sig(requests_rps=1.0), 3)
+    d = sc.decide(0.1, _sig(requests_rps=1.0), 2)
+    assert d.direction == "hold" and "min_replicas" in d.reason
+
+
+def test_scaler_shed_pressure_wins_reason_precedence():
+    sc = RoleScaler(_pol(up_stabilization_s=0.0))
+    d = sc.decide(0.0, _sig(shed_rps=2.0, goodput_attainment=0.1,
+                            judged=10), 1)
+    assert d.direction == "up" and "shedding" in d.reason
+
+
+def test_scaler_queue_depth_trigger_and_disable():
+    sc = RoleScaler(_pol(max_queue_depth=10.0, up_stabilization_s=0.0))
+    d = sc.decide(0.0, _sig(queue_depth=25.0), 1)
+    assert d.direction == "up" and "queue depth" in d.reason
+    off = RoleScaler(_pol(max_queue_depth=0.0, up_stabilization_s=0.0))
+    assert off.decide(0.0, _sig(queue_depth=25.0), 1).direction == "hold"
+
+
+def test_scaler_wait_trigger_disabled_by_zero():
+    sc = RoleScaler(_pol(max_estimated_wait_s=0.0, up_stabilization_s=0.0))
+    assert sc.decide(0.0, _sig(estimated_wait_s=99.0), 1).direction == "hold"
+
+
+def test_scaler_no_judgments_is_not_pressure():
+    sc = RoleScaler(_pol(up_stabilization_s=0.0))
+    d = sc.decide(0.0, _sig(goodput_attainment=None, judged=0), 2)
+    assert d.direction == "hold"
+
+
+def test_scaler_stale_resets_stabilization_onset():
+    sc = RoleScaler(_pol(up_stabilization_s=1.0))
+    bad = _sig(goodput_attainment=0.1, judged=10)
+    sc.decide(0.0, bad, 1)                       # onset at t=0
+    sc.decide(0.5, _sig(fresh=False), 1)         # stale forgets the onset
+    d = sc.decide(1.2, bad, 1)
+    assert d.direction == "hold" and d.suppressed == "stabilizing"
+
+
+def test_scaler_shed_only_demand_counts():
+    sc = RoleScaler(_pol(target_rps_per_replica=10.0,
+                         up_stabilization_s=0.0))
+    d = sc.decide(0.0, _sig(requests_rps=None, shed_rps=31.0), 1)
+    assert d.direction == "up" and d.target == 4
+
+
+def test_scaler_cooldown_remaining():
+    sc = RoleScaler(_pol(up_stabilization_s=0.0, cooldown_s=3.0))
+    assert sc.cooldown_remaining(0.0) == 0.0
+    sc.decide(0.0, _sig(goodput_attainment=0.0, judged=10), 1)
+    assert sc.cooldown_remaining(1.0) == pytest.approx(2.0)
+    assert sc.cooldown_remaining(9.0) == 0.0
+
+
+def test_scaler_no_signals_holds():
+    sc = RoleScaler(_pol(up_stabilization_s=0.0))
+    d = sc.decide(0.0, _sig(), 3)
+    assert d.direction == "hold" and d.reason == "load matches capacity"
+
+
+def test_scaler_idle_scale_in_without_load_sizing():
+    sc = RoleScaler(_pol(up_stabilization_s=0.0, down_stabilization_s=1.0,
+                         cooldown_s=0.0))
+    idle = _sig(requests_rps=0.0, queue_depth=0.0)
+    assert sc.decide(0.0, idle, 3).suppressed == "stabilizing"
+    d = sc.decide(1.5, idle, 3)
+    assert d.direction == "down" and d.target == 2
+
+
+def test_scaler_actuation_resets_onsets():
+    sc = RoleScaler(_pol(up_stabilization_s=1.0, cooldown_s=0.0))
+    bad = _sig(goodput_attainment=0.1, judged=10)
+    sc.decide(0.0, bad, 1)
+    assert sc.decide(1.5, bad, 1).direction == "up"
+    # The next actuation needs a FRESH stabilization window.
+    assert sc.decide(1.6, bad, 2).suppressed == "stabilizing"
+
+
+def test_scaler_revoke_returns_cooldown_and_stabilization():
+    sc = RoleScaler(_pol(up_stabilization_s=1.0, cooldown_s=60.0))
+    bad = _sig(goodput_attainment=0.1, judged=10)
+    sc.decide(0.0, bad, 5)                       # onset at t=0
+    d = sc.decide(1.2, bad, 5)
+    assert d.direction == "up"
+    # The controller could not land it (skew-gated / write lost):
+    sc.revoke(d)
+    d2 = sc.decide(1.3, bad, 5)
+    # Neither cooldown-suppressed nor re-stabilizing — the unlanded
+    # actuation gave both back.
+    assert d2.direction == "up" and d2.suppressed is None
+    # d2 landed (current became 6). A later HOLD decision is not
+    # revocable — the landed actuation's cooldown stands once the fresh
+    # stabilization window passes.
+    sc.revoke(sc.decide(1.4, bad, 6))            # stabilizing hold
+    assert sc.decide(2.5, bad, 6).suppressed == "cooldown"
+
+
+def test_decision_and_signals_as_dict():
+    from rbg_tpu.autoscale.policy import Decision
+    d = Decision("serve", 2, 3, "up", "why", clamped=True)
+    dd = d.as_dict()
+    assert dd["target"] == 3 and dd["clamped"] is True
+    sd = _sig(requests_rps=1.0).as_dict()
+    assert sd["role"] == "serve" and sd["requests_rps"] == 1.0
+
+
+def test_signal_reader_extras_override_rates():
+    s = TimeSeriesSampler(interval_s=1.0, retention_s=300.0)
+    s.sample_now(now=0.0)
+    s.sample_now(now=10.0)
+    r = SignalReader(sampler=s, window_s=60.0, stale_after_s=60.0,
+                     extras_fn=lambda _r: {"requests_rps": 42.0})
+    assert r.read("no-such-role", now=10.0).requests_rps == 42.0
+
+
+def test_signal_reader_read_all_and_broken_extras():
+    s = TimeSeriesSampler(interval_s=1.0, retention_s=300.0)
+    s.sample_now(now=0.0)
+
+    def boom(_r):
+        raise RuntimeError("extras hook broke")
+
+    r = SignalReader(sampler=s, window_s=60.0, extras_fn=boom)
+    out = r.read_all(["a", "b"], now=0.0)
+    assert set(out) == {"a", "b"}    # a broken hook never kills the loop
+
+
+def test_sampler_last_sample_age():
+    s = TimeSeriesSampler(interval_s=1.0, retention_s=300.0)
+    assert s.last_sample_age_s(now=5.0) is None
+    s.sample_now(now=5.0)
+    assert s.last_sample_age_s(now=5.0) == 0.0
+    assert s.last_sample_age_s(now=9.0) == pytest.approx(4.0)
+
+
+def test_spare_pool_available_peek():
+    from rbg_tpu.sched.capacity import SparePool
+    pool = SparePool(per_topology=2)
+    with pool._lock:
+        pool._reserved.update({"s-a": "2x4", "s-b": "2x4", "s-c": "4x4"})
+    assert pool.available() == 3
+    assert pool.available(topology="2x4") == 2
+    assert pool.available(topology="8x8") == 0
+    # Peek never consumes.
+    assert pool.available() == 3
+
+
+# ---- coordinated-ratio mode ------------------------------------------------
+
+
+def _pd_group(prefill=("prefill", 2, 2), decode=("decode", 2, 2)):
+    """(name, spec_replicas, ready) per role."""
+    g = RoleBasedGroup()
+    g.metadata.name = "pd"
+    g.spec.roles = [simple_role(prefill[0], replicas=prefill[1]),
+                    simple_role(decode[0], replicas=decode[1])]
+    g.status.roles = [
+        RoleStatus(name=prefill[0], replicas=prefill[2],
+                   ready_replicas=prefill[2]),
+        RoleStatus(name=decode[0], replicas=decode[2],
+                   ready_replicas=decode[2]),
+    ]
+    return g
+
+
+def test_coordinated_ratio_derives_follower():
+    pair = CoordinatedRoles(driver="decode", follower="prefill",
+                            default_ratio=0.5)
+    g = _pd_group(prefill=("prefill", 2, 4), decode=("decode", 2, 8))
+    targets, clamped = coordinated_targets(
+        g, pair, 8, RolePolicy("prefill", min_replicas=1, max_replicas=8))
+    assert targets["decode"] == 8 and targets["prefill"] == 4
+    assert not clamped
+    # Measured ratio wins over the default — and the skew clamp bites:
+    # prefill's progress (4) lags the raw 8, so it gets the slowest-role
+    # progress+1 step, not the whole jump.
+    targets, clamped = coordinated_targets(
+        g, pair, 8, RolePolicy("prefill", min_replicas=1, max_replicas=8),
+        measured_ratio=1.0)
+    assert targets["prefill"] == 5 and clamped
+
+
+def test_coordinated_growth_keeps_skew_and_converges():
+    """Autoscaler-driven growth 2→8 through clamp_targets: every round
+    honors the maxSkew bound (non-slowest roles never exceed
+    floor(t·(min_ratio+skew)) unless they are the slowest+1), and as
+    progress lands the clamp converges to the raw targets."""
+    pair = CoordinatedRoles(driver="decode", follower="prefill",
+                            max_skew_percent=10)
+    ready = {"prefill": 2, "decode": 2}
+    seen = []
+    for _ in range(12):
+        g = _pd_group(prefill=("prefill", 2, ready["prefill"]),
+                      decode=("decode", 2, ready["decode"]))
+        targets, _ = coordinated_targets(
+            g, pair, 8, RolePolicy("prefill", min_replicas=1,
+                                   max_replicas=8))
+        seen.append(dict(targets))
+        min_ratio = min(min(1.0, ready[r] / targets[r]) for r in targets)
+        for r, t in targets.items():
+            cap = int(t * (min_ratio + 0.10))
+            assert t <= max(8, 0) and (
+                min(1.0, ready[r] / t) <= min_ratio + 1e-9
+                or t <= max(cap, ready[r] + 1)), (r, t, ready, min_ratio)
+        # Progression gate: the controllers bring the clamped targets up.
+        ready = dict(targets)
+        if targets == {"decode": 8, "prefill": 8}:
+            break
+    assert seen[-1] == {"decode": 8, "prefill": 8}
+    # Monotone, stepwise growth — never a jump straight to 8.
+    assert seen[0]["decode"] < 8 and seen[0]["prefill"] < 8
+
+
+def test_coordinated_anti_deadlock_under_oscillating_targets():
+    """The slowest role always gets progress+1 even when the skew cap
+    rounds to less — oscillating raw targets can never wedge the group."""
+    pair = CoordinatedRoles(driver="decode", follower="prefill",
+                            max_skew_percent=10)
+    ready = {"prefill": 1, "decode": 1}
+    for i in range(10):
+        raw = 6 if i % 2 == 0 else 4
+        g = _pd_group(prefill=("prefill", 1, ready["prefill"]),
+                      decode=("decode", 1, ready["decode"]))
+        targets, _ = coordinated_targets(
+            g, pair, raw, RolePolicy("prefill", min_replicas=1,
+                                     max_replicas=8))
+        # Anti-deadlock is an UPWARD guarantee: whenever some role is
+        # below its raw target, the clamp must leave at least one role
+        # room to advance past its progress. (A round where progress
+        # covers every target is convergence, not deadlock.)
+        if all(ready[r] >= raw for r in ready):
+            continue
+        assert any(targets[r] > ready[r] for r in targets), (
+            "deadlock: no role may advance", targets, ready)
+        # Advance ONE role only (worst-case staggered progress).
+        lag = min(targets, key=lambda r: ready[r] / max(targets[r], 1))
+        ready[lag] = min(targets[lag], ready[lag] + 1)
+
+
+def test_coordinated_scale_down_during_scale_up_converges():
+    pair = CoordinatedRoles(driver="decode", follower="prefill",
+                            max_skew_percent=10)
+    # Mid-flight: raw 8, progress only 4 — then the autoscaler cuts the
+    # raw target to 3. The clamp must follow DOWN at once and stay there.
+    g = _pd_group(prefill=("prefill", 2, 4), decode=("decode", 2, 4))
+    targets, _ = coordinated_targets(
+        g, pair, 3, RolePolicy("prefill", min_replicas=1, max_replicas=8))
+    assert targets == {"decode": 3, "prefill": 3}
+    g = _pd_group(prefill=("prefill", 2, 3), decode=("decode", 2, 3))
+    targets, _ = coordinated_targets(
+        g, pair, 3, RolePolicy("prefill", min_replicas=1, max_replicas=8))
+    assert targets == {"decode": 3, "prefill": 3}
+
+
+def test_coordinated_respects_operator_policy():
+    pair = CoordinatedRoles(driver="decode", follower="prefill",
+                            max_skew_percent=90)
+    g = _pd_group(prefill=("prefill", 2, 2), decode=("decode", 2, 2))
+    operator = CoordinatedScaling(roles=["prefill", "decode"],
+                                  max_skew_percent=0)
+    loose, _ = coordinated_targets(
+        g, pair, 8, RolePolicy("prefill", min_replicas=1, max_replicas=8))
+    tight, _ = coordinated_targets(
+        g, pair, 8, RolePolicy("prefill", min_replicas=1, max_replicas=8),
+        scaling_policy=operator)
+    assert tight["decode"] < loose["decode"]
+
+
+# ---- controller: store-level actuation -------------------------------------
+
+
+class _FakeReader:
+    def __init__(self):
+        self.signals = {}
+        self.ratio = None
+
+    def read_all(self, roles, now=None):
+        return {r: self.signals[r] for r in roles}
+
+    def measured_ratio(self, num, den, now=None):
+        return self.ratio
+
+
+def _store_env(policy=None, replicas=2):
+    store = Store()
+    g = make_group("g", simple_role("serve", replicas=replicas))
+    store.create(g)
+    sa = ScalingAdapter()
+    sa.metadata.name = "g-serve-scaling-adapter"
+    sa.metadata.namespace = "default"
+    sa.spec = ScalingAdapterSpec(group_name="g", role_name="serve",
+                                 min_replicas=1, max_replicas=16)
+    store.create(sa)
+    policy = policy or RolePolicy("serve", min_replicas=1, max_replicas=8,
+                                  up_stabilization_s=0.0,
+                                  down_stabilization_s=0.0, cooldown_s=0.0)
+    ctrl = AutoscaleController(store, AutoscaleConfig(
+        roles={"serve": policy}, eval_period_s=60.0))
+    ctrl.reader = _FakeReader()
+    return store, ctrl
+
+
+def _adapter(store):
+    return store.get("ScalingAdapter", "default", "g-serve-scaling-adapter")
+
+
+def test_controller_writes_target_through_adapter():
+    store, ctrl = _store_env()
+    ctrl.reader.signals["serve"] = _sig(goodput_attainment=0.2, judged=10)
+    ctrl.reconcile(store, ("default", "g"))
+    sa = _adapter(store)
+    assert sa.spec.replicas == 3
+    assert sa.metadata.annotations[C.ANN_AUTOSCALE_LAST_WRITE] == "3"
+    assert REGISTRY.gauge(names.AUTOSCALE_TARGET_REPLICAS,
+                          role="serve") == 3.0
+
+
+def test_controller_two_writer_conflict_backs_off_then_adopts():
+    store, ctrl = _store_env()
+    ctrl.reader.signals["serve"] = _sig(goodput_attainment=0.2, judged=10)
+    ctrl.reconcile(store, ("default", "g"))
+    assert _adapter(store).spec.replicas == 3
+    before = REGISTRY.counter(names.AUTOSCALE_CONFLICTS_TOTAL, role="serve")
+
+    # An external HPA writes the adapter out from under us.
+    def hpa(a):
+        a.spec.replicas = 7
+        return True
+    store.mutate("ScalingAdapter", "default", "g-serve-scaling-adapter", hpa)
+
+    ctrl.reader.signals["serve"] = _sig(goodput_attainment=0.2, judged=10)
+    ctrl.reconcile(store, ("default", "g"))
+    sa = _adapter(store)
+    # Backed off: the foreign value survives, the stamp is dropped, the
+    # conflict is counted — never silent last-writer-wins.
+    assert sa.spec.replicas == 7
+    assert C.ANN_AUTOSCALE_LAST_WRITE not in sa.metadata.annotations
+    assert REGISTRY.counter(names.AUTOSCALE_CONFLICTS_TOTAL,
+                            role="serve") == before + 1
+    # Next cycle resumes control FROM the foreign baseline.
+    ctrl.reconcile(store, ("default", "g"))
+    sa = _adapter(store)
+    assert sa.spec.replicas == 8 \
+        and sa.metadata.annotations[C.ANN_AUTOSCALE_LAST_WRITE] == "8"
+
+
+def test_controller_stale_signals_hold_and_count():
+    store, ctrl = _store_env()
+    before = REGISTRY.counter(names.AUTOSCALE_STALE_HOLDS_TOTAL,
+                              role="serve")
+    ctrl.reader.signals["serve"] = _sig(fresh=False, goodput_attainment=0.0,
+                                        judged=99)
+    ctrl.reconcile(store, ("default", "g"))
+    assert _adapter(store).spec.replicas is None
+    assert REGISTRY.counter(names.AUTOSCALE_STALE_HOLDS_TOTAL,
+                            role="serve") == before + 1
+
+
+def test_controller_disable_enable_per_role():
+    store, ctrl = _store_env()
+    assert ctrl.set_enabled("serve", False)
+    ctrl.reader.signals["serve"] = _sig(goodput_attainment=0.0, judged=99)
+    ctrl.reconcile(store, ("default", "g"))
+    assert _adapter(store).spec.replicas is None
+    row = ctrl.status()["roles"][0]
+    assert row["enabled"] is False
+    assert not ctrl.set_enabled("nosuch", True)
+    ctrl.set_enabled("serve", True)
+    ctrl.reconcile(store, ("default", "g"))
+    assert _adapter(store).spec.replicas == 3
+
+
+def test_controller_stamps_victim_costs_on_scale_down():
+    store, ctrl = _store_env(replicas=4)
+    ctrl.cfg.inflight_streams_fn = {"p-a": 5.0, "p-b": 0.0}.get
+    from rbg_tpu.api.instance import RoleInstance
+    from rbg_tpu.api.pod import Pod
+    for iname, pname in (("i-a", "p-a"), ("i-b", "p-b")):
+        inst = RoleInstance()
+        inst.metadata.name = iname
+        inst.metadata.namespace = "default"
+        inst.metadata.labels = {C.LABEL_GROUP_NAME: "g",
+                                C.LABEL_ROLE_NAME: "serve"}
+        store.create(inst)
+        pod = Pod()
+        pod.metadata.name = pname
+        pod.metadata.namespace = "default"
+        pod.metadata.labels = {C.LABEL_GROUP_NAME: "g",
+                               C.LABEL_ROLE_NAME: "serve",
+                               C.LABEL_INSTANCE_NAME: iname}
+        store.create(pod)
+    ctrl.reader.signals["serve"] = _sig(requests_rps=0.0, queue_depth=0.0)
+    ctrl.reconcile(store, ("default", "g"))
+    assert _adapter(store).spec.replicas == 3
+    a = store.get("RoleInstance", "default", "i-a")
+    b = store.get("RoleInstance", "default", "i-b")
+    assert a.metadata.annotations[C.ANN_SCALE_DOWN_COST] == "5"
+    assert b.metadata.annotations[C.ANN_SCALE_DOWN_COST] == "0"
+
+
+def test_controller_coordinated_pair_follows_driver():
+    store = Store()
+    g = make_group("g", simple_role("decode", replicas=2),
+                   simple_role("prefill", replicas=2))
+    g.status.roles = [RoleStatus(name="decode", replicas=6,
+                                 ready_replicas=6),
+                      RoleStatus(name="prefill", replicas=6,
+                                 ready_replicas=6)]
+    store.create(g)
+    for role in ("decode", "prefill"):
+        sa = ScalingAdapter()
+        sa.metadata.name = f"g-{role}-scaling-adapter"
+        sa.metadata.namespace = "default"
+        sa.spec = ScalingAdapterSpec(group_name="g", role_name=role,
+                                     min_replicas=1, max_replicas=16)
+        store.create(sa)
+    pol = dict(min_replicas=1, max_replicas=8, up_stabilization_s=0.0,
+               down_stabilization_s=0.0, cooldown_s=0.0)
+    ctrl = AutoscaleController(store, AutoscaleConfig(
+        roles={"decode": RolePolicy("decode", **pol),
+               "prefill": RolePolicy("prefill", **pol)},
+        coordinated=[CoordinatedRoles(driver="decode", follower="prefill",
+                                      default_ratio=0.5)],
+        eval_period_s=60.0))
+    ctrl.reader = _FakeReader()
+    ctrl.reader.ratio = 1.0     # measured prefill:decode token ratio
+    ctrl.reader.signals["decode"] = _sig(role="decode",
+                                         goodput_attainment=0.2, judged=10,
+                                         requests_rps=50.0)
+    ctrl.reader.signals["prefill"] = _sig(role="prefill")
+    ctrl.reconcile(store, ("default", "g"))
+    dec = store.get("ScalingAdapter", "default", "g-decode-scaling-adapter")
+    pre = store.get("ScalingAdapter", "default",
+                    "g-prefill-scaling-adapter")
+    assert dec.spec.replicas == 3
+    # follower = driver × measured ratio 1.0 — progress (6) is ahead of
+    # both targets, so no skew clamp bites and the follower is written.
+    assert pre.spec.replicas == 3
+    row = {r["role"]: r for r in ctrl.status()["roles"]}
+    assert "coordinated with decode" in \
+        row["prefill"]["last_decision"]["reason"]
+
+
+def test_gate_growth_only_semantics():
+    from rbg_tpu.autoscale.policy import gate_growth_only
+    # Rise: the clamp may hold the target anywhere in [current, raw]...
+    assert gate_growth_only(raw=6, current=5, clamped=2) == 5
+    assert gate_growth_only(6, 5, 5) == 5
+    assert gate_growth_only(6, 5, 6) == 6
+    assert gate_growth_only(6, 2, 4) == 4
+    # ...but a genuine scale-down is never deepened by a lagging partner.
+    assert gate_growth_only(raw=4, current=5, clamped=1) == 4
+    assert gate_growth_only(4, 5, 4) == 4
+
+
+def test_controller_skew_clamp_never_sheds_capacity():
+    """A transiently lagging follower caps the driver's RISE — it must
+    never be persisted as a scale-down of the driver's current
+    capacity (the clamp is a progression gate, not a decision)."""
+    store = Store()
+    g = make_group("g", simple_role("decode", replicas=5),
+                   simple_role("prefill", replicas=5))
+    # Follower progress badly lags: prefill has 1 ready of 5.
+    g.status.roles = [RoleStatus(name="decode", replicas=5,
+                                 ready_replicas=5),
+                      RoleStatus(name="prefill", replicas=5,
+                                 ready_replicas=1)]
+    store.create(g)
+    for role in ("decode", "prefill"):
+        sa = ScalingAdapter()
+        sa.metadata.name = f"g-{role}-scaling-adapter"
+        sa.metadata.namespace = "default"
+        sa.spec = ScalingAdapterSpec(group_name="g", role_name=role,
+                                     min_replicas=1, max_replicas=16)
+        store.create(sa)
+    pol = dict(min_replicas=1, max_replicas=8, up_stabilization_s=0.0,
+               down_stabilization_s=0.0, cooldown_s=0.0)
+    ctrl = AutoscaleController(store, AutoscaleConfig(
+        roles={"decode": RolePolicy("decode", **pol),
+               "prefill": RolePolicy("prefill", **pol)},
+        coordinated=[CoordinatedRoles(driver="decode", follower="prefill",
+                                      default_ratio=1.0)],
+        eval_period_s=60.0))
+    ctrl.reader = _FakeReader()
+    ctrl.reader.signals["decode"] = _sig(role="decode",
+                                         goodput_attainment=0.2, judged=10)
+    ctrl.reader.signals["prefill"] = _sig(role="prefill")
+    ctrl.reconcile(store, ("default", "g"))
+    dec = store.get("ScalingAdapter", "default", "g-decode-scaling-adapter")
+    # The raw up decision (5→6) was gated by prefill's lag, but decode
+    # never dropped below its current 5.
+    assert dec.spec.replicas is None or dec.spec.replicas >= 5
+
+
+def test_controller_tight_adapter_bounds_no_write_loop():
+    """Adapter bounds tighter than the policy: no 'Autoscaled N -> N'
+    event spam, the clamp is counted, and the gauge shows the bounded
+    value that can actually land."""
+    store, ctrl = _store_env()
+
+    def tighten(a):
+        a.spec.max_replicas = 3    # tighter than the policy's max of 8
+        return True
+    store.mutate("ScalingAdapter", "default", "g-serve-scaling-adapter",
+                 tighten)
+    ctrl.reader.signals["serve"] = _sig(goodput_attainment=0.0, judged=10)
+    ctrl.reconcile(store, ("default", "g"))
+    sa = _adapter(store)
+    assert sa.spec.replicas == 3     # wrote up to the adapter bound once
+    events1 = len(store.events_for(sa))
+    # Steady pressure at the bound: no further writes, no event spam, no
+    # foreign-writer misfire — just the clamp counter moving.
+    before_clamp = REGISTRY.counter(names.AUTOSCALE_CLAMPED_TOTAL,
+                                    role="serve")
+    before_conf = REGISTRY.counter(names.AUTOSCALE_CONFLICTS_TOTAL,
+                                   role="serve")
+    ctrl.reader.signals["serve"] = _sig(goodput_attainment=0.0, judged=10)
+    ctrl.reconcile(store, ("default", "g"))
+    sa = _adapter(store)
+    assert sa.spec.replicas == 3
+    assert len(store.events_for(sa)) == events1
+    assert REGISTRY.counter(names.AUTOSCALE_CONFLICTS_TOTAL,
+                            role="serve") == before_conf
+    assert REGISTRY.counter(names.AUTOSCALE_CLAMPED_TOTAL,
+                            role="serve") > before_clamp
+    assert REGISTRY.gauge(names.AUTOSCALE_TARGET_REPLICAS,
+                          role="serve") == 3.0
+
+
+def test_controller_clears_victim_costs_after_down_pressure():
+    store, ctrl = _store_env(replicas=4)
+    ctrl.cfg.inflight_streams_fn = {"p-a": 5.0}.get
+    from rbg_tpu.api.instance import RoleInstance
+    from rbg_tpu.api.pod import Pod
+    inst = RoleInstance()
+    inst.metadata.name = "i-a"
+    inst.metadata.namespace = "default"
+    inst.metadata.labels = {C.LABEL_GROUP_NAME: "g",
+                            C.LABEL_ROLE_NAME: "serve"}
+    store.create(inst)
+    pod = Pod()
+    pod.metadata.name = "p-a"
+    pod.metadata.namespace = "default"
+    pod.metadata.labels = {C.LABEL_GROUP_NAME: "g",
+                           C.LABEL_ROLE_NAME: "serve",
+                           C.LABEL_INSTANCE_NAME: "i-a"}
+    store.create(pod)
+    ctrl.reader.signals["serve"] = _sig(requests_rps=0.0, queue_depth=0.0)
+    ctrl.reconcile(store, ("default", "g"))
+    got = store.get("RoleInstance", "default", "i-a")
+    assert got.metadata.annotations[C.ANN_SCALE_DOWN_COST] == "5"
+    # Down pressure gone: the stale stream counts must not survive to
+    # order some FUTURE (e.g. operator-driven) scale-down.
+    ctrl.reader.signals["serve"] = _sig(requests_rps=30.0)
+    ctrl.reconcile(store, ("default", "g"))
+    got = store.get("RoleInstance", "default", "i-a")
+    assert C.ANN_SCALE_DOWN_COST not in got.metadata.annotations
+
+
+class _FakeSpares:
+    def __init__(self):
+        self.taken = []
+
+    def take(self, topology=None):
+        self.taken.append(topology)
+        return f"spare-{len(self.taken)}"
+
+    def replenish(self, store):
+        pass
+
+    def available(self, topology=None):
+        return 1
+
+
+def test_controller_grants_spares_to_pending_tpu_instances():
+    from rbg_tpu.api.instance import RoleInstance
+    from rbg_tpu.testutil import tpu_leaderworker_role
+
+    store = Store()
+    role = tpu_leaderworker_role("serve", replicas=1, topology="2x4")
+    store.create(make_group("g", role))
+    sa = ScalingAdapter()
+    sa.metadata.name = "g-serve-scaling-adapter"
+    sa.metadata.namespace = "default"
+    sa.spec = ScalingAdapterSpec(group_name="g", role_name="serve",
+                                 min_replicas=1, max_replicas=8)
+    store.create(sa)
+    inst = RoleInstance()
+    inst.metadata.name = "g-serve-1"
+    inst.metadata.namespace = "default"
+    inst.metadata.labels = {C.LABEL_GROUP_NAME: "g",
+                            C.LABEL_ROLE_NAME: "serve"}
+    store.create(inst)
+    spares = _FakeSpares()
+    ctrl = AutoscaleController(store, AutoscaleConfig(
+        roles={"serve": RolePolicy("serve", min_replicas=1, max_replicas=8,
+                                   up_stabilization_s=0.0, cooldown_s=0.0)},
+        eval_period_s=60.0), spares=spares)
+    ctrl.reader = _FakeReader()
+    before = REGISTRY.counter(names.AUTOSCALE_SPARE_GRANTS_TOTAL,
+                              role="serve")
+    ctrl.reader.signals["serve"] = _sig(goodput_attainment=0.0, judged=10)
+    ctrl.reconcile(store, ("default", "g"))
+    # Scale-up wrote the adapter AND granted the pending instance a warm
+    # spare of the role's topology.
+    assert _adapter(store).spec.replicas == 2
+    assert spares.taken == ["2x4"]
+    got = store.get("RoleInstance", "default", "g-serve-1")
+    assert got.metadata.annotations[C.ANN_SLICE_BINDING] == "spare-1"
+    assert REGISTRY.counter(names.AUTOSCALE_SPARE_GRANTS_TOTAL,
+                            role="serve") == before + 1
+    assert ctrl.status()["spare_slices_available"] == 1
+    # Instances created AFTER the write cycle (the real ordering: group
+    # controller → instance set → instances) are granted on a LATER
+    # evaluation even though no new write happens.
+    late = RoleInstance()
+    late.metadata.name = "g-serve-2"
+    late.metadata.namespace = "default"
+    late.metadata.labels = {C.LABEL_GROUP_NAME: "g",
+                            C.LABEL_ROLE_NAME: "serve"}
+    store.create(late)
+    ctrl.reader.signals["serve"] = _sig()       # no pressure, no write
+    ctrl.reconcile(store, ("default", "g"))
+    got = store.get("RoleInstance", "default", "g-serve-2")
+    assert got.metadata.annotations[C.ANN_SLICE_BINDING] == "spare-2"
+
+
+# ---- victim selection through the stateless engine -------------------------
+
+
+def test_stateless_scale_down_retires_lowest_cost_first():
+    from rbg_tpu.runtime.plane import ControlPlane
+    plane = ControlPlane(backend="fake")
+    make_tpu_nodes(plane.store, slices=1, hosts_per_slice=2)
+    with plane:
+        role = simple_role("worker", replicas=3)
+        role.identity = "random"
+        plane.apply(make_group("vc", role))
+        plane.wait_group_ready("vc", timeout=20)
+        insts = sorted(plane.store.list("RoleInstance", namespace="default"),
+                       key=lambda i: i.metadata.name)
+        costs = {insts[0].metadata.name: "5", insts[1].metadata.name: "0",
+                 insts[2].metadata.name: "2"}
+        for iname, cost in costs.items():
+            plane.store.mutate(
+                "RoleInstance", "default", iname,
+                lambda i, c=cost: (
+                    i.metadata.annotations.__setitem__(
+                        C.ANN_SCALE_DOWN_COST, c) or True))
+        g = plane.store.get("RoleBasedGroup", "default", "vc")
+        g.spec.roles[0].replicas = 1
+        plane.store.update(g)
+        survivor = max(costs, key=lambda k: float(costs[k]))
+        plane.wait_for(
+            lambda: {i.metadata.name for i in plane.store.list(
+                "RoleInstance", namespace="default")} == {survivor},
+            timeout=20, desc="lowest-cost victims retired first")
+
+
+# ---- plane wiring + admin op + top render ----------------------------------
+
+
+def test_admin_autoscale_op_and_top_render():
+    from rbg_tpu.runtime.admin import AdminServer
+    from rbg_tpu.runtime.plane import ControlPlane
+    from rbg_tpu.engine.protocol import request_once
+
+    cfg = AutoscaleConfig(
+        roles={"serve": RolePolicy("serve", min_replicas=1, max_replicas=4,
+                                   up_stabilization_s=0.0, cooldown_s=0.0)},
+        eval_period_s=0.1, stale_after_s=3600.0)
+    plane = ControlPlane(backend="fake", autoscale=cfg)
+    make_tpu_nodes(plane.store, slices=1, hosts_per_slice=2)
+    from rbg_tpu.obs import timeseries
+    timeseries.get_sampler().sample_now()
+    with plane:
+        role = simple_role("serve", replicas=1)
+        role.scaling_adapter = ScalingAdapterHook(enabled=True,
+                                                  min_replicas=1,
+                                                  max_replicas=4)
+        plane.apply(make_group("ad", role))
+        plane.wait_group_ready("ad", timeout=20)
+        admin = AdminServer(plane, port=0).start()
+        try:
+            addr = f"127.0.0.1:{admin.port}"
+            plane.wait_for(
+                lambda: plane.autoscale_controller.status()["roles"],
+                timeout=10, desc="autoscaler evaluated once")
+            resp, _, _ = request_once(addr, {"op": "autoscale"}, timeout=10)
+            rows = resp["autoscale"]["roles"]
+            assert rows and rows[0]["role"] == "serve"
+            assert "last_decision" in rows[0]
+            # Per-role kill switch over the wire.
+            resp, _, _ = request_once(addr, {"op": "autoscale",
+                                             "disable": "serve"},
+                                      timeout=10)
+            assert resp["autoscale"]["roles"][0]["enabled"] is False \
+                or plane.autoscale_controller.enabled("serve") is False
+            resp, _, _ = request_once(addr, {"op": "autoscale",
+                                             "enable": "serve"}, timeout=10)
+            assert plane.autoscale_controller.enabled("serve") is True
+            resp, _, _ = request_once(addr, {"op": "autoscale",
+                                             "disable": "nosuch"},
+                                      timeout=10)
+            assert "error" in resp
+            # top renders the posture section from the same payload.
+            from rbg_tpu.cli import top as top_mod
+            src = {"kind": "admin", "addr": addr, "slo": {},
+                   "autoscale": plane.autoscale_controller.status()}
+            lines = "\n".join(top_mod._render_admin(src, 60))
+            assert "TARGET" in lines and "serve" in lines
+            assert "LAST DECISION" in lines
+        finally:
+            admin.stop()
+
+
+@pytest.mark.slow
+def test_autoscale_loop_e2e_drill():
+    """The full capacity-follows-load loop (compact trace): the drill's
+    own invariants are the assertions."""
+    from rbg_tpu.stress.harness import AutoscaleStressConfig, run_autoscale
+
+    # Default trace length: the post-burst tail must be long enough for
+    # the down-stabilization window to fire (a 10 s trace is not).
+    rep = run_autoscale(AutoscaleStressConfig())
+    assert rep["invariants"]["capacity_follows_load"], rep["burst_react_s"]
+    assert rep["invariants"]["zero_dropped_streams"], rep["requests"]
+    assert rep["invariants"]["slo_accounted"], rep["requests"]
+    assert rep["invariants"]["targets_fell_after_burst"], rep["decisions"]
+    assert rep["peak_target"] > 1

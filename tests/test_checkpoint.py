@@ -72,6 +72,7 @@ def test_hf_import_matches_transformers(tmp_path, with_bias):
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_engine_loads_checkpoint(tmp_path):
     from rbg_tpu.engine import Engine, EngineConfig, SamplingParams
 

@@ -45,6 +45,7 @@ def router_role() -> RoleSpec:
 
 
 @pytest.mark.e2e
+@pytest.mark.slow
 def test_pd_disagg_serves_through_real_processes(tmp_path):
     plane = ControlPlane(
         backend="local",

@@ -58,6 +58,7 @@ def test_embed_rejects_bad_prompts():
         svc.stop()
 
 
+@pytest.mark.slow
 def test_hf_chat_template_render_and_fallback():
     tok = HFTokenizer("tests/fixtures/tiny_hf_tokenizer")
     msgs = [{"role": "user", "content": "hi"}]
@@ -69,6 +70,7 @@ def test_hf_chat_template_render_and_fallback():
 
 
 @pytest.mark.e2e
+@pytest.mark.slow
 def test_embeddings_over_http():
     import json
     import socket

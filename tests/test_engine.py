@@ -31,6 +31,7 @@ def ref_greedy(params, cfg, prompt, steps):
     return [int(t) for t in np.asarray(out)[0]]
 
 
+@pytest.mark.slow
 def test_paged_attention_matches_dense(tiny_setup):
     """Paged forward == contiguous forward for a single sequence."""
     cfg, params = tiny_setup
@@ -41,6 +42,7 @@ def test_paged_attention_matches_dense(tiny_setup):
     assert got == expect
 
 
+@pytest.mark.slow
 def test_chunked_prefill_long_prompt(tiny_setup):
     cfg, params = tiny_setup
     rng = np.random.RandomState(0)
@@ -95,6 +97,7 @@ def test_preemption_under_page_pressure(tiny_setup):
     assert eng.metrics["preemptions"] >= 1, "page pressure must trigger preemption"
 
 
+@pytest.mark.slow
 def test_sampling_modes(tiny_setup):
     cfg, params = tiny_setup
     prompt = [3, 1, 4, 1, 5]
@@ -110,6 +113,7 @@ def test_sampling_modes(tiny_setup):
     assert hot[0] != hot[1]  # two hot samples almost surely diverge
 
 
+@pytest.mark.slow
 def test_stop_token(tiny_setup):
     cfg, params = tiny_setup
     prompt = [2, 4, 6]
@@ -120,6 +124,7 @@ def test_stop_token(tiny_setup):
     assert got == expect[:3]
 
 
+@pytest.mark.slow
 def test_page_accounting_balances(tiny_setup):
     cfg, params = tiny_setup
     eng = make_engine(params, radix=False, num_pages=32)
@@ -158,6 +163,7 @@ def test_engine_on_mesh_matches_single_device(tiny_setup):
     assert got == expect
 
 
+@pytest.mark.slow
 def test_int8_kv_cache(tiny_setup):
     """int8-quantized KV pool: half the KV memory, bounded logit deviation,
     page accounting still balanced."""
@@ -232,6 +238,7 @@ def test_multistep_matches_single_step_greedy(tiny_setup):
         assert got == expect, f"multi_step={k}"
 
 
+@pytest.mark.slow
 def test_multistep_stop_token_mid_window(tiny_setup):
     """A stop token landing mid-window cuts emission at the stop; the
     window's speculative tail is discarded and pages are reclaimed."""
@@ -283,6 +290,7 @@ def test_multistep_preemption_under_pressure(tiny_setup):
     assert eng.metrics["preemptions"] > 0
 
 
+@pytest.mark.slow
 def test_multistep_stop_plus_page_pressure_no_leak(tiny_setup):
     """A pending stop token emitted by the alloc-retry drain finishes the
     very request being grown — its freshly allocated pages must return to

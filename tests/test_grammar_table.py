@@ -168,6 +168,7 @@ def test_fused_table_decode_is_bit_identical(eng_factory, temperature):
                         _TOK.decode([t for t in b[1] if t != _TOK.eos_id]))
 
 
+@pytest.mark.slow
 def test_pushdown_json_mode_keeps_host_synced_path(eng_factory):
     """json_mode rides the pushdown JsonGrammar — no finite table — so it
     must keep the host-synced path even with tables on, and still match
@@ -262,6 +263,7 @@ def test_device_table_upload_is_cached_per_combination(eng_factory):
     assert offs == {id(g1): 0} and len(eng._gtable_dev) == before
 
 
+@pytest.mark.slow
 def test_shared_grammar_rows_share_one_table_block(eng_factory):
     """Two rows with the SAME pattern share one compiled grammar (the
     LRU) and therefore one table block — and decode exactly."""

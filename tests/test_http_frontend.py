@@ -176,6 +176,7 @@ def test_models_and_health(stack):
 
 
 @pytest.mark.e2e
+@pytest.mark.slow
 def test_completions_nonstream_through_pd(stack):
     fe = stack
     resp = _post(fe, "/v1/completions",

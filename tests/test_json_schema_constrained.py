@@ -238,6 +238,7 @@ def test_http_edge_maps_schema_fields():
 
 
 @pytest.mark.e2e
+@pytest.mark.slow
 def test_json_schema_over_wire():
     """guided_json through a real server subprocess: generate_text with a
     json_schema constraint returns text that parses AND validates."""

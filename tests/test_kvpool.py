@@ -88,6 +88,7 @@ def test_store_lru_eviction_by_bytes():
 # ---- prefill integration (in-process, two workers sharing one pool) ----
 
 
+@pytest.mark.slow
 def test_second_replica_skips_prefill_through_pool(tiny_setup):
     cfg, params = tiny_setup
     srv = KVPoolServer(("127.0.0.1", 0), KVPoolStore(PS))

@@ -54,6 +54,7 @@ def _engine(params=PARAMS, **kw):
 PROMPT = [1, 2, 3, 4]
 
 
+@pytest.mark.slow
 def test_lora_matches_merged_weights():
     ad = _adapter(0)
     ref = _engine(params=_merged(ad, 8.0)).generate(
@@ -65,6 +66,7 @@ def test_lora_matches_merged_weights():
     assert got == ref
 
 
+@pytest.mark.slow
 def test_base_rows_unaffected_by_loaded_adapters():
     eng = _engine()
     eng.load_lora("a", _adapter(0), alpha=8.0)

@@ -80,6 +80,7 @@ def test_moe_train_step_reduces_loss(moe_setup):
     assert float(loss) < loss0
 
 
+@pytest.mark.slow
 def test_moe_serving_engine(moe_setup):
     """The engine serves MoE models unchanged (paged path uses the same
     block math)."""

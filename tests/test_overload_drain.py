@@ -571,6 +571,7 @@ ENGINE_ARGS = ["--model", "tiny", "--page-size", "8", "--num-pages", "128",
 
 
 @pytest.mark.e2e
+@pytest.mark.slow
 def test_sigterm_drains_stream_then_exits_cleanly():
     """The rollout drill: SIGTERM lands mid-stream. The in-flight stream
     completes, health reports draining, NEW ops are refused with the
@@ -621,6 +622,7 @@ def test_sigterm_drains_stream_then_exits_cleanly():
 
 
 @pytest.mark.e2e
+@pytest.mark.slow
 def test_client_disconnect_cancels_backend_decode_leg():
     """Satellite: the router's _ClientGone path must CANCEL the backend
     decode leg, not merely stop relaying — verified by the decode

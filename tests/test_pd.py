@@ -23,6 +23,7 @@ def ecfg(**kw):
     return EngineConfig(**base)
 
 
+@pytest.mark.slow
 def test_pd_matches_unified(tiny_setup):
     """Disaggregated output must be token-identical to a unified engine."""
     cfg, params = tiny_setup

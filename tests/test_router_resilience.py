@@ -519,6 +519,7 @@ def _wait_engine_ready(port, timeout=180.0):
 
 
 @pytest.mark.e2e
+@pytest.mark.slow
 def test_sigkill_decode_mid_stream_client_completes():
     """The VERDICT-mandated drill: PD group with TWO decode replicas; the
     active one is SIGKILLed mid-stream; the client still receives the

@@ -46,6 +46,7 @@ def test_byte_tokenizer_roundtrip():
     assert load_tokenizer(None).vocab_size == 259
 
 
+@pytest.mark.slow
 def test_generate_text_op():
     from conftest import SpawnedEngineServer
     from rbg_tpu.engine.protocol import request_once

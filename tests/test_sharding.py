@@ -47,6 +47,7 @@ def test_sharded_forward_matches_single_device(mesh8):
     )
 
 
+@pytest.mark.slow
 def test_train_step_reduces_loss(mesh8):
     cfg = get_config("tiny")
     params = init_params(cfg, jax.random.key(0))

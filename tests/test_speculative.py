@@ -75,6 +75,7 @@ def test_spec_sampled_bit_identical():
     assert a == b
 
 
+@pytest.mark.slow
 def test_spec_batch_bit_identical():
     prompts = [[1, 2, 3] * 6, [9, 8, 7, 6, 5], [4] * 8]
     sp = SamplingParams(max_new_tokens=12)

@@ -71,6 +71,7 @@ def test_pure_prefill_bit_identity(tiny_setup):
     assert eng.metrics["unified_steps"] > 0
 
 
+@pytest.mark.slow
 def test_pure_decode_keeps_fused_scan(tiny_setup):
     """Once every row is decoding, the engine must return to the fused
     multi-step scan (unified steps only cover the prefill-mixed phase) —
@@ -89,6 +90,7 @@ def test_pure_decode_keeps_fused_scan(tiny_setup):
     assert eng.metrics["decode_tokens"] > 4
 
 
+@pytest.mark.slow
 def test_mixed_join_bit_identity_greedy(tiny_setup):
     """Rows joining a decoding batch mid-stream (continuous admission)
     produce bit-identical streams to the split path for every row."""
@@ -102,6 +104,7 @@ def test_mixed_join_bit_identity_greedy(tiny_setup):
     assert eng.metrics["joins"] == 4
 
 
+@pytest.mark.slow
 def test_mixed_join_bit_identity_sampled(tiny_setup):
     """Seeded sampling + penalties + logprobs across a mid-decode join:
     per-row keys are position-keyed, so the ragged path must replay the
@@ -172,6 +175,7 @@ def test_preemption_under_page_pressure_ragged(tiny_setup):
     assert all(len(o) == 12 for o in got)
 
 
+@pytest.mark.slow
 def test_seq_len_accounting_after_pending_drain(tiny_setup):
     """Regression for the prefill-chunk boundary invariant (the seq_len
     double-count the runtime-LoRA drain comment protects): a join forces
@@ -219,6 +223,7 @@ def test_seq_len_accounting_after_pending_drain(tiny_setup):
     assert got == split_run()
 
 
+@pytest.mark.slow
 def test_join_accounting_metrics(tiny_setup):
     """Admissions record joins and (with free capacity) zero excess wait;
     page-blocked queueing counts as availability wait, not excess."""

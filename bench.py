@@ -279,6 +279,100 @@ def mixed_probe() -> dict:
     }
 
 
+# PD transfer-plane probe: the SAME PD pair drives a modeled link
+# (kvtransfer.FakeICITransport — measured pacing, identical for both
+# arms) twice per rep, INTERLEAVED: chunked layer-overlapped streaming
+# (prefill publishes KV as chunks complete; decode admits at coverage)
+# vs whole-bundle (all frames after prefill, admit at stream close).
+# Metric: p50 time-to-first-DECODE-token — the decode-side stall the
+# transfer plane shrinks (the first token's latency is identical by
+# construction: it is produced prefill-side). Greedy sampling ⇒ the two
+# arms must also be BIT-IDENTICAL per request (gate-coupled).
+PD_STREAM_PROMPT_LEN = 96
+PD_STREAM_REQUESTS = 4
+PD_STREAM_REPS = 4
+PD_STREAM_LINK_BYTES_PER_S = 2e6
+PD_STREAM_MAX_NEW = 8
+
+
+def pd_stream_probe() -> dict:
+    import numpy as np
+
+    from rbg_tpu.engine import EngineConfig, SamplingParams
+    from rbg_tpu.engine.pd import PDStreamPair
+    from rbg_tpu.kvtransfer import FakeICITransport
+
+    rng = np.random.RandomState(13)
+    cfg = EngineConfig(model="tiny", page_size=8, num_pages=512,
+                       max_batch=4, max_seq_len=256, prefill_chunk=16,
+                       enable_radix_cache=False, use_pallas="never")
+    pair = PDStreamPair(cfg, transport=FakeICITransport(
+        bytes_per_s=PD_STREAM_LINK_BYTES_PER_S, latency_s=0.0005))
+    vocab = pair.prefill.engine.mcfg.vocab_size
+    prompts = [rng.randint(1, vocab, size=PD_STREAM_PROMPT_LEN).tolist()
+               for _ in range(PD_STREAM_REQUESTS)]
+    sp = SamplingParams(max_new_tokens=PD_STREAM_MAX_NEW)
+    # Warm both arms (jit compiles must not land in a timed rep).
+    warm = rng.randint(1, vocab, size=PD_STREAM_PROMPT_LEN).tolist()
+    pair.generate_one(warm, sp, stream=True, recv_timeout=120.0)
+    pair.generate_one(warm, sp, stream=False, recv_timeout=120.0)
+
+    def rep(stream: bool):
+        ttfd, toks = [], []
+        for p in prompts:
+            r = pair.generate_one(p, sp, stream=stream,
+                                  recv_timeout=120.0)
+            ttfd.append(r["t_first_decode"])
+            toks.append(r["tokens"])
+        return statistics.median(ttfd), toks
+
+    # Interleaved reps: this box's throughput is bimodal at multi-second
+    # granularity — back-to-back arms fake (or hide) deltas. Trimmed
+    # spread gates each arm like every other probe in this file.
+    best = None
+    attempt_spreads = []
+    for _ in range(MAX_ATTEMPTS):
+        s_runs, b_runs = [], []
+        s_out = b_out = None
+        for _ in range(PD_STREAM_REPS):
+            p50, s_out = rep(stream=True)
+            s_runs.append(p50)
+            p50, b_out = rep(stream=False)
+            b_runs.append(p50)
+        spread = max(trimmed_spread_of(s_runs), trimmed_spread_of(b_runs))
+        attempt_spreads.append(round(spread, 1)
+                               if math.isfinite(spread) else None)
+        if best is None or spread < best[0]:
+            best = (spread, s_runs, b_runs, s_out, b_out)
+        if spread <= SPREAD_GATE_PCT:
+            break
+    spread, s_runs, b_runs, s_out, b_out = best
+    s_p50 = statistics.median(s_runs)
+    b_p50 = statistics.median(b_runs)
+    bit_identical = s_out == b_out
+    delta_pct = 100.0 * (1 - s_p50 / b_p50) if b_p50 else None
+    return {
+        "metric": ("pd_first_decode_token_tiny_"
+                   f"n{PD_STREAM_REQUESTS}x{PD_STREAM_REPS}_fakeici"),
+        "link_bytes_per_s": PD_STREAM_LINK_BYTES_PER_S,
+        "prompt_len": PD_STREAM_PROMPT_LEN,
+        "stream_ttfd_p50_ms": round(s_p50 * 1000, 2),
+        "bundle_ttfd_p50_ms": round(b_p50 * 1000, 2),
+        "stream_runs_ms": [round(r * 1000, 1) for r in s_runs],
+        "bundle_runs_ms": [round(r * 1000, 1) for r in b_runs],
+        "ttfd_p50_reduction_pct": (round(delta_pct, 1)
+                                   if delta_pct is not None else None),
+        "bit_identical": bit_identical,
+        "spread_pct": round(spread, 1) if math.isfinite(spread) else None,
+        "attempt_spreads_pct": attempt_spreads,
+        "spread_estimator": "trimmed_minmax_drop1",
+        # The gate COUPLES speed to correctness: chunked streaming must
+        # STRICTLY lower p50 decode-side TTFT AND decode bit-identically.
+        "gate": ("pass" if bit_identical and s_p50 < b_p50
+                 and spread <= SPREAD_GATE_PCT else "fail"),
+    }
+
+
 def tpu_probe() -> dict:
     """Probe the chip in a THROWAWAY subprocess: the tunnel can wedge
     indefinitely (grant lost), and a hung probe must not hang the bench.
@@ -427,6 +521,12 @@ def main():
         out["mixed"] = mixed_probe()
     except Exception as e:  # noqa: BLE001 — diagnostics beat a dead line
         out["mixed"] = {"error": f"{type(e).__name__}: {e}"}
+    # PD transfer-plane probe (chunked layer-overlapped KV streaming vs
+    # whole-bundle over the same modeled link) — same failure isolation.
+    try:
+        out["pd_stream"] = pd_stream_probe()
+    except Exception as e:  # noqa: BLE001 — diagnostics beat a dead line
+        out["pd_stream"] = {"error": f"{type(e).__name__}: {e}"}
     if probe is not None and not probe.get("ok"):
         out["tpu_probe"] = probe
     print(json.dumps(out))

@@ -38,6 +38,13 @@ CODE_DRAINING = "draining"
 #: specific kinds above.
 CODE_REJECTED = "rejected"
 
+#: A prefill→decode KV chunk stream failed (truncated, aborted, or never
+#: became coverage-complete). The KV at that decode replica is gone — the
+#: router recovers by RE-PREFILLING (pool/radix makes it cheap) rather
+#: than retrying the same stream; clients never see it when a sibling
+#: path exists.
+CODE_KV_STREAM = "kv_stream_failed"
+
 #: Codes the router may retry on a sibling backend (a shed or draining
 #: backend is HEALTHY — never evicted).
 RETRYABLE_REJECT_CODES = (CODE_OVERLOADED, CODE_DRAINING)
@@ -49,6 +56,7 @@ ALL_CODES = frozenset({
     CODE_DEADLINE,
     CODE_DRAINING,
     CODE_REJECTED,
+    CODE_KV_STREAM,
 })
 
 # ---- HTTP edge mapping (single source for http_frontend) ----

@@ -54,7 +54,8 @@ from rbg_tpu.utils.racetrace import guard as _race_guard
 
 
 class _Node:
-    __slots__ = ("key", "k", "v", "children", "parent", "last_used", "nbytes")
+    __slots__ = ("key", "k", "v", "children", "parent", "last_used",
+                 "nbytes", "dirkey")
 
     def __init__(self, key: Tuple[int, ...], parent):
         self.key = key                    # page_size tokens
@@ -64,18 +65,26 @@ class _Node:
         self.parent = parent
         self.last_used = time.monotonic()
         self.nbytes = 0
+        # Directory hash-chain key of the prefix ending at this node
+        # (chunks.prefix_keys convention) — eviction invalidates it.
+        self.dirkey: str = ""
 
 
 @_race_guard
 class KVPoolStore:
     """Page-granular prefix trie with LRU byte-budget eviction."""
 
-    def __init__(self, page_size: int, max_bytes: int = 1 << 30):
+    def __init__(self, page_size: int, max_bytes: int = 1 << 30,
+                 directory=None):
         self.page_size = page_size
         self.max_bytes = max_bytes
         self.root = _Node((), None)  # guarded_by[engine.kvpool]
         self.bytes = 0  # guarded_by[engine.kvpool]
         self._lock = named_lock("engine.kvpool")
+        # Cluster prefix directory living NEXT to the pool (the kv-pool
+        # server hosts both): evicting a prefix here invalidates its
+        # directory keys, so a lookup can never return an evicted prefix.
+        self.directory = directory
         # guarded_by[engine.kvpool]
         self.metrics = {"hits": 0, "misses": 0, "hit_tokens": 0,
                         "put_pages": 0, "evicted_pages": 0, "pages": 0}
@@ -122,16 +131,20 @@ class KVPoolStore:
         newly stored."""
         ps = self.page_size
         n = min((len(tokens) // ps) * ps, k.shape[1] * ps)
-        # Copy the page payloads BEFORE taking the lock (see match()).
+        # Copy the page payloads BEFORE taking the lock (see match());
+        # directory keys (the cross-process hash chain) likewise.
+        from rbg_tpu.kvtransfer.chunks import prefix_keys
+        dirkeys = prefix_keys(tokens[:n], ps)
         staged = [(tuple(tokens[pi * ps:(pi + 1) * ps]),
                    np.ascontiguousarray(k[:, pi]),
-                   np.ascontiguousarray(v[:, pi]))
+                   np.ascontiguousarray(v[:, pi]),
+                   dirkeys[pi])
                   for pi in range(n // ps)]
         new_pages = 0
         with self._lock:
             node = self.root
             now = time.monotonic()
-            for key, kp, vp in staged:
+            for key, kp, vp, dk in staged:
                 child = node.children.get(key)
                 if child is not None:
                     child.last_used = now
@@ -144,22 +157,31 @@ class KVPoolStore:
                 child.k, child.v = kp, vp
                 child.nbytes = kp.nbytes + vp.nbytes
                 child.last_used = now
+                child.dirkey = dk
                 node.children[key] = child
                 self.bytes += child.nbytes
                 new_pages += 1
                 node = child
             self.metrics["put_pages"] += new_pages
             self.metrics["pages"] += new_pages
-            self._evict_locked()
+            evicted_keys = self._evict_locked()
+        if evicted_keys and self.directory is not None:
+            # Outside the pool lock: a lookup racing this sees the prefix
+            # a moment longer, but never AFTER invalidation completes —
+            # the directory_consistent drill checks post-eviction lookups.
+            self.directory.invalidate_keys(evicted_keys, reason="eviction")
         return new_pages
 
     # ---- eviction ----
 
-    def _evict_locked(self):
+    def _evict_locked(self) -> List[str]:
         """Evict LRU leaves until under budget. Each pass walks the trie
         ONCE and evicts all current leaves in LRU order (a per-page
         full-trie scan would be O(pages²) under sustained pressure); a node
-        whose children were all evicted becomes a leaf for the next pass."""
+        whose children were all evicted becomes a leaf for the next pass.
+        Returns the directory keys of evicted pages (the caller
+        invalidates them outside this lock)."""
+        evicted: List[str] = []
         while self.bytes > self.max_bytes:
             leaves = []
             stack = [self.root]
@@ -169,15 +191,18 @@ class KVPoolStore:
                     leaves.append(node)
                 stack.extend(node.children.values())
             if not leaves:
-                return
+                return evicted
             leaves.sort(key=lambda nd: nd.last_used)
             for leaf in leaves:
                 if self.bytes <= self.max_bytes:
-                    return
+                    return evicted
                 leaf.parent.children.pop(leaf.key, None)
                 self.bytes -= leaf.nbytes
                 self.metrics["evicted_pages"] += 1
                 self.metrics["pages"] -= 1
+                if leaf.dirkey:
+                    evicted.append(leaf.dirkey)
+        return evicted
 
     def stats(self) -> dict:
         with self._lock:
@@ -262,9 +287,49 @@ class _Handler(socketserver.BaseRequestHandler):
             vs = np.frombuffer(v, dtype=obj["dtype"]).reshape(obj["v_shape"])
             stored = store.put(obj["prompt"], ks, vs)
             send_msg(self.request, {"stored_pages": stored})
+        elif op in ("dir_register", "dir_lookup", "dir_invalidate",
+                    "dir_stats"):
+            d = store.directory
+            if d is None:
+                send_msg(self.request, {"error": "no directory configured"})
+                return
+            if op == "dir_register":
+                n = d.register_keys(list(obj.get("keys") or ()),
+                                    obj.get("backend") or "",
+                                    slice_id=obj.get("slice_id") or "")
+                send_msg(self.request, {"registered": n})
+            elif op == "dir_lookup":
+                if "prompt" in obj:
+                    # Key chain computed HERE with the pool's page size —
+                    # routers hold no engine config.
+                    from rbg_tpu.kvtransfer.chunks import prefix_keys
+                    keys = prefix_keys(list(obj["prompt"]),
+                                       store.page_size)
+                else:
+                    keys = list(obj.get("keys") or ())
+                matched, holders = d.lookup_keys(keys)
+                send_msg(self.request, {
+                    "matched": matched,
+                    "matched_tokens": matched * store.page_size,
+                    "holders": holders})
+            elif op == "dir_invalidate":
+                reason = obj.get("reason") or "explicit"
+                n = 0
+                if obj.get("backend"):
+                    n += d.invalidate_backend(obj["backend"], reason)
+                if obj.get("slice_id"):
+                    n += d.invalidate_slice(obj["slice_id"], reason)
+                if obj.get("keys"):
+                    n += d.invalidate_keys(list(obj["keys"]), reason)
+                send_msg(self.request, {"invalidated": n})
+            else:
+                send_msg(self.request, {"directory": d.stats(),
+                                        "mode": "kvpool"})
         elif op == "pool_stats" or op == "metrics":
-            send_msg(self.request, {"metrics": store.stats(),
-                                    "mode": "kvpool"})
+            stats = {"metrics": store.stats(), "mode": "kvpool"}
+            if store.directory is not None:
+                stats["directory"] = store.directory.stats()
+            send_msg(self.request, stats)
         elif op == "health":
             send_msg(self.request, {"ok": True, "mode": "kvpool"})
         else:
@@ -365,7 +430,10 @@ def main(argv=None) -> int:
                          "via runtime.tlsutil.ensure_certs, same CA "
                          "machinery as the admin wire)")
     args = ap.parse_args(argv)
-    store = KVPoolStore(args.page_size, max_bytes=args.max_bytes)
+    from rbg_tpu.kvtransfer.directory import PrefixDirectory
+    store = KVPoolStore(args.page_size, max_bytes=args.max_bytes,
+                        directory=PrefixDirectory(
+                            page_size=args.page_size))
     ctx = None
     if args.cert_dir:
         from rbg_tpu.runtime.tlsutil import ensure_certs, server_context

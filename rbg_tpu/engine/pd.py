@@ -7,35 +7,56 @@ in PAPERS.md). The control plane places the roles; THIS module is the data
 path between them:
 
 * ``PrefillWorker`` — runs prompts to first-token on a prefill engine and
-  exports the sequence's KV pages as a ``KVBundle``.
-* ``DecodeWorker`` — imports a bundle into its own page pool and continues
-  decoding with continuous batching.
-* ``PDPair`` — in-process pair (same chip / same slice: the transfer is a
-  device gather+scatter). Cross-process transfer sends the same bundle over
-  the transport in ``rbg_tpu.engine.server`` (DCN analog); on multi-slice
-  TPU the placement layer keeps the pair within one ICI domain so the
-  transfer rides ICI (BASELINE.json north star).
+  exports the sequence's KV pages: as one ``KVBundle`` (legacy, single
+  blob) or as a CHUNKED STREAM over a ``rbg_tpu.kvtransfer`` transport —
+  page-aligned, layer-ordered chunks published AS prefill chunks complete,
+  so the transfer overlaps the remaining prefill compute.
+* ``DecodeWorker`` — imports KV into its own page pool and continues
+  decoding with continuous batching. The streaming form writes chunks
+  into the page table as they arrive (host staging on transport threads;
+  device commits on the engine loop thread, the single-writer contract)
+  and admits the row the moment layer coverage is complete for the
+  prompt — decode starts before the stream closes.
+* ``PDPair`` / ``PDStreamPair`` — in-process pairs (same chip / same
+  slice). Cross-process transfer rides ``rbg_tpu.engine.server`` ops
+  (``kv_stream`` / ``decode_stream``); on multi-slice TPU the placement
+  layer keeps the pair within one ICI domain (BASELINE.json north star).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import os
+import queue
+import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from rbg_tpu.engine.config import EngineConfig, SamplingParams
 from rbg_tpu.engine.engine import Engine, Request
-from rbg_tpu.engine.kvcache import pages_for_tokens
+from rbg_tpu.engine.kvcache import PagedKVCache, pages_for_tokens
+from rbg_tpu.kvtransfer.chunks import (KVChunk, StreamError, StreamFin,
+                                       StreamFirstToken, StreamMeta,
+                                       slab_to_chunks)
 from rbg_tpu.obs import names as obs_names
 from rbg_tpu.obs import trace
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.utils.locktrace import named_lock
+
+_stream_ids = itertools.count()
+
+
+def new_stream_id(prefix: str = "kvs") -> str:
+    return f"{prefix}-{os.getpid()}-{next(_stream_ids)}"
 
 
 @dataclasses.dataclass
 class KVBundle:
-    """A sequence's transferable KV state."""
+    """A sequence's transferable KV state (the whole-blob form)."""
 
     prompt: List[int]
     first_token: int
@@ -47,21 +68,58 @@ class KVBundle:
         return self.k_data.nbytes + self.v_data.nbytes
 
 
+class PushResult:
+    """Handle on an in-flight chunked KV push. ``prefill_stream`` returns
+    the moment prefill COMPUTE ends (first token exists); the sender
+    thread keeps draining queued chunks over the link. ``wait`` joins the
+    push; ``error`` is the structured failure, if any."""
+
+    def __init__(self, stream_id: str, meta: StreamMeta):
+        self.stream_id = stream_id
+        self.meta = meta
+        self.first_token: Optional[int] = None
+        self.nbytes = 0
+        self.push_s = 0.0
+        self.chunks = 0
+        self._err: Optional[str] = None
+        self._done = threading.Event()
+
+    def wait(self, timeout: float = 60.0) -> bool:
+        return self._done.wait(timeout)
+
+    def error(self) -> Optional[str]:
+        return self._err
+
+
 class PrefillWorker:
     def __init__(self, cfg: EngineConfig, params: Optional[dict] = None,
-                 mesh=None, pool=None):
+                 mesh=None, pool=None, directory=None,
+                 advertise_addr: str = "", slice_id: Optional[str] = None):
         """``pool``: optional ``rbg_tpu.engine.kvpool.KVPoolClient`` — the
         SHARED cross-request/cross-replica prefix store (Mooncake-store
         analog, keps/74). Consulted before computing, published to after.
-        Pool failures degrade to cold prefill, never to request failure."""
+        Pool failures degrade to cold prefill, never to request failure.
+
+        ``directory``: optional cluster prefix directory handle
+        (``kvtransfer.PrefixDirectory`` or ``DirectoryClient``). Computed
+        page-aligned prefixes are registered under ``advertise_addr`` (this
+        replica's serving address) so the router can send prefix-sharing
+        requests to ANY holder. ``slice_id`` tags entries for slice-level
+        invalidation on preemption (default: $RBG_SLICE_ID)."""
         cfg = dataclasses.replace(cfg, mode="prefill")
         self.engine = Engine(cfg, params=params, mesh=mesh)
         self.pool = pool
+        self.directory = directory
+        self.advertise_addr = advertise_addr
+        self.slice_id = (slice_id if slice_id is not None
+                         else os.environ.get("RBG_SLICE_ID", ""))
         if pool is not None and getattr(pool, "page_size", None) is None:
             pool.page_size = cfg.page_size  # handshake: server verifies
         self.metrics = {"bundles": 0, "bytes_out": 0, "transfer_s": 0.0,
                         "pool_hits": 0, "pool_hit_tokens": 0,
-                        "pool_exports": 0, "pool_errors": 0}
+                        "pool_exports": 0, "pool_errors": 0,
+                        "streams": 0, "stream_chunks": 0,
+                        "dir_registers": 0}
 
     def warmup(self, input_len: int = 32) -> float:
         """Compile the prefill + bundle-export path (jit variants keyed on
@@ -73,29 +131,22 @@ class PrefillWorker:
 
         t0 = time.perf_counter()
         pool, self.pool = self.pool, None
+        directory, self.directory = self.directory, None
         try:
             self.prefill(warm_prompt(input_len))
         finally:
             self.pool = pool
+            self.directory = directory
         return time.perf_counter() - t0
 
-    def prefill(self, prompt: List[int],
-                sampling: Optional[SamplingParams] = None,
-                deadline: Optional[float] = None) -> KVBundle:
-        """Run one prompt to its first token; export KV pages.
+    # ---- shared prefill core ----
 
-        ``deadline`` (absolute ``time.monotonic()``) aborts a long chunked
-        prefill between chunks once the client's budget is spent — the
-        pages recycle immediately instead of finishing a bundle nobody is
-        waiting for. Raises the service-layer ``DeadlineExceeded`` so the
-        server maps it to the structured wire code."""
-        sampling = sampling or SamplingParams()
-        one = dataclasses.replace(sampling, max_new_tokens=1)
-        ps = self.engine.cfg.page_size
+    def _start_request(self, prompt: List[int], one: SamplingParams):
+        """Pool-consulted admission. Returns (rid, matched_tokens)."""
         rid = None
         matched = 0
         # Adapter requests skip the shared pool: pooled KV is base-model KV.
-        if self.pool is not None and sampling.lora is None:
+        if self.pool is not None and one.lora is None:
             # Keep at least the prompt's last token for prefill (logits) —
             # same contract as the in-process radix cache.
             try:
@@ -119,7 +170,15 @@ class PrefillWorker:
                     self.metrics["pool_hit_tokens"] += matched
         if rid is None:
             rid = self.engine.add_request(prompt, one)
+        return rid, matched
+
+    def _run_to_first(self, rid: int, deadline: Optional[float],
+                      on_step: Optional[Callable[[Request], None]] = None
+                      ) -> int:
+        """Step the engine until ``rid`` emits its first token. ``on_step``
+        fires after every step with the request — the chunk-publish hook."""
         first = None
+        req = self.engine.requests[rid]
         while first is None:
             if deadline is not None and time.monotonic() >= deadline:
                 from rbg_tpu.engine.protocol import DeadlineExceeded
@@ -131,36 +190,268 @@ class PrefillWorker:
             for ev in self.engine.step():
                 if ev.request_id == rid:
                     first = ev.token
+            if on_step is not None:
+                on_step(req)
+        return first
+
+    def _export_pages(self, req: Request, lo: int, hi: int):
+        """Host copy of device pages [lo, hi) of this request — the
+        transfer payload. Device→host sync; callers keep it off any
+        critical section."""
+        ids = jnp.asarray(req.pages[lo:hi], jnp.int32)
+        t0 = time.perf_counter()
+        k = np.asarray(self.engine.cache.k_pages[:, ids])
+        v = np.asarray(self.engine.cache.v_pages[:, ids])
+        self.metrics["transfer_s"] += time.perf_counter() - t0
+        return k, v
+
+    def _publish_pool(self, prompt: List[int], matched: int,
+                      k: np.ndarray, v: np.ndarray,
+                      lora) -> None:
+        """Publish the page-aligned prompt prefix to the shared store and
+        register it in the cluster directory. Adapter KV never enters
+        either — it is not base-model KV."""
+        ps = self.engine.cfg.page_size
+        full = len(prompt) // ps
+        if self.pool is not None and lora is None and full > matched // ps:
+            try:
+                self.pool.put(prompt, k[:, :full], v[:, :full])
+                self.metrics["pool_exports"] += 1
+            except (OSError, RuntimeError):
+                self.metrics["pool_errors"] += 1
+        if self.directory is not None and lora is None and full > 0 \
+                and self.advertise_addr:
+            try:
+                self.directory.register(prompt[:full * ps],
+                                        self.advertise_addr,
+                                        slice_id=self.slice_id)
+                self.metrics["dir_registers"] += 1
+            except (OSError, RuntimeError, ValueError):
+                pass  # the directory is an optimization, never a dependency
+
+    def prefill(self, prompt: List[int],
+                sampling: Optional[SamplingParams] = None,
+                deadline: Optional[float] = None) -> KVBundle:
+        """Run one prompt to its first token; export KV pages as one
+        bundle (the legacy whole-blob handoff).
+
+        ``deadline`` (absolute ``time.monotonic()``) aborts a long chunked
+        prefill between chunks once the client's budget is spent — the
+        pages recycle immediately instead of finishing a bundle nobody is
+        waiting for. Raises the service-layer ``DeadlineExceeded`` so the
+        server maps it to the structured wire code."""
+        sampling = sampling or SamplingParams()
+        one = dataclasses.replace(sampling, max_new_tokens=1)
+        rid, matched = self._start_request(prompt, one)
+        first = self._run_to_first(rid, deadline)
         req = self.engine.requests[rid]
         n_pages = pages_for_tokens(len(prompt), self.engine.cfg.page_size)
-        page_ids = jnp.asarray(req.pages[:n_pages], jnp.int32)
-        t0 = time.perf_counter()
-        k = np.asarray(self.engine.cache.k_pages[:, page_ids])
-        v = np.asarray(self.engine.cache.v_pages[:, page_ids])
-        self.metrics["transfer_s"] += time.perf_counter() - t0
+        k, v = self._export_pages(req, 0, n_pages)
         self.engine.release_request(rid)
-        if self.pool is not None and sampling.lora is None:
-            # Publish the page-aligned prompt prefix for future requests
-            # (idempotent: the store refreshes rather than duplicates).
-            # Adapter KV never enters the pool — it is not base-model KV.
-            full = len(prompt) // ps
-            if full > matched // ps:
-                try:
-                    self.pool.put(prompt, k[:, :full], v[:, :full])
-                    self.metrics["pool_exports"] += 1
-                except (OSError, RuntimeError):
-                    self.metrics["pool_errors"] += 1
-        bundle = KVBundle(prompt=list(prompt), first_token=first, k_data=k, v_data=v)
+        self._publish_pool(prompt, matched, k, v, sampling.lora)
+        bundle = KVBundle(prompt=list(prompt), first_token=first,
+                          k_data=k, v_data=v)
         self.metrics["bundles"] += 1
         self.metrics["bytes_out"] += bundle.nbytes
         return bundle
 
+    def stream_meta(self, prompt: List[int],
+                    stream_id: str) -> StreamMeta:
+        cache = self.engine.cache
+        return StreamMeta(
+            stream_id=stream_id, prompt=list(prompt),
+            n_pages=pages_for_tokens(len(prompt),
+                                     self.engine.cfg.page_size),
+            k_page_shape=tuple(cache.k_pages.shape[2:]),
+            v_page_shape=tuple(cache.v_pages.shape[2:]),
+            dtype=str(cache.k_pages.dtype),
+            layers=int(cache.k_pages.shape[0]),
+            page_size=self.engine.cfg.page_size)
+
+    def prefill_stream(self, prompt: List[int],
+                       sampling: Optional[SamplingParams] = None,
+                       *, transport, peer: str,
+                       stream_id: Optional[str] = None,
+                       deadline: Optional[float] = None,
+                       layer_split: int = 0) -> PushResult:
+        """Chunked, layer-overlapped prefill→decode push.
+
+        META is sent before compute (the receiver can allocate pages
+        early); each prefill chunk's newly-final full pages are exported
+        and published AS the next chunk computes; the remaining pages, the
+        first token, and FIN follow prefill completion. All SENDS happen
+        on a dedicated sender thread — the prefill engine (and the
+        server's pd_lock critical section around it) never blocks on the
+        link. Returns when COMPUTE is done; the push drains behind
+        (``PushResult.wait``). Push failures surface on the result, not as
+        request failures — the caller decides bundle-fallback vs retry."""
+        sampling = sampling or SamplingParams()
+        one = dataclasses.replace(sampling, max_new_tokens=1)
+        sid = stream_id or new_stream_id()
+        meta = self.stream_meta(prompt, sid)
+        res = PushResult(sid, meta)
+        ps = self.engine.cfg.page_size
+        split = layer_split or meta.layers
+        q: "queue.Queue" = queue.Queue()
+        pspan = trace.child(obs_names.SPAN_KVT_PUSH, stream_id=sid,
+                            peer=peer, pages=meta.n_pages)
+
+        def sender():
+            send_s = 0.0   # pure link time, excluding waits on compute
+            try:
+                while True:
+                    frame = q.get()
+                    if frame is None:      # producer abort (deadline)
+                        transport.send_one(peer, StreamFin(
+                            sid, n_chunks=res.chunks, aborted=True,
+                            error="prefill aborted"))
+                        res._err = "prefill aborted before completion"
+                        return
+                    t0 = time.monotonic()
+                    transport.send_one(peer, frame)
+                    send_s += time.monotonic() - t0
+                    if isinstance(frame, KVChunk):
+                        res.nbytes += frame.nbytes
+                        res.chunks += 1
+                        REGISTRY.inc(obs_names.KVT_CHUNKS_TOTAL,
+                                     direction="sent")
+                    if isinstance(frame, StreamFin):
+                        return
+            except (StreamError, OSError) as e:
+                res._err = str(e)
+            finally:
+                res.push_s = send_s
+                if res.nbytes and res._err is None:
+                    REGISTRY.inc(obs_names.KVT_STREAMS_TOTAL, outcome="ok")
+                    REGISTRY.inc(obs_names.KVT_BYTES_TOTAL,
+                                 float(res.nbytes), direction="sent",
+                                 transport=transport.name)
+                    # Measured link rate from THIS real transfer — what
+                    # the router's transfer-cost scoring consumes.
+                    transport.stats.observe(peer, res.nbytes, send_s)
+                elif res._err is not None:
+                    REGISTRY.inc(obs_names.KVT_STREAMS_TOTAL,
+                                 outcome="error")
+                pspan.end(outcome=res._err or "ok", bytes=res.nbytes)
+                res._done.set()
+
+        t = threading.Thread(target=sender, daemon=True,
+                             name=f"kvpush-{sid}")
+        t.start()
+        q.put(meta)
+        rid, matched = self._start_request(prompt, one)
+        req = self.engine.requests[rid]
+        exported = [0]    # pages fully exported so far
+        seq = [0]
+        # Retain the exported slabs when a pool/directory publish will
+        # need the full prefix — re-exporting device→host a second time
+        # would double the transfer AND stretch the server's pd_lock
+        # critical section.
+        publishing = ((self.pool is not None or self.directory is not None)
+                      and sampling.lora is None and len(prompt) // ps > 0)
+        slabs: List = []
+
+        def publish_final_pages(r: Request) -> None:
+            done = min(r.prefill_pos // ps, meta.n_pages)
+            if done <= exported[0]:
+                return
+            k, v = self._export_pages(r, exported[0], done)
+            if publishing:
+                slabs.append((k, v))
+            for ch in slab_to_chunks(meta, k, v, exported[0], seq[0],
+                                     split):
+                q.put(ch)
+                seq[0] += 1
+            self.metrics["stream_chunks"] += 1
+            exported[0] = done
+
+        try:
+            first = self._run_to_first(rid, deadline,
+                                       on_step=publish_final_pages)
+        except Exception:
+            q.put(None)    # structured abort to the receiver
+            raise
+        res.first_token = first
+        # Remaining pages (the last prefill chunk's, incl. a partial
+        # final page), then the first token, then FIN.
+        if exported[0] < meta.n_pages:
+            k, v = self._export_pages(req, exported[0], meta.n_pages)
+            if publishing:
+                slabs.append((k, v))
+            for ch in slab_to_chunks(meta, k, v, exported[0], seq[0],
+                                     split):
+                q.put(ch)
+                seq[0] += 1
+            exported[0] = meta.n_pages
+        q.put(StreamFirstToken(sid, first))
+        q.put(StreamFin(sid, n_chunks=seq[0]))
+        # Pool/directory publish wants the page-aligned prefix —
+        # assembled from the slabs already exported for the stream.
+        if publishing and slabs:
+            full = len(prompt) // ps
+            k = np.concatenate([s[0] for s in slabs], axis=1)[:, :full]
+            v = np.concatenate([s[1] for s in slabs], axis=1)[:, :full]
+            self._publish_pool(prompt, matched, k, v, sampling.lora)
+        self.engine.release_request(rid)
+        self.metrics["streams"] += 1
+        self.metrics["bytes_out"] += meta.nbytes()
+        return res
+
+
+class _StreamCommit:
+    """Loop-thread bookkeeping for one in-flight inbound stream: the
+    allocated pages and which staged cells already hit the device."""
+
+    __slots__ = ("receiver", "pages", "committed", "t_first_commit")
+
+    def __init__(self, receiver):
+        self.receiver = receiver
+        self.pages: Optional[List[int]] = None
+        self.committed = 0
+        self.t_first_commit: Optional[float] = None
+
 
 class DecodeWorker:
-    def __init__(self, cfg: EngineConfig, params: Optional[dict] = None, mesh=None):
+    def __init__(self, cfg: EngineConfig, params: Optional[dict] = None,
+                 mesh=None):
         cfg = dataclasses.replace(cfg, mode="decode", enable_radix_cache=False)
         self.engine = Engine(cfg, params=params, mesh=mesh)
-        self.metrics = {"bundles": 0, "bytes_in": 0}
+        self.metrics = {"bundles": 0, "bytes_in": 0, "streams_in": 0,
+                        "stream_commits": 0, "stream_errors": 0}
+        # Serializes the device page-pool swap against any OTHER committer
+        # (the engine loop thread is the only sanctioned one — the lock
+        # makes a violation visible instead of silently corrupting KV) and
+        # feeds the pd_lock hold-time histogram: the satellite contract is
+        # copy OUTSIDE this lock, commit alone inside it.
+        self._commit_lock = named_lock("engine.pd_commit")
+        # Loop-thread-confined: stream_id → _StreamCommit. TTL backstop:
+        # a stream nobody finalizes (abandoned push, dead consumer) must
+        # release its pages instead of holding KV capacity forever.
+        self._stream_commits: Dict[str, _StreamCommit] = {}
+        self.stream_ttl_s = 120.0
+
+    # ---- shared commit primitive ----
+
+    def _commit_pages(self, ids: jnp.ndarray, k_dev, v_dev,
+                      layer_lo: Optional[int] = None,
+                      layer_hi: Optional[int] = None) -> None:
+        """Swap staged K/V into the device page pool. The staging
+        (host→device conversion) happened in the CALLER, outside the
+        lock; only the functional pool swap is serialized."""
+        eng = self.engine
+        t0 = time.perf_counter()
+        with self._commit_lock:
+            if layer_lo is None:
+                k_pages = eng.cache.k_pages.at[:, ids].set(k_dev)
+                v_pages = eng.cache.v_pages.at[:, ids].set(v_dev)
+            else:
+                k_pages = eng.cache.k_pages.at[layer_lo:layer_hi, ids].set(k_dev)
+                v_pages = eng.cache.v_pages.at[layer_lo:layer_hi, ids].set(v_dev)
+            eng.cache = PagedKVCache(k_pages=k_pages, v_pages=v_pages)
+        REGISTRY.observe(obs_names.PD_LOCK_HOLD_SECONDS,
+                         time.perf_counter() - t0, lock="pd_commit")
+
+    # ---- whole-bundle import ----
 
     def inject(self, bundle: KVBundle,
                sampling: Optional[SamplingParams] = None) -> int:
@@ -169,16 +460,15 @@ class DecodeWorker:
 
         The page-pool import (the on-device half of the prefill→decode KV
         handoff) gets its own ``pd.kv_handoff`` span under the ambient
-        request span — the ROADMAP transfer-plane work (chunked /
-        layer-overlapped streaming) lands inside this same hop and
-        inherits the instrumentation."""
+        request span. The host→device staging happens BEFORE the commit
+        lock; only the page-table swap holds it (hold time lands in the
+        rbg_pd_lock_hold_seconds histogram)."""
         sampling = sampling or SamplingParams()
         eng = self.engine
         prompt = bundle.prompt
         eng._check_prompt(prompt)
         # Before alloc — a raise must not leak pages.
         eng._grammar_check(sampling)
-        lora_idx = eng._resolve_lora(sampling)
         n_pages = bundle.k_data.shape[1]
         need = pages_for_tokens(len(prompt) + 1, eng.cfg.page_size)
         pages = eng._alloc(need)
@@ -189,13 +479,28 @@ class DecodeWorker:
         with trace.child(obs_names.SPAN_PD_KV_HANDOFF,
                          bytes=bundle.nbytes, pages=int(n_pages)):
             ids = jnp.asarray(pages[:n_pages], jnp.int32)
-            from rbg_tpu.engine.kvcache import PagedKVCache
-            eng.cache = PagedKVCache(
-                k_pages=eng.cache.k_pages.at[:, ids].set(
-                    jnp.asarray(bundle.k_data, eng.cache.k_pages.dtype)),
-                v_pages=eng.cache.v_pages.at[:, ids].set(
-                    jnp.asarray(bundle.v_data, eng.cache.v_pages.dtype)),
-            )
+            # Staging (host→device dtype conversion) outside the lock.
+            k_dev = jnp.asarray(bundle.k_data, eng.cache.k_pages.dtype)
+            v_dev = jnp.asarray(bundle.v_data, eng.cache.v_pages.dtype)
+            self._commit_pages(ids, k_dev, v_dev)
+        try:
+            rid = self._admit_row(prompt, bundle.first_token, pages,
+                                  sampling)
+        except Exception:
+            eng.allocator.release(pages)
+            raise
+        self.metrics["bundles"] += 1
+        self.metrics["bytes_in"] += bundle.nbytes
+        return rid
+
+    def _admit_row(self, prompt: List[int], first_token: int,
+                   pages: List[int],
+                   sampling: SamplingParams) -> int:
+        """Post-KV-import admission shared by bundle and stream paths:
+        grammar fold-in, request construction, finished-at-inject
+        handling. The caller releases pages on a raise."""
+        eng = self.engine
+        lora_idx = eng._resolve_lora(sampling)
         req = Request(prompt, sampling)
         req.lora_idx = lora_idx
         g = eng._grammar_for(sampling)
@@ -206,15 +511,14 @@ class DecodeWorker:
             # request without req.grammar used to crash the decode batch
             # (advance_token on a None grammar), and regex/json_schema
             # requests silently decoded UNCONSTRAINED.
-            nxt = g.advance_token(g.initial(), bundle.first_token)
+            nxt = g.advance_token(g.initial(), first_token)
             if nxt is None:
                 # A grammar-wired prefill can't produce this; it means the
                 # prefill peer ignored the constraint (mixed-version
                 # deploy). Reject rather than emit corrupt "constrained"
                 # output.
-                eng.allocator.release(pages)
                 raise ValueError(
-                    f"first token {bundle.first_token} violates the "
+                    f"first token {first_token} violates the "
                     "request's grammar constraint — prefill peer ignored "
                     "json_mode/regex/json_schema?")
             req.grammar = g
@@ -223,20 +527,143 @@ class DecodeWorker:
         req.pages = pages
         req.seq_len = len(prompt)
         req.prefill_pos = len(prompt)
-        req.output = [bundle.first_token]
-        req.last_token = bundle.first_token
+        req.output = [first_token]
+        req.last_token = first_token
         req.t_first = time.perf_counter()
         eng.requests[req.id] = req
         eng.running.append(req)
-        self.metrics["bundles"] += 1
-        self.metrics["bytes_in"] += bundle.nbytes
         # Already complete (max_new_tokens == 1 or stop token hit): finish
         # now so its pages recycle.
         if (len(req.output) >= sampling.max_new_tokens
                 or (sampling.stop_token is not None
-                    and bundle.first_token == sampling.stop_token)):
+                    and first_token == sampling.stop_token)):
             eng._finish(req)
         return req.id
+
+    # ---- streaming import (engine loop thread only) ----
+
+    def begin_stream(self, receiver) -> None:
+        """Start committing a stream's chunks as they arrive. Loop-thread
+        only (the engine single-writer contract)."""
+        sid = receiver.stream_id
+        if sid not in self._stream_commits:
+            self._stream_commits[sid] = _StreamCommit(receiver)
+
+    def pump_streams(self) -> int:
+        """Write newly-arrived chunks of every watched stream into the
+        device page table. Loop-thread only. Returns cells committed."""
+        eng = self.engine
+        done = 0
+        now = time.monotonic()
+        for sid in list(self._stream_commits):
+            sc = self._stream_commits[sid]
+            rx = sc.receiver
+            if now - rx.t_open > self.stream_ttl_s:
+                rx.fail("stream expired unconsumed (TTL)")
+            if rx.error() is not None:
+                # Structured failure: recycle any pages; the waiter (the
+                # decode_stream handler) surfaces the error.
+                if sc.pages is not None:
+                    eng.allocator.release(sc.pages)
+                del self._stream_commits[sid]
+                self.metrics["stream_errors"] += 1
+                REGISTRY.inc(obs_names.KVT_STREAMS_TOTAL,
+                             outcome="recv_error")
+                continue
+            a = rx.assembler
+            if a is None:
+                continue
+            if sc.pages is None:
+                need = pages_for_tokens(len(a.meta.prompt) + 1,
+                                        eng.cfg.page_size)
+                pages = eng._alloc(need)
+                if pages is None:
+                    continue   # retry when pages free up
+                sc.pages = pages
+            cells = rx.drain_uncommitted()
+            if not cells:
+                continue
+            done += self._commit_cells(sc, cells)
+        return done
+
+    def _commit_cells(self, sc: _StreamCommit, cells) -> int:
+        """Grouped device writes for staged (layer, page) cells. The host
+        slice + device staging happen outside the commit lock."""
+        rx = sc.receiver
+        a = rx.assembler
+        eng = self.engine
+        if sc.t_first_commit is None:
+            sc.t_first_commit = time.perf_counter()
+        with trace.child(obs_names.SPAN_KVT_COMMIT,
+                         stream_id=rx.stream_id, cells=len(cells)):
+            for (llo, lhi, plo, phi) in cells:
+                ids = jnp.asarray(sc.pages[plo:phi], jnp.int32)
+                k_dev = jnp.asarray(a.k[llo:lhi, plo:phi],
+                                    eng.cache.k_pages.dtype)
+                v_dev = jnp.asarray(a.v[llo:lhi, plo:phi],
+                                    eng.cache.v_pages.dtype)
+                self._commit_pages(ids, k_dev, v_dev, llo, lhi)
+                self.metrics["stream_commits"] += 1
+        return len(cells)
+
+    def finalize_stream(self, receiver,
+                        sampling: Optional[SamplingParams] = None) -> int:
+        """Admit a coverage-complete stream as a running decode row. Loop
+        thread only; the receiver must be ready() (the caller waited).
+        Flushes any cells not yet committed, then admits — the row starts
+        decoding even while the stream's FIN is still in flight."""
+        sampling = sampling or SamplingParams()
+        eng = self.engine
+        rx = receiver
+        if rx.error() is not None:
+            raise StreamError(rx.error())
+        a = rx.assembler
+        if a is None or not a.ready():
+            raise StreamError(
+                f"stream {rx.stream_id} not ready at finalize")
+        prompt = list(a.meta.prompt)
+        try:
+            eng._check_prompt(prompt)
+            eng._grammar_check(sampling)
+        except Exception:
+            # Wire-supplied meta can be garbage — recycle any pages the
+            # pump already allocated for it before failing the request.
+            self.abandon_stream(rx)
+            raise
+        self.begin_stream(rx)
+        sc = self._stream_commits[rx.stream_id]
+        if sc.pages is None:
+            need = pages_for_tokens(len(prompt) + 1, eng.cfg.page_size)
+            sc.pages = eng._alloc(need)
+            if sc.pages is None:
+                del self._stream_commits[rx.stream_id]
+                # StreamError (not RuntimeError): the wire code lets the
+                # router retry this row on a sibling in bundle mode — the
+                # pushed KV cannot be admitted here.
+                raise StreamError("decode engine out of KV pages")
+        cells = rx.drain_uncommitted()
+        if cells:
+            self._commit_cells(sc, cells)
+        pages = sc.pages
+        del self._stream_commits[rx.stream_id]
+        try:
+            rid = self._admit_row(prompt, int(a.first_token), pages,
+                                  sampling)
+        except Exception:
+            eng.allocator.release(pages)
+            raise
+        self.metrics["streams_in"] += 1
+        self.metrics["bytes_in"] += a.bytes_seen
+        REGISTRY.inc(obs_names.KVT_BYTES_TOTAL, float(a.bytes_seen),
+                     direction="recv", transport="stream")
+        return rid
+
+    def abandon_stream(self, receiver) -> None:
+        """Drop a watched stream (deadline/cancel before admission) —
+        pages recycle. Loop thread only."""
+        sc = self._stream_commits.pop(receiver.stream_id, None)
+        if sc is not None and sc.pages is not None:
+            self.engine.allocator.release(sc.pages)
 
 
 class PDPair:
@@ -269,3 +696,110 @@ class PDPair:
                     outputs[ev.request_id].append(ev.token)
         result = [outputs[r] for r in order]
         return (result, ttft) if collect_ttft else result
+
+
+class PDStreamPair:
+    """In-process PD pair over an explicit ``kvtransfer`` transport —
+    the chunked/overlapped twin of ``PDPair`` the bench A/Bs and the
+    slow-link stress drill drive. ``stream=False`` sends the SAME frames
+    whole (every chunk after prefill completes, admission only at FIN):
+    the whole-bundle baseline measured over the identical link."""
+
+    def __init__(self, cfg: EngineConfig, params: Optional[dict] = None,
+                 mesh=None, transport=None, layer_split: int = 0):
+        from rbg_tpu.kvtransfer.transport import InProcTransport
+
+        self.prefill = PrefillWorker(cfg, params=params, mesh=mesh)
+        self.decode = DecodeWorker(cfg, params=self.prefill.engine.params,
+                                   mesh=mesh)
+        self.transport = transport or InProcTransport()
+        self.layer_split = layer_split
+
+    def generate_one(self, prompt: List[int],
+                     sampling: Optional[SamplingParams] = None,
+                     stream: bool = True, recv_timeout: float = 30.0,
+                     max_retries: int = 1) -> dict:
+        """One request through the transfer plane. Returns a timing dict:
+        tokens, t_first_decode (request start → first DECODE token — the
+        stall the plane shrinks), admit_lead_s, retries."""
+        from rbg_tpu.kvtransfer.chunks import bundle_to_frames
+        from rbg_tpu.kvtransfer.stream import KVStreamReceiver
+
+        sampling = sampling or SamplingParams()
+        t0 = time.perf_counter()
+        last_err = None
+        for attempt in range(max_retries + 1):
+            sid = new_stream_id()
+            rx = KVStreamReceiver(sid)
+            rx_thread = threading.Thread(
+                target=rx.pump, args=(self.transport,),
+                kwargs={"timeout": recv_timeout}, daemon=True,
+                name=f"kvrecv-{sid}")
+            rx_thread.start()
+            if stream:
+                res = self.prefill.prefill_stream(
+                    prompt, sampling, transport=self.transport, peer="",
+                    stream_id=sid, layer_split=self.layer_split)
+                first_token = res.first_token
+            else:
+                bundle = self.prefill.prefill(prompt, sampling)
+                first_token = bundle.first_token
+                meta = self.prefill.stream_meta(prompt, sid)
+                frames = bundle_to_frames(meta, bundle.k_data,
+                                          bundle.v_data,
+                                          bundle.first_token,
+                                          self.layer_split)
+                threading.Thread(target=self.transport.send_chunks,
+                                 args=("", frames), daemon=True,
+                                 name=f"kvsend-{sid}").start()
+            # Drive commits while the stream lands; admit at coverage
+            # (stream arm) / at FIN (whole-bundle semantics: ready implies
+            # all data, and FIN follows immediately in this arm anyway).
+            self.decode.begin_stream(rx)
+            deadline = time.monotonic() + recv_timeout
+            rid = None
+            while rid is None:
+                if rx.error() is not None:
+                    last_err = rx.error()
+                    self.decode.abandon_stream(rx)
+                    break
+                self.decode.pump_streams()
+                if rx.ready() and (stream or rx.t_fin is not None):
+                    rid = self.decode.finalize_stream(rx, sampling)
+                    break
+                if time.monotonic() >= deadline:
+                    self.decode.abandon_stream(rx)
+                    raise StreamError(
+                        f"stream {sid} never became ready")
+                time.sleep(0.0002)
+            if rid is None:
+                continue   # retry (token-exact: decode never started)
+            tokens = [first_token]
+            t_first_decode = None
+            while self.decode.engine.has_work():
+                for ev in self.decode.engine.step():
+                    if ev.request_id == rid:
+                        if t_first_decode is None:
+                            t_first_decode = time.perf_counter() - t0
+                            rx.t_first_step = time.monotonic()
+                        tokens.append(ev.token)
+            rx_thread.join(timeout=recv_timeout)
+            return {"tokens": tokens, "t_first_decode": t_first_decode,
+                    "admit_lead_s": rx.admit_lead_s(),
+                    # Overlap: the first decode step landed BEFORE the
+                    # stream's close frame — decode started while the
+                    # transfer plane was still moving this row's stream.
+                    "overlap": (rx.t_first_step is not None
+                                and rx.t_fin is not None
+                                and rx.t_first_step < rx.t_fin),
+                    "retries": attempt, "stream_id": sid,
+                    "bytes": rx.assembler.bytes_seen if rx.assembler
+                    else 0}
+        raise StreamError(
+            f"stream failed after {max_retries + 1} attempts: {last_err}")
+
+    def generate(self, prompts: List[List[int]],
+                 sampling: Optional[SamplingParams] = None,
+                 stream: bool = True, **kw) -> List[List[int]]:
+        return [self.generate_one(p, sampling, stream=stream, **kw)["tokens"]
+                for p in prompts]

@@ -29,8 +29,8 @@ import numpy as np
 # rule enforces it); re-exported here because the server process imports
 # protocol.py before jax loads and callers already import from here.
 from rbg_tpu.api.errors import (CODE_DEADLINE, CODE_DRAINING,  # noqa: F401
-                                CODE_OVERLOADED, CODE_REJECTED,
-                                RETRYABLE_REJECT_CODES)
+                                CODE_KV_STREAM, CODE_OVERLOADED,
+                                CODE_REJECTED, RETRYABLE_REJECT_CODES)
 
 
 class Rejected(RuntimeError):

@@ -44,6 +44,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from rbg_tpu.engine.protocol import (CODE_DEADLINE, CODE_DRAINING,
+                                     CODE_KV_STREAM,
                                      RETRYABLE_REJECT_CODES, recv_msg,
                                      request_once, send_msg)
 from rbg_tpu.obs import names as obs_names
@@ -58,6 +59,14 @@ LEG_TIMEOUT_S = 120.0     # per-attempt blocking-call cap (deadline trims it)
 DEFAULT_TIMEOUT_S = 120.0 # whole-request budget when the client sends none
 AFFINITY_PREFIX = 32      # prompt tokens hashed for cache affinity
 AFFINITY_SLACK = 4        # max extra outstanding before affinity yields
+# Transfer-cost-aware decode selection (NetKV, PAPERS.md): estimated
+# KV-move seconds (bytes / measured link rate) are weighed against queue
+# depth at this exchange rate — 1/WEIGHT seconds of transfer costs as much
+# as one outstanding request. Rates come from rbg_kvtransfer link
+# observations; with no measurement yet the default keeps the cost term
+# small so least-outstanding still dominates.
+KV_COST_WEIGHT = 4.0
+DEFAULT_KV_LINK_RATE = 1e9   # bytes/s assumed before any real transfer
 
 
 class _Rejected(Exception):
@@ -185,10 +194,15 @@ class BackendPool:
     EVICT_BASE_S = 1.0
     EVICT_MAX_S = 15.0
 
-    def __init__(self):
+    def __init__(self, on_unavailable=None):
         self._lock = threading.Lock()
         self._st: Dict[str, _BackendState] = {}
         self._seq = 0
+        # Fired (outside the lock) when an address stops being a routing
+        # candidate — drain mark or eviction. The router wires it to
+        # prefix-affinity demotion: a draining/preempted backend must fall
+        # out of the front-of-LRU IMMEDIATELY, not when it gets evicted.
+        self._on_unavailable = on_unavailable
 
     def _state(self, addr: str) -> _BackendState:
         st = self._st.get(addr)
@@ -196,13 +210,19 @@ class BackendPool:
             st = self._st[addr] = _BackendState()
         return st
 
-    def order(self, addrs: List[str]) -> List[str]:
-        """Candidates in try-order: healthy by (outstanding, last_pick),
-        then DRAINING by the same key (not-a-candidate while any healthy
-        sibling exists, but still reachable so a fleet-wide rollout
-        degrades to 'draining' replies rather than a hard outage), then
-        evicted by soonest recovery."""
+    def order(self, addrs: List[str], cost=None) -> List[str]:
+        """Candidates in try-order: healthy by (outstanding + transfer
+        cost, last_pick), then DRAINING by the same key (not-a-candidate
+        while any healthy sibling exists, but still reachable so a
+        fleet-wide rollout degrades to 'draining' replies rather than a
+        hard outage), then evicted by soonest recovery.
+
+        ``cost`` (optional ``addr -> float``) is the transfer-cost term of
+        the NetKV-style decode selection: estimated KV-move seconds scaled
+        into outstanding-equivalents. Healthy candidates only — a cheap
+        link never un-drains or un-evicts anything."""
         now = time.monotonic()
+        costs = {a: cost(a) for a in addrs} if cost is not None else {}
         with self._lock:
             healthy, draining, down = [], [], []
             for i, a in enumerate(addrs):
@@ -212,7 +232,8 @@ class BackendPool:
                 elif st.draining:
                     draining.append((st.outstanding, st.last_pick, i, a))
                 else:
-                    healthy.append((st.outstanding, st.last_pick, i, a))
+                    healthy.append((st.outstanding + costs.get(a, 0.0),
+                                    st.last_pick, i, a))
             healthy.sort()
             draining.sort()
             down.sort()
@@ -256,6 +277,10 @@ class BackendPool:
             backoff = min(self.EVICT_BASE_S * (2 ** (st.fails - 1)),
                           self.EVICT_MAX_S)
             st.down_until = time.monotonic() + backoff
+        # Outside the lock: an evicted (dead / preempted) backend must
+        # lose its prefix-affinity front-of-LRU spot immediately.
+        if self._on_unavailable is not None:
+            self._on_unavailable(addr)
 
     def set_draining(self, addr: str, draining: bool) -> None:
         """Mark an address as draining (SIGTERM rollout): it stops being a
@@ -266,6 +291,12 @@ class BackendPool:
             self._state(addr).draining = draining
             REGISTRY.set_gauge(obs_names.ROUTER_BACKEND_DRAINING,
                                1.0 if draining else 0.0, backend=addr)
+        if draining and self._on_unavailable is not None:
+            self._on_unavailable(addr)
+
+    def is_draining(self, addr: str) -> bool:
+        with self._lock:
+            return self._state(addr).draining
 
     def draining(self) -> List[str]:
         with self._lock:
@@ -379,17 +410,47 @@ class PrefixAffinity:
             if len(self._m) > self.cap:
                 self._m.popitem(last=False)
 
+    def drop_backend(self, addr: str) -> int:
+        """Demote every prefix remembered for ``addr`` — the drain /
+        disruption staleness fix: a draining or preempted backend used to
+        stay front-of-LRU until eviction aged it out, steering prefix
+        traffic at a pod that refuses (or dropped) it."""
+        with self._lock:
+            dead = [k for k, a in self._m.items() if a == addr]
+            for k in dead:
+                del self._m[k]
+            return len(dead)
+
 
 class RouterState:
     def __init__(self, registry: Registry, group: Optional[str],
                  static_backends: Optional[dict] = None,
                  token: Optional[str] = None,
                  retry_budget: Optional[RetryBudget] = None,
-                 slo_targets: Optional[SLOTargets] = None):
+                 slo_targets: Optional[SLOTargets] = None,
+                 directory=None, kv_stream: bool = True):
+        from rbg_tpu.kvtransfer.transport import LinkStats
+
         self.registry = registry
         self.group = group
         self.static = static_backends or {}
-        self.pool = BackendPool()
+        # Drain/eviction notifications demote prefix affinity immediately
+        # (the staleness fix) — wired before any traffic.
+        self.pool = BackendPool(on_unavailable=self._backend_unavailable)
+        # Cluster prefix directory (kvtransfer.directory): lets prefix
+        # affinity route to ANY replica holding the prefix, not just the
+        # last-serving one. Optional — lookups degrade to the local LRU.
+        self.directory = directory
+        # Chunked prefill→decode KV streaming (push_to): on by default;
+        # backends that don't support it reply with a bundle and nothing
+        # changes.
+        self.kv_stream = kv_stream
+        # Measured prefill→decode link rates (merged from prefill replies'
+        # observed push rates) feeding transfer-cost-aware decode choice.
+        self.linkstats = LinkStats("router")
+        # Observed KV bytes per prompt token (EWMA) — the pre-prefill
+        # estimate the stream-mode decode choice scores with.
+        self._kv_bpt: Optional[float] = None
         # Router-level SLO judgment (obs/slo.py): TTFT/TPOT measured from
         # the INGRESS arrival stamp — a retried or failed-over request is
         # charged its full wait — aggregated per role and per backend
@@ -406,11 +467,22 @@ class RouterState:
         self.metrics = {"requests": 0, "pd_requests": 0, "errors": 0,
                         "retries": 0, "failovers": 0, "affinity_hits": 0,
                         "kv_bytes_routed": 0,
+                        # KV transfer plane (kvtransfer): streamed PD
+                        # requests, bundle fallbacks after a stream
+                        # failure, cluster prefix-directory hits, and
+                        # affinity entries demoted on drain/eviction.
+                        "kv_stream_routed": 0, "kv_stream_fallbacks": 0,
+                        "directory_hits": 0, "affinity_demotions": 0,
                         # Overload / lifecycle robustness counters.
                         "sheds_routed_around": 0, "sheds_returned": 0,
                         "draining_routed_around": 0,
                         "deadline_refusals": 0,
                         "retry_budget_exhausted": 0}
+
+    def _backend_unavailable(self, addr: str) -> None:
+        dropped = self.affinity.drop_backend(addr)
+        if dropped:
+            self.metrics["affinity_demotions"] += dropped
 
     def charge_retry(self) -> bool:
         """Take one retry token; on exhaustion count it and refuse."""
@@ -443,13 +515,49 @@ class RouterState:
         from rbg_tpu.engine.protocol import token_ok
         return token_ok(obj.get("token"), self.token)
 
-    def candidates(self, role: str) -> List[str]:
+    def candidates(self, role: str, cost=None) -> List[str]:
         backends = self.static.get(role) or self.registry.backends(role, self.group)
         live = {a for addrs in self.static.values() for a in addrs}
         live.update(e["addr"] for e in self.registry.entries().values()
                     if "addr" in e)
         self.pool.retain(live)
-        return self.pool.order(list(backends))
+        return self.pool.order(list(backends), cost=cost)
+
+    # -- transfer-cost-aware decode selection (NetKV) --
+
+    def kv_cost_fn(self, kv_bytes: int):
+        """``addr -> outstanding-equivalents`` for moving ``kv_bytes`` to
+        that backend, from MEASURED link rates (None when there is
+        nothing to weigh)."""
+        if not kv_bytes:
+            return None
+
+        def cost(addr: str) -> float:
+            rate = self.linkstats.rate(addr) or DEFAULT_KV_LINK_RATE
+            return (kv_bytes / rate) * KV_COST_WEIGHT
+        return cost
+
+    def est_kv_bytes(self, prompt_tokens: int) -> int:
+        """Pre-prefill KV size estimate from observed bytes/token."""
+        if self._kv_bpt is None:
+            return 0
+        return int(self._kv_bpt * prompt_tokens)
+
+    def note_kv_observed(self, prompt_tokens: int, kv_bytes: int) -> None:
+        if not prompt_tokens or not kv_bytes:
+            return
+        bpt = kv_bytes / prompt_tokens
+        self._kv_bpt = bpt if self._kv_bpt is None \
+            else 0.7 * self._kv_bpt + 0.3 * bpt
+
+    def merge_link_rates(self, rates: Optional[dict]) -> None:
+        """Fold prefill-reported push rates (prefill→decode, observed on
+        real transfers) into this router's link view."""
+        for addr, rate in (rates or {}).items():
+            try:
+                self.linkstats.observe(addr, int(float(rate)), 1.0)
+            except (TypeError, ValueError):
+                continue
 
     def pd_mode(self) -> bool:
         return bool(
@@ -471,30 +579,55 @@ class RouterState:
                 return r
         raise RuntimeError("no backends available")
 
+    def _affinity_viable(self, addr: Optional[str],
+                         cands: List[str]) -> bool:
+        """A cache-affinity candidate wins only while it is a live,
+        non-draining candidate that is not meaningfully busier than the
+        least-loaded choice — a hot prefix cannot melt one replica, and a
+        draining/preempted backend is never fronted."""
+        return bool(addr and addr in cands and addr != cands[0]
+                    and not self.pool.is_down(addr)
+                    and not self.pool.is_draining(addr)
+                    and self.pool.outstanding(addr)
+                    <= self.pool.outstanding(cands[0]) + AFFINITY_SLACK)
+
     def candidates_for(self, role: str, prompt) -> List[str]:
         """Candidates with CACHE AFFINITY applied: the backend that last
         served this prompt prefix moves to the front — its radix / shared-
-        pool prefix is warm — unless it is evicted or meaningfully busier
-        (> AFFINITY_SLACK outstanding) than the least-loaded choice, so a
-        hot prefix cannot melt one replica."""
+        pool prefix is warm. When the local LRU has nothing, the CLUSTER
+        prefix directory is consulted: ANY replica that registered this
+        prefix (it published the pages to the shared pool) qualifies, not
+        just the last-serving one. Both are subject to the same balance
+        guard (never evicted/draining, never > AFFINITY_SLACK busier)."""
         cands = self.candidates(role)
         akey = PrefixAffinity.key(prompt)
         if akey is None or len(cands) < 2:
             return cands
         addr = self.affinity.get(akey)
-        if (addr and addr in cands and addr != cands[0]
-                and not self.pool.is_down(addr)
-                and self.pool.outstanding(addr)
-                <= self.pool.outstanding(cands[0]) + AFFINITY_SLACK):
+        if self._affinity_viable(addr, cands):
             self.metrics["affinity_hits"] += 1
             return [addr] + [a for a in cands if a != addr]
         if addr == cands[0] and addr is not None:
             self.metrics["affinity_hits"] += 1
+            return cands
+        if self.directory is not None and prompt:
+            try:
+                _, holders = self.directory.lookup(list(prompt))
+            except (OSError, RuntimeError, ValueError):
+                holders = []
+            for h in cands:               # keep least-loaded preference
+                if h in holders and self._affinity_viable(h, cands):
+                    self.metrics["directory_hits"] += 1
+                    return [h] + [a for a in cands if a != h]
+            if holders and cands[0] in holders:
+                self.metrics["directory_hits"] += 1
         return cands
 
     def call(self, role: str, obj: dict, k_bytes=None, v_bytes=None,
              timeout: float = LEG_TIMEOUT_S, prompt=None,
-             deadline: Optional[float] = None) -> Tuple[str, dict, bytes, bytes]:
+             deadline: Optional[float] = None,
+             pinned: Optional[str] = None,
+             kv_bytes: int = 0) -> Tuple[str, dict, bytes, bytes]:
         """One blocking request with failover across the role's backends.
         Transport failures (connect refused, peer closed) evict + retry on
         a sibling; application errors pass through untouched. ``prompt``
@@ -512,8 +645,19 @@ class RouterState:
         failures: the backend is healthy and answered. The router tries a
         sibling (retry-budget permitting) and, when every candidate shed,
         raises ``_Rejected`` carrying the frame with the smallest
-        retry_after_s — the edge maps it to 429/503 + Retry-After."""
-        cands = self.candidates_for(role, prompt)
+        retry_after_s — the edge maps it to 429/503 + Retry-After.
+
+        ``pinned`` restricts the leg to ONE address (a decode_stream leg —
+        the KV lives only there; failover is the caller's re-route).
+        ``kv_bytes`` engages transfer-cost-aware candidate ordering: the
+        estimated move time over each backend's MEASURED link rate is
+        weighed against its queue depth."""
+        if pinned is not None:
+            cands = [pinned]
+        elif kv_bytes:
+            cands = self.candidates(role, cost=self.kv_cost_fn(kv_bytes))
+        else:
+            cands = self.candidates_for(role, prompt)
         if not cands:
             raise RuntimeError(f"no {role} backends available")
         akey = PrefixAffinity.key(prompt)
@@ -632,6 +776,18 @@ class Handler(socketserver.BaseRequestHandler):
                     resp["backends"] = state.pool.snapshot()
                     resp["draining_backends"] = state.pool.draining()
                     resp["retry_budget"] = state.retry_budget.snapshot()
+                    # KV transfer plane posture: streaming mode, measured
+                    # per-backend link rates, observed KV bytes/token.
+                    resp["kv"] = {
+                        "stream": state.kv_stream,
+                        "directory": state.directory is not None,
+                        "link_rates": {
+                            a: round(r, 1) for a, r in
+                            state.linkstats.snapshot().items()},
+                        "kv_bytes_per_token": (
+                            round(state._kv_bpt, 1)
+                            if state._kv_bpt is not None else None),
+                    }
                     # Measured SLO attainment from THIS router's vantage
                     # (ingress-anchored TTFT): per role and per backend,
                     # 60 s window — the agg↔disagg switcher's decision
@@ -733,20 +889,38 @@ class Handler(socketserver.BaseRequestHandler):
             obj["seed"] = random.getrandbits(31)
         return obj
 
-    def _route(self, state: RouterState, obj: dict, deadline: float):
+    _FWD_DECODE_KEYS = ("max_new_tokens", "temperature", "top_k", "top_p",
+                        "min_p", "repetition_penalty", "presence_penalty",
+                        "frequency_penalty", "seed", "logprobs", "json_mode",
+                        "regex", "json_schema", "lora", "stop_token",
+                        "stream", "token")
+
+    def _route(self, state: RouterState, obj: dict, deadline: float,
+               force_bundle: bool = False):
         """Resolve the final leg shared by blocking and streaming paths.
         PD mode runs the (always blocking, failover-wrapped) prefill hop
         here; returns (role, (header, k_bytes, v_bytes), affinity_prompt,
-        t_first) for the leg the caller owns — the caller can re-send
-        that payload to any sibling of ``role`` (decode failover), the
+        t_first, pinned) for the leg the caller owns — the caller can
+        re-send that payload to any sibling of ``role`` (decode failover;
+        ``pinned`` non-None means the payload only works on THAT address
+        — a pushed KV stream — and failover is a bundle re-route), the
         affinity prompt (None on cache-less legs) steers cache-aware
         ordering, and ``t_first`` (PD only, else None) is the monotonic
         instant the prefill hop returned: the FIRST TOKEN exists from
-        then on, so PD TTFT ends here, not when decode completes."""
-        state.metrics["requests"] += 1
+        then on, so PD TTFT ends here, not when decode completes.
+
+        KVCache-centric path (default): the decode replica is chosen
+        FIRST — transfer-cost-aware: queue depth + estimated KV bytes
+        over its measured link rate — and the prefill request carries
+        ``push_to``, so KV chunks stream prefill→decode as they compute.
+        A prefill that can't push (older build, no transport, early
+        connect failure) replies with the bundle and nothing changes."""
+        if not force_bundle:    # a fallback re-route is the SAME request
+            state.metrics["requests"] += 1
         obj = self._pin_seed(obj)
         if state.pd_mode():
-            state.metrics["pd_requests"] += 1
+            if not force_bundle:
+                state.metrics["pd_requests"] += 1
             # Forward sampling fields: the FIRST token is sampled by the
             # prefill engine — without them it would always be greedy,
             # diverging from unified mode for the identical request.
@@ -757,6 +931,15 @@ class Handler(socketserver.BaseRequestHandler):
                         "json_schema", "lora", "stop_token", "token"):
                 if key in obj:
                     pf_req[key] = obj[key]
+            decode_addr = None
+            if state.kv_stream and not force_bundle:
+                est = state.est_kv_bytes(len(obj.get("prompt") or ()))
+                dcands = state.candidates("decode",
+                                          cost=state.kv_cost_fn(est))
+                if dcands and not state.pool.is_down(dcands[0]):
+                    decode_addr = dcands[0]
+                    pf_req["push_to"] = decode_addr
+                    pf_req["stream_id"] = f"rtr-{random.getrandbits(48):x}"
             # Cache affinity on the prefill leg: the replica that served
             # this prefix before has it in its radix cache / pool hot set.
             # The prefill leg spends from the SAME deadline the decode leg
@@ -768,19 +951,47 @@ class Handler(socketserver.BaseRequestHandler):
             t_first = time.monotonic()
             if "error" in hdr:
                 raise RuntimeError(f"prefill failed: {hdr}")
+            if hdr.get("pushed"):
+                # KV already streamed (or streaming) prefill→decode; the
+                # router never touched the payload bytes.
+                state.metrics["kv_stream_routed"] += 1
+                state.note_kv_observed(len(obj.get("prompt") or ()),
+                                       int(hdr.get("kv_bytes") or 0))
+                state.merge_link_rates(hdr.get("link_rates"))
+                fwd = {"op": "decode_stream",
+                       "stream_id": hdr["stream_id"]}
+                for key in self._FWD_DECODE_KEYS:
+                    if key in obj:
+                        fwd[key] = obj[key]
+                return "decode", (fwd, None, None), None, t_first, \
+                    decode_addr
+            if hdr.get("pushed") is False:
+                # Push failed before the reply (decode peer unreachable):
+                # the prefill ran but holds no bundle — re-run it in
+                # bundle mode (its radix/pool hot set makes the re-prefill
+                # cheap) instead of failing the request.
+                state.metrics["kv_stream_fallbacks"] += 1
+                pf_req.pop("push_to", None)
+                pf_req.pop("stream_id", None)
+                _, hdr, kb, vb = state.call("prefill", pf_req,
+                                            prompt=obj.get("prompt"),
+                                            deadline=deadline)
+                hdr.pop("_router_t_dispatch", None)
+                t_first = time.monotonic()
+                if "error" in hdr:
+                    raise RuntimeError(f"prefill failed: {hdr}")
             state.metrics["kv_bytes_routed"] += len(kb or b"") + len(vb or b"")
+            state.note_kv_observed(len(obj.get("prompt") or ()),
+                                   len(kb or b"") + len(vb or b""))
             fwd = dict(hdr)
             fwd["op"] = "decode_bundle"
-            for key in ("max_new_tokens", "temperature", "top_k", "top_p",
-                        "min_p", "repetition_penalty", "presence_penalty",
-                        "frequency_penalty", "seed", "logprobs", "json_mode",
-                        "regex", "json_schema", "lora", "stop_token", "stream",
-                        "token"):
+            for key in self._FWD_DECODE_KEYS:
                 if key in obj:
                     fwd[key] = obj[key]
             # Decode replicas hold no prefix cache — no affinity prompt.
-            return "decode", (fwd, kb, vb), None, t_first
-        return state.worker_role(), (obj, None, None), obj.get("prompt"), None
+            return "decode", (fwd, kb, vb), None, t_first, None
+        return (state.worker_role(), (obj, None, None), obj.get("prompt"),
+                None, None)
 
     def _generate(self, state: RouterState, obj: dict, deadline: float,
                   t_arrival: float) -> dict:
@@ -791,9 +1002,43 @@ class Handler(socketserver.BaseRequestHandler):
         attempt's dispatch offset (a failed-over request is charged the
         attempts that preceded it, not just the winner's clock)."""
         pd = state.pd_mode()
-        role, payload, aff, t_first = self._route(state, obj, deadline)
-        addr, resp, _, _ = state.call(role, *payload, prompt=aff,
-                                      deadline=deadline)
+        role, payload, aff, t_first, pinned = self._route(state, obj,
+                                                          deadline)
+        kvb = len(payload[1] or b"") + len(payload[2] or b"")
+        fall_back = False
+        try:
+            addr, resp, _, _ = state.call(role, *payload, prompt=aff,
+                                          deadline=deadline, pinned=pinned,
+                                          kv_bytes=kvb)
+            if pinned is not None and isinstance(resp, dict) \
+                    and "error" in resp:
+                # The pushed stream's decode leg failed (stream truncated,
+                # replica died holding the KV) — recoverable below.
+                raise RuntimeError(f"decode_stream failed: {resp}")
+        except _Rejected as e:
+            # A pinned leg that SHED (overloaded/draining — the replica is
+            # healthy, just unwilling) must not surface a 429/503 that a
+            # sibling would have absorbed: bundle mode retries the fleet.
+            # Deadline rejections stay terminal on any path.
+            if pinned is None \
+                    or e.frame.get("code") not in RETRYABLE_REJECT_CODES:
+                raise
+            fall_back = True
+        except Exception:
+            if pinned is None:
+                raise
+            fall_back = True
+        if fall_back:
+            # KVCache-centric leg is gone; the request is not: re-route
+            # in bundle mode (pinned seed ⇒ token-exact) and try the
+            # decode fleet normally. TTFT honestly re-anchors on the
+            # fallback prefill's return.
+            state.metrics["kv_stream_fallbacks"] += 1
+            role, payload, aff, t_first, _ = self._route(
+                state, obj, deadline, force_bundle=True)
+            kvb = len(payload[1] or b"") + len(payload[2] or b"")
+            addr, resp, _, _ = state.call(role, *payload, prompt=aff,
+                                          deadline=deadline, kv_bytes=kvb)
         t_dispatch = resp.pop("_router_t_dispatch", None) \
             if isinstance(resp, dict) else None
         t_done = time.monotonic()
@@ -829,7 +1074,8 @@ class Handler(socketserver.BaseRequestHandler):
         (overloaded / draining — always before any token) is routed
         around without eviction; a spent deadline ends the request with a
         structured frame instead of another doomed attempt."""
-        role, payload, aff, t_first = self._route(state, obj, deadline)
+        role, payload, aff, t_first, pinned = self._route(state, obj,
+                                                          deadline)
         akey = PrefixAffinity.key(aff)
         rspan = trace.current()
         kv_bytes = len(payload[1] or b"") + len(payload[2] or b"")
@@ -847,10 +1093,18 @@ class Handler(socketserver.BaseRequestHandler):
                 self._send_client({**_deadline_frame(
                     "deadline spent mid-stream"), "done": True})
                 return
-            # Affinity only steers the FIRST attempt: a failover must not
-            # re-pin to the remembered (possibly just-dead) backend.
-            cands = (state.candidates_for(role, aff) if attempt == 0
-                     else state.candidates(role))
+            if pinned is not None:
+                # The payload is a pushed KV stream — it only exists on
+                # ONE decode replica. A failed attempt re-routes in
+                # bundle mode below instead of trying siblings.
+                cands = [pinned]
+            else:
+                # Affinity only steers the FIRST attempt: a failover must
+                # not re-pin to the remembered (possibly just-dead)
+                # backend. KV-carrying legs weigh measured transfer cost.
+                cands = (state.candidates_for(role, aff) if attempt == 0
+                         else state.candidates(
+                             role, cost=state.kv_cost_fn(kv_bytes)))
             if not cands:
                 break
             addr = cands[0]
@@ -899,6 +1153,31 @@ class Handler(socketserver.BaseRequestHandler):
                     aspan.end(outcome=CODE_DEADLINE)
                     self._send_client({**frame, "done": True})
                     return
+                if pinned is not None:
+                    # The pushed stream is unusable — whether it never
+                    # became decodable (kv_stream_failed) or the only
+                    # replica holding it SHED the attempt. Retrying the
+                    # same pinned address cannot help: re-route in bundle
+                    # mode, token-exact (seed pinned, delivered prefix
+                    # skipped), and let the fleet absorb it. Sheds still
+                    # feed the shed bookkeeping (drain marks, best
+                    # retry_after_s should the fallback shed everywhere).
+                    code = frame.get("code")
+                    if code == CODE_KV_STREAM:
+                        state.pool.ok(addr)
+                    else:
+                        shed = state.note_shed(addr, frame, shed)
+                    aspan.end(outcome=code or "rejected")
+                    state.metrics["kv_stream_fallbacks"] += 1
+                    try:
+                        role, payload, aff, _, pinned = self._route(
+                            state, obj, deadline, force_bundle=True)
+                    except Exception as e:  # noqa: BLE001
+                        last = e
+                        break
+                    kv_bytes = len(payload[1] or b"") \
+                        + len(payload[2] or b"")
+                    continue
                 shed = state.note_shed(addr, frame, shed)
                 aspan.end(outcome=frame.get("code") or "rejected")
                 continue
@@ -906,6 +1185,18 @@ class Handler(socketserver.BaseRequestHandler):
             state.pool.fail(addr)
             aspan.end(outcome="died_mid_stream", delivered=delivered)
             last = RuntimeError(f"{addr} closed mid-stream")
+            if pinned is not None:
+                # The replica holding the pushed KV died (possibly with
+                # tokens already delivered): bundle re-route + replay —
+                # the client stream never breaks.
+                state.metrics["kv_stream_fallbacks"] += 1
+                try:
+                    role, payload, aff, _, pinned = self._route(
+                        state, obj, deadline, force_bundle=True)
+                except Exception as e:  # noqa: BLE001
+                    last = e
+                    break
+                kv_bytes = len(payload[1] or b"") + len(payload[2] or b"")
         if shed is not None:
             state.metrics["sheds_returned"] += 1
             self._send_client({**shed, "done": True})
@@ -975,9 +1266,11 @@ class Handler(socketserver.BaseRequestHandler):
                         return delivered, "died", None
                     if "error" in frame:
                         if frame.get("code") in RETRYABLE_REJECT_CODES \
-                                or frame.get("code") == CODE_DEADLINE:
+                                or frame.get("code") in (CODE_DEADLINE,
+                                                         CODE_KV_STREAM):
                             # Shed at admission (always before any token):
-                            # the caller routes around / ends the request.
+                            # the caller routes around / ends the request
+                            # (kv_stream_failed → bundle re-route).
                             return delivered, "rejected", frame
                         # Application error — not a transport failure; the
                         # engine is healthy and answered. Pass through
@@ -1053,6 +1346,18 @@ def main(argv=None) -> int:
     ap.add_argument("--slo-tpot-s", type=float, default=0.5,
                     help="per-output-token latency target for router-side "
                          "SLO judgment (0 disables)")
+    ap.add_argument("--kv-stream", choices=("auto", "off"), default="auto",
+                    help="KVCache-centric PD routing: pick the decode "
+                         "replica first (transfer-cost-aware) and have "
+                         "prefill push KV chunks to it as they compute; "
+                         "'off' keeps the whole-bundle relay path")
+    ap.add_argument("--directory",
+                    default=os.environ.get("RBG_KV_POOL_ADDR", ""),
+                    help="host:port of the cluster prefix directory (the "
+                         "kv-pool server hosts it) — prefix affinity can "
+                         "then route to ANY replica holding a prefix "
+                         "(default: $RBG_KV_POOL_ADDR; empty = local LRU "
+                         "only)")
     args = ap.parse_args(argv)
     port = int(os.environ.get("RBG_SERVE_PORT")
                or os.environ.get("RBG_PORT_SERVE") or args.port)
@@ -1060,12 +1365,19 @@ def main(argv=None) -> int:
     server = RouterServer(("127.0.0.1", port), Handler)
     budget = RetryBudget(rate=None if args.retry_rate < 0 else args.retry_rate,
                          burst=args.retry_burst)
+    directory = None
+    if args.directory:
+        from rbg_tpu.kvtransfer.directory import DirectoryClient
+        directory = DirectoryClient(args.directory,
+                                    token=args.auth_token or None)
     server.state = RouterState(Registry(args.registry), args.group, static,
                                token=args.auth_token or None,
                                retry_budget=budget,
                                slo_targets=SLOTargets(
                                    ttft_s=args.slo_ttft_s,
-                                   tpot_s=args.slo_tpot_s))
+                                   tpot_s=args.slo_tpot_s),
+                               directory=directory,
+                               kv_stream=args.kv_stream != "off")
     from rbg_tpu.obs import timeseries
     timeseries.ensure_started()
     start_prober(server.state)

@@ -33,6 +33,11 @@ from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.utils.locktrace import named_lock
 
 
+# Blocking decode_stream wait bound when the client sent no deadline —
+# the same legacy contract as service.DEFAULT_TIMEOUT_S.
+DEFAULT_WAIT_S = 600.0
+
+
 def _deadline_of(obj: dict):
     """Absolute monotonic deadline from a wire ``timeout_s`` (None = the
     legacy unbounded contract). The router stamps the REMAINING client
@@ -153,7 +158,8 @@ class Handler(socketserver.BaseRequestHandler):
             raise ConnectionError("client closed stream")
 
     _DATA_OPS = frozenset({"generate", "generate_text", "embed",
-                           "prefill", "decode_bundle"})
+                           "prefill", "decode_bundle", "kv_stream",
+                           "decode_stream"})
 
     def _dispatch(self, srv, obj, k, v):
         op = obj.get("op")
@@ -396,11 +402,25 @@ class Handler(socketserver.BaseRequestHandler):
             else:
                 srv.pd_lock.acquire()
             qspan.end(outcome="admitted")
+            t_lock = time.perf_counter()
             pspan = trace.child(names.SPAN_PD_PREFILL,
                                 prompt_tokens=len(obj.get("prompt") or ()))
+            push_to = obj.get("push_to")
+            push = None
             try:
-                bundle = srv.prefill.prefill(obj["prompt"], sampling,
-                                             deadline=deadline)
+                if push_to and srv.kv_push is not None:
+                    # KVCache-centric path: chunks stream DIRECTLY to the
+                    # decode peer as prefill chunks complete; the sends
+                    # ride a sender thread, so the pd_lock critical
+                    # section covers compute only, never the link.
+                    push = srv.prefill.prefill_stream(
+                        obj["prompt"], sampling, transport=srv.kv_push,
+                        peer=push_to,
+                        stream_id=obj.get("stream_id"),
+                        deadline=deadline)
+                else:
+                    bundle = srv.prefill.prefill(obj["prompt"], sampling,
+                                                 deadline=deadline)
             except DeadlineExceeded as e:
                 pspan.end(outcome="deadline_abort")
                 send_msg(self.request, e.to_wire())
@@ -410,9 +430,37 @@ class Handler(socketserver.BaseRequestHandler):
                 raise
             finally:
                 srv.pd_lock.release()
+                REGISTRY.observe(names.PD_LOCK_HOLD_SECONDS,
+                                 time.perf_counter() - t_lock,
+                                 lock="server_pd")
+            if push is not None:
+                pspan.end(outcome="pushed", bytes=push.meta.nbytes())
+                # Reply the moment COMPUTE is done — the chunk tail drains
+                # to the decode peer while the router sets up the decode
+                # leg. An already-failed push (connect refused surfaces
+                # during compute) is reported so the router falls back to
+                # the bundle path instead of a doomed decode_stream.
+                send_msg(self.request, {
+                    "pushed": push.error() is None,
+                    "stream_id": push.stream_id,
+                    "first_token": push.first_token,
+                    "prompt": list(obj["prompt"]),
+                    "kv_bytes": push.meta.nbytes(),
+                    "push_error": push.error(),
+                    # Measured prefill→decode link rates from COMPLETED
+                    # pushes — the router folds them into its
+                    # transfer-cost-aware decode scoring.
+                    "link_rates": srv.kv_push.stats.snapshot()})
+                return
             pspan.end(outcome="ok", bytes=bundle.nbytes)
             header, kb, vb = bundle_to_wire(bundle)
             send_msg(self.request, header, kb, vb)
+            return
+        if op == "kv_stream" and srv.decode is not None:
+            self._serve_kv_stream(srv, obj)
+            return
+        if op == "decode_stream" and srv.decode is not None:
+            self._serve_decode_stream(srv, obj)
             return
         if op == "decode_bundle" and srv.decode is not None:
             bundle = bundle_from_wire(obj, k, v)
@@ -456,6 +504,110 @@ class Handler(socketserver.BaseRequestHandler):
             return
         send_msg(self.request, {"error": f"unsupported op {op!r} in mode {srv.mode}"})
 
+    def _serve_kv_stream(self, srv, obj):
+        """Ingest one inbound KV chunk stream on THIS connection (the
+        prefill peer opened it): frames land in the decode service's
+        stream registry; the loop thread commits them into the page table
+        as they arrive. Replies an ack after FIN (the sender's drain
+        barrier). A broken connection fails the stream with a structured
+        error — never a wedge."""
+        from rbg_tpu.kvtransfer.chunks import StreamFin
+        from rbg_tpu.kvtransfer.transport import frame_from_wire
+
+        sid = obj.get("stream_id") or ""
+        rx = srv.decode.kv_streams.get_or_create(sid)
+        srv.decode.watch_stream(rx)
+        nbytes = 0
+        while True:
+            try:
+                fobj, fk, fv = recv_msg(self.request)
+            except (ConnectionError, json.JSONDecodeError) as e:
+                rx.fail(f"kv stream connection broke: {e}")
+                return
+            if fobj is None:
+                rx.fail("kv stream EOF before FIN")
+                return
+            try:
+                frame = frame_from_wire(fobj, fk, fv)
+            except Exception as e:  # noqa: BLE001 — fail the stream, not the handler
+                rx.fail(f"bad kv frame: {e}")
+                send_msg(self.request, {"error": str(e)})
+                return
+            nbytes += len(fk or b"") + len(fv or b"")
+            rx.feed(frame)
+            if isinstance(frame, StreamFin):
+                REGISTRY.inc(names.KVT_BYTES_TOTAL, float(nbytes),
+                             direction="recv", transport="tcp")
+                send_msg(self.request, {"ok": True, "bytes": nbytes})
+                return
+
+    def _serve_decode_stream(self, srv, obj):
+        """Decode a previously (or concurrently) pushed KV stream: wait
+        for admission coverage, then decode exactly like decode_bundle.
+        The row is admitted the moment layer coverage for the prompt is
+        complete — the stream's FIN may still be in flight."""
+        from rbg_tpu.engine.protocol import CODE_KV_STREAM
+        from rbg_tpu.kvtransfer.chunks import StreamError
+
+        try:
+            sampling = SamplingParams.from_wire(obj)
+            deadline = _deadline_of(obj)
+        except (ValueError, TypeError) as e:
+            send_msg(self.request, {"error": f"bad sampling params: {e}"})
+            return
+        sid = obj.get("stream_id") or ""
+        rx = srv.decode.kv_streams.get_or_create(sid)
+        srv.decode.watch_stream(rx)
+        wait_s = 30.0
+        if deadline is not None:
+            wait_s = max(0.0, min(wait_s, deadline - time.monotonic()))
+        try:
+            rx.wait_ready(wait_s)
+        except StreamError as e:
+            # Mark the receiver failed so the loop thread's pump releases
+            # any pages it pre-allocated — an abandoned stream must not
+            # hold KV capacity.
+            rx.fail(f"abandoned: {e}")
+            srv.decode.kv_streams.pop(sid)
+            send_msg(self.request, {"error": f"kv stream: {e}",
+                                    "code": CODE_KV_STREAM, "done": True})
+            return
+        first_token = rx.assembler.first_token
+        if obj.get("stream"):
+            try:
+                pending = srv.decode.submit_stream(rx, sampling,
+                                                   deadline=deadline)
+            except Rejected as e:
+                send_msg(self.request, {**e.to_wire(), "done": True})
+                return
+            self._stream_pending(srv.decode, pending,
+                                 first_tokens=[first_token],
+                                 with_logprobs=sampling.logprobs,
+                                 deadline=deadline)
+            return
+        p = None
+        try:
+            p = srv.decode.submit_stream(rx, sampling, deadline=deadline)
+            srv.decode.wait(p, DEFAULT_WAIT_S if deadline is None
+                            else max(0.0, deadline - time.monotonic()) + 1.0)
+        except Rejected as e:
+            send_msg(self.request, e.to_wire())
+            return
+        except (TimeoutError, ValueError) as e:
+            frame = {"error": str(e)}
+            # Admit-time stream failures (dead kv_stream connection,
+            # no pages for the pushed KV) keep their wire code so the
+            # router re-routes in bundle mode instead of surfacing them.
+            if p is not None and p.code:
+                frame["code"] = p.code
+            send_msg(self.request, frame)
+            return
+        resp = {"tokens": [first_token] + p.tokens}
+        if sampling.logprobs:
+            resp["logprobs"] = [None] + p.logprobs
+        send_msg(self.request, resp)
+        return
+
 
 class EngineServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
@@ -486,6 +638,16 @@ def start_drain(server: EngineServer, drain_deadline_s: float) -> None:
     server.drain_started = time.monotonic()
     REGISTRY.inc(names.SERVING_DRAINS_TOTAL)
     REGISTRY.set_gauge(names.SERVING_DRAINING, 1.0)
+    # A draining prefill replica's prefix-directory entries go stale the
+    # moment it exits — invalidate them NOW so no router routes a prefix
+    # hit at a pod that is about to refuse it.
+    pf = server.prefill
+    if pf is not None and pf.directory is not None and pf.advertise_addr:
+        try:
+            pf.directory.invalidate_backend(pf.advertise_addr,
+                                            reason="drain")
+        except Exception:  # noqa: BLE001 — drain must never fail on this
+            pass
     print(f"draining: finishing in-flight work "
           f"(deadline {drain_deadline_s:.1f}s)", flush=True)
 
@@ -537,6 +699,7 @@ def serve(args) -> None:
     server.auth_token = (args.auth_token
                          or os.environ.get("RBG_DATA_TOKEN") or None)
     server.pd_lock = named_lock("engine.server_pd")
+    server.kv_push = None          # TCPTransport, prefill mode only
     server.draining = False
     server.drain_started = 0.0
     server._inflight = 0
@@ -587,6 +750,7 @@ def serve(args) -> None:
             if cfg.mode == "prefill":
                 from rbg_tpu.engine.pd import PrefillWorker
                 pool = None
+                directory = None
                 pool_addr = args.kv_pool or os.environ.get(
                     "RBG_KV_POOL_ADDR", "")
                 if pool_addr:
@@ -597,9 +761,25 @@ def serve(args) -> None:
                         ca_path=(args.kv_pool_ca
                                  or os.environ.get("RBG_KV_POOL_CA")
                                  or None))
-                prefill = PrefillWorker(cfg, pool=pool)
+                    # The pool server hosts the cluster prefix directory
+                    # (dir_* ops): computed prefixes register under this
+                    # replica's serving address so the router can steer
+                    # prefix-sharing requests to ANY holder.
+                    from rbg_tpu.kvtransfer.directory import DirectoryClient
+                    directory = DirectoryClient(
+                        pool_addr, token=server.auth_token,
+                        page_size=cfg.page_size)
+                advertise = (args.advertise_addr
+                             or os.environ.get("RBG_ADVERTISE_ADDR")
+                             or f"127.0.0.1:{port}")
+                prefill = PrefillWorker(cfg, pool=pool,
+                                        directory=directory,
+                                        advertise_addr=advertise)
                 prefill.engine.enable_json_grammar(server.tokenizer)
                 load_adapters(prefill.engine)
+                if args.kv_stream != "off":
+                    from rbg_tpu.kvtransfer.transport import TCPTransport
+                    server.kv_push = TCPTransport(token=server.auth_token)
                 server.prefill = prefill
             elif cfg.mode == "decode":
                 from rbg_tpu.engine.service import DecodeService
@@ -658,6 +838,15 @@ def main(argv=None) -> int:
     ap.add_argument("--kv-pool-ca", default="",
                     help="CA cert path for a TLS kv-pool (default: "
                          "$RBG_KV_POOL_CA; empty = plaintext)")
+    ap.add_argument("--kv-stream", choices=("auto", "off"), default="auto",
+                    help="chunked layer-overlapped prefill→decode KV "
+                         "streaming (the router passes push_to and this "
+                         "prefill pushes chunks as they compute); 'off' "
+                         "keeps the whole-bundle wire path")
+    ap.add_argument("--advertise-addr", default="",
+                    help="address this replica registers in the cluster "
+                         "prefix directory (default: $RBG_ADVERTISE_ADDR "
+                         "or 127.0.0.1:<port>)")
     ap.add_argument("--auth-token", default="",
                     help="require this bearer token on every data op "
                          "(default: $RBG_DATA_TOKEN; empty = open wire). "

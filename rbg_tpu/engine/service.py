@@ -31,7 +31,8 @@ from rbg_tpu.utils.racetrace import guard as _race_guard
 
 class _Pending:
     __slots__ = ("tokens", "logprobs", "done", "t_submit", "t_first", "error",
-                 "code", "deadline", "span_parent", "span_queue", "span_scan")
+                 "code", "deadline", "span_parent", "span_queue", "span_scan",
+                 "stream_rx")
 
     def __init__(self, deadline: Optional[float] = None):
         self.tokens: List[int] = []
@@ -48,6 +49,10 @@ class _Pending:
         self.span_parent = trace.NULL_SPAN
         self.span_queue = trace.NULL_SPAN
         self.span_scan = trace.NULL_SPAN
+        # KV stream receiver backing this request (decode_stream path) —
+        # its t_first_step is stamped at the first decode token, the
+        # kv_stream_overlap invariant's input.
+        self.stream_rx = None
 
 
 DEFAULT_TIMEOUT_S = 600.0
@@ -163,14 +168,21 @@ class _BatchService:
         # guarded_by[engine.service_queue]
         self._queue: List[Tuple[object, SamplingParams, _Pending]] = []
         self._cancels: List[_Pending] = []  # guarded_by[engine.service_queue]
+        # Inbound KV stream receivers awaiting loop-thread adoption
+        # (DecodeService.watch_stream fills it; _pump drains it).
+        self._new_streams: List[object] = []  # guarded_by[engine.service_queue]
         self._done_times = collections.deque(maxlen=_RATE_WINDOW)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=type(self).__name__.lower())
         self._thread.start()
 
-    # -- subclass hook --
+    # -- subclass hooks --
     def _admit(self, item, sampling: SamplingParams) -> Optional[int]:
         raise NotImplementedError
+
+    def _pump(self) -> None:
+        """Loop-thread hook before each iteration's engine work —
+        DecodeService commits inbound KV stream chunks here."""
 
     # -- admission control --
 
@@ -213,7 +225,7 @@ class _BatchService:
     # -- public --
     def submit_async(self, item, sampling: SamplingParams,
                      deadline: Optional[float] = None,
-                     span=None) -> _Pending:
+                     span=None, stream_rx=None) -> _Pending:
         """Enqueue one request. ``deadline`` is absolute ``time.monotonic()``
         seconds; raises ``Overloaded`` / ``DeadlineExceeded`` instead of
         queueing work that cannot be served. ``span`` (or the ambient
@@ -232,6 +244,7 @@ class _BatchService:
         p = _Pending(deadline=deadline)
         p.span_parent = parent
         p.span_queue = qspan
+        p.stream_rx = stream_rx
         try:
             with self._lock:
                 # estimated_wait_s with an explicit depth never re-takes the
@@ -464,6 +477,11 @@ class _BatchService:
                     # A bad request must fail ITSELF, never the loop thread.
                     scan.end(outcome="admit_error")
                     pending.error = str(e)
+                    # Structured failure classes (e.g. a dead KV stream's
+                    # kv_stream_failed) keep their wire code so the router
+                    # can recognize and recover instead of passing a raw
+                    # error to the client.
+                    pending.code = getattr(e, "wire_code", None)
                     pending.done.set()
                     continue
                 if rid is None:
@@ -474,6 +492,7 @@ class _BatchService:
                     continue
                 self._pending[rid] = pending
             self._abort_expired_running(now)
+            self._pump()
             for pending in cancels:
                 rid = next((r for r, p in self._pending.items() if p is pending),
                            None)
@@ -513,6 +532,12 @@ class _BatchService:
                     continue
                 if pending.t_first is None:
                     pending.t_first = time.perf_counter()
+                    if pending.stream_rx is not None \
+                            and pending.stream_rx.t_first_step is None:
+                        # First DECODE step of a streamed row — the
+                        # kv_stream_overlap invariant compares this
+                        # against the stream's FIN arrival.
+                        pending.stream_rx.t_first_step = time.monotonic()
                 pending.tokens.append(ev.token)
                 if ev.logprob is not None:
                     pending.logprobs.append(ev.logprob)
@@ -576,13 +601,46 @@ class DecodeService(_BatchService):
     def __init__(self, cfg, params=None, mesh=None,
                  max_queue: Optional[int] = None):
         from rbg_tpu.engine.pd import DecodeWorker
+        from rbg_tpu.kvtransfer.stream import StreamRegistry
 
         self.worker = DecodeWorker(cfg, params=params, mesh=mesh)
         self.engine = self.worker.engine
+        # Inbound KV chunk streams (the decode server's kv_stream op feeds
+        # these; decode_stream requests consume them).
+        self.kv_streams = StreamRegistry()
         super().__init__(max_queue=max_queue)
 
-    def _admit(self, bundle, sampling: SamplingParams) -> Optional[int]:
-        rid = self.worker.inject(bundle, sampling)
+    def watch_stream(self, receiver) -> None:
+        """Ask the loop thread to start committing this stream's chunks
+        into the page table AS THEY ARRIVE (before admission) — callable
+        from any connection thread."""
+        with self._lock:
+            self._new_streams.append(receiver)
+        self._wake.set()
+
+    def _pump(self) -> None:
+        with self._lock:
+            new, self._new_streams = self._new_streams, []
+        for rx in new:
+            self.worker.begin_stream(rx)
+        self.worker.pump_streams()
+
+    def submit_stream(self, receiver, sampling: SamplingParams,
+                      deadline: Optional[float] = None,
+                      span=None) -> _Pending:
+        """Admit a coverage-complete KV stream (caller waited on
+        ``receiver.wait_ready``) into the decode batch."""
+        return self.submit_async(receiver, sampling, deadline=deadline,
+                                 span=span, stream_rx=receiver)
+
+    def _admit(self, item, sampling: SamplingParams) -> Optional[int]:
+        from rbg_tpu.kvtransfer.stream import KVStreamReceiver
+
+        if isinstance(item, KVStreamReceiver):
+            rid = self.worker.finalize_stream(item, sampling)
+            self.kv_streams.pop(item.stream_id)
+        else:
+            rid = self.worker.inject(item, sampling)
         req = self.engine.requests.get(rid)
         if req is None or req.state == "finished":
             return None  # completed at inject (max_new_tokens == 1 / stop)

@@ -1,0 +1,30 @@
+"""KVCache-centric transfer plane (Mooncake/NetKV analog, PAPERS.md).
+
+Chunked, layer-overlapped prefill→decode KV streaming over an explicit
+transport seam, plus the cluster-wide prefix directory the router's
+cache-aware and transfer-cost-aware routing consults. See
+docs/architecture.md "KV transfer plane".
+"""
+
+from rbg_tpu.kvtransfer.chunks import (ChunkAssembler, KVChunk, StreamError,
+                                       StreamFin, StreamFirstToken,
+                                       StreamMeta, bundle_to_frames,
+                                       plan_chunks, prefix_keys,
+                                       slab_to_chunks)
+from rbg_tpu.kvtransfer.directory import DirectoryClient, PrefixDirectory
+from rbg_tpu.kvtransfer.stream import KVStreamReceiver, StreamRegistry
+from rbg_tpu.kvtransfer.transport import (FakeICITransport, InProcTransport,
+                                          LinkStats, SlowLossyTransport,
+                                          TCPTransport, Transport,
+                                          frame_from_wire, frame_to_wire)
+
+__all__ = [
+    "ChunkAssembler", "KVChunk", "StreamError", "StreamFin",
+    "StreamFirstToken", "StreamMeta", "bundle_to_frames", "plan_chunks",
+    "prefix_keys", "slab_to_chunks",
+    "DirectoryClient", "PrefixDirectory",
+    "KVStreamReceiver", "StreamRegistry",
+    "FakeICITransport", "InProcTransport", "LinkStats",
+    "SlowLossyTransport", "TCPTransport", "Transport",
+    "frame_from_wire", "frame_to_wire",
+]

@@ -1,0 +1,272 @@
+"""Cluster-wide prefix directory — which replica holds which KV prefix.
+
+The router's prefix affinity used to be a last-serving-backend LRU: it
+could only re-find a prefix on the ONE replica that most recently served
+it. Mooncake's KVCache-centric design keeps a cluster-wide index instead:
+any replica that published a page-aligned prefix (to its radix cache and
+the shared pool) registers it here, and the router can route a request to
+ANY holder.
+
+* ``PrefixDirectory``  — the authoritative in-memory map: page-aligned
+  prefix key (``chunks.prefix_keys`` hash chain — stable across
+  processes) → {backend addr → entry}. Entries carry a ``slice_id`` tag
+  so the disruption controller can invalidate a whole slice on
+  preemption, and a TTL backstop against anything the explicit
+  invalidation paths miss.
+* ``DirectoryClient``  — wire client for the directory ops the kv-pool
+  server hosts (``dir_register`` / ``dir_lookup`` / ``dir_invalidate`` /
+  ``dir_stats``): the pool is already the cluster's shared KV service, so
+  the index lives next to the data.
+
+Lifecycle contract (the staleness satellite): entries are registered by
+the prefill publish path, and invalidated on (a) pool/radix eviction of
+the prefix, (b) backend drain (SIGTERM), (c) slice preemption or
+maintenance (DisruptionController), (d) TTL expiry. A lookup must never
+return an evicted prefix — the ``directory_consistent`` stress invariant.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from rbg_tpu.kvtransfer.chunks import prefix_keys
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.utils.locktrace import named_lock
+from rbg_tpu.utils.racetrace import guard as _race_guard
+
+
+class _Entry:
+    __slots__ = ("backend", "slice_id", "t_registered")
+
+    def __init__(self, backend: str, slice_id: str):
+        self.backend = backend
+        self.slice_id = slice_id
+        self.t_registered = time.monotonic()
+
+
+@_race_guard
+class PrefixDirectory:
+    def __init__(self, page_size: Optional[int] = None,
+                 ttl_s: float = 600.0, max_keys: int = 65536):
+        self.page_size = page_size
+        self.ttl_s = ttl_s
+        self.max_keys = max_keys
+        self._lock = named_lock("kvtransfer.directory")
+        # key → {backend: _Entry}
+        self._m: Dict[str, Dict[str, _Entry]] = {}  # guarded_by[kvtransfer.directory]
+        # guarded_by[kvtransfer.directory]
+        self.metrics = {"registers": 0, "lookups": 0, "hits": 0,
+                        "invalidated": 0}
+
+    # -- write paths --
+
+    def register_keys(self, keys: List[str], backend: str,
+                      slice_id: str = "") -> int:
+        """Register a hash-chain of page keys for ``backend``. Returns the
+        number of keys registered. Keys are refreshed, not duplicated."""
+        if not keys or not backend:
+            return 0
+        now = time.monotonic()
+        with self._lock:
+            for key in keys:
+                holders = self._m.get(key)
+                if holders is None:
+                    holders = self._m[key] = {}
+                e = holders.get(backend)
+                if e is None:
+                    holders[backend] = _Entry(backend, slice_id)
+                else:
+                    e.t_registered = now
+                    e.slice_id = slice_id or e.slice_id
+            self.metrics["registers"] += 1
+            self._cap_locked()
+            n = len(self._m)
+        REGISTRY.set_gauge(obs_names.KVT_DIR_ENTRIES, float(n))
+        return len(keys)
+
+    def register(self, tokens: List[int], backend: str,
+                 slice_id: str = "") -> int:
+        if self.page_size is None:
+            raise ValueError("directory has no page_size; use register_keys")
+        return self.register_keys(prefix_keys(tokens, self.page_size),
+                                  backend, slice_id)
+
+    def _invalidate_where(self, pred, reason: str) -> int:
+        """Drop entries matching ``pred(key, entry)``; empty keys die."""
+        dropped = 0
+        with self._lock:
+            for key in list(self._m):
+                holders = self._m[key]
+                for b in [b for b, e in holders.items() if pred(key, e)]:
+                    del holders[b]
+                    dropped += 1
+                if not holders:
+                    del self._m[key]
+            self.metrics["invalidated"] += dropped
+            n = len(self._m)
+        if dropped:
+            REGISTRY.inc(obs_names.KVT_DIR_INVALIDATIONS_TOTAL,
+                         float(dropped), reason=reason)
+            REGISTRY.set_gauge(obs_names.KVT_DIR_ENTRIES, float(n))
+        return dropped
+
+    def invalidate_backend(self, backend: str, reason: str = "drain") -> int:
+        return self._invalidate_where(
+            lambda _k, e: e.backend == backend, reason)
+
+    def invalidate_slice(self, slice_id: str,
+                         reason: str = "preemption") -> int:
+        if not slice_id:
+            return 0
+        return self._invalidate_where(
+            lambda _k, e: e.slice_id == slice_id, reason)
+
+    def invalidate_keys(self, keys: List[str],
+                        reason: str = "eviction") -> int:
+        ks = set(keys)
+        return self._invalidate_where(lambda k, _e: k in ks, reason)
+
+    def _cap_locked(self) -> None:
+        """Bound the index: evict oldest-registered keys past max_keys
+        (caller holds the lock)."""
+        over = len(self._m) - self.max_keys
+        if over <= 0:
+            return
+        oldest = sorted(
+            self._m,
+            key=lambda k: max(e.t_registered
+                              for e in self._m[k].values()))[:over]
+        for k in oldest:
+            del self._m[k]
+        self.metrics["invalidated"] += over
+
+    # -- read path --
+
+    def lookup_keys(self, keys: List[str]) -> Tuple[int, List[str]]:
+        """Longest registered prefix of the key chain. Returns
+        (matched_pages, holders-of-the-deepest-matched-key). TTL-expired
+        entries are dropped on the way."""
+        cutoff = time.monotonic() - self.ttl_s
+        with self._lock:
+            self.metrics["lookups"] += 1
+            matched, holders = 0, []
+            for key in keys:
+                hs = self._m.get(key)
+                if hs:
+                    for b in [b for b, e in hs.items()
+                              if e.t_registered < cutoff]:
+                        del hs[b]
+                    if not hs:
+                        del self._m[key]
+                        hs = None
+                if not hs:
+                    break
+                matched += 1
+                holders = list(hs)
+            if matched:
+                self.metrics["hits"] += 1
+        REGISTRY.inc(obs_names.KVT_DIR_LOOKUPS_TOTAL,
+                     result="hit" if matched else "miss")
+        return matched, holders
+
+    def lookup(self, tokens: List[int]) -> Tuple[int, List[str]]:
+        """Longest registered page-aligned prefix of ``tokens`` →
+        (matched_tokens, holder backends)."""
+        if self.page_size is None:
+            raise ValueError("directory has no page_size; use lookup_keys")
+        pages, holders = self.lookup_keys(
+            prefix_keys(tokens, self.page_size))
+        return pages * self.page_size, holders
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {**self.metrics, "keys": len(self._m)}
+
+
+class DirectoryClient:
+    """Wire client for the directory ops hosted on the kv-pool server.
+    Failures degrade (return misses / 0) — the directory is an
+    optimization, never a request dependency. A failed call opens a
+    short circuit-breaker (``backoff_s``): the router's hot path must
+    not pay a connect timeout per request while the pool host is down."""
+
+    def __init__(self, addr: str, timeout: float = 2.0,
+                 token: Optional[str] = None,
+                 page_size: Optional[int] = None,
+                 backoff_s: float = 5.0):
+        import os
+        self.addr = addr
+        self.timeout = timeout
+        self.page_size = page_size
+        self.backoff_s = backoff_s
+        self.token = (token if token is not None
+                      else os.environ.get("RBG_DATA_TOKEN") or None)
+        self._lock = named_lock("kvtransfer.dirclient")
+        self._down_until = 0.0   # guarded_by[kvtransfer.dirclient]
+
+    def _call(self, obj: dict) -> Optional[dict]:
+        from rbg_tpu.engine.protocol import request_once
+        with self._lock:
+            if time.monotonic() < self._down_until:
+                return None
+        if self.token:
+            obj = dict(obj, token=self.token)
+        try:
+            resp, _, _ = request_once(self.addr, obj, timeout=self.timeout)
+        except (OSError, ValueError):
+            with self._lock:
+                self._down_until = time.monotonic() + self.backoff_s
+            return None
+        if not isinstance(resp, dict) or resp.get("error"):
+            return None
+        return resp
+
+    def register_keys(self, keys: List[str], backend: str,
+                      slice_id: str = "") -> int:
+        resp = self._call({"op": "dir_register", "keys": list(keys),
+                           "backend": backend, "slice_id": slice_id})
+        return int(resp.get("registered", 0)) if resp else 0
+
+    def register(self, tokens: List[int], backend: str,
+                 slice_id: str = "") -> int:
+        if self.page_size is None:
+            return 0
+        return self.register_keys(prefix_keys(tokens, self.page_size),
+                                  backend, slice_id)
+
+    def lookup_keys(self, keys: List[str]) -> Tuple[int, List[str]]:
+        resp = self._call({"op": "dir_lookup", "keys": list(keys)})
+        if not resp:
+            return 0, []
+        return int(resp.get("matched", 0)), list(resp.get("holders") or ())
+
+    def lookup(self, tokens: List[int]) -> Tuple[int, List[str]]:
+        """Longest registered prefix of ``tokens``. Without a local
+        page_size the prompt goes to the server, which computes the key
+        chain with ITS page size (the router has no engine config)."""
+        if self.page_size is not None:
+            pages, holders = self.lookup_keys(
+                prefix_keys(tokens, self.page_size))
+            return pages * self.page_size, holders
+        resp = self._call({"op": "dir_lookup", "prompt": list(tokens)})
+        if not resp:
+            return 0, []
+        return (int(resp.get("matched_tokens", 0)),
+                list(resp.get("holders") or ()))
+
+    def invalidate_backend(self, backend: str, reason: str = "drain") -> int:
+        resp = self._call({"op": "dir_invalidate", "backend": backend,
+                           "reason": reason})
+        return int(resp.get("invalidated", 0)) if resp else 0
+
+    def invalidate_slice(self, slice_id: str,
+                         reason: str = "preemption") -> int:
+        resp = self._call({"op": "dir_invalidate", "slice_id": slice_id,
+                           "reason": reason})
+        return int(resp.get("invalidated", 0)) if resp else 0
+
+    def stats(self) -> dict:
+        resp = self._call({"op": "dir_stats"})
+        return (resp or {}).get("directory", {})

@@ -54,6 +54,11 @@ AUTOSCALE_COOLDOWN_SUPPRESSED_TOTAL = (
 AUTOSCALE_STALE_HOLDS_TOTAL = "rbg_autoscale_stale_holds_total"
 AUTOSCALE_CONFLICTS_TOTAL = "rbg_autoscale_conflicts_total"
 AUTOSCALE_SPARE_GRANTS_TOTAL = "rbg_autoscale_spare_grants_total"
+KVT_CHUNKS_TOTAL = "rbg_kvtransfer_chunks_total"
+KVT_BYTES_TOTAL = "rbg_kvtransfer_bytes_total"
+KVT_STREAMS_TOTAL = "rbg_kvtransfer_streams_total"
+KVT_DIR_LOOKUPS_TOTAL = "rbg_kvtransfer_dir_lookups_total"
+KVT_DIR_INVALIDATIONS_TOTAL = "rbg_kvtransfer_dir_invalidations_total"
 
 # ---- gauges (last-write-wins) ----
 
@@ -67,6 +72,8 @@ ROUTER_BACKEND_OUTSTANDING = "rbg_router_backend_outstanding"
 ROUTER_BACKEND_DRAINING = "rbg_router_backend_draining"
 AUTOSCALE_TARGET_REPLICAS = "rbg_autoscale_target_replicas"
 AUTOSCALE_ACTUAL_REPLICAS = "rbg_autoscale_actual_replicas"
+KVT_LINK_RATE = "rbg_kvtransfer_link_bytes_per_s"
+KVT_DIR_ENTRIES = "rbg_kvtransfer_dir_entries"
 
 # ---- histograms ----
 
@@ -77,6 +84,8 @@ SERVING_BATCH_OCCUPANCY = "rbg_serving_batch_occupancy"
 SERVING_JOIN_LATENCY_SECONDS = "rbg_serving_join_latency_seconds"
 SLO_TTFT_SECONDS = "rbg_slo_ttft_seconds"
 SLO_TPOT_SECONDS = "rbg_slo_tpot_seconds"
+PD_LOCK_HOLD_SECONDS = "rbg_pd_lock_hold_seconds"
+KVT_ADMIT_LEAD_SECONDS = "rbg_kvtransfer_admit_lead_seconds"
 
 # ---- catalog sets (consumed by the lint rule and strict-mode registry) ----
 
@@ -110,6 +119,11 @@ COUNTERS = frozenset({
     AUTOSCALE_STALE_HOLDS_TOTAL,
     AUTOSCALE_CONFLICTS_TOTAL,
     AUTOSCALE_SPARE_GRANTS_TOTAL,
+    KVT_CHUNKS_TOTAL,
+    KVT_BYTES_TOTAL,
+    KVT_STREAMS_TOTAL,
+    KVT_DIR_LOOKUPS_TOTAL,
+    KVT_DIR_INVALIDATIONS_TOTAL,
 })
 
 GAUGES = frozenset({
@@ -123,6 +137,8 @@ GAUGES = frozenset({
     ROUTER_BACKEND_DRAINING,
     AUTOSCALE_TARGET_REPLICAS,
     AUTOSCALE_ACTUAL_REPLICAS,
+    KVT_LINK_RATE,
+    KVT_DIR_ENTRIES,
 })
 
 HISTOGRAMS = frozenset({
@@ -133,6 +149,8 @@ HISTOGRAMS = frozenset({
     SERVING_JOIN_LATENCY_SECONDS,
     SLO_TTFT_SECONDS,
     SLO_TPOT_SECONDS,
+    PD_LOCK_HOLD_SECONDS,
+    KVT_ADMIT_LEAD_SECONDS,
 })
 
 ALL_NAMES = COUNTERS | GAUGES | HISTOGRAMS
@@ -213,6 +231,22 @@ HELP = {
     SLO_TPOT_SECONDS:
         "Per-output-token latency after the first token, per judged "
         "request",
+    KVT_CHUNKS_TOTAL: "KV transfer chunks moved, per direction",
+    KVT_BYTES_TOTAL: "KV transfer payload bytes moved, per direction "
+                     "and transport",
+    KVT_STREAMS_TOTAL: "KV chunk streams completed, per outcome",
+    KVT_DIR_LOOKUPS_TOTAL:
+        "Cluster prefix-directory lookups, per result (hit/miss)",
+    KVT_DIR_INVALIDATIONS_TOTAL:
+        "Prefix-directory entries invalidated, per reason",
+    KVT_LINK_RATE:
+        "Measured KV link throughput from real transfers, per transport",
+    KVT_DIR_ENTRIES: "Live prefix-directory entries",
+    PD_LOCK_HOLD_SECONDS:
+        "Time a PD critical-section lock was held, per lock",
+    KVT_ADMIT_LEAD_SECONDS:
+        "How long before its stream finished a streamed decode row was "
+        "admitted (coverage-complete vs stream-close lead)",
 }
 
 # ---- span names (obs/trace.py) ----
@@ -230,6 +264,8 @@ SPAN_SERVICE_QUEUE_WAIT = "service.queue_wait"
 SPAN_SERVICE_SCAN = "service.scan"
 SPAN_PD_PREFILL = "pd.prefill"
 SPAN_PD_KV_HANDOFF = "pd.kv_handoff"
+SPAN_KVT_PUSH = "kvtransfer.push"
+SPAN_KVT_COMMIT = "kvtransfer.commit"
 SPAN_STRESS_REQUEST = "stress.request"
 
 SPANS = frozenset({
@@ -241,5 +277,7 @@ SPANS = frozenset({
     SPAN_SERVICE_SCAN,
     SPAN_PD_PREFILL,
     SPAN_PD_KV_HANDOFF,
+    SPAN_KVT_PUSH,
+    SPAN_KVT_COMMIT,
     SPAN_STRESS_REQUEST,
 })

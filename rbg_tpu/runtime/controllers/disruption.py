@@ -202,10 +202,25 @@ class DisruptionController(Controller):
     # sleep through a notice window; active slices self-requeue instead.
     resync_period = 30.0
 
-    def __init__(self, store: Store, node_binding=None, spares=None):
+    def __init__(self, store: Store, node_binding=None, spares=None,
+                 kv_directory=None):
         super().__init__(store)
         self.node_binding = node_binding
         self.spares = spares
+        # Cluster prefix directory (kvtransfer.PrefixDirectory /
+        # DirectoryClient): slice loss invalidates every KV prefix entry
+        # registered from that slice — a router must never route a
+        # prefix hit at a preempted replica. Optional; disruption
+        # handling never depends on it.
+        self.kv_directory = kv_directory
+
+    def _invalidate_kv_slice(self, sid: str, reason: str) -> None:
+        if self.kv_directory is None:
+            return
+        try:
+            self.kv_directory.invalidate_slice(sid, reason=reason)
+        except Exception:  # noqa: BLE001 — the directory is best-effort
+            pass
 
     def watches(self) -> List[Watch]:
         def node_keys(node):
@@ -324,6 +339,10 @@ class DisruptionController(Controller):
     def _handle_preemption(self, store, sid, nodes, preempted) -> Optional[Result]:
         self._ack_once(store, preempted, _ANN_PREEMPT_ACKED,
                        names.DISRUPTION_PREEMPTIONS_TOTAL)
+        # KV prefixes computed on this slice are gone with its HBM —
+        # drop their cluster-directory entries immediately (idempotent
+        # across reconciles of the same incident).
+        self._invalidate_kv_slice(sid, "preemption")
         # Cordon every host of the slice — a partially-preempted ICI
         # domain must not receive new binds while the gang recovers.
         self._cordon(store, nodes)
@@ -477,6 +496,11 @@ class DisruptionController(Controller):
         self._ack_once(store, maint, _ANN_NOTICE_ACKED,
                        names.DISRUPTION_NOTICES_TOTAL)
         self._cordon(store, nodes)
+        # This slice's replicas are on the way out — demote their KV
+        # prefix-directory entries now (the replacement gang re-registers
+        # as it serves), so prefix affinity stops steering at a slice
+        # mid-migration.
+        self._invalidate_kv_slice(sid, "maintenance")
 
         host_names = {n.metadata.name for n in nodes}
         all_pods = store.list("Pod", copy_=False)

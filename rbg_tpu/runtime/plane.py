@@ -25,7 +25,8 @@ from rbg_tpu.sched.scheduler import SchedulerController
 class ControlPlane:
     def __init__(self, store: Optional[Store] = None, backend: str = "fake",
                  ready_delay: float = 0.0, executor_env: Optional[dict] = None,
-                 k8s_client=None, warm_spares: int = 0, autoscale=None):
+                 k8s_client=None, warm_spares: int = 0, autoscale=None,
+                 kv_directory=None):
         self.store = store or Store()
         self.manager = Manager(self.store)
         self.node_binding = NodeBindingStore(self.store)
@@ -54,7 +55,8 @@ class ControlPlane:
                                 spares=self.spares))
         self.disruption_controller = self.manager.register(
             DisruptionController(self.store, node_binding=self.node_binding,
-                                 spares=self.spares))
+                                 spares=self.spares,
+                                 kv_directory=kv_directory))
         # SLO-driven autoscaler (rbg_tpu/autoscale): reads the windowed
         # signal plane, writes role targets through ScalingAdapter. Off
         # unless an AutoscaleConfig is passed — capacity is operator-owned

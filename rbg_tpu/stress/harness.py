@@ -442,6 +442,161 @@ def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
     return report
 
 
+# ---- KV transfer plane scenario --------------------------------------------
+
+
+@dataclasses.dataclass
+class KVStreamConfig:
+    """Slow-link drill for the KVCache-centric transfer plane
+    (rbg_tpu/kvtransfer): a PD pair streams chunked KV over a slow, lossy,
+    reordering link — with one stream truncated mid-transfer — and the
+    drill asserts the plane's three promises:
+
+    * ``kv_stream_overlap`` — decode starts before the transfer plane is
+      done: a row's first decode step lands before its stream's close
+      frame arrives on the slow link (coverage-based admission, never
+      wait-for-FIN).
+    * ``directory_consistent`` — no cluster prefix-directory lookup
+      returns an evicted prefix or an invalidated (preempted-slice)
+      backend.
+    * ``zero_dropped_streams`` — the truncated stream surfaces as a
+      structured error and is retried token-exact; every request
+      completes with outputs BIT-IDENTICAL to a unified engine.
+    """
+
+    requests: int = 6
+    prompt_len: int = 48            # several pages at page_size 8
+    max_new_tokens: int = 8
+    slow_link_delay_s: float = 0.05  # per-frame; the overlap window
+    dup_rate: float = 0.25
+    reorder_window: int = 3
+    truncate_nth_stream: int = 2    # this stream dies mid-transfer
+    model: str = "tiny"
+
+
+def run_kv_stream(cfg: KVStreamConfig) -> dict:
+    import numpy as np
+
+    from rbg_tpu.engine.config import EngineConfig, SamplingParams
+    from rbg_tpu.engine.engine import Engine
+    from rbg_tpu.engine.kvpool import KVPoolStore
+    from rbg_tpu.engine.pd import PDStreamPair
+    from rbg_tpu.kvtransfer import (InProcTransport, PrefixDirectory,
+                                    SlowLossyTransport)
+
+    page_size = 8
+    ecfg = dict(model=cfg.model, page_size=page_size, num_pages=256,
+                max_batch=4, max_seq_len=256, prefill_chunk=16,
+                use_pallas="never")
+    rng = np.random.RandomState(11)
+    eng_ref = Engine(EngineConfig(enable_radix_cache=False, **ecfg))
+    vocab = eng_ref.mcfg.vocab_size
+    prompts = [rng.randint(1, vocab, size=cfg.prompt_len).tolist()
+               for _ in range(cfg.requests)]
+    sp = SamplingParams(max_new_tokens=cfg.max_new_tokens)
+    expect = eng_ref.generate(prompts, sp)
+
+    directory = PrefixDirectory(page_size=page_size)
+    # The shared prefix store doubles as the drill's eviction source: a
+    # budget small enough that later puts evict earlier prefixes, whose
+    # directory keys must be invalidated with them.
+    pool = KVPoolStore(page_size, max_bytes=1 << 18, directory=directory)
+    link = SlowLossyTransport(InProcTransport(),
+                              delay_s=cfg.slow_link_delay_s,
+                              reorder_window=cfg.reorder_window,
+                              dup_rate=cfg.dup_rate,
+                              truncate_nth_stream=cfg.truncate_nth_stream,
+                              truncate_after_bytes=1 << 12, seed=7)
+    pair = PDStreamPair(EngineConfig(**ecfg),
+                        params=eng_ref.params, transport=link)
+    pair.prefill.pool = pool
+    pool.page_size = page_size
+    pair.prefill.directory = directory
+    pair.prefill.advertise_addr = "10.0.0.1:9000"
+    pair.prefill.slice_id = "slice-a"
+
+    # Two warm passes (same prompt) through the SAME plane, slow link
+    # included, compile the prefill/inject/decode programs — the second
+    # hits the pool prefix published by the first, compiling the
+    # prefix-import scatter too. The drill then measures the transfer
+    # plane, not jit compiles (which would mask overlap).
+    warm_prompt = rng.randint(1, vocab,
+                              size=cfg.prompt_len).tolist()
+    for _ in range(2):
+        pair.generate_one(warm_prompt, sp, stream=True,
+                          recv_timeout=120.0, max_retries=2)
+
+    results = []
+    failures = []
+    t0 = time.perf_counter()
+    for i, p in enumerate(prompts):
+        try:
+            results.append(pair.generate_one(p, sp, stream=True,
+                                             recv_timeout=60.0,
+                                             max_retries=2))
+        except Exception as e:  # noqa: BLE001 — account, don't crash
+            failures.append(f"request {i}: {type(e).__name__}: {e}")
+            results.append(None)
+    elapsed = time.perf_counter() - t0
+
+    bit_identical = all(r is not None and r["tokens"] == e
+                        for r, e in zip(results, expect))
+    overlaps = [bool(r and r.get("overlap")) for r in results]
+    retried = sum(r["retries"] for r in results if r)
+
+    # Directory consistency sweep #1 (evictions): every holder claim the
+    # directory still makes must be backed by the pool actually holding
+    # at least that many prefix tokens.
+    dir_vs_pool_ok = True
+    for p in prompts:
+        matched, holders = directory.lookup(p)
+        if matched and holders:
+            pool_tokens = pool.match(p)[0]
+            if pool_tokens < matched:
+                dir_vs_pool_ok = False
+    # Sweep #2 (slice preemption): invalidating the slice must empty
+    # every lookup — the DisruptionController's wire into the directory.
+    directory.invalidate_slice("slice-a", reason="preemption")
+    post_preempt_ok = all(directory.lookup(p)[1] == [] for p in prompts)
+
+    report = {
+        "scenario": "kvstream",
+        "config": dataclasses.asdict(cfg),
+        "elapsed_s": round(elapsed, 3),
+        "requests": {
+            "total": cfg.requests,
+            "completed": sum(1 for r in results if r),
+            "stream_retries": retried,
+            "failures": failures,
+        },
+        "transfer": {
+            "bytes_per_request": (results[0]["bytes"]
+                                  if results and results[0] else 0),
+            "overlap_requests": sum(overlaps),
+            "admit_lead_ms": _pcts([r["admit_lead_s"] for r in results
+                                    if r and r["admit_lead_s"] is not None]),
+            "t_first_decode_ms": _pcts([r["t_first_decode"] for r in results
+                                        if r and r["t_first_decode"]]),
+        },
+        "pool": pool.stats(),
+        "directory": directory.stats(),
+        "bit_identical": bit_identical,
+        "invariants": {
+            # Decode began while this row's stream was still closing on
+            # the slow link — for EVERY completed row (coverage-based
+            # admission is unconditional, not lucky).
+            "kv_stream_overlap": bool(overlaps) and all(
+                o for o, r in zip(overlaps, results) if r),
+            "directory_consistent": dir_vs_pool_ok and post_preempt_ok,
+            # The truncated stream was retried, nothing was dropped, and
+            # every output matches the unified reference bit-for-bit.
+            "zero_dropped_streams": (not failures and bit_identical
+                                     and retried >= 1),
+        },
+    }
+    return report
+
+
 # ---- SLO-driven autoscaling scenario ---------------------------------------
 
 
@@ -1089,7 +1244,8 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(prog="rbg-tpu-stress")
     ap.add_argument("--scenario", default="churn",
-                    choices=["churn", "overload", "preemption", "autoscale"],
+                    choices=["churn", "overload", "preemption", "autoscale",
+                             "kvstream"],
                     help="churn = control-plane create/update/delete "
                          "percentiles; overload = serving-plane admission "
                          "control drill (sheds, deadlines, queue bound); "
@@ -1097,7 +1253,10 @@ def main(argv=None) -> int:
                          "semantics, deadline migration, router replay); "
                          "autoscale = capacity-follows-load drill (diurnal "
                          "+ burst trace against a live mini-plane, the "
-                         "autoscaler closing the signal→capacity loop)")
+                         "autoscaler closing the signal→capacity loop); "
+                         "kvstream = KV transfer-plane drill (chunked "
+                         "PD streaming over a slow/lossy link: overlap, "
+                         "directory consistency, zero dropped streams)")
     ap.add_argument("--clients", type=int, default=6)
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--max-queue", type=int, default=4)
@@ -1112,6 +1271,12 @@ def main(argv=None) -> int:
     ap.add_argument("--warm-spares", type=int, default=1,
                     help="standby slices reserved per topology "
                          "(preemption scenario)")
+    ap.add_argument("--kv-slow-link", type=float, default=None,
+                    metavar="DELAY_S",
+                    help="per-frame delay of the injected slow KV link "
+                         "(kvstream scenario, default 0.02; adding it to "
+                         "--scenario overload runs the kvstream drill "
+                         "alongside and merges its invariants)")
     ap.add_argument("--duration-s", type=float, default=14.0,
                     help="trace length for the autoscale scenario")
     ap.add_argument("--burst-rps", type=float, default=85.0,
@@ -1198,13 +1363,27 @@ def main(argv=None) -> int:
             r: REGISTRY.counter(metric_names.TRACE_TRACES_TOTAL, result=r)
             for r in ("complete", "incomplete", "leaked")}
     load1 = os.getloadavg()[0]
-    if args.scenario in ("overload", "preemption", "autoscale"):
+    if args.scenario in ("overload", "preemption", "autoscale", "kvstream"):
         if args.scenario == "overload":
             report = run_serving_overload(OverloadConfig(
                 clients=args.clients, requests_per_client=args.requests,
                 max_queue=args.max_queue, max_batch=args.max_batch,
                 timeout_s=args.timeout_s,
                 slo_ttft_s=args.slo_ttft_s, slo_tpot_s=args.slo_tpot_s))
+            if args.kv_slow_link is not None:
+                # Transfer-plane drill riding along: slow-link streaming
+                # PD invariants merge into the overload report (one red
+                # anywhere fails the run).
+                kv = run_kv_stream(KVStreamConfig(
+                    slow_link_delay_s=args.kv_slow_link))
+                report["kvstream"] = {k: v for k, v in kv.items()
+                                      if k != "invariants"}
+                report["invariants"].update(kv["invariants"])
+        elif args.scenario == "kvstream":
+            report = run_kv_stream(KVStreamConfig(
+                slow_link_delay_s=(args.kv_slow_link
+                                   if args.kv_slow_link is not None
+                                   else 0.02)))
         elif args.scenario == "autoscale":
             report = run_autoscale(AutoscaleStressConfig(
                 duration_s=args.duration_s, burst_rps=args.burst_rps,
@@ -1571,6 +1750,20 @@ def _preemption_sections(report: dict) -> str:
 <h2>invariants</h2>{_invariants_table(report.get("invariants") or {})}"""
 
 
+def _kvstream_sections(report: dict) -> str:
+    tr = report.get("transfer") or {}
+    return f"""<h2>requests</h2>{_kv_table(report.get("requests") or {})}
+<h2>transfer (slow link)</h2>{_kv_table(
+        {k: v for k, v in tr.items()
+         if not isinstance(v, dict)})}
+<h2>admit lead ms (ready → stream close)</h2>{_kv_table(
+        tr.get("admit_lead_ms") or {})}
+<h2>prefix pool</h2>{_kv_table(report.get("pool") or {})}
+<h2>prefix directory</h2>{_kv_table(report.get("directory") or {})}
+<p>bit_identical: {report.get("bit_identical")}</p>
+<h2>invariants</h2>{_invariants_table(report.get("invariants") or {})}"""
+
+
 def write_html_report(report: dict, path: str) -> None:
     """Scenario-aware HTML report (reference analog: test/stress
     report.go). Each scenario renders ITS OWN sections — an overload or
@@ -1586,6 +1779,8 @@ def write_html_report(report: dict, path: str) -> None:
         body = _preemption_sections(report)
     elif scenario == "autoscale":
         body = _autoscale_sections(report)
+    elif scenario == "kvstream":
+        body = _kvstream_sections(report)
     else:
         body = f"<pre>{json.dumps(report, indent=2)}</pre>"
     tr = report.get("trace")

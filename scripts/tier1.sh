@@ -86,6 +86,35 @@ assert len(r.get('curve') or []) > 10, 'capacity-vs-load curve is empty'
              "zero-dropped-streams invariant red in /tmp/_t1_autoscale.json" >&2
         exit 1
     fi
+    # KV transfer-plane smoke: chunked PD streaming over an injected slow
+    # lossy link (reorder + duplicates + one truncated stream). Asserts
+    # kv_stream_overlap (decode starts before the stream closes),
+    # directory_consistent (no lookup returns an evicted prefix), and
+    # zero_dropped_streams (truncation retried token-exact). Outside the
+    # 870 s pytest budget, --lint mode only.
+    echo "== rbg-tpu stress --scenario kvstream --kv-slow-link (smoke) =="
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
+            stress --scenario kvstream --kv-slow-link 0.05 --json \
+            >/tmp/_t1_kvstream.json; then
+        echo "TIER1 KVSTREAM SMOKE FAILED — see /tmp/_t1_kvstream.json" \
+             "(invariants)" >&2
+        exit 1
+    fi
+    if ! python -c "
+import json
+r = json.load(open('/tmp/_t1_kvstream.json'))
+inv = r.get('invariants') or {}
+assert inv.get('kv_stream_overlap'), \
+    'decode never overlapped the stream: %s' % (r.get('transfer') or {})
+assert inv.get('directory_consistent'), 'directory returned evicted prefix'
+assert inv.get('zero_dropped_streams'), \
+    'streams dropped: %s' % (r.get('requests') or {})
+assert r.get('bit_identical'), 'streamed decode diverged from reference'
+"; then
+        echo "TIER1 KVSTREAM SMOKE FAILED — overlap/directory/zero-drop" \
+             "invariant red in /tmp/_t1_kvstream.json" >&2
+        exit 1
+    fi
     # Live windowed-signal render: boot a tiny engine server, push one
     # request through it, and assert `rbg-tpu top --once` renders the
     # per-role dashboard (attainment + goodput columns) from its slo +

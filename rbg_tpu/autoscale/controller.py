@@ -46,7 +46,7 @@ from rbg_tpu.autoscale.signals import SignalReader
 from rbg_tpu.obs import names
 from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.runtime.controller import Controller, Result, Watch
-from rbg_tpu.runtime.store import Conflict, NotFound, Store
+from rbg_tpu.runtime.store import EVENT_WARNING, Conflict, NotFound, Store
 from rbg_tpu.utils.locktrace import named_lock
 
 
@@ -370,7 +370,8 @@ class AutoscaleController(Controller):
         store.record_event(
             sa, "AutoscaleConflict",
             f"{role}: foreign writer set replicas={sa.spec.replicas}; "
-            f"backing off and adopting it as baseline")
+            f"backing off and adopting it as baseline",
+            type_=EVENT_WARNING)
 
     def _stamp_victim_costs(self, store, ns, group, role) -> None:
         """Stamp each live instance's scale-down cost from observed

@@ -127,6 +127,36 @@ def register(sub) -> None:
     rp.add_argument("-n", "--namespace", default="default")
     rp.set_defaults(func=cmd_rollout)
 
+    evp = sub.add_parser(
+        "events",
+        help="control-plane event timeline from a serve plane's "
+             "structured recorder (k8s `kubectl get events` analog): "
+             "type/reason/count-deduped, filterable by object, reason, "
+             "and age")
+    evp.add_argument("kind", nargs="?",
+                     help="narrow to one object (pass kind AND name)")
+    evp.add_argument("name", nargs="?")
+    evp.add_argument("--reason", default=None,
+                     help="exact event reason (e.g. FailedScheduling)")
+    evp.add_argument("--type", dest="etype", default=None,
+                     choices=["Normal", "Warning"],
+                     help="only events of this type")
+    evp.add_argument("--since", default=None, metavar="AGE",
+                     help="only events newer than AGE — seconds, or with "
+                          "an s/m/h suffix (e.g. 90, 5m, 2h)")
+    evp.add_argument("--limit", type=int, default=100,
+                     help="newest-N records to pull (server clamps to 500)")
+    evp.add_argument("--admin", default="127.0.0.1:7070")
+    evp.add_argument("--token", default=None,
+                     help="admin bearer token (default: $RBG_ADMIN_TOKEN)")
+    evp.add_argument("--tls-ca", default=None,
+                     help="CA cert for a TLS admin endpoint "
+                          "(default: $RBG_ADMIN_TLS_CA)")
+    evp.add_argument("-n", "--namespace", default="default")
+    evp.add_argument("--json", action="store_true",
+                     help="raw JSON records")
+    evp.set_defaults(func=cmd_events)
+
     tp = sub.add_parser(
         "traces",
         help="pull request traces from a live plane: slowest-request "
@@ -438,6 +468,69 @@ def cmd_rollout(args) -> int:
     resp = _admin_call(args.admin, {"op": "undo", "revision": args.revision, **base}, token=getattr(args, 'token', None),
                        tls_ca=getattr(args, 'tls_ca', None))
     print(f"rolled back to revision {resp['restoredRevision']}")
+    return 0
+
+
+def _parse_age(text: str) -> float:
+    """``90`` / ``90s`` / ``5m`` / ``2h`` → seconds."""
+    t = text.strip().lower()
+    mult = {"s": 1.0, "m": 60.0, "h": 3600.0}.get(t[-1:])
+    if mult is not None:
+        t = t[:-1]
+    return float(t) * (mult or 1.0)
+
+
+def cmd_events(args) -> int:
+    """Render the structured event timeline (the operator leg of the
+    control-plane event plane, docs/observability.md)."""
+    import json as _json
+    import time as _time
+
+    req = {"op": "events", "namespace": args.namespace,
+           "limit": args.limit}
+    if args.kind:
+        if not args.name:
+            print("error: pass kind AND name (or neither)", file=sys.stderr)
+            return 2
+        req["kind"], req["name"] = args.kind, args.name
+    if args.reason:
+        req["reason"] = args.reason
+    if args.etype:
+        req["type"] = args.etype
+    if args.since:
+        try:
+            req["since"] = _parse_age(args.since)
+        except ValueError:
+            print(f"error: cannot parse --since {args.since!r} "
+                  f"(use seconds or s/m/h suffix)", file=sys.stderr)
+            return 2
+    resp = _admin_call(args.admin, req, token=getattr(args, "token", None),
+                       tls_ca=getattr(args, "tls_ca", None))
+    if args.json:
+        print(_json.dumps(resp, indent=2))
+        return 0
+    events = resp.get("events") or []
+    stats = resp.get("stats") or {}
+    print(f"{len(events)} events ({stats.get('records', '?')} records / "
+          f"{stats.get('objects', '?')} objects tracked plane-wide)")
+    if not events:
+        return 0
+    print(f"{'AGE':>7} {'TYPE':<8} {'REASON':<24} "
+          f"{'OBJECT':<42} {'COUNT':>5}  MESSAGE")
+    now = _time.time()
+
+    def age(ts) -> str:
+        d = max(0.0, now - ts)
+        if d < 90:
+            return f"{d:.0f}s"
+        if d < 5400:
+            return f"{d / 60:.0f}m"
+        return f"{d / 3600:.1f}h"
+
+    for e in events:
+        print(f"{age(e['time']):>7} {e.get('type', ''):<8} "
+              f"{e['reason']:<24} {e['object']:<42} "
+              f"{e.get('count', 1):>5}  {e['message']}")
     return 0
 
 

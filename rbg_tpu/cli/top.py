@@ -73,6 +73,13 @@ def _collect_admin(addr: str, token: Optional[str], window: int) -> dict:
         out["autoscale"] = auto.get("autoscale")
     except (OSError, RuntimeError, ConnectionError):
         pass
+    # Control-plane panel (optional for the same reason): per-controller
+    # reconcile rates/latency, workqueue depth, event-recorder rate.
+    try:
+        cp = _call(addr, {"op": "controlplane"}, tok or None)
+        out["controlplane"] = cp.get("controlplane")
+    except (OSError, RuntimeError, ConnectionError):
+        pass
     return out
 
 
@@ -174,6 +181,33 @@ def _render_admin(src: dict, window: int) -> List[str]:
     lines.append(_ROLE_HDR)
     lines.extend(_tracker_role_rows(slo.get("trackers") or [], window,
                                     signals, {}))
+    cp = src.get("controlplane")
+    if cp:
+        ev = cp.get("events") or {}
+        watch = cp.get("watch") or {}
+        lines.append(
+            f"  control plane — events "
+            f"{_fmt(ev.get('per_s'), 1, '/s')} "
+            f"({ev.get('records', 0)} records / {ev.get('objects', 0)} "
+            f"objects), watch {_fmt(watch.get('events_per_s'), 1, '/s')}")
+        lines.append(f"  {'CONTROLLER':<18} {'QDEPTH':>6} {'REC/S':>7} "
+                     f"{'ERRORS':>7} {'P50-MS':>7} {'P99-MS':>7} "
+                     f"{'AGE99-MS':>9} {'RETRY':>5}")
+        for c in cp.get("controllers") or []:
+            rec = c.get("reconciles") or {}
+            ms = (lambda v: None if v is None else v * 1000.0)
+            lines.append(
+                f"  {c.get('name', ''):<18} {c.get('queue_depth', 0):>6} "
+                f"{_fmt(c.get('reconcile_per_s'), 1):>7} "
+                f"{rec.get('error', 0):>7.0f} "
+                f"{_fmt(ms(c.get('reconcile_p50_s')), 1):>7} "
+                f"{_fmt(ms(c.get('reconcile_p99_s')), 1):>7} "
+                f"{_fmt(ms(c.get('queue_age_p99_s')), 1):>9} "
+                f"{c.get('retries_pending', 0):>5}")
+            for sk in (c.get("stuck_keys") or [])[:3]:
+                if sk.get("failures", 0) >= 3:
+                    lines.append(f"    !! stuck {sk['key']} "
+                                 f"({sk['failures']} consecutive failures)")
     auto = src.get("autoscale")
     if auto:
         lines.append(

@@ -117,6 +117,17 @@ class Registry:
                     return _BUCKETS[i] if i < len(_BUCKETS) else h[3]
             return h[3]
 
+    def hist_stats(self, name: str, **labels) -> Optional[dict]:
+        """``{count, sum, max}`` for one histogram series (None when the
+        series doesn't exist) — the cheap aggregate the fleet report
+        pairs with ``quantile`` percentile points."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._hist.get(key)
+            if h is None:
+                return None
+            return {"count": h[2], "sum": h[1], "max": h[3]}
+
     def exemplars(self, name: str, **labels) -> Dict[str, dict]:
         """{le: {"value", "trace_id"}} for one histogram series — the
         slowest traced observation per bucket."""

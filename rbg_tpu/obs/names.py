@@ -59,6 +59,14 @@ KVT_BYTES_TOTAL = "rbg_kvtransfer_bytes_total"
 KVT_STREAMS_TOTAL = "rbg_kvtransfer_streams_total"
 KVT_DIR_LOOKUPS_TOTAL = "rbg_kvtransfer_dir_lookups_total"
 KVT_DIR_INVALIDATIONS_TOTAL = "rbg_kvtransfer_dir_invalidations_total"
+WORKQUEUE_ADDS_TOTAL = "rbg_workqueue_adds_total"
+RECONCILE_REQUEUES_TOTAL = "rbg_reconcile_requeues_total"
+WATCH_EVENTS_TOTAL = "rbg_watch_events_total"
+WATCH_DELIVERIES_TOTAL = "rbg_watch_deliveries_total"
+SCHED_BINDS_TOTAL = "rbg_sched_binds_total"
+EVENTS_RECORDED_TOTAL = "rbg_events_recorded_total"
+EVENTS_DEDUPED_TOTAL = "rbg_events_deduped_total"
+EVENTS_EVICTED_TOTAL = "rbg_events_evicted_total"
 
 # ---- gauges (last-write-wins) ----
 
@@ -74,6 +82,9 @@ AUTOSCALE_TARGET_REPLICAS = "rbg_autoscale_target_replicas"
 AUTOSCALE_ACTUAL_REPLICAS = "rbg_autoscale_actual_replicas"
 KVT_LINK_RATE = "rbg_kvtransfer_link_bytes_per_s"
 KVT_DIR_ENTRIES = "rbg_kvtransfer_dir_entries"
+WORKQUEUE_DEPTH = "rbg_workqueue_depth"
+WORKQUEUE_RETRIES_PENDING = "rbg_workqueue_retries_pending"
+EVENTS_OBJECTS = "rbg_events_objects"
 
 # ---- histograms ----
 
@@ -86,6 +97,9 @@ SLO_TTFT_SECONDS = "rbg_slo_ttft_seconds"
 SLO_TPOT_SECONDS = "rbg_slo_tpot_seconds"
 PD_LOCK_HOLD_SECONDS = "rbg_pd_lock_hold_seconds"
 KVT_ADMIT_LEAD_SECONDS = "rbg_kvtransfer_admit_lead_seconds"
+WORKQUEUE_QUEUE_AGE_SECONDS = "rbg_workqueue_queue_age_seconds"
+WATCH_DISPATCH_SECONDS = "rbg_watch_dispatch_seconds"
+SCHED_FEASIBILITY_SCAN_SECONDS = "rbg_sched_feasibility_scan_seconds"
 
 # ---- catalog sets (consumed by the lint rule and strict-mode registry) ----
 
@@ -124,6 +138,14 @@ COUNTERS = frozenset({
     KVT_STREAMS_TOTAL,
     KVT_DIR_LOOKUPS_TOTAL,
     KVT_DIR_INVALIDATIONS_TOTAL,
+    WORKQUEUE_ADDS_TOTAL,
+    RECONCILE_REQUEUES_TOTAL,
+    WATCH_EVENTS_TOTAL,
+    WATCH_DELIVERIES_TOTAL,
+    SCHED_BINDS_TOTAL,
+    EVENTS_RECORDED_TOTAL,
+    EVENTS_DEDUPED_TOTAL,
+    EVENTS_EVICTED_TOTAL,
 })
 
 GAUGES = frozenset({
@@ -139,6 +161,9 @@ GAUGES = frozenset({
     AUTOSCALE_ACTUAL_REPLICAS,
     KVT_LINK_RATE,
     KVT_DIR_ENTRIES,
+    WORKQUEUE_DEPTH,
+    WORKQUEUE_RETRIES_PENDING,
+    EVENTS_OBJECTS,
 })
 
 HISTOGRAMS = frozenset({
@@ -151,6 +176,9 @@ HISTOGRAMS = frozenset({
     SLO_TPOT_SECONDS,
     PD_LOCK_HOLD_SECONDS,
     KVT_ADMIT_LEAD_SECONDS,
+    WORKQUEUE_QUEUE_AGE_SECONDS,
+    WATCH_DISPATCH_SECONDS,
+    SCHED_FEASIBILITY_SCAN_SECONDS,
 })
 
 ALL_NAMES = COUNTERS | GAUGES | HISTOGRAMS
@@ -247,6 +275,32 @@ HELP = {
     KVT_ADMIT_LEAD_SECONDS:
         "How long before its stream finished a streamed decode row was "
         "admitted (coverage-complete vs stream-close lead)",
+    WORKQUEUE_ADDS_TOTAL:
+        "Keys enqueued into a controller workqueue, per controller",
+    RECONCILE_REQUEUES_TOTAL:
+        "Reconcile keys re-queued, per controller and reason "
+        "(error backoff vs requeue_after revisit)",
+    WATCH_EVENTS_TOTAL: "Store watch events published, per kind and type",
+    WATCH_DELIVERIES_TOTAL:
+        "Watch handler invocations (event fan-out), per kind",
+    SCHED_BINDS_TOTAL: "Pods bound to nodes by the scheduler",
+    EVENTS_RECORDED_TOTAL:
+        "Control-plane events recorded, per type (dedup bumps included)",
+    EVENTS_DEDUPED_TOTAL:
+        "Event records collapsed into an existing record's count",
+    EVENTS_EVICTED_TOTAL:
+        "Event occurrences evicted by the per-object/per-plane bounds",
+    WORKQUEUE_DEPTH: "Ready keys in a controller workqueue, per controller",
+    WORKQUEUE_RETRIES_PENDING:
+        "Keys currently carrying failure backoff, per controller",
+    EVENTS_OBJECTS: "Objects with live event history in the recorder",
+    WORKQUEUE_QUEUE_AGE_SECONDS:
+        "Enqueue-to-dequeue wait of workqueue keys (intentional "
+        "add_after delay excluded), per controller",
+    WATCH_DISPATCH_SECONDS:
+        "Time to deliver one store event to every subscriber, per kind",
+    SCHED_FEASIBILITY_SCAN_SECONDS:
+        "Scheduler feasibility scan (placement plan computation) duration",
 }
 
 # ---- span names (obs/trace.py) ----
@@ -267,6 +321,8 @@ SPAN_PD_KV_HANDOFF = "pd.kv_handoff"
 SPAN_KVT_PUSH = "kvtransfer.push"
 SPAN_KVT_COMMIT = "kvtransfer.commit"
 SPAN_STRESS_REQUEST = "stress.request"
+SPAN_CTRL_EVENT = "controller.event"
+SPAN_CTRL_RECONCILE = "controller.reconcile"
 
 SPANS = frozenset({
     SPAN_HTTP_REQUEST,
@@ -280,4 +336,6 @@ SPANS = frozenset({
     SPAN_KVT_PUSH,
     SPAN_KVT_COMMIT,
     SPAN_STRESS_REQUEST,
+    SPAN_CTRL_EVENT,
+    SPAN_CTRL_RECONCILE,
 })

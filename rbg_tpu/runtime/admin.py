@@ -170,12 +170,99 @@ class _Handler(socketserver.BaseRequestHandler):
             return sample_profile(seconds=min(float(obj.get("seconds", 2.0)),
                                               30.0))
         if op == "events":
-            o = store.get(obj["kind"], ns, obj["name"]) if obj.get("kind") else None
-            return {"events": [
-                {"time": t, "object": ref, "reason": reason, "message": msg}
-                for (t, ref, reason, msg) in store.events_for(o)
-            ][-50:]}
+            return self._events(store, ns, obj)
+        if op == "controlplane":
+            return self._controlplane(store)
         return {"error": f"unknown op {op!r}"}
+
+    def _events(self, store, ns, obj: dict) -> dict:
+        """Structured event timeline (k8s ``kubectl get events`` analog):
+        optional object ref, reason/type filters, a ``since`` horizon in
+        seconds-ago, and a clamped ``limit`` — wire-facing, malformed
+        input degrades to defaults instead of killing the handler."""
+        import time as _time
+        ref = None
+        if obj.get("kind"):
+            # Lookup is by REF, never by live object: events outlive
+            # their object (a crashlooped-and-replaced pod's Warning
+            # history is exactly the post-mortem case).
+            ref = f"{obj['kind']}/{ns}/{obj.get('name', '')}"
+        try:
+            limit = int(obj.get("limit", 100))
+        except (TypeError, ValueError):
+            limit = 100
+        limit = max(1, min(limit, 500))
+        since = None
+        raw_since = obj.get("since")
+        if raw_since is not None:
+            try:
+                since = _time.time() - max(0.0, float(raw_since))
+            except (TypeError, ValueError):
+                since = None
+        reason = obj.get("reason")
+        etype = obj.get("type")
+        recs = store.events_for(
+            ref=ref, reason=str(reason) if reason is not None else None,
+            event_type=str(etype) if etype is not None else None,
+            since=since, limit=limit)
+        return {"events": [r.to_dict() for r in recs],
+                "stats": store.event_stats()}
+
+    def _controlplane(self, store) -> dict:
+        """Control-plane posture: per-controller reconcile totals/latency
+        quantiles, workqueue depth/age, pending retry damping with the
+        most-retried keys, the event-recorder accounting, and windowed
+        rates when the in-process sampler has samples — what ``rbg-tpu
+        top --admin`` renders as the control-plane panel."""
+        from rbg_tpu.obs import names, timeseries
+        from rbg_tpu.obs.metrics import REGISTRY
+        sampler = timeseries.get_sampler()
+
+        def rnd(v, nd=6):
+            return round(v, nd) if v is not None else None
+
+        controllers = []
+        for c in self.server.plane.manager.controllers:
+            st = c.stats()
+            st.update({
+                "reconciles": {
+                    r: REGISTRY.counter(names.RECONCILE_TOTAL,
+                                        controller=c.name, result=r)
+                    for r in ("success", "error")},
+                "reconcile_p50_s": rnd(REGISTRY.quantile(
+                    names.RECONCILE_DURATION_SECONDS, 0.5,
+                    controller=c.name)),
+                "reconcile_p99_s": rnd(REGISTRY.quantile(
+                    names.RECONCILE_DURATION_SECONDS, 0.99,
+                    controller=c.name)),
+                "queue_age_p99_s": rnd(REGISTRY.quantile(
+                    names.WORKQUEUE_QUEUE_AGE_SECONDS, 0.99,
+                    controller=c.name)),
+                "reconcile_per_s": rnd(sampler.rate(
+                    names.RECONCILE_TOTAL, 60.0, controller=c.name), 3),
+            })
+            controllers.append(st)
+        ev_stats = store.event_stats()
+        ev_stats["recorded_total"] = sum(
+            REGISTRY.counter(names.EVENTS_RECORDED_TOTAL, type=t)
+            for t in ("Normal", "Warning"))
+        ev_stats["per_s"] = rnd(sampler.rate(
+            names.EVENTS_RECORDED_TOTAL, 60.0), 3)
+        return {"controlplane": {
+            "controllers": controllers,
+            "events": ev_stats,
+            "watch": {
+                # Dispatch series are per-kind; report each (an unlabeled
+                # quantile would silently miss every series).
+                "dispatch_p99_s": {
+                    k: rnd(REGISTRY.quantile(
+                        names.WATCH_DISPATCH_SECONDS, 0.99, kind=k))
+                    for k in sorted(REGISTRY.label_values(
+                        names.WATCH_DISPATCH_SECONDS, "kind"))},
+                "events_per_s": rnd(sampler.rate(
+                    names.WATCH_EVENTS_TOTAL, 60.0), 3),
+            },
+        }}
 
     # ---- group helpers ----
 

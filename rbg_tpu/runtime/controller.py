@@ -13,15 +13,90 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 import traceback
 from typing import Callable, List, Optional, Tuple
 
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.runtime.queue import ExponentialBackoff
 from rbg_tpu.runtime.store import Event, Store
+from rbg_tpu.utils.locktrace import named_lock
 
 log = logging.getLogger("rbg_tpu.runtime")
 
 ReconcileKey = Tuple[str, str]  # (namespace, name)
+
+
+class InstrumentedWorkQueue:
+    """Transparent workqueue wrapper publishing one controller's queue
+    telemetry: depth gauge, adds counter, and the enqueue→dequeue age
+    histogram. Wraps EITHER implementation (native C++ or the Python
+    ``WorkQueue``) so the metrics never depend on which one is built.
+
+    Age semantics: the stamp for ``add_after`` is the EXPECTED fire time
+    — queue-age measures waiting beyond intent, so a 5 s backoff requeue
+    must not read as a 5 s-deep queue. Dedup keeps the EARLIEST stamp
+    (k8s workqueue convention: age runs from the first unprocessed
+    add)."""
+
+    def __init__(self, inner, controller: str):
+        self._inner = inner
+        self._controller = controller
+        self._lock = named_lock("runtime.ctrlqueue")
+        # item -> expected-ready stamp  # guarded_by[runtime.ctrlqueue]
+        self._stamps: dict = {}
+
+    def _set_depth(self) -> None:
+        REGISTRY.set_gauge(obs_names.WORKQUEUE_DEPTH,
+                           float(len(self._inner)),
+                           controller=self._controller)
+
+    def _stamp(self, item, when: float) -> None:
+        # Keep the EARLIEST expected-ready time: an immediate add for a
+        # key parked in backoff (future stamp) must pull the stamp back
+        # to NOW, or the age of its real backlog wait reads as 0.
+        with self._lock:
+            cur = self._stamps.get(item)
+            if cur is None or when < cur:
+                self._stamps[item] = when
+
+    def add(self, item) -> None:
+        self._stamp(item, time.monotonic())
+        self._inner.add(item)
+        REGISTRY.inc(obs_names.WORKQUEUE_ADDS_TOTAL,
+                     controller=self._controller)
+        self._set_depth()
+
+    def add_after(self, item, delay: float) -> None:
+        self._stamp(item, time.monotonic() + max(0.0, delay))
+        self._inner.add_after(item, delay)
+        REGISTRY.inc(obs_names.WORKQUEUE_ADDS_TOTAL,
+                     controller=self._controller)
+        self._set_depth()
+
+    def get(self, timeout: Optional[float] = None):
+        item = self._inner.get(timeout)
+        if item is not None:
+            with self._lock:
+                stamp = self._stamps.pop(item, None)
+            if stamp is not None:
+                REGISTRY.observe(obs_names.WORKQUEUE_QUEUE_AGE_SECONDS,
+                                 max(0.0, time.monotonic() - stamp),
+                                 controller=self._controller)
+            self._set_depth()
+        return item
+
+    def done(self, item) -> None:
+        # done() may re-queue a dirty item; its stamp was set at that add.
+        self._inner.done(item)
+        self._set_depth()
+
+    def shutdown(self) -> None:
+        self._inner.shutdown()
+
+    def __len__(self) -> int:
+        return len(self._inner)
 
 
 @dataclasses.dataclass
@@ -100,7 +175,8 @@ class Controller:
     def __init__(self, store: Store):
         self.store = store
         from rbg_tpu.native import make_workqueue
-        self.queue = make_workqueue()
+        self.queue = InstrumentedWorkQueue(make_workqueue(),
+                                           controller=self.name)
         # Decorrelated jitter: a slice-wide failure fails every member of
         # the gang at once — synchronized exponential retries would storm
         # the store in waves.
@@ -109,6 +185,11 @@ class Controller:
         self._threads: List[threading.Thread] = []
         self._started = False
         self._stop_event = threading.Event()
+        # Pending watch-event root spans keyed by reconcile key (plain
+        # dict + plain lock — the tracer must never feed back into the
+        # lock-order detector it helps debug).
+        self._event_spans: dict = {}
+        self._event_spans_lock = threading.Lock()
 
     # -- override points --
     def watches(self) -> List[Watch]:
@@ -129,11 +210,38 @@ class Controller:
     def _on_event(self, watch: Watch, ev: Event):
         if watch.predicate is not None and not watch.predicate(ev):
             return
+        from rbg_tpu.obs import trace
+        traced = trace.enabled()
         for key in watch.mapper(ev.object):
+            if traced:
+                self._stamp_event_span(ev, key)
             if watch.delay > 0:
                 self.queue.add_after(key, watch.delay)
             else:
                 self.queue.add(key)
+
+    def _stamp_event_span(self, ev: Event, key: ReconcileKey) -> None:
+        """Root a trace at the watch event so the worker's reconcile span
+        parents off it — event→enqueue→dequeue→reconcile as ONE tree. A
+        newer event for the same key supersedes the pending root (the
+        workqueue dedups them into one reconcile; the superseded trace
+        finalizes as a single-span coalesced record). An event that LOSES
+        the sampling roll still stamps its (falsy) NULL_SPAN: the head
+        decision is made once here — the worker must neither re-roll it
+        nor mislabel a watch-origin reconcile as resync."""
+        from rbg_tpu.obs import trace
+        root = trace.start_trace(
+            obs_names.SPAN_CTRL_EVENT, controller=self.name,
+            kind=ev.object.kind, event=ev.type, key=f"{key[0]}/{key[1]}")
+        with self._event_spans_lock:
+            old = self._event_spans.pop(key, None)
+            self._event_spans[key] = root
+        if old:
+            old.end(outcome="superseded")
+
+    def _take_event_span(self, key: ReconcileKey):
+        with self._event_spans_lock:
+            return self._event_spans.pop(key, None)
 
     def start(self):
         if self._started:
@@ -183,7 +291,7 @@ class Controller:
     def _worker(self):
         import time as _time
 
-        from rbg_tpu.obs import names
+        from rbg_tpu.obs import names, trace
         from rbg_tpu.obs.metrics import REGISTRY
         while True:
             key = self.queue.get()
@@ -193,18 +301,44 @@ class Controller:
                 # post-stop reconciles churn against backends that are
                 # themselves stopping.
                 return
+            # Reconcile span: child of the pending watch-event root when
+            # one exists (event→reconcile as one tree), its own sampled
+            # root for resync/initial-list origins.
+            ev_root = self._take_event_span(key)
+            if ev_root is not None:
+                span = ev_root.child(names.SPAN_CTRL_RECONCILE,
+                                     controller=self.name,
+                                     key=f"{key[0]}/{key[1]}")
+            elif trace.enabled():
+                span = trace.start_trace(names.SPAN_CTRL_RECONCILE,
+                                         controller=self.name,
+                                         key=f"{key[0]}/{key[1]}",
+                                         origin="resync")
+            else:
+                span = trace.NULL_SPAN
             t0 = _time.perf_counter()
+            outcome = "success"
             try:
-                res = self.reconcile(self.store, key)
+                with trace.use_span(span):
+                    res = self.reconcile(self.store, key)
                 self.backoff.forget(key)
                 REGISTRY.inc(names.RECONCILE_TOTAL, controller=self.name,
                              result="success")
-                if res is not None and res.requeue_after is not None:
-                    self.queue.add_after(key, res.requeue_after)
+                requeue_after = (res.requeue_after if res is not None
+                                 else None)
+                if requeue_after is not None:
+                    REGISTRY.inc(names.RECONCILE_REQUEUES_TOTAL,
+                                 controller=self.name,
+                                 reason="requeue_after")
+                    self.queue.add_after(key, requeue_after)
+                span.end(outcome="success", requeue_after=requeue_after)
             except Exception as exc:
+                outcome = "error"
                 delay = self.backoff.next_delay(key)
                 REGISTRY.inc(names.RECONCILE_TOTAL, controller=self.name,
                              result="error")
+                REGISTRY.inc(names.RECONCILE_REQUEUES_TOTAL,
+                             controller=self.name, reason="error")
                 # Conflicts are expected optimistic-concurrency churn (debug);
                 # anything else is a real fault and must be LOUD (warning) —
                 # a silent drop here is how bindings/status vanish (VERDICT
@@ -215,11 +349,37 @@ class Controller:
                     "%s reconcile %s failed (retry in %.3fs):\n%s",
                     self.name, key, delay, traceback.format_exc(),
                 )
+                span.end(outcome="error", error=type(exc).__name__,
+                         retries=self.backoff.retries(key),
+                         retry_in_s=round(delay, 4))
                 self.queue.add_after(key, delay)
             finally:
                 REGISTRY.observe(names.RECONCILE_DURATION_SECONDS,
-                                 _time.perf_counter() - t0, controller=self.name)
+                                 _time.perf_counter() - t0,
+                                 exemplar=(span.trace_id or None),
+                                 controller=self.name)
+                REGISTRY.set_gauge(names.WORKQUEUE_RETRIES_PENDING,
+                                   float(self.backoff.pending_count()),
+                                   controller=self.name)
+                if ev_root is not None:
+                    ev_root.end(outcome=outcome)
                 self.queue.done(key)
+
+    def stats(self) -> dict:
+        """Operator snapshot for the admin ``controlplane`` op: queue
+        depth, pending retry damping, and the most-retried keys (the
+        stuck-key signal the fleet drill asserts on)."""
+        return {
+            "name": self.name,
+            "workers": self.workers,
+            "queue_depth": len(self.queue),
+            "retries_pending": self.backoff.pending_count(),
+            "stuck_keys": [
+                {"key": (f"{k[0]}/{k[1]}" if isinstance(k, tuple)
+                         and len(k) == 2 else str(k)),
+                 "failures": n}
+                for k, n in self.backoff.pending(top=5).items()],
+        }
 
     def stop(self):
         self._stop_event.set()
@@ -230,6 +390,13 @@ class Controller:
         for t in self._threads:
             t.join(timeout=2.0)
         self._threads = [t for t in self._threads if t.is_alive()]
+        # End pending watch-event roots so a stopped plane's undelivered
+        # events don't sit in the sink until leak-eviction.
+        with self._event_spans_lock:
+            pending = list(self._event_spans.values())
+            self._event_spans.clear()
+        for sp in pending:
+            sp.end(outcome="shutdown")
 
 
 class Manager:

@@ -45,7 +45,7 @@ from rbg_tpu.api.meta import Condition
 from rbg_tpu.obs import names
 from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.runtime.controller import Controller, Result, Watch
-from rbg_tpu.runtime.store import Conflict, NotFound, Store
+from rbg_tpu.runtime.store import EVENT_WARNING, Conflict, NotFound, Store
 
 # Internal ack markers (idempotent metric counting across reconciles).
 _ANN_NOTICE_ACKED = f"{C.DOMAIN}/disruption-notice-acked"
@@ -400,7 +400,8 @@ class DisruptionController(Controller):
                 store.record_event(
                     inst, "GangPreempted",
                     f"slice {sid} lost hosts; killed {killed} survivor "
-                    f"pod(s) — recovering the gang whole")
+                    f"pod(s) — recovering the gang whole",
+                    type_=EVENT_WARNING)
             # Bind-time recovery: grant a warm spare so the restart
             # machinery recreates straight onto reserved capacity. Any
             # in-flight MAINTENANCE migration of this instance is
@@ -443,7 +444,8 @@ class DisruptionController(Controller):
             return
         store.delete("Warmup", ns, self._warmup_name(inst))
         store.record_event(inst, "MigrationAborted",
-                           f"in-flight migration dropped: {reason}")
+                           f"in-flight migration dropped: {reason}",
+                           type_=EVENT_WARNING)
 
     def _ack_gang_kill(self, store, inst, sid) -> bool:
         """Stamp the instance's gang-kill ack for this slice incident;

@@ -23,7 +23,7 @@ from rbg_tpu.coordination.dependency import dependencies_ready, sort_roles
 from rbg_tpu.runtime.controller import (
     Controller, Result, Watch, own_keys, owner_keys,
 )
-from rbg_tpu.runtime.store import AlreadyExists, Conflict, NotFound, Store
+from rbg_tpu.runtime.store import EVENT_WARNING, AlreadyExists, Conflict, NotFound, Store
 from rbg_tpu.utils import spec_hash
 
 REVISION_HISTORY_LIMIT = 10
@@ -91,7 +91,8 @@ class RoleBasedGroupController(Controller):
                     raise ValidationError(e.args[0])
                 backend.validate(store, rbg, role)
         except ValidationError as e:
-            store.record_event(rbg, "ValidationFailed", str(e))
+            store.record_event(rbg, "ValidationFailed", str(e),
+                               type_=EVENT_WARNING)
             self._set_group_condition(store, rbg, False, "ValidationFailed", str(e))
             return None
 
@@ -138,7 +139,8 @@ class RoleBasedGroupController(Controller):
             logging.getLogger("rbg_tpu.runtime").warning(
                 "topology configmap for %s/%s failed: %s",
                 ns, name, e, exc_info=True)
-            store.record_event(rbg, "DiscoveryConfigFailed", str(e))
+            store.record_event(rbg, "DiscoveryConfigFailed", str(e),
+                               type_=EVENT_WARNING)
 
         # 7. roles in dependency order
         levels = sort_roles(rbg.spec.roles)
@@ -319,7 +321,8 @@ class RoleBasedGroupController(Controller):
         tmpl = store.get("RoleTemplate", rbg.metadata.namespace, role.template_ref)
         if tmpl is None:
             store.record_event(rbg, "MissingRoleTemplate",
-                               f"role {role.name}: RoleTemplate {role.template_ref} not found")
+                               f"role {role.name}: RoleTemplate {role.template_ref} not found",
+                               type_=EVENT_WARNING)
             return role
         role = copy.deepcopy(role)
         if not role.template.containers:

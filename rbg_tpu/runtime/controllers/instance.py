@@ -26,7 +26,8 @@ from rbg_tpu.api.policy import PodGroup, PodGroupSpec
 from rbg_tpu.runtime.controller import (
     Controller, Result, Watch, own_keys, owner_keys,
 )
-from rbg_tpu.runtime.store import AlreadyExists, Store
+from rbg_tpu.runtime.store import EVENT_WARNING, AlreadyExists, Store
+
 
 def desired_pods(inst: RoleInstance) -> List[Tuple[str, str, int, int, object]]:
     """[(pod_name, component, component_id, component_index, template)].
@@ -190,7 +191,8 @@ class RoleInstanceController(Controller):
                     inst, "ReplacingFailedPod",
                     f"pod {p.metadata.name} inactive "
                     f"({p.inactive_reason or 'Failed'}); deleting so the "
-                    f"fixed-name replacement can be created")
+                    f"fixed-name replacement can be created",
+                    type_=EVENT_WARNING)
                 store.delete("Pod", ns, p.metadata.name)
         # Replace Succeeded pods only under policy None (legacy behavior for
         # run-to-completion mains that should restart).
@@ -330,7 +332,8 @@ class RoleInstanceController(Controller):
 
         store.mutate("RoleInstance", ns, name, fn, status=True)
         store.record_event(inst, "Restarting",
-                           f"recreating pod gang (restart #{n + 1})")
+                           f"recreating pod gang (restart #{n + 1})",
+                           type_=EVENT_WARNING)
         for p in pods:
             if p.metadata.deletion_timestamp is None:
                 store.delete("Pod", ns, p.metadata.name, grace=True)

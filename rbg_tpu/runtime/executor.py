@@ -28,7 +28,7 @@ import time
 from typing import Dict, Optional
 
 from rbg_tpu.api import constants as C
-from rbg_tpu.runtime.store import Event, Store
+from rbg_tpu.runtime.store import EVENT_WARNING, Event, Store
 from rbg_tpu.utils.locktrace import named_lock
 from rbg_tpu.utils.racetrace import guard as _race_guard
 
@@ -176,7 +176,8 @@ class LocalExecutor:
                         proc.kill()
                 self._set_status(key, "Failed", ready=False)
         except Exception as e:
-            self.store.record_event(pod, "LaunchFailed", str(e))
+            self.store.record_event(pod, "LaunchFailed", str(e),
+                                    type_=EVENT_WARNING)
             self._set_status(key, "Failed", ready=False)
 
     def _write_topology(self, env, pod):

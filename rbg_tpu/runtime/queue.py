@@ -79,6 +79,22 @@ class ExponentialBackoff:
         with self._lock:
             return self._failures.get(item, 0)
 
+    def pending_count(self) -> int:
+        """Items currently carrying failure backoff (not yet forgotten) —
+        the per-controller retries-pending gauge."""
+        with self._lock:
+            return len(self._failures)
+
+    def pending(self, top: int = 0) -> dict:
+        """Snapshot of item -> consecutive-failure count, most-failed
+        first; ``top`` truncates (0 = all). The admin ``controlplane`` op
+        and the fleet drill's no-stuck-keys invariant read this."""
+        with self._lock:
+            items = sorted(self._failures.items(), key=lambda kv: -kv[1])
+        if top > 0:
+            items = items[:top]
+        return dict(items)
+
 
 @_race_guard
 class WorkQueue:

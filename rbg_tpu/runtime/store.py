@@ -20,10 +20,12 @@ import copy
 import threading
 import time
 import uuid
-from collections import defaultdict
+from collections import OrderedDict, defaultdict
 from typing import Callable, Dict, List, Optional, Tuple
 
 from rbg_tpu.api import serde
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.utils.locktrace import named_rlock
 from rbg_tpu.utils.racetrace import guard as _race_guard
 from rbg_tpu.api.constants import (
@@ -31,6 +33,47 @@ from rbg_tpu.api.constants import (
 )
 
 Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+
+EVENT_NORMAL = "Normal"
+EVENT_WARNING = "Warning"
+
+
+class EventRecord(tuple):
+    """One recorded control-plane event. Tuple-compatible with the legacy
+    flat log — ``(time, object_ref, reason, message)`` unpacks and indexes
+    exactly as before — with the k8s-recorder structure as attributes:
+    ``type`` (Normal/Warning), ``count`` (dedup of repeated reasons), and
+    ``first_time`` (the first occurrence this record aggregates)."""
+
+    def __new__(cls, ts, ref, reason, message, type_=EVENT_NORMAL,
+                count=1, first_ts=None):
+        self = tuple.__new__(cls, (ts, ref, reason, message))
+        self.type = type_
+        self.count = count
+        self.first_time = first_ts if first_ts is not None else ts
+        return self
+
+    @property
+    def time(self):
+        return self[0]
+
+    @property
+    def object_ref(self):
+        return self[1]
+
+    @property
+    def reason(self):
+        return self[2]
+
+    @property
+    def message(self):
+        return self[3]
+
+    def to_dict(self) -> dict:
+        return {"time": self[0], "object": self[1], "type": self.type,
+                "reason": self[2], "message": self[3], "count": self.count,
+                "first_time": self.first_time}
 
 
 class Conflict(Exception):
@@ -84,8 +127,10 @@ class Store:
         self._uids: set = set()
         # kind -> write counter  # guarded_by[runtime.store]
         self._kind_version: Dict[str, int] = {}
-        # (ts, kind/ns/name, reason, msg)  # guarded_by[runtime.store]
-        self._events_log: List[tuple] = []
+        # Structured event recorder: ref -> OrderedDict keyed by
+        # (type, reason, message) -> mutable record dict, LRU at both
+        # levels (see record_event)  # guarded_by[runtime.store]
+        self._events: "OrderedDict[str, OrderedDict]" = OrderedDict()
 
     # ---- helpers ----
 
@@ -159,12 +204,23 @@ class Store:
         # so a handler holding this reference observes a frozen snapshot.
         # Handlers MUST treat event objects as read-only; per-watcher
         # deepcopies of every pod event dominated burst throughput.
+        kind = ev.object.kind
+        REGISTRY.inc(obs_names.WATCH_EVENTS_TOTAL, kind=kind, type=ev.type)
+        if subs:
+            REGISTRY.inc(obs_names.WATCH_DELIVERIES_TOTAL, float(len(subs)),
+                         kind=kind)
+        t0 = time.perf_counter()
         for fn in subs:
             try:
                 fn(ev)
             except Exception:  # watcher bugs must not poison the store
                 import traceback
                 traceback.print_exc()
+        # Delivery lag: synchronous fan-out means every subscriber's
+        # handler time lands between the write and the NEXT write on this
+        # thread — the curve the watch/informer refactor must bend.
+        REGISTRY.observe(obs_names.WATCH_DISPATCH_SECONDS,
+                         time.perf_counter() - t0, kind=kind)
 
     # ---- watch ----
 
@@ -454,21 +510,115 @@ class Store:
 
     # ---- event recorder (k8s Events equivalent) ----
 
-    def record_event(self, obj, reason: str, message: str):
-        with self._lock:
-            self._events_log.append(
-                (time.time(), f"{obj.kind}/{obj.metadata.namespace}/{obj.metadata.name}",
-                 reason, message)
-            )
-            if len(self._events_log) > 2000:
-                del self._events_log[:1000]
+    # Retention bounds. Per-object: a chatty controller repeating reasons
+    # against one object can never evict another object's history (the
+    # old flat log's 2000→1000 truncation did exactly that). Per-plane:
+    # the ref LRU bounds total memory under unbounded object churn.
+    MAX_EVENTS_PER_OBJECT = 64
+    MAX_EVENT_OBJECTS = 4096
 
-    def events_for(self, obj=None) -> list:
+    @staticmethod
+    def _event_ref(obj) -> str:
+        return f"{obj.kind}/{obj.metadata.namespace}/{obj.metadata.name}"
+
+    def record_event(self, obj, reason: str, message: str,
+                     type_: str = EVENT_NORMAL):
+        """K8s-style recorder: events carry a type (Normal/Warning) and a
+        reason, index by object ref, and count-dedup — re-recording the
+        same (type, reason, message) against the same object bumps the
+        existing record's count/last-time instead of appending."""
+        ref = self._event_ref(obj)
+        now = time.time()
+        dedup_key = (type_, reason, message)
+        deduped = evicted = 0
         with self._lock:
-            if obj is None:
-                return list(self._events_log)
-            ref = f"{obj.kind}/{obj.metadata.namespace}/{obj.metadata.name}"
-            return [e for e in self._events_log if e[1] == ref]
+            bucket = self._events.get(ref)
+            if bucket is None:
+                bucket = self._events[ref] = OrderedDict()
+            else:
+                self._events.move_to_end(ref)
+            rec = bucket.get(dedup_key)
+            if rec is not None:
+                rec["count"] += 1
+                rec["ts"] = now
+                bucket.move_to_end(dedup_key)
+                deduped = 1
+            else:
+                bucket[dedup_key] = {"ts": now, "first_ts": now, "count": 1,
+                                     "type": type_, "reason": reason,
+                                     "message": message}
+                if len(bucket) > self.MAX_EVENTS_PER_OBJECT:
+                    _, old = bucket.popitem(last=False)
+                    evicted += old["count"]
+            if len(self._events) > self.MAX_EVENT_OBJECTS:
+                _, old_bucket = self._events.popitem(last=False)
+                evicted += sum(r["count"] for r in old_bucket.values())
+            # Publish INSIDE the lock (the registry lock is a plain leaf,
+            # no ordering hazard): two concurrent recorders could
+            # otherwise commit the objects gauge out of order and park a
+            # stale value, and a live reader could see recorded/evicted
+            # counters that don't yet reconcile (the events_accounted
+            # contract) — the same race the PR-8 pool gauges fixed.
+            REGISTRY.inc(obs_names.EVENTS_RECORDED_TOTAL, type=type_)
+            if deduped:
+                REGISTRY.inc(obs_names.EVENTS_DEDUPED_TOTAL)
+            if evicted:
+                REGISTRY.inc(obs_names.EVENTS_EVICTED_TOTAL, float(evicted))
+            REGISTRY.set_gauge(obs_names.EVENTS_OBJECTS,
+                               float(len(self._events)))
+
+    def events_for(self, obj=None, reason: Optional[str] = None,
+                   event_type: Optional[str] = None,
+                   since: Optional[float] = None,
+                   limit: Optional[int] = None,
+                   ref: Optional[str] = None) -> List[EventRecord]:
+        """Structured event timeline, oldest-first by last occurrence.
+        ``obj`` (or a raw ``ref`` string — events outlive their object,
+        the post-mortem case) narrows to one object's bucket (O(1) index
+        lookup, not a scan); ``reason``/``event_type`` filter exactly;
+        ``since`` is an absolute ``time.time()`` lower bound; ``limit``
+        keeps the NEWEST records. Records are tuple-compatible with the
+        legacy flat log. Filtering happens in the single pass under the
+        lock — only matching records are materialized (records are
+        mutated in place by dedup, so reading them outside the lock
+        would tear)."""
+        out = []
+        with self._lock:
+            if obj is not None:
+                ref = self._event_ref(obj)
+            if ref is not None:
+                items = [(ref, self._events.get(ref) or {})]
+            else:
+                items = self._events.items()
+            for r, bucket in items:
+                for rec in bucket.values():
+                    if reason is not None and rec["reason"] != reason:
+                        continue
+                    if event_type is not None and rec["type"] != event_type:
+                        continue
+                    if since is not None and rec["ts"] < since:
+                        continue
+                    out.append(EventRecord(
+                        rec["ts"], r, rec["reason"], rec["message"],
+                        type_=rec["type"], count=rec["count"],
+                        first_ts=rec["first_ts"]))
+        out.sort(key=lambda e: e[0])
+        if limit is not None and limit > 0:
+            out = out[-limit:]
+        return out
+
+    def event_stats(self) -> dict:
+        """Recorder accounting: objects tracked, live records, and the
+        total occurrence count they carry (with the evicted counter this
+        reconciles against ``rbg_events_recorded_total`` — the fleet
+        drill's ``events_accounted`` invariant)."""
+        with self._lock:
+            objects = len(self._events)
+            records = sum(len(b) for b in self._events.values())
+            total = sum(r["count"] for b in self._events.values()
+                        for r in b.values())
+        return {"objects": objects, "records": records,
+                "total_count": total}
 
 
 # ---- registered snapshot migrations (rbg_tpu/api/conversions.py) ----

@@ -21,11 +21,14 @@ ICI > DCN ladder over slice topology:
 from __future__ import annotations
 
 import collections
+import time
 from typing import Dict, List, Optional, Tuple
 
 from rbg_tpu.api import constants as C
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.runtime.controller import Controller, Result, Watch
-from rbg_tpu.runtime.store import NotFound, Store
+from rbg_tpu.runtime.store import EVENT_WARNING, NotFound, Store
 
 
 def _unscheduled(ev) -> bool:
@@ -111,7 +114,8 @@ class SchedulerController(Controller):
             return self._schedule_gang(store, ns, group)
         plan = self._place(store, [pod])
         if plan is None:
-            store.record_event(pod, "FailedScheduling", "no feasible node")
+            store.record_event(pod, "FailedScheduling", "no feasible node",
+                               type_=EVENT_WARNING)
             return Result(requeue_after=0.2)
         self._bind(store, plan)
         return None
@@ -133,7 +137,8 @@ class SchedulerController(Controller):
         if plan is None:
             if pods:
                 store.record_event(pods[0], "FailedGangScheduling",
-                                   f"group {group}: cannot place {len(unbound)} pods atomically")
+                                   f"group {group}: cannot place {len(unbound)} pods atomically",
+                                   type_=EVENT_WARNING)
             return Result(requeue_after=0.3)
         self._bind(store, plan)
         self._mark_pg(store, ns, group, pods)
@@ -169,6 +174,17 @@ class SchedulerController(Controller):
         All aggregates come from the incremental CapacityCache (O(nodes)
         per plan) — the old per-decision full pod rescan made create bursts
         scheduler-backlog-bound (VERDICT r1 item 6)."""
+        t0 = time.perf_counter()
+        try:
+            return self._place_inner(store, pods)
+        finally:
+            # The feasibility-scan curve: O(nodes) per plan today; the
+            # topology-sharded scan refactor will be judged against it.
+            REGISTRY.observe(obs_names.SCHED_FEASIBILITY_SCAN_SECONDS,
+                             time.perf_counter() - t0)
+
+    def _place_inner(self, store: Store,
+                     pods: List) -> Optional[Dict[Tuple[str, str], str]]:
         nodes = self.cap.ready_nodes()
         if not nodes:
             return None
@@ -389,3 +405,4 @@ class SchedulerController(Controller):
             # must not see the capacity as still free.
             if obj is not None and obj.node_name:
                 self.cap.apply_bind(obj)
+                REGISTRY.inc(obs_names.SCHED_BINDS_TOTAL)

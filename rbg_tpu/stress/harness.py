@@ -215,6 +215,334 @@ def _run(cfg: StressConfig, plane: ControlPlane) -> dict:
     return report
 
 
+# ---- 10k-node fleet control-plane scenario ---------------------------------
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Control-plane scale drill: O(1k–10k) simulated nodes and a group
+    churn wave (create → image update → delete) against a live plane,
+    publishing the per-controller reconcile-latency and scheduler-
+    throughput curves the future watch/informer refactor will be judged
+    against. Invariants:
+
+    * ``workqueue_drained`` — after churn stops, every controller
+      workqueue reaches empty (no self-sustaining reconcile storm);
+    * ``no_stuck_keys`` — no key is parked in failure backoff at or past
+      the stuck threshold when the drill ends;
+    * ``reconcile_p99_bound`` — every controller's reconcile p99 stays
+      under the bound;
+    * ``events_accounted`` — the structured event recorder accounts for
+      every recorded occurrence (live counts + evictions == recorded).
+    """
+
+    nodes: int = 5000
+    hosts_per_slice: int = 4
+    groups: int = 150
+    roles_per_group: int = 2
+    replicas: int = 2
+    create_qps: float = 100.0
+    update_fraction: float = 0.25    # groups image-updated mid-run
+    delete_fraction: float = 0.25    # groups deleted mid-run (from the end)
+    reconcile_p99_bound_s: float = 2.5
+    stuck_failures_threshold: int = 5
+    drain_timeout_s: float = 90.0
+    timeout_s: float = 300.0
+    sample_interval_s: float = 0.5   # throughput-curve sampling period
+    # Head-sampling rate for the reconcile traces the exemplars link to
+    # (the drill arms tracing itself; 1.0 would trace every reconcile of
+    # a 10k-pod run — the sink only keeps the slowest anyway).
+    trace_sample: float = 0.05
+
+
+FLEET_PERCENTILES = (0.50, 0.90, 0.95, 0.99)
+
+
+def _reconciles_total(controller_names) -> float:
+    return sum(
+        REGISTRY.counter(metric_names.RECONCILE_TOTAL, controller=c,
+                         result=r)
+        for c in controller_names for r in ("success", "error"))
+
+
+def _fleet_curve_sampler(plane, stop, out: List[dict], interval_s: float):
+    """Background sampler turning cumulative counters into the drill's
+    throughput curve: scheduler binds/s, reconciles/s, events/s, and the
+    summed workqueue depth, per tick. Controller names come from the
+    LIVE plane registration, never a parallel hard-coded list — a newly
+    registered controller must not be invisible to the curve."""
+    t0 = time.perf_counter()
+    names = [c.name for c in plane.manager.controllers]
+
+    def totals():
+        ev = sum(REGISTRY.counter(metric_names.EVENTS_RECORDED_TOTAL, type=t)
+                 for t in ("Normal", "Warning"))
+        return (REGISTRY.counter(metric_names.SCHED_BINDS_TOTAL),
+                _reconciles_total(names), ev)
+
+    prev_t, prev = 0.0, totals()
+    while not stop.wait(interval_s):
+        now = time.perf_counter() - t0
+        cur = totals()
+        dt = max(1e-6, now - prev_t)
+        out.append({
+            "t": round(now, 3),
+            "binds_per_s": round((cur[0] - prev[0]) / dt, 2),
+            "reconciles_per_s": round((cur[1] - prev[1]) / dt, 2),
+            "events_per_s": round((cur[2] - prev[2]) / dt, 2),
+            "queue_depth": sum(len(c.queue)
+                               for c in plane.manager.controllers),
+        })
+        prev_t, prev = now, cur
+
+
+def run_fleet(cfg: FleetConfig) -> dict:
+    import math
+    import threading
+
+    from rbg_tpu.obs import trace
+
+    slices = max(1, math.ceil(cfg.nodes / cfg.hosts_per_slice))
+    plane = ControlPlane(backend="fake")
+    # Nodes land BEFORE controllers start (no watchers yet): node
+    # bring-up is fleet bootstrap, not the churn under measurement.
+    make_tpu_nodes(plane.store, slices=slices,
+                   hosts_per_slice=cfg.hosts_per_slice)
+    n_nodes = slices * cfg.hosts_per_slice
+    REGISTRY.reset()
+    # Arm tracing for this run so reconcile-duration exemplars name the
+    # slowest reconcile per controller (restored on exit).
+    was_enabled, old_sample = trace.enabled(), trace._CFG.sample
+    trace.configure(enabled=True, sample=cfg.trace_sample)
+    trace.SINK.reset()
+
+    ctrl_names = [c.name for c in plane.manager.controllers]
+    names = [f"fleet-{i}" for i in range(cfg.groups)]
+    n_update = int(cfg.groups * cfg.update_fraction)
+    n_delete = int(cfg.groups * cfg.delete_fraction)
+    deleted = set(names[cfg.groups - n_delete:]) if n_delete else set()
+    curve: List[dict] = []
+    stop_sampler = threading.Event()
+    inv: Dict[str, bool] = {}
+    phases: Dict[str, object] = {}
+    pods_peak = 0
+    t_run = time.perf_counter()
+
+    def ready(name) -> bool:
+        g = plane.store.get("RoleBasedGroup", "default", name, copy_=False)
+        if g is None:
+            return False
+        c = get_condition(g.status.conditions, C.COND_READY)
+        return c is not None and c.status == "True"
+
+    plane.start()
+    sampler = threading.Thread(
+        target=_fleet_curve_sampler,
+        args=(plane, stop_sampler, curve, cfg.sample_interval_s),
+        daemon=True)
+    sampler.start()
+    try:
+        # --- create wave ---
+        interval = 1.0 / cfg.create_qps if cfg.create_qps > 0 else 0.0
+        t0 = time.perf_counter()
+        for name in names:
+            roles = [simple_role(f"role{j}", replicas=cfg.replicas)
+                     for j in range(cfg.roles_per_group)]
+            plane.apply(make_group(name, *roles))
+            if interval:
+                time.sleep(interval)
+        phases["create_s"] = round(time.perf_counter() - t0, 3)
+        for name in names:
+            plane.wait_for(lambda n=name: ready(n), timeout=cfg.timeout_s,
+                           desc=f"{name} ready")
+        phases["all_ready_s"] = round(time.perf_counter() - t0, 3)
+        inv["all_groups_ready"] = True
+
+        def group_pods(name):
+            return plane.store.list("Pod", namespace="default",
+                                    selector={C.LABEL_GROUP_NAME: name},
+                                    copy_=False)
+
+        pods_peak = max(pods_peak,
+                        sum(len(group_pods(n)) for n in names))
+
+        # --- churn wave: image update on a slice of the fleet ---
+        t0 = time.perf_counter()
+        for name in names[:n_update]:
+            g = plane.store.get("RoleBasedGroup", "default", name)
+            for r in g.spec.roles:
+                r.template.containers[0].image = "engine:v2"
+            plane.store.update(g)
+        for name in names[:n_update]:
+            def converged(n=name):
+                pods = group_pods(n)
+                return pods and all(
+                    p.template.containers[0].image == "engine:v2"
+                    and p.running_ready for p in pods if p.active
+                ) and ready(n)
+            plane.wait_for(converged, timeout=cfg.timeout_s,
+                           desc=f"{name} updated")
+        phases["update_s"] = round(time.perf_counter() - t0, 3)
+
+        # --- churn wave: deletes ---
+        t0 = time.perf_counter()
+        for name in deleted:
+            plane.store.delete("RoleBasedGroup", "default", name)
+        for name in deleted:
+            plane.wait_for(lambda n=name: not group_pods(n),
+                           timeout=cfg.timeout_s, desc=f"{name} gone")
+        phases["delete_s"] = round(time.perf_counter() - t0, 3)
+
+        # --- drain: every workqueue must reach empty and STAY there ---
+        t0 = time.perf_counter()
+
+        def reconciles_now() -> float:
+            return _reconciles_total(ctrl_names)
+
+        def drained() -> bool:
+            return sum(len(c.queue)
+                       for c in plane.manager.controllers) == 0
+
+        # "Drained" = ready queues empty AND no reconcile ran for a full
+        # stability window. len(queue) alone counts only READY items — a
+        # key ping-ponging through requeue_after/backoff delays would
+        # read as an empty queue at nearly every poll while the plane
+        # churns forever; the reconcile-counter delta catches it.
+        stable_since = [None]
+        stable_base = [0.0]
+
+        def drained_stable() -> bool:
+            if not drained():
+                stable_since[0] = None
+                return False
+            total = reconciles_now()
+            if stable_since[0] is None or total != stable_base[0]:
+                stable_since[0] = time.monotonic()
+                stable_base[0] = total
+                return False
+            return time.monotonic() - stable_since[0] >= 1.0
+
+        try:
+            plane.wait_for(drained_stable, timeout=cfg.drain_timeout_s,
+                           interval=0.05, desc="workqueues drained")
+            inv["workqueue_drained"] = True
+        except TimeoutError:
+            inv["workqueue_drained"] = False
+        phases["drain_s"] = round(time.perf_counter() - t0, 3)
+
+        controller_stats = [c.stats() for c in plane.manager.controllers]
+    except TimeoutError as e:
+        inv.setdefault("all_groups_ready", False)
+        inv.setdefault("workqueue_drained", False)
+        controller_stats = [c.stats() for c in plane.manager.controllers]
+        # pods_peak keeps whatever was measured before the timeout — a
+        # create-then-update-timeout report must not claim zero pods.
+        phases["timeout"] = str(e)
+    finally:
+        stop_sampler.set()
+        sampler.join(timeout=5.0)
+        plane.stop()
+        trace.configure(enabled=was_enabled, sample=old_sample)
+
+    # --- per-controller reconcile-latency percentile curves ---
+    latency: Dict[str, dict] = {}
+    for c in ctrl_names:
+        st = REGISTRY.hist_stats(metric_names.RECONCILE_DURATION_SECONDS,
+                                 controller=c)
+        if not st or not st["count"]:
+            continue
+        pts = [
+            {"pct": int(p * 100),
+             "ms": round((REGISTRY.quantile(
+                 metric_names.RECONCILE_DURATION_SECONDS, p,
+                 controller=c) or 0.0) * 1000, 3)}
+            for p in FLEET_PERCENTILES]
+        qa = REGISTRY.quantile(metric_names.WORKQUEUE_QUEUE_AGE_SECONDS,
+                               0.99, controller=c)
+        latency[c] = {
+            "n": st["count"], "max_ms": round(st["max"] * 1000, 3),
+            "curve": pts,
+            "queue_age_p99_ms": (round(qa * 1000, 3)
+                                 if qa is not None else None),
+        }
+    inv["reconcile_latency_curves"] = bool(latency)
+    inv["reconcile_p99_bound"] = all(
+        next(p["ms"] for p in v["curve"] if p["pct"] == 99) / 1000.0
+        <= cfg.reconcile_p99_bound_s for v in latency.values()
+    ) if latency else False
+
+    # --- stuck keys ---
+    stuck = [
+        {"controller": st["name"], **sk}
+        for st in controller_stats for sk in st["stuck_keys"]
+        if sk["failures"] >= cfg.stuck_failures_threshold]
+    inv["no_stuck_keys"] = not stuck
+
+    # --- event-plane accounting (registry was reset at drill start) ---
+    ev_stats = plane.store.event_stats()
+    recorded = sum(REGISTRY.counter(metric_names.EVENTS_RECORDED_TOTAL,
+                                    type=t) for t in ("Normal", "Warning"))
+    evicted = REGISTRY.counter(metric_names.EVENTS_EVICTED_TOTAL)
+    inv["events_accounted"] = (recorded
+                               == ev_stats["total_count"] + evicted)
+
+    # --- scheduler throughput + feasibility scans ---
+    scan = REGISTRY.hist_stats(
+        metric_names.SCHED_FEASIBILITY_SCAN_SECONDS) or {}
+    sched = {
+        "binds_total": REGISTRY.counter(metric_names.SCHED_BINDS_TOTAL),
+        "peak_binds_per_s": max((c["binds_per_s"] for c in curve),
+                                default=0.0),
+        "feasibility_scans": scan.get("count", 0),
+        "scan_p50_ms": round((REGISTRY.quantile(
+            metric_names.SCHED_FEASIBILITY_SCAN_SECONDS, 0.5) or 0.0)
+            * 1000, 3),
+        "scan_p99_ms": round((REGISTRY.quantile(
+            metric_names.SCHED_FEASIBILITY_SCAN_SECONDS, 0.99) or 0.0)
+            * 1000, 3),
+    }
+    inv["scheduler_throughput_curve"] = any(
+        c["binds_per_s"] > 0 for c in curve)
+
+    # --- slowest reconcile per controller (exemplar → waterfall) ---
+    slowest_by_controller = {}
+    for c in ctrl_names:
+        ex = REGISTRY.exemplars(metric_names.RECONCILE_DURATION_SECONDS,
+                                controller=c)
+        if not ex:
+            continue
+        worst = max(ex.values(), key=lambda e: e["value"])
+        slowest_by_controller[c] = {
+            "duration_ms": round(worst["value"] * 1000, 3),
+            "trace_id": worst["trace_id"]}
+    from rbg_tpu.obs import trace as _trace
+    slow_recs = [r for r in _trace.SINK.slowest(16)
+                 if r["root"].startswith("controller.")]
+    waterfall = _trace.waterfall(slow_recs[0]) if slow_recs else []
+
+    return {
+        "scenario": "fleet",
+        "config": dataclasses.asdict(cfg),
+        "elapsed_s": round(time.perf_counter() - t_run, 3),
+        "fleet": {"nodes": n_nodes, "slices": slices,
+                  "groups": cfg.groups, "pods_peak": pods_peak,
+                  "updated": n_update, "deleted": n_delete},
+        "phases": phases,
+        "reconcile_latency": latency,
+        "scheduler": sched,
+        "throughput_curve": curve,
+        "workqueues": controller_stats,
+        "stuck_keys": stuck,
+        "events": {**ev_stats, "recorded_total": recorded,
+                   "deduped_total": REGISTRY.counter(
+                       metric_names.EVENTS_DEDUPED_TOTAL),
+                   "evicted_total": evicted},
+        "slowest_reconcile_by_controller": slowest_by_controller,
+        "slowest_reconcile_waterfall": waterfall,
+        "invariants": inv,
+    }
+
+
 # ---- serving-plane overload scenario ---------------------------------------
 
 
@@ -1245,7 +1573,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="rbg-tpu-stress")
     ap.add_argument("--scenario", default="churn",
                     choices=["churn", "overload", "preemption", "autoscale",
-                             "kvstream"],
+                             "kvstream", "fleet"],
                     help="churn = control-plane create/update/delete "
                          "percentiles; overload = serving-plane admission "
                          "control drill (sheds, deadlines, queue bound); "
@@ -1256,7 +1584,11 @@ def main(argv=None) -> int:
                          "autoscaler closing the signal→capacity loop); "
                          "kvstream = KV transfer-plane drill (chunked "
                          "PD streaming over a slow/lossy link: overlap, "
-                         "directory consistency, zero dropped streams)")
+                         "directory consistency, zero dropped streams); "
+                         "fleet = 10k-node control-plane scale drill "
+                         "(group churn at fleet scale: reconcile-latency "
+                         "and scheduler-throughput curves, workqueue-"
+                         "drains, stuck keys, event accounting)")
     ap.add_argument("--clients", type=int, default=6)
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--max-queue", type=int, default=4)
@@ -1285,9 +1617,15 @@ def main(argv=None) -> int:
     ap.add_argument("--notice-s", type=float, default=25.0,
                     help="maintenance notice window before the deadline "
                          "(preemption scenario)")
+    ap.add_argument("--nodes", type=int, default=None,
+                    help="simulated fleet size for --scenario fleet "
+                         "(default 5000; the acceptance drill runs >=5k)")
+    ap.add_argument("--reconcile-p99-bound-s", type=float, default=2.5,
+                    help="reconcile p99 bound the fleet drill asserts "
+                         "per controller")
     ap.add_argument("--groups", type=int, default=None,
                     help="groups to create (default: 10 for churn, "
-                         "2 for preemption)")
+                         "2 for preemption, 150 for fleet)")
     ap.add_argument("--roles", type=int, default=2)
     ap.add_argument("--replicas", type=int, default=2)
     ap.add_argument("--qps", type=float, default=5.0)
@@ -1363,8 +1701,20 @@ def main(argv=None) -> int:
             r: REGISTRY.counter(metric_names.TRACE_TRACES_TOTAL, result=r)
             for r in ("complete", "incomplete", "leaked")}
     load1 = os.getloadavg()[0]
-    if args.scenario in ("overload", "preemption", "autoscale", "kvstream"):
-        if args.scenario == "overload":
+    if args.scenario in ("overload", "preemption", "autoscale", "kvstream",
+                         "fleet"):
+        if args.scenario == "fleet":
+            # Scenario-aware rate default: the churn scenarios' 5 qps
+            # would spend 30 s just CREATING a 150-group fleet wave.
+            qps = args.qps if args.qps != ap.get_default("qps") else 100.0
+            report = run_fleet(FleetConfig(
+                nodes=args.nodes or 5000,
+                groups=args.groups or 150,
+                roles_per_group=args.roles, replicas=args.replicas,
+                create_qps=qps, hosts_per_slice=args.hosts or 4,
+                reconcile_p99_bound_s=args.reconcile_p99_bound_s,
+                timeout_s=max(args.timeout_s, 120.0)))
+        elif args.scenario == "overload":
             report = run_serving_overload(OverloadConfig(
                 clients=args.clients, requests_per_client=args.requests,
                 max_queue=args.max_queue, max_batch=args.max_batch,
@@ -1764,6 +2114,157 @@ def _kvstream_sections(report: dict) -> str:
 <h2>invariants</h2>{_invariants_table(report.get("invariants") or {})}"""
 
 
+_FLEET_COLORS = ("#2a78d6", "#eb6834", "#1baf7a", "#eda100", "#8a4fd3",
+                 "#c23a6b", "#52514e", "#0b8a9e")
+
+
+def _fleet_latency_svg(latency: Dict[str, dict]) -> str:
+    """Per-controller reconcile-latency percentile curves: x = percentile
+    position, y = latency on a log scale (p50 and p99 of a control plane
+    differ by orders of magnitude — linear axes flatten every curve but
+    the worst one)."""
+    import math
+    if not latency:
+        return "<p>(no reconcile samples)</p>"
+    ml, mr, mt, ph, iw = 52, 150, 14, 160, 420
+    W, H = ml + iw + mr, mt + ph + 26
+    pcts = [p["pct"] for p in next(iter(latency.values()))["curve"]]
+    xs = {p: ml + i * iw / (len(pcts) - 1) for i, p in enumerate(pcts)}
+    all_ms = [max(0.001, p["ms"]) for v in latency.values()
+              for p in v["curve"]]
+    lo = math.floor(math.log10(min(all_ms)))
+    hi = math.ceil(math.log10(max(all_ms)))
+    if hi <= lo:  # ceil can legitimately be 0 — don't truthiness-test it
+        hi = lo + 1
+
+    def y(ms):
+        f = (math.log10(max(0.001, ms)) - lo) / (hi - lo)
+        return mt + ph - min(1.0, max(0.0, f)) * ph
+
+    svg = [f'<svg viewBox="0 0 {W} {H}" width="{W}" height="{H}" '
+           f'role="img" aria-label="reconcile latency percentiles">']
+    for d in range(lo, hi + 1):
+        gy = y(10 ** d)
+        svg.append(f'<line x1="{ml}" y1="{gy:.1f}" x2="{ml + iw}" '
+                   f'y2="{gy:.1f}" stroke="#e4e3de"/>'
+                   f'<text x="{ml - 6}" y="{gy + 3.5:.1f}" '
+                   f'text-anchor="end" class="vt">{10 ** d:g}ms</text>')
+    for p in pcts:
+        svg.append(f'<text x="{xs[p]:.1f}" y="{H - 8}" '
+                   f'text-anchor="middle" class="vt">p{p}</text>')
+    for i, (c, v) in enumerate(sorted(latency.items())):
+        color = _FLEET_COLORS[i % len(_FLEET_COLORS)]
+        pts = " ".join(f'{xs[p["pct"]]:.1f},{y(p["ms"]):.1f}'
+                       for p in v["curve"])
+        last = v["curve"][-1]
+        svg.append(f'<polyline points="{pts}" fill="none" stroke="{color}" '
+                   f'stroke-width="2" stroke-linejoin="round"/>'
+                   f'<text x="{ml + iw + 6}" '
+                   f'y="{y(last["ms"]) + 3.5:.1f}" class="vl" '
+                   f'fill="{color}">{c} {last["ms"]:g}ms</text>')
+    svg.append("</svg>")
+    return "".join(svg)
+
+
+def _fleet_throughput_svg(curve: List[dict]) -> str:
+    """Scheduler-throughput curve over the drill: binds/s + reconciles/s
+    (one rate panel) and summed workqueue depth (its own panel — depth is
+    not a rate)."""
+    if len(curve) < 2:
+        return "<p>(no throughput samples)</p>"
+    ml, mr, mt, ph, gap, iw = 52, 130, 14, 110, 28, 460
+    W = ml + iw + mr
+    H = mt + ph * 2 + gap + 24
+    x1 = curve[-1]["t"] or 1.0
+    panels = [
+        ("/s", (("binds_per_s", "sched binds", "#2a78d6"),
+                ("reconciles_per_s", "reconciles", "#eb6834"),
+                ("events_per_s", "events", "#1baf7a"))),
+        ("queue depth", (("queue_depth", "workqueue depth", "#8a4fd3"),)),
+    ]
+    svg = [f'<svg viewBox="0 0 {W} {H}" width="{W}" height="{H}" '
+           f'role="img" aria-label="scheduler throughput over time">']
+    for pi, (unit, series) in enumerate(panels):
+        top = mt + pi * (ph + gap)
+        ymax = max(max(c[k] for c in curve) for k, _, _ in series) or 1.0
+        ymax *= 1.1
+        for gi in range(3):
+            gy = top + ph - gi * ph / 2
+            svg.append(f'<line x1="{ml}" y1="{gy:.1f}" x2="{ml + iw}" '
+                       f'y2="{gy:.1f}" stroke="#e4e3de"/>'
+                       f'<text x="{ml - 6}" y="{gy + 3.5:.1f}" '
+                       f'text-anchor="end" class="vt">'
+                       f'{ymax * gi / 2:.0f}</text>')
+        svg.append(f'<text x="{ml}" y="{top - 3}" class="vt">{unit}</text>')
+        for key, label, color in series:
+            pts = " ".join(
+                f'{ml + c["t"] / x1 * iw:.1f},'
+                f'{top + ph - min(1.0, c[key] / ymax) * ph:.1f}'
+                for c in curve)
+            ly = top + ph - min(1.0, curve[-1][key] / ymax) * ph
+            svg.append(f'<polyline points="{pts}" fill="none" '
+                       f'stroke="{color}" stroke-width="2" '
+                       f'stroke-linejoin="round"/>'
+                       f'<text x="{ml + iw + 6}" y="{ly + 3.5:.1f}" '
+                       f'class="vl" fill="{color}">{label}</text>')
+    for tx in range(0, 5):
+        t = x1 * tx / 4
+        svg.append(f'<text x="{ml + t / x1 * iw:.1f}" y="{H - 6}" '
+                   f'text-anchor="middle" class="vt">{t:.0f}s</text>')
+    svg.append("</svg>")
+    step = max(1, len(curve) // 40)
+    rows = "".join(
+        f'<tr><td>{c["t"]}</td><td>{c["binds_per_s"]}</td>'
+        f'<td>{c["reconciles_per_s"]}</td><td>{c["events_per_s"]}</td>'
+        f'<td>{c["queue_depth"]}</td></tr>' for c in curve[::step])
+    return ("".join(svg)
+            + "<details><summary>data table</summary><table>"
+              "<tr><th>t (s)</th><th>binds/s</th><th>reconciles/s</th>"
+              "<th>events/s</th><th>qdepth</th></tr>"
+            + rows + "</table></details>")
+
+
+def _fleet_sections(report: dict) -> str:
+    latency = report.get("reconcile_latency") or {}
+    lat_rows = "".join(
+        f"<tr><td>{c}</td>"
+        + "".join(f"<td>{p['ms']}</td>" for p in v["curve"])
+        + f"<td>{v['max_ms']}</td><td>{v['n']}</td>"
+          f"<td>{v['queue_age_p99_ms']}</td></tr>"
+        for c, v in sorted(latency.items()))
+    pct_hdr = "".join(
+        f"<th>p{p['pct']} (ms)</th>"
+        for p in (next(iter(latency.values()))["curve"] if latency else []))
+    slowest = report.get("slowest_reconcile_by_controller") or {}
+    slow_rows = "".join(
+        f"<tr><td>{c}</td><td>{v['duration_ms']}</td>"
+        f"<td>{v['trace_id']}</td></tr>"
+        for c, v in sorted(slowest.items()))
+    wf = "\n".join(report.get("slowest_reconcile_waterfall")
+                   or ["(no sampled reconcile traces)"])
+    stuck = report.get("stuck_keys") or []
+    stuck_html = ("<p>none</p>" if not stuck else _kv_table(
+        {f"{s['controller']} {s['key']}": f"{s['failures']} failures"
+         for s in stuck}))
+    return f"""<style>.vt{{font:10px sans-serif;fill:#52514e}}
+.vl{{font:11px sans-serif}}</style>
+<h2>fleet</h2>{_kv_table(report.get("fleet") or {})}
+<h2>phases (s)</h2>{_kv_table(report.get("phases") or {})}
+<h2>per-controller reconcile latency</h2>
+<table><tr><th>controller</th>{pct_hdr}<th>max (ms)</th><th>n</th>
+<th>queue-age p99 (ms)</th></tr>{lat_rows}</table>
+{_fleet_latency_svg(latency)}
+<h2>scheduler throughput</h2>{_kv_table(report.get("scheduler") or {})}
+{_fleet_throughput_svg(report.get("throughput_curve") or [])}
+<h2>slowest reconcile per controller (exemplar → trace)</h2>
+<table><tr><th>controller</th><th>ms</th><th>trace_id</th></tr>
+{slow_rows}</table>
+<pre>{wf}</pre>
+<h2>event plane</h2>{_kv_table(report.get("events") or {})}
+<h2>stuck keys</h2>{stuck_html}
+<h2>invariants</h2>{_invariants_table(report.get("invariants") or {})}"""
+
+
 def write_html_report(report: dict, path: str) -> None:
     """Scenario-aware HTML report (reference analog: test/stress
     report.go). Each scenario renders ITS OWN sections — an overload or
@@ -1781,6 +2282,8 @@ def write_html_report(report: dict, path: str) -> None:
         body = _autoscale_sections(report)
     elif scenario == "kvstream":
         body = _kvstream_sections(report)
+    elif scenario == "fleet":
+        body = _fleet_sections(report)
     else:
         body = f"<pre>{json.dumps(report, indent=2)}</pre>"
     tr = report.get("trace")

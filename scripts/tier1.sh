@@ -115,6 +115,37 @@ assert r.get('bit_identical'), 'streamed decode diverged from reference'
              "invariant red in /tmp/_t1_kvstream.json" >&2
         exit 1
     fi
+    # Control-plane fleet smoke: the 10k-node drill at ~500 nodes. Asserts
+    # the control-plane observability invariants (workqueues drain to
+    # empty, no stuck keys, event-recorder accounting) and that the
+    # reconcile-latency and scheduler-throughput curves are NON-EMPTY —
+    # the baseline the watch/informer refactor will be judged against.
+    # Outside the 870 s pytest budget, --lint mode only.
+    echo "== rbg-tpu stress --scenario fleet --nodes 500 (control-plane smoke) =="
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
+            stress --scenario fleet --nodes 500 --groups 24 --json \
+            >/tmp/_t1_fleet.json; then
+        echo "TIER1 FLEET SMOKE FAILED — see /tmp/_t1_fleet.json" \
+             "(invariants)" >&2
+        exit 1
+    fi
+    if ! python -c "
+import json
+r = json.load(open('/tmp/_t1_fleet.json'))
+inv = r.get('invariants') or {}
+assert inv.get('workqueue_drained'), 'workqueues never drained to empty'
+assert inv.get('no_stuck_keys'), 'stuck keys: %s' % r.get('stuck_keys')
+assert inv.get('events_accounted'), 'event recorder lost occurrences: %s' \
+    % r.get('events')
+assert r.get('reconcile_latency'), 'reconcile-latency curves are empty'
+assert any(c.get('binds_per_s', 0) > 0
+           for c in r.get('throughput_curve') or []), \
+    'scheduler-throughput curve is empty'
+"; then
+        echo "TIER1 FLEET SMOKE FAILED — drained/stuck-keys/events or" \
+             "empty curves in /tmp/_t1_fleet.json" >&2
+        exit 1
+    fi
     # Live windowed-signal render: boot a tiny engine server, push one
     # request through it, and assert `rbg-tpu top --once` renders the
     # per-role dashboard (attainment + goodput columns) from its slo +

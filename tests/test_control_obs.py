@@ -1,0 +1,423 @@
+"""Control-plane observability: workqueue telemetry, the structured
+event recorder, reconcile tracing, the admin operator surface, and the
+fleet drill (PR: control-plane observability)."""
+
+import threading
+import time
+
+import pytest
+
+from rbg_tpu.obs import names, trace
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.runtime.controller import (Controller, InstrumentedWorkQueue,
+                                        Result, Watch, own_keys)
+from rbg_tpu.runtime.queue import WorkQueue
+from rbg_tpu.runtime.store import EVENT_WARNING, EventRecord, Store
+
+
+def _pod(name, ns="default"):
+    from rbg_tpu.api.pod import Pod
+    p = Pod()
+    p.metadata.name = name
+    p.metadata.namespace = ns
+    return p
+
+
+# ---- workqueue telemetry ----------------------------------------------------
+
+
+def test_workqueue_depth_and_age_metrics():
+    q = InstrumentedWorkQueue(WorkQueue(), controller="tq1")
+    adds0 = REGISTRY.counter(names.WORKQUEUE_ADDS_TOTAL, controller="tq1")
+    age0 = (REGISTRY.hist_stats(names.WORKQUEUE_QUEUE_AGE_SECONDS,
+                                controller="tq1") or {}).get("count", 0)
+    for i in range(5):
+        q.add(("ns", f"k{i}"))
+    assert REGISTRY.gauge(names.WORKQUEUE_DEPTH, controller="tq1") == 5.0
+    assert REGISTRY.counter(names.WORKQUEUE_ADDS_TOTAL,
+                            controller="tq1") - adds0 == 5.0
+    got = []
+    while True:
+        item = q.get(timeout=0.1)
+        if item is None:
+            break
+        got.append(item)
+        q.done(item)
+    assert len(got) == 5
+    assert REGISTRY.gauge(names.WORKQUEUE_DEPTH, controller="tq1") == 0.0
+    st = REGISTRY.hist_stats(names.WORKQUEUE_QUEUE_AGE_SECONDS,
+                             controller="tq1")
+    assert st["count"] - age0 == 5
+
+
+def test_workqueue_age_excludes_intentional_delay():
+    q = InstrumentedWorkQueue(WorkQueue(), controller="tq2")
+    q.add_after(("ns", "delayed"), 0.15)
+    item = q.get(timeout=2.0)
+    assert item == ("ns", "delayed")
+    st = REGISTRY.hist_stats(names.WORKQUEUE_QUEUE_AGE_SECONDS,
+                             controller="tq2")
+    # Age measures waiting BEYOND the intentional add_after delay — a
+    # backoff requeue must not read as queue backlog.
+    assert st["max"] < 0.1
+    q.done(item)
+
+
+def test_workqueue_immediate_add_overrides_future_stamp():
+    q = InstrumentedWorkQueue(WorkQueue(), controller="tq4")
+    q.add_after(("ns", "k"), 5.0)    # parked in backoff: future stamp
+    q.add(("ns", "k"))               # watch event: ready NOW
+    time.sleep(0.1)
+    item = q.get(timeout=1.0)
+    assert item == ("ns", "k")
+    st = REGISTRY.hist_stats(names.WORKQUEUE_QUEUE_AGE_SECONDS,
+                             controller="tq4")
+    # Age is measured from the immediate add — the lingering future
+    # backoff stamp must not clamp a real backlog wait to 0.
+    assert st["max"] >= 0.05
+    q.done(item)
+
+
+def test_workqueue_concurrent_add_get_all_accounted():
+    q = InstrumentedWorkQueue(WorkQueue(), controller="tq3")
+    n_producers, per = 4, 50
+    seen = set()
+    seen_lock = threading.Lock()
+    stop = threading.Event()
+
+    def produce(pid):
+        for i in range(per):
+            q.add((pid, i))
+
+    def consume():
+        while not stop.is_set():
+            item = q.get(timeout=0.05)
+            if item is None:
+                continue
+            with seen_lock:
+                seen.add(item)
+            q.done(item)
+
+    consumers = [threading.Thread(target=consume, daemon=True)
+                 for _ in range(3)]
+    for t in consumers:
+        t.start()
+    producers = [threading.Thread(target=produce, args=(p,), daemon=True)
+                 for p in range(n_producers)]
+    for t in producers:
+        t.start()
+    for t in producers:
+        t.join(timeout=10.0)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        with seen_lock:
+            if len(seen) == n_producers * per:
+                break
+        time.sleep(0.01)
+    stop.set()
+    q.shutdown()
+    for t in consumers:
+        t.join(timeout=2.0)
+    assert len(seen) == n_producers * per
+    assert REGISTRY.gauge(names.WORKQUEUE_DEPTH, controller="tq3") == 0.0
+
+
+# ---- structured event recorder ----------------------------------------------
+
+
+def test_event_dedup_and_tuple_compat():
+    s = Store()
+    p = _pod("a")
+    for _ in range(4):
+        s.record_event(p, "FailedScheduling", "no feasible node",
+                       type_=EVENT_WARNING)
+    evs = s.events_for(p)
+    assert len(evs) == 1
+    rec = evs[0]
+    assert isinstance(rec, EventRecord)
+    assert rec.count == 4 and rec.type == "Warning"
+    assert rec.first_time <= rec.time
+    # Legacy flat-log compatibility: 4-tuple unpack + positional index.
+    t, ref, reason, msg = rec
+    assert ref == "Pod/default/a" and reason == "FailedScheduling"
+    assert rec[3] == "no feasible node"
+    # A different message is a new record, not a dedup bump.
+    s.record_event(p, "FailedScheduling", "still no feasible node",
+                   type_=EVENT_WARNING)
+    assert len(s.events_for(p)) == 2
+
+
+def test_event_per_object_bound_protects_other_objects():
+    s = Store()
+    chatty, quiet = _pod("chatty"), _pod("quiet")
+    s.record_event(quiet, "Scheduled", "bound to node-1")
+    for i in range(Store.MAX_EVENTS_PER_OBJECT * 3):
+        s.record_event(chatty, f"Reason{i}", "spam", type_=EVENT_WARNING)
+    assert len(s.events_for(chatty)) <= Store.MAX_EVENTS_PER_OBJECT
+    # The old flat log trimmed globally — a chatty controller evicted
+    # every other object's history. The per-ref index must not.
+    assert len(s.events_for(quiet)) == 1
+
+
+def test_event_filters_and_accounting():
+    s = Store()
+    rec0 = {t: REGISTRY.counter(names.EVENTS_RECORDED_TOTAL, type=t)
+            for t in ("Normal", "Warning")}
+    evict0 = REGISTRY.counter(names.EVENTS_EVICTED_TOTAL)
+    a, b = _pod("a"), _pod("b")
+    s.record_event(a, "Scheduled", "bound")
+    s.record_event(a, "Restarting", "gang restart", type_=EVENT_WARNING)
+    time.sleep(0.02)
+    cut = time.time()
+    s.record_event(b, "Scheduled", "bound")
+    assert [e.reason for e in s.events_for(reason="Restarting")] == [
+        "Restarting"]
+    assert len(s.events_for(event_type="Warning")) == 1
+    assert [e[1] for e in s.events_for(since=cut)] == ["Pod/default/b"]
+    assert len(s.events_for(limit=2)) == 2
+    # Accounting: recorded == live counts + evicted (the fleet drill's
+    # events_accounted invariant).
+    recorded = sum(
+        REGISTRY.counter(names.EVENTS_RECORDED_TOTAL, type=t) - rec0[t]
+        for t in ("Normal", "Warning"))
+    evicted = REGISTRY.counter(names.EVENTS_EVICTED_TOTAL) - evict0
+    assert recorded == s.event_stats()["total_count"] + evicted == 3
+
+
+# ---- reconcile tracing ------------------------------------------------------
+
+
+class _NodeEcho(Controller):
+    """Minimal controller: reconciles Node objects, counts passes."""
+
+    name = "nodeecho"
+    workers = 1
+    resync_period = 0  # no resync loop — the watch is the only trigger
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.seen = []
+
+    def watches(self):
+        return [Watch("Node", own_keys)]
+
+    def reconcile(self, store, key):
+        self.seen.append(key)
+        return None
+
+
+@pytest.fixture()
+def traced():
+    was, sample = trace.enabled(), trace._CFG.sample
+    trace.configure(enabled=True, sample=1.0)
+    trace.SINK.reset()
+    yield
+    trace.configure(enabled=was, sample=sample)
+    trace.SINK.reset()
+
+
+def test_reconcile_span_parents_off_watch_event(traced):
+    from rbg_tpu.api.pod import Node
+    store = Store()
+    ctrl = _NodeEcho(store)
+    ctrl.start()
+    try:
+        n = Node()
+        n.metadata.name = "n1"
+        n.metadata.namespace = "default"
+        store.create(n)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not ctrl.seen:
+            time.sleep(0.01)
+        assert ctrl.seen
+        time.sleep(0.1)
+    finally:
+        ctrl.stop()
+    pairs = [r for r in trace.SINK.recent(64)
+             if r["root"] == names.SPAN_CTRL_EVENT
+             and any(s["name"] == names.SPAN_CTRL_RECONCILE
+                     for s in r["spans"])]
+    assert pairs, "no event->reconcile trace finalized"
+    rec = pairs[0]
+    assert rec["complete"]
+    ev = rec["spans"][0]
+    rc = next(s for s in rec["spans"]
+              if s["name"] == names.SPAN_CTRL_RECONCILE)
+    assert rc["parent_id"] == ev["span_id"]
+    assert ev["attrs"]["controller"] == "nodeecho"
+    assert ev["attrs"]["kind"] == "Node"
+    assert rc["attrs"]["outcome"] == "success"
+    # Exemplar satellite: the duration histogram links to the trace.
+    ex = REGISTRY.exemplars(names.RECONCILE_DURATION_SECONDS,
+                            controller="nodeecho")
+    assert any(e["trace_id"] == rec["trace_id"] for e in ex.values())
+
+
+def test_unsampled_event_stamps_null_decision(traced):
+    """An event that LOSES the head-sampling roll still records its
+    decision: the worker must find the (falsy) sentinel and neither
+    re-roll sampling nor mislabel the reconcile as resync-origin."""
+    from rbg_tpu.api.pod import Node
+    from rbg_tpu.runtime.store import Event
+    trace.configure(sample=0.0)
+    ctrl = _NodeEcho(Store())   # not started — no workers to race
+    n = Node()
+    n.metadata.name = "n1"
+    n.metadata.namespace = "default"
+    ctrl._stamp_event_span(Event(Event.ADDED, n), ("default", "n1"))
+    sp = ctrl._take_event_span(("default", "n1"))
+    assert sp is not None and not sp
+    assert ctrl._take_event_span(("default", "n1")) is None
+
+
+def test_reconcile_error_requeue_accounting():
+    store = Store()
+
+    class Flaky(_NodeEcho):
+        name = "flakyecho"
+        fails = 2
+
+        def reconcile(self, store, key):
+            self.seen.append(key)
+            if len(self.seen) <= self.fails:
+                raise RuntimeError("transient")
+            return Result(requeue_after=30.0)
+
+    err0 = REGISTRY.counter(names.RECONCILE_REQUEUES_TOTAL,
+                            controller="flakyecho", reason="error")
+    ctrl = Flaky(store)
+    ctrl.start()
+    try:
+        from rbg_tpu.api.pod import Node
+        n = Node()
+        n.metadata.name = "n1"
+        n.metadata.namespace = "default"
+        store.create(n)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and len(ctrl.seen) < 3:
+            time.sleep(0.01)
+    finally:
+        ctrl.stop()
+    assert len(ctrl.seen) >= 3
+    assert REGISTRY.counter(names.RECONCILE_REQUEUES_TOTAL,
+                            controller="flakyecho",
+                            reason="error") - err0 == 2.0
+    assert REGISTRY.counter(names.RECONCILE_REQUEUES_TOTAL,
+                            controller="flakyecho",
+                            reason="requeue_after") >= 1.0
+    # Success forgot the backoff: nothing pending, gauge settled at 0.
+    assert ctrl.backoff.pending_count() == 0
+    st = ctrl.stats()
+    assert st["queue_depth"] == 0 and st["retries_pending"] == 0
+
+
+# ---- admin operator surface -------------------------------------------------
+
+
+@pytest.fixture()
+def served_plane():
+    from rbg_tpu.runtime.admin import AdminServer
+    from rbg_tpu.runtime.plane import ControlPlane
+    from rbg_tpu.testutil import make_tpu_nodes
+    p = ControlPlane(backend="fake")
+    make_tpu_nodes(p.store, slices=1, hosts_per_slice=2)
+    p.start()
+    admin = AdminServer(p, port=0).start()
+    yield p, f"127.0.0.1:{admin.port}"
+    admin.stop()
+    p.stop()
+
+
+def _call(addr, obj):
+    from rbg_tpu.engine.protocol import request_once
+    resp, _, _ = request_once(addr, obj)
+    assert resp is not None
+    return resp
+
+
+def test_admin_events_op_filters_and_clamping(served_plane):
+    plane, addr = served_plane
+    p = _pod("evpod")
+    plane.store.create(p)
+    for i in range(8):
+        plane.store.record_event(p, "FailedScheduling", f"attempt {i}",
+                                 type_=EVENT_WARNING)
+    plane.store.record_event(p, "Scheduled", "bound")
+    resp = _call(addr, {"op": "events"})
+    assert resp["stats"]["objects"] >= 1
+    assert any(e["reason"] == "Scheduled" for e in resp["events"])
+    # Filters: object ref, reason, type.
+    resp = _call(addr, {"op": "events", "kind": "Pod", "name": "evpod",
+                        "type": "Warning"})
+    assert all(e["type"] == "Warning" for e in resp["events"])
+    assert len(resp["events"]) == 8
+    # Clamping: absurd/malformed limits degrade, never kill the handler.
+    resp = _call(addr, {"op": "events", "limit": 10 ** 9})
+    assert "events" in resp
+    resp = _call(addr, {"op": "events", "limit": "garbage",
+                        "since": "alsogarbage"})
+    assert "events" in resp
+    resp = _call(addr, {"op": "events", "limit": 1})
+    assert len(resp["events"]) == 1
+    # Events OUTLIVE their object: the post-mortem of a deleted pod must
+    # still be readable (lookup is by ref, not by live object).
+    plane.store.delete("Pod", "default", "evpod")
+    resp = _call(addr, {"op": "events", "kind": "Pod", "name": "evpod"})
+    assert len(resp["events"]) == 9  # 8 distinct warnings + Scheduled
+    # An unknown ref is just an empty timeline, not an error.
+    resp = _call(addr, {"op": "events", "kind": "Pod", "name": "nope"})
+    assert resp["events"] == []
+
+
+def test_admin_controlplane_op(served_plane):
+    plane, addr = served_plane
+    from rbg_tpu.testutil import make_group, simple_role
+    plane.apply(make_group("cp", simple_role("s", replicas=1)))
+    plane.wait_group_ready("cp")
+    resp = _call(addr, {"op": "controlplane"})
+    cp = resp["controlplane"]
+    by_name = {c["name"]: c for c in cp["controllers"]}
+    assert "scheduler" in by_name and "rolebasedgroup" in by_name
+    sched = by_name["scheduler"]
+    assert sched["reconciles"]["success"] >= 1
+    assert sched["reconcile_p99_s"] is not None
+    assert sched["queue_depth"] == 0
+    assert "events" in cp and "watch" in cp
+    assert cp["watch"]["dispatch_p99_s"].get("Pod") is not None
+
+
+# ---- fleet drill smoke ------------------------------------------------------
+
+
+def _run_fleet_small(**kw):
+    from rbg_tpu.stress.harness import FleetConfig, run_fleet
+    cfg = FleetConfig(nodes=40, hosts_per_slice=4, groups=4,
+                      roles_per_group=2, replicas=1, create_qps=200.0,
+                      timeout_s=60.0, drain_timeout_s=30.0,
+                      sample_interval_s=0.1, **kw)
+    return run_fleet(cfg)
+
+
+def test_fleet_scenario_smoke():
+    report = _run_fleet_small()
+    assert all(report["invariants"].values()), report["invariants"]
+    assert report["reconcile_latency"], "latency curves empty"
+    assert report["fleet"]["pods_peak"] == 8
+    assert report["scheduler"]["binds_total"] >= 8
+    assert any(c["binds_per_s"] > 0 for c in report["throughput_curve"])
+    assert report["events"]["recorded_total"] == (
+        report["events"]["total_count"] + report["events"]["evicted_total"])
+    # HTML render of the curves must not throw and must carry both SVGs.
+    from rbg_tpu.stress.harness import _fleet_sections
+    html = _fleet_sections(report)
+    assert html.count("<svg") == 2
+
+
+@pytest.mark.slow
+def test_fleet_scenario_at_scale():
+    from rbg_tpu.stress.harness import FleetConfig, run_fleet
+    report = run_fleet(FleetConfig(nodes=2000, groups=60, replicas=2,
+                                   timeout_s=300.0))
+    assert all(report["invariants"].values()), report["invariants"]
+    assert report["fleet"]["nodes"] >= 2000
+    assert report["slowest_reconcile_by_controller"]

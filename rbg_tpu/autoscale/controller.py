@@ -79,6 +79,9 @@ class AutoscaleController(Controller):
         self.cfg = config
         self.spares = spares
         self.resync_period = max(config.eval_period_s, 0.05)
+        # The autoscaler's resync IS its evaluation tick, not a drift
+        # backstop — the event-carried demotion must not stretch it.
+        self.backstop_period = self.resync_period
         self.reader = SignalReader(window_s=config.window_s,
                                    stale_after_s=config.stale_after_s,
                                    extras_fn=config.extras_fn)

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from rbg_tpu.api import constants as C
@@ -377,53 +378,145 @@ class K8sPodBackend:
 
     # ---- node inventory ----
 
+    # Legacy polling cadence (the resync-carried plane, preserved under
+    # the ``legacy_resync`` A/B toggle). Event-carried mode rides the
+    # node WATCH stream instead and keeps only a long drift backstop:
+    # node disruption state arrives when it changes, and the full re-list
+    # exists to self-heal a silently wedged stream, not to carry data.
     NODE_RESYNC_S = 2.0
+    NODE_BACKSTOP_S = 60.0
+    legacy_resync = False
 
     def _node_loop(self):
+        if self.legacy_resync:
+            while not self._stop.is_set():
+                self._stop.wait(self.NODE_RESYNC_S)
+                if self._stop.is_set():
+                    return
+                try:
+                    self._sync_nodes()
+                except Exception:
+                    log.warning("k8s node resync failed", exc_info=True)
+            return
+        # Resume the watch from the rv the initial LIST covered — a
+        # rv="0" watch against a REAL apiserver starts at a server-chosen
+        # point with no snapshot, silently dropping anything that landed
+        # between the list and the watch registration (the same
+        # list→watch gap class Store.watch(since_rv=) closes in-process).
+        rv = self._sync_nodes()
+        last_full = time.monotonic()
         while not self._stop.is_set():
-            self._stop.wait(self.NODE_RESYNC_S)
-            if self._stop.is_set():
-                return
             try:
-                self._sync_nodes()
+                for ev_type, kn in self.client.watch_nodes(
+                        resource_version=rv,
+                        timeout_s=self.WATCH_WINDOW_S):
+                    if self._stop.is_set():
+                        return
+                    if ev_type == "ERROR":
+                        # History expired past our bookmark: full re-list
+                        # and resume from the rv that list covered.
+                        rv = self._sync_nodes()
+                        last_full = time.monotonic()
+                        break
+                    meta = kn.get("metadata", {})
+                    rv = meta.get("resourceVersion", rv)
+                    if ev_type == "DELETED":
+                        continue  # parity: the poller never deleted either
+                    try:
+                        self._sync_node_obj(kn)
+                    except Exception:
+                        log.warning("k8s node event sync failed",
+                                    exc_info=True)
+            except ApiError as e:
+                if e.status == 410:
+                    # History expired: a REAL apiserver will not snapshot
+                    # current state on a rv=0 reconnect (that is
+                    # fake-only), so re-list now — state changed during
+                    # the dark window must not wait out the 60 s backstop.
+                    rv = self._sync_nodes()
+                    last_full = time.monotonic()
+                else:
+                    log.warning("k8s node watch: %s (reconnecting)", e)
+                    self._stop.wait(0.5)
             except Exception:
-                log.warning("k8s node resync failed", exc_info=True)
+                log.warning("k8s node watch failed (reconnecting)",
+                            exc_info=True)
+                self._stop.wait(0.5)
+            if time.monotonic() - last_full >= self.NODE_BACKSTOP_S:
+                try:
+                    rv = self._sync_nodes()
+                except Exception:
+                    log.warning("k8s node backstop sync failed",
+                                exc_info=True)
+                last_full = time.monotonic()
 
-    def _sync_nodes(self):
+    def _sync_nodes(self) -> str:
         """Import the cluster's TPU nodes as plane Nodes (idempotent): the
         scheduler then gangs slices onto real capacity. Non-TPU nodes are
-        imported too (router/CPU roles need somewhere to run). Re-run
-        periodically so node-level disruption state (maintenance
-        conditions, preemption NotReady, cordons) keeps flowing; no-op
-        when nothing changed so steady state emits no events."""
-        from rbg_tpu.api import serde
+        imported too (router/CPU roles need somewhere to run). Run at
+        startup, from node watch events, and as a periodic drift backstop
+        so node-level disruption state (maintenance conditions, preemption
+        NotReady, cordons) keeps flowing; no-op when nothing changed so
+        steady state emits no events. Returns the max resourceVersion the
+        list covered — the gap-free resume point for the node watch."""
         try:
             knodes = self.client.list_nodes()
         except ApiError as e:
             log.warning("k8s node sync failed: %s", e)
-            return
+            return "0"
+        max_rv = 0
         for kn in knodes:
+            try:
+                max_rv = max(max_rv, int(
+                    kn.get("metadata", {}).get("resourceVersion", 0)))
+            except ValueError:
+                pass
+            self._sync_node_obj(kn)
+        return str(max_rv) if max_rv else "0"
+
+    def _sync_node_obj(self, kn: dict):
+        """Reflect ONE cluster node into the plane (shared by the watch
+        event path and the full-list backstop). Conflicts retry with a
+        fresh read: under the old 2 s poller a lost write self-healed
+        within one period, but a watch event is delivered ONCE — dropping
+        it on conflict would park cluster disruption state for the whole
+        60 s backstop (longer than some maintenance notice windows)."""
+        from rbg_tpu.api import serde
+        for _ in range(4):
             node = T.node_from_k8s(kn)
             if not node.metadata.name:
-                continue
+                return
             cur = self.store.get("Node", "default", node.metadata.name)
             if cur is None:
-                self.store.create(node)
-            else:
-                node.metadata = cur.metadata
-                # The plane owns cordons it placed ITSELF (disruption
-                # controller, marked by the cordoned-by annotation) — a
-                # resync must not clear those just because the cluster
-                # hasn't mirrored the bit. Every other cordon state is the
-                # cluster's to set AND clear: without the marker check, an
-                # operator's kubectl cordon/uncordon cycle would leave the
-                # plane-side bit stuck True forever.
-                if (cur.unschedulable and cur.metadata.annotations.get(
-                        C.ANN_CORDONED_BY) == "disruption"):
-                    node.unschedulable = True
-                if serde.to_dict(node) == serde.to_dict(cur):
-                    continue
+                from rbg_tpu.runtime.store import AlreadyExists
                 try:
-                    self.store.update(node)
-                except StoreConflict:
-                    pass
+                    self.store.create(node)
+                    return
+                except AlreadyExists:
+                    continue  # watch raced the startup/backstop list
+            node.metadata = cur.metadata
+            # The plane owns cordons it placed ITSELF (disruption
+            # controller, marked by the cordoned-by annotation) — a
+            # resync must not clear those just because the cluster
+            # hasn't mirrored the bit. Every other cordon state is the
+            # cluster's to set AND clear: without the marker check, an
+            # operator's kubectl cordon/uncordon cycle would leave the
+            # plane-side bit stuck True forever.
+            if (cur.unschedulable and cur.metadata.annotations.get(
+                    C.ANN_CORDONED_BY) == "disruption"):
+                node.unschedulable = True
+            if serde.to_dict(node) == serde.to_dict(cur):
+                return
+            try:
+                self.store.update(node)
+                return
+            except StoreConflict:
+                continue  # plane wrote concurrently — re-read and re-merge
+        # Watch events are delivered ONCE — a drop here parks cluster
+        # state for the whole backstop, so losing the retry race must at
+        # least be LOUD (the operations runbook tells operators to look
+        # for exactly this when drift shows up).
+        log.warning("k8s node sync %s: conflict retries exhausted — "
+                    "state deferred to the %ss backstop",
+                    kn.get("metadata", {}).get("name", "?"),
+                    self.NODE_BACKSTOP_S)

@@ -176,11 +176,27 @@ class KubeClient:
         """Yield (event_type, pod) from a JSON-lines watch stream. Returns
         when the server closes the stream (bookmark your own last
         resourceVersion and reconnect)."""
+        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
+                else "/api/v1/pods")
+        yield from self._watch_stream(path, label_selector,
+                                      resource_version, timeout_s)
+
+    def watch_nodes(self, label_selector: str = "",
+                    resource_version: str = "0",
+                    timeout_s: float = 30.0) -> Iterator[Tuple[str, dict]]:
+        """Node watch stream (same contract as watch_pods) — the
+        event-carried replacement for polling list_nodes: node disruption
+        state reaches the plane when it CHANGES, with the periodic full
+        sync demoted to a drift backstop."""
+        yield from self._watch_stream("/api/v1/nodes", label_selector,
+                                      resource_version, timeout_s)
+
+    def _watch_stream(self, path: str, label_selector: str,
+                      resource_version: str,
+                      timeout_s: float) -> Iterator[Tuple[str, dict]]:
         import http.client
 
         u = urllib.parse.urlparse(self.base_url)
-        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
-                else "/api/v1/pods")
         params = {"watch": "true", "resourceVersion": resource_version,
                   "timeoutSeconds": str(int(timeout_s))}
         if label_selector:

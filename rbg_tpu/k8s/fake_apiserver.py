@@ -77,9 +77,9 @@ class _State:
         self.rv += 1
         return str(self.rv)
 
-    def record(self, ev_type: str, obj: dict):
+    def record(self, ev_type: str, obj: dict, kind: str = "Pod"):
         self.log.append((int(obj["metadata"]["resourceVersion"]),
-                         ev_type, copy.deepcopy(obj)))
+                         ev_type, kind, copy.deepcopy(obj)))
         if len(self.log) > self.max_log:
             del self.log[:max(1, self.max_log // 4)]
             self.floor = self.log[0][0]
@@ -158,6 +158,8 @@ class FakeK8sApiServer:
                 parts, q = self._route()
                 # /api/v1/nodes[/name]
                 if parts[:3] == ["api", "v1", "nodes"]:
+                    if len(parts) == 3 and q.get("watch") == "true":
+                        return self._watch("", q, kind="Node")
                     with state.lock:
                         if len(parts) == 4:
                             n = state.nodes.get(parts[3])
@@ -200,7 +202,7 @@ class FakeK8sApiServer:
                     return parts[3], name
                 return None, None
 
-            def _watch(self, ns: str, q: dict):
+            def _watch(self, ns: str, q: dict, kind: str = "Pod"):
                 sel = q.get("labelSelector", "")
                 since = int(q.get("resourceVersion", "0") or 0)
                 deadline = time.monotonic() + float(q.get("timeoutSeconds", 30))
@@ -222,7 +224,8 @@ class FakeK8sApiServer:
                     self.wfile.flush()
 
                 def matches(o):
-                    return ((not ns or o["metadata"]["namespace"] == ns)
+                    return ((not ns
+                             or o["metadata"].get("namespace") == ns)
                             and _match_selector(
                                 o["metadata"].get("labels", {}), sel))
 
@@ -232,8 +235,9 @@ class FakeK8sApiServer:
                         # then future events — never a log replay, which
                         # would be incomplete after any trim.
                         with state.lock:
-                            snap = [copy.deepcopy(p)
-                                    for p in state.pods.values()
+                            objs = (state.nodes.values() if kind == "Node"
+                                    else state.pods.values())
+                            snap = [copy.deepcopy(p) for p in objs
                                     if matches(p)]
                             since = state.rv
                         for o in snap:
@@ -251,8 +255,10 @@ class FakeK8sApiServer:
                                 # apiserver's in-stream form).
                                 batch = None
                             else:
-                                batch = [(rv, t, o) for (rv, t, o) in state.log
-                                         if rv > since and matches(o)]
+                                batch = [(rv, t, o)
+                                         for (rv, t, k, o) in state.log
+                                         if rv > since and k == kind
+                                         and matches(o)]
                             if batch == []:
                                 remaining = deadline - time.monotonic()
                                 if remaining <= 0:
@@ -279,8 +285,11 @@ class FakeK8sApiServer:
                 if parts[:3] == ["api", "v1", "nodes"]:
                     with state.lock:
                         name = body["metadata"]["name"]
+                        ev = ("MODIFIED" if name in state.nodes
+                              else "ADDED")
                         body["metadata"]["resourceVersion"] = state.bump()
                         state.nodes[name] = body
+                        state.record(ev, body, kind="Node")
                         return self._send(201, body)
                 ns, _ = self._pod_path(parts)
                 if ns is None:
@@ -482,8 +491,10 @@ class FakeK8sApiServer:
             },
         }
         with self.state.lock:
+            ev = "MODIFIED" if name in self.state.nodes else "ADDED"
             node["metadata"]["resourceVersion"] = self.state.bump()
             self.state.nodes[name] = node
+            self.state.record(ev, node, kind="Node")
 
     # ---- node disruption lifecycle (GKE maintenance / spot preemption) ----
 
@@ -510,6 +521,7 @@ class FakeK8sApiServer:
                 node["metadata"].setdefault("annotations", {}).update(
                     annotations)
             node["metadata"]["resourceVersion"] = self.state.bump()
+            self.state.record("MODIFIED", node, kind="Node")
 
     def set_maintenance(self, slice_id: str, deadline_s: float,
                         now: Optional[float] = None) -> List[str]:

@@ -61,6 +61,12 @@ KVT_DIR_LOOKUPS_TOTAL = "rbg_kvtransfer_dir_lookups_total"
 KVT_DIR_INVALIDATIONS_TOTAL = "rbg_kvtransfer_dir_invalidations_total"
 WORKQUEUE_ADDS_TOTAL = "rbg_workqueue_adds_total"
 RECONCILE_REQUEUES_TOTAL = "rbg_reconcile_requeues_total"
+RECONCILE_DEDUPED_TOTAL = "rbg_reconcile_deduped_total"
+RESYNC_BACKSTOP_ENQUEUED_TOTAL = "rbg_resync_backstop_enqueued_total"
+RESYNC_BACKSTOP_SKIPPED_TOTAL = "rbg_resync_backstop_skipped_total"
+SCHED_SHARD_SCANS_TOTAL = "rbg_sched_shard_scans_total"
+SCHED_SHARD_SKIPS_TOTAL = "rbg_sched_shard_skips_total"
+WATCH_REPLAYS_TOTAL = "rbg_watch_replays_total"
 WATCH_EVENTS_TOTAL = "rbg_watch_events_total"
 WATCH_DELIVERIES_TOTAL = "rbg_watch_deliveries_total"
 SCHED_BINDS_TOTAL = "rbg_sched_binds_total"
@@ -140,6 +146,12 @@ COUNTERS = frozenset({
     KVT_DIR_INVALIDATIONS_TOTAL,
     WORKQUEUE_ADDS_TOTAL,
     RECONCILE_REQUEUES_TOTAL,
+    RECONCILE_DEDUPED_TOTAL,
+    RESYNC_BACKSTOP_ENQUEUED_TOTAL,
+    RESYNC_BACKSTOP_SKIPPED_TOTAL,
+    SCHED_SHARD_SCANS_TOTAL,
+    SCHED_SHARD_SKIPS_TOTAL,
+    WATCH_REPLAYS_TOTAL,
     WATCH_EVENTS_TOTAL,
     WATCH_DELIVERIES_TOTAL,
     SCHED_BINDS_TOTAL,
@@ -280,6 +292,28 @@ HELP = {
     RECONCILE_REQUEUES_TOTAL:
         "Reconcile keys re-queued, per controller and reason "
         "(error backoff vs requeue_after revisit)",
+    RECONCILE_DEDUPED_TOTAL:
+        "Dequeued keys skipped because every pending trigger version was "
+        "already covered by a completed reconcile, per controller "
+        "(coalesced stale events, status-only self-writes, backstop "
+        "sweeps of unchanged objects)",
+    RESYNC_BACKSTOP_ENQUEUED_TOTAL:
+        "Keys the periodic drift-backstop resync enqueued, per controller "
+        "(a healthy event path keeps this near zero useful work — the "
+        "dedup counter absorbs unchanged keys)",
+    RESYNC_BACKSTOP_SKIPPED_TOTAL:
+        "Keys the drift-backstop resync skipped because the event path "
+        "already reconciled them since the last backstop tick, per "
+        "controller",
+    SCHED_SHARD_SCANS_TOTAL:
+        "Topology shards (slices) whose hosts the feasibility scan "
+        "actually visited",
+    SCHED_SHARD_SKIPS_TOTAL:
+        "Topology shards pruned by the free-capacity index before any "
+        "host was visited (shard cannot fit the gang)",
+    WATCH_REPLAYS_TOTAL:
+        "Store watch events replayed to a subscriber resuming from a "
+        "resourceVersion watermark, per kind",
     WATCH_EVENTS_TOTAL: "Store watch events published, per kind and type",
     WATCH_DELIVERIES_TOTAL:
         "Watch handler invocations (event fan-out), per kind",

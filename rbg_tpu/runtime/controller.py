@@ -38,14 +38,38 @@ class InstrumentedWorkQueue:
     — queue-age measures waiting beyond intent, so a 5 s backoff requeue
     must not read as a 5 s-deep queue. Dedup keeps the EARLIEST stamp
     (k8s workqueue convention: age runs from the first unprocessed
-    add)."""
+    add).
+
+    Version watermarks (the event-carried control plane's dedup layer):
+    ``add(item, version=rv)`` records the trigger's store rv (the MAX of
+    all pending triggers for the item); ``add(item)`` with no version is
+    a FORCED add (requeue_after revisits, error backoff, explicit
+    re-queues) that can never be deduped. After a successful reconcile
+    the controller calls ``mark_reconciled(item, rv)`` with the store
+    watermark that reconcile's reads covered; a later dequeue whose
+    claimed trigger version is ≤ that watermark is a counted no-op
+    (``rbg_reconcile_deduped_total``) — coalesced stale events,
+    duplicate self-write retriggers, and backstop sweeps of unchanged
+    objects all land there instead of in a reconcile body."""
+
+    # Watermark retention: losing an entry only costs one extra (no-op)
+    # reconcile, so an LRU bound keeps deleted keys from leaking forever.
+    MAX_WATERMARKS = 65536
 
     def __init__(self, inner, controller: str):
+        import collections
         self._inner = inner
         self._controller = controller
         self._lock = named_lock("runtime.ctrlqueue")
         # item -> expected-ready stamp  # guarded_by[runtime.ctrlqueue]
         self._stamps: dict = {}
+        # item -> max pending trigger rv  # guarded_by[runtime.ctrlqueue]
+        self._versions: dict = {}
+        # items with a pending forced add  # guarded_by[runtime.ctrlqueue]
+        self._forced: set = set()
+        # item -> rv watermark of the last completed reconcile (LRU)
+        # guarded_by[runtime.ctrlqueue]
+        self._watermarks = collections.OrderedDict()
 
     def _set_depth(self) -> None:
         REGISTRY.set_gauge(obs_names.WORKQUEUE_DEPTH,
@@ -61,19 +85,57 @@ class InstrumentedWorkQueue:
             if cur is None or when < cur:
                 self._stamps[item] = when
 
-    def add(self, item) -> None:
+    def _note_trigger(self, item, version) -> None:
+        with self._lock:
+            if version is None:
+                self._forced.add(item)
+            else:
+                cur = self._versions.get(item)
+                if cur is None or version > cur:
+                    self._versions[item] = version
+
+    def add(self, item, version=None) -> None:
+        self._note_trigger(item, version)
         self._stamp(item, time.monotonic())
         self._inner.add(item)
         REGISTRY.inc(obs_names.WORKQUEUE_ADDS_TOTAL,
                      controller=self._controller)
         self._set_depth()
 
-    def add_after(self, item, delay: float) -> None:
+    def add_after(self, item, delay: float, version=None) -> None:
+        self._note_trigger(item, version)
         self._stamp(item, time.monotonic() + max(0.0, delay))
         self._inner.add_after(item, delay)
         REGISTRY.inc(obs_names.WORKQUEUE_ADDS_TOTAL,
                      controller=self._controller)
         self._set_depth()
+
+    def claim(self, item):
+        """Consume the pending trigger state for a just-dequeued item:
+        returns ``(max_version, forced)``. Triggers recorded AFTER this
+        call belong to the NEXT dequeue (the inner queue's dirty-set
+        re-queue guarantees one happens)."""
+        with self._lock:
+            version = self._versions.pop(item, None)
+            forced = item in self._forced
+            self._forced.discard(item)
+            return version, forced
+
+    def watermark(self, item):
+        with self._lock:
+            return self._watermarks.get(item)
+
+    def mark_reconciled(self, item, rv) -> None:
+        """Record that a COMPLETED reconcile of ``item`` observed store
+        state covering every write ≤ ``rv`` (never lowers an existing
+        watermark)."""
+        with self._lock:
+            cur = self._watermarks.get(item)
+            if cur is None or rv > cur:
+                self._watermarks[item] = rv
+            self._watermarks.move_to_end(item)
+            while len(self._watermarks) > self.MAX_WATERMARKS:
+                self._watermarks.popitem(last=False)
 
     def get(self, timeout: Optional[float] = None):
         item = self._inner.get(timeout)
@@ -170,7 +232,24 @@ class Controller:
     # exceeded the period the queues never drained (the 300-group stress
     # knee: p50 44 s). controller-runtime's SyncPeriod default is 10 HOURS;
     # watches, not resyncs, carry the control plane.
+    #
+    # ``resync_period`` is the LEGACY cadence (preserved under the
+    # ``legacy_resync`` A/B toggle); event-carried mode runs the sweep at
+    # ``backstop_period`` instead (None = same as resync_period), with
+    # versioned enqueues so an unchanged key dedups at dequeue and with
+    # keys the event path already reconciled since the last tick skipped
+    # outright (rbg_resync_backstop_* accounting).
     resync_period: float = 300.0
+    backstop_period: Optional[float] = 600.0
+    # A/B toggle (ControlPlane(legacy_resync=True) / RBG_LEGACY_RESYNC=1):
+    # True restores the resync-carried plane — short sweep periods, no
+    # dequeue dedup — so the fleet drill can measure the refactor.
+    legacy_resync: bool = False
+    # Drill hook: fn(controller_name, duration_s) called per reconcile.
+    # The fleet A/B sets it to collect EXACT durations — the registry
+    # histogram's bucket-quantized quantiles (both variants landing in
+    # one bucket reads as "no delta") cannot judge a percentile gate.
+    reconcile_duration_hook = None
 
     def __init__(self, store: Store):
         self.store = store
@@ -190,6 +269,11 @@ class Controller:
         # lock-order detector it helps debug).
         self._event_spans: dict = {}
         self._event_spans_lock = threading.Lock()
+        # Keys the workers handled since the last backstop tick (the
+        # backstop sweep skips them — a healthy event path does zero
+        # backstop work). Plain lock: leaf, never held across calls.
+        self._recent_keys: set = set()
+        self._recent_lock = threading.Lock()
 
     # -- override points --
     def watches(self) -> List[Watch]:
@@ -212,13 +296,33 @@ class Controller:
             return
         from rbg_tpu.obs import trace
         traced = trace.enabled()
+        # Trigger version: the event object's store rv. The store rv is
+        # GLOBAL (one monotone counter across kinds), so a mapped key
+        # (node event → pod keys) still compares correctly against that
+        # key's reconcile watermark. DELETED is forced: a tombstone must
+        # never be mistaken for already-covered state, whatever its rv.
+        #
+        # Deliberately NO self-write folding: a reconcile's own write
+        # always re-triggers one (cheap, no-op) reconcile, which then
+        # advances the watermark honestly. Folding the self-write rv
+        # into the watermark is unsound twice over — a reconcile may
+        # RELY on re-observing its own state transition (the instanceset
+        # controller condemns an instance and arms the drain-deadline
+        # requeue only on the next, self-triggered pass), and a FOREIGN
+        # write whose rv lands between the reconcile's read watermark
+        # and its own later write's rv would be treated as covered and
+        # deduped forever (the backstop cannot heal it: the sweep
+        # carries the object's current rv, which the lying watermark
+        # also covers).
+        version = (None if ev.type == Event.DELETED
+                   else ev.object.metadata.resource_version)
         for key in watch.mapper(ev.object):
             if traced:
                 self._stamp_event_span(ev, key)
             if watch.delay > 0:
-                self.queue.add_after(key, watch.delay)
+                self.queue.add_after(key, watch.delay, version=version)
             else:
-                self.queue.add(key)
+                self.queue.add(key, version=version)
 
     def _stamp_event_span(self, ev: Event, key: ReconcileKey) -> None:
         """Root a trace at the watch event so the worker's reconcile span
@@ -270,21 +374,56 @@ class Controller:
             t.start()
             self._threads.append(t)
 
-    def _enqueue_all(self):
+    def _effective_resync_period(self) -> float:
+        if self.legacy_resync or self.backstop_period is None:
+            return self.resync_period
+        return self.backstop_period
+
+    def _recent_snapshot(self) -> set:
+        """Swap out the keys handled since the last backstop tick."""
+        with self._recent_lock:
+            recent, self._recent_keys = self._recent_keys, set()
+        return recent
+
+    def _note_recent(self, key) -> None:
+        with self._recent_lock:
+            self._recent_keys.add(key)
+
+    def _enqueue_all(self, backstop: bool = False):
+        """Sweep every watched object into the queue (initial LIST sync;
+        periodic drift backstop). Adds carry the object's CURRENT rv so a
+        key whose last reconcile already covered that rv dedups at
+        dequeue. ``backstop=True`` additionally skips keys the event path
+        reconciled since the previous tick — the sweep then only touches
+        keys that DRIFTED (no event, no reconcile)."""
+        recent = self._recent_snapshot() if backstop else frozenset()
+        enq = skip = 0
         for w in self.watches():
             if w.kind == "*":
                 continue
             for obj in self.store.list(w.kind, namespace=None, copy_=False):
+                rv = obj.metadata.resource_version
                 for key in w.mapper(obj):
-                    self.queue.add(key)
+                    if key in recent:
+                        skip += 1
+                        continue
+                    enq += 1
+                    self.queue.add(key, version=rv)
+        if backstop:
+            if enq:
+                REGISTRY.inc(obs_names.RESYNC_BACKSTOP_ENQUEUED_TOTAL,
+                             float(enq), controller=self.name)
+            if skip:
+                REGISTRY.inc(obs_names.RESYNC_BACKSTOP_SKIPPED_TOTAL,
+                             float(skip), controller=self.name)
 
     def _resync_loop(self):
         # Event-wait, not sleep: stop() must not leave this thread parked
         # for a full resync period (300 s of leaked thread per controller
         # per test plane, before the fix).
-        while not self._stop_event.wait(self.resync_period):
+        while not self._stop_event.wait(self._effective_resync_period()):
             try:
-                self._enqueue_all()
+                self._enqueue_all(backstop=not self.legacy_resync)
             except Exception:
                 pass
 
@@ -301,6 +440,33 @@ class Controller:
                 # post-stop reconciles churn against backends that are
                 # themselves stopping.
                 return
+            # Generation dedup: every pending trigger for this key is
+            # claimed; if the newest one is already covered by the last
+            # completed reconcile's watermark (and nothing FORCED a
+            # revisit — requeue_after, error backoff, tombstones), the
+            # dequeue is a counted no-op. Coalesced stale events and
+            # backstop sweeps of unchanged objects land here instead of
+            # in reconcile (a self-write's retrigger runs ONCE — see
+            # _on_event — then its duplicates dedup here).
+            version, forced = self.queue.claim(key)
+            if (not forced and not self.legacy_resync
+                    and version is not None
+                    and (wm := self.queue.watermark(key)) is not None
+                    and version <= wm):
+                REGISTRY.inc(names.RECONCILE_DEDUPED_TOTAL,
+                             controller=self.name)
+                self._note_recent(key)
+                ev_root = self._take_event_span(key)
+                if ev_root is not None:
+                    ev_root.end(outcome="deduped")
+                self.queue.done(key)
+                continue
+            # Watermark this reconcile will commit on success: the store's
+            # global rv BEFORE the reconcile body reads anything — every
+            # write ≤ it is visible to those reads. The reconcile's own
+            # writes mint HIGHER rvs, so they re-trigger one no-op pass
+            # that advances the watermark honestly (see _on_event).
+            rv_before = self.store.current_rv()
             # Reconcile span: child of the pending watch-event root when
             # one exists (event→reconcile as one tree), its own sampled
             # root for resync/initial-list origins.
@@ -322,6 +488,7 @@ class Controller:
                 with trace.use_span(span):
                     res = self.reconcile(self.store, key)
                 self.backoff.forget(key)
+                self.queue.mark_reconciled(key, rv_before)
                 REGISTRY.inc(names.RECONCILE_TOTAL, controller=self.name,
                              result="success")
                 requeue_after = (res.requeue_after if res is not None
@@ -354,10 +521,17 @@ class Controller:
                          retry_in_s=round(delay, 4))
                 self.queue.add_after(key, delay)
             finally:
-                REGISTRY.observe(names.RECONCILE_DURATION_SECONDS,
-                                 _time.perf_counter() - t0,
+                self._note_recent(key)
+                dur = _time.perf_counter() - t0
+                REGISTRY.observe(names.RECONCILE_DURATION_SECONDS, dur,
                                  exemplar=(span.trace_id or None),
                                  controller=self.name)
+                hook = Controller.reconcile_duration_hook
+                if hook is not None:
+                    try:
+                        hook(self.name, dur)
+                    except Exception:
+                        pass
                 REGISTRY.set_gauge(names.WORKQUEUE_RETRIES_PENDING,
                                    float(self.backoff.pending_count()),
                                    controller=self.name)

@@ -200,7 +200,11 @@ class DisruptionController(Controller):
     workers = 2
     # Deadlines are wall-clock: the resync backstop alone (300 s) would
     # sleep through a notice window; active slices self-requeue instead.
+    # Event-carried mode demotes the sweep to 60 s — active state
+    # machines carry their own requeue_after, so the sweep only covers
+    # drift (a lost event on an otherwise idle slice).
     resync_period = 30.0
+    backstop_period = 60.0
 
     def __init__(self, store: Store, node_binding=None, spares=None,
                  kv_directory=None):

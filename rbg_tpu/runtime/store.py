@@ -80,6 +80,12 @@ class Conflict(Exception):
     """resourceVersion mismatch (optimistic concurrency failure)."""
 
 
+class WatchExpired(Exception):
+    """A ``watch(since_rv=...)`` resume point fell behind the bounded
+    event log (the 410 Gone / etcd-compaction analog) — the caller must
+    fall back to a full re-list before re-subscribing."""
+
+
 class AlreadyExists(Exception):
     pass
 
@@ -103,6 +109,20 @@ class Event:
         return f"Event({self.type}, {self.object.kind}/{m.namespace}/{m.name})"
 
 
+class _Watcher:
+    """One subscription. ``buffer`` is non-None while the watcher is in
+    its replay window (``watch(since_rv=...)``): live events landing
+    during the replay are parked here and drained IN ORDER before the
+    watcher goes live — the list→watch gap closes without ever
+    dispatching under the store lock."""
+
+    __slots__ = ("fn", "buffer")
+
+    def __init__(self, fn, buffering: bool = False):
+        self.fn = fn
+        self.buffer: Optional[list] = [] if buffering else None
+
+
 @_race_guard
 class Store:
     # Label keys served from an index by ``list(selector=...)`` (reference:
@@ -120,7 +140,14 @@ class Store:
         self._label_index: Dict[Tuple[str, str, str], set] = defaultdict(set)
         self._rv = 0  # guarded_by[runtime.store]
         # guarded_by[runtime.store]
-        self._watchers: Dict[str, List[Callable[[Event], None]]] = defaultdict(list)
+        self._watchers: Dict[str, List[_Watcher]] = defaultdict(list)
+        # Bounded event replay log: (rv, Event), rv strictly increasing
+        # (hard deletes mint a fresh rv so DELETED is replayable and
+        # orderable like any write). ``_log_floor`` is the newest rv the
+        # log can no longer prove coverage past — resumes at or before it
+        # raise WatchExpired.  # guarded_by[runtime.store]
+        self._event_log: List[Tuple[int, Event]] = []
+        self._log_floor = 0  # guarded_by[runtime.store]
         # owner uid -> keys  # guarded_by[runtime.store]
         self._owner_index: Dict[str, set] = defaultdict(set)
         # live object uids (O(1) owner-exists checks)  # guarded_by[runtime.store]
@@ -194,10 +221,40 @@ class Store:
             self._index_remove(k, old)
             self._index_add(k, new)
 
-    def _notify(self, ev: Event):
-        # Snapshot subscribers under lock; dispatch outside to avoid deadlocks.
+    # Replay-log bound: at fleet scale (10k nodes / 100k pods) the log is
+    # a ring, not a history — a resumer further behind than this re-lists.
+    WATCH_LOG_MAX = 8192
+
+    def _log_event(self, ev: Event) -> None:
+        """Append to the replay log (store lock held). Caller guarantees
+        ``ev.object.metadata.resource_version`` was minted for this event
+        (hard deletes included), so log order == rv order."""
+        self._event_log.append((ev.object.metadata.resource_version, ev))
+        if len(self._event_log) > self.WATCH_LOG_MAX:
+            drop = max(1, self.WATCH_LOG_MAX // 4)
+            self._log_floor = self._event_log[drop - 1][0]
+            del self._event_log[:drop]
+
+    def current_rv(self) -> int:
+        """The store's global write watermark. Snapshot this BEFORE a
+        list to later resume a watch gap-free (``watch(since_rv=...)``),
+        or before a reconcile body to know which queued trigger versions
+        that reconcile's store reads already cover."""
         with self._lock:
-            subs = list(self._watchers.get(ev.object.kind, ())) + list(self._watchers.get("*", ()))
+            return self._rv
+
+    def _notify(self, ev: Event):
+        # Snapshot subscribers under lock; dispatch outside to avoid
+        # deadlocks. Watchers still inside their replay window buffer the
+        # event instead (drained in order before they go live).
+        with self._lock:
+            subs = []
+            for w in (list(self._watchers.get(ev.object.kind, ()))
+                      + list(self._watchers.get("*", ()))):
+                if w.buffer is not None:
+                    w.buffer.append(ev)
+                else:
+                    subs.append(w.fn)
         # The event carries the stored object WITHOUT copying (the
         # no-deepcopy informer, ``pkg/utils/client/no_deepcopy_lister.go``):
         # update/mutate always insert fresh objects, never mutate in place,
@@ -224,10 +281,47 @@ class Store:
 
     # ---- watch ----
 
-    def watch(self, kind: str, handler: Callable[[Event], None]) -> None:
-        """Subscribe to events for ``kind`` ("*" = all kinds)."""
+    def watch(self, kind: str, handler: Callable[[Event], None],
+              since_rv: Optional[int] = None) -> None:
+        """Subscribe to events for ``kind`` ("*" = all kinds).
+
+        ``since_rv``: resume watermark — replay every retained event for
+        ``kind`` with rv > since_rv to ``handler`` (synchronously, on this
+        thread) before going live, with NO gap: events published while the
+        replay runs are buffered and drained in order. This is the
+        reflector re-subscription path — a subscriber that snapshotted
+        ``current_rv()`` before a list can register afterwards without
+        losing the writes that landed in between. Raises ``WatchExpired``
+        when the bounded log no longer covers ``since_rv`` (caller must
+        re-list, then subscribe from the fresh watermark)."""
+        if since_rv is None:
+            with self._lock:
+                self._watchers[kind].append(_Watcher(handler))
+            return
+        w = _Watcher(handler, buffering=True)
         with self._lock:
-            self._watchers[kind].append(handler)
+            if since_rv < self._log_floor:
+                raise WatchExpired(
+                    f"resume rv {since_rv} predates log floor "
+                    f"{self._log_floor}")
+            replay = [ev for rv, ev in self._event_log
+                      if rv > since_rv
+                      and (kind == "*" or ev.object.kind == kind)]
+            self._watchers[kind].append(w)
+        while True:
+            for ev in replay:
+                REGISTRY.inc(obs_names.WATCH_REPLAYS_TOTAL,
+                             kind=ev.object.kind)
+                try:
+                    handler(ev)
+                except Exception:  # parity with _notify: never poison
+                    import traceback
+                    traceback.print_exc()
+            with self._lock:
+                if not w.buffer:
+                    w.buffer = None  # live: future events dispatch directly
+                    return
+                replay, w.buffer = w.buffer, []
 
     # ---- CRUD ----
 
@@ -256,7 +350,9 @@ class Store:
             self._objects[k] = obj
             self._index_add(k, obj)
             self._bump_kind(k[0])
-        self._notify(Event(Event.ADDED, obj))
+            ev = Event(Event.ADDED, obj)
+            self._log_event(ev)
+        self._notify(ev)
         return copy.deepcopy(obj)
 
     def get(self, kind: str, namespace: str, name: str, copy_: bool = True):
@@ -363,7 +459,9 @@ class Store:
             self._objects[k] = obj
             self._reindex(k, cur, obj)
             self._bump_kind(k[0])
-        self._notify(Event(Event.MODIFIED, obj, old=cur))
+            ev = Event(Event.MODIFIED, obj, old=cur)
+            self._log_event(ev)
+        self._notify(ev)
         return obj if _owned else copy.deepcopy(obj)
 
     def update_status(self, obj, _owned: bool = False):
@@ -385,7 +483,9 @@ class Store:
             new.metadata.resource_version = self._next_rv()
             self._objects[k] = new
             self._bump_kind(k[0])
-        self._notify(Event(Event.MODIFIED, new, old=cur))
+            ev = Event(Event.MODIFIED, new, old=cur)
+            self._log_event(ev)
+        self._notify(ev)
         return new if _owned else copy.deepcopy(new)
 
     def mutate(self, kind: str, namespace: str, name: str, fn, status: bool = False,
@@ -431,8 +531,18 @@ class Store:
             else:
                 del self._objects[k]
                 self._index_remove(k, cur)
+                # Mint a fresh rv for the DELETED event (etcd assigns a
+                # mod-revision to deletes too): the tombstone must order
+                # AFTER every prior write so rv-watermark consumers (the
+                # workqueue dedup, watch-resume replay) can never treat a
+                # delete as already-covered stale state. Shallow-copy so
+                # earlier MODIFIED events' aliased snapshot keeps its rv.
+                cur = copy.copy(cur)
+                cur.metadata = copy.copy(cur.metadata)
+                cur.metadata.resource_version = self._next_rv()
                 ev = Event(Event.DELETED, cur)
             self._bump_kind(kind)
+            self._log_event(ev)
         self._notify(ev)
         if ev.type == Event.DELETED:
             self._gc_owned(cur.metadata.uid)

@@ -68,6 +68,25 @@ class CapacityCache:
         # Tombstones that already survived one rebuild (dropped on the next).
         # guarded_by[sched.capacity_cache]
         self._aged_tombstones: set = set()
+        # ---- topology-sharded feasibility index (event-maintained) ----
+        # Every structure below is recomputed incrementally by wrapping
+        # each node/bound/tpu_bound mutation in _unindex_node/_index_node,
+        # so `_place` can prune whole slices and argmax free capacity
+        # without touching the full node list.
+        # slice_id -> node names of the slice  # guarded_by[sched.capacity_cache]
+        self._slices: Dict[str, set] = {}
+        # slice_id -> hosts that could take a NEW slice pod right now
+        # (schedulable, free>0, no slice pod bound) — an UPPER bound on
+        # any pod-filtered host count, so pruning shards below the gang
+        # size is exact.  # guarded_by[sched.capacity_cache]
+        self._slice_placeable: Dict[str, int] = {}
+        # free-pod-count -> names of placeable nodes (schedulable, free>0)
+        # — the singles-path argmax index.  # guarded_by[sched.capacity_cache]
+        self._free_buckets: Dict[int, set] = {}
+        # name -> tombstone rv of a DELETED node (hard deletes mint a
+        # fresh rv, so any event older than the tombstone is stale).
+        # Cleared on rebuild.  # guarded_by[sched.capacity_cache]
+        self._node_tombstones: Dict[str, int] = {}
         self._started = False
 
     # ---- lifecycle ----
@@ -76,9 +95,25 @@ class CapacityCache:
         if self._started:
             return
         self._started = True
-        self.store.watch("Pod", self._on_pod)
-        self.store.watch("Node", self._on_node)
+        # List-then-watch with a resume watermark: snapshot the store rv,
+        # build from the list, then subscribe replaying everything after
+        # the snapshot — a write landing between the list and the watch
+        # registration is REPLAYED, never dropped (the rv-ordered _apply
+        # makes redelivery of already-listed state a no-op). WatchExpired
+        # (bounded log outran the gap) falls back to live-watch + a second
+        # rebuild, which covers the gap by re-listing.
+        from rbg_tpu.runtime.store import WatchExpired
+        rv0 = self.store.current_rv()
         self.rebuild()
+        expired = False
+        for kind, fn in (("Pod", self._on_pod), ("Node", self._on_node)):
+            try:
+                self.store.watch(kind, fn, since_rv=rv0)
+            except WatchExpired:
+                self.store.watch(kind, fn)
+                expired = True
+        if expired:
+            self.rebuild()
 
     def rebuild(self):
         """Full resync from the store (drift backstop; also initial build)."""
@@ -100,6 +135,12 @@ class CapacityCache:
             self._tpu_bound.clear()
             self._excl.clear()
             self._contrib.clear()
+            self._slices.clear()
+            self._slice_placeable.clear()
+            self._free_buckets.clear()
+            self._node_tombstones.clear()
+            for name in self._nodes:
+                self._index_node(name)
             for uid in keep:
                 self._contrib[uid] = (None, None)
             for pod in pods:
@@ -122,11 +163,46 @@ class CapacityCache:
         from rbg_tpu.runtime.store import Event
         node = ev.object
         with self._lock:
-            if ev.type == Event.DELETED:
-                self._nodes.pop(node.metadata.name, None)
+            name = node.metadata.name
+            rv = node.metadata.resource_version
+            # Same rv ordering discipline _apply enforces for pods:
+            # _notify dispatches outside the store lock and the
+            # watch-resume replay path deliberately redelivers, so a
+            # late-dispatched OLDER node event must never overwrite
+            # newer cached state (a stale "uncordoned" snapshot landing
+            # after the cordon would hand the sharded scan a node the
+            # store says is unschedulable).
+            cur = self._nodes.get(name)
+            tomb = self._node_tombstones.get(name)
+            if tomb is not None:
+                if rv <= tomb:
+                    return  # pre-delete stragglers of a deleted node
+                self._node_tombstones.pop(name, None)  # genuine re-create
+            if (ev.type != Event.DELETED and cur is not None
+                    and rv < cur.metadata.resource_version):
                 return
-            old = self._nodes.get(node.metadata.name)
-            self._nodes[node.metadata.name] = node
+            if ev.type == Event.DELETED:
+                self._node_tombstones[name] = rv
+                self._unindex_node(name)
+                old = self._nodes.pop(name, None)
+                if old is not None and old.tpu.slice_id:
+                    members = self._slices.get(old.tpu.slice_id)
+                    if members is not None:
+                        members.discard(name)
+                        if not members:
+                            del self._slices[old.tpu.slice_id]
+                return
+            old = self._nodes.get(name)
+            self._unindex_node(name)
+            if (old is not None and old.tpu.slice_id
+                    and old.tpu.slice_id != node.tpu.slice_id):
+                members = self._slices.get(old.tpu.slice_id)
+                if members is not None:
+                    members.discard(name)
+                    if not members:
+                        del self._slices[old.tpu.slice_id]
+            self._nodes[name] = node
+            self._index_node(name)
             # Topology labels are immutable by convention on TPU nodepools,
             # but if one DOES change, re-derive the exclusive-topology
             # domains of pods bound to this node so existing footprints
@@ -175,6 +251,7 @@ class CapacityCache:
         if contrib is None:
             return
         node, tpu, excl = contrib
+        self._unindex_node(node)
         self._bound[node] = self._bound.get(node, 1) - 1
         if self._bound[node] <= 0:
             del self._bound[node]
@@ -191,11 +268,13 @@ class CapacityCache:
                     owners.pop(grp, None)
                 if not owners:
                     self._excl.pop((key, domain), None)
+        self._index_node(node)
 
     def _add_footprint(self, contrib: Optional[_Contrib]):
         if contrib is None:
             return
         node, tpu, excl = contrib
+        self._unindex_node(node)
         self._bound[node] = self._bound.get(node, 0) + 1
         if tpu:
             self._tpu_bound[node] = self._tpu_bound.get(node, 0) + 1
@@ -203,6 +282,47 @@ class CapacityCache:
             key, domain, grp = excl
             owners = self._excl.setdefault((key, domain), {})
             owners[grp] = owners.get(grp, 0) + 1
+        self._index_node(node)
+
+    # ---- shard-index maintenance (lock held by every caller) ----
+
+    def _index_node(self, name: str) -> None:
+        """(Re-)derive one node's index contribution from the CURRENT
+        maps. Callers bracket every mutation of ``_nodes``/``_bound``/
+        ``_tpu_bound`` with _unindex_node(old state) → mutate →
+        _index_node(new state), so contributions never drift."""
+        node = self._nodes.get(name)
+        if node is None:
+            return
+        sid = node.tpu.slice_id
+        if sid:
+            self._slices.setdefault(sid, set()).add(name)
+        free = node.capacity_pods - self._bound.get(name, 0)
+        if not node.schedulable or free <= 0:
+            return
+        self._free_buckets.setdefault(free, set()).add(name)
+        if sid and name not in self._tpu_bound:
+            self._slice_placeable[sid] = self._slice_placeable.get(sid, 0) + 1
+
+    def _unindex_node(self, name: str) -> None:
+        node = self._nodes.get(name)
+        if node is None:
+            return
+        free = node.capacity_pods - self._bound.get(name, 0)
+        if not node.schedulable or free <= 0:
+            return
+        bucket = self._free_buckets.get(free)
+        if bucket is not None:
+            bucket.discard(name)
+            if not bucket:
+                del self._free_buckets[free]
+        sid = node.tpu.slice_id
+        if sid and name not in self._tpu_bound:
+            n = self._slice_placeable.get(sid, 0) - 1
+            if n > 0:
+                self._slice_placeable[sid] = n
+            else:
+                self._slice_placeable.pop(sid, None)
 
     def apply_bind(self, pod):
         """Synchronously account a bind this scheduler just committed (pod
@@ -236,6 +356,73 @@ class CapacityCache:
         with self._lock:
             return {kd: next(iter(owners))
                     for kd, owners in self._excl.items() if owners}
+
+    # ---- sharded-scan views (the event-maintained feasibility index) ----
+
+    def node(self, name: str):
+        with self._lock:
+            return self._nodes.get(name)
+
+    def node_count(self) -> int:
+        with self._lock:
+            return len(self._nodes)
+
+    def free_of(self, name: str, default: int = 0) -> int:
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                return default
+            return node.capacity_pods - self._bound.get(name, 0)
+
+    def is_tpu_used(self, name: str) -> bool:
+        with self._lock:
+            return name in self._tpu_bound
+
+    def placeable_nodes(self) -> List[object]:
+        """Schedulable nodes with free capacity (the only nodes a single
+        placement can pick) — from the bucket index, NOT a full-node
+        scan."""
+        with self._lock:
+            return [self._nodes[n] for bucket in self._free_buckets.values()
+                    for n in bucket if n in self._nodes]
+
+    def gang_shards(self, need: int) -> Tuple[List[Tuple[str, List[object]]], int]:
+        """Slices whose placeable-host UPPER BOUND can fit a gang of
+        ``need`` hosts, with their schedulable member nodes; plus the
+        count of shards pruned. Pruning is exact: the bound counts hosts
+        by schedulable/free/slice-pod state only, and every pod-specific
+        filter the scan applies afterwards can only REMOVE hosts."""
+        with self._lock:
+            out = []
+            for sid, count in self._slice_placeable.items():
+                if count < need:
+                    continue
+                hosts = [self._nodes[n] for n in self._slices.get(sid, ())
+                         if n in self._nodes]
+                out.append((sid, [n for n in hosts if n.schedulable]))
+            skipped = len(self._slices) - len(out)
+            return out, skipped
+
+    def best_plain_node(self, exclude) -> Optional[Tuple[str, int]]:
+        """Argmax over placeable nodes by (free capacity, then lexico-
+        graphically smallest name), skipping ``exclude`` — the fast path
+        for a pod with no selector/affinity/chip/exclusivity constraints.
+        Returns (name, free) or None."""
+        with self._lock:
+            for free in sorted(self._free_buckets, reverse=True):
+                names = self._free_buckets[free]
+                inter = (exclude & names) if exclude else None
+                cand = names if not inter else names - inter
+                if cand:
+                    return min(cand), free
+            return None
+
+    def nodes_in_slices(self, slice_ids) -> set:
+        with self._lock:
+            out = set()
+            for sid in slice_ids:
+                out |= self._slices.get(sid, set())
+            return out
 
 
 @_race_guard

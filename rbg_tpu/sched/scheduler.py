@@ -35,15 +35,67 @@ def _unscheduled(ev) -> bool:
     return True  # level-triggered; reconcile re-checks everything
 
 
+class _FreeOverlay:
+    """Plan-local free-capacity view for the sharded scan: reads fall
+    through to the incremental cache, writes (the plan's own in-flight
+    binds) stay local — no per-plan O(nodes) dict copy."""
+
+    __slots__ = ("cap", "local")
+
+    def __init__(self, cap):
+        self.cap = cap
+        self.local: Dict[str, int] = {}
+
+    def get(self, name: str, default: int = 0) -> int:
+        v = self.local.get(name)
+        return v if v is not None else self.cap.free_of(name, default)
+
+    def __getitem__(self, name: str) -> int:
+        return self.get(name, 0)
+
+    def __setitem__(self, name: str, value: int) -> None:
+        self.local[name] = value
+
+    def touched(self) -> set:
+        return set(self.local)
+
+
+class _TpuUsedOverlay:
+    """Plan-local slice-pod-occupancy view (same contract as
+    _FreeOverlay: cache fallthrough reads, local adds)."""
+
+    __slots__ = ("cap", "local")
+
+    def __init__(self, cap):
+        self.cap = cap
+        self.local: set = set()
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.local or self.cap.is_tpu_used(name)
+
+    def add(self, name: str) -> None:
+        self.local.add(name)
+
+
 class SchedulerController(Controller):
     name = "scheduler"
     # Single worker: placement decisions are serialized (as in kube-scheduler's
     # one scheduling loop) so concurrent plans can never double-book a host.
     workers = 1
-    # Faster drift backstop than the 300 s controller default: an unbound
+    # Faster drift backstop than the controller default: an unbound
     # pod with no wake-up event is a stranded gang; scheduler sweeps are
-    # cheap (bound pods return in one store.get).
+    # cheap (bound pods return in one store.get). 30 s is the LEGACY
+    # cadence (the A/B baseline); event-carried mode demotes the sweep to
+    # a 60 s drift backstop that skips keys the event path already
+    # reconciled since the last tick.
     resync_period = 30.0
+    backstop_period = 60.0
+    # Topology-sharded feasibility scan (the event-maintained capacity
+    # index): prunes whole slices before visiting a host and serves the
+    # singles path from the free-capacity buckets. False = the reference
+    # full-scan path (bit-identical placements by contract; the
+    # equivalence suite and the fleet A/B run both).
+    use_sharded = True
 
     def __init__(self, store: Store, node_binding=None, spares=None):
         super().__init__(store)
@@ -60,10 +112,35 @@ class SchedulerController(Controller):
         self.spares.replenish(self.store)
         super().start()
 
+    def _enqueue_all(self, backstop: bool = False):
+        """One pass over pods — NOT the base class's per-watch sweep: the
+        Node watch's mapper lists every pod per node, which makes the
+        generic sweep O(nodes × pods) at fleet scale. Every key a node
+        event could map to is a pod key, so one pod list covers both
+        watches. Backstop ticks skip keys the event path already
+        reconciled since the last tick (satellite fix: a healthy event
+        path does zero backstop work)."""
+        recent = self._recent_snapshot() if backstop else frozenset()
+        enq = skip = 0
+        for p in self.store.list("Pod", namespace=None, copy_=False):
+            key = (p.metadata.namespace, p.metadata.name)
+            if key in recent:
+                skip += 1
+                continue
+            enq += 1
+            self.queue.add(key, version=p.metadata.resource_version)
+        if backstop:
+            if enq:
+                REGISTRY.inc(obs_names.RESYNC_BACKSTOP_ENQUEUED_TOTAL,
+                             float(enq), controller=self.name)
+            if skip:
+                REGISTRY.inc(obs_names.RESYNC_BACKSTOP_SKIPPED_TOTAL,
+                             float(skip), controller=self.name)
+
     def _resync_loop(self):
         # Piggyback the drift-backstop rebuild on the controller resync
         # (event-wait so stop() exits promptly, as in the base class).
-        while not self._stop_event.wait(self.resync_period):
+        while not self._stop_event.wait(self._effective_resync_period()):
             try:
                 self.spares.replenish(self.store)
             except Exception:
@@ -83,7 +160,7 @@ class SchedulerController(Controller):
             # Outside the try: the periodic re-enqueue must still happen
             # when the rebuild fails.
             try:
-                self._enqueue_all()
+                self._enqueue_all(backstop=not self.legacy_resync)
             except Exception:
                 import logging
                 logging.getLogger("rbg_tpu.sched").warning(
@@ -96,7 +173,7 @@ class SchedulerController(Controller):
             # Node changes can unblock pending pods — re-enqueue all pending.
             Watch("Node", lambda obj: [
                 (p.metadata.namespace, p.metadata.name)
-                for p in self.store.list("Pod")
+                for p in self.store.list("Pod", copy_=False)
                 if not p.node_name and p.active
             ]),
         ]
@@ -171,26 +248,34 @@ class SchedulerController(Controller):
 
     def _place(self, store: Store, pods: List) -> Optional[Dict[Tuple[str, str], str]]:
         """Compute {(ns, pod): node} for all pods or None (all-or-nothing).
-        All aggregates come from the incremental CapacityCache (O(nodes)
-        per plan) — the old per-decision full pod rescan made create bursts
-        scheduler-backlog-bound (VERDICT r1 item 6)."""
+        All aggregates come from the incremental CapacityCache. The
+        default path is the topology-SHARDED scan (`use_sharded`): gang
+        placement visits only slices whose free-capacity upper bound fits
+        the gang, and plain singles resolve from the free-bucket argmax —
+        bit-identical placements to the reference full scan (the
+        equivalence suite drills both paths on seeded fleets)."""
         t0 = time.perf_counter()
         try:
-            return self._place_inner(store, pods)
+            return self._place_inner(store, pods, sharded=self.use_sharded)
         finally:
-            # The feasibility-scan curve: O(nodes) per plan today; the
-            # topology-sharded scan refactor will be judged against it.
             REGISTRY.observe(obs_names.SCHED_FEASIBILITY_SCAN_SECONDS,
                              time.perf_counter() - t0)
 
-    def _place_inner(self, store: Store,
-                     pods: List) -> Optional[Dict[Tuple[str, str], str]]:
-        nodes = self.cap.ready_nodes()
-        if not nodes:
-            return None
-        free = self.cap.free_view()
-        # TPU hosts are chip-exclusive: one slice pod per host.
-        tpu_used = self.cap.tpu_used_view()
+    def _place_inner(self, store: Store, pods: List,
+                     sharded: bool = False) -> Optional[Dict[Tuple[str, str], str]]:
+        if sharded:
+            if self.cap.node_count() == 0:
+                return None
+            nodes = None  # host iteration comes from the shard index
+            free = _FreeOverlay(self.cap)
+            tpu_used = _TpuUsedOverlay(self.cap)
+        else:
+            nodes = self.cap.ready_nodes()
+            if not nodes:
+                return None
+            free = self.cap.free_view()
+            # TPU hosts are chip-exclusive: one slice pod per host.
+            tpu_used = self.cap.tpu_used_view()
         excl = self.cap.excl_view()
 
         plan: Dict[Tuple[str, str], str] = {}
@@ -213,12 +298,24 @@ class SchedulerController(Controller):
                                            plan, tpu_used, plan_slices):
                 return None
         for p in sorted(singles, key=lambda p: p.metadata.name):
-            node = self._pick_node(p, nodes, free, excl)
+            node = self._pick_single(p, nodes, free, excl)
             if node is None:
                 return None
             plan[(p.metadata.namespace, p.metadata.name)] = node
             free[node] -= 1
         return plan
+
+    def _gang_hosts(self, need: int) -> List:
+        """Sharded gang host source: only slices whose placeable-host
+        upper bound fits the gang; pruned shards are counted, never
+        visited."""
+        cands, skipped = self.cap.gang_shards(need)
+        if cands:
+            REGISTRY.inc(obs_names.SCHED_SHARD_SCANS_TOTAL,
+                         float(len(cands)))
+        if skipped > 0:
+            REGISTRY.inc(obs_names.SCHED_SHARD_SKIPS_TOTAL, float(skipped))
+        return [n for _, hosts in cands for n in hosts]
 
     def _place_slice_group(self, store, group, nodes, free, excl, plan,
                            tpu_used, plan_slices) -> bool:
@@ -230,7 +327,18 @@ class SchedulerController(Controller):
         ns = group[0].metadata.namespace
         inst = group[0].metadata.labels.get(C.LABEL_INSTANCE_NAME, "")
         ordinal = group[0].metadata.labels.get(C.LABEL_SLICE_ORDINAL, "0")
-        node_by = {n.metadata.name: n for n in nodes}
+        need = len(group)
+        if nodes is not None:
+            node_by = {n.metadata.name: n for n in nodes}
+            lookup = node_by.get
+        else:
+            # Sharded path: resolve sibling hosts from the cache, with
+            # the same schedulable membership the legacy ready-node map
+            # had — an unschedulable sibling host must stay invisible
+            # here exactly as it was invisible in ready_nodes().
+            def lookup(name):
+                n = self.cap.node(name)
+                return n if n is not None and n.schedulable else None
         # Siblings share the RoleInstance controller-owner — the owner-uid
         # index makes this O(gang) instead of an O(namespace) label scan.
         ref = group[0].metadata.controller_owner()
@@ -248,7 +356,7 @@ class SchedulerController(Controller):
         forbidden_slices = set()
         for p in all_siblings:
             if p.metadata.labels.get(C.LABEL_SLICE_ORDINAL, "0") != ordinal:
-                n = node_by.get(p.node_name)
+                n = lookup(p.node_name)
                 if n is not None and n.tpu.slice_id:
                     forbidden_slices.add(n.tpu.slice_id)
         key_ = (ns, inst)
@@ -257,7 +365,7 @@ class SchedulerController(Controller):
                 forbidden_slices.add(sid)
         sibling_slice = ""
         for p in siblings:
-            n = node_by.get(p.node_name)
+            n = lookup(p.node_name)
             if n is not None and n.tpu.slice_id:
                 sibling_slice = n.tpu.slice_id
                 break
@@ -265,9 +373,8 @@ class SchedulerController(Controller):
         group = sorted(
             group, key=lambda p: int(p.metadata.labels.get(C.LABEL_COMPONENT_INDEX, "0"))
         )
-        need = len(group)
         slices = collections.defaultdict(list)
-        for n in nodes:
+        for n in (nodes if nodes is not None else self._gang_hosts(need)):
             name = n.metadata.name
             if (n.tpu.slice_id and n.tpu.slice_id not in forbidden_slices
                     and self._node_ok(group[0], n, excl)
@@ -296,8 +403,11 @@ class SchedulerController(Controller):
                 yield preferred, slices[preferred]
             if sibling_slice:
                 return  # bound siblings pin the ICI domain — no other slice is legal
-            # Emptiest-first: keep fragmentation low, leave room for big gangs.
-            for sid, hosts in sorted(slices.items(), key=lambda kv: -len(kv[1])):
+            # Emptiest-first (slice id breaks ties deterministically so
+            # the sharded and reference scans order identically): keep
+            # fragmentation low, leave room for big gangs.
+            for sid, hosts in sorted(slices.items(),
+                                     key=lambda kv: (-len(kv[1]), kv[0])):
                 if sid != preferred and (include_reserved
                                          or sid not in reserved):
                     yield sid, hosts
@@ -339,16 +449,59 @@ class SchedulerController(Controller):
         return all(self._term_satisfied(t, n)
                    for t in pod.affinity if t.required)
 
+    def _pick_single(self, pod, nodes, free, excl) -> Optional[str]:
+        """Single-pod placement dispatch: the reference full scan when a
+        node list was materialized (legacy path), otherwise the shard
+        index — free-bucket argmax for unconstrained pods, an indexed
+        scan over only-placeable nodes for everything else."""
+        if nodes is not None:
+            return self._pick_node(pod, nodes, free, excl)
+        if self._plain_pod(pod) and not self.spares.held_slices():
+            return self._pick_plain_fast(free)
+        return self._pick_node(pod, self.cap.placeable_nodes(), free, excl)
+
+    @staticmethod
+    def _plain_pod(pod) -> bool:
+        """No selector, no affinity terms, no chip demand, no exclusive
+        topology: every placeable node qualifies and scores exactly its
+        free capacity — the bucket argmax IS the full scan's answer."""
+        if pod.template.node_selector or pod.affinity:
+            return False
+        if (pod.template.containers
+                and pod.template.containers[0].resources.tpu_chips):
+            return False
+        return not pod.metadata.annotations.get(C.ANN_EXCLUSIVE_TOPOLOGY)
+
+    def _pick_plain_fast(self, free: "_FreeOverlay") -> Optional[str]:
+        """(max free, then min name) over placeable nodes: the bucket
+        index answers for untouched nodes; nodes this plan already bound
+        onto are re-scored at their overlay value."""
+        touched = free.touched()
+        best = self.cap.best_plain_node(touched)
+        b_name, b_free = best if best is not None else (None, 0)
+        for name in touched:
+            f = free[name]
+            if f <= 0:
+                continue
+            n = self.cap.node(name)
+            if n is None or not n.schedulable:
+                continue
+            if (b_name is None or f > b_free
+                    or (f == b_free and name < b_name)):
+                b_name, b_free = name, f
+        return b_name
+
     def _pick_node(self, pod, nodes, free, excl) -> Optional[str]:
         best, best_score = None, None
         reserved = self.spares.held_slices()
         for n in nodes:
-            if free.get(n.metadata.name, 0) <= 0 or not self._node_ok(pod, n, excl):
+            name = n.metadata.name
+            if free.get(name, 0) <= 0 or not self._node_ok(pod, n, excl):
                 continue
             # Required affinity filters candidates; preferred terms score.
             if not self._required_affinity_ok(pod, n):
                 continue
-            score = free[n.metadata.name]
+            score = free[name]
             # Spare-pool hosts sort last: a single pod landing on a warm
             # spare makes that slice non-idle (gone from the pool on the
             # next replenish) — only use one when nothing else fits.
@@ -357,8 +510,11 @@ class SchedulerController(Controller):
             for term in pod.affinity:
                 if not term.required and self._term_satisfied(term, n):
                     score += 1000 * term.weight
-            if best_score is None or score > best_score:
-                best, best_score = n.metadata.name, score
+            # Name breaks score ties so the sharded scan (which visits
+            # nodes in index order, not list order) picks identically.
+            if (best_score is None or score > best_score
+                    or (score == best_score and name < best)):
+                best, best_score = name, score
         return best
 
     def _node_ok(self, pod, node, excl) -> bool:

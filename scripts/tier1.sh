@@ -122,11 +122,12 @@ assert r.get('bit_identical'), 'streamed decode diverged from reference'
     # the baseline the watch/informer refactor will be judged against.
     # Outside the 870 s pytest budget, --lint mode only.
     echo "== rbg-tpu stress --scenario fleet --nodes 500 (control-plane smoke) =="
-    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
-            stress --scenario fleet --nodes 500 --groups 24 --json \
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 480 python -m rbg_tpu.cli.main \
+            stress --scenario fleet --nodes 500 --groups 24 \
+            --ab-reps 2 --ab-groups 12 --json \
             >/tmp/_t1_fleet.json; then
         echo "TIER1 FLEET SMOKE FAILED — see /tmp/_t1_fleet.json" \
-             "(invariants)" >&2
+             "(invariants incl. the legacy-vs-event A/B gate)" >&2
         exit 1
     fi
     if ! python -c "
@@ -138,12 +139,22 @@ assert inv.get('no_stuck_keys'), 'stuck keys: %s' % r.get('stuck_keys')
 assert inv.get('events_accounted'), 'event recorder lost occurrences: %s' \
     % r.get('events')
 assert r.get('reconcile_latency'), 'reconcile-latency curves are empty'
-assert any(c.get('binds_per_s', 0) > 0
-           for c in r.get('throughput_curve') or []), \
-    'scheduler-throughput curve is empty'
+# Scheduler-throughput floor: a 24-group wave (96 pods) over a ~2 s bind
+# window must clear 10 binds/s at peak, or the scheduler regressed.
+peak = max((c.get('binds_per_s', 0)
+            for c in r.get('throughput_curve') or []), default=0)
+assert peak >= 10, 'scheduler-throughput floor: peak %.1f binds/s < 10' % peak
+# Legacy-vs-event A/B: section present, non-empty, every rep completed.
+ab = r.get('legacy_vs_event') or {}
+assert ab.get('reps'), 'legacy-vs-event A/B section missing or empty'
+assert all(len(v) > 0 for v in ab['reps'].values()), 'A/B reps missing'
+assert ab.get('reps_ok'), 'an A/B repetition failed to complete'
+assert (ab.get('median') or {}).get('event', {}).get('deduped_total', 0) \
+    > 0, 'event-mode reps recorded zero dedup — event plane not engaged'
 "; then
-        echo "TIER1 FLEET SMOKE FAILED — drained/stuck-keys/events or" \
-             "empty curves in /tmp/_t1_fleet.json" >&2
+        echo "TIER1 FLEET SMOKE FAILED — drained/stuck-keys/events, the" \
+             "throughput floor, or the legacy-vs-event A/B section in" \
+             "/tmp/_t1_fleet.json" >&2
         exit 1
     fi
     # Live windowed-signal render: boot a tiny engine server, push one

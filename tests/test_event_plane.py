@@ -1,0 +1,401 @@
+"""Event-carried control plane: watch-resume watermarks on the store,
+generation dedup in the workqueue, drift-backstop skip accounting, the
+watch-driven k8s node sync, and the legacy_resync A/B toggle.
+
+The dedup-safety property drilled here is the one the refactor must
+never break: the NEWEST generation of an object is never skipped — a
+dequeued key is a no-op only when a COMPLETED reconcile already observed
+store state at least as new as every pending trigger.
+"""
+
+import threading
+import time
+
+import pytest
+
+from rbg_tpu.api.pod import Pod
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.runtime.controller import Controller, Result, Watch, own_keys
+from rbg_tpu.runtime.store import Store, WatchExpired
+from rbg_tpu.testutil import make_tpu_nodes
+
+
+def _pod(name, ns="default"):
+    p = Pod()
+    p.metadata.name = name
+    p.metadata.namespace = ns
+    return p
+
+
+# ---- store watch resume ----------------------------------------------------
+
+
+def test_watch_resume_covers_list_to_watch_gap():
+    """The reflector pattern: snapshot rv, list, and only THEN subscribe
+    — a write landing in the gap must be replayed, not dropped."""
+    store = Store()
+    store.create(_pod("a"))
+    rv0 = store.current_rv()
+    listed = [p.metadata.name for p in store.list("Pod")]
+    assert listed == ["a"]
+    # The gap write: lands after the list snapshot, before the watch.
+    store.create(_pod("gap"))
+    seen = []
+    store.watch("Pod", lambda ev: seen.append(
+        (ev.type, ev.object.metadata.name)), since_rv=rv0)
+    assert ("ADDED", "gap") in seen
+    # Live events flow after the replay drained.
+    store.create(_pod("live"))
+    assert ("ADDED", "live") in seen
+
+
+def test_watch_resume_replays_in_order_and_counts():
+    store = Store()
+    base = REGISTRY.counter(obs_names.WATCH_REPLAYS_TOTAL, kind="Pod")
+    rv0 = store.current_rv()
+    store.create(_pod("x"))
+    store.mutate("Pod", "default", "x",
+                 lambda p: setattr(p.status, "phase", "Running") or True,
+                 status=True)
+    store.delete("Pod", "default", "x")
+    seen = []
+    store.watch("Pod", lambda ev: seen.append(ev.type), since_rv=rv0)
+    assert seen == ["ADDED", "MODIFIED", "DELETED"]
+    assert REGISTRY.counter(obs_names.WATCH_REPLAYS_TOTAL,
+                            kind="Pod") - base == 3
+
+
+def test_watch_resume_expired_after_log_trim():
+    small = Store()
+    small_log_max = 16
+    small.WATCH_LOG_MAX = small_log_max  # shrink per-instance
+    rv0 = small.current_rv()
+    for i in range(small_log_max * 3):
+        small.create(_pod(f"p{i}"))
+    with pytest.raises(WatchExpired):
+        small.watch("Pod", lambda ev: None, since_rv=rv0)
+    # A fresh watermark (post-trim) still resumes fine.
+    rv1 = small.current_rv()
+    small.create(_pod("tail"))
+    seen = []
+    small.watch("Pod", lambda ev: seen.append(ev.object.metadata.name),
+                since_rv=rv1)
+    assert seen == ["tail"]
+
+
+def test_hard_delete_mints_fresh_rv():
+    """DELETED events order after every prior write: rv-watermark
+    consumers (workqueue dedup, replay) must never see a tombstone as
+    already-covered stale state."""
+    store = Store()
+    obj = store.create(_pod("d"))
+    rv_create = obj.metadata.resource_version
+    events = []
+    store.watch("Pod", lambda ev: events.append(ev))
+    store.delete("Pod", "default", "d")
+    deleted = [ev for ev in events if ev.type == "DELETED"]
+    assert deleted and (deleted[0].object.metadata.resource_version
+                        > rv_create)
+
+
+def test_capacity_cache_start_survives_injected_gap_write(monkeypatch):
+    """A bind injected between the cache's rebuild list and its watch
+    registration is replayed by the resume watermark — the cache
+    converges without any further event."""
+    from rbg_tpu.sched.capacity import CapacityCache
+    store = Store()
+    make_tpu_nodes(store, slices=1, hosts_per_slice=2)
+    cap = CapacityCache(store)
+    orig_rebuild = CapacityCache.rebuild
+
+    def rebuild_then_write(self):
+        orig_rebuild(self)
+        # The gap: a pod binds after the list snapshot was consumed.
+        p = _pod("gapper")
+        p.node_name = "slice-0-host-0"
+        store.create(p)
+        monkeypatch.setattr(CapacityCache, "rebuild", orig_rebuild)
+
+    monkeypatch.setattr(CapacityCache, "rebuild", rebuild_then_write)
+    cap.start()
+    assert cap.free_view()["slice-0-host-0"] == 63
+
+
+# ---- workqueue dedup -------------------------------------------------------
+
+
+class _Recorder(Controller):
+    """Reconciles Pods, recording the store rv observed per reconcile."""
+
+    name = "recorder"
+    workers = 2
+    resync_period = 0  # event-driven only unless a test says otherwise
+
+    def __init__(self, store, write_status=False, requeue=None):
+        super().__init__(store)
+        self.write_status = write_status
+        self.requeue = requeue
+        self.observed = []  # (key, rv at read time)
+        self._obs_lock = threading.Lock()
+
+    def watches(self):
+        return [Watch("Pod", own_keys)]
+
+    def reconcile(self, store, key):
+        rv = store.current_rv()
+        with self._obs_lock:
+            self.observed.append((key, rv))
+        if self.write_status:
+            obj = store.get("Pod", *key, copy_=False)
+            if obj is not None:
+                # Idempotent status write (level-triggered discipline):
+                # second pass is a no-op → no event → convergence.
+                def fn(p):
+                    if p.status.reason == "seen":
+                        return False
+                    p.status.reason = "seen"
+                    return True
+                store.mutate("Pod", *key, fn, status=True)
+        if self.requeue is not None:
+            return Result(requeue_after=self.requeue)
+        return None
+
+
+def _deduped(name):
+    return REGISTRY.counter(obs_names.RECONCILE_DEDUPED_TOTAL,
+                            controller=name)
+
+
+def _wait(fn, timeout=5.0, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out: {desc}")
+
+
+def test_newest_generation_never_skipped_under_coalescing_storm():
+    """Property: after an update storm plus requeue-after churn settles,
+    the LAST completed reconcile observed store state at least as new as
+    the final write — dedup may collapse the middle, never the end."""
+    store = Store()
+    ctrl = _Recorder(store, requeue=0.005)
+    store.create(_pod("storm"))
+    ctrl.start()
+    try:
+        _wait(lambda: ctrl.observed, desc="first reconcile")
+        for i in range(60):
+            store.mutate("Pod", "default", "storm",
+                         lambda p, i=i: setattr(
+                             p.status, "reason", f"r{i}") or True,
+                         status=True)
+            if i % 7 == 0:
+                time.sleep(0.002)
+        final_rv = store.current_rv()
+        _wait(lambda: ctrl.observed and ctrl.observed[-1][1] >= final_rv,
+              desc="final write observed")
+        with ctrl._obs_lock:
+            last = ctrl.observed[-1]
+        assert last[1] >= final_rv
+    finally:
+        ctrl.stop()
+
+
+def test_stale_coalesced_events_dedup_as_counted_noops():
+    store = Store()
+    ctrl = _Recorder(store)
+    ctrl.start()
+    base_ded = _deduped(ctrl.name)
+    try:
+        store.create(_pod("c"))
+        _wait(lambda: len(ctrl.observed) >= 1, desc="create reconciled")
+        # Quiesce, then deliver a STALE trigger: enqueue with an old rv.
+        time.sleep(0.05)
+        n_before = len(ctrl.observed)
+        stale_rv = store.current_rv() - 1
+        ctrl.queue.add(("default", "c"), version=max(0, stale_rv))
+        _wait(lambda: _deduped(ctrl.name) > base_ded,
+              desc="stale trigger counted as dedup")
+        time.sleep(0.05)
+        assert len(ctrl.observed) == n_before  # reconcile did NOT run
+    finally:
+        ctrl.stop()
+
+
+def test_self_write_retriggers_once_then_duplicates_dedup():
+    """A controller's own write re-triggers EXACTLY ONE (no-op)
+    reconcile — never zero: a reconcile may rely on re-observing its own
+    state transition, and a foreign write interleaved with the self-write
+    must never be masked (the two unsound failure modes of watermark
+    self-folding). The no-op pass then advances the watermark, so
+    DUPLICATE stale triggers for the covered state dedup."""
+    store = Store()
+    ctrl = _Recorder(store, write_status=True)
+    base_ded = _deduped(ctrl.name)
+    ctrl.start()
+    try:
+        store.create(_pod("sw"))
+        # create-pass writes status → retrigger → idempotent no-op pass.
+        _wait(lambda: len(ctrl.observed) >= 2, desc="self-write retrigger")
+        time.sleep(0.1)
+        with ctrl._obs_lock:
+            runs = len(ctrl.observed)
+        assert runs == 2  # converged: no self-sustaining write loop
+        # A stale duplicate of the covered state dedups, not reconciles.
+        ctrl.queue.add(("default", "sw"), version=store.current_rv())
+        _wait(lambda: _deduped(ctrl.name) > base_ded,
+              desc="stale duplicate deduped")
+        time.sleep(0.05)
+        with ctrl._obs_lock:
+            assert len(ctrl.observed) == runs
+    finally:
+        ctrl.stop()
+
+
+def test_forced_requeue_never_deduped():
+    store = Store()
+    ctrl = _Recorder(store, requeue=0.01)
+    store.create(_pod("f"))
+    ctrl.start()
+    try:
+        _wait(lambda: len(ctrl.observed) >= 4,
+              desc="requeue_after keeps firing despite unchanged rv")
+    finally:
+        ctrl.stop()
+
+
+def test_legacy_mode_disables_dedup():
+    store = Store()
+    ctrl = _Recorder(store, write_status=True)
+    ctrl.legacy_resync = True
+    base_ded = _deduped(ctrl.name)
+    ctrl.start()
+    try:
+        store.create(_pod("lg"))
+        # Legacy: the self-write event must RUN a second reconcile.
+        _wait(lambda: len(ctrl.observed) >= 2, desc="self-write reconciled")
+        assert _deduped(ctrl.name) == base_ded
+    finally:
+        ctrl.stop()
+
+
+def test_backstop_skips_recently_reconciled_keys():
+    store = Store()
+    ctrl = _Recorder(store)
+    ctrl.resync_period = 0.2
+    ctrl.backstop_period = 0.2
+    store.create(_pod("warm"))
+    store.create(_pod("cold"))
+    base_enq = REGISTRY.counter(obs_names.RESYNC_BACKSTOP_ENQUEUED_TOTAL,
+                                controller=ctrl.name)
+    base_skip = REGISTRY.counter(obs_names.RESYNC_BACKSTOP_SKIPPED_TOTAL,
+                                 controller=ctrl.name)
+    ctrl.start()
+    try:
+        _wait(lambda: len(ctrl.observed) >= 2, desc="initial sync")
+        # Both keys were just reconciled → the first backstop tick skips
+        # them entirely.
+        _wait(lambda: REGISTRY.counter(
+            obs_names.RESYNC_BACKSTOP_SKIPPED_TOTAL,
+            controller=ctrl.name) - base_skip >= 2,
+            desc="backstop skipped recent keys")
+        # After a quiet period (no reconciles), the next tick enqueues
+        # them — and the versioned add dedups at dequeue (drift sweep of
+        # unchanged objects costs zero reconcile work).
+        n = len(ctrl.observed)
+        _wait(lambda: REGISTRY.counter(
+            obs_names.RESYNC_BACKSTOP_ENQUEUED_TOTAL,
+            controller=ctrl.name) - base_enq >= 2,
+            desc="backstop enqueued after quiet period")
+        time.sleep(0.1)
+        assert len(ctrl.observed) == n  # deduped, not reconciled
+    finally:
+        ctrl.stop()
+
+
+# ---- plane toggle + k8s node watch ----------------------------------------
+
+
+def test_plane_legacy_toggle_flags_controllers():
+    from rbg_tpu.runtime.plane import ControlPlane
+    plane = ControlPlane(backend="none", legacy_resync=True)
+    assert all(c.legacy_resync for c in plane.manager.controllers)
+    assert plane.scheduler.use_sharded is False
+    event_plane = ControlPlane(backend="none")
+    assert not any(c.legacy_resync for c in event_plane.manager.controllers)
+    assert event_plane.scheduler.use_sharded is True
+
+
+def test_k8s_node_watch_carries_disruption_without_polling():
+    """Node disruption state must reach the plane through the node WATCH
+    stream (the 2 s poll is demoted to a 60 s backstop — polling cadence
+    can no longer be what carries the signal)."""
+    from rbg_tpu.k8s import translate as T
+    from rbg_tpu.k8s.backend import K8sPodBackend
+    from rbg_tpu.k8s.client import KubeClient
+    from rbg_tpu.k8s.fake_apiserver import FakeK8sApiServer
+
+    api = FakeK8sApiServer(agent=False).start()
+    try:
+        for h in range(2):
+            api.add_node(f"w-{h}", labels={
+                T.LABEL_GKE_NODEPOOL: "pool-a",
+                T.LABEL_GKE_TPU_TOPOLOGY: "2x2",
+                T.LABEL_GKE_TPU_ACCEL: "tpu-v5-lite-podslice",
+            }, tpu=4)
+        store = Store()
+        backend = K8sPodBackend(store, KubeClient(api.url))
+        assert backend.legacy_resync is False
+        assert backend.NODE_BACKSTOP_S >= 60.0
+        backend.start()
+        try:
+            _wait(lambda: len(store.list("Node")) == 2,
+                  desc="nodes imported")
+            api.set_maintenance("pool-a", deadline_s=300.0)
+            # Well inside the 60 s backstop — only the watch can carry it.
+            _wait(lambda: all(
+                n.disruption == "maintenance"
+                for n in store.list("Node", copy_=False)),
+                timeout=10.0, desc="maintenance reached the plane via watch")
+        finally:
+            backend.stop()
+    finally:
+        api.stop()
+
+
+# ---- fleet drill (A/B + 10k slow) -----------------------------------------
+
+
+def test_fleet_ab_section_small():
+    """One interleaved A/B pair at toy scale: the section is present,
+    both reps complete with identical bind counts, and legacy mode never
+    dedups. (Dedup VOLUME is asserted at real churn scale — the tier1
+    fleet smoke — because a 16-pod rep can legitimately coalesce
+    nothing.)"""
+    from rbg_tpu.stress.harness import FleetConfig, _run_fleet_rep
+    cfg = FleetConfig(nodes=24, hosts_per_slice=4, groups=4, ab_groups=4,
+                      replicas=1, roles_per_group=1, timeout_s=60.0)
+    legacy = _run_fleet_rep(cfg, legacy=True)
+    event = _run_fleet_rep(cfg, legacy=False)
+    assert legacy["ok"] and event["ok"]
+    assert legacy["mode"] == "legacy" and event["mode"] == "event"
+    assert legacy["deduped_total"] == 0
+    assert event["binds_total"] == legacy["binds_total"] > 0
+
+
+@pytest.mark.slow
+def test_fleet_drill_10k_nodes():
+    """The acceptance-scale slow drill: 10k nodes, invariants green."""
+    from rbg_tpu.stress.harness import FleetConfig, run_fleet
+    report = run_fleet(FleetConfig(
+        nodes=10000, hosts_per_slice=4, groups=60, roles_per_group=2,
+        replicas=2, create_qps=200.0, timeout_s=240.0,
+        drain_timeout_s=120.0, ab_reps=0))
+    inv = report["invariants"]
+    assert inv["workqueue_drained"], report["workqueues"]
+    assert inv["no_stuck_keys"], report["stuck_keys"]
+    assert inv["reconcile_p99_bound"], report["reconcile_latency"]
+    assert inv["events_accounted"], report["events"]
+    assert report["fleet"]["nodes"] == 10000

@@ -76,6 +76,19 @@ ANN_AUTOSCALE_LAST_WRITE = f"{DOMAIN}/autoscale-last-write"
 # victim ordering; absent reads as 0.
 ANN_SCALE_DOWN_COST = f"{DOMAIN}/scale-down-cost"
 
+# ---- adaptive topology contract (aggregation <-> disaggregation) ----
+# On a RoleBasedGroup, the runtime PD-shape state machine driven by the
+# topology controller. Annotations are the ONLY persistent state — a
+# plane restart resumes a mid-flight flip from them (same discipline as
+# the migration state machine above).
+ANN_TOPOLOGY_POSTURE = f"{DOMAIN}/topology-posture"    # unified|disagg
+ANN_TOPOLOGY_STATE = f"{DOMAIN}/topology-state"        # Warming|CutOver|Draining
+ANN_TOPOLOGY_TARGET = f"{DOMAIN}/topology-target"      # unified|disagg
+ANN_TOPOLOGY_STARTED = f"{DOMAIN}/topology-flip-started"  # unix seconds
+# Roles currently eligible for NEW traffic (JSON list) — the router
+# candidacy set the cutover phase flips role-by-role.
+ANN_TOPOLOGY_SERVING = f"{DOMAIN}/topology-serving-roles"
+
 # ---- slice disruption lifecycle (GKE TPU failure domains) ----
 # On a RoleInstance, the advance-notice migration state machine driven by
 # the disruption controller: "" -> Warming -> CutOver -> (cleared).

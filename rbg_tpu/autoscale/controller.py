@@ -145,9 +145,8 @@ class AutoscaleController(Controller):
             return None
         adapters = {
             sa.spec.role_name: sa
-            for sa in store.list("ScalingAdapter", namespace=ns)
-            if sa.spec.group_name == group
-            and sa.spec.role_name in self.cfg.roles
+            for sa in store.list_for("ScalingAdapter", rbg, copy_=False)
+            if sa.spec.role_name in self.cfg.roles
             and rbg.spec.role(sa.spec.role_name) is not None
         }
         if not adapters:
@@ -248,8 +247,7 @@ class AutoscaleController(Controller):
             follower_policy = self.cfg.roles[pair.follower]
             ratio = self.reader.measured_ratio(pair.follower, pair.driver,
                                                now=now)
-            scaling = self._store_scaling_policy(ns, rbg.metadata.name,
-                                                 pair)
+            scaling = self._store_scaling_policy(rbg, pair)
             drv_raw, drv_dec, _ = out[pair.driver]
             targets, _ = coordinated_targets(
                 rbg, pair, drv_raw, follower_policy,
@@ -279,15 +277,14 @@ class AutoscaleController(Controller):
             out[pair.follower] = (fol_final, d, fol_final != fol_raw)
         return out
 
-    def _store_scaling_policy(self, ns, group, pair):
+    def _store_scaling_policy(self, rbg, pair):
         """The operator's CoordinatedScaling for this pair when one is
         declared — the autoscaler must respect it, not invent a second
         skew bound."""
-        for p in self.store.list("CoordinatedPolicy", namespace=ns,
-                                 copy_=False):
+        for p in self.store.list_for("CoordinatedPolicy", rbg,
+                                     copy_=False):
             sc = p.spec.scaling
-            if (p.spec.group_name == group and sc is not None
-                    and pair.driver in sc.roles
+            if (sc is not None and pair.driver in sc.roles
                     and pair.follower in sc.roles):
                 return sc
         return None
@@ -436,55 +433,22 @@ class AutoscaleController(Controller):
     def _grant_spares(self, store, ns, rbg, role) -> None:
         """Bind-time scale-up: steer pending TPU instances of the role
         onto reserved warm spares so new capacity serves in rebind time,
-        not provision time (the PR-3 grant seam, autoscaler-driven)."""
+        not provision time (the PR-3 grant seam, shared with the
+        topology controller via ``capacity.grant_spares_for_role``)."""
+        from rbg_tpu.sched.capacity import grant_spares_for_role
         spec = rbg.spec.role(role)
         if self.spares is None or spec is None or spec.tpu is None:
             return
-        group = rbg.metadata.name
-        took = 0
-        for inst in store.list("RoleInstance", namespace=ns, copy_=False):
-            if (inst.metadata.labels.get(C.LABEL_GROUP_NAME) != group
-                    or inst.metadata.labels.get(C.LABEL_ROLE_NAME) != role):
-                continue
-            if (inst.metadata.annotations.get(C.ANN_SLICE_BINDING)
-                    or inst.status.slice_id):
-                continue
-            target = self.spares.take(topology=spec.tpu.slice_topology)
-            if target is None:
-                break   # pool dry — still replenish below for what landed
-            took += 1
-            iname = inst.metadata.name
-            bound = {"v": False}
 
-            def fn(i, target=target):
-                bound["v"] = False  # reset: mutate retries re-run fn
-                if i.metadata.annotations.get(C.ANN_SLICE_BINDING):
-                    return False
-                i.metadata.annotations[C.ANN_SLICE_BINDING] = target
-                bound["v"] = True
-                return True
-
-            try:
-                store.mutate("RoleInstance", ns, iname, fn)
-            except (NotFound, Conflict):
-                continue   # replenish reclaims the unreferenced grant
-            if not bound["v"]:
-                # Someone bound the instance between our pre-check and
-                # the mutate (scheduler, disruption grant) — the taken
-                # spare references nothing; replenish below reclaims it.
-                continue
+        def on_grant(inst, target):
             REGISTRY.inc(names.AUTOSCALE_SPARE_GRANTS_TOTAL, role=role)
             store.record_event(
                 inst, "AutoscaleSpareGrant",
                 f"scale-up of {role} granted warm spare {target}")
-        if took:
-            # Replenish in the background so the pool does not stay
-            # shallow until the scheduler's resync — and so any take
-            # whose bind was lost returns to the re-reservable set.
-            try:
-                self.spares.replenish(store)
-            except Exception:
-                pass
+
+        grant_spares_for_role(store, self.spares, ns, rbg.metadata.name,
+                              role, spec.tpu.slice_topology,
+                              on_grant=on_grant)
 
     # ---- bookkeeping ----
 

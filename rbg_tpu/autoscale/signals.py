@@ -132,13 +132,27 @@ class SignalReader:
         coordinated-ratio policy (prefill:decode). Falls back to the
         judged-request ratio when token counters carry no role label
         (real engines label tokens per service; routers judge per role).
-        None when neither side measured."""
+
+        ``None`` means "not measured" — and that includes the case where
+        ONE side of the pair measured zero activity in the window (e.g.
+        a PD role with no judged requests). A zero side would otherwise
+        read as ratio 0 or ∞, and a consumer that steers on the ratio
+        (the coordinated autoscaler's follower target, the topology
+        policy's shape decision) would actuate on an artifact of an idle
+        window instead of a real mix. Consumers must treat ``None`` as
+        not-fresh: fall back to defaults, or HOLD — never flip."""
         w = self.window_s
         for name in (names.SERVING_TOKENS_TOTAL, names.SLO_JUDGED_TOTAL):
             num = self.sampler.rate(name, w, now=now, role=num_role)
             den = self.sampler.rate(name, w, now=now, role=den_role)
-            if num is not None and den is not None and den > 1e-9:
-                return num / den
+            if num is None or den is None:
+                continue
+            if num <= 1e-9 or den <= 1e-9:
+                # Zero measured activity on a side is absence of signal,
+                # not a measurement of 0.0 (or ∞) — report not-measured
+                # rather than fabricate a degenerate ratio.
+                return None
+            return num / den
         return None
 
     # -- internals --

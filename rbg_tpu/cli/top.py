@@ -80,6 +80,12 @@ def _collect_admin(addr: str, token: Optional[str], window: int) -> dict:
         out["controlplane"] = cp.get("controlplane")
     except (OSError, RuntimeError, ConnectionError):
         pass
+    # Topology posture panel (optional): per-group PD shape + flip state.
+    try:
+        topo = _call(addr, {"op": "topology"}, tok or None)
+        out["topology"] = topo.get("topology")
+    except (OSError, RuntimeError, ConnectionError):
+        pass
     return out
 
 
@@ -208,6 +214,27 @@ def _render_admin(src: dict, window: int) -> List[str]:
                 if sk.get("failures", 0) >= 3:
                     lines.append(f"    !! stuck {sk['key']} "
                                  f"({sk['failures']} consecutive failures)")
+    topo = src.get("topology")
+    if topo:
+        lines.append(
+            f"  topology — eval every {topo.get('eval_period_s')}s, "
+            f"window {topo.get('window_s')}s")
+        lines.append(f"  {'GROUP':<12} {'POSTURE':>8} {'STATE':>9} "
+                     f"{'ON':>3} {'COOL-S':>7}  LAST DECISION")
+        for g in topo.get("groups") or []:
+            last = g.get("last_decision") or {}
+            what = last.get("recommendation", "—")
+            if last.get("suppressed"):
+                what = f"{what}/{last['suppressed']}"
+            state = g.get("state") or "idle"
+            if g.get("target"):
+                state = f"{state}->{g['target']}"
+            lines.append(
+                f"  {g.get('group', ''):<12} {g.get('posture', '?'):>8} "
+                f"{state:>9} "
+                f"{'y' if g.get('enabled') else 'n':>3} "
+                f"{g.get('cooldown_remaining_s', 0):>7}  "
+                f"{what}: {last.get('reason', '')}")
     auto = src.get("autoscale")
     if auto:
         lines.append(

@@ -464,6 +464,10 @@ class RouterState:
             else (os.environ.get("RBG_DATA_TOKEN") or None)
         self.affinity = PrefixAffinity()
         self.retry_budget = retry_budget or RetryBudget()
+        # Topology candidacy: roles withdrawn from NEW-traffic routing by
+        # the adaptive agg↔disagg controller (in-flight work on their
+        # backends finishes untouched; set membership is GIL-atomic).
+        self._inactive_roles: set = set()
         self.metrics = {"requests": 0, "pd_requests": 0, "errors": 0,
                         "retries": 0, "failovers": 0, "affinity_hits": 0,
                         "kv_bytes_routed": 0,
@@ -515,7 +519,20 @@ class RouterState:
         from rbg_tpu.engine.protocol import token_ok
         return token_ok(obj.get("token"), self.token)
 
+    def set_role_candidacy(self, role: str, active: bool) -> None:
+        """Topology cutover seam: an inactive role's backends take no NEW
+        requests, while streams they already hold run to completion."""
+        if active:
+            self._inactive_roles.discard(role)
+        else:
+            self._inactive_roles.add(role)
+
+    def role_active(self, role: str) -> bool:
+        return role not in self._inactive_roles
+
     def candidates(self, role: str, cost=None) -> List[str]:
+        if role in self._inactive_roles:
+            return []
         backends = self.static.get(role) or self.registry.backends(role, self.group)
         live = {a for addrs in self.static.values() for a in addrs}
         live.update(e["addr"] for e in self.registry.entries().values()
@@ -561,21 +578,26 @@ class RouterState:
 
     def pd_mode(self) -> bool:
         return bool(
-            (self.static.get("prefill") or self.registry.backends("prefill", self.group))
+            self.role_active("prefill") and self.role_active("decode")
+            and (self.static.get("prefill") or self.registry.backends("prefill", self.group))
             and (self.static.get("decode") or self.registry.backends("decode", self.group))
         )
 
     def worker_role(self) -> str:
         """The unified-engine role (embed / non-PD generate)."""
         for role in ("worker", "server"):
-            if self.static.get(role) or self.registry.backends(role, self.group):
+            if self.role_active(role) and (
+                    self.static.get(role)
+                    or self.registry.backends(role, self.group)):
                 return role
         roles = {e.get("role") for e in self.registry.entries().values()}
         roles |= set(self.static)
         roles.discard("router")
         roles.discard(None)
         for r in sorted(roles):
-            if self.static.get(r) or self.registry.backends(r, self.group):
+            if self.role_active(r) and (
+                    self.static.get(r)
+                    or self.registry.backends(r, self.group)):
                 return r
         raise RuntimeError("no backends available")
 
@@ -772,6 +794,11 @@ class Handler(socketserver.BaseRequestHandler):
                 # fleet the token protects.
                 resp = {"ok": True, "pd": state.pd_mode()}
                 if state.authorized(obj):
+                    # Candidacy is fleet topology — authenticated peers
+                    # only, like the backend snapshot below.
+                    if state._inactive_roles:
+                        resp["inactive_roles"] = sorted(
+                            state._inactive_roles)
                     resp["metrics"] = state.metrics
                     resp["backends"] = state.pool.snapshot()
                     resp["draining_backends"] = state.pool.draining()

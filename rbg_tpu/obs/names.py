@@ -73,6 +73,10 @@ SCHED_BINDS_TOTAL = "rbg_sched_binds_total"
 EVENTS_RECORDED_TOTAL = "rbg_events_recorded_total"
 EVENTS_DEDUPED_TOTAL = "rbg_events_deduped_total"
 EVENTS_EVICTED_TOTAL = "rbg_events_evicted_total"
+TOPOLOGY_FLIPS_TOTAL = "rbg_topology_flips_total"
+TOPOLOGY_HOLDS_TOTAL = "rbg_topology_holds_total"
+TOPOLOGY_COST_GATED_TOTAL = "rbg_topology_cost_gated_total"
+TOPOLOGY_CONFLICTS_TOTAL = "rbg_topology_conflicts_total"
 
 # ---- gauges (last-write-wins) ----
 
@@ -91,6 +95,7 @@ KVT_DIR_ENTRIES = "rbg_kvtransfer_dir_entries"
 WORKQUEUE_DEPTH = "rbg_workqueue_depth"
 WORKQUEUE_RETRIES_PENDING = "rbg_workqueue_retries_pending"
 EVENTS_OBJECTS = "rbg_events_objects"
+TOPOLOGY_POSTURE = "rbg_topology_posture"
 
 # ---- histograms ----
 
@@ -106,6 +111,7 @@ KVT_ADMIT_LEAD_SECONDS = "rbg_kvtransfer_admit_lead_seconds"
 WORKQUEUE_QUEUE_AGE_SECONDS = "rbg_workqueue_queue_age_seconds"
 WATCH_DISPATCH_SECONDS = "rbg_watch_dispatch_seconds"
 SCHED_FEASIBILITY_SCAN_SECONDS = "rbg_sched_feasibility_scan_seconds"
+TOPOLOGY_SWITCH_DURATION_SECONDS = "rbg_topology_switch_duration_seconds"
 
 # ---- catalog sets (consumed by the lint rule and strict-mode registry) ----
 
@@ -158,6 +164,10 @@ COUNTERS = frozenset({
     EVENTS_RECORDED_TOTAL,
     EVENTS_DEDUPED_TOTAL,
     EVENTS_EVICTED_TOTAL,
+    TOPOLOGY_FLIPS_TOTAL,
+    TOPOLOGY_HOLDS_TOTAL,
+    TOPOLOGY_COST_GATED_TOTAL,
+    TOPOLOGY_CONFLICTS_TOTAL,
 })
 
 GAUGES = frozenset({
@@ -176,6 +186,7 @@ GAUGES = frozenset({
     WORKQUEUE_DEPTH,
     WORKQUEUE_RETRIES_PENDING,
     EVENTS_OBJECTS,
+    TOPOLOGY_POSTURE,
 })
 
 HISTOGRAMS = frozenset({
@@ -191,6 +202,7 @@ HISTOGRAMS = frozenset({
     WORKQUEUE_QUEUE_AGE_SECONDS,
     WATCH_DISPATCH_SECONDS,
     SCHED_FEASIBILITY_SCAN_SECONDS,
+    TOPOLOGY_SWITCH_DURATION_SECONDS,
 })
 
 ALL_NAMES = COUNTERS | GAUGES | HISTOGRAMS
@@ -335,6 +347,24 @@ HELP = {
         "Time to deliver one store event to every subscriber, per kind",
     SCHED_FEASIBILITY_SCAN_SECONDS:
         "Scheduler feasibility scan (placement plan computation) duration",
+    TOPOLOGY_POSTURE:
+        "PD shape of a role group: 0 unified, 1 disaggregated, 0.5 while "
+        "a flip is in progress",
+    TOPOLOGY_FLIPS_TOTAL:
+        "Completed topology flips, per group and target shape",
+    TOPOLOGY_HOLDS_TOTAL:
+        "Topology evaluations that held the current shape, per reason "
+        "(stale / deadband / stabilizing / cooldown / no_ratio / "
+        "low_sample)",
+    TOPOLOGY_COST_GATED_TOTAL:
+        "Topology flips vetoed because the estimated KV move cost over "
+        "measured link rates exceeded the gate",
+    TOPOLOGY_CONFLICTS_TOTAL:
+        "Topology flips backed off because another actuator's adapter "
+        "write was in flight",
+    TOPOLOGY_SWITCH_DURATION_SECONDS:
+        "Wall time of a completed topology flip (warm start to old-shape "
+        "drained), per target shape",
 }
 
 # ---- span names (obs/trace.py) ----
@@ -357,6 +387,10 @@ SPAN_KVT_COMMIT = "kvtransfer.commit"
 SPAN_STRESS_REQUEST = "stress.request"
 SPAN_CTRL_EVENT = "controller.event"
 SPAN_CTRL_RECONCILE = "controller.reconcile"
+SPAN_TOPOLOGY_FLIP = "topology.flip"
+SPAN_TOPOLOGY_WARM = "topology.warm"
+SPAN_TOPOLOGY_CUTOVER = "topology.cutover"
+SPAN_TOPOLOGY_DRAIN = "topology.drain"
 
 SPANS = frozenset({
     SPAN_HTTP_REQUEST,
@@ -372,4 +406,8 @@ SPANS = frozenset({
     SPAN_STRESS_REQUEST,
     SPAN_CTRL_EVENT,
     SPAN_CTRL_RECONCILE,
+    SPAN_TOPOLOGY_FLIP,
+    SPAN_TOPOLOGY_WARM,
+    SPAN_TOPOLOGY_CUTOVER,
+    SPAN_TOPOLOGY_DRAIN,
 })

@@ -156,6 +156,26 @@ class _Handler(socketserver.BaseRequestHandler):
                         return {"error": f"role {role!r} is not under "
                                          f"autoscaler control"}
             return {"autoscale": ac.status()}
+        if op == "topology":
+            # Adaptive agg↔disagg posture: per-group shape, flip state
+            # machine phase, last decision (reason + suppression), and a
+            # per-group runtime kill switch ({"op":"topology",
+            # "disable":"<group>"} / "enable"). Wire-facing: unknown
+            # groups return an error, never an exception.
+            tc = getattr(self.server.plane, "topology_controller", None)
+            if tc is None:
+                return {"error": "topology controller not enabled on "
+                                 "this plane"}
+            for key, want in (("enable", True), ("disable", False)):
+                group = obj.get(key)
+                if group is not None:
+                    # No explicit namespace = every namespace the group
+                    # name is configured in (groups are usually unique).
+                    if not tc.set_enabled(str(group), want,
+                                          namespace=obj.get("namespace")):
+                        return {"error": f"group {group!r} is not under "
+                                         f"topology control"}
+            return {"topology": tc.status()}
         if op == "traces":
             # Operator pull of the trace sink: recent + slowest-N ring
             # buffers, the slowest request's rendered waterfall, and the

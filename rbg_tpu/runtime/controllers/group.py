@@ -113,10 +113,9 @@ class RoleBasedGroupController(Controller):
 
         # 5. coordination policy: maxSkew-clamped scaling targets + rolling
         #    update partitions, computed from the status refreshed above
-        policies = [
-            p for p in store.list("CoordinatedPolicy", namespace=ns)
-            if p.spec.group_name == name
-        ]
+        # Indexed child listing (list_for): the old full-kind scan + group
+        # filter was the reconcile-latency tail at 5k-node fleets.
+        policies = store.list_for("CoordinatedPolicy", rbg)
         role_targets = self._coordination_targets(rbg, policies)
         role_partitions = self._coordination_partitions(store, rbg, policies,
                                                         role_hashes)
@@ -209,9 +208,8 @@ class RoleBasedGroupController(Controller):
 
     def _apply_scaling_overrides(self, store, rbg):
         adapters = [
-            a for a in store.list("ScalingAdapter", namespace=rbg.metadata.namespace)
-            if a.spec.group_name == rbg.metadata.name and a.spec.replicas is not None
-            and a.status.phase == "Bound"
+            a for a in store.list_for("ScalingAdapter", rbg, copy_=False)
+            if a.spec.replicas is not None and a.status.phase == "Bound"
         ]
         if not adapters:
             return rbg

@@ -86,8 +86,7 @@ class RoleInstanceSetController(Controller):
                 if ris is None or ris.metadata.deletion_timestamp is not None:
                     return None
         instances = [
-            i for i in store.list("RoleInstance", namespace=ns,
-                                  owner_uid=ris.metadata.uid, copy_=False)
+            i for i in store.list_for("RoleInstance", ris, copy_=False)
             if i.metadata.deletion_timestamp is None
         ]
 
@@ -457,8 +456,10 @@ class RoleInstanceSetController(Controller):
 
     def _update_status(self, store, ris, revision):
         ns, name = ris.metadata.namespace, ris.metadata.name
+        # Read-only rollup: the indexed no-copy listing (list_for) — the
+        # per-reconcile deepcopy of every instance was pure waste here.
         instances = [
-            i for i in store.list("RoleInstance", namespace=ns, owner_uid=ris.metadata.uid)
+            i for i in store.list_for("RoleInstance", ris, copy_=False)
             if i.metadata.deletion_timestamp is None
         ]
         now = time.time()

@@ -32,8 +32,8 @@ class ScalingAdapterController(Controller):
                 return []
             ns = obj.metadata.namespace
             return [(ns, a.metadata.name)
-                    for a in self.store.list("ScalingAdapter", namespace=ns)
-                    if a.spec.group_name == obj.metadata.name]
+                    for a in self.store.list_for("ScalingAdapter", obj,
+                                                 copy_=False)]
 
         return [
             Watch("ScalingAdapter", own_keys),

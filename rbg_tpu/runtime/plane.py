@@ -26,7 +26,8 @@ class ControlPlane:
     def __init__(self, store: Optional[Store] = None, backend: str = "fake",
                  ready_delay: float = 0.0, executor_env: Optional[dict] = None,
                  k8s_client=None, warm_spares: int = 0, autoscale=None,
-                 kv_directory=None, legacy_resync: Optional[bool] = None):
+                 kv_directory=None, legacy_resync: Optional[bool] = None,
+                 topology=None):
         import os
         if legacy_resync is None:
             legacy_resync = os.environ.get("RBG_LEGACY_RESYNC", "") == "1"
@@ -75,6 +76,16 @@ class ControlPlane:
             self.autoscale_controller = self.manager.register(
                 AutoscaleController(self.store, autoscale,
                                     spares=self.spares))
+        # Adaptive aggregation↔disaggregation (rbg_tpu/topology): flips a
+        # group's PD shape at runtime off the observed load mix. Off
+        # unless a TopologyConfig is passed — shape is operator-owned by
+        # default.
+        self.topology_controller = None
+        if topology is not None:
+            from rbg_tpu.topology import TopologyController
+            self.topology_controller = self.manager.register(
+                TopologyController(self.store, topology,
+                                   spares=self.spares))
         self._register_optional()
         if self.legacy_resync:
             for c in self.manager.controllers:

@@ -606,3 +606,56 @@ class SparePool:
         for topo in topos:
             REGISTRY.set_gauge(names.DISRUPTION_SPARE_POOL_DEPTH,
                                float(depth.get(topo, 0)), topology=topo)
+
+
+def grant_spares_for_role(store, spares, ns: str, group: str, role: str,
+                          slice_topology: Optional[str],
+                          on_grant=None) -> int:
+    """Bind-time warm-up shared by the autoscaler and the topology
+    controller: steer UNBOUND pending instances of (group, role) onto
+    reserved spare slices (the PR-3 grant seam), then replenish so the
+    pool does not stay shallow — and so any take whose bind was lost
+    returns to the re-reservable set. Returns the grants that LANDED;
+    ``on_grant(inst, slice_id)`` runs once per landed grant (metrics /
+    events stay caller-owned)."""
+    from rbg_tpu.runtime.store import Conflict, NotFound
+    took = granted = 0
+    for inst in store.list("RoleInstance", namespace=ns,
+                           selector={C.LABEL_GROUP_NAME: group,
+                                     C.LABEL_ROLE_NAME: role},
+                           copy_=False):
+        if (inst.metadata.annotations.get(C.ANN_SLICE_BINDING)
+                or inst.status.slice_id):
+            continue
+        target = spares.take(topology=slice_topology)
+        if target is None:
+            break   # pool dry — still replenish below for what landed
+        took += 1
+        bound = {"v": False}
+
+        def fn(i, target=target):
+            bound["v"] = False  # reset: mutate retries re-run fn
+            if i.metadata.annotations.get(C.ANN_SLICE_BINDING):
+                return False
+            i.metadata.annotations[C.ANN_SLICE_BINDING] = target
+            bound["v"] = True
+            return True
+
+        try:
+            store.mutate("RoleInstance", ns, inst.metadata.name, fn)
+        except (NotFound, Conflict):
+            continue   # replenish reclaims the unreferenced grant
+        if not bound["v"]:
+            # Someone bound the instance between the pre-check and the
+            # mutate (scheduler, disruption grant) — the taken spare
+            # references nothing; replenish below reclaims it.
+            continue
+        granted += 1
+        if on_grant is not None:
+            on_grant(inst, target)
+    if took:
+        try:
+            spares.replenish(store)
+        except Exception:
+            pass
+    return granted

@@ -1475,6 +1475,488 @@ def run_autoscale(cfg: AutoscaleStressConfig) -> dict:
     }
 
 
+# ---- adaptive topology (agg<->disagg) scenario -----------------------------
+
+
+@dataclasses.dataclass
+class TopoFlipConfig:
+    """Adaptive-topology drill: a load-mix-shifting Poisson trace
+    (chat-heavy → long-prompt-heavy → mixed) against a live mini-plane
+    whose group can flip between the unified shape and the PD-disagg
+    shape at runtime (rbg_tpu/topology). The trace runs INTERLEAVED
+    against both static shapes, and the drill asserts the subsystem's
+    promises:
+
+    * ``zero_dropped_streams`` — no in-flight stream dies across any
+      flip: old-shape pods drain through PreparingDelete, streams finish;
+    * ``bit_identical`` — a PD stream cut mid-flip re-routes token-exact
+      through the PR-10 bundle fallback (real tiny-engine leg);
+    * ``topology_converged`` — the controller flips to the winning shape
+      within the ratio window + stabilization + 2 evaluation periods of
+      a sustained mix shift;
+    * ``no_flap`` — bounded flips across the whole trace (the mixed tail
+      sits in the deadband and must NOT flip);
+    * ``goodput_adaptive_ge_static`` — adaptive goodput ≥ both static
+      shapes on the full trace (median of interleaved reps,
+      trimmed-spread gated per the fleet A/B discipline; reps >= 2).
+    """
+
+    duration_s: float = 15.0
+    tick_s: float = 0.05
+    rps: float = 40.0
+    # Phase boundaries (fractions of the trace) and the long-document
+    # fraction of arrivals inside each phase. chat ~ ratio 1.1 (unified
+    # pressure), long ~ ratio 15.6 (disagg pressure), mixed ~ ratio 4.5
+    # (deadband: HOLD, the anti-flap leg).
+    phase_fracs: tuple = (0.30, 0.40, 0.30)
+    long_frac_by_phase: tuple = (0.02, 0.95, 0.15)
+    chat_tokens: tuple = (32, 64)      # (prompt, decode) tokens
+    long_tokens: tuple = (2048, 128)
+    # Service model: each serving replica provides this many cost units
+    # per second; a completed request costs units by (shape, class) —
+    # unified pays a prefill-monopolizes-decode tax on long prompts,
+    # disagg pays the KV-transfer tax on short chat turns (the paper's
+    # crossover, scaled down).
+    per_replica_units: float = 14.0
+    cost_unified: tuple = (1.0, 4.0)   # (chat, long)
+    cost_disagg: tuple = (2.0, 1.2)
+    unified_replicas: int = 4
+    prefill_replicas: int = 2
+    decode_replicas: int = 2
+    queue_limit: int = 160
+    slo_wait_s: float = 0.7
+    drain_s: float = 2.0
+    eval_period_s: float = 0.3
+    window_s: float = 2.0
+    stale_after_s: float = 1.5
+    disagg_stab_s: float = 0.45
+    unified_stab_s: float = 0.45
+    cooldown_s: float = 1.5
+    disagg_ratio: float = 6.0
+    unified_ratio: float = 2.0
+    max_switch_cost_s: float = 5.0
+    kv_bytes_per_stream: float = 1 << 20
+    link_bytes_per_s: float = 200e6
+    max_flips: int = 2
+    reps: int = 3                      # interleaved adaptive/static reps
+    spread_max: float = 0.45
+    attempts: int = 2                  # whole-A/B retries (bimodal box)
+    token_exact: bool = True           # run the real-engine PD leg
+    seed: int = 11
+    timeout_s: float = 60.0
+
+
+def _run_topoflip_rep(cfg: TopoFlipConfig, mode: str) -> dict:
+    """One trace repetition. ``mode``: adaptive (TopologyController
+    live), unified / disagg (static shape, no controller)."""
+    import collections
+
+    from rbg_tpu.api import constants as C2
+    from rbg_tpu.api.group import IdentityMode, ScalingAdapterHook
+    from rbg_tpu.obs import timeseries
+    from rbg_tpu.topology import (
+        GroupTopology, POSTURE_DISAGG, POSTURE_UNIFIED, TopologyConfig,
+        TopologyPolicyConfig,
+    )
+
+    group_name = "topo"
+    gt = GroupTopology(
+        group=group_name, unified_replicas=cfg.unified_replicas,
+        prefill_replicas=cfg.prefill_replicas,
+        decode_replicas=cfg.decode_replicas)
+    rng = __import__("random").Random(cfg.seed)
+    sampler = timeseries.get_sampler()
+
+    # ---- shared sim state the controller hooks read ----
+    active_roles = ({gt.unified_role} if mode != "disagg"
+                    else {gt.prefill_role, gt.decode_role})
+    arrivals_win = collections.deque()   # (t, prompt_toks, decode_toks)
+    done_win = collections.deque()       # completion stamps
+    # One-slot publish: the trace loop computes the decision inputs each
+    # tick and stores a FRESH dict here (atomic slot write); the
+    # controller thread's signals_fn only ever reads a frozen snapshot —
+    # it must never iterate the live deques the loop is mutating.
+    published = {"sig": {"fresh": True, "prefill_decode_ratio": None,
+                         "judged": 0,
+                         "link_bytes_per_s": cfg.link_bytes_per_s}}
+
+    def candidacy_fn(_group, role, active):
+        if active:
+            active_roles.add(role)
+        else:
+            active_roles.discard(role)
+
+    def signals_fn(_gt):
+        return dict(published["sig"])
+
+    topo_cfg = None
+    if mode == "adaptive":
+        topo_cfg = TopologyConfig(
+            groups=[gt],
+            policy=TopologyPolicyConfig(
+                disagg_ratio=cfg.disagg_ratio,
+                unified_ratio=cfg.unified_ratio,
+                min_judged=3,
+                disagg_stabilization_s=cfg.disagg_stab_s,
+                unified_stabilization_s=cfg.unified_stab_s,
+                cooldown_s=cfg.cooldown_s,
+                max_switch_cost_s=cfg.max_switch_cost_s),
+            eval_period_s=cfg.eval_period_s, window_s=cfg.window_s,
+            stale_after_s=cfg.stale_after_s,
+            signals_fn=signals_fn, candidacy_fn=candidacy_fn)
+
+    plane = ControlPlane(backend="fake", topology=topo_cfg)
+    make_tpu_nodes(plane.store, slices=4, hosts_per_slice=4)
+
+    def mk_role(name, replicas):
+        role = simple_role(name, replicas=replicas)
+        role.identity = IdentityMode.RANDOM
+        role.drain_seconds = cfg.drain_s
+        role.scaling_adapter = ScalingAdapterHook(
+            enabled=True, min_replicas=0,
+            max_replicas=max(cfg.unified_replicas, cfg.prefill_replicas
+                             + cfg.decode_replicas))
+        return role
+
+    init = {
+        gt.unified_role: cfg.unified_replicas if mode != "disagg" else 0,
+        gt.prefill_role: cfg.prefill_replicas if mode == "disagg" else 0,
+        gt.decode_role: cfg.decode_replicas if mode == "disagg" else 0,
+    }
+    roles = [mk_role(r, n) for r, n in init.items()]
+    flips_before = {
+        t: REGISTRY.counter(metric_names.TOPOLOGY_FLIPS_TOTAL,
+                            group=group_name, target=t)
+        for t in (POSTURE_UNIFIED, POSTURE_DISAGG)}
+
+    t_run = time.perf_counter()
+    plane.start()
+    curve: List[dict] = []
+    greens = [0]
+    arrivals_total = [0]
+    shed_total = [0]
+    dropped = [0]
+    completed = [0]
+    flip_started_t: Optional[float] = None
+    flip_done_t: Optional[float] = None
+    phase2_t0 = cfg.duration_s * cfg.phase_fracs[0]
+    try:
+        plane.apply(make_group(group_name, *roles))
+        plane.wait_group_ready(group_name, timeout=cfg.timeout_s)
+
+        def pods():
+            return [p for p in plane.store.list(
+                "Pod", namespace="default",
+                selector={C.LABEL_GROUP_NAME: group_name}) if p.active]
+
+        def is_draining(p) -> bool:
+            return (p.metadata.annotations.get(C2.ANN_LIFECYCLE_STATE)
+                    == C2.LIFECYCLE_PREPARING_DELETE)
+
+        def posture_now():
+            g = plane.store.get("RoleBasedGroup", "default", group_name,
+                                copy_=False)
+            if g is None:
+                return "?", ""
+            a = g.metadata.annotations
+            posture = a.get(C2.ANN_TOPOLOGY_POSTURE) or (
+                POSTURE_UNIFIED if mode != "disagg" else POSTURE_DISAGG)
+            return posture, a.get(C2.ANN_TOPOLOGY_STATE) or ""
+
+        queue = collections.deque()      # (class_idx, t_arrive)
+        streams: Dict[str, float] = {}
+        carry = 0.0
+        t0 = time.perf_counter()
+        while True:
+            now = time.perf_counter() - t0
+            if now >= cfg.duration_s:
+                break
+            frac = now / cfg.duration_s
+            phase = 0
+            acc = 0.0
+            for i, pf in enumerate(cfg.phase_fracs):
+                acc += pf
+                if frac < acc:
+                    phase = i
+                    break
+            long_frac = cfg.long_frac_by_phase[phase]
+
+            # ---- arrivals ----
+            n_arr = _poisson(rng, cfg.rps * cfg.tick_s)
+            for _ in range(n_arr):
+                is_long = rng.random() < long_frac
+                toks = cfg.long_tokens if is_long else cfg.chat_tokens
+                arrivals_win.append((now, toks[0], toks[1]))
+                queue.append((1 if is_long else 0, now))
+                arrivals_total[0] += 1
+            while arrivals_win and arrivals_win[0][0] < now - cfg.window_s:
+                arrivals_win.popleft()
+            while done_win and done_win[0] < now - cfg.window_s:
+                done_win.popleft()
+
+            # ---- pod census ----
+            ps = pods()
+            live = {p.metadata.name for p in ps}
+            serving = [p for p in ps
+                       if p.running_ready and not is_draining(p)
+                       and p.metadata.labels.get(C.LABEL_ROLE_NAME)
+                       in active_roles]
+            draining = [p for p in ps if is_draining(p)]
+
+            # ---- streams: vanished pods with streams are DROPS ----
+            for pname in [n for n in streams if n not in live]:
+                if streams[pname] > 0:
+                    dropped[0] += int(streams[pname])
+                del streams[pname]
+            for p in draining:
+                n = streams.get(p.metadata.name, 0.0)
+                if n > 0:
+                    streams[p.metadata.name] = max(0.0, n - 2.0)
+                if streams.get(p.metadata.name, 0.0) <= 0:
+                    iname = p.metadata.labels.get(C.LABEL_INSTANCE_NAME)
+                    if iname:
+                        def ack(i):
+                            if i.metadata.annotations.get(
+                                    C2.ANN_DRAIN_COMPLETE) == "true":
+                                return False
+                            i.metadata.annotations[
+                                C2.ANN_DRAIN_COMPLETE] = "true"
+                            return True
+                        try:
+                            plane.store.mutate("RoleInstance", "default",
+                                               iname, ack)
+                        except Exception:
+                            pass
+            want_streams = min(len(serving) * 4, int(cfg.rps / 6) + 1)
+            have = sum(int(streams.get(p.metadata.name, 0.0))
+                       for p in serving)
+            for p in serving:
+                if have >= want_streams:
+                    break
+                streams[p.metadata.name] = \
+                    streams.get(p.metadata.name, 0.0) + 1
+                have += 1
+            streams_now = float(sum(streams.values()))
+
+            # ---- service: capacity units complete the queue ----
+            shape = ("disagg"
+                     if gt.prefill_role in active_roles else "unified")
+            costs = (cfg.cost_disagg if shape == "disagg"
+                     else cfg.cost_unified)
+            cap_units_s = len(serving) * cfg.per_replica_units
+            units = carry + cap_units_s * cfg.tick_s
+            while queue and units >= costs[queue[0][0]]:
+                cls, t_arr = queue.popleft()
+                units -= costs[cls]
+                completed[0] += 1
+                done_win.append(now)
+                if now - t_arr <= cfg.slo_wait_s:
+                    greens[0] += 1
+            carry = min(units, cap_units_s * cfg.tick_s)
+            while len(queue) > cfg.queue_limit:
+                queue.pop()      # shed the newest — no capacity for it
+                shed_total[0] += 1
+            p_toks = sum(a[1] for a in arrivals_win)
+            d_toks = sum(a[2] for a in arrivals_win)
+            ratio_now = (round(p_toks / d_toks, 2)
+                         if p_toks > 1e-9 and d_toks > 1e-9 else None)
+            published["sig"] = {
+                "fresh": True,
+                "prefill_decode_ratio": ratio_now,
+                "judged": len(done_win),
+                "queue_depth": float(len(queue)),
+                "kv_bytes_to_move": streams_now * cfg.kv_bytes_per_stream,
+                "link_bytes_per_s": cfg.link_bytes_per_s,
+            }
+            sampler.sample_now()
+
+            posture, state = posture_now()
+            if mode == "adaptive":
+                if flip_started_t is None and state:
+                    flip_started_t = now
+                if (flip_started_t is not None and flip_done_t is None
+                        and posture == POSTURE_DISAGG and not state):
+                    flip_done_t = now
+            curve.append({
+                "t": round(now, 3),
+                "offered_rps": round(cfg.rps, 1),
+                "long_frac": long_frac,
+                "ratio": ratio_now,
+                "posture": posture, "state": state,
+                "capacity_units_s": round(cap_units_s, 1),
+                "serving": len(serving),
+                "queue": len(queue),
+                "goodput_frac": round(
+                    greens[0] / max(1, arrivals_total[0]), 4),
+            })
+            time.sleep(cfg.tick_s)
+        status = (plane.topology_controller.status()
+                  if plane.topology_controller else {})
+    finally:
+        plane.stop()
+
+    flips = {
+        t: round(REGISTRY.counter(metric_names.TOPOLOGY_FLIPS_TOTAL,
+                                  group=group_name, target=t)
+                 - flips_before[t], 1)
+        for t in (POSTURE_UNIFIED, POSTURE_DISAGG)}
+    goodput = greens[0] / max(1, arrivals_total[0])
+    return {
+        "mode": mode,
+        "elapsed_s": round(time.perf_counter() - t_run, 3),
+        "arrivals": arrivals_total[0],
+        "completed": completed[0],
+        "shed": shed_total[0],
+        "greens": greens[0],
+        "goodput_fraction": round(goodput, 4),
+        "dropped_streams": dropped[0],
+        "flips": flips,
+        "flip_started_after_shift_s": (
+            round(flip_started_t - phase2_t0, 3)
+            if flip_started_t is not None else None),
+        "flip_done_after_shift_s": (
+            round(flip_done_t - phase2_t0, 3)
+            if flip_done_t is not None else None),
+        "end_posture": curve[-1]["posture"] if curve else "?",
+        "topology_status": status,
+        "curve": curve,
+    }
+
+
+def _topoflip_token_exact(cfg: TopoFlipConfig) -> dict:
+    """Real-engine leg: an in-flight PD stream cut mid-transfer (what a
+    drained old-shape backend does to its stream at cutover) must finish
+    token-exact through the PR-10 bundle fallback — outputs bit-identical
+    to a unified engine."""
+    import numpy as np
+
+    from rbg_tpu.engine.config import EngineConfig, SamplingParams
+    from rbg_tpu.engine.engine import Engine
+    from rbg_tpu.engine.pd import PDStreamPair
+    from rbg_tpu.kvtransfer import InProcTransport, SlowLossyTransport
+
+    page_size = 8
+    ecfg = dict(model="tiny", page_size=page_size, num_pages=128,
+                max_batch=2, max_seq_len=128, prefill_chunk=16,
+                use_pallas="never")
+    rng = np.random.RandomState(23)
+    eng_ref = Engine(EngineConfig(enable_radix_cache=False, **ecfg))
+    vocab = eng_ref.mcfg.vocab_size
+    prompts = [rng.randint(1, vocab, size=40).tolist() for _ in range(2)]
+    sp = SamplingParams(max_new_tokens=6)
+    expect = eng_ref.generate(prompts, sp)
+
+    link = SlowLossyTransport(InProcTransport(), delay_s=0.002,
+                              truncate_nth_stream=1,
+                              truncate_after_bytes=1 << 11, seed=5)
+    pair = PDStreamPair(EngineConfig(**ecfg), params=eng_ref.params,
+                        transport=link)
+    results, retries, failures = [], 0, []
+    for i, p in enumerate(prompts):
+        try:
+            r = pair.generate_one(p, sp, stream=True, recv_timeout=60.0,
+                                  max_retries=2)
+            retries += r["retries"]
+            results.append(r)
+        except Exception as e:  # noqa: BLE001 — account, don't crash
+            failures.append(f"request {i}: {type(e).__name__}: {e}")
+            results.append(None)
+    bit_identical = all(r is not None and r["tokens"] == e
+                        for r, e in zip(results, expect))
+    return {"requests": len(prompts), "stream_retries": retries,
+            "failures": failures, "bit_identical": bit_identical}
+
+
+def run_topoflip(cfg: TopoFlipConfig) -> dict:
+    t_run = time.perf_counter()
+    converge_bound = (cfg.window_s + cfg.disagg_stab_s
+                      + 2 * cfg.eval_period_s + 0.75)
+
+    def one_attempt(attempt: int) -> dict:
+        reps: Dict[str, List[dict]] = {
+            "adaptive": [], "static_unified": [], "static_disagg": []}
+        for _ in range(max(1, cfg.reps)):
+            # Strict interleave: every adaptive rep has adjacent static
+            # reps in the same machine regime (ROADMAP: throughput here
+            # is bimodal at multi-second granularity).
+            reps["adaptive"].append(_run_topoflip_rep(cfg, "adaptive"))
+            reps["static_unified"].append(_run_topoflip_rep(cfg, "unified"))
+            reps["static_disagg"].append(_run_topoflip_rep(cfg, "disagg"))
+        med = {m: _median([r["goodput_fraction"] for r in rs])
+               for m, rs in reps.items()}
+        spread = max(_trimmed_spread([r["goodput_fraction"] for r in rs])
+                     for rs in reps.values())
+        ad = reps["adaptive"]
+        out = {
+            "attempt": attempt,
+            "reps": reps,
+            "median_goodput": med,
+            "spread": round(spread, 4),
+            "spread_max": cfg.spread_max,
+            "spread_estimator": "trimmed_minmax_drop1",
+            "converge_bound_s": round(converge_bound, 3),
+            "dropped_streams": sum(r["dropped_streams"]
+                                   for rs in reps.values() for r in rs),
+            "converged": all(
+                r["flip_started_after_shift_s"] is not None
+                and r["flip_started_after_shift_s"] <= converge_bound
+                and r["end_posture"] == "disagg" for r in ad),
+            "flap_bounded": all(
+                sum(r["flips"].values()) <= cfg.max_flips for r in ad),
+            "goodput_ge_static": med["adaptive"] >= max(
+                med["static_unified"], med["static_disagg"]),
+            "spread_ok": spread <= cfg.spread_max,
+        }
+        return out
+
+    last = None
+    for attempt in range(1, max(1, cfg.attempts) + 1):
+        last = one_attempt(attempt)
+        if (last["converged"] and last["flap_bounded"]
+                and last["dropped_streams"] == 0
+                and (cfg.reps < 2
+                     or (last["goodput_ge_static"] and last["spread_ok"]))):
+            break
+
+    token_exact = _topoflip_token_exact(cfg) if cfg.token_exact else None
+    inv: Dict[str, bool] = {
+        "zero_dropped_streams": last["dropped_streams"] == 0,
+        "topology_converged": last["converged"],
+        "no_flap": last["flap_bounded"],
+    }
+    if token_exact is not None:
+        # The cut stream was retried through the bundle fallback, nothing
+        # was dropped, outputs match the unified engine bit-for-bit.
+        inv["bit_identical"] = (token_exact["bit_identical"]
+                                and not token_exact["failures"]
+                                and token_exact["stream_retries"] >= 1)
+    if cfg.reps >= 2:
+        # The headline gate needs interleaved reps to mean anything; a
+        # single-rep smoke run reports the comparison without gating it.
+        inv["goodput_adaptive_ge_static"] = bool(
+            last["goodput_ge_static"])
+        inv["goodput_spread_ok"] = bool(last["spread_ok"])
+    curve = (last["reps"]["adaptive"][0]["curve"]
+             if last["reps"]["adaptive"] else [])
+    report = {
+        "scenario": "topoflip",
+        "config": dataclasses.asdict(cfg),
+        "elapsed_s": round(time.perf_counter() - t_run, 3),
+        **{k: v for k, v in last.items() if k != "reps"},
+        "reps": {
+            m: [{k: v for k, v in r.items()
+                 if k not in ("curve", "topology_status")} for r in rs]
+            for m, rs in last["reps"].items()},
+        "topology_status_end": (
+            last["reps"]["adaptive"][0].get("topology_status")
+            if last["reps"]["adaptive"] else {}),
+        "curve": curve,
+        "token_exact": token_exact,
+        "invariants": inv,
+    }
+    return report
+
+
 # ---- slice preemption / self-healing scenario ------------------------------
 
 
@@ -1806,7 +2288,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="rbg-tpu-stress")
     ap.add_argument("--scenario", default="churn",
                     choices=["churn", "overload", "preemption", "autoscale",
-                             "kvstream", "fleet"],
+                             "kvstream", "fleet", "topoflip"],
                     help="churn = control-plane create/update/delete "
                          "percentiles; overload = serving-plane admission "
                          "control drill (sheds, deadlines, queue bound); "
@@ -1821,7 +2303,11 @@ def main(argv=None) -> int:
                          "fleet = 10k-node control-plane scale drill "
                          "(group churn at fleet scale: reconcile-latency "
                          "and scheduler-throughput curves, workqueue-"
-                         "drains, stuck keys, event accounting)")
+                         "drains, stuck keys, event accounting); "
+                         "topoflip = adaptive agg<->disagg drill (load-"
+                         "mix-shifting trace, runtime PD-shape flips "
+                         "with zero dropped streams, goodput vs both "
+                         "static shapes)")
     ap.add_argument("--clients", type=int, default=6)
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--max-queue", type=int, default=4)
@@ -1842,8 +2328,16 @@ def main(argv=None) -> int:
                          "(kvstream scenario, default 0.02; adding it to "
                          "--scenario overload runs the kvstream drill "
                          "alongside and merges its invariants)")
-    ap.add_argument("--duration-s", type=float, default=14.0,
-                    help="trace length for the autoscale scenario")
+    ap.add_argument("--duration-s", type=float, default=None,
+                    help="trace length for the autoscale (default 14) and "
+                         "topoflip (default 15) scenarios")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="interleaved adaptive-vs-static repetitions for "
+                         "the topoflip scenario (>=2 arms the goodput "
+                         "gate; 1 = smoke, comparison reported ungated)")
+    ap.add_argument("--no-token-exact", action="store_true",
+                    help="skip the topoflip real-engine bit-identical "
+                         "leg (mid-flip stream cut -> bundle fallback)")
     ap.add_argument("--burst-rps", type=float, default=85.0,
                     help="burst magnitude on top of the diurnal profile "
                          "(autoscale scenario)")
@@ -1942,7 +2436,7 @@ def main(argv=None) -> int:
             for r in ("complete", "incomplete", "leaked")}
     load1 = os.getloadavg()[0]
     if args.scenario in ("overload", "preemption", "autoscale", "kvstream",
-                         "fleet"):
+                         "fleet", "topoflip"):
         if args.scenario == "fleet":
             # Scenario-aware rate default: the churn scenarios' 5 qps
             # would spend 30 s just CREATING a 150-group fleet wave.
@@ -1978,7 +2472,16 @@ def main(argv=None) -> int:
                                    else 0.02)))
         elif args.scenario == "autoscale":
             report = run_autoscale(AutoscaleStressConfig(
-                duration_s=args.duration_s, burst_rps=args.burst_rps,
+                duration_s=(args.duration_s if args.duration_s is not None
+                            else 14.0),
+                burst_rps=args.burst_rps,
+                timeout_s=args.timeout_s))
+        elif args.scenario == "topoflip":
+            report = run_topoflip(TopoFlipConfig(
+                duration_s=(args.duration_s if args.duration_s is not None
+                            else 15.0),
+                reps=max(1, args.reps),
+                token_exact=not args.no_token_exact,
                 timeout_s=args.timeout_s))
         else:
             report = run_preemption(PreemptionConfig(
@@ -2342,6 +2845,153 @@ def _preemption_sections(report: dict) -> str:
 <h2>invariants</h2>{_invariants_table(report.get("invariants") or {})}"""
 
 
+def _topoflip_posture_html(report: dict) -> str:
+    """Posture-vs-load-mix timeline: the measured prompt:output token
+    ratio (with the two hysteresis thresholds) above the goodput curve,
+    with the POSTURE BAND — unified / flipping / disagg — shaded behind
+    both panels, so a flip is visually attributable to the mix shift
+    that caused it (PR-9 SVG panel style: stacked single-axis panels,
+    thin lines, recessive grid, line-end labels)."""
+    curve = report.get("curve") or []
+    if len(curve) < 2:
+        return "<p>(no curve samples)</p>"
+    cfg = report.get("config") or {}
+    ml, mr, mt, ph, gap, iw = 46, 110, 16, 120, 30, 560
+    W = ml + iw + mr
+    H = mt + ph * 2 + gap + 22
+    x1 = curve[-1]["t"] or 1.0
+
+    def x(t):
+        return ml + t / x1 * iw
+
+    # Posture band segments (drawn first, behind everything).
+    band_colors = {"unified": "#2a78d6", "disagg": "#eb6834"}
+    segs = []
+    seg_start, seg_key = curve[0]["t"], (curve[0]["posture"],
+                                         bool(curve[0]["state"]))
+    for c in curve[1:] + [None]:
+        key = (c["posture"], bool(c["state"])) if c else None
+        if key != seg_key:
+            t_end = c["t"] if c else curve[-1]["t"]
+            color = "#52514e" if seg_key[1] else \
+                band_colors.get(seg_key[0], "#52514e")
+            segs.append((seg_start, t_end, color, seg_key))
+            if c:
+                seg_start, seg_key = c["t"], key
+    svg = [f'<svg viewBox="0 0 {W} {H}" width="{W}" height="{H}" '
+           f'role="img" aria-label="posture vs load mix over time">']
+    for t0s, t1s, color, key in segs:
+        svg.append(f'<rect x="{x(t0s):.1f}" y="{mt}" '
+                   f'width="{max(0.5, x(t1s) - x(t0s)):.1f}" '
+                   f'height="{ph * 2 + gap}" fill="{color}" '
+                   f'opacity="{0.16 if key[1] else 0.08}"/>')
+    panels = [
+        ("prompt:output ratio", "ratio",
+         lambda: max(max((c["ratio"] or 0) for c in curve), 1.0) * 1.1,
+         "#8a4fd3"),
+        ("goodput fraction", "goodput_frac", lambda: 1.05, "#1baf7a"),
+    ]
+    for pi, (unit, kkey, ymax_fn, color) in enumerate(panels):
+        top = mt + pi * (ph + gap)
+        ymax = float(ymax_fn())
+        for gi in range(5):
+            gy = top + ph - gi * ph / 4
+            val = ymax * gi / 4
+            svg.append(
+                f'<line x1="{ml}" y1="{gy:.1f}" x2="{ml + iw}" '
+                f'y2="{gy:.1f}" stroke="#e4e3de" stroke-width="1"/>'
+                f'<text x="{ml - 6}" y="{gy + 3.5:.1f}" text-anchor="end" '
+                f'class="vt">{val:.2g}</text>')
+        svg.append(f'<text x="{ml}" y="{top - 4}" class="vt">{unit}</text>')
+        if kkey == "ratio":
+            for thr, lbl in ((cfg.get("unified_ratio"), "unified<="),
+                             (cfg.get("disagg_ratio"), "disagg>=")):
+                if not thr or thr > ymax:
+                    continue
+                ty = top + ph - min(1.0, thr / ymax) * ph
+                svg.append(
+                    f'<line x1="{ml}" y1="{ty:.1f}" x2="{ml + iw}" '
+                    f'y2="{ty:.1f}" stroke="#c23a6b" stroke-width="1" '
+                    f'stroke-dasharray="4 3"/>'
+                    f'<text x="{ml + iw + 8}" y="{ty + 3.5:.1f}" '
+                    f'class="vt">{lbl}{thr:g}</text>')
+        pts = " ".join(
+            f'{x(c["t"]):.1f},'
+            f'{top + ph - min(1.0, (c[kkey] or 0) / ymax) * ph:.1f}'
+            for c in curve)
+        last = curve[-1]
+        ly = top + ph - min(1.0, (last[kkey] or 0) / ymax) * ph
+        svg.append(
+            f'<polyline points="{pts}" fill="none" stroke="{color}" '
+            f'stroke-width="2" stroke-linejoin="round"/>'
+            f'<circle cx="{ml + iw:.1f}" cy="{ly:.1f}" r="4" '
+            f'fill="{color}"/>'
+            f'<text x="{ml + iw + 8}" y="{ly + 3.5:.1f}" class="vl">'
+            f'{(last[kkey] or 0):g}</text>')
+    for tx in range(0, 5):
+        t = x1 * tx / 4
+        svg.append(f'<text x="{x(t):.1f}" y="{H - 6}" '
+                   f'text-anchor="middle" class="vt">{t:.1f}s</text>')
+    svg.append("</svg>")
+    legend = "".join(
+        f'<span class="chip" style="background:{c};opacity:.35"></span>'
+        f'<span class="vl">{lbl}</span>'
+        for lbl, c in (("unified posture", band_colors["unified"]),
+                       ("disagg posture", band_colors["disagg"]),
+                       ("flip in progress", "#52514e")))
+    step = max(1, len(curve) // 40)
+    rows = "".join(
+        f'<tr><td>{c["t"]}</td><td>{c["long_frac"]}</td>'
+        f'<td>{c["ratio"]}</td><td>{c["posture"]}'
+        f'{("/" + c["state"]) if c["state"] else ""}</td>'
+        f'<td>{c["queue"]}</td><td>{c["goodput_frac"]}</td></tr>'
+        for c in curve[::step])
+    return f"""<div class="viz-root">
+<style>.viz-root{{color-scheme:light}}
+.viz-root .vt{{font:10px sans-serif;fill:#52514e}}
+.viz-root .vl{{font:11px sans-serif;fill:#0b0b0b;color:#0b0b0b;
+margin-right:10px}}
+.viz-root .chip{{display:inline-block;width:10px;height:10px;
+border-radius:2px;margin:0 4px 0 0;vertical-align:-1px}}</style>
+<div>{legend}</div>
+{"".join(svg)}
+<details><summary>data table</summary>
+<table><tr><th>t (s)</th><th>long frac</th><th>ratio</th>
+<th>posture</th><th>queue</th><th>goodput frac</th></tr>{rows}</table>
+</details></div>"""
+
+
+def _topoflip_sections(report: dict) -> str:
+    med = report.get("median_goodput") or {}
+    flip = {
+        "converge_bound_s": report.get("converge_bound_s"),
+        "spread (trimmed)":
+            f"{report.get('spread')} (max {report.get('spread_max')})",
+        "attempt": report.get("attempt"),
+    }
+    rep_rows = "".join(
+        f"<tr><td>{m}</td><td>{r['goodput_fraction']}</td>"
+        f"<td>{r['arrivals']}</td><td>{r['shed']}</td>"
+        f"<td>{r['dropped_streams']}</td>"
+        f"<td>{sum((r.get('flips') or {}).values()):g}</td>"
+        f"<td>{r.get('flip_started_after_shift_s')}</td>"
+        f"<td>{r.get('end_posture')}</td></tr>"
+        for m, rs in (report.get("reps") or {}).items() for r in rs)
+    te = report.get("token_exact")
+    te_html = (f"<h2>token-exact leg (mid-flip stream cut → bundle "
+               f"fallback)</h2>{_kv_table(te)}" if te else "")
+    return f"""<h2>posture vs load mix</h2>{_topoflip_posture_html(report)}
+<h2>goodput: adaptive vs both static shapes (median of interleaved
+reps)</h2>{_kv_table(med)}
+<h2>per-rep results</h2>
+<table><tr><th>variant</th><th>goodput frac</th><th>arrivals</th>
+<th>shed</th><th>dropped</th><th>flips</th><th>flip react (s)</th>
+<th>end posture</th></tr>{rep_rows}</table>
+<h2>flip discipline</h2>{_kv_table(flip)}
+{te_html}
+<h2>invariants</h2>{_invariants_table(report.get("invariants") or {})}"""
+
+
 def _kvstream_sections(report: dict) -> str:
     tr = report.get("transfer") or {}
     return f"""<h2>requests</h2>{_kv_table(report.get("requests") or {})}
@@ -2555,6 +3205,8 @@ def write_html_report(report: dict, path: str) -> None:
         body = _kvstream_sections(report)
     elif scenario == "fleet":
         body = _fleet_sections(report)
+    elif scenario == "topoflip":
+        body = _topoflip_sections(report)
     else:
         body = f"<pre>{json.dumps(report, indent=2)}</pre>"
     tr = report.get("trace")

@@ -1,0 +1,14 @@
+"""Adaptive aggregation↔disaggregation topology subsystem.
+
+Decision (``policy``) → actuation (``controller``) → proof
+(``stress --scenario topoflip``). See docs/architecture.md §"Adaptive
+topology".
+"""
+
+from rbg_tpu.topology.controller import (   # noqa: F401
+    GroupTopology, TopologyConfig, TopologyController,
+)
+from rbg_tpu.topology.policy import (       # noqa: F401
+    POSTURE_DISAGG, POSTURE_UNIFIED, REC_HOLD, TopologyDecision,
+    TopologyPolicy, TopologyPolicyConfig, TopologySignals,
+)

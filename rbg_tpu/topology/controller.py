@@ -1,0 +1,629 @@
+"""TopologyController — runtime aggregation↔disaggregation actuation.
+
+A runtime controller (same lifecycle as the autoscaler,
+``ControlPlane(topology=TopologyConfig(...))``) that lets a role group
+flip between the unified shape (one engine role serving prefill+decode)
+and the PD-disaggregated shape (prefill + decode roles over the PR-10
+transfer plane) at runtime, with the router absorbing the transition
+without dropping a stream.
+
+The flip is a persistent per-group state machine carried ENTIRELY in
+group annotations (``topology-state`` / ``topology-target`` /
+``topology-posture``), so a plane restart resumes a mid-flight flip
+exactly like the PR-3 migration machine resumes a slice move:
+
+* **Warming** — the target shape's roles are scaled up through their
+  ScalingAdapters (SparePool grants steer pending TPU instances onto
+  reserved warm slices first); the machine waits for the target shape to
+  report ready — capacity is made BEFORE anything is broken;
+* **CutOver** — router candidacy flips role-by-role: the target roles
+  become eligible for new traffic FIRST, then the old shape's roles are
+  withdrawn (the serving set is published in the ``topology-serving``
+  annotation and mirrored through ``candidacy_fn`` to live routers);
+* **Draining** — the old shape's adapters go to 0 and the stateless
+  instance engine walks every old instance through PreparingDelete:
+  in-flight streams finish (or re-route token-exact via the PR-10
+  bundle fallback) before the instance dies. The flip completes when no
+  old-shape instance remains.
+
+Actuator coordination: every adapter write stamps
+``autoscale-last-write`` (the PR-9 two-writer protocol — whoever writes,
+stamps), so the autoscaler adopts the new shape as its baseline instead
+of fighting it; and a flip never STARTS while an adapter carries an
+unadopted foreign write (``rbg_topology_conflicts_total`` + one-cycle
+backoff), so the two actuators never interleave half-applied targets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.autoscale.signals import SignalReader
+from rbg_tpu.obs import names
+from rbg_tpu.obs import trace
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.runtime.controller import Controller, Result, Watch
+from rbg_tpu.runtime.store import (
+    EVENT_WARNING, Conflict, NotFound, Store,
+)
+from rbg_tpu.topology.policy import (
+    POSTURE_DISAGG, POSTURE_UNIFIED, REC_HOLD, TopologyDecision,
+    TopologyPolicy, TopologyPolicyConfig, TopologySignals,
+)
+from rbg_tpu.utils.locktrace import named_lock
+
+STATE_WARMING = "Warming"
+STATE_CUTOVER = "CutOver"
+STATE_DRAINING = "Draining"
+
+
+@dataclasses.dataclass
+class GroupTopology:
+    """Shape plan for one group: which roles form each posture and the
+    replica count each shape warms to. The group's spec carries ALL the
+    roles; posture is which of them hold replicas + router candidacy."""
+
+    group: str
+    namespace: str = "default"
+    unified_role: str = "unified"
+    prefill_role: str = "prefill"
+    decode_role: str = "decode"
+    unified_replicas: int = 2
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+
+    def shape_roles(self, posture: str) -> List[Tuple[str, int]]:
+        if posture == POSTURE_UNIFIED:
+            return [(self.unified_role, self.unified_replicas)]
+        return [(self.prefill_role, self.prefill_replicas),
+                (self.decode_role, self.decode_replicas)]
+
+    def all_roles(self) -> List[str]:
+        return [self.unified_role, self.prefill_role, self.decode_role]
+
+
+@dataclasses.dataclass
+class TopologyConfig:
+    """Wiring for one plane's topology controller."""
+
+    groups: List[GroupTopology] = dataclasses.field(default_factory=list)
+    policy: TopologyPolicyConfig = dataclasses.field(
+        default_factory=TopologyPolicyConfig)
+    eval_period_s: float = 15.0
+    window_s: float = 60.0
+    stale_after_s: float = 10.0
+    # Per-group decision-input overrides (GroupTopology -> dict with any
+    # TopologySignals field): the seam the stress harness and router-fed
+    # deployments use for signals the registry does not carry (the
+    # ingress-vantage prompt:output token ratio above all).
+    signals_fn: Optional[Callable[[GroupTopology], dict]] = None
+    # Live-router candidacy mirror: (group, role, active) -> None, called
+    # as the cutover phase flips roles. The annotation is the durable
+    # record; this hook is the push path to in-process routers.
+    candidacy_fn: Optional[Callable[[str, str, bool], None]] = None
+
+
+class TopologyController(Controller):
+    name = "topology"
+    workers = 1
+
+    def __init__(self, store: Store, config: TopologyConfig, spares=None):
+        super().__init__(store)
+        self.cfg = config
+        self.spares = spares
+        self.resync_period = max(config.eval_period_s, 0.05)
+        # The resync IS the evaluation tick (autoscaler convention).
+        self.backstop_period = self.resync_period
+        self.flip_poll_s = min(self.resync_period, 0.25)
+        self.reader = SignalReader(window_s=config.window_s,
+                                   stale_after_s=config.stale_after_s)
+        self._groups: Dict[tuple, GroupTopology] = {
+            (g.namespace, g.group): g for g in config.groups}
+        self._policies: Dict[tuple, TopologyPolicy] = {}
+        # key -> {"root": span, "phase": span} for the flip in flight
+        # (spans are process-local; a resumed flip starts fresh ones).
+        self._spans: Dict[tuple, dict] = {}
+        self._lock = named_lock("topology.status")
+        # key -> status row  # guarded_by[topology.status]
+        self._status: Dict[tuple, dict] = {}
+        # runtime-disabled group names  # guarded_by[topology.status]
+        self._disabled: set = set()
+
+    # ---- wiring ----
+
+    def watches(self) -> List[Watch]:
+        def group_keys(obj):
+            if obj.kind != "RoleBasedGroup":
+                return []
+            key = (obj.metadata.namespace, obj.metadata.name)
+            return [key] if key in self._groups else []
+
+        return [Watch("RoleBasedGroup", group_keys)]
+
+    # ---- operator surface ----
+
+    def set_enabled(self, group: str, enabled: bool,
+                    namespace: Optional[str] = None) -> bool:
+        """Runtime kill switch. ``namespace=None`` matches the group
+        name in EVERY namespace it is configured in (the admin op's
+        default); pass it to scope the flip. Returns True when anything
+        matched."""
+        keys = [(g.namespace, g.group) for g in self.cfg.groups
+                if g.group == group
+                and (namespace is None or g.namespace == namespace)]
+        if not keys:
+            return False
+        with self._lock:
+            for key in keys:
+                if enabled:
+                    self._disabled.discard(key)
+                else:
+                    self._disabled.add(key)
+        return True
+
+    def enabled(self, namespace: str, group: str) -> bool:
+        with self._lock:
+            return (namespace, group) not in self._disabled
+
+    def status(self) -> dict:
+        with self._lock:
+            rows = [dict(v) for v in self._status.values()]
+            disabled = set(self._disabled)
+        for r in rows:
+            # Live flag, not the last-reconcile snapshot: a kill-switch
+            # flip must be visible in the op's own response.
+            r["enabled"] = (r["namespace"], r["group"]) not in disabled
+        rows.sort(key=lambda r: (r["namespace"], r["group"]))
+        return {
+            "eval_period_s": self.cfg.eval_period_s,
+            "window_s": self.cfg.window_s,
+            "groups": rows,
+        }
+
+    # ---- reconcile ----
+
+    def reconcile(self, store: Store, key) -> Optional[Result]:
+        gt = self._groups.get(tuple(key))
+        if gt is None:
+            return None
+        ns, name = key
+        rbg = store.get("RoleBasedGroup", ns, name, copy_=False)
+        if rbg is None or rbg.metadata.deletion_timestamp is not None:
+            return None
+        ann = rbg.metadata.annotations
+        posture = ann.get(C.ANN_TOPOLOGY_POSTURE) or self._infer(rbg, gt)
+        state = ann.get(C.ANN_TOPOLOGY_STATE)
+        now = time.monotonic()
+        if state:
+            self._gauge(name, 0.5)
+            self._advance(store, gt, rbg, posture, state, now)
+            return Result(requeue_after=self.flip_poll_s)
+
+        self._gauge(name, 1.0 if posture == POSTURE_DISAGG else 0.0)
+        policy = self._policy(key)
+        if not self.enabled(ns, name):
+            # Time spent disabled must never count as sustained pressure
+            # at re-enable.
+            policy.reset_pressure()
+            d = TopologyDecision(posture, REC_HOLD, "disabled",
+                                 suppressed="disabled")
+            policy.last_decision = d
+            self._record(gt, posture, None, d, now)
+            return Result(requeue_after=self.cfg.eval_period_s)
+
+        sig = self._signals(gt, now)
+        d = policy.decide(now, sig, posture)
+        if d.recommendation == REC_HOLD:
+            REGISTRY.inc(names.TOPOLOGY_HOLDS_TOTAL, group=name,
+                         reason=d.suppressed or "steady")
+            if d.suppressed == "cost_gated":
+                REGISTRY.inc(names.TOPOLOGY_COST_GATED_TOTAL, group=name)
+        else:
+            blocked = self._flip_blocked(store, gt, rbg, d)
+            if blocked is not None:
+                kind, why = blocked
+                if kind == "conflict":
+                    REGISTRY.inc(names.TOPOLOGY_CONFLICTS_TOTAL,
+                                 group=name)
+                REGISTRY.inc(names.TOPOLOGY_HOLDS_TOTAL, group=name,
+                             reason=kind)
+                policy.revoke(d)
+                store.record_event(
+                    rbg, "TopologyConflict" if kind == "conflict"
+                    else "TopologyInfeasible",
+                    f"flip to {d.recommendation} backed off: {why}",
+                    type_=EVENT_WARNING)
+                d = TopologyDecision(posture, REC_HOLD,
+                                     f"{kind} (wanted "
+                                     f"{d.recommendation}): {why}",
+                                     suppressed=kind)
+                policy.last_decision = d
+            else:
+                self._begin(store, gt, rbg, d)
+        self._record(gt, posture, ann.get(C.ANN_TOPOLOGY_STATE), d, now)
+        return Result(requeue_after=self.cfg.eval_period_s)
+
+    # ---- decision inputs ----
+
+    def _signals(self, gt: GroupTopology, now: float) -> TopologySignals:
+        extras = {}
+        if self.cfg.signals_fn is not None:
+            try:
+                extras = dict(self.cfg.signals_fn(gt) or {})
+            except Exception:
+                extras = {}
+        fresh, age = self.reader.fresh()
+        if extras.get("fresh") is not None:
+            fresh = bool(extras["fresh"])
+        ratio = extras.get("prefill_decode_ratio")
+        if ratio is None:
+            # Measured per-role token rates (meaningful once the group is
+            # disaggregated; the reader reports None — never inf/0 — when
+            # one side measured nothing in the window).
+            ratio = self.reader.measured_ratio(gt.prefill_role,
+                                               gt.decode_role)
+        judged = extras.get("judged")
+        ttft = extras.get("ttft_attainment")
+        tpot = extras.get("tpot_attainment")
+        good = extras.get("goodput_rps")
+        if judged is None:
+            judged, ttft_w, tpot_w, n_w = 0, 0.0, 0.0, 0
+            for role in gt.all_roles():
+                rs = self.reader.read(role)
+                if not rs.judged:
+                    continue
+                judged += rs.judged
+                if rs.ttft_attainment is not None:
+                    ttft_w += rs.ttft_attainment * rs.judged
+                if rs.tpot_attainment is not None:
+                    tpot_w += rs.tpot_attainment * rs.judged
+                n_w += rs.judged
+                if rs.goodput_rps is not None:
+                    good = (good or 0.0) + rs.goodput_rps
+            if n_w and ttft is None:
+                ttft = round(ttft_w / n_w, 4)
+            if n_w and tpot is None:
+                tpot = round(tpot_w / n_w, 4)
+        link = extras.get("link_bytes_per_s")
+        if link is None:
+            link = self._measured_link_rate()
+        return TopologySignals(
+            fresh=fresh, sample_age_s=age,
+            prefill_decode_ratio=ratio, judged=int(judged or 0),
+            ttft_attainment=ttft, tpot_attainment=tpot, goodput_rps=good,
+            queue_depth=extras.get("queue_depth"),
+            kv_bytes_to_move=extras.get("kv_bytes_to_move"),
+            link_bytes_per_s=link)
+
+    @staticmethod
+    def _measured_link_rate() -> Optional[float]:
+        """Fastest measured KV link (``rbg_kvtransfer_link_bytes_per_s``)
+        — the rate a warm flip would actually move pages at."""
+        _, gauges, _ = REGISTRY.snapshot_values()
+        rates = [v for k, v in gauges.items()
+                 if k[0] == names.KVT_LINK_RATE]
+        return max(rates) if rates else None
+
+    # ---- flip state machine ----
+
+    def _infer(self, rbg, gt: GroupTopology) -> str:
+        u = rbg.spec.role(gt.unified_role)
+        return POSTURE_UNIFIED if (u is not None and u.replicas > 0) \
+            else POSTURE_DISAGG
+
+    def _policy(self, key) -> TopologyPolicy:
+        key = tuple(key)
+        p = self._policies.get(key)
+        if p is None:
+            p = self._policies[key] = TopologyPolicy(self.cfg.policy)
+        return p
+
+    def _gauge(self, group: str, value: float) -> None:
+        REGISTRY.set_gauge(names.TOPOLOGY_POSTURE, value, group=group)
+
+    def _adapters(self, store, gt: GroupTopology, rbg) -> Dict[str, object]:
+        roles = set(gt.all_roles())
+        return {sa.spec.role_name: sa
+                for sa in store.list_for("ScalingAdapter", rbg, copy_=False)
+                if sa.spec.role_name in roles}
+
+    def _flip_blocked(self, store, gt, rbg, d) -> Optional[tuple]:
+        """(kind, why) when this flip must not START, else None.
+
+        ``conflict``: an adapter carries a write the stamping writer has
+        not adopted yet — flipping now would interleave two actuators'
+        half-applied targets. ``infeasible``: the adapters' own [min,
+        max] bounds make the flip un-completable (an old-shape role with
+        min_replicas > 0 can never drain to zero; a target role with
+        max_replicas below its plan can never report ready) — refusing
+        up front turns a would-be permanent mid-flip wedge into a
+        visible, retriable HOLD."""
+        adapters = self._adapters(store, gt, rbg)
+        for sa in adapters.values():
+            stamp = sa.metadata.annotations.get(C.ANN_AUTOSCALE_LAST_WRITE)
+            if (stamp is not None and sa.spec.replicas is not None
+                    and str(sa.spec.replicas) != stamp):
+                return ("conflict", "another actuator's adapter write "
+                                    "is in flight")
+        target = d.recommendation
+        new_roles = {r for r, _ in gt.shape_roles(target)}
+        for role, plan in gt.shape_roles(target):
+            sa = adapters.get(role)
+            if (sa is not None and sa.spec.max_replicas > 0
+                    and sa.spec.max_replicas < plan):
+                return ("infeasible",
+                        f"{role} adapter max_replicas="
+                        f"{sa.spec.max_replicas} < shape plan {plan}")
+        for role, _ in gt.shape_roles(d.current):
+            sa = adapters.get(role)
+            if (role not in new_roles and sa is not None
+                    and sa.spec.min_replicas > 0):
+                return ("infeasible",
+                        f"{role} adapter min_replicas="
+                        f"{sa.spec.min_replicas} > 0: old shape can "
+                        f"never drain")
+        return None
+
+    def _begin(self, store, gt: GroupTopology, rbg,
+               d: TopologyDecision) -> None:
+        ns, name = gt.namespace, gt.group
+        target = d.recommendation
+        started = f"{time.time():.3f}"
+
+        def fn(g):
+            a = g.metadata.annotations
+            if a.get(C.ANN_TOPOLOGY_STATE):
+                return False     # a concurrent pass already started one
+            a[C.ANN_TOPOLOGY_STATE] = STATE_WARMING
+            a[C.ANN_TOPOLOGY_TARGET] = target
+            a[C.ANN_TOPOLOGY_STARTED] = started
+            a.setdefault(C.ANN_TOPOLOGY_POSTURE, d.current)
+            return True
+
+        try:
+            store.mutate("RoleBasedGroup", ns, name, fn)
+        except (NotFound, Conflict):
+            self._policy((ns, name)).revoke(d)
+            return
+        root = trace.start_trace(names.SPAN_TOPOLOGY_FLIP, group=name,
+                                 target=target)
+        self._spans[(ns, name)] = {
+            "root": root,
+            "phase": root.child(names.SPAN_TOPOLOGY_WARM)}
+        self._gauge(name, 0.5)
+        store.record_event(
+            rbg, "TopologyFlip",
+            f"{d.current} -> {target} ({d.reason}); warming "
+            f"{[r for r, _ in gt.shape_roles(target)]}")
+
+    def _advance(self, store, gt: GroupTopology, rbg, posture: str,
+                 state: str, now: float) -> None:
+        ns, name = gt.namespace, gt.group
+        ann = rbg.metadata.annotations
+        target = ann.get(C.ANN_TOPOLOGY_TARGET) or posture
+        if state == STATE_WARMING:
+            self._ensure_shape(store, gt, rbg, gt.shape_roles(target))
+            if self._shape_ready(store, gt, rbg, target):
+                self._set_state(store, gt, STATE_CUTOVER,
+                                names.SPAN_TOPOLOGY_CUTOVER)
+        elif state == STATE_CUTOVER:
+            self._cutover(store, gt, rbg, posture, target)
+            self._set_state(store, gt, STATE_DRAINING,
+                            names.SPAN_TOPOLOGY_DRAIN)
+        elif state == STATE_DRAINING:
+            old = gt.shape_roles(posture)
+            self._ensure_shape(store, gt, rbg,
+                               [(r, 0) for r, _ in old])
+            if self._drained(store, gt, [r for r, _ in old]):
+                self._complete(store, gt, rbg, posture, target, now)
+        self._record(gt, posture, state, None, now, target=target)
+
+    def _set_state(self, store, gt: GroupTopology, state: str,
+                   span_name: str) -> None:
+        ns, name = gt.namespace, gt.group
+
+        def fn(g):
+            a = g.metadata.annotations
+            if a.get(C.ANN_TOPOLOGY_STATE) == state:
+                return False
+            a[C.ANN_TOPOLOGY_STATE] = state
+            return True
+
+        try:
+            store.mutate("RoleBasedGroup", ns, name, fn)
+        except (NotFound, Conflict):
+            return
+        spans = self._spans.get((ns, name))
+        if spans is not None:
+            spans["phase"].end()
+            spans["phase"] = spans["root"].child(span_name)
+
+    def _ensure_shape(self, store, gt: GroupTopology, rbg,
+                      roles: List[Tuple[str, int]]) -> None:
+        """Idempotent adapter writes for a shape's roles, each stamped
+        with the two-writer ownership annotation; pending TPU instances
+        of a warming role get SparePool grants."""
+        from rbg_tpu.autoscale.controller import AutoscaleController
+        from rbg_tpu.runtime.controllers.scalingadapter import adapter_name
+        ns = gt.namespace
+        for role, replicas in roles:
+            sa_name = adapter_name(gt.group, role)
+
+            def fn(a, replicas=replicas):
+                # The adapter's own [min, max] bounds the write (the
+                # PR-9 clamp, applied on OUR side so the adapter
+                # controller never rewrites our value — which would
+                # read as a foreign writer next cycle). _flip_blocked
+                # already refused flips these bounds make
+                # un-completable.
+                v = AutoscaleController._bound_to_adapter(a, replicas)
+                if (a.spec.replicas == v
+                        and a.metadata.annotations.get(
+                            C.ANN_AUTOSCALE_LAST_WRITE) == str(v)):
+                    return False
+                a.spec.replicas = v
+                # Whoever writes, stamps (PR-9 protocol): the autoscaler
+                # adopts this as its baseline instead of conflicting.
+                a.metadata.annotations[C.ANN_AUTOSCALE_LAST_WRITE] = str(v)
+                return True
+
+            try:
+                store.mutate("ScalingAdapter", ns, sa_name, fn)
+            except (NotFound, Conflict):
+                continue     # adapter not created yet — next poll retries
+            if replicas > 0:
+                self._grant_spares(store, gt, rbg, role)
+
+    def _grant_spares(self, store, gt: GroupTopology, rbg, role) -> None:
+        """Bind-time warm-up: unbound pending TPU instances of a warming
+        role take reserved spare slices (the PR-3 grant seam, shared
+        with the autoscaler via ``capacity.grant_spares_for_role``)."""
+        from rbg_tpu.sched.capacity import grant_spares_for_role
+        spec = rbg.spec.role(role)
+        if self.spares is None or spec is None or spec.tpu is None:
+            return
+
+        def on_grant(inst, target):
+            store.record_event(
+                inst, "TopologySpareGrant",
+                f"warming {role} granted warm spare {target}")
+
+        grant_spares_for_role(store, self.spares, gt.namespace, gt.group,
+                              role, spec.tpu.slice_topology,
+                              on_grant=on_grant)
+
+    def _shape_ready(self, store, gt: GroupTopology, rbg,
+                     target: str) -> bool:
+        """Every target role reports ready at the replica count the
+        adapter write could actually LAND (the clamped value — bounds
+        may have tightened mid-flip; comparing against the unclamped
+        plan would park the machine in Warming forever)."""
+        from rbg_tpu.autoscale.controller import AutoscaleController
+        adapters = self._adapters(store, gt, rbg)
+        for role, replicas in gt.shape_roles(target):
+            sa = adapters.get(role)
+            want = (AutoscaleController._bound_to_adapter(sa, replicas)
+                    if sa is not None else replicas)
+            st = rbg.status.role(role)
+            if st is None or st.ready_replicas < want:
+                return False
+        return True
+
+    def _cutover(self, store, gt: GroupTopology, rbg, posture: str,
+                 target: str) -> None:
+        """Role-by-role candidacy flip: the target shape's roles join the
+        serving set FIRST, then the old shape's roles are withdrawn —
+        there is never an instant with no candidate for new traffic."""
+        ns, name = gt.namespace, gt.group
+        new_roles = [r for r, _ in gt.shape_roles(target)]
+        old_roles = [r for r, _ in gt.shape_roles(posture)
+                     if r not in new_roles]
+        for role in new_roles:
+            self._set_candidacy(name, role, True)
+        self._publish_serving(store, gt, new_roles + old_roles)
+        for role in old_roles:
+            self._set_candidacy(name, role, False)
+        self._publish_serving(store, gt, new_roles)
+        store.record_event(
+            rbg, "TopologyCutOver",
+            f"router candidacy -> {new_roles} (withdrawn: {old_roles})")
+
+    def _set_candidacy(self, group: str, role: str, active: bool) -> None:
+        if self.cfg.candidacy_fn is None:
+            return
+        try:
+            self.cfg.candidacy_fn(group, role, active)
+        except Exception:
+            pass
+
+    def _publish_serving(self, store, gt: GroupTopology,
+                         roles: List[str]) -> None:
+        val = json.dumps(sorted(roles))
+
+        def fn(g):
+            if g.metadata.annotations.get(C.ANN_TOPOLOGY_SERVING) == val:
+                return False
+            g.metadata.annotations[C.ANN_TOPOLOGY_SERVING] = val
+            return True
+
+        try:
+            store.mutate("RoleBasedGroup", gt.namespace, gt.group, fn)
+        except (NotFound, Conflict):
+            pass
+
+    def _drained(self, store, gt: GroupTopology,
+                 old_roles: List[str]) -> bool:
+        """The old shape is gone only when no RoleInstance of its roles
+        survives — every drain window ran to ack or deadline, so every
+        in-flight stream finished or re-routed."""
+        for role in old_roles:
+            if store.list("RoleInstance", namespace=gt.namespace,
+                          selector={C.LABEL_GROUP_NAME: gt.group,
+                                    C.LABEL_ROLE_NAME: role},
+                          copy_=False):
+                return False
+        return True
+
+    def _complete(self, store, gt: GroupTopology, rbg, posture: str,
+                  target: str, now: float) -> None:
+        ns, name = gt.namespace, gt.group
+        started = rbg.metadata.annotations.get(C.ANN_TOPOLOGY_STARTED)
+
+        def fn(g):
+            a = g.metadata.annotations
+            if not a.get(C.ANN_TOPOLOGY_STATE):
+                return False
+            a.pop(C.ANN_TOPOLOGY_STATE, None)
+            a.pop(C.ANN_TOPOLOGY_TARGET, None)
+            a.pop(C.ANN_TOPOLOGY_STARTED, None)
+            a[C.ANN_TOPOLOGY_POSTURE] = target
+            return True
+
+        try:
+            store.mutate("RoleBasedGroup", ns, name, fn)
+        except (NotFound, Conflict):
+            return
+        try:
+            duration = max(0.0, time.time() - float(started))
+        except (TypeError, ValueError):
+            duration = 0.0
+        REGISTRY.observe(names.TOPOLOGY_SWITCH_DURATION_SECONDS, duration,
+                         target=target)
+        REGISTRY.inc(names.TOPOLOGY_FLIPS_TOTAL, group=name, target=target)
+        self._gauge(name, 1.0 if target == POSTURE_DISAGG else 0.0)
+        # Cooldown re-latches at completion too, so a plane that RESUMED
+        # this flip from annotations (decide() never ran here) still
+        # honors the post-flip cooldown.
+        self._policy((ns, name)).note_flip(now)
+        spans = self._spans.pop((ns, name), None)
+        if spans is not None:
+            spans["phase"].end()
+            spans["root"].end(outcome="flipped", duration_s=round(duration, 3))
+        store.record_event(
+            rbg, "TopologyFlipped",
+            f"{posture} -> {target} in {duration:.2f}s (old shape drained)")
+
+    # ---- bookkeeping ----
+
+    def _record(self, gt: GroupTopology, posture: str,
+                state: Optional[str], decision: Optional[TopologyDecision],
+                now: float, target: Optional[str] = None) -> None:
+        key = (gt.namespace, gt.group)
+        policy = self._policy(key)
+        row = {
+            "namespace": gt.namespace, "group": gt.group,
+            "posture": posture, "state": state or "",
+            "target": target or "",
+            "enabled": self.enabled(gt.namespace, gt.group),
+            "cooldown_remaining_s": round(
+                policy.cooldown_remaining(now), 2),
+            "last_decision": (decision.as_dict() if decision is not None
+                              else (policy.last_decision.as_dict()
+                                    if policy.last_decision else None)),
+        }
+        with self._lock:
+            self._status[key] = row

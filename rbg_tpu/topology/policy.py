@@ -1,0 +1,247 @@
+"""Topology decision layer: UNIFIED vs DISAGG vs HOLD.
+
+Per "Prefill-Decode Aggregation or Disaggregation? Unifying Both for
+Goodput-Optimized LLM Serving" (PAPERS.md): neither PD shape wins at every
+load mix — long-prompt traffic wants disaggregation (prefill never
+monopolizes decode steps), short-prompt chat wants the unified engine (no
+KV transfer tax). The policy reads the measured prefill:decode token
+ratio from the windowed-signal plane and recommends a shape behind the
+same stability machinery the autoscaler uses (PR-9 policy style):
+
+* **deadband hysteresis** — DISAGG pressure only at ratio >=
+  ``disagg_ratio``, UNIFIED pressure only at ratio <= ``unified_ratio``;
+  the band between is a deliberate no-man's-land so a mix oscillating
+  around one threshold cannot flap the fleet;
+* **direction-split stabilization** — pressure toward a shape must hold
+  continuously for that direction's stabilization window before it
+  actuates (disagg and unified windows tune independently);
+* **cooldown** — after a flip starts, the group holds for ``cooldown_s``;
+* **staleness / missing ratio → HOLD** — a dead sampler or a ratio the
+  reader could not measure (one PD side judged nothing in the window)
+  never drives a flip, and pressure onsets are forgotten;
+* **switch-cost gate** — the estimated KV bytes to re-home over the
+  MEASURED link rate (``rbg_kvtransfer_link_bytes_per_s``) must fit
+  ``max_switch_cost_s``, or the flip is vetoed: a shape change that costs
+  more than it buys is thrash, not optimization.
+
+Pure state-machine code: ``now`` is a parameter, no clocks are read, no
+store is touched — the controller owns all I/O.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+POSTURE_UNIFIED = "unified"
+POSTURE_DISAGG = "disagg"
+POSTURES = (POSTURE_UNIFIED, POSTURE_DISAGG)
+REC_HOLD = "hold"
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologySignals:
+    """One group's windowed decision inputs at one evaluation instant.
+    ``None`` fields mean "not measured in this window"."""
+
+    fresh: bool
+    sample_age_s: Optional[float] = None
+    # Prompt:output token-rate ratio over the window (ingress vantage, or
+    # per-role token rates when the group is already disaggregated).
+    prefill_decode_ratio: Optional[float] = None
+    judged: int = 0
+    ttft_attainment: Optional[float] = None
+    tpot_attainment: Optional[float] = None
+    goodput_rps: Optional[float] = None
+    queue_depth: Optional[float] = None
+    # Switch-cost inputs: resident KV the flip would re-home, and the
+    # measured transfer-plane link rate.
+    kv_bytes_to_move: Optional[float] = None
+    link_bytes_per_s: Optional[float] = None
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class TopologyPolicyConfig:
+    """Tuning for one group's shape decision. The deadband is
+    [unified_ratio, disagg_ratio]; keep it wide — the cost of a wrong
+    HOLD is a few percent of goodput, the cost of a flap is a full warm +
+    drain cycle."""
+
+    disagg_ratio: float = 6.0      # ratio >= this -> DISAGG pressure
+    unified_ratio: float = 2.0     # ratio <= this -> UNIFIED pressure
+    min_judged: int = 3            # below this the window is anecdote
+    disagg_stabilization_s: float = 30.0
+    unified_stabilization_s: float = 60.0
+    cooldown_s: float = 120.0
+    # Flip veto: estimated KV move time (bytes / measured link rate) must
+    # stay under this. 0 disables the gate.
+    max_switch_cost_s: float = 30.0
+    enabled: bool = True
+
+
+@dataclasses.dataclass
+class TopologyDecision:
+    current: str                   # posture the decision was made from
+    recommendation: str            # unified | disagg | hold
+    reason: str
+    # stale | no_ratio | low_sample | deadband | stabilizing | cooldown |
+    # cost_gated | disabled
+    suppressed: Optional[str] = None
+    est_switch_cost_s: Optional[float] = None
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class TopologyPolicy:
+    """Hysteresis state for one group. ``decide(now, signals, current)``
+    is the whole API; the instance remembers per-direction pressure
+    onsets and the last flip time. Like the autoscaler's RoleScaler, a
+    non-HOLD decision latches cooldown immediately — ``revoke()`` gives
+    it back when the controller could not start the flip (actuator
+    conflict), so a retry is not charged a cooldown for a flip that never
+    happened."""
+
+    def __init__(self, cfg: TopologyPolicyConfig):
+        self.cfg = cfg
+        self._pressure_since: Optional[float] = None
+        self._pressure_target: Optional[str] = None
+        self._last_flip: Optional[float] = None
+        self._revoke_state: Optional[tuple] = None
+        self.last_decision: Optional[TopologyDecision] = None
+
+    # -- internals --
+
+    def _hold(self, current: str, reason: str,
+              suppressed: Optional[str] = None,
+              est_cost: Optional[float] = None) -> TopologyDecision:
+        d = TopologyDecision(current, REC_HOLD, reason,
+                             suppressed=suppressed,
+                             est_switch_cost_s=est_cost)
+        self.last_decision = d
+        return d
+
+    def _forget_pressure(self) -> None:
+        self._pressure_since = None
+        self._pressure_target = None
+
+    @staticmethod
+    def estimate_cost_s(sig: TopologySignals) -> Optional[float]:
+        """KV move time the flip would spend, from measured inputs; None
+        when either side is unmeasured (no transfers yet — an unknown
+        cost must not block the first flip forever)."""
+        if not sig.kv_bytes_to_move or not sig.link_bytes_per_s:
+            return None
+        if sig.link_bytes_per_s <= 0:
+            return None
+        return sig.kv_bytes_to_move / sig.link_bytes_per_s
+
+    # -- the API --
+
+    def decide(self, now: float, sig: TopologySignals,
+               current: str) -> TopologyDecision:
+        cfg = self.cfg
+        if not cfg.enabled:
+            return self._hold(current, "disabled", suppressed="disabled")
+        if not sig.fresh:
+            # A dead scrape never flips a fleet; stale time is not
+            # evidence of a sustained mix either.
+            self._forget_pressure()
+            return self._hold(current, "signals stale", suppressed="stale")
+        ratio = sig.prefill_decode_ratio
+        if ratio is None:
+            # The reader refused to fabricate a ratio (one PD side judged
+            # nothing in the window) — HOLD, never flip on inf/0.
+            self._forget_pressure()
+            return self._hold(current, "prefill:decode ratio unmeasured",
+                              suppressed="no_ratio")
+        if sig.judged < cfg.min_judged:
+            self._forget_pressure()
+            return self._hold(
+                current, f"only {sig.judged} judged < {cfg.min_judged}",
+                suppressed="low_sample")
+
+        if ratio >= cfg.disagg_ratio:
+            target = POSTURE_DISAGG
+            why = f"ratio {ratio:.2f} >= {cfg.disagg_ratio:.2f}"
+            window = cfg.disagg_stabilization_s
+        elif ratio <= cfg.unified_ratio:
+            target = POSTURE_UNIFIED
+            why = f"ratio {ratio:.2f} <= {cfg.unified_ratio:.2f}"
+            window = cfg.unified_stabilization_s
+        else:
+            self._forget_pressure()
+            return self._hold(
+                current,
+                f"ratio {ratio:.2f} inside deadband "
+                f"[{cfg.unified_ratio:.2f}, {cfg.disagg_ratio:.2f}]",
+                suppressed="deadband")
+
+        if target == current:
+            self._forget_pressure()
+            return self._hold(current, f"already {current} ({why})")
+
+        # Direction-split stabilization: the onset restarts whenever the
+        # pressure direction changes.
+        if self._pressure_target != target:
+            self._pressure_target = target
+            self._pressure_since = now
+        if now - self._pressure_since < window:
+            return self._hold(current, f"{why} (stabilizing toward {target})",
+                              suppressed="stabilizing")
+
+        est_cost = self.estimate_cost_s(sig)
+        if (cfg.max_switch_cost_s > 0 and est_cost is not None
+                and est_cost > cfg.max_switch_cost_s):
+            return self._hold(
+                current,
+                f"{why} but KV move ~{est_cost:.1f}s > "
+                f"{cfg.max_switch_cost_s:.1f}s gate",
+                suppressed="cost_gated", est_cost=est_cost)
+
+        if (self._last_flip is not None
+                and now - self._last_flip < cfg.cooldown_s):
+            return self._hold(current, f"cooldown ({why})",
+                              suppressed="cooldown", est_cost=est_cost)
+
+        self._revoke_state = (self._last_flip, self._pressure_since,
+                              self._pressure_target)
+        self._last_flip = now
+        self._forget_pressure()
+        d = TopologyDecision(current, target, why,
+                             est_switch_cost_s=est_cost)
+        self.last_decision = d
+        return d
+
+    def revoke(self, decision: TopologyDecision) -> None:
+        """The controller could not START this flip (another actuator's
+        write was in flight, target write lost): undo the cooldown latch
+        and restore the pressure onset."""
+        if decision is not self.last_decision \
+                or decision.recommendation == REC_HOLD:
+            return
+        if self._revoke_state is not None:
+            (self._last_flip, self._pressure_since,
+             self._pressure_target) = self._revoke_state
+            self._revoke_state = None
+
+    def reset_pressure(self) -> None:
+        """Forget the pressure onset without touching cooldown — called
+        while the group is runtime-disabled, so time spent disabled can
+        never count as sustained pressure at re-enable."""
+        self._forget_pressure()
+
+    def note_flip(self, now: float) -> None:
+        """Re-latch cooldown at flip COMPLETION (also called by a plane
+        that resumed a mid-flight flip from annotations, where decide()
+        never ran in this process)."""
+        self._last_flip = now
+        self._forget_pressure()
+
+    def cooldown_remaining(self, now: float) -> float:
+        if self._last_flip is None:
+            return 0.0
+        return max(0.0, self.cfg.cooldown_s - (now - self._last_flip))

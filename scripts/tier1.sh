@@ -115,6 +115,44 @@ assert r.get('bit_identical'), 'streamed decode diverged from reference'
              "invariant red in /tmp/_t1_kvstream.json" >&2
         exit 1
     fi
+    # Adaptive-topology smoke: the agg<->disagg drill at 1 repetition
+    # (the goodput-vs-static gate needs interleaved reps and runs in the
+    # full acceptance drill; the smoke asserts the safety + convergence
+    # invariants and a non-empty goodput curve). Includes the real-engine
+    # token-exact leg (mid-flip stream cut -> bundle fallback). Outside
+    # the 870 s pytest budget, --lint mode only; capped at 300 s.
+    echo "== rbg-tpu stress --scenario topoflip --reps 1 (adaptive topology smoke) =="
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
+            stress --scenario topoflip --reps 1 --json \
+            >/tmp/_t1_topoflip.json; then
+        echo "TIER1 TOPOFLIP SMOKE FAILED — see /tmp/_t1_topoflip.json" \
+             "(invariants)" >&2
+        exit 1
+    fi
+    if ! python -c "
+import json
+r = json.load(open('/tmp/_t1_topoflip.json'))
+inv = r.get('invariants') or {}
+assert inv.get('zero_dropped_streams'), \
+    'a flip dropped streams: %s' % r.get('dropped_streams')
+assert inv.get('topology_converged'), \
+    'controller never converged on the mix shift: %s' % [
+        x.get('flip_started_after_shift_s')
+        for x in (r.get('reps') or {}).get('adaptive', [])]
+assert inv.get('no_flap'), 'flip count exceeded the flap bound'
+assert inv.get('bit_identical'), \
+    'mid-flip stream cut diverged from the unified reference: %s' \
+    % r.get('token_exact')
+curve = r.get('curve') or []
+assert len(curve) > 10 and any(
+    c.get('goodput_frac', 0) > 0 for c in curve), \
+    'goodput curve empty or all-zero'
+"; then
+        echo "TIER1 TOPOFLIP SMOKE FAILED — zero-dropped/converged/" \
+             "bit-identical invariant or goodput curve red in" \
+             "/tmp/_t1_topoflip.json" >&2
+        exit 1
+    fi
     # Control-plane fleet smoke: the 10k-node drill at ~500 nodes. Asserts
     # the control-plane observability invariants (workqueues drain to
     # empty, no stuck keys, event-recorder accounting) and that the

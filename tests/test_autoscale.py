@@ -98,6 +98,34 @@ def test_signal_reader_measured_ratio():
     assert r.measured_ratio("sigtest-p", "never-published", now=10.0) is None
 
 
+def test_signal_reader_measured_ratio_zero_side_is_not_measured():
+    """One role of a PD pair with ZERO activity in the window must read
+    as not-measured (None) — never ratio 0 or ∞. The topology policy
+    consumes this value: a fabricated degenerate ratio would flip a
+    fleet off an idle window."""
+    s = TimeSeriesSampler(interval_s=1.0, retention_s=300.0)
+    s.sample_now(now=0.0)
+    # Both roles have published the counter, but only one moved in the
+    # window (the "zero judged requests on one side" case).
+    REGISTRY.inc(names.SERVING_TOKENS_TOTAL, 0.0, role="sigtest-zp")
+    REGISTRY.inc(names.SERVING_TOKENS_TOTAL, 300.0, role="sigtest-zd")
+    REGISTRY.inc(names.SLO_JUDGED_TOTAL, 0.0, role="sigtest-zp")
+    REGISTRY.inc(names.SLO_JUDGED_TOTAL, 30.0, role="sigtest-zd")
+    s.sample_now(now=10.0)
+    r = SignalReader(sampler=s, window_s=60.0)
+    # Numerator idle -> None (was: 0.0, which a follower target or a
+    # topology decision would happily actuate on).
+    assert r.measured_ratio("sigtest-zp", "sigtest-zd", now=10.0) is None
+    # Denominator idle -> None (was: fell through / inf-shaped).
+    assert r.measured_ratio("sigtest-zd", "sigtest-zp", now=10.0) is None
+    # Both sides active still measures.
+    REGISTRY.inc(names.SERVING_TOKENS_TOTAL, 100.0, role="sigtest-zp")
+    REGISTRY.inc(names.SERVING_TOKENS_TOTAL, 100.0, role="sigtest-zd")
+    s.sample_now(now=20.0)
+    assert r.measured_ratio("sigtest-zp", "sigtest-zd", now=20.0) \
+        == pytest.approx(100.0 / 400.0)
+
+
 # ---- RoleScaler hysteresis -------------------------------------------------
 
 

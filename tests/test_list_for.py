@@ -1,0 +1,172 @@
+"""Store.list_for — the indexed per-reconcile child listing. The
+contract under test is EQUIVALENCE: for every (kind, parent) pair the
+controllers use, ``list_for`` must return exactly what the old full
+listing + group filter returned, through creates, label/spec updates,
+and deletes.
+"""
+
+from __future__ import annotations
+
+from rbg_tpu.api import constants as C
+from rbg_tpu.api.group import RoleBasedGroup
+from rbg_tpu.api.instance import RoleInstance
+from rbg_tpu.api.meta import owner_ref
+from rbg_tpu.api.policy import (
+    CoordinatedPolicy, CoordinatedPolicySpec, CoordinatedScaling,
+    ScalingAdapter, ScalingAdapterSpec,
+)
+from rbg_tpu.runtime.store import Store
+from rbg_tpu.testutil import make_group, simple_role
+
+
+def _full_listing(store, kind, parent):
+    """The pre-index semantics: scan the whole kind, keep objects in the
+    parent's namespace that are owned by it, labeled for it, or
+    back-reference it via spec.group_name."""
+    m = parent.metadata
+    out = []
+    for o in store.list(kind, namespace=m.namespace):
+        owned = any(r.uid == m.uid for r in o.metadata.owner_references)
+        labeled = (parent.kind == "RoleBasedGroup"
+                   and o.metadata.labels.get(C.LABEL_GROUP_NAME) == m.name)
+        backref = (parent.kind == "RoleBasedGroup"
+                   and getattr(getattr(o, "spec", None), "group_name",
+                               None) == m.name)
+        if owned or labeled or backref:
+            out.append(o)
+    return out
+
+
+def _names(objs):
+    return [o.metadata.name for o in objs]
+
+
+def _adapter(name, group, role, ns="default", owner=None):
+    sa = ScalingAdapter()
+    sa.metadata.name = name
+    sa.metadata.namespace = ns
+    sa.spec = ScalingAdapterSpec(group_name=group, role_name=role)
+    if owner is not None:
+        sa.metadata.owner_references = [owner_ref(owner)]
+    return sa
+
+
+def _policy(name, group, ns="default"):
+    p = CoordinatedPolicy()
+    p.metadata.name = name
+    p.metadata.namespace = ns
+    p.spec = CoordinatedPolicySpec(
+        group_name=group,
+        scaling=CoordinatedScaling(roles=["a", "b"], max_skew_percent=10))
+    return p
+
+
+def _instance(name, group, role, ns="default", owner=None):
+    inst = RoleInstance()
+    inst.metadata.name = name
+    inst.metadata.namespace = ns
+    inst.metadata.labels = {C.LABEL_GROUP_NAME: group,
+                            C.LABEL_ROLE_NAME: role}
+    if owner is not None:
+        inst.metadata.owner_references = [owner_ref(owner)]
+    return inst
+
+
+def _assert_equivalent(store, parents, kinds):
+    for parent in parents:
+        for kind in kinds:
+            assert _names(store.list_for(kind, parent)) == \
+                _names(_full_listing(store, kind, parent)), \
+                f"{kind} for {parent.metadata.namespace}/" \
+                f"{parent.metadata.name}"
+
+
+def test_list_for_matches_full_listing_through_churn():
+    store = Store()
+    g1 = store.create(make_group("g", simple_role("serve")))
+    # Same NAME in another namespace: the sharpest aliasing case the
+    # label bucket (not namespace-scoped) must not leak across.
+    g_other = store.create(make_group("g", simple_role("serve"),
+                                      namespace="other"))
+    g2 = store.create(make_group("g2", simple_role("serve")))
+
+    # Children across all three attachment mechanisms:
+    store.create(_adapter("sa-owned", "g", "serve", owner=g1))  # owner+spec
+    store.create(_adapter("sa-spec-only", "g", "serve"))        # spec only
+    store.create(_adapter("sa-other-ns", "g", "serve", ns="other",
+                          owner=g_other))
+    store.create(_adapter("sa-g2", "g2", "serve", owner=g2))
+    store.create(_policy("cp-g", "g"))                          # spec only
+    store.create(_policy("cp-other", "g", ns="other"))
+    store.create(_policy("cp-g2", "g2"))
+    store.create(_instance("g-serve-a", "g", "serve", owner=g1))  # label
+    store.create(_instance("g2-serve-a", "g2", "serve", owner=g2))
+
+    parents = [store.get("RoleBasedGroup", "default", "g"),
+               store.get("RoleBasedGroup", "other", "g"),
+               store.get("RoleBasedGroup", "default", "g2")]
+    kinds = ("ScalingAdapter", "CoordinatedPolicy", "RoleInstance")
+    _assert_equivalent(store, parents, kinds)
+
+    # Spot-check the interesting rows landed where expected.
+    assert _names(store.list_for("ScalingAdapter", parents[0])) == \
+        ["sa-owned", "sa-spec-only"]
+    assert _names(store.list_for("CoordinatedPolicy", parents[0])) == \
+        ["cp-g"]
+    assert _names(store.list_for("ScalingAdapter", parents[1])) == \
+        ["sa-other-ns"]
+
+    # Back-reference UPDATE moves the child between parents' views.
+    def move(a):
+        a.spec.group_name = "g2"
+        return True
+    store.mutate("ScalingAdapter", "default", "sa-spec-only", move)
+    _assert_equivalent(store, parents, kinds)
+    assert "sa-spec-only" in _names(
+        store.list_for("ScalingAdapter", parents[2]))
+
+    # Label UPDATE re-indexes.
+    def relabel(i):
+        i.metadata.labels[C.LABEL_GROUP_NAME] = "g2"
+        return True
+    store.mutate("RoleInstance", "default", "g-serve-a", relabel)
+    _assert_equivalent(store, parents, kinds)
+
+    # Deletes drop out of every view (owner cascade included).
+    store.delete("ScalingAdapter", "default", "sa-owned")
+    store.delete("RoleBasedGroup", "default", "g2")
+    parents = [p for p in parents if store.get(
+        p.kind, p.metadata.namespace, p.metadata.name)]
+    _assert_equivalent(store, parents, kinds)
+    # g2's cascade took its owned adapter; the moved spec-only adapter
+    # now references a dead group name — and therefore appears for no
+    # surviving parent.
+    for p in parents:
+        assert "sa-g2" not in _names(store.list_for("ScalingAdapter", p))
+
+
+def test_list_for_owner_parent_instances():
+    """RoleInstanceSet → RoleInstance: pure owner-index parentage (the
+    instanceset controller's per-reconcile listing)."""
+    from rbg_tpu.api.instance import RoleInstanceSet
+
+    store = Store()
+    ris = RoleInstanceSet()
+    ris.metadata.name = "ris-a"
+    ris.metadata.namespace = "default"
+    ris = store.create(ris)
+    ris2 = RoleInstanceSet()
+    ris2.metadata.name = "ris-b"
+    ris2.metadata.namespace = "default"
+    ris2 = store.create(ris2)
+    for i in range(3):
+        store.create(_instance(f"ris-a-{i}", "g", "serve", owner=ris))
+    store.create(_instance("ris-b-0", "g", "serve", owner=ris2))
+
+    assert _names(store.list_for("RoleInstance", ris)) == \
+        ["ris-a-0", "ris-a-1", "ris-a-2"]
+    assert _names(store.list_for("RoleInstance", ris2)) == ["ris-b-0"]
+    # copy_=False returns the live objects (read-only hot path).
+    live = store.list_for("RoleInstance", ris, copy_=False)
+    assert live[0] is store.get("RoleInstance", "default", "ris-a-0",
+                                copy_=False)

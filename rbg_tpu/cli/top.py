@@ -140,6 +140,29 @@ def _render_engine(src: dict, window: int) -> List[str]:
     lines.append(_ROLE_HDR)
     lines.extend(_tracker_role_rows(slo.get("trackers") or [], window,
                                     signals, stats))
+    lines.extend(_cache_panel(slo.get("cache") or {}))
+    return lines
+
+
+def _cache_panel(cache: dict) -> List[str]:
+    """KV cache-hierarchy panel (host-DRAM spill tier under the device
+    radix cache) — omitted entirely when the engine never published tier
+    gauges (host tier off)."""
+    tiers = cache.get("tiers") or {}
+    if not tiers:
+        return []
+    lines = [
+        f"  kv cache — miss {_fmt(cache.get('misses_per_s'), 2, '/s')}, "
+        f"spill {_fmt(cache.get('spill_pages_per_s'), 1, ' pg/s')}, "
+        f"promote {_fmt(cache.get('promote_pages_per_s'), 1, ' pg/s')}",
+        f"  {'TIER':<8} {'PAGES':>7} {'MBYTES':>8} {'HIT/S':>7} "
+        f"{'EVICT-PG/S':>11}"]
+    for tier, t in sorted(tiers.items()):
+        mb = (t.get("bytes") / 1e6) if t.get("bytes") is not None else None
+        lines.append(
+            f"  {tier:<8} {_fmt(t.get('pages'), 0):>7} {_fmt(mb, 1):>8} "
+            f"{_fmt(t.get('hits_per_s')):>7} "
+            f"{_fmt(t.get('evicted_pages_per_s')):>11}")
     return lines
 
 

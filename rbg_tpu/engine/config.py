@@ -18,6 +18,13 @@ class EngineConfig:
     prefill_chunk: int = 64                 # chunked-prefill bucket
     decode_buckets: Tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
     enable_radix_cache: bool = True
+    # Host-DRAM KV spill tier (engine/kvtier.py): when > 0, radix-cache
+    # evictions spill their pages into a host trie bounded to this many
+    # bytes instead of discarding them, and admission promotes host-held
+    # prefixes back onto device. Mooncake's "more storage for less
+    # computation" level — needs the radix cache; int8 KV pools keep it
+    # off (spilled pages would need their scales carried too).
+    host_tier_bytes: int = 0
     # Decode steps fused into ONE device dispatch (lax.scan window) — the
     # JetStream-style device-side decode loop. Each window samples K tokens
     # per sequence before control returns to the host, amortizing dispatch
@@ -68,6 +75,14 @@ class EngineConfig:
     # always counts as met).
     slo_ttft_s: float = 2.0
     slo_tpot_s: float = 0.5
+    # Predictive early rejection (Mooncake's overload story): admission
+    # predicts TTFT — measured queue wait plus prefill time net of the
+    # prefix hit this request would get — and sheds at INGRESS with
+    # retry_after_s when the prediction exceeds early_reject_factor ×
+    # slo_ttft_s, before any prefill compute is spent. "auto" arms it
+    # whenever slo_ttft_s > 0; "off" keeps the PR-2 deadline-only gate.
+    early_reject: str = "off"               # off | auto
+    early_reject_factor: float = 1.5
     mode: str = "unified"                   # unified | prefill | decode
     mesh_spec: Optional[dict] = None        # {"dp": 1, "tp": 4} — from discovery
     checkpoint_path: str = ""               # orbax dir or local HF dir
@@ -113,6 +128,22 @@ class EngineConfig:
         if self.slo_ttft_s < 0 or self.slo_tpot_s < 0:
             raise ValueError("slo_ttft_s / slo_tpot_s must be >= 0 "
                              "(0 disables that SLO dimension)")
+        if self.host_tier_bytes < 0:
+            raise ValueError("host_tier_bytes must be >= 0 (0 disables "
+                             "the host spill tier)")
+        if self.host_tier_bytes and self.kv_dtype == "int8":
+            raise ValueError("host_tier_bytes with kv_dtype='int8': the "
+                             "spill tier does not carry page scales yet")
+        if self.host_tier_bytes and not self.enable_radix_cache:
+            raise ValueError(
+                "host_tier_bytes needs the radix cache (spills come from "
+                "its evictions) — a silently absent tier would discard "
+                "every evicted prefix the operator budgeted RAM to keep")
+        if self.early_reject not in ("off", "auto"):
+            raise ValueError(f"early_reject {self.early_reject!r} not in "
+                             "(off, auto)")
+        if self.early_reject_factor <= 0:
+            raise ValueError("early_reject_factor must be > 0")
         if self.kv_dtype not in ("model", "int8"):
             raise ValueError(f"kv_dtype {self.kv_dtype!r} not in (model, int8)")
         if self.kv_dtype == "int8" and self.mode != "unified":

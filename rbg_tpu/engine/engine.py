@@ -39,6 +39,8 @@ from rbg_tpu.engine.kvcache import PageAllocator, PagedKVCache, pages_for_tokens
 from rbg_tpu.engine.radix_cache import RadixCache
 from rbg_tpu.engine.sampler import NEG_INF, row_keys, sample, step_keys
 from rbg_tpu.models.llama import forward_paged, forward_ragged, init_params
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs.metrics import REGISTRY
 
 
 @dataclasses.dataclass
@@ -120,6 +122,13 @@ class Engine:
                                          quantize=(cfg.kv_dtype == "int8"))
         self.allocator = PageAllocator(cfg.num_pages)
         self.radix = RadixCache(self.allocator, cfg.page_size) if cfg.enable_radix_cache else None
+        # Host-DRAM spill tier under the device pool (engine/kvtier.py):
+        # radix evictions spill into it, admission promotes out of it.
+        self.host_tier = None
+        if cfg.host_tier_bytes and self.radix is not None \
+                and not self.cache.quantized:
+            from rbg_tpu.engine.kvtier import HostKVTier
+            self.host_tier = HostKVTier(cfg.page_size, cfg.host_tier_bytes)
 
         if mesh is not None:
             self._shard_state(mesh)
@@ -166,7 +175,8 @@ class Engine:
         self._lora_raw: List[Tuple[dict, float]] = []
         self.lora_stack: Optional[dict] = None
         self.metrics = {"steps": 0, "decode_tokens": 0, "prefill_tokens": 0,
-                        "radix_hit_tokens": 0, "preemptions": 0,
+                        "radix_hit_tokens": 0, "host_hit_tokens": 0,
+                        "preemptions": 0,
                         "spec_drafted": 0, "spec_accepted": 0,
                         "spec_steps": 0, "unified_steps": 0, "joins": 0,
                         "join_wait_steps_max": 0, "join_excess_steps_max": 0}
@@ -610,12 +620,18 @@ class Engine:
                 break
             req = self.waiting[0]
             matched, shared_pages = 0, []
+            radix_matched = host_matched = 0
             if (self.radix is not None and req.state == "waiting"
                     and req.lora_idx == 0):
                 # Keep at least the prompt's last token for prefill (logits).
                 # Adapter requests skip the prefix cache: their KV differs
                 # from base-model KV for the same tokens.
                 matched, shared_pages = self.radix.match(req.prompt[:-1])
+                radix_matched = matched
+                if self.host_tier is not None:
+                    matched, shared_pages = self._promote_host(
+                        req, matched, shared_pages)
+                    host_matched = matched - radix_matched
             # Admit with pages for the PROMPT + first token only — decode
             # grows page-by-page (memory oversubscription; preemption
             # reclaims on exhaustion). Reserving max_len up front would
@@ -651,21 +667,116 @@ class Engine:
             req.seq_len = matched
             req.state = "prefill"
             self.running.append(req)
-            self.metrics["radix_hit_tokens"] += matched
+            # Hit accounting happens HERE, on admission success, and the
+            # two tiers' counters sum to the request's total hit. A
+            # promotion whose request then fails its remaining alloc
+            # must count NOTHING: the promoted pages entered the radix,
+            # so the retry's radix.match re-finds them — charging the
+            # promotion too would double-count the same tokens. Same
+            # rule for the registry tier counters: a blocked request
+            # re-attempts every step and must not inflate the panel.
+            self.metrics["radix_hit_tokens"] += radix_matched
+            self.metrics["host_hit_tokens"] += host_matched
+            if self.host_tier is not None and req.lora_idx == 0:
+                if host_matched:
+                    REGISTRY.inc(obs_names.KVC_TIER_HITS_TOTAL,
+                                 tier="host")
+                elif radix_matched:
+                    REGISTRY.inc(obs_names.KVC_TIER_HITS_TOTAL,
+                                 tier="device")
+                else:
+                    REGISTRY.inc(obs_names.KVC_TIER_MISSES_TOTAL)
         if blocked:
             # Every still-queued request sat this step out for a capacity
             # reason — the excess-wait metric must not count it.
             for r in self.waiting:
                 r.blocked_steps += 1
 
+    def _promote_host(self, req: "Request", matched: int,
+                      shared_pages: List[int]):
+        """Extend a radix hit from the host spill tier: promoted pages
+        move onto freshly allocated device pages and enter the radix
+        cache, so this request — and every later one — device-hits
+        them. Tier hit/miss accounting lives here (the one admission
+        site where both tiers are consulted)."""
+        h_tokens, h_pages, new_cache = self.host_tier.promote_to_device(
+            req.prompt[:-1], matched, self._alloc, self.cache,
+            release_fn=self.allocator.release)
+        if h_tokens:
+            self.cache = new_cache
+            # The radix insert takes the cache's own reference on the
+            # promoted pages (share()) — the request's ref stays
+            # separate, exactly like a radix hit. Token accounting is
+            # the CALLER's, on admission success only.
+            self.radix.insert(req.prompt[:matched + h_tokens],
+                              shared_pages + h_pages)
+            shared_pages = shared_pages + h_pages
+            matched += h_tokens
+        self._publish_tier_gauges()
+        return matched, shared_pages
+
     def _alloc(self, n: int) -> Optional[List[int]]:
         if n <= 0:
             return []
         pages = self.allocator.alloc(n)
         if pages is None and self.radix is not None:
-            self.radix.evict(n - self.allocator.free_pages)
+            self.radix.evict(
+                n - self.allocator.free_pages,
+                on_evict=(self._spill_evicted if self.host_tier is not None
+                          else None))
             pages = self.allocator.alloc(n)
+            if self.host_tier is not None:
+                self._publish_tier_gauges()
         return pages
+
+    def _spill_evicted(self, prefix_tokens: List[int],
+                       page_ids: List[int]) -> None:
+        """Radix eviction hook: copy the evicted leaf's device pages into
+        the host tier BEFORE their allocator release (device contents are
+        still valid here; ids may recycle right after).
+
+        Pages a RUNNING request still pins (refcount > 1: the cache's
+        ref plus the request's) are NOT spilled: they stay device-
+        resident and re-enter the radix when that request finishes —
+        spilling a copy would leave the same content resident in both
+        tiers, breaking the exactly-one-tier contract. A request's
+        match/share always takes a PREFIX of a node's pages, so the
+        pinned region is a prefix of ``page_ids`` and the free tail is
+        contiguous."""
+        k = 0
+        while k < len(page_ids) \
+                and self.allocator.refcount(page_ids[k]) > 1:
+            k += 1
+        if k == len(page_ids):
+            return
+        self.host_tier.spill_from_device(prefix_tokens, page_ids[k:],
+                                         self.cache)
+
+    def _publish_tier_gauges(self) -> None:
+        if self.radix is None or self.host_tier is None:
+            return
+        pages = self.radix.cached_pages
+        per_page = ((self.cache.k_pages.nbytes + self.cache.v_pages.nbytes)
+                    / max(1, self.cache.num_pages))
+        REGISTRY.set_gauge(obs_names.KVC_TIER_PAGES, float(pages),
+                           tier="device")
+        REGISTRY.set_gauge(obs_names.KVC_TIER_BYTES,
+                           float(pages * per_page), tier="device")
+
+    def prefix_peek(self, prompt: List[int]) -> int:
+        """Advisory total prefix-hit depth (device radix + host tier)
+        this prompt would get at admission. Read cross-thread by the
+        admission TTFT predictor — pure dict walks, best-effort: a stale
+        or zero answer only skews one prediction, never correctness."""
+        if self.radix is None or len(prompt) < 2:
+            return 0
+        try:
+            m = self.radix.peek(prompt[:-1])
+            if self.host_tier is not None:
+                m += self.host_tier.peek(prompt[:-1], m)
+            return m
+        except Exception:  # noqa: BLE001 — racy read, degrade to miss
+            return 0
 
     # ---- ragged unified prefill/decode step ----
 
@@ -1696,6 +1807,8 @@ class Engine:
             # Cache the full sequence (prompt + output) for future prefixes
             # (base-model requests only — adapter KV must not cross-match).
             self.radix.insert(req.prompt + req.output[:-1], req.pages)
+            if self.host_tier is not None:
+                self._publish_tier_gauges()
         self.allocator.release(req.pages)
         req.pages = []
         # Don't retain finished requests forever (long-running servers).

@@ -55,7 +55,7 @@ from rbg_tpu.utils.racetrace import guard as _race_guard
 
 class _Node:
     __slots__ = ("key", "k", "v", "children", "parent", "last_used",
-                 "nbytes", "dirkey")
+                 "nbytes", "dirkey", "hits")
 
     def __init__(self, key: Tuple[int, ...], parent):
         self.key = key                    # page_size tokens
@@ -68,6 +68,17 @@ class _Node:
         # Directory hash-chain key of the prefix ending at this node
         # (chunks.prefix_keys convention) — eviction invalidates it.
         self.dirkey: str = ""
+        # Hotness: payload matches through this node. Eviction is
+        # LRU-by-hotness — coldest (fewest hits) pages go first.
+        self.hits = 0
+
+    @property
+    def placeholder(self) -> bool:
+        """Path-only node: a deeper tier (the device radix cache) still
+        holds this page, so the trie keeps the route to payload pages
+        below it without holding data itself (the host tier receives
+        DEEP pages first — radix eviction is leaf-first)."""
+        return self.k is None
 
 
 @_race_guard
@@ -85,6 +96,12 @@ class KVPoolStore:
         # server hosts both): evicting a prefix here invalidates its
         # directory keys, so a lookup can never return an evicted prefix.
         self.directory = directory
+        # Backend whose claims this store's evictions invalidate. Empty
+        # = key-wide (the shared cluster pool, sole holder registry);
+        # a per-replica host tier (kvtier.wire_directory) sets its own
+        # address so its byte-budget eviction cannot wipe a sibling
+        # replica's claim for the same content-hashed key.
+        self.owner_backend = ""
         # guarded_by[engine.kvpool]
         self.metrics = {"hits": 0, "misses": 0, "hit_tokens": 0,
                         "put_pages": 0, "evicted_pages": 0, "pages": 0}
@@ -104,9 +121,10 @@ class KVPoolStore:
             now = time.monotonic()
             while i < n:
                 child = node.children.get(tuple(tokens[i:i + ps]))
-                if child is None:
-                    break
+                if child is None or child.placeholder:
+                    break   # payload run ends (missing or path-only node)
                 child.last_used = now
+                child.hits += 1
                 ks.append(child.k)
                 vs.append(child.v)
                 i += ps
@@ -122,24 +140,119 @@ class KVPoolStore:
         # serialize every other replica's match/put behind it.
         return i, np.stack(ks, axis=1), np.stack(vs, axis=1)
 
+    def extend(self, tokens: List[int], start_tokens: int,
+               take: bool = False,
+               max_tokens: Optional[int] = None
+               ) -> Tuple[int, Optional[np.ndarray],
+                          Optional[np.ndarray]]:
+        """Contiguous payload run BELOW ``start_tokens`` — the page-
+        aligned depth a faster tier (the device radix cache) already
+        covers. The walk to ``start_tokens`` may cross placeholder
+        nodes; the returned run is payload pages only. With ``take``
+        the matched pages leave this store (the caller moves them to
+        the faster tier — every cached page lives in exactly one tier),
+        their nodes staying as placeholders so deeper payloads remain
+        reachable. ``max_tokens`` caps the run (a caller that allocated
+        destination room from a peek must not receive more than it can
+        place). Returns ``(extra_tokens, k, v)``."""
+        ps = self.page_size
+        n = (len(tokens) // ps) * ps
+        start_tokens = (start_tokens // ps) * ps
+        if max_tokens is not None:
+            n = min(n, start_tokens + (max_tokens // ps) * ps)
+        with self._lock:
+            node = self.root
+            i = 0
+            now = time.monotonic()
+            while i < start_tokens:
+                child = node.children.get(tuple(tokens[i:i + ps]))
+                if child is None:
+                    self.metrics["misses"] += 1
+                    return 0, None, None
+                node = child
+                i += ps
+            ks, vs, run = [], [], []
+            while i < n:
+                child = node.children.get(tuple(tokens[i:i + ps]))
+                if child is None or child.placeholder:
+                    break
+                child.last_used = now
+                child.hits += 1
+                ks.append(child.k)
+                vs.append(child.v)
+                run.append(child)
+                i += ps
+                node = child
+            if not ks:
+                self.metrics["misses"] += 1
+                return 0, None, None
+            self.metrics["hits"] += 1
+            self.metrics["hit_tokens"] += i - start_tokens
+            if take:
+                for nd in run:
+                    self.bytes -= nd.nbytes
+                    self.metrics["pages"] -= 1
+                    nd.k = nd.v = None
+                    nd.nbytes = 0
+                    nd.dirkey = ""   # caller re-registers as device tier
+        # Stack outside the lock (match() rationale); the local ks/vs
+        # refs keep taken arrays alive past the placeholder conversion.
+        return (i - start_tokens, np.stack(ks, axis=1),
+                np.stack(vs, axis=1))
+
+    def peek(self, tokens: List[int], start_tokens: int = 0) -> int:
+        """Advisory payload-run depth below ``start_tokens`` — no LRU or
+        hotness mutation (the admission TTFT predictor's read)."""
+        ps = self.page_size
+        n = (len(tokens) // ps) * ps
+        start_tokens = (start_tokens // ps) * ps
+        with self._lock:
+            node = self.root
+            i = 0
+            while i < start_tokens:
+                child = node.children.get(tuple(tokens[i:i + ps]))
+                if child is None:
+                    return 0
+                node = child
+                i += ps
+            while i < n:
+                child = node.children.get(tuple(tokens[i:i + ps]))
+                if child is None or child.placeholder:
+                    break
+                i += ps
+                node = child
+        return i - start_tokens
+
     # ---- insert ----
 
-    def put(self, tokens: List[int], k: np.ndarray, v: np.ndarray) -> int:
+    def put(self, tokens: List[int], k: np.ndarray, v: np.ndarray,
+            data_from_page: int = 0) -> int:
         """Store the page-aligned prefix of ``tokens``; ``k``/``v`` are
-        ``[L, n_pages, page, KV, hd]`` covering exactly those pages.
-        Existing pages are refreshed (LRU), not duplicated. Returns pages
-        newly stored."""
+        ``[L, n_pages, page, KV, hd]`` covering the pages from
+        ``data_from_page`` on (pages before it — held by a faster tier —
+        become path-only placeholder nodes so later spills of deeper
+        suffixes stay reachable). Existing pages are refreshed (LRU), not
+        duplicated; a placeholder reached with payload is filled in.
+        Returns pages newly stored."""
         ps = self.page_size
-        n = min((len(tokens) // ps) * ps, k.shape[1] * ps)
+        n = min((len(tokens) // ps) * ps,
+                (data_from_page + k.shape[1]) * ps if k is not None
+                else data_from_page * ps)
         # Copy the page payloads BEFORE taking the lock (see match());
         # directory keys (the cross-process hash chain) likewise.
         from rbg_tpu.kvtransfer.chunks import prefix_keys
         dirkeys = prefix_keys(tokens[:n], ps)
-        staged = [(tuple(tokens[pi * ps:(pi + 1) * ps]),
-                   np.ascontiguousarray(k[:, pi]),
-                   np.ascontiguousarray(v[:, pi]),
-                   dirkeys[pi])
-                  for pi in range(n // ps)]
+        staged = []
+        for pi in range(n // ps):
+            if pi < data_from_page:
+                staged.append((tuple(tokens[pi * ps:(pi + 1) * ps]),
+                               None, None, ""))
+            else:
+                ci = pi - data_from_page
+                staged.append((tuple(tokens[pi * ps:(pi + 1) * ps]),
+                               np.ascontiguousarray(k[:, ci]),
+                               np.ascontiguousarray(v[:, ci]),
+                               dirkeys[pi]))
         new_pages = 0
         with self._lock:
             node = self.root
@@ -148,19 +261,28 @@ class KVPoolStore:
                 child = node.children.get(key)
                 if child is not None:
                     child.last_used = now
+                    if kp is not None and child.placeholder:
+                        # A shallower page arrived after its deeper
+                        # suffix (leaf-first radix eviction) — fill it.
+                        child.k, child.v = kp, vp
+                        child.nbytes = kp.nbytes + vp.nbytes
+                        child.dirkey = dk
+                        self.bytes += child.nbytes
+                        new_pages += 1
                     node = child
                     continue
                 # Children are keyed by the FULL page's tokens: prompts
                 # sharing a first token but diverging inside a page coexist
                 # as siblings instead of clobbering each other.
                 child = _Node(key, node)
-                child.k, child.v = kp, vp
-                child.nbytes = kp.nbytes + vp.nbytes
                 child.last_used = now
-                child.dirkey = dk
+                if kp is not None:
+                    child.k, child.v = kp, vp
+                    child.nbytes = kp.nbytes + vp.nbytes
+                    child.dirkey = dk
+                    self.bytes += child.nbytes
+                    new_pages += 1
                 node.children[key] = child
-                self.bytes += child.nbytes
-                new_pages += 1
                 node = child
             self.metrics["put_pages"] += new_pages
             self.metrics["pages"] += new_pages
@@ -169,7 +291,8 @@ class KVPoolStore:
             # Outside the pool lock: a lookup racing this sees the prefix
             # a moment longer, but never AFTER invalidation completes —
             # the directory_consistent drill checks post-eviction lookups.
-            self.directory.invalidate_keys(evicted_keys, reason="eviction")
+            self.directory.invalidate_keys(evicted_keys, reason="eviction",
+                                           backend=self.owner_backend)
         return new_pages
 
     # ---- eviction ----
@@ -192,14 +315,25 @@ class KVPoolStore:
                 stack.extend(node.children.values())
             if not leaves:
                 return evicted
-            leaves.sort(key=lambda nd: nd.last_used)
+            # LRU-by-hotness WITH aging: coldest (fewest payload
+            # matches) go first, recency breaks ties — and every
+            # eviction pass halves the survivors' heat, so a prefix
+            # that was hot months ago cannot hold the budget against
+            # current traffic forever (hits only ever incremented
+            # would otherwise turn the store into no-aging LFU).
+            # Placeholder leaves (payload taken or never arrived) sort
+            # first and cost nothing to drop. No pressure = no decay.
+            for nd in leaves:
+                nd.hits >>= 1
+            leaves.sort(key=lambda nd: (nd.hits, nd.last_used))
             for leaf in leaves:
                 if self.bytes <= self.max_bytes:
                     return evicted
                 leaf.parent.children.pop(leaf.key, None)
-                self.bytes -= leaf.nbytes
-                self.metrics["evicted_pages"] += 1
-                self.metrics["pages"] -= 1
+                if not leaf.placeholder:
+                    self.bytes -= leaf.nbytes
+                    self.metrics["evicted_pages"] += 1
+                    self.metrics["pages"] -= 1
                 if leaf.dirkey:
                     evicted.append(leaf.dirkey)
         return evicted
@@ -296,7 +430,8 @@ class _Handler(socketserver.BaseRequestHandler):
             if op == "dir_register":
                 n = d.register_keys(list(obj.get("keys") or ()),
                                     obj.get("backend") or "",
-                                    slice_id=obj.get("slice_id") or "")
+                                    slice_id=obj.get("slice_id") or "",
+                                    tier=obj.get("tier") or "device")
                 send_msg(self.request, {"registered": n})
             elif op == "dir_lookup":
                 if "prompt" in obj:
@@ -307,20 +442,30 @@ class _Handler(socketserver.BaseRequestHandler):
                                        store.page_size)
                 else:
                     keys = list(obj.get("keys") or ())
-                matched, holders = d.lookup_keys(keys)
-                send_msg(self.request, {
+                matched, detail = d.lookup_entries(keys)
+                reply = {
                     "matched": matched,
                     "matched_tokens": matched * store.page_size,
-                    "holders": holders})
+                    "holders": [e["backend"] for e in detail]}
+                if obj.get("detail"):
+                    # Tier + hotness per holder — the router's tier-
+                    # fetch-cost scoring input.
+                    reply["detail"] = detail
+                send_msg(self.request, reply)
             elif op == "dir_invalidate":
                 reason = obj.get("reason") or "explicit"
                 n = 0
-                if obj.get("backend"):
+                if obj.get("keys"):
+                    # keys + backend = that replica's claims for those
+                    # keys only (per-replica host-tier eviction must not
+                    # wipe siblings' claims for a shared prefix hash).
+                    n += d.invalidate_keys(list(obj["keys"]), reason,
+                                           backend=obj.get("backend")
+                                           or "")
+                elif obj.get("backend"):
                     n += d.invalidate_backend(obj["backend"], reason)
                 if obj.get("slice_id"):
                     n += d.invalidate_slice(obj["slice_id"], reason)
-                if obj.get("keys"):
-                    n += d.invalidate_keys(list(obj["keys"]), reason)
                 send_msg(self.request, {"invalidated": n})
             else:
                 send_msg(self.request, {"directory": d.stats(),

@@ -30,6 +30,7 @@ class RadixCache:
         self.page_size = page_size
         self.root = _Node((), [], None)
         self._nodes = 0
+        self._cached_pages = 0
 
     # ---- lookup ----
 
@@ -66,6 +67,29 @@ class RadixCache:
             self.allocator.share(pages)  # lock for the caller
         return i, pages
 
+    def peek(self, tokens: List[int]) -> int:
+        """Advisory matched-token depth: no page sharing, no LRU touch.
+        The admission-side TTFT predictor reads this from a submitter
+        thread while the loop thread owns the trie — pure dict reads,
+        tolerant of a stale answer (callers wrap it best-effort)."""
+        ps = self.page_size
+        node = self.root
+        i, n = 0, len(tokens)
+        while i < n:
+            child = node.children.get(tokens[i])
+            if child is None:
+                break
+            kl = len(child.key)
+            limit = min(kl, n - i)
+            common = 0
+            while common < limit and child.key[common] == tokens[i + common]:
+                common += 1
+            i += (common // ps) * ps
+            if common < kl:
+                break
+            node = child
+        return i
+
     # ---- insert ----
 
     def insert(self, tokens: List[int], pages: List[int]) -> None:
@@ -86,6 +110,7 @@ class RadixCache:
                 self.allocator.share(new_pages)
                 node.children[tokens[i]] = _Node(key, list(new_pages), node)
                 self._nodes += 1
+                self._cached_pages += len(new_pages)
                 return
             kl = len(child.key)
             if tuple(tokens[i:i + kl]) == child.key:
@@ -116,15 +141,23 @@ class RadixCache:
 
     # ---- eviction ----
 
-    def evict(self, need_pages: int) -> int:
+    def evict(self, need_pages: int, on_evict=None) -> int:
         """Evict LRU leaves until ``need_pages`` pages were released (or the
         tree is empty). Returns pages released. Pages still referenced by
-        running requests survive via refcounts."""
+        running requests survive via refcounts.
+
+        ``on_evict(prefix_tokens, pages)`` — called per evicted leaf
+        BEFORE its pages are released, with the FULL root→leaf token
+        prefix — is the device→host spill hook: the page contents are
+        still valid on device at that point, so the host tier can copy
+        them out before the allocator may recycle the ids."""
         released = 0
         while released < need_pages:
             leaf = self._lru_leaf()
             if leaf is None:
                 break
+            if on_evict is not None and leaf.pages:
+                on_evict(self._full_prefix(leaf), list(leaf.pages))
             free_before = self.allocator.free_pages
             self.allocator.release(leaf.pages)
             # Only pages whose refcount hit zero actually freed — pages still
@@ -135,7 +168,21 @@ class RadixCache:
                 t: c for t, c in parent.children.items() if c is not leaf
             }
             self._nodes -= 1
+            self._cached_pages -= len(leaf.pages)
         return released
+
+    @staticmethod
+    def _full_prefix(node: "_Node") -> List[int]:
+        """Root→node token prefix (page-aligned by construction — every
+        node's pages cover its whole key)."""
+        parts = []
+        while node is not None and node.key:
+            parts.append(node.key)
+            node = node.parent
+        out: List[int] = []
+        for key in reversed(parts):
+            out.extend(key)
+        return out
 
     def _lru_leaf(self) -> Optional[_Node]:
         best = None
@@ -152,3 +199,9 @@ class RadixCache:
     @property
     def num_nodes(self) -> int:
         return self._nodes
+
+    @property
+    def cached_pages(self) -> int:
+        """Pages this cache currently indexes — the DEVICE tier's
+        population for the rbg_kvcache_tier_pages accounting."""
+        return self._cached_pages

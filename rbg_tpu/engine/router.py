@@ -59,6 +59,18 @@ LEG_TIMEOUT_S = 120.0     # per-attempt blocking-call cap (deadline trims it)
 DEFAULT_TIMEOUT_S = 120.0 # whole-request budget when the client sends none
 AFFINITY_PREFIX = 32      # prompt tokens hashed for cache affinity
 AFFINITY_SLACK = 4        # max extra outstanding before affinity yields
+# Cache-aware scoring (Mooncake): a FULL prefill weighs this many
+# outstanding-request equivalents in the candidate order; a prefix hit
+# scales it down by the hit fraction, and a host-tier hit adds the
+# promote-fetch time over the measured link (KV_COST_WEIGHT currency).
+PREFIX_MISS_WEIGHT = 2.0
+REPLICATE_HOTNESS = 8     # deepest-key lookups before a prefix is "hot"
+REPLICATE_EVERY = 4       # every Nth hot single-holder lookup goes off-holder
+# Off-holder routes attempted per prefix before giving up: a replica
+# with no directory publish path never registers the second copy, and
+# an unbounded tick would tax the hottest traffic with deliberate full
+# prefills forever. A second holder appearing resets the count.
+REPLICATE_MAX_PER_PREFIX = 3
 # Transfer-cost-aware decode selection (NetKV, PAPERS.md): estimated
 # KV-move seconds (bytes / measured link rate) are weighed against queue
 # depth at this exchange rate — 1/WEIGHT seconds of transfer costs as much
@@ -468,6 +480,14 @@ class RouterState:
         # the adaptive agg↔disagg controller (in-flight work on their
         # backends finishes untouched; set membership is GIL-atomic).
         self._inactive_roles: set = set()
+        # Hot-prefix replication cadence (single counter; GIL-atomic
+        # increments — an off-by-one under a race only shifts WHICH
+        # lookup replicates, never whether replication happens) plus a
+        # bounded per-prefix attempt ledger (akey -> off-holder routes)
+        # so a fleet that never registers the second copy stops paying
+        # the deliberate-miss tax after REPLICATE_MAX_PER_PREFIX tries.
+        self._replicate_seq = 0
+        self._replicated: "OrderedDict[int, int]" = OrderedDict()
         self.metrics = {"requests": 0, "pd_requests": 0, "errors": 0,
                         "retries": 0, "failovers": 0, "affinity_hits": 0,
                         "kv_bytes_routed": 0,
@@ -477,6 +497,7 @@ class RouterState:
                         # affinity entries demoted on drain/eviction.
                         "kv_stream_routed": 0, "kv_stream_fallbacks": 0,
                         "directory_hits": 0, "affinity_demotions": 0,
+                        "dir_replications": 0,
                         # Overload / lifecycle robustness counters.
                         "sheds_routed_around": 0, "sheds_returned": 0,
                         "draining_routed_around": 0,
@@ -613,14 +634,66 @@ class RouterState:
                     and self.pool.outstanding(addr)
                     <= self.pool.outstanding(cands[0]) + AFFINITY_SLACK)
 
+    def _prefix_cost_fn(self, prompt, matched_tokens: int,
+                        detail: List[dict], akey=None):
+        """``addr -> outstanding-equivalents`` of serving this prompt's
+        prefill there, from the cluster directory's tier-tagged holder
+        detail: a device-tier holder's hit is ~free (only the unmatched
+        tail costs), a host-tier holder adds the promote fetch (estimated
+        bytes over its measured link rate — the PR-10 KV-move currency),
+        and a non-holder pays the full prefill. Hot single-holder
+        prefixes are deliberately scored as misses every
+        ``REPLICATE_EVERY``-th lookup, so the least-loaded non-holder
+        computes AND registers the prefix — a second replica appears
+        without any explicit copy protocol. Returns ``(cost_fn,
+        replicate_tick, holder_addrs)`` — the caller counts a
+        replication only when the tick actually routed off-holder."""
+        entries = {e["backend"]: e for e in detail if e.get("backend")}
+        replicate = False
+        if len(entries) == 1 and any(
+                e.get("hotness", 0) >= REPLICATE_HOTNESS
+                for e in entries.values()):
+            if (akey is None or self._replicated.get(akey, 0)
+                    < REPLICATE_MAX_PER_PREFIX):
+                self._replicate_seq += 1
+                replicate = self._replicate_seq % REPLICATE_EVERY == 0
+        elif akey is not None and len(entries) > 1:
+            # A second holder appeared: replication CONVERGED for this
+            # prefix — forget the attempt count so a later holder loss
+            # can re-replicate.
+            self._replicated.pop(akey, None)
+        hit_fraction = min(1.0, matched_tokens / max(1, len(prompt)))
+
+        def cost(addr: str) -> float:
+            e = entries.get(addr)
+            if replicate:
+                # Replication tick: the holder scores as a miss and the
+                # non-holders as hits, so the least-loaded NON-holder
+                # wins (unless it is much busier), computes the prefix,
+                # and registers the second copy.
+                return PREFIX_MISS_WEIGHT if e is not None else 0.0
+            if e is None:
+                return PREFIX_MISS_WEIGHT
+            c = PREFIX_MISS_WEIGHT * (1.0 - hit_fraction)
+            if e.get("tier") == "host":
+                bytes_ = self.est_kv_bytes(matched_tokens)
+                rate = self.linkstats.rate(addr) or DEFAULT_KV_LINK_RATE
+                c += (bytes_ / rate) * KV_COST_WEIGHT
+            return c
+        return cost, replicate, frozenset(entries)
+
     def candidates_for(self, role: str, prompt) -> List[str]:
-        """Candidates with CACHE AFFINITY applied: the backend that last
-        served this prompt prefix moves to the front — its radix / shared-
-        pool prefix is warm. When the local LRU has nothing, the CLUSTER
-        prefix directory is consulted: ANY replica that registered this
-        prefix (it published the pages to the shared pool) qualifies, not
-        just the last-serving one. Both are subject to the same balance
-        guard (never evicted/draining, never > AFFINITY_SLACK busier)."""
+        """Candidates ordered CACHE-AWARE. The local last-serving LRU
+        stays the FAST PATH: a viable affinity hit answers with zero I/O
+        — against a wire directory (``DirectoryClient``) the scored path
+        costs a blocking RPC per request, which must only be paid when
+        the LRU has nothing (the pre-hierarchy contract). On an LRU
+        miss, the cluster directory scores every candidate prefix-hit
+        depth × tier-fetch cost AGAINST its queue depth
+        (``_prefix_cost_fn`` — the balance guard is the scoring itself:
+        a deep hit on a swamped replica loses to a shallow miss on an
+        idle one). Without a directory the LRU is all there is, under
+        the legacy AFFINITY_SLACK balance guard."""
         cands = self.candidates(role)
         akey = PrefixAffinity.key(prompt)
         if akey is None or len(cands) < 2:
@@ -634,15 +707,32 @@ class RouterState:
             return cands
         if self.directory is not None and prompt:
             try:
-                _, holders = self.directory.lookup(list(prompt))
+                matched, detail = self.directory.lookup_detail(list(prompt))
             except (OSError, RuntimeError, ValueError):
-                holders = []
-            for h in cands:               # keep least-loaded preference
-                if h in holders and self._affinity_viable(h, cands):
+                matched, detail = 0, []
+            if matched and detail:
+                cost, replicate, holders = self._prefix_cost_fn(
+                    prompt, matched, detail, akey=akey)
+                # Reorder the list already built above — rebuilding via
+                # candidates() would repeat the registry read + pool
+                # retain on a hot path that just paid a directory RPC.
+                scored = self.pool.order(list(cands), cost=cost)
+                if scored and scored[0] in holders:
                     self.metrics["directory_hits"] += 1
-                    return [h] + [a for a in cands if a != h]
-            if holders and cands[0] in holders:
-                self.metrics["directory_hits"] += 1
+                elif scored and replicate:
+                    # Counted only when the inverted scoring ACTUALLY
+                    # routed off-holder (a single-backend role, or a
+                    # much-less-loaded holder, replicates nothing) —
+                    # and the per-prefix ledger bounds the attempts.
+                    self.metrics["dir_replications"] += 1
+                    REGISTRY.inc(obs_names.KVT_DIR_REPLICATIONS_TOTAL)
+                    self._replicated[akey] = \
+                        self._replicated.get(akey, 0) + 1
+                    self._replicated.move_to_end(akey)
+                    while len(self._replicated) > 1024:
+                        self._replicated.popitem(last=False)
+                if scored:
+                    return scored
         return cands
 
     def call(self, role: str, obj: dict, k_bytes=None, v_bytes=None,
@@ -1079,11 +1169,26 @@ class Handler(socketserver.BaseRequestHandler):
             resp["ttft_s"] = round(t_first - t_arrival, 6)
         else:
             t_first = None
-        if "error" not in resp and t_first is not None:
+        if "error" not in resp:
+            # Ingress-vantage token counters — the production
+            # prefill:decode ratio signal the topology policy steers on
+            # (topology.router_ingress_signals_fn). Counted on SUCCESS
+            # only, both kinds symmetrically: shed/failed requests did
+            # no prefill work, and counting them would inflate the
+            # ratio toward prefill-heavy exactly when the fleet is
+            # failing.
+            n_prompt = len(obj.get("prompt") or ())
+            if n_prompt:
+                REGISTRY.inc(obs_names.ROUTER_INGRESS_TOKENS_TOTAL,
+                             float(n_prompt), kind="prefill")
             n = len(resp.get("tokens") or ())
-            tpot = ((t_done - t_first) / (n - 1)) if n > 1 else 0.0
-            state.slo.judge(t_first - t_arrival, tpot,
-                            role=role, backend=addr)
+            if n:
+                REGISTRY.inc(obs_names.ROUTER_INGRESS_TOKENS_TOTAL,
+                             float(n), kind="decode")
+            if t_first is not None:
+                tpot = ((t_done - t_first) / (n - 1)) if n > 1 else 0.0
+                state.slo.judge(t_first - t_arrival, tpot,
+                                role=role, backend=addr)
         return resp
 
     def _generate_stream(self, state: RouterState, obj: dict,
@@ -1162,6 +1267,19 @@ class Handler(socketserver.BaseRequestHandler):
                 if attempt:
                     state.metrics["failovers"] += 1
                 aspan.end(outcome="ok", delivered=delivered)
+                if frame is None:
+                    # Ingress tokens on SUCCESS only, both kinds
+                    # symmetrically (the blocking path's rule): a
+                    # stream that ultimately fails counts NEITHER side,
+                    # so failure storms cannot skew the topology ratio.
+                    # ``delivered`` already nets out failover replays.
+                    n_prompt = len(obj.get("prompt") or ())
+                    if n_prompt:
+                        REGISTRY.inc(obs_names.ROUTER_INGRESS_TOKENS_TOTAL,
+                                     float(n_prompt), kind="prefill")
+                    if delivered:
+                        REGISTRY.inc(obs_names.ROUTER_INGRESS_TOKENS_TOTAL,
+                                     float(delivered), kind="decode")
                 # frame is None on a CLEAN stream completion; an
                 # application-error passthrough carries its frame and is
                 # not a finished request — never judged.

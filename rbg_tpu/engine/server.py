@@ -69,6 +69,9 @@ def build_config(args) -> EngineConfig:
         grammar_state_budget=args.grammar_state_budget,
         slo_ttft_s=args.slo_ttft_s,
         slo_tpot_s=args.slo_tpot_s,
+        host_tier_bytes=args.host_tier_bytes,
+        early_reject=args.early_reject,
+        early_reject_factor=args.early_reject_factor,
     )
 
 
@@ -268,10 +271,13 @@ class Handler(socketserver.BaseRequestHandler):
             return
         if op == "metrics":
             stats = {}
+            eng = None
             if srv.service is not None:
                 stats = srv.service.stats()
+                eng = srv.service.engine
             elif srv.prefill is not None:
                 stats = {**srv.prefill.engine.metrics, **srv.prefill.metrics}
+                eng = srv.prefill.engine
             elif srv.decode is not None:
                 eng = srv.decode.engine
                 stats = {**eng.metrics, **srv.decode.worker.metrics,
@@ -279,6 +285,10 @@ class Handler(socketserver.BaseRequestHandler):
                          "running": len(eng.running),
                          "waiting": len(eng.waiting),
                          "free_pages": eng.allocator.free_pages}
+            if eng is not None and getattr(eng, "host_tier", None) is not None:
+                stats["host_tier"] = eng.host_tier.stats()
+                stats["device_tier_pages"] = (
+                    eng.radix.cached_pages if eng.radix is not None else 0)
             stats["draining"] = srv.draining
             send_msg(self.request, {"metrics": stats, "mode": srv.mode})
             return
@@ -775,6 +785,12 @@ def serve(args) -> None:
                 prefill = PrefillWorker(cfg, pool=pool,
                                         directory=directory,
                                         advertise_addr=advertise)
+                if prefill.engine.host_tier is not None and directory:
+                    # Host-tier spills register in the cluster directory
+                    # under this replica's serving address (tier="host"),
+                    # so the router's tier-fetch-cost scoring sees them.
+                    prefill.engine.host_tier.wire_directory(
+                        directory, advertise)
                 prefill.engine.enable_json_grammar(server.tokenizer)
                 load_adapters(prefill.engine)
                 if args.kv_stream != "off":
@@ -893,6 +909,22 @@ def main(argv=None) -> int:
     ap.add_argument("--slo-tpot-s", type=float, default=0.5,
                     help="per-output-token latency target (time per token "
                          "after the first; 0 disables the dimension)")
+    ap.add_argument("--host-tier-bytes", type=int, default=0,
+                    help="host-DRAM KV spill tier budget in bytes: device "
+                         "page-pool evictions spill prefix pages here and "
+                         "admission promotes them back on a hit (0 = off; "
+                         "needs the radix cache; Mooncake's 'more storage "
+                         "for less computation' level)")
+    ap.add_argument("--early-reject", choices=("off", "auto"),
+                    default="off",
+                    help="predictive early rejection: admission predicts "
+                         "TTFT (measured queue wait + prefill net of the "
+                         "prefix hit this request would get) and sheds at "
+                         "ingress with retry_after_s when it exceeds "
+                         "--early-reject-factor x --slo-ttft-s")
+    ap.add_argument("--early-reject-factor", type=float, default=1.5,
+                    help="early-rejection gate as a multiple of the TTFT "
+                         "SLO target")
     ap.add_argument("--max-queue", type=int, default=256,
                     help="admission-control bound on the service queue: "
                          "submissions past it are shed with a structured "

@@ -58,6 +58,10 @@ class _Pending:
 DEFAULT_TIMEOUT_S = 600.0
 # Completion timestamps kept for the estimated-wait admission gate.
 _RATE_WINDOW = 64
+# Prefill-throughput EMA expiry for the early-reject predictor: past
+# this, the rate is absence-of-signal, not a measurement (a shed does
+# no prefill, so a stale-slow rate could otherwise never re-learn).
+_PF_RATE_TTL_S = 30.0
 # Fallback backpressure hint when no throughput estimate exists yet.
 _RETRY_AFTER_FLOOR_S = 0.5
 
@@ -159,7 +163,26 @@ class _BatchService:
             component=type(self).__name__.lower())
         # guarded_by[engine.service_queue]
         self.counters = {"shed_total": 0, "deadline_queue_drops": 0,
-                         "deadline_running_aborts": 0}
+                         "deadline_running_aborts": 0, "early_rejects": 0}
+        # Predictive early rejection (Mooncake overload story): armed by
+        # cfg.early_reject="auto" with a TTFT SLO target — admission
+        # predicts TTFT (queue wait + prefill net of the prefix hit this
+        # request would get) and sheds at INGRESS, before any prefill
+        # compute is spent.
+        self._early_reject = (cfg.early_reject == "auto"
+                              and cfg.slo_ttft_s > 0)
+        self._er_gate_s = cfg.slo_ttft_s * cfg.early_reject_factor
+        # Measured prefill throughput (tokens/s EMA) — written by the
+        # loop thread between steps, read racily by submitter threads
+        # (a float read; staleness only skews one prediction). The
+        # rate expires after _PF_RATE_TTL_S without a prefill window:
+        # rejected requests do no prefill, so a stale-slow rate (e.g.
+        # compile stalls on an unwarmed service) would otherwise shed
+        # everything FOREVER — the rate could never re-learn.
+        self._prefill_rate: Optional[float] = None
+        self._pf_rate_t = 0.0
+        self._pf_tokens = self.engine.metrics.get("prefill_tokens", 0)
+        self._pf_t = time.monotonic()
         # Loop-thread-confined (admitted rows); deliberately NOT guarded.
         self._pending: Dict[int, _Pending] = {}
         self._lock = named_lock("engine.service_queue")
@@ -183,6 +206,12 @@ class _BatchService:
     def _pump(self) -> None:
         """Loop-thread hook before each iteration's engine work —
         DecodeService commits inbound KV stream chunks here."""
+
+    def _ingress_prompt(self, item) -> Optional[List[int]]:
+        """Prompt tokens of a submission, for the TTFT predictor — None
+        when the item carries no prefill work this engine would run
+        (e.g. a decode leg, whose prefill was already paid upstream)."""
+        return None
 
     # -- admission control --
 
@@ -215,6 +244,44 @@ class _BatchService:
     def _retry_after_hint(self, depth: int) -> float:
         est = self.estimated_wait_s(depth)
         return max(_RETRY_AFTER_FLOOR_S, est if est is not None else 1.0)
+
+    def _note_prefill_progress(self) -> None:
+        """Loop-thread sampling of prefill throughput between steps —
+        only windows that actually prefilled update the EMA (idle
+        windows must not decay the estimate toward zero and blind the
+        predictor after a lull, the _completion_rate lesson)."""
+        now = time.monotonic()
+        dt = now - self._pf_t
+        if dt < 0.2:
+            return
+        tp = self.engine.metrics.get("prefill_tokens", 0)
+        if tp > self._pf_tokens:
+            rate = (tp - self._pf_tokens) / dt
+            stale = now - self._pf_rate_t > _PF_RATE_TTL_S
+            self._prefill_rate = (
+                rate if self._prefill_rate is None or stale
+                else 0.7 * self._prefill_rate + 0.3 * rate)
+            self._pf_rate_t = now
+        self._pf_tokens, self._pf_t = tp, now
+
+    def predicted_ttft_s(self, item,
+                         depth: Optional[int] = None) -> Optional[float]:
+        """Predicted TTFT for a NEW submission: measured queue wait plus
+        this request's prefill time NET of the prefix-cache hit (device
+        radix + host tier) it would get. None while either rate lacks
+        history — the predictor never sheds on a guess."""
+        est = self.estimated_wait_s(depth)
+        prompt = self._ingress_prompt(item)
+        rate = self._prefill_rate
+        if (prompt is None or rate is None or rate <= 0
+                or time.monotonic() - self._pf_rate_t > _PF_RATE_TTL_S):
+            # No (or expired) throughput history: predict queue wait
+            # only — the gate must never shed on a rate it cannot
+            # re-measure.
+            return est
+        hit = self.engine.prefix_peek(list(prompt))
+        prefill_s = max(0, len(prompt) - hit) / rate
+        return prefill_s if est is None else est + prefill_s
 
     def _shed(self, msg: str, depth: int) -> None:
         self.counters["shed_total"] += 1
@@ -258,6 +325,21 @@ class _BatchService:
                         self._shed(
                             f"estimated wait {est:.2f}s exceeds remaining "
                             f"deadline budget {deadline - now:.2f}s", depth)
+                if self._early_reject:
+                    pred = self.predicted_ttft_s(item, depth)
+                    if pred is not None:
+                        svc = type(self).__name__.lower()
+                        REGISTRY.observe(
+                            names.SERVING_PREDICTED_TTFT_SECONDS, pred,
+                            service=svc)
+                        if pred > self._er_gate_s:
+                            self.counters["early_rejects"] += 1
+                            REGISTRY.inc(names.SERVING_EARLY_REJECTS_TOTAL,
+                                         service=svc)
+                            self._shed(
+                                f"predicted TTFT {pred:.2f}s exceeds the "
+                                f"early-reject gate {self._er_gate_s:.2f}s",
+                                depth)
                 self._queue.append((item, sampling, p))
                 REGISTRY.observe(names.SERVING_QUEUE_DEPTH, depth + 1)
         except Rejected as e:
@@ -312,6 +394,13 @@ class _BatchService:
         # early-exit twins (_decode_window's join shortening) would
         # otherwise first compile MID-SERVING, on the join-latency path.
         self.engine.warm_join_windows()
+        # The warm waves were compile-laden: their token throughput is
+        # not serving throughput, and an early-reject predictor trained
+        # on it would shed the first real traffic. Reset so the EMA
+        # learns from warm steps only.
+        self._prefill_rate = None
+        self._pf_tokens = self.engine.metrics.get("prefill_tokens", 0)
+        self._pf_t = time.monotonic()
         return time.monotonic() - t0
 
     def _warm_item(self, input_len: int, wave: int, row: int):
@@ -357,6 +446,9 @@ class _BatchService:
         out["max_queue"] = self.max_queue
         out["estimated_wait_s"] = round(est, 4) if est is not None else None
         out["slo_judged_total"] = self.slo.judged_total()
+        pf = self._prefill_rate
+        out["prefill_tokens_per_s"] = round(pf, 2) if pf is not None else None
+        out["early_reject_armed"] = self._early_reject
         return out
 
     def cancel(self, pending: _Pending) -> None:
@@ -511,10 +603,18 @@ class _BatchService:
                 with self._lock:
                     empty = not self._queue and not self._cancels
                 if empty:
+                    # Idle time must not enter the prefill-rate window:
+                    # the first active window after a lull would
+                    # otherwise measure chunk_tokens / lull_length, and
+                    # (past the TTL) REPLACE the EMA with that near-zero
+                    # rate — shedding the whole next burst.
+                    self._pf_t = time.monotonic()
+                    self._pf_tokens = eng.metrics.get("prefill_tokens", 0)
                     self._wake.wait(0.01)
                     self._wake.clear()
                 continue
             events = eng.step()
+            self._note_prefill_progress()
             # Batch-occupancy / join-latency observability (one occupancy
             # sample per step; join waits are recorded by the engine at
             # admission and drained here — both loop-thread-confined).
@@ -565,6 +665,9 @@ class EngineService(_BatchService):
 
     def _admit(self, prompt, sampling: SamplingParams) -> Optional[int]:
         return self.engine.add_request(prompt, sampling)
+
+    def _ingress_prompt(self, item) -> Optional[List[int]]:
+        return item if isinstance(item, (list, tuple)) else None
 
     def _warm_item(self, input_len: int, wave: int, row: int):
         from rbg_tpu.engine.config import warm_prompt

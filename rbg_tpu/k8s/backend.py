@@ -378,26 +378,14 @@ class K8sPodBackend:
 
     # ---- node inventory ----
 
-    # Legacy polling cadence (the resync-carried plane, preserved under
-    # the ``legacy_resync`` A/B toggle). Event-carried mode rides the
-    # node WATCH stream instead and keeps only a long drift backstop:
-    # node disruption state arrives when it changes, and the full re-list
-    # exists to self-heal a silently wedged stream, not to carry data.
-    NODE_RESYNC_S = 2.0
+    # Node inventory rides the node WATCH stream with a long drift
+    # backstop: node disruption state arrives when it changes, and the
+    # full re-list exists to self-heal a silently wedged stream, not to
+    # carry data. (The pre-PR-12 2 s polling plane is gone with the
+    # ``legacy_resync`` A/B toggle.)
     NODE_BACKSTOP_S = 60.0
-    legacy_resync = False
 
     def _node_loop(self):
-        if self.legacy_resync:
-            while not self._stop.is_set():
-                self._stop.wait(self.NODE_RESYNC_S)
-                if self._stop.is_set():
-                    return
-                try:
-                    self._sync_nodes()
-                except Exception:
-                    log.warning("k8s node resync failed", exc_info=True)
-            return
         # Resume the watch from the rv the initial LIST covered — a
         # rv="0" watch against a REAL apiserver starts at a server-chosen
         # point with no snapshot, silently dropping anything that landed

@@ -38,12 +38,20 @@ from rbg_tpu.utils.racetrace import guard as _race_guard
 
 
 class _Entry:
-    __slots__ = ("backend", "slice_id", "t_registered")
+    __slots__ = ("backend", "slice_id", "t_registered", "tier", "hits")
 
-    def __init__(self, backend: str, slice_id: str):
+    def __init__(self, backend: str, slice_id: str, tier: str = "device"):
         self.backend = backend
         self.slice_id = slice_id
         self.t_registered = time.monotonic()
+        # Cache tier the holder keeps this prefix in: "device" (radix /
+        # HBM pool — a hit is ~free) or "host" (spill tier — a hit costs
+        # the promote fetch). The router's tier-fetch-cost scoring reads
+        # it; re-registration refreshes it (promotion flips host→device).
+        self.tier = tier
+        # Lookup hotness: times this entry fronted a deepest-key lookup.
+        # The router replicates hot single-holder prefixes off it.
+        self.hits = 0
 
 
 @_race_guard
@@ -63,9 +71,11 @@ class PrefixDirectory:
     # -- write paths --
 
     def register_keys(self, keys: List[str], backend: str,
-                      slice_id: str = "") -> int:
+                      slice_id: str = "", tier: str = "device") -> int:
         """Register a hash-chain of page keys for ``backend``. Returns the
-        number of keys registered. Keys are refreshed, not duplicated."""
+        number of keys registered. Keys are refreshed, not duplicated;
+        re-registration updates the tier tag (spill demotes to "host",
+        promotion restores "device")."""
         if not keys or not backend:
             return 0
         now = time.monotonic()
@@ -76,10 +86,11 @@ class PrefixDirectory:
                     holders = self._m[key] = {}
                 e = holders.get(backend)
                 if e is None:
-                    holders[backend] = _Entry(backend, slice_id)
+                    holders[backend] = _Entry(backend, slice_id, tier=tier)
                 else:
                     e.t_registered = now
                     e.slice_id = slice_id or e.slice_id
+                    e.tier = tier
             self.metrics["registers"] += 1
             self._cap_locked()
             n = len(self._m)
@@ -87,11 +98,11 @@ class PrefixDirectory:
         return len(keys)
 
     def register(self, tokens: List[int], backend: str,
-                 slice_id: str = "") -> int:
+                 slice_id: str = "", tier: str = "device") -> int:
         if self.page_size is None:
             raise ValueError("directory has no page_size; use register_keys")
         return self.register_keys(prefix_keys(tokens, self.page_size),
-                                  backend, slice_id)
+                                  backend, slice_id, tier=tier)
 
     def _invalidate_where(self, pred, reason: str) -> int:
         """Drop entries matching ``pred(key, entry)``; empty keys die."""
@@ -124,8 +135,18 @@ class PrefixDirectory:
             lambda _k, e: e.slice_id == slice_id, reason)
 
     def invalidate_keys(self, keys: List[str],
-                        reason: str = "eviction") -> int:
+                        reason: str = "eviction",
+                        backend: str = "") -> int:
+        """Drop entries for these keys — scoped to ``backend`` when
+        given. Scoping matters once host tiers are per-replica: replica
+        A's byte-budget eviction of a shared (content-hashed) prefix
+        key must not wipe replica B's still-valid claim for the same
+        key. Empty backend keeps the key-wide semantics the single
+        shared cluster pool relies on (the pool IS its only holder)."""
         ks = set(keys)
+        if backend:
+            return self._invalidate_where(
+                lambda k, e: k in ks and e.backend == backend, reason)
         return self._invalidate_where(lambda k, _e: k in ks, reason)
 
     def _cap_locked(self) -> None:
@@ -144,14 +165,16 @@ class PrefixDirectory:
 
     # -- read path --
 
-    def lookup_keys(self, keys: List[str]) -> Tuple[int, List[str]]:
-        """Longest registered prefix of the key chain. Returns
-        (matched_pages, holders-of-the-deepest-matched-key). TTL-expired
-        entries are dropped on the way."""
+    def lookup_entries(self, keys: List[str]) -> Tuple[int, List[dict]]:
+        """Longest registered prefix of the key chain, with per-holder
+        detail. Returns (matched_pages, [{backend, tier, hotness}] of the
+        deepest matched key). TTL-expired entries are dropped on the way;
+        each hit bumps the deepest entries' hotness (the replication
+        signal)."""
         cutoff = time.monotonic() - self.ttl_s
         with self._lock:
             self.metrics["lookups"] += 1
-            matched, holders = 0, []
+            matched, deepest = 0, None
             for key in keys:
                 hs = self._m.get(key)
                 if hs:
@@ -164,12 +187,24 @@ class PrefixDirectory:
                 if not hs:
                     break
                 matched += 1
-                holders = list(hs)
+                deepest = hs
+            detail = []
+            if deepest is not None:
+                for e in deepest.values():
+                    e.hits += 1
+                    detail.append({"backend": e.backend, "tier": e.tier,
+                                   "hotness": e.hits})
             if matched:
                 self.metrics["hits"] += 1
         REGISTRY.inc(obs_names.KVT_DIR_LOOKUPS_TOTAL,
                      result="hit" if matched else "miss")
-        return matched, holders
+        return matched, detail
+
+    def lookup_keys(self, keys: List[str]) -> Tuple[int, List[str]]:
+        """Longest registered prefix of the key chain. Returns
+        (matched_pages, holders-of-the-deepest-matched-key)."""
+        matched, detail = self.lookup_entries(keys)
+        return matched, [d["backend"] for d in detail]
 
     def lookup(self, tokens: List[int]) -> Tuple[int, List[str]]:
         """Longest registered page-aligned prefix of ``tokens`` →
@@ -179,6 +214,16 @@ class PrefixDirectory:
         pages, holders = self.lookup_keys(
             prefix_keys(tokens, self.page_size))
         return pages * self.page_size, holders
+
+    def lookup_detail(self, tokens: List[int]) -> Tuple[int, List[dict]]:
+        """Longest registered page-aligned prefix of ``tokens`` →
+        (matched_tokens, [{backend, tier, hotness}]) — the router's
+        tier-fetch-cost scoring input."""
+        if self.page_size is None:
+            raise ValueError("directory has no page_size; use lookup_entries")
+        pages, detail = self.lookup_entries(
+            prefix_keys(tokens, self.page_size))
+        return pages * self.page_size, detail
 
     def stats(self) -> dict:
         with self._lock:
@@ -224,23 +269,44 @@ class DirectoryClient:
         return resp
 
     def register_keys(self, keys: List[str], backend: str,
-                      slice_id: str = "") -> int:
+                      slice_id: str = "", tier: str = "device") -> int:
         resp = self._call({"op": "dir_register", "keys": list(keys),
-                           "backend": backend, "slice_id": slice_id})
+                           "backend": backend, "slice_id": slice_id,
+                           "tier": tier})
         return int(resp.get("registered", 0)) if resp else 0
 
     def register(self, tokens: List[int], backend: str,
-                 slice_id: str = "") -> int:
+                 slice_id: str = "", tier: str = "device") -> int:
         if self.page_size is None:
             return 0
         return self.register_keys(prefix_keys(tokens, self.page_size),
-                                  backend, slice_id)
+                                  backend, slice_id, tier=tier)
 
     def lookup_keys(self, keys: List[str]) -> Tuple[int, List[str]]:
         resp = self._call({"op": "dir_lookup", "keys": list(keys)})
         if not resp:
             return 0, []
         return int(resp.get("matched", 0)), list(resp.get("holders") or ())
+
+    def lookup_detail(self, tokens: List[int]) -> Tuple[int, List[dict]]:
+        """(matched_tokens, [{backend, tier, hotness}]) — like
+        ``PrefixDirectory.lookup_detail`` but over the wire; the server
+        computes the key chain with ITS page size when this client holds
+        none. Degrades to (0, []) like every directory op."""
+        if self.page_size is not None:
+            obj = {"op": "dir_lookup", "detail": True,
+                   "keys": prefix_keys(tokens, self.page_size)}
+            resp = self._call(obj)
+            if not resp:
+                return 0, []
+            return (int(resp.get("matched", 0)) * self.page_size,
+                    list(resp.get("detail") or ()))
+        resp = self._call({"op": "dir_lookup", "detail": True,
+                           "prompt": list(tokens)})
+        if not resp:
+            return 0, []
+        return (int(resp.get("matched_tokens", 0)),
+                list(resp.get("detail") or ()))
 
     def lookup(self, tokens: List[int]) -> Tuple[int, List[str]]:
         """Longest registered prefix of ``tokens``. Without a local
@@ -265,6 +331,20 @@ class DirectoryClient:
                          reason: str = "preemption") -> int:
         resp = self._call({"op": "dir_invalidate", "slice_id": slice_id,
                            "reason": reason})
+        return int(resp.get("invalidated", 0)) if resp else 0
+
+    def invalidate_keys(self, keys: List[str],
+                        reason: str = "eviction",
+                        backend: str = "") -> int:
+        """Key-level invalidation (the KVPoolStore eviction path calls
+        this on whatever directory handle it was built with — the wire
+        client must honor the same contract as the in-proc directory).
+        ``backend`` scopes the drop to one replica's claims."""
+        obj = {"op": "dir_invalidate", "keys": list(keys),
+               "reason": reason}
+        if backend:
+            obj["backend"] = backend
+        resp = self._call(obj)
         return int(resp.get("invalidated", 0)) if resp else 0
 
     def stats(self) -> dict:

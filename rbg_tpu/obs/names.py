@@ -77,6 +77,14 @@ TOPOLOGY_FLIPS_TOTAL = "rbg_topology_flips_total"
 TOPOLOGY_HOLDS_TOTAL = "rbg_topology_holds_total"
 TOPOLOGY_COST_GATED_TOTAL = "rbg_topology_cost_gated_total"
 TOPOLOGY_CONFLICTS_TOTAL = "rbg_topology_conflicts_total"
+KVC_TIER_HITS_TOTAL = "rbg_kvcache_tier_hits_total"
+KVC_TIER_MISSES_TOTAL = "rbg_kvcache_tier_misses_total"
+KVC_TIER_SPILLED_PAGES_TOTAL = "rbg_kvcache_tier_spilled_pages_total"
+KVC_TIER_PROMOTED_PAGES_TOTAL = "rbg_kvcache_tier_promoted_pages_total"
+KVC_TIER_EVICTED_PAGES_TOTAL = "rbg_kvcache_tier_evicted_pages_total"
+KVT_DIR_REPLICATIONS_TOTAL = "rbg_kvtransfer_dir_replications_total"
+ROUTER_INGRESS_TOKENS_TOTAL = "rbg_router_ingress_tokens_total"
+SERVING_EARLY_REJECTS_TOTAL = "rbg_serving_early_rejects_total"
 
 # ---- gauges (last-write-wins) ----
 
@@ -96,6 +104,8 @@ WORKQUEUE_DEPTH = "rbg_workqueue_depth"
 WORKQUEUE_RETRIES_PENDING = "rbg_workqueue_retries_pending"
 EVENTS_OBJECTS = "rbg_events_objects"
 TOPOLOGY_POSTURE = "rbg_topology_posture"
+KVC_TIER_PAGES = "rbg_kvcache_tier_pages"
+KVC_TIER_BYTES = "rbg_kvcache_tier_bytes"
 
 # ---- histograms ----
 
@@ -112,6 +122,9 @@ WORKQUEUE_QUEUE_AGE_SECONDS = "rbg_workqueue_queue_age_seconds"
 WATCH_DISPATCH_SECONDS = "rbg_watch_dispatch_seconds"
 SCHED_FEASIBILITY_SCAN_SECONDS = "rbg_sched_feasibility_scan_seconds"
 TOPOLOGY_SWITCH_DURATION_SECONDS = "rbg_topology_switch_duration_seconds"
+KVC_TIER_SPILL_SECONDS = "rbg_kvcache_tier_spill_seconds"
+KVC_TIER_PROMOTE_SECONDS = "rbg_kvcache_tier_promote_seconds"
+SERVING_PREDICTED_TTFT_SECONDS = "rbg_serving_predicted_ttft_seconds"
 
 # ---- catalog sets (consumed by the lint rule and strict-mode registry) ----
 
@@ -168,6 +181,14 @@ COUNTERS = frozenset({
     TOPOLOGY_HOLDS_TOTAL,
     TOPOLOGY_COST_GATED_TOTAL,
     TOPOLOGY_CONFLICTS_TOTAL,
+    KVC_TIER_HITS_TOTAL,
+    KVC_TIER_MISSES_TOTAL,
+    KVC_TIER_SPILLED_PAGES_TOTAL,
+    KVC_TIER_PROMOTED_PAGES_TOTAL,
+    KVC_TIER_EVICTED_PAGES_TOTAL,
+    KVT_DIR_REPLICATIONS_TOTAL,
+    ROUTER_INGRESS_TOKENS_TOTAL,
+    SERVING_EARLY_REJECTS_TOTAL,
 })
 
 GAUGES = frozenset({
@@ -187,6 +208,8 @@ GAUGES = frozenset({
     WORKQUEUE_RETRIES_PENDING,
     EVENTS_OBJECTS,
     TOPOLOGY_POSTURE,
+    KVC_TIER_PAGES,
+    KVC_TIER_BYTES,
 })
 
 HISTOGRAMS = frozenset({
@@ -203,6 +226,9 @@ HISTOGRAMS = frozenset({
     WATCH_DISPATCH_SECONDS,
     SCHED_FEASIBILITY_SCAN_SECONDS,
     TOPOLOGY_SWITCH_DURATION_SECONDS,
+    KVC_TIER_SPILL_SECONDS,
+    KVC_TIER_PROMOTE_SECONDS,
+    SERVING_PREDICTED_TTFT_SECONDS,
 })
 
 ALL_NAMES = COUNTERS | GAUGES | HISTOGRAMS
@@ -365,6 +391,37 @@ HELP = {
     TOPOLOGY_SWITCH_DURATION_SECONDS:
         "Wall time of a completed topology flip (warm start to old-shape "
         "drained), per target shape",
+    KVC_TIER_HITS_TOTAL:
+        "Prefix-cache hits per tier (device = radix, host = spill tier)",
+    KVC_TIER_MISSES_TOTAL:
+        "Prefix lookups that missed every cache tier",
+    KVC_TIER_SPILLED_PAGES_TOTAL:
+        "KV pages spilled device-tier → host-tier on device eviction",
+    KVC_TIER_PROMOTED_PAGES_TOTAL:
+        "KV pages promoted host-tier → device-tier on a host hit",
+    KVC_TIER_EVICTED_PAGES_TOTAL:
+        "Cached KV pages evicted from a tier's bounded store, per tier "
+        "(host = byte-budget LRU-by-hotness eviction)",
+    KVC_TIER_PAGES: "Cached KV pages resident, per tier",
+    KVC_TIER_BYTES: "Cached KV bytes resident, per tier",
+    KVC_TIER_SPILL_SECONDS:
+        "Device→host page spill latency (device readback + trie insert)",
+    KVC_TIER_PROMOTE_SECONDS:
+        "Host→device page promotion latency (trie take + device scatter)",
+    KVT_DIR_REPLICATIONS_TOTAL:
+        "Hot single-holder prefixes the router deliberately routed to a "
+        "non-holder so a second replica computes and registers them",
+    ROUTER_INGRESS_TOKENS_TOTAL:
+        "Tokens observed at router ingress, per kind (prefill = prompt "
+        "tokens dispatched, decode = output tokens delivered) — the "
+        "production prefill:decode ratio signal for the topology policy",
+    SERVING_EARLY_REJECTS_TOTAL:
+        "Requests shed at ingress because predicted TTFT (queue wait + "
+        "prefill net of the prefix hit this request would get) exceeded "
+        "the SLO gate — before any prefill compute was spent",
+    SERVING_PREDICTED_TTFT_SECONDS:
+        "Predicted TTFT computed by the admission gate for each "
+        "submission it evaluated",
 }
 
 # ---- span names (obs/trace.py) ----

@@ -233,18 +233,15 @@ class Controller:
     # knee: p50 44 s). controller-runtime's SyncPeriod default is 10 HOURS;
     # watches, not resyncs, carry the control plane.
     #
-    # ``resync_period`` is the LEGACY cadence (preserved under the
-    # ``legacy_resync`` A/B toggle); event-carried mode runs the sweep at
-    # ``backstop_period`` instead (None = same as resync_period), with
-    # versioned enqueues so an unchanged key dedups at dequeue and with
-    # keys the event path already reconciled since the last tick skipped
-    # outright (rbg_resync_backstop_* accounting).
+    # The sweep runs at ``backstop_period`` (None = fall back to
+    # ``resync_period``), with versioned enqueues so an unchanged key
+    # dedups at dequeue and with keys the event path already reconciled
+    # since the last tick skipped outright (rbg_resync_backstop_*
+    # accounting). The PR-12 ``legacy_resync`` A/B toggle is gone — the
+    # fleet drill's event-mode gates (dedup engaged, binds/s floor) keep
+    # the refactor honest without carrying the dead resync plane.
     resync_period: float = 300.0
     backstop_period: Optional[float] = 600.0
-    # A/B toggle (ControlPlane(legacy_resync=True) / RBG_LEGACY_RESYNC=1):
-    # True restores the resync-carried plane — short sweep periods, no
-    # dequeue dedup — so the fleet drill can measure the refactor.
-    legacy_resync: bool = False
     # Drill hook: fn(controller_name, duration_s) called per reconcile.
     # The fleet A/B sets it to collect EXACT durations — the registry
     # histogram's bucket-quantized quantiles (both variants landing in
@@ -375,7 +372,7 @@ class Controller:
             self._threads.append(t)
 
     def _effective_resync_period(self) -> float:
-        if self.legacy_resync or self.backstop_period is None:
+        if self.backstop_period is None:
             return self.resync_period
         return self.backstop_period
 
@@ -423,7 +420,7 @@ class Controller:
         # per test plane, before the fix).
         while not self._stop_event.wait(self._effective_resync_period()):
             try:
-                self._enqueue_all(backstop=not self.legacy_resync)
+                self._enqueue_all(backstop=True)
             except Exception:
                 pass
 
@@ -449,8 +446,7 @@ class Controller:
             # in reconcile (a self-write's retrigger runs ONCE — see
             # _on_event — then its duplicates dedup here).
             version, forced = self.queue.claim(key)
-            if (not forced and not self.legacy_resync
-                    and version is not None
+            if (not forced and version is not None
                     and (wm := self.queue.watermark(key)) is not None
                     and version <= wm):
                 REGISTRY.inc(names.RECONCILE_DEDUPED_TOTAL,

@@ -26,16 +26,7 @@ class ControlPlane:
     def __init__(self, store: Optional[Store] = None, backend: str = "fake",
                  ready_delay: float = 0.0, executor_env: Optional[dict] = None,
                  k8s_client=None, warm_spares: int = 0, autoscale=None,
-                 kv_directory=None, legacy_resync: Optional[bool] = None,
-                 topology=None):
-        import os
-        if legacy_resync is None:
-            legacy_resync = os.environ.get("RBG_LEGACY_RESYNC", "") == "1"
-        # A/B toggle: True restores the resync-carried plane (short sweep
-        # periods, no dequeue dedup, unsharded feasibility scan) so the
-        # fleet drill can measure the event-carried refactor against its
-        # baseline. Default False = event-carried.
-        self.legacy_resync = bool(legacy_resync)
+                 kv_directory=None, topology=None):
         self.store = store or Store()
         self.manager = Manager(self.store)
         self.node_binding = NodeBindingStore(self.store)
@@ -87,10 +78,6 @@ class ControlPlane:
                 TopologyController(self.store, topology,
                                    spares=self.spares))
         self._register_optional()
-        if self.legacy_resync:
-            for c in self.manager.controllers:
-                c.legacy_resync = True
-            self.scheduler.use_sharded = False
 
         self.kubelet = None
         if backend == "fake":
@@ -103,8 +90,6 @@ class ControlPlane:
                 raise ValueError("backend='k8s' requires k8s_client")
             from rbg_tpu.k8s.backend import K8sPodBackend
             self.kubelet = K8sPodBackend(self.store, k8s_client)
-            if self.legacy_resync:
-                self.kubelet.legacy_resync = True
 
     def _register_optional(self):
         """Controllers gated on availability (reference: CheckCrdExists gating,

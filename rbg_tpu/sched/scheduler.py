@@ -160,7 +160,7 @@ class SchedulerController(Controller):
             # Outside the try: the periodic re-enqueue must still happen
             # when the rebuild fails.
             try:
-                self._enqueue_all(backstop=not self.legacy_resync)
+                self._enqueue_all(backstop=True)
             except Exception:
                 import logging
                 logging.getLogger("rbg_tpu.sched").warning(

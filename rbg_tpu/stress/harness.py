@@ -253,13 +253,14 @@ class FleetConfig:
     # (the drill arms tracing itself; 1.0 would trace every reconcile of
     # a 10k-pod run — the sink only keeps the slowest anyway).
     trace_sample: float = 0.05
-    # Interleaved legacy-vs-event A/B: after the main (event-carried)
-    # drill, run ``ab_reps`` back-to-back pairs — legacy plane (short
-    # resyncs, no dedup, unsharded scan) then event plane — on the same
-    # fleet size with a lighter churn wave, and gate on median reconcile
-    # p99 AND scheduler binds/s both improving. Interleaving is
-    # mandatory on this box: throughput is bimodal at multi-second
-    # granularity, so sequential blocks fake ratios. 0 = skip.
+    # Event-plane throughput reps: after the main drill, run ``ab_reps``
+    # fresh-plane repetitions of a lighter churn wave and gate on the
+    # event-mode invariants — every rep completes, dedup is ENGAGED
+    # (deduped > 0: the watch-carried plane is actually doing the
+    # dedup work), and the rep-to-rep binds/s spread stays inside the
+    # trimmed gate. (The PR-12 legacy arm is deleted — these gates are
+    # what remains of the A/B now that the baseline has served its
+    # purpose.) 0 = skip.
     ab_reps: int = 0
     ab_groups: int = 40
     ab_spread_max: float = 0.45
@@ -325,15 +326,15 @@ def _median(vals: List[float]) -> float:
     return s[len(s) // 2] if s else 0.0
 
 
-def _run_fleet_rep(cfg: FleetConfig, legacy: bool) -> dict:
-    """One A/B repetition: fresh plane over a fresh fleet, a create →
-    image-update → delete churn wave, measured as (worst-controller
+def _run_fleet_rep(cfg: FleetConfig) -> dict:
+    """One throughput repetition: fresh plane over a fresh fleet, a
+    create → image-update → delete churn wave, measured as (pooled
     reconcile p99, scheduler binds/s over the bind window) plus the
     event-plane dedup accounting."""
     import math
 
     slices = max(1, math.ceil(cfg.nodes / cfg.hosts_per_slice))
-    plane = ControlPlane(backend="fake", legacy_resync=legacy)
+    plane = ControlPlane(backend="fake")
     make_tpu_nodes(plane.store, slices=slices,
                    hosts_per_slice=cfg.hosts_per_slice)
     REGISTRY.reset()
@@ -421,18 +422,12 @@ def _run_fleet_rep(cfg: FleetConfig, legacy: bool) -> dict:
         REGISTRY.counter(metric_names.RECONCILE_DEDUPED_TOTAL, controller=c)
         for c in ctrl_names)
     return {
-        "mode": "legacy" if legacy else "event",
         "ok": ok,
         "elapsed_s": round(elapsed, 3),
         "ready_s": round(ready_s, 3),
-        # Gate metric: EXACT p99 pooled across every controller's
-        # reconciles. Pooling is deliberately conservative: legacy mode
-        # runs many extra cheap no-op reconciles (resync sweeps the
-        # event plane dedups away), and those DEFLATE its pooled tail —
-        # an event-mode win here is won against a handicap. The
-        # worst-controller tail is reported but not gated: dedup shifts
-        # that population (fewer cheap samples ⇒ optically worse p99)
-        # even when every real reconcile got faster.
+        # EXACT p99 pooled across every controller's reconciles (the
+        # registry histogram's bucket-quantized quantiles cannot carry a
+        # per-rep tail comparison).
         "reconcile_p99_ms": round(
             _p99([d for _, d in samples]) * 1000, 3) if samples else 0.0,
         "reconcile_p99_worst_ms": round(max(p99s.values(), default=0.0), 3),
@@ -450,50 +445,42 @@ def _run_fleet_rep(cfg: FleetConfig, legacy: bool) -> dict:
     }
 
 
-def _run_fleet_ab(cfg: FleetConfig) -> dict:
-    """Interleaved legacy-vs-event A/B with the trimmed-spread gate.
+def _run_fleet_reps(cfg: FleetConfig) -> dict:
+    """Event-plane throughput repetitions with the trimmed-spread gate:
+    every rep must complete, dedup must be ENGAGED (deduped > 0 — the
+    watch-carried plane actually absorbing coalesced/stale triggers),
+    and the rep-to-rep binds/s spread must stay inside the gate.
     Retries the whole block once (ab_attempts) before reporting a red —
     this box's bimodal throughput can sink a single attempt."""
     last = None
     for attempt in range(1, max(1, cfg.ab_attempts) + 1):
-        reps: Dict[str, List[dict]] = {"legacy": [], "event": []}
-        for _ in range(cfg.ab_reps):
-            # Strict interleave: every legacy rep has an adjacent event
-            # rep in the same machine regime.
-            reps["legacy"].append(_run_fleet_rep(cfg, legacy=True))
-            reps["event"].append(_run_fleet_rep(cfg, legacy=False))
+        reps: Dict[str, List[dict]] = {
+            "event": [_run_fleet_rep(cfg) for _ in range(cfg.ab_reps)]}
         out: Dict[str, object] = {"attempt": attempt, "reps": reps}
-        reps_ok = all(r["ok"] for rs in reps.values() for r in rs)
-        med = {
-            m: {
-                "reconcile_p99_ms": _median(
-                    [r["reconcile_p99_ms"] for r in reps[m]]),
-                "binds_per_s": _median([r["binds_per_s"] for r in reps[m]]),
-                "scan_p99_ms": _median([r["scan_p99_ms"] for r in reps[m]]),
-                "deduped_total": _median(
-                    [float(r["deduped_total"]) for r in reps[m]]),
-            } for m in ("legacy", "event")}
-        spread = max(
-            _trimmed_spread([r["binds_per_s"] for r in reps["legacy"]]),
-            _trimmed_spread([r["binds_per_s"] for r in reps["event"]]))
-        lp, ep = (med["legacy"]["reconcile_p99_ms"],
-                  med["event"]["reconcile_p99_ms"])
-        lb, eb = med["legacy"]["binds_per_s"], med["event"]["binds_per_s"]
+        reps_ok = all(r["ok"] for r in reps["event"])
+        med = {"event": {
+            "reconcile_p99_ms": _median(
+                [r["reconcile_p99_ms"] for r in reps["event"]]),
+            "binds_per_s": _median(
+                [r["binds_per_s"] for r in reps["event"]]),
+            "scan_p99_ms": _median(
+                [r["scan_p99_ms"] for r in reps["event"]]),
+            "deduped_total": _median(
+                [float(r["deduped_total"]) for r in reps["event"]]),
+        }}
+        spread = _trimmed_spread(
+            [r["binds_per_s"] for r in reps["event"]])
         out.update({
             "median": med,
             "spread": round(spread, 4),
             "spread_max": cfg.ab_spread_max,
             "spread_estimator": "trimmed_minmax_drop1",
-            "reconcile_p99_ratio": round(ep / lp, 4) if lp else None,
-            "binds_per_s_ratio": round(eb / lb, 4) if lb else None,
             "reps_ok": reps_ok,
-            "p99_improved": bool(lp and ep < lp),
-            "binds_improved": bool(eb > lb),
+            "dedup_engaged": med["event"]["deduped_total"] > 0,
             "spread_ok": spread <= cfg.ab_spread_max,
         })
         last = out
-        if (reps_ok and out["p99_improved"] and out["binds_improved"]
-                and out["spread_ok"]):
+        if reps_ok and out["dedup_engaged"] and out["spread_ok"]:
             return out
     return last
 
@@ -742,14 +729,13 @@ def run_fleet(cfg: FleetConfig) -> dict:
     events_deduped_total = REGISTRY.counter(
         metric_names.EVENTS_DEDUPED_TOTAL)
 
-    # --- interleaved legacy-vs-event A/B (resets the registry per rep —
+    # --- event-plane throughput reps (resets the registry per rep —
     # every main-drill metric above is already materialized) ---
     ab = None
     if cfg.ab_reps > 0:
-        ab = _run_fleet_ab(cfg)
+        ab = _run_fleet_reps(cfg)
         inv["ab_reps_ok"] = bool(ab["reps_ok"])
-        inv["ab_reconcile_p99_improved"] = bool(ab["p99_improved"])
-        inv["ab_binds_per_s_improved"] = bool(ab["binds_improved"])
+        inv["ab_dedup_engaged"] = bool(ab["dedup_engaged"])
         inv["ab_spread_ok"] = bool(ab["spread_ok"])
 
     return {
@@ -769,7 +755,7 @@ def run_fleet(cfg: FleetConfig) -> dict:
                    "deduped_total": events_deduped_total,
                    "evicted_total": evicted},
         "dedup": dedup,
-        "legacy_vs_event": ab,
+        "event_reps": ab,
         "slowest_reconcile_by_controller": slowest_by_controller,
         "slowest_reconcile_waterfall": waterfall,
         "invariants": inv,
@@ -1156,6 +1142,202 @@ def run_kv_stream(cfg: KVStreamConfig) -> dict:
         },
     }
     return report
+
+
+# ---- KV cache-hierarchy scenario -------------------------------------------
+
+
+@dataclasses.dataclass
+class PrefixCacheConfig:
+    """Mooncake-tier cache-hierarchy drill: a deliberately undersized
+    device page pool serves system-prompt-heavy traffic (long shared
+    prefixes, unique suffixes, round-robin across prefix groups so every
+    admission evicts someone else's prefix), with the host-DRAM spill
+    tier underneath and predictive early rejection at admission. Four
+    promises:
+
+    * ``tier_accounting`` — every cached page lives in exactly one tier:
+      the host tier's lifetime identity closes (spilled == promoted +
+      evicted + resident) and no prompt's pages are simultaneously
+      device- and host-resident.
+    * ``directory_consistent`` — every tier-tagged directory claim is
+      backed by the tiers actually covering at least that depth.
+    * ``early_reject_before_prefill`` — rejected requests consumed ZERO
+      prefill steps: the engine's prefill-token counter accounts exactly
+      for the COMPLETED requests' prompts net of their prefix hits.
+    * ``zero_dropped_streams`` — every submission either completes
+      bit-identical to the device-only reference or is a structured
+      overload rejection with a retry hint; nothing times out or errors.
+    """
+
+    system_prompts: int = 3
+    prefix_len: int = 64            # shared prefix (pages of 8)
+    suffix_len: int = 16
+    requests_per_prefix: int = 4
+    max_new_tokens: int = 6
+    num_pages: int = 40             # undersized on purpose: ~1.2 prompts
+    host_tier_bytes: int = 1 << 26
+    burst_clients: int = 10         # early-rejection burst
+    slo_ttft_s: float = 0.6
+    early_reject_factor: float = 1.0
+    model: str = "tiny"
+
+
+def run_prefix_cache(cfg: PrefixCacheConfig) -> dict:
+    import threading
+
+    import numpy as np
+
+    from rbg_tpu.engine.config import EngineConfig, SamplingParams
+    from rbg_tpu.engine.engine import Engine
+    from rbg_tpu.engine.protocol import Overloaded
+    from rbg_tpu.engine.service import EngineService
+    from rbg_tpu.kvtransfer import PrefixDirectory
+
+    page_size = 8
+    base = dict(model=cfg.model, page_size=page_size, max_batch=4,
+                max_seq_len=256, prefill_chunk=16, use_pallas="never")
+    rng = np.random.RandomState(17)
+    probe = Engine(EngineConfig(num_pages=256, enable_radix_cache=False,
+                                **base))
+    vocab = probe.mcfg.vocab_size
+    prefixes = [rng.randint(1, vocab, size=cfg.prefix_len).tolist()
+                for _ in range(cfg.system_prompts)]
+    # Round-robin across prefix groups: admitting group B's prompt must
+    # evict group A's prefix from the undersized device pool — the exact
+    # pattern that threw prefixes away forever before the host tier.
+    prompts = []
+    for r in range(cfg.requests_per_prefix):
+        for pre in prefixes:
+            prompts.append(pre + rng.randint(
+                1, vocab, size=cfg.suffix_len).tolist())
+    sp = SamplingParams(max_new_tokens=cfg.max_new_tokens)
+    expect = {tuple(p): probe.generate([p], sp)[0] for p in prompts}
+
+    # --- phase A: hierarchy correctness + accounting under churn ---
+    directory = PrefixDirectory(page_size=page_size)
+    eng = Engine(EngineConfig(num_pages=cfg.num_pages,
+                              host_tier_bytes=cfg.host_tier_bytes, **base))
+    eng.host_tier.wire_directory(directory, "10.0.0.1:9000",
+                                 slice_id="slice-a")
+    t0 = time.perf_counter()
+    outs = [eng.generate([p], sp)[0] for p in prompts]
+    outs += [eng.generate([p], sp)[0] for p in prompts]   # host-hit pass
+    elapsed = time.perf_counter() - t0
+    bit_identical = all(o == expect[tuple(p)]
+                        for o, p in zip(outs, prompts + prompts))
+    tier = eng.host_tier.stats()
+    # Exactly-one-tier: the lifetime identity closes AND no prompt has
+    # pages resident in both tiers at once (host payload may only begin
+    # where the device-resident prefix ends; radix eviction is
+    # leaf-first, so device keeps a prefix of the path, host the rest).
+    overlap_free = True
+    dir_ok = True
+    for p in prompts:
+        d = eng.radix.peek(p)
+        h0 = eng.host_tier.peek(p, 0)
+        if d > 0 and h0 > 0:
+            overlap_free = False
+        dir_matched, _detail = directory.lookup_detail(p)
+        if dir_matched > d + eng.host_tier.peek(p, d):
+            dir_ok = False
+    accounting = (eng.host_tier.accounting_closes()
+                  and tier["spilled_pages"] > 0
+                  and tier["promoted_pages"] > 0)
+
+    # --- phase B: predictive early rejection under a burst ---
+    svc = EngineService(EngineConfig(
+        num_pages=cfg.num_pages, host_tier_bytes=cfg.host_tier_bytes,
+        early_reject="auto", slo_ttft_s=cfg.slo_ttft_s,
+        early_reject_factor=cfg.early_reject_factor, **base))
+    try:
+        # Warm the jit cache first (the predictor must learn steady-state
+        # prefill throughput, not compile stalls — a cold service would
+        # predict multi-second TTFTs and reject its very first traffic),
+        # then train the completion/prefill rates on real sequential
+        # requests.
+        svc.warmup(input_len=32, out_len=2)
+        for p in prompts[:4]:
+            svc.submit(p, sp, timeout=120.0)
+        pf_base = svc.engine.metrics["prefill_tokens"]
+        hit_base = (svc.engine.metrics["radix_hit_tokens"]
+                    + svc.engine.metrics["host_hit_tokens"])
+        results = {}
+        lock = threading.Lock()
+
+        def client(i: int, prompt):
+            try:
+                tokens, _ = svc.submit(prompt, sp, timeout=120.0)
+                out = ("ok", tokens)
+            except Overloaded as e:
+                out = ("shed", getattr(e, "retry_after_s", None))
+            except Exception as e:  # noqa: BLE001 — account, don't crash
+                out = ("error", f"{type(e).__name__}: {e}")
+            with lock:
+                results[i] = out
+        burst = [prompts[i % len(prompts)]
+                 for i in range(cfg.burst_clients * 2)]
+        threads = [threading.Thread(target=client, args=(i, p), daemon=True)
+                   for i, p in enumerate(burst)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180.0)
+        wedged = [t for t in threads if t.is_alive()]
+        completed = [(i, burst[i]) for i, (kind, _) in results.items()
+                     if kind == "ok"]
+        shed = [(i, r) for i, (kind, r) in results.items() if kind == "shed"]
+        errors = [(i, r) for i, (kind, r) in results.items()
+                  if kind == "error"]
+        early_rejects = svc.counters["early_rejects"]
+        # The zero-prefill-for-rejected identity: every prefill token the
+        # engine spent during the burst is attributable to a COMPLETED
+        # request's prompt net of its prefix hits. A rejected request
+        # that touched prefill would break the equality.
+        pf_spent = svc.engine.metrics["prefill_tokens"] - pf_base
+        hits = (svc.engine.metrics["radix_hit_tokens"]
+                + svc.engine.metrics["host_hit_tokens"]) - hit_base
+        pf_expected = sum(len(p) for _, p in completed) - hits
+        burst_identical = all(
+            results[i][1] == expect[tuple(p)] for i, p in completed)
+        shed_have_hints = all(r is not None and r > 0 for _, r in shed)
+    finally:
+        svc.stop()
+
+    return {
+        "scenario": "prefixcache",
+        "config": dataclasses.asdict(cfg),
+        "elapsed_s": round(elapsed, 3),
+        "hierarchy": {
+            "requests": len(prompts) * 2,
+            "host_tier": tier,
+            "device_tier_pages": eng.radix.cached_pages,
+            "radix_hit_tokens": eng.metrics["radix_hit_tokens"],
+            "host_hit_tokens": eng.metrics["host_hit_tokens"],
+            "directory": directory.stats(),
+        },
+        "burst": {
+            "submitted": len(burst),
+            "completed": len(completed),
+            "shed": len(shed),
+            "early_rejects": early_rejects,
+            "errors": [f"client {i}: {msg}" for i, msg in errors],
+            "wedged_clients": len(wedged),
+            "prefill_tokens_spent": pf_spent,
+            "prefill_tokens_expected": pf_expected,
+        },
+        "bit_identical": bit_identical and burst_identical,
+        "invariants": {
+            "tier_accounting": accounting and overlap_free,
+            "directory_consistent": dir_ok,
+            "early_reject_before_prefill": (
+                early_rejects > 0 and pf_spent == pf_expected
+                and shed_have_hints),
+            "zero_dropped_streams": (
+                not errors and not wedged and bit_identical
+                and burst_identical and len(completed) > 0),
+        },
+    }
 
 
 # ---- SLO-driven autoscaling scenario ---------------------------------------
@@ -2288,7 +2470,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="rbg-tpu-stress")
     ap.add_argument("--scenario", default="churn",
                     choices=["churn", "overload", "preemption", "autoscale",
-                             "kvstream", "fleet", "topoflip"],
+                             "kvstream", "prefixcache", "fleet", "topoflip"],
                     help="churn = control-plane create/update/delete "
                          "percentiles; overload = serving-plane admission "
                          "control drill (sheds, deadlines, queue bound); "
@@ -2348,12 +2530,14 @@ def main(argv=None) -> int:
                     help="simulated fleet size for --scenario fleet "
                          "(default 5000; the acceptance drill runs >=5k)")
     ap.add_argument("--ab-reps", type=int, default=3,
-                    help="interleaved legacy-vs-event A/B pairs the fleet "
+                    help="event-plane throughput repetitions the fleet "
                          "drill runs after the main wave (0 disables; the "
-                         "gate requires reconcile p99 AND binds/s to "
-                         "improve in event mode)")
+                         "gate requires every rep to complete, dedup "
+                         "engaged, and binds/s spread inside the trimmed "
+                         "gate)")
     ap.add_argument("--ab-groups", type=int, default=40,
-                    help="churn size per A/B repetition (fleet scenario)")
+                    help="churn size per throughput repetition (fleet "
+                         "scenario)")
     ap.add_argument("--reconcile-p99-bound-s", type=float, default=2.5,
                     help="reconcile p99 bound the fleet drill asserts "
                          "per controller")
@@ -2436,7 +2620,7 @@ def main(argv=None) -> int:
             for r in ("complete", "incomplete", "leaked")}
     load1 = os.getloadavg()[0]
     if args.scenario in ("overload", "preemption", "autoscale", "kvstream",
-                         "fleet", "topoflip"):
+                         "prefixcache", "fleet", "topoflip"):
         if args.scenario == "fleet":
             # Scenario-aware rate default: the churn scenarios' 5 qps
             # would spend 30 s just CREATING a 150-group fleet wave.
@@ -2470,6 +2654,9 @@ def main(argv=None) -> int:
                 slow_link_delay_s=(args.kv_slow_link
                                    if args.kv_slow_link is not None
                                    else 0.02)))
+        elif args.scenario == "prefixcache":
+            report = run_prefix_cache(PrefixCacheConfig(
+                slo_ttft_s=min(args.slo_ttft_s, 0.6)))
         elif args.scenario == "autoscale":
             report = run_autoscale(AutoscaleStressConfig(
                 duration_s=(args.duration_s if args.duration_s is not None
@@ -3138,7 +3325,7 @@ def _fleet_sections(report: dict) -> str:
     stuck_html = ("<p>none</p>" if not stuck else _kv_table(
         {f"{s['controller']} {s['key']}": f"{s['failures']} failures"
          for s in stuck}))
-    ab = report.get("legacy_vs_event") or {}
+    ab = report.get("event_reps") or {}
     if ab:
         med = ab.get("median") or {}
         ab_rows = "".join(
@@ -3147,22 +3334,20 @@ def _fleet_sections(report: dict) -> str:
             f"<td>{(med.get(m) or {}).get('binds_per_s')}</td>"
             f"<td>{(med.get(m) or {}).get('scan_p99_ms')}</td>"
             f"<td>{(med.get(m) or {}).get('deduped_total')}</td></tr>"
-            for m in ("legacy", "event"))
+            for m in ("event",))
         ab_html = (
             "<table><tr><th>mode (median of reps)</th>"
             "<th>reconcile p99 (ms)</th><th>binds/s</th>"
             "<th>scan p99 (ms)</th><th>deduped</th></tr>"
             f"{ab_rows}</table>"
             + _kv_table({
-                "reconcile_p99 event/legacy":
-                    ab.get("reconcile_p99_ratio"),
-                "binds_per_s event/legacy": ab.get("binds_per_s_ratio"),
+                "dedup engaged": ab.get("dedup_engaged"),
                 "spread (trimmed)":
                     f"{ab.get('spread')} (max {ab.get('spread_max')})",
                 "attempt": ab.get("attempt"),
             }))
     else:
-        ab_html = "<p>(A/B disabled: ab_reps=0)</p>"
+        ab_html = "<p>(throughput reps disabled: ab_reps=0)</p>"
     return f"""<style>.vt{{font:10px sans-serif;fill:#52514e}}
 .vl{{font:11px sans-serif}}</style>
 <h2>fleet</h2>{_kv_table(report.get("fleet") or {})}
@@ -3180,7 +3365,7 @@ def _fleet_sections(report: dict) -> str:
 <h2>event plane</h2>{_kv_table(report.get("events") or {})}
 <h2>event-carried delivery (dedup / backstop accounting)</h2>
 {_kv_table(report.get("dedup") or {})}
-<h2>legacy vs event A/B (interleaved)</h2>
+<h2>event-plane throughput reps</h2>
 {ab_html}
 <h2>stuck keys</h2>{stuck_html}
 <h2>invariants</h2>{_invariants_table(report.get("invariants") or {})}"""

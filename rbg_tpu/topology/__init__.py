@@ -12,3 +12,6 @@ from rbg_tpu.topology.policy import (       # noqa: F401
     POSTURE_DISAGG, POSTURE_UNIFIED, REC_HOLD, TopologyDecision,
     TopologyPolicy, TopologyPolicyConfig, TopologySignals,
 )
+from rbg_tpu.topology.signals import (      # noqa: F401
+    router_ingress_ratio, router_ingress_signals_fn,
+)

@@ -1,0 +1,55 @@
+"""Production ratio signal for the topology policy — router-ingress fed.
+
+The PR-13 controller left a seam: ``TopologyConfig.signals_fn`` was only
+exercised by the stress drill's scripted inputs, while production planes
+fell back to per-role token rates that are only measurable once a group
+is ALREADY disaggregated. The router now publishes
+``rbg_router_ingress_tokens_total{kind="prefill"|"decode"}`` — prompt
+tokens at dispatch, output tokens at delivery, the true ingress-vantage
+load mix — and this module turns the windowed rate of that counter pair
+into the ``prefill_decode_ratio`` the policy steers on.
+
+Absence-of-signal discipline matches ``SignalReader.measured_ratio``: a
+side that measured nothing in the window yields NO ratio (empty extras),
+never 0 or ∞ — the controller then falls back to per-role rates or
+HOLDs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from rbg_tpu.obs import names
+
+
+def router_ingress_signals_fn(sampler=None, window_s: float = 60.0):
+    """Build a ``TopologyConfig.signals_fn`` reading the router-ingress
+    token counters through the windowed sampler (the PR-8 plane). Wire it
+    in any plane whose router shares the process's metrics registry:
+
+        TopologyConfig(groups=[...],
+                       signals_fn=router_ingress_signals_fn())
+    """
+    if sampler is None:
+        from rbg_tpu.obs import timeseries
+        sampler = timeseries.get_sampler()
+
+    def signals_fn(_gt) -> dict:
+        ratio = router_ingress_ratio(sampler, window_s)
+        return {} if ratio is None else {"prefill_decode_ratio": ratio}
+
+    return signals_fn
+
+
+def router_ingress_ratio(sampler, window_s: float = 60.0,
+                         now: Optional[float] = None) -> Optional[float]:
+    """Windowed prefill:decode token-rate ratio at router ingress, or
+    None when either side measured no activity (absence of signal, not a
+    measurement of 0/∞)."""
+    num = sampler.rate(names.ROUTER_INGRESS_TOKENS_TOTAL, window_s,
+                       now=now, kind="prefill")
+    den = sampler.rate(names.ROUTER_INGRESS_TOKENS_TOTAL, window_s,
+                       now=now, kind="decode")
+    if num is None or den is None or num <= 1e-9 or den <= 1e-9:
+        return None
+    return num / den

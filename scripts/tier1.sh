@@ -115,6 +115,43 @@ assert r.get('bit_identical'), 'streamed decode diverged from reference'
              "invariant red in /tmp/_t1_kvstream.json" >&2
         exit 1
     fi
+    # Cache-hierarchy smoke: the Mooncake-tier drill — an undersized
+    # device pool spilling into the host-DRAM tier under shared-prefix
+    # churn, with predictive early rejection at admission. Asserts
+    # tier_accounting (every cached page in exactly one tier, lifetime
+    # identity closes), directory_consistent (tier-tagged claims backed
+    # by the tiers), early_reject_before_prefill (rejected requests
+    # consumed ZERO prefill steps), and zero_dropped_streams (everything
+    # completes bit-identical or is a structured rejection). Outside the
+    # 870 s pytest budget, --lint mode only.
+    echo "== rbg-tpu stress --scenario prefixcache (cache-hierarchy smoke) =="
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
+            stress --scenario prefixcache --json \
+            >/tmp/_t1_prefixcache.json; then
+        echo "TIER1 PREFIXCACHE SMOKE FAILED — see /tmp/_t1_prefixcache.json" \
+             "(invariants)" >&2
+        exit 1
+    fi
+    if ! python -c "
+import json
+r = json.load(open('/tmp/_t1_prefixcache.json'))
+inv = r.get('invariants') or {}
+assert inv.get('tier_accounting'), \
+    'a cached page escaped tier accounting: %s' % (r.get('hierarchy') or {})
+assert inv.get('directory_consistent'), 'directory overclaimed a tier'
+assert inv.get('early_reject_before_prefill'), \
+    'a rejected request consumed prefill: %s' % (r.get('burst') or {})
+assert inv.get('zero_dropped_streams'), \
+    'requests dropped: %s' % (r.get('burst') or {})
+assert r.get('bit_identical'), 'hierarchy output diverged from reference'
+tier = (r.get('hierarchy') or {}).get('host_tier') or {}
+assert tier.get('spilled_pages', 0) > 0, 'nothing ever spilled'
+assert tier.get('promoted_pages', 0) > 0, 'nothing ever promoted'
+"; then
+        echo "TIER1 PREFIXCACHE SMOKE FAILED — tier-accounting/early-" \
+             "rejection invariant red in /tmp/_t1_prefixcache.json" >&2
+        exit 1
+    fi
     # Adaptive-topology smoke: the agg<->disagg drill at 1 repetition
     # (the goodput-vs-static gate needs interleaved reps and runs in the
     # full acceptance drill; the smoke asserts the safety + convergence
@@ -165,7 +202,7 @@ assert len(curve) > 10 and any(
             --ab-reps 2 --ab-groups 12 --json \
             >/tmp/_t1_fleet.json; then
         echo "TIER1 FLEET SMOKE FAILED — see /tmp/_t1_fleet.json" \
-             "(invariants incl. the legacy-vs-event A/B gate)" >&2
+             "(invariants incl. the event-plane throughput-rep gate)" >&2
         exit 1
     fi
     if ! python -c "
@@ -182,17 +219,18 @@ assert r.get('reconcile_latency'), 'reconcile-latency curves are empty'
 peak = max((c.get('binds_per_s', 0)
             for c in r.get('throughput_curve') or []), default=0)
 assert peak >= 10, 'scheduler-throughput floor: peak %.1f binds/s < 10' % peak
-# Legacy-vs-event A/B: section present, non-empty, every rep completed.
-ab = r.get('legacy_vs_event') or {}
-assert ab.get('reps'), 'legacy-vs-event A/B section missing or empty'
-assert all(len(v) > 0 for v in ab['reps'].values()), 'A/B reps missing'
-assert ab.get('reps_ok'), 'an A/B repetition failed to complete'
+# Event-plane throughput reps: section present, non-empty, every rep
+# completed, dedup engaged (the watch-carried plane doing real work).
+ab = r.get('event_reps') or {}
+assert ab.get('reps'), 'event-plane throughput-rep section missing or empty'
+assert all(len(v) > 0 for v in ab['reps'].values()), 'throughput reps missing'
+assert ab.get('reps_ok'), 'a throughput repetition failed to complete'
 assert (ab.get('median') or {}).get('event', {}).get('deduped_total', 0) \
-    > 0, 'event-mode reps recorded zero dedup — event plane not engaged'
+    > 0, 'throughput reps recorded zero dedup — event plane not engaged'
 "; then
         echo "TIER1 FLEET SMOKE FAILED — drained/stuck-keys/events, the" \
-             "throughput floor, or the legacy-vs-event A/B section in" \
-             "/tmp/_t1_fleet.json" >&2
+             "throughput floor, or the event-plane throughput-rep section" \
+             "in /tmp/_t1_fleet.json" >&2
         exit 1
     fi
     # Live windowed-signal render: boot a tiny engine server, push one

@@ -1,6 +1,6 @@
 """Event-carried control plane: watch-resume watermarks on the store,
-generation dedup in the workqueue, drift-backstop skip accounting, the
-watch-driven k8s node sync, and the legacy_resync A/B toggle.
+generation dedup in the workqueue, drift-backstop skip accounting, and
+the watch-driven k8s node sync.
 
 The dedup-safety property drilled here is the one the refactor must
 never break: the NEWEST generation of an object is never skipped — a
@@ -266,21 +266,6 @@ def test_forced_requeue_never_deduped():
         ctrl.stop()
 
 
-def test_legacy_mode_disables_dedup():
-    store = Store()
-    ctrl = _Recorder(store, write_status=True)
-    ctrl.legacy_resync = True
-    base_ded = _deduped(ctrl.name)
-    ctrl.start()
-    try:
-        store.create(_pod("lg"))
-        # Legacy: the self-write event must RUN a second reconcile.
-        _wait(lambda: len(ctrl.observed) >= 2, desc="self-write reconciled")
-        assert _deduped(ctrl.name) == base_ded
-    finally:
-        ctrl.stop()
-
-
 def test_backstop_skips_recently_reconciled_keys():
     store = Store()
     ctrl = _Recorder(store)
@@ -318,14 +303,15 @@ def test_backstop_skips_recently_reconciled_keys():
 # ---- plane toggle + k8s node watch ----------------------------------------
 
 
-def test_plane_legacy_toggle_flags_controllers():
+def test_plane_is_event_carried_by_default():
+    """The legacy_resync A/B toggle is deleted: every plane is event-
+    carried — sharded feasibility scan on, long backstop periods, dedup
+    active (the _Recorder dedup tests above prove the behavior)."""
     from rbg_tpu.runtime.plane import ControlPlane
-    plane = ControlPlane(backend="none", legacy_resync=True)
-    assert all(c.legacy_resync for c in plane.manager.controllers)
-    assert plane.scheduler.use_sharded is False
-    event_plane = ControlPlane(backend="none")
-    assert not any(c.legacy_resync for c in event_plane.manager.controllers)
-    assert event_plane.scheduler.use_sharded is True
+    plane = ControlPlane(backend="none")
+    assert plane.scheduler.use_sharded is True
+    assert all((c.backstop_period or c.resync_period) >= 30.0
+               for c in plane.manager.controllers)
 
 
 def test_k8s_node_watch_carries_disruption_without_polling():
@@ -347,7 +333,6 @@ def test_k8s_node_watch_carries_disruption_without_polling():
             }, tpu=4)
         store = Store()
         backend = K8sPodBackend(store, KubeClient(api.url))
-        assert backend.legacy_resync is False
         assert backend.NODE_BACKSTOP_S >= 60.0
         backend.start()
         try:
@@ -365,24 +350,21 @@ def test_k8s_node_watch_carries_disruption_without_polling():
         api.stop()
 
 
-# ---- fleet drill (A/B + 10k slow) -----------------------------------------
+# ---- fleet drill (throughput reps + 10k slow) ------------------------------
 
 
-def test_fleet_ab_section_small():
-    """One interleaved A/B pair at toy scale: the section is present,
-    both reps complete with identical bind counts, and legacy mode never
-    dedups. (Dedup VOLUME is asserted at real churn scale — the tier1
-    fleet smoke — because a 16-pod rep can legitimately coalesce
-    nothing.)"""
+def test_fleet_rep_section_small():
+    """Two throughput reps at toy scale: each completes with identical
+    bind counts (the churn wave is deterministic per rep). (Dedup VOLUME
+    is asserted at real churn scale — the tier1 fleet smoke — because a
+    16-pod rep can legitimately coalesce nothing.)"""
     from rbg_tpu.stress.harness import FleetConfig, _run_fleet_rep
     cfg = FleetConfig(nodes=24, hosts_per_slice=4, groups=4, ab_groups=4,
                       replicas=1, roles_per_group=1, timeout_s=60.0)
-    legacy = _run_fleet_rep(cfg, legacy=True)
-    event = _run_fleet_rep(cfg, legacy=False)
-    assert legacy["ok"] and event["ok"]
-    assert legacy["mode"] == "legacy" and event["mode"] == "event"
-    assert legacy["deduped_total"] == 0
-    assert event["binds_total"] == legacy["binds_total"] > 0
+    a = _run_fleet_rep(cfg)
+    b = _run_fleet_rep(cfg)
+    assert a["ok"] and b["ok"]
+    assert a["binds_total"] == b["binds_total"] > 0
 
 
 @pytest.mark.slow

@@ -1,0 +1,450 @@
+"""KV cache hierarchy (Mooncake tier): host-DRAM spill tier lifecycle —
+spill-on-eviction, promote-on-hit, byte-budget enforcement under churn,
+directory tier/hotness updates, cache-aware router scoring, predictive
+early rejection — and the bit-identity contract: a host-tier hit decodes
+identically to a cold prefill.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from rbg_tpu.engine.config import EngineConfig, SamplingParams
+from rbg_tpu.engine.kvpool import KVPoolStore
+from rbg_tpu.engine.kvtier import HostKVTier
+from rbg_tpu.kvtransfer.directory import PrefixDirectory
+
+PS = 8
+BASE = dict(model="tiny", page_size=PS, max_batch=2, max_seq_len=256,
+            prefill_chunk=16, use_pallas="never")
+
+
+def _prompts(n, length, seed=0, vocab=250):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, size=length).tolist() for _ in range(n)]
+
+
+def _pages(tokens, n_pages, seed=1):
+    """Fake numpy page payloads [L, n_pages, page, KV, hd]."""
+    rng = np.random.RandomState(seed)
+    return (rng.rand(2, n_pages, PS, 1, 4).astype(np.float32),
+            rng.rand(2, n_pages, PS, 1, 4).astype(np.float32))
+
+
+# ---- KVPoolStore placeholder / extend / hotness mechanics ------------------
+
+
+def test_store_placeholders_deep_first_spill_then_fill():
+    """Radix eviction is leaf-first: DEEP pages arrive before shallow
+    ones. The trie must keep placeholder path nodes so the deep payload
+    stays reachable, and fill them when the shallow pages arrive."""
+    store = KVPoolStore(PS, max_bytes=1 << 20)
+    toks = list(range(1, 4 * PS + 1))          # 4 pages
+    k, v = _pages(toks, 2)
+    # Pages 2..3 spill first (deep), 0..1 exist only as placeholders.
+    stored = store.put(toks, k, v, data_from_page=2)
+    assert stored == 2
+    assert store.stats()["pages"] == 2
+    # match() from the root crosses no payload -> miss; extend() past the
+    # placeholder depth finds the run.
+    assert store.match(toks)[0] == 0
+    extra, ek, ev = store.extend(toks, 2 * PS)
+    assert extra == 2 * PS
+    assert np.array_equal(ek, k) and np.array_equal(ev, v)
+    # Shallow pages arrive later: placeholders fill, full match works.
+    k01, v01 = _pages(toks, 2, seed=2)
+    assert store.put(toks, k01, v01, data_from_page=0) == 2
+    matched, mk, _ = store.match(toks)
+    assert matched == 4 * PS
+    assert np.array_equal(mk[:, :2], k01)
+    assert np.array_equal(mk[:, 2:], k)
+
+
+def test_store_take_moves_pages_out_and_accounting_follows():
+    store = KVPoolStore(PS, max_bytes=1 << 20)
+    toks = list(range(1, 3 * PS + 1))
+    k, v = _pages(toks, 3)
+    store.put(toks, k, v)
+    bytes_before = store.stats()["bytes"]
+    extra, tk, tv = store.extend(toks, 0, take=True)
+    assert extra == 3 * PS
+    assert np.array_equal(tk, k) and np.array_equal(tv, v)
+    s = store.stats()
+    assert s["pages"] == 0 and s["bytes"] == 0 and bytes_before > 0
+    # Taken pages are GONE (placeholders remain): no second hit.
+    assert store.extend(toks, 0)[0] == 0
+
+
+def test_store_byte_budget_evicts_coldest_first():
+    """LRU-by-hotness: under byte pressure the un-hit prefix dies first
+    even when it was touched more recently."""
+    one_page_bytes = _pages([0], 1)[0].nbytes * 2
+    store = KVPoolStore(PS, max_bytes=3 * one_page_bytes)
+    hot = list(range(1, PS + 1))
+    cold = list(range(100, 100 + PS))
+    k, v = _pages(hot, 1)
+    store.put(hot, k, v)
+    store.put(cold, *_pages(cold, 1, seed=3))
+    for _ in range(3):
+        assert store.match(hot)[0] == PS       # heat the hot prefix
+    store.put(cold, *_pages(cold, 1, seed=3))  # refresh cold's recency
+    # Two more prefixes blow the budget: cold (0 hits) must go first.
+    store.put(list(range(200, 200 + PS)), *_pages([0], 1, seed=4))
+    store.put(list(range(300, 300 + PS)), *_pages([0], 1, seed=5))
+    assert store.stats()["bytes"] <= 3 * one_page_bytes
+    assert store.match(hot)[0] == PS
+    assert store.match(cold)[0] == 0
+
+
+# ---- host-tier lifecycle against a real engine -----------------------------
+
+
+def _expect(prompts, sp, **cfg):
+    from rbg_tpu.engine.engine import Engine
+    return [Engine(EngineConfig(num_pages=256, enable_radix_cache=False,
+                                **BASE)).generate([p], sp)[0]
+            for p in prompts]
+
+
+def test_spill_on_eviction_promote_on_hit_bit_identical():
+    """The tentpole lifecycle: an undersized device pool evicts between
+    prompts (spill), the second pass promotes from host (hit), and every
+    output — cold, spilled, promoted — is bit-identical to a cold
+    prefill on a reference engine. Accounting closes throughout."""
+    from rbg_tpu.engine.engine import Engine
+
+    prompts = _prompts(5, 40, seed=7)
+    sp = SamplingParams(max_new_tokens=6)
+    expect = _expect(prompts, sp)
+    eng = Engine(EngineConfig(num_pages=24, host_tier_bytes=1 << 26,
+                              **BASE))
+    pass1 = [eng.generate([p], sp)[0] for p in prompts]
+    assert pass1 == expect
+    tier = eng.host_tier.stats()
+    assert tier["spilled_pages"] > 0, "undersized pool never spilled"
+    pass2 = [eng.generate([p], sp)[0] for p in prompts]
+    assert pass2 == expect, "host-tier hit diverged from cold prefill"
+    tier = eng.host_tier.stats()
+    assert tier["promoted_pages"] > 0, "second pass never promoted"
+    assert eng.metrics["host_hit_tokens"] > 0
+    assert eng.host_tier.accounting_closes(), tier
+    # Promotion is a MOVE: no prompt may be payload-resident in both
+    # tiers at once (device keeps a prefix of the path, host the rest).
+    for p in prompts:
+        d = eng.radix.peek(p)
+        assert not (d > 0 and eng.host_tier.peek(p, 0) > 0)
+
+
+def test_host_tier_byte_budget_under_churn():
+    from rbg_tpu.engine.engine import Engine
+
+    prompts = _prompts(8, 48, seed=11)
+    sp = SamplingParams(max_new_tokens=4)
+    # Budget of ~4 pages: churn MUST evict host pages, and the lifetime
+    # identity still closes (spilled == promoted + evicted + resident).
+    one_page = 2 * 2 * PS * 1 * 8 * 4   # [L=2, page, KV=1, hd=8] f32 x2
+    eng = Engine(EngineConfig(num_pages=24,
+                              host_tier_bytes=4 * one_page, **BASE))
+    for _ in range(2):
+        for p in prompts:
+            eng.generate([p], sp)
+    tier = eng.host_tier.stats()
+    assert tier["bytes"] <= 4 * one_page
+    assert tier["evicted_pages"] > 0, tier
+    assert eng.host_tier.accounting_closes(), tier
+
+
+def test_host_tier_updates_directory_tier_and_hotness():
+    from rbg_tpu.engine.engine import Engine
+
+    directory = PrefixDirectory(page_size=PS)
+    # 15 usable pages vs ~6 pages/prompt: every admission evicts.
+    eng = Engine(EngineConfig(num_pages=16, host_tier_bytes=1 << 26,
+                              **BASE))
+    eng.host_tier.wire_directory(directory, "10.0.0.9:9", "slice-z")
+    prompts = _prompts(4, 40, seed=13)
+    sp = SamplingParams(max_new_tokens=4)
+    for p in prompts:
+        eng.generate([p], sp)
+    assert eng.host_tier.stats()["spilled_pages"] > 0
+    # Spills registered the evicted prefixes as host-tier holders.
+    matched, detail = directory.lookup_detail(prompts[0])
+    assert matched > 0 and detail
+    assert all(e["backend"] == "10.0.0.9:9" for e in detail)
+    first_hot = detail[0]["hotness"]
+    # Hotness climbs per deepest-key lookup.
+    _, detail2 = directory.lookup_detail(prompts[0])
+    assert detail2[0]["hotness"] == first_hot + 1
+    # A promotion re-registers the promoted run as device tier. (The
+    # full prompt's DEEPEST key covers the first pass's output page,
+    # which legitimately stays host-resident — promotion only takes the
+    # page-aligned prompt prefix — so probe at the promoted depth.)
+    eng.generate([prompts[0]], sp)
+    promoted_depth = (len(prompts[0]) - 1) // PS * PS
+    _, detail3 = directory.lookup_detail(prompts[0][:promoted_depth])
+    assert any(e["tier"] == "device" for e in detail3), detail3
+
+
+def test_directory_register_tier_refresh_and_client_invalidate_keys():
+    d = PrefixDirectory(page_size=PS)
+    toks = list(range(1, 2 * PS + 1))
+    d.register(toks, "b1", tier="host")
+    _, detail = d.lookup_detail(toks)
+    assert detail[0]["tier"] == "host"
+    d.register(toks, "b1", tier="device")
+    _, detail = d.lookup_detail(toks)
+    assert detail[0]["tier"] == "device"
+    # invalidate_keys drops exactly those pages.
+    from rbg_tpu.kvtransfer.chunks import prefix_keys
+    keys = prefix_keys(toks, PS)
+    assert d.invalidate_keys(keys[1:]) == 1
+    matched, _ = d.lookup_detail(toks)
+    assert matched == PS
+
+
+def test_spill_skips_pages_pinned_by_running_requests():
+    """A radix-evicted page a RUNNING request still pins (refcount > 1)
+    must NOT spill: it stays device-resident and re-enters the radix at
+    that request's finish — spilling a copy would put the same content
+    in both tiers."""
+    from rbg_tpu.engine.engine import Engine
+
+    eng = Engine(EngineConfig(num_pages=32, host_tier_bytes=1 << 26,
+                              **BASE))
+    calls = []
+
+    class _FakeTier:
+        def spill_from_device(self, toks, ids, cache):
+            calls.append(list(ids))
+            return len(ids)
+
+    eng.host_tier = _FakeTier()
+    pages = eng.allocator.alloc(3)
+    eng.allocator.share(pages[:2])       # a running request pins 2 pages
+    eng._spill_evicted(list(range(1, 3 * PS + 1)), pages)
+    assert calls == [pages[2:]]          # only the unpinned tail spills
+    calls.clear()
+    eng.allocator.share([pages[2]])      # now everything is pinned
+    eng._spill_evicted(list(range(1, 3 * PS + 1)), pages)
+    assert calls == []                   # nothing to spill at all
+
+
+def test_host_hits_not_double_counted_when_admission_blocks():
+    """A promotion whose request then fails its remaining alloc counts
+    NOTHING — the promoted pages entered the radix, so the retry's
+    radix.match re-finds them; charging the promotion too would count
+    the same tokens under both tiers (and break the prefixcache drill's
+    prefill-accounting equality)."""
+    from rbg_tpu.engine.engine import Engine
+
+    prompts = _prompts(6, 40, seed=41)
+    sp = SamplingParams(max_new_tokens=6)
+    eng = Engine(EngineConfig(num_pages=24, host_tier_bytes=1 << 26,
+                              **BASE))
+    for _ in range(2):
+        for p in prompts:
+            eng.generate([p], sp)
+    total_prompt = 2 * sum(len(p) for p in prompts)
+    hits = (eng.metrics["radix_hit_tokens"]
+            + eng.metrics["host_hit_tokens"])
+    # Combined hits can never exceed the tokens actually submitted.
+    assert hits <= total_prompt
+    assert eng.metrics["host_hit_tokens"] > 0
+
+
+def test_invalidate_keys_scoped_to_backend():
+    """Per-replica host-tier eviction drops ONLY that replica's claims:
+    prefix keys are content-hashed, so replica A evicting a shared
+    system prompt must not wipe replica B's still-valid entry."""
+    from rbg_tpu.kvtransfer.chunks import prefix_keys
+
+    d = PrefixDirectory(page_size=PS)
+    toks = list(range(1, 2 * PS + 1))
+    d.register(toks, "a", tier="host")
+    d.register(toks, "b", tier="device")
+    keys = prefix_keys(toks, PS)
+    assert d.invalidate_keys(keys, backend="a") == 2
+    matched, detail = d.lookup_detail(toks)
+    assert matched == 2 * PS
+    assert [e["backend"] for e in detail] == ["b"]
+    # Unscoped keeps the shared-pool semantics: everything goes.
+    assert d.invalidate_keys(keys) == 2
+    assert d.lookup_detail(toks)[0] == 0
+
+
+def test_host_tier_requires_radix_cache():
+    with pytest.raises(ValueError, match="radix"):
+        EngineConfig(host_tier_bytes=1 << 20, enable_radix_cache=False,
+                     num_pages=32, **BASE).validate()
+
+
+# ---- cache-aware router scoring --------------------------------------------
+
+
+class _StubDirectory:
+    def __init__(self, matched_tokens, detail):
+        self.matched_tokens = matched_tokens
+        self.detail = detail
+
+    def lookup_detail(self, _tokens):
+        return self.matched_tokens, [dict(e) for e in self.detail]
+
+
+def test_router_scores_prefix_depth_by_tier_cost():
+    from rbg_tpu.engine.router import Registry, RouterState
+
+    prompt = list(range(1, 65))
+    # Equal queues: the device-tier holder wins over host-tier holder
+    # and both beat the non-holder.
+    st = RouterState(Registry(None), None,
+                     {"worker": ["dev:1", "host:2", "none:3"]},
+                     directory=_StubDirectory(48, [
+                         {"backend": "dev:1", "tier": "device",
+                          "hotness": 1},
+                         {"backend": "host:2", "tier": "host",
+                          "hotness": 1}]))
+    st.note_kv_observed(64, 64 * 4096)        # bytes/token estimate
+    cands = st.candidates_for("worker", prompt)
+    assert cands[0] == "dev:1"
+    assert cands[1] == "host:2"
+    assert st.metrics["directory_hits"] == 1
+    # The balance guard IS the scoring: a swamped deep-hit holder loses
+    # to an idle miss.
+    for _ in range(4):
+        st.pool.acquire("dev:1")
+        st.pool.acquire("host:2")
+    assert st.candidates_for("worker", prompt)[0] == "none:3"
+
+
+def test_router_replicates_hot_single_holder_prefix():
+    from rbg_tpu.engine.router import (REPLICATE_EVERY, Registry,
+                                       RouterState)
+
+    prompt = list(range(1, 65))
+    st = RouterState(Registry(None), None,
+                     {"worker": ["only:1", "other:2"]},
+                     directory=_StubDirectory(64, [
+                         {"backend": "only:1", "tier": "device",
+                          "hotness": 50}]))
+    picks = [st.candidates_for("worker", prompt)[0]
+             for _ in range(2 * REPLICATE_EVERY)]
+    # Most lookups front the holder; every REPLICATE_EVERY-th scores it
+    # as a miss so the (equally loaded) non-holder computes + registers.
+    assert "only:1" in picks and "other:2" in picks
+    assert st.metrics["dir_replications"] == 2
+    # The per-prefix ledger bounds the tax: when the off-holder never
+    # registers the copy (this stub directory never gains a second
+    # holder), replication stops after REPLICATE_MAX_PER_PREFIX routes
+    # instead of deliberately full-prefilling hot traffic forever.
+    from rbg_tpu.engine.router import REPLICATE_MAX_PER_PREFIX
+    for _ in range(10 * REPLICATE_EVERY):
+        st.candidates_for("worker", prompt)
+    assert st.metrics["dir_replications"] == REPLICATE_MAX_PER_PREFIX
+
+
+# ---- predictive early rejection --------------------------------------------
+
+
+def _mk_service(**over):
+    from rbg_tpu.engine.service import EngineService
+    cfg = dict(num_pages=64, early_reject="auto", slo_ttft_s=0.5,
+               early_reject_factor=1.0, **BASE)
+    cfg.update(over)
+    return EngineService(EngineConfig(**cfg))
+
+
+def test_early_reject_sheds_at_ingress_with_retry_hint():
+    from rbg_tpu.engine.protocol import Overloaded
+
+    svc = _mk_service()
+    try:
+        # Force the predictor's inputs: slow measured prefill makes the
+        # prediction exceed the gate before ANY engine work happens.
+        svc._prefill_rate = 10.0               # tokens/s
+        svc._pf_rate_t = time.monotonic()      # fresh, not TTL-expired
+        prompt = _prompts(1, 40, seed=17)[0]   # 40 tok / 10 tps = 4 s
+        pf_before = svc.engine.metrics["prefill_tokens"]
+        with pytest.raises(Overloaded) as ei:
+            svc.submit(prompt, SamplingParams(max_new_tokens=4))
+        assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+        assert svc.counters["early_rejects"] == 1
+        # ZERO prefill steps were spent on the rejected request.
+        assert svc.engine.metrics["prefill_tokens"] == pf_before
+        stats = svc.service_stats()
+        assert stats["early_reject_armed"] is True
+        assert stats["early_rejects"] == 1
+    finally:
+        svc.stop()
+
+
+def test_early_reject_never_sheds_without_rate_history():
+    svc = _mk_service()
+    try:
+        assert svc._prefill_rate is None
+        tokens, _ = svc.submit(_prompts(1, 40, seed=19)[0],
+                               SamplingParams(max_new_tokens=4))
+        assert tokens
+        assert svc.counters["early_rejects"] == 0
+        # A TTL-expired rate is absence of signal too: a stale-slow EMA
+        # (sheds do no prefill, so it could never re-learn) must not
+        # lock the service into rejecting everything forever.
+        svc._prefill_rate = 1.0
+        svc._pf_rate_t = time.monotonic() - 3600.0
+        tokens, _ = svc.submit(_prompts(1, 40, seed=20)[0],
+                               SamplingParams(max_new_tokens=4))
+        assert tokens
+        assert svc.counters["early_rejects"] == 0
+    finally:
+        svc.stop()
+
+
+def test_predicted_ttft_nets_out_prefix_hit():
+    svc = _mk_service()
+    try:
+        prompt = _prompts(1, 40, seed=23)[0]
+        svc.submit(prompt, SamplingParams(max_new_tokens=4))
+        svc._prefill_rate = 100.0
+        svc._pf_rate_t = time.monotonic()
+        cold = svc.predicted_ttft_s(_prompts(1, 40, seed=29)[0], depth=0)
+        warm = svc.predicted_ttft_s(prompt, depth=0)
+        # The served prompt's radix-cached prefix must shrink its
+        # predicted prefill time vs an unseen prompt of equal length.
+        assert warm is not None and cold is not None and warm < cold
+    finally:
+        svc.stop()
+
+
+def test_early_reject_off_by_default():
+    svc = _mk_service(early_reject="off")
+    try:
+        assert svc._early_reject is False
+        svc._prefill_rate = 1.0   # would reject everything if armed
+        tokens, _ = svc.submit(_prompts(1, 40, seed=31)[0],
+                               SamplingParams(max_new_tokens=4))
+        assert tokens
+    finally:
+        svc.stop()
+
+
+# ---- operator surface ------------------------------------------------------
+
+
+def test_slo_response_and_top_render_cache_panel():
+    from rbg_tpu.cli.top import _cache_panel
+    from rbg_tpu.engine.engine import Engine
+    from rbg_tpu.obs.slo import slo_response
+
+    eng = Engine(EngineConfig(num_pages=24, host_tier_bytes=1 << 26,
+                              **BASE))
+    for p in _prompts(4, 40, seed=37):
+        eng.generate([p], SamplingParams(max_new_tokens=4))
+    cache = slo_response(60).get("cache")
+    assert cache and "host" in cache["tiers"] and "device" in cache["tiers"]
+    assert cache["tiers"]["host"]["pages"] is not None
+    lines = _cache_panel(cache)
+    assert any("kv cache" in ln for ln in lines)
+    assert any(ln.strip().startswith("host") for ln in lines)
+    assert any(ln.strip().startswith("device") for ln in lines)

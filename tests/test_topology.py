@@ -43,6 +43,47 @@ def _cfg(**kw) -> TopologyPolicyConfig:
 # ---- policy (pure, injected clock) -----------------------------------------
 
 
+def test_policy_flips_off_live_router_ingress_counters():
+    """The production ratio seam: real rbg_router_ingress_tokens_total
+    counter increments, sampled by the windowed plane, must drive the
+    policy to a flip — no drill-only scripted signals involved."""
+    from rbg_tpu.obs.metrics import Registry
+    from rbg_tpu.obs.timeseries import TimeSeriesSampler
+    from rbg_tpu.topology import router_ingress_signals_fn
+
+    reg = Registry()
+    sampler = TimeSeriesSampler(registry=reg, interval_s=1.0,
+                                retention_s=300.0)
+    fn = router_ingress_signals_fn(sampler, window_s=60.0)
+    # No samples yet → absence of signal, never ratio 0/∞.
+    assert fn(None) == {}
+    reg.inc(names.ROUTER_INGRESS_TOKENS_TOTAL, 100.0, kind="prefill")
+    reg.inc(names.ROUTER_INGRESS_TOKENS_TOTAL, 100.0, kind="decode")
+    sampler.sample_now(now=0.0)
+    # Sustained long-prompt mix: 10:1 prompt:output tokens at ingress —
+    # what a router serving system-prompt-heavy traffic would publish.
+    reg.inc(names.ROUTER_INGRESS_TOKENS_TOTAL, 10000.0, kind="prefill")
+    reg.inc(names.ROUTER_INGRESS_TOKENS_TOTAL, 1000.0, kind="decode")
+    sampler.sample_now(now=10.0)
+    extras = fn(None)
+    assert 9.0 <= extras["prefill_decode_ratio"] <= 11.0
+    # One side idle → no ratio (the controller falls back / HOLDs).
+    reg2 = Registry()
+    s2 = TimeSeriesSampler(registry=reg2, interval_s=1.0, retention_s=300.0)
+    reg2.inc(names.ROUTER_INGRESS_TOKENS_TOTAL, 100.0, kind="prefill")
+    s2.sample_now(now=0.0)
+    reg2.inc(names.ROUTER_INGRESS_TOKENS_TOTAL, 900.0, kind="prefill")
+    s2.sample_now(now=10.0)
+    assert router_ingress_signals_fn(s2, window_s=60.0)(None) == {}
+    # The measured ratio drives a real flip through the policy's own
+    # stabilization machinery.
+    p = TopologyPolicy(_cfg())
+    sig = _sig(ratio=extras["prefill_decode_ratio"])
+    assert p.decide(0.0, sig, POSTURE_UNIFIED).recommendation == REC_HOLD
+    d = p.decide(1.1, sig, POSTURE_UNIFIED)
+    assert d.recommendation == POSTURE_DISAGG
+
+
 def test_policy_stale_holds_and_forgets_onset():
     p = TopologyPolicy(_cfg())
     d = p.decide(0.0, _sig(ratio=10.0), POSTURE_UNIFIED)

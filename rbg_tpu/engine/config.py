@@ -150,13 +150,7 @@ class EngineConfig:
             raise ValueError(
                 "int8 KV is unified-mode only for now (PD bundles carry "
                 "unquantized pages)")
-        mcfg = self.model_config  # also: fail fast on an unknown preset
-        if mcfg.mla and self.kv_dtype == "int8" and self.use_pallas == "always":
-            # The GQA kernel dequantizes; the MLA latent kernel does not
-            # yet — 'always' would otherwise silently fall back to XLA.
-            raise ValueError(
-                "use_pallas='always' with an int8 MLA latent pool: the "
-                "latent kernel does not dequantize yet — use 'auto'")
+        self.model_config  # fail fast on an unknown preset
 
 
 @dataclasses.dataclass

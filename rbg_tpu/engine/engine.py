@@ -581,10 +581,11 @@ class Engine:
     def step(self) -> List[StepEvent]:
         """One scheduler iteration: admit, then either the ragged UNIFIED
         dispatch (prefill chunks + decode steps of the whole batch in one
-        program — continuous batching, no phase split) or the legacy
-        split prefill→decode paths (pure-decode batches always take the
-        fused multi-step scan; cfg.ragged='off', MLA, speculative, and
-        LoRA-mixed batches keep the split paths throughout)."""
+        program — continuous batching, no phase split; MLA rides it via
+        the ragged latent path since round 16) or the legacy split
+        prefill→decode paths (pure-decode batches always take the fused
+        multi-step scan; cfg.ragged='off', speculative, and LoRA-mixed
+        batches keep the split paths throughout)."""
         events: List[StepEvent] = []
         if self._deferred_events:
             events.extend(self._deferred_events)
@@ -785,8 +786,7 @@ class Engine:
         whole batch (prefill chunks + decode steps together). Pure-decode
         batches return False — the fused multi-step scan (zero host syncs
         per window) beats a host-synced ragged step there."""
-        if (self.cfg.ragged == "off" or self.cfg.speculative != "off"
-                or self.mcfg.mla):
+        if self.cfg.ragged == "off" or self.cfg.speculative != "off":
             return False
         if not any(r.state == "prefill" for r in self.running):
             return False
@@ -806,8 +806,13 @@ class Engine:
 
     def _get_ragged_fn(self, R: int, T: int):
         """One jitted ragged forward per (row bucket, packed-token
-        bucket)."""
-        fn = self._ragged_fn_cache.get((R, T))
+        bucket). The cache key carries the kernel's grid revision so a
+        cache warmed for one grid (PR-7 token grid vs the round-16
+        block-ragged tile grid) can never alias programs compiled for
+        the other."""
+        from rbg_tpu.ops.pallas.ragged_attention_kernel import \
+            RAGGED_GRID_REV
+        fn = self._ragged_fn_cache.get((R, T, RAGGED_GRID_REV))
         if fn is None:
             import functools
             base = functools.partial(forward_ragged, cfg=self.mcfg,
@@ -825,7 +830,7 @@ class Engine:
 
             donate = (7, 8, 9, 10) if self.cache.quantized else (7, 8)
             fn = jax.jit(wrapped, donate_argnums=donate)
-            self._ragged_fn_cache[(R, T)] = fn
+            self._ragged_fn_cache[(R, T, RAGGED_GRID_REV)] = fn
         return fn
 
     def warm_ragged(self) -> int:
@@ -840,7 +845,7 @@ class Engine:
         thread's single-writer discipline. Returns the number of
         programs compiled."""
         if (self.cfg.ragged == "off" or self.cfg.speculative != "off"
-                or self.mcfg.mla or self.cfg.mode == "decode"):
+                or self.cfg.mode == "decode"):
             return 0
         P = self.cfg.max_pages_per_seq
         n = 0

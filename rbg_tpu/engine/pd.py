@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -352,7 +353,14 @@ class PrefillWorker:
         slabs: List = []
 
         def publish_final_pages(r: Request) -> None:
-            done = min(r.prefill_pos // ps, meta.n_pages)
+            # Hold the LAST page group for the post-token tail: the final
+            # page finalizes with the final prefill chunk (same instant
+            # the first token's logits exist), and exporting it here
+            # would queue its bytes AHEAD of StreamFirstToken — on an
+            # in-order link that serializes every admission (which needs
+            # the token) behind the full transfer, closing the
+            # layer-sliced window for page-aligned prompts.
+            done = min(r.prefill_pos // ps, meta.n_pages - 1)
             if done <= exported[0]:
                 return
             k, v = self._export_pages(r, exported[0], done)
@@ -372,8 +380,14 @@ class PrefillWorker:
             q.put(None)    # structured abort to the receiver
             raise
         res.first_token = first
+        # First token the moment compute ends — BEFORE the tail pages'
+        # payload (the StreamFirstToken contract in kvtransfer.chunks).
+        # Admission needs (coverage AND first token); queuing the token
+        # behind the last chunk slab would serialize layer-sliced
+        # admission behind the full transfer on any in-order link.
+        q.put(StreamFirstToken(sid, first))
         # Remaining pages (the last prefill chunk's, incl. a partial
-        # final page), then the first token, then FIN.
+        # final page), then FIN.
         if exported[0] < meta.n_pages:
             k, v = self._export_pages(req, exported[0], meta.n_pages)
             if publishing:
@@ -383,7 +397,6 @@ class PrefillWorker:
                 q.put(ch)
                 seq[0] += 1
             exported[0] = meta.n_pages
-        q.put(StreamFirstToken(sid, first))
         q.put(StreamFin(sid, n_chunks=seq[0]))
         # Pool/directory publish wants the page-aligned prefix —
         # assembled from the slabs already exported for the stream.
@@ -402,13 +415,23 @@ class _StreamCommit:
     """Loop-thread bookkeeping for one in-flight inbound stream: the
     allocated pages and which staged cells already hit the device."""
 
-    __slots__ = ("receiver", "pages", "committed", "t_first_commit")
+    __slots__ = ("receiver", "pages", "committed", "t_first_commit",
+                 "committed_map", "dispatched_layers", "admitted")
 
     def __init__(self, receiver):
         self.receiver = receiver
         self.pages: Optional[List[int]] = None
         self.committed = 0
         self.t_first_commit: Optional[float] = None
+        # Layer-sliced admission state: which (layer, page) cells hit the
+        # DEVICE (the dispatch watermark source), how many leading layers
+        # the window chain already attended (commits below this are
+        # clipped — a retransmitted slab must not zero the decode-token
+        # KV the window pass wrote), and whether the row was admitted
+        # (page ownership moved to the request).
+        self.committed_map = None          # np.bool_ [L, n_pages]
+        self.dispatched_layers = 0
+        self.admitted = False
 
 
 class DecodeWorker:
@@ -429,6 +452,10 @@ class DecodeWorker:
         # release its pages instead of holding KV capacity forever.
         self._stream_commits: Dict[str, _StreamCommit] = {}
         self.stream_ttl_s = 120.0
+        # Layer-sliced admission: jitted forward_paged_window programs
+        # keyed (layer_lo, layer_hi, B) and the per-bucket LM head.
+        self._window_fns: Dict = {}
+        self._head_fns: Dict = {}
 
     # ---- shared commit primitive ----
 
@@ -562,8 +589,11 @@ class DecodeWorker:
                 rx.fail("stream expired unconsumed (TTL)")
             if rx.error() is not None:
                 # Structured failure: recycle any pages; the waiter (the
-                # decode_stream handler) surfaces the error.
-                if sc.pages is not None:
+                # decode_stream handler) surfaces the error. An ADMITTED
+                # row's pages belong to the request (the layer-sliced
+                # window chain cancels it and releases them there) —
+                # releasing here too would double-free the page ids.
+                if sc.pages is not None and not sc.admitted:
                     eng.allocator.release(sc.pages)
                 del self._stream_commits[sid]
                 self.metrics["stream_errors"] += 1
@@ -594,15 +624,29 @@ class DecodeWorker:
         eng = self.engine
         if sc.t_first_commit is None:
             sc.t_first_commit = time.perf_counter()
+        if sc.committed_map is None:
+            sc.committed_map = np.zeros((a.meta.layers, a.meta.n_pages),
+                                        bool)
         with trace.child(obs_names.SPAN_KVT_COMMIT,
                          stream_id=rx.stream_id, cells=len(cells)):
             for (llo, lhi, plo, phi) in cells:
+                # Clip below the dispatch watermark: layers the window
+                # chain already attended carry the decode token's KV at
+                # slot len(prompt) — a lossy link's retransmitted slab
+                # (re-staged by the assembler on partial overlap) must not
+                # zero it. Everything below the watermark is on device
+                # already (dispatch REQUIRES the watermark), so skipping
+                # is lossless.
+                llo = max(llo, sc.dispatched_layers)
+                if llo >= lhi:
+                    continue
                 ids = jnp.asarray(sc.pages[plo:phi], jnp.int32)
                 k_dev = jnp.asarray(a.k[llo:lhi, plo:phi],
                                     eng.cache.k_pages.dtype)
                 v_dev = jnp.asarray(a.v[llo:lhi, plo:phi],
                                     eng.cache.v_pages.dtype)
                 self._commit_pages(ids, k_dev, v_dev, llo, lhi)
+                sc.committed_map[llo:lhi, plo:phi] = True
                 self.metrics["stream_commits"] += 1
         return len(cells)
 
@@ -630,6 +674,15 @@ class DecodeWorker:
             # pump already allocated for it before failing the request.
             self.abandon_stream(rx)
             raise
+        if rx.t_first_step is None:
+            # Decode stopped waiting on the transfer plane here: the
+            # admission decision is made and everything after (page
+            # flush, inject scatter, the first step) is engine cost, not
+            # plane wait. Stamping at the decision — not when the first
+            # step's events surface — keeps the kv_stream_overlap
+            # comparison honest when FIN rides the same link flush as
+            # the final data chunk.
+            rx.t_first_step = time.monotonic()
         self.begin_stream(rx)
         sc = self._stream_commits[rx.stream_id]
         if sc.pages is None:
@@ -657,6 +710,276 @@ class DecodeWorker:
         REGISTRY.inc(obs_names.KVT_BYTES_TOTAL, float(a.bytes_seen),
                      direction="recv", transport="stream")
         return rid
+
+    # ---- layer-sliced admission (engine loop thread only) ----
+
+    def _device_layer_coverage(self, sc: _StreamCommit) -> int:
+        """Leading layers whose every page cell hit the DEVICE — the
+        dispatch watermark (host assembly coverage is necessary but not
+        sufficient: the window must attend committed pages)."""
+        m = sc.committed_map
+        if m is None:
+            return 0
+        return int(np.cumprod(m.all(axis=1)).sum())
+
+    def _get_window_fn(self, lo: int, hi: int, B: int):
+        """Jitted layer-window forward, cached per (layer_lo, layer_hi,
+        bucket). Pools are donated: each window consumes the pool snapshot
+        it was handed and returns the next one."""
+        key = (lo, hi, B)
+        fn = self._window_fns.get(key)
+        if fn is None:
+            import functools
+
+            from rbg_tpu.models.llama import forward_paged_window
+            eng = self.engine
+            base = functools.partial(forward_paged_window, eng.params,
+                                     eng.mcfg, lo, hi,
+                                     use_pallas=eng.cfg.use_pallas)
+
+            def window(x, pos, mask, kvl, table, k_pages, v_pages,
+                       k_scales, v_scales):
+                return base(x, pos, mask, kvl, table, k_pages, v_pages,
+                            k_scales=k_scales, v_scales=v_scales)
+
+            donate = (5, 6, 7, 8) if eng.cache.quantized else (5, 6)
+            fn = jax.jit(window, donate_argnums=donate)
+            self._window_fns[key] = fn
+        return fn
+
+    def _get_head_fn(self, B: int):
+        fn = self._head_fns.get(B)
+        if fn is None:
+            from rbg_tpu.models.llama import _head
+            eng = self.engine
+            fn = jax.jit(lambda x: _head(eng.params, eng.mcfg, x))
+            self._head_fns[B] = fn
+        return fn
+
+    def _wait_layer_watermark(self, sc: _StreamCommit, hi: int,
+                              deadline: Optional[float]) -> None:
+        """Block (pumping commits) until the first ``hi`` layers are fully
+        on device. A layer missing its watermark degrades to waiting — the
+        same wait the full-coverage path would pay — bounded by
+        ``deadline`` and the receiver's error state, never a wedge."""
+        rx = sc.receiver
+        while self._device_layer_coverage(sc) < hi:
+            if rx.error() is not None:
+                raise StreamError(rx.error())
+            self.pump_streams()
+            if self._device_layer_coverage(sc) >= hi:
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                raise StreamError(
+                    f"stream {rx.stream_id}: layer watermark {hi} not "
+                    f"reached before deadline (device coverage "
+                    f"{self._device_layer_coverage(sc)})")
+            time.sleep(0.0002)
+
+    def finalize_stream_layer_sliced(self, receiver,
+                                     sampling: Optional[SamplingParams]
+                                     = None,
+                                     min_layers: int = 1,
+                                     deadline: Optional[float] = None
+                                     ) -> int:
+        """Admit a stream at layer-``min_layers`` coverage — BEFORE the
+        tail layers land — and run the first decode step as a chain of
+        layer-windowed forward passes, each dispatched the moment its
+        layers' pages are on device. The decode step overlaps the
+        transfer tail instead of waiting it out (the TTFD cut on top of
+        chunk-streamed admission). Loop thread only.
+
+        The chain reproduces the fused decode program's first iteration
+        exactly: same padded bucket, same write mask, same key schedule
+        (fold_in(row_key, seq_len + 1)), same grammar-mask/penalty/sampler
+        composition — its emitted token is bit-identical to the token the
+        fused path would have produced, and the KV it writes at slot
+        ``len(prompt)`` is the KV the fused path would have written.
+        Subsequent tokens ride the normal fused path."""
+        sampling = sampling or SamplingParams()
+        eng = self.engine
+        rx = receiver
+        if rx.error() is not None:
+            raise StreamError(rx.error())
+        if sampling.lora is not None:
+            # The layer-window forward has no adapter path (same exclusion
+            # as the unified step) — callers route lora rows to the
+            # full-coverage wait.
+            raise StreamError(
+                "layer-sliced admission does not support lora requests")
+        a = rx.assembler
+        if a is None or not a.ready_layers(min_layers):
+            raise StreamError(
+                f"stream {rx.stream_id} not layer-ready at layer-sliced "
+                f"finalize (need {min_layers} layers)")
+        prompt = list(a.meta.prompt)
+        try:
+            eng._check_prompt(prompt)
+            eng._grammar_check(sampling)
+        except Exception:
+            self.abandon_stream(rx)
+            raise
+        self.begin_stream(rx)
+        sid = rx.stream_id
+        sc = self._stream_commits[sid]
+        if sc.pages is None:
+            need = pages_for_tokens(len(prompt) + 1, eng.cfg.page_size)
+            sc.pages = eng._alloc(need)
+            if sc.pages is None:
+                del self._stream_commits[sid]
+                raise StreamError("decode engine out of KV pages")
+        cells = rx.drain_uncommitted()
+        if cells:
+            self._commit_cells(sc, cells)
+        pages = sc.pages
+        try:
+            rid = self._admit_row(prompt, int(a.first_token), pages,
+                                  sampling)
+        except Exception:
+            eng.allocator.release(pages)
+            del self._stream_commits[sid]
+            raise
+        # Page ownership moved to the request — a later stream error must
+        # not release them a second time (pump_streams checks this flag).
+        sc.admitted = True
+        layers_at_admit = a.layer_coverage()
+        rx.layers_at_admit = layers_at_admit
+        rx.total_layers = int(a.meta.layers)
+        self.metrics["streams_in"] += 1
+        self.metrics["bytes_in"] += a.bytes_seen
+        REGISTRY.inc(obs_names.KVT_BYTES_TOTAL, float(a.bytes_seen),
+                     direction="recv", transport="stream")
+        REGISTRY.inc(obs_names.KVT_LAYER_ADMIT_TOTAL)
+        REGISTRY.observe(obs_names.KVT_LAYER_ADMIT_COVERAGE_LAYERS,
+                         float(layers_at_admit))
+        req = eng.requests.get(rid)
+        if req is None or req.state != "running":
+            # Finished at inject (max_new_tokens == 1 / stop token): its
+            # pages already recycled — stop committing into them NOW.
+            del self._stream_commits[sid]
+            return rid
+        try:
+            # The chain IS the row's first decode step — stamp it here
+            # (before FIN can land) so overlap accounting credits the
+            # decode work started under the transfer tail.
+            receiver.t_first_step = time.monotonic()
+            with trace.child(obs_names.SPAN_PD_LAYER_SLICED_STEP,
+                             stream_id=sid,
+                             layers_at_admit=layers_at_admit):
+                self._layer_sliced_first_step(sc, req, min_layers,
+                                              deadline)
+        except BaseException:
+            self._stream_commits.pop(sid, None)
+            eng.cancel_request(rid)
+            raise
+        # Every layer is dispatched (and therefore committed) — the only
+        # frames still in flight are duplicates/FIN; drop the watch.
+        del self._stream_commits[sid]
+        return rid
+
+    def _layer_sliced_first_step(self, sc: _StreamCommit, req,
+                                 min_layers: int,
+                                 deadline: Optional[float]) -> None:
+        """The layer-windowed decode step for a just-admitted row: embed →
+        [wait watermark → window forward] per layer window → head →
+        sample → emit (deferred). Mirrors the fused program's first
+        iteration; see ``finalize_stream_layer_sliced``."""
+        from rbg_tpu.engine.sampler import NEG_INF, row_keys, step_keys
+        eng = self.engine
+        L = int(eng.cache.k_pages.shape[0])
+        win = max(1, int(min_layers))
+        B = eng._bucket(1)
+        P = eng.cfg.max_pages_per_seq
+        tok = np.zeros(B, np.int32)
+        pos = np.zeros(B, np.int32)
+        kvl = np.zeros(B, np.int32)
+        mask = np.zeros((B, 1), bool)
+        limit = np.zeros(B, np.int32)
+        table = np.zeros((B, P), np.int32)
+        tok[0] = req.last_token
+        pos[0] = req.seq_len
+        kvl[0] = req.seq_len + 1
+        mask[0, 0] = True
+        limit[0] = req.max_len()
+        table[0, :len(req.pages)] = req.pages
+        temps, ks, tps, mps, seeds, rids, pen, lp, tpmp = \
+            eng._sampling_rows([req], B)
+        write_ok = jnp.asarray(mask & (pos < limit)[:, None])  # [B, 1]
+        pos_d = jnp.asarray(pos)
+        kvl_d = jnp.asarray(kvl)
+        table_d = jnp.asarray(table)
+        # Embedding gather + cast — pure data movement, bit-exact whether
+        # traced or eager, so it can live outside the window programs.
+        x = eng.params["embed"].astype(eng.mcfg.jax_dtype)[
+            jnp.asarray(tok)[:, None]]                         # [B, 1, D]
+        for lo in range(0, L, win):
+            hi = min(lo + win, L)
+            self._wait_layer_watermark(sc, hi, deadline)
+            fn = self._get_window_fn(lo, hi, B)
+            cache = eng.cache
+            x, kp, vp, ksc, vsc = fn(x, pos_d[:, None], write_ok, kvl_d,
+                                     table_d, cache.k_pages,
+                                     cache.v_pages, cache.k_scales,
+                                     cache.v_scales)
+            with self._commit_lock:
+                eng.cache = PagedKVCache(k_pages=kp, v_pages=vp,
+                                         k_scales=ksc, v_scales=vsc)
+            sc.dispatched_layers = hi
+        lg = self._get_head_fn(B)(x)[:, 0, :]                  # [B, V]
+        if req.gstate is not None:
+            # Grammar mask before sampling — the host-synced path's exact
+            # order (penalties apply inside sample()).
+            gm = np.ones((B, eng.mcfg.vocab_size), bool)
+            gm[0] = eng._gmask(req.grammar, req.gstate)
+            lg = jnp.where(jnp.asarray(gm), lg, NEG_INF)
+        # Key by the OUTPUT position (seq_len + 1) — the fused program's
+        # key schedule for the first decode token.
+        keys = step_keys(row_keys(seeds, eng._sample_base, rids),
+                         jnp.asarray(pos + 1))
+        args = [lg, keys, jnp.asarray(temps), jnp.asarray(ks),
+                jnp.asarray(tps), jnp.asarray(mps)]
+        if pen:
+            pmask, oc, rep, pres, freq = eng._penalty_rows([req], B)
+            np.add.at(oc[0], np.asarray(req.output, np.int64), 1)
+            args += [pmask, jnp.asarray(oc), rep, pres, freq]
+        toks, lps = eng._get_sampler(pen, lp, tpmp)(*args)
+        tok_out = int(np.asarray(toks)[0])
+        lp_val = (float(np.asarray(lps)[0])
+                  if lps is not None and req.sampling.logprobs else None)
+        req.seq_len += 1
+        eng.metrics["decode_tokens"] += 1
+        # Deferred emission: the event surfaces from the engine's next
+        # step() drain, exactly like a unified-step decode token.
+        eng._deferred_events.append(eng._emit(req, tok_out, lp_val))
+
+    def warm_layer_sliced(self, min_layers: int) -> float:
+        """Compile the layer-window chain (window programs, head, default
+        sampler) before traffic — all writes masked off, so the live pool
+        round-trips unchanged through the donated calls."""
+        eng = self.engine
+        t0 = time.perf_counter()
+        L = int(eng.cache.k_pages.shape[0])
+        win = max(1, int(min_layers))
+        B = eng._bucket(1)
+        P = eng.cfg.max_pages_per_seq
+        x = eng.params["embed"].astype(eng.mcfg.jax_dtype)[
+            jnp.zeros((B, 1), jnp.int32)]
+        pos = jnp.zeros((B, 1), jnp.int32)
+        mask = jnp.zeros((B, 1), bool)
+        kvl = jnp.zeros(B, jnp.int32)
+        table = jnp.zeros((B, P), jnp.int32)
+        for lo in range(0, L, win):
+            hi = min(lo + win, L)
+            fn = self._get_window_fn(lo, hi, B)
+            cache = eng.cache
+            x, kp, vp, ksc, vsc = fn(x, pos, mask, kvl, table,
+                                     cache.k_pages, cache.v_pages,
+                                     cache.k_scales, cache.v_scales)
+            with self._commit_lock:
+                eng.cache = PagedKVCache(k_pages=kp, v_pages=vp,
+                                         k_scales=ksc, v_scales=vsc)
+        self._get_head_fn(B)(x).block_until_ready()
+        return time.perf_counter() - t0
 
     def abandon_stream(self, receiver) -> None:
         """Drop a watched stream (deadline/cancel before admission) —
@@ -706,7 +1029,8 @@ class PDStreamPair:
     the whole-bundle baseline measured over the identical link."""
 
     def __init__(self, cfg: EngineConfig, params: Optional[dict] = None,
-                 mesh=None, transport=None, layer_split: int = 0):
+                 mesh=None, transport=None, layer_split: int = 0,
+                 admit_layers: int = 0):
         from rbg_tpu.kvtransfer.transport import InProcTransport
 
         self.prefill = PrefillWorker(cfg, params=params, mesh=mesh)
@@ -714,6 +1038,12 @@ class PDStreamPair:
                                    mesh=mesh)
         self.transport = transport or InProcTransport()
         self.layer_split = layer_split
+        # > 0: admit at layer-k coverage and run the first decode step as
+        # a layer-windowed chain overlapping the transfer tail. Only
+        # effective with a layer_split fine enough to stream layers
+        # separately (layer_split == 0 sends all layers per chunk — there
+        # is no tail to overlap).
+        self.admit_layers = int(admit_layers)
 
     def generate_one(self, prompt: List[int],
                      sampling: Optional[SamplingParams] = None,
@@ -764,6 +1094,23 @@ class PDStreamPair:
                     self.decode.abandon_stream(rx)
                     break
                 self.decode.pump_streams()
+                if (self.admit_layers > 0 and stream
+                        and sampling.lora is None and not rx.ready()
+                        and rx.ready_layers(self.admit_layers)):
+                    # Layer-sliced early admission: layer-k coverage is in
+                    # but full coverage is not — start decoding under the
+                    # transfer tail. (Full coverage already in: the plain
+                    # finalize below is strictly cheaper.) A mid-chain
+                    # stream failure cancels the row before any token is
+                    # emitted, so falling into the retry loop stays
+                    # token-exact.
+                    try:
+                        rid = self.decode.finalize_stream_layer_sliced(
+                            rx, sampling, min_layers=self.admit_layers,
+                            deadline=deadline)
+                    except StreamError as e:
+                        last_err = str(e)
+                    break
                 if rx.ready() and (stream or rx.t_fin is not None):
                     rid = self.decode.finalize_stream(rx, sampling)
                     break
@@ -781,7 +1128,10 @@ class PDStreamPair:
                     if ev.request_id == rid:
                         if t_first_decode is None:
                             t_first_decode = time.perf_counter() - t0
-                            rx.t_first_step = time.monotonic()
+                            if rx.t_first_step is None:
+                                # Layer-sliced rows stamped this at the
+                                # window chain's start already.
+                                rx.t_first_step = time.monotonic()
                         tokens.append(ev.token)
             rx_thread.join(timeout=recv_timeout)
             return {"tokens": tokens, "t_first_decode": t_first_decode,
@@ -793,6 +1143,8 @@ class PDStreamPair:
                                 and rx.t_fin is not None
                                 and rx.t_first_step < rx.t_fin),
                     "retries": attempt, "stream_id": sid,
+                    "layers_at_admit": rx.layers_at_admit,
+                    "total_layers": rx.total_layers,
                     "bytes": rx.assembler.bytes_seen if rx.assembler
                     else 0}
         raise StreamError(

@@ -226,10 +226,27 @@ class ChunkAssembler:
     def coverage_complete(self) -> bool:
         return bool(self._have.all())
 
+    def layer_coverage(self) -> int:
+        """Number of LEADING layers with every page cell covered — the
+        layer-sliced admission watermark. Chunks are published layer-
+        ordered within a page group (``plan_chunks``), so on an in-order
+        link this grows monotonically front-to-back; on a lossy/reordered
+        link it is simply the honest prefix."""
+        full = self._have.all(axis=1)           # [L]
+        return int(np.cumprod(full).sum())
+
     def ready(self) -> bool:
         """Admission predicate: full coverage + the prefill-sampled first
         token. Deliberately independent of FIN."""
         return self.coverage_complete() and self.first_token is not None
+
+    def ready_layers(self, min_layers: int) -> bool:
+        """Layer-sliced admission predicate: the first ``min_layers``
+        layers fully covered + the first token — the Mooncake-style
+        layer-ordered arrival finally pays off (decode's layer 0 can
+        start while layer L-1 is still on the wire)."""
+        return (self.first_token is not None
+                and self.layer_coverage() >= min_layers)
 
     def drain_uncommitted(self) -> List[Tuple[int, int, int, int]]:
         out, self._uncommitted = self._uncommitted, []

@@ -40,6 +40,12 @@ class KVStreamReceiver:
         self.t_ready: Optional[float] = None   # coverage+first_token time
         self.t_fin: Optional[float] = None     # stream-close time
         self.t_first_step: Optional[float] = None  # stamped by the decoder
+        # Layer-sliced admission bookkeeping: the decode worker stamps
+        # these when it admits at layer-k coverage (before full ready).
+        self.t_layer_ready: Optional[float] = None  # min_layers reached
+        self.layers_at_admit: Optional[int] = None  # coverage at admit
+        self.total_layers: Optional[int] = None
+        self._min_layers: int = 0          # guarded_by[kvtransfer.receiver]
 
     # -- producer side (transport / connection threads) --
 
@@ -64,8 +70,18 @@ class KVStreamReceiver:
                 self._error = str(e)
             a = self.assembler
             if a is not None:
+                if (self.t_layer_ready is None and self._min_layers > 0
+                        and a.ready_layers(self._min_layers)):
+                    self.t_layer_ready = time.monotonic()
                 if self.t_ready is None and a.ready():
                     self.t_ready = time.monotonic()
+                    if self.t_layer_ready is not None:
+                        # The overlap the layer-sliced admission created:
+                        # how long before FULL coverage the decode side
+                        # could already start.
+                        REGISTRY.observe(
+                            obs_names.KVT_LAYER_ADMIT_LEAD_SECONDS,
+                            max(0.0, self.t_ready - self.t_layer_ready))
                 if a.fin is not None and self.t_fin is None:
                     self.t_fin = time.monotonic()
                     # An abort AFTER coverage is complete is harmless —
@@ -113,17 +129,48 @@ class KVStreamReceiver:
             return (self._error is None and self.assembler is not None
                     and self.assembler.ready())
 
-    def wait_ready(self, timeout: float) -> "ChunkAssembler":
-        """Block until admission coverage or failure. Returns the
+    def ready_layers(self, min_layers: int) -> bool:
+        """Layer-sliced readiness: first ``min_layers`` layers fully
+        covered + first token (also registers the watermark so feed()
+        stamps ``t_layer_ready`` the moment it is crossed)."""
+        with self._cond:
+            if min_layers > self._min_layers:
+                self._min_layers = min_layers
+            a = self.assembler
+            ok = (self._error is None and a is not None
+                  and a.ready_layers(min_layers))
+            if ok and self.t_layer_ready is None:
+                self.t_layer_ready = time.monotonic()
+            return ok
+
+    def layer_coverage(self) -> int:
+        with self._cond:
+            a = self.assembler
+            return 0 if a is None else a.layer_coverage()
+
+    def wait_ready(self, timeout: float,
+                   min_layers: int = 0) -> "ChunkAssembler":
+        """Block until admission coverage or failure. With ``min_layers``
+        > 0, returns as soon as the FIRST ``min_layers`` layers are fully
+        covered (+ first token) — the layer-sliced admission entry; the
+        caller must then verify per-layer watermarks before each dispatch
+        and fall back to a full-coverage wait on a miss. Returns the
         assembler; raises StreamError on abort/truncation/timeout."""
         deadline = time.monotonic() + timeout
         with self._cond:
+            if min_layers > self._min_layers:
+                self._min_layers = min_layers
             while True:
                 if self._error is not None:
                     raise StreamError(self._error)
                 a = self.assembler
-                if a is not None and a.ready():
-                    return a
+                if a is not None:
+                    if min_layers > 0 and a.ready_layers(min_layers):
+                        if self.t_layer_ready is None:
+                            self.t_layer_ready = time.monotonic()
+                        return a
+                    if a.ready():
+                        return a
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise StreamError(

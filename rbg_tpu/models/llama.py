@@ -435,6 +435,83 @@ def forward_paged(
     return _head(params, cfg, x), k_pages, v_pages, k_scales, v_scales
 
 
+def forward_paged_window(
+    params: dict,
+    cfg: ModelConfig,
+    layer_lo: int,              # static — first layer of the window
+    layer_hi: int,              # static — one past the last layer
+    x: jnp.ndarray,             # [B, T, D] hidden states ENTERING layer_lo
+    positions: jnp.ndarray,     # [B, T] int32 absolute positions
+    token_mask: jnp.ndarray,    # [B, T] bool — real (non-pad) tokens
+    kv_lens: jnp.ndarray,       # [B] int32 — cache length AFTER this step
+    page_table: jnp.ndarray,    # [B, P] int32 physical page ids
+    k_pages: jnp.ndarray,       # [L, NP, page, KV, hd] — FULL pool
+    v_pages: jnp.ndarray,
+    use_pallas: str = "auto",
+    k_scales: Optional[jnp.ndarray] = None,
+    v_scales: Optional[jnp.ndarray] = None,
+):
+    """One LAYER WINDOW of ``forward_paged``: run layers
+    ``[layer_lo, layer_hi)`` over hidden states, writing/attending only
+    those layers' pages. The layer-sliced decode admission path
+    (kvtransfer) chains these windows so the first decode step can start
+    as soon as the leading layers' KV has arrived, overlapping compute
+    with the transfer tail; the caller embeds tokens before window 0 and
+    applies ``_head`` after the last window.
+
+    Same per-layer math as ``forward_paged``'s scan body (the window of
+    size L is exactly the full forward), so a chain covering every layer
+    reproduces the unified step's numerics. Returns
+    (x, k_pages, v_pages, k_scales, v_scales) with the FULL pool
+    (untouched layers pass through)."""
+    from rbg_tpu.ops.paged_attention import paged_attention, write_kv_pages
+
+    quantized = k_scales is not None
+    L_, NP = k_pages.shape[0], k_pages.shape[1]
+    flat = lambda p: p.reshape((L_ * NP,) + p.shape[2:])
+    kpf, vpf = flat(k_pages), flat(v_pages)
+    ksf = flat(k_scales) if quantized else None
+    vsf = flat(v_scales) if quantized else None
+
+    def step(carry, xs):
+        hcur, kpf, vpf, ksf, vsf = carry
+        blk, li = xs
+        table = page_table + li * NP
+        if cfg.mla:
+            from rbg_tpu.ops.mla_attention import paged_mla_attention
+            q_lat, q_pe, c, k_pe = _mla_qkv(cfg, blk, hcur, positions)
+            kpf, vpf, ksf, vsf = write_kv_pages(
+                kpf, vpf, c[:, :, None, :], k_pe[:, :, None, :], table,
+                positions, token_mask, ksf, vsf)
+            attn_lat = paged_mla_attention(q_lat, q_pe, kpf, vpf, table,
+                                           positions, kv_lens,
+                                           _mla_scale(cfg),
+                                           use_pallas=use_pallas,
+                                           c_scales=ksf, pe_scales=vsf)
+            attn = _mla_out(cfg, blk, attn_lat)
+        else:
+            q, k, vv = _qkv(cfg, blk, hcur, positions)
+            kpf, vpf, ksf, vsf = write_kv_pages(kpf, vpf, k, vv, table,
+                                                positions, token_mask,
+                                                ksf, vsf)
+            attn = paged_attention(q, kpf, vpf, table, positions, kv_lens,
+                                   use_pallas=use_pallas, k_scales=ksf,
+                                   v_scales=vsf)
+        out = _post_attention(cfg, blk, hcur, attn)
+        return (out, kpf, vpf, ksf, vsf), None
+
+    window = jax.tree_util.tree_map(lambda a: a[layer_lo:layer_hi],
+                                    params["blocks"])
+    (x, kpf, vpf, ksf, vsf), _ = jax.lax.scan(
+        step, (x, kpf, vpf, ksf, vsf),
+        (window, jnp.arange(layer_lo, layer_hi, dtype=jnp.int32)))
+    k_pages, v_pages = kpf.reshape(k_pages.shape), vpf.reshape(v_pages.shape)
+    if quantized:
+        k_scales = ksf.reshape(k_scales.shape)
+        v_scales = vsf.reshape(v_scales.shape)
+    return x, k_pages, v_pages, k_scales, v_scales
+
+
 def forward_ragged(
     params: dict,
     cfg: ModelConfig,
@@ -458,20 +535,18 @@ def forward_ragged(
     table line / kv length). Everything token-pointwise (norms, projections,
     RoPE, MLP, head) is shape-agnostic and reuses the ``forward_paged``
     building blocks verbatim — only the KV scatter and the attention need
-    the ragged metadata. GQA only: the MLA latent path keeps the split
-    programs (engine gates on ``cfg.mla``); multi-LoRA rows are likewise
-    gated out by the engine (``lora_delta`` gathers adapters per batch ROW,
-    and the packed batch axis is 1).
+    the ragged metadata. MLA rides the same pack: the latent write reuses
+    ``write_kv_pages_ragged`` on the (c, k_pe) pair and the attention goes
+    through ``ragged_paged_mla_attention`` (round 16 — MLA configs get the
+    continuous-batching wins). Multi-LoRA rows stay gated out by the engine
+    (``lora_delta`` gathers adapters per batch ROW, and the packed batch
+    axis is 1).
 
     Returns (logits [1, T, V] f32, k_pages, v_pages, k_scales, v_scales).
     """
+    from rbg_tpu.ops.mla_attention import ragged_paged_mla_attention
     from rbg_tpu.ops.ragged_paged_attention import (ragged_paged_attention,
                                                     write_kv_pages_ragged)
-
-    if cfg.mla:
-        raise NotImplementedError(
-            "forward_ragged is GQA-only; MLA serves via the split "
-            "prefill/decode programs")
 
     x = params["embed"].astype(cfg.jax_dtype)[tokens]
     quantized = k_scales is not None
@@ -488,15 +563,26 @@ def forward_ragged(
         hcur, kpf, vpf, ksf, vsf = carry
         blk, li = xs
         table = page_table + li * NP
-        q, k, vv = _qkv(cfg, blk, hcur, positions)
-        kpf, vpf, ksf, vsf = write_kv_pages_ragged(
-            kpf, vpf, k, vv, table, row_ids, positions, token_mask,
-            ksf, vsf)
-        attn = ragged_paged_attention(q, kpf, vpf, table, positions,
-                                      kv_lens, row_ids,
-                                      use_pallas=use_pallas,
-                                      k_scales=ksf, v_scales=vsf,
-                                      max_q_len=max_q_len)
+        if cfg.mla:
+            q_lat, q_pe, c, k_pe = _mla_qkv(cfg, blk, hcur, positions)
+            kpf, vpf, ksf, vsf = write_kv_pages_ragged(
+                kpf, vpf, c[:, :, None, :], k_pe[:, :, None, :], table,
+                row_ids, positions, token_mask, ksf, vsf)
+            attn_lat = ragged_paged_mla_attention(
+                q_lat, q_pe, kpf, vpf, table, positions, kv_lens, row_ids,
+                _mla_scale(cfg), use_pallas=use_pallas, c_scales=ksf,
+                pe_scales=vsf, max_q_len=max_q_len)
+            attn = _mla_out(cfg, blk, attn_lat)
+        else:
+            q, k, vv = _qkv(cfg, blk, hcur, positions)
+            kpf, vpf, ksf, vsf = write_kv_pages_ragged(
+                kpf, vpf, k, vv, table, row_ids, positions, token_mask,
+                ksf, vsf)
+            attn = ragged_paged_attention(q, kpf, vpf, table, positions,
+                                          kv_lens, row_ids,
+                                          use_pallas=use_pallas,
+                                          k_scales=ksf, v_scales=vsf,
+                                          max_q_len=max_q_len)
         out = _post_attention(cfg, blk, hcur, attn)
         return (out, kpf, vpf, ksf, vsf), None
 

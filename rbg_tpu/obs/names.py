@@ -57,6 +57,7 @@ AUTOSCALE_SPARE_GRANTS_TOTAL = "rbg_autoscale_spare_grants_total"
 KVT_CHUNKS_TOTAL = "rbg_kvtransfer_chunks_total"
 KVT_BYTES_TOTAL = "rbg_kvtransfer_bytes_total"
 KVT_STREAMS_TOTAL = "rbg_kvtransfer_streams_total"
+KVT_LAYER_ADMIT_TOTAL = "rbg_kvtransfer_layer_admit_total"
 KVT_DIR_LOOKUPS_TOTAL = "rbg_kvtransfer_dir_lookups_total"
 KVT_DIR_INVALIDATIONS_TOTAL = "rbg_kvtransfer_dir_invalidations_total"
 WORKQUEUE_ADDS_TOTAL = "rbg_workqueue_adds_total"
@@ -118,6 +119,9 @@ SLO_TTFT_SECONDS = "rbg_slo_ttft_seconds"
 SLO_TPOT_SECONDS = "rbg_slo_tpot_seconds"
 PD_LOCK_HOLD_SECONDS = "rbg_pd_lock_hold_seconds"
 KVT_ADMIT_LEAD_SECONDS = "rbg_kvtransfer_admit_lead_seconds"
+KVT_LAYER_ADMIT_LEAD_SECONDS = "rbg_kvtransfer_layer_admit_lead_seconds"
+KVT_LAYER_ADMIT_COVERAGE_LAYERS = (
+    "rbg_kvtransfer_layer_admit_coverage_layers")
 WORKQUEUE_QUEUE_AGE_SECONDS = "rbg_workqueue_queue_age_seconds"
 WATCH_DISPATCH_SECONDS = "rbg_watch_dispatch_seconds"
 SCHED_FEASIBILITY_SCAN_SECONDS = "rbg_sched_feasibility_scan_seconds"
@@ -161,6 +165,7 @@ COUNTERS = frozenset({
     KVT_CHUNKS_TOTAL,
     KVT_BYTES_TOTAL,
     KVT_STREAMS_TOTAL,
+    KVT_LAYER_ADMIT_TOTAL,
     KVT_DIR_LOOKUPS_TOTAL,
     KVT_DIR_INVALIDATIONS_TOTAL,
     WORKQUEUE_ADDS_TOTAL,
@@ -222,6 +227,8 @@ HISTOGRAMS = frozenset({
     SLO_TPOT_SECONDS,
     PD_LOCK_HOLD_SECONDS,
     KVT_ADMIT_LEAD_SECONDS,
+    KVT_LAYER_ADMIT_LEAD_SECONDS,
+    KVT_LAYER_ADMIT_COVERAGE_LAYERS,
     WORKQUEUE_QUEUE_AGE_SECONDS,
     WATCH_DISPATCH_SECONDS,
     SCHED_FEASIBILITY_SCAN_SECONDS,
@@ -325,6 +332,15 @@ HELP = {
     KVT_ADMIT_LEAD_SECONDS:
         "How long before its stream finished a streamed decode row was "
         "admitted (coverage-complete vs stream-close lead)",
+    KVT_LAYER_ADMIT_TOTAL:
+        "Layer-sliced decode admissions dispatched (first decode step "
+        "started before full KV coverage)",
+    KVT_LAYER_ADMIT_LEAD_SECONDS:
+        "How long before FULL coverage a layer-sliced admission could "
+        "start (layer-watermark-ready vs coverage-complete lead)",
+    KVT_LAYER_ADMIT_COVERAGE_LAYERS:
+        "Leading fully-covered layers at the moment of a layer-sliced "
+        "admission",
     WORKQUEUE_ADDS_TOTAL:
         "Keys enqueued into a controller workqueue, per controller",
     RECONCILE_REQUEUES_TOTAL:
@@ -441,6 +457,7 @@ SPAN_PD_PREFILL = "pd.prefill"
 SPAN_PD_KV_HANDOFF = "pd.kv_handoff"
 SPAN_KVT_PUSH = "kvtransfer.push"
 SPAN_KVT_COMMIT = "kvtransfer.commit"
+SPAN_PD_LAYER_SLICED_STEP = "pd.layer_sliced_step"
 SPAN_STRESS_REQUEST = "stress.request"
 SPAN_CTRL_EVENT = "controller.event"
 SPAN_CTRL_RECONCILE = "controller.reconcile"
@@ -460,6 +477,7 @@ SPANS = frozenset({
     SPAN_PD_KV_HANDOFF,
     SPAN_KVT_PUSH,
     SPAN_KVT_COMMIT,
+    SPAN_PD_LAYER_SLICED_STEP,
     SPAN_STRESS_REQUEST,
     SPAN_CTRL_EVENT,
     SPAN_CTRL_RECONCILE,

@@ -106,22 +106,78 @@ def paged_mla_attention(q_lat, q_pe, c_pages, pe_pages, page_table,
     """Dispatch between the Pallas MLA decode kernel and the XLA gather
     fallback (same policy as ``paged_attention``'s GQA dispatch — shared
     via ``dispatch_pallas``). Quantized (int8 + scales) latent pools
-    take the XLA path — the MLA kernel does not dequantize yet (the GQA
-    kernel grew a dequant variant in round 5; the latent one is the
-    remaining seam). Under ``use_pallas='always'`` that would be a
-    SILENT fallback, so it raises instead (the 'always' contract: fail
-    loudly when the kernel cannot run)."""
-    if c_scales is not None:
-        if use_pallas == "always":
-            raise ValueError(
-                "use_pallas='always' with an int8 MLA latent pool: the "
-                "latent kernel does not dequantize yet — use 'auto' "
-                "(XLA dequant path) or kv_dtype='model'")
-        return paged_mla_attention_xla(q_lat, q_pe, c_pages, pe_pages,
-                                       page_table, q_positions, kv_lens,
-                                       scale, c_scales, pe_scales)
+    route to the ``_q`` kernel, which folds the per-slot scales
+    algebraically like the GQA dequant variant — ``use_pallas='always'``
+    + int8 is a working path (the round-2 seam closure)."""
     from rbg_tpu.ops.paged_attention import dispatch_pallas
+    if c_scales is not None:
+        return dispatch_pallas(
+            use_pallas, "paged_mla_attention_pallas_q",
+            paged_mla_attention_xla,
+            (q_lat, q_pe, c_pages, pe_pages, page_table, q_positions,
+             kv_lens, scale, c_scales, pe_scales))
     return dispatch_pallas(
         use_pallas, "paged_mla_attention_pallas", paged_mla_attention_xla,
         (q_lat, q_pe, c_pages, pe_pages, page_table, q_positions, kv_lens,
          scale))
+
+
+def ragged_paged_mla_attention_xla(
+    q_lat: jnp.ndarray,        # [1, T, H, dc] packed tokens (row-major)
+    q_pe: jnp.ndarray,         # [1, T, H, dr]
+    c_pages: jnp.ndarray,      # [NP_layer, page, 1, dc]
+    pe_pages: jnp.ndarray,     # [NP_layer, page, 1, dr]
+    page_table: jnp.ndarray,   # [R, P] int32 — per ROW
+    q_positions: jnp.ndarray,  # [1, T] int32 absolute positions
+    kv_lens: jnp.ndarray,      # [R] int32 — post-write cache length per row
+    row_ids: jnp.ndarray,      # [T] int32 — token → row, contiguous runs
+    scale: float,
+    c_scales: jnp.ndarray = None,   # [NP_layer, page, 1, 1] (int8 pools)
+    pe_scales: jnp.ndarray = None,
+    max_q_len=None,            # static bound on any row's q_len
+) -> jnp.ndarray:
+    """Ragged (mixed prefill/decode pack) MLA: unpack → padded batch MLA →
+    repack — the MLA twin of ``ragged_paged_attention_xla``, same pad
+    contract (q_position < 0 tokens scatter out of range, dropped). The
+    numerics are the SPLIT path's numerics by construction, so the engine's
+    unified step stays bit-identical to phase-split for MLA configs."""
+    from rbg_tpu.ops.ragged_paged_attention import _unpack_offsets
+    _, T, H, dc = q_lat.shape
+    R = page_table.shape[0]
+    Tmax = T if max_q_len is None else min(max_q_len, T)
+
+    idx_in_row = _unpack_offsets(row_ids)
+    scatter_row = jnp.where(q_positions[0] < 0, R, row_ids)
+    qlp = jnp.zeros((R, Tmax, H, dc), q_lat.dtype)
+    qlp = qlp.at[scatter_row, idx_in_row].set(q_lat[0], mode="drop")
+    qpp = jnp.zeros((R, Tmax, H, q_pe.shape[-1]), q_pe.dtype)
+    qpp = qpp.at[scatter_row, idx_in_row].set(q_pe[0], mode="drop")
+    pp = jnp.zeros((R, Tmax), jnp.int32)
+    pp = pp.at[scatter_row, idx_in_row].set(q_positions[0], mode="drop")
+    out = paged_mla_attention_xla(qlp, qpp, c_pages, pe_pages, page_table,
+                                  pp, kv_lens, scale, c_scales, pe_scales)
+    return out[row_ids, idx_in_row][None]                   # [1, T, H, dc]
+
+
+def ragged_paged_mla_attention(q_lat, q_pe, c_pages, pe_pages, page_table,
+                               q_positions, kv_lens, row_ids, scale,
+                               *, use_pallas: str = "auto",
+                               c_scales=None, pe_scales=None,
+                               max_q_len=None) -> jnp.ndarray:
+    """Dispatch the ragged MLA latent path: block-ragged Pallas kernel
+    over the ``c/pe`` pools vs the XLA unpack/repack fallback — the seam
+    that lets ``_unified_step()`` drop its ``mcfg.mla`` exclusion."""
+    from rbg_tpu.ops.paged_attention import dispatch_pallas
+
+    def xla_fn(*args):
+        return ragged_paged_mla_attention_xla(*args, max_q_len=max_q_len)
+
+    if c_scales is not None:
+        return dispatch_pallas(
+            use_pallas, "ragged_paged_mla_attention_pallas_q", xla_fn,
+            (q_lat, q_pe, c_pages, pe_pages, page_table, q_positions,
+             kv_lens, row_ids, scale, c_scales, pe_scales))
+    return dispatch_pallas(
+        use_pallas, "ragged_paged_mla_attention_pallas", xla_fn,
+        (q_lat, q_pe, c_pages, pe_pages, page_table, q_positions, kv_lens,
+         row_ids, scale))

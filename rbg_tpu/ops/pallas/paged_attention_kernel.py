@@ -307,11 +307,14 @@ def _mla_decode_kernel(
     acc_ref,          # [H, dc] running numerator
     *,
     scale: float,
+    cs_ref=None,      # int8 pools: [1, page, 1] f32 scales
+    ps_ref=None,
 ):
     b = pl.program_id(0)
     p = pl.program_id(1)
     num_p = pl.num_programs(1)
     page = c_ref.shape[1]
+    quantized = cs_ref is not None
 
     @pl.when(p == 0)
     def _init():
@@ -328,12 +331,17 @@ def _mla_decode_kernel(
         c = c_ref[0, :, 0, :].astype(jnp.float32)       # [page, dc]
         pe = pe_ref[0, :, 0, :].astype(jnp.float32)     # [page, dr]
 
-        scores = (
-            jax.lax.dot_general(ql, c, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-            + jax.lax.dot_general(qp, pe, (((1,), (1,)), ((), ())),
+        s_c = jax.lax.dot_general(ql, c, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32)
-        ) * scale                                       # [H, page]
+        s_pe = jax.lax.dot_general(qp, pe, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        if quantized:
+            # int8 latent pool: fold the per-slot scales ALGEBRAICALLY —
+            # the latent scale multiplies the latent score term, the RoPE
+            # scale the RoPE term; the pages feed the MXU as int8.
+            s_c = s_c * cs_ref[0, :, 0][None, :]
+            s_pe = s_pe * ps_ref[0, :, 0][None, :]
+        scores = (s_c + s_pe) * scale                   # [H, page]
 
         token_idx = p * page + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, dimension=1)
@@ -346,8 +354,13 @@ def _mla_decode_kernel(
 
         m_ref[:] = m_new
         l_ref[:] = l_ref[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        pmat = probs
+        if quantized:
+            # Values ARE the latents: their scale folds into the probs
+            # before the value dot (same algebra as the GQA v-scale fold).
+            pmat = probs * cs_ref[0, :, 0][None, :]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            probs, c, (((1,), (0,)), ((), ())),
+            pmat, c, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # [H, dc]
 
     @pl.when(p == num_p - 1)
@@ -414,13 +427,100 @@ def paged_mla_attention_pallas(q_lat, q_pe, c_pages, pe_pages, page_table,
     return out[:, None]
 
 
+def _mla_decode_kernel_q(
+    # scalar prefetch
+    page_table_ref, kv_lens_ref,
+    # blocks
+    ql_ref, qp_ref, c_ref, pe_ref,
+    cs_ref,           # [1, page, 1] f32 scales
+    ps_ref,
+    out_ref,
+    # scratch
+    m_ref, l_ref, acc_ref,
+    *,
+    scale: float,
+):
+    _mla_decode_kernel(page_table_ref, kv_lens_ref, ql_ref, qp_ref,
+                       c_ref, pe_ref, out_ref, m_ref, l_ref, acc_ref,
+                       scale=scale, cs_ref=cs_ref, ps_ref=ps_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _mla_decode_call_q(q_lat, q_pe, c_pages, pe_pages, c_scales, pe_scales,
+                       page_table, kv_lens, scale, interpret=False):
+    """int8-latent-pool twin of ``_mla_decode_call``: scales ride two
+    extra [NP, page, 1] operands blocked alongside their pages."""
+    B, H, dc = q_lat.shape
+    dr = q_pe.shape[-1]
+    _, page, _, _ = c_pages.shape
+    P = page_table.shape[1]
+
+    pick4 = lambda b, p, table, lens: (table[b, p], 0, 0, 0)
+    pick3 = lambda b, p, table, lens: (table[b, p], 0, 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, H, dc), lambda b, p, table, lens: (b, 0, 0)),
+            pl.BlockSpec((1, H, dr), lambda b, p, table, lens: (b, 0, 0)),
+            pl.BlockSpec((1, page, 1, dc), pick4),
+            pl.BlockSpec((1, page, 1, dr), pick4),
+            pl.BlockSpec((1, page, 1), pick3),
+            pl.BlockSpec((1, page, 1), pick3),
+        ],
+        out_specs=pl.BlockSpec((1, H, dc),
+                               lambda b, p, table, lens: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, dc), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_mla_decode_kernel_q, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, dc), q_lat.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(page_table, kv_lens, q_lat, q_pe, c_pages, pe_pages,
+      c_scales, pe_scales)
+
+
+def paged_mla_attention_pallas_q(q_lat, q_pe, c_pages, pe_pages, page_table,
+                                 q_positions, kv_lens, scale,
+                                 c_scales, pe_scales,
+                                 interpret: bool = False):
+    """Quantized-latent-pool drop-in: closes the int8-MLA seam — the
+    kernel dequantizes in-register, so ``use_pallas='always'`` + int8
+    latent pools is a working path. Decode (T == 1) runs the kernel;
+    prefill falls back to the XLA dequant gather."""
+    B, T, H, dc = q_lat.shape
+    if T != 1:
+        from rbg_tpu.ops.mla_attention import paged_mla_attention_xla
+        return paged_mla_attention_xla(q_lat, q_pe, c_pages, pe_pages,
+                                       page_table, q_positions, kv_lens,
+                                       scale, c_scales, pe_scales)
+    out = _mla_decode_call_q(q_lat[:, 0], q_pe[:, 0], c_pages, pe_pages,
+                             c_scales[..., 0], pe_scales[..., 0],
+                             page_table.astype(jnp.int32),
+                             kv_lens.astype(jnp.int32),
+                             scale=float(scale), interpret=interpret)
+    return out[:, None]
+
+
 # ---- ragged (mixed prefill/decode) kernels ---------------------------------
 #
 # Re-exported here because ``dispatch_pallas`` resolves every kernel name
-# against this module; the implementation lives in
-# ragged_attention_kernel.py (token-grid variant of the decode kernel).
+# against this module; the implementations live in
+# ragged_attention_kernel.py (block-ragged tile grid; the PR-7 token-grid
+# variants stay exported as the bench A/B baseline).
 
 from rbg_tpu.ops.pallas.ragged_attention_kernel import (  # noqa: E402,F401
     ragged_paged_attention_pallas,
     ragged_paged_attention_pallas_q,
+    ragged_paged_attention_pallas_tokengrid,
+    ragged_paged_mla_attention_pallas,
+    ragged_paged_mla_attention_pallas_q,
 )

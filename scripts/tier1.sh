@@ -89,8 +89,10 @@ assert len(r.get('curve') or []) > 10, 'capacity-vs-load curve is empty'
     # KV transfer-plane smoke: chunked PD streaming over an injected slow
     # lossy link (reorder + duplicates + one truncated stream). Asserts
     # kv_stream_overlap (decode starts before the stream closes),
-    # directory_consistent (no lookup returns an evicted prefix), and
-    # zero_dropped_streams (truncation retried token-exact). Outside the
+    # directory_consistent (no lookup returns an evicted prefix),
+    # zero_dropped_streams (truncation retried token-exact), and that
+    # layer-sliced admission ENGAGED — at least one row admitted at
+    # layer-k coverage with full coverage still pending. Outside the
     # 870 s pytest budget, --lint mode only.
     echo "== rbg-tpu stress --scenario kvstream --kv-slow-link (smoke) =="
     if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
@@ -110,6 +112,12 @@ assert inv.get('directory_consistent'), 'directory returned evicted prefix'
 assert inv.get('zero_dropped_streams'), \
     'streams dropped: %s' % (r.get('requests') or {})
 assert r.get('bit_identical'), 'streamed decode diverged from reference'
+la = (r.get('transfer') or {}).get('layer_admit') or {}
+assert la.get('engaged_requests', 0) >= 1, \
+    'layer-sliced admission never engaged: %s' % la
+assert any(c and c[0] < c[1]
+           for c in la.get('coverage_at_admit') or []), \
+    'no stream admitted with full coverage still pending: %s' % la
 "; then
         echo "TIER1 KVSTREAM SMOKE FAILED — overlap/directory/zero-drop" \
              "invariant red in /tmp/_t1_kvstream.json" >&2

@@ -1,0 +1,244 @@
+"""Block-ragged tiling round 2: query tiles SPAN row boundaries, so the
+identity suite pins exactly the layouts the tile grid makes interesting —
+a prefill row straddling two tiles, a decode row sharing a tile with a
+prefill tail, fully-pad tiles — against the XLA ragged reference, for the
+fp, int8, MLA, and int8-MLA kernels (interpret mode on CPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rbg_tpu.ops.mla_attention import (paged_mla_attention_xla,
+                                       ragged_paged_mla_attention,
+                                       ragged_paged_mla_attention_xla)
+from rbg_tpu.ops.paged_attention import quantize_kv
+from rbg_tpu.ops.pallas.ragged_attention_kernel import (
+    Q_TILE, ragged_paged_attention_pallas, ragged_paged_attention_pallas_q,
+    ragged_paged_attention_pallas_tokengrid,
+    ragged_paged_mla_attention_pallas, ragged_paged_mla_attention_pallas_q)
+from rbg_tpu.ops.ragged_paged_attention import ragged_paged_attention_xla
+
+
+def _pool(rng, NP=32, page=8, KV=2, hd=32):
+    k = jnp.asarray(rng.randn(NP, page, KV, hd), jnp.float32)
+    v = jnp.asarray(rng.randn(NP, page, KV, hd), jnp.float32)
+    return k, v
+
+
+def _pack(rng, q_specs, H=8, hd=32, P=6, NP=32):
+    """Engine pack layout: row-major, positions are each row's causal
+    tail (see tests/test_ragged_attention.py)."""
+    R = len(q_specs)
+    perm = rng.permutation(NP - 1)[: R * P] + 1
+    table = jnp.asarray(perm.reshape(R, P), jnp.int32)
+    kv_lens = jnp.asarray([kv for _, kv in q_specs], jnp.int32)
+    T = sum(ql for ql, _ in q_specs)
+    q = jnp.asarray(rng.randn(1, T, H, hd), jnp.float32)
+    row_ids, q_pos = [], []
+    for r, (ql, kv) in enumerate(q_specs):
+        row_ids += [r] * ql
+        q_pos += list(range(kv - ql, kv))
+    return (q, table, jnp.asarray([q_pos], jnp.int32), kv_lens,
+            jnp.asarray(row_ids, jnp.int32))
+
+
+def _check(q_specs, seed):
+    rng = np.random.RandomState(seed)
+    k, v = _pool(rng)
+    q, table, q_pos, kv_lens, row_ids = _pack(rng, q_specs)
+    ref = ragged_paged_attention_xla(q, k, v, table, q_pos, kv_lens,
+                                     row_ids)
+    got = ragged_paged_attention_pallas(q, k, v, table, q_pos, kv_lens,
+                                        row_ids, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_prefill_row_straddles_two_tiles():
+    """One prefill row longer than Q_TILE: its tokens occupy (at least)
+    two tiles, so the second tile's KV streaming must resume mid-row
+    (duplicate-leader suppression in the kernel)."""
+    assert Q_TILE == 8  # layouts below are built around this
+    _check([(Q_TILE + 4, Q_TILE + 4), (1, 9)], seed=10)
+
+
+def test_row_boundary_inside_a_tile():
+    """A decode row sharing a tile with a prefill tail: tile 0 holds
+    7 prefill tokens of row 0 plus row 1's single decode token — the
+    per-token row_ids/limits must mask each row's KV independently."""
+    _check([(Q_TILE - 1, 19), (1, 33), (2, 12)], seed=11)
+
+
+def test_three_rows_in_one_tile():
+    """Multiple short rows packed into a single tile (the decode-heavy
+    mix): every row transition happens inside the tile."""
+    _check([(1, 9), (1, 21), (1, 33), (2, 6), (3, 7)], seed=12)
+
+
+def test_all_pad_tile():
+    """Real tokens fill less than one tile; the grid still launches a
+    second, fully-pad tile (position -1 everywhere) which must be a
+    numeric no-op for every real output."""
+    rng = np.random.RandomState(13)
+    k, v = _pool(rng, NP=16, page=4)
+    q_specs = [(2, 9), (1, 13)]
+    q, table, q_pos, kv_lens, row_ids = _pack(rng, q_specs, P=4, NP=16)
+    base = ragged_paged_attention_xla(q, k, v, table, q_pos, kv_lens,
+                                      row_ids)
+    # Pad out past the next tile boundary: 3 real + 13 pads = 2 tiles,
+    # tile 1 entirely pads tagged row 0 / position -1.
+    n_pad = 2 * Q_TILE - 3
+    qp = jnp.concatenate(
+        [q, jnp.asarray(rng.randn(1, n_pad, 8, 32), jnp.float32)], axis=1)
+    rp = jnp.concatenate([row_ids, jnp.zeros(n_pad, jnp.int32)])
+    pp = jnp.concatenate([q_pos, jnp.full((1, n_pad), -1, jnp.int32)],
+                         axis=1)
+    got = ragged_paged_attention_pallas(qp, k, v, table, pp, kv_lens, rp,
+                                        interpret=True)
+    np.testing.assert_allclose(np.asarray(got[:, :3]), np.asarray(base),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gqa_int8_straddling_tiles():
+    """GQA (KV < H) + int8 pool + a row straddling tiles, through the
+    dequantizing block-ragged kernel."""
+    rng = np.random.RandomState(14)
+    kf, vf = _pool(rng, NP=32, page=8, KV=2, hd=32)
+    k_q, k_s = quantize_kv(kf)
+    v_q, v_s = quantize_kv(vf)
+    q_specs = [(Q_TILE + 3, Q_TILE + 5), (1, 30), (4, 12)]
+    q, table, q_pos, kv_lens, row_ids = _pack(rng, q_specs)
+    ref = ragged_paged_attention_xla(q, k_q, v_q, table, q_pos, kv_lens,
+                                     row_ids, k_scales=k_s, v_scales=v_s)
+    got = ragged_paged_attention_pallas_q(q, k_q, v_q, table, q_pos,
+                                          kv_lens, row_ids, k_s, v_s,
+                                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_tokengrid_matches_block_ragged():
+    """The retained PR-7 token-grid kernel (the bench's A/B baseline)
+    still agrees with the block-ragged kernel on a mixed pack."""
+    rng = np.random.RandomState(15)
+    k, v = _pool(rng)
+    q_specs = [(5, 15), (1, 21), (1, 4), (3, 40)]
+    q, table, q_pos, kv_lens, row_ids = _pack(rng, q_specs)
+    new = ragged_paged_attention_pallas(q, k, v, table, q_pos, kv_lens,
+                                        row_ids, interpret=True)
+    old = ragged_paged_attention_pallas_tokengrid(
+        q, k, v, table, q_pos, kv_lens, row_ids, interpret=True)
+    np.testing.assert_allclose(np.asarray(new), np.asarray(old),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---- MLA ragged latent path ----
+
+
+def _mla_pack(rng, q_specs, H=4, dc=128, dr=32, page=4, NP=64, P=8):
+    R = len(q_specs)
+    c_pages = jnp.asarray(rng.randn(NP, page, 1, dc) * 0.1, jnp.float32)
+    pe_pages = jnp.asarray(rng.randn(NP, page, 1, dr) * 0.1, jnp.float32)
+    perm = rng.permutation(NP - 1)[: R * P] + 1
+    table = jnp.asarray(perm.reshape(R, P), jnp.int32)
+    kv_lens = jnp.asarray([kv for _, kv in q_specs], jnp.int32)
+    T = sum(ql for ql, _ in q_specs)
+    q_lat = jnp.asarray(rng.randn(1, T, H, dc) * 0.1, jnp.float32)
+    q_pe = jnp.asarray(rng.randn(1, T, H, dr) * 0.1, jnp.float32)
+    row_ids, q_pos = [], []
+    for r, (ql, kv) in enumerate(q_specs):
+        row_ids += [r] * ql
+        q_pos += list(range(kv - ql, kv))
+    scale = 1.0 / np.sqrt(128 + dr)
+    return (q_lat, q_pe, c_pages, pe_pages, table,
+            jnp.asarray([q_pos], jnp.int32), kv_lens,
+            jnp.asarray(row_ids, jnp.int32), scale)
+
+
+def _mla_split_reference(ql, qp, c, pe, table, q_pos, kv_lens, row_ids,
+                         scale, q_specs):
+    outs, off = [], 0
+    for r, (n, _) in enumerate(q_specs):
+        outs.append(paged_mla_attention_xla(
+            ql[:, off:off + n], qp[:, off:off + n], c, pe,
+            table[r:r + 1], q_pos[:, off:off + n], kv_lens[r:r + 1],
+            scale))
+        off += n
+    return jnp.concatenate(outs, axis=1)
+
+
+def test_mla_ragged_xla_matches_split_reference():
+    rng = np.random.RandomState(20)
+    q_specs = [(6, 14), (1, 30), (1, 5), (4, 4)]
+    ql, qp, c, pe, table, q_pos, lens, rows, scale = _mla_pack(rng, q_specs)
+    got = ragged_paged_mla_attention_xla(ql, qp, c, pe, table, q_pos,
+                                         lens, rows, scale)
+    ref = _mla_split_reference(ql, qp, c, pe, table, q_pos, lens, rows,
+                               scale, q_specs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mla_ragged_pallas_matches_xla_straddling():
+    """Block-ragged MLA kernel vs XLA reference — prefill row straddling
+    tiles plus a tile-sharing decode row."""
+    rng = np.random.RandomState(21)
+    q_specs = [(Q_TILE + 4, Q_TILE + 6), (1, 21), (3, 9)]
+    ql, qp, c, pe, table, q_pos, lens, rows, scale = _mla_pack(rng, q_specs)
+    ref = ragged_paged_mla_attention_xla(ql, qp, c, pe, table, q_pos,
+                                         lens, rows, scale)
+    got = ragged_paged_mla_attention_pallas(ql, qp, c, pe, table, q_pos,
+                                            lens, rows, scale,
+                                            interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mla_ragged_pallas_pad_tile():
+    rng = np.random.RandomState(22)
+    q_specs = [(2, 7), (1, 13)]
+    ql, qp, c, pe, table, q_pos, lens, rows, scale = _mla_pack(rng, q_specs)
+    base = ragged_paged_mla_attention_xla(ql, qp, c, pe, table, q_pos,
+                                          lens, rows, scale)
+    n_pad = 2 * Q_TILE - 3
+    qlp = jnp.concatenate(
+        [ql, jnp.asarray(rng.randn(1, n_pad, 4, 128), jnp.float32)], axis=1)
+    qpp = jnp.concatenate(
+        [qp, jnp.asarray(rng.randn(1, n_pad, 4, 32), jnp.float32)], axis=1)
+    rp = jnp.concatenate([rows, jnp.zeros(n_pad, jnp.int32)])
+    pp = jnp.concatenate([q_pos, jnp.full((1, n_pad), -1, jnp.int32)],
+                         axis=1)
+    got = ragged_paged_mla_attention_pallas(qlp, qpp, c, pe, table, pp,
+                                            lens, rp, scale,
+                                            interpret=True)
+    np.testing.assert_allclose(np.asarray(got[:, :3]), np.asarray(base),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mla_ragged_pallas_quantized_matches_xla():
+    rng = np.random.RandomState(23)
+    q_specs = [(Q_TILE + 1, Q_TILE + 1), (1, 17)]
+    ql, qp, c, pe, table, q_pos, lens, rows, scale = _mla_pack(rng, q_specs)
+    c_q, c_s = quantize_kv(c)
+    pe_q, pe_s = quantize_kv(pe)
+    ref = ragged_paged_mla_attention_xla(ql, qp, c_q, pe_q, table, q_pos,
+                                         lens, rows, scale,
+                                         c_scales=c_s, pe_scales=pe_s)
+    got = ragged_paged_mla_attention_pallas_q(ql, qp, c_q, pe_q, table,
+                                              q_pos, lens, rows, scale,
+                                              c_s, pe_s, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_mla_ragged_dispatcher_never_matches_xla():
+    """use_pallas='never' through the public dispatcher equals the raw
+    XLA reference (and exercises the scatter/gather detour)."""
+    rng = np.random.RandomState(24)
+    q_specs = [(3, 11), (1, 6)]
+    ql, qp, c, pe, table, q_pos, lens, rows, scale = _mla_pack(rng, q_specs)
+    ref = ragged_paged_mla_attention_xla(ql, qp, c, pe, table, q_pos,
+                                         lens, rows, scale)
+    got = ragged_paged_mla_attention(ql, qp, c, pe, table, q_pos, lens,
+                                     rows, scale, use_pallas="never")
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))

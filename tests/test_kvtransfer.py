@@ -654,3 +654,99 @@ def test_router_kv_stream_e2e_matches_bundle_path(tmp_path):
     assert m_stream["kv_stream_routed"] == 1
     assert m_bundle["kv_stream_routed"] == 0
     assert m_bundle["kv_bytes_routed"] > 0   # bundle path moved KV bytes
+
+
+# ---- layer-sliced decode admission (round 16) ------------------------------
+
+
+@pytest.mark.slow
+def test_layer_sliced_admission_bit_identity_clean(tiny_setup):
+    """admit_layers=1 over a paced link: the decode side admits at
+    layer-1 coverage and runs the first decode step as a layer-window
+    chain under the transfer tail — token streams stay bit-identical to
+    the full-coverage path, the layer-admit metrics populate, and
+    admit-lead grows (full coverage was still pending at admission)."""
+    from rbg_tpu.engine import SamplingParams
+    from rbg_tpu.engine.pd import PDStreamPair
+    from rbg_tpu.obs import names as obs_names
+    from rbg_tpu.obs.metrics import REGISTRY
+
+    cfg, params = tiny_setup
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(0, cfg.vocab_size, size=37).tolist()
+    sp = SamplingParams(max_new_tokens=8, temperature=0.7, seed=123)
+    paced = lambda: FakeICITransport(bytes_per_s=2e5, latency_s=0.0005)
+
+    full = PDStreamPair(ecfg(), params=params, transport=paced(),
+                        layer_split=1, admit_layers=0)
+    expect = full.generate_one(prompt, sp)
+    assert expect["layers_at_admit"] is None   # plain path never stamps
+
+    admits0 = REGISTRY.counter(obs_names.KVT_LAYER_ADMIT_TOTAL)
+    sliced = PDStreamPair(ecfg(), params=params, transport=paced(),
+                          layer_split=1, admit_layers=1)
+    got = sliced.generate_one(prompt, sp)
+    assert got["tokens"] == expect["tokens"]
+    # Engaged early: admitted below full layer coverage...
+    assert got["layers_at_admit"] is not None
+    assert got["layers_at_admit"] < got["total_layers"]
+    assert REGISTRY.counter(obs_names.KVT_LAYER_ADMIT_TOTAL) > admits0
+    # ...and the admit-lead histogram recorded the overlap (full
+    # coverage landed strictly after layer-ready).
+    assert REGISTRY.quantile(obs_names.KVT_LAYER_ADMIT_LEAD_SECONDS,
+                             0.5) is not None
+    assert REGISTRY.quantile(obs_names.KVT_LAYER_ADMIT_COVERAGE_LAYERS,
+                             0.5) is not None
+    # Pages fully recycled after decode on both pairs.
+    assert sliced.decode.engine.allocator.free_pages == 127
+
+
+@pytest.mark.slow
+def test_layer_sliced_admission_lossy_bit_identity(tiny_setup):
+    """Layer-sliced admission over a reordering, duplicating paced link:
+    retransmitted slabs below the dispatch watermark are clipped (they
+    must not zero the decode token's freshly-written KV) — output stays
+    bit-identical across fault seeds."""
+    from rbg_tpu.engine import SamplingParams
+    from rbg_tpu.engine.pd import PDStreamPair
+    from rbg_tpu.kvtransfer.transport import FakeICITransport
+
+    cfg, params = tiny_setup
+    rng = np.random.RandomState(13)
+    prompt = rng.randint(0, cfg.vocab_size, size=37).tolist()
+    sp = SamplingParams(max_new_tokens=8, temperature=0.7, seed=321)
+    ref = PDStreamPair(ecfg(), params=params, transport=InProcTransport(),
+                       layer_split=1)
+    expect = ref.generate_one(prompt, sp)["tokens"]
+
+    engaged = 0
+    for seed in range(3):
+        lossy = SlowLossyTransport(
+            FakeICITransport(bytes_per_s=2e5, latency_s=0.0005),
+            delay_s=0.001, reorder_window=2, dup_rate=0.5, seed=seed)
+        pair = PDStreamPair(ecfg(), params=params, transport=lossy,
+                            layer_split=1, admit_layers=1)
+        r = pair.generate_one(prompt, sp)
+        assert r["tokens"] == expect, f"fault seed {seed} diverged"
+        if r["layers_at_admit"] is not None:
+            engaged += 1
+    assert engaged >= 1   # the drill actually exercised the sliced path
+
+
+def test_layer_sliced_needs_layer_split_to_engage(tiny_setup):
+    """layer_split=0 ships all layers per chunk, so layer coverage and
+    full coverage land together — admit_layers degrades to the plain
+    full-coverage path (correct output, no layer-admit stamp)."""
+    from rbg_tpu.engine import SamplingParams
+    from rbg_tpu.engine.pd import PDStreamPair
+
+    cfg, params = tiny_setup
+    prompt = list(range(2, 25))
+    sp = SamplingParams(max_new_tokens=4)
+    ref = PDStreamPair(ecfg(), params=params, transport=InProcTransport(),
+                       layer_split=0)
+    expect = ref.generate_one(prompt, sp)["tokens"]
+    pair = PDStreamPair(ecfg(), params=params, transport=InProcTransport(),
+                        layer_split=0, admit_layers=1)
+    r = pair.generate_one(prompt, sp)
+    assert r["tokens"] == expect

@@ -181,6 +181,53 @@ def test_int8_decode_kernel_edge_lens():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_mla_int8_decode_kernel_matches_xla_dequant():
+    """The dequantizing MLA decode kernel (round 16): int8 latent pools
+    + per-slot scales vs the XLA dequant gather."""
+    from rbg_tpu.ops.pallas.paged_attention_kernel import \
+        paged_mla_attention_pallas_q
+
+    ql, qp, c, pe, table, q_pos, lens, scale = _mla_setup(seed=11)
+    cq, cs = quantize_kv(c)
+    peq, pes = quantize_kv(pe)
+    ref = paged_mla_attention_xla(ql, qp, cq, peq, table, q_pos, lens,
+                                  scale, c_scales=cs, pe_scales=pes)
+    got = paged_mla_attention_pallas_q(ql, qp, cq, peq, table, q_pos,
+                                       lens, scale, cs, pes,
+                                       interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_mla_int8_dispatch_routes_to_quantized_kernel(monkeypatch):
+    """use_pallas='always' + int8 MLA now routes to the dequantizing
+    kernel instead of raising (the last 'dequantize first' guard fell in
+    round 16)."""
+    from rbg_tpu.ops.pallas import paged_attention_kernel as K
+    from rbg_tpu.ops.pallas.paged_attention_kernel import \
+        paged_mla_attention_pallas_q
+
+    ql, qp, c, pe, table, q_pos, lens, scale = _mla_setup(dc=64, dr=16,
+                                                          H=4, seed=12)
+    cq, cs = quantize_kv(c)
+    peq, pes = quantize_kv(pe)
+    calls = []
+
+    def spy(*args, **kw):
+        calls.append(args)
+        return paged_mla_attention_pallas_q(*args, interpret=True, **kw)
+
+    monkeypatch.setattr(K, "paged_mla_attention_pallas_q", spy)
+    got = paged_mla_attention(ql, qp, cq, peq, table, q_pos, lens, scale,
+                              use_pallas="always", c_scales=cs,
+                              pe_scales=pes)
+    assert len(calls) == 1
+    ref = paged_mla_attention_xla(ql, qp, cq, peq, table, q_pos, lens,
+                                  scale, c_scales=cs, pe_scales=pes)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_int8_dispatch_routes_to_quantized_kernel(monkeypatch):
     from rbg_tpu.ops import paged_attention as PA
     from rbg_tpu.ops.pallas import paged_attention_kernel as K

@@ -279,3 +279,62 @@ def test_service_publishes_join_and_occupancy_metrics(tiny_setup):
                                  service="engineservice") is not None
     finally:
         svc.stop()
+
+
+# ---- MLA rides the unified step (round 16) ----
+
+
+@pytest.fixture(scope="module")
+def tiny_mla_setup():
+    cfg = get_config("tiny-mla")
+    params = init_params(cfg, jax.random.key(2))
+    return cfg, params
+
+
+def run_mla_batch(params, ragged, prompts, sps, stagger_after=None, **kw):
+    return run_batch(params, ragged, prompts, sps,
+                     stagger_after=stagger_after, model="tiny-mla", **kw)
+
+
+def test_mla_unified_step_bit_identity(tiny_mla_setup):
+    """MLA models join the unified prefill/decode step (the mcfg.mla
+    exclusion fell in round 16): packed ragged latent attention must
+    reproduce the phase-split MLA path exactly."""
+    cfg, params = tiny_mla_setup
+    prompts = _prompts(cfg, (4, 23, 9), seed=7)
+    sps = [SamplingParams(max_new_tokens=4)] * 3
+    got, eng = run_mla_batch(params, "auto", prompts, sps)
+    ref, off = run_mla_batch(params, "off", prompts, sps)
+    assert got == ref
+    assert eng.metrics["unified_steps"] > 0
+    assert off.metrics["unified_steps"] == 0
+
+
+@pytest.mark.slow
+def test_mla_unified_step_staggered_joins(tiny_mla_setup):
+    """Late MLA rows joining a decoding batch mid-stream — the ragged
+    pack carries a decode row and a prefill chunk through the latent
+    kernel in one dispatch — stay bit-identical to phase-split."""
+    cfg, params = tiny_mla_setup
+    prompts = _prompts(cfg, (4, 23, 9, 17), seed=8)
+    sps = [SamplingParams(max_new_tokens=6, temperature=0.8, top_k=20,
+                          seed=i, logprobs=bool(i % 2)) for i in range(4)]
+    got, eng = run_mla_batch(params, "auto", prompts, sps, stagger_after=2)
+    ref, _ = run_mla_batch(params, "off", prompts, sps, stagger_after=2)
+    assert got == ref
+    assert eng.metrics["unified_steps"] >= 2
+    assert eng.metrics["joins"] == 4
+
+
+@pytest.mark.slow
+def test_mla_unified_step_int8_latent_pool(tiny_mla_setup):
+    """int8 latent pools through the ragged MLA path (scatter detour's
+    _q reference on CPU) — identical to the phase-split int8 path."""
+    cfg, params = tiny_mla_setup
+    prompts = _prompts(cfg, (4, 23, 9), seed=9)
+    sps = [SamplingParams(max_new_tokens=4)] * 3
+    got, _ = run_mla_batch(params, "auto", prompts, sps, stagger_after=1,
+                           kv_dtype="int8")
+    ref, _ = run_mla_batch(params, "off", prompts, sps, stagger_after=1,
+                           kv_dtype="int8")
+    assert got == ref

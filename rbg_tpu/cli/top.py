@@ -86,6 +86,12 @@ def _collect_admin(addr: str, token: Optional[str], window: int) -> dict:
         out["topology"] = topo.get("topology")
     except (OSError, RuntimeError, ConnectionError):
         pass
+    # HA panel (optional): lease holder + epoch, per-elector posture.
+    try:
+        ha = _call(addr, {"op": "ha"}, tok or None)
+        out["ha"] = ha.get("ha")
+    except (OSError, RuntimeError, ConnectionError):
+        pass
     return out
 
 
@@ -258,6 +264,27 @@ def _render_admin(src: dict, window: int) -> List[str]:
                 f"{'y' if g.get('enabled') else 'n':>3} "
                 f"{g.get('cooldown_remaining_s', 0):>7}  "
                 f"{what}: {last.get('reason', '')}")
+    ha = src.get("ha")
+    if ha and (ha.get("lease") or ha.get("electors")):
+        lease = ha.get("lease") or {}
+        holder = lease.get("holder") or "—"
+        lines.append(
+            f"  ha — lease holder {holder} epoch {lease.get('epoch', '—')} "
+            f"expires in {_fmt(lease.get('expires_in_s'), 1, 's')}")
+        electors = ha.get("electors") or []
+        if electors:
+            lines.append(f"  {'ELECTOR':<14} {'ROLE':>8} {'EPOCH':>6} "
+                         f"{'TRANSITIONS':>12} {'TAIL-RV':>8} "
+                         f"{'TAILED':>7}")
+            for e in electors:
+                role = "leader" if e.get("leader") else (
+                    "killed" if e.get("killed") else "standby")
+                lines.append(
+                    f"  {e.get('name', ''):<14} {role:>8} "
+                    f"{e.get('epoch') if e.get('epoch') is not None else '—':>6} "
+                    f"{e.get('transitions', 0):>12} "
+                    f"{e.get('tail_rv', 0):>8} "
+                    f"{e.get('tailed_events', 0):>7}")
     auto = src.get("autoscale")
     if auto:
         lines.append(

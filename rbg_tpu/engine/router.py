@@ -121,10 +121,15 @@ class RetryBudget:
             self._tokens = min(self.burst,
                                self._tokens + (now - self._t) * self.rate)
             self._t = now
-            if self._tokens >= 1.0:
+            ok = self._tokens >= 1.0
+            if ok:
                 self._tokens -= 1.0
-                return True
-            return False
+            tokens = self._tokens
+        # Published so operators can SEE the per-process bucket drain:
+        # the budget is per-router-process, so a tier of N routers has an
+        # N x fleet-wide effective budget (docs/operations.md).
+        REGISTRY.set_gauge(obs_names.SERVING_RETRY_BUDGET_TOKENS, tokens)
+        return ok
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -440,11 +445,27 @@ class RouterState:
                  token: Optional[str] = None,
                  retry_budget: Optional[RetryBudget] = None,
                  slo_targets: Optional[SLOTargets] = None,
-                 directory=None, kv_stream: bool = True):
+                 directory=None, kv_stream: bool = True,
+                 router_id: Optional[str] = None, tier=None):
         from rbg_tpu.kvtransfer.transport import LinkStats
 
         self.registry = registry
         self.group = group
+        # Router-tier membership (engine/routertier.py): this router's
+        # stable identity on the hash ring plus the peer event feed it
+        # publishes health/draining/link-rate/ingress transitions to.
+        # None = standalone single-router deployment, nothing changes.
+        self.router_id = router_id or "router-0"
+        self.tier = tier
+        # PR-2 drain protocol, router edition: a draining router finishes
+        # in-flight streams (tracked below) while refusing NEW requests
+        # with a structured CODE_DRAINING frame — tier peers take its
+        # hash ranges the moment the drain transition hits the feed.
+        self.draining = False
+        self._active_requests = 0
+        self._drain_lock = threading.Lock()
+        if tier is not None:
+            tier.register(self.router_id, state=self)
         self.static = static_backends or {}
         # Drain/eviction notifications demote prefix affinity immediately
         # (the staleness fix) — wired before any traffic.
@@ -508,6 +529,86 @@ class RouterState:
         dropped = self.affinity.drop_backend(addr)
         if dropped:
             self.metrics["affinity_demotions"] += dropped
+        self._tier_publish("health", {"backend": addr, "available": False})
+
+    # -- router tier seam (engine/routertier.py) --
+
+    def _tier_publish(self, kind: str, payload: dict) -> None:
+        if self.tier is None:
+            return
+        try:
+            self.tier.publish(self.router_id, kind, payload)
+        except Exception:
+            pass
+
+    def note_ingress(self, kind: str, n: float) -> None:
+        """One ingress token observation (prefill prompt tokens at
+        dispatch / decode tokens at delivery) — counted in THIS process's
+        registry AND in the tier aggregate, because the topology ratio
+        must see the whole tier's mix, not one router's shard of it."""
+        if n <= 0:
+            return
+        REGISTRY.inc(obs_names.ROUTER_INGRESS_TOKENS_TOTAL, float(n),
+                     kind=kind)
+        if self.tier is not None:
+            try:
+                self.tier.note_ingress(self.router_id, kind, float(n))
+            except Exception:
+                pass
+
+    def on_peer_event(self, ev: dict) -> None:
+        """Receive one router-to-router feed event: peers' backend
+        health/draining transitions and measured link rates fold into
+        THIS router's pool and link view, so N routers converge on one
+        picture of the fleet instead of each rediscovering it."""
+        kind, payload = ev.get("kind"), ev.get("payload") or {}
+        addr = payload.get("backend")
+        if kind == "link_rates":
+            self.merge_link_rates(payload.get("rates"), _from_peer=True)
+        elif kind == "draining" and addr:
+            self.pool.set_draining(addr, bool(payload.get("draining")))
+        elif kind == "health" and addr:
+            if payload.get("available"):
+                self.pool.ok(addr)
+            else:
+                self.pool.fail(addr)
+
+    # -- drain protocol (SIGTERM → finish in-flight, refuse new) --
+
+    def enter_request(self) -> bool:
+        """Admission gate for one request: False when draining (caller
+        replies with the structured CODE_DRAINING frame)."""
+        with self._drain_lock:
+            if self.draining:
+                return False
+            self._active_requests += 1
+            return True
+
+    def exit_request(self) -> None:
+        with self._drain_lock:
+            if self._active_requests > 0:
+                self._active_requests -= 1
+
+    def begin_drain(self, wait_s: float = 30.0) -> bool:
+        """Flip to draining, announce it on the tier feed (peers take
+        this router's hash ranges), then wait for in-flight streams to
+        finish. Returns True when the router drained clean inside
+        ``wait_s``."""
+        with self._drain_lock:
+            self.draining = True
+        if self.tier is not None:
+            try:
+                self.tier.set_draining(self.router_id, True)
+            except Exception:
+                pass
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            with self._drain_lock:
+                if self._active_requests == 0:
+                    return True
+            time.sleep(0.02)
+        with self._drain_lock:
+            return self._active_requests == 0
 
     def charge_retry(self) -> bool:
         """Take one retry token; on exhaustion count it and refuse."""
@@ -527,6 +628,10 @@ class RouterState:
         if frame.get("code") == CODE_DRAINING:
             self.pool.set_draining(addr, True)
             self.metrics["draining_routed_around"] += 1
+            # Tier peers learn the drain NOW instead of each waiting to
+            # be shed by the same backend themselves.
+            self._tier_publish("draining", {"backend": addr,
+                                            "draining": True})
         else:
             self.metrics["sheds_routed_around"] += 1
         if best is None or (frame.get("retry_after_s") or 1e9) < \
@@ -588,14 +693,21 @@ class RouterState:
         self._kv_bpt = bpt if self._kv_bpt is None \
             else 0.7 * self._kv_bpt + 0.3 * bpt
 
-    def merge_link_rates(self, rates: Optional[dict]) -> None:
+    def merge_link_rates(self, rates: Optional[dict],
+                         _from_peer: bool = False) -> None:
         """Fold prefill-reported push rates (prefill→decode, observed on
-        real transfers) into this router's link view."""
-        for addr, rate in (rates or {}).items():
+        real transfers) into this router's link view. Locally-observed
+        rates (not peer echoes) are re-published on the tier feed so
+        every router's NetKV decode choice prices the same links."""
+        if not rates:
+            return
+        for addr, rate in rates.items():
             try:
                 self.linkstats.observe(addr, int(float(rate)), 1.0)
             except (TypeError, ValueError):
                 continue
+        if not _from_peer:
+            self._tier_publish("link_rates", {"rates": dict(rates)})
 
     def pd_mode(self) -> bool:
         return bool(
@@ -882,7 +994,9 @@ class Handler(socketserver.BaseRequestHandler):
                 # snapshot (internal topology addresses) are only for
                 # authenticated peers — health must not map the very
                 # fleet the token protects.
-                resp = {"ok": True, "pd": state.pd_mode()}
+                resp = {"ok": True, "pd": state.pd_mode(),
+                        "draining": state.draining,
+                        "router_id": state.router_id}
                 if state.authorized(obj):
                     # Candidacy is fleet topology — authenticated peers
                     # only, like the backend snapshot below.
@@ -928,59 +1042,75 @@ class Handler(socketserver.BaseRequestHandler):
                 self._send_client({"error": f"bad timeout_s: {e}",
                                    "done": True})
                 continue
-            # Ingress arrival stamp (the PR-2 deadline's sibling): TTFT is
-            # measured from HERE — spanning queueing, the prefill leg, and
-            # every failover attempt — never restarted per attempt.
-            t_arrival = time.monotonic()
-            # The router continues the edge's trace context — or IS the
-            # ingress (head sampling) when clients hit it directly. The
-            # incoming context is consumed here; every downstream leg gets
-            # a fresh per-attempt child context instead.
-            rspan = trace.from_wire(obj.pop("trace", None),
-                                    obs_names.SPAN_ROUTER_REQUEST, op=op)
-            if op == "embed":
-                state.metrics["requests"] += 1
-                try:
-                    with trace.use_span(rspan):
-                        _, resp, _, _ = state.call(state.worker_role(), obj,
-                                                   deadline=deadline)
-                except _Rejected as e:
-                    resp = e.frame
-                except Exception as e:
-                    state.metrics["errors"] += 1
-                    resp = {"error": f"embed: {e}"}
-                resp.pop("_router_t_dispatch", None)
-                rspan.end(outcome=resp.get("code") or
-                          ("error" if "error" in resp else "ok"))
-                self._send_client(resp)
-                continue
-            if op != "generate":
-                rspan.end(outcome="unsupported_op")
-                self._send_client({"error": f"router: unsupported op {op!r}"})
+            if not state.enter_request():
+                # SIGTERM drain: in-flight streams run to completion
+                # (they passed this gate already); NEW work gets the
+                # structured draining frame — the same shed contract the
+                # backends use, so clients/peers route around.
+                self._send_client({"error": "router draining",
+                                   "code": CODE_DRAINING,
+                                   "retry_after_s": 1.0, "done": True})
                 continue
             try:
+                self._dispatch_op(state, op, obj, deadline)
+            finally:
+                state.exit_request()
+
+    def _dispatch_op(self, state: "RouterState", op: str, obj: dict,
+                     deadline: float) -> None:
+        # Ingress arrival stamp (the PR-2 deadline's sibling): TTFT is
+        # measured from HERE — spanning queueing, the prefill leg, and
+        # every failover attempt — never restarted per attempt.
+        t_arrival = time.monotonic()
+        # The router continues the edge's trace context — or IS the
+        # ingress (head sampling) when clients hit it directly. The
+        # incoming context is consumed here; every downstream leg gets
+        # a fresh per-attempt child context instead.
+        rspan = trace.from_wire(obj.pop("trace", None),
+                                obs_names.SPAN_ROUTER_REQUEST, op=op)
+        if op == "embed":
+            state.metrics["requests"] += 1
+            try:
                 with trace.use_span(rspan):
-                    if obj.get("stream"):
-                        self._generate_stream(state, obj, deadline,
-                                              t_arrival)
-                    else:
-                        resp = self._generate(state, obj, deadline,
-                                              t_arrival)
-                        self._send_client(resp)
-            except _ClientGone:
-                rspan.end(outcome="client_gone")
-                raise
+                    _, resp, _, _ = state.call(state.worker_role(), obj,
+                                               deadline=deadline)
             except _Rejected as e:
-                # Structured shed/deadline: NOT a router error — the
-                # contract under overload is exactly this reply.
-                rspan.end(outcome=e.frame.get("code") or "rejected")
-                self._send_client({**e.frame, "done": True})
+                resp = e.frame
             except Exception as e:
                 state.metrics["errors"] += 1
-                rspan.end(outcome="error")
-                self._send_client({"error": str(e), "done": True})
-            else:
-                rspan.end(outcome="ok")
+                resp = {"error": f"embed: {e}"}
+            resp.pop("_router_t_dispatch", None)
+            rspan.end(outcome=resp.get("code") or
+                      ("error" if "error" in resp else "ok"))
+            self._send_client(resp)
+            return
+        if op != "generate":
+            rspan.end(outcome="unsupported_op")
+            self._send_client({"error": f"router: unsupported op {op!r}"})
+            return
+        try:
+            with trace.use_span(rspan):
+                if obj.get("stream"):
+                    self._generate_stream(state, obj, deadline,
+                                          t_arrival)
+                else:
+                    resp = self._generate(state, obj, deadline,
+                                          t_arrival)
+                    self._send_client(resp)
+        except _ClientGone:
+            rspan.end(outcome="client_gone")
+            raise
+        except _Rejected as e:
+            # Structured shed/deadline: NOT a router error — the
+            # contract under overload is exactly this reply.
+            rspan.end(outcome=e.frame.get("code") or "rejected")
+            self._send_client({**e.frame, "done": True})
+        except Exception as e:
+            state.metrics["errors"] += 1
+            rspan.end(outcome="error")
+            self._send_client({"error": str(e), "done": True})
+        else:
+            rspan.end(outcome="ok")
 
     @staticmethod
     def _stamp_deadline(obj: dict) -> float:
@@ -1178,13 +1308,9 @@ class Handler(socketserver.BaseRequestHandler):
             # ratio toward prefill-heavy exactly when the fleet is
             # failing.
             n_prompt = len(obj.get("prompt") or ())
-            if n_prompt:
-                REGISTRY.inc(obs_names.ROUTER_INGRESS_TOKENS_TOTAL,
-                             float(n_prompt), kind="prefill")
+            state.note_ingress("prefill", float(n_prompt))
             n = len(resp.get("tokens") or ())
-            if n:
-                REGISTRY.inc(obs_names.ROUTER_INGRESS_TOKENS_TOTAL,
-                             float(n), kind="decode")
+            state.note_ingress("decode", float(n))
             if t_first is not None:
                 tpot = ((t_done - t_first) / (n - 1)) if n > 1 else 0.0
                 state.slo.judge(t_first - t_arrival, tpot,
@@ -1274,12 +1400,8 @@ class Handler(socketserver.BaseRequestHandler):
                     # so failure storms cannot skew the topology ratio.
                     # ``delivered`` already nets out failover replays.
                     n_prompt = len(obj.get("prompt") or ())
-                    if n_prompt:
-                        REGISTRY.inc(obs_names.ROUTER_INGRESS_TOKENS_TOTAL,
-                                     float(n_prompt), kind="prefill")
-                    if delivered:
-                        REGISTRY.inc(obs_names.ROUTER_INGRESS_TOKENS_TOTAL,
-                                     float(delivered), kind="decode")
+                    state.note_ingress("prefill", float(n_prompt))
+                    state.note_ingress("decode", float(delivered))
                 # frame is None on a CLEAN stream completion; an
                 # application-error passthrough carries its frame and is
                 # not a finished request — never judged.
@@ -1409,6 +1531,13 @@ class Handler(socketserver.BaseRequestHandler):
                     frame, _, _ = recv_msg(s)
                     if frame is None:
                         return delivered, "died", None
+                    if frame.get("keepalive"):
+                        # SSE liveness pass-through: forwarded verbatim so
+                        # the edge can emit its comment frame, but never
+                        # counted as tokens and never re-arming the
+                        # deadline — liveness is not progress.
+                        self._send_client(frame)
+                        continue
                     if "error" in frame:
                         if frame.get("code") in RETRYABLE_REJECT_CODES \
                                 or frame.get("code") in (CODE_DEADLINE,
@@ -1503,6 +1632,13 @@ def main(argv=None) -> int:
                          "then route to ANY replica holding a prefix "
                          "(default: $RBG_KV_POOL_ADDR; empty = local LRU "
                          "only)")
+    ap.add_argument("--router-id",
+                    default=os.environ.get("RBG_ROUTER_ID", ""),
+                    help="stable identity on the router-tier hash ring "
+                         "(default: $RBG_ROUTER_ID or router-<port>)")
+    ap.add_argument("--drain-wait-s", type=float, default=30.0,
+                    help="SIGTERM drain: max seconds to wait for in-flight "
+                         "streams to finish before exiting")
     args = ap.parse_args(argv)
     port = int(os.environ.get("RBG_SERVE_PORT")
                or os.environ.get("RBG_PORT_SERVE") or args.port)
@@ -1522,10 +1658,28 @@ def main(argv=None) -> int:
                                    ttft_s=args.slo_ttft_s,
                                    tpot_s=args.slo_tpot_s),
                                directory=directory,
-                               kv_stream=args.kv_stream != "off")
+                               kv_stream=args.kv_stream != "off",
+                               router_id=args.router_id or f"router-{port}")
     from rbg_tpu.obs import timeseries
     timeseries.ensure_started()
     start_prober(server.state)
+
+    # PR-2 drain protocol: SIGTERM flips the admission gate (new requests
+    # get the structured draining frame; tier peers take the hash range),
+    # in-flight streams finish, then the listener exits cleanly.
+    import signal
+
+    def _on_sigterm(signum, frame):
+        def drain():
+            server.state.begin_drain(wait_s=args.drain_wait_s)
+            server.shutdown()
+        threading.Thread(target=drain, daemon=True,
+                         name="router-drain").start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # non-main thread (embedded use) — drain via begin_drain()
     print(f"router listening on 127.0.0.1:{port} group={args.group}", flush=True)
     server.serve_forever()
     return 0

@@ -234,18 +234,26 @@ class DirectoryClient:
     """Wire client for the directory ops hosted on the kv-pool server.
     Failures degrade (return misses / 0) — the directory is an
     optimization, never a request dependency. A failed call opens a
-    short circuit-breaker (``backoff_s``): the router's hot path must
-    not pay a connect timeout per request while the pool host is down."""
+    circuit-breaker whose window GROWS with consecutive failures
+    (``ExponentialBackoff``, decorrelated jitter): a flapping pool host
+    is neither hammered at a fixed half-open cadence (N routers with the
+    same 5 s window would reconnect in lockstep) nor allowed to
+    blackhole affinity for a long fixed wall-clock window after one
+    blip. A successful call snaps the window back to the base."""
 
     def __init__(self, addr: str, timeout: float = 2.0,
                  token: Optional[str] = None,
                  page_size: Optional[int] = None,
-                 backoff_s: float = 5.0):
+                 backoff_s: float = 0.5, backoff_max_s: float = 30.0):
         import os
+        from rbg_tpu.runtime.queue import ExponentialBackoff
         self.addr = addr
         self.timeout = timeout
         self.page_size = page_size
         self.backoff_s = backoff_s
+        self._backoff = ExponentialBackoff(base=backoff_s,
+                                           max_delay=backoff_max_s,
+                                           jitter=True)
         self.token = (token if token is not None
                       else os.environ.get("RBG_DATA_TOKEN") or None)
         self._lock = named_lock("kvtransfer.dirclient")
@@ -262,10 +270,15 @@ class DirectoryClient:
             resp, _, _ = request_once(self.addr, obj, timeout=self.timeout)
         except (OSError, ValueError):
             with self._lock:
-                self._down_until = time.monotonic() + self.backoff_s
+                delay = self._backoff.next_delay(self.addr)
+                self._down_until = time.monotonic() + delay
+            REGISTRY.inc(obs_names.KVT_DIR_BREAKER_OPEN_TOTAL)
             return None
         if not isinstance(resp, dict) or resp.get("error"):
             return None
+        with self._lock:
+            self._backoff.forget(self.addr)
+            self._down_until = 0.0
         return resp
 
     def register_keys(self, keys: List[str], backend: str,

@@ -86,6 +86,13 @@ KVC_TIER_EVICTED_PAGES_TOTAL = "rbg_kvcache_tier_evicted_pages_total"
 KVT_DIR_REPLICATIONS_TOTAL = "rbg_kvtransfer_dir_replications_total"
 ROUTER_INGRESS_TOKENS_TOTAL = "rbg_router_ingress_tokens_total"
 SERVING_EARLY_REJECTS_TOTAL = "rbg_serving_early_rejects_total"
+ROUTER_RING_ROUTES_TOTAL = "rbg_router_ring_routes_total"
+ROUTER_RING_RESHARDS_TOTAL = "rbg_router_ring_reshards_total"
+ROUTER_PEER_EVENTS_TOTAL = "rbg_router_peer_events_total"
+PLANE_LEADER_TRANSITIONS_TOTAL = "rbg_plane_leader_transitions_total"
+PLANE_FENCED_WRITES_TOTAL = "rbg_plane_fenced_writes_total"
+PLANE_STANDBY_TAIL_EVENTS_TOTAL = "rbg_plane_standby_tail_events_total"
+KVT_DIR_BREAKER_OPEN_TOTAL = "rbg_kvtransfer_dir_breaker_open_total"
 
 # ---- gauges (last-write-wins) ----
 
@@ -107,6 +114,10 @@ EVENTS_OBJECTS = "rbg_events_objects"
 TOPOLOGY_POSTURE = "rbg_topology_posture"
 KVC_TIER_PAGES = "rbg_kvcache_tier_pages"
 KVC_TIER_BYTES = "rbg_kvcache_tier_bytes"
+ROUTER_RING_MEMBERS = "rbg_router_ring_members"
+PLANE_LEADER_STATE = "rbg_plane_leader_state"
+PLANE_LEADER_EPOCH = "rbg_plane_leader_epoch"
+SERVING_RETRY_BUDGET_TOKENS = "rbg_serving_retry_budget_tokens"
 
 # ---- histograms ----
 
@@ -194,6 +205,13 @@ COUNTERS = frozenset({
     KVT_DIR_REPLICATIONS_TOTAL,
     ROUTER_INGRESS_TOKENS_TOTAL,
     SERVING_EARLY_REJECTS_TOTAL,
+    ROUTER_RING_ROUTES_TOTAL,
+    ROUTER_RING_RESHARDS_TOTAL,
+    ROUTER_PEER_EVENTS_TOTAL,
+    PLANE_LEADER_TRANSITIONS_TOTAL,
+    PLANE_FENCED_WRITES_TOTAL,
+    PLANE_STANDBY_TAIL_EVENTS_TOTAL,
+    KVT_DIR_BREAKER_OPEN_TOTAL,
 })
 
 GAUGES = frozenset({
@@ -215,6 +233,10 @@ GAUGES = frozenset({
     TOPOLOGY_POSTURE,
     KVC_TIER_PAGES,
     KVC_TIER_BYTES,
+    ROUTER_RING_MEMBERS,
+    PLANE_LEADER_STATE,
+    PLANE_LEADER_EPOCH,
+    SERVING_RETRY_BUDGET_TOKENS,
 })
 
 HISTOGRAMS = frozenset({
@@ -438,6 +460,41 @@ HELP = {
     SERVING_PREDICTED_TTFT_SECONDS:
         "Predicted TTFT computed by the admission gate for each "
         "submission it evaluated",
+    ROUTER_RING_MEMBERS:
+        "Live (non-draining) router replicas on the consistent-hash ring",
+    ROUTER_RING_ROUTES_TOTAL:
+        "Tier routing decisions, per result (affinity = hash owner taken, "
+        "fallback = bounded-load spill to the next replica, rescue = "
+        "owner dead/draining, range absorbed by a peer)",
+    ROUTER_RING_RESHARDS_TOTAL:
+        "Ring membership changes (a router joined, drained, or died — "
+        "its hash range moved to peers)",
+    ROUTER_PEER_EVENTS_TOTAL:
+        "Router-to-router feed events delivered, per type (backend "
+        "health/draining transitions, measured link rates, ingress "
+        "token counters)",
+    PLANE_LEADER_STATE:
+        "1 while this control-plane candidate holds the leader lease, "
+        "0 on standby, per plane",
+    PLANE_LEADER_EPOCH:
+        "Fencing epoch of the current leader lease (monotone; bumps on "
+        "every takeover)",
+    PLANE_LEADER_TRANSITIONS_TOTAL:
+        "Leadership acquisitions, per plane (a takeover after leader "
+        "death or graceful handover)",
+    PLANE_FENCED_WRITES_TOTAL:
+        "Store writes refused because they carried a stale lease epoch "
+        "(a deposed leader's in-flight actuation), per lease",
+    PLANE_STANDBY_TAIL_EVENTS_TOTAL:
+        "Store watch events tailed by a standby plane keeping its resume "
+        "watermark warm, per plane",
+    KVT_DIR_BREAKER_OPEN_TOTAL:
+        "Prefix-directory client circuit-breaker opens (decorrelated-"
+        "jitter exponential window, not a fixed wall-clock hold)",
+    SERVING_RETRY_BUDGET_TOKENS:
+        "Retry-budget tokens currently available in THIS router process "
+        "(fleet-wide effective budget is N x per-replica after router "
+        "scale-out)",
 }
 
 # ---- span names (obs/trace.py) ----
@@ -465,6 +522,8 @@ SPAN_TOPOLOGY_FLIP = "topology.flip"
 SPAN_TOPOLOGY_WARM = "topology.warm"
 SPAN_TOPOLOGY_CUTOVER = "topology.cutover"
 SPAN_TOPOLOGY_DRAIN = "topology.drain"
+SPAN_PLANE_TAKEOVER = "plane.takeover"
+SPAN_ROUTER_RESHARD = "router.reshard"
 
 SPANS = frozenset({
     SPAN_HTTP_REQUEST,
@@ -485,4 +544,6 @@ SPANS = frozenset({
     SPAN_TOPOLOGY_WARM,
     SPAN_TOPOLOGY_CUTOVER,
     SPAN_TOPOLOGY_DRAIN,
+    SPAN_PLANE_TAKEOVER,
+    SPAN_ROUTER_RESHARD,
 })

@@ -193,7 +193,32 @@ class _Handler(socketserver.BaseRequestHandler):
             return self._events(store, ns, obj)
         if op == "controlplane":
             return self._controlplane(store)
+        if op == "ha":
+            return self._ha(store)
         return {"error": f"unknown op {op!r}"}
+
+    def _ha(self, store) -> dict:
+        """HA posture: this plane's elector (when it runs under one),
+        every elector alive in the process (active + standby candidates
+        in drills/embedded deployments), and the raw lease — who leads,
+        at what epoch, how long until the TTL would let a standby in.
+        Fencing refusals ride the metrics op
+        (``rbg_plane_fenced_writes_total``); this op answers 'who is
+        leader RIGHT NOW and is failover armed'."""
+        from rbg_tpu.runtime import ha as _ha
+        out: dict = {"electors": _ha.snapshot_all()}
+        elector = getattr(self.server.plane, "ha", None)
+        if elector is not None:
+            try:
+                out["this_plane"] = elector.snapshot()
+            except Exception:
+                pass
+        try:
+            out["lease"] = store.lease_info(_ha.DEFAULT_LEASE)
+        except AttributeError:
+            # A store proxy without lease surface — HA not wired here.
+            out["lease"] = None
+        return {"ha": out}
 
     def _events(self, store, ns, obj: dict) -> dict:
         """Structured event timeline (k8s ``kubectl get events`` analog):

@@ -1,0 +1,316 @@
+"""Control-plane HA: leader-elected active/standby planes over the store.
+
+The single-process ``ControlPlane`` was one of the two SPOFs this layer
+kills (the other is the router — ``engine/routertier.py``). The design is
+the classic lease + fencing-token protocol over the event-carried store:
+
+* one named lease in ``runtime/store.py`` (``acquire_lease`` /
+  ``renew_lease``) grants a TTL'd leadership term identified by a
+  monotone EPOCH;
+* the leader's plane writes through a :class:`FencedStore` that stamps
+  every write with that epoch — a deposed leader's in-flight actuation
+  is refused atomically inside the store lock (``LeaseFenced``, the
+  structured refusal), never silently double-applied;
+* the standby tails ``Store.watch(since_rv=...)`` to keep its resume
+  watermark warm, and on takeover starts a FRESH plane whose controllers
+  list-sync and resume the annotation-carried state machines (PR-3
+  migrations, PR-13 flips, PR-9 autoscale stamps) exactly where the dead
+  leader left them — failover is the restart-resume drill, not a cold
+  start.
+
+Proof: ``rbg-tpu stress --scenario ha`` kills the leader while a
+migration AND a topology flip are mid-state-machine and asserts the
+standby completes both with zero double-actuations.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Callable, Optional
+
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs import trace
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.runtime.store import LeaseFenced, Store, WatchExpired
+from rbg_tpu.utils.locktrace import named_lock
+
+__all__ = ["FencedStore", "LeaderElector", "LeaseFenced", "snapshot_all"]
+
+DEFAULT_LEASE = "control-plane"
+
+
+class FencedStore:
+    """Store proxy stamping every WRITE with a ``(lease, epoch)`` fence.
+
+    Reads (and everything else: watch, list, leases, event recorder)
+    delegate untouched; the five write entry points forward their fence
+    so the store validates the epoch in the same critical section that
+    commits the write. Give one of these to a ``ControlPlane`` and every
+    controller actuation of that leadership term is fenced — no
+    controller needs to know the protocol exists.
+    """
+
+    def __init__(self, store: Store, lease: str, epoch: int):
+        self._store = store
+        self.lease = lease
+        self.epoch = epoch
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+    # -- fenced write surface --
+
+    def create(self, obj):
+        return self._store.create(obj, fence=(self.lease, self.epoch))
+
+    def update(self, obj, _owned: bool = False):
+        return self._store.update(obj, _owned=_owned,
+                                  fence=(self.lease, self.epoch))
+
+    def update_status(self, obj, _owned: bool = False):
+        return self._store.update_status(obj, _owned=_owned,
+                                         fence=(self.lease, self.epoch))
+
+    def mutate(self, kind, namespace, name, fn, status: bool = False,
+               retries: int = 8):
+        return self._store.mutate(kind, namespace, name, fn, status=status,
+                                  retries=retries,
+                                  fence=(self.lease, self.epoch))
+
+    def delete(self, kind, namespace, name, grace: bool = False):
+        return self._store.delete(kind, namespace, name, grace=grace,
+                                  fence=(self.lease, self.epoch))
+
+    def finalize_delete(self, kind, namespace, name):
+        return self.delete(kind, namespace, name, grace=False)
+
+
+# Live electors, for the admin ``ha`` op when the serving plane object
+# isn't the one holding the coordinator (weak: test planes must not leak).
+_ELECTORS: "weakref.WeakSet[LeaderElector]" = weakref.WeakSet()
+
+
+def snapshot_all() -> list:
+    out = []
+    for e in list(_ELECTORS):
+        try:
+            out.append(e.snapshot())
+        except Exception:
+            continue
+    out.sort(key=lambda s: s.get("name", ""))
+    return out
+
+
+class LeaderElector:
+    """One control-plane candidate: campaigns for the lease, runs a
+    freshly-built plane while leading, steps down the moment a renewal
+    discovers it was deposed.
+
+    ``plane_factory(fenced_store)`` builds (but does not start) the
+    candidate's ``ControlPlane`` against the fenced write surface; it is
+    called once per leadership TERM, so a takeover always resumes from
+    the store, never from a previous term's in-memory state.
+
+    ``clock`` is injectable (monotonic seconds) so fencing tests and the
+    HA drill run on scripted time.
+    """
+
+    def __init__(self, name: str, store: Store,
+                 plane_factory: Callable[[FencedStore], object],
+                 lease: str = DEFAULT_LEASE, ttl_s: float = 3.0,
+                 renew_period_s: Optional[float] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 tail: bool = True):
+        self.name = name
+        self.store = store
+        self.lease = lease
+        self.ttl_s = float(ttl_s)
+        self.renew_period_s = (float(renew_period_s) if renew_period_s
+                               else max(self.ttl_s / 3.0, 0.01))
+        self._clock = clock or time.monotonic
+        self._plane_factory = plane_factory
+        self._lock = named_lock("runtime.ha")
+        self.plane = None            # guarded_by[runtime.ha]
+        self.fenced_store: Optional[FencedStore] = None  # guarded_by[runtime.ha]
+        self.epoch: Optional[int] = None  # guarded_by[runtime.ha]
+        self.is_leader = False       # guarded_by[runtime.ha]
+        self.transitions = 0         # guarded_by[runtime.ha]
+        self.tailed_events = 0       # guarded_by[runtime.ha]
+        self.tail_rv = 0             # guarded_by[runtime.ha]
+        self._tail = tail
+        self._stop = threading.Event()
+        self._killed = False
+        self._thread: Optional[threading.Thread] = None
+        _ELECTORS.add(self)
+
+    # -- standby watch tail --
+
+    def _on_tail_event(self, ev) -> None:
+        with self._lock:
+            self.tailed_events += 1
+            rv = ev.object.metadata.resource_version
+            if rv and rv > self.tail_rv:
+                self.tail_rv = rv
+        REGISTRY.inc(obs_names.PLANE_STANDBY_TAIL_EVENTS_TOTAL,
+                     plane=self.name)
+
+    def _subscribe_tail(self) -> None:
+        """Tail every store write from the current watermark — the
+        standby's warm resume point. ``WatchExpired`` cannot happen from
+        ``current_rv()`` but the re-list fallback stays for parity with
+        real reflector resumes."""
+        try:
+            self.store.watch("*", self._on_tail_event,
+                             since_rv=self.store.current_rv())
+        except WatchExpired:
+            self.store.watch("*", self._on_tail_event)
+
+    # -- lifecycle --
+
+    def start(self) -> "LeaderElector":
+        if self._thread is not None:
+            return self
+        if self._tail:
+            self._subscribe_tail()
+        self._publish_state()
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"ha-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.renew_period_s):
+            try:
+                self.tick()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """One campaign/renew step (public so scripted-clock tests can
+        drive the elector without its thread)."""
+        t = self._clock() if now is None else now
+        with self._lock:
+            leading, epoch = self.is_leader, self.epoch
+        if leading:
+            if not self.store.renew_lease(self.lease, self.name, epoch,
+                                          self.ttl_s, now=t):
+                self._step_down(reason="deposed")
+            else:
+                self._publish_state()
+            return
+        got = self.store.acquire_lease(self.lease, self.name, self.ttl_s,
+                                       now=t)
+        if got is not None:
+            self._become_leader(got)
+
+    def _become_leader(self, epoch: int) -> None:
+        span = trace.start_trace(obs_names.SPAN_PLANE_TAKEOVER,
+                                 plane=self.name, epoch=epoch,
+                                 lease=self.lease)
+        fenced = FencedStore(self.store, self.lease, epoch)
+        plane = self._plane_factory(fenced)
+        # Back-pointer for the admin ``ha`` op (AdminServer holds a plane).
+        try:
+            plane.ha = self
+        except Exception:
+            pass
+        with self._lock:
+            self.fenced_store = fenced
+            self.plane = plane
+            self.epoch = epoch
+            self.is_leader = True
+            self.transitions += 1
+        REGISTRY.inc(obs_names.PLANE_LEADER_TRANSITIONS_TOTAL,
+                     plane=self.name)
+        self._publish_state()
+        try:
+            plane.start()
+            span.end(outcome="leading")
+        except Exception as e:
+            span.end(outcome="error", error=type(e).__name__)
+            raise
+
+    def _step_down(self, reason: str) -> None:
+        with self._lock:
+            plane, self.plane = self.plane, None
+            self.fenced_store = None
+            self.is_leader = False
+        self._publish_state()
+        if plane is not None:
+            try:
+                plane.stop()
+            except Exception:
+                pass
+
+    def _publish_state(self) -> None:
+        with self._lock:
+            leading = self.is_leader
+            epoch = self.epoch
+        REGISTRY.set_gauge(obs_names.PLANE_LEADER_STATE,
+                           1.0 if leading else 0.0, plane=self.name)
+        info = self.store.lease_info(self.lease)
+        if info is not None:
+            REGISTRY.set_gauge(obs_names.PLANE_LEADER_EPOCH,
+                               float(info["epoch"]))
+        elif epoch is not None:
+            REGISTRY.set_gauge(obs_names.PLANE_LEADER_EPOCH, float(epoch))
+
+    def kill(self) -> None:
+        """Crash simulation: the elector vanishes WITHOUT releasing the
+        lease (the standby must wait out the TTL) and without any clean
+        step-down — but the dead leader's plane and fenced store stay
+        reachable so drills can replay its in-flight writes against the
+        fence."""
+        self._killed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        with self._lock:
+            plane = self.plane
+            self.is_leader = False
+        if plane is not None:
+            try:
+                plane.stop()
+            except Exception:
+                pass
+        REGISTRY.set_gauge(obs_names.PLANE_LEADER_STATE, 0.0,
+                           plane=self.name)
+
+    def stop(self) -> None:
+        """Graceful shutdown: release the lease (standby takes over
+        immediately, no TTL wait), stop the plane, join the thread."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        with self._lock:
+            leading, epoch = self.is_leader, self.epoch
+        if leading and epoch is not None:
+            self.store.release_lease(self.lease, self.name, epoch,
+                                     now=self._clock())
+        self._step_down(reason="shutdown")
+
+    # -- introspection --
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "name": self.name,
+                "lease": self.lease,
+                "leader": self.is_leader,
+                "epoch": self.epoch,
+                "transitions": self.transitions,
+                "tailed_events": self.tailed_events,
+                "tail_rv": self.tail_rv,
+                "ttl_s": self.ttl_s,
+                "killed": self._killed,
+            }
+        info = self.store.lease_info(self.lease)
+        if info is not None:
+            out["lease_holder"] = info["holder"]
+            out["lease_epoch"] = info["epoch"]
+            out["lease_expires_in_s"] = round(info["expires_in_s"], 3)
+        return out

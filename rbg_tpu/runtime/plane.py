@@ -29,6 +29,9 @@ class ControlPlane:
                  kv_directory=None, topology=None):
         self.store = store or Store()
         self.manager = Manager(self.store)
+        # Set by runtime/ha.py when this plane runs under a LeaderElector
+        # (the admin ``ha`` op reads it through the serving plane).
+        self.ha = None
         self.node_binding = NodeBindingStore(self.store)
         from rbg_tpu.portalloc import PortAllocatorService
         self.ports = PortAllocatorService(self.store)

@@ -86,6 +86,24 @@ class WatchExpired(Exception):
     fall back to a full re-list before re-subscribing."""
 
 
+class LeaseFenced(Exception):
+    """A write carried a stale lease epoch — the structured refusal of
+    the fencing-token protocol. The holder was deposed (a newer epoch was
+    minted by a takeover) and its in-flight actuation must NOT land; the
+    correct reaction is to stop actuating, never to retry the write."""
+
+    def __init__(self, lease: str, stale_epoch: int, current_epoch: int,
+                 holder: Optional[str] = None):
+        self.lease = lease
+        self.stale_epoch = stale_epoch
+        self.current_epoch = current_epoch
+        self.holder = holder
+        super().__init__(
+            f"lease {lease!r}: write fenced — epoch {stale_epoch} is stale "
+            f"(current epoch {current_epoch}"
+            + (f", held by {holder!r}" if holder else "") + ")")
+
+
 class AlreadyExists(Exception):
     pass
 
@@ -165,6 +183,10 @@ class Store:
         # (type, reason, message) -> mutable record dict, LRU at both
         # levels (see record_event)  # guarded_by[runtime.store]
         self._events: "OrderedDict[str, OrderedDict]" = OrderedDict()
+        # Leader leases: name -> {holder, epoch, expires} (monotonic-clock
+        # expiry; epoch is the fencing token — bumps on every change of
+        # holder, never reused)  # guarded_by[runtime.store]
+        self._leases: Dict[str, dict] = {}
 
     # ---- helpers ----
 
@@ -268,6 +290,93 @@ class Store:
         with self._lock:
             return self._rv
 
+    # ---- leader leases + write fencing ----
+    #
+    # The coordination primitive for control-plane HA (runtime/ha.py): a
+    # named lease grants one holder a TTL'd leadership term identified by
+    # a monotone EPOCH — the fencing token. Writes stamped with the epoch
+    # (``fence=(lease, epoch)`` on any write method) are validated under
+    # the store lock in the same critical section that commits them, so a
+    # deposed leader's in-flight actuation is refused atomically — never
+    # a check-then-write race. Clocks are injectable (``now=``) so the
+    # failover drills and fencing tests run on scripted time.
+
+    def acquire_lease(self, name: str, holder: str, ttl_s: float,
+                      now: Optional[float] = None) -> Optional[int]:
+        """Try to take (or renew) the lease. Returns the fencing epoch on
+        success, None while another live holder owns it. A new holder —
+        first acquisition, expired lease, or graceful release — mints a
+        FRESH epoch; re-acquisition by the current holder keeps its epoch
+        (a renewal, not a term change)."""
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            lease = self._leases.get(name)
+            if lease is None:
+                lease = {"holder": holder, "epoch": 1, "expires": t + ttl_s}
+                self._leases[name] = lease
+                return lease["epoch"]
+            if lease["holder"] == holder:
+                lease["expires"] = t + ttl_s
+                return lease["epoch"]
+            if lease["expires"] > t:
+                return None
+            lease["holder"] = holder
+            lease["epoch"] += 1
+            lease["expires"] = t + ttl_s
+            return lease["epoch"]
+
+    def renew_lease(self, name: str, holder: str, epoch: int, ttl_s: float,
+                    now: Optional[float] = None) -> bool:
+        """Extend the lease iff ``holder`` still owns ``epoch``. A False
+        return means deposed (a takeover minted a newer epoch) — the
+        caller must stop acting as leader immediately."""
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            lease = self._leases.get(name)
+            if (lease is None or lease["holder"] != holder
+                    or lease["epoch"] != epoch):
+                return False
+            lease["expires"] = t + ttl_s
+            return True
+
+    def release_lease(self, name: str, holder: str, epoch: int,
+                      now: Optional[float] = None) -> bool:
+        """Graceful handover: expire the lease NOW so a standby acquires
+        without waiting out the TTL. Only the current (holder, epoch) may
+        release; the epoch survives so stale writes stay fenced."""
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            lease = self._leases.get(name)
+            if (lease is None or lease["holder"] != holder
+                    or lease["epoch"] != epoch):
+                return False
+            lease["expires"] = t
+            return True
+
+    def lease_info(self, name: str,
+                   now: Optional[float] = None) -> Optional[dict]:
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            lease = self._leases.get(name)
+            if lease is None:
+                return None
+            return {"holder": lease["holder"], "epoch": lease["epoch"],
+                    "expires_in_s": lease["expires"] - t}
+
+    def _check_fence_locked(self, fence) -> None:
+        """Validate a write's fencing stamp (store lock held). Refusal is
+        by EPOCH only — expiry alone never fences: a leader briefly late
+        on renewal is still the unique holder until someone else actually
+        takes over (and bumps the epoch)."""
+        name, epoch = fence
+        lease = self._leases.get(name)
+        cur = lease["epoch"] if lease is not None else 0
+        if lease is None or cur != epoch:
+            REGISTRY.inc(obs_names.PLANE_FENCED_WRITES_TOTAL, lease=name)
+            raise LeaseFenced(
+                name, epoch, cur,
+                holder=lease["holder"] if lease is not None else None)
+
     def _notify(self, ev: Event):
         # Snapshot subscribers under lock; dispatch outside to avoid
         # deadlocks. Watchers still inside their replay window buffer the
@@ -350,10 +459,12 @@ class Store:
 
     # ---- CRUD ----
 
-    def create(self, obj):
+    def create(self, obj, fence=None):
         obj = copy.deepcopy(obj)
         m = obj.metadata
         with self._lock:
+            if fence is not None:
+                self._check_fence_locked(fence)
             k = self.key(obj)
             if k in self._objects:
                 raise AlreadyExists(f"{k} already exists")
@@ -484,7 +595,7 @@ class Store:
                     return True
         return False
 
-    def update(self, obj, _owned: bool = False):
+    def update(self, obj, _owned: bool = False, fence=None):
         """Full update with optimistic concurrency; bumps generation on spec
         change. Status is carried over from the stored object — use
         update_status for the status subresource.
@@ -497,6 +608,8 @@ class Store:
         if not _owned:
             obj = copy.deepcopy(obj)
         with self._lock:
+            if fence is not None:
+                self._check_fence_locked(fence)
             k = self.key(obj)
             cur = self._objects.get(k)
             if cur is None:
@@ -524,11 +637,13 @@ class Store:
         self._notify(ev)
         return obj if _owned else copy.deepcopy(obj)
 
-    def update_status(self, obj, _owned: bool = False):
+    def update_status(self, obj, _owned: bool = False, fence=None):
         """Status-subresource update (no generation bump). Spec always
         comes from the STORED object — spec edits on ``obj`` are discarded.
         ``_owned``: see ``update``."""
         with self._lock:
+            if fence is not None:
+                self._check_fence_locked(fence)
             k = self.key(obj)
             cur = self._objects.get(k)
             if cur is None:
@@ -549,7 +664,7 @@ class Store:
         return new if _owned else copy.deepcopy(new)
 
     def mutate(self, kind: str, namespace: str, name: str, fn, status: bool = False,
-               retries: int = 8):
+               retries: int = 8, fence=None):
         """Read-modify-write with conflict retry (the SSA-patch equivalent:
         reference controllers use server-side apply; our single-writer-per-
         field discipline plus this retry loop gives the same convergence).
@@ -563,20 +678,30 @@ class Store:
                 raise NotFound(f"{kind}/{namespace}/{name}")
             res = fn(obj)
             if res is False:
+                if fence is not None:
+                    # A no-op is still an ACTUATION DECISION: a deposed
+                    # leader must learn it is deposed here, not keep
+                    # cycling "already done" against a state machine the
+                    # new leader is advancing.
+                    with self._lock:
+                        self._check_fence_locked(fence)
                 return obj  # no-op
             try:
                 if status:
-                    return self.update_status(obj, _owned=True)
-                return self.update(obj, _owned=True)
+                    return self.update_status(obj, _owned=True, fence=fence)
+                return self.update(obj, _owned=True, fence=fence)
             except Conflict:
                 continue
         raise Conflict(f"{kind}/{namespace}/{name}: retries exhausted")
 
-    def delete(self, kind: str, namespace: str, name: str, grace: bool = False):
+    def delete(self, kind: str, namespace: str, name: str, grace: bool = False,
+               fence=None):
         """Delete an object. grace=True only marks deletionTimestamp (the
         executor finalizes via finalize_delete); grace=False removes now.
         Owned objects are cascade-deleted (k8s GC equivalent)."""
         with self._lock:
+            if fence is not None:
+                self._check_fence_locked(fence)
             k = (kind, namespace, name)
             cur = self._objects.get(k)
             if cur is None:

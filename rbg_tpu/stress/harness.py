@@ -2511,13 +2511,517 @@ def _router_replay_drill(n_tokens: int) -> dict:
     return out
 
 
+# ---- HA scenario: kill the leader, kill a router ---------------------------
+
+
+@dataclasses.dataclass
+class HAConfig:
+    """The two-SPOF drill. Leg A (plane HA): two lease-campaigning
+    ``LeaderElector`` candidates over ONE store; the leader dies while a
+    PR-3 migration AND a PR-13 topology flip are mid-state-machine; the
+    standby must take the lease, resume BOTH annotation-carried machines
+    from the store, and the deposed leader's replayed in-flight writes
+    must be refused by the epoch fence — zero double-actuation. A live
+    SSE-style stream spans the failover untouched (the data plane does
+    not ride the control plane). Leg B (router tier): a hash-ring tier
+    of N routers serving token streams loses one member mid-stream; its
+    sessions re-hash to ring successors and replay token-exact (pinned
+    seed + delivered-prefix skip) while sessions on other members see
+    no re-route at all. Leg C: the topology ratio signal computed from
+    the tier aggregate is IDENTICAL whether the same trace feeds 1
+    router or N."""
+
+    routers: int = 3
+    sessions: int = 24
+    stream_tokens: int = 48
+    ttl_s: float = 0.6
+    renew_period_s: float = 0.15
+    ready_delay_s: float = 1.5
+    flip_drain_s: float = 30.0       # gate: A must NOT finish the flip
+    notice_deadline_s: float = 25.0
+    timeout_s: float = 60.0
+    seed: int = 17
+
+
+def run_ha(cfg: HAConfig) -> dict:
+    report: Dict[str, object] = {"scenario": "ha",
+                                 "config": dataclasses.asdict(cfg)}
+    inv: Dict[str, bool] = {}
+    t_run = time.perf_counter()
+    report["plane_ha"] = _ha_leader_drill(cfg, inv)
+    report["router_kill"] = _ha_router_kill_drill(cfg, inv)
+    report["ratio_identity"] = _ha_ratio_identity(cfg, inv)
+    report["elapsed_s"] = round(time.perf_counter() - t_run, 3)
+    report["invariants"] = inv
+    return report
+
+
+def _ha_leader_drill(cfg: HAConfig, inv: Dict[str, bool]) -> dict:
+    from rbg_tpu.api.group import (IdentityMode, RestartPolicyConfig,
+                                   ScalingAdapterHook)
+    from rbg_tpu.runtime.controllers.disruption import notify_maintenance
+    from rbg_tpu.runtime.ha import LeaderElector
+    from rbg_tpu.runtime.store import LeaseFenced, Store
+    from rbg_tpu.testutil import tpu_leaderworker_role
+    from rbg_tpu.topology import (GroupTopology, POSTURE_DISAGG,
+                                  TopologyConfig, TopologyPolicyConfig)
+
+    out: Dict[str, object] = {}
+    store = Store()
+    make_tpu_nodes(store, slices=4, hosts_per_slice=2)
+
+    # Forced-ratio slot: the drill flips the signal to disagg pressure at
+    # a scripted moment (one-slot publish, the topoflip pattern).
+    sig = {"cur": {"fresh": True, "prefill_decode_ratio": 1.0,
+                   "judged": 10, "link_bytes_per_s": 1e9}}
+    flip_group = "ha-flip"
+    gt = GroupTopology(group=flip_group, unified_replicas=2,
+                       prefill_replicas=1, decode_replicas=1)
+    topo_cfg = TopologyConfig(
+        groups=[gt],
+        policy=TopologyPolicyConfig(
+            disagg_ratio=6.0, unified_ratio=2.0, min_judged=3,
+            disagg_stabilization_s=0.1, unified_stabilization_s=0.1,
+            cooldown_s=0.5, max_switch_cost_s=60.0),
+        eval_period_s=0.1, window_s=5.0, stale_after_s=30.0,
+        signals_fn=lambda _gt: dict(sig["cur"]))
+
+    def plane_factory(fenced):
+        # Fresh plane per leadership TERM, reading ONLY the store: this
+        # is what makes takeover a restart-resume drill.
+        return ControlPlane(store=fenced, backend="fake",
+                            ready_delay=cfg.ready_delay_s, warm_spares=1,
+                            topology=topo_cfg)
+
+    def mk_flip_role(name, replicas):
+        role = simple_role(name, replicas=replicas)
+        role.identity = IdentityMode.RANDOM
+        role.drain_seconds = cfg.flip_drain_s
+        role.scaling_adapter = ScalingAdapterHook(enabled=True,
+                                                 min_replicas=0,
+                                                 max_replicas=4)
+        return role
+
+    fenced_before = REGISTRY.counter(
+        metric_names.PLANE_FENCED_WRITES_TOTAL,
+        lease="control-plane")
+    flips_before = REGISTRY.counter(metric_names.TOPOLOGY_FLIPS_TOTAL,
+                                    group=flip_group,
+                                    target=POSTURE_DISAGG)
+    mig_before = REGISTRY.counter(
+        metric_names.DISRUPTION_MIGRATIONS_COMPLETED_TOTAL)
+
+    el_a = LeaderElector("plane-a", store, plane_factory,
+                         ttl_s=cfg.ttl_s,
+                         renew_period_s=cfg.renew_period_s)
+    el_b = LeaderElector("plane-b", store, plane_factory,
+                         ttl_s=cfg.ttl_s,
+                         renew_period_s=cfg.renew_period_s)
+    stream = {"tokens": [], "ok": False}
+    stream_thread = None
+    backends = []
+    try:
+        el_a.start()
+        _wait(lambda: el_a.is_leader, cfg.timeout_s, "A leads")
+        el_b.start()          # standby: campaigns, loses, tails the watch
+        plane_a = el_a.plane
+
+        # Migration target: one TPU gang on a slice; flip target: the
+        # topology-managed group starting unified.
+        mig_group = "ha-mig"
+        role = tpu_leaderworker_role("serve", replicas=1, topology="2x4")
+        role.restart_policy = RestartPolicyConfig(base_delay_seconds=0.01,
+                                                  max_delay_seconds=0.1)
+        plane_a.apply(make_group(mig_group, role))
+        plane_a.apply(make_group(flip_group, *[
+            mk_flip_role(r, n) for r, n in
+            ((gt.unified_role, 2), (gt.prefill_role, 0),
+             (gt.decode_role, 0))]))
+        plane_a.wait_group_ready(mig_group, timeout=cfg.timeout_s)
+        plane_a.wait_group_ready(flip_group, timeout=cfg.timeout_s)
+
+        # A live stream spanning the failover: data plane vs control
+        # plane separation made measurable.
+        stream_thread, backends = _ha_background_stream(
+            stream, n_tokens=cfg.stream_tokens)
+
+        # ---- wound both state machines ----
+        def gang_slice():
+            nodes = {n.metadata.name: n for n in store.list("Node")}
+            for p in store.list("Pod", namespace="default"):
+                if (p.metadata.labels.get(C.LABEL_GROUP_NAME) == mig_group
+                        and p.active and p.node_name):
+                    return nodes[p.node_name].tpu.slice_id
+            return None
+
+        notify_maintenance(store, gang_slice(), cfg.notice_deadline_s)
+        sig["cur"] = {"fresh": True, "prefill_decode_ratio": 20.0,
+                      "judged": 10, "link_bytes_per_s": 1e9}
+
+        def mid_migration():
+            return any(C.ANN_MIGRATION_STATE in i.metadata.annotations
+                       for i in store.list("RoleInstance",
+                                           namespace="default"))
+
+        def flip_state():
+            g = store.get("RoleBasedGroup", "default", flip_group,
+                          copy_=False)
+            return (g.metadata.annotations.get(C.ANN_TOPOLOGY_STATE) or ""
+                    if g is not None else "")
+
+        _wait(mid_migration, cfg.timeout_s, "migration mid-machine")
+        _wait(flip_state, cfg.timeout_s, "flip mid-machine")
+
+        # ---- kill the leader (no lease release: crash, not shutdown) --
+        el_a.kill()
+        deposed = el_a.fenced_store
+        out["mid_state_at_kill"] = {"migration": mid_migration(),
+                                    "flip": flip_state()}
+        _wait(lambda: el_b.is_leader, cfg.ttl_s * 4 + 5.0, "B takes over")
+        out["mid_state_at_takeover"] = {"migration": mid_migration(),
+                                        "flip": flip_state()}
+        inv["standby_resumed_mid_state"] = (
+            out["mid_state_at_takeover"]["migration"]
+            and bool(out["mid_state_at_takeover"]["flip"]))
+
+        # ---- the deposed leader replays its in-flight writes ----
+        refusals = 0
+        marker = "stress.rbg.io/deposed-write"
+
+        def poison(g):
+            g.metadata.annotations[marker] = "1"
+            return True
+
+        for fn in (poison, lambda g: False):   # real write AND no-op path
+            try:
+                deposed.mutate("RoleBasedGroup", "default", flip_group, fn)
+            except LeaseFenced:
+                refusals += 1
+        g_now = store.get("RoleBasedGroup", "default", flip_group)
+        out["fence_refusals"] = refusals
+        inv["deposed_writes_fenced"] = (
+            refusals == 2
+            and marker not in g_now.metadata.annotations
+            and REGISTRY.counter(metric_names.PLANE_FENCED_WRITES_TOTAL,
+                                 lease="control-plane")
+            - fenced_before >= 2)
+
+        # ---- standby completes BOTH machines ----
+        # The flip's Draining phase is gated on drain acks the dead
+        # leader never got (drain_seconds ≫ drill): ack them under B,
+        # like a serving plane finishing its streams.
+        def ack_drains():
+            for i in store.list("RoleInstance", namespace="default"):
+                a = i.metadata.annotations
+                if (a.get(C.ANN_LIFECYCLE_STATE)
+                        == C.LIFECYCLE_PREPARING_DELETE
+                        and a.get(C.ANN_DRAIN_COMPLETE) != "true"):
+                    def ack(obj):
+                        if obj.metadata.annotations.get(
+                                C.ANN_DRAIN_COMPLETE) == "true":
+                            return False
+                        obj.metadata.annotations[
+                            C.ANN_DRAIN_COMPLETE] = "true"
+                        return True
+                    try:
+                        store.mutate("RoleInstance", "default",
+                                     i.metadata.name, ack)
+                    except Exception:
+                        pass
+
+        def flip_done():
+            ack_drains()
+            g = store.get("RoleBasedGroup", "default", flip_group,
+                          copy_=False)
+            a = g.metadata.annotations
+            return (not a.get(C.ANN_TOPOLOGY_STATE)
+                    and a.get(C.ANN_TOPOLOGY_POSTURE) == POSTURE_DISAGG)
+
+        def migration_done():
+            return not mid_migration()
+
+        t0 = time.perf_counter()
+        _wait(flip_done, cfg.timeout_s, "flip completed by standby")
+        _wait(migration_done, cfg.timeout_s,
+              "migration completed by standby")
+        el_b.plane.wait_group_ready(mig_group, timeout=cfg.timeout_s)
+        out["resume_complete_s"] = round(time.perf_counter() - t0, 3)
+        inv["migration_completed_by_standby"] = True
+        inv["flip_completed_by_standby"] = True
+    except TimeoutError as e:
+        out["timeout"] = str(e)
+        inv.setdefault("standby_resumed_mid_state", False)
+        inv.setdefault("deposed_writes_fenced", False)
+        inv.setdefault("migration_completed_by_standby", False)
+        inv.setdefault("flip_completed_by_standby", False)
+    finally:
+        if stream_thread is not None:
+            stream_thread.join(timeout=30.0)
+        for b in backends:
+            b.shutdown()
+        el_b.stop()
+        el_a.stop()
+
+    inv["leader_failover_completed"] = bool(el_b.is_leader is False
+                                            and el_b.transitions >= 1)
+    # Exactly-once actuation: ONE flip, ONE migration, across both terms.
+    flips = REGISTRY.counter(metric_names.TOPOLOGY_FLIPS_TOTAL,
+                             group=flip_group,
+                             target=POSTURE_DISAGG) - flips_before
+    migs = REGISTRY.counter(
+        metric_names.DISRUPTION_MIGRATIONS_COMPLETED_TOTAL) - mig_before
+    out["flips"] = round(flips, 1)
+    out["migrations_completed"] = round(migs, 1)
+    inv["no_double_actuation"] = (flips == 1.0 and migs == 1.0)
+    inv["zero_dropped_streams_plane"] = stream["ok"]
+    out["electors"] = [el_a.snapshot(), el_b.snapshot()]
+    out["stream_tokens_delivered"] = len(stream["tokens"])
+    return out
+
+
+def _wait(fn, timeout_s: float, desc: str, interval: float = 0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return
+        except Exception:
+            pass
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {desc}")
+
+
+def _ha_background_stream(slot: dict, n_tokens: int):
+    """One real router+backend token stream paced to SPAN the leader
+    failover (~40 ms/token): started before the kill, asserted after the
+    standby finishes — the control plane's death must not cost the data
+    plane a single frame."""
+    import socket as _socket
+    import socketserver
+    import threading
+
+    from rbg_tpu.engine.protocol import recv_msg, send_msg
+    from rbg_tpu.engine.router import (Handler, Registry, RouterServer,
+                                       RouterState)
+
+    class SlowBackend(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+        def __init__(self):
+            class H(socketserver.BaseRequestHandler):
+                def handle(self):
+                    while True:
+                        try:
+                            obj, _, _ = recv_msg(self.request)
+                        except (ConnectionError, json.JSONDecodeError):
+                            return
+                        if obj is None:
+                            return
+                        if obj.get("op") == "health":
+                            send_msg(self.request, {"ok": True})
+                            continue
+                        for t in range(n_tokens):
+                            send_msg(self.request,
+                                     {"tokens": [t], "done": False})
+                            time.sleep(0.04)
+                        send_msg(self.request, {"tokens": [], "done": True})
+
+            super().__init__(("127.0.0.1", 0), H)
+            self.addr = f"127.0.0.1:{self.server_address[1]}"
+            threading.Thread(target=self.serve_forever,
+                             daemon=True).start()
+
+    backend = SlowBackend()
+    router = RouterServer(("127.0.0.1", 0), Handler)
+    router.state = RouterState(Registry(None), None,
+                               {"worker": [backend.addr]})
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    router_addr = f"127.0.0.1:{router.server_address[1]}"
+
+    def run():
+        host, port = router_addr.rsplit(":", 1)
+        try:
+            with _socket.create_connection((host, int(port)),
+                                           timeout=30) as s:
+                send_msg(s, {"op": "generate", "stream": True,
+                             "prompt": [1, 2, 3], "timeout_s": 60})
+                while True:
+                    frame, _, _ = recv_msg(s)
+                    if frame is None or "error" in frame:
+                        return
+                    slot["tokens"].extend(frame.get("tokens") or [])
+                    if frame.get("done"):
+                        slot["ok"] = (slot["tokens"]
+                                      == list(range(n_tokens)))
+                        return
+        except OSError:
+            return
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, [router, backend]
+
+
+def _ha_router_kill_drill(cfg: HAConfig, inv: Dict[str, bool]) -> dict:
+    """Kill one of N tier routers while every session is mid-stream:
+    its sessions re-hash to ring successors and replay token-exact;
+    sessions owned by surviving members never re-route."""
+    import threading
+
+    from rbg_tpu.engine.routertier import MemberDown, RouterTier, TierClient
+
+    tier = RouterTier(name="stress-ha")
+    names = [f"rtr-{i}" for i in range(cfg.routers)]
+    for n in names:
+        tier.register(n)
+    killed: set = set()
+    kill_done = threading.Event()
+
+    def token_fn(seed: int, pos: int) -> int:
+        return (seed * 1315423911 + pos * 2654435761) & 0xFFFF
+
+    half = cfg.stream_tokens // 2
+
+    def deliver(member, key, seed, start, n):
+        # Every session parks at its stream midpoint until the victim is
+        # dead: the kill lands while ALL sessions are provably
+        # mid-stream, so the drill is deterministic, not a sleep race.
+        if start >= half:
+            kill_done.wait(timeout=10.0)
+        time.sleep(0.001)
+        if member in killed or member not in tier.ring:
+            raise MemberDown(member)
+        return [token_fn(seed, p) for p in range(start, start + n)]
+
+    client = TierClient(tier, token_fn, deliver_fn=deliver)
+    rng = __import__("random").Random(cfg.seed)
+    sessions = [(f"sess-{i}", rng.getrandbits(31))
+                for i in range(cfg.sessions)]
+    # Kill the ring owner of the most sessions (bounded-load may spill a
+    # few at runtime; classification below is by ACTUAL serving member).
+    owner_at_start = {k: tier.ring.owner(k) for k, _ in sessions}
+    victim = max(set(owner_at_start.values()),
+                 key=lambda m: sum(1 for v in owner_at_start.values()
+                                   if v == m))
+    results: Dict[str, dict] = {}
+    errors: List[str] = []
+
+    def run_one(key, seed):
+        try:
+            results[key] = client.run_session(key, seed,
+                                              cfg.stream_tokens, chunk=4)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"{key}: {e}")
+
+    threads = [threading.Thread(target=run_one, args=s, daemon=True)
+               for s in sessions]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)          # let every session reach the midpoint park
+    killed.add(victim)
+    tier.remove(victim)       # the crash: hash ranges move to successors
+    kill_done.set()
+    for t in threads:
+        t.join(timeout=30.0)
+
+    reference = {k: [token_fn(seed, p) for p in range(cfg.stream_tokens)]
+                 for k, seed in sessions}
+    exact = all(results.get(k, {}).get("tokens") == reference[k]
+                for k, _ in sessions)
+    affected = [k for k, _ in sessions
+                if victim in results.get(k, {}).get("members", [])]
+    untouched = [k for k, _ in sessions if k not in affected]
+    undisturbed = all(
+        results.get(k, {}).get("rehashes", 1) == 0
+        and len(results.get(k, {}).get("members", [])) == 1
+        for k in untouched)
+    rehashed = all(
+        results.get(k, {}).get("rehashes", 0) >= 1
+        and results.get(k, {}).get("members", [None])[-1] != victim
+        for k in affected)
+    inv["router_kill_token_exact"] = exact and not errors
+    inv["affected_sessions_rehash"] = bool(affected) and rehashed
+    inv["untouched_sessions_undisturbed"] = bool(untouched) and undisturbed
+    inv["zero_dropped_streams_tier"] = (not errors
+                                        and len(results) == len(sessions))
+    return {
+        "victim": victim,
+        "sessions": len(sessions),
+        "affected": len(affected),
+        "untouched": len(untouched),
+        "rehashes": client.rehashes,
+        "errors": errors[:5],
+        "ring_after": tier.members(),
+    }
+
+
+def _ha_ratio_identity(cfg: HAConfig, inv: Dict[str, bool]) -> dict:
+    """The aggregation contract, proven: the SAME ingress trace fed to a
+    1-router tier and an N-router tier (sessions split by ring ownership)
+    yields the IDENTICAL prefill:decode ratio — because the ratio is
+    taken over tier SUMS, never per-member ratios."""
+    from rbg_tpu.engine.routertier import RouterTier
+    from rbg_tpu.topology.signals import tier_ingress_ratio
+
+    clock = {"t": 1000.0}
+    tick = lambda: clock["t"]  # noqa: E731
+    one = RouterTier(name="one", clock=tick)
+    one.register("solo")
+    many = RouterTier(name="many", clock=tick)
+    names = [f"r{i}" for i in range(cfg.routers)]
+    for n in names:
+        many.register(n)
+
+    rng = __import__("random").Random(cfg.seed + 1)
+    for i in range(400):
+        clock["t"] += 0.05
+        key = f"sess-{rng.randrange(64)}"
+        prompt = rng.choice((32, 64, 2048))
+        decode = rng.choice((16, 64, 128))
+        one.note_ingress("solo", "prefill", prompt)
+        one.note_ingress("solo", "decode", decode)
+        member = many.route(key) or names[0]
+        many.note_ingress(member, "prefill", prompt)
+        many.note_ingress(member, "decode", decode)
+
+    now = clock["t"]
+    r1 = tier_ingress_ratio(one, window_s=60.0, now=now)
+    rn = tier_ingress_ratio(many, window_s=60.0, now=now)
+    per_member = {
+        m: round(v, 4) for m, v in (
+            (m, _member_ratio(many, m, 60.0, now)) for m in names)
+        if v is not None}
+    inv["ratio_identical_1_vs_n"] = (
+        r1 is not None and rn is not None
+        and abs(r1 - rn) <= 1e-9 * max(1.0, abs(r1)))
+    return {"ratio_one_router": round(r1, 6) if r1 is not None else None,
+            "ratio_n_routers": round(rn, 6) if rn is not None else None,
+            # The lie a non-aggregating tier would tell: per-member
+            # ratios scatter around the true mix.
+            "per_member_ratios": per_member}
+
+
+def _member_ratio(tier, member: str, window_s: float, now: float):
+    lo = now - window_s
+    sums = {"prefill": 0.0, "decode": 0.0}
+    with tier._lock:
+        for ts, name, kind, n in tier._ingress_log:
+            if name == member and lo <= ts <= now:
+                sums[kind] = sums.get(kind, 0.0) + n
+    if sums["prefill"] <= 1e-9 or sums["decode"] <= 1e-9:
+        return None
+    return sums["prefill"] / sums["decode"]
+
+
 def main(argv=None) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(prog="rbg-tpu-stress")
     ap.add_argument("--scenario", default="churn",
                     choices=["churn", "overload", "preemption", "autoscale",
-                             "kvstream", "prefixcache", "fleet", "topoflip"],
+                             "kvstream", "prefixcache", "fleet", "topoflip",
+                             "ha"],
                     help="churn = control-plane create/update/delete "
                          "percentiles; overload = serving-plane admission "
                          "control drill (sheds, deadlines, queue bound); "
@@ -2674,7 +3178,7 @@ def main(argv=None) -> int:
             for r in ("complete", "incomplete", "leaked")}
     load1 = os.getloadavg()[0]
     if args.scenario in ("overload", "preemption", "autoscale", "kvstream",
-                         "prefixcache", "fleet", "topoflip"):
+                         "prefixcache", "fleet", "topoflip", "ha"):
         if args.scenario == "fleet":
             # Scenario-aware rate default: the churn scenarios' 5 qps
             # would spend 30 s just CREATING a 150-group fleet wave.
@@ -2726,6 +3230,8 @@ def main(argv=None) -> int:
                 reps=max(1, args.reps),
                 token_exact=not args.no_token_exact,
                 timeout_s=args.timeout_s))
+        elif args.scenario == "ha":
+            report = run_ha(HAConfig(timeout_s=args.timeout_s))
         else:
             report = run_preemption(PreemptionConfig(
                 groups=max(2, args.groups) if args.groups else 2,

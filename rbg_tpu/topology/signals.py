@@ -53,3 +53,39 @@ def router_ingress_ratio(sampler, window_s: float = 60.0,
     if num is None or den is None or num <= 1e-9 or den <= 1e-9:
         return None
     return num / den
+
+
+# ---- router TIER aggregation (engine/routertier.py) ----
+#
+# The aggregation contract: with N routers each serving 1/N of the
+# traffic, any single router's counter pair is a biased shard of the
+# load mix (sessions hash by prefix, so one router can be all-prefill
+# while another is all-decode). The policy input must therefore be the
+# ratio of SUMS across members — never the mean of per-member ratios —
+# and the result is identical whether the same trace flows through 1
+# router or N (the identity `stress --scenario ha` asserts).
+
+
+def tier_ingress_signals_fn(tier, window_s: float = 60.0):
+    """Build a ``TopologyConfig.signals_fn`` reading the CROSS-ROUTER
+    ingress aggregate from a :class:`~rbg_tpu.engine.routertier.RouterTier`
+    — the N-router replacement for ``router_ingress_signals_fn`` (whose
+    process-local sampler only ever sees one member's shard)."""
+
+    def signals_fn(_gt) -> dict:
+        ratio = tier_ingress_ratio(tier, window_s)
+        return {} if ratio is None else {"prefill_decode_ratio": ratio}
+
+    return signals_fn
+
+
+def tier_ingress_ratio(tier, window_s: float = 60.0,
+                       now: Optional[float] = None) -> Optional[float]:
+    """Windowed prefill:decode ratio over token rates SUMMED across every
+    tier member. Same absence-of-signal discipline as the single-router
+    reader: a side with no samples in the window yields None."""
+    rates = tier.ingress_rates(window_s, now=now)
+    num, den = rates.get("prefill"), rates.get("decode")
+    if num is None or den is None or num <= 1e-9 or den <= 1e-9:
+        return None
+    return num / den

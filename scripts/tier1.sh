@@ -198,6 +198,45 @@ assert len(curve) > 10 and any(
              "/tmp/_t1_topoflip.json" >&2
         exit 1
     fi
+    # HA smoke: kill-the-leader-mid-churn (standby resumes the mid-flight
+    # migration AND topology flip exactly once; the deposed leader's
+    # replayed writes are fenced; a live stream spans the failover) plus
+    # kill-a-router-mid-stream (affected sessions re-hash and replay
+    # token-exact, untouched sessions undisturbed) and the 1-vs-N ratio
+    # identity. Outside the 870 s pytest budget, --lint only; 300 s cap.
+    echo "== rbg-tpu stress --scenario ha (leader failover + router kill smoke) =="
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
+            stress --scenario ha --json >/tmp/_t1_ha.json; then
+        echo "TIER1 HA SMOKE FAILED — see /tmp/_t1_ha.json (invariants)" >&2
+        exit 1
+    fi
+    if ! python -c "
+import json
+r = json.load(open('/tmp/_t1_ha.json'))
+inv = r.get('invariants') or {}
+assert inv.get('leader_failover_completed'), \
+    'standby never took the lease: %s' % (r.get('plane_ha') or {}).get('electors')
+assert inv.get('migration_completed_by_standby') \
+    and inv.get('flip_completed_by_standby'), \
+    'standby did not finish the mid-flight machines: %s' \
+    % (r.get('plane_ha') or {}).get('mid_state_at_takeover')
+assert inv.get('deposed_writes_fenced'), 'a deposed write landed'
+assert inv.get('no_double_actuation'), \
+    'flip/migration actuated twice: %s' % {
+        k: (r.get('plane_ha') or {}).get(k)
+        for k in ('flips', 'migrations_completed')}
+assert inv.get('zero_dropped_streams_plane') \
+    and inv.get('zero_dropped_streams_tier'), 'a failover dropped streams'
+assert inv.get('router_kill_token_exact') \
+    and inv.get('untouched_sessions_undisturbed'), \
+    'router kill broke a stream: %s' % (r.get('router_kill') or {})
+assert inv.get('ratio_identical_1_vs_n'), \
+    'tier ratio depends on router count: %s' % (r.get('ratio_identity') or {})
+"; then
+        echo "TIER1 HA SMOKE FAILED — failover/fencing/token-exact" \
+             "invariant red in /tmp/_t1_ha.json" >&2
+        exit 1
+    fi
     # Control-plane fleet smoke: the 10k-node drill at ~500 nodes. Asserts
     # the control-plane observability invariants (workqueues drain to
     # empty, no stuck keys, event-recorder accounting) and that the

@@ -1,0 +1,338 @@
+"""Sharded router tier: consistent-hash ring, bounded-load spill, the
+router-to-router event feed, cross-router ingress aggregation, and the
+drain/replay machinery that makes a router replica disposable.
+
+The tier exists so the router stops being a single point of failure: N
+replicas own disjoint hash ranges of the session/prefix key space, a
+member leaving moves ONLY its ranges (to ring successors), and signals
+that feed global decisions — the topology ratio above all — are computed
+from tier SUMS, never from one replica's shard of the traffic.
+"""
+
+import threading
+import time
+
+import pytest
+
+from rbg_tpu.engine.router import Registry, RetryBudget, RouterState
+from rbg_tpu.engine.routertier import (
+    BOUNDED_LOAD_FACTOR, HashRing, MemberDown, RouterTier, TierClient,
+)
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs.metrics import REGISTRY
+from rbg_tpu.topology.signals import tier_ingress_ratio
+
+
+# ---- hash ring -------------------------------------------------------------
+
+
+def test_ring_owner_deterministic_and_covering():
+    r1, r2 = HashRing(), HashRing()
+    for m in ("a", "b", "c"):
+        r1.add(m)
+        r2.add(m)
+    keys = [f"sess-{i}" for i in range(500)]
+    owners = [r1.owner(k) for k in keys]
+    # Deterministic across instances (blake2b, not salted hash()) and
+    # every member owns a share.
+    assert owners == [r2.owner(k) for k in keys]
+    assert set(owners) == {"a", "b", "c"}
+    for k in keys[:50]:
+        assert r1.owners(k)[0] == r1.owner(k)
+
+
+def test_ring_removal_moves_only_the_removed_members_keys():
+    ring = HashRing()
+    for m in ("a", "b", "c", "d"):
+        ring.add(m)
+    keys = [f"sess-{i}" for i in range(1000)]
+    before = {k: ring.owner(k) for k in keys}
+    ring.remove("b")
+    moved = [k for k in keys if ring.owner(k) != before[k]]
+    assert moved, "removal moved nothing"
+    assert all(before[k] == "b" for k in moved)
+    # The moved keys land on the removed member's ring successors.
+    assert all(ring.owner(k) in ("a", "c", "d") for k in moved)
+
+
+def test_ring_empty_and_single():
+    ring = HashRing()
+    assert ring.owner("k") is None and ring.owners("k") == []
+    ring.add("solo")
+    assert ring.owner("k") == "solo" and "solo" in ring
+    ring.remove("solo")
+    assert len(ring) == 0
+
+
+# ---- bounded-load routing --------------------------------------------------
+
+
+def test_route_spills_overloaded_owner_to_successor():
+    tier = RouterTier(name="t-spill")
+    for m in ("a", "b", "c"):
+        tier.register(m)
+    key = "sess-42"
+    owner = tier.ring.owner(key)
+    successor = tier.ring.owners(key)[1]
+    assert tier.route(key) == owner
+    # Load the owner past the bounded-load limit (mean stays low because
+    # the siblings are idle): the SAME key now spills to the SAME
+    # successor — consistent spill, not scatter.
+    for _ in range(10):
+        tier.acquire(owner)
+    assert tier.route(key) == successor
+    assert tier.route(key) == successor
+    for _ in range(10):
+        tier.release(owner)
+    assert tier.route(key) == owner
+
+
+def test_route_skips_draining_and_falls_back_when_all_loaded():
+    tier = RouterTier(name="t-drain")
+    for m in ("a", "b", "c"):
+        tier.register(m)
+    key = "sess-7"
+    owner = tier.ring.owner(key)
+    tier.set_draining(owner, True)
+    pick = tier.route(key)
+    assert pick is not None and pick != owner
+    # Everyone over the limit: the first non-draining candidate is the
+    # floor — routing never returns None while a live member exists.
+    for m in ("a", "b", "c"):
+        for _ in range(5):
+            tier.acquire(m)
+    assert tier.route(key) is not None
+    tier.set_draining(owner, False)
+    assert BOUNDED_LOAD_FACTOR > 1.0
+
+
+def test_routes_counter_and_members_gauge():
+    tier = RouterTier(name="t-metrics")
+    tier.register("a")
+    before = REGISTRY.counter(obs_names.ROUTER_RING_ROUTES_TOTAL,
+                              tier="t-metrics", member="a")
+    tier.route("k1")
+    tier.route("k2")
+    assert REGISTRY.counter(obs_names.ROUTER_RING_ROUTES_TOTAL,
+                            tier="t-metrics", member="a") == before + 2
+    assert REGISTRY.gauge(obs_names.ROUTER_RING_MEMBERS,
+                          tier="t-metrics") == 1.0
+    resh = REGISTRY.counter(obs_names.ROUTER_RING_RESHARDS_TOTAL,
+                            tier="t-metrics")
+    tier.remove("a")
+    assert REGISTRY.counter(obs_names.ROUTER_RING_RESHARDS_TOTAL,
+                            tier="t-metrics") == resh + 1
+    assert REGISTRY.gauge(obs_names.ROUTER_RING_MEMBERS,
+                          tier="t-metrics") == 0.0
+
+
+# ---- peer event feed -------------------------------------------------------
+
+
+def _tier_with_states(n=2, prefix="r"):
+    tier = RouterTier(name="t-feed")
+    states = []
+    for i in range(n):
+        st = RouterState(Registry(None), None,
+                         {"worker": [f"10.0.0.{i}:9000"]},
+                         router_id=f"{prefix}{i}", tier=tier)
+        states.append(st)
+    return tier, states
+
+
+def test_backend_draining_event_folds_into_peer_pools():
+    tier, (s0, s1) = _tier_with_states()
+    addr = "10.9.9.9:7000"
+    # s0 learns its backend is draining (CODE_DRAINING shed) and tells
+    # the tier; s1's pool must reflect it WITHOUT probing that backend.
+    delivered = tier.publish(s0.router_id, "draining",
+                             {"backend": addr, "draining": True})
+    assert delivered == 1
+    assert s1.pool.is_draining(addr)
+    tier.publish(s0.router_id, "draining",
+                 {"backend": addr, "draining": False})
+    assert not s1.pool.is_draining(addr)
+
+
+def test_backend_health_event_folds_into_peer_pools():
+    tier, (s0, s1) = _tier_with_states()
+    addr = "10.9.9.8:7000"
+    tier.publish(s0.router_id, "health",
+                 {"backend": addr, "available": False})
+    assert addr in s1.pool.evicted()
+    tier.publish(s0.router_id, "health",
+                 {"backend": addr, "available": True})
+    assert addr not in s1.pool.evicted()
+
+
+def test_link_rates_propagate_without_echo_loop():
+    tier, (s0, s1) = _tier_with_states()
+    before = tier.events_published
+    # s0 observes a transfer rate locally → republishes on the feed; s1
+    # folds it with _from_peer=True and must NOT republish (no echo
+    # storm: exactly ONE feed event for one observation).
+    s0.merge_link_rates({"10.0.0.1:9000": 2.5e9})
+    assert tier.events_published == before + 1
+    assert s1.linkstats.rate("10.0.0.1:9000") is not None
+
+
+def test_router_drain_protocol_announces_and_waits():
+    tier, (s0, s1) = _tier_with_states()
+    assert s0.enter_request()
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(s0.begin_drain(wait_s=5.0)),
+        daemon=True)
+    t.start()
+    deadline = time.monotonic() + 2.0
+    while not s0.draining and time.monotonic() < deadline:
+        time.sleep(0.005)
+    # Draining: new requests refused, tier re-routes its ranges.
+    assert not s0.enter_request()
+    assert tier.draining(s0.router_id)
+    key = next(k for k in (f"s{i}" for i in range(200))
+               if tier.ring.owner(k) == s0.router_id)
+    assert tier.route(key) == s1.router_id
+    s0.exit_request()          # the in-flight stream finishes
+    t.join(timeout=5.0)
+    assert done == [True], "drain did not complete clean"
+
+
+# ---- cross-router ingress aggregation --------------------------------------
+
+
+def test_ingress_rates_window_and_absence_discipline():
+    t = {"t": 100.0}
+    tier = RouterTier(name="t-ing", clock=lambda: t["t"])
+    tier.register("a")
+    tier.register("b")
+    tier.note_ingress("a", "prefill", 600.0)
+    tier.note_ingress("b", "prefill", 600.0)
+    tier.note_ingress("a", "decode", 60.0)
+    rates = tier.ingress_rates(window_s=60.0)
+    assert rates["prefill"] == pytest.approx(20.0)   # tier SUM / window
+    assert rates["decode"] == pytest.approx(1.0)
+    # Outside the window: no samples → None, never 0.0.
+    t["t"] = 200.0
+    rates = tier.ingress_rates(window_s=60.0)
+    assert rates["prefill"] is None and rates["decode"] is None
+    assert tier.ingress_totals()["prefill"] == pytest.approx(1200.0)
+
+
+def test_tier_ingress_ratio_identical_one_vs_n_members():
+    """The aggregation contract: ratio of SUMS across members. Feeding
+    the same trace to 1 member or sharding it over 3 must produce the
+    IDENTICAL ratio — a mean of per-member ratios would not."""
+    t = {"t": 0.0}
+    one = RouterTier(name="t-one", clock=lambda: t["t"])
+    one.register("solo")
+    many = RouterTier(name="t-many", clock=lambda: t["t"])
+    for m in ("a", "b", "c"):
+        many.register(m)
+    trace = [("a", 2048.0, 16.0), ("b", 32.0, 128.0), ("c", 64.0, 64.0),
+             ("a", 32.0, 256.0), ("b", 4096.0, 8.0)]
+    for member, prefill, decode in trace:
+        t["t"] += 1.0
+        one.note_ingress("solo", "prefill", prefill)
+        one.note_ingress("solo", "decode", decode)
+        many.note_ingress(member, "prefill", prefill)
+        many.note_ingress(member, "decode", decode)
+    r1 = tier_ingress_ratio(one, window_s=60.0, now=t["t"])
+    rn = tier_ingress_ratio(many, window_s=60.0, now=t["t"])
+    assert r1 is not None and r1 == pytest.approx(rn, abs=1e-12)
+    # And it is NOT what any single member would report.
+    assert r1 != pytest.approx(2048.0 / 16.0)
+
+
+def test_tier_ingress_ratio_absence_is_none():
+    t = {"t": 0.0}
+    tier = RouterTier(name="t-none", clock=lambda: t["t"])
+    tier.register("a")
+    assert tier_ingress_ratio(tier, now=0.0) is None
+    tier.note_ingress("a", "prefill", 100.0)
+    assert tier_ingress_ratio(tier, now=0.0) is None  # one side missing
+
+
+# ---- session replay across a member loss -----------------------------------
+
+
+def test_tier_client_replays_token_exact_after_member_loss():
+    tier = RouterTier(name="t-replay")
+    for m in ("a", "b", "c"):
+        tier.register(m)
+
+    def token_fn(seed, pos):
+        return (seed * 31 + pos * 7) & 0xFFFF
+
+    killed = set()
+
+    def deliver(member, key, seed, start, n):
+        if member in killed or member not in tier.ring:
+            raise MemberDown(member)
+        return [token_fn(seed, p) for p in range(start, start + n)]
+
+    client = TierClient(tier, token_fn, deliver_fn=deliver)
+    key = "sess-replay"
+    victim = tier.ring.owner(key)
+
+    # Uninterrupted session: single member, no rehash.
+    out = client.run_session(key, seed=5, total=32, chunk=8)
+    assert out["tokens"] == [token_fn(5, p) for p in range(32)]
+    assert out["rehashes"] == 0 and out["members"] == [victim]
+
+    # Kill the owner between sessions-in-flight: the next session on the
+    # same key re-hashes mid-stream and the delivered prefix is skipped,
+    # never re-sent — token-exact, no duplicates.
+    orig = client.deliver_fn
+
+    def deliver_then_kill(member, key_, seed, start, n):
+        if member == victim and start >= 16:
+            killed.add(victim)
+            tier.remove(victim)
+        return orig(member, key_, seed, start, n)
+
+    client.deliver_fn = deliver_then_kill
+    out = client.run_session(key, seed=9, total=32, chunk=8)
+    assert out["tokens"] == [token_fn(9, p) for p in range(32)]
+    assert out["rehashes"] == 1
+    assert out["members"][0] == victim and out["members"][-1] != victim
+
+
+# ---- satellite: retry-budget gauge ----------------------------------------
+
+
+def test_retry_budget_publishes_tokens_gauge():
+    rb = RetryBudget(rate=8.0, burst=4.0)
+    assert rb.take()
+    g = REGISTRY.gauge(obs_names.SERVING_RETRY_BUDGET_TOKENS)
+    assert g is not None and g <= 3.0 + 0.1
+
+
+# ---- satellite: directory breaker backoff ----------------------------------
+
+
+def test_directory_breaker_window_grows_then_resets():
+    from rbg_tpu.kvtransfer.directory import DirectoryClient
+
+    # Unroutable address: every _call attempt fails fast with OSError.
+    c = DirectoryClient("127.0.0.1:1", timeout=0.05,
+                        backoff_s=0.2, backoff_max_s=30.0)
+    before = REGISTRY.counter(obs_names.KVT_DIR_BREAKER_OPEN_TOTAL)
+    windows = []
+    for _ in range(4):
+        c._down_until = 0.0        # force the half-open probe NOW
+        t0 = time.monotonic()
+        assert c.lookup_keys(["k"]) == (0, [])
+        windows.append(c._down_until - t0)
+    assert REGISTRY.counter(obs_names.KVT_DIR_BREAKER_OPEN_TOTAL) \
+        == before + 4
+    # Decorrelated jitter grows the window from the base: later windows
+    # must be able to exceed the old fixed 5 s cadence's base, and the
+    # FIRST is bounded by base*3 (jitter range), proving it is not fixed.
+    assert windows[0] >= 0.0
+    assert max(windows) > 0.2, f"breaker window never grew: {windows}"
+    # A success snaps the window back (forget + closed breaker).
+    with c._lock:
+        c._backoff.forget(c.addr)
+        c._down_until = 0.0
+    assert c._down_until == 0.0

@@ -45,6 +45,14 @@ CODE_REJECTED = "rejected"
 #: path exists.
 CODE_KV_STREAM = "kv_stream_failed"
 
+#: A KV payload failed its end-to-end checksum — a chunk frame whose
+#: bytes do not match the checksum minted at the producer, or a cached
+#: page whose bytes rotted across spill→promote / a peer fetch. Never
+#: served: the receiver abandons the stream (bundle-fallback replays it
+#: token-exact) or the pool treats the page as a miss. Distinct from
+#: CODE_KV_STREAM so operators can tell "link flaked" from "bytes lied".
+CODE_KV_INTEGRITY = "kv_integrity_failed"
+
 #: Codes the router may retry on a sibling backend (a shed or draining
 #: backend is HEALTHY — never evicted).
 RETRYABLE_REJECT_CODES = (CODE_OVERLOADED, CODE_DRAINING)
@@ -57,6 +65,7 @@ ALL_CODES = frozenset({
     CODE_DRAINING,
     CODE_REJECTED,
     CODE_KV_STREAM,
+    CODE_KV_INTEGRITY,
 })
 
 # ---- HTTP edge mapping (single source for http_frontend) ----

@@ -1,0 +1,15 @@
+"""Deterministic chaos plane: schedule-driven fault injection against
+the system's existing seams (transports, directory wire, clocks, peer
+feed). See docs/operations.md "Failure-modes matrix" for the fault class
+→ detection signal → degradation rung map the injectors exercise."""
+
+from rbg_tpu.chaos.inject import ChaosTransport, directory_fault
+from rbg_tpu.chaos.schedule import (BROWNOUT, CORRUPT, KINDS, PARTITION,
+                                    SKEW, ChaosClock, FaultSchedule,
+                                    FaultWindow, SkewedClock)
+
+__all__ = [
+    "BROWNOUT", "CORRUPT", "KINDS", "PARTITION", "SKEW",
+    "ChaosClock", "ChaosTransport", "FaultSchedule", "FaultWindow",
+    "SkewedClock", "directory_fault",
+]

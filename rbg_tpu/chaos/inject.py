@@ -1,0 +1,103 @@
+"""Fault injectors wrapping the system's existing seams.
+
+Nothing in here reaches into private state: every injector wraps a
+boundary the production code already routes through — the kvtransfer
+``Transport`` (chunk streams), the ``DirectoryClient`` wire (via its
+``chaos=`` hook), and the injectable clocks the lease/elector machinery
+takes (``schedule.SkewedClock``). Remove the wrapper and the system is
+untouched; that is what makes a chaos finding a real finding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Iterator, Optional
+
+from rbg_tpu.chaos.schedule import (BROWNOUT, CORRUPT, PARTITION,
+                                    FaultSchedule)
+from rbg_tpu.kvtransfer.chunks import Frame, KVChunk
+from rbg_tpu.kvtransfer.transport import Transport
+
+
+class ChaosTransport(Transport):
+    """Schedule-driven fault wrapper for any chunk transport. Unlike
+    ``SlowLossyTransport`` (free-running randomness), every fault here is
+    gated on a schedule window, so a drill can script "corrupt exactly
+    the second stream, partition A→B from t=2 to t=4" and assert the
+    recovery it expects.
+
+    * BROWNOUT  — adds ``delay_s`` to every frame send in-window.
+    * PARTITION — frames into a dead ``src->dst`` direction vanish
+      (no error, no FIN: the receiver's bounded wait is what saves it —
+      exactly how a real asymmetric partition presents).
+    * CORRUPT   — flips payload bytes of in-window data chunks while
+      KEEPING the producer's checksum: the wire tells the truth about
+      what the payload should have been, the payload lies, and the
+      assembler's verify-at-commit is what must catch it.
+    """
+
+    name = "chaos"
+
+    def __init__(self, inner: Transport, schedule: FaultSchedule,
+                 src: str = "prefill", dst: str = "decode"):
+        super().__init__()
+        self.inner = inner
+        self.schedule = schedule
+        self.src = src
+        self.dst = dst
+        # Per-window spend for params["max_faults"] budgets, keyed by
+        # window identity (the same window object may be consulted for
+        # thousands of frames).
+        self._spent: dict = {}
+
+    def _corrupted(self, ch: KVChunk) -> KVChunk:
+        kb = bytearray(ch.k_bytes)
+        if not kb:
+            return ch
+        i = self.schedule.rng.randrange(len(kb))
+        kb[i] ^= 0xFF
+        # checksum deliberately NOT recomputed — see class docstring.
+        return dataclasses.replace(ch, k_bytes=bytes(kb))
+
+    def send_one(self, peer: str, frame: Frame) -> None:
+        s = self.schedule
+        w = s.active(BROWNOUT)
+        if w is not None:
+            s.note(BROWNOUT)
+            time.sleep(float(w.params.get("delay_s", 0.02)))
+        w = s.active(PARTITION)
+        if w is not None and s.cut(w, self.src, self.dst):
+            s.note(PARTITION)
+            return
+        w = s.active(CORRUPT)
+        if w is not None and isinstance(frame, KVChunk):
+            budget = w.params.get("max_faults")
+            in_budget = (budget is None
+                         or self._spent.get(id(w), 0) < int(budget))
+            rate = float(w.params.get("rate", 1.0))
+            if in_budget and (rate >= 1.0 or s.rng.random() < rate):
+                self._spent[id(w)] = self._spent.get(id(w), 0) + 1
+                s.note(CORRUPT)
+                frame = self._corrupted(frame)
+        self.inner.send_one(peer, frame)
+
+    def recv_chunks(self, stream_id: str,
+                    timeout: float = 30.0) -> Iterator[Frame]:
+        return self.inner.recv_chunks(stream_id, timeout=timeout)
+
+
+def directory_fault(schedule: FaultSchedule, src: str = "router",
+                    dst: str = "directory"):
+    """Hook for ``DirectoryClient(chaos=...)``: raises ``OSError`` while
+    a PARTITION window kills the src→dst direction, so the client's REAL
+    breaker/degrade machinery engages — the drill tests the production
+    ladder, not a mock of it."""
+
+    def hook() -> None:
+        w = schedule.active(PARTITION)
+        if w is not None and schedule.cut(w, src, dst):
+            schedule.note(PARTITION)
+            raise OSError("chaos: directory partitioned")
+
+    return hook

@@ -1,0 +1,155 @@
+"""Deterministic fault schedules — the chaos plane's clockwork.
+
+A ``FaultSchedule`` is a list of ``FaultWindow``s evaluated against an
+INJECTABLE clock: the same schedule, seed, and clock script replays the
+same faults in the same order, so a chaos drill is a regression test,
+not a dice roll (Taming-the-Chaos, PAPERS.md: heterogeneous disaggregated
+fleets fail in *partial* ways — the injector has to reproduce exactly the
+partial failure a fix claims to handle).
+
+Fault kinds (one per degradation ladder the system must own):
+
+* ``PARTITION`` — asymmetric link death: ``params["dead"]`` lists
+  ``"src->dst"`` directions that blackhole (A→B dead while B→A delivers —
+  the failure symmetric timeouts never exercise).
+* ``CORRUPT``   — byzantine payload corruption: chunk bytes flipped in
+  flight, checksum left TRUTHFUL (the corruption is the payload lying,
+  the checksum is how the receiver catches it).
+* ``SKEW``      — per-process clock offset (``params["offsets"]``:
+  name → seconds) driving lease/fencing races.
+* ``BROWNOUT``  — slow-node injection: ``params["delay_s"]`` added to
+  every frame send while the window is open.
+
+Every applied fault is counted under ``rbg_chaos_faults_injected_total``
+per kind — the drill's "every fault class maps to a counted metric"
+invariant reads it, and a nonzero value in production means a chaos
+schedule leaked into prod config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs.metrics import REGISTRY
+
+PARTITION = "partition"
+CORRUPT = "corrupt"
+SKEW = "skew"
+BROWNOUT = "brownout"
+
+KINDS = (PARTITION, CORRUPT, SKEW, BROWNOUT)
+
+
+@dataclasses.dataclass
+class FaultWindow:
+    """One scheduled fault: ``kind`` active over ``[t_start, t_end)`` on
+    the schedule's clock, shaped by ``params`` (see module docstring)."""
+
+    kind: str
+    t_start: float
+    t_end: float
+    params: Dict = dataclasses.field(default_factory=dict)
+
+    def active_at(self, t: float) -> bool:
+        return self.t_start <= t < self.t_end
+
+
+class ChaosClock:
+    """Scripted, skewable clock. Callable (drop-in for the ``clock=``
+    params runtime/ha and the stores already take); thread-safe so a
+    drill thread can advance it under a ticking elector."""
+
+    def __init__(self, t0: float = 0.0):
+        self._lock = threading.Lock()
+        self._t = float(t0)
+        self._skew = 0.0
+
+    def __call__(self) -> float:
+        with self._lock:
+            return self._t + self._skew
+
+    def advance(self, dt: float) -> float:
+        with self._lock:
+            self._t += float(dt)
+            return self._t + self._skew
+
+    def set(self, t: float) -> None:
+        with self._lock:
+            self._t = float(t)
+
+    def skew(self, offset: float) -> None:
+        """Apply a constant offset ON TOP of the scripted time — the
+        clock-skew fault's lever (a skewed process reads a different
+        'now' from the same underlying script)."""
+        with self._lock:
+            self._skew = float(offset)
+
+
+class FaultSchedule:
+    """Seeded, clock-driven fault activation. ``clock`` is any zero-arg
+    callable (``ChaosClock``, ``time.monotonic``, or a drill-relative
+    lambda); determinism is the caller scripting that clock."""
+
+    def __init__(self, windows: Sequence[FaultWindow],
+                 clock: Callable[[], float], seed: int = 0):
+        self.windows: List[FaultWindow] = list(windows)
+        self.clock = clock
+        self.rng = random.Random(seed)
+
+    def now(self) -> float:
+        return float(self.clock())
+
+    def active(self, kind: str,
+               now: Optional[float] = None) -> Optional[FaultWindow]:
+        """The first ``kind`` window open at ``now`` (schedule order),
+        or None — call sites branch on it and apply the fault."""
+        t = self.now() if now is None else now
+        for w in self.windows:
+            if w.kind == kind and w.active_at(t):
+                return w
+        return None
+
+    def note(self, kind: str, n: float = 1.0) -> None:
+        """Count one applied fault — every injection accounts."""
+        REGISTRY.inc(obs_names.CHAOS_FAULTS_INJECTED_TOTAL, float(n),
+                     kind=kind)
+
+    @staticmethod
+    def cut(window: FaultWindow, src: str, dst: str) -> bool:
+        """True when ``window`` (a PARTITION) kills the src→dst
+        direction. Asymmetry is the point: ``dead=["a->b"]`` drops a→b
+        while b→a still delivers."""
+        return f"{src}->{dst}" in (window.params.get("dead") or ())
+
+
+class SkewedClock:
+    """View of a base clock as seen by one named process under a
+    schedule's SKEW windows: reads the base, adds this process's offset
+    while a window is open. Feeds ``LeaderElector(clock=...)`` /
+    ``Store`` ``now=`` params so fencing races replay deterministically."""
+
+    def __init__(self, base: Callable[[], float], schedule: FaultSchedule,
+                 who: str):
+        self.base = base
+        self.schedule = schedule
+        self.who = who
+        self._noted = False
+
+    def __call__(self) -> float:
+        t = float(self.base())
+        w = self.schedule.active(SKEW, now=t)
+        if w is None:
+            return t
+        off = float((w.params.get("offsets") or {}).get(self.who, 0.0))
+        if off and not self._noted:
+            # Counted once per (clock, window entry) — the fault is "this
+            # process's clock is wrong", not every read of it.
+            self._noted = True
+            self.schedule.note(SKEW)
+        elif not off:
+            self._noted = False
+        return t + off

@@ -275,7 +275,7 @@ def _render_admin(src: dict, window: int) -> List[str]:
         if electors:
             lines.append(f"  {'ELECTOR':<14} {'ROLE':>8} {'EPOCH':>6} "
                          f"{'TRANSITIONS':>12} {'TAIL-RV':>8} "
-                         f"{'TAILED':>7}")
+                         f"{'TAILED':>7} {'DEMOTE':>7}")
             for e in electors:
                 role = "leader" if e.get("leader") else (
                     "killed" if e.get("killed") else "standby")
@@ -284,7 +284,15 @@ def _render_admin(src: dict, window: int) -> List[str]:
                     f"{e.get('epoch') if e.get('epoch') is not None else '—':>6} "
                     f"{e.get('transitions', 0):>12} "
                     f"{e.get('tail_rv', 0):>8} "
-                    f"{e.get('tailed_events', 0):>7}")
+                    f"{e.get('tailed_events', 0):>7} "
+                    f"{e.get('self_demotions', 0):>7}")
+            # Any self-demotion on the board means the lease ladder rung
+            # engaged at least once this process lifetime: say so.
+            if any(e.get("self_demotions") for e in electors):
+                lines.append("  !! lease ladder engaged: a leader self-"
+                             "demoted after failed renewals (coordinator "
+                             "partition) — see docs/operations.md "
+                             "failure-modes matrix")
     auto = src.get("autoscale")
     if auto:
         lines.append(

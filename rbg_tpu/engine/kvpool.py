@@ -49,18 +49,26 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from rbg_tpu.engine.protocol import recv_msg, send_msg, token_ok
+from rbg_tpu.kvtransfer.chunks import payload_checksum
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.utils.locktrace import named_lock
 from rbg_tpu.utils.racetrace import guard as _race_guard
 
 
 class _Node:
     __slots__ = ("key", "k", "v", "children", "parent", "last_used",
-                 "nbytes", "dirkey", "hits")
+                 "nbytes", "dirkey", "hits", "crc")
 
     def __init__(self, key: Tuple[int, ...], parent):
         self.key = key                    # page_size tokens
         self.k: Optional[np.ndarray] = None   # [L, page, KV, hd]
         self.v: Optional[np.ndarray] = None
+        # Payload checksum minted when the page payload was stored (None
+        # until then) — verified on every match/extend so bytes that
+        # rotted while resident (or were poisoned over the wire) are
+        # dropped as a miss instead of served (spill→promote rides this).
+        self.crc: Optional[int] = None
         self.children: Dict[int, "_Node"] = {}
         self.parent = parent
         self.last_used = time.monotonic()
@@ -116,7 +124,7 @@ class KVPoolStore:
         ps = self.page_size
         with self._lock:
             node = self.root
-            ks, vs = [], []
+            ks, vs, run = [], [], []
             i, n = 0, (len(tokens) // ps) * ps
             now = time.monotonic()
             while i < n:
@@ -127,6 +135,7 @@ class KVPoolStore:
                 child.hits += 1
                 ks.append(child.k)
                 vs.append(child.v)
+                run.append(child)
                 i += ps
                 node = child
             if not ks:
@@ -137,8 +146,13 @@ class KVPoolStore:
         # The payload copy happens OUTSIDE the lock: stored arrays are
         # immutable (eviction only drops references; our refs keep them
         # alive), and a multi-MB np.stack under the global lock would
-        # serialize every other replica's match/put behind it.
-        return i, np.stack(ks, axis=1), np.stack(vs, axis=1)
+        # serialize every other replica's match/put behind it. Checksum
+        # verification rides the same rationale.
+        good = self._verify_run(run, ks, vs)
+        if good == 0:
+            return 0, None, None
+        return (good * ps, np.stack(ks[:good], axis=1),
+                np.stack(vs[:good], axis=1))
 
     def extend(self, tokens: List[int], start_tokens: int,
                take: bool = False,
@@ -188,17 +202,27 @@ class KVPoolStore:
                 return 0, None, None
             self.metrics["hits"] += 1
             self.metrics["hit_tokens"] += i - start_tokens
-            if take:
-                for nd in run:
+        # Verify OUTSIDE the lock (match() rationale) and only then take:
+        # a corrupt page must not be promoted to the device tier, and the
+        # pages behind it must stay resident here for the next hit.
+        good = self._verify_run(run, ks, vs)
+        if good == 0:
+            return 0, None, None
+        if take:
+            with self._lock:
+                for nd in run[:good]:
+                    if nd.placeholder:
+                        continue   # a racing take already moved it
                     self.bytes -= nd.nbytes
                     self.metrics["pages"] -= 1
                     nd.k = nd.v = None
+                    nd.crc = None
                     nd.nbytes = 0
                     nd.dirkey = ""   # caller re-registers as device tier
         # Stack outside the lock (match() rationale); the local ks/vs
         # refs keep taken arrays alive past the placeholder conversion.
-        return (i - start_tokens, np.stack(ks, axis=1),
-                np.stack(vs, axis=1))
+        return (good * ps, np.stack(ks[:good], axis=1),
+                np.stack(vs[:good], axis=1))
 
     def peek(self, tokens: List[int], start_tokens: int = 0) -> int:
         """Advisory payload-run depth below ``start_tokens`` — no LRU or
@@ -223,6 +247,39 @@ class KVPoolStore:
                 node = child
         return i - start_tokens
 
+    # ---- integrity ----
+
+    def _verify_run(self, run: List[_Node], ks: List[np.ndarray],
+                    vs: List[np.ndarray]) -> int:
+        """Checksum-verify a matched payload run OUTSIDE the lock (the
+        arrays are immutable once stored). Returns the count of leading
+        good pages. The first corrupt page is dropped from the store and
+        its directory claim invalidated — a rotted page must neither be
+        served nor stay resident to poison the next lookup; the caller's
+        hit simply ends one page earlier (graceful, never wrong)."""
+        for j, nd in enumerate(run):
+            crc = nd.crc
+            if crc is None or payload_checksum(ks[j], vs[j]) == crc:
+                continue
+            REGISTRY.inc(obs_names.KVT_INTEGRITY_FAILURES_TOTAL,
+                         surface="pool")
+            dirkey = ""
+            with self._lock:
+                if not nd.placeholder:
+                    self.bytes -= nd.nbytes
+                    self.metrics["pages"] -= 1
+                    self.metrics["evicted_pages"] += 1
+                    nd.k = nd.v = None
+                    nd.crc = None
+                    nd.nbytes = 0
+                    dirkey, nd.dirkey = nd.dirkey, ""
+            if dirkey and self.directory is not None:
+                self.directory.invalidate_keys(
+                    [dirkey], reason="integrity",
+                    backend=self.owner_backend)
+            return j
+        return len(run)
+
     # ---- insert ----
 
     def put(self, tokens: List[int], k: np.ndarray, v: np.ndarray,
@@ -246,18 +303,21 @@ class KVPoolStore:
         for pi in range(n // ps):
             if pi < data_from_page:
                 staged.append((tuple(tokens[pi * ps:(pi + 1) * ps]),
-                               None, None, ""))
+                               None, None, "", None))
             else:
                 ci = pi - data_from_page
+                kp = np.ascontiguousarray(k[:, ci])
+                vp = np.ascontiguousarray(v[:, ci])
+                # Checksum minted at store time, outside the lock like
+                # the payload copy — the match/extend verify leg reads it.
                 staged.append((tuple(tokens[pi * ps:(pi + 1) * ps]),
-                               np.ascontiguousarray(k[:, ci]),
-                               np.ascontiguousarray(v[:, ci]),
-                               dirkeys[pi]))
+                               kp, vp, dirkeys[pi],
+                               payload_checksum(kp, vp)))
         new_pages = 0
         with self._lock:
             node = self.root
             now = time.monotonic()
-            for key, kp, vp, dk in staged:
+            for key, kp, vp, dk, crc in staged:
                 child = node.children.get(key)
                 if child is not None:
                     child.last_used = now
@@ -267,6 +327,7 @@ class KVPoolStore:
                         child.k, child.v = kp, vp
                         child.nbytes = kp.nbytes + vp.nbytes
                         child.dirkey = dk
+                        child.crc = crc
                         self.bytes += child.nbytes
                         new_pages += 1
                     node = child
@@ -280,6 +341,7 @@ class KVPoolStore:
                     child.k, child.v = kp, vp
                     child.nbytes = kp.nbytes + vp.nbytes
                     child.dirkey = dk
+                    child.crc = crc
                     self.bytes += child.nbytes
                     new_pages += 1
                 node.children[key] = child
@@ -411,11 +473,16 @@ class _Handler(socketserver.BaseRequestHandler):
             if matched == 0:
                 send_msg(self.request, {"matched": 0})
             else:
+                kb, vb = km.tobytes(), vm.tobytes()
+                # End-to-end: the checksum covers the stacked payload as
+                # sent, so a peer fetch is verified at the RECEIVER —
+                # corruption on this hop degrades to a miss, never KV.
                 send_msg(self.request, {
                     "matched": matched,
                     "k_shape": list(km.shape), "v_shape": list(vm.shape),
                     "dtype": str(km.dtype),
-                }, km.tobytes(), vm.tobytes())
+                    "checksum": payload_checksum(kb, vb),
+                }, kb, vb)
         elif op == "pool_put":
             ks = np.frombuffer(k, dtype=obj["dtype"]).reshape(obj["k_shape"])
             vs = np.frombuffer(v, dtype=obj["dtype"]).reshape(obj["v_shape"])
@@ -539,6 +606,14 @@ class KVPoolClient:
         if obj.get("error"):
             raise RuntimeError(obj["error"])
         if obj["matched"] == 0:
+            return 0, None, None
+        cs = obj.get("checksum")
+        if cs is not None \
+                and payload_checksum(k or b"", v or b"") != int(cs):
+            # Bytes rotted on the peer-fetch hop: a miss (the caller
+            # recomputes — correct and cheap), never corrupt KV.
+            REGISTRY.inc(obs_names.KVT_INTEGRITY_FAILURES_TOTAL,
+                         surface="peer_fetch")
             return 0, None, None
         km = np.frombuffer(k, dtype=obj["dtype"]).reshape(obj["k_shape"])
         vm = np.frombuffer(v, dtype=obj["dtype"]).reshape(obj["v_shape"])

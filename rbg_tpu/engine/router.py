@@ -556,6 +556,18 @@ class RouterState:
             except Exception:
                 pass
 
+    def publish_ingress(self) -> None:
+        """Publish this process's CUMULATIVE ingress token counters on
+        the tier feed (the wire-form twin of ``note_ingress`` for members
+        whose tier object is remote). Cumulative on purpose: the tier
+        folds watermark deltas, and a restart that zeroes these counters
+        reads as a counter restart (full-value fold), never a negative
+        delta — the PR-8 convention, now load-bearing for the ratio."""
+        totals = {k: REGISTRY.counter(obs_names.ROUTER_INGRESS_TOKENS_TOTAL,
+                                      kind=k)
+                  for k in ("prefill", "decode")}
+        self._tier_publish("ingress", {"totals": totals})
+
     def on_peer_event(self, ev: dict) -> None:
         """Receive one router-to-router feed event: peers' backend
         health/draining transitions and measured link rates fold into

@@ -131,9 +131,9 @@ class HashRing:
 
 class _Member:
     __slots__ = ("name", "state", "draining", "outstanding", "ingress",
-                 "link_rates", "peer_events")
+                 "link_rates", "peer_events", "last_seen", "ingress_last")
 
-    def __init__(self, name: str, state=None):
+    def __init__(self, name: str, state=None, now: float = 0.0):
         self.name = name
         self.state = state           # optional RouterState back-reference
         self.draining = False
@@ -141,6 +141,13 @@ class _Member:
         self.ingress = {"prefill": 0.0, "decode": 0.0}
         self.link_rates: Dict[str, float] = {}
         self.peer_events = 0
+        # Last instant this member showed life on the feed (registration,
+        # any published event, its own ingress notes) — the per-peer
+        # staleness TTL ages routing eligibility off it.
+        self.last_seen = now
+        # Per-kind last CUMULATIVE ingress totals seen from this member's
+        # EV_INGRESS events — the counter-restart fold's watermark.
+        self.ingress_last: Dict[str, float] = {}
 
 
 class MemberDown(Exception):
@@ -158,10 +165,17 @@ class RouterTier:
 
     def __init__(self, name: str = "tier", vnodes: int = VNODES,
                  bounded_load: float = BOUNDED_LOAD_FACTOR,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 peer_stale_after_s: Optional[float] = None):
         self.name = name
         self.ring = HashRing(vnodes)
         self.bounded_load = float(bounded_load)
+        # Per-peer staleness TTL: a member silent on the feed for longer
+        # than this is EXCLUDED from routing (a router must not steer at
+        # backends whose health it can no longer observe) until it speaks
+        # again. None (default) = off — single-process tiers with no
+        # heartbeat traffic must not age themselves out.
+        self.peer_stale_after_s = peer_stale_after_s
         self._clock = clock or time.monotonic
         self._lock = named_lock("engine.tier")
         self._members: Dict[str, _Member] = {}   # guarded_by[engine.tier]
@@ -177,11 +191,15 @@ class RouterTier:
         the member an in-process peer: events fan in through its
         ``on_peer_event``."""
         with self._lock:
+            now = self._clock()
             if name not in self._members:
-                self._members[name] = _Member(name, state)
+                self._members[name] = _Member(name, state, now=now)
                 self.ring.add(name)
-            elif state is not None:
-                self._members[name].state = state
+            else:
+                m = self._members[name]
+                m.last_seen = now
+                if state is not None:
+                    m.state = state
             n = len(self.ring)
         REGISTRY.set_gauge(obs_names.ROUTER_RING_MEMBERS, float(n),
                            tier=self.name)
@@ -214,7 +232,13 @@ class RouterTier:
         eligible ring successor (consistent spill: the same overloaded
         key always spills to the same peer). Returns None on an empty
         tier."""
+        stale_cut = None
+        n_stale = 0
         with self._lock:
+            if self.peer_stale_after_s is not None:
+                stale_cut = self._clock() - self.peer_stale_after_s
+                n_stale = sum(1 for m in self._members.values()
+                              if m.last_seen < stale_cut)
             order = self.ring.owners(key)
             if not order:
                 return None
@@ -226,11 +250,23 @@ class RouterTier:
                 m = self._members.get(cand)
                 if m is None or m.draining:
                     continue
+                if stale_cut is not None and m.last_seen < stale_cut:
+                    # Silent past the TTL: maybe partitioned, maybe dead —
+                    # either way its health view is fiction. Its ranges
+                    # spill to ring successors until it speaks again.
+                    continue
                 if pick is None:
                     pick = cand      # first non-draining = fallback floor
                 if m.outstanding <= limit:
                     pick = cand
                     break
+        if stale_cut is not None:
+            # Tier-level, not per-decision: ANY stale member means the
+            # ladder rung is engaged (its ranges are spilling), whether
+            # or not this particular key's walk touched it.
+            REGISTRY.set_gauge(obs_names.DEGRADED_MODE,
+                               1.0 if n_stale else 0.0,
+                               ladder="peer_feed")
         if pick is not None:
             REGISTRY.inc(obs_names.ROUTER_RING_ROUTES_TOTAL,
                          tier=self.name, member=pick)
@@ -258,19 +294,42 @@ class RouterTier:
               "payload": payload, "t": self._clock()}
         with self._lock:
             self.events_published += 1
+            m = self._members.get(origin)
+            if m is not None:
+                # Any event is proof of life — the staleness TTL feeds
+                # off this watermark.
+                m.last_seen = ev["t"]
             if kind == EV_DRAINING and "router" in payload:
-                m = self._members.get(origin)
                 if m is not None:
                     m.draining = bool(payload.get("draining"))
             if kind == EV_LINK_RATES:
-                m = self._members.get(origin)
                 if m is not None:
                     for a, r in (payload.get("rates") or {}).items():
                         try:
                             m.link_rates[a] = float(r)
                         except (TypeError, ValueError):
                             continue
-            targets = [m for n, m in self._members.items() if n != origin]
+            if kind == EV_INGRESS and m is not None:
+                # Payload carries CUMULATIVE per-kind totals. Fold the
+                # delta against this member's watermark; a total BELOW
+                # the watermark is a counter restart (the member came
+                # back under the same --router-id with zeroed counters,
+                # PR-8 convention) — fold the full new value, never a
+                # negative delta that would poison the topology ratio.
+                for k, tot in (payload.get("totals") or {}).items():
+                    try:
+                        tot = float(tot)
+                    except (TypeError, ValueError):
+                        continue
+                    last = m.ingress_last.get(k)
+                    delta = tot if (last is None or tot < last) \
+                        else tot - last
+                    m.ingress_last[k] = tot
+                    if delta > 0:
+                        m.ingress[k] = m.ingress.get(k, 0.0) + delta
+                        self._ingress_log.append((ev["t"], origin, k,
+                                                  delta))
+            targets = [mm for n, mm in self._members.items() if n != origin]
         delivered = 0
         for m in targets:
             st = m.state
@@ -321,6 +380,7 @@ class RouterTier:
             m = self._members.get(name)
             if m is not None:
                 m.ingress[kind] = m.ingress.get(kind, 0.0) + float(n)
+                m.last_seen = max(m.last_seen, t)  # its own heartbeat
             self._ingress_log.append((t, name, kind, float(n)))
 
     def ingress_totals(self) -> Dict[str, float]:
@@ -354,15 +414,21 @@ class RouterTier:
 
     def snapshot(self) -> dict:
         with self._lock:
+            now = self._clock()
             members = {
                 n: {"draining": m.draining, "outstanding": m.outstanding,
                     "ingress": dict(m.ingress), "peer_events": m.peer_events,
+                    "silent_s": round(max(0.0, now - m.last_seen), 3),
+                    "stale": bool(self.peer_stale_after_s is not None
+                                  and now - m.last_seen
+                                  > self.peer_stale_after_s),
                     "link_rates": {a: round(r, 1)
                                    for a, r in m.link_rates.items()}}
                 for n, m in self._members.items()}
             return {"tier": self.name, "members": members,
                     "ring": self.ring.members(),
                     "events_published": self.events_published,
+                    "peer_stale_after_s": self.peer_stale_after_s,
                     "bounded_load": self.bounded_load}
 
 

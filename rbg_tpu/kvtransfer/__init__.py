@@ -6,11 +6,12 @@ cache-aware and transfer-cost-aware routing consults. See
 docs/architecture.md "KV transfer plane".
 """
 
-from rbg_tpu.kvtransfer.chunks import (ChunkAssembler, KVChunk, StreamError,
+from rbg_tpu.kvtransfer.chunks import (ChunkAssembler, KVChunk,
+                                       KVIntegrityError, StreamError,
                                        StreamFin, StreamFirstToken,
                                        StreamMeta, bundle_to_frames,
-                                       plan_chunks, prefix_keys,
-                                       slab_to_chunks)
+                                       payload_checksum, plan_chunks,
+                                       prefix_keys, slab_to_chunks)
 from rbg_tpu.kvtransfer.directory import DirectoryClient, PrefixDirectory
 from rbg_tpu.kvtransfer.stream import KVStreamReceiver, StreamRegistry
 from rbg_tpu.kvtransfer.transport import (FakeICITransport, InProcTransport,
@@ -19,9 +20,9 @@ from rbg_tpu.kvtransfer.transport import (FakeICITransport, InProcTransport,
                                           frame_from_wire, frame_to_wire)
 
 __all__ = [
-    "ChunkAssembler", "KVChunk", "StreamError", "StreamFin",
-    "StreamFirstToken", "StreamMeta", "bundle_to_frames", "plan_chunks",
-    "prefix_keys", "slab_to_chunks",
+    "ChunkAssembler", "KVChunk", "KVIntegrityError", "StreamError",
+    "StreamFin", "StreamFirstToken", "StreamMeta", "bundle_to_frames",
+    "payload_checksum", "plan_chunks", "prefix_keys", "slab_to_chunks",
     "DirectoryClient", "PrefixDirectory",
     "KVStreamReceiver", "StreamRegistry",
     "FakeICITransport", "InProcTransport", "LinkStats",

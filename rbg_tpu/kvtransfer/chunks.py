@@ -25,11 +25,15 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import zlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from rbg_tpu.api.errors import CODE_KV_STREAM  # dependency-free catalog
+from rbg_tpu.api.errors import (CODE_KV_INTEGRITY,  # dependency-free catalog
+                                CODE_KV_STREAM)
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs.metrics import REGISTRY
 
 
 @dataclasses.dataclass
@@ -68,6 +72,10 @@ class KVChunk:
     page_hi: int
     k_bytes: bytes
     v_bytes: bytes
+    # End-to-end payload checksum minted by the PRODUCER (slab_to_chunks)
+    # and verified at decode commit (ChunkAssembler.feed) — None only for
+    # frames from a pre-checksum sender (back-compat: verify when present).
+    checksum: Optional[int] = None
 
     @property
     def nbytes(self) -> int:
@@ -105,6 +113,24 @@ class StreamError(RuntimeError):
     wire_code = CODE_KV_STREAM
 
 
+class KVIntegrityError(StreamError):
+    """A KV payload failed its end-to-end checksum — bytes corrupted
+    between the producer's compute and the consumer's commit. A subclass
+    of ``StreamError`` so every existing recovery path (receiver error
+    surface, router bundle-fallback replay) engages unchanged; the
+    distinct ``wire_code`` keeps "bytes lied" separable from "link
+    flaked" at the edge and in accounting."""
+
+    wire_code = CODE_KV_INTEGRITY
+
+
+def payload_checksum(k_bytes: bytes, v_bytes: bytes) -> int:
+    """CRC32 over the concatenated K+V payload — cheap enough to run on
+    every chunk/page, strong enough to catch the bit flips and torn
+    writes partitioned links actually produce (not an adversarial MAC)."""
+    return zlib.crc32(v_bytes, zlib.crc32(k_bytes))
+
+
 def plan_chunks(meta: StreamMeta, page_lo: int, page_hi: int,
                 layer_split: int) -> List[Tuple[int, int, int, int]]:
     """(layer_lo, layer_hi, page_lo, page_hi) plan for one page group,
@@ -128,13 +154,15 @@ def slab_to_chunks(meta: StreamMeta, k_slab: np.ndarray, v_slab: np.ndarray,
     pages = k_slab.shape[1]
     for i, (llo, lhi, plo, phi) in enumerate(
             plan_chunks(meta, page_lo, page_lo + pages, layer_split)):
+        kb = np.ascontiguousarray(
+            k_slab[llo:lhi, plo - page_lo:phi - page_lo]).tobytes()
+        vb = np.ascontiguousarray(
+            v_slab[llo:lhi, plo - page_lo:phi - page_lo]).tobytes()
         chunks.append(KVChunk(
             stream_id=meta.stream_id, seq=seq0 + i,
             layer_lo=llo, layer_hi=lhi, page_lo=plo, page_hi=phi,
-            k_bytes=np.ascontiguousarray(
-                k_slab[llo:lhi, plo - page_lo:phi - page_lo]).tobytes(),
-            v_bytes=np.ascontiguousarray(
-                v_slab[llo:lhi, plo - page_lo:phi - page_lo]).tobytes(),
+            k_bytes=kb, v_bytes=vb,
+            checksum=payload_checksum(kb, vb),
         ))
     return chunks
 
@@ -177,7 +205,9 @@ class ChunkAssembler:
         self.fin: Optional[StreamFin] = None
         self.chunks_seen = 0
         self.dup_chunks = 0
+        self.reordered_chunks = 0
         self.bytes_seen = 0
+        self._max_seq = -1
         # (layer_lo, layer_hi, page_lo, page_hi) cells already applied —
         # the "new for the page table" delta the committer drains.
         self._uncommitted: List[Tuple[int, int, int, int]] = []
@@ -212,8 +242,30 @@ class ChunkAssembler:
                 f"[{ch.page_lo},{ch.page_hi})")
         if self._have[ch.layer_lo:ch.layer_hi,
                       ch.page_lo:ch.page_hi].all():
+            # Retransmit of cells already committed — tolerated, but a
+            # degrading link retransmits before it truncates: count it.
             self.dup_chunks += 1
+            REGISTRY.inc(obs_names.KVT_CHUNKS_DUPLICATE_TOTAL)
             return
+        if ch.seq < self._max_seq:
+            # Arrived after a higher seq (duplicates excluded above):
+            # the link is reordering — visible before it corrupts.
+            self.reordered_chunks += 1
+            REGISTRY.inc(obs_names.KVT_CHUNKS_REORDERED_TOTAL)
+        self._max_seq = max(self._max_seq, ch.seq)
+        if ch.checksum is not None \
+                and payload_checksum(ch.k_bytes, ch.v_bytes) != ch.checksum:
+            # Verified BEFORE the bytes touch the assembly buffers: a
+            # corrupt payload never becomes committable KV. The error
+            # rides the receiver's structured-failure surface, so the
+            # router replays the whole stream token-exact (bundle
+            # fallback) — never a wedge, never silent corruption.
+            REGISTRY.inc(obs_names.KVT_INTEGRITY_FAILURES_TOTAL,
+                         surface="chunk")
+            raise KVIntegrityError(
+                f"chunk seq={ch.seq} layers [{ch.layer_lo},{ch.layer_hi}) "
+                f"pages [{ch.page_lo},{ch.page_hi}) failed its payload "
+                f"checksum — corrupted in flight")
         self.k[ch.layer_lo:ch.layer_hi, ch.page_lo:ch.page_hi] = \
             np.frombuffer(ch.k_bytes, dt).reshape(kshape)
         self.v[ch.layer_lo:ch.layer_hi, ch.page_lo:ch.page_hi] = \

@@ -244,13 +244,18 @@ class DirectoryClient:
     def __init__(self, addr: str, timeout: float = 2.0,
                  token: Optional[str] = None,
                  page_size: Optional[int] = None,
-                 backoff_s: float = 0.5, backoff_max_s: float = 30.0):
+                 backoff_s: float = 0.5, backoff_max_s: float = 30.0,
+                 chaos=None):
         import os
         from rbg_tpu.runtime.queue import ExponentialBackoff
         self.addr = addr
         self.timeout = timeout
         self.page_size = page_size
         self.backoff_s = backoff_s
+        # Fault-injection hook (chaos.inject.directory_fault): called
+        # inside the request try-block so an injected OSError rides the
+        # REAL failure path (breaker, degraded gauge). None in production.
+        self._chaos = chaos
         self._backoff = ExponentialBackoff(base=backoff_s,
                                            max_delay=backoff_max_s,
                                            jitter=True)
@@ -258,27 +263,51 @@ class DirectoryClient:
                       else os.environ.get("RBG_DATA_TOKEN") or None)
         self._lock = named_lock("kvtransfer.dirclient")
         self._down_until = 0.0   # guarded_by[kvtransfer.dirclient]
+        # True while ONE caller owns the half-open probe (see _call).
+        self._probing = False    # guarded_by[kvtransfer.dirclient]
 
     def _call(self, obj: dict) -> Optional[dict]:
         from rbg_tpu.engine.protocol import request_once
+        # Half-open single-flight: while the breaker window is open every
+        # caller degrades instantly (local-affinity fast path). When the
+        # window closes, exactly ONE caller becomes the probe; concurrent
+        # callers keep degrading until the probe's verdict lands — N
+        # routers recovering must not thundering-herd the pool host.
+        probe = False
         with self._lock:
             if time.monotonic() < self._down_until:
                 return None
+            if self._down_until > 0.0:
+                if self._probing:
+                    return None
+                self._probing = probe = True
         if self.token:
             obj = dict(obj, token=self.token)
         try:
+            if self._chaos is not None:
+                self._chaos()
             resp, _, _ = request_once(self.addr, obj, timeout=self.timeout)
         except (OSError, ValueError):
             with self._lock:
                 delay = self._backoff.next_delay(self.addr)
                 self._down_until = time.monotonic() + delay
+                self._probing = False
             REGISTRY.inc(obs_names.KVT_DIR_BREAKER_OPEN_TOTAL)
+            # Ladder rung engaged: the router serves on, affinity-only.
+            REGISTRY.set_gauge(obs_names.DEGRADED_MODE, 1.0,
+                               ladder="directory")
             return None
         if not isinstance(resp, dict) or resp.get("error"):
+            if probe:
+                with self._lock:
+                    self._probing = False
             return None
         with self._lock:
             self._backoff.forget(self.addr)
             self._down_until = 0.0
+            self._probing = False
+        REGISTRY.set_gauge(obs_names.DEGRADED_MODE, 0.0,
+                           ladder="directory")
         return resp
 
     def register_keys(self, keys: List[str], backend: str,

@@ -286,11 +286,15 @@ def frame_to_wire(frame: Frame):
                  "dtype": frame.dtype, "layers": frame.layers,
                  "page_size": frame.page_size}, None, None)
     if isinstance(frame, KVChunk):
-        return ({"op": "kv_chunk", "stream_id": frame.stream_id,
-                 "seq": frame.seq, "layer_lo": frame.layer_lo,
-                 "layer_hi": frame.layer_hi, "page_lo": frame.page_lo,
-                 "page_hi": frame.page_hi},
-                frame.k_bytes, frame.v_bytes)
+        hdr = {"op": "kv_chunk", "stream_id": frame.stream_id,
+               "seq": frame.seq, "layer_lo": frame.layer_lo,
+               "layer_hi": frame.layer_hi, "page_lo": frame.page_lo,
+               "page_hi": frame.page_hi}
+        if frame.checksum is not None:
+            # Omitted (not null) when absent so pre-checksum receivers
+            # never see an unknown key with a surprising value.
+            hdr["checksum"] = frame.checksum
+        return (hdr, frame.k_bytes, frame.v_bytes)
     if isinstance(frame, StreamFirstToken):
         return ({"op": "kv_first", "stream_id": frame.stream_id,
                  "first_token": frame.first_token}, None, None)
@@ -313,12 +317,14 @@ def frame_from_wire(obj: dict, k: Optional[bytes],
                           dtype=obj["dtype"], layers=int(obj["layers"]),
                           page_size=int(obj["page_size"]))
     if op == "kv_chunk":
+        cs = obj.get("checksum")
         return KVChunk(stream_id=obj["stream_id"], seq=int(obj["seq"]),
                        layer_lo=int(obj["layer_lo"]),
                        layer_hi=int(obj["layer_hi"]),
                        page_lo=int(obj["page_lo"]),
                        page_hi=int(obj["page_hi"]),
-                       k_bytes=k or b"", v_bytes=v or b"")
+                       k_bytes=k or b"", v_bytes=v or b"",
+                       checksum=int(cs) if cs is not None else None)
     if op == "kv_first":
         return StreamFirstToken(obj["stream_id"], int(obj["first_token"]))
     if op == "kv_fin":

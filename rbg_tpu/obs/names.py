@@ -93,6 +93,11 @@ PLANE_LEADER_TRANSITIONS_TOTAL = "rbg_plane_leader_transitions_total"
 PLANE_FENCED_WRITES_TOTAL = "rbg_plane_fenced_writes_total"
 PLANE_STANDBY_TAIL_EVENTS_TOTAL = "rbg_plane_standby_tail_events_total"
 KVT_DIR_BREAKER_OPEN_TOTAL = "rbg_kvtransfer_dir_breaker_open_total"
+KVT_CHUNKS_DUPLICATE_TOTAL = "rbg_kvtransfer_chunks_duplicate_total"
+KVT_CHUNKS_REORDERED_TOTAL = "rbg_kvtransfer_chunks_reordered_total"
+KVT_INTEGRITY_FAILURES_TOTAL = "rbg_kvtransfer_integrity_failures_total"
+CHAOS_FAULTS_INJECTED_TOTAL = "rbg_chaos_faults_injected_total"
+PLANE_SELF_DEMOTIONS_TOTAL = "rbg_plane_self_demotions_total"
 
 # ---- gauges (last-write-wins) ----
 
@@ -118,6 +123,7 @@ ROUTER_RING_MEMBERS = "rbg_router_ring_members"
 PLANE_LEADER_STATE = "rbg_plane_leader_state"
 PLANE_LEADER_EPOCH = "rbg_plane_leader_epoch"
 SERVING_RETRY_BUDGET_TOKENS = "rbg_serving_retry_budget_tokens"
+DEGRADED_MODE = "rbg_degraded_mode"
 
 # ---- histograms ----
 
@@ -212,6 +218,11 @@ COUNTERS = frozenset({
     PLANE_FENCED_WRITES_TOTAL,
     PLANE_STANDBY_TAIL_EVENTS_TOTAL,
     KVT_DIR_BREAKER_OPEN_TOTAL,
+    KVT_CHUNKS_DUPLICATE_TOTAL,
+    KVT_CHUNKS_REORDERED_TOTAL,
+    KVT_INTEGRITY_FAILURES_TOTAL,
+    CHAOS_FAULTS_INJECTED_TOTAL,
+    PLANE_SELF_DEMOTIONS_TOTAL,
 })
 
 GAUGES = frozenset({
@@ -237,6 +248,7 @@ GAUGES = frozenset({
     PLANE_LEADER_STATE,
     PLANE_LEADER_EPOCH,
     SERVING_RETRY_BUDGET_TOKENS,
+    DEGRADED_MODE,
 })
 
 HISTOGRAMS = frozenset({
@@ -495,6 +507,32 @@ HELP = {
         "Retry-budget tokens currently available in THIS router process "
         "(fleet-wide effective budget is N x per-replica after router "
         "scale-out)",
+    KVT_CHUNKS_DUPLICATE_TOTAL:
+        "KV chunk frames delivered more than once (already fully "
+        "written when they arrived) — a degrading link retransmits "
+        "before it truncates",
+    KVT_CHUNKS_REORDERED_TOTAL:
+        "KV chunk frames that arrived out of send order (a lower seq "
+        "after a higher one, duplicates excluded) — reorder depth is a "
+        "link-health leading indicator",
+    KVT_INTEGRITY_FAILURES_TOTAL:
+        "KV payloads whose bytes failed their end-to-end checksum, per "
+        "surface (chunk = wire frame at decode commit, pool = cached "
+        "page at match/extend, peer_fetch = directory-advertised "
+        "remote page) — every one was refused, never served",
+    CHAOS_FAULTS_INJECTED_TOTAL:
+        "Faults the deterministic chaos plane injected, per kind "
+        "(partition / corrupt / skew / brownout) — drill-only; nonzero "
+        "in production means a chaos schedule leaked into prod config",
+    PLANE_SELF_DEMOTIONS_TOTAL:
+        "Leaders that stepped down proactively because lease renewal "
+        "stopped landing (partition from the lease store) before their "
+        "TTL could expire under a contending standby, per plane",
+    DEGRADED_MODE:
+        "1 while a graceful-degradation ladder rung is engaged, per "
+        "ladder (directory = local-affinity-only routing, peer_feed = "
+        "stale tier members excluded from the ring, lease = leader "
+        "self-demoted on renewal failure) — 0 after heal",
 }
 
 # ---- span names (obs/trace.py) ----

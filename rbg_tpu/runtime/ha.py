@@ -122,13 +122,20 @@ class LeaderElector:
                  lease: str = DEFAULT_LEASE, ttl_s: float = 3.0,
                  renew_period_s: Optional[float] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 tail: bool = True):
+                 tail: bool = True, self_demote_frac: float = 0.5):
         self.name = name
         self.store = store
         self.lease = lease
         self.ttl_s = float(ttl_s)
         self.renew_period_s = (float(renew_period_s) if renew_period_s
                                else max(self.ttl_s / 3.0, 0.01))
+        # When renewals RAISE (lease store unreachable — distinct from a
+        # clean "deposed" refusal) the leader self-demotes once this
+        # fraction of the TTL has passed since its last confirmed renewal:
+        # strictly BEFORE a standby's TTL takeover can mint a new epoch,
+        # so two planes never actuate concurrently even while fenced
+        # writes still land on a reachable data store.
+        self.self_demote_frac = float(self_demote_frac)
         self._clock = clock or time.monotonic
         self._plane_factory = plane_factory
         self._lock = named_lock("runtime.ha")
@@ -139,6 +146,9 @@ class LeaderElector:
         self.transitions = 0         # guarded_by[runtime.ha]
         self.tailed_events = 0       # guarded_by[runtime.ha]
         self.tail_rv = 0             # guarded_by[runtime.ha]
+        self.self_demotions = 0      # guarded_by[runtime.ha]
+        self._last_renew_ok = 0.0    # guarded_by[runtime.ha]
+        self.catchup_lag_rv = 0
         self._tail = tail
         self._stop = threading.Event()
         self._killed = False
@@ -161,9 +171,15 @@ class LeaderElector:
         standby's warm resume point. ``WatchExpired`` cannot happen from
         ``current_rv()`` but the re-list fallback stays for parity with
         real reflector resumes."""
+        rv = self.store.current_rv()
+        with self._lock:
+            # Subscribing at rv MEANS current-as-of rv: the watermark
+            # starts there, not at 0 — catch-up only measures writes
+            # made after this point that the tail hasn't delivered yet.
+            if rv > self.tail_rv:
+                self.tail_rv = rv
         try:
-            self.store.watch("*", self._on_tail_event,
-                             since_rv=self.store.current_rv())
+            self.store.watch("*", self._on_tail_event, since_rv=rv)
         except WatchExpired:
             self.store.watch("*", self._on_tail_event)
 
@@ -195,15 +211,42 @@ class LeaderElector:
         with self._lock:
             leading, epoch = self.is_leader, self.epoch
         if leading:
-            if not self.store.renew_lease(self.lease, self.name, epoch,
-                                          self.ttl_s, now=t):
+            try:
+                renewed = self.store.renew_lease(self.lease, self.name,
+                                                 epoch, self.ttl_s, now=t)
+            except Exception:
+                # The lease store RAISED — partitioned from the
+                # coordinator, not cleanly deposed. Our fenced data-store
+                # writes may still be landing, so waiting for a standby's
+                # TTL takeover to fence us out is a race. Self-demote
+                # once self_demote_frac of the TTL has passed without a
+                # confirmed renewal: strictly before the lease can
+                # expire, so the old and new plane never overlap.
+                with self._lock:
+                    last_ok = self._last_renew_ok
+                if t - last_ok >= self.ttl_s * self.self_demote_frac:
+                    with self._lock:
+                        self.self_demotions += 1
+                    REGISTRY.inc(obs_names.PLANE_SELF_DEMOTIONS_TOTAL,
+                                 plane=self.name)
+                    REGISTRY.set_gauge(obs_names.DEGRADED_MODE, 1.0,
+                                       ladder="lease")
+                    self._step_down(reason="renew_failed")
+                return
+            if not renewed:
                 self._step_down(reason="deposed")
             else:
+                with self._lock:
+                    self._last_renew_ok = t
+                REGISTRY.set_gauge(obs_names.DEGRADED_MODE, 0.0,
+                                   ladder="lease")
                 self._publish_state()
             return
         got = self.store.acquire_lease(self.lease, self.name, self.ttl_s,
                                        now=t)
         if got is not None:
+            with self._lock:
+                self._last_renew_ok = t
             self._become_leader(got)
 
     def _become_leader(self, epoch: int) -> None:
@@ -226,12 +269,34 @@ class LeaderElector:
         REGISTRY.inc(obs_names.PLANE_LEADER_TRANSITIONS_TOTAL,
                      plane=self.name)
         self._publish_state()
+        self._await_tail_catchup()
         try:
             plane.start()
             span.end(outcome="leading")
         except Exception as e:
             span.end(outcome="error", error=type(e).__name__)
             raise
+
+    def _await_tail_catchup(self, timeout_s: float = 2.0) -> None:
+        """A standby behind on its watch tail finishes catch-up BEFORE
+        actuating. Controllers list-sync at start, but the resume
+        watermark (``tail_rv``) is what proves the standby has SEEN every
+        write up to the takeover point — actuating ahead of it risks
+        replaying a decision the dead leader already superseded. Bounded
+        by wall time (the drill clock may be scripted and frozen); watch
+        delivery is synchronous in-process so the common case exits on
+        the first check."""
+        if not self._tail:
+            return
+        target = self.store.current_rv()
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                lag = target - self.tail_rv
+            if lag <= 0 or time.monotonic() >= deadline:
+                self.catchup_lag_rv = max(0, lag)
+                return
+            time.sleep(0.002)
 
     def _step_down(self, reason: str) -> None:
         with self._lock:
@@ -305,6 +370,7 @@ class LeaderElector:
                 "transitions": self.transitions,
                 "tailed_events": self.tailed_events,
                 "tail_rv": self.tail_rv,
+                "self_demotions": self.self_demotions,
                 "ttl_s": self.ttl_s,
                 "killed": self._killed,
             }

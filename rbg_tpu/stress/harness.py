@@ -3014,6 +3014,357 @@ def _member_ratio(tier, member: str, window_s: float, now: float):
     return sums["prefill"] / sums["decode"]
 
 
+# ---- partition-tolerance scenario (deterministic chaos plane) --------------
+
+
+@dataclasses.dataclass
+class PartitionConfig:
+    """The partition-tolerance drill: every fault the chaos plane can
+    script, thrown at the production seams, with recovery asserted — not
+    hoped for. Four legs, one per degradation ladder:
+
+    * **corruption** — a ``ChaosTransport`` flips payload bytes of a
+      scheduled number of KV chunks while keeping the wire checksum
+      truthful; the assembler's verify-at-commit must catch every one
+      (``no_silent_corruption``) and the PR-10 bundle fallback must
+      replay the wounded streams token-exact (``zero_dropped_streams``
+      + ``bit_identical``).
+    * **directory** — the directory wire partitions; the client's
+      breaker opens, lookups degrade to the local-affinity answer
+      FAST (``degraded_not_down``), and after heal exactly one
+      half-open probe reconnects within the backoff bound
+      (``recovery_bounded``).
+    * **peer staleness** — a tier member goes silent on the peer feed;
+      past the TTL its ring ranges spill to successors; one event after
+      heal re-admits it.
+    * **lease** — the leader's lease-store renewals start RAISING while
+      its data writes still land; it must self-demote BEFORE the TTL so
+      the standby's takeover never overlaps.
+    """
+
+    requests: int = 4
+    prompt_len: int = 48
+    max_new_tokens: int = 8
+    corrupt_chunks: int = 2         # scheduled byzantine chunk budget
+    model: str = "tiny"
+    stale_ttl_s: float = 2.0        # peer-feed staleness TTL (drill clock)
+    lease_ttl_s: float = 1.0
+    recovery_bound_s: float = 5.0   # post-heal reconnect must beat this
+    timeout_s: float = 120.0
+    seed: int = 23
+
+
+def run_partition(cfg: PartitionConfig) -> dict:
+    from rbg_tpu.chaos import KINDS
+
+    report: Dict[str, object] = {"scenario": "partition",
+                                 "config": dataclasses.asdict(cfg)}
+    inv: Dict[str, bool] = {}
+    t_run = time.perf_counter()
+    faults_before = {k: REGISTRY.counter(
+        metric_names.CHAOS_FAULTS_INJECTED_TOTAL, kind=k) for k in KINDS}
+    report["corruption"] = _partition_corruption_leg(cfg, inv)
+    report["directory"] = _partition_directory_leg(cfg, inv)
+    report["peer_staleness"] = _partition_staleness_leg(cfg, inv)
+    report["lease"] = _partition_lease_leg(cfg, inv)
+    # Every fault class the drill injected must have ACCOUNTED for
+    # itself: a fault that doesn't count is a fault production can't see.
+    injected = {k: round(REGISTRY.counter(
+        metric_names.CHAOS_FAULTS_INJECTED_TOTAL, kind=k)
+        - faults_before[k], 1) for k in KINDS}
+    report["faults_injected"] = injected
+    inv["all_faults_counted"] = all(v >= 1.0 for v in injected.values())
+    report["elapsed_s"] = round(time.perf_counter() - t_run, 3)
+    report["invariants"] = inv
+    return report
+
+
+def _partition_corruption_leg(cfg: PartitionConfig,
+                              inv: Dict[str, bool]) -> dict:
+    import numpy as np
+
+    from rbg_tpu.chaos import (BROWNOUT, CORRUPT, ChaosClock,
+                               ChaosTransport, FaultSchedule, FaultWindow)
+    from rbg_tpu.engine.config import EngineConfig, SamplingParams
+    from rbg_tpu.engine.engine import Engine
+    from rbg_tpu.engine.pd import PDStreamPair
+    from rbg_tpu.kvtransfer import FakeICITransport
+
+    page_size = 8
+    ecfg = dict(model=cfg.model, page_size=page_size, num_pages=256,
+                max_batch=4, max_seq_len=256, prefill_chunk=16,
+                use_pallas="never")
+    rng = np.random.RandomState(cfg.seed)
+    eng_ref = Engine(EngineConfig(enable_radix_cache=False, **ecfg))
+    vocab = eng_ref.mcfg.vocab_size
+    prompts = [rng.randint(1, vocab, size=cfg.prompt_len).tolist()
+               for _ in range(cfg.requests)]
+    sp = SamplingParams(max_new_tokens=cfg.max_new_tokens)
+    expect = eng_ref.generate(prompts, sp)
+
+    # Scripted clock starts BEFORE the corrupt window so the jit-warming
+    # passes ride a clean link; opening the window is one clock set, so
+    # exactly the first ``corrupt_chunks`` drill chunks get wounded —
+    # deterministic, replayable, seed-pinned.
+    clock = ChaosClock(t0=-1.0)
+    sched = FaultSchedule(
+        [FaultWindow(CORRUPT, 0.0, float("inf"),
+                     params={"max_faults": cfg.corrupt_chunks}),
+         # Brownout rides the first drill window only (the clock jumps
+         # past it after request 0): the wounded stream is ALSO slow —
+         # corruption detection and token-exact replay must work on a
+         # browned-out link, not just a fast one.
+         FaultWindow(BROWNOUT, 0.0, 5.0, params={"delay_s": 0.004})],
+        clock=clock, seed=cfg.seed)
+    detected_before = REGISTRY.counter(
+        metric_names.KVT_INTEGRITY_FAILURES_TOTAL, surface="chunk")
+    link = ChaosTransport(FakeICITransport(bytes_per_s=1e9,
+                                           latency_s=1e-4), sched)
+    pair = PDStreamPair(EngineConfig(**ecfg), params=eng_ref.params,
+                        transport=link)
+    warm = rng.randint(1, vocab, size=cfg.prompt_len).tolist()
+    for _ in range(2):
+        pair.generate_one(warm, sp, stream=True, recv_timeout=60.0,
+                          max_retries=2)
+    clock.set(0.0)
+
+    results: list = []
+    failures: list = []
+    for i, p in enumerate(prompts):
+        try:
+            results.append(pair.generate_one(p, sp, stream=True,
+                                             recv_timeout=60.0,
+                                             max_retries=3))
+        except Exception as e:  # noqa: BLE001 — account, don't crash
+            failures.append(f"request {i}: {type(e).__name__}: {e}")
+            results.append(None)
+        if i == 0:
+            clock.set(10.0)   # brownout window closes; CORRUPT stays
+                              # open but its budget is already spent
+
+    bit_identical = all(r is not None and r["tokens"] == e
+                        for r, e in zip(results, expect))
+    detected = REGISTRY.counter(
+        metric_names.KVT_INTEGRITY_FAILURES_TOTAL,
+        surface="chunk") - detected_before
+    retried = sum(r["retries"] for r in results if r)
+    # The chain the ladder promises: every wounded chunk DETECTED at
+    # commit (checksum, not luck), every wounded stream REPLAYED
+    # (retries), every output BIT-IDENTICAL to the unified reference.
+    inv["no_silent_corruption"] = (detected >= 1.0 and retried >= 1
+                                   and bit_identical)
+    inv["zero_dropped_streams"] = not failures and bit_identical
+    return {
+        "requests": cfg.requests,
+        "completed": sum(1 for r in results if r),
+        "corrupted_chunks_injected": cfg.corrupt_chunks,
+        "integrity_failures_detected": round(detected, 1),
+        "stream_retries": retried,
+        "bit_identical": bit_identical,
+        "failures": failures,
+    }
+
+
+def _partition_directory_leg(cfg: PartitionConfig,
+                             inv: Dict[str, bool]) -> dict:
+    import threading
+
+    from rbg_tpu.chaos import (PARTITION, ChaosClock, FaultSchedule,
+                               FaultWindow, directory_fault)
+    from rbg_tpu.engine.kvpool import KVPoolServer, KVPoolStore
+    from rbg_tpu.kvtransfer import PrefixDirectory
+    from rbg_tpu.kvtransfer.directory import DirectoryClient
+
+    d = PrefixDirectory(page_size=8)
+    store = KVPoolStore(8, directory=d)
+    srv = KVPoolServer(("127.0.0.1", 0), store)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    out: Dict[str, object] = {}
+    try:
+        addr = f"127.0.0.1:{srv.server_address[1]}"
+        clock = ChaosClock(t0=0.0)
+        sched = FaultSchedule(
+            [FaultWindow(PARTITION, 1.0, 2.0,
+                         params={"dead": ["router->directory"]})],
+            clock=clock, seed=cfg.seed)
+        c = DirectoryClient(addr, timeout=2.0, page_size=8, token="",
+                            backoff_s=0.1, backoff_max_s=1.0,
+                            chaos=directory_fault(sched))
+        toks = list(range(24))
+        assert c.register(toks, "10.0.0.5:9000", slice_id="sl-a") == 3
+        assert c.lookup(toks) == (24, ["10.0.0.5:9000"])
+
+        # ---- partition opens: degraded, not down ----
+        clock.set(1.0)
+        lat = []
+        for _ in range(8):
+            t0 = time.perf_counter()
+            got = c.lookup(toks)
+            lat.append(time.perf_counter() - t0)
+            assert got == (0, []), "partitioned lookup must DEGRADE"
+        out["degraded_lookup_ms"] = _pcts(lat)
+        degraded_gauge = REGISTRY.gauge(metric_names.DEGRADED_MODE,
+                                        ladder="directory")
+        # Goodput floor: the degraded answer arrives ~instantly (breaker
+        # short-circuit), never eats the 2 s wire timeout per request.
+        inv["degraded_not_down"] = (max(lat) < 0.5
+                                    and degraded_gauge == 1.0)
+
+        # ---- heal: bounded recovery through the half-open probe ----
+        clock.set(2.0)
+        t0 = time.perf_counter()
+        _wait(lambda: c.lookup(toks) == (24, ["10.0.0.5:9000"]),
+              cfg.recovery_bound_s, "directory reconnect after heal")
+        recovery_s = time.perf_counter() - t0
+        out["recovery_s"] = round(recovery_s, 3)
+        out["breaker_opens"] = round(REGISTRY.counter(
+            metric_names.KVT_DIR_BREAKER_OPEN_TOTAL), 1)
+        inv["recovery_bounded_directory"] = (
+            recovery_s <= cfg.recovery_bound_s
+            and REGISTRY.gauge(metric_names.DEGRADED_MODE,
+                               ladder="directory") == 0.0)
+    except (AssertionError, TimeoutError) as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+        inv.setdefault("degraded_not_down", False)
+        inv.setdefault("recovery_bounded_directory", False)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    return out
+
+
+def _partition_staleness_leg(cfg: PartitionConfig,
+                             inv: Dict[str, bool]) -> dict:
+    from rbg_tpu.engine.routertier import EV_HEALTH, RouterTier
+
+    clock = {"t": 100.0}
+    tier = RouterTier(name="part", clock=lambda: clock["t"],
+                      peer_stale_after_s=cfg.stale_ttl_s)
+    for n in ("ra", "rb", "rc"):
+        tier.register(n)
+    keys = [f"sess-{i}" for i in range(64)]
+    served0 = {tier.route(k) for k in keys}
+
+    # rb partitions off the peer feed: ra/rc keep speaking, rb goes
+    # silent past the TTL. Its ranges must spill to ring successors —
+    # routing DEGRADES (fewer targets) instead of steering blind.
+    clock["t"] += cfg.stale_ttl_s + 0.5
+    for n in ("ra", "rc"):
+        tier.publish(n, EV_HEALTH, {"ok": True})
+    served_stale = {tier.route(k) for k in keys}
+    stale_excluded = "rb" not in served_stale and served_stale <= {"ra",
+                                                                   "rc"}
+    gauge_stale = REGISTRY.gauge(metric_names.DEGRADED_MODE,
+                                 ladder="peer_feed")
+
+    # Heal: one event from rb is proof of life — re-admitted at once.
+    tier.publish("rb", EV_HEALTH, {"ok": True})
+    served_healed = {tier.route(k) for k in keys}
+    gauge_healed = REGISTRY.gauge(metric_names.DEGRADED_MODE,
+                                  ladder="peer_feed")
+
+    inv["stale_peer_excluded"] = (stale_excluded and gauge_stale == 1.0)
+    inv["recovery_bounded_peer_feed"] = ("rb" in served_healed
+                                         and gauge_healed == 0.0)
+    snap = tier.snapshot()
+    return {
+        "served_before": sorted(served0),
+        "served_while_stale": sorted(served_stale),
+        "served_after_heal": sorted(served_healed),
+        "stale_ttl_s": cfg.stale_ttl_s,
+        "members": snap.get("members"),
+    }
+
+
+def _partition_lease_leg(cfg: PartitionConfig,
+                         inv: Dict[str, bool]) -> dict:
+    from rbg_tpu.chaos import (SKEW, ChaosClock, FaultSchedule,
+                               FaultWindow, SkewedClock)
+    from rbg_tpu.runtime.ha import LeaderElector
+    from rbg_tpu.runtime.store import Store
+
+    store = Store()
+    fail = {"on": False}
+
+    class _FlakyLeaseStore:
+        """The tentpole's exact failure: the COORDINATOR is unreachable
+        (renewals raise) while the data-store write surface still
+        works."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def renew_lease(self, *a, **kw):
+            if fail["on"]:
+                raise OSError("chaos: lease store unreachable")
+            return self._inner.renew_lease(*a, **kw)
+
+    clock = ChaosClock(t0=0.0)
+    # The partitioned leader's clock ALSO skews forward mid-outage
+    # (partitions and clock trouble travel together): the elector must
+    # judge "how long since my last confirmed renewal" on its OWN skewed
+    # view and still demote before the store-side TTL.
+    sk = FaultSchedule(
+        [FaultWindow(SKEW, 0.4, 1.0,
+                     params={"offsets": {"plane-p": 0.2}})],
+        clock=clock, seed=cfg.seed)
+    skc = SkewedClock(clock, sk, "plane-p")
+
+    class _Plane:
+        def start(self):
+            pass
+
+        def stop(self):
+            pass
+
+    el = LeaderElector("plane-p", _FlakyLeaseStore(store),
+                       lambda fenced: _Plane(), ttl_s=cfg.lease_ttl_s,
+                       renew_period_s=cfg.lease_ttl_s / 5.0, clock=skc,
+                       tail=False, self_demote_frac=0.5)
+
+    def tick_at(t):
+        clock.set(t)
+        el.tick(now=skc())
+
+    tick_at(0.0)
+    assert el.is_leader
+    tick_at(0.2)                         # healthy renewal at t=0.2
+    # Coordinator partitions — but the DATA store is fine: the leader's
+    # fenced writes keep landing. That is exactly why waiting out the
+    # TTL is unsafe and self-demotion must come first.
+    fail["on"] = True
+    writes_land = False
+    try:
+        el.fenced_store.create(make_group("chaos-lease-w",
+                                          simple_role("w", replicas=0)))
+        writes_land = store.get("RoleBasedGroup", "default",
+                                "chaos-lease-w") is not None
+    except Exception:
+        writes_land = False
+    tick_at(0.3)                         # 0.1 s since last OK: holds on
+    still_leading_early = el.is_leader
+    tick_at(0.8)                         # skewed now=1.0: 0.8 s >= ttl/2
+    demoted_at = 0.8                     # base-clock demotion moment
+    lease_expiry = 0.2 + cfg.lease_ttl_s
+    inv["leader_self_demoted_before_ttl"] = (
+        writes_land and still_leading_early and not el.is_leader
+        and el.self_demotions == 1 and demoted_at < lease_expiry)
+
+    # Heal: re-campaign succeeds once the old epoch expires — recovery
+    # is bounded by TTL + one renew period, on the DRILL clock.
+    fail["on"] = False
+    tick_at(lease_expiry + 0.01)
+    inv["recovery_bounded_lease"] = el.is_leader and el.transitions == 2
+    out = el.snapshot()
+    out["writes_landed_during_partition"] = bool(writes_land)
+    out["demoted_at_s"] = demoted_at
+    out["lease_expiry_s"] = lease_expiry
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -3021,7 +3372,7 @@ def main(argv=None) -> int:
     ap.add_argument("--scenario", default="churn",
                     choices=["churn", "overload", "preemption", "autoscale",
                              "kvstream", "prefixcache", "fleet", "topoflip",
-                             "ha"],
+                             "ha", "partition"],
                     help="churn = control-plane create/update/delete "
                          "percentiles; overload = serving-plane admission "
                          "control drill (sheds, deadlines, queue bound); "
@@ -3040,7 +3391,13 @@ def main(argv=None) -> int:
                          "topoflip = adaptive agg<->disagg drill (load-"
                          "mix-shifting trace, runtime PD-shape flips "
                          "with zero dropped streams, goodput vs both "
-                         "static shapes)")
+                         "static shapes); "
+                         "partition = partition-tolerance drill "
+                         "(deterministic chaos plane: byzantine chunk "
+                         "corruption caught at commit + token-exact "
+                         "replay, directory breaker degrade/recover, "
+                         "peer-feed staleness spill, lease self-"
+                         "demotion before TTL)")
     ap.add_argument("--clients", type=int, default=6)
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--max-queue", type=int, default=4)
@@ -3178,7 +3535,8 @@ def main(argv=None) -> int:
             for r in ("complete", "incomplete", "leaked")}
     load1 = os.getloadavg()[0]
     if args.scenario in ("overload", "preemption", "autoscale", "kvstream",
-                         "prefixcache", "fleet", "topoflip", "ha"):
+                         "prefixcache", "fleet", "topoflip", "ha",
+                         "partition"):
         if args.scenario == "fleet":
             # Scenario-aware rate default: the churn scenarios' 5 qps
             # would spend 30 s just CREATING a 150-group fleet wave.
@@ -3232,6 +3590,9 @@ def main(argv=None) -> int:
                 timeout_s=args.timeout_s))
         elif args.scenario == "ha":
             report = run_ha(HAConfig(timeout_s=args.timeout_s))
+        elif args.scenario == "partition":
+            report = run_partition(PartitionConfig(
+                timeout_s=args.timeout_s))
         else:
             report = run_preemption(PreemptionConfig(
                 groups=max(2, args.groups) if args.groups else 2,

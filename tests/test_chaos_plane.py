@@ -1,0 +1,418 @@
+"""Deterministic chaos plane + the robustness seams it exercises.
+
+The injectors (``rbg_tpu/chaos``) wrap production boundaries — the
+kvtransfer ``Transport``, the ``DirectoryClient`` wire hook, the
+injectable clocks — so every test here is the production detection /
+degradation path reacting to a scripted fault, never a mock of it.
+Engine-free throughout (numpy + sockets): these all run in tier 1.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rbg_tpu.chaos import (BROWNOUT, CORRUPT, PARTITION, SKEW, ChaosClock,
+                           ChaosTransport, FaultSchedule, FaultWindow,
+                           SkewedClock, directory_fault)
+from rbg_tpu.kvtransfer import (ChunkAssembler, InProcTransport,
+                                KVIntegrityError, StreamError, StreamFin,
+                                StreamMeta, bundle_to_frames,
+                                payload_checksum)
+from rbg_tpu.kvtransfer.chunks import KVChunk
+from rbg_tpu.obs import names as obs_names
+from rbg_tpu.obs.metrics import REGISTRY
+
+
+def mk_meta(sid="s1", n_pages=4, layers=3, page=8, kv=2, hd=4):
+    prompt = list(range(1, n_pages * page + 1))
+    return StreamMeta(stream_id=sid, prompt=prompt, n_pages=n_pages,
+                      k_page_shape=(page, kv, hd),
+                      v_page_shape=(page, kv, hd),
+                      dtype="float32", layers=layers, page_size=page)
+
+
+def mk_payload(meta, seed=0):
+    rng = np.random.RandomState(seed)
+    k = rng.randn(*meta.k_shape()).astype(np.float32)
+    v = rng.randn(*meta.v_shape()).astype(np.float32)
+    return k, v
+
+
+# ---- schedule & clocks -----------------------------------------------------
+
+
+def test_fault_windows_open_and_close_on_the_scripted_clock():
+    clock = ChaosClock(t0=0.0)
+    s = FaultSchedule([FaultWindow(PARTITION, 1.0, 2.0,
+                                   params={"dead": ["a->b"]})], clock=clock)
+    assert s.active(PARTITION) is None
+    clock.set(1.5)
+    w = s.active(PARTITION)
+    assert w is not None
+    assert FaultSchedule.cut(w, "a", "b")
+    assert not FaultSchedule.cut(w, "b", "a")      # asymmetry is the point
+    clock.set(2.0)                                 # half-open interval
+    assert s.active(PARTITION) is None
+
+
+def test_skewed_clock_offsets_only_named_process_in_window():
+    clock = ChaosClock(t0=0.0)
+    s = FaultSchedule([FaultWindow(SKEW, 1.0, 2.0,
+                                   params={"offsets": {"b": 0.5}})],
+                      clock=clock)
+    a, b = SkewedClock(clock, s, "a"), SkewedClock(clock, s, "b")
+    before = REGISTRY.counter(obs_names.CHAOS_FAULTS_INJECTED_TOTAL,
+                              kind=SKEW)
+    assert a() == b() == 0.0
+    clock.set(1.5)
+    assert a() == 1.5 and b() == 2.0
+    # Counted once per window entry, not once per read.
+    b()
+    assert REGISTRY.counter(obs_names.CHAOS_FAULTS_INJECTED_TOTAL,
+                            kind=SKEW) == before + 1
+    clock.set(2.5)
+    assert b() == 2.5
+
+
+# ---- transport injectors ---------------------------------------------------
+
+
+def _drain(transport, sid, timeout=2.0):
+    out = []
+    for f in transport.recv_chunks(sid, timeout=timeout):
+        out.append(f)
+        if isinstance(f, StreamFin):
+            break
+    return out
+
+
+def test_corrupted_chunk_keeps_truthful_checksum_and_fails_commit():
+    meta = mk_meta()
+    k, v = mk_payload(meta)
+    frames = bundle_to_frames(meta, k, v, first_token=7, layer_split=1)
+    clock = ChaosClock(t0=0.0)
+    sched = FaultSchedule([FaultWindow(CORRUPT, 0.0, 10.0,
+                                       params={"max_faults": 1})],
+                          clock=clock, seed=5)
+    link = ChaosTransport(InProcTransport(), sched)
+    before = REGISTRY.counter(obs_names.KVT_INTEGRITY_FAILURES_TOTAL,
+                              surface="chunk")
+    for f in frames:
+        link.send_one("peer", f)
+    got = _drain(link, meta.stream_id)
+    wounded = [f for f in got if isinstance(f, KVChunk)
+               and payload_checksum(f.k_bytes, f.v_bytes) != f.checksum]
+    assert len(wounded) == 1, "exactly the budgeted chunk is corrupted"
+    a = ChunkAssembler(meta)
+    with pytest.raises(KVIntegrityError) as ei:
+        for f in got[1:]:
+            a.feed(f)
+    assert isinstance(ei.value, StreamError)       # rides bundle fallback
+    assert ei.value.wire_code == "kv_integrity_failed"
+    assert REGISTRY.counter(obs_names.KVT_INTEGRITY_FAILURES_TOTAL,
+                            surface="chunk") == before + 1
+
+
+def test_corruption_budget_and_seed_are_deterministic():
+    def run():
+        meta = mk_meta()
+        k, v = mk_payload(meta)
+        frames = bundle_to_frames(meta, k, v, first_token=7, layer_split=1)
+        clock = ChaosClock(t0=0.0)
+        sched = FaultSchedule([FaultWindow(CORRUPT, 0.0, 10.0,
+                                           params={"max_faults": 2})],
+                              clock=clock, seed=11)
+        link = ChaosTransport(InProcTransport(), sched)
+        for f in frames:
+            link.send_one("peer", f)
+        return [f.k_bytes for f in _drain(link, meta.stream_id)
+                if isinstance(f, KVChunk)]
+
+    assert run() == run(), "same schedule + seed must replay byte-exact"
+
+
+def test_asymmetric_partition_blackholes_one_direction_only():
+    meta = mk_meta(sid="p1")
+    clock = ChaosClock(t0=5.0)
+    sched = FaultSchedule([FaultWindow(PARTITION, 0.0, 10.0,
+                                       params={"dead": ["a->b"]})],
+                          clock=clock)
+    inner = InProcTransport()
+    ab = ChaosTransport(inner, sched, src="a", dst="b")
+    ba = ChaosTransport(inner, sched, src="b", dst="a")
+    ab.send_one("peer", StreamFin(stream_id="p1", n_chunks=0))
+    ba.send_one("peer", StreamFin(stream_id="p2", n_chunks=0))
+    # a→b vanished: nothing arrives, the receiver's bounded wait fires —
+    # exactly how a real blackhole presents (no error, no FIN).
+    with pytest.raises(StreamError):
+        list(inner.recv_chunks(meta.stream_id, timeout=0.1))
+    # … while b→a delivered.
+    got = _drain(inner, "p2", timeout=1.0)
+    assert len(got) == 1 and isinstance(got[0], StreamFin)
+
+
+def test_brownout_delays_every_in_window_send():
+    clock = ChaosClock(t0=0.0)
+    sched = FaultSchedule([FaultWindow(BROWNOUT, 0.0, 10.0,
+                                       params={"delay_s": 0.05})],
+                          clock=clock)
+    link = ChaosTransport(InProcTransport(), sched)
+    t0 = time.monotonic()
+    link.send_one("peer", StreamFin(stream_id="b1", n_chunks=0))
+    assert time.monotonic() - t0 >= 0.05
+    clock.set(11.0)                                 # window closed
+    t0 = time.monotonic()
+    link.send_one("peer", StreamFin(stream_id="b2", n_chunks=0))
+    assert time.monotonic() - t0 < 0.04
+
+
+# ---- duplicate / reordered delivery accounting -----------------------------
+
+
+def test_assembler_counts_duplicate_and_reordered_chunks():
+    meta = mk_meta()
+    k, v = mk_payload(meta)
+    frames = bundle_to_frames(meta, k, v, first_token=7, layer_split=1)
+    data = frames[1:-2]
+    dup_before = REGISTRY.counter(obs_names.KVT_CHUNKS_DUPLICATE_TOTAL)
+    reo_before = REGISTRY.counter(obs_names.KVT_CHUNKS_REORDERED_TOTAL)
+    a = ChunkAssembler(meta)
+    # Deliver 0,2,1 then replay 0 twice: one reorder (1 after 2), two dups.
+    a.feed(data[0])
+    a.feed(data[2])
+    a.feed(data[1])
+    a.feed(data[0])
+    a.feed(data[0])
+    for ch in data[3:]:
+        a.feed(ch)
+    assert a.dup_chunks == 2 and a.reordered_chunks == 1
+    assert REGISTRY.counter(
+        obs_names.KVT_CHUNKS_DUPLICATE_TOTAL) == dup_before + 2
+    assert REGISTRY.counter(
+        obs_names.KVT_CHUNKS_REORDERED_TOTAL) == reo_before + 1
+
+
+def test_duplicate_of_committed_chunk_never_kills_a_healthy_stream():
+    """A corrupted RETRANSMIT of an already-committed chunk is dropped by
+    the duplicate path before checksum verify — the copy that counted was
+    verified; a late wounded twin must not wedge the stream."""
+    import dataclasses as _dc
+
+    meta = mk_meta()
+    k, v = mk_payload(meta)
+    frames = bundle_to_frames(meta, k, v, first_token=7, layer_split=1)
+    data = frames[1:-2]
+    a = ChunkAssembler(meta)
+    for ch in data:
+        a.feed(ch)
+    bad = _dc.replace(data[0],
+                      k_bytes=bytes(len(data[0].k_bytes)))  # zeroed payload
+    a.feed(bad)                                             # no raise
+    a.feed(frames[-2])
+    a.feed(frames[-1])
+    assert a.ready()
+
+
+# ---- pool page integrity ---------------------------------------------------
+
+
+def _page(shape=(2, 1, 8, 2, 4), seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(*shape).astype(np.float32),
+            rng.randn(*shape).astype(np.float32))
+
+
+def test_pool_detects_bitrot_truncates_hit_and_invalidates_directory():
+    from rbg_tpu.engine.kvpool import KVPoolStore
+    from rbg_tpu.kvtransfer import PrefixDirectory
+
+    d = PrefixDirectory(page_size=8)
+    store = KVPoolStore(8, directory=d)
+    store.owner_backend = "10.0.0.9:9000"
+    toks = list(range(24))                      # three pages
+    k, v = _page(shape=(2, 3, 8, 2, 4))
+    assert store.put(toks, k, v) == 3               # pages committed
+    d.register(toks, "10.0.0.9:9000")
+    before = REGISTRY.counter(obs_names.KVT_INTEGRITY_FAILURES_TOTAL,
+                              surface="pool")
+
+    # Bit-rot the middle page in place (host-tier spill, DMA, cosmic ray
+    # — the cause doesn't matter; the stored crc no longer matches).
+    node = store.root.children[tuple(toks[0:8])]
+    mid = node.children[tuple(toks[8:16])]
+    mid.k[0].flat[3] += 1.0
+
+    matched, mk, mv = store.match(toks)
+    assert matched == 8, "hit truncated to the leading GOOD pages"
+    assert mk is not None and mk.shape[1] == 1
+    assert REGISTRY.counter(obs_names.KVT_INTEGRITY_FAILURES_TOTAL,
+                            surface="pool") == before + 1
+    # The rotten page is gone (cannot poison the next hit) and its
+    # directory claim is withdrawn.
+    assert store.metrics["evicted_pages"] >= 1
+    m2, holders = d.lookup(toks)
+    assert m2 <= 8
+    assert store.match(toks)[0] == 8            # stable on re-match
+
+
+# ---- directory wire: chaos hook, degrade ladder, single-flight probe -------
+
+
+def _pool_server():
+    from rbg_tpu.engine.kvpool import KVPoolServer, KVPoolStore
+    from rbg_tpu.kvtransfer import PrefixDirectory
+
+    d = PrefixDirectory(page_size=8)
+    srv = KVPoolServer(("127.0.0.1", 0), KVPoolStore(8, directory=d))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"127.0.0.1:{srv.server_address[1]}"
+
+
+def test_directory_partition_degrades_then_recovers_via_breaker():
+    from rbg_tpu.kvtransfer.directory import DirectoryClient
+
+    srv, addr = _pool_server()
+    try:
+        clock = ChaosClock(t0=0.0)
+        sched = FaultSchedule(
+            [FaultWindow(PARTITION, 1.0, 2.0,
+                         params={"dead": ["router->directory"]})],
+            clock=clock)
+        c = DirectoryClient(addr, timeout=2.0, page_size=8, token="",
+                            backoff_s=0.05, backoff_max_s=0.2,
+                            chaos=directory_fault(sched))
+        toks = list(range(16))
+        assert c.register(toks, "b1", slice_id="sl") == 2
+        assert c.lookup(toks) == (16, ["b1"])
+        clock.set(1.5)                           # partition opens
+        assert c.lookup(toks) == (0, [])         # degraded, instantly
+        assert REGISTRY.gauge(obs_names.DEGRADED_MODE,
+                              ladder="directory") == 1.0
+        clock.set(2.5)                           # heal
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if c.lookup(toks) == (16, ["b1"]):
+                break
+            time.sleep(0.01)
+        assert c.lookup(toks) == (16, ["b1"])
+        assert REGISTRY.gauge(obs_names.DEGRADED_MODE,
+                              ladder="directory") == 0.0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_breaker_half_open_probe_is_single_flight():
+    """When the backoff window closes, EXACTLY ONE caller probes; every
+    concurrent caller stays on the degraded fast path — recovery must not
+    thundering-herd a directory that just came back."""
+    from rbg_tpu.kvtransfer.directory import DirectoryClient
+
+    srv, addr = _pool_server()
+    try:
+        attempts = {"n": 0}
+        gate = threading.Event()
+
+        def chaos():
+            attempts["n"] += 1
+            gate.wait(1.0)     # hold the probe open under the lock-free
+                               # window so peers must decide concurrently
+
+        c = DirectoryClient(addr, timeout=2.0, page_size=8, token="",
+                            backoff_s=0.05, backoff_max_s=0.2, chaos=chaos)
+        # Open the breaker once (real failure), then let the window pass.
+        gate.set()
+        c._down_until = 0.0
+        srv_alive_probe = c.lookup_keys(["k"])   # addr is alive: fine
+        assert srv_alive_probe == (0, [])        # no keys registered yet
+        with c._lock:
+            c._down_until = time.monotonic() + 0.05
+        time.sleep(0.08)                         # window now closed
+        gate.clear()
+        attempts["n"] = 0
+        results = []
+        start = threading.Barrier(8)
+
+        def caller():
+            start.wait(2.0)
+            results.append(c.lookup_keys(["k"]))
+
+        threads = [threading.Thread(target=caller) for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)       # everyone has hit the breaker by now
+        gate.set()             # release the one probe
+        for t in threads:
+            t.join(timeout=5.0)
+        assert attempts["n"] == 1, \
+            f"half-open probe not single-flight: {attempts['n']} attempts"
+        assert len(results) == 8
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---- router tier: staleness TTL + ingress counter restart ------------------
+
+
+def test_stale_peer_spills_to_successors_until_it_speaks():
+    from rbg_tpu.engine.routertier import EV_HEALTH, RouterTier
+
+    clock = {"t": 0.0}
+    tier = RouterTier(name="st", clock=lambda: clock["t"],
+                      peer_stale_after_s=1.0)
+    for n in ("ra", "rb", "rc"):
+        tier.register(n)
+    keys = [f"k{i}" for i in range(48)]
+    assert {tier.route(k) for k in keys} == {"ra", "rb", "rc"}
+    clock["t"] = 2.0
+    for n in ("ra", "rc"):
+        tier.publish(n, EV_HEALTH, {"ok": True})
+    served = {tier.route(k) for k in keys}
+    assert "rb" not in served and served, "silent member must spill"
+    assert REGISTRY.gauge(obs_names.DEGRADED_MODE,
+                          ladder="peer_feed") == 1.0
+    snap = tier.snapshot()
+    assert snap["members"]["rb"]["stale"] is True
+    tier.publish("rb", EV_HEALTH, {"ok": True})    # proof of life
+    assert "rb" in {tier.route(k) for k in keys}
+    assert REGISTRY.gauge(obs_names.DEGRADED_MODE,
+                          ladder="peer_feed") == 0.0
+
+
+def test_staleness_off_by_default_keeps_quiet_tiers_routable():
+    from rbg_tpu.engine.routertier import RouterTier
+
+    clock = {"t": 0.0}
+    tier = RouterTier(name="quiet", clock=lambda: clock["t"])
+    tier.register("only")
+    clock["t"] = 1e6                               # silent for ages
+    assert tier.route("k") == "only"
+
+
+def test_ingress_publish_counter_restart_folds_not_negative():
+    from rbg_tpu.engine.routertier import EV_INGRESS, RouterTier
+
+    clock = {"t": 0.0}
+    tier = RouterTier(name="ing", clock=lambda: clock["t"])
+    tier.register("r1")
+    tier.register("r2")
+    tier.publish("r1", EV_INGRESS, {"totals": {"prefill": 100.0,
+                                               "decode": 50.0}})
+    clock["t"] = 1.0
+    tier.publish("r1", EV_INGRESS, {"totals": {"prefill": 160.0,
+                                               "decode": 80.0}})
+    totals = tier.ingress_totals()
+    assert totals["prefill"] == 160.0 and totals["decode"] == 80.0
+    # r1 restarts under the same --router-id: cumulative totals reset to
+    # a LOWER value — fold the full new value (PR-8 counter-restart
+    # convention), never a negative delta.
+    clock["t"] = 2.0
+    tier.publish("r1", EV_INGRESS, {"totals": {"prefill": 30.0,
+                                               "decode": 10.0}})
+    totals = tier.ingress_totals()
+    assert totals["prefill"] == 190.0 and totals["decode"] == 90.0
+    with tier._lock:
+        assert all(d > 0 for _, _, _, d in tier._ingress_log)

@@ -261,3 +261,150 @@ def test_standby_tails_store_writes():
               lambda g: g.metadata.annotations.update(x="1") or True)
     assert b.tailed_events >= before + 2
     assert b.tail_rv > 0
+
+
+def test_takeover_finishes_tail_catchup_before_actuating():
+    """A standby behind on its watch tail must catch up to the store's
+    watermark BEFORE its plane starts. In-process watch delivery is
+    synchronous, so the gate is satisfiable immediately — the assertion
+    is that it ran and measured zero lag, not that it spun."""
+    st = Store()
+    st.create(make_group("g", simple_role("serve")))
+    t = {"t": 0.0}
+    b = _elector("b", st, t)
+    b._subscribe_tail()
+    b.tick(now=0.0)
+    assert b.is_leader and b.plane.started == 1
+    assert b.catchup_lag_rv == 0
+    assert b.tail_rv >= st.current_rv() or b.tailed_events == 0
+
+
+# ---- fencing under clock skew (chaos SKEW schedule) ------------------------
+
+
+def _skewed_pair(st, offsets, window=(0.0, 100.0)):
+    from rbg_tpu.chaos import (SKEW, ChaosClock, FaultSchedule,
+                               FaultWindow, SkewedClock)
+
+    base = ChaosClock(t0=0.0)
+    sched = FaultSchedule(
+        [FaultWindow(SKEW, window[0], window[1],
+                     params={"offsets": offsets})], clock=base)
+    clocks = {w: SkewedClock(base, sched, w) for w in ("a", "b")}
+
+    def mk(n):
+        return LeaderElector(n, st, lambda fenced: _DummyPlane(),
+                             ttl_s=1.0, clock=clocks[n], tail=False)
+
+    return base, clocks, mk("a"), mk("b")
+
+
+def test_skewed_standby_takeover_fences_deposed_writer_mid_replay():
+    """B's clock runs 0.4 s FAST (chaos SKEW window): it sees A's lease
+    expire early and takes over while A — on true time — still believes
+    it leads. The epoch fence, not the clocks, is what keeps A's
+    mid-takeover replay out: the no-op mutate path first ('sometimes
+    fenced' is not a protocol), then the real write; both refused, state
+    untouched, and the successor's same write lands."""
+    st = Store()
+    st.create(make_group("g", simple_role("serve")))
+    base, clocks, a, b = _skewed_pair(st, {"b": 0.4})
+    a.tick(now=clocks["a"]())
+    assert a.is_leader
+    deposed = a.fenced_store
+
+    base.set(0.7)                     # true 0.7 → B reads 1.1 > TTL
+    b.tick(now=clocks["b"]())
+    assert b.is_leader and b.epoch == a.epoch + 1
+
+    with pytest.raises(LeaseFenced):  # no-op path, still fence-checked
+        deposed.mutate("RoleBasedGroup", "default", "g", lambda g: False)
+
+    def poison(g):
+        g.metadata.annotations["skew-poison"] = "1"
+        return True
+
+    with pytest.raises(LeaseFenced):  # the real in-flight write
+        deposed.mutate("RoleBasedGroup", "default", "g", poison)
+    g = st.get("RoleBasedGroup", "default", "g")
+    assert "skew-poison" not in g.metadata.annotations
+
+    # The successor resumes the same machine with ITS epoch: lands.
+    b.fenced_store.mutate("RoleBasedGroup", "default", "g",
+                          lambda o: o.metadata.annotations.update(
+                              owner="b") or True)
+    assert st.get("RoleBasedGroup", "default",
+                  "g").metadata.annotations["owner"] == "b"
+
+    # A's own next renewal — still on its slow clock — deposes it.
+    a.tick(now=clocks["a"]())
+    assert not a.is_leader
+
+
+def test_skew_fault_is_counted_once_per_window_entry():
+    st = Store()
+    base, clocks, a, b = _skewed_pair(st, {"b": 0.4}, window=(0.5, 2.0))
+    before = REGISTRY.counter(obs_names.CHAOS_FAULTS_INJECTED_TOTAL,
+                              kind="skew")
+    assert clocks["b"]() == 0.0       # window closed: no offset, no count
+    base.set(1.0)
+    assert clocks["b"]() == 1.4
+    clocks["b"]()
+    assert REGISTRY.counter(obs_names.CHAOS_FAULTS_INJECTED_TOTAL,
+                            kind="skew") == before + 1
+    assert a.name == "a" and b.name == "b"
+
+
+# ---- self-demotion: renewals RAISE (coordinator partition) -----------------
+
+
+class _FlakyLeaseStore:
+    """Coordinator-partition sim: renew_lease RAISES while every other
+    store surface (including fenced data writes) still works."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.fail = False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def renew_lease(self, *a, **kw):
+        if self.fail:
+            raise OSError("lease store unreachable")
+        return self._inner.renew_lease(*a, **kw)
+
+
+def test_renewal_raise_self_demotes_before_ttl_expiry():
+    st = Store()
+    fl = _FlakyLeaseStore(st)
+    el = LeaderElector("a", fl, lambda fenced: _DummyPlane(), ttl_s=1.0,
+                       clock=lambda: 0.0, tail=False)
+    before = REGISTRY.counter(obs_names.PLANE_SELF_DEMOTIONS_TOTAL,
+                              plane="a")
+    el.tick(now=0.0)
+    assert el.is_leader
+    el.tick(now=0.2)                  # last confirmed renewal at 0.2
+    fl.fail = True
+    el.tick(now=0.4)                  # 0.2 s since last OK: holds on
+    assert el.is_leader and el.self_demotions == 0
+    plane = el.plane
+    el.tick(now=0.75)                 # 0.55 s >= ttl/2: demote NOW —
+    assert not el.is_leader           # lease would expire at 1.2
+    assert el.self_demotions == 1 and plane.stopped == 1
+    assert REGISTRY.counter(obs_names.PLANE_SELF_DEMOTIONS_TOTAL,
+                            plane="a") == before + 1
+    assert REGISTRY.gauge(obs_names.DEGRADED_MODE, ladder="lease") == 1.0
+
+    # A healthy standby still waits out the TTL — the demotion at 0.75
+    # strictly precedes its earliest takeover: the terms never overlap.
+    b = _elector("b", st, {"t": 0.0})
+    b.tick(now=1.0)
+    assert not b.is_leader
+    b.tick(now=1.3)
+    assert b.is_leader
+
+    # The healed ex-leader re-campaigns as a standby (clean ladder exit).
+    fl.fail = False
+    el.tick(now=1.4)
+    assert not el.is_leader           # b holds a live lease now

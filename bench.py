@@ -212,8 +212,18 @@ def mixed_probe(model: str = "tiny", gate_ratio: float = 1.2) -> dict:
             ragged=ragged))
         eng.warm_ragged()               # every (rows, tokens) ragged shape
         drive(eng)                      # warm: samplers + fused windows
+        eng.warm_decode()               # full-window plain fused variants
         eng.warm_join_windows()         # K=1 early-exit fused variants
+        eng.warm_samplers()             # host-path sampler per bucket
         return eng
+
+    # Compile sentry (--jitwatch): everything mk_engine compiles is
+    # warmup; once both engines exist the gate arms, and ANY compile
+    # during the interleaved reps is a mid-measurement stall that
+    # contaminates exactly one side — the probe FAILS on it.
+    from rbg_tpu.utils import jitwatch
+    if jitwatch.enabled():
+        jitwatch.reset()
 
     # The two paths run INTERLEAVED, rep by rep, on two warm engines:
     # this machine's throughput is bimodal at multi-second granularity,
@@ -223,6 +233,8 @@ def mixed_probe(model: str = "tiny", gate_ratio: float = 1.2) -> dict:
     # estimator and retry policy as the headline metric) re-measures a
     # whole attempt when even the interleaved reps came out contaminated.
     eng_ragged, eng_split = mk_engine("auto"), mk_engine("off")
+    if jitwatch.enabled():
+        jitwatch.warmup_complete()
     best, best_spread, attempt_spreads = None, None, []
     for _ in range(MAX_ATTEMPTS):
         ragged_runs, split_runs = [], []
@@ -261,7 +273,12 @@ def mixed_probe(model: str = "tiny", gate_ratio: float = 1.2) -> dict:
     tps_ratio = (ragged["tps"] / split["tps"]) if split["tps"] else None
     ttft_cut = (100.0 * (1 - ragged["ttft_p50_ms"] / split["ttft_p50_ms"])
                 if split["ttft_p50_ms"] else None)
+    jw_violations = []
+    if jitwatch.enabled():
+        jw_violations = jitwatch.violations()
+        jitwatch.reset()   # later probes' compiles are their own warmup
     return {
+        **({"jitwatch_violations": jw_violations} if jw_violations else {}),
         "metric": (f"mixed_poisson_trace_{model}_bs8_"
                    f"n{MIXED_REQUESTS}_cpu"),
         "prompt_lens": list(MIXED_PROMPT_LENS),
@@ -282,8 +299,11 @@ def mixed_probe(model: str = "tiny", gate_ratio: float = 1.2) -> dict:
         # the split baseline but diverges from its outputs is a
         # regression, never a pass.
         "gate_ratio": gate_ratio,
+        # A mid-measurement compile (jitwatch) fails the A/B outright:
+        # the stall landed on one side's reps and poisoned the ratio.
         "gate": ("pass" if (ragged_out == split_out)
                  and ((tps_ratio or 0) >= gate_ratio or (ttft_cut or 0) >= 30.0)
+                 and not jw_violations
                  else "fail"),
     }
 
@@ -687,6 +707,16 @@ def main():
 
     from rbg_tpu.engine import Engine, EngineConfig, SamplingParams
 
+    if "--jitwatch" in flags:
+        # Compile sentry over the measurement windows: warn mode (record,
+        # don't raise mid-rep) — violations fail the run via exit code
+        # and the probes' gates. Armed before ANY engine exists so every
+        # warmup compile is recorded as such.
+        os.environ.setdefault("RBG_JITWATCH", "warn")
+        from rbg_tpu.utils import jitwatch
+        jitwatch.disarm()
+        jitwatch.arm()
+
     if flags & {"--mla", "--block-ragged"}:
         # Selective mode: run only the requested blocks (still ONE JSON
         # line) — the full headline suite takes minutes and the ragged
@@ -706,6 +736,8 @@ def main():
         if probe is not None and not probe.get("ok"):
             out["tpu_probe"] = probe
         print(json.dumps(out))
+        if _jitwatch_failed(flags, out):
+            sys.exit(1)
         return
 
     on_tpu = jax.default_backend() == "tpu"
@@ -733,6 +765,11 @@ def main():
             eng.step()
         for _ in range(4):
             eng.step()
+        # Warm region over: arm the compile gate (idempotent; a no-op
+        # unless --jitwatch installed the hooks). Any compile inside the
+        # timed windows below is a recorded violation.
+        from rbg_tpu.utils import jitwatch
+        jitwatch.warmup_complete()
         runs = []
         for _ in range(REPS):
             start_tokens = eng.metrics["decode_tokens"]
@@ -761,6 +798,14 @@ def main():
     tps = statistics.median(runs)
     raw_spread = spread_of(runs)
 
+    jw = None
+    if "--jitwatch" in flags:
+        from rbg_tpu.utils import jitwatch
+        jw = {"counters": jitwatch.counters(),
+              "violations": jitwatch.violations(),
+              "gate": "fail" if jitwatch.violations() else "pass"}
+        jitwatch.reset()   # the probes below warm their own engines
+
     # MFU estimate: decode FLOPs/token ≈ 2·N_params (matmul MACs×2) plus
     # KV-read attention FLOPs (small at these lengths). Peak: v5e bf16
     # 197 TFLOP/s; CPU runs report mfu_est=null (no meaningful peak).
@@ -786,6 +831,8 @@ def main():
         "attempt_spreads_pct": attempt_spreads,
         "load1": round(os.getloadavg()[0], 2),
     }
+    if jw is not None:
+        out["jitwatch"] = jw
     # Constrained-decode probe rides along — a probe failure must never
     # cost the headline line.
     try:
@@ -825,6 +872,19 @@ def main():
     if probe is not None and not probe.get("ok"):
         out["tpu_probe"] = probe
     print(json.dumps(out))
+    if _jitwatch_failed(flags, out):
+        sys.exit(1)
+
+
+def _jitwatch_failed(flags: set, out: dict) -> bool:
+    """True when --jitwatch ran and recorded a mid-measurement compile —
+    in the headline windows or either side of an interleaved A/B probe."""
+    if "--jitwatch" not in flags:
+        return False
+    if out.get("jitwatch", {}).get("gate") == "fail":
+        return True
+    return any(isinstance(v, dict) and v.get("jitwatch_violations")
+               for v in out.values())
 
 
 if __name__ == "__main__":

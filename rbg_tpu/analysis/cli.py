@@ -2,19 +2,40 @@
 
 Exit codes: 0 clean, 1 findings, 2 usage/internal error. ``--format json``
 emits machine-readable findings (``file``/``line``/``col``/``rule``/
-``message``/``severity``) for tooling; the default text form is one
-``path:line:col: [rule] message`` per finding. ``--changed`` lints only
-files touched vs ``git HEAD`` (plus untracked) — the fast pre-commit mode.
+``message``/``severity``/``fingerprint``) for tooling; the default text
+form is one ``path:line:col: [rule] message`` per finding. ``--changed``
+lints only files touched vs ``git HEAD`` (plus untracked) — the fast
+pre-commit mode.
+
+The ``fingerprint`` is sha1 of ``file:rule:<normalized source line>`` —
+stable across unrelated edits that merely shift line numbers, so finding
+trackers (baselines, suppress-lists, CI diffing) can key on it.
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import subprocess
 import sys
 from typing import List, Optional, Tuple
+
+
+def _fingerprint(f) -> str:
+    """sha1 of file:rule:normalized-line — the finding's stable identity.
+    The LINE TEXT (whitespace-collapsed), not the line number, anchors it:
+    edits elsewhere in the file don't churn every fingerprint below them."""
+    try:
+        with open(f.path, encoding="utf-8", errors="replace") as fh:
+            lines = fh.read().splitlines()
+        text = " ".join(lines[f.line - 1].split()) \
+            if 0 < f.line <= len(lines) else ""
+    except OSError:
+        text = ""
+    key = f"{f.path.replace(os.sep, '/')}:{f.rule}:{text}"
+    return hashlib.sha1(key.encode("utf-8")).hexdigest()
 
 
 def _git_changed_files() -> Tuple[str, List[str]]:
@@ -119,6 +140,7 @@ def run(argv: Optional[List[str]] = None) -> int:
         print(json.dumps([{
             "file": f.path, "line": f.line, "col": f.col, "rule": f.rule,
             "message": f.message, "severity": f.severity,
+            "fingerprint": _fingerprint(f),
         } for f in findings], indent=2))
     else:
         for f in findings:

@@ -9,15 +9,20 @@ from rbg_tpu.analysis.rules.blocking import BlockingInCriticalSection
 from rbg_tpu.analysis.rules.deadlines import DeadlineHygiene
 from rbg_tpu.analysis.rules.errorcodes import ErrorCodeRegistry
 from rbg_tpu.analysis.rules.guardedby import GuardedBy
+from rbg_tpu.analysis.rules.jit import (BucketDiscipline, DonationSafety,
+                                        JitHygiene)
 from rbg_tpu.analysis.rules.metricnames import MetricNameRegistry
 from rbg_tpu.analysis.rules.spannames import SpanNameRegistry
 from rbg_tpu.analysis.rules.threads import ThreadLifecycle
 
 RULE_CLASSES: List[Type[Rule]] = [
     BlockingInCriticalSection,
+    BucketDiscipline,
     DeadlineHygiene,
+    DonationSafety,
     ErrorCodeRegistry,
     GuardedBy,
+    JitHygiene,
     MetricNameRegistry,
     SpanNameRegistry,
     ThreadLifecycle,

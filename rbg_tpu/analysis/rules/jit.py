@@ -1,0 +1,749 @@
+"""Compile & host-sync discipline rules: ``jit-hygiene``,
+``bucket-discipline``, ``donation-safety``.
+
+The repo's worst latency bugs have all been one class: a JAX program
+compiling, or a device→host sync landing, on the serving path after
+warmup (the PR-7 join-window compile, the PR-15 unbucketed scatters).
+These rules make that class lintable, riding the PR-5 interprocedural
+layer (``analysis/ipe.py``):
+
+* ``# hot_path`` on a function (own-line comment above the ``def``, or
+  trailing on the ``def`` line — the ``# guarded_by`` convention) marks a
+  serving-path root. Everything reachable from a root through the
+  module's call graph (``self.helper()`` edges in class scope, bare
+  ``helper()`` edges at module scope — the ipe model) is hot.
+
+* ``jit-hygiene`` flags, inside hot functions: host-sync forcers
+  (``.item()`` / ``np.asarray`` / ``float()`` / ``int()`` / ``bool()``
+  on values a device-taint dataflow says are jax arrays,
+  ``.block_until_ready()`` and ``jax.device_get`` unconditionally),
+  ``jax.jit`` / ``pl.pallas_call`` construction outside a cache seam
+  (programs are built at init or fetched through a seam, never per
+  request), ``time.sleep``, and logging calls that interpolate a device
+  value. Taint sources: ``jnp.*`` / ``jax.*`` call results, calls of a
+  program-getter result (``fn = self._get_x(...)`` then ``fn(...)``),
+  and KV-pool attribute chains (``*.cache.*`` / ``k_pages`` & friends).
+  Metadata access (``.shape`` / ``.dtype`` / ``.ndim`` / ``.nbytes``)
+  clears taint — reading a shape is host bookkeeping, not a sync — and
+  so does a forcer's own result (it is host data from then on).
+  A *cache seam* is a function that both ``.get()``\\ s a container and
+  stores into it by subscript (or fills a module-global memo declared
+  ``global``) — the ``_get_ragged_fn`` shape.
+
+* ``bucket-discipline`` flags raw shape values (``len(...)``, ``.shape``
+  and arithmetic over them) flowing into a jitted program's identity —
+  an argument of an in-scope program getter called from a hot function,
+  or the cache key of any seam under ``rbg_tpu/`` — unless laundered
+  through a registered bucketing helper: a function annotated
+  ``# bucket_fn`` and cataloged in ``obs.names.BUCKET_FNS`` (the rule
+  audits annotation ↔ catalog agreement for files under ``rbg_tpu/``, so
+  a helper added in code but not cataloged — or cataloged but stripped
+  of its annotation — is itself a finding).
+
+* ``donation-safety`` flags reusing a reference passed in a donated
+  position of a jitted program after the call (the PR-15 donated-scatter
+  contract): donated positions come from ``donate_argnums=`` at the
+  ``jax.jit`` site — in the calling function itself or in the in-scope
+  getter the callee was fetched from (int constants are unioned across
+  the getter's ``donate`` assignments, a sound over-approximation). The
+  reference is dead from the call until an assignment to the same
+  expression (or a prefix of it: ``self.cache = ...`` kills
+  ``self.cache.k_pages``) rebinds it. Line-ordered, single pass: loads
+  on the call's own lines (multi-line argument lists) and on the kill
+  line are not flagged, so the warm loops' call-then-rebind idiom stays
+  clean; loop-carried reuse across iterations is out of scope.
+
+All three skip test and bench files (fixtures are never exempt — they
+are the rules' own known-bad/known-good corpus)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from rbg_tpu.analysis.core import (FileContext, Finding, Rule, call_name,
+                                   dotted_name, kwarg)
+from rbg_tpu.analysis import ipe
+
+HOT_PATH_RE = re.compile(r"#\s*hot_path\b")
+BUCKET_FN_RE = re.compile(r"#\s*bucket_fn\b")
+
+# Attribute reads that return host metadata, not device data.
+_METADATA_ATTRS = {"shape", "dtype", "ndim", "nbytes", "size", "sharding",
+                   # PagedKVCache's host-int properties (shape lookups)
+                   "num_pages", "page_size", "quantized"}
+# KV-pool fields: attribute chains ending here (or passing through
+# ``.cache``) hold device buffers whatever the dataflow says.
+_KV_FIELDS = {"k_pages", "v_pages", "k_scales", "v_scales"}
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical"}
+# Builtin combinators that pass shape-ness through arithmetic.
+_SHAPE_COMBINATORS = {"max", "min", "sum", "abs", "round", "sorted", "len"}
+
+
+def _annotation_lines(ctx: FileContext, regex: re.Pattern) -> Set[int]:
+    """Lines covered by an annotation comment; an own-line comment covers
+    the line below it too (the guarded_by convention)."""
+    lines: Set[int] = set()
+    for lineno, text, own_line in ctx.comment_tokens():
+        if regex.search(text):
+            lines.add(lineno)
+            if own_line:
+                lines.add(lineno + 1)
+    return lines
+
+
+def _annotated_functions(ctx: FileContext, regex: re.Pattern
+                         ) -> Set[Tuple[str, str]]:
+    """{(scope name, function name)} for annotated defs; module-level
+    functions use the ipe scope name ``<module>``."""
+    lines = _annotation_lines(ctx, regex)
+    if not lines:
+        return set()
+    idx = ipe.index_module(ctx)
+    out: Set[Tuple[str, str]] = set()
+    for scope in [idx.module, *idx.classes.values()]:
+        for name, fn in scope.functions.items():
+            if fn.lineno in lines:
+                out.add((scope.name, name))
+    return out
+
+
+def _reachable(scope: "ipe.ScopeIndex", roots: Set[str]
+               ) -> Dict[str, List[str]]:
+    """fn name -> call chain from the nearest hot root (root itself has a
+    one-element chain), BFS over the scope's intra-scope call edges."""
+    chains: Dict[str, List[str]] = {r: [r] for r in roots
+                                    if r in scope.functions}
+    frontier = list(chains)
+    edges: Dict[str, List[str]] = {}
+    for c in scope.calls:
+        edges.setdefault(c.caller, []).append(c.callee)
+    while frontier:
+        cur = frontier.pop(0)
+        for callee in edges.get(cur, ()):
+            if callee not in chains and callee in scope.functions:
+                chains[callee] = chains[cur] + [callee]
+                frontier.append(callee)
+    return chains
+
+
+def _resolve(ctx: FileContext, dotted: str) -> str:
+    """Resolve the leading alias of a dotted name through the import
+    table: ``np.asarray`` -> ``numpy.asarray``, ``jnp.where`` ->
+    ``jax.numpy.where``."""
+    if not dotted:
+        return dotted
+    parts = dotted.split(".")
+    root = ctx.imports().get(parts[0], parts[0])
+    return ".".join([root] + parts[1:])
+
+
+def _is_jit_construction(ctx: FileContext, call: ast.Call) -> bool:
+    resolved = _resolve(ctx, call_name(call))
+    if resolved in ("jax.jit", "jax.pjit") or resolved.endswith(".pallas_call"):
+        return True
+    last = (call.func.attr if isinstance(call.func, ast.Attribute) else "")
+    return last == "pallas_call"
+
+
+def _is_cache_seam(fn: ast.AST) -> bool:
+    """The ``_get_*`` idiom: a function that ``.get()``\\ s a container
+    and stores into it by subscript — or fills a ``global`` memo."""
+    has_get = has_subscript_store = False
+    globals_declared: Set[str] = set()
+    stores_global = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            globals_declared.update(node.names)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "get"):
+            has_get = True
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    has_subscript_store = True
+                if isinstance(t, ast.Name) and t.id in globals_declared:
+                    stores_global = True
+    return (has_get and has_subscript_store) or stores_global
+
+
+def _constructs_jit(ctx: FileContext, fn: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and _is_jit_construction(ctx, n)
+               for n in ast.walk(fn))
+
+
+def _ordered_nodes(fn: ast.AST) -> List[ast.AST]:
+    """Pre-order nodes of one function body in source order, skipping
+    nested function / lambda / class bodies (deferred execution)."""
+    out: List[ast.AST] = []
+
+    def rec(n: ast.AST) -> None:
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            out.append(child)
+            rec(child)
+
+    rec(fn)
+    return out
+
+
+def _norm_text(ctx: FileContext, node: ast.AST) -> str:
+    # Structural render (NOT ctx.expr_text): get_source_segment re-splits
+    # the whole file per call, and donation tracking normalizes every Load
+    # node — source-segment lookups made that quadratic in file size.
+    try:
+        return "".join(ast.unparse(node).split())
+    except Exception:
+        return ""
+
+
+# ---- device-taint dataflow (shared by jit-hygiene's forcer checks) ----
+
+class _Taint:
+    """Approximate forward dataflow over one function body: which local
+    names hold device (jax) values. No CFG — statements in source order,
+    which matches how the hot paths are written."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.tainted: Set[str] = set()
+        self.getter_results: Set[str] = set()
+
+    def _is_getter_call(self, call: ast.Call) -> bool:
+        fname = call_name(call)
+        last = fname.rsplit(".", 1)[-1]
+        if last.startswith("_get_"):
+            return True
+        if isinstance(call.func, ast.Name):
+            return call.func.id in self.getter_results
+        return False
+
+    def is_forcer_result(self, call: ast.Call) -> bool:
+        resolved = _resolve(self.ctx, call_name(call))
+        if resolved in ("numpy.asarray", "numpy.array", "jax.device_get",
+                        "float", "int", "bool"):
+            return True
+        return (isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("item", "block_until_ready"))
+
+    def expr(self, node: Optional[ast.AST]) -> bool:
+        """True when ``node`` evaluates to a device value."""
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _METADATA_ATTRS:
+                return False        # .shape/.dtype/... is host bookkeeping
+            d = dotted_name(node)
+            parts = d.split(".") if d else []
+            if parts and (parts[-1] in _KV_FIELDS
+                          or "cache" in parts[1:]):
+                return True
+            return self.expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            if self.is_forcer_result(node):
+                return False
+            resolved = _resolve(self.ctx, call_name(node))
+            if resolved.split(".")[0] == "jax":
+                return True
+            if self._is_getter_call(node) or isinstance(node.func, ast.Call):
+                # fn(...) where fn came from a program getter — or the
+                # direct self._get_x(...)(...) form: a program's outputs
+                # are device arrays.
+                return True
+            return any(self.expr(a) for a in node.args)
+        if isinstance(node, ast.BinOp):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.body) or self.expr(node.orelse)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        return False
+
+    def assign(self, node: ast.AST) -> None:
+        """Update name taint / getter bindings for one assignment."""
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.AugAssign):
+            targets, value = [node.target], node.value
+        else:
+            return
+        if value is None:
+            return
+        is_getter = (isinstance(value, ast.Call)
+                     and not isinstance(value.func, ast.Call)
+                     and call_name(value).rsplit(".", 1)[-1]
+                     .startswith("_get_"))
+        t = self.expr(value)
+        for target in targets:
+            elts = (target.elts if isinstance(target, (ast.Tuple, ast.List))
+                    else [target])
+            for e in elts:
+                if not isinstance(e, ast.Name):
+                    continue
+                if is_getter:
+                    self.getter_results.add(e.id)
+                    self.tainted.discard(e.id)
+                elif t:
+                    self.tainted.add(e.id)
+                else:
+                    self.tainted.discard(e.id)
+                    self.getter_results.discard(e.id)
+
+
+class JitHygiene(Rule):
+    name = "jit-hygiene"
+    description = ("no host-sync forcers, per-request jit construction, "
+                   "sleeps, or device-value logging in functions reachable "
+                   "from a # hot_path root")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        if ctx.is_test or ctx.is_bench:
+            return []
+        hot = _annotated_functions(ctx, HOT_PATH_RE)
+        if not hot:
+            return []
+        idx = ipe.index_module(ctx)
+        findings: List[Finding] = []
+        for scope in [idx.module, *idx.classes.values()]:
+            roots = {fn for sc, fn in hot if sc == scope.name}
+            if not roots:
+                continue
+            for fn_name, chain in _reachable(scope, roots).items():
+                findings.extend(self._check_fn(
+                    ctx, scope.functions[fn_name], fn_name, chain))
+        return findings
+
+    def _check_fn(self, ctx: FileContext, fn: ast.AST, fn_name: str,
+                  chain: List[str]) -> List[Finding]:
+        out: List[Finding] = []
+        via = (" (hot path root)" if len(chain) == 1
+               else f" (reachable from hot path: {' -> '.join(chain)})")
+        seam = _is_cache_seam(fn)
+        # Parameters start untainted: the caller already staged them — the
+        # designed once-per-window fetch of carried state stays clean.
+        taint = _Taint(ctx)
+
+        def flag(node: ast.AST, msg: str) -> None:
+            out.append(Finding(self.name, ctx.path, node.lineno,
+                               node.col_offset, msg + via))
+
+        for node in _ordered_nodes(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                # Forcer checks inside the value run against the
+                # PRE-assignment taint (handled when the Call node is
+                # visited below, which happens after this update — so do
+                # the call scan here first).
+                value = getattr(node, "value", None)
+                if value is not None:
+                    for sub in ast.walk(value):
+                        if isinstance(sub, ast.Call):
+                            self._check_call(ctx, sub, taint, seam, flag)
+                taint.assign(node)
+            elif isinstance(node, ast.Call):
+                # Calls inside assignment values were already checked
+                # against the pre-assignment taint; _check_call's marker
+                # keeps them from re-running against the post state.
+                self._check_call(ctx, node, taint, seam, flag)
+        return out
+
+    def _check_call(self, ctx: FileContext, call: ast.Call, taint: _Taint,
+                    seam: bool, flag) -> None:
+        if getattr(call, "_jit_rule_seen", False):
+            return
+        call._jit_rule_seen = True
+        fname = call_name(call)
+        resolved = _resolve(ctx, fname)
+        last = (call.func.attr if isinstance(call.func, ast.Attribute)
+                else fname)
+
+        if _is_jit_construction(ctx, call):
+            if not seam:
+                flag(call, f"`{fname}(...)` builds a program on the hot "
+                           f"path — construct at init or fetch through a "
+                           f"cache seam (the _get_* idiom)")
+            return
+        if resolved == "time.sleep":
+            flag(call, "time.sleep on the hot path stalls every in-flight "
+                       "request")
+            return
+        if resolved == "jax.device_get":
+            flag(call, "jax.device_get forces a device->host sync on the "
+                       "hot path")
+            return
+        if last == "block_until_ready":
+            flag(call, ".block_until_ready() forces a device sync on the "
+                       "hot path")
+            return
+        if last == "item" and isinstance(call.func, ast.Attribute) \
+                and taint.expr(call.func.value):
+            flag(call, ".item() on a device value forces a host sync")
+            return
+        if resolved in ("numpy.asarray", "numpy.array") and call.args \
+                and taint.expr(call.args[0]):
+            flag(call, f"`{fname}(...)` on a device value forces a host "
+                       f"sync")
+            return
+        if resolved in ("float", "int", "bool") and len(call.args) == 1 \
+                and taint.expr(call.args[0]):
+            flag(call, f"`{resolved}(...)` on a device value forces a host "
+                       f"sync")
+            return
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _LOG_METHODS):
+            base = dotted_name(call.func.value)
+            root = base.split(".")[0] if base else ""
+            if (root in ("log", "logger", "logging")
+                    or ctx.imports().get(root, "") == "logging"):
+                args = list(call.args)
+                for a in list(args):
+                    if isinstance(a, ast.JoinedStr):
+                        args.extend(v.value for v in a.values
+                                    if isinstance(v, ast.FormattedValue))
+                if any(taint.expr(a) for a in args):
+                    flag(call, "logging interpolates a device value "
+                               "(formatting forces a host sync)")
+
+
+# ---- bucket-discipline ----
+
+def _catalog_bucket_fns() -> Set[str]:
+    try:
+        from rbg_tpu.obs import names
+        return set(names.BUCKET_FNS)
+    except Exception:
+        return set()
+
+
+class _ShapeTaint:
+    """Which local names carry a raw (unbucketed) shape value."""
+
+    def __init__(self, ctx: FileContext, bucket_fns: Set[str]):
+        self.ctx = ctx
+        self.bucket_fns = bucket_fns
+        self.raw: Set[str] = set()
+
+    def launders(self, call: ast.Call) -> bool:
+        return call_name(call).rsplit(".", 1)[-1] in self.bucket_fns
+
+    def expr(self, node: Optional[ast.AST]) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.raw
+        if isinstance(node, ast.Call):
+            if self.launders(node):
+                return False
+            fname = call_name(node)
+            if fname == "len":
+                return True
+            if fname.rsplit(".", 1)[-1] in _SHAPE_COMBINATORS:
+                return any(self.expr(a) for a in node.args)
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr == "shape":
+                return True
+            return False
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.body) or self.expr(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            return self.expr(node.elt)
+        return False
+
+    def assign(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets, value = [node.target], node.value
+        else:
+            return
+        if value is None:
+            return
+        t = self.expr(value)
+        for target in targets:
+            elts = (target.elts if isinstance(target, (ast.Tuple, ast.List))
+                    else [target])
+            for e in elts:
+                if isinstance(e, ast.Name):
+                    (self.raw.add if t else self.raw.discard)(e.id)
+
+
+class BucketDiscipline(Rule):
+    name = "bucket-discipline"
+    description = ("raw shapes (len()/.shape) must pass through a "
+                   "registered # bucket_fn helper before reaching a "
+                   "jitted program's identity")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        if ctx.is_test or ctx.is_bench:
+            return []
+        findings: List[Finding] = []
+        idx = ipe.index_module(ctx)
+        catalog = _catalog_bucket_fns()
+        annotated = _annotation_lines(ctx, BUCKET_FN_RE)
+        annotated_names: Set[str] = set()
+        in_repo = "rbg_tpu/" in ctx.path.replace("\\", "/")
+
+        for scope in [idx.module, *idx.classes.values()]:
+            for name, fn in scope.functions.items():
+                if fn.lineno in annotated:
+                    annotated_names.add(name)
+                    if in_repo and name not in catalog:
+                        findings.append(Finding(
+                            self.name, ctx.path, fn.lineno, fn.col_offset,
+                            f"`{name}` is annotated # bucket_fn but not "
+                            f"cataloged in obs/names.py BUCKET_FNS — "
+                            f"catalog it (the sentry and rules gate on "
+                            f"the catalog, not the comment)"))
+                elif in_repo and name in catalog:
+                    findings.append(Finding(
+                        self.name, ctx.path, fn.lineno, fn.col_offset,
+                        f"`{name}` is cataloged in BUCKET_FNS but its "
+                        f"definition lost the # bucket_fn annotation — "
+                        f"annotate it (or retire the catalog entry)"))
+
+        bucket_fns = catalog | annotated_names
+        hot = _annotated_functions(ctx, HOT_PATH_RE)
+        for scope in [idx.module, *idx.classes.values()]:
+            builders = {n for n, f in scope.functions.items()
+                        if _constructs_jit(ctx, f)}
+            roots = {fn for sc, fn in hot if sc == scope.name}
+            reach = _reachable(scope, roots) if roots else {}
+            for name, fn in scope.functions.items():
+                is_builder = name in builders
+                chain = reach.get(name)
+                if not is_builder and chain is None:
+                    continue
+                findings.extend(self._check_fn(
+                    ctx, fn, bucket_fns, builders, is_builder, chain))
+        return findings
+
+    def _check_fn(self, ctx: FileContext, fn: ast.AST,
+                  bucket_fns: Set[str], builders: Set[str],
+                  is_builder: bool, chain: Optional[List[str]]
+                  ) -> List[Finding]:
+        out: List[Finding] = []
+        taint = _ShapeTaint(ctx, bucket_fns)
+        via = ("" if chain is None
+               else f" (reachable from hot path: {' -> '.join(chain)})"
+               if len(chain) > 1 else " (hot path root)")
+
+        def flag(node: ast.AST, what: str) -> None:
+            out.append(Finding(
+                self.name, ctx.path, node.lineno, node.col_offset,
+                f"raw shape value reaches {what} — route it through a "
+                f"registered # bucket_fn helper (compile variety must "
+                f"stay logarithmic){via}"))
+
+        for node in _ordered_nodes(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = getattr(node, "value", None)
+                if value is not None:
+                    self._scan_value(ctx, value, taint, builders,
+                                     is_builder, chain, flag)
+                taint.assign(node)
+            elif isinstance(node, ast.Call):
+                self._scan_call(ctx, node, taint, builders, is_builder,
+                                chain, flag)
+        return out
+
+    def _scan_value(self, ctx, value, taint, builders, is_builder, chain,
+                    flag) -> None:
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                self._scan_call(ctx, sub, taint, builders, is_builder,
+                                chain, flag)
+
+    def _scan_call(self, ctx, call, taint, builders, is_builder, chain,
+                   flag) -> None:
+        if getattr(call, "_bucket_rule_seen", False):
+            return
+        call._bucket_rule_seen = True
+        fname = call_name(call)
+        last = fname.rsplit(".", 1)[-1]
+        # A hot-path call of an in-scope program getter: its arguments
+        # ARE the program identity.
+        if chain is not None and last in builders:
+            for a in call.args:
+                if taint.expr(a):
+                    flag(a, f"the jitted-program getter `{fname}()`")
+        # Inside any seam: the cache-lookup key selects the program.
+        if is_builder and last == "get" and call.args:
+            key = call.args[0]
+            for part in ([key] if not isinstance(key, ast.Tuple)
+                         else list(key.elts)):
+                if taint.expr(part):
+                    flag(part, "a jitted-program cache key")
+
+
+# ---- donation-safety ----
+
+def _donated_positions(ctx: FileContext, fn: ast.AST) -> Optional[Set[int]]:
+    """Donated arg positions for the jax.jit call inside ``fn`` (a
+    program getter), or None when ``fn`` builds no donated program.
+    Non-literal ``donate_argnums=`` expressions fall back to the union of
+    int constants assigned to the expression's names in this function —
+    a sound over-approximation for the conditional-donation idiom."""
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and _is_jit_construction(ctx, node)):
+            continue
+        dn = kwarg(node, "donate_argnums") or kwarg(node, "donate")
+        if dn is None:
+            continue
+        ints = {c.value for c in ast.walk(dn)
+                if isinstance(c, ast.Constant) and isinstance(c.value, int)}
+        if not ints:
+            names = {n.id for n in ast.walk(dn) if isinstance(n, ast.Name)}
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+                    targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                               else [stmt.target])
+                    if any(isinstance(t, ast.Name) and t.id in names
+                           for t in targets):
+                        ints |= {c.value for c in ast.walk(stmt.value)
+                                 if isinstance(c, ast.Constant)
+                                 and isinstance(c.value, int)}
+        if ints:
+            return ints
+    return None
+
+
+class DonationSafety(Rule):
+    name = "donation-safety"
+    description = ("a reference passed in a donate_argnums position is "
+                   "dead after the call until rebound — reuse is a "
+                   "use-after-donate")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        if ctx.is_test or ctx.is_bench:
+            return []
+        idx = ipe.index_module(ctx)
+        findings: List[Finding] = []
+        for scope in [idx.module, *idx.classes.values()]:
+            donated_getters = {}
+            for name, fn in scope.functions.items():
+                pos = _donated_positions(ctx, fn)
+                if pos is not None and _is_cache_seam(fn):
+                    donated_getters[name] = pos
+            for name, fn in scope.functions.items():
+                findings.extend(
+                    self._check_fn(ctx, fn, donated_getters))
+        return findings
+
+    def _check_fn(self, ctx: FileContext, fn: ast.AST,
+                  donated_getters: Dict[str, Set[int]]) -> List[Finding]:
+        out: List[Finding] = []
+        fn_vars: Dict[str, Set[int]] = {}
+        # (donated expr text, display text, call line, call end line)
+        donations: List[Tuple[str, str, int, int]] = []
+        events: List[Tuple[int, str, str]] = []   # (line, "load"/"kill", text)
+
+        def donated_of_call(call: ast.Call) -> Optional[Set[int]]:
+            if isinstance(call.func, ast.Name) \
+                    and call.func.id in fn_vars:
+                return fn_vars[call.func.id]
+            if isinstance(call.func, ast.Call):
+                inner = call_name(call.func).rsplit(".", 1)[-1]
+                return donated_getters.get(inner)
+            return None
+
+        for node in _ordered_nodes(fn):
+            if isinstance(node, ast.Assign):
+                value = node.value
+                if isinstance(value, ast.Call):
+                    getter = call_name(value).rsplit(".", 1)[-1]
+                    pos = None
+                    if getter in donated_getters:
+                        pos = donated_getters[getter]
+                    elif _is_jit_construction(ctx, value):
+                        pos = _donated_positions_of_call(value)
+                    if pos:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                fn_vars[t.id] = pos
+                        continue
+                for t in node.targets:
+                    for e in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                              else [t]):
+                        text = _norm_text(ctx, e)
+                        if text:
+                            events.append((node.lineno, "kill", text))
+            elif isinstance(node, ast.AugAssign):
+                text = _norm_text(ctx, node.target)
+                if text:
+                    events.append((node.lineno, "kill", text))
+            elif isinstance(node, ast.Call):
+                pos = donated_of_call(node)
+                if pos:
+                    end = getattr(node, "end_lineno", node.lineno)
+                    for p in sorted(pos):
+                        if p < len(node.args):
+                            text = _norm_text(ctx, node.args[p])
+                            if text:
+                                donations.append(
+                                    (text, ctx.expr_text(node.args[p]),
+                                     node.lineno, end))
+            elif isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)) \
+                    and isinstance(getattr(node, "ctx", None), ast.Load):
+                text = _norm_text(ctx, node)
+                if text:
+                    events.append((node.lineno, "load", text))
+
+        for text, display, call_line, call_end in donations:
+            kill_line = None
+            for line, kind, etext in events:
+                if (kind == "kill" and line > call_end
+                        and _covers(etext, text)):
+                    kill_line = line
+                    break
+            for line, kind, etext in events:
+                if kind != "load" or line <= call_end:
+                    continue
+                if kill_line is not None and line >= kill_line:
+                    continue
+                if _covers(text, etext) or etext == text:
+                    out.append(Finding(
+                        self.name, ctx.path, line, 0,
+                        f"`{display}` was donated to a jitted program at "
+                        f"line {call_line} (donate_argnums) — its buffer "
+                        f"is dead; rebind it before reuse"))
+                    break   # one finding per donation is enough
+        return out
+
+
+def _covers(prefix: str, text: str) -> bool:
+    """`prefix` kills/aliases `text`: equal, or a dotted/subscript
+    prefix of it (``self.cache`` covers ``self.cache.k_pages``)."""
+    return (text == prefix or text.startswith(prefix + ".")
+            or text.startswith(prefix + "["))
+
+
+def _donated_positions_of_call(call: ast.Call) -> Optional[Set[int]]:
+    dn = kwarg(call, "donate_argnums") or kwarg(call, "donate")
+    if dn is None:
+        return None
+    ints = {c.value for c in ast.walk(dn)
+            if isinstance(c, ast.Constant) and isinstance(c.value, int)}
+    return ints or None

@@ -38,6 +38,9 @@ from rbg_tpu.engine.config import EngineConfig, SamplingParams
 from rbg_tpu.engine.kvcache import PageAllocator, PagedKVCache, pages_for_tokens
 from rbg_tpu.engine.radix_cache import RadixCache
 from rbg_tpu.engine.sampler import NEG_INF, row_keys, sample, step_keys
+from rbg_tpu.obs.names import (PROGRAM_FUSED_DECODE, PROGRAM_PAGED_FWD,
+                               PROGRAM_RAGGED_FWD, PROGRAM_SAMPLER,
+                               PROGRAM_SPEC_VERIFY)
 from rbg_tpu.models.llama import forward_paged, forward_ragged, init_params
 from rbg_tpu.obs import names as obs_names
 from rbg_tpu.obs.metrics import REGISTRY
@@ -693,6 +696,7 @@ class Engine:
             for r in self.waiting:
                 r.blocked_steps += 1
 
+    # hot_path
     def _promote_host(self, req: "Request", matched: int,
                       shared_pages: List[int]):
         """Extend a radix hit from the host spill tier: promoted pages
@@ -796,6 +800,7 @@ class Engine:
             return False
         return True
 
+    # bucket_fn
     def _token_bucket(self, n: int) -> int:
         """Packed-token bucket: next power of two (≥ 8), so compile
         variety stays at log2(max_batch × prefill_chunk) programs."""
@@ -828,6 +833,7 @@ class Engine:
                             k_pages=k_pages, v_pages=v_pages,
                             k_scales=k_scales, v_scales=v_scales)
 
+            wrapped.__name__ = PROGRAM_RAGGED_FWD   # jitwatch catalog name
             donate = (7, 8, 9, 10) if self.cache.quantized else (7, 8)
             fn = jax.jit(wrapped, donate_argnums=donate)
             self._ragged_fn_cache[(R, T, RAGGED_GRID_REV)] = fn
@@ -916,6 +922,80 @@ class Engine:
             n += 1
         return n
 
+    def warm_decode(self) -> int:
+        """Pre-compile the PLAIN fused decode program (no penalties /
+        logprobs / LoRA / grammar) for every decode bucket × top-p
+        variant at the full multi_step window. The jitwatch sentry
+        surfaced this gap: warm_ragged covers the unified forward and
+        warm_join_windows the K=1 variants, but the full-window decode
+        program itself compiled lazily on the first pure-decode batch —
+        stalling every in-flight request mid-serving. Exotic variants
+        stay lazy (same policy as warm_join_windows). Same idle-engine
+        requirement as warm_ragged (the warm dispatches mutate the cache
+        from the calling thread). Returns the number of programs
+        compiled."""
+        if self.cfg.mode == "prefill" or self.cfg.speculative != "off":
+            return 0   # no fused decode path to warm
+        P = self.cfg.max_pages_per_seq
+        K = self.cfg.multi_step
+        n = 0
+        buckets = sorted({self._bucket(b)
+                          for b in range(1, self.cfg.max_batch + 1)})
+        for B in buckets:
+            for tpmp in (False, True):
+                if (B, False, False, tpmp, False, False, K) \
+                        in self._dec_fn_cache:
+                    continue
+                temps, ks, tps, mps, seeds, rids, _, _, _ = \
+                    self._sampling_rows([], B)
+                fn = self._get_decode_fn(B, False, False, tpmp, False,
+                                         False, K=K)
+                # mask all-False: no KV slot is written and pos/kvl never
+                # advance — the donated pool buffers round-trip unchanged
+                # (see warm_join_windows).
+                _, _, _, _, _, kp, vp, ksc, vsc, _, _ = fn(
+                    self.params, jnp.zeros(B, jnp.int32),
+                    jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+                    jnp.zeros((B, P), jnp.int32), jnp.zeros((B, K), bool),
+                    jnp.zeros(B, jnp.int32),
+                    self.cache.k_pages, self.cache.v_pages,
+                    self.cache.k_scales, self.cache.v_scales,
+                    row_keys(seeds, self._sample_base, rids),
+                    jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(tps),
+                    jnp.asarray(mps))
+                self.cache = PagedKVCache(k_pages=kp, v_pages=vp,
+                                          k_scales=ksc, v_scales=vsc)
+                n += 1
+        return n
+
+    def warm_samplers(self) -> int:
+        """Pre-compile the host-path sampler (prefill finish + unified
+        emission) for every sample-row bucket × top-p variant. One jitted
+        program per (pen, lp, tpmp) — but XLA compiles per SHAPE under
+        that wrapper, so each bucket is its own compile; a first-hit
+        mid-serving stalls the step exactly like an unwarmed forward.
+        Penalties/logprobs variants stay lazy (warm_join_windows
+        rationale). Returns the number of programs compiled."""
+        if self.cfg.mode == "decode":
+            return 0   # decode-only workers sample inside the fused scan
+        V = self.mcfg.vocab_size
+        n = 0
+        buckets = sorted({self._bucket(b)
+                          for b in range(1, self.cfg.max_batch + 1)})
+        for B in buckets:
+            for tpmp in (False, True):
+                temps, ks, tps, mps, seeds, rids, _, _, _ = \
+                    self._sampling_rows([], B)
+                keys = step_keys(row_keys(seeds, self._sample_base, rids),
+                                 jnp.zeros(B, jnp.int32))
+                fn = self._get_sampler(False, False, tpmp)
+                toks, _ = fn(jnp.zeros((B, V), jnp.float32), keys,
+                             jnp.asarray(temps), jnp.asarray(ks),
+                             jnp.asarray(tps), jnp.asarray(mps))
+                toks.block_until_ready()
+                n += 1
+        return n
+
     def _grow_decode_pages(self, rows: List[Request]) -> None:
         """Ensure every decode row has a page for its next token (the
         unified step advances decode rows by exactly one). Preempts the
@@ -938,6 +1018,7 @@ class Engine:
                 continue
             req.pages.extend(extra)
 
+    # hot_path
     def _unified_step(self) -> List[StepEvent]:
         """ONE ragged device dispatch for the whole batch: every
         mid-prefill row contributes its next chunk, every decoding row
@@ -1057,8 +1138,11 @@ class Engine:
                 np.add.at(oc[n], np.asarray(req.output, np.int64), 1)
             args += [pmask, jnp.asarray(oc), rep, pres, freq]
         toks, lps = self._get_sampler(pen, lp, tpmp)(*args)
-        toks = np.asarray(toks)
-        lps = np.asarray(lps) if lps is not None else None
+        # One batched fetch instead of two sequential np.asarray syncs
+        # (device_get resolves both leaves in a single transfer; a None
+        # lps leaf passes through untouched).
+        # lint: allow[jit-hygiene] the step's one intrinsic emission fetch — sampled tokens must reach the host to stream
+        toks, lps = jax.device_get((toks, lps))
         for n, (req, _, _, is_decode) in enumerate(sample_rows):
             lpv = (float(lps[n]) if lps is not None and req.sampling.logprobs
                    else None)
@@ -1140,8 +1224,9 @@ class Engine:
             pmask, oc_base, rep, pres, freq = self._penalty_rows(reqs, Bs)
             args += [pmask, jnp.asarray(oc_base), rep, pres, freq]
         toks, lps = self._get_sampler(pen, lp, tpmp)(*args)
-        toks = np.asarray(toks)
-        lps = np.asarray(lps) if lps is not None else None
+        # One batched fetch — same single-transfer emission as the
+        # unified step.
+        toks, lps = jax.device_get((toks, lps))
         events = []
         for n, req in enumerate(reqs):
             req.state = "running"
@@ -1210,6 +1295,7 @@ class Engine:
         return (jnp.asarray(pmask), oc_base, jnp.asarray(rep),
                 jnp.asarray(pres), jnp.asarray(freq))
 
+    # hot_path
     def _get_sampler(self, pen: bool, lp: bool, tpmp: bool = True):
         fn = self._samplers.get((pen, lp, tpmp))
         if fn is None:
@@ -1224,6 +1310,7 @@ class Engine:
                 def f(sel, keys, temps, ks, tps, mps):
                     return sample(sel, keys, temps, ks, tps, mps,
                                   want_logprobs=lp, use_top_p_min_p=tpmp)
+            f.__name__ = PROGRAM_SAMPLER   # jitwatch catalog name
             fn = jax.jit(f)
             self._samplers[(pen, lp, tpmp)] = fn
         return fn
@@ -1283,6 +1370,7 @@ class Engine:
             return []
         return self._emit_pending(st["pending"])
 
+    # hot_path
     def _decode_window(self) -> int:
         """Fused-scan window length for THIS step. Continuous batching:
         when a join is possible and work is waiting (a service submission
@@ -1397,6 +1485,7 @@ class Engine:
         donate += [7, 8, 9, 10] if self.cache.quantized else [7, 8]
         if pen:
             donate.append(17)  # ocounts
+        fused.__name__ = PROGRAM_FUSED_DECODE   # jitwatch catalog name
         fn = jax.jit(fused, donate_argnums=tuple(donate))
         self._dec_fn_cache[(B, pen, lp, tpmp, la, gr, K)] = fn
         return fn
@@ -1477,6 +1566,7 @@ class Engine:
             return events + self._fused_decode_step()
         return self._fused_decode_step()
 
+    # hot_path
     def _fused_decode_step(self) -> List[StepEvent]:
         events: List[StepEvent] = []
         batch = self._decode_batch()
@@ -1643,6 +1733,7 @@ class Engine:
             toks, lps = jax.vmap(samp, in_axes=(1, 1, 1))(logits, pos, gm)
             return toks, lps, kp, vp, ksc, vsc  # toks/lps: [T, B]
 
+        specfn.__name__ = PROGRAM_SPEC_VERIFY   # jitwatch catalog name
         donate = (6, 7, 8, 9) if self.cache.quantized else (6, 7)
         fn = jax.jit(specfn, donate_argnums=donate)
         self._spec_fn_cache[key] = fn
@@ -1876,6 +1967,7 @@ class Engine:
 
     # ---- device dispatch ----
 
+    # bucket_fn
     def _bucket(self, n: int) -> int:
         for b in self.cfg.decode_buckets:
             if b >= n:
@@ -1899,6 +1991,7 @@ class Engine:
                             v_pages=v_pages, k_scales=k_scales,
                             v_scales=v_scales, lora=lora, lora_ids=lids)
 
+            wrapped.__name__ = PROGRAM_PAGED_FWD   # jitwatch catalog name
             donate = (6, 7, 8, 9) if self.cache.quantized else (6, 7)
             fn = jax.jit(wrapped, donate_argnums=donate)
             self._fwd_cache[key] = fn

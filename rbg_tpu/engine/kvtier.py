@@ -60,10 +60,13 @@ def _promote_scatter():
         def scatter(kp, vp, ids, k, v):
             return kp.at[:, ids].set(k), vp.at[:, ids].set(v)
 
+        from rbg_tpu.obs.names import PROGRAM_KVTIER_PROMOTE
+        scatter.__name__ = PROGRAM_KVTIER_PROMOTE   # jitwatch catalog
         _PROMOTE_SCATTER = jax.jit(scatter, donate_argnums=(0, 1))
     return _PROMOTE_SCATTER
 
 
+# bucket_fn
 def _pow2_bucket(n: int) -> int:
     """Device transfers are padded to power-of-two page counts: a gather
     or scatter of k pages compiles one XLA program PER DISTINCT k, and

@@ -201,8 +201,11 @@ class PrefillWorker:
         critical section."""
         ids = jnp.asarray(req.pages[lo:hi], jnp.int32)
         t0 = time.perf_counter()
-        k = np.asarray(self.engine.cache.k_pages[:, ids])
-        v = np.asarray(self.engine.cache.v_pages[:, ids])
+        # One batched fetch: the two page slabs resolve in a single
+        # transfer instead of two sequential round-trip syncs.
+        # lint: allow[jit-hygiene] the transfer payload itself — exporting KV to the decode worker IS a host copy
+        k, v = jax.device_get((self.engine.cache.k_pages[:, ids],
+                               self.engine.cache.v_pages[:, ids]))
         self.metrics["transfer_s"] += time.perf_counter() - t0
         return k, v
 
@@ -269,6 +272,7 @@ class PrefillWorker:
             layers=int(cache.k_pages.shape[0]),
             page_size=self.engine.cfg.page_size)
 
+    # hot_path
     def prefill_stream(self, prompt: List[int],
                        sampling: Optional[SamplingParams] = None,
                        *, transport, peer: str,
@@ -480,6 +484,7 @@ class DecodeWorker:
 
     # ---- whole-bundle import ----
 
+    # hot_path
     def inject(self, bundle: KVBundle,
                sampling: Optional[SamplingParams] = None) -> int:
         """Import a KV bundle and start decoding it. Returns the request id.
@@ -576,6 +581,7 @@ class DecodeWorker:
         if sid not in self._stream_commits:
             self._stream_commits[sid] = _StreamCommit(receiver)
 
+    # hot_path
     def pump_streams(self) -> int:
         """Write newly-arrived chunks of every watched stream into the
         device page table. Loop-thread only. Returns cells committed."""
@@ -742,6 +748,7 @@ class DecodeWorker:
                 return base(x, pos, mask, kvl, table, k_pages, v_pages,
                             k_scales=k_scales, v_scales=v_scales)
 
+            window.__name__ = obs_names.PROGRAM_PD_WINDOW   # jitwatch catalog
             donate = (5, 6, 7, 8) if eng.cache.quantized else (5, 6)
             fn = jax.jit(window, donate_argnums=donate)
             self._window_fns[key] = fn
@@ -752,7 +759,12 @@ class DecodeWorker:
         if fn is None:
             from rbg_tpu.models.llama import _head
             eng = self.engine
-            fn = jax.jit(lambda x: _head(eng.params, eng.mcfg, x))
+
+            def head(x):
+                return _head(eng.params, eng.mcfg, x)
+
+            head.__name__ = obs_names.PROGRAM_PD_HEAD   # jitwatch catalog
+            fn = jax.jit(head)
             self._head_fns[B] = fn
         return fn
 
@@ -979,6 +991,21 @@ class DecodeWorker:
                 eng.cache = PagedKVCache(k_pages=kp, v_pages=vp,
                                          k_scales=ksc, v_scales=vsc)
         self._get_head_fn(B)(x).block_until_ready()
+        # The first-step sampler: _layer_sliced_first_step samples on the
+        # HOST path even on a decode-role engine (the fused scan only
+        # takes over from the second token). The jitwatch sentry caught
+        # this warmer silently not covering it — the compile landed
+        # mid-measurement the first time layer-sliced admission engaged.
+        from rbg_tpu.engine.sampler import row_keys, step_keys
+        temps, ks, tps, mps, seeds, rids, _, _, _ = eng._sampling_rows([], B)
+        keys = step_keys(row_keys(seeds, eng._sample_base, rids),
+                         jnp.zeros(B, jnp.int32))
+        for tpmp in (False, True):
+            toks, _ = eng._get_sampler(False, False, tpmp)(
+                jnp.zeros((B, eng.mcfg.vocab_size), jnp.float32), keys,
+                jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(tps),
+                jnp.asarray(mps))
+            toks.block_until_ready()
         return time.perf_counter() - t0
 
     def abandon_stream(self, receiver) -> None:

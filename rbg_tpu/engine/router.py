@@ -806,6 +806,7 @@ class RouterState:
             return c
         return cost, replicate, frozenset(entries)
 
+    # hot_path
     def candidates_for(self, role: str, prompt) -> List[str]:
         """Candidates ordered CACHE-AWARE. The local last-serving LRU
         stays the FAST PATH: a viable affinity hit answers with zero I/O

@@ -25,6 +25,7 @@ from rbg_tpu.engine.protocol import (CODE_DEADLINE, DeadlineExceeded,
 from rbg_tpu.obs import names, trace
 from rbg_tpu.obs.metrics import REGISTRY
 from rbg_tpu.obs.slo import SLOTargets, SLOTracker
+from rbg_tpu.utils import jitwatch
 from rbg_tpu.utils.locktrace import named_lock
 from rbg_tpu.utils.racetrace import guard as _race_guard
 
@@ -94,6 +95,17 @@ def embed_prompts(engine: Engine, prompts: List[List[int]]) -> List[List[float]]
 EMBED_MAX_BATCH = 32
 
 
+# bucket_fn
+def _chunk_bucket(n: int, chunk: int = 1) -> int:
+    """Round ``n`` up to ``chunk`` × a power of two: log-many compiled
+    shapes per axis instead of one per chunk multiple (chunk=1 is a plain
+    pow2 bucket). Extra padding is masked out downstream."""
+    m = 1
+    while m * chunk < n:
+        m *= 2
+    return m * chunk
+
+
 def _embed_batch(engine: Engine, prompts: List[List[int]]) -> List[List[float]]:
     import jax
     import jax.numpy as jnp
@@ -101,10 +113,10 @@ def _embed_batch(engine: Engine, prompts: List[List[int]]) -> List[List[float]]:
 
     chunk = engine.cfg.prefill_chunk
     longest = max(len(p) for p in prompts)
-    T = max(chunk, ((longest + chunk - 1) // chunk) * chunk)
-    B = 1
-    while B < len(prompts):
-        B *= 2
+    # Both axes bucketed (log compile variety): T to chunk × pow2 — the
+    # old chunk-multiple rounding compiled one program per multiple.
+    T = _chunk_bucket(longest, chunk)
+    B = _chunk_bucket(len(prompts))
     cache = getattr(engine, "_embed_cache", None)
     if cache is None:
         cache = engine._embed_cache = {}
@@ -121,6 +133,7 @@ def _embed_batch(engine: Engine, prompts: List[List[int]]) -> List[List[float]]:
             m = mask[:, :, None].astype(jnp.float32)
             return (h * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
 
+        pooled.__name__ = names.PROGRAM_EMBED_POOLED   # jitwatch catalog
         fn = cache[(B, T)] = jax.jit(pooled)
     toks = np.zeros((B, T), np.int32)
     mask = np.zeros((B, T), bool)
@@ -390,10 +403,20 @@ class _BatchService:
                      for i in range(B)]
             for p in self.submit_wave(items):
                 self.wait(p, 600.0)
+        # The waves only compiled the fused-decode and sampler variants
+        # their own composition hit (default sampling, wave-sized
+        # buckets); warm_decode/warm_samplers cover the full plain
+        # bucket × top-p grid — the gap the jitwatch sentry surfaced.
+        self.engine.warm_decode()
         # The waves compiled the K=multi_step fused programs; the K=1
         # early-exit twins (_decode_window's join shortening) would
         # otherwise first compile MID-SERVING, on the join-latency path.
         self.engine.warm_join_windows()
+        self.engine.warm_samplers()
+        # Arm the jitwatch gate (no-op unless RBG_JITWATCH armed the
+        # hooks): everything compiled above is the blessed warmup set;
+        # any cataloged program compiling after this is a violation.
+        jitwatch.warmup_complete()
         # The warm waves were compile-laden: their token throughput is
         # not serving throughput, and an early-reject predictor trained
         # on it would shed the first real traffic. Reset so the EMA

@@ -39,6 +39,9 @@ DISRUPTION_SPARES_CONSUMED_TOTAL = "rbg_disruption_spares_consumed_total"
 LOCKTRACE_INVERSIONS_TOTAL = "rbg_locktrace_inversions_total"
 RACE_CHECKED_TOTAL = "rbg_race_checked_total"
 RACE_VIOLATIONS_TOTAL = "rbg_race_violations_total"
+JIT_COMPILES_TOTAL = "rbg_jit_compiles_total"
+JIT_UNWARMED_COMPILES_TOTAL = "rbg_jit_unwarmed_compiles_total"
+JIT_HOST_SYNCS_TOTAL = "rbg_jit_host_syncs_total"
 TRACE_TRACES_TOTAL = "rbg_trace_traces_total"
 TRACE_SPANS_DROPPED_TOTAL = "rbg_trace_spans_dropped_total"
 SERVING_REQUESTS_FINISHED_TOTAL = "rbg_serving_requests_finished_total"
@@ -165,6 +168,9 @@ COUNTERS = frozenset({
     LOCKTRACE_INVERSIONS_TOTAL,
     RACE_CHECKED_TOTAL,
     RACE_VIOLATIONS_TOTAL,
+    JIT_COMPILES_TOTAL,
+    JIT_UNWARMED_COMPILES_TOTAL,
+    JIT_HOST_SYNCS_TOTAL,
     TRACE_TRACES_TOTAL,
     TRACE_SPANS_DROPPED_TOTAL,
     SERVING_REQUESTS_FINISHED_TOTAL,
@@ -295,6 +301,11 @@ HELP = {
     LOCKTRACE_INVERSIONS_TOTAL: "Lock acquisition-order inversions observed",
     RACE_CHECKED_TOTAL: "Guarded-field accesses checked by racetrace",
     RACE_VIOLATIONS_TOTAL: "Guarded-field accesses without the owning lock",
+    JIT_COMPILES_TOTAL: "XLA compiles recorded while jitwatch is armed",
+    JIT_UNWARMED_COMPILES_TOTAL:
+        "Cataloged programs compiled after warmup_complete(), per program",
+    JIT_HOST_SYNCS_TOTAL:
+        "Device-to-host syncs observed by the jitwatch probe",
     TRACE_TRACES_TOTAL: "Traces finalized into the trace sink, per result",
     TRACE_SPANS_DROPPED_TOTAL:
         "Spans dropped by the per-trace span bound",
@@ -562,6 +573,54 @@ SPAN_TOPOLOGY_CUTOVER = "topology.cutover"
 SPAN_TOPOLOGY_DRAIN = "topology.drain"
 SPAN_PLANE_TAKEOVER = "plane.takeover"
 SPAN_ROUTER_RESHARD = "router.reshard"
+
+# ---- jitted program catalog (jitwatch sentry + warmers) ----
+#
+# Same contract as the metric catalog: every named hot-path XLA program
+# the engine builds is declared here once — the builders stamp the inner
+# callable's __name__ with the constant (XLA's sym_name is "jit_" + that
+# name), the warmers pre-compile them, and the jitwatch sentry gates on
+# exactly this set after warmup_complete(). A program missing here is
+# invisible to the recompile gate; a warmer that silently stops covering
+# a cataloged variant is a drill failure. Naming contract: ``rbg_<area>``.
+
+PROGRAM_RAGGED_FWD = "rbg_ragged_fwd"          # Engine._get_ragged_fn
+PROGRAM_PAGED_FWD = "rbg_paged_fwd"            # Engine._get_fwd
+PROGRAM_FUSED_DECODE = "rbg_fused_decode"      # Engine._get_decode_fn
+PROGRAM_SPEC_VERIFY = "rbg_spec_verify"        # Engine._get_spec_fn
+PROGRAM_SAMPLER = "rbg_sampler"                # Engine._get_sampler
+PROGRAM_PD_WINDOW = "rbg_pd_window"            # DecodeWorker._get_window_fn
+PROGRAM_PD_HEAD = "rbg_pd_head"                # DecodeWorker._get_head_fn
+PROGRAM_EMBED_POOLED = "rbg_embed_pooled"      # service._embed_batch
+PROGRAM_KVTIER_PROMOTE = "rbg_kvtier_promote"  # kvtier._promote_scatter
+
+PROGRAMS = frozenset({
+    PROGRAM_RAGGED_FWD,
+    PROGRAM_PAGED_FWD,
+    PROGRAM_FUSED_DECODE,
+    PROGRAM_SPEC_VERIFY,
+    PROGRAM_SAMPLER,
+    PROGRAM_PD_WINDOW,
+    PROGRAM_PD_HEAD,
+    PROGRAM_EMBED_POOLED,
+    PROGRAM_KVTIER_PROMOTE,
+})
+
+# ---- bucketing-helper catalog (bucket-discipline lint rule) ----
+#
+# The registered shape launderers: a raw shape (len(...), .shape) may
+# reach a jitted program's cache key or a program-getter argument only
+# through one of these (each carries a ``# bucket_fn`` annotation at its
+# definition). The static rule audits the annotation set against this
+# catalog so a helper added in code but not cataloged (or vice versa) is
+# itself a finding.
+
+BUCKET_FNS = frozenset({
+    "_pow2_bucket",      # engine/kvtier.py — pow2 page counts
+    "_bucket",           # engine/engine.py — decode_buckets table
+    "_token_bucket",     # engine/engine.py — packed-token pow2 (>= 8)
+    "_chunk_bucket",     # engine/service.py — chunk-multiple pow2
+})
 
 SPANS = frozenset({
     SPAN_HTTP_REQUEST,

@@ -827,6 +827,13 @@ def run_serving_overload(cfg: OverloadConfig, service=None) -> dict:
                          slo_ttft_s=cfg.slo_ttft_s,
                          slo_tpot_s=cfg.slo_tpot_s),
             max_queue=cfg.max_queue)
+        from rbg_tpu.utils import jitwatch
+        if jitwatch.enabled():
+            # The compile sentry needs a warmed service: warmup records
+            # the blessed compile set, then warmup_complete() (called at
+            # its end) arms the gate — every compile the overload itself
+            # triggers is a zero_unwarmed_compiles red.
+            service.warmup(input_len=32, out_len=2)
     # Windowed-signal plane: sample through the drill so the report's
     # signals section reflects THIS run's windows.
     sampler = timeseries.ensure_started()
@@ -1103,6 +1110,11 @@ def run_kv_stream(cfg: KVStreamConfig) -> dict:
         # may have taken the plain path, so compile the window chain
         # explicitly (masked writes — live pool unchanged).
         pair.decode.warm_layer_sliced(cfg.admit_layers)
+    # Everything above is the blessed warmup set; the measured phase
+    # below must not compile a cataloged program (no-op unless
+    # --jitwatch armed the hooks).
+    from rbg_tpu.utils import jitwatch as _jitwatch
+    _jitwatch.warmup_complete()
 
     results = []
     failures = []
@@ -3489,6 +3501,13 @@ def main(argv=None) -> int:
                          "sampled read) of a `# guarded_by[...]` field "
                          "checks the owning lock is held; violations fail "
                          "the run via the race_free invariant")
+    ap.add_argument("--jitwatch", action="store_true",
+                    help="run the scenario with the compile/host-sync "
+                         "sentry armed (RBG_JITWATCH=warn unless the env "
+                         "var is already set): every XLA compile is "
+                         "recorded; a cataloged program compiling AFTER "
+                         "warmup_complete() fails the run via the "
+                         "zero_unwarmed_compiles invariant")
     ap.add_argument("--trace", action="store_true",
                     help="run the scenario with request tracing armed "
                          "(obs/trace.py): per-request hop spans, the "
@@ -3518,6 +3537,16 @@ def main(argv=None) -> int:
         from rbg_tpu.utils import racetrace
         racetrace.reset()
         racetrace.arm()
+    if args.jitwatch:
+        # warn, not raise — same rationale as racetrace: the drill's job
+        # is to finish and REPORT; zero_unwarmed_compiles turns records
+        # into a red. Armed BEFORE construction/warmup so the warmup
+        # compile set is recorded (warmup_complete() arms the gate at
+        # the end of _BatchService.warmup).
+        os.environ.setdefault("RBG_JITWATCH", "warn")
+        from rbg_tpu.utils import jitwatch
+        jitwatch.disarm()
+        jitwatch.arm()
     if args.trace:
         # Programmatic arming (env-var route: RBG_TRACE=1). Sample 1.0 by
         # default so a drill of a few dozen requests reliably fills the
@@ -3603,6 +3632,7 @@ def main(argv=None) -> int:
         report["load1_before"] = round(load1, 2)
         _attach_locktrace(report, args)
         _attach_racetrace(report, args)
+        _attach_jitwatch(report, args)
         _attach_trace(report, args)
         if args.json_out:
             with open(args.json_out, "w") as f:
@@ -3625,6 +3655,7 @@ def main(argv=None) -> int:
         argv if argv is not None else __import__("sys").argv[1:])
     _attach_locktrace(report, args)
     _attach_racetrace(report, args)
+    _attach_jitwatch(report, args)
     _attach_trace(report, args)
     if args.json_out:
         with open(args.json_out, "w") as f:
@@ -3638,6 +3669,8 @@ def main(argv=None) -> int:
     if report.get("locktrace", {}).get("inversions"):
         return 1
     if report.get("racetrace", {}).get("violations"):
+        return 1
+    if report.get("jitwatch", {}).get("violations"):
         return 1
     return 0
 
@@ -3653,6 +3686,26 @@ def _attach_locktrace(report: dict, args) -> None:
     if "invariants" in report:
         report["invariants"]["lock_order_acyclic"] = (
             not locktrace.inversions())
+
+
+def _attach_jitwatch(report: dict, args) -> None:
+    """Fold the compile-sentry verdict into the report when --jitwatch
+    ran: the counters, every post-warmup compile of a cataloged program
+    (with shape signature + origin stack), and the
+    zero_unwarmed_compiles invariant so one fails the drill red."""
+    if not getattr(args, "jitwatch", False):
+        return
+    from rbg_tpu.utils import jitwatch
+    report["jitwatch"] = {
+        "counters": jitwatch.counters(),
+        "warmed_programs": sorted(jitwatch.warmed_programs()),
+        "unwarmed_by_program": jitwatch.unwarmed_by_program(),
+        "violations": jitwatch.violations(),
+    }
+    if "invariants" in report:
+        report["invariants"]["zero_unwarmed_compiles"] = (
+            not jitwatch.violations())
+    jitwatch.disarm()
 
 
 def _attach_trace(report: dict, args) -> None:

@@ -5,9 +5,13 @@
 #   scripts/lint.sh                  # lint rbg_tpu/ (the repo gate)
 #   scripts/lint.sh PATH...          # lint specific files/dirs
 #   scripts/lint.sh --json [PATH...] # machine-readable findings
-#                                    #   (file/line/rule/message/severity);
-#                                    #   skips the ruff tier so stdout
-#                                    #   stays pure JSON
+#                                    #   (file/line/col/rule/message/
+#                                    #   severity/fingerprint); skips the
+#                                    #   ruff tier so stdout stays pure
+#                                    #   JSON. fingerprint = sha1 of
+#                                    #   file:rule:normalized-line —
+#                                    #   stable across line-number churn,
+#                                    #   the key for finding trackers.
 #   scripts/lint.sh --changed        # only files changed vs git HEAD —
 #                                    #   the fast pre-commit mode
 #

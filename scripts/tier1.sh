@@ -58,6 +58,35 @@ assert 'goodput_rps' in (r.get('goodput_vs_throughput') or {}), \
              "traces, or missing SLO accounting in /tmp/_t1_race.json" >&2
         exit 1
     fi
+    # Dynamic complement to the jit-hygiene/bucket-discipline rules: the
+    # overload drill with the compile sentry armed. The service warms up
+    # (recording the blessed compile set, then warmup_complete() arms the
+    # gate) and the drill itself must compile NOTHING cataloged — one
+    # post-warmup compile of a rbg_* program is the mid-serving stall the
+    # static rules exist to prevent, and fails this smoke red. Outside
+    # the 870 s pytest budget, --lint mode only; capped at 300 s.
+    echo "== rbg-tpu stress --scenario overload --jitwatch (compile-sentry smoke) =="
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
+            stress --scenario overload --jitwatch --clients 2 --requests 2 \
+            --max-queue 2 --max-batch 1 --timeout-s 60 --json >/tmp/_t1_jitwatch.json; then
+        echo "TIER1 JITWATCH SMOKE FAILED — see /tmp/_t1_jitwatch.json" \
+             "(zero_unwarmed_compiles/invariants)" >&2
+        exit 1
+    fi
+    if ! python -c "
+import json
+r = json.load(open('/tmp/_t1_jitwatch.json'))
+jw = r.get('jitwatch') or {}
+assert r['invariants'].get('zero_unwarmed_compiles'), \
+    'post-warmup compiles: %s' % jw.get('violations')
+assert jw.get('counters', {}).get('rbg_jit_compiles_total', 0) > 0, \
+    'sentry recorded no compiles at all — hook not installed?'
+assert jw.get('warmed_programs'), 'no cataloged program in the warmup set'
+"; then
+        echo "TIER1 JITWATCH SMOKE FAILED — unwarmed post-warmup compiles" \
+             "or a dead sentry in /tmp/_t1_jitwatch.json" >&2
+        exit 1
+    fi
     # Capacity-follows-load smoke: the autoscale drill against a live
     # mini-plane (diurnal + burst trace; the AutoscaleController must
     # raise targets within an evaluation period of the burst, drop them
@@ -94,9 +123,9 @@ assert len(r.get('curve') or []) > 10, 'capacity-vs-load curve is empty'
     # layer-sliced admission ENGAGED — at least one row admitted at
     # layer-k coverage with full coverage still pending. Outside the
     # 870 s pytest budget, --lint mode only.
-    echo "== rbg-tpu stress --scenario kvstream --kv-slow-link (smoke) =="
+    echo "== rbg-tpu stress --scenario kvstream --kv-slow-link --jitwatch (smoke) =="
     if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
-            stress --scenario kvstream --kv-slow-link 0.05 --json \
+            stress --scenario kvstream --kv-slow-link 0.05 --jitwatch --json \
             >/tmp/_t1_kvstream.json; then
         echo "TIER1 KVSTREAM SMOKE FAILED — see /tmp/_t1_kvstream.json" \
              "(invariants)" >&2
@@ -118,6 +147,9 @@ assert la.get('engaged_requests', 0) >= 1, \
 assert any(c and c[0] < c[1]
            for c in la.get('coverage_at_admit') or []), \
     'no stream admitted with full coverage still pending: %s' % la
+assert inv.get('zero_unwarmed_compiles'), \
+    'measured phase compiled a cataloged program: %s' % \
+    (r.get('jitwatch') or {}).get('violations')
 "; then
         echo "TIER1 KVSTREAM SMOKE FAILED — overlap/directory/zero-drop" \
              "invariant red in /tmp/_t1_kvstream.json" >&2

@@ -1,0 +1,37 @@
+"""Known-bad corpus for bucket-discipline: raw shape values reaching a
+jitted program's identity — getter arguments on the hot path and cache
+keys inside the seam itself."""
+
+import jax
+
+_PROGRAMS = {}
+
+
+def _kernel(x):
+    return x
+
+
+def _get_fn(n):
+    fn = _PROGRAMS.get(n)
+    if fn is None:
+        fn = _PROGRAMS[n] = jax.jit(_kernel)
+    return fn
+
+
+def _get_raw_keyed(batch):
+    key = len(batch)
+    fn = _PROGRAMS.get(key)  # BAD raw cache key selects the program
+    if fn is None:
+        fn = _PROGRAMS[key] = jax.jit(_kernel)
+    return fn
+
+
+# hot_path
+def serve(prompts, state):
+    b = len(prompts)
+    fn = _get_fn(b)  # BAD raw batch size into the getter
+    t = max(len(p) for p in prompts)
+    fn2 = _get_fn(t + 1)  # BAD raw token-count arithmetic into the getter
+    rows = state.shape[0]
+    fn3 = _get_fn(rows)  # BAD .shape flows into the program identity
+    return fn(state), fn2(state), fn3(state)

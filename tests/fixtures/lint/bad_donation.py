@@ -1,0 +1,50 @@
+"""Known-bad corpus for donation-safety: references passed in donated
+positions reused after the call without a rebind — via a donated getter,
+a direct jax.jit site, and the conditional-donation idiom."""
+
+import jax
+
+_PROGRAMS = {}
+
+
+def _step(x, pages):
+    return x + pages, pages
+
+
+def _step4(x, a, b, c):
+    return x, a, b, c
+
+
+def _get_step(n):
+    fn = _PROGRAMS.get(n)
+    if fn is None:
+        fn = _PROGRAMS[n] = jax.jit(_step, donate_argnums=(1,))
+    return fn
+
+
+def _get_cond(n, quantized):
+    fn = _PROGRAMS.get((n, quantized))
+    if fn is None:
+        donate = (2,) if quantized else (2, 3)
+        fn = _PROGRAMS[(n, quantized)] = jax.jit(
+            _step4, donate_argnums=donate)
+    return fn
+
+
+def reuse_via_getter(x, pages):
+    fn = _get_step(4)
+    out, new_pages = fn(x, pages)
+    total = pages.sum()  # BAD pages was donated at the call above
+    return out, total
+
+
+def reuse_direct(x, pages):
+    fn = jax.jit(_step, donate_argnums=(1,))
+    out = fn(x, pages)
+    return out, pages + 1  # BAD donated buffer read again
+
+
+def reuse_conditional(x, a, b, c):
+    fn = _get_cond(2, True)
+    out = fn(x, a, b, c)
+    return out, b * 2, c * 2  # BAD both conditionally-donated buffers dead

@@ -1,0 +1,56 @@
+"""Known-bad corpus for jit-hygiene: every marked line must be flagged —
+forcers on device values, per-request program construction, sleeps and
+device-value logging, both in the hot root itself and in helpers only
+reachable through the call chain."""
+
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger("fixture")
+
+
+def _kernel(x):
+    return x * 2
+
+
+# hot_path
+def serve_step(batch):
+    x = jnp.zeros((4,))
+    first = float(x[0])  # BAD float() on a device value forces a host sync
+    arr = np.asarray(x)  # BAD np.asarray on a device value forces a host sync
+    fn = jax.jit(_kernel)  # BAD program built per request, not via a seam
+    y = fn(x)
+    y.block_until_ready()  # BAD explicit device sync on the hot path
+    host = jax.device_get(y)  # BAD device_get on the hot path
+    log.info("step result %s", y)  # BAD logging interpolates a device value
+    _stage_one(y)
+    return first, arr, host
+
+
+def _stage_one(y):
+    _stage_two(y)
+
+
+def _stage_two(y):
+    time.sleep(0.001)  # BAD sleep, serve_step -> _stage_one -> _stage_two
+    z = jnp.ones(2)
+    return z.item()  # BAD .item() in a transitively-hot helper
+
+
+class Worker:
+    def __init__(self):
+        self.cache = None
+        self.fn = jax.jit(_kernel)  # fine: init-time construction, not hot
+
+    # hot_path
+    def inject(self, tokens):
+        pages = np.asarray(self.cache.k_pages)  # BAD KV slab fetch is a sync
+        self._refresh()
+        return pages
+
+    def _refresh(self):
+        self.fn = jax.jit(_kernel)  # BAD rebuilt via inject -> _refresh

@@ -1,0 +1,39 @@
+"""Known-good fixture for bucket-discipline: every shape value is
+laundered through a registered # bucket_fn helper before it touches
+program identity; cold paths may size things freely."""
+
+import jax
+
+_PROGRAMS = {}
+
+
+def _kernel(x):
+    return x
+
+
+# bucket_fn
+def _fixture_bucket(n):
+    m = 1
+    while m < n:
+        m *= 2
+    return m
+
+
+def _get_fn(n):
+    fn = _PROGRAMS.get(n)
+    if fn is None:
+        fn = _PROGRAMS[n] = jax.jit(_kernel)
+    return fn
+
+
+# hot_path
+def serve(prompts, state):
+    b = _fixture_bucket(len(prompts))
+    fn = _get_fn(b)
+    t = _fixture_bucket(max(len(p) for p in prompts))
+    return fn(state), _get_fn(t)(state)
+
+
+def admin_resize(pool, n):
+    # Cold path: no hot_path root reaches this, raw sizes are fine.
+    return pool.resize(len(pool.items) + n)

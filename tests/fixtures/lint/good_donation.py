@@ -1,0 +1,71 @@
+"""Known-good fixture for donation-safety: donated references rebound
+before reuse (name rebind and the prefix-kill cache rebind), the warm
+loops' multi-line call-then-rebind idiom, and non-donated programs."""
+
+import jax
+
+_PROGRAMS = {}
+
+
+def _step(x, pages):
+    return x + pages, pages
+
+
+def _get_step(n):
+    fn = _PROGRAMS.get(n)
+    if fn is None:
+        fn = _PROGRAMS[n] = jax.jit(_step, donate_argnums=(1,))
+    return fn
+
+
+def _get_plain(n):
+    fn = _PROGRAMS.get(("plain", n))
+    if fn is None:
+        fn = _PROGRAMS[("plain", n)] = jax.jit(_step)
+    return fn
+
+
+def rebind_then_reuse(x, pages):
+    fn = _get_step(4)
+    out, fresh = fn(x, pages)
+    pages = fresh          # rebind: the name points at live data again
+    return out, pages.sum()
+
+
+def multiline_call_then_rebind(x, pages):
+    fn = _get_step(8)
+    out, fresh = fn(
+        x,
+        pages,
+    )
+    pages = fresh
+    return out, pages
+
+
+def plain_program(x, pages):
+    fn = _get_plain(4)
+    out = fn(x, pages)
+    return out, pages.sum()   # nothing donated: reuse is fine
+
+
+class Cache:
+    def __init__(self, pages):
+        self.pages = pages
+
+
+class Pool:
+    def __init__(self, cache):
+        self.cache = cache
+        self._fns = {}
+
+    def _get_promote(self, n):
+        fn = self._fns.get(n)
+        if fn is None:
+            fn = self._fns[n] = jax.jit(_step, donate_argnums=(1,))
+        return fn
+
+    def promote(self, x):
+        fn = self._get_promote(2)
+        out, new_pages = fn(x, self.cache.pages)
+        self.cache = Cache(new_pages)
+        return self.cache.pages.sum()   # the prefix rebind revived the chain

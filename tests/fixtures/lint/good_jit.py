@@ -1,0 +1,46 @@
+"""Known-good fixture for jit-hygiene: metadata reads, cache-seam program
+fetches, host math on host values, and forcers confined to functions no
+hot-path root ever reaches."""
+
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger("fixture")
+
+_PROGRAMS = {}
+
+
+def _kernel(x):
+    return x * 2
+
+
+def _get_step(b):
+    # The cache seam: construction here is legal even though the hot
+    # root reaches this function — programs are fetched, not rebuilt.
+    fn = _PROGRAMS.get(b)
+    if fn is None:
+        fn = _PROGRAMS[b] = jax.jit(_kernel)
+    return fn
+
+
+# hot_path
+def serve_step(batch, state):
+    fn = _get_step(len(batch))
+    y = fn(state)
+    rows = y.shape[0]            # metadata read, not a sync
+    width = float(rows)          # host int -> float: no device value involved
+    log.info("dispatched %d rows", rows)
+    emitted = jnp.where(y > 0, y, 0)
+    # lint: allow[jit-hygiene] the step's one intrinsic emission fetch for the fixture
+    return np.asarray(emitted), width
+
+
+def drain_and_report(y):
+    # Not reachable from any hot root: forcers are fine here.
+    time.sleep(0.001)
+    host = np.asarray(y)
+    return float(host[0])

@@ -158,3 +158,35 @@ def test_embed_batched_matches_singles_and_chunks():
                                  - np.asarray(batch[i]))) < 1e-4
     finally:
         svc.stop()
+
+
+def test_embed_program_cache_keys_are_bucketed():
+    """Compile variety stays logarithmic: every compiled embed program is
+    keyed by (_chunk_bucket(B), _chunk_bucket(T, chunk)), so nearby raw
+    sizes share one program instead of compiling per exact shape."""
+    from rbg_tpu.engine.service import _chunk_bucket, embed_prompts
+    svc = _svc()
+    try:
+        eng = svc.engine
+        embed_prompts(eng, [[1, 2, 3]])                       # B=1
+        embed_prompts(eng, [[1, 2, 3, 4], [5, 6, 7]])         # B=2
+        embed_prompts(eng, [[1, 2], [3, 4], [5, 6]])          # B=3 -> 4
+        embed_prompts(eng, [[1], [2], [3], [4]])              # B=4 -> 4
+        chunk = eng.cfg.prefill_chunk
+        keys = set(eng._embed_cache)
+        for (B, T) in keys:
+            assert B == _chunk_bucket(B), keys
+            assert T == _chunk_bucket(T, chunk), keys
+        # The B=3 and B=4 calls share one program (both bucket to 4).
+        assert sum(1 for (B, _) in keys if B == 4) == 1
+        assert not any(B == 3 for (B, _) in keys)
+    finally:
+        svc.stop()
+
+
+def test_chunk_bucket_values():
+    from rbg_tpu.engine.service import _chunk_bucket
+    assert [_chunk_bucket(n) for n in (1, 2, 3, 4, 5, 9)] == [1, 2, 4, 4, 8, 16]
+    assert _chunk_bucket(1, 16) == 16      # one chunk minimum
+    assert _chunk_bucket(17, 16) == 32     # chunk x pow2, not chunk multiples
+    assert _chunk_bucket(40, 16) == 64

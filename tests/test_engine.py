@@ -318,3 +318,26 @@ def test_multistep_stop_plus_page_pressure_no_leak(tiny_setup):
                 finished.add(ev.request_id)
     assert outputs[ids[0]] == expect[:3]
     assert eng.allocator.free_pages == free0, "page leak"
+
+
+def test_unified_emission_is_one_batched_fetch_per_step(tiny_setup,
+                                                        monkeypatch):
+    """The unified step emits via ONE jax.device_get over the (toks, lps)
+    pytree — not two sequential per-array syncs (the jit-hygiene fix).
+    Every device fetch during a generate must be the batched pair form."""
+    cfg, params = tiny_setup
+    eng = make_engine(params)
+    calls = []
+    real = jax.device_get
+
+    def counting(x):
+        calls.append(x)
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    out = eng.generate([[5, 9, 13, 2]], SamplingParams(max_new_tokens=4))[0]
+    assert len(out) == 4
+    assert eng.metrics["unified_steps"] > 0
+    assert calls, "emission must flow through the batched jax.device_get"
+    assert all(isinstance(c, tuple) and len(c) == 2 for c in calls), (
+        [type(c) for c in calls])

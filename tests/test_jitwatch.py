@@ -1,0 +1,220 @@
+"""jitwatch: the runtime compile & host-sync sentry. The arming matrix,
+warmup_complete gating (including a seeded violation proving the sentry
+actually fires), warn-mode counters, the hot_section sync probe, and the
+off-by-default zero-overhead contract."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from rbg_tpu.obs import names
+from rbg_tpu.utils import jitwatch
+
+
+@pytest.fixture()
+def watch(monkeypatch):
+    monkeypatch.setenv("RBG_JITWATCH", "1")
+    jitwatch.disarm()
+    yield jitwatch
+    jitwatch.disarm()
+
+
+def _compile_cataloged(program, shape=(4,)):
+    """Force a fresh XLA compile whose sym_name matches a cataloged
+    program — the same __name__-stamping the engine getters use."""
+    def f(x):
+        return x * 2 + 1
+    f.__name__ = program
+    return jax.jit(f)(jnp.ones(shape))
+
+
+# ---- arming matrix ----
+
+
+@pytest.mark.parametrize("value,expect", [
+    ("1", "raise"), ("true", "raise"), ("warn", "warn"),
+    ("0", ""), ("false", ""), ("off", ""), ("", ""),
+])
+def test_arming_matrix(monkeypatch, value, expect):
+    monkeypatch.setenv("RBG_JITWATCH", value)
+    assert jitwatch.mode() == expect
+    assert jitwatch.enabled() == bool(expect)
+
+
+def test_off_by_default_nothing_patched(monkeypatch):
+    monkeypatch.delenv("RBG_JITWATCH", raising=False)
+    jitwatch.disarm()
+    from jax._src import compiler
+    from jax._src.array import ArrayImpl
+    assert compiler.backend_compile.__name__ != "traced_backend_compile"
+    item = getattr(ArrayImpl, "item", None)
+    assert item is None or not item.__name__.startswith("jitwatch_")
+    assert jax.device_get.__name__ != "traced_device_get"
+    # hot_section without hooks is a no-op, not an error.
+    with jitwatch.hot_section("cold", strict=True):
+        pass
+
+
+def test_disarm_restores_all_seams(watch):
+    from jax._src import compiler
+    orig_compile = compiler.backend_compile
+    orig_get = jax.device_get
+    watch.arm()
+    assert compiler.backend_compile is not orig_compile
+    assert jax.device_get is not orig_get
+    watch.disarm()
+    assert compiler.backend_compile is orig_compile
+    assert jax.device_get is orig_get
+
+
+# ---- warmup_complete gating ----
+
+
+def test_sentry_fires_on_post_warmup_cataloged_compile(watch):
+    """The seeded fixture: a cataloged program compiling AFTER the gate
+    must raise — this is the proof the sentry is live, not decorative."""
+    watch.arm()
+    _compile_cataloged(names.PROGRAM_FUSED_DECODE)       # warmup set
+    n = watch.warmup_complete()
+    assert n >= 1 and watch.gate_armed()
+    assert names.PROGRAM_FUSED_DECODE in watch.warmed_programs()
+    with pytest.raises(watch.JitCompileError):
+        _compile_cataloged(names.PROGRAM_FUSED_DECODE, shape=(8,))
+    assert watch.violations()
+    assert watch.unwarmed_by_program() == {names.PROGRAM_FUSED_DECODE: 1}
+
+
+def test_pre_gate_compiles_are_the_blessed_warmup_set(watch):
+    watch.arm()
+    _compile_cataloged(names.PROGRAM_RAGGED_FWD)
+    _compile_cataloged(names.PROGRAM_SAMPLER)
+    watch.warmup_complete()
+    assert {names.PROGRAM_RAGGED_FWD,
+            names.PROGRAM_SAMPLER} <= watch.warmed_programs()
+    assert watch.violations() == []
+    assert watch.counters()["rbg_jit_unwarmed_compiles_total"] == 0.0
+
+
+def test_uncataloged_compiles_never_gate(watch):
+    """Eager-op scaffolding and test helpers compile freely post-gate:
+    only the PROGRAMS catalog is the contract."""
+    watch.arm()
+    watch.warmup_complete()
+
+    def f(x):
+        return x + 3
+    f.__name__ = "totally_uncataloged_program"
+    jax.jit(f)(jnp.ones(3))
+    assert watch.violations() == []
+    recs = [r for r in watch.compiles()
+            if r["program"] == "totally_uncataloged_program"]
+    assert recs and recs[0]["post_warmup"] and not recs[0]["violation"]
+
+
+def test_violation_names_program_and_origin(watch):
+    watch.arm()
+    watch.warmup_complete()
+    with pytest.raises(watch.JitCompileError) as ei:
+        _compile_cataloged(names.PROGRAM_PD_HEAD)
+    assert names.PROGRAM_PD_HEAD in str(ei.value)
+    assert "after warmup_complete()" in str(ei.value)
+
+
+def test_warn_mode_counts_instead_of_raising(monkeypatch):
+    monkeypatch.setenv("RBG_JITWATCH", "warn")
+    jitwatch.disarm()
+    try:
+        jitwatch.arm()
+        _compile_cataloged(names.PROGRAM_SAMPLER)
+        jitwatch.warmup_complete()
+        _compile_cataloged(names.PROGRAM_SAMPLER, shape=(8,))   # no raise
+        c = jitwatch.counters()
+        assert c["rbg_jit_unwarmed_compiles_total"] == 1.0
+        assert c["rbg_jit_compiles_total"] >= 2.0
+        assert jitwatch.unwarmed_by_program() == {names.PROGRAM_SAMPLER: 1}
+        assert len(jitwatch.violations()) == 1
+        assert len(jitwatch.unwarmed()) == 1
+    finally:
+        jitwatch.disarm()
+
+
+def test_reset_clears_records_but_keeps_hooks(watch):
+    watch.arm()
+    _compile_cataloged(names.PROGRAM_RAGGED_FWD)
+    watch.warmup_complete()
+    watch.reset()
+    assert not watch.gate_armed()
+    assert watch.compiles() == [] and watch.warmed_programs() == set()
+    from jax._src import compiler
+    assert compiler.backend_compile.__name__ == "traced_backend_compile"
+
+
+def test_warmup_complete_without_arm_is_harmless(monkeypatch):
+    monkeypatch.delenv("RBG_JITWATCH", raising=False)
+    jitwatch.disarm()
+    try:
+        assert jitwatch.warmup_complete() == 0
+        jnp.ones(2).block_until_ready()      # no wrappers: nothing counted
+        assert jitwatch.counters()["rbg_jit_host_syncs_total"] == 0.0
+    finally:
+        jitwatch.disarm()
+
+
+# ---- host-sync probe ----
+
+
+def test_hot_section_strict_raises_on_forcer(watch):
+    watch.arm()
+    x = jnp.ones(2)
+    with watch.hot_section("decode", strict=True):
+        with pytest.raises(watch.HostSyncError):
+            x.item()
+
+
+def test_hot_section_counts_without_strict(watch):
+    watch.arm()
+    x = jnp.arange(4)
+    before = watch.counters()["rbg_jit_host_syncs_total"]
+    with watch.hot_section("decode"):
+        float(x[0])
+    assert watch.counters()["rbg_jit_host_syncs_total"] > before
+
+
+def test_gate_armed_counts_syncs_outside_hot_sections(watch):
+    watch.arm()
+    x = jnp.ones(3)
+    watch.warmup_complete()
+    base = watch.counters()["rbg_jit_host_syncs_total"]
+    x.block_until_ready()
+    assert watch.counters()["rbg_jit_host_syncs_total"] >= base + 1
+
+
+def test_syncs_before_gate_and_outside_sections_are_free(watch):
+    watch.arm()
+    x = jnp.ones(3)
+    x.block_until_ready()                     # pre-gate, not hot: untracked
+    assert watch.counters()["rbg_jit_host_syncs_total"] == 0.0
+
+
+def test_hot_section_nesting_unwinds_cleanly(watch):
+    watch.arm()
+    with watch.hot_section("outer"):
+        with watch.hot_section("inner", strict=False):
+            pass
+    # Depth unwound: a sync after the sections (gate unarmed) is free.
+    jnp.ones(2).block_until_ready()
+    assert watch.counters()["rbg_jit_host_syncs_total"] == 0.0
+
+
+# ---- catalog agreement ----
+
+
+def test_programs_catalog_names_are_stamped_constants():
+    """The PROGRAMS frozenset and the PROGRAM_* constants must agree —
+    the warmers stamp __name__ from the constants and the sentry gates on
+    the frozenset, so drift here silently disables the gate."""
+    constants = {v for k, v in vars(names).items()
+                 if k.startswith("PROGRAM_") and isinstance(v, str)}
+    assert constants == set(names.PROGRAMS)
+    assert all(p.startswith("rbg_") for p in names.PROGRAMS)

@@ -750,3 +750,52 @@ def test_layer_sliced_needs_layer_split_to_engage(tiny_setup):
                         layer_split=0, admit_layers=1)
     r = pair.generate_one(prompt, sp)
     assert r["tokens"] == expect
+
+
+def test_warm_layer_sliced_covers_first_step_sampler(tiny_setup):
+    """The jitwatch-caught warmer gap: warm_layer_sliced promises 'window
+    programs, head, default sampler' — the sampler half must actually be
+    compiled, or the first layer-sliced token pays a mid-serving compile
+    (the kvstream drill's zero_unwarmed_compiles invariant)."""
+    from rbg_tpu.engine.pd import PDStreamPair
+
+    cfg, params = tiny_setup
+    pair = PDStreamPair(ecfg(), params=params,
+                        transport=FakeICITransport(bytes_per_s=1e9,
+                                                   latency_s=0.0),
+                        layer_split=1, admit_layers=1)
+    assert pair.decode.engine._samplers == {}
+    pair.decode.warm_layer_sliced(1)
+    samplers = pair.decode.engine._samplers
+    assert (False, False, False) in samplers, sorted(samplers)
+    assert (False, False, True) in samplers, sorted(samplers)
+
+
+def test_pd_device_fetches_are_batched_pairs(tiny_setup, monkeypatch):
+    """_export_pages fetches both page slabs in ONE jax.device_get (a
+    2-tuple pytree), and the engines' emission fetches are the same
+    batched-pair form — no sequential per-array syncs anywhere on the
+    stream path."""
+    import jax as _jax
+
+    from rbg_tpu.engine import SamplingParams
+    from rbg_tpu.engine.pd import PDStreamPair
+
+    cfg, params = tiny_setup
+    calls = []
+    real = _jax.device_get
+
+    def counting(x):
+        calls.append(x)
+        return real(x)
+
+    monkeypatch.setattr(_jax, "device_get", counting)
+    pair = PDStreamPair(ecfg(), params=params,
+                        transport=FakeICITransport(bytes_per_s=1e9,
+                                                   latency_s=0.0))
+    out = pair.generate_one([3, 1, 4, 1, 5, 9, 2, 6],
+                            SamplingParams(max_new_tokens=4))
+    assert len(out["tokens"]) == 4
+    assert calls, "the export/emission fetches must use jax.device_get"
+    assert all(isinstance(c, tuple) and len(c) == 2 for c in calls), (
+        [type(c) for c in calls])

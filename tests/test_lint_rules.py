@@ -32,6 +32,9 @@ def lint_fixture(fname, rule=None):
     ("metric-name-registry", "bad_metrics.py", "good_metrics.py", 5),
     ("span-name-registry", "bad_spannames.py", "good_spannames.py", 6),
     ("thread-lifecycle", "bad_threads.py", "good_threads.py", 3),
+    ("jit-hygiene", "bad_jit.py", "good_jit.py", 10),
+    ("bucket-discipline", "bad_bucket.py", "good_bucket.py", 4),
+    ("donation-safety", "bad_donation.py", "good_donation.py", 4),
 ])
 def test_rule_fires_on_bad_and_passes_good(rule, bad, good, min_bad):
     bad_findings = [f for f in lint_fixture(bad, rule) if f.rule == rule]
@@ -66,8 +69,9 @@ def test_span_catalog_audit_flags_unregistered_and_duplicates(tmp_path):
 
 def test_rule_catalog_names_match():
     assert set(rule_catalog()) == {
-        "blocking-in-critical-section", "deadline-hygiene",
-        "error-code-registry", "guarded-by", "metric-name-registry",
+        "blocking-in-critical-section", "bucket-discipline",
+        "deadline-hygiene", "donation-safety", "error-code-registry",
+        "guarded-by", "jit-hygiene", "metric-name-registry",
         "span-name-registry", "thread-lifecycle"}
 
 
@@ -290,6 +294,80 @@ def test_guardedby_self_acquiring_helper_is_clean(tmp_path):
     assert run_lint([str(p)], make_rules(["guarded-by"])) == []
 
 
+# ---- jit-hygiene / bucket-discipline / donation-safety edges ----
+
+
+def test_jit_hygiene_silent_without_hot_path_roots(tmp_path):
+    """No # hot_path annotation in the module -> the rule has no roots
+    and must stay silent, whatever the code does."""
+    p = tmp_path / "mod.py"
+    p.write_text("import jax\n"
+                 "import jax.numpy as jnp\n"
+                 "def f():\n"
+                 "    x = jnp.zeros(4)\n"
+                 "    return float(x[0]), jax.device_get(x)\n")
+    assert run_lint([str(p)], make_rules(["jit-hygiene"])) == []
+
+
+def test_jit_hygiene_allow_with_justification_suppresses(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("import jax\n"
+                 "import jax.numpy as jnp\n"
+                 "# hot_path\n"
+                 "def serve():\n"
+                 "    x = jnp.zeros(4)\n"
+                 "    # lint: allow[jit-hygiene] the one intrinsic emission fetch\n"
+                 "    return jax.device_get(x)\n")
+    assert run_lint([str(p)], make_rules(["jit-hygiene"])) == []
+
+
+def test_bucket_catalog_audit_flags_uncataloged_annotation(tmp_path):
+    """# bucket_fn in repo code without a BUCKET_FNS catalog entry is a
+    finding — the sentry and rules gate on the catalog, not the comment."""
+    d = tmp_path / "rbg_tpu"
+    d.mkdir()
+    p = d / "mod.py"
+    p.write_text("# bucket_fn\n"
+                 "def _my_rounding(n):\n"
+                 "    return n\n")
+    findings = run_lint([str(p)], make_rules(["bucket-discipline"]))
+    assert any("not cataloged" in f.message for f in findings), (
+        [f.render() for f in findings])
+
+
+def test_bucket_catalog_audit_flags_stripped_annotation(tmp_path):
+    """A cataloged helper whose definition lost its # bucket_fn comment is
+    the reverse drift — also a finding."""
+    d = tmp_path / "rbg_tpu"
+    d.mkdir()
+    p = d / "mod.py"
+    p.write_text("def _pow2_bucket(n):\n"
+                 "    return n\n")
+    findings = run_lint([str(p)], make_rules(["bucket-discipline"]))
+    assert any("lost the # bucket_fn annotation" in f.message
+               for f in findings), [f.render() for f in findings]
+
+
+def test_bucket_fixture_helper_launders_outside_repo_paths():
+    """Outside rbg_tpu/ the catalog audit is off, but a locally-annotated
+    helper still launders (good_bucket.py relies on this)."""
+    findings = run_lint([os.path.join(FIXTURES, "good_bucket.py")],
+                        make_rules(["bucket-discipline"]),
+                        skip_fixture_dirs=False)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_donation_conditional_idiom_unions_positions():
+    """bad_donation's _get_cond assigns donate = (2,) if q else (2, 3):
+    the rule must treat BOTH positions as donated (sound
+    over-approximation) and flag each reuse."""
+    findings = [f for f in lint_fixture("bad_donation.py",
+                                        "donation-safety")]
+    cond = [f for f in findings if f.line and "b * 2" in open(
+        os.path.join(FIXTURES, "bad_donation.py")).readlines()[f.line - 1]]
+    assert len(cond) == 2, [f.render() for f in findings]
+
+
 # ---- stale-allow ----
 
 
@@ -339,9 +417,30 @@ def test_cli_json_format_fields():
     assert payload, "expected findings"
     for item in payload:
         assert set(item) == {"file", "line", "col", "rule", "message",
-                             "severity"}
+                             "severity", "fingerprint"}
+        assert len(item["fingerprint"]) == 40  # sha1 hex
     assert any(i["rule"] == "deadline-hygiene" for i in payload)
     assert all(i["severity"] in ("error", "warning") for i in payload)
+
+
+def test_cli_json_fingerprint_stable_across_line_shift(tmp_path):
+    """The fingerprint keys on file:rule:normalized-line-TEXT, so editing
+    elsewhere in the file must not churn it (the finding-tracker
+    contract); the line number itself may move."""
+    import json
+    body = ("import time as _t\n"
+            "def f():\n"
+            "    deadline = _t.monotonic() + 3.0\n"
+            "    return deadline\n")
+    p = tmp_path / "mod.py"
+    p.write_text(body)
+    r1 = _run_cli(["--format", "json", str(p)])
+    p.write_text("# a new leading comment shifts every line\n" + body)
+    r2 = _run_cli(["--format", "json", str(p)])
+    f1, = json.loads(r1.stdout)
+    f2, = json.loads(r2.stdout)
+    assert f1["line"] != f2["line"]
+    assert f1["fingerprint"] == f2["fingerprint"]
 
 
 def test_cli_changed_mode(tmp_path):

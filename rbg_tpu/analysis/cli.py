@@ -38,6 +38,48 @@ def _fingerprint(f) -> str:
     return hashlib.sha1(key.encode("utf-8")).hexdigest()
 
 
+def _apply_baseline(findings, baseline_path: str, check_stale: bool = True):
+    """Drop findings whose fingerprint the baseline blesses; report
+    baseline entries that match nothing as ``stale-baseline`` findings
+    (anchored at the baseline file) so the suppress-list cannot rot.
+    The baseline is the ``--format json`` output of a blessed run — a
+    JSON LIST of finding dicts keyed by ``fingerprint`` (anything else is
+    a usage error — a malformed file must not read as "nothing blessed is
+    clean"); extra fields are carried for humans and used only in the
+    stale message."""
+    from rbg_tpu.analysis.core import Finding
+
+    with open(baseline_path, encoding="utf-8") as fh:
+        entries = json.load(fh)
+    if not isinstance(entries, list) or any(
+            not isinstance(e, dict) or "fingerprint" not in e
+            for e in entries):
+        raise ValueError(
+            f"{baseline_path}: expected a JSON list of finding objects "
+            f"with a 'fingerprint' key (the --format json output)")
+    blessed = {e["fingerprint"]: e for e in entries}
+    seen: set = set()
+    kept = []
+    for f in findings:
+        fp = _fingerprint(f)
+        if fp in blessed:
+            seen.add(fp)
+        else:
+            kept.append(f)
+    if check_stale:
+        for fp, e in blessed.items():
+            if fp in seen:
+                continue
+            where = e.get("file", "?")
+            rule = e.get("rule", "?")
+            kept.append(Finding(
+                rule="stale-baseline", path=baseline_path, line=1, col=0,
+                message=f"entry {fp[:12]}… ([{rule}] at {where}) matches "
+                        f"no current finding — prune it (a rotting "
+                        f"baseline hides the next real finding)"))
+    return kept
+
+
 def _git_changed_files() -> Tuple[str, List[str]]:
     """(repo toplevel, changed .py files abs paths): worktree+index diff vs
     HEAD plus untracked files. Raises on any git failure."""
@@ -92,6 +134,16 @@ def run(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--include-fixtures", action="store_true",
                         help="lint tests/fixtures too (they are known-bad "
                              "by design and skipped by default)")
+    parser.add_argument("--baseline", metavar="FILE", default=None,
+                        help="suppress findings whose fingerprint appears "
+                             "in this checked-in JSON baseline (the "
+                             "--format json output of a blessed run); "
+                             "anything NEW still fails, and a baseline "
+                             "entry matching nothing is reported as a "
+                             "stale-baseline finding so the file cannot "
+                             "rot (stale detection is skipped under "
+                             "--changed: a partial run cannot prove an "
+                             "entry dead)")
     args = parser.parse_args(argv)
 
     from rbg_tpu.analysis.core import run_lint
@@ -136,6 +188,13 @@ def run(argv: Optional[List[str]] = None) -> int:
             return 0
     findings = run_lint(paths, rules,
                         skip_fixture_dirs=not args.include_fixtures)
+    if args.baseline is not None:
+        try:
+            findings = _apply_baseline(findings, args.baseline,
+                                       check_stale=not args.changed)
+        except (OSError, ValueError) as e:
+            print(f"rbg-tpu lint: --baseline: {e}", file=sys.stderr)
+            return 2
     if args.format == "json":
         print(json.dumps([{
             "file": f.path, "line": f.line, "col": f.col, "rule": f.rule,

@@ -32,7 +32,8 @@ ALLOW_RE = re.compile(
 
 # Rules implemented by the framework itself (not Rule classes): an allow
 # naming one of these is never checked for staleness against the rule set.
-BUILTIN_FINDINGS = {"io-error", "syntax-error", "lint-allow", "stale-allow"}
+BUILTIN_FINDINGS = {"io-error", "syntax-error", "lint-allow", "stale-allow",
+                    "stale-baseline"}
 
 
 @dataclasses.dataclass

@@ -14,6 +14,9 @@ from rbg_tpu.analysis.rules.jit import (BucketDiscipline, DonationSafety,
 from rbg_tpu.analysis.rules.metricnames import MetricNameRegistry
 from rbg_tpu.analysis.rules.spannames import SpanNameRegistry
 from rbg_tpu.analysis.rules.threads import ThreadLifecycle
+from rbg_tpu.analysis.rules.wire import (WireErrorCodeFlow,
+                                         WireFieldDiscipline,
+                                         WireOpRegistry)
 
 RULE_CLASSES: List[Type[Rule]] = [
     BlockingInCriticalSection,
@@ -26,6 +29,9 @@ RULE_CLASSES: List[Type[Rule]] = [
     MetricNameRegistry,
     SpanNameRegistry,
     ThreadLifecycle,
+    WireErrorCodeFlow,
+    WireFieldDiscipline,
+    WireOpRegistry,
 ]
 
 

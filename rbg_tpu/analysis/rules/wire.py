@@ -1,0 +1,982 @@
+"""Wire-contract rules: the op catalog (``api/ops.py``) audited against
+both sides of every socket.
+
+Three rules ride one shared per-file index (built once per file per run,
+cached on the :class:`FileContext`, all parsing through ``parse_module``):
+
+* ``op-registry`` — every dispatch arm (``op == "..."`` / ``op in
+  (...)``) and every client request construction (``{"op": ...}`` or
+  ``req["op"] = ...``) must name a cataloged op; in a plane's server
+  module the op must be cataloged FOR that plane. At finalize time the
+  audit runs the other direction, ``BUCKET_FNS``-style: a cataloged op
+  no handler on its plane ever dispatches is itself a finding.
+* ``field-discipline`` — handler reads of the request payload
+  (``obj.get("x")`` / ``obj["x"]`` / ``"x" in obj``) must name declared
+  request fields; reply dict literals (returned, ``send_msg``-ed, or
+  built up in a variable that is later sent) must stay within the
+  declared reply fields; client constructions must send declared request
+  fields; and client reads of a reply must name fields some cataloged
+  outcome declares — the silent-drift class. Shared reply helpers
+  (``slo_response`` and friends) are resolved through the call graph:
+  same-file helpers by direct scan, imported helpers by parsing their
+  module (memoized) and reading the returned dict literal.
+* ``error-code-flow`` — a ``"code"`` a handler puts in a reply must be
+  one of the op's declared codes (extending PR-4's "code exists" to
+  "code is legal HERE").
+
+Soundness stance: under-approximate. Anything not statically resolvable
+(dynamic keys, dicts built by foreign calls, ``**`` spreads) is skipped
+silently — the runtime wirecheck sentry covers those frames against the
+same catalog. ``_``-prefixed keys are process-local annotations and are
+always ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from rbg_tpu.analysis.core import (FileContext, Finding, Rule, call_name,
+                                   parse_module, str_const,
+                                   walk_no_nested_functions)
+
+CATALOG_MODULE = "rbg_tpu.api.ops"
+
+#: server-module path suffix → the plane its dispatch arms implement.
+PLANE_MODULES: Dict[str, str] = {
+    "rbg_tpu/runtime/admin.py": "admin",
+    "rbg_tpu/engine/server.py": "engine",
+    "rbg_tpu/kvtransfer/transport.py": "engine",
+    "rbg_tpu/engine/kvpool.py": "kvpool",
+    "rbg_tpu/engine/router.py": "router",
+}
+
+#: plane → every module suffix that must be seen before the reverse
+#: (cataloged-but-never-dispatched) audit may run for that plane.
+PLANE_SUFFIXES: Dict[str, Tuple[str, ...]] = {}
+for _sfx, _pl in PLANE_MODULES.items():
+    PLANE_SUFFIXES.setdefault(_pl, ())
+    PLANE_SUFFIXES[_pl] = PLANE_SUFFIXES[_pl] + (_sfx,)
+del _sfx, _pl
+
+#: callables whose argument is a wire reply frame (send_msg's frame is
+#: its second positional arg; the router's _send_client takes only one).
+_SEND_FRAME_ARG = {"send_msg": 1, "_send_client": 0}
+
+
+def _ops_mod():
+    import rbg_tpu.api.ops as ops
+    return ops
+
+
+def _errors_mod():
+    import rbg_tpu.api.errors as errors
+    return errors
+
+
+def _pkg_root() -> str:
+    import rbg_tpu
+    return os.path.dirname(os.path.abspath(rbg_tpu.__file__))
+
+
+def _module_path(dotted: str) -> Optional[str]:
+    if not dotted.startswith("rbg_tpu."):
+        return None
+    return os.path.join(_pkg_root(), *dotted.split(".")[1:]) + ".py"
+
+
+def _resolve_op_expr(node: ast.expr, imports: Dict[str, str]
+                     ) -> Optional[str]:
+    """The op name for a string literal or an ``api/ops`` constant
+    reference (``OP_X`` from-import or ``ops.OP_X`` module attribute);
+    None when the expression is not statically an op name."""
+    lit = str_const(node)
+    if lit is not None:
+        return lit
+    const = None
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and imports.get(node.value.id) == CATALOG_MODULE):
+        const = node.attr
+    elif (isinstance(node, ast.Name)
+          and imports.get(node.id) == f"{CATALOG_MODULE}.{node.id}"):
+        const = node.id
+    if const is not None:
+        value = getattr(_ops_mod(), const, None)
+        if isinstance(value, str):
+            return value
+    return None
+
+
+def _resolve_code_expr(node: ast.expr) -> Optional[str]:
+    """The error-code string for a literal or a ``CODE_*`` constant
+    reference (codes are globally unique strings, so provenance of the
+    import does not matter the way op constants' does)."""
+    lit = str_const(node)
+    if lit is not None:
+        return lit
+    name = None
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    if name and name.startswith("CODE_"):
+        value = getattr(_errors_mod(), name, None)
+        if isinstance(value, str):
+            return value
+    return None
+
+
+def _is_get_op(node: ast.expr) -> Optional[str]:
+    """The receiver variable name when ``node`` is ``X.get("op")``."""
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and isinstance(node.func.value, ast.Name)
+            and node.args and str_const(node.args[0]) == "op"):
+        return node.func.value.id
+    return None
+
+
+def _dict_entries(node: ast.Dict) -> List[Tuple[str, ast.expr]]:
+    """(key, value) for the constant-string keys of a dict literal
+    (``**`` spreads and computed keys are skipped)."""
+    out = []
+    for k, v in zip(node.keys, node.values):
+        key = str_const(k) if k is not None else None
+        if key is not None:
+            out.append((key, v))
+    return out
+
+
+class _Arm:
+    """One op-dispatch arm: the ops its test names, and the identity set
+    of every AST node in its body (for innermost-arm attribution)."""
+
+    __slots__ = ("ops", "if_node", "nodes", "size")
+
+    def __init__(self, ops: Tuple[str, ...], if_node: ast.If):
+        self.ops = ops
+        self.if_node = if_node
+        nodes: Set[int] = set()
+        for stmt in if_node.body:
+            for n in ast.walk(stmt):
+                nodes.add(id(n))
+        self.nodes = nodes
+        self.size = len(nodes)
+
+
+class _FnScan:
+    """Raw single-pass harvest of one function body (no nested defs)."""
+
+    __slots__ = ("fn", "payload", "is_dispatch", "arms", "dispatch_refs",
+                 "var_reads", "dict_literals", "var_dicts", "call_assigns",
+                 "sub_stores", "returned", "sent", "calls")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.payload: Optional[str] = None
+        self.is_dispatch = False
+        self.arms: List[_Arm] = []
+        self.dispatch_refs: List[Tuple[str, int, int]] = []
+        self.var_reads: List[Tuple[str, str, ast.AST]] = []
+        self.dict_literals: List[ast.Dict] = []
+        self.var_dicts: List[Tuple[str, ast.Dict]] = []
+        self.call_assigns: List[Tuple[List[str], ast.Call]] = []
+        self.sub_stores: List[Tuple[str, str, ast.expr, ast.AST]] = []
+        self.returned: List[ast.expr] = []
+        self.sent: List[ast.expr] = []
+        self.calls: List[ast.Call] = []
+
+
+def _scan_function(fn, imports: Dict[str, str]) -> _FnScan:
+    scan = _FnScan(fn)
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    op_vars: Set[str] = set()
+    if "op" in params and "obj" in params:
+        op_vars.add("op")        # dispatch-helper idiom: handle(op, obj)
+    if "obj" in params:
+        scan.payload = "obj"
+
+    nodes = list(walk_no_nested_functions(fn))
+
+    # Pass 1: op variables + payload (``op = obj.get("op")``).
+    for node in nodes:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            recv = _is_get_op(node.value)
+            if recv is not None:
+                op_vars.add(node.targets[0].id)
+                scan.payload = scan.payload or recv
+                scan.is_dispatch = True
+
+    def is_op_side(expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Name) and expr.id in op_vars:
+            return True
+        recv = _is_get_op(expr)
+        if recv is not None:
+            if scan.payload is None:
+                scan.payload = recv
+            return True
+        return False
+
+    # Pass 2: arms + dispatch refs + everything else.
+    for node in nodes:
+        if isinstance(node, ast.Compare) and len(node.ops) == 1:
+            left, cmp_op, right = node.left, node.ops[0], node.comparators[0]
+            op_side = lit_side = None
+            if is_op_side(left):
+                op_side, lit_side = left, right
+            elif is_op_side(right):
+                op_side, lit_side = right, left
+            if op_side is not None:
+                scan.is_dispatch = True
+                names: List[str] = []
+                if isinstance(cmp_op, (ast.Eq, ast.NotEq)):
+                    resolved = _resolve_op_expr(lit_side, imports)
+                    if resolved is not None:
+                        names = [resolved]
+                elif (isinstance(cmp_op, (ast.In, ast.NotIn))
+                      and isinstance(lit_side, (ast.Tuple, ast.List,
+                                                ast.Set))):
+                    for elt in lit_side.elts:
+                        resolved = _resolve_op_expr(elt, imports)
+                        if resolved is not None:
+                            names.append(resolved)
+                for name in names:
+                    scan.dispatch_refs.append(
+                        (name, node.lineno, node.col_offset))
+            elif (isinstance(cmp_op, (ast.In, ast.NotIn))
+                  and isinstance(right, ast.Name)):
+                field = str_const(left)
+                if field is not None:
+                    scan.var_reads.append((right.id, field, node))
+        elif isinstance(node, ast.Dict):
+            scan.dict_literals.append(node)
+        elif isinstance(node, ast.Assign):
+            tgt = node.targets[0] if len(node.targets) == 1 else None
+            if isinstance(tgt, ast.Name):
+                if isinstance(node.value, ast.Dict):
+                    scan.var_dicts.append((tgt.id, node.value))
+                elif isinstance(node.value, ast.Call):
+                    scan.call_assigns.append(([tgt.id], node.value))
+            elif (isinstance(tgt, ast.Tuple)
+                  and isinstance(node.value, ast.Call)):
+                names = [e.id for e in tgt.elts if isinstance(e, ast.Name)]
+                if names:
+                    scan.call_assigns.append((names, node.value))
+            elif (isinstance(tgt, ast.Subscript)
+                  and isinstance(tgt.value, ast.Name)):
+                key = str_const(tgt.slice)
+                if key is not None:
+                    scan.sub_stores.append(
+                        (tgt.value.id, key, node.value, node))
+        elif isinstance(node, ast.Return) and node.value is not None:
+            for expr in (node.value.elts
+                         if isinstance(node.value, ast.Tuple)
+                         else [node.value]):
+                scan.returned.append(expr)
+        elif isinstance(node, ast.Call):
+            scan.calls.append(node)
+            frame_arg = _SEND_FRAME_ARG.get(
+                call_name(node).rsplit(".", 1)[-1])
+            if frame_arg is not None and len(node.args) > frame_arg:
+                scan.sent.append(node.args[frame_arg])
+        elif (isinstance(node, ast.Subscript)
+              and isinstance(node.ctx, ast.Load)
+              and isinstance(node.value, ast.Name)):
+            key = str_const(node.slice)
+            if key is not None:
+                scan.var_reads.append((node.value.id, key, node))
+
+    # get/pop reads (Call nodes already collected above).
+    for call in scan.calls:
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("get", "pop")
+                and isinstance(call.func.value, ast.Name)
+                and call.args):
+            key = str_const(call.args[0])
+            if key is not None:
+                scan.var_reads.append((call.func.value.id, key, call))
+
+    # Arms: If tests whose op comparisons use == / in (not the negated
+    # guards — those name ops without scoping a body to them).
+    for node in nodes:
+        if not isinstance(node, ast.If):
+            continue
+        ops: List[str] = []
+        for sub in ast.walk(node.test):
+            if not (isinstance(sub, ast.Compare) and len(sub.ops) == 1):
+                continue
+            left, cmp_op, right = sub.left, sub.ops[0], sub.comparators[0]
+            op_side = lit_side = None
+            if is_op_side(left):
+                op_side, lit_side = left, right
+            elif is_op_side(right):
+                op_side, lit_side = right, left
+            if op_side is None:
+                continue
+            if isinstance(cmp_op, ast.Eq):
+                resolved = _resolve_op_expr(lit_side, imports)
+                if resolved is not None:
+                    ops.append(resolved)
+            elif (isinstance(cmp_op, ast.In)
+                  and isinstance(lit_side, (ast.Tuple, ast.List, ast.Set))):
+                for elt in lit_side.elts:
+                    resolved = _resolve_op_expr(elt, imports)
+                    if resolved is not None:
+                        ops.append(resolved)
+        if ops:
+            scan.arms.append(_Arm(tuple(dict.fromkeys(ops)), node))
+    return scan
+
+
+class _WireIndex:
+    """Per-file wire events, shared by the three rules (built once)."""
+
+    __slots__ = ("plane", "plane_key", "op_refs", "dispatched",
+                 "req_reads", "reply_keys", "codes", "constructions",
+                 "construction_frames", "client_reads")
+
+    def __init__(self):
+        self.plane: Optional[str] = None
+        self.plane_key: Optional[str] = None
+        #: (op, line, col, kind) — kind "dispatch" | "construct"
+        self.op_refs: List[Tuple[str, int, int, str]] = []
+        self.dispatched: Set[str] = set()
+        #: (ops or None, field, line, col, via) — ops None = loose
+        self.req_reads: List[tuple] = []
+        self.reply_keys: List[tuple] = []
+        #: (ops or None, code, line, col)
+        self.codes: List[tuple] = []
+        #: (op, field, line, col)
+        self.constructions: List[Tuple[str, str, int, int]] = []
+        #: (op, fields, has_spread, line, col) — one entry per complete
+        #: ``{"op": ...}`` dict literal (required-field audit; skipped
+        #: when a ``**`` spread hides part of the frame).
+        self.construction_frames: List[tuple] = []
+        self.client_reads: List[Tuple[str, str, int, int]] = []
+
+
+def wire_index(ctx: FileContext) -> _WireIndex:
+    cached = getattr(ctx, "_wire_index", None)
+    if cached is not None:
+        return cached
+    idx = _build_index(ctx)
+    ctx._wire_index = idx
+    return idx
+
+
+def _iter_functions(tree: ast.AST):
+    """(function node, enclosing-class methods or None) for every
+    function at any nesting depth — the stress harness defines scripted
+    backend handlers as classes inside scenario functions, and those
+    arms are part of the wire surface too. Each def is yielded exactly
+    once; ``walk_no_nested_functions`` keeps the scans disjoint."""
+    method_of: Dict[int, dict] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            methods = {s.name: s for s in node.body
+                       if isinstance(s, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            for m in methods.values():
+                method_of[id(m)] = methods
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, method_of.get(id(node))
+
+
+def _build_index(ctx: FileContext) -> _WireIndex:
+    idx = _WireIndex()
+    norm = ctx.path.replace(os.sep, "/")
+    for suffix, plane in PLANE_MODULES.items():
+        if norm.endswith(suffix):
+            idx.plane, idx.plane_key = plane, suffix
+            break
+    imports = ctx.imports()
+    mod_funcs = {s.name: s for s in ctx.tree.body
+                 if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    scans: Dict[int, _FnScan] = {}
+
+    def scan_of(fn) -> _FnScan:
+        s = scans.get(id(fn))
+        if s is None:
+            s = scans[id(fn)] = _scan_function(fn, imports)
+        return s
+
+    seen_dicts: Set[int] = set()
+    for fn, cls_methods in _iter_functions(ctx.tree):
+        _assemble(idx, scan_of(fn), cls_methods, mod_funcs, imports,
+                  scan_of, seen_dicts)
+
+    # Sweep for request constructions the function scans can't reach
+    # (lambda bodies, module-level dicts): the op name and its literal
+    # fields are still part of the wire surface.
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Dict) or id(node) in seen_dicts:
+            continue
+        entries = _dict_entries(node)
+        op_val = next((v for k, v in entries if k == "op"), None)
+        if op_val is None:
+            continue
+        resolved = _resolve_op_expr(op_val, imports)
+        if resolved is None:
+            continue
+        idx.op_refs.append(
+            (resolved, node.lineno, node.col_offset, "construct"))
+        has_spread = any(k is None for k in node.keys)
+        idx.construction_frames.append(
+            (resolved, frozenset(k for k, _v in entries if k != "op"),
+             has_spread, node.lineno, node.col_offset))
+        for key, _value in entries:
+            if key != "op":
+                idx.constructions.append(
+                    (resolved, key, node.lineno, node.col_offset))
+    return idx
+
+
+def _arm_ops_of(arms: Sequence[_Arm], node: ast.AST
+                ) -> Optional[Tuple[str, ...]]:
+    best = None
+    nid = id(node)
+    for arm in arms:
+        if nid in arm.nodes and (best is None or arm.size < best.size):
+            best = arm
+    return best.ops if best is not None else None
+
+
+def _construction_ops(call: ast.Call, imports: Dict[str, str],
+                      request_vars: Dict[str, str]) -> Set[str]:
+    """Ops of every request the call expression carries: inline
+    ``{"op": ...}`` dict literals anywhere inside it, plus request
+    variables passed by name."""
+    ops: Set[str] = set()
+    for sub in ast.walk(call):
+        if isinstance(sub, ast.Dict):
+            for key, value in _dict_entries(sub):
+                if key == "op":
+                    resolved = _resolve_op_expr(value, imports)
+                    if resolved is not None:
+                        ops.add(resolved)
+    for arg in call.args:
+        if isinstance(arg, ast.Name) and arg.id in request_vars:
+            ops.add(request_vars[arg.id])
+    return ops
+
+
+def _assemble(idx: _WireIndex, scan: _FnScan, cls_methods, mod_funcs,
+              imports: Dict[str, str], scan_of,
+              seen_dicts: Set[int]) -> None:
+    arms = scan.arms
+    for d in scan.dict_literals:
+        seen_dicts.add(id(d))
+
+    # -- op references --
+    for op, line, col in scan.dispatch_refs:
+        idx.op_refs.append((op, line, col, "dispatch"))
+        if idx.plane is not None:
+            idx.dispatched.add(op)
+
+    # -- request constructions (client side of the contract) --
+    request_vars: Dict[str, str] = {}
+    construction_dicts: Set[int] = set()
+    for var, d in scan.var_dicts:
+        for key, value in _dict_entries(d):
+            if key == "op":
+                resolved = _resolve_op_expr(value, imports)
+                if resolved is not None:
+                    request_vars[var] = resolved
+    for var, key, value, node in scan.sub_stores:
+        if key == "op":
+            resolved = _resolve_op_expr(value, imports)
+            if resolved is not None:
+                request_vars[var] = resolved
+                idx.op_refs.append(
+                    (resolved, node.lineno, node.col_offset, "construct"))
+                for v2, k2, _val2, n2 in scan.sub_stores:
+                    if v2 == var and k2 != "op":
+                        idx.constructions.append(
+                            (resolved, k2, n2.lineno, n2.col_offset))
+    for d in scan.dict_literals:
+        entries = _dict_entries(d)
+        op_val = next((v for k, v in entries if k == "op"), None)
+        if op_val is None:
+            continue
+        construction_dicts.add(id(d))
+        resolved = _resolve_op_expr(op_val, imports)
+        if resolved is None:
+            continue
+        idx.op_refs.append(
+            (resolved, d.lineno, d.col_offset, "construct"))
+        has_spread = any(k is None for k in d.keys)
+        idx.construction_frames.append(
+            (resolved, frozenset(k for k, _v in entries if k != "op"),
+             has_spread, d.lineno, d.col_offset))
+        for key, _value in entries:
+            if key != "op":
+                idx.constructions.append(
+                    (resolved, key, d.lineno, d.col_offset))
+
+    # -- client reply reads --
+    # A variable may be rebound to different ops' replies over the
+    # function body (``resp = call({"op": "history"}) ... resp =
+    # call({"op": "diff"})``): a read binds to the nearest PRECEDING
+    # assignment of its variable.
+    reply_bindings: Dict[str, List[Tuple[int, str]]] = {}
+    for targets, call in scan.call_assigns:
+        ops = _construction_ops(call, imports, request_vars)
+        if len(ops) == 1:
+            op = next(iter(ops))
+            for name in targets:
+                reply_bindings.setdefault(name, []).append(
+                    (call.lineno, op))
+    for var, field, node in scan.var_reads:
+        if field.startswith("_"):
+            continue
+        op = None
+        for lineno, bound_op in sorted(reply_bindings.get(var, ())):
+            if lineno <= node.lineno:
+                op = bound_op
+        if op is not None:
+            idx.client_reads.append(
+                (op, field, node.lineno, node.col_offset))
+
+    # The server-side contract only applies inside dispatch machinery.
+    if not scan.is_dispatch:
+        return
+
+    # -- handler request reads --
+    for var, field, node in scan.var_reads:
+        if var != scan.payload or field.startswith("_"):
+            continue
+        idx.req_reads.append((_arm_ops_of(arms, node), field,
+                              node.lineno, node.col_offset, ""))
+
+    # -- handler replies --
+    sent_dicts = [e for e in scan.sent + scan.returned
+                  if isinstance(e, ast.Dict)]
+    reply_names = {e.id for e in scan.sent + scan.returned
+                   if isinstance(e, ast.Name)}
+    for var, d in scan.var_dicts:
+        if var in reply_names and var not in request_vars:
+            sent_dicts.append(d)
+    seen_dicts: Set[int] = set()
+    for d in sent_dicts:
+        if id(d) in seen_dicts or id(d) in construction_dicts:
+            continue
+        seen_dicts.add(id(d))
+        ops = _arm_ops_of(arms, d)
+        for key, value in _dict_entries(d):
+            if key.startswith("_"):
+                continue
+            idx.reply_keys.append((ops, key, d.lineno, d.col_offset, ""))
+            if key == "code":
+                code = _resolve_code_expr(value)
+                if code is not None:
+                    idx.codes.append((ops, code, d.lineno, d.col_offset))
+    for var, key, value, node in scan.sub_stores:
+        if (var not in reply_names or var in request_vars
+                or key.startswith("_")):
+            continue
+        ops = _arm_ops_of(arms, node)
+        idx.reply_keys.append((ops, key, node.lineno, node.col_offset, ""))
+        if key == "code":
+            code = _resolve_code_expr(value)
+            if code is not None:
+                idx.codes.append((ops, code, node.lineno, node.col_offset))
+
+    # -- helper resolution through the call graph --
+    sent_or_returned = {id(e) for e in scan.sent + scan.returned}
+    for call in scan.calls:
+        ops = _arm_ops_of(arms, call)
+        payload_arg = None
+        if scan.payload is not None:
+            for i, arg in enumerate(call.args):
+                if isinstance(arg, ast.Name) and arg.id == scan.payload:
+                    payload_arg = i
+                    break
+        in_reply_position = id(call) in sent_or_returned
+        if payload_arg is None and not in_reply_position:
+            continue
+        helper = offset = None
+        fname = call_name(call)
+        func = call.func
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self" and cls_methods
+                and func.attr in cls_methods):
+            helper, offset = cls_methods[func.attr], 1
+        elif isinstance(func, ast.Name) and func.id in mod_funcs:
+            helper, offset = mod_funcs[func.id], 0
+        if helper is not None and helper is not scan.fn:
+            _fold_helper(idx, scan_of(helper), ops, payload_arg, offset,
+                         fname)
+        elif in_reply_position:
+            _fold_imported_reply(idx, call, fname, imports, ops)
+
+
+def _fold_helper(idx: _WireIndex, hscan: _FnScan,
+                 ops: Optional[Tuple[str, ...]],
+                 payload_arg: Optional[int], offset: int,
+                 fname: str) -> None:
+    """Attribute a same-file helper's payload reads and reply frames to
+    the calling arm (one level deep — helpers' own helper calls are the
+    runtime sentry's job)."""
+    via = f"via {fname}()"
+    params = [a.arg for a in (hscan.fn.args.posonlyargs
+                              + hscan.fn.args.args)]
+    payload_param = None
+    if payload_arg is not None and payload_arg + offset < len(params):
+        payload_param = params[payload_arg + offset]
+    if payload_param is not None:
+        for var, field, node in hscan.var_reads:
+            if var == payload_param and not field.startswith("_"):
+                idx.req_reads.append(
+                    (ops, field, node.lineno, node.col_offset, via))
+    sent_dicts = [e for e in hscan.sent + hscan.returned
+                  if isinstance(e, ast.Dict)]
+    reply_names = {e.id for e in hscan.sent + hscan.returned
+                   if isinstance(e, ast.Name)}
+    for var, d in hscan.var_dicts:
+        if var in reply_names:
+            sent_dicts.append(d)
+    seen: Set[int] = set()
+    for d in sent_dicts:
+        if id(d) in seen:
+            continue
+        seen.add(id(d))
+        entries = _dict_entries(d)
+        if any(k == "op" for k, _v in entries):
+            continue
+        for key, value in entries:
+            if key.startswith("_"):
+                continue
+            idx.reply_keys.append((ops, key, d.lineno, d.col_offset, via))
+            if key == "code":
+                code = _resolve_code_expr(value)
+                if code is not None:
+                    idx.codes.append((ops, code, d.lineno, d.col_offset))
+    for var, key, value, node in hscan.sub_stores:
+        if var not in reply_names or key.startswith("_"):
+            continue
+        idx.reply_keys.append(
+            (ops, key, node.lineno, node.col_offset, via))
+        if key == "code":
+            code = _resolve_code_expr(value)
+            if code is not None:
+                idx.codes.append((ops, code, node.lineno,
+                                  node.col_offset))
+
+
+def _fold_imported_reply(idx: _WireIndex, call: ast.Call, fname: str,
+                         imports: Dict[str, str],
+                         ops: Optional[Tuple[str, ...]]) -> None:
+    """A reply built by an imported helper (``return slo_response(...)``):
+    parse the helper's module (memoized) and check the dict literal it
+    returns. Helpers that build their reply dynamically are skipped."""
+    dotted = None
+    func = call.func
+    if isinstance(func, ast.Name):
+        target = imports.get(func.id, "")
+        if target.endswith("." + func.id):
+            dotted = target.rsplit(".", 1)[0]
+    elif isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                        ast.Name):
+        target = imports.get(func.value.id, "")
+        if target.startswith("rbg_tpu."):
+            dotted = target
+    if not dotted or not dotted.startswith("rbg_tpu."):
+        return
+    path = _module_path(dotted)
+    if path is None or not os.path.exists(path):
+        return
+    try:
+        _src, tree = parse_module(path)
+    except (OSError, SyntaxError):
+        return
+    helper = next((s for s in tree.body
+                   if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and s.name == fname), None)
+    if helper is None:
+        return
+    via = f"via {dotted}.{fname}()"
+    for node in walk_no_nested_functions(helper):
+        if not (isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for key, _value in _dict_entries(node.value):
+            if not key.startswith("_"):
+                idx.reply_keys.append((ops, key, call.lineno,
+                                       call.col_offset, via))
+
+
+# ---- shared catalog lookups ----
+
+
+def _plane_catalog(plane: Optional[str]) -> Dict[str, object]:
+    ops = _ops_mod()
+    if plane is not None:
+        return ops.PLANES[plane]
+    return ops.MERGED
+
+
+def _op_request_fields(plane: Optional[str], op: str) -> Optional[frozenset]:
+    ops = _ops_mod()
+    if plane is not None:
+        spec = ops.PLANES[plane].get(op)
+        return ops.request_fields(spec) if spec is not None else None
+    m = ops.MERGED.get(op)
+    return m["request"] if m is not None else None
+
+
+def _op_reply_fields(plane: Optional[str], op: str) -> Optional[frozenset]:
+    ops = _ops_mod()
+    if plane is not None:
+        spec = ops.PLANES[plane].get(op)
+        return ops.reply_fields(spec) if spec is not None else None
+    m = ops.MERGED.get(op)
+    return m["reply"] if m is not None else None
+
+
+def _op_errors(plane: Optional[str], op: str) -> Optional[frozenset]:
+    ops = _ops_mod()
+    if plane is not None:
+        spec = ops.PLANES[plane].get(op)
+        return frozenset(spec.errors) if spec is not None else None
+    m = ops.MERGED.get(op)
+    return m["errors"] if m is not None else None
+
+
+def _plane_union(plane: Optional[str], kind: str) -> frozenset:
+    """Union of request / reply / error fields across a plane (or every
+    plane) — the check for frames outside any attributable arm."""
+    ops = _ops_mod()
+    cats = ([ops.PLANES[plane]] if plane is not None
+            else list(ops.PLANES.values()))
+    out: Set[str] = set()
+    for cat in cats:
+        for spec in cat.values():
+            if kind == "request":
+                out |= ops.request_fields(spec)
+            elif kind == "reply":
+                out |= ops.reply_fields(spec)
+            else:
+                out |= set(spec.errors)
+    return frozenset(out)
+
+
+def _union_over(ops_tuple: Tuple[str, ...], plane: Optional[str],
+                lookup) -> Optional[frozenset]:
+    """Field union across the arm's ops; None when no op is cataloged
+    (op-registry owns that finding — don't double-report)."""
+    out: Set[str] = set()
+    known = False
+    for op in ops_tuple:
+        fields = lookup(plane, op)
+        if fields is not None:
+            known = True
+            out |= fields
+    return frozenset(out) if known else None
+
+
+def _fmt_ops(ops_tuple: Tuple[str, ...]) -> str:
+    return "/".join(ops_tuple)
+
+
+class WireOpRegistry(Rule):
+    name = "op-registry"
+    description = ("every dispatch arm and client {\"op\": ...} request "
+                   "must name an op cataloged in api/ops.py, and every "
+                   "cataloged op must have a dispatching handler")
+
+    def __init__(self):
+        ops = _ops_mod()
+        self._ops_module = ops.__file__
+        self._dispatched: Dict[str, Set[str]] = {}
+        self._seen: Set[str] = set()
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        idx = wire_index(ctx)
+        findings: List[Finding] = []
+        ops = _ops_mod()
+        if idx.plane_key is not None:
+            self._seen.add(idx.plane_key)
+            self._dispatched.setdefault(idx.plane, set()).update(
+                idx.dispatched)
+        for op, line, col, kind in idx.op_refs:
+            if idx.plane is not None and kind == "dispatch":
+                if op not in ops.PLANES[idx.plane]:
+                    where = (f"cataloged for other plane(s) "
+                             f"{ops.MERGED[op]['planes']}"
+                             if op in ops.ALL_OP_NAMES
+                             else "not cataloged at all")
+                    findings.append(Finding(
+                        self.name, ctx.path, line, col,
+                        f"op {op!r} is dispatched on the {idx.plane} "
+                        f"plane but is {where} in api/ops.py — catalog "
+                        f"it (or fix the op name)"))
+            elif op not in ops.ALL_OP_NAMES:
+                what = ("dispatch arm" if kind == "dispatch"
+                        else "request construction")
+                findings.append(Finding(
+                    self.name, ctx.path, line, col,
+                    f"{what} names op {op!r}, which no plane catalogs "
+                    f"in api/ops.py — add an OpSpec or fix the name"))
+        return findings
+
+    def finalize(self) -> List[Finding]:
+        findings: List[Finding] = []
+        ops = _ops_mod()
+        const_lines = self._catalog_lines()
+        for plane, suffixes in PLANE_SUFFIXES.items():
+            if not all(s in self._seen for s in suffixes):
+                continue  # plane's server module(s) not in this run
+            missing = set(ops.PLANES[plane]) - self._dispatched.get(
+                plane, set())
+            for op in sorted(missing):
+                findings.append(Finding(
+                    self.name, self._ops_module,
+                    const_lines.get(op, 1), 0,
+                    f"op {op!r} is cataloged for the {plane} plane but "
+                    f"no handler in {', '.join(suffixes)} dispatches it "
+                    f"— dead contract entry (both-direction audit)"))
+        return findings
+
+    def _catalog_lines(self) -> Dict[str, int]:
+        """op name → line of its ``OP_X = "..."`` constant (for finding
+        placement). Via the run-scoped parse memo."""
+        out: Dict[str, int] = {}
+        try:
+            _src, tree = parse_module(self._ops_module)
+        except (OSError, SyntaxError):
+            return out
+        for node in tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.startswith("OP_")):
+                value = str_const(node.value)
+                if value is not None:
+                    out.setdefault(value, node.lineno)
+        return out
+
+
+class WireFieldDiscipline(Rule):
+    name = "field-discipline"
+    description = ("request/reply fields on the wire must match the "
+                   "api/ops.py contract on both the handler and the "
+                   "client side")
+
+    def __init__(self):
+        self._ops_module = _ops_mod().__file__
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        idx = wire_index(ctx)
+        ops = _ops_mod()
+        findings: List[Finding] = []
+        emitted: Set[Tuple[int, int, str]] = set()
+
+        def emit(line, col, message):
+            key = (line, col, message)
+            if key not in emitted:
+                emitted.add(key)
+                findings.append(Finding(
+                    self.name, ctx.path, line, col, message))
+
+        universal = ops.REQUEST_UNIVERSAL
+        err_fields = ops.REPLY_ERROR_FIELDS
+        for arm_ops, field, line, col, via in idx.req_reads:
+            if arm_ops is None:
+                allowed = _plane_union(idx.plane, "request") | universal
+                scope = (f"any {idx.plane} op" if idx.plane
+                         else "any cataloged op")
+            else:
+                fields = _union_over(arm_ops, idx.plane,
+                                     _op_request_fields)
+                if fields is None:
+                    continue
+                allowed = fields | universal
+                scope = f"op {_fmt_ops(arm_ops)}"
+            if field not in allowed:
+                suffix = f" ({via})" if via else ""
+                emit(line, col,
+                     f"handler reads request field {field!r} that "
+                     f"{scope} does not declare in api/ops.py{suffix}")
+        for arm_ops, key, line, col, via in idx.reply_keys:
+            if arm_ops is None:
+                allowed = _plane_union(idx.plane, "reply") | err_fields
+                scope = (f"any {idx.plane} op" if idx.plane
+                         else "any cataloged op")
+            else:
+                fields = _union_over(arm_ops, idx.plane, _op_reply_fields)
+                if fields is None:
+                    continue
+                allowed = fields | err_fields
+                scope = f"op {_fmt_ops(arm_ops)}"
+            if key not in allowed:
+                suffix = f" ({via})" if via else ""
+                emit(line, col,
+                     f"handler reply sets field {key!r} that {scope} "
+                     f"does not declare in api/ops.py{suffix}")
+        for op, field, line, col in idx.constructions:
+            merged = ops.MERGED.get(op)
+            if merged is None or field.startswith("_"):
+                continue
+            if field not in merged["request"] | universal:
+                emit(line, col,
+                     f"request construction for op {op!r} sends field "
+                     f"{field!r} that no plane's contract declares")
+        for op, fields, has_spread, line, col in idx.construction_frames:
+            merged = ops.MERGED.get(op)
+            if merged is None or has_spread:
+                continue  # spreads hide part of the frame — sentry's job
+            missing = merged["required"] - fields - universal
+            if missing:
+                emit(line, col,
+                     f"request construction for op {op!r} omits required "
+                     f"field(s) {sorted(missing)} (api/ops.py)")
+        framing = ops.FRAMING_FIELDS
+        for op, field, line, col in idx.client_reads:
+            merged = ops.MERGED.get(op)
+            if merged is None:
+                continue
+            if field not in merged["reply"] | err_fields | framing:
+                emit(line, col,
+                     f"client reads reply field {field!r} of op {op!r} "
+                     f"that no cataloged outcome declares — silent "
+                     f"drift (api/ops.py)")
+        return findings
+
+
+class WireErrorCodeFlow(Rule):
+    name = "error-code-flow"
+    description = ("error codes a handler returns must be declared for "
+                   "that op in api/ops.py (legal HERE, not merely "
+                   "existing)")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        idx = wire_index(ctx)
+        findings: List[Finding] = []
+        seen: Set[Tuple[int, int, str]] = set()
+        for arm_ops, code, line, col in idx.codes:
+            if arm_ops is None:
+                allowed = _plane_union(idx.plane, "errors")
+                scope = (f"any {idx.plane} op" if idx.plane
+                         else "any cataloged op")
+            else:
+                errs = _union_over(arm_ops, idx.plane, _op_errors)
+                if errs is None:
+                    continue
+                allowed = errs
+                scope = f"op {_fmt_ops(arm_ops)}"
+            if code not in allowed:
+                key = (line, col, code)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    self.name, ctx.path, line, col,
+                    f"error code {code!r} is not declared for {scope} "
+                    f"in api/ops.py — declare it on the OpSpec or stop "
+                    f"returning it"))
+        return findings

@@ -12,6 +12,9 @@ from __future__ import annotations
 import os
 import sys
 
+from rbg_tpu.api.ops import (OP_DELETE, OP_DIFF, OP_EVENTS, OP_HISTORY,
+                             OP_LIST, OP_STATUS, OP_TRACES, OP_UNDO)
+
 
 def register(sub) -> None:
     ap = sub.add_parser("apply", help="apply manifests to an in-process plane and wait")
@@ -386,7 +389,7 @@ def cmd_migrate_state(args) -> int:
 
 
 def cmd_status(args) -> int:
-    st = _admin_call(args.admin, {"op": "status", "name": args.name,
+    st = _admin_call(args.admin, {"op": OP_STATUS, "name": args.name,
                                   "namespace": args.namespace},
                      token=getattr(args, 'token', None),
                        tls_ca=getattr(args, 'tls_ca', None))
@@ -404,7 +407,7 @@ def cmd_status(args) -> int:
 
 
 def cmd_get(args) -> int:
-    resp = _admin_call(args.admin, {"op": "list", "kind": args.kind,
+    resp = _admin_call(args.admin, {"op": OP_LIST, "kind": args.kind,
                                     "namespace": args.namespace},
                        token=getattr(args, 'token', None),
                        tls_ca=getattr(args, 'tls_ca', None))
@@ -415,7 +418,7 @@ def cmd_get(args) -> int:
 
 
 def cmd_delete(args) -> int:
-    _admin_call(args.admin, {"op": "delete", "kind": args.kind,
+    _admin_call(args.admin, {"op": OP_DELETE, "kind": args.kind,
                              "name": args.name, "namespace": args.namespace},
                 token=getattr(args, 'token', None),
                        tls_ca=getattr(args, 'tls_ca', None))
@@ -453,19 +456,19 @@ def cmd_schema(args) -> int:
 def cmd_rollout(args) -> int:
     base = {"name": args.name, "namespace": args.namespace}
     if args.action == "history":
-        resp = _admin_call(args.admin, {"op": "history", **base}, token=getattr(args, 'token', None),
+        resp = _admin_call(args.admin, {"op": OP_HISTORY, **base}, token=getattr(args, 'token', None),
                        tls_ca=getattr(args, 'tls_ca', None))
         print(f"{'REVISION':<10} NAME")
         for r in resp["revisions"]:
             print(f"{r['revision']:<10} {r['name']}")
         return 0
     if args.action == "diff":
-        resp = _admin_call(args.admin, {"op": "diff", "revision": args.revision, **base}, token=getattr(args, 'token', None),
+        resp = _admin_call(args.admin, {"op": OP_DIFF, "revision": args.revision, **base}, token=getattr(args, 'token', None),
                        tls_ca=getattr(args, 'tls_ca', None))
         for line in resp["diff"]:
             print(line)
         return 0
-    resp = _admin_call(args.admin, {"op": "undo", "revision": args.revision, **base}, token=getattr(args, 'token', None),
+    resp = _admin_call(args.admin, {"op": OP_UNDO, "revision": args.revision, **base}, token=getattr(args, 'token', None),
                        tls_ca=getattr(args, 'tls_ca', None))
     print(f"rolled back to revision {resp['restoredRevision']}")
     return 0
@@ -486,7 +489,7 @@ def cmd_events(args) -> int:
     import json as _json
     import time as _time
 
-    req = {"op": "events", "namespace": args.namespace,
+    req = {"op": OP_EVENTS, "namespace": args.namespace,
            "limit": args.limit}
     if args.kind:
         if not args.name:
@@ -540,7 +543,7 @@ def cmd_traces(args) -> int:
     of the exemplar→waterfall workflow (docs/observability.md)."""
     import json as _json
 
-    req = {"op": "traces", "n": args.slowest}
+    req = {"op": OP_TRACES, "n": args.slowest}
     if args.engine:
         from rbg_tpu.engine.protocol import request_once
         # The serving wire is token-gated (RBG_DATA_TOKEN, VERDICT r4 #6) —

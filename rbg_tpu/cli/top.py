@@ -24,6 +24,9 @@ import sys
 import time
 from typing import List, Optional
 
+from rbg_tpu.api.ops import (OP_AUTOSCALE, OP_CONTROLPLANE, OP_HA,
+                             OP_HEALTH, OP_METRICS, OP_SLO, OP_TOPOLOGY)
+
 REFRESH_DEFAULT_S = 2.0
 
 
@@ -51,44 +54,44 @@ def _call(addr: str, obj: dict, token: Optional[str] = None,
 
 
 def _collect_engine(addr: str, token: Optional[str], window: int) -> dict:
-    met = _call(addr, {"op": "metrics"}, token)
-    slo = _call(addr, {"op": "slo", "window": window})
+    met = _call(addr, {"op": OP_METRICS}, token)
+    slo = _call(addr, {"op": OP_SLO, "window": window})
     return {"kind": "engine", "addr": addr, "mode": met.get("mode", "?"),
             "stats": met.get("metrics") or {}, "slo": slo}
 
 
 def _collect_router(addr: str, token: Optional[str]) -> dict:
-    health = _call(addr, {"op": "health"}, token)
+    health = _call(addr, {"op": OP_HEALTH}, token)
     return {"kind": "router", "addr": addr, "health": health}
 
 
 def _collect_admin(addr: str, token: Optional[str], window: int) -> dict:
     tok = token if token is not None else os.environ.get("RBG_ADMIN_TOKEN", "")
-    resp = _call(addr, {"op": "slo", "window": window}, tok or None)
+    resp = _call(addr, {"op": OP_SLO, "window": window}, tok or None)
     out = {"kind": "admin", "addr": addr, "slo": resp}
     # Autoscaler posture (optional — older/unconfigured planes answer
     # with an error, which just omits the section).
     try:
-        auto = _call(addr, {"op": "autoscale"}, tok or None)
+        auto = _call(addr, {"op": OP_AUTOSCALE}, tok or None)
         out["autoscale"] = auto.get("autoscale")
     except (OSError, RuntimeError, ConnectionError):
         pass
     # Control-plane panel (optional for the same reason): per-controller
     # reconcile rates/latency, workqueue depth, event-recorder rate.
     try:
-        cp = _call(addr, {"op": "controlplane"}, tok or None)
+        cp = _call(addr, {"op": OP_CONTROLPLANE}, tok or None)
         out["controlplane"] = cp.get("controlplane")
     except (OSError, RuntimeError, ConnectionError):
         pass
     # Topology posture panel (optional): per-group PD shape + flip state.
     try:
-        topo = _call(addr, {"op": "topology"}, tok or None)
+        topo = _call(addr, {"op": OP_TOPOLOGY}, tok or None)
         out["topology"] = topo.get("topology")
     except (OSError, RuntimeError, ConnectionError):
         pass
     # HA panel (optional): lease holder + epoch, per-elector posture.
     try:
-        ha = _call(addr, {"op": "ha"}, tok or None)
+        ha = _call(addr, {"op": OP_HA}, tok or None)
         out["ha"] = ha.get("ha")
     except (OSError, RuntimeError, ConnectionError):
         pass

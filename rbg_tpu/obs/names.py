@@ -42,6 +42,7 @@ RACE_VIOLATIONS_TOTAL = "rbg_race_violations_total"
 JIT_COMPILES_TOTAL = "rbg_jit_compiles_total"
 JIT_UNWARMED_COMPILES_TOTAL = "rbg_jit_unwarmed_compiles_total"
 JIT_HOST_SYNCS_TOTAL = "rbg_jit_host_syncs_total"
+WIRE_CONTRACT_VIOLATIONS_TOTAL = "rbg_wire_contract_violations_total"
 TRACE_TRACES_TOTAL = "rbg_trace_traces_total"
 TRACE_SPANS_DROPPED_TOTAL = "rbg_trace_spans_dropped_total"
 SERVING_REQUESTS_FINISHED_TOTAL = "rbg_serving_requests_finished_total"
@@ -171,6 +172,7 @@ COUNTERS = frozenset({
     JIT_COMPILES_TOTAL,
     JIT_UNWARMED_COMPILES_TOTAL,
     JIT_HOST_SYNCS_TOTAL,
+    WIRE_CONTRACT_VIOLATIONS_TOTAL,
     TRACE_TRACES_TOTAL,
     TRACE_SPANS_DROPPED_TOTAL,
     SERVING_REQUESTS_FINISHED_TOTAL,
@@ -306,6 +308,8 @@ HELP = {
         "Cataloged programs compiled after warmup_complete(), per program",
     JIT_HOST_SYNCS_TOTAL:
         "Device-to-host syncs observed by the jitwatch probe",
+    WIRE_CONTRACT_VIOLATIONS_TOTAL:
+        "Wire frames violating the api/ops.py contract, per op and kind",
     TRACE_TRACES_TOTAL: "Traces finalized into the trace sink, per result",
     TRACE_SPANS_DROPPED_TOTAL:
         "Spans dropped by the per-trace span bound",

@@ -2403,6 +2403,7 @@ def _router_replay_drill(n_tokens: int) -> dict:
     retriable error carrying the smallest retry_after_s."""
     import socketserver
 
+    from rbg_tpu.api.ops import OP_GENERATE, OP_HEALTH
     from rbg_tpu.engine.protocol import (CODE_DRAINING, recv_msg,
                                          request_once, send_msg)
     from rbg_tpu.engine.router import (Handler, Registry, RouterServer,
@@ -2432,7 +2433,7 @@ def _router_replay_drill(n_tokens: int) -> dict:
                             return
                         if obj is None:
                             return
-                        if obj.get("op") == "health":
+                        if obj.get("op") == OP_HEALTH:
                             send_msg(self.request,
                                      {"ok": True,
                                       "draining": backend.draining})
@@ -2486,7 +2487,7 @@ def _router_replay_drill(n_tokens: int) -> dict:
         got: List[int] = []
         host, port = router_addr.rsplit(":", 1)
         with _socket.create_connection((host, int(port)), timeout=10) as s:
-            send_msg(s, {"op": "generate", "stream": True,
+            send_msg(s, {"op": OP_GENERATE, "stream": True,
                          "prompt": [1, 2, 3], "timeout_s": 20})
             while True:
                 frame, _, _ = recv_msg(s)
@@ -2503,7 +2504,7 @@ def _router_replay_drill(n_tokens: int) -> dict:
         steady.draining = True
         resp, _, _ = request_once(
             router_addr,
-            {"op": "generate", "prompt": [1], "timeout_s": 5}, timeout=10)
+            {"op": OP_GENERATE, "prompt": [1], "timeout_s": 5}, timeout=10)
         out["drain_ok"] = (resp is not None
                           and resp.get("code") == CODE_DRAINING
                           and resp.get("retry_after_s") == 1.5)
@@ -2812,6 +2813,7 @@ def _ha_background_stream(slot: dict, n_tokens: int):
     import socketserver
     import threading
 
+    from rbg_tpu.api.ops import OP_GENERATE, OP_HEALTH
     from rbg_tpu.engine.protocol import recv_msg, send_msg
     from rbg_tpu.engine.router import (Handler, Registry, RouterServer,
                                        RouterState)
@@ -2830,7 +2832,7 @@ def _ha_background_stream(slot: dict, n_tokens: int):
                             return
                         if obj is None:
                             return
-                        if obj.get("op") == "health":
+                        if obj.get("op") == OP_HEALTH:
                             send_msg(self.request, {"ok": True})
                             continue
                         for t in range(n_tokens):
@@ -2856,7 +2858,7 @@ def _ha_background_stream(slot: dict, n_tokens: int):
         try:
             with _socket.create_connection((host, int(port)),
                                            timeout=30) as s:
-                send_msg(s, {"op": "generate", "stream": True,
+                send_msg(s, {"op": OP_GENERATE, "stream": True,
                              "prompt": [1, 2, 3], "timeout_s": 60})
                 while True:
                     frame, _, _ = recv_msg(s)
@@ -3508,6 +3510,14 @@ def main(argv=None) -> int:
                          "recorded; a cataloged program compiling AFTER "
                          "warmup_complete() fails the run via the "
                          "zero_unwarmed_compiles invariant")
+    ap.add_argument("--wirecheck", action="store_true",
+                    help="run the scenario with the wire-contract sentry "
+                         "armed (RBG_WIRECHECK=warn unless the env var is "
+                         "already set): every frame crossing the codec "
+                         "seam is validated against api/ops.py (unknown "
+                         "op, missing required field, undeclared "
+                         "reply/error field); violations fail the run via "
+                         "the wire_contract_clean invariant")
     ap.add_argument("--trace", action="store_true",
                     help="run the scenario with request tracing armed "
                          "(obs/trace.py): per-request hop spans, the "
@@ -3547,6 +3557,15 @@ def main(argv=None) -> int:
         from rbg_tpu.utils import jitwatch
         jitwatch.disarm()
         jitwatch.arm()
+    if args.wirecheck:
+        # warn, not raise — the drill's job is to finish and REPORT;
+        # wire_contract_clean turns records into a red. Armed BEFORE
+        # scenario construction so every frame (scripted backends
+        # included) crosses the patched codec seam.
+        os.environ.setdefault("RBG_WIRECHECK", "warn")
+        from rbg_tpu.utils import wirecheck
+        wirecheck.disarm()
+        wirecheck.arm()
     if args.trace:
         # Programmatic arming (env-var route: RBG_TRACE=1). Sample 1.0 by
         # default so a drill of a few dozen requests reliably fills the
@@ -3633,6 +3652,7 @@ def main(argv=None) -> int:
         _attach_locktrace(report, args)
         _attach_racetrace(report, args)
         _attach_jitwatch(report, args)
+        _attach_wirecheck(report, args)
         _attach_trace(report, args)
         if args.json_out:
             with open(args.json_out, "w") as f:
@@ -3656,6 +3676,7 @@ def main(argv=None) -> int:
     _attach_locktrace(report, args)
     _attach_racetrace(report, args)
     _attach_jitwatch(report, args)
+    _attach_wirecheck(report, args)
     _attach_trace(report, args)
     if args.json_out:
         with open(args.json_out, "w") as f:
@@ -3671,6 +3692,8 @@ def main(argv=None) -> int:
     if report.get("racetrace", {}).get("violations"):
         return 1
     if report.get("jitwatch", {}).get("violations"):
+        return 1
+    if report.get("wirecheck", {}).get("violations"):
         return 1
     return 0
 
@@ -3706,6 +3729,25 @@ def _attach_jitwatch(report: dict, args) -> None:
         report["invariants"]["zero_unwarmed_compiles"] = (
             not jitwatch.violations())
     jitwatch.disarm()
+
+
+def _attach_wirecheck(report: dict, args) -> None:
+    """Fold the wire-contract sentry verdict into the report when
+    --wirecheck ran: frames checked, per-(op, kind) violation counts, the
+    first violation descriptions, and the wire_contract_clean invariant
+    so one fails the drill red."""
+    if not getattr(args, "wirecheck", False):
+        return
+    from rbg_tpu.utils import wirecheck
+    report["wirecheck"] = {
+        "counters": wirecheck.counters(),
+        "violations_by_key": wirecheck.violations_by_key(),
+        "violations": wirecheck.violations()[:20],
+    }
+    if "invariants" in report:
+        report["invariants"]["wire_contract_clean"] = (
+            not wirecheck.violations())
+    wirecheck.disarm()
 
 
 def _attach_trace(report: dict, args) -> None:

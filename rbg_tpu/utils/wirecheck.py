@@ -1,0 +1,255 @@
+"""Opt-in runtime wire-contract sentry — the dynamic half of the
+``op-registry`` / ``field-discipline`` / ``error-code-flow`` disciplines
+(the static rules in ``analysis/rules/wire.py`` prove the LEXICAL
+contract; this module catches what they cannot see: a frame built from a
+``**`` spread, a field injected through a dynamically-keyed store, a
+peer speaking an older protocol revision).
+
+Armed, it patches the two wire-codec seams (``protocol.send_msg`` /
+``protocol.recv_msg`` — plus the module-level from-import bindings in the
+plane servers) and validates every frame against the SAME catalog the
+lint rules read, ``rbg_tpu.api.ops``:
+
+* **Request frames** (``"op"`` present): the op must be cataloged
+  (``ops.MERGED``) and every required field — declared type without the
+  ``"?"`` optional marker — must be present. The socket's current op is
+  remembered so the reply can be attributed (kv streaming frames update
+  it, which is how the ``{ok, bytes}`` FIN ack validates against
+  ``kv_fin``'s declared response).
+
+* **Reply frames** (no ``"op"``): every key must be declared for the
+  socket's op — the union of the op's response outcomes, the error reply
+  envelope (``REPLY_ERROR_FIELDS``) and the codec's framing fields
+  (``FRAMING_FIELDS``); ``_``-prefixed keys are debug-plumbing and
+  exempt, matching the lint rule. A ``"code"`` must be one the op
+  declares (``errors`` in its :class:`~rbg_tpu.api.ops.OpSpec`).
+
+Off by default: nothing is patched, zero overhead. Armed by
+``RBG_WIRECHECK=1`` (raise :class:`WireContractError` at the seam — the
+violating frame is never sent) or ``RBG_WIRECHECK=warn`` (log + count
+``rbg_wire_contract_violations_total{op=,kind=}``, the stress-drill
+mode). ``rbg-tpu stress --wirecheck`` arms warn mode and folds the
+verdict into a ``wire_contract_clean`` invariant.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import weakref
+from typing import Dict, List, Optional
+
+log = logging.getLogger("rbg_tpu.wirecheck")
+
+ENV_VAR = "RBG_WIRECHECK"
+
+MAX_RECORDS = 500          # bound the report payload
+
+#: Violation kinds — the ``kind=`` label on
+#: ``rbg_wire_contract_violations_total``.
+KIND_UNKNOWN_OP = "unknown_op"
+KIND_MISSING_REQUIRED = "missing_required_field"
+KIND_UNDECLARED_REPLY = "undeclared_reply_field"
+KIND_UNDECLARED_CODE = "undeclared_error_code"
+
+#: Modules that bind ``send_msg``/``recv_msg`` at module level via
+#: from-import — patched alongside protocol.py when already imported.
+#: (Function-local from-imports and ``request_once`` resolve through the
+#: protocol module at call time, so patching protocol covers them; a
+#: consumer imported AFTER arm() binds the wrapper from protocol.)
+_CONSUMER_MODULES = (
+    "rbg_tpu.runtime.admin",
+    "rbg_tpu.engine.server",
+    "rbg_tpu.engine.router",
+    "rbg_tpu.engine.kvpool",
+    "rbg_tpu.engine.http_frontend",
+)
+
+
+class WireContractError(RuntimeError):
+    """A wire frame violated the ``api/ops.py`` contract catalog."""
+
+
+def mode() -> str:
+    """"" (disabled) | "raise" | "warn" — from the RBG_WIRECHECK env var."""
+    v = (os.environ.get(ENV_VAR) or "").strip().lower()
+    if not v or v in ("0", "false", "off"):
+        return ""
+    return "warn" if v == "warn" else "raise"
+
+
+def enabled() -> bool:
+    return bool(mode())
+
+
+# ---- global state ----
+
+_state = threading.Lock()
+_installed = [False]
+_saved: Dict[str, tuple] = {}       # "<module>.<attr>" -> (module, attr, orig)
+_mode = ["raise"]
+_frames = [0]                       # frames validated while armed
+_counts: Dict[tuple, int] = {}      # (op, kind) -> n
+_violations: List[str] = []
+#: socket -> op of the most recent request frame seen on it, so replies
+#: (which carry no op) can be validated against the right contract. Weak
+#: so the entry dies with the connection.
+_sock_ops: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+# ---- arming ----
+
+def arm(strict: Optional[bool] = None) -> bool:
+    """Patch the wire-codec seam (idempotent). ``strict`` overrides the
+    env mode (True = raise, False = warn). Returns True once installed."""
+    m = mode() or "raise"
+    if strict is not None:
+        m = "raise" if strict else "warn"
+    _mode[0] = m
+    if _installed[0]:
+        return True
+    from rbg_tpu.engine import protocol
+    orig_send, orig_recv = protocol.send_msg, protocol.recv_msg
+
+    def checked_send_msg(sock, obj, k_bytes=None, v_bytes=None):
+        if _installed[0]:
+            _check_frame(sock, obj)
+        return orig_send(sock, obj, k_bytes, v_bytes)
+
+    def checked_recv_msg(sock):
+        out = orig_recv(sock)
+        if _installed[0] and out and out[0] is not None:
+            _check_frame(sock, out[0])
+        return out
+
+    _patch(protocol, "send_msg", orig_send, checked_send_msg)
+    _patch(protocol, "recv_msg", orig_recv, checked_recv_msg)
+    for name in _CONSUMER_MODULES:
+        mod = sys.modules.get(name)
+        if mod is None:
+            continue
+        if getattr(mod, "send_msg", None) is orig_send:
+            _patch(mod, "send_msg", orig_send, checked_send_msg)
+        if getattr(mod, "recv_msg", None) is orig_recv:
+            _patch(mod, "recv_msg", orig_recv, checked_recv_msg)
+    _installed[0] = True
+    return True
+
+
+def _patch(mod, attr: str, orig, repl) -> None:
+    _saved[f"{mod.__name__}.{attr}"] = (mod.__name__, attr, orig)
+    setattr(mod, attr, repl)
+
+
+def disarm() -> None:
+    """Restore every patched binding and reset all state (test
+    isolation). Wrappers a late importer may still hold check
+    ``_installed`` and degrade to passthrough."""
+    for key, (mod_name, attr, orig) in list(_saved.items()):
+        mod = sys.modules.get(mod_name)
+        if mod is not None:
+            setattr(mod, attr, orig)
+        del _saved[key]
+    _installed[0] = False
+    reset()
+
+
+def reset() -> None:
+    """Clear records (the seam patches stay installed)."""
+    with _state:
+        _frames[0] = 0
+        _counts.clear()
+        _violations.clear()
+
+
+def armed() -> bool:
+    return _installed[0]
+
+
+# ---- report surface ----
+
+def violations() -> List[str]:
+    with _state:
+        return list(_violations)
+
+
+def violations_by_key() -> Dict[str, int]:
+    """``{"<op>/<kind>": n}`` — the labeled counter snapshot."""
+    with _state:
+        return {f"{op}/{kind}": n for (op, kind), n in sorted(_counts.items())}
+
+
+def counters() -> Dict[str, float]:
+    """The ``rbg_wire_*`` counter snapshot for reports."""
+    with _state:
+        return {
+            "rbg_wire_frames_checked": float(_frames[0]),
+            "rbg_wire_contract_violations_total":
+                float(sum(_counts.values())),
+        }
+
+
+# ---- validation ----
+
+def _violation(op: str, kind: str, desc: str) -> None:
+    with _state:
+        _counts[(op, kind)] = _counts.get((op, kind), 0) + 1
+        if len(_violations) < MAX_RECORDS:
+            _violations.append(desc)
+    try:
+        from rbg_tpu.obs import metrics, names
+        metrics.REGISTRY.inc(names.WIRE_CONTRACT_VIOLATIONS_TOTAL,
+                             op=op, kind=kind)
+    except Exception:   # metrics must never mask the finding
+        pass
+    if _mode[0] != "warn":
+        raise WireContractError(desc)
+    log.warning("wire contract: %s", desc)
+
+
+def _check_frame(sock, frame) -> None:
+    if not isinstance(frame, dict):
+        return
+    from rbg_tpu.api import ops
+    with _state:
+        _frames[0] += 1
+    op = frame.get("op")
+    if op is not None:
+        if sock is not None:
+            try:
+                _sock_ops[sock] = op
+            except TypeError:   # not weakref-able (test double) — fine
+                pass
+        merged = ops.MERGED.get(op)
+        if merged is None:
+            _violation(str(op), KIND_UNKNOWN_OP,
+                       f"request names op {op!r} that api/ops.py does not "
+                       f"catalog")
+            return
+        missing = merged["required"] - frame.keys()
+        if missing:
+            _violation(op, KIND_MISSING_REQUIRED,
+                       f"request for op {op!r} omits required field(s) "
+                       f"{sorted(missing)}")
+        return
+    # Reply frame: attribute to the socket's most recent request op.
+    op = _sock_ops.get(sock) if sock is not None else None
+    merged = ops.MERGED.get(op) if op else None
+    if merged is None:
+        return      # reply on an untracked socket — nothing to hold it to
+    allowed = merged["reply"] | ops.REPLY_ERROR_FIELDS | ops.FRAMING_FIELDS
+    undeclared = sorted(k for k in frame
+                        if not k.startswith("_") and k not in allowed)
+    if undeclared:
+        _violation(op, KIND_UNDECLARED_REPLY,
+                   f"reply to op {op!r} carries undeclared field(s) "
+                   f"{undeclared} (declared: {sorted(allowed)})")
+    # The sentry validates REPLY frames too — "code" below is the
+    # reply error envelope, not a request field read.
+    code = frame.get("code")  # lint: allow[field-discipline] reply envelope
+    if code is not None and code not in merged["errors"]:
+        _violation(op, KIND_UNDECLARED_CODE,
+                   f"reply to op {op!r} carries error code {code!r} not in "
+                   f"its declared set {sorted(merged['errors'])}")

@@ -87,6 +87,35 @@ assert jw.get('warmed_programs'), 'no cataloged program in the warmup set'
              "or a dead sentry in /tmp/_t1_jitwatch.json" >&2
         exit 1
     fi
+    # Dynamic complement to the wire rules (op-registry/field-discipline/
+    # error-code-flow): the overload drill with the frame validator armed
+    # at the codec seam. Every frame that crosses send_msg/recv_msg is
+    # checked against the api/ops.py catalog; one undeclared field or
+    # unknown op reds wire_contract_clean and fails this smoke. Outside
+    # the 870 s pytest budget, --lint mode only; capped at 300 s. (The
+    # overload scenario exercises the service in-process; the ha smoke
+    # below also arms --wirecheck and validates real TCP frames.)
+    echo "== rbg-tpu stress --scenario overload --wirecheck (wire-contract smoke) =="
+    if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
+            stress --scenario overload --wirecheck --clients 2 --requests 2 \
+            --max-queue 2 --max-batch 1 --timeout-s 60 --json >/tmp/_t1_wirecheck.json; then
+        echo "TIER1 WIRECHECK SMOKE FAILED — see /tmp/_t1_wirecheck.json" \
+             "(wire_contract_clean/invariants)" >&2
+        exit 1
+    fi
+    if ! python -c "
+import json
+r = json.load(open('/tmp/_t1_wirecheck.json'))
+wc = r.get('wirecheck') or {}
+assert r['invariants'].get('wire_contract_clean'), \
+    'wire contract violations: %s' % wc.get('violations_by_key')
+assert 'rbg_wire_frames_checked' in wc.get('counters', {}), \
+    'sentry report missing — --wirecheck fold did not run'
+"; then
+        echo "TIER1 WIRECHECK SMOKE FAILED — contract violations or a dead" \
+             "sentry in /tmp/_t1_wirecheck.json" >&2
+        exit 1
+    fi
     # Capacity-follows-load smoke: the autoscale drill against a live
     # mini-plane (diurnal + burst trace; the AutoscaleController must
     # raise targets within an evaluation period of the burst, drop them
@@ -235,10 +264,12 @@ assert len(curve) > 10 and any(
     # replayed writes are fenced; a live stream spans the failover) plus
     # kill-a-router-mid-stream (affected sessions re-hash and replay
     # token-exact, untouched sessions undisturbed) and the 1-vs-N ratio
-    # identity. Outside the 870 s pytest budget, --lint only; 300 s cap.
-    echo "== rbg-tpu stress --scenario ha (leader failover + router kill smoke) =="
+    # identity. Runs with --wirecheck: this is the one smoke whose frames
+    # cross real TCP, so the frame validator sees live traffic here.
+    # Outside the 870 s pytest budget, --lint only; 300 s cap.
+    echo "== rbg-tpu stress --scenario ha --wirecheck (leader failover + router kill smoke) =="
     if ! env JAX_PLATFORMS=cpu timeout -k 10 300 python -m rbg_tpu.cli.main \
-            stress --scenario ha --json >/tmp/_t1_ha.json; then
+            stress --scenario ha --wirecheck --json >/tmp/_t1_ha.json; then
         echo "TIER1 HA SMOKE FAILED — see /tmp/_t1_ha.json (invariants)" >&2
         exit 1
     fi
@@ -264,6 +295,11 @@ assert inv.get('router_kill_token_exact') \
     'router kill broke a stream: %s' % (r.get('router_kill') or {})
 assert inv.get('ratio_identical_1_vs_n'), \
     'tier ratio depends on router count: %s' % (r.get('ratio_identity') or {})
+wc = r.get('wirecheck') or {}
+assert inv.get('wire_contract_clean'), \
+    'wire contract violations on live TCP: %s' % wc.get('violations_by_key')
+assert wc.get('counters', {}).get('rbg_wire_frames_checked', 0) > 0, \
+    'wirecheck saw no frames — sentry armed too late?'
 "; then
         echo "TIER1 HA SMOKE FAILED — failover/fencing/token-exact" \
              "invariant red in /tmp/_t1_ha.json" >&2

@@ -1,0 +1,18 @@
+"""Known-bad fixture for the error-code-flow rule: handlers replying
+with error codes their op does not declare in ``api/ops.py`` (or that no
+op declares at all). Every BAD-marked line must be flagged."""
+
+
+def handle(sock, send_msg, obj):
+    op = obj.get("op")
+    if not op:
+        send_msg(sock, {"error": "x", "code": "not_a_code"})  # BAD: no op declares this
+        return
+    if op == "generate":
+        send_msg(sock, {"error": "y", "code": "quantum_flux_inverted"})  # BAD: not in catalog
+        send_msg(sock, {"error": "kv pull failed",
+                        "code": "kv_stream_failed"})  # declared for generate — clean
+        return
+    if op == "health":
+        send_msg(sock, {"error": "busy", "code": "overloaded"})  # BAD: health declares none
+        return

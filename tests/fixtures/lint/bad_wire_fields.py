@@ -1,0 +1,32 @@
+"""Known-bad fixture for the field-discipline rule: handler reads of
+undeclared request fields, replies carrying undeclared keys, client
+constructions sending undeclared or omitting required fields, and client
+reads of undeclared reply keys. Every BAD-marked line must be flagged.
+
+The ``n_pages`` prefill arm is the real drift class this rule exists
+for: the bundle header replaced ``n_pages`` with ``shape``/``dtype``
+(``protocol.bundle_to_wire``), and a stub still speaking the old shape
+rode the wire silently until the catalog pinned the contract."""
+
+
+def handle(sock, send_msg, obj):
+    op = obj.get("op")
+    if op == "generate":
+        prompt = obj.get("prompt")          # declared — clean
+        speed = obj.get("warp_factor")      # BAD: undeclared request field
+        send_msg(sock, {"tokens": [1], "addr": "10.0.0.1:1"})  # BAD: undeclared reply key
+        return prompt, speed
+    if op == "prefill":
+        n = obj.get("n_pages")              # BAD: stale pre-shape/dtype bundle field
+        send_msg(sock, {"prompt": [], "first_token": 0,
+                        "shape": [1, 4], "dtype": "float32"})
+        return n
+
+
+def client(send_msg, request_once, sock):
+    send_msg(sock, {"op": "generate", "prompt": [1], "volume": 11})  # BAD: undeclared request field
+    send_msg(sock, {"op": "generate"})  # BAD: omits required field 'prompt'
+    resp, _, _ = request_once("10.0.0.1:1", {"op": "generate", "prompt": [1]})
+    tokens = resp.get("tokens")             # declared — clean
+    where = resp.get("addr")                # BAD: reads undeclared reply key
+    return tokens, where

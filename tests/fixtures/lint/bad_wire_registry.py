@@ -1,0 +1,19 @@
+"""Known-bad fixture for the op-registry rule: dispatch arms and client
+frame constructions naming ops that ``rbg_tpu/api/ops.py`` does not
+catalog. Every BAD-marked line must be flagged."""
+
+
+def handle(sock, send_msg, obj):
+    op = obj.get("op")
+    if op == "frobnicate":  # BAD: dispatch arm for an uncataloged op
+        send_msg(sock, {"ok": True})
+        return
+    if op == "generate":    # cataloged — clean
+        send_msg(sock, {"tokens": []})
+        return
+    send_msg(sock, {"error": f"unsupported op {op!r}"})
+
+
+def client(send_msg, sock):
+    send_msg(sock, {"op": "mystery_op"})  # BAD: constructs an uncataloged op
+    send_msg(sock, {"op": "health"})      # cataloged — clean

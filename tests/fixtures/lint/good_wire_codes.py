@@ -1,0 +1,18 @@
+"""Known-good twin of bad_wire_codes.py: every error code a handler
+replies with is declared for its op in ``api/ops.py`` — resolved both
+from literals and from the ``api/errors.py`` constants."""
+
+from rbg_tpu.api.errors import CODE_DEADLINE, CODE_OVERLOADED
+
+
+def handle(sock, send_msg, obj):
+    op = obj.get("op")
+    if op == "generate":
+        send_msg(sock, {"error": "shed", "code": CODE_OVERLOADED,
+                        "retry_after_s": 0.5})
+        send_msg(sock, {"error": "too slow", "code": CODE_DEADLINE,
+                        "done": True})
+        send_msg(sock, {"error": "kv pull failed",
+                        "code": "kv_stream_failed", "done": True})
+        return
+    send_msg(sock, {"error": f"unsupported op {op!r}"})

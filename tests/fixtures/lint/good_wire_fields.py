@@ -1,0 +1,26 @@
+"""Known-good twin of bad_wire_fields.py: every handler read, reply key,
+client construction and client reply read stays inside the fields the
+``api/ops.py`` catalog declares (universal request fields and the error
+reply envelope included)."""
+
+
+def handle(sock, send_msg, obj):
+    op = obj.get("op")
+    if op == "generate":
+        prompt = obj.get("prompt")
+        deadline = obj.get("timeout_s")     # universal request field
+        send_msg(sock, {"tokens": [1], "ttft_s": 0.5})
+        return prompt, deadline
+    if op == "prefill":
+        send_msg(sock, {"prompt": [], "first_token": 0,
+                        "shape": [1, 4], "dtype": "float32"})
+        return
+    send_msg(sock, {"error": f"unsupported op {op!r}"})
+
+
+def client(send_msg, request_once, sock):
+    send_msg(sock, {"op": "generate", "prompt": [1], "timeout_s": 5})
+    resp, _, _ = request_once("10.0.0.1:1", {"op": "generate", "prompt": [1]})
+    if resp.get("error"):                   # error envelope — declared
+        return None, resp.get("retry_after_s")
+    return resp.get("tokens"), resp.get("ttft_s")

@@ -1,0 +1,21 @@
+"""Known-good twin of bad_wire_registry.py: every dispatch arm and every
+client frame construction names a cataloged op — including through the
+``api/ops.py`` constants, which the rule resolves like literals."""
+
+from rbg_tpu.api.ops import OP_HEALTH, OP_METRICS
+
+
+def handle(sock, send_msg, obj):
+    op = obj.get("op")
+    if op == OP_HEALTH:         # constant from the catalog — clean
+        send_msg(sock, {"ok": True})
+        return
+    if op == "generate":        # literal, cataloged — clean
+        send_msg(sock, {"tokens": []})
+        return
+    send_msg(sock, {"error": f"unsupported op {op!r}"})
+
+
+def client(send_msg, sock):
+    send_msg(sock, {"op": OP_METRICS})
+    send_msg(sock, {"op": "slo"})

@@ -35,6 +35,9 @@ def lint_fixture(fname, rule=None):
     ("jit-hygiene", "bad_jit.py", "good_jit.py", 10),
     ("bucket-discipline", "bad_bucket.py", "good_bucket.py", 4),
     ("donation-safety", "bad_donation.py", "good_donation.py", 4),
+    ("op-registry", "bad_wire_registry.py", "good_wire_registry.py", 2),
+    ("field-discipline", "bad_wire_fields.py", "good_wire_fields.py", 6),
+    ("error-code-flow", "bad_wire_codes.py", "good_wire_codes.py", 3),
 ])
 def test_rule_fires_on_bad_and_passes_good(rule, bad, good, min_bad):
     bad_findings = [f for f in lint_fixture(bad, rule) if f.rule == rule]
@@ -70,8 +73,9 @@ def test_span_catalog_audit_flags_unregistered_and_duplicates(tmp_path):
 def test_rule_catalog_names_match():
     assert set(rule_catalog()) == {
         "blocking-in-critical-section", "bucket-discipline",
-        "deadline-hygiene", "donation-safety", "error-code-registry",
-        "guarded-by", "jit-hygiene", "metric-name-registry",
+        "deadline-hygiene", "donation-safety", "error-code-flow",
+        "error-code-registry", "field-discipline", "guarded-by",
+        "jit-hygiene", "metric-name-registry", "op-registry",
         "span-name-registry", "thread-lifecycle"}
 
 
@@ -728,3 +732,112 @@ def test_plane_lifecycle_under_locktrace(traced):
         plane.apply(make_group("svc", simple_role("worker", replicas=2)))
         plane.wait_group_ready("svc", timeout=30)
     assert traced.inversions() == []
+
+
+# ---- wire-contract rules: drift regressions, allow sweep, baseline ----
+
+
+def test_wire_drift_regressions_stay_fixed():
+    """The two genuine drifts the wire rules surfaced (a prefill stub
+    still speaking the pre-shape/dtype bundle header with ``n_pages``; a
+    scripted backend replying an undeclared ``addr`` field) were fixed
+    in-tree — the wire rules over those test files must stay clean."""
+    wire_rules = make_rules(["op-registry", "field-discipline",
+                             "error-code-flow"])
+    here = os.path.dirname(os.path.abspath(__file__))
+    for fn in ("test_slo.py", "test_router_resilience.py"):
+        findings = run_lint([os.path.join(here, fn)], wire_rules)
+        assert findings == [], (
+            fn + ":\n" + "\n".join(f.render() for f in findings))
+
+
+def test_justified_allows_still_fire():
+    """Every in-tree `# lint: allow[rule] why` must still be load-bearing:
+    the full rule set over the files carrying them yields NO findings —
+    the allow suppresses a live finding (else stale-allow fires) and the
+    justification is present (else lint-allow fires)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    carriers = [
+        "rbg_tpu/engine/pd.py",             # jit-hygiene: KV export copy
+        "rbg_tpu/engine/engine.py",         # jit-hygiene: emission fetch
+        "rbg_tpu/engine/server.py",         # deadline-hygiene: ingress stamp
+        "rbg_tpu/utils/wirecheck.py",       # field-discipline: reply envelope
+        "tests/test_trace.py",              # span-name-registry: negative test
+    ]
+    for rel in carriers:
+        path = os.path.join(repo, rel)
+        src = open(path).read()
+        assert "# lint: allow[" in src, f"{rel}: allow comment vanished"
+        findings = run_lint([path], make_rules())
+        assert findings == [], (
+            rel + ":\n" + "\n".join(f.render() for f in findings))
+
+
+def _lint_cli(args, env):
+    return subprocess.run(
+        [sys.executable, "-m", "rbg_tpu.cli.main", "lint", *args],
+        capture_output=True, text=True, env=env, timeout=120)
+
+
+def test_cli_baseline_suppresses_and_fails_new(tmp_path):
+    """--baseline blesses exactly the fingerprinted findings: a blessed
+    run exits 0, while a NEW finding (not in the baseline) still fails."""
+    import json as _json
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "PYTHONPATH": repo_root}
+    bad = os.path.join(FIXTURES, "bad_metrics.py")
+    r = _lint_cli(["--include-fixtures", "--format", "json", bad], env)
+    assert r.returncode == 1
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(r.stdout)
+    # Everything blessed: clean exit.
+    r = _lint_cli(["--include-fixtures", "--baseline", str(baseline), bad],
+                  env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # A finding the baseline does not bless still fails.
+    other = os.path.join(FIXTURES, "bad_deadline.py")
+    r = _lint_cli(["--include-fixtures", "--baseline", str(baseline),
+                   bad, other], env)
+    assert r.returncode == 1
+    assert "deadline-hygiene" in r.stdout
+    # Malformed baseline is a usage error, not a clean pass.
+    junk = tmp_path / "junk.json"
+    junk.write_text('{"not": "a list"}')
+    junk2 = tmp_path / "junk2.json"
+    junk2.write_text('[{"no_fingerprint": true}]')
+    for p in (junk, junk2):
+        r = _lint_cli(["--include-fixtures", "--baseline", str(p), bad], env)
+        assert r.returncode == 2, r.stdout + r.stderr
+
+
+def test_cli_baseline_stale_entry_reported(tmp_path):
+    """A baseline entry matching no current finding is itself a finding
+    (stale-baseline) — the suppress-list cannot rot silently."""
+    import json as _json
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "PYTHONPATH": repo_root}
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(_json.dumps([{
+        "fingerprint": "0" * 40, "file": "gone.py",
+        "rule": "metric-name-registry"}]))
+    r = _lint_cli(["--baseline", str(baseline), str(clean)], env)
+    assert r.returncode == 1
+    assert "stale-baseline" in r.stdout
+    assert "gone.py" in r.stdout
+    # --changed cannot prove an entry dead (partial tree): stale check off.
+    # (Covered here via the in-process helper to avoid a git fixture.)
+    from rbg_tpu.analysis.cli import _apply_baseline
+    assert _apply_baseline([], str(baseline), check_stale=False) == []
+
+
+def test_checked_in_baseline_is_valid_and_empty():
+    """The repo gate's checked-in baseline (scripts/lint-baseline.json)
+    must stay parseable — and empty while the tree is clean, so a new
+    finding cannot hide in it unreviewed."""
+    import json as _json
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "scripts", "lint-baseline.json")) as fh:
+        entries = _json.load(fh)
+    assert entries == []

@@ -51,7 +51,7 @@ class _EchoBackend(socketserver.ThreadingTCPServer):
                     if obj.get("op") == "health":
                         send_msg(self.request, {"ok": True})
                         continue
-                    resp = {"tokens": [1, 2, 3], "addr": backend.addr}
+                    resp = {"tokens": [1, 2, 3]}
                     resp.update(backend.reply)
                     send_msg(self.request, resp)
 

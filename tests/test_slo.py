@@ -196,7 +196,8 @@ class _ScriptedBackend(socketserver.ThreadingTCPServer):
                     if prefill:
                         send_msg(self.request,
                                  {"prompt": obj.get("prompt"),
-                                  "first_token": 5, "n_pages": 0},
+                                  "first_token": 5, "shape": [0],
+                                  "dtype": "float32"},
                                  b"", b"")
                         continue
                     if stream_tokens and obj.get("stream"):

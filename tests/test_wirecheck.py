@@ -1,0 +1,325 @@
+"""Runtime wire-contract sentry (utils/wirecheck.py): the arming matrix
+(off by default / warn counts / strict raises / disarm restores the
+codec seam), frame validation against the api/ops.py catalog on both
+seam directions, kv-frame op tracking, and the seeded-violation drill a
+stress report folds into ``wire_contract_clean``."""
+
+import socket
+import threading
+
+import pytest
+
+from rbg_tpu.engine import protocol
+from rbg_tpu.utils import wirecheck
+
+
+@pytest.fixture
+def armed_warn(monkeypatch):
+    monkeypatch.setenv(wirecheck.ENV_VAR, "warn")
+    wirecheck.disarm()
+    wirecheck.arm()
+    yield wirecheck
+    wirecheck.disarm()
+
+
+@pytest.fixture
+def armed_strict(monkeypatch):
+    monkeypatch.setenv(wirecheck.ENV_VAR, "1")
+    wirecheck.disarm()
+    wirecheck.arm()
+    yield wirecheck
+    wirecheck.disarm()
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+# ---- arming matrix ----
+
+
+def test_off_by_default_nothing_patched(monkeypatch):
+    monkeypatch.delenv(wirecheck.ENV_VAR, raising=False)
+    assert wirecheck.mode() == ""
+    assert not wirecheck.enabled()
+    # Importing the module patches nothing: the codec seam is pristine.
+    assert not wirecheck.armed()
+    assert protocol.send_msg.__name__ == "send_msg"
+    assert protocol.recv_msg.__name__ == "recv_msg"
+
+
+@pytest.mark.parametrize("val,expect", [
+    ("1", "raise"), ("true", "raise"), ("warn", "warn"),
+    ("0", ""), ("off", ""), ("", "")])
+def test_env_mode_matrix(monkeypatch, val, expect):
+    monkeypatch.setenv(wirecheck.ENV_VAR, val)
+    assert wirecheck.mode() == expect
+
+
+def test_warn_mode_counts_without_raising(armed_warn):
+    a, b = _pair()
+    try:
+        protocol.send_msg(a, {"op": "frobnicate"})     # unknown op: counted
+        obj, _, _ = protocol.recv_msg(b)                # counted again on recv
+        assert obj["op"] == "frobnicate"
+    finally:
+        a.close(); b.close()
+    assert wirecheck.violations_by_key() == {"frobnicate/unknown_op": 2}
+    assert wirecheck.counters()["rbg_wire_contract_violations_total"] == 2.0
+    # The labeled metric counted too.
+    from rbg_tpu.obs import names
+    from rbg_tpu.obs.metrics import REGISTRY
+    assert REGISTRY.counter(names.WIRE_CONTRACT_VIOLATIONS_TOTAL,
+                            op="frobnicate", kind="unknown_op") >= 2
+
+
+def test_strict_mode_raises_at_the_seam(armed_strict):
+    a, b = _pair()
+    try:
+        with pytest.raises(wirecheck.WireContractError):
+            protocol.send_msg(a, {"op": "frobnicate"})
+        # The violating frame was never sent: the peer sees nothing.
+        with pytest.raises(wirecheck.WireContractError):
+            protocol.send_msg(a, {"op": "generate"})    # missing 'prompt'
+    finally:
+        a.close(); b.close()
+
+
+def test_disarm_restores_codec_seam(monkeypatch):
+    # Import a module-level from-importer BEFORE arming so its binding is
+    # on record (a consumer imported after arm() binds the wrapper from
+    # protocol instead — it degrades to passthrough on disarm, but its
+    # identity is not restorable, so don't assert on that path).
+    from rbg_tpu.engine import kvpool
+    monkeypatch.setenv(wirecheck.ENV_VAR, "warn")
+    wirecheck.disarm()
+    orig_send, orig_recv = protocol.send_msg, protocol.recv_msg
+    pre_send, pre_recv = kvpool.send_msg, kvpool.recv_msg
+    wirecheck.arm()
+    assert protocol.send_msg is not orig_send
+    if pre_send is orig_send:
+        # Consumer bound the original: patched alongside protocol.
+        assert kvpool.send_msg is protocol.send_msg
+        assert kvpool.recv_msg is protocol.recv_msg
+    wirecheck.disarm()
+    assert protocol.send_msg is orig_send
+    assert protocol.recv_msg is orig_recv
+    assert kvpool.send_msg is pre_send
+    assert kvpool.recv_msg is pre_recv
+    assert wirecheck.counters()["rbg_wire_frames_checked"] == 0.0
+
+
+def test_arm_is_idempotent(armed_warn):
+    patched = protocol.send_msg
+    wirecheck.arm()
+    assert protocol.send_msg is patched     # no double wrap
+
+
+# ---- frame validation ----
+
+
+def test_clean_request_reply_roundtrip(armed_warn):
+    a, b = _pair()
+    try:
+        protocol.send_msg(a, {"op": "generate", "prompt": [1, 2],
+                              "timeout_s": 5})
+        obj, _, _ = protocol.recv_msg(b)
+        protocol.send_msg(b, {"tokens": [3], "ttft_s": 0.1, "done": True})
+        resp, _, _ = protocol.recv_msg(a)
+        assert resp["tokens"] == [3]
+    finally:
+        a.close(); b.close()
+    assert wirecheck.violations() == []
+    assert wirecheck.counters()["rbg_wire_frames_checked"] == 4.0
+
+
+def test_undeclared_reply_field_flagged(armed_warn):
+    a, b = _pair()
+    try:
+        protocol.send_msg(a, {"op": "generate", "prompt": [1]})
+        protocol.recv_msg(b)
+        protocol.send_msg(b, {"tokens": [3], "addr": "10.0.0.1:1"})
+        protocol.recv_msg(a)
+    finally:
+        a.close(); b.close()
+    assert wirecheck.violations_by_key() == {
+        "generate/undeclared_reply_field": 2}     # send seam + recv seam
+    assert "addr" in wirecheck.violations()[0]
+
+
+def test_underscore_reply_keys_exempt(armed_warn):
+    """`_`-prefixed reply keys are debug plumbing (the router pops
+    `_router_t_dispatch` before forwarding) — exempt, matching the lint
+    rule."""
+    a, b = _pair()
+    try:
+        protocol.send_msg(a, {"op": "embed"})
+        protocol.recv_msg(b)
+        protocol.send_msg(b, {"embedding": [0.1], "_router_t_dispatch": 1.0})
+        protocol.recv_msg(a)
+    finally:
+        a.close(); b.close()
+    assert wirecheck.violations() == []
+
+
+def test_undeclared_error_code_flagged(armed_warn):
+    a, b = _pair()
+    try:
+        protocol.send_msg(a, {"op": "health"})
+        protocol.recv_msg(b)
+        # health declares no error codes: a shed frame on it is drift.
+        protocol.send_msg(b, {"error": "busy", "code": "overloaded"})
+        protocol.recv_msg(a)
+    finally:
+        a.close(); b.close()
+    assert wirecheck.violations_by_key() == {
+        "health/undeclared_error_code": 2}
+
+
+def test_kv_frames_update_socket_op(armed_warn):
+    """kv_* frames retarget the socket's op, so the bare `{ok, bytes}`
+    FIN ack validates against kv_fin's declared response — not against
+    the generate/prefill op that opened the connection."""
+    a, b = _pair()
+    try:
+        protocol.send_msg(a, {"op": "kv_meta", "stream_id": "s", "seq": 0,
+                              "prompt": [1], "n_pages": 1, "page_size": 8,
+                              "layers": 2, "k_page_shape": [1],
+                              "v_page_shape": [1], "dtype": "float32"})
+        protocol.recv_msg(b)
+        protocol.send_msg(a, {"op": "kv_fin", "stream_id": "s",
+                              "n_chunks": 0})
+        protocol.recv_msg(b)
+        protocol.send_msg(b, {"ok": True, "bytes": 128})
+        resp, _, _ = protocol.recv_msg(a)
+        assert resp["ok"] is True
+    finally:
+        a.close(); b.close()
+    assert wirecheck.violations() == []
+
+
+def test_binary_framing_fields_tolerated(armed_warn):
+    """send_msg adds bin_k/bin_v to the header after validation; the recv
+    side sees them on the frame and must not flag them."""
+    a, b = _pair()
+    try:
+        protocol.send_msg(a, {"op": "prefill", "prompt": [1]})
+        protocol.recv_msg(b)
+        protocol.send_msg(b, {"prompt": [1], "first_token": 2,
+                              "shape": [1, 1], "dtype": "float32"},
+                          k_bytes=b"\x00" * 4, v_bytes=b"\x00" * 4)
+        resp, k, v = protocol.recv_msg(a)
+        assert k == b"\x00" * 4 and v == b"\x00" * 4
+    finally:
+        a.close(); b.close()
+    assert wirecheck.violations() == []
+
+
+def test_reset_clears_but_keeps_patches(armed_warn):
+    protocol.send_msg.__wrapped__ = None   # attribute write must not break
+    a, b = _pair()
+    try:
+        protocol.send_msg(a, {"op": "frobnicate"})
+    finally:
+        a.close(); b.close()
+    assert wirecheck.violations()
+    wirecheck.reset()
+    assert wirecheck.violations() == []
+    assert wirecheck.armed()
+
+
+# ---- the seeded-violation drill ----
+
+
+def test_seeded_drill_scripted_backend(armed_warn):
+    """The stress-shaped drill: a scripted TCP backend replies an
+    undeclared field to a generate request; the sentry catches it at the
+    client's recv seam and the verdict fails a report's
+    wire_contract_clean invariant (the --wirecheck fold)."""
+    import socketserver
+
+    class H(socketserver.BaseRequestHandler):
+        def handle(self):
+            obj, _, _ = protocol.recv_msg(self.request)
+            assert obj.get("op") == "generate"
+            protocol.send_msg(self.request,
+                              {"tokens": [1], "done": True,
+                               "backend_addr": "10.0.0.1:1"})  # undeclared
+
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        host, port = srv.server_address
+        resp, _, _ = protocol.request_once(
+            f"{host}:{port}", {"op": "generate", "prompt": [1]}, timeout=10)
+        assert resp["tokens"] == [1]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    by_key = wirecheck.violations_by_key()
+    # Flagged at the backend's send seam and the client's recv seam.
+    assert by_key.get("generate/undeclared_reply_field", 0) >= 1, by_key
+
+    # The harness fold: the verdict becomes a red invariant.
+    from rbg_tpu.stress.harness import _attach_wirecheck
+
+    class _Args:
+        wirecheck = True
+
+    report = {"invariants": {"other": True}}
+    _attach_wirecheck(report, _Args())
+    assert report["invariants"]["wire_contract_clean"] is False
+    assert not all(report["invariants"].values())   # the drill exits 1
+    assert report["wirecheck"]["violations_by_key"] == by_key
+    assert not wirecheck.armed()                    # the fold disarms
+
+
+def test_attach_wirecheck_clean_run(monkeypatch):
+    monkeypatch.setenv(wirecheck.ENV_VAR, "warn")
+    wirecheck.disarm()
+    wirecheck.arm()
+    from rbg_tpu.stress.harness import _attach_wirecheck
+
+    class _Args:
+        wirecheck = True
+
+    report = {"invariants": {}}
+    _attach_wirecheck(report, _Args())
+    assert report["invariants"]["wire_contract_clean"] is True
+    assert not wirecheck.armed()
+
+
+def test_attach_wirecheck_noop_without_flag():
+    from rbg_tpu.stress.harness import _attach_wirecheck
+
+    class _Args:
+        wirecheck = False
+
+    report = {"invariants": {}}
+    _attach_wirecheck(report, _Args())
+    assert "wirecheck" not in report
+    assert "wire_contract_clean" not in report["invariants"]
+
+
+def test_strict_seeded_drill_raises_at_client(armed_strict):
+    """RBG_WIRECHECK=1: the undeclared reply field raises at the seam —
+    in-process here via a socketpair, the same codepath request_once
+    crosses."""
+    a, b = _pair()
+    try:
+        protocol.send_msg(a, {"op": "generate", "prompt": [1]})
+        protocol.recv_msg(b)
+        # The backend half bypasses its own send seam (raw codec) to
+        # prove the CLIENT side catches a misbehaving peer.
+        import json as _json
+        b.sendall(_json.dumps({"tokens": [1], "rogue": True}).encode()
+                  + b"\n")
+        with pytest.raises(wirecheck.WireContractError):
+            protocol.recv_msg(a)
+    finally:
+        a.close(); b.close()
